@@ -1,0 +1,10 @@
+from flink_tpu.core.keygroups import (  # noqa: F401
+    KeyGroupRange,
+    assign_to_key_group,
+    compute_key_group_for_key_hash,
+    compute_operator_index_for_key_group,
+    key_group_range_for_operator,
+)
+from flink_tpu.core.config import Configuration, ConfigOption  # noqa: F401
+from flink_tpu.core.types import RecordBatch, Schema, Field  # noqa: F401
+from flink_tpu.core.time import TimeCharacteristic, TimeDomain, Watermark  # noqa: F401

@@ -1,0 +1,108 @@
+"""Configuration system.
+
+Mirrors the contracts of the reference's string-keyed Configuration
+(flink-core/.../configuration/Configuration.java:43) with typed ConfigOption
+(ConfigOptions.java:53), re-done as plain Python. Loads ``flink-tpu-conf.yaml``
+(a flat ``key: value`` file, like GlobalConfiguration.java:36 does for
+flink-conf.yaml) without requiring a YAML dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class ConfigOption(Generic[T]):
+    key: str
+    default: Optional[T] = None
+    description: str = ""
+
+    def with_default(self, default: T) -> "ConfigOption[T]":
+        return ConfigOption(self.key, default, self.description)
+
+
+class Configuration:
+    """String-keyed config map with typed accessors."""
+
+    def __init__(self, data: Optional[dict] = None):
+        self._data: dict[str, Any] = dict(data or {})
+
+    # -- generic --------------------------------------------------------
+    def set(self, key, value) -> "Configuration":
+        self._data[key.key if isinstance(key, ConfigOption) else key] = value
+        return self
+
+    def get(self, option: ConfigOption, default=None):
+        if option.key in self._data:
+            return self._data[option.key]
+        return option.default if default is None else default
+
+    def contains(self, option: ConfigOption) -> bool:
+        return option.key in self._data
+
+    # -- typed ----------------------------------------------------------
+    def get_int(self, key: str, default: int = 0) -> int:
+        return int(self._data.get(key, default))
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        return float(self._data.get(key, default))
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._data.get(key, default)
+        if isinstance(v, str):
+            return v.strip().lower() in ("true", "1", "yes")
+        return bool(v)
+
+    def get_str(self, key: str, default: str = "") -> str:
+        return str(self._data.get(key, default))
+
+    def to_dict(self) -> dict:
+        return dict(self._data)
+
+    def merge(self, other: "Configuration") -> "Configuration":
+        out = Configuration(self._data)
+        out._data.update(other._data)
+        return out
+
+    def __repr__(self):
+        return f"Configuration({self._data!r})"
+
+
+def load_global_configuration(conf_dir: Optional[str] = None) -> Configuration:
+    """Load flink-tpu-conf.yaml from conf_dir (or $FLINK_TPU_CONF_DIR).
+
+    Parses the flat `key: value` subset of YAML (comments with #), matching
+    how the reference's GlobalConfiguration treats flink-conf.yaml.
+    """
+    conf_dir = conf_dir or os.environ.get("FLINK_TPU_CONF_DIR", "")
+    cfg = Configuration()
+    path = os.path.join(conf_dir, "flink-tpu-conf.yaml") if conf_dir else None
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or ":" not in line:
+                    continue
+                k, v = line.split(":", 1)
+                cfg.set(k.strip(), v.strip())
+    return cfg
+
+
+class CoreOptions:
+    """Registry of well-known options (ref ConfigConstants.java:29 role)."""
+
+    DEFAULT_PARALLELISM = ConfigOption("parallelism.default", 1)
+    MAX_PARALLELISM = ConfigOption("parallelism.max", 128)
+    BATCH_SIZE = ConfigOption("execution.micro-batch-size", 8192)
+    STATE_SLOTS_PER_SHARD = ConfigOption("state.backend.device.slots-per-shard", 1 << 20)
+    STATE_PROBE_LENGTH = ConfigOption("state.backend.device.probe-length", 16)
+    CHECKPOINT_INTERVAL_STEPS = ConfigOption("checkpoint.interval-steps", 0)
+    CHECKPOINT_DIR = ConfigOption("checkpoint.dir", None)
+    RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
+    RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
+    RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)

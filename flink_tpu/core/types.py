@@ -1,0 +1,145 @@
+"""Typed record batches — the unit of data flow.
+
+The reference moves one serialized record at a time through Netty buffers
+(SpanningRecordSerializer; StreamRecord wrappers, SURVEY §2.3/§3.2). The
+TPU-native unit is instead a fixed-width **struct-of-arrays micro-batch**: a
+dict of equally-sized columns plus a validity mask and optional timestamps.
+Fixed shapes keep XLA compilation stable; invalid lanes are padding.
+
+RecordBatch is a registered pytree so it can flow through jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.ops.hashing import hash64_host
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: Any  # numpy dtype-like
+    shape: Tuple[int, ...] = ()  # per-record trailing shape
+
+
+@dataclass(frozen=True)
+class Schema:
+    fields: Tuple[Field, ...]
+
+    @staticmethod
+    def of(**kwargs) -> "Schema":
+        return Schema(tuple(Field(k, v) for k, v in kwargs.items()))
+
+    def names(self):
+        return [f.name for f in self.fields]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class RecordBatch:
+    """Fixed-size columnar micro-batch.
+
+    columns:    name -> array [B, ...]
+    valid:      bool [B] — lanes carrying real records
+    timestamps: int32 [B] event-time ticks (or None)
+    key_hi/key_lo: uint32 [B] — 64-bit key identity, set after `keyBy`
+    """
+
+    columns: Dict[str, Any]
+    valid: Any
+    timestamps: Optional[Any] = None
+    key_hi: Optional[Any] = None
+    key_lo: Optional[Any] = None
+
+    @property
+    def size(self) -> int:
+        return int(self.valid.shape[0])
+
+    def with_columns(self, **cols) -> "RecordBatch":
+        new = dict(self.columns)
+        new.update(cols)
+        return RecordBatch(new, self.valid, self.timestamps, self.key_hi, self.key_lo)
+
+    def col(self, name: str):
+        return self.columns[name]
+
+    # -- pytree ---------------------------------------------------------
+    def tree_flatten(self):
+        keys = sorted(self.columns)
+        children = tuple(self.columns[k] for k in keys) + (
+            self.valid,
+            self.timestamps,
+            self.key_hi,
+            self.key_lo,
+        )
+        return children, tuple(keys)
+
+    @classmethod
+    def tree_unflatten(cls, keys, children):
+        cols = dict(zip(keys, children[: len(keys)]))
+        valid, ts, hi, lo = children[len(keys):]
+        return cls(cols, valid, ts, hi, lo)
+
+
+def make_batch(
+    columns: Dict[str, np.ndarray],
+    batch_size: int,
+    timestamps: Optional[np.ndarray] = None,
+) -> RecordBatch:
+    """Pad host columns up to batch_size and build the validity mask."""
+    n = len(next(iter(columns.values())))
+    if n > batch_size:
+        raise ValueError(f"{n} records exceed batch size {batch_size}")
+    out = {}
+    for name, arr in columns.items():
+        arr = np.asarray(arr)
+        pad = np.zeros((batch_size - n,) + arr.shape[1:], dtype=arr.dtype)
+        out[name] = np.concatenate([arr, pad], axis=0)
+    valid = np.zeros(batch_size, dtype=bool)
+    valid[:n] = True
+    ts = None
+    if timestamps is not None:
+        ts = np.zeros(batch_size, dtype=np.int32)
+        ts[:n] = np.asarray(timestamps, dtype=np.int32)
+    return RecordBatch(out, valid, ts)
+
+
+class KeyCodec:
+    """Maps arbitrary host keys <-> 64-bit device key identities.
+
+    Numeric keys hash vectorized (splitmix64); other keys via a cached
+    per-object stable hash. Keeps the reverse map so fired windows can be
+    reported with original keys (the device only ever sees the 64-bit id).
+    """
+
+    def __init__(self):
+        self._rev: dict[int, Any] = {}
+
+    def encode(self, keys: Sequence[Any]) -> Tuple[np.ndarray, np.ndarray]:
+        h = hash64_host(keys)
+        if self._rev is not None:
+            for k, hv in zip(keys, h.tolist()):
+                self._rev.setdefault(hv, k)
+        hi = (h >> np.uint64(32)).astype(np.uint32)
+        lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return hi, lo
+
+    def encode_numeric(self, keys: np.ndarray, keep_reverse: bool = True):
+        h = hash64_host(keys)
+        if keep_reverse:
+            for k, hv in zip(np.asarray(keys).tolist(), h.tolist()):
+                self._rev.setdefault(hv, k)
+        hi = (h >> np.uint64(32)).astype(np.uint32)
+        lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return hi, lo
+
+    def decode(self, hi: np.ndarray, lo: np.ndarray):
+        h = (np.asarray(hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(
+            lo, dtype=np.uint64
+        )
+        return [self._rev.get(int(v), int(v)) for v in h.tolist()]

@@ -1,0 +1,175 @@
+"""Device-resident open-addressing key index — the heart of keyed state.
+
+The reference's keyed backends resolve ``(key)`` -> state via JVM HashMap
+probes per record (HeapKeyedStateBackend/StateTable, SURVEY §2.4) or RocksDB
+point lookups. TPU-native replacement: each key-group shard owns a fixed-
+capacity open-addressing table held in HBM:
+
+    keys: uint32[C, 2]   -- (hi, lo) 64-bit key identity per slot; the
+                            all-ones row is the EMPTY sentinel.
+
+State values live in separate [C, ...] arrays indexed by slot (managed by the
+state backend), so one table serves every state descriptor of an operator.
+
+All operations are batched and jit-compatible:
+
+  * ``lookup``  — for B records, gather a P-long linear probe chain
+    ([B, P] gathers) and pick the matching or first-empty slot. No scalar
+    loops; one XLA gather + reductions.
+  * ``upsert``  — insert unseen keys via *iterative scatter-claim*: every
+    missing lane scatters its key row into its first empty slot (single
+    [2]-wide scatter => row-atomic; duplicate claims -> exactly one winner),
+    then re-looks-up. Lanes that lost a claim race retry against the updated
+    table. Rounds are bounded; with a warm key set the loop exits after the
+    first check. Duplicate keys within a batch need no dedup: they follow
+    identical probe chains and claim identical slots with identical rows.
+
+Failure is explicit: a lane whose probe chain has neither its key nor an
+empty slot reports ok=False (table over capacity) and the runtime surfaces a
+state-backend-full error, like RocksDB surfacing disk-full.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops.hashing import probe_hash
+
+EMPTY = np.uint32(0xFFFFFFFF)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class SlotTable:
+    keys: jax.Array  # uint32[C, 2]
+    probe_len: int = 16
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    def used_mask(self) -> jax.Array:
+        return ~jnp.all(self.keys == EMPTY, axis=1)
+
+    def tree_flatten(self):
+        return (self.keys,), (self.probe_len,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0])
+
+
+def create(capacity: int, probe_len: int = 16) -> SlotTable:
+    if capacity & (capacity - 1):
+        raise ValueError(f"capacity must be a power of two, got {capacity}")
+    keys = jnp.full((capacity, 2), EMPTY, dtype=jnp.uint32)
+    return SlotTable(keys, probe_len)
+
+
+def _chain(hi, lo, capacity: int, probe_len: int):
+    """[B, P] candidate slot indices along each record's probe chain."""
+    base = probe_hash(hi, lo, jnp) & jnp.uint32(capacity - 1)
+    offs = jnp.arange(probe_len, dtype=jnp.uint32)
+    return ((base[:, None] + offs[None, :]) & jnp.uint32(capacity - 1)).astype(
+        jnp.int32
+    )
+
+
+def _probe(table_keys, cand, hi, lo):
+    """Gather the chain and classify each candidate slot."""
+    rows = table_keys[cand]  # [B, P, 2]
+    t_hi, t_lo = rows[..., 0], rows[..., 1]
+    empty = (t_hi == EMPTY) & (t_lo == EMPTY)
+    match = (~empty) & (t_hi == hi[:, None]) & (t_lo == lo[:, None])
+    return match, empty
+
+
+def lookup(
+    table: SlotTable, hi: jax.Array, lo: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Find slots for a batch of keys.
+
+    Returns (slot int32[B], found bool[B]). Unfound lanes get slot=capacity
+    (out-of-range => safe to use with mode='drop' scatters / clipped gathers).
+    """
+    cand = _chain(hi, lo, table.capacity, table.probe_len)
+    match, _ = _probe(table.keys, cand, hi, lo)
+    found = match.any(axis=1)
+    slot = jnp.take_along_axis(
+        cand, jnp.argmax(match, axis=1)[:, None], axis=1
+    )[:, 0]
+    return jnp.where(found, slot, table.capacity), found
+
+
+def _lookup_or_empty(table_keys, capacity, probe_len, hi, lo):
+    cand = _chain(hi, lo, capacity, probe_len)
+    match, empty = _probe(table_keys, cand, hi, lo)
+    found = match.any(axis=1)
+    has_empty = empty.any(axis=1)
+    match_slot = jnp.take_along_axis(cand, jnp.argmax(match, 1)[:, None], 1)[:, 0]
+    empty_slot = jnp.take_along_axis(cand, jnp.argmax(empty, 1)[:, None], 1)[:, 0]
+    return found, match_slot, has_empty, empty_slot
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _upsert_impl(table_keys, hi, lo, static, valid):
+    capacity, probe_len, max_rounds = static
+
+    def cond(carry):
+        table_keys, missing, rounds = carry
+        return jnp.any(missing) & (rounds < max_rounds)
+
+    def body(carry):
+        table_keys, missing, rounds = carry
+        found, _, has_empty, empty_slot = _lookup_or_empty(
+            table_keys, capacity, probe_len, hi, lo
+        )
+        claim = missing & ~found & has_empty
+        idx = jnp.where(claim, empty_slot, capacity)
+        rows = jnp.stack([hi, lo], axis=1)
+        table_keys = table_keys.at[idx].set(rows, mode="drop")
+        found2, _, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
+        return table_keys, missing & ~found2, rounds + 1
+
+    found, slot, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
+    missing0 = valid & ~found
+    table_keys, still_missing, _ = jax.lax.while_loop(
+        cond, body, (table_keys, missing0, jnp.int32(0))
+    )
+    found, slot, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
+    ok = valid & found
+    slot = jnp.where(ok, slot, capacity)
+    return table_keys, slot, ok
+
+
+def upsert(
+    table: SlotTable, hi: jax.Array, lo: jax.Array, valid: jax.Array,
+    max_rounds: int = 8,
+) -> Tuple[SlotTable, jax.Array, jax.Array]:
+    """Insert-or-find a batch of keys.
+
+    Returns (new_table, slot int32[B], ok bool[B]). ok=False lanes were valid
+    records whose key could not be placed (chain exhausted — table too full).
+    """
+    new_keys, slot, ok = _upsert_impl(
+        table.keys, hi, lo, (table.capacity, table.probe_len, max_rounds), valid
+    )
+    return SlotTable(new_keys, table.probe_len), slot, ok
+
+
+def remove_slots(table: SlotTable, slots: jax.Array, mask: jax.Array) -> SlotTable:
+    """Mark slots empty (used by state clear / TTL eviction).
+
+    NOTE: with linear probing, removal must not break other keys' chains.
+    We therefore only use this during full-shard compaction (rebuild), not
+    point deletes; point "clear" of state zeroes the value arrays instead.
+    """
+    idx = jnp.where(mask, slots, table.capacity)
+    rows = jnp.full((slots.shape[0], 2), EMPTY, dtype=jnp.uint32)
+    return SlotTable(table.keys.at[idx].set(rows, mode="drop"), table.probe_len)

@@ -1,0 +1,106 @@
+"""Batched pre-aggregation: sort + segmented reduce.
+
+The reference combines per record (HeapReducingState.add = HashMap get ->
+user reduce -> put, SURVEY §3.2 "per-record scalar reduce"). TPU-native: a
+whole micro-batch is pre-aggregated *per (slot, pane)* in one shot, then a
+single scatter-combine touches state. For the built-in reducers this is a
+native duplicate-index scatter (`.at[].add/.min/.max`); for arbitrary
+associative combine functions we sort by segment id and run a segmented
+associative scan (the classic "flagged scan" trick), which works for any
+jnp-traceable associative op.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def segmented_reduce_sorted(values, seg_start, combine: Callable):
+    """Reduce runs of a sorted array with an arbitrary associative combine.
+
+    values:    [B, ...] sorted so equal segments are adjacent
+    seg_start: bool [B], True where a new segment begins
+    combine:   (a, b) -> c, associative, jnp-traceable
+
+    Returns [B, ...] where the *last* element of each segment holds the
+    segment's reduction (other lanes hold partial prefixes).
+    """
+
+    def seg_combine(a, b):
+        a_flag, a_val = a
+        b_flag, b_val = b
+        merged = jax.tree_util.tree_map(
+            lambda av, bv: jnp.where(
+                _bshape(b_flag, bv), bv, combine(av, bv)
+            ),
+            a_val,
+            b_val,
+        )
+        return a_flag | b_flag, merged
+
+    _, out = jax.lax.associative_scan(seg_combine, (seg_start, values))
+    return out
+
+
+def _bshape(flag, val):
+    """Broadcast a [B] bool against [B, ...] values."""
+    extra = val.ndim - flag.ndim
+    return flag.reshape(flag.shape + (1,) * extra)
+
+
+def preaggregate(seg_ids, values, valid, combine: Callable, neutral):
+    """Pre-aggregate a batch by segment id with a general associative combine.
+
+    seg_ids: int32 [B]  (e.g. slot * num_panes + pane)
+    values:  pytree of [B, ...]
+    valid:   bool [B]
+    combine: associative (a, b) -> c over the pytree leaves
+    neutral: pytree of scalars — identity element, substituted in invalid lanes
+
+    Returns (rep_ids int32[B], rep_mask bool[B], reduced values [B, ...]):
+    one representative lane per distinct segment carries the full reduction;
+    rep_mask selects it. Invalid lanes sort to the end (id = INT32_MAX).
+    """
+    big = jnp.int32(2**31 - 1)
+    ids = jnp.where(valid, seg_ids, big)
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    valid_s = valid[order]
+    vals_s = jax.tree_util.tree_map(
+        lambda v, n: jnp.where(
+            _bshape(valid_s, v[order]), v[order], jnp.asarray(n, v.dtype)
+        ),
+        values,
+        neutral,
+    )
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]]
+    )
+    reduced = segmented_reduce_sorted(vals_s, seg_start, combine)
+    # last lane of each segment = lane before the next segment start (or last)
+    seg_end = jnp.concatenate([ids_s[1:] != ids_s[:-1], jnp.ones((1,), bool)])
+    rep_mask = seg_end & (ids_s != big)
+    return ids_s, rep_mask, reduced
+
+
+def scatter_combine(target, idx, updates, mask, kind: str):
+    """Scatter a batch into state with a built-in reducer.
+
+    kind: 'add' | 'min' | 'max' | 'set'. idx lanes with mask=False must be
+    out of range already (or are forced out here); duplicates are fine for
+    add/min/max (hardware-combined) and resolved arbitrarily for 'set'.
+    """
+    safe_idx = jnp.where(mask, idx, target.shape[0])
+    at = target.at[safe_idx]
+    if kind == "add":
+        return at.add(updates, mode="drop")
+    if kind == "min":
+        return at.min(updates, mode="drop")
+    if kind == "max":
+        return at.max(updates, mode="drop")
+    if kind == "set":
+        return at.set(updates, mode="drop")
+    raise ValueError(f"unknown scatter kind {kind!r}")

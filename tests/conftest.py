@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's in-process mini-cluster testing approach (SURVEY §4):
+multi-"worker" behavior is exercised on one host by faking 8 devices.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's axon sitecustomize force-sets jax_platforms="axon,cpu",
+# which makes any jax.devices() dial the (single, possibly busy) TPU tunnel.
+# Tests must run on the virtual 8-device CPU mesh, so override it back before
+# any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

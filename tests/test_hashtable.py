@@ -1,0 +1,91 @@
+"""Device hash-slot table: batched lookup/upsert against a dict model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashing import hash64_host
+
+
+def split(h):
+    h = np.asarray(h, dtype=np.uint64)
+    return (h >> np.uint64(32)).astype(np.uint32), (h & np.uint64(0xFFFFFFFF)).astype(
+        np.uint32
+    )
+
+
+def test_upsert_then_lookup_roundtrip(rng):
+    t = hashtable.create(1024, probe_len=16)
+    keys = rng.integers(0, 2**63, 300, dtype=np.int64)
+    hi, lo = split(hash64_host(keys))
+    valid = np.ones(300, bool)
+
+    t, slot, ok = hashtable.upsert(t, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert bool(ok.all())
+    slot2, found = hashtable.lookup(t, jnp.asarray(hi), jnp.asarray(lo))
+    assert bool(found.all())
+    assert np.array_equal(np.asarray(slot), np.asarray(slot2))
+    # distinct keys -> distinct slots
+    assert len(np.unique(np.asarray(slot))) == len(np.unique(keys))
+
+
+def test_duplicate_keys_same_slot(rng):
+    t = hashtable.create(256)
+    keys = np.array([7, 7, 7, 9, 9, 7], dtype=np.int64)
+    hi, lo = split(hash64_host(keys))
+    t, slot, ok = hashtable.upsert(t, jnp.asarray(hi), jnp.asarray(lo),
+                                   jnp.ones(6, dtype=bool))
+    slot = np.asarray(slot)
+    assert bool(ok.all())
+    assert slot[0] == slot[1] == slot[2] == slot[5]
+    assert slot[3] == slot[4] != slot[0]
+
+
+def test_invalid_lanes_ignored(rng):
+    t = hashtable.create(256)
+    keys = np.arange(10, dtype=np.int64)
+    hi, lo = split(hash64_host(keys))
+    valid = np.zeros(10, bool)
+    valid[:3] = True
+    t, slot, ok = hashtable.upsert(t, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(valid))
+    assert np.asarray(ok).sum() == 3
+    # unseeded keys are not present
+    _, found = hashtable.lookup(t, jnp.asarray(hi[3:]), jnp.asarray(lo[3:]))
+    assert not bool(found.any())
+
+
+def test_incremental_batches_accumulate(rng):
+    t = hashtable.create(4096)
+    all_slots = {}
+    for step in range(5):
+        keys = rng.integers(0, 500, 256, dtype=np.int64)  # heavy overlap
+        hi, lo = split(hash64_host(keys))
+        t, slot, ok = hashtable.upsert(
+            t, jnp.asarray(hi), jnp.asarray(lo), jnp.ones(256, bool)
+        )
+        assert bool(ok.all())
+        for k, s in zip(keys.tolist(), np.asarray(slot).tolist()):
+            if k in all_slots:
+                assert all_slots[k] == s, "slot must be stable across batches"
+            all_slots[k] = s
+    used = np.asarray(t.used_mask()).sum()
+    assert used == len(all_slots)
+
+
+def test_table_overflow_reports_not_ok():
+    t = hashtable.create(64, probe_len=4)
+    keys = np.arange(200, dtype=np.int64)
+    hi, lo = split(hash64_host(keys))
+    t, slot, ok = hashtable.upsert(t, jnp.asarray(hi), jnp.asarray(lo),
+                                   jnp.ones(200, bool))
+    ok = np.asarray(ok)
+    assert not ok.all()  # can't fit 200 keys in 64 slots
+    # the ones that reported ok are genuinely findable
+    slot2, found = hashtable.lookup(t, jnp.asarray(hi), jnp.asarray(lo))
+    assert np.array_equal(np.asarray(found), ok)
+
+
+def test_capacity_must_be_power_of_two():
+    with pytest.raises(ValueError):
+        hashtable.create(1000)

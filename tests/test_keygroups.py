@@ -1,0 +1,94 @@
+"""Key-group assignment semantics (mirrors the role of the reference's
+KeyGroupRangeAssignment tests: stability, balance, range math)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+    compute_key_group_for_key_hash,
+    compute_operator_index_for_key_group,
+    key_group_range_for_operator,
+    murmur3_32,
+)
+
+
+def test_murmur_deterministic_and_scrambles():
+    a = murmur3_32(np.uint32(1))
+    b = murmur3_32(np.uint32(1))
+    c = murmur3_32(np.uint32(2))
+    assert a == b
+    assert a != c
+
+
+def test_murmur_matches_reference_vectors():
+    # Independent check against a pure-python murmur3_32 of a 4-byte LE word.
+    def ref(code):
+        def rotl(x, r):
+            return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+        k = (code * 0xCC9E2D51) & 0xFFFFFFFF
+        k = rotl(k, 15)
+        k = (k * 0x1B873593) & 0xFFFFFFFF
+        h = k
+        h = rotl(h, 13)
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+        h ^= 4
+        h ^= h >> 16
+        h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+        h ^= h >> 13
+        h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+        h ^= h >> 16
+        return h
+
+    for v in [0, 1, 42, 0xDEADBEEF, 0xFFFFFFFF]:
+        assert int(murmur3_32(np.uint32(v))) == ref(v)
+
+
+def test_key_groups_in_range_and_balanced():
+    maxp = 128
+    hashes = np.arange(100_000, dtype=np.uint32)
+    kgs = assign_to_key_group(hashes, maxp)
+    assert kgs.min() >= 0 and kgs.max() < maxp
+    counts = np.bincount(kgs, minlength=maxp)
+    assert counts.min() > 0.5 * counts.mean()
+    assert counts.max() < 1.5 * counts.mean()
+
+
+def test_ranges_partition_key_groups():
+    for maxp, par in [(128, 1), (128, 4), (128, 7), (4096, 13), (32768, 32)]:
+        seen = []
+        for op in range(par):
+            r = key_group_range_for_operator(maxp, par, op)
+            seen.extend(list(r))
+        assert seen == list(range(maxp))
+
+
+def test_operator_index_consistent_with_ranges():
+    maxp, par = 128, 7
+    for kg in range(maxp):
+        op = compute_operator_index_for_key_group(maxp, par, kg)
+        assert kg in key_group_range_for_operator(maxp, par, op)
+
+
+def test_vectorized_matches_scalar():
+    maxp = 128
+    hashes = np.random.default_rng(0).integers(0, 2**32, 1000, dtype=np.uint32)
+    vec = compute_key_group_for_key_hash(hashes, maxp)
+    for h, kg in zip(hashes[:50], vec[:50]):
+        assert int(compute_key_group_for_key_hash(np.uint32(h), maxp)) == kg
+
+
+def test_key_group_range():
+    r = KeyGroupRange(4, 10)
+    assert len(r) == 7
+    assert 4 in r and 10 in r and 11 not in r
+    assert r.intersect(KeyGroupRange(8, 20)) == KeyGroupRange(8, 10)
+    assert r.intersect(KeyGroupRange(11, 20)) == KeyGroupRange.EMPTY
+    assert len(KeyGroupRange.EMPTY) == 0
+
+
+def test_parallelism_validation():
+    with pytest.raises(ValueError):
+        key_group_range_for_operator(128, 256, 0)

@@ -1,0 +1,70 @@
+"""Segmented pre-aggregation kernels vs numpy reference."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops.segment import preaggregate, scatter_combine
+
+
+def test_preaggregate_sum_matches_numpy(rng):
+    B = 512
+    ids = rng.integers(0, 40, B).astype(np.int32)
+    vals = rng.normal(size=B).astype(np.float32)
+    valid = rng.random(B) < 0.9
+
+    rep_ids, rep_mask, reduced = preaggregate(
+        jnp.asarray(ids), jnp.asarray(vals), jnp.asarray(valid),
+        combine=lambda a, b: a + b, neutral=np.float32(0),
+    )
+    rep_ids, rep_mask, reduced = map(np.asarray, (rep_ids, rep_mask, reduced))
+
+    expect = {}
+    for i, v, ok in zip(ids, vals, valid):
+        if ok:
+            expect[i] = expect.get(i, np.float32(0)) + v
+    got = {int(i): float(r) for i, r in zip(rep_ids[rep_mask], reduced[rep_mask])}
+    assert set(got) == set(int(k) for k in expect)
+    for k, v in expect.items():
+        assert abs(got[int(k)] - float(v)) < 1e-3
+
+
+def test_preaggregate_noncommutative_associative(rng):
+    # max-with-argmax packed as (val, tag): associative, not commutative-trivial
+    B = 128
+    ids = rng.integers(0, 10, B).astype(np.int32)
+    vals = rng.normal(size=B).astype(np.float32)
+    tags = np.arange(B, dtype=np.float32)
+    valid = np.ones(B, bool)
+
+    def combine(a, b):
+        take_b = b[..., 0] > a[..., 0]
+        return jnp.where(take_b[..., None], b, a)
+
+    packed = jnp.stack([jnp.asarray(vals), jnp.asarray(tags)], axis=-1)
+    rep_ids, rep_mask, reduced = preaggregate(
+        jnp.asarray(ids), packed, jnp.asarray(valid),
+        combine=combine, neutral=np.float32(-np.inf),
+    )
+    rep_ids, rep_mask, reduced = map(np.asarray, (rep_ids, rep_mask, reduced))
+    got = {int(i): r for i, r in zip(rep_ids[rep_mask], reduced[rep_mask])}
+    for seg in np.unique(ids):
+        mask = ids == seg
+        j = np.argmax(vals[mask])
+        assert got[int(seg)][0] == vals[mask][j]
+
+
+def test_scatter_combine_kinds():
+    target = jnp.zeros(8, jnp.float32)
+    idx = jnp.asarray([1, 1, 3, 9], jnp.int32)  # 9 out of range
+    ups = jnp.asarray([2.0, 3.0, 4.0, 100.0], jnp.float32)
+    mask = jnp.asarray([True, True, True, True])
+    out = np.asarray(scatter_combine(target, idx, ups, mask, "add"))
+    assert out[1] == 5.0 and out[3] == 4.0 and out.sum() == 9.0
+
+    tmin = jnp.full(8, jnp.inf, jnp.float32)
+    out = np.asarray(scatter_combine(tmin, idx, ups, mask, "min"))
+    assert out[1] == 2.0 and out[3] == 4.0
+
+    masked = jnp.asarray([True, False, True, False])
+    out = np.asarray(scatter_combine(target, idx, ups, masked, "add"))
+    assert out[1] == 2.0 and out[3] == 4.0
