@@ -19,3 +19,5 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 """
 
 __version__ = "0.1.0"
+
+from flink_tpu.datastream.environment import StreamExecutionEnvironment  # noqa: F401,E402

@@ -99,7 +99,7 @@ class CoreOptions:
     DEFAULT_PARALLELISM = ConfigOption("parallelism.default", 1)
     MAX_PARALLELISM = ConfigOption("parallelism.max", 128)
     BATCH_SIZE = ConfigOption("execution.micro-batch-size", 8192)
-    STATE_SLOTS_PER_SHARD = ConfigOption("state.backend.device.slots-per-shard", 1 << 20)
+    STATE_SLOTS_PER_SHARD = ConfigOption("state.backend.device.slots-per-shard", 1 << 16)
     STATE_PROBE_LENGTH = ConfigOption("state.backend.device.probe-length", 16)
     CHECKPOINT_INTERVAL_STEPS = ConfigOption("checkpoint.interval-steps", 0)
     CHECKPOINT_DIR = ConfigOption("checkpoint.dir", None)

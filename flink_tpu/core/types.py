@@ -120,23 +120,19 @@ class KeyCodec:
     def __init__(self):
         self._rev: dict[int, Any] = {}
 
-    def encode(self, keys: Sequence[Any]) -> Tuple[np.ndarray, np.ndarray]:
+    def encode(self, keys, keep_reverse: bool = True):
+        """keys: numeric array (vectorized) or sequence of objects."""
         h = hash64_host(keys)
-        if self._rev is not None:
-            for k, hv in zip(keys, h.tolist()):
+        if keep_reverse:
+            klist = keys.tolist() if isinstance(keys, np.ndarray) else keys
+            for k, hv in zip(klist, h.tolist()):
                 self._rev.setdefault(hv, k)
         hi = (h >> np.uint64(32)).astype(np.uint32)
         lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         return hi, lo
 
-    def encode_numeric(self, keys: np.ndarray, keep_reverse: bool = True):
-        h = hash64_host(keys)
-        if keep_reverse:
-            for k, hv in zip(np.asarray(keys).tolist(), h.tolist()):
-                self._rev.setdefault(hv, k)
-        hi = (h >> np.uint64(32)).astype(np.uint32)
-        lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        return hi, lo
+    # kept as an alias for the columnar fast path's call sites
+    encode_numeric = encode
 
     def decode(self, hi: np.ndarray, lo: np.ndarray):
         h = (np.asarray(hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(

@@ -1,0 +1,2 @@
+from flink_tpu.datastream.environment import StreamExecutionEnvironment  # noqa: F401
+from flink_tpu.datastream.datastream import DataStream, KeyedStream, WindowedStream  # noqa: F401

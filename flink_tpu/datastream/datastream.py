@@ -1,0 +1,206 @@
+"""DataStream API — the fluent user surface.
+
+Mirrors the shape of the reference's DataStream / KeyedStream /
+WindowedStream (SURVEY §2.5: api/datastream/DataStream.java,
+KeyedStream.java:227 timeWindow, WindowedStream.java:185 reduce), TPU-adapted:
+window aggregations must be declared as associative combines (built-in
+sum/min/max/count/mean or jnp-traceable generic reduces) so they execute as
+whole-shard kernels; arbitrary per-element Python functions are host-chain
+operators fused between keyed boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.datastream.window.assigners import (
+    SessionWindowAssigner,
+    SlidingEventTimeWindows,
+    TumblingEventTimeWindows,
+    WindowAssigner,
+)
+from flink_tpu.graph import stream_graph as sg
+from flink_tpu.ops.window_kernels import ReduceSpec
+from flink_tpu.runtime import sinks as sink_mod
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+
+def _field_extractor(pos):
+    if callable(pos):
+        return pos
+    if isinstance(pos, (int, str)):
+        return lambda e: e[pos]
+    raise TypeError(f"cannot extract field {pos!r}")
+
+
+class DataStream:
+    def __init__(self, env, transformation: sg.Transformation):
+        self.env = env
+        self.transformation = transformation
+
+    # -- stateless chain -------------------------------------------------
+    def map(self, fn: Callable) -> "DataStream":
+        t = sg.OneInputTransformation("map", self.transformation, kind="map", fn=fn)
+        return DataStream(self.env, t)
+
+    def filter(self, fn: Callable) -> "DataStream":
+        t = sg.OneInputTransformation("filter", self.transformation, kind="filter", fn=fn)
+        return DataStream(self.env, t)
+
+    def flat_map(self, fn: Callable) -> "DataStream":
+        t = sg.OneInputTransformation(
+            "flat_map", self.transformation, kind="flat_map", fn=fn
+        )
+        return DataStream(self.env, t)
+
+    def assign_timestamps_and_watermarks(
+        self, timestamp_fn: Callable, strategy: Optional[WatermarkStrategy] = None
+    ) -> "DataStream":
+        t = sg.TimestampsWatermarksTransformation(
+            "timestamps", self.transformation,
+            timestamp_fn=timestamp_fn,
+            strategy=strategy or WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        return DataStream(self.env, t)
+
+    # -- keying ----------------------------------------------------------
+    def key_by(self, selector) -> "KeyedStream":
+        t = sg.KeyByTransformation(
+            "key_by", self.transformation, key_selector=_field_extractor(selector)
+        )
+        return KeyedStream(self.env, t)
+
+    # -- sinks -----------------------------------------------------------
+    def add_sink(self, sink) -> "DataStream":
+        if callable(sink) and not isinstance(sink, sink_mod.Sink):
+            sink = sink_mod.FunctionSink(sink)
+        t = sg.SinkTransformation("sink", self.transformation, sink=sink)
+        self.env._sinks.append(t)
+        return DataStream(self.env, t)
+
+    def print_(self) -> "DataStream":
+        return self.add_sink(sink_mod.PrintSink())
+
+    def write_as_text(self, path: str) -> "DataStream":
+        return self.add_sink(sink_mod.WriteAsTextSink(path))
+
+
+class KeyedStream(DataStream):
+    # -- windows ---------------------------------------------------------
+    def window(self, assigner) -> "WindowedStream":
+        return WindowedStream(self.env, self, assigner)
+
+    def time_window(self, size_ms: int, slide_ms: Optional[int] = None):
+        if slide_ms is None:
+            return self.window(TumblingEventTimeWindows.of(size_ms))
+        return self.window(SlidingEventTimeWindows.of(size_ms, slide_ms))
+
+    # -- rolling (non-windowed) keyed aggregation ------------------------
+    def reduce(self, fn: Callable, extractor=None, neutral=0.0,
+               dtype=jnp.float32) -> DataStream:
+        """Rolling reduce per key (ref StreamGroupedReduce): emits the
+        updated accumulator for every input record."""
+        t = sg.KeyedProcessTransformation(
+            "rolling_reduce", self.transformation,
+            reduce_spec_factory=lambda: ReduceSpec(
+                "generic", dtype, combine=fn, neutral=neutral
+            ),
+            extractor=_field_extractor(extractor) if extractor is not None
+            else (lambda e: e),
+        )
+        return DataStream(self.env, t)
+
+    def sum(self, pos=None) -> DataStream:
+        t = sg.KeyedProcessTransformation(
+            "rolling_sum", self.transformation,
+            reduce_spec_factory=lambda: ReduceSpec("sum", jnp.float32),
+            extractor=_field_extractor(pos) if pos is not None else (lambda e: e),
+        )
+        return DataStream(self.env, t)
+
+
+class WindowedStream:
+    def __init__(self, env, keyed: KeyedStream, assigner):
+        self.env = env
+        self.keyed = keyed
+        self.assigner = assigner
+        self._lateness_ms = 0
+
+    def allowed_lateness(self, ms: int) -> "WindowedStream":
+        self._lateness_ms = ms
+        return self
+
+    def _agg(self, name, spec_factory, extractor, result_fn=None) -> DataStream:
+        t = sg.WindowAggTransformation(
+            name, self.keyed.transformation,
+            assigner=self.assigner,
+            extractor=extractor,
+            reduce_spec_factory=spec_factory,
+            result_fn=result_fn,
+            allowed_lateness_ms=self._lateness_ms,
+        )
+        return DataStream(self.env, t)
+
+    def sum(self, pos=None, dtype=jnp.float32) -> DataStream:
+        return self._agg(
+            "window_sum",
+            lambda: ReduceSpec("sum", dtype),
+            _field_extractor(pos) if pos is not None else (lambda e: e),
+        )
+
+    def min(self, pos=None, dtype=jnp.float32) -> DataStream:
+        return self._agg(
+            "window_min", lambda: ReduceSpec("min", dtype),
+            _field_extractor(pos) if pos is not None else (lambda e: e),
+        )
+
+    def max(self, pos=None, dtype=jnp.float32) -> DataStream:
+        return self._agg(
+            "window_max", lambda: ReduceSpec("max", dtype),
+            _field_extractor(pos) if pos is not None else (lambda e: e),
+        )
+
+    def count(self) -> DataStream:
+        return self._agg(
+            "window_count", lambda: ReduceSpec("count", jnp.float32),
+            lambda e: 1.0,
+        )
+
+    def mean(self, pos=None) -> DataStream:
+        """sum+count composite accumulator, host-side divide at fire."""
+        def extractor(e):
+            v = _field_extractor(pos)(e) if pos is not None else e
+            return np.asarray([v, 1.0], np.float32)
+
+        return self._agg(
+            "window_mean",
+            lambda: ReduceSpec("sum", jnp.float32, value_shape=(2,)),
+            extractor,
+            result_fn=lambda acc: acc[..., 0] / np.maximum(acc[..., 1], 1.0),
+        )
+
+    def reduce(self, fn: Callable, extractor=None, neutral=0.0,
+               dtype=jnp.float32, value_shape=()) -> DataStream:
+        """General associative reduce. fn must be jnp-traceable; for
+        arbitrary element types provide extractor (element -> array) and
+        result_fn via .aggregate()."""
+        return self._agg(
+            "window_reduce",
+            lambda: ReduceSpec("generic", dtype, value_shape,
+                               combine=fn, neutral=neutral),
+            _field_extractor(extractor) if extractor is not None else (lambda e: e),
+        )
+
+    def aggregate(self, agg_fn) -> DataStream:
+        """AggregateFunction contract (add/merge/get_result) — ref
+        AggregatingState. agg_fn: state.AggregatingStateDescriptor or any
+        object with .to_reduce_spec(), .extractor, .get_result."""
+        return self._agg(
+            "window_aggregate",
+            agg_fn.to_reduce_spec,
+            getattr(agg_fn, "extractor", lambda e: e),
+            result_fn=getattr(agg_fn, "get_result", None),
+        )

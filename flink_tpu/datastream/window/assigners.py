@@ -1,0 +1,76 @@
+"""Window assigners — the catalog of the reference's
+api/windowing/assigners (SURVEY §2.5), TPU-adapted.
+
+In the reference an assigner maps each element to window objects
+(TumblingEventTimeWindows etc.). Here aligned time windows compile to a
+pane-ring `WindowSpec` (ops/window_kernels.py): panes of `slide` ticks,
+windows of `size` ticks. Processing-time variants use the same machinery
+with host-clock watermarks (the executor drives them). Session windows are
+handled by a dedicated merging path (cep/session rounds); Global windows +
+count triggers by the count-window path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from flink_tpu.core.time import TimeCharacteristic
+
+
+@dataclass(frozen=True)
+class WindowAssigner:
+    size_ms: int
+    slide_ms: int
+    is_event_time: bool = True
+
+    @property
+    def is_session(self) -> bool:
+        return False
+
+
+class TumblingEventTimeWindows(WindowAssigner):
+    @staticmethod
+    def of(size_ms: int) -> "WindowAssigner":
+        return WindowAssigner(size_ms, size_ms, True)
+
+
+class SlidingEventTimeWindows(WindowAssigner):
+    @staticmethod
+    def of(size_ms: int, slide_ms: int) -> "WindowAssigner":
+        return WindowAssigner(size_ms, slide_ms, True)
+
+
+class TumblingProcessingTimeWindows(WindowAssigner):
+    @staticmethod
+    def of(size_ms: int) -> "WindowAssigner":
+        return WindowAssigner(size_ms, size_ms, False)
+
+
+class SlidingProcessingTimeWindows(WindowAssigner):
+    @staticmethod
+    def of(size_ms: int, slide_ms: int) -> "WindowAssigner":
+        return WindowAssigner(size_ms, slide_ms, False)
+
+
+@dataclass(frozen=True)
+class SessionWindowAssigner:
+    """Session windows (gap-merged); executed by the session-merge path."""
+
+    gap_ms: int
+    is_event_time: bool = True
+
+    @property
+    def is_session(self) -> bool:
+        return True
+
+
+class EventTimeSessionWindows:
+    @staticmethod
+    def with_gap(gap_ms: int) -> SessionWindowAssigner:
+        return SessionWindowAssigner(gap_ms, True)
+
+
+class ProcessingTimeSessionWindows:
+    @staticmethod
+    def with_gap(gap_ms: int) -> SessionWindowAssigner:
+        return SessionWindowAssigner(gap_ms, False)

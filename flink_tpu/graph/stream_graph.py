@@ -1,0 +1,81 @@
+"""Transformation DAG recorded by the DataStream API.
+
+Role of the reference's StreamTransformation / StreamGraph /
+StreamingJobGraphGenerator chain (SURVEY §2.5): API calls record immutable
+nodes; at execute() the graph is translated into pipeline *stages*. Where the
+reference fuses chainable operators into JobVertex chains
+(StreamingJobGraphGenerator.createChain:172), we fuse every stateless host op
+between two keyed boundaries into one chain list, and each keyed window
+aggregation into one compiled SPMD stage — the TPU analog of operator
+chaining (fusion happens again, at the XLA level, inside the stage).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+_ids = itertools.count()
+
+
+@dataclass
+class Transformation:
+    name: str
+    parent: Optional["Transformation"] = None
+    id: int = field(default_factory=lambda: next(_ids))
+
+
+@dataclass
+class SourceTransformation(Transformation):
+    source: Any = None  # runtime.sources.Source
+
+
+@dataclass
+class OneInputTransformation(Transformation):
+    kind: str = "map"  # map | filter | flat_map | process
+    fn: Callable = None
+
+
+@dataclass
+class TimestampsWatermarksTransformation(Transformation):
+    timestamp_fn: Callable = None   # element -> epoch ms
+    strategy: Any = None            # runtime.watermarks.WatermarkStrategy
+
+
+@dataclass
+class KeyByTransformation(Transformation):
+    key_selector: Callable = None
+
+
+@dataclass
+class WindowAggTransformation(Transformation):
+    assigner: Any = None            # window.assigners.WindowAssigner
+    extractor: Callable = None      # element -> numeric value (host)
+    reduce_spec_factory: Callable = None  # () -> ReduceSpec
+    result_fn: Optional[Callable] = None  # acc -> output value (host, vectorized)
+    allowed_lateness_ms: int = 0
+
+
+@dataclass
+class KeyedProcessTransformation(Transformation):
+    """Keyed rolling aggregation (StreamGroupedReduce analog)."""
+
+    reduce_spec_factory: Callable = None
+    extractor: Callable = None
+    result_fn: Optional[Callable] = None
+
+
+@dataclass
+class SinkTransformation(Transformation):
+    sink: Any = None  # runtime.sinks.Sink
+
+
+def lineage(t: Transformation) -> List[Transformation]:
+    """Walk parents to the source, returning [source, ..., t]."""
+    chain = []
+    cur = t
+    while cur is not None:
+        chain.append(cur)
+        cur = cur.parent
+    return list(reversed(chain))

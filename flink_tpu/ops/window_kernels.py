@@ -1,0 +1,393 @@
+"""Keyed window aggregation as whole-shard device kernels.
+
+The reference's WindowOperator (SURVEY §2.5, WindowOperator.java:222) handles
+one record at a time: assign windows, HashMap-probe the pane accumulator,
+apply the user reduce, maybe register a timer; window fire replays per-key
+timer callbacks sequentially (§3.3). TPU-native redesign:
+
+  * Time is divided into aligned *panes* of `slide` ticks. A tumbling window
+    is one pane; a sliding window of size k*slide is the combine of k
+    consecutive panes (pane composition — the reference's aligned-window
+    fast path AbstractKeyedTimePanes has the same idea, per key on heap).
+  * Each shard holds accumulators for ALL its keys × a ring of R recent
+    panes: acc[C*R, ...]. A micro-batch updates them with one upsert +
+    one scatter-combine (built-in reducers) or sort+segmented-scan (general
+    associative combines). No per-record control flow.
+  * Window fire is watermark-driven and evaluates the ENTIRE key population
+    of up to F window-ends per step as masked whole-array reads — the
+    vectorized analog of draining the timer queue.
+
+Late records (all their windows already fired) are dropped and counted,
+matching the reference's default allowed-lateness=0 behavior
+(WindowOperator.isWindowLate). Ring overflow (data older than the R-pane
+horizon evicted before firing) is counted separately — R is the configured
+out-of-orderness budget.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashtable import SlotTable
+from flink_tpu.ops.segment import preaggregate, scatter_combine
+
+PANE_NONE = jnp.int32(-(2**31) + 1)
+
+
+@dataclass(frozen=True)
+class ReduceSpec:
+    """How window contents aggregate.
+
+    kind: 'sum' | 'min' | 'max' | 'count' | 'generic'
+    For 'generic', combine must be associative and jnp-traceable and
+    neutral its identity element.
+    Mirrors the role of ReduceFunction under ReducingStateDescriptor
+    (ref flink-core state API, SURVEY §2.1).
+    """
+
+    kind: str = "sum"
+    dtype: Any = jnp.float32
+    value_shape: Tuple[int, ...] = ()
+    combine: Optional[Callable] = None
+    neutral: Any = None
+
+    def neutral_value(self):
+        if self.neutral is not None:
+            return jnp.asarray(self.neutral, self.dtype)
+        if self.kind in ("sum", "count"):
+            return jnp.zeros((), self.dtype)
+        if self.kind == "min":
+            return jnp.asarray(jnp.finfo(self.dtype).max
+                               if jnp.issubdtype(self.dtype, jnp.floating)
+                               else jnp.iinfo(self.dtype).max, self.dtype)
+        if self.kind == "max":
+            return jnp.asarray(jnp.finfo(self.dtype).min
+                               if jnp.issubdtype(self.dtype, jnp.floating)
+                               else jnp.iinfo(self.dtype).min, self.dtype)
+        raise ValueError(f"generic reduce needs an explicit neutral")
+
+    def combine_fn(self) -> Callable:
+        return {
+            "sum": lambda a, b: a + b,
+            "count": lambda a, b: a + b,
+            "min": jnp.minimum,
+            "max": jnp.maximum,
+            "generic": self.combine,
+        }[self.kind]
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """Aligned time windows via pane composition.
+
+    size_ticks must be a multiple of slide_ticks; panes_per_window =
+    size // slide (1 = tumbling). ring = R panes of history retained;
+    fires_per_step = max window-ends emitted per step.
+    """
+
+    size_ticks: int
+    slide_ticks: int
+    ring: int = 8
+    fires_per_step: int = 2
+
+    def __post_init__(self):
+        if self.size_ticks % self.slide_ticks:
+            raise ValueError("window size must be a multiple of slide")
+        if self.panes_per_window + 1 > self.ring:
+            raise ValueError(
+                f"ring={self.ring} too small for {self.panes_per_window} panes/window"
+            )
+
+    @property
+    def panes_per_window(self) -> int:
+        return self.size_ticks // self.slide_ticks
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class WindowShardState:
+    """All device state of one key-group shard of a window operator."""
+
+    table: SlotTable
+    acc: jax.Array          # [C*R, *value_shape] pane accumulators
+    touched: jax.Array      # bool [C*R]
+    pane_ids: jax.Array     # int32 [R]: absolute pane id in each ring slot
+    max_pane: jax.Array     # int32 scalar: newest registered pane
+    min_pane: jax.Array     # int32 scalar: oldest pane ever seen (fire start)
+    watermark: jax.Array    # int32 scalar
+    fired_through: jax.Array  # int32 scalar: last window-end pane emitted
+    dropped_late: jax.Array     # int32 counter
+    dropped_capacity: jax.Array  # int32 counter (table full or ring overflow)
+
+    def tree_flatten(self):
+        return (
+            (self.table, self.acc, self.touched, self.pane_ids, self.max_pane,
+             self.min_pane, self.watermark, self.fired_through,
+             self.dropped_late, self.dropped_capacity),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_state(capacity: int, probe_len: int, win: WindowSpec,
+               red: ReduceSpec) -> WindowShardState:
+    R = win.ring
+    neutral = red.neutral_value()
+    acc = jnp.broadcast_to(neutral, (capacity * R,) + red.value_shape).astype(red.dtype)
+    return WindowShardState(
+        table=hashtable.create(capacity, probe_len),
+        acc=acc + jnp.zeros_like(acc),  # materialize (broadcast_to is a view)
+        touched=jnp.zeros(capacity * R, bool),
+        pane_ids=jnp.full((R,), PANE_NONE, jnp.int32),
+        max_pane=jnp.asarray(PANE_NONE),
+        min_pane=jnp.asarray(2**31 - 1, jnp.int32),
+        watermark=jnp.asarray(-(2**31) + 1, jnp.int32),
+        fired_through=jnp.asarray(PANE_NONE),
+        dropped_late=jnp.zeros((), jnp.int32),
+        dropped_capacity=jnp.zeros((), jnp.int32),
+    )
+
+
+def _floor_div_pane(ts, slide: int):
+    # floor division for possibly-negative ticks
+    return jnp.floor_divide(ts, jnp.int32(slide)).astype(jnp.int32)
+
+
+def update(
+    state: WindowShardState,
+    win: WindowSpec,
+    red: ReduceSpec,
+    hi, lo, ts, values, valid,
+) -> WindowShardState:
+    """Apply one micro-batch of records to shard state (pure function).
+
+    The caller has already routed records: `valid` is False for lanes not
+    owned by this shard. Replaces WindowOperator.processElement +
+    HeapReducingState.add for the whole batch at once.
+    """
+    C = state.table.capacity
+    R = win.ring
+    k = win.panes_per_window
+
+    pane = _floor_div_pane(ts, win.slide_ticks)
+
+    # -- late check: every window containing this pane already fired? ------
+    last_end = pane + jnp.int32(k - 1)  # newest window-end pane covering rec
+    late = valid & (last_end <= state.fired_through)
+    n_late = jnp.sum(late, dtype=jnp.int32)
+    live = valid & ~late
+
+    # -- register/advance the pane ring -----------------------------------
+    batch_max = jnp.max(jnp.where(live, pane, PANE_NONE))
+    new_max = jnp.maximum(state.max_pane, batch_max)
+    batch_min = jnp.min(jnp.where(live, pane, jnp.int32(2**31 - 1)))
+    new_min = jnp.minimum(state.min_pane, batch_min)
+    r_idx = jnp.arange(R, dtype=jnp.int32)
+    # newest pane with (p % R) == r, p <= new_max
+    p_r = new_max - jnp.mod(new_max - r_idx, jnp.int32(R))
+    have_data = new_max != PANE_NONE
+    p_r = jnp.where(have_data, p_r, PANE_NONE)
+    stale = (p_r != state.pane_ids)
+    # unfired data being evicted from the ring = capacity drop
+    evicted = stale & (state.pane_ids != PANE_NONE) & (
+        state.pane_ids + jnp.int32(k - 1) > state.fired_through
+    )
+    touched2d = state.touched.reshape(C, R)
+    n_evicted = jnp.sum(
+        jnp.where(evicted[None, :], touched2d, False), dtype=jnp.int32
+    )
+    neutral = red.neutral_value()
+    acc2d = state.acc.reshape((C, R) + red.value_shape)
+    acc2d = jnp.where(
+        _expand(stale[None, :], acc2d), neutral.astype(red.dtype), acc2d
+    )
+    touched2d = jnp.where(stale[None, :], False, touched2d)
+    pane_ids = jnp.where(stale, p_r, state.pane_ids)
+    acc = acc2d.reshape((C * R,) + red.value_shape)
+    touched = touched2d.reshape(C * R)
+
+    # -- drop records older than the ring horizon --------------------------
+    oldest = new_max - jnp.int32(R - 1)
+    too_old = live & (pane < oldest)
+    n_too_old = jnp.sum(too_old, dtype=jnp.int32)
+    live = live & ~too_old
+
+    # -- key upsert ---------------------------------------------------------
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, live)
+    n_nofit = jnp.sum(live & ~ok, dtype=jnp.int32)
+    live = live & ok
+
+    # -- scatter-combine into (slot, pane-ring) accumulators ----------------
+    ring = jnp.mod(pane, jnp.int32(R))
+    flat = slot * jnp.int32(R) + ring  # safe: slot==C when !ok -> masked
+    if red.kind in ("sum", "min", "max", "count"):
+        upd = values if red.kind != "count" else jnp.ones_like(values)
+        acc = scatter_combine(acc, flat, upd.astype(red.dtype), live,
+                              {"sum": "add", "count": "add",
+                               "min": "min", "max": "max"}[red.kind])
+    else:
+        ids, rep_mask, reduced = preaggregate(
+            flat, values.astype(red.dtype), live,
+            combine=red.combine_fn(), neutral=neutral,
+        )
+        safe = jnp.where(rep_mask, ids, C * R)
+        old = acc.at[safe].get(mode="clip")
+        old_touched = touched.at[safe].get(mode="clip") & rep_mask
+        merged = jnp.where(
+            _expand(old_touched, old), red.combine_fn()(old, reduced), reduced
+        )
+        acc = acc.at[safe].set(merged, mode="drop")
+    touched = scatter_combine(touched, flat, jnp.ones_like(flat, bool), live, "set")
+
+    return WindowShardState(
+        table=table,
+        acc=acc,
+        touched=touched,
+        pane_ids=pane_ids,
+        max_pane=new_max,
+        min_pane=new_min,
+        watermark=state.watermark,
+        fired_through=state.fired_through,
+        dropped_late=state.dropped_late + n_late,
+        dropped_capacity=state.dropped_capacity + n_too_old + n_nofit + n_evicted,
+    )
+
+
+def _expand(flag, val):
+    extra = val.ndim - flag.ndim
+    return flag.reshape(flag.shape + (1,) * extra)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class FireResult:
+    """Up to F window fires, whole-shard masked.
+
+    mask:     bool [F, C] — slot emitted for fire f
+    values:   [F, C, *value_shape]
+    window_end_ticks: int32 [F] (exclusive end; PANE_NONE when fire lane unused)
+    n_fires:  int32 scalar
+    """
+
+    mask: jax.Array
+    values: jax.Array
+    window_end_ticks: jax.Array
+    n_fires: jax.Array
+
+    def tree_flatten(self):
+        return (self.mask, self.values, self.window_end_ticks, self.n_fires), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def advance_and_fire(
+    state: WindowShardState,
+    win: WindowSpec,
+    red: ReduceSpec,
+    new_watermark,
+) -> Tuple[WindowShardState, FireResult]:
+    """Advance the shard watermark and emit due window fires.
+
+    Vectorized analog of HeapInternalTimerService.advanceWatermark +
+    WindowOperator.onEventTime per key (ref §3.3): instead of per-key timer
+    callbacks, each due window-end is evaluated for ALL keys at once; a
+    sliding window combines its panes_per_window ring columns.
+    """
+    C = state.table.capacity
+    R = win.ring
+    k = win.panes_per_window
+    F = win.fires_per_step
+
+    wm = jnp.maximum(state.watermark, jnp.asarray(new_watermark, jnp.int32))
+    # window ending at pane p covers ticks [(p-k+1)*slide, (p+1)*slide);
+    # fires when wm >= end-1. Clamp before the subtraction so the MIN
+    # sentinel watermark cannot wrap int32 and spuriously fire everything.
+    wm_c = jnp.maximum(wm, jnp.int32(-(2**31) + 1 + win.slide_ticks))
+    wm_pane = _floor_div_pane(wm_c + 1 - win.slide_ticks, win.slide_ticks)
+
+    have = state.max_pane != PANE_NONE
+    oldest_registered = jnp.maximum(
+        state.max_pane - jnp.int32(R - 1), state.min_pane
+    )
+    start = jnp.maximum(state.fired_through + 1, oldest_registered)
+    start = jnp.where(state.fired_through == PANE_NONE,
+                      oldest_registered, start)
+    # Sliding windows ending up to k-1 panes past max_pane still contain
+    # registered panes; only ends beyond max_pane+k-1 are certainly empty.
+    end = jnp.where(
+        have, jnp.minimum(wm_pane, state.max_pane + jnp.int32(k - 1)), start - 1
+    )
+    n_due = jnp.maximum(end - start + 1, 0)
+    n_now = jnp.minimum(n_due, F)
+
+    f_idx = jnp.arange(F, dtype=jnp.int32)
+    p_f = start + f_idx                      # window-end pane per fire lane
+    lane_ok = f_idx < n_now
+
+    acc3 = state.acc.reshape((C, R) + red.value_shape)
+    touched2 = state.touched.reshape(C, R)
+
+    def fire_one(p, ok):
+        # combine panes p-k+1 .. p
+        combine = red.combine_fn()
+        neutral = red.neutral_value()
+        vals = jnp.broadcast_to(
+            neutral, (C,) + red.value_shape
+        ).astype(red.dtype)
+        any_touched = jnp.zeros(C, bool)
+        for j in range(k - 1, -1, -1):
+            q = p - j
+            r = jnp.mod(q, jnp.int32(R))
+            present = ok & (state.pane_ids[r] == q)
+            col = acc3[:, r]
+            col_t = touched2[:, r] & present
+            vals = jnp.where(_expand(col_t, vals), combine(vals, col), vals)
+            # combine(neutral, col) == col for first touch
+            any_touched = any_touched | col_t
+        return any_touched & ok, vals
+
+    mask, values = jax.vmap(fire_one)(p_f, lane_ok)
+
+    window_end = jnp.where(
+        lane_ok, (p_f + 1) * jnp.int32(win.slide_ticks), PANE_NONE
+    )
+
+    # purge panes no longer in any unfired window: q + k - 1 <= fired_through'
+    new_fired_through = jnp.where(
+        n_due > F, start + n_now - 1, jnp.maximum(wm_pane, state.fired_through)
+    )
+    new_fired_through = jnp.where(
+        have, new_fired_through, state.fired_through
+    )
+    r_idx = jnp.arange(R, dtype=jnp.int32)
+    purgeable = (state.pane_ids != PANE_NONE) & (
+        state.pane_ids + jnp.int32(k - 1) <= new_fired_through
+    )
+    neutral = red.neutral_value()
+    acc3 = jnp.where(_expand(purgeable[None, :], acc3), neutral, acc3)
+    touched2 = jnp.where(purgeable[None, :], False, touched2)
+
+    new_state = WindowShardState(
+        table=state.table,
+        acc=acc3.reshape((C * R,) + red.value_shape),
+        touched=touched2.reshape(C * R),
+        pane_ids=state.pane_ids,
+        max_pane=state.max_pane,
+        min_pane=state.min_pane,
+        watermark=wm,
+        fired_through=new_fired_through,
+        dropped_late=state.dropped_late,
+        dropped_capacity=state.dropped_capacity,
+    )
+    return new_state, FireResult(mask, values, window_end, n_now)

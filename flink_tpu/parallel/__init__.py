@@ -1,0 +1,1 @@
+from flink_tpu.parallel.mesh import MeshContext, SHARD_AXIS  # noqa: F401

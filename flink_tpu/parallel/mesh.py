@@ -1,0 +1,88 @@
+"""Device mesh & sharding context — the TPU replacement for the reference's
+TaskManager slot topology + Netty data plane (SURVEY §2.3).
+
+Where the reference places subtasks in TM slots and wires them with TCP
+partitions, we lay key-group shards over a `jax.sharding.Mesh` axis. The
+`keyBy` hash exchange becomes either:
+
+  * replicate-and-mask (default): every device sees the full micro-batch and
+    masks the lanes whose key group it owns. Zero collective traffic on the
+    records themselves (input is broadcast once from host); per-shard
+    pre-aggregation makes the redundant compute cheap. Best at small batch.
+  * all_to_all exchange (parallel/exchange.py): records are bucketed by
+    target shard with fixed per-shard capacity and exchanged over ICI.
+    Best when batches are large and value payloads wide.
+
+One mesh axis ("shards") carries keyed-state parallelism (the reference's
+"operator parallelism over key groups"); a second optional axis ("pipe") is
+reserved for pipeline stages of chained jobs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_tpu.core.keygroups import (
+    check_parallelism,
+    key_group_range_for_operator,
+)
+
+SHARD_AXIS = "shards"
+
+
+@dataclass
+class MeshContext:
+    """A job's device topology: n_shards over the `shards` mesh axis."""
+
+    mesh: Mesh
+    max_parallelism: int
+
+    @staticmethod
+    def create(
+        n_shards: Optional[int] = None,
+        max_parallelism: int = 128,
+        devices=None,
+    ) -> "MeshContext":
+        devices = devices if devices is not None else jax.devices()
+        n = n_shards or len(devices)
+        if n > len(devices):
+            raise ValueError(f"need {n} devices, have {len(devices)}")
+        check_parallelism(max_parallelism, n)
+        mesh = Mesh(np.asarray(devices[:n]), (SHARD_AXIS,))
+        return MeshContext(mesh, max_parallelism)
+
+    @property
+    def n_shards(self) -> int:
+        return self.mesh.shape[SHARD_AXIS]
+
+    @cached_property
+    def key_group_ranges(self):
+        return [
+            key_group_range_for_operator(self.max_parallelism, self.n_shards, i)
+            for i in range(self.n_shards)
+        ]
+
+    def sharding(self, *axes) -> NamedSharding:
+        """NamedSharding placing leading axis over shards: sharding('s')"""
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def state_sharding(self) -> NamedSharding:
+        """State arrays carry a leading [n_shards] axis, one slice per shard."""
+        return NamedSharding(self.mesh, P(SHARD_AXIS))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def kg_bounds(self):
+        """(starts[n_shards], ends[n_shards]) int32 arrays of key-group ranges."""
+        starts = np.asarray([r.start for r in self.key_group_ranges], np.int32)
+        ends = np.asarray([r.end for r in self.key_group_ranges], np.int32)
+        return starts, ends

@@ -1,0 +1,425 @@
+"""LocalExecutor: drives a job's micro-batch loop on the device mesh.
+
+The role of StreamTask.invoke + StreamInputProcessor.processInput
+(SURVEY §3.2) collapsed into a host loop around ONE compiled SPMD step per
+keyed stage:
+
+    poll source -> host chain (fused stateless ops) -> key/encode ->
+    device step(state, batch, watermark) -> decode fires -> sinks
+
+Checkpoint barriers are step boundaries (no BarrierBuffer needed: between
+steps, device state + source offsets form a consistent cut — the
+Chandy-Lamport cut is structural).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import namedtuple
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.time import TimeDomain
+from flink_tpu.core.types import KeyCodec
+from flink_tpu.graph import stream_graph as sg
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.parallel.mesh import MeshContext
+from flink_tpu.runtime.step import (
+    WindowStageSpec,
+    build_window_step,
+    init_sharded_state,
+)
+from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
+
+
+def _pad(arr, size, dtype):
+    arr = np.asarray(arr, dtype)
+    if len(arr) == size:
+        return arr
+    out = np.zeros((size,) + arr.shape[1:], dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+@dataclasses.dataclass
+class JobMetrics:
+    records_in: int = 0
+    records_out: int = 0
+    fires: int = 0
+    steps: int = 0
+    dropped_late: int = 0
+    dropped_capacity: int = 0
+    wall_time_s: float = 0.0
+
+
+@dataclasses.dataclass
+class JobHandle:
+    name: str
+    metrics: JobMetrics
+    state: Any = None      # final device state (windowed stages)
+    ctx: Any = None
+
+
+@dataclasses.dataclass
+class _Pipeline:
+    source: Any
+    pre_chain: List[sg.OneInputTransformation]
+    ts_transform: Optional[sg.TimestampsWatermarksTransformation]
+    key_by: Optional[sg.KeyByTransformation]
+    window_agg: Optional[sg.WindowAggTransformation]
+    post_chain: List[sg.OneInputTransformation]
+    sinks: List[Any]
+
+
+def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
+    if not sink_transforms:
+        raise ValueError("job has no sinks")
+    lineages = [sg.lineage(t)[:-1] for t in sink_transforms]
+    first = lineages[0]
+    for ln in lineages[1:]:
+        if [t.id for t in ln] != [t.id for t in first]:
+            raise NotImplementedError(
+                "multiple divergent sink lineages not yet supported"
+            )
+    pipe = _Pipeline(None, [], None, None, None, [], [t.sink for t in sink_transforms])
+    stage = "pre"
+    for t in first:
+        if isinstance(t, sg.SourceTransformation):
+            pipe.source = t.source
+        elif isinstance(t, sg.TimestampsWatermarksTransformation):
+            pipe.ts_transform = t
+        elif isinstance(t, sg.KeyByTransformation):
+            pipe.key_by = t
+            stage = "keyed"
+        elif isinstance(t, sg.WindowAggTransformation):
+            pipe.window_agg = t
+            stage = "post"
+        elif isinstance(t, sg.KeyedProcessTransformation):
+            raise NotImplementedError("rolling keyed reduce lands with the next stage kind")
+        elif isinstance(t, sg.OneInputTransformation):
+            (pipe.pre_chain if stage == "pre" else pipe.post_chain).append(t)
+        else:
+            raise NotImplementedError(f"transformation {type(t).__name__}")
+    if pipe.source is None:
+        raise ValueError("pipeline has no source")
+    if pipe.key_by is not None and pipe.window_agg is None:
+        raise NotImplementedError("keyed stream must currently end in a window agg")
+    return pipe
+
+
+def _apply_chain(chain, elements):
+    for t in chain:
+        if t.kind == "map":
+            elements = [t.fn(e) for e in elements]
+        elif t.kind == "filter":
+            elements = [e for e in elements if t.fn(e)]
+        elif t.kind == "flat_map":
+            out = []
+            for e in elements:
+                out.extend(t.fn(e))
+            elements = out
+        else:
+            raise NotImplementedError(t.kind)
+    return elements
+
+
+class LocalExecutor:
+    def __init__(self, env):
+        self.env = env
+
+    def run(self, job_name: str, sink_transforms) -> JobHandle:
+        from flink_tpu.core.time import TimeCharacteristic
+
+        pipe = _translate(sink_transforms)
+        metrics = JobMetrics()
+        t_start = time.perf_counter()
+        for s in pipe.sinks:
+            s.open()
+        pipe.source.open()
+        try:
+            if pipe.window_agg is None:
+                self._run_stateless(pipe, metrics)
+                handle = JobHandle(job_name, metrics)
+            else:
+                handle = self._run_windowed(pipe, metrics, job_name)
+        finally:
+            pipe.source.close()
+            for s in pipe.sinks:
+                s.close()
+        metrics.wall_time_s = time.perf_counter() - t_start
+        return handle
+
+    # ------------------------------------------------------------------
+    def _run_stateless(self, pipe: _Pipeline, metrics: JobMetrics):
+        B = self.env.batch_size
+        while True:
+            polled, end = pipe.source.poll(B)
+            elements = self._to_elements(polled)
+            metrics.records_in += len(elements)
+            out = _apply_chain(pipe.pre_chain + pipe.post_chain, elements)
+            metrics.records_out += len(out)
+            if out:
+                for s in pipe.sinks:
+                    s.invoke_batch(out)
+            metrics.steps += 1
+            if end:
+                break
+
+    @staticmethod
+    def _to_elements(polled):
+        if isinstance(polled, tuple) and len(polled) == 2 and isinstance(polled[0], dict):
+            cols, _ts = polled
+            if not cols:
+                return []
+            names = list(cols)
+            arrays = [cols[n] for n in names]
+            if len(names) == 1:
+                return list(arrays[0].tolist())
+            return list(zip(*[a.tolist() for a in arrays]))
+        return polled
+
+    # ------------------------------------------------------------------
+    def _run_windowed(self, pipe: _Pipeline, metrics: JobMetrics, job_name):
+        from flink_tpu.core.time import TimeCharacteristic
+
+        env = self.env
+        wagg = pipe.window_agg
+        assigner = wagg.assigner
+        if getattr(assigner, "is_session", False):
+            raise NotImplementedError(
+                "session windows execute via the session-merge path "
+                "(not wired into the executor yet)"
+            )
+        if wagg.allowed_lateness_ms > 0:
+            raise NotImplementedError(
+                "allowed_lateness > 0 (late re-fires) is not implemented yet; "
+                "late records are currently dropped and counted"
+            )
+        event_time = assigner.is_event_time and (
+            env.time_characteristic == TimeCharacteristic.EventTime
+        )
+
+        n_dev = len(jax.devices())
+        n_shards = max(1, min(env.parallelism, n_dev))
+        ctx = MeshContext.create(n_shards, env.max_parallelism)
+
+        red = wagg.reduce_spec_factory()
+        # time domain: 1 tick = 1 ms until first batch fixes the origin
+        td: Optional[TimeDomain] = None
+        size_ms, slide_ms = assigner.size_ms, assigner.slide_ms
+
+        win = None
+        spec = None
+        step = None
+        state = None
+        codec = KeyCodec()
+        B = env.batch_size
+        wm_strategy = (
+            pipe.ts_transform.strategy if pipe.ts_transform is not None
+            else WatermarkStrategy.for_monotonous_timestamps()
+        )
+
+        def setup(first_ts_ms: int):
+            nonlocal td, win, spec, step, state
+            origin = (int(first_ts_ms) // size_ms) * size_ms
+            td = TimeDomain(origin_ms=origin, ms_per_tick=1)
+            ring = env.config.get_int("window.ring-panes", 0) or max(
+                8,
+                2 * (size_ms // slide_ms)
+                + (wm_strategy.out_of_orderness_ms + wagg.allowed_lateness_ms)
+                // slide_ms
+                + 2,
+            )
+            win = wk.WindowSpec(
+                size_ticks=size_ms, slide_ticks=slide_ms,
+                ring=ring, fires_per_step=4,
+            )
+            spec = WindowStageSpec(
+                win=win, red=red,
+                capacity_per_shard=env.state_capacity_per_shard,
+            )
+            step = build_window_step(ctx, spec)
+            state = init_sharded_state(ctx, spec)
+
+        def run_step(hi, lo, ticks, values, valid, wm_ms):
+            nonlocal state
+            wm_ticks = int(td.to_ticks(wm_ms)) if wm_ms is not None else None
+            wmv = jnp.full((ctx.n_shards,), np.int32(
+                wm_ticks if wm_ticks is not None else -(2**31) + 1
+            ))
+            state, fr = step(
+                state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
+                jnp.asarray(values), jnp.asarray(valid), wmv,
+            )
+            metrics.steps += 1
+            return fr
+
+        def emit_fires(fr):
+            n_f = np.asarray(fr.n_fires)
+            if int(n_f.sum()) == 0:
+                return 0
+            mask = np.asarray(fr.mask)
+            vals = np.asarray(fr.values)
+            ends = np.asarray(fr.window_end_ticks)
+            tkeys = np.asarray(state.table.keys)
+            out = []
+            for sh in range(mask.shape[0]):
+                for f in range(int(n_f[sh])):
+                    sel = np.nonzero(mask[sh, f])[0]
+                    if sel.size == 0:
+                        continue
+                    khi = tkeys[sh, sel, 0]
+                    klo = tkeys[sh, sel, 1]
+                    keys = codec.decode(khi, klo)
+                    end_ms = int(td.to_ms(int(ends[sh, f])))
+                    v = vals[sh, f, sel]
+                    if wagg.result_fn is not None:
+                        v = wagg.result_fn(v)
+                    for k, vv in zip(keys, np.asarray(v).tolist()):
+                        out.append(WindowResult(k, end_ms, vv))
+            if not out:
+                return 0
+            metrics.fires += len(out)
+            out = _apply_chain(pipe.post_chain, out)
+            metrics.records_out += len(out)
+            for s in pipe.sinks:
+                s.invoke_batch(out)
+            return len(out)
+
+        empty = None  # cached empty-batch args
+        end = False
+        while not end:
+            polled, end = pipe.source.poll(B)
+            now_ms = int(time.time() * 1000)
+            hi = lo = ticks = values = None
+            n = 0
+            if pipe.source.columnar and isinstance(polled, tuple):
+                cols, ts_ms = polled
+                if cols:
+                    # columnar chain ops transform the column dict itself
+                    for t in pipe.pre_chain:
+                        if t.kind != "map":
+                            raise NotImplementedError(
+                                f"columnar sources support only 'map' "
+                                f"(dict->dict) before key_by, got {t.kind!r}"
+                            )
+                        cols = t.fn(cols)
+                    # selectors index the column dict (key_by('name') etc.)
+                    keys_arr = np.asarray(pipe.key_by.key_selector(cols))
+                    n = len(keys_arr)
+                    hi, lo = codec.encode(keys_arr)
+                    values = np.asarray(wagg.extractor(cols))
+                    if event_time:
+                        if pipe.ts_transform is not None:
+                            ts_ms = np.asarray(
+                                pipe.ts_transform.timestamp_fn(cols), np.int64
+                            )
+                        elif ts_ms is None:
+                            raise ValueError(
+                                "event-time job but the columnar source "
+                                "provides no timestamps and no "
+                                "assign_timestamps_and_watermarks is set"
+                            )
+                    else:
+                        ts_ms = np.full(n, now_ms, np.int64)
+            else:
+                elements = _apply_chain(pipe.pre_chain, self._to_elements(polled))
+                n = len(elements)
+                if n:
+                    keys = [pipe.key_by.key_selector(e) for e in elements]
+                    hi, lo = codec.encode(keys)
+                    values = np.asarray(
+                        [wagg.extractor(e) for e in elements], np.float32
+                    )
+                    if event_time and pipe.ts_transform is not None:
+                        ts_ms = np.asarray(
+                            [pipe.ts_transform.timestamp_fn(e) for e in elements],
+                            np.int64,
+                        )
+                    else:
+                        ts_ms = np.full(n, now_ms, np.int64)
+                else:
+                    ts_ms = None
+
+            metrics.records_in += n
+            if n:
+                if td is None:
+                    setup(int(np.min(ts_ms)))
+                ticks = td.to_ticks(ts_ms)
+                if event_time:
+                    wm_ms = wm_strategy.on_batch(int(np.max(ts_ms)))
+                else:
+                    wm_ms = now_ms - 1
+                values = np.asarray(values)
+                # A batch spanning more panes than the ring holds (replay /
+                # catch-up) must be time-sliced, or fresh panes would evict
+                # unfired ones. Slice so each sub-step spans <= ring-2 panes.
+                panes = ticks // np.int32(win.slide_ticks)
+                span_limit = win.ring - 2
+                if int(panes.max()) - int(panes.min()) >= span_limit:
+                    order = np.argsort(panes, kind="stable")
+                    sorted_panes = panes[order]
+                    groups = []
+                    lo_i = 0
+                    while lo_i < n:
+                        cutoff = sorted_panes[lo_i] + span_limit
+                        hi_i = int(np.searchsorted(sorted_panes, cutoff, "left"))
+                        groups.append(order[lo_i:hi_i])
+                        lo_i = hi_i
+                else:
+                    groups = [np.arange(n)]
+                for sel in groups:
+                    m = len(sel)
+                    fr = run_step(
+                        _pad(hi[sel], B, np.uint32),
+                        _pad(lo[sel], B, np.uint32),
+                        _pad(ticks[sel], B, np.int32),
+                        _pad(values[sel], B, values.dtype),
+                        _pad(np.ones(m, bool), B, bool),
+                        wm_ms,
+                    )
+                    emit_fires(fr)
+            elif td is not None:
+                # idle poll: advance processing-time watermark
+                if not event_time:
+                    fr = self._empty_step(run_step, B, red, now_ms - 1)
+                    emit_fires(fr)
+
+        # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
+        if td is not None:
+            final_wm = td.to_ms(2**31 - 4)
+            while True:
+                fr = self._empty_step(run_step, B, red, int(final_wm))
+                if emit_fires(fr) == 0 and int(np.asarray(fr.n_fires).sum()) == 0:
+                    break
+
+        if state is not None:
+            metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
+            metrics.dropped_capacity = int(
+                np.asarray(state.dropped_capacity).sum()
+            )
+            if metrics.dropped_capacity and self.env.config.get_bool(
+                "state.backend.strict-capacity", True
+            ):
+                raise RuntimeError(
+                    f"state backend over capacity: {metrics.dropped_capacity} "
+                    f"records lost (raise state.backend.device.slots-per-shard "
+                    f"or the pane ring, or set state.backend.strict-capacity "
+                    f"to false to tolerate drops)"
+                )
+        return JobHandle(job_name, metrics, state=state, ctx=ctx)
+
+    @staticmethod
+    def _empty_step(run_step, B, red, wm_ms):
+        hi = np.zeros(B, np.uint32)
+        lo = np.zeros(B, np.uint32)
+        ticks = np.zeros(B, np.int32)
+        values = np.zeros((B,) + tuple(red.value_shape), np.float32)
+        valid = np.zeros(B, bool)
+        return run_step(hi, lo, ticks, values, valid, wm_ms)

@@ -1,0 +1,76 @@
+"""Sinks (ref: api/functions/sink — print/socket/write/collect)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, List
+
+
+class Sink:
+    def open(self):
+        pass
+
+    def invoke_batch(self, elements: List[Any]):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class CollectSink(Sink):
+    """Test sink gathering all outputs (ref test-utils collect pattern)."""
+
+    def __init__(self):
+        self.results: List[Any] = []
+
+    def invoke_batch(self, elements):
+        self.results.extend(elements)
+
+
+class PrintSink(Sink):
+    def invoke_batch(self, elements):
+        for e in elements:
+            print(e)
+
+
+class FunctionSink(Sink):
+    def __init__(self, fn: Callable[[Any], None]):
+        self.fn = fn
+
+    def invoke_batch(self, elements):
+        for e in elements:
+            self.fn(e)
+
+
+class WriteAsTextSink(Sink):
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def open(self):
+        self._f = open(self.path, "w")
+
+    def invoke_batch(self, elements):
+        for e in elements:
+            self._f.write(f"{e}\n")
+
+    def close(self):
+        if self._f:
+            self._f.close()
+
+
+class WriteAsJsonSink(Sink):
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def open(self):
+        self._f = open(self.path, "w")
+
+    def invoke_batch(self, elements):
+        for e in elements:
+            self._f.write(json.dumps(e, default=str) + "\n")
+
+    def close(self):
+        if self._f:
+            self._f.close()

@@ -1,0 +1,183 @@
+"""Sources — batched, replayable, checkpointable.
+
+Contract redesign of the reference's SourceFunction (run(SourceContext) on a
+dedicated thread, emitting under the checkpoint lock — SURVEY §2.5) for a
+micro-batch world:
+
+    poll(max_records) -> (elements | columns, end_of_stream)
+    snapshot_offsets() / restore_offsets(state)   — exactly-once replay
+                                                   (FlinkKafkaConsumerBase
+                                                   offset pattern, §2.8)
+
+Offsets snapshot at step boundaries (the barrier), so restore + replay
+reproduces the exact same micro-batches — the TPU analog of barrier-aligned
+exactly-once.
+
+Two data modes: object mode (list of Python elements, general API) and
+columnar mode (dict of numpy arrays + timestamps, the fast path).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class Source:
+    columnar = False
+
+    def open(self):  # lifecycle (RichFunction.open analog)
+        pass
+
+    def close(self):
+        pass
+
+    def poll(self, max_records: int):
+        raise NotImplementedError
+
+    # -- checkpointing --------------------------------------------------
+    def snapshot_offsets(self):
+        return None
+
+    def restore_offsets(self, state):
+        pass
+
+
+class CollectionSource(Source):
+    """from_collection: finite in-memory source with replayable position."""
+
+    def __init__(self, elements: List[Any]):
+        self.elements = list(elements)
+        self.pos = 0
+
+    def poll(self, max_records: int):
+        chunk = self.elements[self.pos : self.pos + max_records]
+        self.pos += len(chunk)
+        return chunk, self.pos >= len(self.elements)
+
+    def snapshot_offsets(self):
+        return self.pos
+
+    def restore_offsets(self, state):
+        self.pos = int(state)
+
+
+class ColumnarSource(Source):
+    """Base for the fast path: poll returns (columns dict, ts_ms array, end)."""
+
+    columnar = True
+
+
+class GeneratorSource(ColumnarSource):
+    """Deterministic replayable generator: fn(offset, n) -> (columns, ts_ms).
+
+    The Kafka-analog used by benchmarks: offset-addressable, infinite or
+    bounded, exactly-once via offset snapshot/restore.
+    """
+
+    def __init__(self, fn, total: Optional[int] = None):
+        self.fn = fn
+        self.total = total
+        self.offset = 0
+
+    def poll(self, max_records: int):
+        n = max_records
+        if self.total is not None:
+            n = min(n, self.total - self.offset)
+        if n <= 0:
+            return ({}, None), True
+        cols, ts = self.fn(self.offset, n)
+        self.offset += n
+        end = self.total is not None and self.offset >= self.total
+        return (cols, ts), end
+
+    def snapshot_offsets(self):
+        return self.offset
+
+    def restore_offsets(self, state):
+        self.offset = int(state)
+
+
+class SocketTextStreamSource(Source):
+    """socketTextStream: newline-delimited text over TCP
+    (ref SocketTextStreamFunction role). Non-replayable (at-most-once on
+    restore), like the reference's socket source.
+    """
+
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0):
+        self.host, self.port = host, port
+        self.connect_timeout_s = connect_timeout_s
+        self._sock = None
+        self._buf = b""
+        self._eof = False
+
+    def open(self):
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        self._sock.setblocking(False)
+
+    def close(self):
+        if self._sock:
+            self._sock.close()
+
+    def poll(self, max_records: int):
+        if self._eof:
+            return [], True
+        try:
+            while True:
+                data = self._sock.recv(1 << 16)
+                if not data:
+                    self._eof = True
+                    break
+                self._buf += data
+                if self._buf.count(b"\n") >= max_records:
+                    break
+        except (BlockingIOError, socket.timeout):
+            pass
+        lines = []
+        while len(lines) < max_records and b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            lines.append(line.decode("utf-8", errors="replace"))
+        if self._eof and self._buf:
+            lines.append(self._buf.decode("utf-8", errors="replace"))
+            self._buf = b""
+        return lines, self._eof and not self._buf
+
+
+class FileTextSource(Source):
+    """readTextFile: line-by-line file source with byte-offset replay."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self._f = None
+
+    def open(self):
+        self._f = open(self.path, "rb")
+        self._f.seek(self.offset)
+
+    def close(self):
+        if self._f:
+            self._f.close()
+
+    def poll(self, max_records: int):
+        lines = []
+        for _ in range(max_records):
+            line = self._f.readline()
+            if not line:
+                return lines, True
+            lines.append(line.decode("utf-8", errors="replace").rstrip("\n"))
+        self.offset = self._f.tell()
+        return lines, False
+
+    def snapshot_offsets(self):
+        return self._f.tell() if self._f else self.offset
+
+    def restore_offsets(self, state):
+        self.offset = int(state)
+        if self._f:
+            self._f.seek(self.offset)

@@ -1,0 +1,105 @@
+"""Compiled SPMD step functions.
+
+A Flink job runs thousands of task threads pulling records through Netty
+(SURVEY §3.2). Here a pipeline stage compiles to ONE jitted SPMD function:
+
+    step(state, batch, watermark) -> (state', fires)
+
+executed over the mesh with `shard_map`: every device applies the stage's
+stateless chain, masks the lanes whose key group it owns (replicate-and-mask
+exchange, see parallel/mesh.py), updates its shard of windowed state, and
+evaluates due window fires. The checkpoint barrier of the reference
+(BarrierBuffer alignment) is simply the step boundary: between two step
+invocations ALL state is consistent and snapshottable.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import route_hash
+from flink_tpu.parallel.mesh import SHARD_AXIS, MeshContext
+
+
+@dataclass
+class WindowStageSpec:
+    """Static config of one keyed-window pipeline stage."""
+
+    win: wk.WindowSpec
+    red: wk.ReduceSpec
+    capacity_per_shard: int = 1 << 16
+    probe_len: int = 16
+    # jnp-traceable pre-keyed chain: (values_dict, ts, valid) -> (value, ts, valid)
+    # applied on-device before keying (fused maps/filters).
+    pre: Optional[Callable] = None
+
+
+def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
+    """Per-shard window state stacked on a leading [n_shards] axis."""
+    def one(_):
+        return wk.init_state(spec.capacity_per_shard, spec.probe_len,
+                             spec.win, spec.red)
+
+    states = [one(i) for i in range(ctx.n_shards)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return jax.device_put(stacked, ctx.state_sharding)
+
+
+def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
+    """Compile the stage into a jitted SPMD step over the mesh."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        # state leaves arrive with their leading [1] shard axis; drop it.
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        if spec.pre is not None:
+            values, ts, valid = spec.pre(values, ts, valid)
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+            kg <= kg_end.astype(jnp.uint32)
+        )
+        state = wk.update(state, spec.win, spec.red, hi, lo, ts, values, mine)
+        state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
+        state = jax.tree_util.tree_map(lambda x: x[None], state)
+        fires = jax.tree_util.tree_map(lambda x: x[None], fires)
+        return state, fires
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS),  # state (leading shard axis)
+            P(SHARD_AXIS),  # kg_start
+            P(SHARD_AXIS),  # kg_end
+            P(), P(), P(), P(), P(),  # batch replicated
+            P(SHARD_AXIS),  # per-shard watermark
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state, hi, lo, ts, values, valid, wm):
+        """wm: int32[n_shards] watermark per shard (usually identical)."""
+        return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
+
+    return step
+
+
+def watermark_vector(ctx: MeshContext, wm: int):
+    return jnp.full((ctx.n_shards,), np.int32(wm))

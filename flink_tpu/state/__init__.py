@@ -1,0 +1,7 @@
+from flink_tpu.state.descriptors import (  # noqa: F401
+    AggregatingStateDescriptor,
+    ListStateDescriptor,
+    ReducingStateDescriptor,
+    StateDescriptor,
+    ValueStateDescriptor,
+)

@@ -1,0 +1,95 @@
+"""Keyed-state descriptors — the user-facing state API.
+
+Mirrors the contracts of the reference's state API (SURVEY §2.1:
+State.java:32, ValueState.java:40, ReducingState.java:38, FoldingState.java:40,
+StateDescriptor.java:50): a descriptor names a state, fixes its type, and (for
+reducing/aggregating kinds) carries the combine function. TPU-adapted: types
+are dtypes + trailing shapes (device columns), and combine functions must be
+jnp-traceable & associative so a whole key-group shard can be updated as one
+kernel. FoldingState (deprecated in the reference line) is subsumed by
+AggregatingState here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from flink_tpu.ops.window_kernels import ReduceSpec
+
+
+@dataclass(frozen=True)
+class StateDescriptor:
+    name: str
+    dtype: Any = jnp.float32
+    value_shape: Tuple[int, ...] = ()
+
+    def to_reduce_spec(self) -> ReduceSpec:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class ValueStateDescriptor(StateDescriptor):
+    """Single value per key; update semantics = last write wins."""
+
+    default: Any = None
+
+    def to_reduce_spec(self) -> ReduceSpec:
+        # last-write-wins is associative: combine(a, b) = b
+        return ReduceSpec(
+            "generic", self.dtype, self.value_shape,
+            combine=lambda a, b: b,
+            neutral=self.default if self.default is not None else 0,
+        )
+
+
+@dataclass(frozen=True)
+class ReducingStateDescriptor(StateDescriptor):
+    """add(v) folds v into the accumulator with an associative reduce."""
+
+    kind: str = "sum"  # 'sum' | 'min' | 'max' | 'count' | 'generic'
+    reduce_fn: Optional[Callable] = None
+    neutral: Any = None
+
+    def to_reduce_spec(self) -> ReduceSpec:
+        return ReduceSpec(
+            self.kind, self.dtype, self.value_shape,
+            combine=self.reduce_fn, neutral=self.neutral,
+        )
+
+
+@dataclass(frozen=True)
+class AggregatingStateDescriptor(StateDescriptor):
+    """Accumulator-style aggregation (ref AggregateFunction contract):
+
+    add:       (acc, value) -> acc     — fold one input into the accumulator
+    merge:     (acc, acc) -> acc       — associative accumulator merge
+    get_result:(acc) -> out            — host- or device-side projection
+
+    The accumulator (not the input) is what lives per (key, pane) on device;
+    value_shape/dtype describe the ACCUMULATOR columns.
+    """
+
+    add: Optional[Callable] = None
+    merge: Optional[Callable] = None
+    get_result: Optional[Callable] = None
+    acc_init: Any = 0
+
+    def to_reduce_spec(self) -> ReduceSpec:
+        return ReduceSpec(
+            "generic", self.dtype, self.value_shape,
+            combine=self.merge, neutral=self.acc_init,
+        )
+
+
+@dataclass(frozen=True)
+class ListStateDescriptor(StateDescriptor):
+    """Bounded per-key element buffer (device lists are fixed-capacity rings).
+
+    max_elements bounds the on-device buffer, the analog of evictor-bounded
+    ListState in the reference's EvictingWindowOperator.
+    """
+
+    max_elements: int = 16

@@ -1,0 +1,211 @@
+"""Window kernel semantics vs a scalar Python model.
+
+Plays the role of the reference's WindowOperatorTest golden-output tests
+(SURVEY §4): out-of-order event-time input, tumbling and sliding windows,
+late-data dropping — compared against a dict-based model.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import hash64_host
+
+
+def _split(keys):
+    h = hash64_host(np.asarray(keys, dtype=np.int64))
+    return (
+        (h >> np.uint64(32)).astype(np.uint32),
+        (h & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+    )
+
+
+class ScalarModel:
+    """Per-record scalar window aggregation (the reference's semantics)."""
+
+    def __init__(self, size, slide):
+        self.size, self.slide = size, slide
+        self.k = size // slide
+        self.panes = {}  # (key, pane) -> sum
+        self.wm = -(2**31) + 1
+        self.fired_through = None  # last fired window-end pane
+        self.dropped = 0
+        self.fires = []  # (window_end_tick, key, value)
+
+    def add(self, key, ts, val):
+        pane = ts // self.slide
+        if self.fired_through is not None and pane + self.k - 1 <= self.fired_through:
+            self.dropped += 1
+            return
+        self.panes[(key, pane)] = self.panes.get((key, pane), 0.0) + val
+
+    def advance(self, wm):
+        self.wm = max(self.wm, wm)
+        wm_pane = (self.wm + 1 - self.slide) // self.slide
+        if not self.panes and self.fired_through is None:
+            self.fired_through = wm_pane
+            return
+        all_panes = [p for (_, p) in self.panes]
+        if self.fired_through is None:
+            start = min(all_panes) if all_panes else wm_pane + 1
+        else:
+            start = self.fired_through + 1
+        for p in range(start, wm_pane + 1):
+            keys = {}
+            for (key, q), v in self.panes.items():
+                if p - self.k + 1 <= q <= p:
+                    keys[key] = keys.get(key, 0.0) + v
+            for key, v in sorted(keys.items()):
+                self.fires.append(((p + 1) * self.slide, key, v))
+            # purge panes fully fired
+            self.panes = {
+                (key, q): v
+                for (key, q), v in self.panes.items()
+                if q + self.k - 1 > p
+            }
+        self.fired_through = max(wm_pane, self.fired_through if self.fired_through is not None else wm_pane)
+
+
+def run_device(events, batches, size, slide, ring=16, fires_per_step=4,
+               capacity=256):
+    win = wk.WindowSpec(size, slide, ring=ring, fires_per_step=fires_per_step)
+    red = wk.ReduceSpec("sum", jnp.float32)
+    st = wk.init_state(capacity, 8, win, red)
+    fires = []
+    keymap = {}
+
+    def collect(fr, hi, lo):
+        n = int(fr.n_fires)
+        mask = np.asarray(fr.mask)
+        vals = np.asarray(fr.values)
+        ends = np.asarray(fr.window_end_ticks)
+        tk = np.asarray(st.table.keys)
+        for f in range(mask.shape[0]):
+            if f >= n:
+                break
+            for c in np.nonzero(mask[f])[0]:
+                kid = (int(tk[c, 0]) << 32) | int(tk[c, 1])
+                fires.append((int(ends[f]), keymap[kid], float(vals[f, c])))
+
+    for batch, wm in batches:
+        if batch:
+            keys = [e[0] for e in batch]
+            ts = np.asarray([e[1] for e in batch], np.int32)
+            vals = np.asarray([e[2] for e in batch], np.float32)
+            hi, lo = _split(keys)
+            for key, h, l in zip(keys, hi, lo):
+                keymap[(int(h) << 32) | int(l)] = key
+            valid = np.ones(len(batch), bool)
+            st = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+                           jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(valid))
+        while True:
+            st, fr = wk.advance_and_fire(st, win, red, jnp.int32(wm))
+            collect(fr, None, None)
+            if int(fr.n_fires) < fires_per_step:
+                break
+    return st, fires
+
+
+def _compare(model_fires, device_fires):
+    assert sorted(model_fires) == sorted(
+        [(e, k, round(v, 3)) for e, k, v in device_fires]
+    )
+
+
+def test_tumbling_in_order():
+    size = slide = 10
+    model = ScalarModel(size, slide)
+    batches = []
+    rng = np.random.default_rng(1)
+    t = 0
+    for step in range(10):
+        batch = []
+        for _ in range(20):
+            key = int(rng.integers(0, 5))
+            ts = t + int(rng.integers(0, 10))
+            v = float(rng.integers(1, 5))
+            batch.append((key, ts, v))
+            model.add(key, ts, v)
+        t += 10
+        wm = t - 1
+        model.advance(wm)
+        batches.append((batch, wm))
+    _, fires = run_device(None, batches, size, slide)
+    model_fires = [(e, k, round(v, 3)) for e, k, v in model.fires]
+    _compare(model_fires, fires)
+    assert len(fires) > 0
+
+
+def test_tumbling_out_of_order_and_late():
+    size = slide = 10
+    model = ScalarModel(size, slide)
+    rng = np.random.default_rng(7)
+    batches = []
+    wm = -(2**31) + 1
+    now = 0
+    for step in range(15):
+        batch = []
+        for _ in range(30):
+            key = int(rng.integers(0, 8))
+            # timestamps scattered up to 25 ticks behind "now" -> some late
+            ts = now - int(rng.integers(0, 25))
+            if ts < 0:
+                ts = 0
+            v = 1.0
+            batch.append((key, ts, v))
+            model.add(key, ts, v)
+        now += 8
+        wm = now - 12  # bounded out-of-orderness watermark
+        model.advance(wm)
+        batches.append((batch, wm))
+    # flush
+    model.advance(10**6)
+    batches.append(([], 10**6))
+    st, fires = run_device(None, batches, size, slide)
+    _compare([(e, k, round(v, 3)) for e, k, v in model.fires], fires)
+    assert int(st.dropped_late) == model.dropped
+    assert int(st.dropped_capacity) == 0
+
+
+def test_sliding_pane_composition():
+    size, slide = 30, 10
+    model = ScalarModel(size, slide)
+    rng = np.random.default_rng(3)
+    batches = []
+    t = 0
+    for step in range(12):
+        batch = []
+        for _ in range(25):
+            key = int(rng.integers(0, 4))
+            ts = t + int(rng.integers(0, 10))
+            v = float(rng.integers(1, 4))
+            batch.append((key, ts, v))
+            model.add(key, ts, v)
+        t += 10
+        wm = t - 1
+        model.advance(wm)
+        batches.append((batch, wm))
+    model.advance(10**6)
+    batches.append(([], 10**6))
+    _, fires = run_device(None, batches, size, slide)
+    _compare([(e, k, round(v, 3)) for e, k, v in model.fires], fires)
+
+
+def test_generic_combine_max():
+    # 'generic' path: max as a generic associative combine
+    win = wk.WindowSpec(10, 10, ring=8, fires_per_step=2)
+    red = wk.ReduceSpec("generic", jnp.float32,
+                        combine=jnp.maximum, neutral=-np.inf)
+    st = wk.init_state(64, 8, win, red)
+    keys = [1, 2, 1, 2, 1]
+    ts = np.asarray([0, 3, 5, 7, 9], np.int32)
+    vals = np.asarray([5.0, 2.0, 9.0, 1.0, 4.0], np.float32)
+    hi, lo = _split(keys)
+    st = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+                   jnp.asarray(ts), jnp.asarray(vals),
+                   jnp.ones(5, dtype=bool))
+    st, fr = wk.advance_and_fire(st, win, red, jnp.int32(9))
+    assert int(fr.n_fires) == 1
+    mask = np.asarray(fr.mask)[0]
+    vals_out = np.asarray(fr.values)[0][mask]
+    assert sorted(vals_out.tolist()) == [2.0, 9.0]
