@@ -1,0 +1,153 @@
+"""North-star benchmark: events/sec/chip on a 1M-key tumbling-window sum.
+
+Subject: flink_tpu's keyed windowed aggregation (columnar source -> keyBy ->
+5s event-time tumbling window -> sum -> counting sink) through the real
+executor on the default JAX backend (TPU chip under axon; --cpu for the
+virtual CPU mesh).
+
+Baseline: the reference's HeapKeyedStateBackend hot path re-implemented
+faithfully in-process (per-record: hash -> dict probe -> reduce -> put;
+watermark advance -> per-key timer drain; SURVEY §3.2/3.3). The reference
+itself (JVM Flink 1.2) cannot run in this image, so the baseline is the same
+scalar algorithm in optimized Python; see BASELINE.md.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": events_per_sec, "unit": "events/s", "vs_baseline": x}
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+N_KEYS = 1_000_000
+WINDOW_MS = 5_000
+EVENTS_PER_MS = 2_000          # event-time rate: 10M events per 5s window
+BATCH = 65_536
+
+
+def gen_batch(offset, n):
+    idx = np.arange(offset, offset + n, dtype=np.int64)
+    keys = (idx * 2862933555777941757) % N_KEYS
+    ts = idx // EVENTS_PER_MS
+    return keys, ts, np.ones(n, np.float32)
+
+
+# ---------------------------------------------------------------- baseline
+def run_baseline(total_events: int) -> float:
+    """Scalar per-record loop with dict-probe state + per-key fire drain."""
+    state = {}          # (key, pane) -> acc   (the StateTable analog)
+    fired = []
+    wm_pane = -1
+    done = 0
+    t0 = time.perf_counter()
+    off = 0
+    while done < total_events:
+        keys, ts, vals = gen_batch(off, min(BATCH, total_events - done))
+        off += len(keys)
+        kl, tl = keys.tolist(), ts.tolist()
+        for i in range(len(kl)):
+            k = kl[i]
+            pane = tl[i] // WINDOW_MS
+            sk = (k, pane)
+            cur = state.get(sk)          # HashMap probe
+            state[sk] = 1.0 if cur is None else cur + 1.0  # reduce + put
+        done += len(kl)
+        # watermark advance: fire panes older than max ts (timer drain)
+        new_wm_pane = tl[-1] // WINDOW_MS - 1
+        if new_wm_pane > wm_pane:
+            for p in range(wm_pane + 1, new_wm_pane + 1):
+                drain = [sk for sk in state if sk[1] == p]
+                for sk in drain:
+                    fired.append((sk[0], state.pop(sk)))
+            wm_pane = new_wm_pane
+    dt = time.perf_counter() - t0
+    return done / dt
+
+
+# ---------------------------------------------------------------- subject
+def run_subject(total_events: int, warmup_events: int) -> tuple:
+    import jax
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    def gen(offset, n):
+        keys, ts, vals = gen_batch(offset, n)
+        return {"key": keys, "value": vals}, ts
+
+    cfg = Configuration({"keys.reverse-map": False})
+    env = StreamExecutionEnvironment(cfg)
+    env.set_parallelism(len(jax.devices()))
+    env.set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1 << 21)
+    env.batch_size = BATCH
+
+    sink = CountingSink()
+
+    timings = {"t_first": None, "t_start": time.perf_counter()}
+
+    class TimingSource(GeneratorSource):
+        def poll(self, max_records):
+            out = super().poll(max_records)
+            if self.offset >= warmup_events and timings["t_first"] is None:
+                timings["t_first"] = time.perf_counter()
+            return out
+
+    (
+        env.add_source(TimingSource(gen, total=total_events))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW_MS)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    t0 = time.perf_counter()
+    job = env.execute("bench-1m-key-window-sum")
+    t1 = time.perf_counter()
+    measured = total_events - warmup_events
+    steady = measured / (t1 - timings["t_first"])
+    assert sink.value_sum == total_events, (sink.value_sum, total_events)
+    return steady, job, sink
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="CPU mesh instead of TPU")
+    ap.add_argument("--events", type=int, default=30_000_000)
+    ap.add_argument("--baseline-events", type=int, default=2_000_000)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    baseline_eps = run_baseline(args.baseline_events)
+    print(f"baseline (scalar heap path): {baseline_eps:,.0f} events/s",
+          file=sys.stderr)
+
+    warmup = min(args.events // 3, 5_000_000)
+    subject_eps, job, sink = run_subject(args.events, warmup)
+    print(
+        f"subject: {subject_eps:,.0f} events/s steady-state | fires={sink.count:,}"
+        f" | steps={job.metrics.steps} | late={job.metrics.dropped_late}"
+        f" | cap={job.metrics.dropped_capacity}",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "events/sec/chip, 1M-key 5s tumbling-window sum",
+        "value": round(subject_eps),
+        "unit": "events/s",
+        "vs_baseline": round(subject_eps / baseline_eps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

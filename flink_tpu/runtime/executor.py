@@ -219,6 +219,9 @@ class LocalExecutor:
         step = None
         state = None
         codec = KeyCodec()
+        # reverse key map costs a python dict insert per record; benchmarks
+        # and columnar sinks that accept 64-bit key ids can turn it off
+        keep_rev = env.config.get_bool("keys.reverse-map", True)
         B = env.batch_size
         wm_strategy = (
             pipe.ts_transform.strategy if pipe.ts_transform is not None
@@ -260,6 +263,11 @@ class LocalExecutor:
             metrics.steps += 1
             return fr
 
+        columnar_emit = (
+            not pipe.post_chain
+            and all(s.columnar for s in pipe.sinks)
+        )
+
         def emit_fires(fr):
             n_f = np.asarray(fr.n_fires)
             if int(n_f.sum()) == 0:
@@ -268,24 +276,41 @@ class LocalExecutor:
             vals = np.asarray(fr.values)
             ends = np.asarray(fr.window_end_ticks)
             tkeys = np.asarray(state.table.keys)
-            out = []
+            khi_l, klo_l, end_l, val_l = [], [], [], []
             for sh in range(mask.shape[0]):
                 for f in range(int(n_f[sh])):
                     sel = np.nonzero(mask[sh, f])[0]
                     if sel.size == 0:
                         continue
-                    khi = tkeys[sh, sel, 0]
-                    klo = tkeys[sh, sel, 1]
-                    keys = codec.decode(khi, klo)
-                    end_ms = int(td.to_ms(int(ends[sh, f])))
-                    v = vals[sh, f, sel]
-                    if wagg.result_fn is not None:
-                        v = wagg.result_fn(v)
-                    for k, vv in zip(keys, np.asarray(v).tolist()):
-                        out.append(WindowResult(k, end_ms, vv))
-            if not out:
+                    khi_l.append(tkeys[sh, sel, 0])
+                    klo_l.append(tkeys[sh, sel, 1])
+                    end_l.append(
+                        np.full(sel.size, td.to_ms(int(ends[sh, f])), np.int64)
+                    )
+                    val_l.append(vals[sh, f, sel])
+            if not khi_l:
                 return 0
-            metrics.fires += len(out)
+            khi = np.concatenate(khi_l)
+            klo = np.concatenate(klo_l)
+            end_ms = np.concatenate(end_l)
+            v = np.concatenate(val_l)
+            if wagg.result_fn is not None:
+                v = np.asarray(wagg.result_fn(v))
+            metrics.fires += len(v)
+            if columnar_emit:
+                kid = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
+                    np.uint64
+                )
+                cols = {"key_id": kid, "window_end_ms": end_ms, "value": v}
+                metrics.records_out += len(v)
+                for s in pipe.sinks:
+                    s.invoke_columnar(cols)
+                return len(v)
+            keys = codec.decode(khi, klo)
+            out = [
+                WindowResult(k, int(e), vv)
+                for k, e, vv in zip(keys, end_ms.tolist(), v.tolist())
+            ]
             out = _apply_chain(pipe.post_chain, out)
             metrics.records_out += len(out)
             for s in pipe.sinks:
@@ -313,7 +338,7 @@ class LocalExecutor:
                     # selectors index the column dict (key_by('name') etc.)
                     keys_arr = np.asarray(pipe.key_by.key_selector(cols))
                     n = len(keys_arr)
-                    hi, lo = codec.encode(keys_arr)
+                    hi, lo = codec.encode(keys_arr, keep_reverse=keep_rev)
                     values = np.asarray(wagg.extractor(cols))
                     if event_time:
                         if pipe.ts_transform is not None:
@@ -333,7 +358,7 @@ class LocalExecutor:
                 n = len(elements)
                 if n:
                     keys = [pipe.key_by.key_selector(e) for e in elements]
-                    hi, lo = codec.encode(keys)
+                    hi, lo = codec.encode(keys, keep_reverse=keep_rev)
                     values = np.asarray(
                         [wagg.extractor(e) for e in elements], np.float32
                     )

@@ -7,14 +7,44 @@ from typing import Any, Callable, List
 
 
 class Sink:
+    #: set True when invoke_columnar is overridden (vectorized fast path)
+    columnar = False
+
     def open(self):
         pass
 
     def invoke_batch(self, elements: List[Any]):
         raise NotImplementedError
 
+    def invoke_columnar(self, cols: dict):
+        """Vectorized delivery: dict of equal-length numpy arrays."""
+        names = list(cols)
+        self.invoke_batch(list(zip(*[cols[n] for n in names])))
+
     def close(self):
         pass
+
+
+class CountingSink(Sink):
+    """Benchmark sink: O(1) per batch, tallies count and value sum."""
+
+    columnar = True
+
+    def __init__(self):
+        self.count = 0
+        self.value_sum = 0.0
+
+    def invoke_batch(self, elements):
+        self.count += len(elements)
+        for e in elements:
+            v = e[-1] if isinstance(e, tuple) else getattr(e, "value", 0.0)
+            self.value_sum += float(v)
+
+    def invoke_columnar(self, cols):
+        import numpy as np
+
+        self.count += len(cols["value"])
+        self.value_sum += float(np.sum(cols["value"]))
 
 
 class CollectSink(Sink):
