@@ -94,9 +94,12 @@ class StreamExecutionEnvironment:
         )
 
     # -- execution -------------------------------------------------------
-    def execute(self, job_name: str = "flink-tpu-job"):
+    def execute(self, job_name: str = "flink-tpu-job",
+                restore_from: Optional[str] = None):
+        """restore_from: checkpoint/savepoint directory to resume from
+        (the reference's `flink run -s <savepoint>` role)."""
         from flink_tpu.runtime.executor import LocalExecutor
 
         executor = LocalExecutor(self)
-        self.last_job = executor.run(job_name, self._sinks)
+        self.last_job = executor.run(job_name, self._sinks, restore_from)
         return self.last_job

@@ -1,0 +1,350 @@
+"""Checkpointing: consistent snapshots, restore, and key-group rescaling.
+
+The reference implements Chandy-Lamport asynchronous barrier snapshotting
+(CheckpointCoordinator triggering barriers through the dataflow,
+BarrierBuffer alignment, per-key-group state files — SURVEY §3.4). In the
+micro-batch SPMD design the barrier is structural: BETWEEN two steps, device
+state + source offsets form a consistent cut, so a checkpoint is simply
+
+    device state  --DMA-->  host  -->  logical entry format  -->  files
+
+**Logical snapshot format** (the savepoint philosophy, ref SavepointV1 +
+KeyGroupsStateHandle): state is stored as (key, pane, value) entries plus
+scalars, independent of the physical hash-slot layout. Restoring at a
+different parallelism re-buckets entries by key group onto the new mesh —
+the analog of StateAssignmentOperation redistributing KeyGroupsStateHandles,
+validated the way RescalingITCase does.
+
+Exactly-once applies to STATE: sources snapshot offsets at the same cut, so
+replay after restore reproduces identical micro-batches and state converges
+to the no-failure result. Sinks see at-least-once on recovery (fires between
+the checkpoint and the failure are re-emitted), like the reference without
+transactional sinks; idempotent sinks recover exactly-once end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.ops import hashtable
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import route_hash
+
+FORMAT_VERSION = 1
+
+
+@dataclass
+class SnapshotMeta:
+    checkpoint_id: int
+    timestamp: float
+    watermark: int
+    fired_through: int
+    max_pane: int
+    min_pane: int
+    dropped_late: int
+    dropped_capacity: int
+    source_offsets: object
+    aux: dict
+
+
+def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
+    """Device -> logical entries. state is the stacked [n_shards, ...] tree."""
+    keys = np.asarray(state.table.keys)          # [S, C, 2]
+    acc = np.asarray(state.acc)                  # [S, C*R, ...]
+    touched = np.asarray(state.touched)          # [S, C*R]
+    pane_ids = np.asarray(state.pane_ids)        # [S, R]
+    S, C, _ = keys.shape
+    R = win.ring
+
+    khi_l, klo_l, pane_l, val_l = [], [], [], []
+    for s in range(S):
+        t2 = touched[s].reshape(C, R)
+        slots, rings = np.nonzero(t2)
+        if slots.size == 0:
+            continue
+        khi_l.append(keys[s, slots, 0])
+        klo_l.append(keys[s, slots, 1])
+        pane_l.append(pane_ids[s, rings])
+        val_l.append(acc[s].reshape((C, R) + acc.shape[2:])[slots, rings])
+    if khi_l:
+        entries = {
+            "key_hi": np.concatenate(khi_l),
+            "key_lo": np.concatenate(klo_l),
+            "pane": np.concatenate(pane_l).astype(np.int32),
+            "value": np.concatenate(val_l),
+        }
+    else:
+        entries = {
+            "key_hi": np.zeros(0, np.uint32),
+            "key_lo": np.zeros(0, np.uint32),
+            "pane": np.zeros(0, np.int32),
+            "value": np.zeros((0,) + acc.shape[2:], acc.dtype),
+        }
+    scalars = {
+        "watermark": int(np.asarray(state.watermark).min()),
+        "fired_through": int(np.asarray(state.fired_through).min()),
+        "max_pane": int(np.asarray(state.max_pane).max()),
+        "min_pane": int(np.asarray(state.min_pane).min()),
+        "dropped_late": int(np.asarray(state.dropped_late).sum()),
+        "dropped_capacity": int(np.asarray(state.dropped_capacity).sum()),
+    }
+    return entries, scalars
+
+
+def restore_window_state(entries, scalars, ctx, spec):
+    """Logical entries -> device state on a (possibly different) mesh.
+
+    Re-buckets every entry by key group onto ctx's shard ranges, re-inserts
+    keys into fresh hash tables, scatters pane values. The ring is
+    re-registered from the global max_pane.
+    """
+    R = spec.win.ring
+    C = spec.capacity_per_shard
+
+    khi = entries["key_hi"]
+    klo = entries["key_lo"]
+    pane = entries["pane"]
+    value = entries["value"]
+
+    max_pane = scalars["max_pane"]
+    have = max_pane != int(wk.PANE_NONE)
+    # drop entries that fell off the (possibly smaller) ring horizon
+    if have and len(pane):
+        keep = pane > max_pane - R
+        khi, klo, pane, value = khi[keep], klo[keep], pane[keep], value[keep]
+
+    kg = assign_to_key_group(route_hash(khi, klo, np), ctx.max_parallelism, np)
+    shard_tables = []
+    shard_accs = []
+    shard_touched = []
+    pane_rows = []
+    starts, ends = ctx.kg_bounds()
+    for s in range(ctx.n_shards):
+        sel = (kg >= starts[s]) & (kg <= ends[s])
+        e_hi, e_lo = khi[sel], klo[sel]
+        e_pane, e_val = pane[sel], value[sel]
+        table = hashtable.create(C, spec.probe_len)
+        acc_s = np.asarray(
+            jnp.broadcast_to(
+                spec.red.neutral_value(), (C * R,) + spec.red.value_shape
+            ).astype(spec.red.dtype)
+        ).copy()
+        touched_s = np.zeros(C * R, bool)
+        if len(e_hi):
+            # unique keys (entries repeat per pane)
+            u_keys, inv = np.unique(
+                (e_hi.astype(np.uint64) << np.uint64(32)) | e_lo, return_inverse=True
+            )
+            u_hi = (u_keys >> np.uint64(32)).astype(np.uint32)
+            u_lo = (u_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            table, slots, ok = hashtable.upsert(
+                table, jnp.asarray(u_hi), jnp.asarray(u_lo),
+                jnp.ones(len(u_hi), dtype=bool),
+            )
+            if not bool(np.asarray(ok).all()):
+                raise RuntimeError(
+                    "restore: state does not fit the configured capacity"
+                )
+            slots = np.asarray(slots)
+            flat = slots[inv] * R + (e_pane % R)
+            acc_s[flat] = e_val
+            touched_s[flat] = True
+        shard_tables.append(np.asarray(table.keys))
+        shard_accs.append(acc_s)
+        shard_touched.append(touched_s)
+        if have:
+            r_idx = np.arange(R)
+            p_r = max_pane - ((max_pane - r_idx) % R)
+            pane_rows.append(p_r.astype(np.int32))
+        else:
+            pane_rows.append(np.full(R, int(wk.PANE_NONE), np.int32))
+
+    def stack_put(arrs, dtype=None):
+        a = np.stack(arrs)
+        return jax.device_put(
+            a if dtype is None else a.astype(dtype), ctx.state_sharding
+        )
+
+    S = ctx.n_shards
+    new_state = wk.WindowShardState(
+        table=hashtable.SlotTable(stack_put(shard_tables), spec.probe_len),
+        acc=stack_put(shard_accs),
+        touched=stack_put(shard_touched),
+        pane_ids=stack_put(pane_rows),
+        max_pane=_scal(S, scalars["max_pane"], ctx),
+        min_pane=_scal(S, scalars["min_pane"], ctx),
+        watermark=_scal(S, scalars["watermark"], ctx),
+        fired_through=_scal(S, scalars["fired_through"], ctx),
+        dropped_late=_scal(S, scalars["dropped_late"], ctx, split=True),
+        dropped_capacity=_scal(S, scalars["dropped_capacity"], ctx, split=True),
+    )
+    return new_state
+
+
+def _scal(S, v, ctx, split=False):
+    if split:
+        # counters: keep the global total on shard 0 so sums stay correct
+        arr = np.zeros(S, np.int32)
+        arr[0] = v
+    else:
+        arr = np.full(S, v, np.int32)
+    return jax.device_put(arr, ctx.state_sharding)
+
+
+class CheckpointStorage:
+    """Directory layout:  <dir>/chk-<id>/{meta.json, entries.npz, aux.pkl}
+    (ref FsStateBackend checkpoint stream role)."""
+
+    def __init__(self, directory: str, retain: int = 2):
+        self.dir = directory
+        self.retain = retain
+        os.makedirs(directory, exist_ok=True)
+
+    def path(self, cid: int) -> str:
+        return os.path.join(self.dir, f"chk-{cid}")
+
+    def write(self, cid: int, entries, scalars, source_offsets, aux: dict):
+        tmp = self.path(cid) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(os.path.join(tmp, "entries.npz"), **entries)
+        with open(os.path.join(tmp, "aux.pkl"), "wb") as f:
+            pickle.dump({"source_offsets": source_offsets, "aux": aux}, f)
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "checkpoint_id": cid,
+            "timestamp": time.time(),
+            **scalars,
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        final = self.path(cid)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # atomic publish
+        self._gc(keep_latest=cid)
+        return final
+
+    def _gc(self, keep_latest: int):
+        cids = [c for c in self.list_checkpoints() if c != keep_latest]
+        # keep the newest (retain-1) besides keep_latest
+        for cid in cids[: -(self.retain - 1)] if self.retain > 1 else cids:
+            shutil.rmtree(self.path(cid), ignore_errors=True)
+
+    def list_checkpoints(self):
+        out = []
+        if not os.path.isdir(self.dir):
+            return out
+        for name in os.listdir(self.dir):
+            if name.startswith("chk-") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[4:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def read(self, cid: int):
+        p = self.path(cid)
+        with open(os.path.join(p, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint format: {meta}")
+        with np.load(os.path.join(p, "entries.npz")) as z:
+            entries = {k: z[k] for k in z.files}
+        with open(os.path.join(p, "aux.pkl"), "rb") as f:
+            auxd = pickle.load(f)
+        scalars = {
+            k: meta[k]
+            for k in ("watermark", "fired_through", "max_pane", "min_pane",
+                      "dropped_late", "dropped_capacity")
+        }
+        return entries, scalars, auxd["source_offsets"], auxd["aux"]
+
+    def latest(self) -> Optional[int]:
+        cids = self.list_checkpoints()
+        return cids[-1] if cids else None
+
+    # -- incremental key map log ---------------------------------------
+    # The codec's key-id -> original-key map is append-only; checkpoints
+    # record only a count and new entries go to a shared log, so a 1M-key
+    # job doesn't re-pickle the whole map every interval.
+    def _keymap_path(self) -> str:
+        return os.path.join(self.dir, "keymap.log")
+
+    def append_keymap(self, items) -> None:
+        if not items:
+            return
+        with open(self._keymap_path(), "ab") as f:
+            pickle.dump(items, f)
+
+    def read_keymap(self, count: int) -> dict:
+        out = {}
+        path = self._keymap_path()
+        if count and os.path.exists(path):
+            with open(path, "rb") as f:
+                while len(out) < count:
+                    try:
+                        for kid, key in pickle.load(f):
+                            out.setdefault(kid, key)
+                    except EOFError:
+                        break
+        return out
+
+
+# ----------------------------------------------------------------- restart
+
+@dataclass
+class RestartStrategy:
+    """ref RestartStrategies (fixed-delay / failure-rate / no-restart)."""
+
+    kind: str = "none"          # none | fixed-delay | failure-rate
+    attempts: int = 3
+    delay_s: float = 0.0
+    failure_rate: int = 3       # max failures...
+    failure_interval_s: float = 60.0  # ...per interval
+
+    _failures: list = None
+
+    @staticmethod
+    def none() -> "RestartStrategy":
+        return RestartStrategy("none")
+
+    @staticmethod
+    def fixed_delay(attempts: int, delay_s: float = 0.0) -> "RestartStrategy":
+        return RestartStrategy("fixed-delay", attempts=attempts, delay_s=delay_s)
+
+    @staticmethod
+    def failure_rate(max_per_interval: int, interval_s: float,
+                     delay_s: float = 0.0) -> "RestartStrategy":
+        return RestartStrategy(
+            "failure-rate", failure_rate=max_per_interval,
+            failure_interval_s=interval_s, delay_s=delay_s,
+        )
+
+    def should_restart(self) -> bool:
+        now = time.time()
+        if self._failures is None:
+            self._failures = []
+        self._failures.append(now)
+        if self.kind == "none":
+            return False
+        if self.kind == "fixed-delay":
+            ok = len(self._failures) <= self.attempts
+        else:
+            window = [t for t in self._failures
+                      if t > now - self.failure_interval_s]
+            self._failures = window
+            ok = len(window) <= self.failure_rate
+        if ok and self.delay_s:
+            time.sleep(self.delay_s)
+        return ok

@@ -15,6 +15,7 @@ Chandy-Lamport cut is structural).
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import namedtuple
 from typing import Any, Callable, List, Optional
@@ -33,6 +34,7 @@ from flink_tpu.runtime.step import (
     build_window_step,
     init_sharded_state,
 )
+from flink_tpu.runtime import checkpoint as ckpt
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
@@ -55,6 +57,7 @@ class JobMetrics:
     steps: int = 0
     dropped_late: int = 0
     dropped_capacity: int = 0
+    restarts: int = 0
     wall_time_s: float = 0.0
 
 
@@ -133,7 +136,23 @@ class LocalExecutor:
     def __init__(self, env):
         self.env = env
 
-    def run(self, job_name: str, sink_transforms) -> JobHandle:
+    def _restart_strategy(self) -> ckpt.RestartStrategy:
+        cfg = self.env.config
+        kind = cfg.get_str("restart-strategy", "none")
+        if kind == "fixed-delay":
+            return ckpt.RestartStrategy.fixed_delay(
+                cfg.get_int("restart-strategy.fixed-delay.attempts", 3),
+                cfg.get_float("restart-strategy.fixed-delay.delay", 0.0),
+            )
+        if kind == "failure-rate":
+            return ckpt.RestartStrategy.failure_rate(
+                cfg.get_int("restart-strategy.failure-rate.max-failures", 3),
+                cfg.get_float("restart-strategy.failure-rate.interval", 60.0),
+                cfg.get_float("restart-strategy.failure-rate.delay", 0.0),
+            )
+        return ckpt.RestartStrategy.none()
+
+    def run(self, job_name: str, sink_transforms, restore_from=None) -> JobHandle:
         from flink_tpu.core.time import TimeCharacteristic
 
         pipe = _translate(sink_transforms)
@@ -147,7 +166,8 @@ class LocalExecutor:
                 self._run_stateless(pipe, metrics)
                 handle = JobHandle(job_name, metrics)
             else:
-                handle = self._run_windowed(pipe, metrics, job_name)
+                handle = self._run_windowed(pipe, metrics, job_name,
+                                            restore_from)
         finally:
             pipe.source.close()
             for s in pipe.sinks:
@@ -185,7 +205,8 @@ class LocalExecutor:
         return polled
 
     # ------------------------------------------------------------------
-    def _run_windowed(self, pipe: _Pipeline, metrics: JobMetrics, job_name):
+    def _run_windowed(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                      restore_from=None):
         from flink_tpu.core.time import TimeCharacteristic
 
         env = self.env
@@ -228,10 +249,9 @@ class LocalExecutor:
             else WatermarkStrategy.for_monotonous_timestamps()
         )
 
-        def setup(first_ts_ms: int):
+        def setup(origin_ms: int, fresh_state: bool = True):
             nonlocal td, win, spec, step, state
-            origin = (int(first_ts_ms) // size_ms) * size_ms
-            td = TimeDomain(origin_ms=origin, ms_per_tick=1)
+            td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
             ring = env.config.get_int("window.ring-panes", 0) or max(
                 8,
                 2 * (size_ms // slide_ms)
@@ -247,8 +267,65 @@ class LocalExecutor:
                 win=win, red=red,
                 capacity_per_shard=env.state_capacity_per_shard,
             )
-            step = build_window_step(ctx, spec)
-            state = init_sharded_state(ctx, spec)
+            if step is None:
+                step = build_window_step(ctx, spec)
+            if fresh_state:
+                state = init_sharded_state(ctx, spec)
+
+        # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
+        storage = None
+        if env.checkpoint_dir:
+            storage = ckpt.CheckpointStorage(
+                env.checkpoint_dir,
+                retain=env.config.get_int("checkpoint.retain", 2),
+            )
+        # resume numbering after any checkpoints already in the directory
+        next_cid = (storage.latest() or 0) + 1 if storage else 1
+        steps_at_ckpt = 0
+        n_keys_logged = 0
+
+        def write_checkpoint():
+            nonlocal next_cid, steps_at_ckpt, n_keys_logged
+            entries, scalars = ckpt.snapshot_window_state(state, win)
+            if keep_rev:
+                items = list(codec._rev.items())[n_keys_logged:]
+                storage.append_keymap(items)
+                n_keys_logged = len(codec._rev)
+            aux = {
+                "origin_ms": td.origin_ms,
+                "wm_current": wm_strategy.current(),
+                "codec_rev_count": n_keys_logged if keep_rev else 0,
+                "size_ms": size_ms, "slide_ms": slide_ms,
+            }
+            storage.write(next_cid, entries, scalars,
+                          pipe.source.snapshot_offsets(), aux)
+            next_cid += 1
+            steps_at_ckpt = metrics.steps
+
+        def restore_checkpoint(path_or_storage, cid=None):
+            nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
+            st = (
+                ckpt.CheckpointStorage(path_or_storage)
+                if isinstance(path_or_storage, str) else path_or_storage
+            )
+            cid = cid if cid is not None else st.latest()
+            if cid is None:
+                raise FileNotFoundError(f"no checkpoint in {st.dir}")
+            entries, scalars, offsets, aux = st.read(cid)
+            if (aux["size_ms"], aux["slide_ms"]) != (size_ms, slide_ms):
+                raise ValueError("checkpoint window spec mismatch")
+            setup(aux["origin_ms"], fresh_state=False)
+            state = ckpt.restore_window_state(entries, scalars, ctx, spec)
+            pipe.source.restore_offsets(offsets)
+            wm_strategy._current = aux["wm_current"]
+            count = aux.get("codec_rev_count", 0)
+            if count:
+                codec._rev = st.read_keymap(count)
+            same_dir = storage is not None and (
+                os.path.abspath(st.dir) == os.path.abspath(storage.dir)
+            )
+            n_keys_logged = len(codec._rev) if same_dir else 0
+            steps_at_ckpt = metrics.steps
 
         def run_step(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
@@ -317,9 +394,13 @@ class LocalExecutor:
                 s.invoke_batch(out)
             return len(out)
 
-        empty = None  # cached empty-batch args
-        end = False
-        while not end:
+        def batch_loop():
+            end = False
+            while not end:
+                end = poll_cycle()
+
+        def poll_cycle():
+            nonlocal td
             polled, end = pipe.source.poll(B)
             now_ms = int(time.time() * 1000)
             hi = lo = ticks = values = None
@@ -375,7 +456,7 @@ class LocalExecutor:
             metrics.records_in += n
             if n:
                 if td is None:
-                    setup(int(np.min(ts_ms)))
+                    setup((int(np.min(ts_ms)) // size_ms) * size_ms)
                 ticks = td.to_ticks(ts_ms)
                 if event_time:
                     wm_ms = wm_strategy.on_batch(int(np.max(ts_ms)))
@@ -415,6 +496,34 @@ class LocalExecutor:
                 if not event_time:
                     fr = self._empty_step(run_step, B, red, now_ms - 1)
                     emit_fires(fr)
+            if (
+                storage is not None
+                and env.checkpoint_interval_steps > 0
+                and metrics.steps - steps_at_ckpt >= env.checkpoint_interval_steps
+                and td is not None
+            ):
+                write_checkpoint()
+            return end
+
+        # -- run with restore + restart (ref ExecutionGraph.restart + ------
+        # -- CheckpointCoordinator.restoreLatestCheckpointedState) ---------
+        if restore_from:
+            restore_checkpoint(restore_from)
+        restart = self._restart_strategy()
+        while True:
+            try:
+                batch_loop()
+                break
+            except Exception:
+                can = (
+                    storage is not None
+                    and storage.latest() is not None
+                    and restart.should_restart()
+                )
+                if not can:
+                    raise
+                metrics.restarts += 1
+                restore_checkpoint(storage)
 
         # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
         if td is not None:
