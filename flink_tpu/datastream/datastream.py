@@ -98,6 +98,11 @@ class KeyedStream(DataStream):
             return self.window(TumblingEventTimeWindows.of(size_ms))
         return self.window(SlidingEventTimeWindows.of(size_ms, slide_ms))
 
+    def count_window(self, size: int) -> "WindowedStream":
+        from flink_tpu.datastream.window.assigners import CountWindowAssigner
+
+        return self.window(CountWindowAssigner(size))
+
     # -- rolling (non-windowed) keyed aggregation ------------------------
     def reduce(self, fn: Callable, extractor=None, neutral=0.0,
                dtype=jnp.float32) -> DataStream:

@@ -53,6 +53,19 @@ class SlidingProcessingTimeWindows(WindowAssigner):
 
 
 @dataclass(frozen=True)
+class CountWindowAssigner:
+    """countWindow(N): tumbling windows of N elements per key (ref
+    KeyedStream.countWindow = GlobalWindows + CountTrigger + purge)."""
+
+    size_n: int
+    is_event_time: bool = False
+
+    @property
+    def is_session(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
 class SessionWindowAssigner:
     """Session windows (gap-merged); executed by the session-merge path."""
 

@@ -1,0 +1,128 @@
+"""Count windows: per-key tumbling windows of N elements.
+
+The reference builds these from GlobalWindows + CountTrigger(N) + purging
+(KeyedStream.countWindow). TPU redesign: a batch is sorted by state slot;
+per-record positions within each key (segmented cumsum) yield absolute
+element indices, which partition into count-windows of N. A second segment
+level (slot, window) aggregates each window in one pass; windows that fill
+exactly to N fire, the trailing partial window stays in state. The whole
+batch — any number of fires per key — is one kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashtable import SlotTable
+from flink_tpu.ops.segment import _bshape, segmented_reduce_sorted
+from flink_tpu.ops.window_kernels import ReduceSpec
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CountShardState:
+    table: SlotTable
+    count: jax.Array    # int32 [C] absolute element count per key
+    acc: jax.Array      # [C, *vs] partial (trailing) window accumulator
+    touched: jax.Array  # [C] partial window has data
+    dropped_capacity: jax.Array
+
+    def tree_flatten(self):
+        return (self.table, self.count, self.acc, self.touched,
+                self.dropped_capacity), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_state(capacity: int, probe_len: int, red: ReduceSpec) -> CountShardState:
+    neutral = red.neutral_value()
+    acc = jnp.broadcast_to(neutral, (capacity,) + red.value_shape).astype(red.dtype)
+    return CountShardState(
+        table=hashtable.create(capacity, probe_len),
+        count=jnp.zeros(capacity, jnp.int32),
+        acc=acc + jnp.zeros_like(acc),
+        touched=jnp.zeros(capacity, bool),
+        dropped_capacity=jnp.zeros((), jnp.int32),
+    )
+
+
+def update(
+    state: CountShardState, red: ReduceSpec, n_per_window: int,
+    hi, lo, values, valid,
+) -> Tuple[CountShardState, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (state', fire_khi [B], fire_klo [B], fire_w [B],
+    fire_values [B,*vs], fire_mask [B]): one lane per completed window
+    (sorted-lane space); fire_w is the 0-based window ordinal per key."""
+    C = state.table.capacity
+    N = jnp.int32(n_per_window)
+    combine = red.combine_fn()
+    neutral = red.neutral_value()
+
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid)
+    n_nofit = jnp.sum(valid & ~ok, dtype=jnp.int32)
+    live = valid & ok
+
+    big = jnp.int32(2**31 - 1)
+    ids = jnp.where(live, slot, big)
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    khi_s, klo_s = hi[order], lo[order]
+    vals = values.astype(red.dtype)[order]
+    live_s = live[order]
+    vals = jnp.where(_bshape(live_s, vals), vals, jnp.asarray(neutral, red.dtype))
+
+    slot_start = jnp.concatenate([jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    # per-record 1-based position within its key segment
+    pos = segmented_reduce_sorted(
+        jnp.ones_like(ids_s), slot_start, lambda a, b: a + b
+    )
+    safe = jnp.where(ids_s < C, ids_s, C - 1)
+    old_count = jnp.where(ids_s < C, state.count[safe], 0)
+    a = old_count + pos                       # absolute element index (1-based)
+    w = (a - 1) // N                          # window index
+    # (slot, window) sub-segments: already sorted (pos ascending within slot)
+    w_start = slot_start | jnp.concatenate(
+        [jnp.zeros((1,), bool), w[1:] != w[:-1]]
+    )
+    rolled = segmented_reduce_sorted(vals, w_start, combine)
+    # fold the carried partial accumulator into this key's FIRST window
+    first_w = old_count // N
+    in_first = (w == first_w) & live_s
+    old_partial = state.acc[safe]
+    has_partial = state.touched[safe] & (old_count % N != 0)
+    rolled = jnp.where(
+        _bshape(in_first & has_partial, rolled),
+        combine(old_partial, rolled), rolled,
+    )
+
+    w_end = jnp.concatenate(
+        [(ids_s[1:] != ids_s[:-1]) | (w[1:] != w[:-1]), jnp.ones((1,), bool)]
+    )
+    rep = w_end & live_s
+    complete = rep & (a == (w + 1) * N)       # window filled exactly
+    slot_end = jnp.concatenate([ids_s[1:] != ids_s[:-1], jnp.ones((1,), bool)])
+    tail = slot_end & live_s & (a % N != 0)   # trailing partial window
+
+    # -- state update -----------------------------------------------------
+    cnt_idx = jnp.where(slot_end & live_s, ids_s, C)
+    count = state.count.at[cnt_idx].set(a, mode="drop")
+    acc_idx = jnp.where(slot_end & live_s, ids_s, C)
+    new_acc_val = jnp.where(
+        _bshape(tail, rolled), rolled, jnp.asarray(neutral, red.dtype)
+    )
+    acc = state.acc.at[acc_idx].set(new_acc_val.astype(red.dtype), mode="drop")
+    touched = state.touched.at[acc_idx].set(tail, mode="drop")
+
+    new_state = CountShardState(
+        table=table, count=count, acc=acc, touched=touched,
+        dropped_capacity=state.dropped_capacity + n_nofit,
+    )
+    return new_state, khi_s, klo_s, w, rolled, complete

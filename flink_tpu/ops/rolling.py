@@ -1,0 +1,107 @@
+"""Rolling (non-windowed) keyed aggregation — StreamGroupedReduce analog.
+
+The reference's StreamGroupedReduce keeps one ValueState per key and emits
+the updated accumulator for EVERY input record (SURVEY §2.5 built-in
+operators). Batched TPU redesign: sort the batch by state slot, run a
+segmented inclusive scan (any associative combine), add the pre-batch
+accumulator of each key's segment, emit per-record rolling outputs in the
+original lane order, and scatter each segment's total back into state —
+one kernel for the whole batch instead of B sequential probe/update/emits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashtable import SlotTable
+from flink_tpu.ops.segment import _bshape, segmented_reduce_sorted
+from flink_tpu.ops.window_kernels import ReduceSpec
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class RollingShardState:
+    table: SlotTable
+    acc: jax.Array      # [C, *value_shape]
+    touched: jax.Array  # [C]
+    dropped_capacity: jax.Array
+
+    def tree_flatten(self):
+        return (self.table, self.acc, self.touched, self.dropped_capacity), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_state(capacity: int, probe_len: int, red: ReduceSpec) -> RollingShardState:
+    neutral = red.neutral_value()
+    acc = jnp.broadcast_to(neutral, (capacity,) + red.value_shape).astype(red.dtype)
+    return RollingShardState(
+        table=hashtable.create(capacity, probe_len),
+        acc=acc + jnp.zeros_like(acc),
+        touched=jnp.zeros(capacity, bool),
+        dropped_capacity=jnp.zeros((), jnp.int32),
+    )
+
+
+def update(
+    state: RollingShardState, red: ReduceSpec, hi, lo, values, valid
+) -> Tuple[RollingShardState, jax.Array, jax.Array]:
+    """Returns (state', outputs [B, *value_shape], out_valid [B]).
+
+    outputs[i] = accumulator value of record i's key immediately after
+    record i is applied (reference rolling-reduce semantics, batch order =
+    lane order).
+    """
+    C = state.table.capacity
+    combine = red.combine_fn()
+    neutral = red.neutral_value()
+
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid)
+    n_nofit = jnp.sum(valid & ~ok, dtype=jnp.int32)
+    live = valid & ok
+
+    big = jnp.int32(2**31 - 1)
+    ids = jnp.where(live, slot, big)
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    vals = values.astype(red.dtype)
+    vals_s = jnp.where(
+        _bshape(live[order], vals[order]), vals[order],
+        jnp.asarray(neutral, red.dtype),
+    )
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    prefix = segmented_reduce_sorted(vals_s, seg_start, combine)
+
+    # fold the pre-batch accumulator into every lane of touched segments
+    safe = jnp.where(ids_s < C, ids_s, C - 1)
+    seg_old = state.acc[safe]
+    seg_touched = state.touched[safe] & (ids_s < C)
+    rolled = jnp.where(
+        _bshape(seg_touched, prefix), combine(seg_old, prefix), prefix
+    )
+
+    # outputs back in lane order
+    inv = jnp.argsort(order)
+    outputs = rolled[inv]
+    out_valid = live
+
+    # segment totals -> state
+    seg_end = jnp.concatenate([ids_s[1:] != ids_s[:-1], jnp.ones((1,), bool)])
+    rep = seg_end & (ids_s < C)
+    rep_idx = jnp.where(rep, ids_s, C)
+    acc = state.acc.at[rep_idx].set(rolled.astype(red.dtype), mode="drop")
+    touched = state.touched.at[rep_idx].set(True, mode="drop")
+
+    new_state = RollingShardState(
+        table=table, acc=acc, touched=touched,
+        dropped_capacity=state.dropped_capacity + n_nofit,
+    )
+    return new_state, outputs, out_valid

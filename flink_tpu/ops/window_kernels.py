@@ -122,6 +122,7 @@ class WindowShardState:
     min_pane: jax.Array     # int32 scalar: oldest pane ever seen (fire start)
     watermark: jax.Array    # int32 scalar
     fired_through: jax.Array  # int32 scalar: last window-end pane emitted
+    purged_through: jax.Array  # int32 scalar: panes <= this are known clean
     dropped_late: jax.Array     # int32 counter
     dropped_capacity: jax.Array  # int32 counter (table full or ring overflow)
 
@@ -129,7 +130,7 @@ class WindowShardState:
         return (
             (self.table, self.acc, self.touched, self.pane_ids, self.max_pane,
              self.min_pane, self.watermark, self.fired_through,
-             self.dropped_late, self.dropped_capacity),
+             self.purged_through, self.dropped_late, self.dropped_capacity),
             None,
         )
 
@@ -152,6 +153,7 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
         min_pane=jnp.asarray(2**31 - 1, jnp.int32),
         watermark=jnp.asarray(-(2**31) + 1, jnp.int32),
         fired_through=jnp.asarray(PANE_NONE),
+        purged_through=jnp.asarray(PANE_NONE),
         dropped_late=jnp.zeros((), jnp.int32),
         dropped_capacity=jnp.zeros((), jnp.int32),
     )
@@ -207,10 +209,19 @@ def update(
     )
     neutral = red.neutral_value()
     acc2d = state.acc.reshape((C, R) + red.value_shape)
-    acc2d = jnp.where(
-        _expand(stale[None, :], acc2d), neutral.astype(red.dtype), acc2d
+
+    # The ring advances at most once per pane period; gate the full-state
+    # reset sweep behind a cond so steady-state steps skip the HBM pass.
+    def do_reset(acc2d, touched2d):
+        return (
+            jnp.where(_expand(stale[None, :], acc2d),
+                      neutral.astype(red.dtype), acc2d),
+            jnp.where(stale[None, :], False, touched2d),
+        )
+
+    acc2d, touched2d = jax.lax.cond(
+        jnp.any(stale), do_reset, lambda a, t: (a, t), acc2d, touched2d
     )
-    touched2d = jnp.where(stale[None, :], False, touched2d)
     pane_ids = jnp.where(stale, p_r, state.pane_ids)
     acc = acc2d.reshape((C * R,) + red.value_shape)
     touched = touched2d.reshape(C * R)
@@ -257,6 +268,7 @@ def update(
         min_pane=new_min,
         watermark=state.watermark,
         fired_through=state.fired_through,
+        purged_through=state.purged_through,
         dropped_late=state.dropped_late + n_late,
         dropped_capacity=state.dropped_capacity + n_too_old + n_nofit + n_evicted,
     )
@@ -367,16 +379,28 @@ def advance_and_fire(
     new_fired_through = jnp.where(
         n_due > F, start + n_now - 1, jnp.maximum(wm_pane, state.fired_through)
     )
+    # Empty shards track wm_pane too, so fired_through stays consistent
+    # across shards and a snapshot min() reflects the true global cut.
     new_fired_through = jnp.where(
-        have, new_fired_through, state.fired_through
+        have, new_fired_through,
+        jnp.maximum(state.fired_through, wm_pane),
     )
-    r_idx = jnp.arange(R, dtype=jnp.int32)
-    purgeable = (state.pane_ids != PANE_NONE) & (
-        state.pane_ids + jnp.int32(k - 1) <= new_fired_through
+    purgeable = (
+        (state.pane_ids != PANE_NONE)
+        & (state.pane_ids + jnp.int32(k - 1) <= new_fired_through)
+        & (state.pane_ids > state.purged_through)
     )
     neutral = red.neutral_value()
-    acc3 = jnp.where(_expand(purgeable[None, :], acc3), neutral, acc3)
-    touched2 = jnp.where(purgeable[None, :], False, touched2)
+
+    def do_purge(acc3, touched2):
+        return (
+            jnp.where(_expand(purgeable[None, :], acc3), neutral, acc3),
+            jnp.where(purgeable[None, :], False, touched2),
+        )
+
+    acc3, touched2 = jax.lax.cond(
+        jnp.any(purgeable), do_purge, lambda a, t: (a, t), acc3, touched2
+    )
 
     new_state = WindowShardState(
         table=state.table,
@@ -387,6 +411,16 @@ def advance_and_fire(
         min_pane=state.min_pane,
         watermark=wm,
         fired_through=new_fired_through,
+        # clamp before subtracting so near-INT32_MIN values cannot wrap
+        purged_through=jnp.where(
+            new_fired_through == PANE_NONE,
+            state.purged_through,
+            jnp.maximum(
+                state.purged_through,
+                jnp.maximum(new_fired_through, PANE_NONE + jnp.int32(k))
+                - jnp.int32(k - 1),
+            ),
+        ),
         dropped_late=state.dropped_late,
         dropped_capacity=state.dropped_capacity,
     )

@@ -186,6 +186,13 @@ def restore_window_state(entries, scalars, ctx, spec):
         min_pane=_scal(S, scalars["min_pane"], ctx),
         watermark=_scal(S, scalars["watermark"], ctx),
         fired_through=_scal(S, scalars["fired_through"], ctx),
+        purged_through=_scal(
+            S,
+            scalars["fired_through"] - (spec.win.panes_per_window - 1)
+            if scalars["fired_through"] != int(wk.PANE_NONE)
+            else int(wk.PANE_NONE),
+            ctx,
+        ),
         dropped_late=_scal(S, scalars["dropped_late"], ctx, split=True),
         dropped_capacity=_scal(S, scalars["dropped_capacity"], ctx, split=True),
     )

@@ -15,6 +15,7 @@ Chandy-Lamport cut is structural).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import os
 import time
 from collections import namedtuple
@@ -76,6 +77,7 @@ class _Pipeline:
     ts_transform: Optional[sg.TimestampsWatermarksTransformation]
     key_by: Optional[sg.KeyByTransformation]
     window_agg: Optional[sg.WindowAggTransformation]
+    rolling: Optional[sg.KeyedProcessTransformation]
     post_chain: List[sg.OneInputTransformation]
     sinks: List[Any]
 
@@ -90,7 +92,8 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
             raise NotImplementedError(
                 "multiple divergent sink lineages not yet supported"
             )
-    pipe = _Pipeline(None, [], None, None, None, [], [t.sink for t in sink_transforms])
+    pipe = _Pipeline(None, [], None, None, None, None, [],
+                     [t.sink for t in sink_transforms])
     stage = "pre"
     for t in first:
         if isinstance(t, sg.SourceTransformation):
@@ -104,15 +107,18 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
             pipe.window_agg = t
             stage = "post"
         elif isinstance(t, sg.KeyedProcessTransformation):
-            raise NotImplementedError("rolling keyed reduce lands with the next stage kind")
+            pipe.rolling = t
+            stage = "post"
         elif isinstance(t, sg.OneInputTransformation):
             (pipe.pre_chain if stage == "pre" else pipe.post_chain).append(t)
         else:
             raise NotImplementedError(f"transformation {type(t).__name__}")
     if pipe.source is None:
         raise ValueError("pipeline has no source")
-    if pipe.key_by is not None and pipe.window_agg is None:
-        raise NotImplementedError("keyed stream must currently end in a window agg")
+    if pipe.key_by is not None and pipe.window_agg is None and pipe.rolling is None:
+        raise NotImplementedError(
+            "keyed stream must currently end in a window agg or rolling reduce"
+        )
     return pipe
 
 
@@ -162,12 +168,20 @@ class LocalExecutor:
             s.open()
         pipe.source.open()
         try:
-            if pipe.window_agg is None:
-                self._run_stateless(pipe, metrics)
-                handle = JobHandle(job_name, metrics)
-            else:
+            from flink_tpu.datastream.window.assigners import CountWindowAssigner
+
+            if pipe.window_agg is not None and isinstance(
+                pipe.window_agg.assigner, CountWindowAssigner
+            ):
+                handle = self._run_count(pipe, metrics, job_name, restore_from)
+            elif pipe.window_agg is not None:
                 handle = self._run_windowed(pipe, metrics, job_name,
                                             restore_from)
+            elif pipe.rolling is not None:
+                handle = self._run_rolling(pipe, metrics, job_name, restore_from)
+            else:
+                self._run_stateless(pipe, metrics)
+                handle = JobHandle(job_name, metrics)
         finally:
             pipe.source.close()
             for s in pipe.sinks:
@@ -286,9 +300,19 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt, n_keys_logged
+            # drain due fires so fired_through is uniform across shards and
+            # the snapshot is an exact global cut (F-throttle divergence)
+            while True:
+                fr = self._empty_step(run_step, B, red,
+                                      int(wm_strategy.current()))
+                emit_fires(fr)
+                if int(np.asarray(fr.n_fires).sum()) == 0:
+                    break
             entries, scalars = ckpt.snapshot_window_state(state, win)
             if keep_rev:
-                items = list(codec._rev.items())[n_keys_logged:]
+                items = list(
+                    itertools.islice(codec._rev.items(), n_keys_logged, None)
+                )
                 storage.append_keymap(items)
                 n_keys_logged = len(codec._rev)
             aux = {
@@ -547,6 +571,173 @@ class LocalExecutor:
                     f"or the pane ring, or set state.backend.strict-capacity "
                     f"to false to tolerate drops)"
                 )
+        return JobHandle(job_name, metrics, state=state, ctx=ctx)
+
+    # ------------------------------------------------------------------
+    def _prep_keyed_batch(self, pipe: _Pipeline, polled, extractor):
+        """Shared poll -> (key_list, values) prep for keyed stages without
+        event-time handling (rolling / count windows)."""
+        if pipe.source.columnar and isinstance(polled, tuple):
+            cols, _ts = polled
+            if not cols:
+                return None
+            for t in pipe.pre_chain:
+                if t.kind != "map":
+                    raise NotImplementedError(
+                        "columnar sources support only 'map' before key_by"
+                    )
+                cols = t.fn(cols)
+            return np.asarray(pipe.key_by.key_selector(cols)), np.asarray(
+                extractor(cols)
+            )
+        elements = _apply_chain(pipe.pre_chain, self._to_elements(polled))
+        if not elements:
+            return None
+        key_list = [pipe.key_by.key_selector(e) for e in elements]
+        values = np.asarray([extractor(e) for e in elements], np.float32)
+        return key_list, values
+
+    def _check_no_checkpointing(self, kind: str, restore_from=None):
+        if self.env.checkpoint_interval_steps or self.env.checkpoint_dir or restore_from:
+            raise NotImplementedError(
+                f"checkpoint/restore is not implemented yet for {kind} stages"
+            )
+
+    def _run_rolling(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                     restore_from=None):
+        """Rolling keyed reduce: emits the updated accumulator per record
+        (ref StreamGroupedReduce)."""
+        from flink_tpu.runtime.step import (
+            RollingStageSpec, build_rolling_step, init_rolling_state,
+        )
+
+        self._check_no_checkpointing("rolling-reduce", restore_from)
+        env = self.env
+        roll = pipe.rolling
+        red = roll.reduce_spec_factory()
+        n_dev = len(jax.devices())
+        n_shards = max(1, min(env.parallelism, n_dev))
+        ctx = MeshContext.create(n_shards, env.max_parallelism)
+        spec = RollingStageSpec(
+            red=red, capacity_per_shard=env.state_capacity_per_shard
+        )
+        step = build_rolling_step(ctx, spec)
+        state = init_rolling_state(ctx, spec)
+        B = env.batch_size
+        keep_rev = env.config.get_bool("keys.reverse-map", True)
+        codec = KeyCodec()
+
+        end = False
+        while not end:
+            polled, end = pipe.source.poll(B)
+            prepped = self._prep_keyed_batch(pipe, polled, roll.extractor)
+            if prepped is None:
+                continue
+            key_list, values = prepped
+            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+            n = len(hi)
+            metrics.records_in += n
+            state, outputs, out_valid = step(
+                state,
+                jnp.asarray(_pad(hi, B, np.uint32)),
+                jnp.asarray(_pad(lo, B, np.uint32)),
+                jnp.asarray(_pad(values, B, values.dtype)),
+                jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+            )
+            metrics.steps += 1
+            out_np = np.asarray(outputs)[:n]
+            ok_np = np.asarray(out_valid)[:n]
+            if roll.result_fn is not None:
+                out_np = np.asarray(roll.result_fn(out_np))
+            klist = (
+                key_list.tolist() if isinstance(key_list, np.ndarray)
+                else key_list
+            )
+            out = [
+                (k, v) for k, v, okv in zip(klist, out_np.tolist(), ok_np)
+                if okv
+            ]
+            out = _apply_chain(pipe.post_chain, out)
+            metrics.records_out += len(out)
+            for s in pipe.sinks:
+                s.invoke_batch(out)
+
+        dropped = int(np.asarray(state.dropped_capacity).sum())
+        metrics.dropped_capacity = dropped
+        if dropped and env.config.get_bool("state.backend.strict-capacity", True):
+            raise RuntimeError(
+                f"state backend over capacity: {dropped} records lost"
+            )
+        return JobHandle(job_name, metrics, state=state, ctx=ctx)
+
+    # ------------------------------------------------------------------
+    def _run_count(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                   restore_from=None):
+        """countWindow(N): per-key tumbling windows of N elements."""
+        from flink_tpu.runtime.step import (
+            CountStageSpec, build_count_step, init_count_state,
+        )
+
+        self._check_no_checkpointing("count-window", restore_from)
+        env = self.env
+        wagg = pipe.window_agg
+        red = wagg.reduce_spec_factory()
+        n_dev = len(jax.devices())
+        n_shards = max(1, min(env.parallelism, n_dev))
+        ctx = MeshContext.create(n_shards, env.max_parallelism)
+        spec = CountStageSpec(
+            red=red, n_per_window=wagg.assigner.size_n,
+            capacity_per_shard=env.state_capacity_per_shard,
+        )
+        step = build_count_step(ctx, spec)
+        state = init_count_state(ctx, spec)
+        B = env.batch_size
+        keep_rev = env.config.get_bool("keys.reverse-map", True)
+        codec = KeyCodec()
+
+        end = False
+        while not end:
+            polled, end = pipe.source.poll(B)
+            prepped = self._prep_keyed_batch(pipe, polled, wagg.extractor)
+            if prepped is None:
+                continue
+            key_list, values = prepped
+            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+            n = len(hi)
+            metrics.records_in += n
+            state, khi, klo, w, vals, mask = step(
+                state,
+                jnp.asarray(_pad(hi, B, np.uint32)),
+                jnp.asarray(_pad(lo, B, np.uint32)),
+                jnp.asarray(_pad(values, B, values.dtype)),
+                jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+            )
+            metrics.steps += 1
+            mask_np = np.asarray(mask)
+            if mask_np.any():
+                khi_np = np.asarray(khi)[mask_np]
+                klo_np = np.asarray(klo)[mask_np]
+                w_np = np.asarray(w)[mask_np]
+                v_np = np.asarray(vals)[mask_np]
+                if wagg.result_fn is not None:
+                    v_np = np.asarray(wagg.result_fn(v_np))
+                keys = codec.decode(khi_np, klo_np)
+                out = [
+                    WindowResult(k, int(wi), vv)
+                    for k, wi, vv in zip(keys, w_np.tolist(), v_np.tolist())
+                ]
+                metrics.fires += len(out)
+                out = _apply_chain(pipe.post_chain, out)
+                metrics.records_out += len(out)
+                for s in pipe.sinks:
+                    s.invoke_batch(out)
+
+        dropped = int(np.asarray(state.dropped_capacity).sum())
+        metrics.dropped_capacity = dropped
+        if dropped and env.config.get_bool("state.backend.strict-capacity", True):
+            raise RuntimeError(
+                f"state backend over capacity: {dropped} records lost"
+            )
         return JobHandle(job_name, metrics, state=state, ctx=ctx)
 
     @staticmethod

@@ -103,3 +103,134 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
 
 def watermark_vector(ctx: MeshContext, wm: int):
     return jnp.full((ctx.n_shards,), np.int32(wm))
+
+
+# ---------------------------------------------------------- count windows
+
+@dataclass
+class CountStageSpec:
+    red: "object"
+    n_per_window: int = 100
+    capacity_per_shard: int = 1 << 16
+    probe_len: int = 16
+
+
+def init_count_state(ctx: MeshContext, spec: CountStageSpec):
+    from flink_tpu.ops import count_windows as cw
+
+    states = [
+        cw.init_state(spec.capacity_per_shard, spec.probe_len, spec.red)
+        for _ in range(ctx.n_shards)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return jax.device_put(stacked, ctx.state_sharding)
+
+
+def build_count_step(ctx: MeshContext, spec: CountStageSpec):
+    from flink_tpu.ops import count_windows as cw
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+
+    def shard_body(state, kg_start, kg_end, hi, lo, values, valid):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+            kg <= kg_end.astype(jnp.uint32)
+        )
+        state, khi, klo, w, vals, mask = cw.update(
+            state, spec.red, spec.n_per_window, hi, lo, values, mine
+        )
+        pack = lambda x: x[None]
+        state = jax.tree_util.tree_map(pack, state)
+        return state, pack(khi), pack(klo), pack(w), pack(vals), pack(mask)
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(),
+        ),
+        out_specs=tuple([P(SHARD_AXIS)] * 6),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state, hi, lo, values, valid):
+        return sharded(state, starts, ends, hi, lo, values, valid)
+
+    return step
+
+
+# --------------------------------------------------------------- rolling
+
+@dataclass
+class RollingStageSpec:
+    red: "object"  # wk.ReduceSpec
+    capacity_per_shard: int = 1 << 16
+    probe_len: int = 16
+
+
+def init_rolling_state(ctx: MeshContext, spec: RollingStageSpec):
+    from flink_tpu.ops import rolling
+
+    states = [
+        rolling.init_state(spec.capacity_per_shard, spec.probe_len, spec.red)
+        for _ in range(ctx.n_shards)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return jax.device_put(stacked, ctx.state_sharding)
+
+
+def build_rolling_step(ctx: MeshContext, spec: RollingStageSpec):
+    """Rolling keyed reduce over the mesh: per-record outputs are psum-merged
+    across shards (each lane is owned by exactly one shard)."""
+    from flink_tpu.ops import rolling
+    from flink_tpu.ops.segment import _bshape
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+
+    def shard_body(state, kg_start, kg_end, hi, lo, values, valid):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+            kg <= kg_end.astype(jnp.uint32)
+        )
+        state, outputs, out_valid = rolling.update(
+            state, spec.red, hi, lo, values, mine
+        )
+        outputs = jax.lax.psum(
+            jnp.where(_bshape(out_valid, outputs), outputs,
+                      jnp.zeros((), outputs.dtype)),
+            SHARD_AXIS,
+        )
+        out_valid = jax.lax.psum(out_valid.astype(jnp.int32), SHARD_AXIS) > 0
+        state = jax.tree_util.tree_map(lambda x: x[None], state)
+        return state, outputs, out_valid
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(),
+        ),
+        out_specs=(P(SHARD_AXIS), P(), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state, hi, lo, values, valid):
+        return sharded(state, starts, ends, hi, lo, values, valid)
+
+    return step
