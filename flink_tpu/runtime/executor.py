@@ -39,6 +39,9 @@ from flink_tpu.runtime import checkpoint as ckpt
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
+SessionResult = namedtuple(
+    "SessionResult", ["key", "window_start_ms", "window_end_ms", "value"]
+)
 
 
 def _pad(arr, size, dtype):
@@ -170,7 +173,12 @@ class LocalExecutor:
         try:
             from flink_tpu.datastream.window.assigners import CountWindowAssigner
 
-            if pipe.window_agg is not None and isinstance(
+            if pipe.window_agg is not None and getattr(
+                pipe.window_agg.assigner, "is_session", False
+            ):
+                handle = self._run_session(pipe, metrics, job_name,
+                                           restore_from)
+            elif pipe.window_agg is not None and isinstance(
                 pipe.window_agg.assigner, CountWindowAssigner
             ):
                 handle = self._run_count(pipe, metrics, job_name, restore_from)
@@ -226,11 +234,6 @@ class LocalExecutor:
         env = self.env
         wagg = pipe.window_agg
         assigner = wagg.assigner
-        if getattr(assigner, "is_session", False):
-            raise NotImplementedError(
-                "session windows execute via the session-merge path "
-                "(not wired into the executor yet)"
-            )
         if wagg.allowed_lateness_ms > 0:
             raise NotImplementedError(
                 "allowed_lateness > 0 (late re-fires) is not implemented yet; "
@@ -662,6 +665,165 @@ class LocalExecutor:
             for s in pipe.sinks:
                 s.invoke_batch(out)
 
+        dropped = int(np.asarray(state.dropped_capacity).sum())
+        metrics.dropped_capacity = dropped
+        if dropped and env.config.get_bool("state.backend.strict-capacity", True):
+            raise RuntimeError(
+                f"state backend over capacity: {dropped} records lost"
+            )
+        return JobHandle(job_name, metrics, state=state, ctx=ctx)
+
+    # ------------------------------------------------------------------
+    def _run_session(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                     restore_from=None):
+        """Session windows with gap-based merging (see ops/session_windows)."""
+        from flink_tpu.core.time import TimeCharacteristic
+        from flink_tpu.runtime.step import (
+            SessionStageSpec, build_session_step, init_session_state,
+        )
+
+        self._check_no_checkpointing("session-window", restore_from)
+        env = self.env
+        wagg = pipe.window_agg
+        assigner = wagg.assigner
+        event_time = assigner.is_event_time and (
+            env.time_characteristic == TimeCharacteristic.EventTime
+        )
+        red = wagg.reduce_spec_factory()
+        n_dev = len(jax.devices())
+        n_shards = max(1, min(env.parallelism, n_dev))
+        ctx = MeshContext.create(n_shards, env.max_parallelism)
+        spec = SessionStageSpec(
+            red=red, gap_ticks=assigner.gap_ms,
+            capacity_per_shard=env.state_capacity_per_shard,
+        )
+        step = build_session_step(ctx, spec)
+        state = init_session_state(ctx, spec)
+        B = env.batch_size
+        keep_rev = env.config.get_bool("keys.reverse-map", True)
+        codec = KeyCodec()
+        td: Optional[TimeDomain] = None
+        wm_strategy = (
+            pipe.ts_transform.strategy if pipe.ts_transform is not None
+            else WatermarkStrategy.for_monotonous_timestamps()
+        )
+
+        def emit(old_f, mid_f, wm_f):
+            out = []
+            tkeys = np.asarray(state.table.keys)
+            for fire in (old_f, mid_f):
+                khi, klo, f_start, f_end, f_vals, f_mask = map(np.asarray, fire)
+                for sh in range(khi.shape[0]):
+                    sel = np.nonzero(f_mask[sh])[0]
+                    if not sel.size:
+                        continue
+                    keys = codec.decode(khi[sh, sel], klo[sh, sel])
+                    for k, st_, en_, v in zip(
+                        keys, f_start[sh, sel].tolist(),
+                        f_end[sh, sel].tolist(), f_vals[sh, sel].tolist(),
+                    ):
+                        out.append(SessionResult(
+                            k, int(td.to_ms(st_)), int(td.to_ms(en_)), v
+                        ))
+            w_start, w_end, w_vals, w_mask = map(np.asarray, wm_f)
+            for sh in range(w_mask.shape[0]):
+                sel = np.nonzero(w_mask[sh])[0]
+                if not sel.size:
+                    continue
+                keys = codec.decode(tkeys[sh, sel, 0], tkeys[sh, sel, 1])
+                for k, st_, en_, v in zip(
+                    keys, w_start[sh, sel].tolist(),
+                    w_end[sh, sel].tolist(), w_vals[sh, sel].tolist(),
+                ):
+                    out.append(SessionResult(
+                        k, int(td.to_ms(st_)), int(td.to_ms(en_)), v
+                    ))
+            if not out:
+                return
+            if wagg.result_fn is not None:
+                out = [r._replace(value=float(np.asarray(
+                    wagg.result_fn(np.asarray(r.value))))) for r in out]
+            metrics.fires += len(out)
+            out = _apply_chain(pipe.post_chain, out)
+            metrics.records_out += len(out)
+            for s in pipe.sinks:
+                s.invoke_batch(out)
+
+        def run_once(hi, lo, ticks, values, valid, wm_ms):
+            nonlocal state
+            wmv = jnp.full((ctx.n_shards,), np.int32(
+                int(td.to_ticks(wm_ms)) if wm_ms is not None else -(2**31) + 1
+            ))
+            state, old_f, mid_f, wm_f = step(
+                state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
+                jnp.asarray(values), jnp.asarray(valid), wmv,
+            )
+            metrics.steps += 1
+            emit(old_f, mid_f, wm_f)
+
+        end = False
+        while not end:
+            polled, end = pipe.source.poll(B)
+            now_ms = int(time.time() * 1000)
+            if pipe.source.columnar and isinstance(polled, tuple):
+                cols, ts_ms = polled
+                if not cols:
+                    continue
+                for t in pipe.pre_chain:
+                    if t.kind != "map":
+                        raise NotImplementedError(
+                            "columnar sources support only 'map' before key_by"
+                        )
+                    cols = t.fn(cols)
+                key_list = np.asarray(pipe.key_by.key_selector(cols))
+                values = np.asarray(wagg.extractor(cols))
+                if event_time and pipe.ts_transform is not None:
+                    ts_ms = np.asarray(
+                        pipe.ts_transform.timestamp_fn(cols), np.int64)
+                elif not event_time or ts_ms is None:
+                    ts_ms = np.full(len(key_list), now_ms, np.int64)
+            else:
+                elements = _apply_chain(pipe.pre_chain, self._to_elements(polled))
+                if not elements:
+                    continue
+                key_list = [pipe.key_by.key_selector(e) for e in elements]
+                values = np.asarray(
+                    [wagg.extractor(e) for e in elements], np.float32
+                )
+                if event_time and pipe.ts_transform is not None:
+                    ts_ms = np.asarray(
+                        [pipe.ts_transform.timestamp_fn(e) for e in elements],
+                        np.int64,
+                    )
+                else:
+                    ts_ms = np.full(len(key_list), now_ms, np.int64)
+            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+            n = len(hi)
+            metrics.records_in += n
+            if td is None:
+                td = TimeDomain(origin_ms=int(np.min(ts_ms)), ms_per_tick=1)
+            ticks = td.to_ticks(ts_ms)
+            wm_ms = (
+                wm_strategy.on_batch(int(np.max(ts_ms))) if event_time
+                else now_ms - 1
+            )
+            run_once(
+                _pad(hi, B, np.uint32), _pad(lo, B, np.uint32),
+                _pad(ticks, B, np.int32), _pad(values, B, np.float32),
+                _pad(np.ones(n, bool), B, bool), wm_ms,
+            )
+
+        if td is not None:
+            # end of stream: close all open sessions
+            final_wm = int(td.to_ms(2**31 - 4))
+            run_once(
+                np.zeros(B, np.uint32), np.zeros(B, np.uint32),
+                np.zeros(B, np.int32),
+                np.zeros((B,) + tuple(red.value_shape), np.float32),
+                np.zeros(B, bool), final_wm,
+            )
+
+        metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped
         if dropped and env.config.get_bool("state.backend.strict-capacity", True):

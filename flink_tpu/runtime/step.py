@@ -105,6 +105,67 @@ def watermark_vector(ctx: MeshContext, wm: int):
     return jnp.full((ctx.n_shards,), np.int32(wm))
 
 
+# -------------------------------------------------------- session windows
+
+@dataclass
+class SessionStageSpec:
+    red: "object"
+    gap_ticks: int = 1000
+    capacity_per_shard: int = 1 << 16
+    probe_len: int = 16
+
+
+def init_session_state(ctx: MeshContext, spec: SessionStageSpec):
+    from flink_tpu.ops import session_windows as sw
+
+    states = [
+        sw.init_state(spec.capacity_per_shard, spec.probe_len, spec.red)
+        for _ in range(ctx.n_shards)
+    ]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return jax.device_put(stacked, ctx.state_sharding)
+
+
+def build_session_step(ctx: MeshContext, spec: SessionStageSpec):
+    from flink_tpu.ops import session_windows as sw
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+            kg <= kg_end.astype(jnp.uint32)
+        )
+        state, old_f, mid_f, wm_f = sw.update_and_fire(
+            state, spec.red, spec.gap_ticks, hi, lo, ts, values, mine, wm[0]
+        )
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return pack(state), pack(old_f), pack(mid_f), pack(wm_f)
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(), P(), P(SHARD_AXIS),
+        ),
+        out_specs=(P(SHARD_AXIS),) * 4,
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state, hi, lo, ts, values, valid, wm):
+        return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
+
+    return step
+
+
 # ---------------------------------------------------------- count windows
 
 @dataclass

@@ -1,0 +1,125 @@
+"""Session window semantics vs a scalar merging model (the analog of the
+reference's session cases in WindowOperatorTest + MergingWindowSet tests)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.datastream.window.assigners import EventTimeSessionWindows
+from flink_tpu.runtime.sinks import CollectSink
+
+
+def scalar_sessions(events, gap):
+    """events: (key, ts, v) in arrival order (assumed ts-ordered per test).
+    Returns {(key, start, end): sum} with full merging."""
+    sessions = {}  # key -> list of [start, last, sum]
+    for k, ts, v in events:
+        lst = sessions.setdefault(k, [])
+        merged = None
+        for s in lst:
+            if ts <= s[1] + gap and ts + gap >= s[0]:
+                s[0] = min(s[0], ts)
+                s[1] = max(s[1], ts)
+                s[2] += v
+                merged = s
+                break
+        if merged is None:
+            lst.append([ts, ts, v])
+        else:
+            # cascading merges
+            changed = True
+            while changed:
+                changed = False
+                for a in lst:
+                    for b in lst:
+                        if a is not b and a[0] <= b[1] + gap and b[0] <= a[1] + gap:
+                            a[0], a[1], a[2] = (
+                                min(a[0], b[0]), max(a[1], b[1]), a[2] + b[2]
+                            )
+                            lst.remove(b)
+                            changed = True
+                            break
+                    if changed:
+                        break
+    return {
+        (k, s[0], s[1] + gap): s[2]
+        for k, lst in sessions.items()
+        for s in lst
+    }
+
+
+def run(events, gap, batch=16, parallelism=4, oob=0):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(512)
+    env.batch_size = batch
+    sink = CollectSink()
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+    strat = (WatermarkStrategy.for_bounded_out_of_orderness(oob) if oob
+             else None)
+    ds = env.from_collection(events).assign_timestamps_and_watermarks(
+        lambda e: e[1], strat
+    )
+    (
+        ds.key_by(lambda e: e[0])
+        .window(EventTimeSessionWindows.with_gap(gap))
+        .sum(lambda e: e[2])
+        .add_sink(sink)
+    )
+    env.execute("sessions")
+    return {
+        (r.key, r.window_start_ms, r.window_end_ms): r.value
+        for r in sink.results
+    }, env.last_job
+
+
+def test_basic_sessions_in_order():
+    gap = 100
+    events = [
+        ("a", 0, 1.0), ("a", 50, 2.0),      # session a:[0,150)
+        ("b", 20, 5.0),                     # session b:[20,120)
+        ("a", 300, 3.0), ("a", 350, 4.0),   # session a:[300,450)
+        ("b", 500, 1.0),                    # session b:[500,600)
+    ]
+    got, job = run(events, gap)
+    expect = scalar_sessions(events, gap)
+    assert got == expect
+    assert job.metrics.dropped_late == 0
+
+
+def test_sessions_random_stream(rng):
+    gap = 50
+    t = 0
+    events = []
+    for _ in range(400):
+        t += int(rng.integers(0, 40))  # sometimes > gap -> new sessions
+        k = int(rng.integers(0, 6))
+        events.append((k, t, 1.0))
+    got, job = run(events, gap, batch=32, parallelism=8)
+    expect = scalar_sessions(events, gap)
+    assert got == expect
+
+
+def test_session_merge_within_batch_and_across_batches():
+    gap = 100
+    # one key, events split across batches so the open session carries over
+    events = [("k", t, 1.0) for t in range(0, 1000, 60)]  # all one session
+    got, job = run(events, gap, batch=4)
+    assert got == {("k", 0, 960 + gap): float(len(events))}
+
+
+def test_session_out_of_order_within_gap(rng):
+    gap = 200
+    base = [("k", t, 1.0) for t in range(0, 2000, 50)]
+    # shuffle lightly within a 100ms horizon (< gap), watermark bound 100
+    events = []
+    for i, e in enumerate(base):
+        events.append(e)
+    events[3], events[4] = events[4], events[3]
+    events[10], events[12] = events[12], events[10]
+    got, job = run(events, gap, batch=8, oob=100)
+    expect = scalar_sessions(events, gap)
+    assert got == expect
