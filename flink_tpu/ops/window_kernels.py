@@ -95,6 +95,7 @@ class WindowSpec:
     slide_ticks: int
     ring: int = 8
     fires_per_step: int = 2
+    lateness_ticks: int = 0  # allowedLateness: late updates re-fire windows
 
     def __post_init__(self):
         if self.size_ticks % self.slide_ticks:
@@ -125,12 +126,15 @@ class WindowShardState:
     purged_through: jax.Array  # int32 scalar: panes <= this are known clean
     dropped_late: jax.Array     # int32 counter
     dropped_capacity: jax.Array  # int32 counter (table full or ring overflow)
+    fresh: jax.Array            # bool [C*R]: late-updated, pending re-fire
+    n_fresh: jax.Array          # int32 scalar: count of set fresh flags
 
     def tree_flatten(self):
         return (
             (self.table, self.acc, self.touched, self.pane_ids, self.max_pane,
              self.min_pane, self.watermark, self.fired_through,
-             self.purged_through, self.dropped_late, self.dropped_capacity),
+             self.purged_through, self.dropped_late, self.dropped_capacity,
+             self.fresh, self.n_fresh),
             None,
         )
 
@@ -156,6 +160,8 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
         purged_through=jnp.asarray(PANE_NONE),
         dropped_late=jnp.zeros((), jnp.int32),
         dropped_capacity=jnp.zeros((), jnp.int32),
+        fresh=jnp.zeros(capacity * R, bool),
+        n_fresh=jnp.zeros((), jnp.int32),
     )
 
 
@@ -181,10 +187,20 @@ def update(
     k = win.panes_per_window
 
     pane = _floor_div_pane(ts, win.slide_ticks)
+    L = win.lateness_ticks
 
-    # -- late check: every window containing this pane already fired? ------
+    # -- late check (ref WindowOperator.isWindowLate): drop iff every window
+    # containing this pane has passed end-1+allowedLateness at the PRE-batch
+    # watermark, or the pane's storage was already purged.
+    base = jnp.maximum(
+        state.watermark,
+        jnp.int32(-(2**31) + 1 + win.slide_ticks) + jnp.int32(L),
+    ) - jnp.int32(L)
+    wm_pane_l = _floor_div_pane(base + 1 - win.slide_ticks, win.slide_ticks)
     last_end = pane + jnp.int32(k - 1)  # newest window-end pane covering rec
-    late = valid & (last_end <= state.fired_through)
+    late = valid & (
+        (last_end <= wm_pane_l) | (pane <= state.purged_through)
+    )
     n_late = jnp.sum(late, dtype=jnp.int32)
     live = valid & ~late
 
@@ -210,17 +226,21 @@ def update(
     neutral = red.neutral_value()
     acc2d = state.acc.reshape((C, R) + red.value_shape)
 
+    fresh2d = state.fresh.reshape(C, R)
+
     # The ring advances at most once per pane period; gate the full-state
     # reset sweep behind a cond so steady-state steps skip the HBM pass.
-    def do_reset(acc2d, touched2d):
+    def do_reset(acc2d, touched2d, fresh2d):
         return (
             jnp.where(_expand(stale[None, :], acc2d),
                       neutral.astype(red.dtype), acc2d),
             jnp.where(stale[None, :], False, touched2d),
+            jnp.where(stale[None, :], False, fresh2d),
         )
 
-    acc2d, touched2d = jax.lax.cond(
-        jnp.any(stale), do_reset, lambda a, t: (a, t), acc2d, touched2d
+    acc2d, touched2d, fresh2d = jax.lax.cond(
+        jnp.any(stale), do_reset, lambda a, t, fr: (a, t, fr),
+        acc2d, touched2d, fresh2d,
     )
     pane_ids = jnp.where(stale, p_r, state.pane_ids)
     acc = acc2d.reshape((C * R,) + red.value_shape)
@@ -259,6 +279,17 @@ def update(
         acc = acc.at[safe].set(merged, mode="drop")
     touched = scatter_combine(touched, flat, jnp.ones_like(flat, bool), live, "set")
 
+    # -- allowed lateness: records landing in already-fired windows mark
+    # their pane "fresh" so those windows re-fire (ref late-firing panes)
+    fresh = fresh2d.reshape(C * R)
+    n_fresh = state.n_fresh
+    if L > 0:
+        late_upd = live & (pane <= state.fired_through)
+        fresh = scatter_combine(
+            fresh, flat, jnp.ones_like(flat, bool), late_upd, "set"
+        )
+        n_fresh = n_fresh + jnp.sum(late_upd, dtype=jnp.int32)
+
     return WindowShardState(
         table=table,
         acc=acc,
@@ -271,6 +302,8 @@ def update(
         purged_through=state.purged_through,
         dropped_late=state.dropped_late + n_late,
         dropped_capacity=state.dropped_capacity + n_too_old + n_nofit + n_evicted,
+        fresh=fresh,
+        n_fresh=n_fresh,
     )
 
 
@@ -282,21 +315,25 @@ def _expand(flag, val):
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class FireResult:
-    """Up to F window fires, whole-shard masked.
+    """Window fires, whole-shard masked. With allowedLateness the lane count
+    doubles: F on-time lanes then F late re-fire lanes.
 
-    mask:     bool [F, C] — slot emitted for fire f
-    values:   [F, C, *value_shape]
-    window_end_ticks: int32 [F] (exclusive end; PANE_NONE when fire lane unused)
-    n_fires:  int32 scalar
+    mask:     bool [Ft, C] — slot emitted for fire lane f
+    values:   [Ft, C, *value_shape]
+    window_end_ticks: int32 [Ft] (exclusive end; PANE_NONE when lane unused)
+    n_fires:  int32 scalar — number of valid lanes
+    lane_valid: bool [Ft]
     """
 
     mask: jax.Array
     values: jax.Array
     window_end_ticks: jax.Array
     n_fires: jax.Array
+    lane_valid: jax.Array
 
     def tree_flatten(self):
-        return (self.mask, self.values, self.window_end_ticks, self.n_fires), None
+        return (self.mask, self.values, self.window_end_ticks, self.n_fires,
+                self.lane_valid), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -349,15 +386,19 @@ def advance_and_fire(
 
     acc3 = state.acc.reshape((C, R) + red.value_shape)
     touched2 = state.touched.reshape(C, R)
+    fresh2 = state.fresh.reshape(C, R)
+    big = jnp.int32(2**31 - 1)
 
-    def fire_one(p, ok):
-        # combine panes p-k+1 .. p
+    def fire_one(p, ok, mask2):
+        """Evaluate window ending at pane p for all keys; emission mask
+        comes from mask2 (touched for on-time fires, fresh for re-fires),
+        values always combine every touched pane of the window."""
         combine = red.combine_fn()
         neutral = red.neutral_value()
         vals = jnp.broadcast_to(
             neutral, (C,) + red.value_shape
         ).astype(red.dtype)
-        any_touched = jnp.zeros(C, bool)
+        emit = jnp.zeros(C, bool)
         for j in range(k - 1, -1, -1):
             q = p - j
             r = jnp.mod(q, jnp.int32(R))
@@ -366,16 +407,16 @@ def advance_and_fire(
             col_t = touched2[:, r] & present
             vals = jnp.where(_expand(col_t, vals), combine(vals, col), vals)
             # combine(neutral, col) == col for first touch
-            any_touched = any_touched | col_t
-        return any_touched & ok, vals
+            emit = emit | (mask2[:, r] & present)
+        return emit, vals
 
-    mask, values = jax.vmap(fire_one)(p_f, lane_ok)
-
+    mask, values = jax.vmap(lambda p, ok: fire_one(p, ok, touched2))(
+        p_f, lane_ok
+    )
     window_end = jnp.where(
         lane_ok, (p_f + 1) * jnp.int32(win.slide_ticks), PANE_NONE
     )
 
-    # purge panes no longer in any unfired window: q + k - 1 <= fired_through'
     new_fired_through = jnp.where(
         n_due > F, start + n_now - 1, jnp.maximum(wm_pane, state.fired_through)
     )
@@ -385,11 +426,86 @@ def advance_and_fire(
         have, new_fired_through,
         jnp.maximum(state.fired_through, wm_pane),
     )
+
+    # -- late re-fires (allowedLateness): windows <= fired_through whose
+    # panes got late updates re-fire with their corrected full value.
+    if win.lateness_ticks > 0:
+        def do_late(fresh2):
+            fresh_any = jnp.any(fresh2, axis=0)  # [R]
+            j_idx = jnp.arange(k, dtype=jnp.int32)
+            wc = state.pane_ids[:, None] + j_idx[None, :]  # [R, k]
+            need = (
+                fresh_any[:, None]
+                & (state.pane_ids != PANE_NONE)[:, None]
+                & (wc <= new_fired_through)
+            )
+            wflat = jnp.where(need.reshape(-1), wc.reshape(-1), big)
+            wsort = jnp.sort(wflat)
+            first = jnp.concatenate(
+                [jnp.ones((1,), bool), wsort[1:] != wsort[:-1]]
+            ) & (wsort < big)
+            rank = jnp.cumsum(first) - 1
+            sel = jnp.full((F,), big)
+            sel = sel.at[jnp.where(first, rank, F)].set(wsort, mode="drop")
+            sel_ok = sel < big
+            lmask, lvals = jax.vmap(
+                lambda p, ok: fire_one(p, ok, fresh2)
+            )(sel, sel_ok)
+            # clear fresh panes whose due windows were all covered this pass
+            covered_c = (~need) | (wc[:, :, None] == sel[None, None, :]).any(-1)
+            pane_done = covered_c.all(axis=1) & fresh_any
+            fresh2b = jnp.where(pane_done[None, :], False, fresh2)
+            return (lmask, lvals, sel, sel_ok, fresh2b,
+                    jnp.sum(fresh2b, dtype=jnp.int32))
+
+        def no_late(fresh2):
+            return (
+                jnp.zeros((F, C), bool),
+                jnp.zeros((F, C) + red.value_shape, red.dtype),
+                jnp.full((F,), big),
+                jnp.zeros((F,), bool),
+                fresh2,
+                state.n_fresh,
+            )
+
+        lmask, lvals, lsel, lsel_ok, fresh2, n_fresh = jax.lax.cond(
+            state.n_fresh > 0, do_late, no_late, fresh2
+        )
+        mask = jnp.concatenate([mask, lmask])
+        values = jnp.concatenate([values, lvals])
+        window_end = jnp.concatenate(
+            [window_end,
+             jnp.where(lsel_ok, (lsel + 1) * jnp.int32(win.slide_ticks),
+                       PANE_NONE)]
+        )
+        lane_valid = jnp.concatenate([lane_ok, lsel_ok])
+        n_fires = n_now + jnp.sum(lsel_ok, dtype=jnp.int32)
+    else:
+        lane_valid = lane_ok
+        n_fires = n_now
+        n_fresh = state.n_fresh
+
+    # -- purge: a pane leaves state only once BOTH every containing window
+    # has fired AND the lateness horizon has passed (and no pending re-fire)
+    # clamp before subtracting lateness so the MIN sentinel cannot wrap
+    base_l = jnp.maximum(
+        wm,
+        jnp.int32(-(2**31) + 1 + win.slide_ticks) + jnp.int32(win.lateness_ticks),
+    ) - jnp.int32(win.lateness_ticks)
+    wm_pane_l = _floor_div_pane(base_l + 1 - win.slide_ticks, win.slide_ticks)
+    cutoff = jnp.minimum(new_fired_through, wm_pane_l)
     purgeable = (
         (state.pane_ids != PANE_NONE)
-        & (state.pane_ids + jnp.int32(k - 1) <= new_fired_through)
+        & (state.pane_ids + jnp.int32(k - 1) <= cutoff)
         & (state.pane_ids > state.purged_through)
     )
+    if win.lateness_ticks > 0:
+        fresh_guard = jax.lax.cond(
+            n_fresh > 0,
+            lambda: jnp.any(fresh2, axis=0),
+            lambda: jnp.zeros((R,), bool),
+        )
+        purgeable = purgeable & ~fresh_guard
     neutral = red.neutral_value()
 
     def do_purge(acc3, touched2):
@@ -411,17 +527,20 @@ def advance_and_fire(
         min_pane=state.min_pane,
         watermark=wm,
         fired_through=new_fired_through,
-        # clamp before subtracting so near-INT32_MIN values cannot wrap
+        # clamp before subtracting so near-INT32_MIN values cannot wrap;
+        # with lateness, purged_through may only advance to the purge cutoff
         purged_through=jnp.where(
-            new_fired_through == PANE_NONE,
+            cutoff == PANE_NONE,
             state.purged_through,
             jnp.maximum(
                 state.purged_through,
-                jnp.maximum(new_fired_through, PANE_NONE + jnp.int32(k))
+                jnp.maximum(cutoff, PANE_NONE + jnp.int32(k))
                 - jnp.int32(k - 1),
             ),
         ),
         dropped_late=state.dropped_late,
         dropped_capacity=state.dropped_capacity,
+        fresh=fresh2.reshape(C * R),
+        n_fresh=n_fresh,
     )
-    return new_state, FireResult(mask, values, window_end, n_now)
+    return new_state, FireResult(mask, values, window_end, n_fires, lane_valid)

@@ -67,7 +67,9 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
     S, C, _ = keys.shape
     R = win.ring
 
-    khi_l, klo_l, pane_l, val_l = [], [], [], []
+    fresh = np.asarray(state.fresh)               # [S, C*R]
+
+    khi_l, klo_l, pane_l, val_l, fresh_l = [], [], [], [], []
     for s in range(S):
         t2 = touched[s].reshape(C, R)
         slots, rings = np.nonzero(t2)
@@ -77,12 +79,14 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
         klo_l.append(keys[s, slots, 1])
         pane_l.append(pane_ids[s, rings])
         val_l.append(acc[s].reshape((C, R) + acc.shape[2:])[slots, rings])
+        fresh_l.append(fresh[s].reshape(C, R)[slots, rings])
     if khi_l:
         entries = {
             "key_hi": np.concatenate(khi_l),
             "key_lo": np.concatenate(klo_l),
             "pane": np.concatenate(pane_l).astype(np.int32),
             "value": np.concatenate(val_l),
+            "fresh": np.concatenate(fresh_l),
         }
     else:
         entries = {
@@ -90,6 +94,7 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
             "key_lo": np.zeros(0, np.uint32),
             "pane": np.zeros(0, np.int32),
             "value": np.zeros((0,) + acc.shape[2:], acc.dtype),
+            "fresh": np.zeros(0, bool),
         }
     scalars = {
         "watermark": int(np.asarray(state.watermark).min()),
@@ -116,24 +121,29 @@ def restore_window_state(entries, scalars, ctx, spec):
     klo = entries["key_lo"]
     pane = entries["pane"]
     value = entries["value"]
+    e_fresh = entries.get("fresh", np.zeros(len(pane), bool))
 
     max_pane = scalars["max_pane"]
     have = max_pane != int(wk.PANE_NONE)
     # drop entries that fell off the (possibly smaller) ring horizon
     if have and len(pane):
         keep = pane > max_pane - R
-        khi, klo, pane, value = khi[keep], klo[keep], pane[keep], value[keep]
+        khi, klo, pane, value, e_fresh = (
+            khi[keep], klo[keep], pane[keep], value[keep], e_fresh[keep]
+        )
 
     kg = assign_to_key_group(route_hash(khi, klo, np), ctx.max_parallelism, np)
     shard_tables = []
     shard_accs = []
     shard_touched = []
+    shard_fresh = []
     pane_rows = []
     starts, ends = ctx.kg_bounds()
     for s in range(ctx.n_shards):
         sel = (kg >= starts[s]) & (kg <= ends[s])
         e_hi, e_lo = khi[sel], klo[sel]
         e_pane, e_val = pane[sel], value[sel]
+        e_fr = e_fresh[sel]
         table = hashtable.create(C, spec.probe_len)
         acc_s = np.asarray(
             jnp.broadcast_to(
@@ -141,6 +151,7 @@ def restore_window_state(entries, scalars, ctx, spec):
             ).astype(spec.red.dtype)
         ).copy()
         touched_s = np.zeros(C * R, bool)
+        fresh_s = np.zeros(C * R, bool)
         if len(e_hi):
             # unique keys (entries repeat per pane)
             u_keys, inv = np.unique(
@@ -160,9 +171,11 @@ def restore_window_state(entries, scalars, ctx, spec):
             flat = slots[inv] * R + (e_pane % R)
             acc_s[flat] = e_val
             touched_s[flat] = True
+            fresh_s[flat] = e_fr
         shard_tables.append(np.asarray(table.keys))
         shard_accs.append(acc_s)
         shard_touched.append(touched_s)
+        shard_fresh.append(fresh_s)
         if have:
             r_idx = np.arange(R)
             p_r = max_pane - ((max_pane - r_idx) % R)
@@ -195,6 +208,11 @@ def restore_window_state(entries, scalars, ctx, spec):
         ),
         dropped_late=_scal(S, scalars["dropped_late"], ctx, split=True),
         dropped_capacity=_scal(S, scalars["dropped_capacity"], ctx, split=True),
+        fresh=stack_put(shard_fresh),
+        n_fresh=jax.device_put(
+            np.asarray([int(f.sum()) for f in shard_fresh], np.int32),
+            ctx.state_sharding,
+        ),
     )
     return new_state
 

@@ -234,11 +234,6 @@ class LocalExecutor:
         env = self.env
         wagg = pipe.window_agg
         assigner = wagg.assigner
-        if wagg.allowed_lateness_ms > 0:
-            raise NotImplementedError(
-                "allowed_lateness > 0 (late re-fires) is not implemented yet; "
-                "late records are currently dropped and counted"
-            )
         event_time = assigner.is_event_time and (
             env.time_characteristic == TimeCharacteristic.EventTime
         )
@@ -279,6 +274,7 @@ class LocalExecutor:
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
                 ring=ring, fires_per_step=4,
+                lateness_ticks=wagg.allowed_lateness_ms,
             )
             spec = WindowStageSpec(
                 win=win, red=red,
@@ -379,10 +375,11 @@ class LocalExecutor:
             mask = np.asarray(fr.mask)
             vals = np.asarray(fr.values)
             ends = np.asarray(fr.window_end_ticks)
+            lanes = np.asarray(fr.lane_valid)
             tkeys = np.asarray(state.table.keys)
             khi_l, klo_l, end_l, val_l = [], [], [], []
             for sh in range(mask.shape[0]):
-                for f in range(int(n_f[sh])):
+                for f in np.nonzero(lanes[sh])[0]:
                     sel = np.nonzero(mask[sh, f])[0]
                     if sel.size == 0:
                         continue

@@ -1,0 +1,80 @@
+"""allowedLateness semantics: late-but-allowed records re-fire their window
+with the corrected value; beyond-lateness records drop (ref WindowOperator
+lateness logic + cleanup timers)."""
+
+import numpy as np
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink
+
+
+def run(batches, window=10_000, lateness=5_000, batch=8):
+    """batches: list of event lists [(ts, key, v), ...]; batch_size makes
+    each list one micro-batch. The watermark is monotonous on the max seen
+    ts, so later batches make earlier timestamps late."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(2).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(256)
+    env.batch_size = batch
+    flat = [e for batch_ in batches for e in batch_]
+    sink = CollectSink()
+    (
+        env.from_collection(flat)
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .key_by(lambda e: e[1])
+        .time_window(window)
+        .allowed_lateness(lateness)
+        .sum(lambda e: e[2])
+        .add_sink(sink)
+    )
+    env.execute("lateness")
+    return sink.results, env.last_job
+
+
+def test_late_refire_within_lateness():
+    # batch sizing: batch=2 -> each pair is one micro-batch; watermark
+    # advances to 14999 after the second batch, firing window [0,10000).
+    # The late record at ts=5000 (within 5s lateness) must RE-FIRE the
+    # window with the corrected sum.
+    batches = [
+        [(0, "k", 1.0), (9_000, "k", 2.0)],        # window [0,10k): sum 3
+        [(12_000, "k", 10.0), (12_500, "k", 1.0)],  # wm -> 12499, fires [0,10k)
+        # late for [0,10k) but within lateness (cleanup at 9999+5000=14999)
+        [(5_000, "k", 5.0), (13_000, "k", 1.0)],
+    ]
+    results, job = run(batches, batch=2)
+    w1 = [r for r in results if r.window_end_ms == 10_000]
+    assert [r.value for r in w1] == [3.0, 8.0], w1  # on-time fire + re-fire
+    assert job.metrics.dropped_late == 0
+    # the second window [10k,20k) contains 10+1+1 = 12
+    w2 = [r for r in results if r.window_end_ms == 20_000]
+    assert [r.value for r in w2] == [12.0]
+
+
+def test_beyond_lateness_drops():
+    batches = [
+        [(0, "k", 1.0), (9_000, "k", 2.0)],
+        [(30_000, "k", 1.0), (30_500, "k", 1.0)],  # wm -> 30499 >> 10k+5k
+        [(5_000, "k", 100.0), (31_000, "k", 1.0)],  # beyond lateness
+    ]
+    results, job = run(batches, batch=2)
+    w1 = [r for r in results if r.window_end_ms == 10_000]
+    assert [r.value for r in w1] == [3.0]  # no re-fire
+    assert job.metrics.dropped_late == 1
+
+
+def test_multiple_late_refires_accumulate():
+    batches = [
+        [(0, "a", 1.0), (0, "b", 1.0)],
+        [(12_000, "a", 0.5), (12_500, "b", 0.5)],  # fires [0,10k) a=1, b=1
+        [(1_000, "a", 1.0), (13_000, "x", 0.0)],   # late a -> refire a=2
+        [(2_000, "a", 1.0), (2_500, "b", 1.0)],    # late both -> a=3, b=2
+    ]
+    results, job = run(batches, batch=2)
+    a = [r.value for r in results if r.key == "a" and r.window_end_ms == 10_000]
+    b = [r.value for r in results if r.key == "b" and r.window_end_ms == 10_000]
+    assert a == [1.0, 2.0, 3.0]
+    assert b == [1.0, 2.0]
+    # re-fires are per-updated-key: 'b' did not re-emit on a-only updates

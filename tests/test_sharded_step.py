@@ -60,11 +60,9 @@ def test_eight_shard_window_sum_matches_model(rng):
         values = np.asarray(fr.values)   # [S, F, C]
         ends = np.asarray(fr.window_end_ticks)  # [S, F]
         tkeys = np.asarray(state.table.keys)    # [S, C, 2]
-        nf = np.asarray(fr.n_fires)
+        lanes = np.asarray(fr.lane_valid)
         for sh in range(mask.shape[0]):
-            for f in range(mask.shape[1]):
-                if f >= nf[sh]:
-                    continue
+            for f in np.nonzero(lanes[sh])[0]:
                 for c in np.nonzero(mask[sh, f])[0]:
                     kid = (int(tkeys[sh, c, 0]) << 32) | int(tkeys[sh, c, 1])
                     key = keymap[kid]

@@ -75,14 +75,12 @@ def run_device(events, batches, size, slide, ring=16, fires_per_step=4,
     keymap = {}
 
     def collect(fr, hi, lo):
-        n = int(fr.n_fires)
         mask = np.asarray(fr.mask)
         vals = np.asarray(fr.values)
         ends = np.asarray(fr.window_end_ticks)
+        lanes = np.asarray(fr.lane_valid)
         tk = np.asarray(st.table.keys)
-        for f in range(mask.shape[0]):
-            if f >= n:
-                break
+        for f in np.nonzero(lanes)[0]:
             for c in np.nonzero(mask[f])[0]:
                 kid = (int(tk[c, 0]) << 32) | int(tk[c, 1])
                 fires.append((int(ends[f]), keymap[kid], float(vals[f, c])))
