@@ -103,6 +103,15 @@ class KeyedStream(DataStream):
 
         return self.window(CountWindowAssigner(size))
 
+    # -- general keyed processing ----------------------------------------
+    def process(self, fn) -> DataStream:
+        """Run a ProcessFunction over this keyed stream (ref
+        ProcessFunction / StreamTimelyFlatMap): arbitrary host logic with
+        keyed heap state + event/processing-time timers. The device kernels
+        stay the hot path; this is the generality escape hatch."""
+        t = sg.ProcessTransformation("process", self.transformation, fn=fn)
+        return DataStream(self.env, t)
+
     # -- rolling (non-windowed) keyed aggregation ------------------------
     def reduce(self, fn: Callable, extractor=None, neutral=0.0,
                dtype=jnp.float32) -> DataStream:

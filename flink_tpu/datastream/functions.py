@@ -1,0 +1,165 @@
+"""User function contracts: ProcessFunction family + rich-function lifecycle.
+
+Mirrors the reference's function API (SURVEY §2.1 api/common/functions and
+the 1.2 ProcessFunction / TimelyFlatMapFunction at
+api/functions/ProcessFunction and StreamTimelyFlatMap): open/close lifecycle,
+keyed state access via a RuntimeContext, per-element processing with a
+Collector, and event/processing-time timers via a TimerService.
+
+This is the host-side generality path of the framework: arbitrary Python
+logic over keyed state. The hot aggregation path compiles to device kernels
+instead (runtime/step.py); both share the same key-group semantics so a job
+can mix them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+
+class Collector:
+    """out.collect(x) sink buffer (ref util/Collector.java)."""
+
+    def __init__(self):
+        self.buf: List[Any] = []
+
+    def collect(self, value):
+        self.buf.append(value)
+
+    def drain(self) -> List[Any]:
+        out, self.buf = self.buf, []
+        return out
+
+
+class RichFunction:
+    """RichFunction.java lifecycle + runtime context."""
+
+    def open(self, runtime_context: "RuntimeContext"):
+        pass
+
+    def close(self):
+        pass
+
+
+class RuntimeContext:
+    """Keyed-state access for rich functions (ref RuntimeContext.java +
+    KeyedStateStore): get_state/get_list_state/... bound to the operator's
+    keyed backend and the current key set by the runtime."""
+
+    def __init__(self, backend, metrics_group=None, subtask_index: int = 0,
+                 parallelism: int = 1):
+        self._backend = backend
+        self.metrics_group = metrics_group
+        self.subtask_index = subtask_index
+        self.parallelism = parallelism
+
+    def get_state(self, descriptor):
+        return self._backend.get_partitioned_state(descriptor)
+
+    # aliases matching the reference's KeyedStateStore surface
+    get_list_state = get_state
+    get_reducing_state = get_state
+    get_aggregating_state = get_state
+    get_map_state = get_state
+
+
+class TimerService:
+    """ctx.timer_service() facade (ref TimerService interface)."""
+
+    def __init__(self, internal, current_key_fn: Callable[[], Any],
+                 namespace=()):
+        self._internal = internal
+        self._key = current_key_fn
+        self._ns = namespace
+
+    def current_processing_time(self) -> int:
+        return self._internal.current_processing_time
+
+    def current_watermark(self) -> int:
+        return self._internal.current_watermark
+
+    def register_event_time_timer(self, ts: int):
+        self._internal.register_event_time_timer(self._ns, self._key(), ts)
+
+    def register_processing_time_timer(self, ts: int):
+        self._internal.register_processing_time_timer(self._ns, self._key(), ts)
+
+    def delete_event_time_timer(self, ts: int):
+        self._internal.delete_event_time_timer(self._ns, self._key(), ts)
+
+    def delete_processing_time_timer(self, ts: int):
+        self._internal.delete_processing_time_timer(self._ns, self._key(), ts)
+
+
+class ProcessContext:
+    """ctx passed to process_element (ref ProcessFunction.Context)."""
+
+    def __init__(self, timer_service: TimerService):
+        self._ts = timer_service
+        self.element_timestamp: Optional[int] = None
+
+    def timestamp(self) -> Optional[int]:
+        return self.element_timestamp
+
+    def timer_service(self) -> TimerService:
+        return self._ts
+
+
+class OnTimerContext(ProcessContext):
+    """ctx passed to on_timer; also exposes the firing key + time domain."""
+
+    def __init__(self, timer_service: TimerService):
+        super().__init__(timer_service)
+        self.key = None
+        self.time_domain: str = "event"  # 'event' | 'processing'
+
+    def get_current_key(self):
+        return self.key
+
+
+class ProcessFunction(RichFunction):
+    """ProcessFunction contract: per-element hook + timer callback.
+
+    Subclass and override; or use KeyedStream.process(fn) with plain
+    callables for the stateless case.
+    """
+
+    def process_element(self, value, ctx: ProcessContext, out: Collector):
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int, ctx: OnTimerContext, out: Collector):
+        pass
+
+
+KeyedProcessFunction = ProcessFunction  # 1.2 has one class; alias for parity
+
+
+class CoMapFunction(RichFunction):
+    """CoMapFunction.java — two-input map (ConnectedStreams.map)."""
+
+    def map1(self, value):
+        raise NotImplementedError
+
+    def map2(self, value):
+        raise NotImplementedError
+
+
+class CoFlatMapFunction(RichFunction):
+    def flat_map1(self, value):
+        raise NotImplementedError
+
+    def flat_map2(self, value):
+        raise NotImplementedError
+
+
+class CoProcessFunction(RichFunction):
+    """CoProcessFunction — two-input process with shared keyed state."""
+
+    def process_element1(self, value, ctx: ProcessContext, out: Collector):
+        raise NotImplementedError
+
+    def process_element2(self, value, ctx: ProcessContext, out: Collector):
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int, ctx: OnTimerContext, out: Collector):
+        pass

@@ -67,6 +67,14 @@ class KeyedProcessTransformation(Transformation):
 
 
 @dataclass
+class ProcessTransformation(Transformation):
+    """Keyed ProcessFunction stage (host generality path: arbitrary user
+    logic over heap keyed state + timers; ref StreamTimelyFlatMap)."""
+
+    fn: Any = None  # datastream.functions.ProcessFunction
+
+
+@dataclass
 class SinkTransformation(Transformation):
     sink: Any = None  # runtime.sinks.Sink
 

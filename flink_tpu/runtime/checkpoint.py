@@ -299,6 +299,41 @@ class CheckpointStorage:
         cids = self.list_checkpoints()
         return cids[-1] if cids else None
 
+    # -- generic (non-window) stage snapshots ---------------------------
+    # Heap-backend stages (ProcessFunction, CEP, ...) snapshot pickled
+    # key-group blobs instead of device arrays; same chk-<id> layout and
+    # retention, different payload file.
+    def write_generic(self, cid: int, payload: dict):
+        tmp = self.path(cid) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "checkpoint_id": cid,
+            "timestamp": time.time(),
+            "kind": "generic",
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        final = self.path(cid)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        self._gc(keep_latest=cid)
+        return final
+
+    def read_generic(self, cid: int) -> dict:
+        p = self.path(cid)
+        with open(os.path.join(p, "meta.json")) as f:
+            meta = json.load(f)
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(f"unsupported checkpoint format: {meta}")
+        if meta.get("kind") != "generic":
+            raise ValueError(f"checkpoint {cid} is not a generic snapshot")
+        with open(os.path.join(p, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
     # -- incremental key map log ---------------------------------------
     # The codec's key-id -> original-key map is append-only; checkpoints
     # record only a count and new entries go to a shared log, so a 1M-key

@@ -83,6 +83,7 @@ class _Pipeline:
     rolling: Optional[sg.KeyedProcessTransformation]
     post_chain: List[sg.OneInputTransformation]
     sinks: List[Any]
+    process: Optional[sg.ProcessTransformation] = None
 
 
 def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
@@ -112,15 +113,20 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
         elif isinstance(t, sg.KeyedProcessTransformation):
             pipe.rolling = t
             stage = "post"
+        elif isinstance(t, sg.ProcessTransformation):
+            pipe.process = t
+            stage = "post"
         elif isinstance(t, sg.OneInputTransformation):
             (pipe.pre_chain if stage == "pre" else pipe.post_chain).append(t)
         else:
             raise NotImplementedError(f"transformation {type(t).__name__}")
     if pipe.source is None:
         raise ValueError("pipeline has no source")
-    if pipe.key_by is not None and pipe.window_agg is None and pipe.rolling is None:
+    if (pipe.key_by is not None and pipe.window_agg is None
+            and pipe.rolling is None and pipe.process is None):
         raise NotImplementedError(
-            "keyed stream must currently end in a window agg or rolling reduce"
+            "keyed stream must currently end in a window agg, rolling "
+            "reduce, or process function"
         )
     return pipe
 
@@ -185,6 +191,9 @@ class LocalExecutor:
             elif pipe.window_agg is not None:
                 handle = self._run_windowed(pipe, metrics, job_name,
                                             restore_from)
+            elif pipe.process is not None:
+                handle = self._run_process(pipe, metrics, job_name,
+                                           restore_from)
             elif pipe.rolling is not None:
                 handle = self._run_rolling(pipe, metrics, job_name, restore_from)
             else:
@@ -603,6 +612,179 @@ class LocalExecutor:
                 f"checkpoint/restore is not implemented yet for {kind} stages"
             )
 
+    def _run_process(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                     restore_from=None):
+        """Keyed ProcessFunction stage: host generality path over the heap
+        keyed backend + internal timer service (ref StreamTimelyFlatMap /
+        KeyedProcessOperator). Hot aggregations belong on the device stages;
+        this path exists for arbitrary user logic and semantics parity."""
+        from flink_tpu.core.time import TimeCharacteristic
+        from flink_tpu.datastream.functions import (
+            Collector, OnTimerContext, ProcessContext, RichFunction,
+            RuntimeContext, TimerService,
+        )
+        from flink_tpu.runtime.timers import InternalTimerService
+        from flink_tpu.state.backend import HeapKeyedStateBackend
+
+        env = self.env
+        fn = pipe.process.fn
+        event_time = env.time_characteristic == TimeCharacteristic.EventTime
+        backend = HeapKeyedStateBackend(max_parallelism=env.max_parallelism)
+        timers = InternalTimerService(env.max_parallelism)
+        collector = Collector()
+        timer_svc = TimerService(timers, lambda: backend.current_key)
+        ctx = ProcessContext(timer_svc)
+        timer_ctx = OnTimerContext(timer_svc)
+
+        class _Triggerable:
+            def _fire(self, timer, domain):
+                backend.set_current_key(timer.key)
+                timer_ctx.key = timer.key
+                timer_ctx.time_domain = domain
+                timer_ctx.element_timestamp = timer.timestamp
+                fn.on_timer(timer.timestamp, timer_ctx, collector)
+
+            def on_event_time(self, timer):
+                self._fire(timer, "event")
+
+            def on_processing_time(self, timer):
+                self._fire(timer, "processing")
+
+        timers.triggerable = _Triggerable()
+        if isinstance(fn, RichFunction):
+            fn.open(RuntimeContext(backend))
+
+        wm_strategy = (
+            pipe.ts_transform.strategy if pipe.ts_transform is not None
+            else WatermarkStrategy.for_monotonous_timestamps()
+        )
+
+        storage = None
+        if env.checkpoint_dir:
+            storage = ckpt.CheckpointStorage(
+                env.checkpoint_dir,
+                retain=env.config.get_int("checkpoint.retain", 2),
+            )
+        next_cid = (storage.latest() or 0) + 1 if storage else 1
+        steps_at_ckpt = 0
+
+        def write_checkpoint():
+            nonlocal next_cid, steps_at_ckpt
+            storage.write_generic(next_cid, {
+                "backend": backend.snapshot(),
+                "timers": timers.snapshot(),
+                "offsets": pipe.source.snapshot_offsets(),
+                "wm_current": wm_strategy.current(),
+                "proc_time": timers.current_processing_time,
+                "max_parallelism": env.max_parallelism,
+            })
+            next_cid += 1
+            steps_at_ckpt = metrics.steps
+
+        def restore_checkpoint(path_or_storage, cid=None):
+            nonlocal steps_at_ckpt
+            st = (
+                ckpt.CheckpointStorage(path_or_storage)
+                if isinstance(path_or_storage, str) else path_or_storage
+            )
+            cid = cid if cid is not None else st.latest()
+            if cid is None:
+                raise FileNotFoundError(f"no checkpoint in {st.dir}")
+            payload = st.read_generic(cid)
+            if payload["max_parallelism"] != env.max_parallelism:
+                raise ValueError("checkpoint max-parallelism mismatch")
+            backend.restore(payload["backend"])
+            # restore throws away pending queues; re-register from snapshot
+            timers._event_q.clear(); timers._proc_q.clear()
+            timers._event_set.clear(); timers._proc_set.clear()
+            timers.restore(payload["timers"])
+            pipe.source.restore_offsets(payload["offsets"])
+            wm_strategy._current = payload["wm_current"]
+            timers.current_watermark = payload["wm_current"]
+            timers.current_processing_time = payload.get(
+                "proc_time", timers.current_processing_time
+            )
+            steps_at_ckpt = metrics.steps
+
+        def emit():
+            out = collector.drain()
+            if not out:
+                return
+            out = _apply_chain(pipe.post_chain, out)
+            metrics.records_out += len(out)
+            for s in pipe.sinks:
+                s.invoke_batch(out)
+
+        def batch_loop():
+            end = False
+            while not end:
+                polled, end = pipe.source.poll(env.batch_size)
+                now_ms = int(time.time() * 1000)
+                elements = _apply_chain(
+                    pipe.pre_chain, self._to_elements(polled)
+                )
+                metrics.records_in += len(elements)
+                for e in elements:
+                    key = pipe.key_by.key_selector(e)
+                    backend.set_current_key(key)
+                    if event_time and pipe.ts_transform is not None:
+                        ctx.element_timestamp = int(
+                            pipe.ts_transform.timestamp_fn(e)
+                        )
+                    else:
+                        ctx.element_timestamp = now_ms
+                    fn.process_element(e, ctx, collector)
+                metrics.steps += 1
+                if event_time:
+                    ts_list = None
+                    if elements and pipe.ts_transform is not None:
+                        ts_list = max(
+                            int(pipe.ts_transform.timestamp_fn(e))
+                            for e in elements
+                        )
+                    wm = wm_strategy.on_batch(ts_list)
+                    timers.advance_watermark(wm)
+                else:
+                    timers.advance_processing_time(now_ms)
+                emit()
+                if (
+                    storage is not None
+                    and env.checkpoint_interval_steps > 0
+                    and metrics.steps - steps_at_ckpt
+                    >= env.checkpoint_interval_steps
+                ):
+                    write_checkpoint()
+
+        if restore_from:
+            restore_checkpoint(restore_from)
+        restart = self._restart_strategy()
+        while True:
+            try:
+                batch_loop()
+                break
+            except Exception:
+                can = (
+                    storage is not None
+                    and storage.latest() is not None
+                    and restart.should_restart()
+                )
+                if not can:
+                    raise
+                metrics.restarts += 1
+                collector.drain()  # discard partial output of the failed run
+                restore_checkpoint(storage)
+
+        # end of stream: fire all remaining event-time timers
+        if event_time:
+            timers.advance_watermark(2**62)
+        else:
+            timers.advance_processing_time(int(time.time() * 1000) + 1)
+        emit()
+        if isinstance(fn, RichFunction):
+            fn.close()
+        return JobHandle(job_name, metrics, state=backend)
+
+    # ------------------------------------------------------------------
     def _run_rolling(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
                      restore_from=None):
         """Rolling keyed reduce: emits the updated accumulator per record

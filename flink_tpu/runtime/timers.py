@@ -1,0 +1,126 @@
+"""Internal timer service — per-(key, namespace) event/processing timers.
+
+Mirrors the contracts of the reference's HeapInternalTimerService
+(api/operators/HeapInternalTimerService.java:43: registerEventTimeTimer:212,
+advanceWatermark:264, onProcessingTime:239) and SystemProcessingTimeService:
+a priority queue + dedup set per time domain, fired in timestamp order with
+the key context restored before each callback, snapshotted by key group.
+
+TPU adaptation: callbacks run on the host between micro-batch steps (the
+device analog of timers — pane deadlines — lives in ops/window_kernels; this
+service backs the general ProcessFunction/trigger path). Processing time is
+advanced explicitly by the executor (or a test clock), which is what the
+reference's TestProcessingTimeService does in its harnesses.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from flink_tpu.state.backend import key_group_of
+
+
+@dataclass(frozen=True, order=True)
+class InternalTimer:
+    """(timestamp, key, namespace) — ref InternalTimer.java."""
+
+    timestamp: int
+    key: Any = field(compare=False)
+    namespace: Any = field(compare=False)
+
+
+class InternalTimerService:
+    """One instance per keyed operator (ref getInternalTimerService:782)."""
+
+    def __init__(self, max_parallelism: int, triggerable=None):
+        # triggerable: object with on_event_time(timer) / on_processing_time(timer)
+        self.max_parallelism = max_parallelism
+        self.triggerable = triggerable
+        self._event_q: List[Tuple[int, int, InternalTimer]] = []
+        self._proc_q: List[Tuple[int, int, InternalTimer]] = []
+        self._event_set: Set[Tuple[int, Any, Any]] = set()
+        self._proc_set: Set[Tuple[int, Any, Any]] = set()
+        self._seq = 0
+        self.current_watermark = -(2**62)
+        self.current_processing_time = -(2**62)
+
+    # -- registration (dedup exactly as the reference: set + queue) -------
+    def register_event_time_timer(self, namespace, key, ts: int):
+        k = (ts, key, namespace)
+        if k in self._event_set:
+            return
+        self._event_set.add(k)
+        self._seq += 1
+        heapq.heappush(self._event_q, (ts, self._seq, InternalTimer(ts, key, namespace)))
+
+    def register_processing_time_timer(self, namespace, key, ts: int):
+        k = (ts, key, namespace)
+        if k in self._proc_set:
+            return
+        self._proc_set.add(k)
+        self._seq += 1
+        heapq.heappush(self._proc_q, (ts, self._seq, InternalTimer(ts, key, namespace)))
+
+    def delete_event_time_timer(self, namespace, key, ts: int):
+        self._event_set.discard((ts, key, namespace))
+
+    def delete_processing_time_timer(self, namespace, key, ts: int):
+        self._proc_set.discard((ts, key, namespace))
+
+    # -- advancement ------------------------------------------------------
+    def advance_watermark(self, ts: int):
+        """Fire all event-time timers <= ts (ref advanceWatermark:264)."""
+        self.current_watermark = ts
+        fired = []
+        while self._event_q and self._event_q[0][0] <= ts:
+            _, _, timer = heapq.heappop(self._event_q)
+            k = (timer.timestamp, timer.key, timer.namespace)
+            if k not in self._event_set:
+                continue  # deleted
+            self._event_set.discard(k)
+            fired.append(timer)
+            if self.triggerable is not None:
+                self.triggerable.on_event_time(timer)
+        return fired
+
+    def advance_processing_time(self, ts: int):
+        self.current_processing_time = ts
+        fired = []
+        while self._proc_q and self._proc_q[0][0] <= ts:
+            _, _, timer = heapq.heappop(self._proc_q)
+            k = (timer.timestamp, timer.key, timer.namespace)
+            if k not in self._proc_set:
+                continue
+            self._proc_set.discard(k)
+            fired.append(timer)
+            if self.triggerable is not None:
+                self.triggerable.on_processing_time(timer)
+        return fired
+
+    def next_processing_timer(self) -> Optional[int]:
+        while self._proc_q:
+            ts, _, timer = self._proc_q[0]
+            if (timer.timestamp, timer.key, timer.namespace) in self._proc_set:
+                return ts
+            heapq.heappop(self._proc_q)
+        return None
+
+    # -- snapshot / restore by key group ----------------------------------
+    def snapshot(self) -> Dict[int, list]:
+        """-> {key_group: [(domain, ts, key, namespace), ...]}"""
+        out: Dict[int, list] = {}
+        for domain, live in (("event", self._event_set), ("proc", self._proc_set)):
+            for ts, key, ns in live:
+                kg = key_group_of(key, self.max_parallelism)
+                out.setdefault(kg, []).append((domain, ts, key, ns))
+        return out
+
+    def restore(self, key_group_entries: Dict[int, list]):
+        for entries in key_group_entries.values():
+            for domain, ts, key, ns in entries:
+                if domain == "event":
+                    self.register_event_time_timer(ns, key, ts)
+                else:
+                    self.register_processing_time_timer(ns, key, ts)

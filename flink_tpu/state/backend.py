@@ -1,0 +1,395 @@
+"""Keyed state backends — the AbstractStateBackend seam.
+
+Reproduces the contracts of the reference's state-backend SPI (SURVEY §2.4:
+AbstractStateBackend.java:32 createKeyedStateBackend:51,
+AbstractKeyedStateBackend.java:52 with setCurrentKey:167 /
+getPartitionedState:216, and the heap backend HeapKeyedStateBackend.java:65
+with its StateTable.java:36 nested per-key-group maps), TPU-adapted:
+
+* The **device** backend is the sharded array state driven by the compiled
+  SPMD steps (ops/window_kernels, ops/rolling, ...) — that is the hot path
+  and lives in runtime/step.py.
+* The **heap** backend here is the host-side general-purpose backend backing
+  arbitrary user logic (ProcessFunction / custom triggers / evictors / CEP
+  bookkeeping), exactly the role the reference's HeapKeyedStateBackend plays
+  for the RocksDB-less deployments: per (state-name, key-group, namespace,
+  key) values in Python dicts, snapshotted per key group so restore and
+  rescale re-slice key-group ranges (KeyGroupRangeAssignment semantics,
+  core/keygroups.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import io
+import pickle
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.core.keygroups import (
+    DEFAULT_MAX_PARALLELISM,
+    KeyGroupRange,
+    assign_to_key_group,
+    key_group_range_for_operator,
+)
+from flink_tpu.ops.hashing import hash64_host
+from flink_tpu.state.descriptors import (
+    AggregatingStateDescriptor,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    StateDescriptor,
+    ValueStateDescriptor,
+)
+
+VoidNamespace = ()  # the reference's VoidNamespace singleton
+
+
+def key_group_of(key, max_parallelism: int) -> int:
+    """Host key -> key group (KeyGroupRangeAssignment.assignToKeyGroup)."""
+    h64 = int(hash64_host([key])[0])
+    return int(assign_to_key_group(np.uint32(h64 & 0xFFFFFFFF), max_parallelism))
+
+
+class StateTable:
+    """name -> per-key-group dict of {namespace: {key: value}}.
+
+    Mirrors the reference's StateTable.java:36 layout (one map per key group)
+    so snapshots are naturally partitioned by key group.
+    """
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+        self.kgr = key_group_range
+        self.max_parallelism = max_parallelism
+        # maps[key_group - start] : {namespace: {key: value}}
+        self.maps: List[Dict[Any, Dict[Any, Any]]] = [
+            {} for _ in range(key_group_range.num_key_groups)
+        ]
+
+    def _map_for(self, key_group: int) -> Dict[Any, Dict[Any, Any]]:
+        idx = key_group - self.kgr.start
+        if idx < 0 or idx >= len(self.maps):
+            raise KeyError(
+                f"key group {key_group} outside owned range "
+                f"[{self.kgr.start}, {self.kgr.end}]"
+            )
+        return self.maps[idx]
+
+    def get(self, key_group, namespace, key, default=None):
+        return self._map_for(key_group).get(namespace, {}).get(key, default)
+
+    def put(self, key_group, namespace, key, value):
+        self._map_for(key_group).setdefault(namespace, {})[key] = value
+
+    def remove(self, key_group, namespace, key):
+        ns = self._map_for(key_group).get(namespace)
+        if ns is not None:
+            ns.pop(key, None)
+            if not ns:
+                self._map_for(key_group).pop(namespace, None)
+
+    def namespaces(self, key_group):
+        return list(self._map_for(key_group).keys())
+
+    def entries(self):
+        """Iterate (key_group, namespace, key, value)."""
+        for i, m in enumerate(self.maps):
+            kg = self.kgr.start + i
+            for ns, kv in m.items():
+                for k, v in kv.items():
+                    yield kg, ns, k, v
+
+    def size(self) -> int:
+        return sum(len(kv) for m in self.maps for kv in m.values())
+
+
+# --------------------------------------------------------------------------
+# State objects (the State.java:32 hierarchy)
+# --------------------------------------------------------------------------
+
+
+class State:
+    """Base: a view over one (descriptor, current key, namespace) cell."""
+
+    def __init__(self, backend: "HeapKeyedStateBackend", desc: StateDescriptor):
+        self._b = backend
+        self._d = desc
+        self._table = backend._table_for(desc)
+        self._namespace = VoidNamespace
+
+    def set_namespace(self, ns):
+        self._namespace = ns
+
+    def _cell(self, default=None):
+        return self._table.get(
+            self._b.current_key_group, self._namespace, self._b.current_key,
+            default,
+        )
+
+    def _put(self, value):
+        self._table.put(
+            self._b.current_key_group, self._namespace, self._b.current_key,
+            value,
+        )
+
+    def clear(self):
+        self._table.remove(
+            self._b.current_key_group, self._namespace, self._b.current_key
+        )
+
+
+class ValueState(State):
+    """ValueState.java:40 — value()/update()."""
+
+    def value(self):
+        v = self._cell()
+        if v is None:
+            return self._d.default
+        return v
+
+    def update(self, v):
+        self._put(v)
+
+
+class ListState(State):
+    """ListState.java — get()/add(); AppendingState contract."""
+
+    def get(self):
+        return self._cell(default=[])
+
+    def add(self, v):
+        cur = self._cell()
+        if cur is None:
+            cur = []
+            self._put(cur)
+        cur.append(v)
+
+    def update(self, values):
+        self._put(list(values))
+
+
+class ReducingState(State):
+    """ReducingState.java:38 — add() folds with the descriptor's reduce fn."""
+
+    def get(self):
+        return self._cell()
+
+    def add(self, v):
+        if self._d.kind == "count":
+            # count semantics match the device kernel: +1 per add,
+            # regardless of the value (window_kernels 'count' branch)
+            cur = self._cell()
+            self._put(1 if cur is None else cur + 1)
+            return
+        cur = self._cell()
+        if cur is None:
+            self._put(v)
+        else:
+            self._put(self._d.host_reduce(cur, v))
+
+
+class AggregatingState(State):
+    """AggregateFunction-backed accumulator state (subsumes FoldingState)."""
+
+    def get(self):
+        acc = self._cell()
+        if acc is None:
+            return None
+        return self._d.get_result(acc) if self._d.get_result else acc
+
+    def add(self, v):
+        acc = self._cell()
+        if acc is None:
+            acc = self._d.create_accumulator()
+        self._put(self._d.add(acc, v))
+
+    def get_accumulator(self):
+        return self._cell()
+
+
+class FoldingState(AggregatingState):
+    """FoldingState.java:40 — fold(acc, value); kept for reference parity,
+    deprecated in the reference line in favor of aggregating state."""
+
+
+class MapState(State):
+    """Per-key map state (host backend extension; the reference adds
+    MapState in 1.3 — included because user ProcessFunctions commonly
+    need it and it costs nothing on the heap backend)."""
+
+    def get(self, user_key, default=None):
+        m = self._cell()
+        return default if m is None else m.get(user_key, default)
+
+    def put(self, user_key, v):
+        m = self._cell()
+        if m is None:
+            m = {}
+            self._put(m)
+        m[user_key] = v
+
+    def remove(self, user_key):
+        m = self._cell()
+        if m:
+            m.pop(user_key, None)
+
+    def contains(self, user_key):
+        m = self._cell()
+        return bool(m) and user_key in m
+
+    def items(self):
+        m = self._cell()
+        return [] if m is None else list(m.items())
+
+    def keys(self):
+        m = self._cell()
+        return [] if m is None else list(m.keys())
+
+    def values(self):
+        m = self._cell()
+        return [] if m is None else list(m.values())
+
+    def is_empty(self):
+        return not self._cell()
+
+
+_STATE_CLASS = {
+    ValueStateDescriptor: ValueState,
+    ListStateDescriptor: ListState,
+    ReducingStateDescriptor: ReducingState,
+    AggregatingStateDescriptor: AggregatingState,
+    MapStateDescriptor: MapState,
+}
+
+
+# --------------------------------------------------------------------------
+# Backends
+# --------------------------------------------------------------------------
+
+
+class KeyedStateBackend:
+    """AbstractKeyedStateBackend contract (ref :52): current-key context +
+    per-descriptor state handles + key-grouped snapshot/restore."""
+
+    def set_current_key(self, key):
+        raise NotImplementedError
+
+    def get_partitioned_state(self, descriptor, namespace=VoidNamespace):
+        raise NotImplementedError
+
+    def snapshot(self) -> Dict[int, bytes]:
+        """-> {key_group: serialized state}. The key-grouped layout is what
+        makes restore-with-different-parallelism a pure re-slice
+        (StateAssignmentOperation semantics)."""
+        raise NotImplementedError
+
+    def restore(self, key_group_blobs: Dict[int, bytes]) -> None:
+        raise NotImplementedError
+
+
+class HeapKeyedStateBackend(KeyedStateBackend):
+    def __init__(self, key_group_range: Optional[KeyGroupRange] = None,
+                 max_parallelism: int = DEFAULT_MAX_PARALLELISM):
+        self.kgr = key_group_range or KeyGroupRange(0, max_parallelism - 1)
+        self.max_parallelism = max_parallelism
+        self._tables: Dict[str, StateTable] = {}
+        self._states: Dict[str, State] = {}
+        self._descs: Dict[str, StateDescriptor] = {}
+        self.current_key = None
+        self.current_key_group = None
+
+    # -- key context ----------------------------------------------------
+    def set_current_key(self, key):
+        self.current_key = key
+        self.current_key_group = key_group_of(key, self.max_parallelism)
+
+    # -- state handles ---------------------------------------------------
+    def _table_for(self, desc: StateDescriptor) -> StateTable:
+        t = self._tables.get(desc.name)
+        if t is None:
+            t = StateTable(self.kgr, self.max_parallelism)
+            self._tables[desc.name] = t
+            self._descs[desc.name] = desc
+        return t
+
+    def get_partitioned_state(self, descriptor, namespace=VoidNamespace):
+        st = self._states.get(descriptor.name)
+        if st is None:
+            cls = _STATE_CLASS.get(type(descriptor))
+            if cls is None:
+                for base, c in _STATE_CLASS.items():
+                    if isinstance(descriptor, base):
+                        cls = c
+                        break
+            if cls is None:
+                raise TypeError(f"unsupported descriptor {type(descriptor)}")
+            st = cls(self, descriptor)
+            self._states[descriptor.name] = st
+        st.set_namespace(namespace)
+        return st
+
+    # -- introspection (queryable state read path) -----------------------
+    def lookup(self, state_name: str, key, namespace=VoidNamespace):
+        """Point lookup without disturbing the current-key context
+        (KvState.getSerializedValue role)."""
+        t = self._tables.get(state_name)
+        if t is None:
+            return None
+        kg = key_group_of(key, self.max_parallelism)
+        return t.get(kg, namespace, key)
+
+    def keys(self, state_name: str, namespace=VoidNamespace):
+        t = self._tables.get(state_name)
+        if t is None:
+            return []
+        return [k for kg, ns, k, _ in t.entries() if ns == namespace]
+
+    # -- snapshot / restore ----------------------------------------------
+    def snapshot(self) -> Dict[int, bytes]:
+        out: Dict[int, bytes] = {}
+        for kg in self.kgr:
+            per_kg = {}
+            for name, table in self._tables.items():
+                m = table._map_for(kg)
+                if m:
+                    per_kg[name] = m
+            if per_kg:
+                out[kg] = pickle.dumps(per_kg, protocol=pickle.HIGHEST_PROTOCOL)
+        return out
+
+    def restore(self, key_group_blobs: Dict[int, bytes]) -> None:
+        # Restore replaces ALL owned state: key groups absent from the
+        # snapshot were empty at checkpoint time and must be empty after
+        # restore, or replayed records double-apply (exactly-once contract).
+        for table in self._tables.values():
+            table.maps = [{} for _ in range(self.kgr.num_key_groups)]
+        for kg, blob in key_group_blobs.items():
+            if kg < self.kgr.start or kg > self.kgr.end:
+                continue
+            per_kg = pickle.loads(blob)
+            for name, m in per_kg.items():
+                if name not in self._tables:
+                    # table re-registered lazily on first access; stash now
+                    self._tables[name] = StateTable(self.kgr, self.max_parallelism)
+                self._tables[name].maps[kg - self.kgr.start] = m
+
+
+def rescale_key_group_blobs(
+    blobs_per_subtask: List[Dict[int, bytes]],
+    new_parallelism: int,
+    max_parallelism: int,
+) -> List[Dict[int, bytes]]:
+    """Re-slice key-grouped snapshots to a new parallelism
+    (StateAssignmentOperation.java role): pure dictionary routing, no
+    re-hashing of keys."""
+    merged: Dict[int, bytes] = {}
+    for b in blobs_per_subtask:
+        merged.update(b)
+    out = []
+    for idx in range(new_parallelism):
+        r = key_group_range_for_operator(max_parallelism, new_parallelism, idx)
+        out.append({kg: blob for kg, blob in merged.items()
+                    if r.start <= kg <= r.end})
+    return out

@@ -59,6 +59,17 @@ class ReducingStateDescriptor(StateDescriptor):
             combine=self.reduce_fn, neutral=self.neutral,
         )
 
+    def host_reduce(self, a, b):
+        """Scalar combine for the heap backend (same semantics the device
+        kernel applies shard-wide)."""
+        if self.kind == "sum" or self.kind == "count":
+            return a + b
+        if self.kind == "min":
+            return min(a, b)
+        if self.kind == "max":
+            return max(a, b)
+        return self.reduce_fn(a, b)
+
 
 @dataclass(frozen=True)
 class AggregatingStateDescriptor(StateDescriptor):
@@ -82,6 +93,29 @@ class AggregatingStateDescriptor(StateDescriptor):
             "generic", self.dtype, self.value_shape,
             combine=self.merge, neutral=self.acc_init,
         )
+
+    def create_accumulator(self):
+        init = self.acc_init
+        return init() if callable(init) else init
+
+
+@dataclass(frozen=True)
+class FoldingStateDescriptor(AggregatingStateDescriptor):
+    """FoldingStateDescriptor.java:37 parity: fold(acc, value) -> acc.
+    Deprecated in the reference line; an AggregatingState whose `add` is the
+    fold function."""
+
+    fold_fn: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.fold_fn is not None and self.add is None:
+            object.__setattr__(self, "add", self.fold_fn)
+
+
+@dataclass(frozen=True)
+class MapStateDescriptor(StateDescriptor):
+    """Per-key {user_key: value} map (heap backend only; device state is
+    fixed-width columns)."""
 
 
 @dataclass(frozen=True)

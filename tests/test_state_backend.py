@@ -1,0 +1,163 @@
+"""Heap keyed state backend: contracts of the reference state API
+(State.java hierarchy, StateTable key-group layout, snapshot/rescale
+semantics of StateAssignmentOperation)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    key_group_range_for_operator,
+)
+from flink_tpu.state.backend import (
+    HeapKeyedStateBackend,
+    key_group_of,
+    rescale_key_group_blobs,
+)
+from flink_tpu.state.descriptors import (
+    AggregatingStateDescriptor,
+    FoldingStateDescriptor,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
+
+
+def test_value_state_roundtrip():
+    b = HeapKeyedStateBackend()
+    desc = ValueStateDescriptor("v", default=-1.0)
+    b.set_current_key("a")
+    st = b.get_partitioned_state(desc)
+    assert st.value() == -1.0
+    st.update(3.5)
+    assert st.value() == 3.5
+    b.set_current_key("b")
+    assert st.value() == -1.0  # per-key isolation
+    b.set_current_key("a")
+    st.clear()
+    assert st.value() == -1.0
+
+
+def test_list_reducing_agg_map_states():
+    b = HeapKeyedStateBackend()
+    b.set_current_key(7)
+
+    ls = b.get_partitioned_state(ListStateDescriptor("l"))
+    ls.add(1)
+    ls.add(2)
+    assert ls.get() == [1, 2]
+    ls.update([9])
+    assert ls.get() == [9]
+
+    rs = b.get_partitioned_state(ReducingStateDescriptor("r", kind="max"))
+    rs.add(3)
+    rs.add(1)
+    rs.add(5)
+    assert rs.get() == 5
+
+    ag = b.get_partitioned_state(AggregatingStateDescriptor(
+        "a", add=lambda acc, v: (acc[0] + v, acc[1] + 1),
+        merge=lambda x, y: (x[0] + y[0], x[1] + y[1]),
+        get_result=lambda acc: acc[0] / acc[1],
+        acc_init=(0.0, 0),
+    ))
+    ag.add(2.0)
+    ag.add(4.0)
+    assert ag.get() == 3.0  # mean
+
+    ms = b.get_partitioned_state(MapStateDescriptor("m"))
+    ms.put("x", 1)
+    ms.put("y", 2)
+    assert ms.get("x") == 1
+    assert ms.contains("y")
+    assert sorted(ms.keys()) == ["x", "y"]
+    ms.remove("x")
+    assert not ms.contains("x")
+
+
+def test_folding_state_parity():
+    b = HeapKeyedStateBackend()
+    b.set_current_key("k")
+    fs = b.get_partitioned_state(FoldingStateDescriptor(
+        "f", fold_fn=lambda acc, v: acc + str(v), acc_init=""
+    ))
+    fs.add(1)
+    fs.add(2)
+    assert fs.get() == "12"
+
+
+def test_namespaces_isolated():
+    b = HeapKeyedStateBackend()
+    b.set_current_key("k")
+    desc = ValueStateDescriptor("v")
+    s1 = b.get_partitioned_state(desc, namespace=("w", 100))
+    s1.update(1.0)
+    s2 = b.get_partitioned_state(desc, namespace=("w", 200))
+    assert s2.value() is None
+    s2.update(2.0)
+    s1b = b.get_partitioned_state(desc, namespace=("w", 100))
+    assert s1b.value() == 1.0
+
+
+def test_snapshot_restore_roundtrip():
+    b = HeapKeyedStateBackend(max_parallelism=32)
+    desc = ValueStateDescriptor("v")
+    for k in range(100):
+        b.set_current_key(k)
+        b.get_partitioned_state(desc).update(k * 10)
+    blobs = b.snapshot()
+    assert all(0 <= kg < 32 for kg in blobs)
+
+    b2 = HeapKeyedStateBackend(max_parallelism=32)
+    b2.restore(blobs)
+    for k in range(100):
+        b2.set_current_key(k)
+        assert b2.get_partitioned_state(desc).value() == k * 10
+
+
+def test_rescale_2_to_3_subtasks():
+    """Key-grouped snapshots re-slice to a new parallelism without touching
+    keys (RescalingITCase semantics)."""
+    maxp = 12
+    backs = []
+    for idx in range(2):
+        r = key_group_range_for_operator(maxp, 2, idx)
+        backs.append(HeapKeyedStateBackend(r, maxp))
+    desc = ValueStateDescriptor("v")
+    for k in range(200):
+        kg = key_group_of(k, maxp)
+        for b in backs:
+            if kg in b.kgr:
+                b.set_current_key(k)
+                b.get_partitioned_state(desc).update(k + 0.5)
+
+    blobs = [b.snapshot() for b in backs]
+    new_blobs = rescale_key_group_blobs(blobs, 3, maxp)
+    new_backs = []
+    for idx in range(3):
+        r = key_group_range_for_operator(maxp, 3, idx)
+        nb = HeapKeyedStateBackend(r, maxp)
+        nb.restore(new_blobs[idx])
+        new_backs.append(nb)
+
+    seen = 0
+    for k in range(200):
+        kg = key_group_of(k, maxp)
+        for nb in new_backs:
+            if kg in nb.kgr:
+                nb.set_current_key(k)
+                assert nb.get_partitioned_state(desc).value() == k + 0.5
+                seen += 1
+    assert seen == 200
+
+
+def test_lookup_queryable_read_path():
+    b = HeapKeyedStateBackend()
+    desc = ValueStateDescriptor("total")
+    b.set_current_key("alice")
+    b.get_partitioned_state(desc).update(42)
+    b.set_current_key("bob")  # move the key context away
+    assert b.lookup("total", "alice") == 42
+    assert b.lookup("total", "nobody") is None
+    assert b.lookup("missing-state", "alice") is None
