@@ -142,12 +142,27 @@ class WindowedStream:
         self.keyed = keyed
         self.assigner = assigner
         self._lateness_ms = 0
+        self._trigger = None
+        self._evictor = None
 
     def allowed_lateness(self, ms: int) -> "WindowedStream":
         self._lateness_ms = ms
         return self
 
-    def _agg(self, name, spec_factory, extractor, result_fn=None) -> DataStream:
+    def trigger(self, trigger) -> "WindowedStream":
+        """Attach a custom Trigger (ref WindowedStream.trigger). Routes the
+        stage to the generic host window operator."""
+        self._trigger = trigger
+        return self
+
+    def evictor(self, evictor) -> "WindowedStream":
+        """Attach an Evictor (ref WindowedStream.evictor). The window then
+        buffers full element lists (EvictingWindowOperator path)."""
+        self._evictor = evictor
+        return self
+
+    def _agg(self, name, spec_factory, extractor, result_fn=None,
+             window_fn=None) -> DataStream:
         t = sg.WindowAggTransformation(
             name, self.keyed.transformation,
             assigner=self.assigner,
@@ -155,8 +170,38 @@ class WindowedStream:
             reduce_spec_factory=spec_factory,
             result_fn=result_fn,
             allowed_lateness_ms=self._lateness_ms,
+            trigger=self._trigger,
+            evictor=self._evictor,
+            window_fn=window_fn,
         )
         return DataStream(self.env, t)
+
+    def apply(self, window_fn, extractor=None) -> DataStream:
+        """General window function over the buffered elements (ref
+        WindowedStream.apply:254): window_fn(key, window, elements) ->
+        iterable of results. Always runs on the generic host operator."""
+        return self._agg(
+            "window_apply", None,
+            _field_extractor(extractor) if extractor is not None
+            else (lambda e: e),
+            window_fn=window_fn,
+        )
+
+    def fold(self, initial, fold_fn, extractor=None) -> DataStream:
+        """Non-associative fold over the window's elements in arrival order
+        (ref WindowedStream.fold:213)."""
+        def fn(key, window, elements, _init=initial, _fold=fold_fn):
+            acc = _init
+            for v in elements:
+                acc = _fold(acc, v)
+            return [acc]
+
+        return self._agg(
+            "window_fold", None,
+            _field_extractor(extractor) if extractor is not None
+            else (lambda e: e),
+            window_fn=fn,
+        )
 
     def sum(self, pos=None, dtype=jnp.float32) -> DataStream:
         return self._agg(

@@ -111,6 +111,7 @@ class OnTimerContext(ProcessContext):
     def __init__(self, timer_service: TimerService):
         super().__init__(timer_service)
         self.key = None
+        self.namespace = None  # the timer's namespace (e.g. its window)
         self.time_domain: str = "event"  # 'event' | 'processing'
 
     def get_current_key(self):

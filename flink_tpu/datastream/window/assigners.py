@@ -15,6 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.datastream.window.windows import GlobalWindow, TimeWindow
 
 
 @dataclass(frozen=True)
@@ -25,6 +26,32 @@ class WindowAssigner:
 
     @property
     def is_session(self) -> bool:
+        return False
+
+    # -- host semantics (generic window operator path) -------------------
+    # Device stages compile the same arithmetic into the pane ring; these
+    # mirror TumblingEventTimeWindows.assignWindows / SlidingEventTime-
+    # Windows.assignWindows for the host operator.
+    def assign_windows(self, ts: int):
+        if self.size_ms == self.slide_ms:
+            start = ts - (ts % self.size_ms)
+            return [TimeWindow(start, start + self.size_ms)]
+        last_start = ts - (ts % self.slide_ms)
+        out = []
+        start = last_start
+        while start > ts - self.size_ms:
+            out.append(TimeWindow(start, start + self.size_ms))
+            start -= self.slide_ms
+        return out
+
+    def default_trigger(self):
+        from flink_tpu.datastream.window import triggers as tg
+
+        return (tg.EventTimeTrigger() if self.is_event_time
+                else tg.ProcessingTimeTrigger())
+
+    @property
+    def is_merging(self) -> bool:
         return False
 
 
@@ -66,6 +93,36 @@ class CountWindowAssigner:
 
 
 @dataclass(frozen=True)
+class GlobalWindows:
+    """All elements into one global window; fires only via a custom
+    trigger (ref GlobalWindows.java, default NeverTrigger)."""
+
+    is_event_time: bool = False
+    size_ms: int = 0
+    slide_ms: int = 0
+
+    @staticmethod
+    def create() -> "GlobalWindows":
+        return GlobalWindows()
+
+    @property
+    def is_session(self) -> bool:
+        return False
+
+    @property
+    def is_merging(self) -> bool:
+        return False
+
+    def assign_windows(self, ts: int):
+        return [GlobalWindow.get()]
+
+    def default_trigger(self):
+        from flink_tpu.datastream.window import triggers as tg
+
+        return tg.NeverTrigger()
+
+
+@dataclass(frozen=True)
 class SessionWindowAssigner:
     """Session windows (gap-merged); executed by the session-merge path."""
 
@@ -75,6 +132,19 @@ class SessionWindowAssigner:
     @property
     def is_session(self) -> bool:
         return True
+
+    @property
+    def is_merging(self) -> bool:
+        return True
+
+    def assign_windows(self, ts: int):
+        return [TimeWindow(ts, ts + self.gap_ms)]
+
+    def default_trigger(self):
+        from flink_tpu.datastream.window import triggers as tg
+
+        return (tg.EventTimeTrigger() if self.is_event_time
+                else tg.ProcessingTimeTrigger())
 
 
 class EventTimeSessionWindows:

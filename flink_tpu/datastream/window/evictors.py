@@ -1,0 +1,113 @@
+"""Evictor catalog — element removal before/after window evaluation.
+
+Mirrors the reference's api/windowing/evictors (SURVEY §2.5:
+CountEvictor/DeltaEvictor/TimeEvictor with the 1.2 evictBefore/evictAfter
+contract). Evicting windows buffer full element lists (the reference's
+EvictingWindowOperator ListState path), so attaching an evictor routes the
+stage to the generic host window operator.
+
+Elements are (value, timestamp) pairs in insertion order; evict_* return the
+retained list.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+TimestampedValue = Tuple[Any, int]
+
+
+class Evictor:
+    def evict_before(self, elements: List[TimestampedValue], size: int,
+                     window) -> List[TimestampedValue]:
+        return elements
+
+    def evict_after(self, elements: List[TimestampedValue], size: int,
+                    window) -> List[TimestampedValue]:
+        return elements
+
+
+class CountEvictor(Evictor):
+    """Keeps at most `n` (most recent) elements (ref CountEvictor.java)."""
+
+    def __init__(self, n: int, do_evict_after: bool = False):
+        self.n = n
+        self.do_evict_after = do_evict_after
+
+    @staticmethod
+    def of(n: int, do_evict_after: bool = False) -> "CountEvictor":
+        return CountEvictor(n, do_evict_after)
+
+    def _evict(self, elements, size, window):
+        if size <= self.n:
+            return elements
+        return elements[size - self.n:]
+
+    def evict_before(self, elements, size, window):
+        return elements if self.do_evict_after else self._evict(
+            elements, size, window)
+
+    def evict_after(self, elements, size, window):
+        return self._evict(elements, size, window) if self.do_evict_after \
+            else elements
+
+
+class DeltaEvictor(Evictor):
+    """Evicts elements whose delta to the LAST element exceeds the
+    threshold (ref DeltaEvictor.java)."""
+
+    def __init__(self, threshold: float, delta_fn: Callable[[Any, Any], float],
+                 do_evict_after: bool = False):
+        self.threshold = threshold
+        self.delta_fn = delta_fn
+        self.do_evict_after = do_evict_after
+
+    @staticmethod
+    def of(threshold: float, delta_fn, do_evict_after: bool = False):
+        return DeltaEvictor(threshold, delta_fn, do_evict_after)
+
+    def _evict(self, elements, size, window):
+        if not elements:
+            return elements
+        last = elements[-1][0]
+        return [e for e in elements
+                if self.delta_fn(e[0], last) < self.threshold]
+
+    def evict_before(self, elements, size, window):
+        return elements if self.do_evict_after else self._evict(
+            elements, size, window)
+
+    def evict_after(self, elements, size, window):
+        return self._evict(elements, size, window) if self.do_evict_after \
+            else elements
+
+
+class TimeEvictor(Evictor):
+    """Keeps elements within `window_size_ms` of the newest element's
+    timestamp (ref TimeEvictor.java)."""
+
+    def __init__(self, window_size_ms: int, do_evict_after: bool = False):
+        self.window_size_ms = window_size_ms
+        self.do_evict_after = do_evict_after
+
+    @staticmethod
+    def of(window_size_ms: int, do_evict_after: bool = False) -> "TimeEvictor":
+        return TimeEvictor(window_size_ms, do_evict_after)
+
+    def _evict(self, elements, size, window):
+        if not elements:
+            return elements
+        has_ts = any(ts is not None for _, ts in elements)
+        if not has_ts:
+            return elements
+        max_ts = max(ts for _, ts in elements if ts is not None)
+        cutoff = max_ts - self.window_size_ms
+        return [e for e in elements if e[1] is None or e[1] >= cutoff]
+
+    def evict_before(self, elements, size, window):
+        return elements if self.do_evict_after else self._evict(
+            elements, size, window)
+
+    def evict_after(self, elements, size, window):
+        return self._evict(elements, size, window) if self.do_evict_after \
+            else elements

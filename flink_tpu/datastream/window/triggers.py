@@ -1,0 +1,301 @@
+"""Trigger catalog — when windows fire.
+
+Mirrors the reference's api/windowing/triggers (SURVEY §2.5: 9 files,
+TriggerResult.java CONTINUE/FIRE/PURGE/FIRE_AND_PURGE): a Trigger decides,
+per element and per timer, whether the window's contents are emitted and/or
+cleared. Triggers keep their own per-(key, window) state through the
+TriggerContext (partitioned state namespaced by window), exactly as the
+reference's Trigger.TriggerContext.getPartitionedState does.
+
+These drive the **generic host window operator** (runtime/window_operator).
+The device window kernels implement the default EventTimeTrigger /
+ProcessingTimeTrigger semantics natively; attaching any custom trigger
+routes the stage to the generic operator.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from flink_tpu.state.descriptors import (
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
+
+
+class TriggerResult(enum.Enum):
+    CONTINUE = (False, False)
+    FIRE = (True, False)
+    PURGE = (False, True)
+    FIRE_AND_PURGE = (True, True)
+
+    @property
+    def is_fire(self) -> bool:
+        return self.value[0]
+
+    @property
+    def is_purge(self) -> bool:
+        return self.value[1]
+
+
+class Trigger:
+    """Trigger.java contract. ctx is a TriggerContext (window_operator.py):
+    .current_watermark, .current_processing_time,
+    .register_event_time_timer(ts), .register_processing_time_timer(ts),
+    .delete_*_timer(ts), .get_partitioned_state(descriptor).
+    """
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        raise NotImplementedError
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return False
+
+    def on_merge(self, window, ctx) -> None:
+        raise NotImplementedError(f"{type(self).__name__} cannot merge")
+
+    def clear(self, window, ctx) -> None:
+        pass
+
+
+class EventTimeTrigger(Trigger):
+    """Fires once the watermark passes the window end (ref
+    EventTimeTrigger.java)."""
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        if window.max_timestamp() <= ctx.current_watermark:
+            return TriggerResult.FIRE  # late but within allowed lateness
+        ctx.register_event_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return (TriggerResult.FIRE if time == window.max_timestamp()
+                else TriggerResult.CONTINUE)
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        if window.max_timestamp() > ctx.current_watermark:
+            ctx.register_event_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx) -> None:
+        ctx.delete_event_time_timer(window.max_timestamp())
+
+    @staticmethod
+    def create() -> "EventTimeTrigger":
+        return EventTimeTrigger()
+
+
+class ProcessingTimeTrigger(Trigger):
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        ctx.register_processing_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return TriggerResult.FIRE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        ctx.register_processing_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx) -> None:
+        ctx.delete_processing_time_timer(window.max_timestamp())
+
+    @staticmethod
+    def create() -> "ProcessingTimeTrigger":
+        return ProcessingTimeTrigger()
+
+
+class CountTrigger(Trigger):
+    """Fires every `n` elements (ref CountTrigger.java); keeps the count in
+    per-(key, window) ReducingState."""
+
+    def __init__(self, n: int):
+        self.n = n
+        self._desc = ReducingStateDescriptor("trigger-count", kind="sum")
+
+    @staticmethod
+    def of(n: int) -> "CountTrigger":
+        return CountTrigger(n)
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        st = ctx.get_partitioned_state(self._desc)
+        st.add(1)
+        if st.get() >= self.n:
+            st.clear()
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        pass  # count state is merged by the state machinery
+
+    def clear(self, window, ctx) -> None:
+        ctx.get_partitioned_state(self._desc).clear()
+
+
+class ContinuousEventTimeTrigger(Trigger):
+    """Fires every `interval` of event time within the window (ref
+    ContinuousEventTimeTrigger.java)."""
+
+    def __init__(self, interval_ms: int):
+        self.interval = interval_ms
+        self._desc = ReducingStateDescriptor(
+            "trigger-fire-time", kind="min",
+        )
+
+    @staticmethod
+    def of(interval_ms: int) -> "ContinuousEventTimeTrigger":
+        return ContinuousEventTimeTrigger(interval_ms)
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        if window.max_timestamp() <= ctx.current_watermark:
+            return TriggerResult.FIRE
+        ctx.register_event_time_timer(window.max_timestamp())
+        st = ctx.get_partitioned_state(self._desc)
+        if st.get() is None:
+            start = timestamp - (timestamp % self.interval)
+            nxt = start + self.interval
+            ctx.register_event_time_timer(nxt)
+            st.add(nxt)
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        if time == window.max_timestamp():
+            return TriggerResult.FIRE
+        st = ctx.get_partitioned_state(self._desc)
+        fire_ts = st.get()
+        if fire_ts is not None and fire_ts == time:
+            st.clear()
+            nxt = time + self.interval
+            ctx.register_event_time_timer(nxt)
+            st.add(nxt)
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        ctx.register_event_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx) -> None:
+        ctx.get_partitioned_state(self._desc).clear()
+
+
+class ContinuousProcessingTimeTrigger(Trigger):
+    def __init__(self, interval_ms: int):
+        self.interval = interval_ms
+        self._desc = ReducingStateDescriptor("trigger-fire-time", kind="min")
+
+    @staticmethod
+    def of(interval_ms: int) -> "ContinuousProcessingTimeTrigger":
+        return ContinuousProcessingTimeTrigger(interval_ms)
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        now = ctx.current_processing_time
+        st = ctx.get_partitioned_state(self._desc)
+        if st.get() is None:
+            start = now - (now % self.interval)
+            nxt = start + self.interval
+            ctx.register_processing_time_timer(nxt)
+            st.add(nxt)
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        st = ctx.get_partitioned_state(self._desc)
+        st.clear()
+        nxt = time + self.interval
+        ctx.register_processing_time_timer(nxt)
+        st.add(nxt)
+        return TriggerResult.FIRE
+
+    def clear(self, window, ctx) -> None:
+        ctx.get_partitioned_state(self._desc).clear()
+
+
+class DeltaTrigger(Trigger):
+    """Fires when delta(last_fired_element, element) > threshold (ref
+    DeltaTrigger.java)."""
+
+    def __init__(self, threshold: float, delta_fn: Callable[[Any, Any], float]):
+        self.threshold = threshold
+        self.delta_fn = delta_fn
+        self._desc = ValueStateDescriptor("trigger-last-element")
+
+    @staticmethod
+    def of(threshold: float, delta_fn) -> "DeltaTrigger":
+        return DeltaTrigger(threshold, delta_fn)
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        st = ctx.get_partitioned_state(self._desc)
+        last = st.value()
+        if last is None:
+            st.update(element)
+            return TriggerResult.CONTINUE
+        if self.delta_fn(last, element) > self.threshold:
+            st.update(element)
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def clear(self, window, ctx) -> None:
+        ctx.get_partitioned_state(self._desc).clear()
+
+
+class PurgingTrigger(Trigger):
+    """Turns any FIRE of the wrapped trigger into FIRE_AND_PURGE (ref
+    PurgingTrigger.java)."""
+
+    def __init__(self, inner: Trigger):
+        self.inner = inner
+
+    @staticmethod
+    def of(inner: Trigger) -> "PurgingTrigger":
+        return PurgingTrigger(inner)
+
+    def _purge(self, r: TriggerResult) -> TriggerResult:
+        return TriggerResult.FIRE_AND_PURGE if r.is_fire else r
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        return self._purge(self.inner.on_element(element, timestamp, window, ctx))
+
+    def on_event_time(self, time, window, ctx) -> TriggerResult:
+        return self._purge(self.inner.on_event_time(time, window, ctx))
+
+    def on_processing_time(self, time, window, ctx) -> TriggerResult:
+        return self._purge(self.inner.on_processing_time(time, window, ctx))
+
+    def can_merge(self) -> bool:
+        return self.inner.can_merge()
+
+    def on_merge(self, window, ctx) -> None:
+        self.inner.on_merge(window, ctx)
+
+    def clear(self, window, ctx) -> None:
+        self.inner.clear(window, ctx)
+
+
+class NeverTrigger(Trigger):
+    """GlobalWindows' default: never fires (ref GlobalWindows.NeverTrigger)."""
+
+    def on_element(self, element, timestamp, window, ctx) -> TriggerResult:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return True
+
+    def on_merge(self, window, ctx) -> None:
+        pass

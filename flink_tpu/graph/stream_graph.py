@@ -55,6 +55,11 @@ class WindowAggTransformation(Transformation):
     reduce_spec_factory: Callable = None  # () -> ReduceSpec
     result_fn: Optional[Callable] = None  # acc -> output value (host, vectorized)
     allowed_lateness_ms: int = 0
+    # custom trigger/evictor/raw-elements function route the stage to the
+    # generic host window operator instead of the device kernels
+    trigger: Any = None             # window.triggers.Trigger
+    evictor: Any = None             # window.evictors.Evictor
+    window_fn: Optional[Callable] = None  # (key, window, elements) -> iter
 
 
 @dataclass

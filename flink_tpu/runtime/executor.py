@@ -177,9 +177,19 @@ class LocalExecutor:
             s.open()
         pipe.source.open()
         try:
-            from flink_tpu.datastream.window.assigners import CountWindowAssigner
+            from flink_tpu.datastream.window.assigners import (
+                CountWindowAssigner, GlobalWindows,
+            )
 
-            if pipe.window_agg is not None and getattr(
+            if pipe.window_agg is not None and (
+                pipe.window_agg.trigger is not None
+                or pipe.window_agg.evictor is not None
+                or pipe.window_agg.window_fn is not None
+                or isinstance(pipe.window_agg.assigner, GlobalWindows)
+            ):
+                handle = self._run_generic_window(pipe, metrics, job_name,
+                                                  restore_from)
+            elif pipe.window_agg is not None and getattr(
                 pipe.window_agg.assigner, "is_session", False
             ):
                 handle = self._run_session(pipe, metrics, job_name,
@@ -612,6 +622,54 @@ class LocalExecutor:
                 f"checkpoint/restore is not implemented yet for {kind} stages"
             )
 
+    def _run_generic_window(self, pipe: _Pipeline, metrics: JobMetrics,
+                            job_name, restore_from=None):
+        """Windows with custom triggers/evictors/apply functions or
+        GlobalWindows: wrap into the GenericWindowOperator (full
+        WindowOperator.java semantics) and drive it as a process stage."""
+        from flink_tpu.datastream.window import triggers as tg
+        from flink_tpu.datastream.window.assigners import (
+            CountWindowAssigner, GlobalWindows,
+        )
+        from flink_tpu.runtime.window_operator import GenericWindowOperator
+        from flink_tpu.state.descriptors import ReducingStateDescriptor
+
+        wagg = pipe.window_agg
+        assigner, trigger = wagg.assigner, wagg.trigger
+        if isinstance(assigner, CountWindowAssigner):
+            # countWindow(N) IS GlobalWindows + PurgingTrigger(CountTrigger)
+            # (ref KeyedStream.countWindow); the device count path handles
+            # the plain case, this lowering covers custom trigger/evictor/
+            # apply combinations
+            if trigger is None:
+                trigger = tg.PurgingTrigger(tg.CountTrigger(assigner.size_n))
+            assigner = GlobalWindows.create()
+        reduce_desc = None
+        if wagg.reduce_spec_factory is not None:
+            spec = wagg.reduce_spec_factory()
+            reduce_desc = ReducingStateDescriptor(
+                "window-contents", kind=spec.kind,
+                reduce_fn=spec.combine, neutral=spec.neutral,
+            )
+        op = GenericWindowOperator(
+            assigner=assigner,
+            trigger=trigger,
+            evictor=wagg.evictor,
+            extractor=wagg.extractor,
+            reduce_desc=reduce_desc,
+            window_fn=wagg.window_fn,
+            allowed_lateness_ms=wagg.allowed_lateness_ms,
+            result_fn=wagg.result_fn,
+        )
+        proc_pipe = dataclasses.replace(
+            pipe, window_agg=None,
+            process=sg.ProcessTransformation("generic-window", None, fn=op),
+        )
+        handle = self._run_process(proc_pipe, metrics, job_name, restore_from)
+        metrics.dropped_late += op.dropped_late
+        metrics.fires += op.fires
+        return handle
+
     def _run_process(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
                      restore_from=None):
         """Keyed ProcessFunction stage: host generality path over the heap
@@ -640,6 +698,7 @@ class LocalExecutor:
             def _fire(self, timer, domain):
                 backend.set_current_key(timer.key)
                 timer_ctx.key = timer.key
+                timer_ctx.namespace = timer.namespace
                 timer_ctx.time_domain = domain
                 timer_ctx.element_timestamp = timer.timestamp
                 fn.on_timer(timer.timestamp, timer_ctx, collector)
@@ -651,6 +710,9 @@ class LocalExecutor:
                 self._fire(timer, "processing")
 
         timers.triggerable = _Triggerable()
+        if hasattr(fn, "bind_internals"):
+            # operators needing namespaced timers/state (GenericWindowOperator)
+            fn.bind_internals(backend, timers)
         if isinstance(fn, RichFunction):
             fn.open(RuntimeContext(backend))
 
@@ -720,6 +782,12 @@ class LocalExecutor:
             while not end:
                 polled, end = pipe.source.poll(env.batch_size)
                 now_ms = int(time.time() * 1000)
+                # sync the clock BEFORE elements see it: triggers compute
+                # interval timers from current_processing_time, and the
+                # -2^62 sentinel would put those timers ~2^62 in the past
+                # (a ~1e15-iteration advance cascade)
+                if timers.current_processing_time < now_ms:
+                    timers.current_processing_time = now_ms
                 elements = _apply_chain(
                     pipe.pre_chain, self._to_elements(polled)
                 )
@@ -774,11 +842,10 @@ class LocalExecutor:
                 collector.drain()  # discard partial output of the failed run
                 restore_checkpoint(storage)
 
-        # end of stream: fire all remaining event-time timers
-        if event_time:
-            timers.advance_watermark(2**62)
-        else:
-            timers.advance_processing_time(int(time.time() * 1000) + 1)
+        # end of stream: flush everything pending (the device stages'
+        # MAX-watermark flush analog; finite sources always drain). Single
+        # pass: re-registered timers don't cascade.
+        timers.drain(2**62)
         emit()
         if isinstance(fn, RichFunction):
             fn.close()

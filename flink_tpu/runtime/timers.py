@@ -99,6 +99,32 @@ class InternalTimerService:
                 self.triggerable.on_processing_time(timer)
         return fired
 
+    def drain(self, ts: int):
+        """End-of-stream flush: advance both clocks to `ts` and fire each
+        PRE-EXISTING timer exactly once. Timers that callbacks re-register
+        during the drain (continuous triggers re-arming) are discarded
+        instead of cascading — otherwise a trigger re-registering t+interval
+        <= ts would fire ~2^62/interval times."""
+        self.current_watermark = ts
+        self.current_processing_time = ts
+        limit = self._seq
+        for q, live, cb in (
+            (self._event_q, self._event_set,
+             lambda t: self.triggerable.on_event_time(t)),
+            (self._proc_q, self._proc_set,
+             lambda t: self.triggerable.on_processing_time(t)),
+        ):
+            while q and q[0][0] <= ts:
+                _, seq, timer = heapq.heappop(q)
+                k = (timer.timestamp, timer.key, timer.namespace)
+                if k not in live:
+                    continue
+                live.discard(k)
+                if seq > limit:
+                    continue  # registered during this drain: drop
+                if self.triggerable is not None:
+                    cb(timer)
+
     def next_processing_timer(self) -> Optional[int]:
         while self._proc_q:
             ts, _, timer = self._proc_q[0]

@@ -1,0 +1,368 @@
+"""Generic window operator — full WindowOperator.java semantics on the host.
+
+Mirrors the reference's runtime/operators/windowing/WindowOperator.java
+(SURVEY §2.5: processElement:222 window assignment + windowState.add +
+trigger consult + cleanup-timer registration; onEventTime:337 /
+onProcessingTime:378 fire path; MergingWindowSet for session merging;
+EvictingWindowOperator's ListState buffering when an evictor is attached).
+
+Role in this framework: the **generality path**. The device window kernels
+(ops/window_kernels.py) execute the default trigger semantics for the hot
+aligned-window aggregations; any stage with a custom Trigger, an Evictor, a
+raw-elements window function (apply), or a GlobalWindows assigner routes
+here, running as a ProcessFunction over the heap keyed backend + internal
+timer service — which also gives it checkpoint/restore and restart recovery
+for free through the process-stage machinery.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Any, Callable, List, Optional
+
+from flink_tpu.datastream.functions import Collector, ProcessFunction
+from flink_tpu.datastream.window.triggers import Trigger, TriggerResult
+from flink_tpu.datastream.window.windows import GlobalWindow, TimeWindow
+from flink_tpu.state.descriptors import (
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+)
+
+WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
+SessionResult = namedtuple(
+    "SessionResult", ["key", "window_start_ms", "window_end_ms", "value"]
+)
+
+
+class TriggerContext:
+    """Trigger.TriggerContext: window-namespaced timers + partitioned state
+    (ref WindowOperator.Context)."""
+
+    def __init__(self, operator: "GenericWindowOperator"):
+        self._op = operator
+        self.window = None
+        self.key = None
+
+    @property
+    def current_watermark(self) -> int:
+        return self._op._timers.current_watermark
+
+    @property
+    def current_processing_time(self) -> int:
+        return self._op._timers.current_processing_time
+
+    def register_event_time_timer(self, ts: int):
+        self._op._timers.register_event_time_timer(self.window, self.key, ts)
+
+    def register_processing_time_timer(self, ts: int):
+        self._op._timers.register_processing_time_timer(
+            self.window, self.key, ts)
+
+    def delete_event_time_timer(self, ts: int):
+        self._op._timers.delete_event_time_timer(self.window, self.key, ts)
+
+    def delete_processing_time_timer(self, ts: int):
+        self._op._timers.delete_processing_time_timer(
+            self.window, self.key, ts)
+
+    def get_partitioned_state(self, descriptor):
+        return self._op._backend.get_partitioned_state(
+            descriptor, namespace=("trig", self.window))
+
+
+class MergingWindowSet:
+    """Session-window merge bookkeeping (ref MergingWindowSet.java): maps
+    in-flight windows to the namespace ('state window') their contents live
+    under, so merges re-point mappings instead of copying state."""
+
+    def __init__(self, mapping_state):
+        self._state = mapping_state  # MapState: window -> state window
+
+    def state_window(self, window):
+        return self._state.get(window)
+
+    def retire_window(self, window):
+        self._state.remove(window)
+
+    def add_window(self, new_window, merge_cb):
+        """Returns the (possibly merged) actual window for new_window.
+
+        merge_cb(merged, merged_windows, state_window, merged_state_windows)
+        is invoked when a merge happens, BEFORE mappings are updated —
+        exactly the reference's MergeFunction contract.
+        """
+        mapping = dict(self._state.items())
+        overlapping = [w for w in mapping if w.intersects(new_window)]
+        if not overlapping:
+            self._state.put(new_window, new_window)
+            return new_window
+        merged = new_window
+        for w in overlapping:
+            merged = merged.cover(w)
+        state_windows = [mapping[w] for w in overlapping]
+        keep_state = state_windows[0]
+        if len(overlapping) == 1 and overlapping[0] == merged:
+            return merged  # fully contained, nothing changes
+        merge_cb(merged, overlapping, keep_state, state_windows[1:])
+        for w in overlapping:
+            self._state.remove(w)
+        self._state.put(merged, keep_state)
+        return merged
+
+
+class GenericWindowOperator(ProcessFunction):
+    def __init__(
+        self,
+        assigner,
+        trigger: Optional[Trigger] = None,
+        evictor=None,
+        extractor: Callable = None,
+        reduce_desc: Optional[ReducingStateDescriptor] = None,
+        window_fn: Optional[Callable] = None,  # (key, window, elements)->iter
+        allowed_lateness_ms: int = 0,
+        result_fn: Optional[Callable] = None,
+    ):
+        self.assigner = assigner
+        self.trigger = trigger or assigner.default_trigger()
+        self.evictor = evictor
+        self.extractor = extractor or (lambda e: e)
+        self.reduce_desc = reduce_desc
+        self.window_fn = window_fn
+        self.lateness = allowed_lateness_ms
+        self.result_fn = result_fn
+        # evictors and raw-element window functions need the full buffer
+        # (EvictingWindowOperator ListState path)
+        self.buffered = evictor is not None or (
+            window_fn is not None and reduce_desc is None
+        )
+        self.dropped_late = 0
+        self.fires = 0
+
+    # -- wiring (called by the process-stage executor) -------------------
+    def bind_internals(self, backend, timers):
+        self._backend = backend
+        self._timers = timers
+
+    def open(self, runtime_ctx):
+        self._rt = runtime_ctx
+        self._trigger_ctx = TriggerContext(self)
+        if self.buffered:
+            self._contents_desc = ListStateDescriptor("window-contents")
+        else:
+            self._contents_desc = self.reduce_desc
+        self._merge_desc = MapStateDescriptor("merging-window-set")
+
+    # -- helpers ----------------------------------------------------------
+    def _window_state(self, window):
+        return self._backend.get_partitioned_state(
+            self._contents_desc, namespace=("win", window))
+
+    def _cleanup_time(self, window) -> int:
+        if isinstance(window, GlobalWindow):
+            return window.max_timestamp()
+        if self.assigner.is_event_time:
+            t = window.max_timestamp() + self.lateness
+            return t if t >= window.max_timestamp() else 2**62
+        return window.max_timestamp()
+
+    def _register_cleanup(self, key, window):
+        t = self._cleanup_time(window)
+        if t >= 2**62:
+            return
+        if self.assigner.is_event_time:
+            self._timers.register_event_time_timer(window, key, t)
+        else:
+            self._timers.register_processing_time_timer(window, key, t)
+
+    def _delete_cleanup(self, key, window):
+        t = self._cleanup_time(window)
+        if t >= 2**62:
+            return
+        if self.assigner.is_event_time:
+            self._timers.delete_event_time_timer(window, key, t)
+        else:
+            self._timers.delete_processing_time_timer(window, key, t)
+
+    def _is_window_late(self, window) -> bool:
+        return (
+            self.assigner.is_event_time
+            and not isinstance(window, GlobalWindow)
+            and self._cleanup_time(window) <= self._timers.current_watermark
+        )
+
+    def _emit(self, key, window, value, out: Collector):
+        self.fires += 1
+        if self.result_fn is not None:
+            value = self.result_fn(value)
+        if isinstance(window, GlobalWindow):
+            out.collect(WindowResult(key, None, value))
+        elif self.assigner.is_merging:
+            out.collect(SessionResult(key, window.start, window.end, value))
+        else:
+            out.collect(WindowResult(key, window.end, value))
+
+    def _fire(self, key, window, out: Collector, state_window=None):
+        """Evaluate + emit one window. For merging (session) windows the
+        contents live under `state_window`'s namespace; otherwise it is the
+        window itself."""
+        state = self._window_state(state_window or window)
+        if self.buffered:
+            elements = list(state.get())
+            n = len(elements)
+            if self.evictor is not None:
+                elements = self.evictor.evict_before(elements, n, window)
+            if not elements:
+                return
+            if self.window_fn is not None:
+                for r in self.window_fn(key, window,
+                                        [v for v, _ in elements]):
+                    self.fires += 1
+                    out.collect(r)
+            elif self.reduce_desc is not None:
+                acc = elements[0][0]
+                for v, _ in elements[1:]:
+                    acc = self.reduce_desc.host_reduce(acc, v)
+                self._emit(key, window, acc, out)
+            else:
+                self._emit(key, window, [v for v, _ in elements], out)
+            if self.evictor is not None:
+                retained = self.evictor.evict_after(
+                    elements, len(elements), window)
+                state.update(retained)
+        else:
+            acc = state.get()
+            if acc is None:
+                return
+            if self.window_fn is not None:
+                for r in self.window_fn(key, window, [acc]):
+                    self.fires += 1
+                    out.collect(r)
+            else:
+                self._emit(key, window, acc, out)
+
+    def _clear_window(self, key, window, merging_set=None):
+        state_window = window
+        if merging_set is not None:
+            sw = merging_set.state_window(window)
+            if sw is not None:
+                state_window = sw
+            merging_set.retire_window(window)
+        self._window_state(state_window).clear()
+        self._trigger_ctx.window = window
+        self._trigger_ctx.key = key
+        self.trigger.clear(window, self._trigger_ctx)
+
+    # -- ProcessFunction hooks --------------------------------------------
+    def process_element(self, element, ctx, out):
+        key = self._backend.current_key
+        ts = ctx.timestamp()
+        value = self.extractor(element)
+        windows = self.assigner.assign_windows(ts)
+
+        if self.assigner.is_merging:
+            self._process_merging(key, element, value, ts, windows, out)
+            return
+
+        all_late = True
+        for window in windows:
+            if self._is_window_late(window):
+                continue
+            all_late = False
+            state = self._window_state(window)
+            if self.buffered:
+                state.add((value, ts))
+            else:
+                state.add(value)
+            self._trigger_ctx.window = window
+            self._trigger_ctx.key = key
+            r = self.trigger.on_element(element, ts, window, self._trigger_ctx)
+            if r.is_fire:
+                self._fire(key, window, out)
+            if r.is_purge:
+                self._window_state(window).clear()
+            self._register_cleanup(key, window)
+        if all_late and windows:
+            self.dropped_late += 1
+
+    def _process_merging(self, key, element, value, ts, windows, out):
+        merging_set = MergingWindowSet(
+            self._backend.get_partitioned_state(self._merge_desc))
+
+        for window in windows:
+            def merge_cb(merged, merged_windows, keep_state, drop_states,
+                         _key=key):
+                # merge window contents into the kept state window
+                target = self._window_state(keep_state)
+                for sw in drop_states:
+                    src = self._window_state(sw)
+                    if self.buffered:
+                        for item in src.get():
+                            target.add(item)
+                    else:
+                        v = src.get()
+                        if v is not None:
+                            target.add(v)
+                    src.clear()
+                # re-point trigger + cleanup timers to the merged window
+                for w in merged_windows:
+                    self._trigger_ctx.window = w
+                    self._trigger_ctx.key = _key
+                    self.trigger.clear(w, self._trigger_ctx)
+                    self._delete_cleanup(_key, w)
+                self._trigger_ctx.window = merged
+                self._trigger_ctx.key = _key
+                if self.trigger.can_merge():
+                    self.trigger.on_merge(merged, self._trigger_ctx)
+
+            actual = merging_set.add_window(window, merge_cb)
+            if self._is_window_late(actual):
+                merging_set.retire_window(actual)
+                self.dropped_late += 1
+                continue
+            state_window = merging_set.state_window(actual) or actual
+            state = self._window_state(state_window)
+            if self.buffered:
+                state.add((value, ts))
+            else:
+                state.add(value)
+            self._trigger_ctx.window = actual
+            self._trigger_ctx.key = key
+            r = self.trigger.on_element(element, ts, actual, self._trigger_ctx)
+            if r.is_fire:
+                self._fire(key, actual, out, state_window=state_window)
+            if r.is_purge:
+                state.clear()
+            self._register_cleanup(key, actual)
+
+    def on_timer(self, timestamp, ctx, out):
+        key = ctx.get_current_key()
+        window = ctx.namespace
+        if window is None:
+            return
+        merging_set = None
+        state_window = window
+        if self.assigner.is_merging:
+            merging_set = MergingWindowSet(
+                self._backend.get_partitioned_state(self._merge_desc))
+            sw = merging_set.state_window(window)
+            if sw is None:
+                return  # window was merged away; its timers are stale
+            state_window = sw
+
+        self._trigger_ctx.window = window
+        self._trigger_ctx.key = key
+        if ctx.time_domain == "event":
+            r = self.trigger.on_event_time(timestamp, window,
+                                           self._trigger_ctx)
+        else:
+            r = self.trigger.on_processing_time(timestamp, window,
+                                                self._trigger_ctx)
+        if r.is_fire:
+            self._fire(key, window, out, state_window=state_window)
+        if r.is_purge:
+            self._window_state(state_window).clear()
+
+        if timestamp == self._cleanup_time(window) and not isinstance(
+                window, GlobalWindow):
+            self._clear_window(key, window, merging_set)

@@ -295,7 +295,6 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         self.kgr = key_group_range or KeyGroupRange(0, max_parallelism - 1)
         self.max_parallelism = max_parallelism
         self._tables: Dict[str, StateTable] = {}
-        self._states: Dict[str, State] = {}
         self._descs: Dict[str, StateDescriptor] = {}
         self.current_key = None
         self.current_key_group = None
@@ -315,18 +314,19 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         return t
 
     def get_partitioned_state(self, descriptor, namespace=VoidNamespace):
-        st = self._states.get(descriptor.name)
-        if st is None:
-            cls = _STATE_CLASS.get(type(descriptor))
-            if cls is None:
-                for base, c in _STATE_CLASS.items():
-                    if isinstance(descriptor, base):
-                        cls = c
-                        break
-            if cls is None:
-                raise TypeError(f"unsupported descriptor {type(descriptor)}")
-            st = cls(self, descriptor)
-            self._states[descriptor.name] = st
+        # Returns a FRESH view object per call: callers may hold several
+        # handles to the same state under different namespaces at once
+        # (e.g. session-merge moving contents between windows), so views
+        # must not alias. The underlying table is shared by name.
+        cls = _STATE_CLASS.get(type(descriptor))
+        if cls is None:
+            for base, c in _STATE_CLASS.items():
+                if isinstance(descriptor, base):
+                    cls = c
+                    break
+        if cls is None:
+            raise TypeError(f"unsupported descriptor {type(descriptor)}")
+        st = cls(self, descriptor)
         st.set_namespace(namespace)
         return st
 
