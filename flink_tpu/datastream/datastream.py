@@ -162,13 +162,14 @@ class WindowedStream:
         return self
 
     def _agg(self, name, spec_factory, extractor, result_fn=None,
-             window_fn=None) -> DataStream:
+             window_fn=None, value_prep=None) -> DataStream:
         t = sg.WindowAggTransformation(
             name, self.keyed.transformation,
             assigner=self.assigner,
             extractor=extractor,
             reduce_spec_factory=spec_factory,
             result_fn=result_fn,
+            value_prep=value_prep,
             allowed_lateness_ms=self._lateness_ms,
             trigger=self._trigger,
             evictor=self._evictor,
@@ -251,6 +252,51 @@ class WindowedStream:
             lambda: ReduceSpec("generic", dtype, value_shape,
                                combine=fn, neutral=neutral),
             _field_extractor(extractor) if extractor is not None else (lambda e: e),
+        )
+
+    def distinct_count(self, pos=None, precision: int = 12) -> DataStream:
+        """Approximate per-key distinct count of the extracted item per
+        window via a HyperLogLog register array in device state (BASELINE
+        config #3). Emits a float estimate per key per window."""
+        from flink_tpu.ops import sketches as sk
+
+        def factory(p=precision):
+            h = sk.HyperLogLog(p)
+            return ReduceSpec(
+                "sketch", h.dtype, h.value_shape, sketch=h,
+                finalize=h.finalize, result_shape=h.result_shape,
+                result_dtype=h.result_dtype,
+            )
+
+        return self._agg(
+            "window_hll",
+            factory,
+            _field_extractor(pos) if pos is not None else (lambda e: e),
+            value_prep=sk.hash32_host,
+        )
+
+    def count_min(self, pos=None, depth: int = 4, width: int = 1024,
+                  query=None) -> DataStream:
+        """Per-key Count-Min sketch of the extracted items per window
+        (BASELINE config #3). With `query` (a fixed item list) each fire
+        emits the Q point estimates; otherwise the raw depth*width register
+        vector (queryable via CountMinSketch.estimate_np)."""
+        from flink_tpu.ops import sketches as sk
+
+        def factory(d=depth, w=width, q=query):
+            cms = sk.CountMinSketch(d, w, query=q)
+            kwargs = dict(sketch=cms)
+            if q is not None:
+                kwargs.update(finalize=cms.finalize,
+                              result_shape=cms.result_shape,
+                              result_dtype=cms.result_dtype)
+            return ReduceSpec("sketch", cms.dtype, cms.value_shape, **kwargs)
+
+        return self._agg(
+            "window_cms",
+            factory,
+            _field_extractor(pos) if pos is not None else (lambda e: e),
+            value_prep=sk.hash32_host,
         )
 
     def aggregate(self, agg_fn) -> DataStream:

@@ -102,7 +102,9 @@ class TimeEvictor(Evictor):
             return elements
         max_ts = max(ts for _, ts in elements if ts is not None)
         cutoff = max_ts - self.window_size_ms
-        return [e for e in elements if e[1] is None or e[1] >= cutoff]
+        # the reference evicts ts <= cutoff (TimeEvictor.java evictedMaxTime
+        # comparison), so the boundary element goes too
+        return [e for e in elements if e[1] is None or e[1] > cutoff]
 
     def evict_before(self, elements, size, window):
         return elements if self.do_evict_after else self._evict(

@@ -141,7 +141,9 @@ class CountTrigger(Trigger):
         return True
 
     def on_merge(self, window, ctx) -> None:
-        pass  # count state is merged by the state machinery
+        # sum the per-window counts of the merged windows into the result
+        # window's namespace (ref Trigger.OnMergeContext.mergePartitionedState)
+        ctx.merge_partitioned_state(self._desc)
 
     def clear(self, window, ctx) -> None:
         ctx.get_partitioned_state(self._desc).clear()
@@ -190,6 +192,12 @@ class ContinuousEventTimeTrigger(Trigger):
         return True
 
     def on_merge(self, window, ctx) -> None:
+        # keep the earliest pending continuous-fire time across the merged
+        # windows (min-reducing state merge), plus the end-of-window timer
+        ctx.merge_partitioned_state(self._desc)
+        st = ctx.get_partitioned_state(self._desc)
+        if st.get() is not None:
+            ctx.register_event_time_timer(st.get())
         ctx.register_event_time_timer(window.max_timestamp())
 
     def clear(self, window, ctx) -> None:

@@ -54,6 +54,7 @@ class WindowAggTransformation(Transformation):
     extractor: Callable = None      # element -> numeric value (host)
     reduce_spec_factory: Callable = None  # () -> ReduceSpec
     result_fn: Optional[Callable] = None  # acc -> output value (host, vectorized)
+    value_prep: Optional[Callable] = None  # raw values array -> device values
     allowed_lateness_ms: int = 0
     # custom trigger/evictor/raw-elements function route the stage to the
     # generic host window operator instead of the device kernels

@@ -1,0 +1,245 @@
+"""Probabilistic sketch aggregations as device-array window state.
+
+BASELINE config #3: "sliding-window Count-Min / HyperLogLog sketch
+aggregation". In the reference this is user code — a ReduceFunction over a
+sketch object held in ``ReducingState`` and merged per record on the heap
+(HeapReducingState.add, flink-runtime state/heap/HeapReducingState.java:85).
+TPU-native redesign: each (key, pane) holds a flat register array inside the
+window accumulator (`WindowShardState.acc` with ``value_shape = registers``);
+one micro-batch becomes ONE scatter into the flattened register space:
+
+  * Count-Min: record item -> D row positions -> ``.at[].add`` of the D
+    increments. Pane composition (sliding windows) = elementwise ``+``,
+    which the generic pane-combine path already does.
+  * HyperLogLog: record item -> (bucket, rho) -> ``.at[].max``. Pane
+    composition = elementwise ``max``.
+
+Both sketches are *mergeable* monoids, which is exactly what the pane-ring
+design of ``window_kernels`` needs: a sliding window's sketch is the combine
+of its panes' sketches — no per-record re-scan, matching how the reference's
+aligned panes (AbstractKeyedTimePanes.java) compose per-pane aggregates.
+
+A ``finalize`` hook (the analog of Flink's later AggregateFunction.getResult)
+turns the combined registers into a small estimate tensor at fire time so
+fires ship estimates, not multi-KB sketches, off device.
+
+Items are hashed host-side to uint32 via the same stable hash as keys
+(ops/hashing.py) and carried through the routing step in the ``values`` lane.
+Device-side, per-row/bucket hashes derive from that base hash with fmix32
+mixing, so the wire stays one 32-bit word per record.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops.hashing import hash64_host, splitmix64
+
+
+def hash32_host(items) -> np.ndarray:
+    """Host items -> uint32 base sketch hashes (stable across processes)."""
+    h = hash64_host(items)
+    return (h ^ (h >> np.uint64(32))).astype(np.uint32)
+
+
+def _fmix32(h):
+    """murmur3 32-bit finalizer, uint32 wraparound arithmetic (device)."""
+    h = jnp.asarray(h, jnp.uint32)
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    return h ^ (h >> np.uint32(16))
+
+
+def _row_seeds(depth: int) -> np.ndarray:
+    return splitmix64(np.arange(1, depth + 1, dtype=np.uint64)).astype(
+        np.uint32
+    )
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    """numpy mirror of _fmix32 (identical bit pattern, host path)."""
+    h = np.asarray(h, np.uint32)
+    with np.errstate(over="ignore"):
+        h = h ^ (h >> np.uint32(16))
+        h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        h = h ^ (h >> np.uint32(13))
+        h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    return h ^ (h >> np.uint32(16))
+
+
+class CountMinSketch:
+    """Count-Min sketch spec: D x W int32 counters per (key, pane).
+
+    query: optional fixed item list; fires then emit the Q point estimates
+    (min over rows) instead of raw registers. Width must be a power of two.
+    """
+
+    op = "add"  # scatter reducer AND pane-composition combine
+    neutral = 0
+
+    def __init__(self, depth: int = 4, width: int = 1024,
+                 query: Optional[Sequence] = None):
+        if width & (width - 1):
+            raise ValueError("count-min width must be a power of two")
+        self.depth = depth
+        self.width = width
+        self.value_shape = (depth * width,)
+        self.dtype = jnp.int32
+        self.seeds = _row_seeds(depth)
+        self.query = list(query) if query is not None else None
+        if self.query is not None:
+            qh = hash32_host(np.asarray(self.query)
+                             if _numeric(self.query) else self.query)
+            self.qpos = np.stack(
+                [self._positions_np(qh, d) for d in range(depth)]
+            )  # [D, Q] int32
+            self.result_shape = (len(self.query),)
+        else:
+            self.qpos = None
+            self.result_shape = self.value_shape
+        self.result_dtype = jnp.int32
+
+    def _positions_np(self, h32: np.ndarray, d: int) -> np.ndarray:
+        h = _fmix32_np((h32 ^ self.seeds[d]).astype(np.uint32))
+        return (h & np.uint32(self.width - 1)).astype(np.int32)
+
+    def expand(self, flat, hashes, live):
+        """Lane (slot*R+ring) + item hash -> D register updates per record.
+
+        flat: int32 [B]; hashes: uint32 [B]; live: bool [B]
+        Returns (eidx int32 [B*D], upd [B*D], mask bool [B*D]) indexing the
+        flattened [C*R * D*W] register space.
+        """
+        seeds = jnp.asarray(self.seeds)
+        mixed = _fmix32(hashes[:, None] ^ seeds[None, :])        # [B, D]
+        pos = (mixed & np.uint32(self.width - 1)).astype(jnp.int32)
+        d_off = (jnp.arange(self.depth, dtype=jnp.int32) * self.width)
+        eidx = (
+            flat[:, None] * jnp.int32(self.depth * self.width)
+            + d_off[None, :] + pos
+        )
+        upd = jnp.ones_like(eidx, dtype=self.dtype)
+        mask = jnp.broadcast_to(live[:, None], eidx.shape)
+        return eidx.reshape(-1), upd.reshape(-1), mask.reshape(-1)
+
+    def finalize(self, vals):
+        """[..., D*W] registers -> [..., Q] point estimates (min over rows)."""
+        if self.qpos is None:
+            return vals
+        v = vals.reshape(vals.shape[:-1] + (self.depth, self.width))
+        rows = jnp.arange(self.depth)[:, None]
+        g = v[..., rows, jnp.asarray(self.qpos)]                 # [..., D, Q]
+        return jnp.min(g, axis=-2)
+
+    def estimate_np(self, sketch: np.ndarray, items) -> np.ndarray:
+        """Host-side point query of a raw [D*W] sketch for arbitrary items."""
+        qh = hash32_host(np.asarray(items) if _numeric(items) else items)
+        v = np.asarray(sketch).reshape(self.depth, self.width)
+        ests = np.stack(
+            [v[d, self._positions_np(qh, d)] for d in range(self.depth)]
+        )
+        return ests.min(axis=0)
+
+    # -- host path (generic window operator: triggers/evictors/sessions) ---
+    def host_init(self) -> np.ndarray:
+        return np.zeros(self.value_shape, np.int64)
+
+    def host_add(self, acc: np.ndarray, item) -> np.ndarray:
+        qh = hash32_host([item])
+        for d in range(self.depth):
+            acc[d * self.width + int(self._positions_np(qh, d)[0])] += 1
+        return acc
+
+    def host_merge(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return a + b
+
+    def host_result(self, acc: np.ndarray):
+        if self.qpos is None:
+            return acc.copy()
+        v = acc.reshape(self.depth, self.width)
+        return v[np.arange(self.depth)[:, None], self.qpos].min(axis=0)
+
+
+class HyperLogLog:
+    """HLL spec: M = 2**p int32 rank registers per (key, pane).
+
+    finalize -> float32 cardinality estimate with the standard small-range
+    (linear counting) correction. 32-bit item hashes: fine up to ~1e8
+    distinct items, far beyond per-window cardinalities here.
+    """
+
+    op = "max"
+    neutral = 0
+
+    def __init__(self, p: int = 12):
+        if not 4 <= p <= 16:
+            raise ValueError("HLL precision p must be in [4, 16]")
+        self.p = p
+        self.m = 1 << p
+        self.value_shape = (self.m,)
+        self.dtype = jnp.int32
+        self.result_shape = ()
+        self.result_dtype = jnp.float32
+        m = self.m
+        self.alpha = (
+            0.673 if m == 16 else 0.697 if m == 32
+            else 0.709 if m == 64 else 0.7213 / (1 + 1.079 / m)
+        )
+
+    def expand(self, flat, hashes, live):
+        h = _fmix32(hashes)  # decorrelate from any host hash structure
+        bucket = (h >> np.uint32(32 - self.p)).astype(jnp.int32)
+        w = (h << np.uint32(self.p)).astype(jnp.uint32)
+        rho = jnp.where(
+            w == 0, jnp.int32(32 - self.p + 1),
+            jax.lax.clz(w).astype(jnp.int32) + 1,
+        )
+        eidx = flat * jnp.int32(self.m) + bucket
+        return eidx, rho, live
+
+    def finalize(self, regs):
+        """[..., M] registers -> float32 cardinality estimate."""
+        r = regs.astype(jnp.float32)
+        z = jnp.sum(jnp.exp2(-r), axis=-1)
+        e = jnp.float32(self.alpha * self.m * self.m) / z
+        zeros = jnp.sum(regs == 0, axis=-1).astype(jnp.float32)
+        lin = jnp.float32(self.m) * (
+            jnp.log(jnp.float32(self.m)) - jnp.log(jnp.maximum(zeros, 1.0))
+        )
+        use_lin = (e <= 2.5 * self.m) & (zeros > 0)
+        return jnp.where(use_lin, lin, e)
+
+    # -- host path (generic window operator: triggers/evictors/sessions) ---
+    def host_init(self) -> np.ndarray:
+        return np.zeros(self.value_shape, np.int32)
+
+    def host_add(self, acc: np.ndarray, item) -> np.ndarray:
+        qh = hash32_host([item])
+        h = int(_fmix32_np(qh)[0])
+        bucket = h >> (32 - self.p)
+        w = (h << self.p) & 0xFFFFFFFF
+        rho = (32 - self.p + 1) if w == 0 else (32 - w.bit_length() + 1)
+        acc[bucket] = max(acc[bucket], rho)
+        return acc
+
+    def host_merge(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        return np.maximum(a, b)
+
+    def host_result(self, acc: np.ndarray) -> float:
+        z = float(np.sum(np.exp2(-acc.astype(np.float64))))
+        e = self.alpha * self.m * self.m / z
+        zeros = int(np.sum(acc == 0))
+        if e <= 2.5 * self.m and zeros > 0:
+            return float(self.m * np.log(self.m / zeros))
+        return float(e)
+
+
+def _numeric(items) -> bool:
+    arr = np.asarray(items)
+    return arr.dtype.kind in "iufb"

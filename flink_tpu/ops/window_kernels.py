@@ -44,11 +44,15 @@ PANE_NONE = jnp.int32(-(2**31) + 1)
 class ReduceSpec:
     """How window contents aggregate.
 
-    kind: 'sum' | 'min' | 'max' | 'count' | 'generic'
+    kind: 'sum' | 'min' | 'max' | 'count' | 'generic' | 'sketch'
     For 'generic', combine must be associative and jnp-traceable and
-    neutral its identity element.
+    neutral its identity element. For 'sketch', `sketch` is a spec object
+    (ops/sketches.py) whose register array is the accumulator: records
+    scatter-expand into it and panes compose elementwise.
     Mirrors the role of ReduceFunction under ReducingStateDescriptor
-    (ref flink-core state API, SURVEY §2.1).
+    (ref flink-core state API, SURVEY §2.1); `finalize` mirrors the result
+    extraction the reference performs in the window function at fire time
+    (WindowOperator.fire -> InternalWindowFunction.apply).
     """
 
     kind: str = "sum"
@@ -56,8 +60,22 @@ class ReduceSpec:
     value_shape: Tuple[int, ...] = ()
     combine: Optional[Callable] = None
     neutral: Any = None
+    sketch: Any = None
+    finalize: Optional[Callable] = None      # [..., *value_shape] -> [..., *result_shape]
+    result_shape: Optional[Tuple[int, ...]] = None
+    result_dtype: Any = None
+
+    @property
+    def out_shape(self) -> Tuple[int, ...]:
+        return self.value_shape if self.finalize is None else self.result_shape
+
+    @property
+    def out_dtype(self):
+        return self.dtype if self.result_dtype is None else self.result_dtype
 
     def neutral_value(self):
+        if self.kind == "sketch":
+            return jnp.asarray(self.sketch.neutral, self.dtype)
         if self.neutral is not None:
             return jnp.asarray(self.neutral, self.dtype)
         if self.kind in ("sum", "count"):
@@ -73,6 +91,10 @@ class ReduceSpec:
         raise ValueError(f"generic reduce needs an explicit neutral")
 
     def combine_fn(self) -> Callable:
+        if self.kind == "sketch":
+            return {"add": lambda a, b: a + b, "max": jnp.maximum}[
+                self.sketch.op
+            ]
         return {
             "sum": lambda a, b: a + b,
             "count": lambda a, b: a + b,
@@ -146,6 +168,12 @@ class WindowShardState:
 def init_state(capacity: int, probe_len: int, win: WindowSpec,
                red: ReduceSpec) -> WindowShardState:
     R = win.ring
+    n_elems = capacity * R * int(np.prod(red.value_shape, dtype=np.int64))
+    if n_elems > 2**31 - 1:
+        raise ValueError(
+            f"accumulator of {n_elems} elements overflows int32 scatter "
+            f"indices; lower capacity/ring or the sketch register count"
+        )
     neutral = red.neutral_value()
     acc = jnp.broadcast_to(neutral, (capacity * R,) + red.value_shape).astype(red.dtype)
     return WindowShardState(
@@ -260,7 +288,15 @@ def update(
     # -- scatter-combine into (slot, pane-ring) accumulators ----------------
     ring = jnp.mod(pane, jnp.int32(R))
     flat = slot * jnp.int32(R) + ring  # safe: slot==C when !ok -> masked
-    if red.kind in ("sum", "min", "max", "count"):
+    if red.kind == "sketch":
+        # records expand to per-register updates in the flattened
+        # [C*R * prod(value_shape)] register space; one hardware scatter
+        eidx, upd, emask = red.sketch.expand(flat, values, live)
+        acc = scatter_combine(
+            acc.reshape(-1), eidx, upd.astype(red.dtype), emask,
+            red.sketch.op,
+        ).reshape((C * R,) + red.value_shape)
+    elif red.kind in ("sum", "min", "max", "count"):
         upd = values if red.kind != "count" else jnp.ones_like(values)
         acc = scatter_combine(acc, flat, upd.astype(red.dtype), live,
                               {"sum": "add", "count": "add",
@@ -408,6 +444,8 @@ def advance_and_fire(
             vals = jnp.where(_expand(col_t, vals), combine(vals, col), vals)
             # combine(neutral, col) == col for first touch
             emit = emit | (mask2[:, r] & present)
+        if red.finalize is not None:
+            vals = red.finalize(vals)
         return emit, vals
 
     mask, values = jax.vmap(lambda p, ok: fire_one(p, ok, touched2))(
@@ -461,7 +499,7 @@ def advance_and_fire(
         def no_late(fresh2):
             return (
                 jnp.zeros((F, C), bool),
-                jnp.zeros((F, C) + red.value_shape, red.dtype),
+                jnp.zeros((F, C) + red.out_shape, red.out_dtype),
                 jnp.full((F,), big),
                 jnp.zeros((F,), bool),
                 fresh2,

@@ -463,7 +463,11 @@ class LocalExecutor:
                     keys_arr = np.asarray(pipe.key_by.key_selector(cols))
                     n = len(keys_arr)
                     hi, lo = codec.encode(keys_arr, keep_reverse=keep_rev)
-                    values = np.asarray(wagg.extractor(cols))
+                    values = wagg.extractor(cols)
+                    values = (
+                        wagg.value_prep(values) if wagg.value_prep is not None
+                        else np.asarray(values)
+                    )
                     if event_time:
                         if pipe.ts_transform is not None:
                             ts_ms = np.asarray(
@@ -483,8 +487,10 @@ class LocalExecutor:
                 if n:
                     keys = [pipe.key_by.key_selector(e) for e in elements]
                     hi, lo = codec.encode(keys, keep_reverse=keep_rev)
-                    values = np.asarray(
-                        [wagg.extractor(e) for e in elements], np.float32
+                    raw = [wagg.extractor(e) for e in elements]
+                    values = (
+                        wagg.value_prep(raw) if wagg.value_prep is not None
+                        else np.asarray(raw, np.float32)
                     )
                     if event_time and pipe.ts_transform is not None:
                         ts_ms = np.asarray(
@@ -647,10 +653,25 @@ class LocalExecutor:
         reduce_desc = None
         if wagg.reduce_spec_factory is not None:
             spec = wagg.reduce_spec_factory()
-            reduce_desc = ReducingStateDescriptor(
-                "window-contents", kind=spec.kind,
-                reduce_fn=spec.combine, neutral=spec.neutral,
-            )
+            if spec.kind == "sketch":
+                # host mirror of the device sketch registers: the element
+                # folds in via host_add, sessions merge via host_merge, and
+                # the fire emits host_result (estimates)
+                from flink_tpu.state.descriptors import (
+                    AggregatingStateDescriptor,
+                )
+                sk_obj = spec.sketch
+                reduce_desc = AggregatingStateDescriptor(
+                    "window-contents",
+                    add=sk_obj.host_add, merge=sk_obj.host_merge,
+                    get_result=sk_obj.host_result,
+                    acc_init=sk_obj.host_init,
+                )
+            else:
+                reduce_desc = ReducingStateDescriptor(
+                    "window-contents", kind=spec.kind,
+                    reduce_fn=spec.combine, neutral=spec.neutral,
+                )
         op = GenericWindowOperator(
             assigner=assigner,
             trigger=trigger,
@@ -1153,6 +1174,9 @@ class LocalExecutor:
         hi = np.zeros(B, np.uint32)
         lo = np.zeros(B, np.uint32)
         ticks = np.zeros(B, np.int32)
-        values = np.zeros((B,) + tuple(red.value_shape), np.float32)
+        if red.kind == "sketch":
+            values = np.zeros(B, np.uint32)  # per-record item hashes
+        else:
+            values = np.zeros((B,) + tuple(red.value_shape), np.float32)
         valid = np.zeros(B, bool)
         return run_step(hi, lo, ticks, values, valid, wm_ms)

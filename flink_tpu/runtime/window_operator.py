@@ -23,7 +23,9 @@ from typing import Any, Callable, List, Optional
 from flink_tpu.datastream.functions import Collector, ProcessFunction
 from flink_tpu.datastream.window.triggers import Trigger, TriggerResult
 from flink_tpu.datastream.window.windows import GlobalWindow, TimeWindow
+from flink_tpu.state.backend import AggregatingState
 from flink_tpu.state.descriptors import (
+    AggregatingStateDescriptor,
     ListStateDescriptor,
     MapStateDescriptor,
     ReducingStateDescriptor,
@@ -43,6 +45,8 @@ class TriggerContext:
         self._op = operator
         self.window = None
         self.key = None
+        # windows being merged away, set only during Trigger.on_merge
+        self.merged_windows = ()
 
     @property
     def current_watermark(self) -> int:
@@ -69,6 +73,35 @@ class TriggerContext:
     def get_partitioned_state(self, descriptor):
         return self._op._backend.get_partitioned_state(
             descriptor, namespace=("trig", self.window))
+
+    def merge_partitioned_state(self, descriptor):
+        """Fold the merged-away windows' per-window trigger state into the
+        result window's namespace (ref Trigger.OnMergeContext.
+        mergePartitionedState -> AbstractKeyedStateBackend.
+        mergePartitionedStates:294). Supported for mergeable state kinds:
+        reducing (combine) and list (concatenate)."""
+        target = self.get_partitioned_state(descriptor)
+        for w in self.merged_windows:
+            if w == self.window:
+                continue
+            src = self._op._backend.get_partitioned_state(
+                descriptor, namespace=("trig", w))
+            if isinstance(descriptor, ReducingStateDescriptor):
+                v = src.get()
+                if v is not None:
+                    if descriptor.kind == "count":
+                        cur = target.get()
+                        target._put(v if cur is None else cur + v)
+                    else:
+                        target.add(v)
+            elif isinstance(descriptor, ListStateDescriptor):
+                for item in src.get():
+                    target.add(item)
+            else:
+                raise TypeError(
+                    f"{type(descriptor).__name__} state is not mergeable"
+                )
+            src.clear()
 
 
 class MergingWindowSet:
@@ -215,10 +248,17 @@ class GenericWindowOperator(ProcessFunction):
             if not elements:
                 return
             if self.window_fn is not None:
+                self.fires += 1
                 for r in self.window_fn(key, window,
                                         [v for v, _ in elements]):
-                    self.fires += 1
                     out.collect(r)
+            elif isinstance(self.reduce_desc, AggregatingStateDescriptor):
+                acc = self.reduce_desc.create_accumulator()
+                for v, _ in elements:
+                    acc = self.reduce_desc.add(acc, v)
+                if self.reduce_desc.get_result is not None:
+                    acc = self.reduce_desc.get_result(acc)
+                self._emit(key, window, acc, out)
             elif self.reduce_desc is not None:
                 acc = elements[0][0]
                 for v, _ in elements[1:]:
@@ -235,8 +275,8 @@ class GenericWindowOperator(ProcessFunction):
             if acc is None:
                 return
             if self.window_fn is not None:
+                self.fires += 1
                 for r in self.window_fn(key, window, [acc]):
-                    self.fires += 1
                     out.collect(r)
             else:
                 self._emit(key, window, acc, out)
@@ -299,21 +339,33 @@ class GenericWindowOperator(ProcessFunction):
                     if self.buffered:
                         for item in src.get():
                             target.add(item)
+                    elif isinstance(target, AggregatingState):
+                        a = src.get_accumulator()
+                        if a is not None:
+                            target.merge_accumulator(
+                                a, self._contents_desc.merge)
                     else:
                         v = src.get()
                         if v is not None:
                             target.add(v)
                     src.clear()
-                # re-point trigger + cleanup timers to the merged window
+                # trigger.onMerge FIRST (may merge per-window trigger state
+                # out of the dying windows), THEN clear those windows — the
+                # reference's WindowOperator merge callback order; the kept
+                # window (when it equals the merge result) is never cleared
+                self._trigger_ctx.window = merged
+                self._trigger_ctx.key = _key
+                if self.trigger.can_merge():
+                    self._trigger_ctx.merged_windows = merged_windows
+                    self.trigger.on_merge(merged, self._trigger_ctx)
+                    self._trigger_ctx.merged_windows = ()
                 for w in merged_windows:
+                    if w == merged:
+                        continue
                     self._trigger_ctx.window = w
                     self._trigger_ctx.key = _key
                     self.trigger.clear(w, self._trigger_ctx)
                     self._delete_cleanup(_key, w)
-                self._trigger_ctx.window = merged
-                self._trigger_ctx.key = _key
-                if self.trigger.can_merge():
-                    self.trigger.on_merge(merged, self._trigger_ctx)
 
             actual = merging_set.add_window(window, merge_cb)
             if self._is_window_late(actual):

@@ -208,6 +208,13 @@ class AggregatingState(State):
     def get_accumulator(self):
         return self._cell()
 
+    def merge_accumulator(self, other_acc, merge_fn=None):
+        """Fold another accumulator in (window-merge path; the input-`add`
+        path cannot express acc x acc)."""
+        merge_fn = merge_fn or self._d.merge
+        cur = self._cell()
+        self._put(other_acc if cur is None else merge_fn(cur, other_acc))
+
 
 class FoldingState(AggregatingState):
     """FoldingState.java:40 — fold(acc, value); kept for reference parity,

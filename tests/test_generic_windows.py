@@ -364,3 +364,65 @@ def test_merging_window_set_transitive_merge():
     assert merged == TimeWindow(0, 150)
     assert keep in (TimeWindow(0, 50), TimeWindow(100, 150))
     assert ms.state_window(TimeWindow(0, 150)) == keep
+
+
+# -------------------------------------------- review-regression coverage
+def test_sketch_on_generic_path_distinct_count():
+    """Sketch aggregations must work when routed to the generic host
+    operator (custom trigger): host_add/host_result mirror the device
+    registers."""
+    env = StreamExecutionEnvironment()
+    sink = sk.CollectSink()
+    data = [("k", i % 5) for i in range(9)]  # 5 distinct items
+    (
+        env.from_collection(data)
+        .key_by(0)
+        .window(GlobalWindows.create())
+        .trigger(PurgingTrigger.of(CountTrigger.of(9)))
+        .distinct_count(1, precision=10)
+        .add_sink(sink)
+    )
+    env.execute("sketch-generic")
+    assert len(sink.results) == 1
+    assert abs(sink.results[0].value - 5) < 1
+
+
+def test_session_merge_preserves_count_trigger_state():
+    """Merging sessions must merge per-window trigger state, not clear it
+    (ref Trigger.OnMergeContext.mergePartitionedState): elements at 10 and
+    100 form two sessions; 55 bridges them -> merged window has 3 elements
+    and CountTrigger(3) fires. One batch, so the watermark stays MIN and
+    neither pre-merge session expires first."""
+    env = _env_event_time(batch_size=3)
+    sink = sk.CollectSink()
+    data = [(10, 1.0), (100, 2.0), (55, 4.0)]
+    (
+        env.from_collection(data)
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .key_by(lambda e: "k")
+        .window(EventTimeSessionWindows.with_gap(50))
+        .trigger(CountTrigger.of(3))
+        .sum(1)
+        .add_sink(sink)
+    )
+    env.execute("session-trigger-merge")
+    assert [r.value for r in sink.results] == [7.0]
+
+
+def test_time_evictor_boundary_exclusive():
+    """TimeEvictor evicts ts <= max_ts - window_size (boundary element
+    goes), mirroring TimeEvictor.java."""
+    env = _env_event_time()
+    sink = sk.CollectSink()
+    data = [(0, 100.0), (100, 1.0)]
+    (
+        env.from_collection(data)
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .key_by(lambda e: "k")
+        .window(TumblingEventTimeWindows.of(1000))
+        .evictor(TimeEvictor.of(100))
+        .sum(1)
+        .add_sink(sink)
+    )
+    env.execute("time-evictor-boundary")
+    assert [r.value for r in sink.results] == [1.0]
