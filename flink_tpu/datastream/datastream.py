@@ -73,6 +73,58 @@ class DataStream:
         )
         return KeyedStream(self.env, t)
 
+    # -- multi-stream ----------------------------------------------------
+    def union(self, *streams: "DataStream") -> "DataStream":
+        """Merge N streams of the same type (ref DataStream.union)."""
+        t = sg.UnionTransformation(
+            "union",
+            parents=[self.transformation] + [s.transformation for s in streams],
+        )
+        return DataStream(self.env, t)
+
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        """Two differently-typed streams sharing one operator (ref
+        DataStream.connect / ConnectedStreams)."""
+        return ConnectedStreams(self.env, self, other)
+
+    def join(self, other: "DataStream") -> "JoinedStreams":
+        """Windowed equi-join (ref JoinedStreams): per key+window, the cross
+        product of both inputs' elements."""
+        return JoinedStreams(self.env, self, other, is_cogroup=False)
+
+    def co_group(self, other: "DataStream") -> "JoinedStreams":
+        """Windowed coGroup (ref CoGroupedStreams): the user function sees
+        both inputs' full element lists per key+window."""
+        return JoinedStreams(self.env, self, other, is_cogroup=True)
+
+    def split(self, selector: Callable) -> "SplitStream":
+        """Route each element to named outputs (ref SplitStream/
+        OutputSelector): selector(element) -> iterable of names."""
+        return SplitStream(self.env, self.transformation, selector)
+
+    # -- explicit exchange annotations (see PartitionTransformation) -----
+    def _partition(self, mode: str) -> "DataStream":
+        t = sg.PartitionTransformation(mode, self.transformation, mode=mode)
+        return DataStream(self.env, t)
+
+    def broadcast(self) -> "DataStream":
+        return self._partition("broadcast")
+
+    def rebalance(self) -> "DataStream":
+        return self._partition("rebalance")
+
+    def rescale(self) -> "DataStream":
+        return self._partition("rescale")
+
+    def shuffle(self) -> "DataStream":
+        return self._partition("shuffle")
+
+    def global_(self) -> "DataStream":
+        return self._partition("global")
+
+    def forward(self) -> "DataStream":
+        return self._partition("forward")
+
     # -- sinks -----------------------------------------------------------
     def add_sink(self, sink) -> "DataStream":
         if callable(sink) and not isinstance(sink, sink_mod.Sink):
@@ -132,6 +184,188 @@ class KeyedStream(DataStream):
             "rolling_sum", self.transformation,
             reduce_spec_factory=lambda: ReduceSpec("sum", jnp.float32),
             extractor=_field_extractor(pos) if pos is not None else (lambda e: e),
+        )
+        return DataStream(self.env, t)
+
+
+class SplitStream(DataStream):
+    """Result of DataStream.split: select(name) filters by output name."""
+
+    def __init__(self, env, transformation, selector: Callable):
+        super().__init__(env, transformation)
+        self._selector = selector
+
+    def select(self, *names: str) -> DataStream:
+        sel, wanted = self._selector, set(names)
+        t = sg.OneInputTransformation(
+            f"select({','.join(names)})", self.transformation, kind="filter",
+            fn=lambda e: not wanted.isdisjoint(sel(e)),
+        )
+        return DataStream(self.env, t)
+
+
+class ConnectedStreams:
+    """Two-input streams (ref ConnectedStreams). Lowered as a tagged union
+    with per-tag dispatch — structurally what the reference's
+    TwoInputStreamTask + CoStreamMap do across two input gates."""
+
+    def __init__(self, env, s1: DataStream, s2: DataStream,
+                 key1=None, key2=None):
+        self.env = env
+        self.s1, self.s2 = s1, s2
+        self.key1, self.key2 = key1, key2
+
+    def key_by(self, selector1, selector2) -> "ConnectedStreams":
+        return ConnectedStreams(
+            self.env, self.s1, self.s2,
+            _field_extractor(selector1), _field_extractor(selector2),
+        )
+
+    def _union(self) -> sg.UnionTransformation:
+        return sg.UnionTransformation(
+            "connect",
+            parents=[self.s1.transformation, self.s2.transformation],
+            tagged=True,
+        )
+
+    def map(self, co_map) -> DataStream:
+        """co_map: CoMapFunction (map1/map2) or a pair of callables."""
+        f1, f2 = (
+            (co_map.map1, co_map.map2) if hasattr(co_map, "map1") else co_map
+        )
+        t = sg.OneInputTransformation(
+            "co_map", self._union(), kind="map",
+            fn=lambda e: f1(e.value) if e.tag == 0 else f2(e.value),
+        )
+        return DataStream(self.env, t)
+
+    def flat_map(self, co_flat_map) -> DataStream:
+        f1, f2 = (
+            (co_flat_map.flat_map1, co_flat_map.flat_map2)
+            if hasattr(co_flat_map, "flat_map1") else co_flat_map
+        )
+        t = sg.OneInputTransformation(
+            "co_flat_map", self._union(), kind="flat_map",
+            fn=lambda e: f1(e.value) if e.tag == 0 else f2(e.value),
+        )
+        return DataStream(self.env, t)
+
+    def process(self, co_process) -> DataStream:
+        """CoProcessFunction over keyed connected streams: shared keyed
+        state + timers across both inputs (requires key_by)."""
+        if self.key1 is None or self.key2 is None:
+            raise ValueError("connect(...).process requires key_by(k1, k2)")
+        k1, k2 = self.key1, self.key2
+        keyed = sg.KeyByTransformation(
+            "key_by", self._union(),
+            key_selector=lambda e: k1(e.value) if e.tag == 0 else k2(e.value),
+        )
+        t = sg.ProcessTransformation(
+            "co_process", keyed, fn=_CoProcessAdapter(co_process)
+        )
+        return DataStream(self.env, t)
+
+
+from flink_tpu.datastream.functions import RichFunction as _RichFunction
+
+
+class _CoProcessAdapter(_RichFunction):
+    """Dispatches Tagged elements to process_element1/2 of a
+    CoProcessFunction while presenting the single-input ProcessFunction
+    contract to the runtime."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def open(self, ctx):
+        if hasattr(self.fn, "open"):
+            self.fn.open(ctx)
+
+    def close(self):
+        if hasattr(self.fn, "close"):
+            self.fn.close()
+
+    def process_element(self, e, ctx, out):
+        if e.tag == 0:
+            self.fn.process_element1(e.value, ctx, out)
+        else:
+            self.fn.process_element2(e.value, ctx, out)
+
+    def on_timer(self, timestamp, ctx, out):
+        self.fn.on_timer(timestamp, ctx, out)
+
+
+class JoinedStreams:
+    """Builder for windowed join/coGroup:
+    a.join(b).where(k1).equal_to(k2).window(assigner).apply(fn)
+
+    Lowered exactly as the reference lowers CoGroupedStreams (tagged union →
+    keyBy(union selector) → WindowOperator with buffered elements); the join
+    variant wraps the coGroup function with the cross-product (ref
+    JoinedStreams' JoinCoGroupFunction)."""
+
+    def __init__(self, env, s1, s2, is_cogroup: bool):
+        self.env = env
+        self.s1, self.s2 = s1, s2
+        self.is_cogroup = is_cogroup
+        self.k1 = self.k2 = None
+        self._assigner = None
+        self._lateness_ms = 0
+
+    def where(self, selector) -> "JoinedStreams":
+        self.k1 = _field_extractor(selector)
+        return self
+
+    def equal_to(self, selector) -> "JoinedStreams":
+        self.k2 = _field_extractor(selector)
+        return self
+
+    def window(self, assigner) -> "JoinedStreams":
+        self._assigner = assigner
+        return self
+
+    def time_window(self, size_ms: int, slide_ms: Optional[int] = None):
+        if slide_ms is None:
+            return self.window(TumblingEventTimeWindows.of(size_ms))
+        return self.window(SlidingEventTimeWindows.of(size_ms, slide_ms))
+
+    def allowed_lateness(self, ms: int) -> "JoinedStreams":
+        self._lateness_ms = ms
+        return self
+
+    def apply(self, fn: Callable) -> DataStream:
+        """join: fn(left, right) -> result, per matching pair.
+        coGroup: fn(lefts, rights) -> iterable of results."""
+        if self.k1 is None or self.k2 is None or self._assigner is None:
+            raise ValueError("join requires where/equal_to/window")
+        k1, k2 = self.k1, self.k2
+        union = sg.UnionTransformation(
+            "join_union",
+            parents=[self.s1.transformation, self.s2.transformation],
+            tagged=True,
+        )
+        keyed = sg.KeyByTransformation(
+            "key_by", union,
+            key_selector=lambda e: k1(e.value) if e.tag == 0 else k2(e.value),
+        )
+        if self.is_cogroup:
+            def window_fn(key, window, elements, _fn=fn):
+                lefts = [e.value for e in elements if e.tag == 0]
+                rights = [e.value for e in elements if e.tag == 1]
+                return list(_fn(lefts, rights))
+        else:
+            def window_fn(key, window, elements, _fn=fn):
+                lefts = [e.value for e in elements if e.tag == 0]
+                rights = [e.value for e in elements if e.tag == 1]
+                return [_fn(x, y) for x in lefts for y in rights]
+
+        t = sg.WindowAggTransformation(
+            "join" if not self.is_cogroup else "co_group", keyed,
+            assigner=self._assigner,
+            extractor=lambda e: e,
+            reduce_spec_factory=None,
+            allowed_lateness_ms=self._lateness_ms,
+            window_fn=window_fn,
         )
         return DataStream(self.env, t)
 

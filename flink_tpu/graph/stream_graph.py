@@ -85,8 +85,36 @@ class SinkTransformation(Transformation):
     sink: Any = None  # runtime.sinks.Sink
 
 
+@dataclass
+class UnionTransformation(Transformation):
+    """N-input merge (ref DataStream.union / the TaggedUnion lowering the
+    reference uses for ConnectedStreams and CoGroupedStreams —
+    CoGroupedStreams.java WithWindow.apply builds union + WindowOperator).
+
+    `parent` stays None; the executor recursively translates each branch in
+    `parents` into (source, chain, ts) and merges them with a MergedSource.
+    When `tagged`, elements are wrapped as Tagged(tag, value) so downstream
+    co-operators can dispatch per input.
+    """
+
+    parents: List[Transformation] = field(default_factory=list)
+    tagged: bool = False
+
+
+@dataclass
+class PartitionTransformation(Transformation):
+    """Explicit exchange annotation (ref Rebalance/Rescale/Shuffle/Broadcast/
+    Global/ForwardPartitioner, SURVEY §2.5). On this architecture the only
+    physical exchange is the keyed all_to_all inside the compiled SPMD step;
+    non-keyed repartitioning of the host micro-batch stream is a no-op (a
+    single host loop feeds the whole mesh), so these nodes are recorded for
+    graph fidelity and skipped at translation."""
+
+    mode: str = "rebalance"  # rebalance|rescale|shuffle|broadcast|global|forward
+
+
 def lineage(t: Transformation) -> List[Transformation]:
-    """Walk parents to the source, returning [source, ..., t]."""
+    """Walk parents to the source (or union head), returning [head, ..., t]."""
     chain = []
     cur = t
     while cur is not None:

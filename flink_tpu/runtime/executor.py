@@ -36,6 +36,7 @@ from flink_tpu.runtime.step import (
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
+from flink_tpu.runtime.union import to_elements
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
@@ -81,43 +82,153 @@ class _Pipeline:
     key_by: Optional[sg.KeyByTransformation]
     window_agg: Optional[sg.WindowAggTransformation]
     rolling: Optional[sg.KeyedProcessTransformation]
-    post_chain: List[sg.OneInputTransformation]
-    sinks: List[Any]
+    # post-stage fan-out: each branch is (chain_ops, [sinks]); divergent
+    # sink lineages after the last stateful stage become separate branches
+    # (the role of the reference's Output broadcasting to multiple edges)
+    branches: List[Any]
     process: Optional[sg.ProcessTransformation] = None
+
+    @property
+    def all_sinks(self):
+        return [s for _, sinks in self.branches for s in sinks]
+
+
+def _emit_batch(pipe: _Pipeline, elements, metrics: JobMetrics) -> int:
+    """Run each post-stage branch chain over `elements` and invoke sinks."""
+    total = 0
+    for chain, sinks in pipe.branches:
+        out = _apply_chain(chain, elements) if chain else elements
+        total += len(out)
+        for s in sinks:
+            s.invoke_batch(out)
+    metrics.records_out += total
+    return total
+
+
+def _translate_branch(parent: sg.Transformation):
+    """Translate one union input into (source, pre_ts_ops, ts, post_ts_ops).
+
+    Ops are split around the timestamp assigner so the timestamp_fn sees the
+    element exactly as it was at the assigner's position in the chain."""
+    pre_ops, post_ops, source, ts = [], [], None, None
+    for t in sg.lineage(parent):
+        if isinstance(t, sg.SourceTransformation):
+            source = t.source
+        elif isinstance(t, sg.TimestampsWatermarksTransformation):
+            ts = t
+        elif isinstance(t, sg.OneInputTransformation):
+            (post_ops if ts is not None else pre_ops).append(t)
+        elif isinstance(t, sg.PartitionTransformation):
+            pass
+        else:
+            raise NotImplementedError(
+                f"{type(t).__name__} upstream of a union/connect is not "
+                f"supported yet (only source -> stateless chain)"
+            )
+    if source is None:
+        raise ValueError("union input has no source")
+    return source, pre_ops, ts, post_ops
+
+
+def _merge_sources(u: sg.UnionTransformation):
+    """Build a MergedSource + synthesized ts transform for a union head."""
+    from flink_tpu.runtime import union as un
+
+    branches, have_ts = [], []
+    for i, parent in enumerate(u.parents):
+        source, pre_ops, ts, post_ops = _translate_branch(parent)
+        branches.append(un.Branch(
+            source, pre_ops,
+            ts_fn=ts.timestamp_fn if ts is not None else None,
+            post_ops=post_ops,
+            strategy=ts.strategy if ts is not None else None,
+            tag=i if u.tagged else None,
+        ))
+        have_ts.append(ts is not None)
+    merged = un.MergedSource(branches)
+    ts_transform = None
+    if any(have_ts):
+        if not u.tagged:
+            raise NotImplementedError(
+                "assign timestamps AFTER union() (per-input assigners need "
+                "the tagged connect/join path)"
+            )
+        if not all(have_ts):
+            raise ValueError(
+                "either all or none of the connected/joined inputs must "
+                "assign timestamps"
+            )
+        strategy = un.MergedWatermarkStrategy(
+            out_of_orderness_ms=max(
+                b.strategy.out_of_orderness_ms for b in branches
+            ),
+            branches=branches,
+        )
+        ts_transform = sg.TimestampsWatermarksTransformation(
+            "merged-ts", None,
+            timestamp_fn=lambda e: e.ts,
+            strategy=strategy,
+        )
+    return merged, ts_transform
 
 
 def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
     if not sink_transforms:
         raise ValueError("job has no sinks")
-    lineages = [sg.lineage(t)[:-1] for t in sink_transforms]
-    first = lineages[0]
-    for ln in lineages[1:]:
-        if [t.id for t in ln] != [t.id for t in first]:
+    spines, tails = [], []
+    for st in sink_transforms:
+        body = sg.lineage(st)[:-1]
+        i = len(body)
+        while i > 0 and isinstance(
+            body[i - 1],
+            (sg.OneInputTransformation, sg.PartitionTransformation),
+        ):
+            i -= 1
+        spines.append(body[:i])
+        tails.append(body[i:])
+    # stateless jobs have an empty spine except the source; normalize so the
+    # source is always on the spine
+    ref = spines[0]
+    for sp in spines[1:]:
+        if [t.id for t in sp] != [t.id for t in ref]:
             raise NotImplementedError(
-                "multiple divergent sink lineages not yet supported"
+                "sinks must share the pipeline up to the last stateful "
+                "stage; divergence is supported only in trailing "
+                "stateless chains"
             )
-    pipe = _Pipeline(None, [], None, None, None, None, [],
-                     [t.sink for t in sink_transforms])
-    stage = "pre"
-    for t in first:
+    # group identical tails into branches
+    branches, by_key = [], {}
+    for tail, st in zip(tails, sink_transforms):
+        key = tuple(t.id for t in tail)
+        if key not in by_key:
+            entry = (
+                [t for t in tail if isinstance(t, sg.OneInputTransformation)],
+                [],
+            )
+            by_key[key] = entry
+            branches.append(entry)
+        by_key[key][1].append(st.sink)
+
+    pipe = _Pipeline(None, [], None, None, None, None, branches)
+    for t in ref:
         if isinstance(t, sg.SourceTransformation):
             pipe.source = t.source
+        elif isinstance(t, sg.UnionTransformation):
+            pipe.source, pipe.ts_transform = _merge_sources(t)
         elif isinstance(t, sg.TimestampsWatermarksTransformation):
             pipe.ts_transform = t
         elif isinstance(t, sg.KeyByTransformation):
             pipe.key_by = t
-            stage = "keyed"
         elif isinstance(t, sg.WindowAggTransformation):
             pipe.window_agg = t
-            stage = "post"
         elif isinstance(t, sg.KeyedProcessTransformation):
             pipe.rolling = t
-            stage = "post"
         elif isinstance(t, sg.ProcessTransformation):
             pipe.process = t
-            stage = "post"
         elif isinstance(t, sg.OneInputTransformation):
-            (pipe.pre_chain if stage == "pre" else pipe.post_chain).append(t)
+            pipe.pre_chain.append(t)
+        elif isinstance(t, sg.PartitionTransformation):
+            pass
         else:
             raise NotImplementedError(f"transformation {type(t).__name__}")
     if pipe.source is None:
@@ -173,7 +284,7 @@ class LocalExecutor:
         pipe = _translate(sink_transforms)
         metrics = JobMetrics()
         t_start = time.perf_counter()
-        for s in pipe.sinks:
+        for s in pipe.all_sinks:
             s.open()
         pipe.source.open()
         try:
@@ -211,7 +322,7 @@ class LocalExecutor:
                 handle = JobHandle(job_name, metrics)
         finally:
             pipe.source.close()
-            for s in pipe.sinks:
+            for s in pipe.all_sinks:
                 s.close()
         metrics.wall_time_s = time.perf_counter() - t_start
         return handle
@@ -223,27 +334,13 @@ class LocalExecutor:
             polled, end = pipe.source.poll(B)
             elements = self._to_elements(polled)
             metrics.records_in += len(elements)
-            out = _apply_chain(pipe.pre_chain + pipe.post_chain, elements)
-            metrics.records_out += len(out)
-            if out:
-                for s in pipe.sinks:
-                    s.invoke_batch(out)
+            elements = _apply_chain(pipe.pre_chain, elements)
+            _emit_batch(pipe, elements, metrics)
             metrics.steps += 1
             if end:
                 break
 
-    @staticmethod
-    def _to_elements(polled):
-        if isinstance(polled, tuple) and len(polled) == 2 and isinstance(polled[0], dict):
-            cols, _ts = polled
-            if not cols:
-                return []
-            names = list(cols)
-            arrays = [cols[n] for n in names]
-            if len(names) == 1:
-                return list(arrays[0].tolist())
-            return list(zip(*[a.tolist() for a in arrays]))
-        return polled
+    _to_elements = staticmethod(to_elements)
 
     # ------------------------------------------------------------------
     def _run_windowed(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
@@ -371,7 +468,10 @@ class LocalExecutor:
 
         def run_step(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
-            wm_ticks = int(td.to_ticks(wm_ms)) if wm_ms is not None else None
+            wm_ticks = (
+                min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+                if wm_ms is not None else None
+            )
             wmv = jnp.full((ctx.n_shards,), np.int32(
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
@@ -383,8 +483,9 @@ class LocalExecutor:
             return fr
 
         columnar_emit = (
-            not pipe.post_chain
-            and all(s.columnar for s in pipe.sinks)
+            len(pipe.branches) == 1
+            and not pipe.branches[0][0]
+            and all(s.columnar for s in pipe.all_sinks)
         )
 
         def emit_fires(fr):
@@ -423,7 +524,7 @@ class LocalExecutor:
                 )
                 cols = {"key_id": kid, "window_end_ms": end_ms, "value": v}
                 metrics.records_out += len(v)
-                for s in pipe.sinks:
+                for s in pipe.all_sinks:
                     s.invoke_columnar(cols)
                 return len(v)
             keys = codec.decode(khi, klo)
@@ -431,11 +532,7 @@ class LocalExecutor:
                 WindowResult(k, int(e), vv)
                 for k, e, vv in zip(keys, end_ms.tolist(), v.tolist())
             ]
-            out = _apply_chain(pipe.post_chain, out)
-            metrics.records_out += len(out)
-            for s in pipe.sinks:
-                s.invoke_batch(out)
-            return len(out)
+            return _emit_batch(pipe, out, metrics)
 
         def batch_loop():
             end = False
@@ -793,10 +890,7 @@ class LocalExecutor:
             out = collector.drain()
             if not out:
                 return
-            out = _apply_chain(pipe.post_chain, out)
-            metrics.records_out += len(out)
-            for s in pipe.sinks:
-                s.invoke_batch(out)
+            _emit_batch(pipe, out, metrics)
 
         def batch_loop():
             end = False
@@ -927,10 +1021,7 @@ class LocalExecutor:
                 (k, v) for k, v, okv in zip(klist, out_np.tolist(), ok_np)
                 if okv
             ]
-            out = _apply_chain(pipe.post_chain, out)
-            metrics.records_out += len(out)
-            for s in pipe.sinks:
-                s.invoke_batch(out)
+            _emit_batch(pipe, out, metrics)
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped
@@ -1011,15 +1102,13 @@ class LocalExecutor:
                 out = [r._replace(value=float(np.asarray(
                     wagg.result_fn(np.asarray(r.value))))) for r in out]
             metrics.fires += len(out)
-            out = _apply_chain(pipe.post_chain, out)
-            metrics.records_out += len(out)
-            for s in pipe.sinks:
-                s.invoke_batch(out)
+            _emit_batch(pipe, out, metrics)
 
         def run_once(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
             wmv = jnp.full((ctx.n_shards,), np.int32(
-                int(td.to_ticks(wm_ms)) if wm_ms is not None else -(2**31) + 1
+                min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+                if wm_ms is not None else -(2**31) + 1
             ))
             state, old_f, mid_f, wm_f = step(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
@@ -1156,10 +1245,7 @@ class LocalExecutor:
                     for k, wi, vv in zip(keys, w_np.tolist(), v_np.tolist())
                 ]
                 metrics.fires += len(out)
-                out = _apply_chain(pipe.post_chain, out)
-                metrics.records_out += len(out)
-                for s in pipe.sinks:
-                    s.invoke_batch(out)
+                _emit_batch(pipe, out, metrics)
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped

@@ -1,0 +1,271 @@
+"""Multi-stream operators: union, connect/co-ops, windowed join/coGroup,
+split/select, multi-sink fan-out, partition annotations.
+
+Mirrors the reference's DataStream multi-input surface (SURVEY §2.5:
+ConnectedStreams, JoinedStreams/CoGroupedStreams, SplitStream) and the
+union+tag lowering CoGroupedStreams.java uses internally."""
+
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.datastream.functions import (
+    CoFlatMapFunction, CoMapFunction, CoProcessFunction,
+)
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.state.descriptors import ValueStateDescriptor
+
+
+def _env(batch=8):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = batch
+    return env
+
+
+def test_union_merges_streams():
+    env = _env()
+    sink = CollectSink()
+    a = env.from_collection([1, 2, 3])
+    b = env.from_collection([10, 20])
+    c = env.from_collection([100])
+    a.union(b, c).map(lambda x: x * 2).add_sink(sink)
+    env.execute("union")
+    assert sorted(sink.results) == [2, 4, 6, 20, 40, 200]
+
+
+def test_union_then_keyed_window():
+    env = _env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = CollectSink()
+    a = env.from_collection([(0, "x", 1.0), (1000, "y", 2.0)])
+    b = env.from_collection([(500, "x", 3.0), (6000, "x", 7.0)])
+    (
+        a.union(b)
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .key_by(lambda e: e[1])
+        .time_window(5000)
+        .sum(lambda e: e[2])
+        .add_sink(sink)
+    )
+    env.execute("union-window")
+    got = {(r.key, r.window_end_ms): r.value for r in sink.results}
+    assert got == {("x", 5000): 4.0, ("y", 5000): 2.0, ("x", 10000): 7.0}
+
+
+def test_connect_co_map():
+    class MyCoMap(CoMapFunction):
+        def map1(self, v):
+            return ("int", v)
+
+        def map2(self, v):
+            return ("str", v.upper())
+
+    env = _env()
+    sink = CollectSink()
+    nums = env.from_collection([1, 2])
+    words = env.from_collection(["a", "b"])
+    nums.connect(words).map(MyCoMap()).add_sink(sink)
+    env.execute("co-map")
+    assert sorted(sink.results) == [
+        ("int", 1), ("int", 2), ("str", "A"), ("str", "B")
+    ]
+
+
+def test_connect_co_flat_map_with_pair_of_callables():
+    env = _env()
+    sink = CollectSink()
+    a = env.from_collection(["x y", "z"])
+    b = env.from_collection([3])
+    a.connect(b).flat_map(
+        (lambda s: s.split(), lambda n: [n] * n)
+    ).add_sink(sink)
+    env.execute("co-flat-map")
+    assert sorted(sink.results, key=str) == [3, 3, 3, "x", "y", "z"]
+
+
+def test_keyed_co_process_shared_state():
+    """Control-stream pattern: stream 2 sets a per-key threshold, stream 1
+    emits values exceeding it — shared keyed state across both inputs."""
+
+    class Gate(CoProcessFunction):
+        def open(self, ctx):
+            self.threshold = ctx.get_state(
+                ValueStateDescriptor("threshold", default=0.0)
+            )
+
+        def process_element1(self, e, ctx, out):
+            if e[1] > self.threshold.value():
+                out.collect(e)
+
+        def process_element2(self, e, ctx, out):
+            self.threshold.update(e[1])
+
+    env = _env(batch=2)
+    sink = CollectSink()
+    # round-robin merge polls 1 element per branch per cycle: the control
+    # record lands in cycle 1, before ("k", 1.0) arrives in cycle 2
+    data = env.from_collection([("z", 0.0), ("k", 1.0), ("k", 5.0), ("j", 4.0)])
+    control = env.from_collection([("k", 2.0)])
+    data.connect(control).key_by(
+        lambda e: e[0], lambda e: e[0]
+    ).process(Gate()).add_sink(sink)
+    env.execute("co-process")
+    assert ("k", 5.0) in sink.results
+    assert ("j", 4.0) in sink.results
+    assert ("k", 1.0) not in sink.results
+    assert ("z", 0.0) not in sink.results
+
+
+def test_windowed_join():
+    env = _env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = CollectSink()
+    orders = env.from_collection(
+        [(0, "u1", "order-a"), (1000, "u2", "order-b"), (9000, "u1", "order-c")]
+    ).assign_timestamps_and_watermarks(lambda e: e[0])
+    pays = env.from_collection(
+        [(500, "u1", "pay-a"), (1500, "u2", "pay-b"), (2000, "u1", "pay-x")]
+    ).assign_timestamps_and_watermarks(lambda e: e[0])
+    (
+        orders.join(pays)
+        .where(lambda e: e[1])
+        .equal_to(lambda e: e[1])
+        .time_window(5000)
+        .apply(lambda o, p: (o[1], o[2], p[2]))
+        .add_sink(sink)
+    )
+    env.execute("join")
+    assert sorted(sink.results) == [
+        ("u1", "order-a", "pay-a"),
+        ("u1", "order-a", "pay-x"),
+        ("u2", "order-b", "pay-b"),
+    ]
+
+
+def test_windowed_co_group_sees_unmatched():
+    env = _env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = CollectSink()
+    a = env.from_collection([(0, "x", 1), (100, "y", 2)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    b = env.from_collection([(50, "x", 10)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    (
+        a.co_group(b)
+        .where(lambda e: e[1])
+        .equal_to(lambda e: e[1])
+        .time_window(5000)
+        .apply(lambda lefts, rights: [(len(lefts), len(rights))])
+        .add_sink(sink)
+    )
+    env.execute("cogroup")
+    # x: 1 left 1 right; y: 1 left 0 rights (outer-join visibility)
+    assert sorted(sink.results) == [(1, 0), (1, 1)]
+
+
+def test_split_select():
+    env = _env()
+    evens, odds = CollectSink(), CollectSink()
+    split = env.from_collection(list(range(6))).split(
+        lambda e: ["even"] if e % 2 == 0 else ["odd"]
+    )
+    split.select("even").add_sink(evens)
+    split.select("odd").map(lambda x: -x).add_sink(odds)
+    env.execute("split")
+    assert sorted(evens.results) == [0, 2, 4]
+    assert sorted(odds.results) == [-5, -3, -1]
+
+
+def test_multi_sink_fan_out_after_window():
+    env = _env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    raw, doubled = CollectSink(), CollectSink()
+    win = (
+        env.from_collection([(0, "a", 1.0), (1000, "a", 2.0)])
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .key_by(lambda e: e[1])
+        .time_window(5000)
+        .sum(lambda e: e[2])
+    )
+    win.add_sink(raw)
+    win.map(lambda r: r.value * 2).add_sink(doubled)
+    env.execute("fan-out")
+    assert [r.value for r in raw.results] == [3.0]
+    assert doubled.results == [6.0]
+
+
+def test_partition_annotations_are_noops():
+    env = _env()
+    sink = CollectSink()
+    (
+        env.from_collection([1, 2, 3])
+        .rebalance()
+        .map(lambda x: x + 1)
+        .shuffle()
+        .broadcast()
+        .add_sink(sink)
+    )
+    env.execute("partitions")
+    assert sorted(sink.results) == [2, 3, 4]
+
+
+def test_join_map_after_timestamp_assignment():
+    """Ops after assign_timestamps on a joined input must not feed the
+    transformed element back into the timestamp_fn; outputs inherit the
+    input element's timestamp (ref TimestampedCollector)."""
+    env = _env()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = CollectSink()
+    orders = (
+        env.from_collection([(0, "u1", "order-a"), (1000, "u2", "order-b")])
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .map(lambda e: (e[1], e[2]))          # drops the ts field
+    )
+    pays = (
+        env.from_collection([(500, "u1", "pay-a"), (1500, "u2", "pay-b")])
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+        .map(lambda e: (e[1], e[2]))
+    )
+    (
+        orders.join(pays)
+        .where(lambda e: e[0]).equal_to(lambda e: e[0])
+        .time_window(5000)
+        .apply(lambda o, p: (o[0], o[1], p[1]))
+        .add_sink(sink)
+    )
+    env.execute("join-ts-then-map")
+    assert sorted(sink.results) == [
+        ("u1", "order-a", "pay-a"), ("u2", "order-b", "pay-b")
+    ]
+
+
+def test_skewed_inputs_use_min_watermark():
+    """A fast input must not advance the merged watermark past the slow
+    input's elements (ref StreamTwoInputProcessor min-across-inputs)."""
+    env = _env(batch=4)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    sink = CollectSink()
+    a = env.from_collection([(100000, "z", 0)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    b = env.from_collection([(t, "z", t) for t in range(6)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    (
+        a.co_group(b)
+        .where(lambda e: e[1]).equal_to(lambda e: e[1])
+        .time_window(5000)
+        .apply(lambda lefts, rights: [(len(lefts), len(rights))])
+        .add_sink(sink)
+    )
+    env.execute("skewed-cogroup")
+    assert env.last_job.metrics.dropped_late == 0
+    assert sorted(sink.results) == [(0, 6), (1, 0)]
+
+
+def test_union_type_mismatch_divergent_spine_rejected():
+    env = _env()
+    s1, s2 = CollectSink(), CollectSink()
+    a = env.from_collection([1]).key_by(lambda e: e).sum()
+    a.add_sink(s1)
+    env.from_collection([2]).key_by(lambda e: e).sum().add_sink(s2)
+    with pytest.raises(NotImplementedError):
+        env.execute("divergent")
