@@ -1,0 +1,8 @@
+"""CEP — complex event processing over keyed streams (ref flink-cep,
+SURVEY §2.7: Pattern API compiled to an NFA advanced per key)."""
+
+from flink_tpu.cep.cep import CEP, PatternStream
+from flink_tpu.cep.nfa import NFA
+from flink_tpu.cep.pattern import Pattern
+
+__all__ = ["CEP", "PatternStream", "NFA", "Pattern"]

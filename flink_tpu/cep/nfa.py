@@ -1,0 +1,91 @@
+"""NFA for CEP pattern matching (ref flink-cep nfa/NFA.java:132,
+computeNextStates:229, SURVEY §2.7).
+
+Semantics reproduced from the reference:
+- every event can START a new partial match (the start state is always
+  active — NFA.java keeps a start ComputationState alive);
+- STRICT stages (next) have only a "take" transition: a non-matching event
+  kills the partial;
+- RELAXED stages (followedBy) also have an "ignore" self-transition: the
+  partial survives non-matching events, AND survives a matching event (so
+  [a, b1, b2] against `a followedBy b` yields (a,b1) and (a,b2), as the
+  reference's shared-buffer branching does);
+- `within` prunes partials whose first event is older than the horizon
+  (NFA.java's window pruning on processing each event).
+
+Partial matches store their event lists directly — the role of the
+reference's SharedBuffer (a structure to share event prefixes between
+branches with Dewey-number versioning) without the sharing optimization;
+host memory is not the bottleneck here, the device stages are.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.cep.pattern import Pattern, RELAXED, STRICT
+
+
+@dataclass(frozen=True)
+class Partial:
+    stage_idx: int            # index of the last MATCHED stage
+    events: Tuple[Any, ...]
+    start_ts: int
+
+
+class NFA:
+    """One NFA instance per key; state is the list of live partials."""
+
+    def __init__(self, pattern: Pattern):
+        self.pattern = pattern
+        self.stages = pattern.stages
+        self.within_ms = pattern.within_ms
+
+    def initial_state(self) -> List[Partial]:
+        return []
+
+    def process(
+        self, partials: List[Partial], event, ts: int
+    ) -> Tuple[List[Partial], List[Dict[str, Any]]]:
+        """Advance the NFA by one event; returns (new_partials, matches).
+        A match is {stage_name: event} (ref Map<String, IN> from
+        NFA.process)."""
+        nxt: List[Partial] = []
+        matches: List[Dict[str, Any]] = []
+        last = len(self.stages) - 1
+
+        def emit_or_keep(p: Partial):
+            if p.stage_idx == last:
+                matches.append({
+                    s.name: ev for s, ev in zip(self.stages, p.events)
+                })
+            else:
+                nxt.append(p)
+
+        for p in partials:
+            if self.within_ms is not None and ts - p.start_ts > self.within_ms:
+                continue  # window pruning: partial expired
+            stage = self.stages[p.stage_idx + 1]
+            if stage.matches(event):
+                emit_or_keep(Partial(
+                    p.stage_idx + 1, p.events + (event,), p.start_ts
+                ))
+                if stage.contiguity == RELAXED:
+                    nxt.append(p)  # branch: also wait for later matches
+            elif stage.contiguity == RELAXED:
+                nxt.append(p)      # ignore transition
+            # STRICT + no match: partial dies
+
+        if self.stages[0].matches(event):
+            emit_or_keep(Partial(0, (event,), ts))
+
+        return nxt, matches
+
+    def prune(self, partials: List[Partial], watermark_ts: int) -> List[Partial]:
+        """Drop partials that can no longer complete within the window."""
+        if self.within_ms is None:
+            return partials
+        return [
+            p for p in partials if watermark_ts - p.start_ts <= self.within_ms
+        ]

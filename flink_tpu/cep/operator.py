@@ -1,0 +1,82 @@
+"""Keyed CEP operator (ref flink-cep operator/AbstractKeyedCEPPatternOperator
++ KeyedCEPPatternOperator, SURVEY §2.7).
+
+Event-time mode reproduces the reference's behavior: elements are buffered
+per key in a priority queue keyed by timestamp, an event-time timer is
+registered at each element's timestamp, and on watermark advance the buffer
+is drained IN TIMESTAMP ORDER into the NFA (the event-time sort that makes
+CEP deterministic under out-of-order input). Processing-time mode feeds the
+NFA directly in arrival order (ref KeyedCEPPatternOperator.processElement's
+processing-time branch).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Any, Callable, List, Optional
+
+from flink_tpu.cep.nfa import NFA, Partial
+from flink_tpu.datastream.functions import (
+    Collector, ProcessFunction, RuntimeContext,
+)
+from flink_tpu.state.descriptors import ValueStateDescriptor
+
+
+class CEPProcessFunction(ProcessFunction):
+    def __init__(self, pattern, select_fn: Callable, flat: bool,
+                 event_time: bool):
+        self.nfa = NFA(pattern)
+        self.select_fn = select_fn
+        self.flat = flat
+        self.event_time = event_time
+        self._seq = 0  # arrival tiebreak for equal timestamps
+
+    def open(self, ctx: RuntimeContext):
+        # per-key NFA computation state (ref keeping NFA in ValueState)
+        self.partials = ctx.get_state(
+            ValueStateDescriptor("cep-nfa-state", default=None)
+        )
+        # per-key event buffer for event-time ordering (ref the operator's
+        # PriorityQueue<StreamRecord> kept in ValueState)
+        self.buffer = ctx.get_state(
+            ValueStateDescriptor("cep-buffer", default=None)
+        )
+
+    # -- helpers ---------------------------------------------------------
+    def _advance(self, partials: List[Partial], event, ts: int,
+                 out: Collector) -> List[Partial]:
+        partials, matches = self.nfa.process(partials, event, ts)
+        for m in matches:
+            if self.flat:
+                for r in self.select_fn(m):
+                    out.collect(r)
+            else:
+                out.collect(self.select_fn(m))
+        return partials
+
+    # -- ProcessFunction contract ---------------------------------------
+    def process_element(self, value, ctx, out):
+        ts = ctx.timestamp()
+        if not self.event_time:
+            partials = self.partials.value() or []
+            self.partials.update(
+                self._advance(list(partials), value, ts, out)
+            )
+            return
+        buf = self.buffer.value() or []
+        heapq.heappush(buf, (ts, self._seq, value))
+        self._seq += 1
+        self.buffer.update(buf)
+        # fire once the watermark passes this element's timestamp
+        ctx.timer_service().register_event_time_timer(ts)
+
+    def on_timer(self, timestamp, ctx, out):
+        wm = ctx.timer_service().current_watermark()
+        buf = self.buffer.value() or []
+        partials = list(self.partials.value() or [])
+        while buf and buf[0][0] <= wm:
+            ts, _seq, event = heapq.heappop(buf)
+            partials = self._advance(partials, event, ts, out)
+        partials = self.nfa.prune(partials, wm)
+        self.buffer.update(buf)
+        self.partials.update(partials)

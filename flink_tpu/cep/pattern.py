@@ -1,0 +1,80 @@
+"""CEP Pattern API (ref flink-cep pattern/Pattern.java, SURVEY §2.7).
+
+A pattern is a linear sequence of named stages, each with a predicate and a
+contiguity mode relative to its predecessor:
+
+    Pattern.begin("start").where(p1).next("mid").where(p2) \
+           .followed_by("end").where(p3).within(10_000)
+
+- next       = strict contiguity (the very next event must match, else the
+               partial match dies) — ref Pattern.next
+- followed_by = relaxed contiguity (non-matching events are skipped; an
+               "ignore" self-transition keeps the partial alive) —
+               ref Pattern.followedBy
+- where      adds a predicate (ANDed with any existing one — ref
+               Pattern.where's FilterFunction conjunction); or_ ORs one
+- subtype    restricts the stage to an isinstance check — ref Pattern.subtype
+- within     bounds first-to-last event time — ref Pattern.within
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+STRICT = "strict"      # next()
+RELAXED = "relaxed"    # followedBy()
+
+
+@dataclass
+class Stage:
+    name: str
+    contiguity: str            # STRICT for next(), RELAXED for followedBy()
+    predicates: List[Callable] = field(default_factory=list)  # ANDed
+    or_predicates: List[Callable] = field(default_factory=list)
+
+    def matches(self, event) -> bool:
+        base = all(p(event) for p in self.predicates)
+        if self.or_predicates:
+            return base or any(p(event) for p in self.or_predicates)
+        return base
+
+
+class Pattern:
+    def __init__(self):
+        self.stages: List[Stage] = []
+        self.within_ms: Optional[int] = None
+
+    @staticmethod
+    def begin(name: str) -> "Pattern":
+        p = Pattern()
+        p.stages.append(Stage(name, RELAXED))
+        return p
+
+    def _add(self, name: str, contiguity: str) -> "Pattern":
+        if any(s.name == name for s in self.stages):
+            raise ValueError(f"duplicate stage name {name!r}")
+        self.stages.append(Stage(name, contiguity))
+        return self
+
+    def next(self, name: str) -> "Pattern":
+        return self._add(name, STRICT)
+
+    def followed_by(self, name: str) -> "Pattern":
+        return self._add(name, RELAXED)
+
+    def where(self, predicate: Callable) -> "Pattern":
+        self.stages[-1].predicates.append(predicate)
+        return self
+
+    def or_(self, predicate: Callable) -> "Pattern":
+        self.stages[-1].or_predicates.append(predicate)
+        return self
+
+    def subtype(self, cls) -> "Pattern":
+        self.stages[-1].predicates.append(lambda e, _c=cls: isinstance(e, _c))
+        return self
+
+    def within(self, ms: int) -> "Pattern":
+        self.within_ms = int(ms)
+        return self

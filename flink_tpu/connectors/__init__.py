@@ -1,0 +1,18 @@
+"""Connectors (ref flink-streaming-connectors, SURVEY §2.8)."""
+
+from flink_tpu.connectors.files import (
+    PROCESS_CONTINUOUSLY,
+    PROCESS_ONCE,
+    BucketingFileSink,
+    ContinuousFileSource,
+)
+from flink_tpu.connectors.partitioned import (
+    InMemoryPartitionedSource,
+    PartitionedConsumerBase,
+)
+
+__all__ = [
+    "PartitionedConsumerBase", "InMemoryPartitionedSource",
+    "ContinuousFileSource", "BucketingFileSink",
+    "PROCESS_ONCE", "PROCESS_CONTINUOUSLY",
+]

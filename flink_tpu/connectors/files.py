@@ -1,0 +1,161 @@
+"""File connectors: continuous directory monitoring source + exactly-once
+rolling file sink.
+
+ContinuousFileSource — ref ContinuousFileMonitoringFunction +
+ContinuousFileReaderOperator (SURVEY §2.5 sources/sinks): scans a directory,
+emits lines of new/grown files; PROCESS_ONCE ends after draining the initial
+scan, PROCESS_CONTINUOUSLY keeps watching. Replay state = per-file byte
+positions.
+
+BucketingFileSink — ref BucketingSink/RollingSink (SURVEY §2.8): elements
+are appended to an in-progress part file per bucket; each checkpoint records
+the flushed valid length, and restore TRUNCATES files back to the snapshot
+length (the reference's truncate/valid-length mechanism), making the sink
+exactly-once end-to-end under replay. close() finalizes part files by
+renaming away the in-progress suffix.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from flink_tpu.runtime.sinks import Sink
+from flink_tpu.runtime.sources import Source
+
+PROCESS_ONCE = "once"
+PROCESS_CONTINUOUSLY = "continuously"
+
+
+class ContinuousFileSource(Source):
+    def __init__(self, directory: str, pattern: str = "*",
+                 mode: str = PROCESS_ONCE):
+        self.directory = directory
+        self.pattern = pattern
+        self.mode = mode
+        self.positions: Dict[str, int] = {}   # path -> bytes consumed
+
+    def _scan(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.directory, self.pattern)))
+
+    def open(self):
+        # PROCESS_ONCE fixes the file set at job start (ref
+        # FileProcessingMode.PROCESS_ONCE: one monitoring pass)
+        self._initial = set(self._scan()) if self.mode == PROCESS_ONCE else None
+
+    def poll(self, max_records: int):
+        once = self.mode == PROCESS_ONCE
+        lines: List[str] = []
+        paths = self._scan()
+        if once:
+            paths = [p for p in paths if p in self._initial]
+        exhausted = True
+        for path in paths:
+            pos = self.positions.get(path, 0)
+            size = os.path.getsize(path)
+            if pos >= size:
+                continue
+            with open(path, "rb") as f:
+                f.seek(pos)
+                while len(lines) < max_records:
+                    line = f.readline()
+                    if not line:
+                        break
+                    if not line.endswith(b"\n"):
+                        if once:
+                            # bounded input: the unterminated tail is final
+                            pos += len(line)
+                            lines.append(
+                                line.decode("utf-8", errors="replace")
+                            )
+                        # else: a writer may still be appending; re-read
+                        # next poll
+                        break
+                    pos += len(line)
+                    lines.append(line.decode("utf-8", errors="replace")
+                                 .rstrip("\n"))
+                self.positions[path] = pos
+                if pos < os.path.getsize(path):
+                    exhausted = False
+            if len(lines) >= max_records:
+                exhausted = False
+                break
+        if self.mode == PROCESS_CONTINUOUSLY:
+            return lines, False
+        return lines, exhausted
+
+    def snapshot_offsets(self):
+        return dict(self.positions)
+
+    def restore_offsets(self, state):
+        self.positions = dict(state)
+
+
+class BucketingFileSink(Sink):
+    IN_PROGRESS = ".in-progress"
+
+    def __init__(self, base_path: str,
+                 bucketer: Optional[Callable[[Any], str]] = None,
+                 formatter: Callable[[Any], str] = str):
+        self.base_path = base_path
+        self.bucketer = bucketer or (lambda e: "bucket-0")
+        self.formatter = formatter
+        self._files: Dict[str, Any] = {}   # bucket -> open file object
+
+    def _path(self, bucket: str, in_progress: bool = True) -> str:
+        d = os.path.join(self.base_path, bucket)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(
+            d, "part-0" + (self.IN_PROGRESS if in_progress else "")
+        )
+
+    def _file(self, bucket: str):
+        f = self._files.get(bucket)
+        if f is None:
+            f = open(self._path(bucket), "ab")
+            self._files[bucket] = f
+        return f
+
+    def invoke_batch(self, elements):
+        for e in elements:
+            b = self.bucketer(e)
+            self._file(b).write(
+                (self.formatter(e) + "\n").encode("utf-8")
+            )
+
+    # -- exactly-once hooks (driven by the executor's checkpoint cut) ----
+    def snapshot_state(self):
+        lengths = {}
+        for bucket, f in self._files.items():
+            f.flush()
+            os.fsync(f.fileno())
+            lengths[bucket] = f.tell()
+        return {"valid_lengths": lengths}
+
+    def restore_state(self, state):
+        for bucket, f in list(self._files.items()):
+            f.close()
+        self._files.clear()
+        valid = state.get("valid_lengths", {}) if state else {}
+        # truncate any in-progress file back to its checkpointed length;
+        # files unknown to the snapshot are leftovers of the failed attempt
+        for path in glob.glob(
+            os.path.join(self.base_path, "*", "part-0" + self.IN_PROGRESS)
+        ):
+            bucket = os.path.basename(os.path.dirname(path))
+            keep = valid.get(bucket, 0)
+            with open(path, "ab") as f:
+                f.truncate(keep)
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        # finalize EVERY in-progress part under the base path, including
+        # buckets restored from a checkpoint but untouched since recovery —
+        # their truncated contents are checkpoint-valid and must be published
+        for path in glob.glob(
+            os.path.join(self.base_path, "*", "part-0" + self.IN_PROGRESS)
+        ):
+            os.replace(path, path[: -len(self.IN_PROGRESS)])

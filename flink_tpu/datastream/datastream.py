@@ -102,6 +102,18 @@ class DataStream:
         OutputSelector): selector(element) -> iterable of names."""
         return SplitStream(self.env, self.transformation, selector)
 
+    def iterate(self, max_wait_ms: int = 0) -> "IterativeStream":
+        """Streaming iteration head (ref DataStream.iterate): elements loop
+        back through the body via close_with(feedback). Terminates when the
+        upstream ends and the feedback drains."""
+        import collections
+
+        t = sg.IterateTransformation(
+            "iterate", self.transformation,
+            queue=collections.deque(), max_wait_ms=max_wait_ms,
+        )
+        return IterativeStream(self.env, t)
+
     # -- explicit exchange annotations (see PartitionTransformation) -----
     def _partition(self, mode: str) -> "DataStream":
         t = sg.PartitionTransformation(mode, self.transformation, mode=mode)
@@ -186,6 +198,19 @@ class KeyedStream(DataStream):
             extractor=_field_extractor(pos) if pos is not None else (lambda e: e),
         )
         return DataStream(self.env, t)
+
+
+class IterativeStream(DataStream):
+    """Result of DataStream.iterate (ref IterativeStream.closeWith)."""
+
+    def close_with(self, feedback: "DataStream") -> "DataStream":
+        q = self.transformation.queue
+        t = sg.SinkTransformation(
+            "feedback", feedback.transformation,
+            sink=sink_mod.QueueSink(q),
+        )
+        self.env._sinks.append(t)
+        return feedback
 
 
 class SplitStream(DataStream):

@@ -102,6 +102,19 @@ class UnionTransformation(Transformation):
 
 
 @dataclass
+class IterateTransformation(Transformation):
+    """Streaming iteration head (ref IterativeStream / StreamIterationHead +
+    StreamIterationTail connected by BlockingQueueBroker, SURVEY §2.5).
+    `queue` is the in-process feedback channel: close_with attaches a hidden
+    QueueSink branch writing into it, and the head source drains it after
+    the upstream is exhausted. Terminates when the feedback drains (the
+    finite-source adaptation of the reference's iteration-wait timeout)."""
+
+    queue: Any = None  # collections.deque shared with the feedback QueueSink
+    max_wait_ms: int = 0  # accepted for API parity; drain-based termination
+
+
+@dataclass
 class PartitionTransformation(Transformation):
     """Explicit exchange annotation (ref Rebalance/Rescale/Shuffle/Broadcast/
     Global/ForwardPartitioner, SURVEY §2.5). On this architecture the only

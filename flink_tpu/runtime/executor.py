@@ -215,6 +215,13 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
             pipe.source = t.source
         elif isinstance(t, sg.UnionTransformation):
             pipe.source, pipe.ts_transform = _merge_sources(t)
+        elif isinstance(t, sg.IterateTransformation):
+            from flink_tpu.runtime.union import IterationSource
+
+            pipe.source = IterationSource(
+                pipe.source, pipe.pre_chain, t.queue
+            )
+            pipe.pre_chain = []
         elif isinstance(t, sg.TimestampsWatermarksTransformation):
             pipe.ts_transform = t
         elif isinstance(t, sg.KeyByTransformation):
@@ -435,9 +442,15 @@ class LocalExecutor:
                 "wm_current": wm_strategy.current(),
                 "codec_rev_count": n_keys_logged if keep_rev else 0,
                 "size_ms": size_ms, "slide_ms": slide_ms,
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
-            storage.write(next_cid, entries, scalars,
-                          pipe.source.snapshot_offsets(), aux)
+            offsets = pipe.source.snapshot_offsets()
+            storage.write(next_cid, entries, scalars, offsets, aux)
+            # the checkpoint is durable: commit offsets externally + let
+            # sinks finalize (ref notifyCheckpointComplete fan-out)
+            pipe.source.notify_checkpoint_complete(next_cid, offsets)
+            for s in pipe.all_sinks:
+                s.notify_checkpoint_complete(next_cid)
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
@@ -456,6 +469,10 @@ class LocalExecutor:
             setup(aux["origin_ms"], fresh_state=False)
             state = ckpt.restore_window_state(entries, scalars, ctx, spec)
             pipe.source.restore_offsets(offsets)
+            sink_states = aux.get("sink_states")
+            if sink_states:
+                for s, ss in zip(pipe.all_sinks, sink_states):
+                    s.restore_state(ss)
             wm_strategy._current = aux["wm_current"]
             count = aux.get("codec_rev_count", 0)
             if count:
@@ -850,14 +867,19 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt
+            offsets = pipe.source.snapshot_offsets()
             storage.write_generic(next_cid, {
                 "backend": backend.snapshot(),
                 "timers": timers.snapshot(),
-                "offsets": pipe.source.snapshot_offsets(),
+                "offsets": offsets,
                 "wm_current": wm_strategy.current(),
                 "proc_time": timers.current_processing_time,
                 "max_parallelism": env.max_parallelism,
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             })
+            pipe.source.notify_checkpoint_complete(next_cid, offsets)
+            for s in pipe.all_sinks:
+                s.notify_checkpoint_complete(next_cid)
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
@@ -879,6 +901,10 @@ class LocalExecutor:
             timers._event_set.clear(); timers._proc_set.clear()
             timers.restore(payload["timers"])
             pipe.source.restore_offsets(payload["offsets"])
+            sink_states = payload.get("sink_states")
+            if sink_states:
+                for s, ss in zip(pipe.all_sinks, sink_states):
+                    s.restore_state(ss)
             wm_strategy._current = payload["wm_current"]
             timers.current_watermark = payload["wm_current"]
             timers.current_processing_time = payload.get(

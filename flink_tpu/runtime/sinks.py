@@ -24,6 +24,17 @@ class Sink:
     def close(self):
         pass
 
+    # -- exactly-once hooks (ref CheckpointedFunction on sinks, e.g.
+    # BucketingSink.snapshotState / notifyCheckpointComplete) ------------
+    def snapshot_state(self):
+        return None
+
+    def restore_state(self, state):
+        pass
+
+    def notify_checkpoint_complete(self, checkpoint_id: int):
+        pass
+
 
 class CountingSink(Sink):
     """Benchmark sink: O(1) per batch, tallies count and value sum."""
@@ -104,3 +115,14 @@ class WriteAsJsonSink(Sink):
     def close(self):
         if self._f:
             self._f.close()
+
+
+class QueueSink(Sink):
+    """Feedback-edge sink: appends into an iteration head's deque (the role
+    of StreamIterationTail pushing into BlockingQueueBroker)."""
+
+    def __init__(self, queue):
+        self.queue = queue
+
+    def invoke_batch(self, elements):
+        self.queue.extend(elements)

@@ -45,6 +45,11 @@ class Source:
     def restore_offsets(self, state):
         pass
 
+    def notify_checkpoint_complete(self, checkpoint_id: int, offsets=None):
+        """Called once a checkpoint containing `offsets` is durable — the
+        point where offsets may be committed externally (ref
+        FlinkKafkaConsumerBase.notifyCheckpointComplete:384)."""
+
 
 class CollectionSource(Source):
     """from_collection: finite in-memory source with replayable position."""

@@ -24,16 +24,13 @@ import dataclasses
 from collections import namedtuple
 from typing import Any, Callable, List, Optional
 
+from flink_tpu.runtime.sources import Source
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 Tagged = namedtuple("Tagged", ["tag", "value", "ts"])
 Tagged.__new__.__defaults__ = (None,)
 
 MAX_WATERMARK_MS = 2**62
-
-
-def untag(e):
-    return e.value if isinstance(e, Tagged) else e
 
 
 def to_elements(polled):
@@ -149,7 +146,7 @@ class MergedWatermarkStrategy(WatermarkStrategy):
         return self._current
 
 
-class MergedSource:
+class MergedSource(Source):
     """Round-robin merge of N branches behind the single-source contract."""
 
     columnar = False
@@ -183,9 +180,74 @@ class MergedSource:
         return out, end
 
     def snapshot_offsets(self):
-        return [b.source.snapshot_offsets() for b in self.branches]
+        # per-branch (source offsets, watermark) — the watermark must rewind
+        # with the offsets or replayed out-of-order elements would be judged
+        # late against the crash-time watermark and lost
+        return [
+            (
+                b.source.snapshot_offsets(),
+                b.strategy._current if b.strategy else None,
+            )
+            for b in self.branches
+        ]
 
     def restore_offsets(self, state):
-        for b, s in zip(self.branches, state):
-            b.source.restore_offsets(s)
+        for b, (off, wm) in zip(self.branches, state):
+            b.source.restore_offsets(off)
             b.ended = False
+            if b.strategy is not None and wm is not None:
+                b.strategy._current = wm
+
+    def notify_checkpoint_complete(self, checkpoint_id: int, offsets=None):
+        for b, entry in zip(self.branches, offsets or [(None, None)] * len(
+            self.branches
+        )):
+            b.source.notify_checkpoint_complete(checkpoint_id, entry[0])
+
+
+class IterationSource(Source):
+    """Iteration head: upstream elements first, then feedback-queue drain
+    (ref StreamIterationHead's feedback-queue poll loop). Ends only when the
+    upstream is exhausted, the queue is empty, AND this poll returned no
+    elements — so feedback generated while processing the final batch is
+    never lost."""
+
+    columnar = False
+
+    def __init__(self, upstream, pre_ops, queue):
+        self.upstream = upstream
+        self.pre_ops = tuple(pre_ops)
+        self.queue = queue
+        self._up_done = False
+
+    def open(self):
+        self.upstream.open()
+
+    def close(self):
+        self.upstream.close()
+
+    def poll(self, max_records: int):
+        out: List[Any] = []
+        if not self._up_done:
+            polled, end = self.upstream.poll(max_records)
+            self._up_done = end
+            out.extend(_apply_ops(self.pre_ops, to_elements(polled)))
+        while self.queue and len(out) < max(max_records, 1):
+            out.append(self.queue.popleft())
+        end = self._up_done and not self.queue and not out
+        return out, end
+
+    def snapshot_offsets(self):
+        return (self.upstream.snapshot_offsets(), list(self.queue))
+
+    def restore_offsets(self, state):
+        up, pending = state
+        self.upstream.restore_offsets(up)
+        self.queue.clear()
+        self.queue.extend(pending)
+        self._up_done = False
+
+    def notify_checkpoint_complete(self, checkpoint_id: int, offsets=None):
+        self.upstream.notify_checkpoint_complete(
+            checkpoint_id, offsets[0] if offsets is not None else None
+        )

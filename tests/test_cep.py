@@ -1,0 +1,151 @@
+"""CEP golden semantics (ref flink-cep NFATest / CEPITCase patterns)."""
+
+from collections import namedtuple
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.cep import CEP, NFA, Pattern
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink
+
+Event = namedtuple("Event", ["ts", "name", "value"])
+
+
+def _run_nfa(pattern, events):
+    """Drive an NFA directly with (event, ts) pairs; return all matches."""
+    nfa = NFA(pattern)
+    partials, out = nfa.initial_state(), []
+    for e in events:
+        partials, matches = nfa.process(partials, e, e.ts)
+        out.extend(matches)
+    return out
+
+
+def test_strict_contiguity_next():
+    """`a next b`: only immediately-adjacent pairs match."""
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b")
+    )
+    events = [
+        Event(0, "a", 1), Event(1, "b", 2),   # adjacent: match
+        Event(2, "a", 3), Event(3, "x", 0),   # broken by x: no match
+        Event(4, "b", 4),
+    ]
+    out = _run_nfa(p, events)
+    assert len(out) == 1
+    assert (out[0]["a"].value, out[0]["b"].value) == (1, 2)
+
+
+def test_relaxed_contiguity_followed_by_branches():
+    """`a followedBy b` on [a, b1, b2] yields BOTH (a,b1) and (a,b2) —
+    the reference's ignore-transition branching."""
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    events = [Event(0, "a", 1), Event(1, "x", 0), Event(2, "b", 2),
+              Event(3, "b", 3)]
+    out = _run_nfa(p, events)
+    pairs = sorted((m["a"].value, m["b"].value) for m in out)
+    assert pairs == [(1, 2), (1, 3)]
+
+
+def test_three_stage_with_where_conjunction():
+    p = (
+        Pattern.begin("first").where(lambda e: e.name == "a")
+        .followed_by("mid").where(lambda e: e.name == "b")
+        .where(lambda e: e.value > 10)         # ANDed predicate
+        .followed_by("last").where(lambda e: e.name == "c")
+    )
+    events = [
+        Event(0, "a", 1), Event(1, "b", 5),    # mid rejected (value <= 10)
+        Event(2, "b", 20), Event(3, "c", 7),
+    ]
+    out = _run_nfa(p, events)
+    assert len(out) == 1
+    assert out[0]["mid"].value == 20
+
+
+def test_or_predicate():
+    p = Pattern.begin("x").where(lambda e: e.name == "a").or_(
+        lambda e: e.value > 100
+    )
+    events = [Event(0, "a", 1), Event(1, "z", 500), Event(2, "z", 3)]
+    out = _run_nfa(p, events)
+    assert len(out) == 2
+
+
+def test_within_prunes_expired_partials():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+        .within(10)
+    )
+    events = [Event(0, "a", 1), Event(100, "b", 2),   # expired
+              Event(101, "a", 3), Event(105, "b", 4)]  # in window
+    out = _run_nfa(p, events)
+    assert len(out) == 1
+    assert out[0]["a"].value == 3
+
+
+def test_cep_end_to_end_event_time_out_of_order():
+    """Keyed CEP through the DataStream API with out-of-order input:
+    the event-time buffer must sort by timestamp before the NFA sees
+    elements (ref AbstractKeyedCEPPatternOperator watermark drain)."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 16
+    sink = CollectSink()
+    # per key "k1": warn(ts=1) -> crit(ts=2); arrival order scrambled
+    events = [
+        Event(2, "crit", 1), Event(1, "warn", 1),          # k1 out of order
+        Event(5, "warn", 2), Event(7, "ok", 2), Event(9, "crit", 2),
+        Event(20, "flush", 99),
+    ]
+    pattern = (
+        Pattern.begin("w").where(lambda e: e.name == "warn")
+        .followed_by("c").where(lambda e: e.name == "crit")
+        .within(10)
+    )
+    stream = (
+        env.from_collection(events)
+        .assign_timestamps_and_watermarks(lambda e: e.ts)
+        .key_by(lambda e: e.value)
+    )
+    CEP.pattern(stream, pattern).select(
+        lambda m: (m["w"].value, m["w"].ts, m["c"].ts)
+    ).add_sink(sink)
+    env.execute("cep")
+    assert sorted(sink.results) == [(1, 1, 2), (2, 5, 9)]
+
+
+def test_cep_processing_time_arrival_order():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    sink = CollectSink()
+    events = [Event(0, "a", 1), Event(0, "b", 1), Event(0, "a", 2)]
+    pattern = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b")
+    )
+    stream = env.from_collection(events).key_by(lambda e: e.value)
+    CEP.pattern(stream, pattern).select(
+        lambda m: m["a"].value
+    ).add_sink(sink)
+    env.execute("cep-proc")
+    assert sink.results == [1]
+
+
+def test_cep_non_keyed_stream():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    sink = CollectSink()
+    pattern = (
+        Pattern.begin("lo").where(lambda e: e < 10)
+        .followed_by("hi").where(lambda e: e > 100)
+    )
+    CEP.pattern(
+        env.from_collection([5, 50, 200]), pattern
+    ).select(lambda m: (m["lo"], m["hi"])).add_sink(sink)
+    env.execute("cep-global")
+    assert sink.results == [(5, 200)]

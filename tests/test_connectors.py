@@ -1,0 +1,253 @@
+"""Connector contracts: partitioned consumer (Kafka pattern), continuous
+file source, bucketing file sink (ref SURVEY §2.8 + the reference's
+Kafka/BucketingSink exactly-once tests)."""
+
+import os
+
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.connectors import (
+    PROCESS_CONTINUOUSLY,
+    PROCESS_ONCE,
+    BucketingFileSink,
+    ContinuousFileSource,
+    InMemoryPartitionedSource,
+)
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink
+
+
+def test_partitioned_consumer_reads_all_partitions():
+    src = InMemoryPartitionedSource({
+        0: [("k0", 1.0)] * 3,
+        1: [("k1", 1.0)] * 5,
+        2: [("k2", 1.0)] * 2,
+    })
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    sink = CollectSink()
+    env.add_source(src).add_sink(sink)
+    env.execute("partitions")
+    assert len(sink.results) == 10
+    assert {k for k, _ in sink.results} == {"k0", "k1", "k2"}
+
+
+def test_offsets_committed_only_on_checkpoint_complete(tmp_path):
+    """The FlinkKafkaConsumerBase rule: external commits trail checkpoints
+    (notifyCheckpointComplete), never the live read position."""
+    commits = []
+
+    class Recording(InMemoryPartitionedSource):
+        def commit_offsets(self, offsets, cid):
+            super().commit_offsets(offsets, cid)
+            commits.append((cid, dict(offsets)))
+
+    src = Recording({0: [("k", i, 1.0) for i in range(40)]})
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 8
+    env.enable_checkpointing(2, str(tmp_path / "ckpt"))
+    sink = CollectSink()
+    (
+        env.add_source(src)
+        .assign_timestamps_and_watermarks(lambda e: e[1])
+        .key_by(lambda e: e[0])
+        .time_window(10)
+        .sum(lambda e: e[2])
+        .add_sink(sink)
+    )
+    env.execute("kafka-commit")
+    assert commits, "no offsets were committed"
+    cids = [c for c, _ in commits]
+    assert cids == sorted(cids)
+    # each commit's offsets match a consistent snapshot (multiple of batch)
+    for _, offs in commits:
+        assert offs[0] <= 40
+    assert src.committed == commits[-1][1]
+
+
+def test_partitioned_exactly_once_under_restart(tmp_path):
+    """Failure mid-stream + fixed-delay restart: replay from snapshot
+    offsets converges to the no-failure aggregate (ref
+    StateCheckpointedITCase pattern)."""
+    from flink_tpu.core.config import Configuration
+
+    n = 60
+    src = InMemoryPartitionedSource({
+        0: [(f"k{i % 5}", i, 1.0) for i in range(0, n, 2)],
+        1: [(f"k{i % 5}", i, 1.0) for i in range(1, n, 2)],
+    })
+    cfg = Configuration()
+    cfg.set("restart-strategy", "fixed-delay")
+    cfg.set("restart-strategy.fixed-delay.attempts", 3)
+    env = StreamExecutionEnvironment(cfg)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 8
+    env.enable_checkpointing(2, str(tmp_path / "ck"))
+    sink = CollectSink()
+
+    state = {"count": 0, "failed": False}
+
+    def poison(e):
+        state["count"] += 1
+        if state["count"] == 30 and not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("injected failure")
+        return e
+
+    (
+        env.add_source(src)
+        .map(poison)
+        .assign_timestamps_and_watermarks(
+            lambda e: e[1],
+        )
+        .key_by(lambda e: e[0])
+        .time_window(1000)   # one big window: totals visible at flush
+        .sum(lambda e: e[2])
+        .add_sink(sink)
+    )
+    env.execute("exactly-once")
+    assert env.last_job.metrics.restarts == 1
+    totals = {}
+    for r in sink.results:
+        totals[r.key] = totals.get(r.key, 0) + r.value
+    assert totals == {f"k{i}": 12.0 for i in range(5)}
+
+
+def test_continuous_file_source_process_once(tmp_path):
+    for i in range(3):
+        (tmp_path / f"f{i}.txt").write_text(f"line-{i}-a\nline-{i}-b\n")
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    sink = CollectSink()
+    env.add_source(
+        ContinuousFileSource(str(tmp_path), "*.txt", PROCESS_ONCE)
+    ).add_sink(sink)
+    env.execute("files")
+    assert sorted(sink.results) == sorted(
+        f"line-{i}-{s}" for i in range(3) for s in "ab"
+    )
+
+
+def test_continuous_file_source_picks_up_appends(tmp_path):
+    p = tmp_path / "grow.txt"
+    p.write_text("a\n")
+    src = ContinuousFileSource(str(tmp_path), "*.txt", PROCESS_CONTINUOUSLY)
+    src.open()
+    lines, end = src.poll(10)
+    assert lines == ["a"] and not end
+    with open(p, "a") as f:
+        f.write("b\npartial")          # unterminated line must wait
+    lines, end = src.poll(10)
+    assert lines == ["b"] and not end
+    with open(p, "a") as f:
+        f.write("-done\n")
+    lines, _ = src.poll(10)
+    assert lines == ["partial-done"]
+    # replay: restoring positions re-reads nothing
+    snap = src.snapshot_offsets()
+    src2 = ContinuousFileSource(str(tmp_path), "*.txt", PROCESS_CONTINUOUSLY)
+    src2.open()
+    src2.restore_offsets(snap)
+    lines, _ = src2.poll(10)
+    assert lines == []
+
+
+def test_bucketing_sink_truncates_on_restore(tmp_path):
+    base = str(tmp_path / "out")
+    sink = BucketingFileSink(base, bucketer=lambda e: e[0])
+    sink.open()
+    sink.invoke_batch([("b1", "x"), ("b1", "y")])
+    snap = sink.snapshot_state()
+    sink.invoke_batch([("b1", "lost-after-failure")])
+    # crash: a new sink instance restores the snapshot
+    sink2 = BucketingFileSink(base, bucketer=lambda e: e[0])
+    sink2.restore_state(snap)
+    sink2.open()
+    sink2.invoke_batch([("b1", "z")])
+    sink2.close()
+    final = os.path.join(base, "b1", "part-0")
+    assert os.path.exists(final)
+    with open(final) as f:
+        lines = f.read().splitlines()
+    assert lines == ["('b1', 'x')", "('b1', 'y')", "('b1', 'z')"]
+
+
+def test_checkpointing_with_merged_source(tmp_path):
+    """Union/join sources must survive the checkpoint notify fan-out."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 4
+    env.enable_checkpointing(2, str(tmp_path / "ck"))
+    sink = CollectSink()
+    a = env.from_collection([(t * 10, "x", 1.0) for t in range(20)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    b = env.from_collection([(t * 10 + 5, "x", 1.0) for t in range(20)]) \
+        .assign_timestamps_and_watermarks(lambda e: e[0])
+    (
+        a.co_group(b)
+        .where(lambda e: e[1]).equal_to(lambda e: e[1])
+        .time_window(100)
+        .apply(lambda ls, rs: [len(ls) + len(rs)])
+        .add_sink(sink)
+    )
+    env.execute("ckpt-merged")
+    assert sum(sink.results) == 40
+
+
+def test_process_once_unterminated_tail(tmp_path):
+    (tmp_path / "f.txt").write_text("a\nb")       # no trailing newline
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    sink = CollectSink()
+    env.add_source(
+        ContinuousFileSource(str(tmp_path), "*.txt", PROCESS_ONCE)
+    ).add_sink(sink)
+    env.execute("tail")                           # must terminate
+    assert sorted(sink.results) == ["a", "b"]
+
+
+def test_process_once_ignores_files_created_after_start(tmp_path):
+    (tmp_path / "f0.txt").write_text("x\n")
+    src = ContinuousFileSource(str(tmp_path), "*.txt", PROCESS_ONCE)
+    src.open()
+    (tmp_path / "f1.txt").write_text("late\n")
+    lines, end = src.poll(10)
+    assert lines == ["x"] and end
+
+
+def test_bucketing_close_finalizes_restored_untouched_buckets(tmp_path):
+    base = str(tmp_path / "out")
+    sink = BucketingFileSink(base, bucketer=lambda e: e[0],
+                             formatter=lambda e: e[1])
+    sink.open()
+    sink.invoke_batch([("b1", "x"), ("b2", "y")])
+    snap = sink.snapshot_state()
+    # crash; recovery replays only into b1
+    sink2 = BucketingFileSink(base, bucketer=lambda e: e[0],
+                              formatter=lambda e: e[1])
+    sink2.restore_state(snap)
+    sink2.open()
+    sink2.invoke_batch([("b1", "z")])
+    sink2.close()
+    with open(os.path.join(base, "b2", "part-0")) as f:
+        assert f.read().splitlines() == ["y"]
+
+
+def test_bucketing_sink_end_to_end(tmp_path):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    base = str(tmp_path / "sink")
+    (
+        env.from_collection(list(range(10)))
+        .map(lambda x: ("even" if x % 2 == 0 else "odd", x))
+        .add_sink(BucketingFileSink(
+            base, bucketer=lambda e: e[0], formatter=lambda e: str(e[1])
+        ))
+    )
+    env.execute("bucketing")
+    with open(os.path.join(base, "even", "part-0")) as f:
+        assert f.read().splitlines() == ["0", "2", "4", "6", "8"]
+    with open(os.path.join(base, "odd", "part-0")) as f:
+        assert f.read().splitlines() == ["1", "3", "5", "7", "9"]

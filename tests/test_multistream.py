@@ -261,6 +261,21 @@ def test_skewed_inputs_use_min_watermark():
     assert sorted(sink.results) == [(0, 6), (1, 0)]
 
 
+def test_iteration_feedback_loop():
+    """Streaming iteration (ref IterativeStream / IterateExample): decrement
+    until zero; non-zero values loop back through the body."""
+    env = _env(batch=4)
+    sink = CollectSink()
+    it = env.from_collection([3, 1, 4]).iterate()
+    body = it.map(lambda x: x - 1)
+    it.close_with(body.filter(lambda x: x > 0))
+    body.filter(lambda x: x <= 0).add_sink(sink)
+    env.execute("iterate")
+    assert sink.results == [0, 0, 0]
+    # 3+1+4 = 8 trips through the body in total
+    assert env.last_job.metrics.records_in == 8
+
+
 def test_union_type_mismatch_divergent_spine_rejected():
     env = _env()
     s1, s2 = CollectSink(), CollectSink()
