@@ -29,7 +29,6 @@ class CEPProcessFunction(ProcessFunction):
         self.select_fn = select_fn
         self.flat = flat
         self.event_time = event_time
-        self._seq = 0  # arrival tiebreak for equal timestamps
 
     def open(self, ctx: RuntimeContext):
         # per-key NFA computation state (ref keeping NFA in ValueState)
@@ -63,20 +62,24 @@ class CEPProcessFunction(ProcessFunction):
                 self._advance(list(partials), value, ts, out)
             )
             return
-        buf = self.buffer.value() or []
-        heapq.heappush(buf, (ts, self._seq, value))
-        self._seq += 1
-        self.buffer.update(buf)
+        # arrival-order tiebreak lives IN the keyed state so it survives
+        # restore (a reset counter would collide on (ts, seq) and make
+        # heapq compare raw event payloads)
+        state = self.buffer.value() or {"seq": 0, "heap": []}
+        heapq.heappush(state["heap"], (ts, state["seq"], value))
+        state["seq"] += 1
+        self.buffer.update(state)
         # fire once the watermark passes this element's timestamp
         ctx.timer_service().register_event_time_timer(ts)
 
     def on_timer(self, timestamp, ctx, out):
         wm = ctx.timer_service().current_watermark()
-        buf = self.buffer.value() or []
+        state = self.buffer.value() or {"seq": 0, "heap": []}
+        buf = state["heap"]
         partials = list(self.partials.value() or [])
         while buf and buf[0][0] <= wm:
             ts, _seq, event = heapq.heappop(buf)
             partials = self._advance(partials, event, ts, out)
         partials = self.nfa.prune(partials, wm)
-        self.buffer.update(buf)
+        self.buffer.update(state)
         self.partials.update(partials)

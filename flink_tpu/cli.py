@@ -1,0 +1,84 @@
+"""CLI — run/list/info/cancel/stop/savepoint (ref CliFrontend.java:109,
+actions at :114-119, SURVEY §2.9).
+
+    python -m flink_tpu.cli run [-s SAVEPOINT] script.py [args...]
+    python -m flink_tpu.cli list          -m HOST:PORT
+    python -m flink_tpu.cli info  JOB_ID  -m HOST:PORT
+    python -m flink_tpu.cli cancel JOB_ID -m HOST:PORT
+    python -m flink_tpu.cli stop   JOB_ID -m HOST:PORT
+    python -m flink_tpu.cli savepoint JOB_ID TARGET_DIR -m HOST:PORT
+
+`run` executes the user program in-process (PackagedProgram role): the
+script builds pipelines with StreamExecutionEnvironment and calls execute();
+FLINK_TPU_SAVEPOINT is exported for `-s` so programs can pass it as
+execute(restore_from=...) — or use cli.restore_path() to read it.
+The other actions talk to a MiniCluster control server (JobManager RPC
+analog) started by a long-running program via
+MiniCluster.start_control_server().
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+from flink_tpu.runtime.cluster import control_request
+
+
+def restore_path():
+    """The -s/--fromSavepoint path for the current `run`, if any."""
+    return os.environ.get("FLINK_TPU_SAVEPOINT") or None
+
+
+def _addr(spec: str):
+    host, _, port = spec.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="flink-tpu")
+    sub = ap.add_subparsers(dest="action", required=True)
+
+    p_run = sub.add_parser("run", help="execute a job program")
+    p_run.add_argument("-s", "--fromSavepoint", default=None)
+    p_run.add_argument("script")
+    p_run.add_argument("args", nargs=argparse.REMAINDER)
+
+    for name in ("list",):
+        p = sub.add_parser(name)
+        p.add_argument("-m", "--jobmanager", default="127.0.0.1:6123")
+    for name in ("info", "cancel", "stop"):
+        p = sub.add_parser(name)
+        p.add_argument("job_id")
+        p.add_argument("-m", "--jobmanager", default="127.0.0.1:6123")
+    p_sp = sub.add_parser("savepoint")
+    p_sp.add_argument("job_id")
+    p_sp.add_argument("target")
+    p_sp.add_argument("-m", "--jobmanager", default="127.0.0.1:6123")
+
+    ns = ap.parse_args(argv)
+
+    if ns.action == "run":
+        if ns.fromSavepoint:
+            os.environ["FLINK_TPU_SAVEPOINT"] = ns.fromSavepoint
+        sys.argv = [ns.script] + ns.args
+        runpy.run_path(ns.script, run_name="__main__")
+        return 0
+
+    host, port = _addr(ns.jobmanager)
+    if ns.action == "list":
+        req = {"action": "list"}
+    elif ns.action == "savepoint":
+        req = {"action": "savepoint", "job_id": ns.job_id, "path": ns.target}
+    else:
+        req = {"action": ns.action, "job_id": ns.job_id}
+    resp = control_request(host, port, req)
+    print(json.dumps(resp, indent=2, default=str))
+    return 0 if resp.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,14 +35,18 @@ class ContinuousFileSource(Source):
         self.pattern = pattern
         self.mode = mode
         self.positions: Dict[str, int] = {}   # path -> bytes consumed
+        self._initial: Optional[set] = None
 
     def _scan(self) -> List[str]:
         return sorted(glob.glob(os.path.join(self.directory, self.pattern)))
 
     def open(self):
         # PROCESS_ONCE fixes the file set at job start (ref
-        # FileProcessingMode.PROCESS_ONCE: one monitoring pass)
-        self._initial = set(self._scan()) if self.mode == PROCESS_ONCE else None
+        # FileProcessingMode.PROCESS_ONCE: one monitoring pass); a restored
+        # source keeps the ORIGINAL attempt's file set for deterministic
+        # replay (see snapshot_offsets)
+        if self.mode == PROCESS_ONCE and self._initial is None:
+            self._initial = set(self._scan())
 
     def poll(self, max_records: int):
         once = self.mode == PROCESS_ONCE
@@ -53,10 +57,17 @@ class ContinuousFileSource(Source):
         exhausted = True
         for path in paths:
             pos = self.positions.get(path, 0)
-            size = os.path.getsize(path)
+            try:
+                size = os.path.getsize(path)
+            except FileNotFoundError:
+                continue  # deleted between scan and read (e.g. log rotation)
             if pos >= size:
                 continue
-            with open(path, "rb") as f:
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError:
+                continue
+            with f:
                 f.seek(pos)
                 while len(lines) < max_records:
                     line = f.readline()
@@ -86,10 +97,20 @@ class ContinuousFileSource(Source):
         return lines, exhausted
 
     def snapshot_offsets(self):
-        return dict(self.positions)
+        return {
+            "positions": dict(self.positions),
+            "initial": sorted(self._initial) if self._initial is not None
+            else None,
+        }
 
     def restore_offsets(self, state):
-        self.positions = dict(state)
+        if isinstance(state, dict) and "positions" in state:
+            self.positions = dict(state["positions"])
+            self._initial = (
+                set(state["initial"]) if state["initial"] is not None else None
+            )
+        else:  # pre-initial-set snapshots (positions only)
+            self.positions = dict(state)
 
 
 class BucketingFileSink(Sink):
@@ -139,11 +160,13 @@ class BucketingFileSink(Sink):
         self._files.clear()
         valid = state.get("valid_lengths", {}) if state else {}
         # truncate any in-progress file back to its checkpointed length;
-        # files unknown to the snapshot are leftovers of the failed attempt
+        # files unknown to the snapshot are leftovers of the failed attempt.
+        # recursive glob: bucketers may return nested paths (date/hour)
         for path in glob.glob(
-            os.path.join(self.base_path, "*", "part-0" + self.IN_PROGRESS)
+            os.path.join(self.base_path, "**", "part-0" + self.IN_PROGRESS),
+            recursive=True,
         ):
-            bucket = os.path.basename(os.path.dirname(path))
+            bucket = os.path.relpath(os.path.dirname(path), self.base_path)
             keep = valid.get(bucket, 0)
             with open(path, "ab") as f:
                 f.truncate(keep)
@@ -156,6 +179,7 @@ class BucketingFileSink(Sink):
         # buckets restored from a checkpoint but untouched since recovery —
         # their truncated contents are checkpoint-valid and must be published
         for path in glob.glob(
-            os.path.join(self.base_path, "*", "part-0" + self.IN_PROGRESS)
+            os.path.join(self.base_path, "**", "part-0" + self.IN_PROGRESS),
+            recursive=True,
         ):
             os.replace(path, path[: -len(self.IN_PROGRESS)])

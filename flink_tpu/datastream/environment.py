@@ -34,6 +34,10 @@ class StreamExecutionEnvironment:
         )
         self._sinks: List[sg.SinkTransformation] = []
         self.last_job = None  # JobHandle of the last execute()
+        from flink_tpu.metrics import MetricRegistry
+
+        self.metric_registry = MetricRegistry()
+        self._control = None  # cluster.JobControl when cluster-submitted
 
     # -- configuration (fluent, reference-shaped) ------------------------
     @staticmethod

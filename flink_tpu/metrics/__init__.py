@@ -1,0 +1,20 @@
+"""Metrics (ref flink-metrics-core + runtime metric groups, SURVEY §5)."""
+
+from flink_tpu.metrics.core import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonFileReporter,
+    LoggingReporter,
+    Meter,
+    MetricGroup,
+    MetricRegistry,
+    Reporter,
+    ScheduledReporter,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Meter", "MetricGroup",
+    "MetricRegistry", "Reporter", "JsonFileReporter", "LoggingReporter",
+    "ScheduledReporter",
+]

@@ -1,0 +1,245 @@
+"""Metric types + hierarchical groups + registry/reporter SPI.
+
+Mirrors flink-metrics-core (SURVEY §5 Metrics): Counter / Gauge /
+Histogram / Meter, hierarchical MetricGroups (job → task → operator, ref
+TaskMetricGroup/OperatorMetricGroup scope chain), and a MetricRegistry
+fanning out to pluggable reporters (ref MetricRegistry.java:51 + the
+flink-metrics-* reporter modules). Reporters here are pull-based: the
+registry snapshots on demand (the metric-query-service role) and
+ScheduledReporter drives periodic pushes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+
+    def inc(self, n: int = 1):
+        self._v += n
+
+    def dec(self, n: int = 1):
+        self._v -= n
+
+    def get_count(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+
+    def get_value(self):
+        return self._fn()
+
+
+class Histogram:
+    """Sliding-window histogram with percentile snapshots (ref
+    DescriptiveStatisticsHistogram role)."""
+
+    def __init__(self, window: int = 1024):
+        self._values = deque(maxlen=window)
+
+    def update(self, v: float):
+        self._values.append(float(v))
+
+    def get_count(self) -> int:
+        return len(self._values)
+
+    def quantile(self, q: float) -> float:
+        vs = sorted(self._values)
+        if not vs:
+            return float("nan")
+        idx = min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))
+        return vs[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        vs = list(self._values)
+        if not vs:
+            return {"count": 0}
+        return {
+            "count": len(vs),
+            "min": min(vs),
+            "max": max(vs),
+            "mean": sum(vs) / len(vs),
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+class Meter:
+    """Events-per-second over a sliding interval (ref MeterView)."""
+
+    def __init__(self, interval_s: float = 60.0):
+        self.interval_s = interval_s
+        self._events = deque()
+        self._count = 0
+
+    def mark_event(self, n: int = 1):
+        now = time.monotonic()
+        self._events.append((now, n))
+        self._count += n
+        self._evict(now)
+
+    def _evict(self, now):
+        while self._events and self._events[0][0] < now - self.interval_s:
+            self._events.popleft()
+
+    def get_rate(self) -> float:
+        now = time.monotonic()
+        self._evict(now)
+        total = sum(n for _, n in self._events)
+        span = (
+            now - self._events[0][0] if self._events else self.interval_s
+        ) or 1e-9
+        return total / span
+
+    def get_count(self) -> int:
+        return self._count
+
+
+class MetricGroup:
+    """Hierarchical scope (ref AbstractMetricGroup): metrics register into
+    the root registry with a dotted scope identifier."""
+
+    def __init__(self, registry: "MetricRegistry", scope: List[str]):
+        self._registry = registry
+        self._scope = scope
+
+    def add_group(self, name: str) -> "MetricGroup":
+        return MetricGroup(self._registry, self._scope + [str(name)])
+
+    def scope_string(self, name: str = "") -> str:
+        return ".".join(self._scope + ([name] if name else []))
+
+    def _register(self, name: str, metric):
+        self._registry.register(self.scope_string(name), metric)
+        return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._register(name, Counter())
+
+    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+        return self._register(name, Gauge(fn))
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        return self._register(name, Histogram(window))
+
+    def meter(self, name: str, interval_s: float = 60.0) -> Meter:
+        return self._register(name, Meter(interval_s))
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, Any] = {}
+        self._reporters: List["Reporter"] = []
+        self._lock = threading.Lock()
+
+    def register(self, scope: str, metric):
+        with self._lock:
+            self._metrics[scope] = metric
+        for r in self._reporters:
+            r.notify_added(scope, metric)
+
+    def unregister(self, scope: str):
+        with self._lock:
+            self._metrics.pop(scope, None)
+
+    def add_reporter(self, reporter: "Reporter"):
+        self._reporters.append(reporter)
+        reporter.open(self)
+
+    def close(self):
+        for r in self._reporters:
+            r.close()
+
+    def group(self, *scope: str) -> MetricGroup:
+        return MetricGroup(self, list(scope))
+
+    def snapshot(self, prefix: str = "") -> Dict[str, Any]:
+        """Point-in-time values of every registered metric (the metric
+        query service consumed by the web monitor, ref MetricDump)."""
+        with self._lock:
+            items = [
+                (k, m) for k, m in self._metrics.items()
+                if k.startswith(prefix)
+            ]
+        out = {}
+        for k, m in items:
+            if isinstance(m, Counter):
+                out[k] = m.get_count()
+            elif isinstance(m, Gauge):
+                try:
+                    out[k] = m.get_value()
+                except Exception as e:  # a broken gauge must not kill reports
+                    out[k] = f"<error: {e}>"
+            elif isinstance(m, Histogram):
+                out[k] = m.snapshot()
+            elif isinstance(m, Meter):
+                out[k] = {"rate": m.get_rate(), "count": m.get_count()}
+            else:
+                out[k] = repr(m)
+        return out
+
+
+class Reporter:
+    """Reporter SPI (ref MetricReporter)."""
+
+    def open(self, registry: MetricRegistry):
+        self.registry = registry
+
+    def notify_added(self, scope: str, metric):
+        pass
+
+    def report(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class JsonFileReporter(Reporter):
+    """Dumps the full snapshot as one JSON object per report() call."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def report(self):
+        with open(self.path, "w") as f:
+            json.dump(self.registry.snapshot(), f, indent=2, default=str)
+
+
+class LoggingReporter(Reporter):
+    def __init__(self, log_fn: Callable[[str], None] = print):
+        self.log_fn = log_fn
+
+    def report(self):
+        for k, v in sorted(self.registry.snapshot().items()):
+            self.log_fn(f"{k} = {v}")
+
+
+class ScheduledReporter(threading.Thread):
+    """Drives reporter.report() every interval (ref the registry's reporter
+    scheduling executor)."""
+
+    def __init__(self, reporter: Reporter, interval_s: float):
+        super().__init__(daemon=True)
+        self.reporter = reporter
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval_s):
+            self.reporter.report()
+
+    def stop(self):
+        self._stop.set()

@@ -36,6 +36,7 @@ from flink_tpu.runtime.step import (
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
+from flink_tpu.runtime.cluster import JobCancelledException
 from flink_tpu.runtime.union import to_elements
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
@@ -268,6 +269,51 @@ def _apply_chain(chain, elements):
 class LocalExecutor:
     def __init__(self, env):
         self.env = env
+        # set per-stage once a snapshotting path exists (savepoint target)
+        self._savepoint_writer = None
+        self._job_group = None
+        self._cycle_hist = None
+        self._last_cycle_t = None
+
+    def _poll_control(self):
+        """Observe cancel/savepoint requests at the micro-batch boundary
+        (the reference's Task cancellation + barrier injection cadence);
+        also records the cycle-time histogram (back-pressure signal)."""
+        if self._cycle_hist is not None:
+            now = time.perf_counter()
+            if self._last_cycle_t is not None:
+                self._cycle_hist.update((now - self._last_cycle_t) * 1e3)
+            self._last_cycle_t = now
+        ctl = getattr(self.env, "_control", None)
+        if ctl is None:
+            return
+        if ctl.cancel_event.is_set():
+            req = ctl.take_savepoint_request()
+            if req is not None:
+                req.set_error(RuntimeError("job canceled"))
+            raise JobCancelledException("job canceled")
+        req = ctl.take_savepoint_request()
+        if req is not None:
+            if self._savepoint_writer is None:
+                req.set_error(NotImplementedError(
+                    "savepoints are not supported for this stage type"
+                ))
+            else:
+                try:
+                    req.set_result(self._savepoint_writer(req.path))
+                except Exception as e:
+                    req.set_error(e)
+
+    def _init_metrics(self, job_name: str, metrics: JobMetrics):
+        registry = getattr(self.env, "metric_registry", None)
+        if registry is None:
+            return
+        grp = registry.group("jobs", job_name)
+        self._job_group = grp
+        for fname in ("records_in", "records_out", "fires", "steps",
+                      "dropped_late", "dropped_capacity", "restarts"):
+            grp.gauge(fname, lambda m=metrics, n=fname: getattr(m, n))
+        self._cycle_hist = grp.histogram("cycle_time_ms")
 
     def _restart_strategy(self) -> ckpt.RestartStrategy:
         cfg = self.env.config
@@ -290,6 +336,7 @@ class LocalExecutor:
 
         pipe = _translate(sink_transforms)
         metrics = JobMetrics()
+        self._init_metrics(job_name, metrics)
         t_start = time.perf_counter()
         for s in pipe.all_sinks:
             s.open()
@@ -338,6 +385,7 @@ class LocalExecutor:
     def _run_stateless(self, pipe: _Pipeline, metrics: JobMetrics):
         B = self.env.batch_size
         while True:
+            self._poll_control()
             polled, end = pipe.source.poll(B)
             elements = self._to_elements(polled)
             metrics.records_in += len(elements)
@@ -483,6 +531,36 @@ class LocalExecutor:
             n_keys_logged = len(codec._rev) if same_dir else 0
             steps_at_ckpt = metrics.steps
 
+        def write_savepoint(path: str) -> str:
+            """Manually-triggered versioned snapshot into its own directory
+            (ref SavepointStore + CliFrontend ACTION_SAVEPOINT). Unlike
+            periodic checkpoints, the full key map is embedded so the
+            savepoint directory is self-contained."""
+            if td is None:
+                raise RuntimeError("no state to savepoint yet")
+            sp = ckpt.CheckpointStorage(path, retain=10**9)
+            while True:
+                fr = self._empty_step(run_step, B, red,
+                                      int(wm_strategy.current()))
+                emit_fires(fr)
+                if int(np.asarray(fr.n_fires).sum()) == 0:
+                    break
+            entries, scalars = ckpt.snapshot_window_state(state, win)
+            if keep_rev:
+                sp.append_keymap(list(codec._rev.items()))
+            aux = {
+                "origin_ms": td.origin_ms,
+                "wm_current": wm_strategy.current(),
+                "codec_rev_count": len(codec._rev) if keep_rev else 0,
+                "size_ms": size_ms, "slide_ms": slide_ms,
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+            }
+            cid = (sp.latest() or 0) + 1
+            return sp.write(cid, entries, scalars,
+                            pipe.source.snapshot_offsets(), aux)
+
+        self._savepoint_writer = write_savepoint
+
         def run_step(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
             wm_ticks = (
@@ -558,6 +636,7 @@ class LocalExecutor:
 
         def poll_cycle():
             nonlocal td
+            self._poll_control()
             polled, end = pipe.source.poll(B)
             now_ms = int(time.time() * 1000)
             hi = lo = ticks = values = None
@@ -677,6 +756,8 @@ class LocalExecutor:
             try:
                 batch_loop()
                 break
+            except JobCancelledException:
+                raise
             except Exception:
                 can = (
                     storage is not None
@@ -849,7 +930,13 @@ class LocalExecutor:
             # operators needing namespaced timers/state (GenericWindowOperator)
             fn.bind_internals(backend, timers)
         if isinstance(fn, RichFunction):
-            fn.open(RuntimeContext(backend))
+            fn.open(RuntimeContext(
+                backend,
+                metrics_group=(
+                    self._job_group.add_group("user")
+                    if self._job_group is not None else None
+                ),
+            ))
 
         wm_strategy = (
             pipe.ts_transform.strategy if pipe.ts_transform is not None
@@ -912,6 +999,21 @@ class LocalExecutor:
             )
             steps_at_ckpt = metrics.steps
 
+        def write_savepoint(path: str) -> str:
+            sp = ckpt.CheckpointStorage(path, retain=10**9)
+            cid = (sp.latest() or 0) + 1
+            return sp.write_generic(cid, {
+                "backend": backend.snapshot(),
+                "timers": timers.snapshot(),
+                "offsets": pipe.source.snapshot_offsets(),
+                "wm_current": wm_strategy.current(),
+                "proc_time": timers.current_processing_time,
+                "max_parallelism": env.max_parallelism,
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+            })
+
+        self._savepoint_writer = write_savepoint
+
         def emit():
             out = collector.drain()
             if not out:
@@ -921,6 +1023,7 @@ class LocalExecutor:
         def batch_loop():
             end = False
             while not end:
+                self._poll_control()
                 polled, end = pipe.source.poll(env.batch_size)
                 now_ms = int(time.time() * 1000)
                 # sync the clock BEFORE elements see it: triggers compute
@@ -971,6 +1074,8 @@ class LocalExecutor:
             try:
                 batch_loop()
                 break
+            except JobCancelledException:
+                raise
             except Exception:
                 can = (
                     storage is not None
@@ -1019,6 +1124,7 @@ class LocalExecutor:
 
         end = False
         while not end:
+            self._poll_control()
             polled, end = pipe.source.poll(B)
             prepped = self._prep_keyed_batch(pipe, polled, roll.extractor)
             if prepped is None:
@@ -1145,6 +1251,7 @@ class LocalExecutor:
 
         end = False
         while not end:
+            self._poll_control()
             polled, end = pipe.source.poll(B)
             now_ms = int(time.time() * 1000)
             if pipe.source.columnar and isinstance(polled, tuple):
@@ -1241,6 +1348,7 @@ class LocalExecutor:
 
         end = False
         while not end:
+            self._poll_control()
             polled, end = pipe.source.poll(B)
             prepped = self._prep_keyed_batch(pipe, polled, wagg.extractor)
             if prepped is None:

@@ -1,0 +1,114 @@
+"""Web monitor: JSON status endpoints over the MiniCluster.
+
+The role of flink-runtime-web's WebRuntimeMonitor + handlers (SURVEY §2.9):
+a small HTTP server exposing cluster overview, job list/detail, metric
+snapshots, and the back-pressure signal (cycle-time percentiles standing in
+for the reference's stack-trace sampling, see SURVEY §5: in the micro-batch
+design back-pressure IS a growing cycle time).
+
+Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
+    /overview                 cluster summary
+    /jobs                     job ids + states
+    /jobs/<jid>               job detail incl. JobMetrics
+    /jobs/<jid>/metrics       full metric snapshot for the job
+    /jobs/<jid>/backpressure  cycle-time percentiles
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from flink_tpu.runtime.cluster import MiniCluster
+
+
+class WebMonitor:
+    def __init__(self, cluster: MiniCluster, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.cluster = cluster
+        monitor = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    body = monitor._route(self.path)
+                    code = 200 if body is not None else 404
+                    body = body if body is not None else {"error": "not found"}
+                except Exception as e:
+                    code, body = 500, {"error": str(e)}
+                data = json.dumps(body, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="web-monitor"
+        )
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- routing ---------------------------------------------------------
+    def _route(self, path: str) -> Optional[dict]:
+        if path in ("/", "/overview"):
+            jobs = self.cluster.list_jobs()
+            return {
+                "jobs-running": sum(j["state"] == "RUNNING" for j in jobs),
+                "jobs-finished": sum(j["state"] == "FINISHED" for j in jobs),
+                "jobs-cancelled": sum(j["state"] == "CANCELED" for j in jobs),
+                "jobs-failed": sum(j["state"] == "FAILED" for j in jobs),
+                "flink-tpu-version": "0.1",
+            }
+        if path == "/jobs":
+            return {"jobs": self.cluster.list_jobs()}
+        m = re.fullmatch(r"/jobs/([^/]+)", path)
+        if m:
+            try:
+                return self.cluster.job_detail(m.group(1))
+            except KeyError:
+                return None
+        m = re.fullmatch(r"/jobs/([^/]+)/metrics", path)
+        if m:
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            return rec.env.metric_registry.snapshot()
+        m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)
+        if m:
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            snap = rec.env.metric_registry.snapshot(
+                f"jobs.{rec.name}.cycle_time_ms"
+            )
+            hist = next(iter(snap.values()), {"count": 0})
+            count = hist.get("count", 0)
+            p99 = hist.get("p99", 0)
+            p50 = hist.get("p50", 0) or 1e-9
+            # heuristic classification in the spirit of the reference's
+            # OK/LOW/HIGH ratio thresholds (BackPressureStatsTracker)
+            ratio = min(1.0, (p99 / p50 - 1.0) / 10.0) if count else 0.0
+            level = ("ok" if ratio <= 0.10
+                     else "low" if ratio <= 0.5 else "high")
+            return {
+                "status": "ok",
+                "backpressure-level": level,
+                "ratio": ratio,
+                "cycle-time-ms": hist,
+            }
+        return None

@@ -235,6 +235,44 @@ def test_bucketing_close_finalizes_restored_untouched_buckets(tmp_path):
         assert f.read().splitlines() == ["y"]
 
 
+def test_bucketing_nested_bucketer_restore_and_finalize(tmp_path):
+    """Date-path bucketers (nested dirs) must be truncated on restore and
+    finalized on close like flat buckets."""
+    base = str(tmp_path / "out")
+    sink = BucketingFileSink(base, bucketer=lambda e: f"{e[0]}/{e[1]}",
+                             formatter=lambda e: e[2])
+    sink.open()
+    sink.invoke_batch([("2026-07-29", "12", "x")])
+    snap = sink.snapshot_state()
+    sink.invoke_batch([("2026-07-29", "12", "lost")])
+    sink2 = BucketingFileSink(base, bucketer=lambda e: f"{e[0]}/{e[1]}",
+                              formatter=lambda e: e[2])
+    sink2.restore_state(snap)
+    sink2.open()
+    sink2.close()
+    final = os.path.join(base, "2026-07-29", "12", "part-0")
+    assert os.path.exists(final)
+    with open(final) as f:
+        assert f.read().splitlines() == ["x"]
+
+
+def test_savepoint_on_dead_job_fails_fast():
+    import time as _time
+
+    from flink_tpu.runtime.cluster import MiniCluster
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    env.from_collection([1]).add_sink(CollectSink())
+    cluster = MiniCluster()
+    jid = cluster.submit(env, "short")
+    cluster.wait(jid, 30)
+    t0 = _time.monotonic()
+    with pytest.raises(RuntimeError):
+        cluster.trigger_savepoint(jid, "/tmp/never")
+    assert _time.monotonic() - t0 < 5
+
+
 def test_bucketing_sink_end_to_end(tmp_path):
     env = StreamExecutionEnvironment.get_execution_environment()
     env.batch_size = 8
