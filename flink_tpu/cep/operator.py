@@ -65,16 +65,29 @@ class CEPProcessFunction(ProcessFunction):
         # arrival-order tiebreak lives IN the keyed state so it survives
         # restore (a reset counter would collide on (ts, seq) and make
         # heapq compare raw event payloads)
-        state = self.buffer.value() or {"seq": 0, "heap": []}
+        state = self._buffer_state()
         heapq.heappush(state["heap"], (ts, state["seq"], value))
         state["seq"] += 1
         self.buffer.update(state)
         # fire once the watermark passes this element's timestamp
         ctx.timer_service().register_event_time_timer(ts)
 
+    def _buffer_state(self) -> dict:
+        state = self.buffer.value()
+        if not state:
+            return {"seq": 0, "heap": []}
+        if isinstance(state, list):  # pre-dict snapshots (heap only)
+            # seed past every live seq: earlier pops may have consumed low
+            # seqs, and a collision would make heapq compare event payloads
+            return {
+                "seq": max((s for _, s, _ in state), default=-1) + 1,
+                "heap": state,
+            }
+        return state
+
     def on_timer(self, timestamp, ctx, out):
         wm = ctx.timer_service().current_watermark()
-        state = self.buffer.value() or {"seq": 0, "heap": []}
+        state = self._buffer_state()
         buf = state["heap"]
         partials = list(self.partials.value() or [])
         while buf and buf[0][0] <= wm:

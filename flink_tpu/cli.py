@@ -33,9 +33,19 @@ def restore_path():
     return os.environ.get("FLINK_TPU_SAVEPOINT") or None
 
 
+DEFAULT_PORT = 6123  # ref jobmanager.rpc.port default (flink-conf.yaml:33)
+
+
 def _addr(spec: str):
     host, _, port = spec.rpartition(":")
-    return host or "127.0.0.1", int(port)
+    if not host:  # bare hostname, no port
+        return port or "127.0.0.1", DEFAULT_PORT
+    try:
+        return host, int(port)
+    except ValueError:
+        raise SystemExit(
+            f"invalid --jobmanager address {spec!r} (expected HOST:PORT)"
+        )
 
 
 def main(argv=None) -> int:

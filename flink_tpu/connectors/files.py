@@ -87,8 +87,11 @@ class ContinuousFileSource(Source):
                     lines.append(line.decode("utf-8", errors="replace")
                                  .rstrip("\n"))
                 self.positions[path] = pos
-                if pos < os.path.getsize(path):
-                    exhausted = False
+                try:
+                    if pos < os.path.getsize(path):
+                        exhausted = False
+                except FileNotFoundError:
+                    pass  # deleted mid-read: treat as fully consumed
             if len(lines) >= max_records:
                 exhausted = False
                 break

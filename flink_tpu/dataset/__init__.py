@@ -1,0 +1,6 @@
+"""Batch DataSet API (ref flink-java / DataSet, SURVEY §2.6)."""
+
+from flink_tpu.dataset.dataset import DataSet, GroupedDataSet, JoinBuilder
+from flink_tpu.dataset.environment import ExecutionEnvironment
+
+__all__ = ["DataSet", "GroupedDataSet", "JoinBuilder", "ExecutionEnvironment"]

@@ -1,0 +1,48 @@
+"""Batch ExecutionEnvironment (ref flink-java ExecutionEnvironment,
+SURVEY §2.6)."""
+
+from __future__ import annotations
+
+import csv as _csv
+from typing import Any, Iterable, List
+
+import numpy as np
+
+from flink_tpu.dataset.dataset import DataSet
+
+
+class ExecutionEnvironment:
+    @staticmethod
+    def get_execution_environment() -> "ExecutionEnvironment":
+        return ExecutionEnvironment()
+
+    def from_collection(self, data: Iterable[Any]) -> DataSet:
+        data = list(data)
+        return DataSet(self, lambda: data, "source")
+
+    def from_elements(self, *elements: Any) -> DataSet:
+        return self.from_collection(list(elements))
+
+    def generate_sequence(self, start: int, end: int) -> DataSet:
+        return DataSet(
+            self, lambda: list(range(start, end + 1)), "sequence"
+        )
+
+    def read_text_file(self, path: str) -> DataSet:
+        def run():
+            with open(path) as f:
+                return [line.rstrip("\n") for line in f]
+
+        return DataSet(self, run, "text_file")
+
+    def read_csv_file(self, path: str, types=None, delimiter=",") -> DataSet:
+        def run():
+            out = []
+            with open(path) as f:
+                for row in _csv.reader(f, delimiter=delimiter):
+                    if types:
+                        row = [t(v) for t, v in zip(types, row)]
+                    out.append(tuple(row))
+            return out
+
+        return DataSet(self, run, "csv_file")

@@ -1,0 +1,5 @@
+"""Graph processing — the Gelly analog (ref flink-gelly, SURVEY §2.7)."""
+
+from flink_tpu.gelly.graph import Graph
+
+__all__ = ["Graph"]

@@ -43,36 +43,47 @@ class Gauge:
 
 class Histogram:
     """Sliding-window histogram with percentile snapshots (ref
-    DescriptiveStatisticsHistogram role)."""
+    DescriptiveStatisticsHistogram role). Updates come from the job thread
+    while web/reporter threads read — a lock keeps the copy consistent."""
 
     def __init__(self, window: int = 1024):
         self._values = deque(maxlen=window)
+        self._lock = threading.Lock()
 
     def update(self, v: float):
-        self._values.append(float(v))
+        with self._lock:
+            self._values.append(float(v))
+
+    def _copy(self) -> List[float]:
+        with self._lock:
+            return list(self._values)
 
     def get_count(self) -> int:
         return len(self._values)
 
-    def quantile(self, q: float) -> float:
-        vs = sorted(self._values)
-        if not vs:
-            return float("nan")
+    @staticmethod
+    def _q(vs: List[float], q: float) -> float:
         idx = min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))
         return vs[idx]
 
+    def quantile(self, q: float) -> float:
+        vs = sorted(self._copy())
+        if not vs:
+            return float("nan")
+        return self._q(vs, q)
+
     def snapshot(self) -> Dict[str, float]:
-        vs = list(self._values)
+        vs = sorted(self._copy())
         if not vs:
             return {"count": 0}
         return {
             "count": len(vs),
-            "min": min(vs),
-            "max": max(vs),
+            "min": vs[0],
+            "max": vs[-1],
             "mean": sum(vs) / len(vs),
-            "p50": self.quantile(0.50),
-            "p95": self.quantile(0.95),
-            "p99": self.quantile(0.99),
+            "p50": self._q(vs, 0.50),
+            "p95": self._q(vs, 0.95),
+            "p99": self._q(vs, 0.99),
         }
 
 
@@ -83,12 +94,14 @@ class Meter:
         self.interval_s = interval_s
         self._events = deque()
         self._count = 0
+        self._lock = threading.Lock()  # job thread writes, web/reporter read
 
     def mark_event(self, n: int = 1):
         now = time.monotonic()
-        self._events.append((now, n))
-        self._count += n
-        self._evict(now)
+        with self._lock:
+            self._events.append((now, n))
+            self._count += n
+            self._evict(now)
 
     def _evict(self, now):
         while self._events and self._events[0][0] < now - self.interval_s:
@@ -96,11 +109,13 @@ class Meter:
 
     def get_rate(self) -> float:
         now = time.monotonic()
-        self._evict(now)
-        total = sum(n for _, n in self._events)
-        span = (
-            now - self._events[0][0] if self._events else self.interval_s
-        ) or 1e-9
+        with self._lock:
+            self._evict(now)
+            total = sum(n for _, n in self._events)
+            first = self._events[0][0] if self._events else None
+        # clamp the span so a read right after the first event reports
+        # <= total events/sec instead of an absurd instantaneous rate
+        span = max(1.0, now - first if first is not None else self.interval_s)
         return total / span
 
     def get_count(self) -> int:
@@ -239,7 +254,10 @@ class ScheduledReporter(threading.Thread):
 
     def run(self):
         while not self._stop.wait(self.interval_s):
-            self.reporter.report()
+            try:
+                self.reporter.report()
+            except Exception:
+                pass  # a transient failure must not kill future reports
 
     def stop(self):
         self._stop.set()

@@ -1,0 +1,20 @@
+"""ML pipelines — the FlinkML analog (ref flink-ml, SURVEY §2.7)."""
+
+from flink_tpu.ml.pipeline import (
+    KNN,
+    SVM,
+    KMeans,
+    MinMaxScaler,
+    MultipleLinearRegression,
+    Pipeline,
+    PolynomialFeatures,
+    Predictor,
+    StandardScaler,
+    Transformer,
+)
+
+__all__ = [
+    "Pipeline", "Transformer", "Predictor", "StandardScaler",
+    "MinMaxScaler", "PolynomialFeatures", "MultipleLinearRegression",
+    "SVM", "KMeans", "KNN",
+]

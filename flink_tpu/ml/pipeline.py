@@ -1,0 +1,284 @@
+"""ML pipelines — the FlinkML analog (ref flink-ml: Pipeline/Estimator/
+Predictor/Transformer contracts + SVM (CoCoA), MultipleLinearRegression
+(SGD), KNN, StandardScaler/MinMaxScaler/PolynomialFeatures, SURVEY §2.7),
+redesigned for the accelerator:
+
+The reference trains with per-partition JVM loops over Breeze vectors.
+Here every estimator is a jit-compiled JAX program over [N, D] device
+arrays — full-batch matmul-dominated updates (MXU work), `lax.fori_loop`
+training loops, and jit'd predict paths. The Pipeline chaining contract
+(chainTransformer/chainPredictor) is preserved: transformers fit/transform
+in sequence, the trailing predictor fits on the transformed features.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _as2d(x) -> jnp.ndarray:
+    a = jnp.asarray(x, jnp.float32)
+    return a[:, None] if a.ndim == 1 else a
+
+
+class Transformer:
+    """ref Transformer: fit(X) learns parameters, transform(X) applies."""
+
+    def fit(self, X, y=None) -> "Transformer":
+        return self
+
+    def transform(self, X) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def fit_transform(self, X, y=None) -> jnp.ndarray:
+        return self.fit(X, y).transform(X)
+
+
+class Predictor:
+    """ref Predictor: fit(X, y) + predict(X)."""
+
+    def fit(self, X, y) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, X) -> jnp.ndarray:
+        raise NotImplementedError
+
+
+class Pipeline:
+    """ref Pipeline chaining: transformers then an optional predictor."""
+
+    def __init__(self, stages: List[Any]):
+        self.stages = stages
+
+    def fit(self, X, y=None) -> "Pipeline":
+        cur = _as2d(X)
+        for i, s in enumerate(self.stages):
+            if isinstance(s, Predictor) or (
+                i == len(self.stages) - 1 and hasattr(s, "predict")
+            ):
+                s.fit(cur, y)
+            else:
+                cur = s.fit_transform(cur, y)
+        return self
+
+    def transform(self, X) -> jnp.ndarray:
+        cur = _as2d(X)
+        for s in self.stages:
+            if hasattr(s, "transform"):
+                cur = s.transform(cur)
+        return cur
+
+    def predict(self, X) -> jnp.ndarray:
+        cur = _as2d(X)
+        for s in self.stages[:-1]:
+            cur = s.transform(cur)
+        return self.stages[-1].predict(cur)
+
+
+# ------------------------------------------------------------ transformers
+class StandardScaler(Transformer):
+    """ref preprocessing.StandardScaler (mean/std)."""
+
+    def fit(self, X, y=None):
+        X = _as2d(X)
+        self.mean = jnp.mean(X, axis=0)
+        self.std = jnp.maximum(jnp.std(X, axis=0), 1e-9)
+        return self
+
+    def transform(self, X):
+        return (_as2d(X) - self.mean) / self.std
+
+
+class MinMaxScaler(Transformer):
+    """ref preprocessing.MinMaxScaler."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo, self.hi = lo, hi
+
+    def fit(self, X, y=None):
+        X = _as2d(X)
+        self.data_min = jnp.min(X, axis=0)
+        self.data_range = jnp.maximum(
+            jnp.max(X, axis=0) - self.data_min, 1e-9
+        )
+        return self
+
+    def transform(self, X):
+        z = (_as2d(X) - self.data_min) / self.data_range
+        return z * (self.hi - self.lo) + self.lo
+
+
+class PolynomialFeatures(Transformer):
+    """ref preprocessing.PolynomialFeatures: powers up to `degree`."""
+
+    def __init__(self, degree: int = 2):
+        self.degree = degree
+
+    def transform(self, X):
+        X = _as2d(X)
+        return jnp.concatenate(
+            [X**d for d in range(1, self.degree + 1)], axis=1
+        )
+
+
+# -------------------------------------------------------------- predictors
+class MultipleLinearRegression(Predictor):
+    """ref regression.MultipleLinearRegression: squared-loss linear model.
+    Full-batch gradient descent under lax.fori_loop (the reference uses
+    per-partition SGD); one [N,D]@[D] matmul per step."""
+
+    def __init__(self, iterations: int = 200, stepsize: float = 0.1):
+        self.iterations = iterations
+        self.stepsize = stepsize
+
+    def fit(self, X, y):
+        X = _as2d(X)
+        y = jnp.asarray(y, jnp.float32).reshape(-1)
+        N, D = X.shape
+        Xb = jnp.concatenate([X, jnp.ones((N, 1), jnp.float32)], axis=1)
+
+        def step(_, w):
+            grad = Xb.T @ (Xb @ w - y) / N
+            return w - self.stepsize * grad
+
+        self.weights = jax.lax.fori_loop(
+            0, self.iterations, step, jnp.zeros(D + 1, jnp.float32)
+        )
+        return self
+
+    def predict(self, X):
+        X = _as2d(X)
+        Xb = jnp.concatenate(
+            [X, jnp.ones((X.shape[0], 1), jnp.float32)], axis=1
+        )
+        return Xb @ self.weights
+
+    def squared_residual_sum(self, X, y) -> float:
+        r = self.predict(X) - jnp.asarray(y, jnp.float32).reshape(-1)
+        return float(jnp.sum(r * r))
+
+
+class SVM(Predictor):
+    """ref classification.SVM (CoCoA dual solver): linear soft-margin SVM,
+    labels in {-1, +1}. Trained with pegasos-style subgradient descent on
+    the hinge loss — full-batch, matmul-dominated."""
+
+    def __init__(self, iterations: int = 300, regularization: float = 1e-3):
+        self.iterations = iterations
+        self.lam = regularization
+
+    def fit(self, X, y):
+        X = _as2d(X)
+        y = jnp.asarray(y, jnp.float32).reshape(-1)
+        N, D = X.shape
+        Xb = jnp.concatenate([X, jnp.ones((N, 1), jnp.float32)], axis=1)
+
+        def step(t, w):
+            margins = y * (Xb @ w)
+            active = (margins < 1.0).astype(jnp.float32)
+            grad = self.lam * w - (Xb.T @ (active * y)) / N
+            eta = 1.0 / (self.lam * (t + 1.0))
+            return w - eta * grad
+
+        self.weights = jax.lax.fori_loop(
+            0, self.iterations, step, jnp.zeros(D + 1, jnp.float32)
+        )
+        return self
+
+    def decision_function(self, X):
+        X = _as2d(X)
+        Xb = jnp.concatenate(
+            [X, jnp.ones((X.shape[0], 1), jnp.float32)], axis=1
+        )
+        return Xb @ self.weights
+
+    def predict(self, X):
+        return jnp.sign(self.decision_function(X))
+
+
+class KMeans(Predictor):
+    """ref the KMeans batch example (+ FlinkML pipelines): Lloyd iterations
+    with an [N,K] distance matmul per step — pure MXU work."""
+
+    def __init__(self, k: int, iterations: int = 50, seed: int = 0):
+        self.k = k
+        self.iterations = iterations
+        self.seed = seed
+
+    def fit(self, X, y=None):
+        X = _as2d(X)
+        N, D = X.shape
+        # k-means++ seeding (host-side, one pass per center): spreads the
+        # initial centers so Lloyd doesn't collapse clusters
+        Xh = np.asarray(X)
+        rng = np.random.default_rng(self.seed)
+        centers = [Xh[rng.integers(N)]]
+        for _ in range(1, self.k):
+            d2 = np.min(
+                [((Xh - c) ** 2).sum(axis=1) for c in centers], axis=0
+            )
+            p = d2 / max(d2.sum(), 1e-12)
+            centers.append(Xh[rng.choice(N, p=p)])
+        centers0 = jnp.asarray(np.stack(centers), jnp.float32)
+
+        def assign(centers):
+            # |x-c|^2 = |x|^2 - 2 x.c + |c|^2 ; argmin over K
+            d = (
+                jnp.sum(X * X, axis=1, keepdims=True)
+                - 2.0 * (X @ centers.T)
+                + jnp.sum(centers * centers, axis=1)[None, :]
+            )
+            return jnp.argmin(d, axis=1)
+
+        def step(_, centers):
+            a = assign(centers)
+            sums = jnp.zeros((self.k, D), jnp.float32).at[a].add(X)
+            counts = jnp.zeros((self.k,), jnp.float32).at[a].add(1.0)
+            new = sums / jnp.maximum(counts[:, None], 1.0)
+            # empty cluster keeps its old center
+            return jnp.where(counts[:, None] > 0, new, centers)
+
+        self.centers = jax.lax.fori_loop(
+            0, self.iterations, step, centers0
+        )
+        return self
+
+    def predict(self, X):
+        X = _as2d(X)
+        d = (
+            jnp.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * (X @ self.centers.T)
+            + jnp.sum(self.centers * self.centers, axis=1)[None, :]
+        )
+        return jnp.argmin(d, axis=1)
+
+
+class KNN(Predictor):
+    """ref nn.KNN: brute-force k-nearest-neighbors; the [Q,N] distance
+    matrix is one matmul (exact, accelerator-friendly)."""
+
+    def __init__(self, k: int = 5):
+        self.k = k
+
+    def fit(self, X, y):
+        self.X = _as2d(X)
+        self.y = jnp.asarray(y, jnp.float32).reshape(-1)
+        return self
+
+    def predict(self, X):
+        Q = _as2d(X)
+        d = (
+            jnp.sum(Q * Q, axis=1, keepdims=True)
+            - 2.0 * (Q @ self.X.T)
+            + jnp.sum(self.X * self.X, axis=1)[None, :]
+        )
+        _, idx = jax.lax.top_k(-d, self.k)
+        neigh = self.y[idx]                       # [Q, k]
+        # regression-style mean of neighbor labels; round for voting
+        return jnp.mean(neigh, axis=1)

@@ -104,3 +104,34 @@ def scatter_combine(target, idx, updates, mask, kind: str):
     if kind == "set":
         return at.set(updates, mode="drop")
     raise ValueError(f"unknown scatter kind {kind!r}")
+
+
+def grouped_reduce(kind: str, gid, vals, n_groups: int):
+    """Dictionary-encoded grouped reduction: one XLA scatter-reduce per
+    aggregate. Shared by the batch DataSet and Table aggregation paths
+    (the device analog of the reference's ReduceCombineDriver).
+
+    gid: [N] int group ids in [0, n_groups); vals: [N] float values
+    (ignored for 'count'). Returns a numpy [n_groups] float32 array.
+    """
+    import numpy as np
+
+    g = jnp.asarray(np.asarray(gid))
+    if kind == "count":
+        return np.asarray(jnp.zeros(n_groups, jnp.float32).at[g].add(1.0))
+    v = jnp.asarray(np.asarray(vals, np.float32))
+    if kind == "sum":
+        return np.asarray(jnp.zeros(n_groups, jnp.float32).at[g].add(v))
+    if kind == "min":
+        return np.asarray(
+            jnp.full(n_groups, jnp.inf, jnp.float32).at[g].min(v)
+        )
+    if kind == "max":
+        return np.asarray(
+            jnp.full(n_groups, -jnp.inf, jnp.float32).at[g].max(v)
+        )
+    if kind in ("avg", "mean"):
+        s = jnp.zeros(n_groups, jnp.float32).at[g].add(v)
+        c = jnp.zeros(n_groups, jnp.float32).at[g].add(1.0)
+        return np.asarray(s / c)
+    raise ValueError(f"unknown aggregate kind {kind!r}")

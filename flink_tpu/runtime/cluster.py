@@ -179,6 +179,15 @@ class MiniCluster:
         if rec.status != "RUNNING":
             raise RuntimeError(f"job {job_id} is {rec.status}, not RUNNING")
         req = rec.control.request_savepoint(path)
+        # the job may have finished between the status check and the
+        # request attach, in which case its end-of-run drain already ran
+        # and nothing will ever observe this request — fail it ourselves
+        if rec.status != "RUNNING":
+            if rec.control.take_savepoint_request() is req:
+                req.set_error(RuntimeError(
+                    f"job {job_id} ended ({rec.status}) before the "
+                    f"savepoint could be taken"
+                ))
         return req.wait(timeout_s)
 
     def wait(self, job_id: str, timeout_s: Optional[float] = None) -> str:
@@ -190,10 +199,11 @@ class MiniCluster:
         with self._lock:
             return [rec.summary() for rec in self.jobs.values()]
 
-    _METRIC_FIELDS = (
-        "records_in", "records_out", "fires", "steps",
-        "dropped_late", "dropped_capacity", "restarts",
-    )
+    @property
+    def _METRIC_FIELDS(self):
+        from flink_tpu.runtime.executor import JobMetrics
+
+        return JobMetrics.GAUGE_FIELDS
 
     def job_detail(self, job_id: str) -> Dict[str, Any]:
         rec = self._rec(job_id)

@@ -66,6 +66,13 @@ class JobMetrics:
     restarts: int = 0
     wall_time_s: float = 0.0
 
+    # the counter fields exported as live gauges (also consumed by the
+    # MiniCluster's job detail endpoint)
+    GAUGE_FIELDS = (
+        "records_in", "records_out", "fires", "steps",
+        "dropped_late", "dropped_capacity", "restarts",
+    )
+
 
 @dataclasses.dataclass
 class JobHandle:
@@ -310,8 +317,7 @@ class LocalExecutor:
             return
         grp = registry.group("jobs", job_name)
         self._job_group = grp
-        for fname in ("records_in", "records_out", "fires", "steps",
-                      "dropped_late", "dropped_capacity", "restarts"):
+        for fname in JobMetrics.GAUGE_FIELDS:
             grp.gauge(fname, lambda m=metrics, n=fname: getattr(m, n))
         self._cycle_hist = grp.histogram("cycle_time_ms")
 

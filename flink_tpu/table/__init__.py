@@ -1,0 +1,5 @@
+"""Table API + SQL subset (ref flink-table, SURVEY §2.7)."""
+
+from flink_tpu.table.table import Expr, Table, TableEnvironment, col, lit
+
+__all__ = ["Table", "TableEnvironment", "Expr", "col", "lit"]

@@ -1,0 +1,445 @@
+"""Table API + minimal SQL — the flink-table analog (SURVEY §2.7:
+Calcite-planned Table/SQL over DataSet/DataStream), columnar-native:
+
+A Table IS a dict of equal-length numpy columns (the Row batch), and every
+relational operator is a vectorized array program: selections are boolean
+masks, projections are column arithmetic, grouped aggregations
+dictionary-encode keys and segment-reduce values on the device (the same
+kernel shape as the streaming window path — where the reference code-gens
+Janino functions, this design lowers to XLA).
+
+Expression DSL:    col("a") + 1, (col("a") > 5) & (col("b") == "x"),
+                   col("a").sum.alias("total")
+SQL subset:        SELECT ... FROM t [WHERE ...] [GROUP BY ...]
+                   [ORDER BY ... [DESC]] [LIMIT n]
+The SQL front-end parses via Python's ast over translated operators —
+deliberately small, covering the SELECT shape the reference's examples use.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_AGGS = ("sum", "avg", "min", "max", "count")
+
+
+class Expr:
+    """Column expression tree evaluated against a column dict."""
+
+    def __init__(self, fn: Callable[[Dict[str, np.ndarray], int], np.ndarray],
+                 name: str, agg: Optional[Tuple[str, "Expr"]] = None):
+        self._fn = fn
+        self.name = name
+        self.agg = agg          # ('sum', inner) for aggregate expressions
+
+    def eval(self, cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        return self._fn(cols, n)
+
+    def alias(self, name: str) -> "Expr":
+        e = Expr(self._fn, name, self.agg)
+        return e
+
+    # -- operators -------------------------------------------------------
+    def _bin(self, other, op, sym):
+        o = other if isinstance(other, Expr) else lit(other)
+        return Expr(
+            lambda c, n: op(self.eval(c, n), o.eval(c, n)),
+            f"({self.name}{sym}{o.name})",
+        )
+
+    def __add__(self, o):
+        return self._bin(o, lambda a, b: a + b, "+")
+
+    def __radd__(self, o):
+        return lit(o)._bin(self, lambda a, b: a + b, "+")
+
+    def __sub__(self, o):
+        return self._bin(o, lambda a, b: a - b, "-")
+
+    def __rsub__(self, o):
+        return lit(o)._bin(self, lambda a, b: a - b, "-")
+
+    def __mul__(self, o):
+        return self._bin(o, lambda a, b: a * b, "*")
+
+    def __rmul__(self, o):
+        return lit(o)._bin(self, lambda a, b: a * b, "*")
+
+    def __truediv__(self, o):
+        return self._bin(o, lambda a, b: a / b, "/")
+
+    def __mod__(self, o):
+        return self._bin(o, lambda a, b: a % b, "%")
+
+    def __gt__(self, o):
+        return self._bin(o, lambda a, b: a > b, ">")
+
+    def __ge__(self, o):
+        return self._bin(o, lambda a, b: a >= b, ">=")
+
+    def __lt__(self, o):
+        return self._bin(o, lambda a, b: a < b, "<")
+
+    def __le__(self, o):
+        return self._bin(o, lambda a, b: a <= b, "<=")
+
+    def __eq__(self, o):  # noqa: A003
+        return self._bin(o, lambda a, b: a == b, "==")
+
+    def __ne__(self, o):
+        return self._bin(o, lambda a, b: a != b, "!=")
+
+    def __and__(self, o):
+        return self._bin(o, lambda a, b: a & b, "&")
+
+    def __or__(self, o):
+        return self._bin(o, lambda a, b: a | b, "|")
+
+    def __invert__(self):
+        return Expr(lambda c, n: ~self.eval(c, n), f"~{self.name}")
+
+    def __hash__(self):
+        return id(self)
+
+    # -- aggregates ------------------------------------------------------
+    def _mk_agg(self, kind: str) -> "Expr":
+        return Expr(self._fn, f"{kind}_{self.name}", agg=(kind, self))
+
+    @property
+    def sum(self) -> "Expr":
+        return self._mk_agg("sum")
+
+    @property
+    def avg(self) -> "Expr":
+        return self._mk_agg("avg")
+
+    @property
+    def min(self) -> "Expr":  # noqa: A003
+        return self._mk_agg("min")
+
+    @property
+    def max(self) -> "Expr":  # noqa: A003
+        return self._mk_agg("max")
+
+    @property
+    def count(self) -> "Expr":
+        return self._mk_agg("count")
+
+
+def col(name: str) -> Expr:
+    return Expr(lambda c, n, _k=name: c[_k], name)
+
+
+def lit(v: Any) -> Expr:
+    return Expr(lambda c, n, _v=v: np.full(n, _v), repr(v))
+
+
+from flink_tpu.ops.segment import grouped_reduce as _segment  # noqa: E402
+# (shared device scatter-reduce; same kernel the DataSet group_by path uses)
+
+
+class Table:
+    def __init__(self, cols: Dict[str, np.ndarray]):
+        self.cols = {k: np.asarray(v) for k, v in cols.items()}
+        ns = {len(v) for v in self.cols.values()}
+        if len(ns) > 1:
+            raise ValueError("ragged columns")
+        self.n = ns.pop() if ns else 0
+
+    # -- info ------------------------------------------------------------
+    @property
+    def schema(self) -> List[str]:
+        return list(self.cols)
+
+    def count(self) -> int:
+        return self.n
+
+    def to_rows(self) -> List[tuple]:
+        names = self.schema
+        return list(zip(*[self.cols[c].tolist() for c in names]))
+
+    def to_dicts(self) -> List[dict]:
+        names = self.schema
+        return [dict(zip(names, r)) for r in self.to_rows()]
+
+    # -- relational ops --------------------------------------------------
+    def select(self, *exprs) -> "Table":
+        exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+        if any(e.agg for e in exprs):
+            # global aggregation (no grouping): one group
+            return self._aggregate(None, exprs)
+        return Table({e.name: e.eval(self.cols, self.n) for e in exprs})
+
+    def where(self, pred: Expr) -> "Table":
+        mask = np.asarray(pred.eval(self.cols, self.n), bool)
+        return Table({k: v[mask] for k, v in self.cols.items()})
+
+    filter = where  # noqa: A003
+
+    def group_by(self, *keys: str) -> "GroupedTable":
+        return GroupedTable(self, [
+            k.name if isinstance(k, Expr) else k for k in keys
+        ])
+
+    def _aggregate(self, keys: Optional[List[str]], exprs) -> "Table":
+        if keys:
+            key_arrays = [self.cols[k] for k in keys]
+            packed = np.empty(self.n, dtype=object)
+            rows = list(zip(*[a.tolist() for a in key_arrays]))
+            packed[:] = rows
+            uniq, gid = np.unique(packed, return_inverse=True)
+            G = len(uniq)
+            out: Dict[str, np.ndarray] = {}
+            for i, k in enumerate(keys):
+                out[k] = np.asarray([u[i] for u in uniq])
+        else:
+            gid = np.zeros(self.n, np.int64)
+            G = 1
+            out = {}
+        for e in exprs:
+            if e.agg is None:
+                if keys and e.name in keys:
+                    continue
+                raise ValueError(
+                    f"non-aggregate column {e.name!r} outside GROUP BY keys"
+                )
+            kind, inner = e.agg
+            vals = (
+                inner.eval(self.cols, self.n) if kind != "count"
+                else np.zeros(self.n)
+            )
+            out[e.name] = _segment(kind, gid, vals, G)
+        return Table(out)
+
+    def join(self, other: "Table", left_key: str,
+             right_key: Optional[str] = None, how: str = "inner") -> "Table":
+        if how not in ("inner", "left", "right", "full"):
+            raise ValueError(f"unsupported join type {how!r}")
+        rk = right_key or left_key
+        build: Dict[Any, List[int]] = {}
+        for i, v in enumerate(other.cols[rk].tolist()):
+            build.setdefault(v, []).append(i)
+        li, ri = [], []
+        matched_right = set()
+        for i, v in enumerate(self.cols[left_key].tolist()):
+            rows = build.get(v)
+            if rows:
+                matched_right.add(v)
+                for j in rows:
+                    li.append(i)
+                    ri.append(j)
+            elif how in ("left", "full"):
+                li.append(i)
+                ri.append(-1)
+        if how in ("right", "full"):
+            for v, rows in build.items():
+                if v not in matched_right:
+                    for j in rows:
+                        li.append(-1)
+                        ri.append(j)
+        li = np.asarray(li, np.int64)
+        ri = np.asarray(ri, np.int64)
+
+        def take(v, idx):
+            t = v[np.maximum(idx, 0)]
+            return np.where(idx >= 0, t, None) if (idx < 0).any() else t
+
+        out = {k: take(v, li) for k, v in self.cols.items()}
+        for k, v in other.cols.items():
+            if k == rk and rk == left_key:
+                # shared key column: fill left-side gaps from the right
+                out[k] = np.where(li >= 0, out[k], take(v, ri))
+                continue
+            name = k if k not in out else f"r_{k}"
+            out[name] = take(v, ri)
+        return Table(out)
+
+    def order_by(self, key: str, ascending: bool = True) -> "Table":
+        k = key.name if isinstance(key, Expr) else key
+        idx = np.argsort(self.cols[k], kind="stable")
+        if not ascending:
+            idx = idx[::-1]
+        return Table({c: v[idx] for c, v in self.cols.items()})
+
+    def limit(self, n: int) -> "Table":
+        return Table({c: v[:n] for c, v in self.cols.items()})
+
+    def union_all(self, other: "Table") -> "Table":
+        return Table({
+            c: np.concatenate([self.cols[c], other.cols[c]])
+            for c in self.schema
+        })
+
+    def distinct(self) -> "Table":
+        rows = self.to_rows()
+        seen, keep = set(), []
+        for i, r in enumerate(rows):
+            if r not in seen:
+                seen.add(r)
+                keep.append(i)
+        idx = np.asarray(keep, np.int64)
+        return Table({c: v[idx] for c, v in self.cols.items()})
+
+
+class GroupedTable:
+    def __init__(self, table: Table, keys: List[str]):
+        self.table = table
+        self.keys = keys
+
+    def select(self, *exprs) -> Table:
+        exprs = [col(e) if isinstance(e, str) else e for e in exprs]
+        return self.table._aggregate(self.keys, exprs)
+
+
+class TableEnvironment:
+    """ref BatchTableEnvironment: table registry + SQL entry point."""
+
+    def __init__(self):
+        self._tables: Dict[str, Table] = {}
+
+    @staticmethod
+    def create() -> "TableEnvironment":
+        return TableEnvironment()
+
+    def from_columns(self, cols: Dict[str, Sequence]) -> Table:
+        return Table({k: np.asarray(v) for k, v in cols.items()})
+
+    def from_rows(self, rows: List[tuple], names: List[str]) -> Table:
+        arrays = list(zip(*rows)) if rows else [[] for _ in names]
+        return Table({n: np.asarray(a) for n, a in zip(names, arrays)})
+
+    def from_dataset(self, ds, names: List[str]) -> Table:
+        return self.from_rows(ds.collect(), names)
+
+    def register_table(self, name: str, table: Table):
+        self._tables[name] = table
+
+    def scan(self, name: str) -> Table:
+        return self._tables[name]
+
+    # -- SQL subset ------------------------------------------------------
+    _SQL = re.compile(
+        r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<from>\w+)"
+        r"(?:\s+WHERE\s+(?P<where>.+?))?"
+        r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
+        r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
+        r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+        re.IGNORECASE | re.DOTALL,
+    )
+
+    def sql_query(self, query: str) -> Table:
+        m = self._SQL.match(query)
+        if not m:
+            raise ValueError(f"unsupported SQL shape: {query!r}")
+        t = self.scan(m.group("from"))
+        if m.group("where"):
+            t = t.where(_parse_expr(m.group("where")))
+        select_items = _split_commas(m.group("select"))
+        exprs = (
+            None if select_items == ["*"]
+            else [_parse_select_item(s) for s in select_items]
+        )
+        if m.group("group"):
+            keys = [k.strip() for k in _split_commas(m.group("group"))]
+            t = t.group_by(*keys).select(*(exprs or keys))
+        elif exprs is not None:
+            t = t.select(*exprs)
+        if m.group("order"):
+            spec = m.group("order").strip()
+            desc = bool(re.search(r"\s+DESC$", spec, re.IGNORECASE))
+            key = re.sub(r"\s+(DESC|ASC)$", "", spec, flags=re.IGNORECASE)
+            t = t.order_by(key.strip(), ascending=not desc)
+        if m.group("limit"):
+            t = t.limit(int(m.group("limit")))
+        return t
+
+
+def _split_commas(s: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return out
+
+
+def _parse_select_item(s: str) -> Expr:
+    m = re.match(r"^(.+?)\s+AS\s+(\w+)$", s.strip(), re.IGNORECASE)
+    alias = None
+    if m:
+        s, alias = m.group(1), m.group(2)
+    e = _parse_expr(s)
+    return e.alias(alias) if alias else e
+
+
+def _parse_expr(s: str) -> Expr:
+    """SQL fragment -> Expr via the Python ast (SQL operators translated
+    first: = -> ==, AND/OR/NOT -> &/|/~, aggregate calls -> .agg props)."""
+    py = re.sub(r"(?<![<>=!])=(?!=)", "==", s)
+    # python's `and`/`or`/`not` have SQL's precedence (below comparisons);
+    # the builder turns BoolOp into elementwise &/|
+    py = re.sub(r"\bAND\b", "and", py, flags=re.IGNORECASE)
+    py = re.sub(r"\bOR\b", "or", py, flags=re.IGNORECASE)
+    py = re.sub(r"\bNOT\b", "not", py, flags=re.IGNORECASE)
+    py = re.sub(r"\bCOUNT\s*\(\s*\*\s*\)", "COUNT(__star__)", py,
+                flags=re.IGNORECASE)
+    tree = ast.parse(py, mode="eval")
+
+    def build(node) -> Any:
+        if isinstance(node, ast.Expression):
+            return build(node.body)
+        if isinstance(node, ast.Name):
+            if node.id == "__star__":
+                return lit(1.0)
+            return col(node.id)
+        if isinstance(node, ast.Constant):
+            return lit(node.value)
+        if isinstance(node, ast.Compare):
+            left = build(node.left)
+            right = build(node.comparators[0])
+            opmap = {
+                ast.Gt: Expr.__gt__, ast.GtE: Expr.__ge__,
+                ast.Lt: Expr.__lt__, ast.LtE: Expr.__le__,
+                ast.Eq: Expr.__eq__, ast.NotEq: Expr.__ne__,
+            }
+            return opmap[type(node.ops[0])](left, right)
+        if isinstance(node, ast.BinOp):
+            opmap = {
+                ast.Add: Expr.__add__, ast.Sub: Expr.__sub__,
+                ast.Mult: Expr.__mul__, ast.Div: Expr.__truediv__,
+                ast.Mod: Expr.__mod__, ast.BitAnd: Expr.__and__,
+                ast.BitOr: Expr.__or__,
+            }
+            return opmap[type(node.op)](build(node.left), build(node.right))
+        if isinstance(node, ast.BoolOp):
+            parts = [build(v) for v in node.values]
+            acc = parts[0]
+            for p in parts[1:]:
+                acc = (acc & p) if isinstance(node.op, ast.And) else (acc | p)
+            return acc
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, (ast.Invert, ast.Not)):
+                return ~build(node.operand)
+            if isinstance(node.op, ast.USub):
+                return lit(0) - build(node.operand)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            fname = node.func.id.lower()
+            if fname in _AGGS:
+                inner = build(node.args[0])
+                return inner._mk_agg(fname)
+        raise ValueError(f"unsupported SQL expression: {s!r}")
+
+    return build(tree)

@@ -1,0 +1,178 @@
+"""Batch DataSet API semantics — mirrors the reference's batch example
+ITCases (WordCount, joins, iterations; SURVEY §2.6/§2.9)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.dataset import ExecutionEnvironment
+
+
+def _env():
+    return ExecutionEnvironment.get_execution_environment()
+
+
+def test_word_count():
+    text = ["to be or not to be", "that is the question"]
+    env = _env()
+    counts = (
+        env.from_collection(text)
+        .flat_map(lambda line: line.split())
+        .map(lambda w: (w, 1))
+        .group_by(0)
+        .sum(1)
+        .collect()
+    )
+    d = dict(counts)
+    assert d["to"] == 2.0 and d["be"] == 2.0 and d["question"] == 1.0
+
+
+def test_grouped_aggregates_device_path():
+    env = _env()
+    data = [(f"k{i % 3}", float(i)) for i in range(30)]
+    ds = env.from_collection(data).group_by(0)
+    assert dict(ds.max(1).collect())["k0"] == 27.0
+    assert dict(ds.min(1).collect())["k1"] == 1.0
+    assert dict(ds.count().collect())["k2"] == 10.0
+    assert dict(ds.mean(1).collect())["k0"] == pytest.approx(13.5)
+
+
+def test_grouped_reduce_and_group_reduce():
+    env = _env()
+    ds = env.from_collection([("a", 2), ("a", 3), ("b", 5)])
+    out = ds.group_by(0).reduce(lambda x, y: (x[0], x[1] * y[1])).collect()
+    assert sorted(out) == [("a", 6), ("b", 5)]
+    out = (
+        env.from_collection([("a", 3), ("a", 1), ("b", 2)])
+        .group_by(0).sort_group(1).reduce_group(
+            lambda g: [tuple(v for _, v in g)]
+        ).collect()
+    )
+    assert sorted(out) == [(1, 3), (2,)]
+
+
+def test_joins():
+    env = _env()
+    users = env.from_collection([(1, "alice"), (2, "bob"), (3, "carol")])
+    orders = env.from_collection([(1, "x"), (1, "y"), (3, "z"), (9, "w")])
+    inner = users.join(orders).where(0).equal_to(0).apply(
+        lambda u, o: (u[1], o[1])
+    ).collect()
+    assert sorted(inner) == [("alice", "x"), ("alice", "y"), ("carol", "z")]
+
+    left = users.left_outer_join(orders).where(0).equal_to(0).apply(
+        lambda u, o: (u[1], o[1] if o else None)
+    ).collect()
+    assert ("bob", None) in left
+
+    full = users.full_outer_join(orders).where(0).equal_to(0).apply(
+        lambda u, o: ((u or o)[0], u is not None, o is not None)
+    ).collect()
+    assert (9, False, True) in full
+
+    cg = users.co_group(orders).where(0).equal_to(0).apply(
+        lambda us, os_: [(len(us), len(os_))]
+    ).collect()
+    assert sorted(cg) == [(0, 1), (1, 0), (1, 1), (1, 2)]
+
+
+def test_cross_distinct_first_sort_union():
+    env = _env()
+    a = env.from_elements(1, 2)
+    b = env.from_elements("x", "y")
+    assert sorted(a.cross(b).collect()) == [
+        (1, "x"), (1, "y"), (2, "x"), (2, "y")
+    ]
+    assert sorted(
+        env.from_collection([3, 1, 3, 2, 1]).distinct().collect()
+    ) == [1, 2, 3]
+    assert env.from_collection([5, 3, 1]).sort_partition(
+        ascending=True
+    ).first(2).collect() == [1, 3]
+    assert sorted(a.union(env.from_elements(7)).collect()) == [1, 2, 7]
+    assert env.generate_sequence(1, 5).zip_with_index().collect()[2] == (2, 3)
+
+
+def test_full_reduce_and_aggregates():
+    env = _env()
+    assert env.generate_sequence(1, 10).reduce(
+        lambda a, b: a + b
+    ).collect() == [55]
+    assert env.from_collection([(1, 9), (2, 3)]).min_by(1).collect() == [(2, 3)]
+    assert env.from_collection([1.5, 2.5]).sum().collect() == [4.0]
+
+
+def test_bulk_iteration_pi_style():
+    """KMeans-flavored bulk iteration: 1-D centroid refinement."""
+    env = _env()
+    points = [float(x) for x in [1, 2, 3, 20, 21, 22]]
+
+    def step(centroids):
+        cs = centroids.collect()
+
+        def nearest(p):
+            return min(range(len(cs)), key=lambda i: abs(p - cs[i]))
+
+        assign = env.from_collection(points).map(lambda p: (nearest(p), p))
+        return assign.group_by(0).mean(1).map(lambda kv: kv[1])
+
+    out = sorted(
+        env.from_collection([0.0, 10.0]).iterate(10, step).collect()
+    )
+    assert out == [2.0, 21.0]
+
+
+def test_delta_iteration_connected_components():
+    """The reference's canonical delta-iteration example (ref
+    ConnectedComponents): propagate min component id along edges."""
+    env = _env()
+    vertices = [(i, i) for i in range(1, 8)]          # (vid, component)
+    edges = [(1, 2), (2, 3), (3, 4), (5, 6), (6, 7)]
+    undirected = edges + [(b, a) for a, b in edges]
+
+    def step(solution, workset):
+        # candidate components propagated to neighbors
+        cand = (
+            workset.join(env.from_collection(undirected))
+            .where(0).equal_to(0)
+            .apply(lambda w, e: (e[1], w[1]))
+            .group_by(0).min(1)
+            .map(lambda kv: (kv[0], int(kv[1])))
+        )
+        cur = {v: c for v, c in solution.collect()}
+        delta = cand.filter(lambda vc: vc[1] < cur[vc[0]])
+        return delta, delta
+
+    out = dict(
+        env.from_collection(vertices)
+        .delta_iterate(env.from_collection(vertices), 0, 10, step)
+        .collect()
+    )
+    assert out == {1: 1, 2: 1, 3: 1, 4: 1, 5: 5, 6: 5, 7: 5}
+
+
+def test_lazy_memoized_evaluation():
+    env = _env()
+    calls = []
+
+    def trace(x):
+        calls.append(x)
+        return x
+
+    base = env.from_collection([1, 2, 3]).map(trace)
+    a = base.map(lambda x: x + 1)
+    b = base.map(lambda x: x * 10)
+    assert not calls                       # lazy until an action
+    a.collect()
+    b.collect()
+    assert calls == [1, 2, 3]              # shared upstream ran once
+
+
+def test_csv_and_text_sources(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("1,alice\n2,bob\n")
+    env = _env()
+    rows = env.read_csv_file(str(p), types=[int, str]).collect()
+    assert rows == [(1, "alice"), (2, "bob")]
+    t = tmp_path / "t.txt"
+    t.write_text("x\ny\n")
+    assert env.read_text_file(str(t)).collect() == ["x", "y"]

@@ -1,0 +1,136 @@
+"""Graph (Gelly analog) + ML library semantics (ref flink-gelly library
+algorithm tests + flink-ml pipeline ITCases, SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.gelly import Graph
+from flink_tpu.ml import (
+    KNN,
+    SVM,
+    KMeans,
+    MinMaxScaler,
+    MultipleLinearRegression,
+    Pipeline,
+    PolynomialFeatures,
+    StandardScaler,
+)
+
+
+# ---------------------------------------------------------------- graph
+def _two_components():
+    return Graph.from_edge_list(
+        [("a", "b"), ("b", "c"), ("d", "e")], undirected=True
+    )
+
+
+def test_connected_components():
+    cc = _two_components().connected_components()
+    assert cc["a"] == cc["b"] == cc["c"]
+    assert cc["d"] == cc["e"]
+    assert cc["a"] != cc["d"]
+
+
+def test_degrees_and_transforms():
+    g = Graph.from_edge_list([(0, 1), (0, 2), (1, 2)])
+    assert g.out_degrees() == {0: 2, 1: 1, 2: 0}
+    assert g.in_degrees() == {0: 0, 1: 1, 2: 2}
+    assert g.reverse().out_degrees() == {0: 0, 1: 1, 2: 2}
+    g2 = g.map_edges(lambda ev: ev * 3.0)
+    assert float(np.asarray(g2.edge_values).sum()) == 9.0
+
+
+def test_sssp():
+    g = Graph.from_edge_list(
+        [("s", "a"), ("a", "b"), ("s", "b"), ("b", "c")],
+        edge_values=[1.0, 1.0, 5.0, 2.0],
+    )
+    d = g.single_source_shortest_paths("s")
+    assert d["s"] == 0.0
+    assert d["a"] == 1.0
+    assert d["b"] == 2.0   # s->a->b beats s->b
+    assert d["c"] == 4.0
+
+
+def test_page_rank_sums_to_one_and_ranks_hub():
+    # star: everyone links to 'hub'
+    edges = [(f"u{i}", "hub") for i in range(5)]
+    # give sources an incoming edge so they're reachable
+    edges += [("hub", f"u{i}") for i in range(5)]
+    g = Graph.from_edge_list(edges)
+    pr = g.page_rank(num_iterations=50)
+    assert sum(pr.values()) == pytest.approx(1.0, abs=1e-3)
+    assert pr["hub"] > max(v for k, v in pr.items() if k != "hub")
+
+
+def test_triangle_count():
+    g = Graph.from_edge_list([(0, 1), (1, 2), (2, 0), (2, 3)])
+    assert g.triangle_count() == 1
+
+
+# ------------------------------------------------------------------ ml
+def test_linear_regression_recovers_coefficients():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    y = X @ np.array([2.0, -1.0, 0.5]) + 3.0
+    mlr = MultipleLinearRegression(iterations=500, stepsize=0.2).fit(X, y)
+    w = np.asarray(mlr.weights)
+    assert np.allclose(w[:3], [2.0, -1.0, 0.5], atol=0.05)
+    assert abs(w[3] - 3.0) < 0.05
+    assert mlr.squared_residual_sum(X, y) < 1.0
+
+
+def test_svm_separates():
+    rng = np.random.default_rng(1)
+    X0 = rng.normal(loc=-2, size=(100, 2))
+    X1 = rng.normal(loc=+2, size=(100, 2))
+    X = np.vstack([X0, X1]).astype(np.float32)
+    y = np.array([-1.0] * 100 + [1.0] * 100, np.float32)
+    svm = SVM(iterations=500).fit(X, y)
+    pred = np.asarray(svm.predict(X))
+    assert (pred == y).mean() > 0.97
+
+
+def test_kmeans_finds_clusters():
+    rng = np.random.default_rng(2)
+    X = np.vstack([
+        rng.normal(loc=(0, 0), scale=0.3, size=(50, 2)),
+        rng.normal(loc=(5, 5), scale=0.3, size=(50, 2)),
+        rng.normal(loc=(0, 5), scale=0.3, size=(50, 2)),
+    ]).astype(np.float32)
+    km = KMeans(k=3, iterations=30).fit(X)
+    centers = sorted(np.asarray(km.centers).round(0).tolist())
+    assert centers == [[0.0, 0.0], [0.0, 5.0], [5.0, 5.0]]
+    labels = np.asarray(km.predict(X))
+    assert len(set(labels[:50])) == 1
+
+
+def test_knn_regression():
+    X = np.arange(20, dtype=np.float32)
+    y = X * 2.0
+    knn = KNN(k=3).fit(X, y)
+    pred = float(np.asarray(knn.predict(np.array([10.0])))[0])
+    assert pred == pytest.approx(20.0)
+
+
+def test_pipeline_chaining():
+    rng = np.random.default_rng(3)
+    X = rng.normal(loc=100.0, scale=50.0, size=(300, 2)).astype(np.float32)
+    y = (0.01 * X[:, 0] - 0.02 * X[:, 1] + 1.0).astype(np.float32)
+    pipe = Pipeline([
+        StandardScaler(),
+        MultipleLinearRegression(iterations=400, stepsize=0.3),
+    ]).fit(X, y)
+    pred = np.asarray(pipe.predict(X))
+    assert np.abs(pred - y).max() < 0.05
+
+
+def test_scalers_and_poly():
+    X = np.array([[1.0], [2.0], [3.0]], np.float32)
+    z = np.asarray(StandardScaler().fit_transform(X))
+    assert z.mean() == pytest.approx(0.0, abs=1e-6)
+    mm = np.asarray(MinMaxScaler().fit_transform(X))
+    assert mm.min() == 0.0 and mm.max() == 1.0
+    p = np.asarray(PolynomialFeatures(3).transform(X))
+    assert p.shape == (3, 3)
+    assert p[2].tolist() == [3.0, 9.0, 27.0]

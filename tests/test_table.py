@@ -1,0 +1,118 @@
+"""Table API + SQL subset semantics (ref flink-table ITCases)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.table import TableEnvironment, col
+
+
+def _env_with_orders():
+    env = TableEnvironment.create()
+    t = env.from_columns({
+        "user": ["a", "b", "a", "c", "b", "a"],
+        "amount": [10.0, 20.0, 30.0, 5.0, 15.0, 7.0],
+        "region": ["eu", "us", "eu", "eu", "us", "us"],
+    })
+    env.register_table("orders", t)
+    return env, t
+
+
+def test_select_where_projection():
+    _, t = _env_with_orders()
+    out = t.where(col("amount") > 9.0).select(
+        col("user"), (col("amount") * 2).alias("double")
+    )
+    assert out.schema == ["user", "double"]
+    assert out.to_rows() == [("a", 20.0), ("b", 40.0), ("a", 60.0), ("b", 30.0)]
+
+
+def test_group_by_aggregates():
+    _, t = _env_with_orders()
+    out = t.group_by("user").select(
+        "user", col("amount").sum.alias("total"),
+        col("amount").count.alias("n"),
+    ).order_by("user")
+    assert out.to_rows() == [("a", 47.0, 3.0), ("b", 35.0, 2.0), ("c", 5.0, 1.0)]
+
+
+def test_multi_key_grouping_and_global_agg():
+    _, t = _env_with_orders()
+    out = t.group_by("user", "region").select(
+        "user", "region", col("amount").sum.alias("s")
+    )
+    d = {(u, r): s for u, r, s in out.to_rows()}
+    assert d[("a", "eu")] == 40.0 and d[("a", "us")] == 7.0
+    g = t.select(col("amount").max.alias("m"), col("amount").avg.alias("a"))
+    assert g.to_rows() == [(30.0, pytest.approx(87.0 / 6))]
+
+
+def test_join_and_order_limit():
+    env, t = _env_with_orders()
+    users = env.from_columns({
+        "user": ["a", "b", "c"], "country": ["de", "us", "fr"],
+    })
+    j = t.join(users, "user").group_by("country").select(
+        "country", col("amount").sum.alias("total")
+    ).order_by("total", ascending=False).limit(1)
+    assert j.to_rows() == [("de", 47.0)]
+
+
+def test_left_join_unmatched():
+    env = TableEnvironment.create()
+    a = env.from_columns({"k": [1, 2], "v": [10, 20]})
+    b = env.from_columns({"k": [1], "w": [100]})
+    out = a.join(b, "k", how="left").order_by("k")
+    assert out.to_rows() == [(1, 10, 100), (2, 20, None)]
+
+
+def test_union_distinct():
+    env = TableEnvironment.create()
+    a = env.from_columns({"x": [1, 2]})
+    b = env.from_columns({"x": [2, 3]})
+    u = a.union_all(b)
+    assert u.count() == 4
+    assert sorted(r[0] for r in u.distinct().to_rows()) == [1, 2, 3]
+
+
+def test_sql_select_where():
+    env, _ = _env_with_orders()
+    out = env.sql_query(
+        "SELECT user, amount FROM orders WHERE amount > 9 AND region = 'eu'"
+    )
+    assert out.to_rows() == [("a", 10.0), ("a", 30.0)]
+
+
+def test_sql_group_by_order_limit():
+    env, _ = _env_with_orders()
+    out = env.sql_query(
+        "SELECT user, SUM(amount) AS total, COUNT(*) AS n FROM orders "
+        "GROUP BY user ORDER BY total DESC LIMIT 2"
+    )
+    assert out.to_rows() == [("a", 47.0, 3.0), ("b", 35.0, 2.0)]
+
+
+def test_sql_expressions():
+    env, _ = _env_with_orders()
+    out = env.sql_query(
+        "SELECT user, amount * 2 + 1 AS x FROM orders LIMIT 1"
+    )
+    assert out.to_rows() == [("a", 21.0)]
+
+
+def test_sql_star_and_errors():
+    env, t = _env_with_orders()
+    assert env.sql_query("SELECT * FROM orders LIMIT 2").count() == 2
+    with pytest.raises(ValueError):
+        env.sql_query("DELETE FROM orders")
+
+
+def test_right_and_full_outer_join():
+    env = TableEnvironment.create()
+    a = env.from_columns({"k": [1, 2], "v": [10, 20]})
+    b = env.from_columns({"k": [2, 3], "w": [200, 300]})
+    r = a.join(b, "k", how="right").order_by("k")
+    assert r.to_rows() == [(2, 20, 200), (3, None, 300)]
+    f = a.join(b, "k", how="full").order_by("k")
+    assert f.to_rows() == [(1, 10, None), (2, 20, 200), (3, None, 300)]
+    with pytest.raises(ValueError):
+        a.join(b, "k", how="cross")
