@@ -37,9 +37,12 @@ DEFAULT_PORT = 6123  # ref jobmanager.rpc.port default (flink-conf.yaml:33)
 
 
 def _addr(spec: str):
+    if ":" not in spec:  # bare hostname
+        return spec or "127.0.0.1", DEFAULT_PORT
     host, _, port = spec.rpartition(":")
-    if not host:  # bare hostname, no port
-        return port or "127.0.0.1", DEFAULT_PORT
+    host = host or "127.0.0.1"
+    if not port:
+        return host, DEFAULT_PORT
     try:
         return host, int(port)
     except ValueError:

@@ -253,11 +253,16 @@ class ScheduledReporter(threading.Thread):
         self._stop = threading.Event()
 
     def run(self):
+        import logging
+
+        log = logging.getLogger(__name__)
         while not self._stop.wait(self.interval_s):
             try:
                 self.reporter.report()
             except Exception:
-                pass  # a transient failure must not kill future reports
+                # a transient failure must not kill future reports, but a
+                # permanent one must be visible
+                log.warning("metric reporter failed", exc_info=True)
 
     def stop(self):
         self._stop.set()

@@ -1,0 +1,278 @@
+"""Native runtime layer — C++ ring-buffer ingestion, columnar record codec,
+and host spill store, bound via ctypes (SURVEY §2.10: the reference's
+Unsafe/Netty/RocksDB native surface, rebuilt for this runtime).
+
+The shared library compiles on first use (g++ -O2, ~1s) and is cached next
+to the sources; set FLINK_TPU_NATIVE_REBUILD=1 to force a rebuild.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_SO = os.path.join(_DIR, "_flink_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+RECORD_BYTES = 20  # u64 key | i64 ts_ms | f32 value
+
+
+def _build() -> str:
+    srcs = [os.path.join(_SRC, f) for f in ("ringbuf.cpp", "spillstore.cpp")]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if (
+        os.path.exists(_SO)
+        and os.path.getmtime(_SO) > newest_src
+        and not os.environ.get("FLINK_TPU_NATIVE_REBUILD")
+    ):
+        return _SO
+    tmp = _SO + ".tmp"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, *srcs, "-lrt",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _SO)
+    return _SO
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            f32p = ctypes.POINTER(ctypes.c_float)
+
+            lib.rb_create.restype = ctypes.c_void_p
+            lib.rb_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+            ]
+            lib.rb_destroy.argtypes = [ctypes.c_void_p]
+            lib.rb_capacity.restype = ctypes.c_uint64
+            lib.rb_capacity.argtypes = [ctypes.c_void_p]
+            lib.rb_readable.restype = ctypes.c_uint64
+            lib.rb_readable.argtypes = [ctypes.c_void_p]
+            lib.rb_write.restype = ctypes.c_int
+            lib.rb_write.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint32]
+            lib.rb_read.restype = ctypes.c_int64
+            lib.rb_read.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+
+            lib.records_encode.restype = ctypes.c_int64
+            lib.records_encode.argtypes = [
+                u64p, i64p, f32p, ctypes.c_uint64, u8p, ctypes.c_uint64,
+            ]
+            lib.records_decode.restype = ctypes.c_int64
+            lib.records_decode.argtypes = [
+                u8p, ctypes.c_uint64, u64p, i64p, f32p, ctypes.c_uint64,
+            ]
+
+            lib.spill_create.restype = ctypes.c_void_p
+            lib.spill_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+            lib.spill_destroy.argtypes = [ctypes.c_void_p]
+            lib.spill_count.restype = ctypes.c_uint64
+            lib.spill_count.argtypes = [ctypes.c_void_p]
+            lib.spill_capacity.restype = ctypes.c_uint64
+            lib.spill_capacity.argtypes = [ctypes.c_void_p]
+            lib.spill_width.restype = ctypes.c_uint64
+            lib.spill_width.argtypes = [ctypes.c_void_p]
+            lib.spill_put_batch.argtypes = [
+                ctypes.c_void_p, u64p, f32p, ctypes.c_uint64,
+            ]
+            lib.spill_get_batch.argtypes = [
+                ctypes.c_void_p, u64p, f32p, u8p, ctypes.c_uint64,
+            ]
+            lib.spill_delete_batch.restype = ctypes.c_uint64
+            lib.spill_delete_batch.argtypes = [
+                ctypes.c_void_p, u64p, ctypes.c_uint64,
+            ]
+            lib.spill_dump.restype = ctypes.c_uint64
+            lib.spill_dump.argtypes = [
+                ctypes.c_void_p, u64p, f32p, ctypes.c_uint64,
+            ]
+            lib.spill_save.restype = ctypes.c_int
+            lib.spill_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.spill_load.restype = ctypes.c_void_p
+            lib.spill_load.argtypes = [ctypes.c_char_p]
+            _lib = lib
+    return _lib
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class RingBuffer:
+    """SPSC ingestion ring (process-private, or named POSIX shm when `name`
+    is given — the cross-process DCN ingestion seam)."""
+
+    def __init__(self, capacity: int = 1 << 22, name: Optional[str] = None,
+                 create: bool = True):
+        self._lib = get_lib()
+        self._h = self._lib.rb_create(
+            name.encode() if name else None, capacity, int(create)
+        )
+        if not self._h:
+            raise OSError(f"ring buffer create failed (name={name!r})")
+        self._scratch = np.empty(capacity, np.uint8)
+
+    def close(self):
+        if self._h:
+            self._lib.rb_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @property
+    def readable_bytes(self) -> int:
+        return int(self._lib.rb_readable(self._h))
+
+    def write_bytes(self, payload: bytes) -> bool:
+        buf = np.frombuffer(payload, np.uint8)
+        return bool(self._lib.rb_write(self._h, _u8(buf), len(buf)))
+
+    def write_records(self, keys, ts_ms, values) -> bool:
+        """Columnar producer: encode + frame one batch; False = ring full
+        (backpressure)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        ts_ms = np.ascontiguousarray(ts_ms, np.int64)
+        values = np.ascontiguousarray(values, np.float32)
+        n = len(keys)
+        out = np.empty(n * RECORD_BYTES, np.uint8)
+        wrote = self._lib.records_encode(
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ts_ms.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, _u8(out), len(out),
+        )
+        if wrote < 0:
+            raise ValueError("encode overflow")
+        return bool(self._lib.rb_write(self._h, _u8(out), int(wrote)))
+
+    def read_batch(self) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Drain one framed batch into columnar arrays; None when empty."""
+        got = self._lib.rb_read(self._h, _u8(self._scratch),
+                                len(self._scratch))
+        if got == 0:
+            return None
+        if got < 0:
+            raise BufferError("batch larger than scratch buffer")
+        n = int(got) // RECORD_BYTES
+        keys = np.empty(n, np.uint64)
+        ts = np.empty(n, np.int64)
+        vals = np.empty(n, np.float32)
+        dec = self._lib.records_decode(
+            _u8(self._scratch), int(got),
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+        )
+        if dec < 0:
+            raise ValueError("frame corrupt (length not record-aligned)")
+        return keys, ts, vals
+
+
+class SpillStore:
+    """Host overflow tier for keyed state (the RocksDB seam): batch
+    put/get/delete of (u64 key -> float[width] block), save/load files."""
+
+    def __init__(self, width: int = 1, initial_capacity: int = 1024,
+                 _handle=None):
+        self._lib = get_lib()
+        self.width = width
+        self._h = (
+            _handle if _handle is not None
+            else self._lib.spill_create(initial_capacity, width)
+        )
+
+    def close(self):
+        if self._h:
+            self._lib.spill_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __len__(self) -> int:
+        return int(self._lib.spill_count(self._h))
+
+    def put(self, keys, values):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            len(keys), self.width
+        )
+        self._lib.spill_put_batch(
+            self._h,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(keys),
+        )
+
+    def get(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        n = len(keys)
+        vals = np.empty((n, self.width), np.float32)
+        found = np.empty(n, np.uint8)
+        self._lib.spill_get_batch(
+            self._h,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            _u8(found), n,
+        )
+        return vals, found.astype(bool)
+
+    def delete(self, keys) -> int:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        return int(self._lib.spill_delete_batch(
+            self._h,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(keys),
+        ))
+
+    def dump(self) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(self)
+        keys = np.empty(n, np.uint64)
+        vals = np.empty((n, self.width), np.float32)
+        got = self._lib.spill_dump(
+            self._h,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+        )
+        return keys[:got], vals[:got]
+
+    def save(self, path: str):
+        if not self._lib.spill_save(self._h, path.encode()):
+            raise OSError(f"spill save failed: {path}")
+
+    @classmethod
+    def load(cls, path: str) -> "SpillStore":
+        lib = get_lib()
+        h = lib.spill_load(path.encode())
+        if not h:
+            raise OSError(f"spill load failed: {path}")
+        # width recoverable from the file header via a probe dump
+        s = cls.__new__(cls)
+        s._lib = lib
+        s._h = h
+        s.width = int(lib.spill_width(h))
+        return s

@@ -1,0 +1,192 @@
+// Ingestion ring buffer + columnar record codec.
+//
+// Replaces the reference's native hot path (SURVEY §2.10): where Flink used
+// sun.misc.Unsafe MemorySegments + Netty buffers to move serialized records
+// (NetworkBufferPool / SpanningRecordSerializer), this is a lock-free SPSC
+// ring over POSIX shared memory: a producer (socket reader, Kafka client,
+// another process) frames record batches in, the Python executor drains them
+// GIL-free, and the fixed wire format parses straight into contiguous
+// columnar arrays ready for device upload — no per-record Python objects.
+//
+// Wire format, one record = 20 bytes little-endian:
+//     u64 key_id | i64 ts_ms | f32 value
+// Framing in the ring: u32 batch_len | batch bytes.
+//
+// SPSC memory ordering: producer writes payload THEN publishes head with
+// release; consumer reads head with acquire THEN payload. Single producer,
+// single consumer (the executor's poll loop), like the reference's
+// one-subpartition-one-reader channels.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+struct RingHeader {
+  std::atomic<uint64_t> head;  // next write offset (monotonic)
+  std::atomic<uint64_t> tail;  // next read offset (monotonic)
+  uint64_t capacity;           // data bytes
+  uint64_t magic;
+};
+
+static const uint64_t RB_MAGIC = 0x464c4e4b54505531ull;  // "FLNKTPU1"
+
+struct Ring {
+  RingHeader* hdr;
+  uint8_t* data;
+  int shm_fd;       // -1 for private memory
+  char name[256];
+  int owner;
+};
+
+static uint64_t ring_total_size(uint64_t capacity) {
+  return sizeof(RingHeader) + capacity;
+}
+
+// name == nullptr -> process-private (malloc); else POSIX shm for
+// cross-process ingestion.
+Ring* rb_create(const char* name, uint64_t capacity, int create) {
+  Ring* r = new Ring();
+  r->shm_fd = -1;
+  r->owner = create;
+  r->name[0] = 0;
+  void* mem = nullptr;
+  if (name == nullptr) {
+    mem = ::malloc(ring_total_size(capacity));
+    if (!mem) { delete r; return nullptr; }
+  } else {
+    std::strncpy(r->name, name, sizeof(r->name) - 1);
+    int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+    int fd = shm_open(name, flags, 0600);
+    if (fd < 0) { delete r; return nullptr; }
+    if (create && ftruncate(fd, (off_t)ring_total_size(capacity)) != 0) {
+      close(fd); shm_unlink(name); delete r; return nullptr;
+    }
+    if (!create) {
+      struct stat st;
+      if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < ring_total_size(1)) {
+        close(fd); delete r; return nullptr;
+      }
+    }
+    mem = mmap(nullptr, ring_total_size(capacity),
+               PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (mem == MAP_FAILED) { close(fd); delete r; return nullptr; }
+    r->shm_fd = fd;
+  }
+  r->hdr = (RingHeader*)mem;
+  r->data = (uint8_t*)mem + sizeof(RingHeader);
+  if (create || name == nullptr) {
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+    r->hdr->capacity = capacity;
+    r->hdr->magic = RB_MAGIC;
+  } else if (r->hdr->magic != RB_MAGIC) {
+    munmap(mem, ring_total_size(capacity));
+    close(r->shm_fd);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+void rb_destroy(Ring* r) {
+  if (!r) return;
+  if (r->shm_fd >= 0) {
+    munmap(r->hdr, ring_total_size(r->hdr->capacity));
+    close(r->shm_fd);
+    if (r->owner && r->name[0]) shm_unlink(r->name);
+  } else {
+    ::free(r->hdr);
+  }
+  delete r;
+}
+
+uint64_t rb_capacity(Ring* r) { return r->hdr->capacity; }
+
+uint64_t rb_readable(Ring* r) {
+  return r->hdr->head.load(std::memory_order_acquire) -
+         r->hdr->tail.load(std::memory_order_relaxed);
+}
+
+static void copy_in(Ring* r, uint64_t pos, const uint8_t* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  std::memcpy(r->data + off, src, first);
+  if (first < n) std::memcpy(r->data, src + first, n - first);
+}
+
+static void copy_out(Ring* r, uint64_t pos, uint8_t* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  std::memcpy(dst, r->data + off, first);
+  if (first < n) std::memcpy(dst + first, r->data, n - first);
+}
+
+// Frame one batch in; returns 1 on success, 0 if the ring lacks space
+// (backpressure — the reference's buffer-pool-exhaustion signal).
+int rb_write(Ring* r, const uint8_t* buf, uint32_t len) {
+  uint64_t need = 4ull + len;
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  if (r->hdr->capacity - (head - tail) < need) return 0;
+  copy_in(r, head, (const uint8_t*)&len, 4);
+  copy_in(r, head + 4, buf, len);
+  r->hdr->head.store(head + need, std::memory_order_release);
+  return 1;
+}
+
+// Drain one framed batch into out (max_len bytes); returns payload size,
+// 0 if empty, -1 if out is too small (batch left in place).
+int64_t rb_read(Ring* r, uint8_t* out, uint64_t max_len) {
+  uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (head == tail) return 0;
+  uint32_t len;
+  copy_out(r, tail, (uint8_t*)&len, 4);
+  if (len > max_len) return -1;
+  copy_out(r, tail + 4, out, len);
+  r->hdr->tail.store(tail + 4ull + len, std::memory_order_release);
+  return (int64_t)len;
+}
+
+// ---------------------------------------------------------------- codec
+// Encode columns -> wire bytes (producer side).
+int64_t records_encode(const uint64_t* keys, const int64_t* ts,
+                       const float* vals, uint64_t n, uint8_t* out,
+                       uint64_t out_len) {
+  const uint64_t need = n * 20ull;
+  if (out_len < need) return -1;
+  uint8_t* p = out;
+  for (uint64_t i = 0; i < n; i++) {
+    std::memcpy(p, &keys[i], 8); p += 8;
+    std::memcpy(p, &ts[i], 8);  p += 8;
+    std::memcpy(p, &vals[i], 4); p += 4;
+  }
+  return (int64_t)need;
+}
+
+// Decode wire bytes -> columns (consumer side, straight into numpy
+// buffers). Returns record count, -1 on frame error.
+int64_t records_decode(const uint8_t* in, uint64_t in_len, uint64_t* keys,
+                       int64_t* ts, float* vals, uint64_t max_n) {
+  if (in_len % 20 != 0) return -1;
+  uint64_t n = in_len / 20;
+  if (n > max_n) return -1;
+  const uint8_t* p = in;
+  for (uint64_t i = 0; i < n; i++) {
+    std::memcpy(&keys[i], p, 8); p += 8;
+    std::memcpy(&ts[i], p, 8);  p += 8;
+    std::memcpy(&vals[i], p, 4); p += 4;
+  }
+  return (int64_t)n;
+}
+
+}  // extern "C"

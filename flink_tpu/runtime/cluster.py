@@ -77,6 +77,15 @@ class JobControl:
             req, self._savepoint = self._savepoint, None
             return req
 
+    def remove_request(self, req: SavepointRequest) -> bool:
+        """Detach `req` only if it is still the pending one — a caller must
+        never pop another caller's request."""
+        with self._lock:
+            if self._savepoint is req:
+                self._savepoint = None
+                return True
+            return False
+
 
 @dataclass
 class JobRecord:
@@ -182,12 +191,12 @@ class MiniCluster:
         # the job may have finished between the status check and the
         # request attach, in which case its end-of-run drain already ran
         # and nothing will ever observe this request — fail it ourselves
-        if rec.status != "RUNNING":
-            if rec.control.take_savepoint_request() is req:
-                req.set_error(RuntimeError(
-                    f"job {job_id} ended ({rec.status}) before the "
-                    f"savepoint could be taken"
-                ))
+        # (remove_request never pops a different caller's request)
+        if rec.status != "RUNNING" and rec.control.remove_request(req):
+            req.set_error(RuntimeError(
+                f"job {job_id} ended ({rec.status}) before the "
+                f"savepoint could be taken"
+            ))
         return req.wait(timeout_s)
 
     def wait(self, job_id: str, timeout_s: Optional[float] = None) -> str:

@@ -106,6 +106,58 @@ class GeneratorSource(ColumnarSource):
         self.offset = int(state)
 
 
+class RingBufferSource(ColumnarSource):
+    """Drains the native C++ ingestion ring (flink_tpu.native.RingBuffer)
+    into the columnar fast path — the DCN ingestion front-end replacing the
+    reference's Netty server + record deserializer (SURVEY §2.10). A
+    producer thread/process pushes framed batches; poll() surfaces them as
+    {key_id, value} columns + timestamps with zero per-record Python work.
+
+    Not offset-replayable (the ring is transient, like a socket); pair with
+    an upstream replayable system for exactly-once, or accept at-least-once
+    on restore like the reference's socket source."""
+
+    def __init__(self, ring=None, capacity: int = 1 << 22,
+                 shm_name: Optional[str] = None, stop_when_idle: bool = False):
+        from flink_tpu.native import RingBuffer
+
+        self._owns_ring = ring is None
+        self.ring = ring or RingBuffer(
+            capacity, name=shm_name, create=shm_name is not None
+        )
+        self.stop_when_idle = stop_when_idle
+        self._ended = False
+
+    def end_of_stream(self):
+        """Producer-side signal: drain remaining batches, then stop."""
+        self._ended = True
+
+    def poll(self, max_records: int):
+        keys_l, ts_l, vals_l = [], [], []
+        n = 0
+        while n < max_records:
+            batch = self.ring.read_batch()
+            if batch is None:
+                break
+            k, t, v = batch
+            keys_l.append(k)
+            ts_l.append(t)
+            vals_l.append(v)
+            n += len(k)
+        if not keys_l:
+            end = self._ended or self.stop_when_idle
+            return ({}, None), end
+        keys = np.concatenate(keys_l)
+        ts = np.concatenate(ts_l)
+        vals = np.concatenate(vals_l)
+        return ({"key_id": keys, "value": vals}, ts), False
+
+    def close(self):
+        # a caller-supplied ring may still have a live producer attached
+        if self._owns_ring:
+            self.ring.close()
+
+
 class SocketTextStreamSource(Source):
     """socketTextStream: newline-delimited text over TCP
     (ref SocketTextStreamFunction role). Non-replayable (at-most-once on

@@ -261,9 +261,20 @@ class Table:
 
     def order_by(self, key: str, ascending: bool = True) -> "Table":
         k = key.name if isinstance(key, Expr) else key
-        idx = np.argsort(self.cols[k], kind="stable")
-        if not ascending:
-            idx = idx[::-1]
+        vals = self.cols[k]
+        if vals.dtype == object and any(v is None for v in vals.tolist()):
+            # outer joins produce None gaps: sort non-null values, NULLS
+            # LAST (the SQL default for ascending order)
+            none_mask = np.asarray([v is None for v in vals.tolist()])
+            idx_non = np.nonzero(~none_mask)[0]
+            idx_non = idx_non[np.argsort(vals[idx_non], kind="stable")]
+            if not ascending:
+                idx_non = idx_non[::-1]
+            idx = np.concatenate([idx_non, np.nonzero(none_mask)[0]])
+        else:
+            idx = np.argsort(vals, kind="stable")
+            if not ascending:
+                idx = idx[::-1]
         return Table({c: v[idx] for c, v in self.cols.items()})
 
     def limit(self, n: int) -> "Table":
@@ -387,8 +398,17 @@ def _parse_select_item(s: str) -> Expr:
 
 def _parse_expr(s: str) -> Expr:
     """SQL fragment -> Expr via the Python ast (SQL operators translated
-    first: = -> ==, AND/OR/NOT -> &/|/~, aggregate calls -> .agg props)."""
-    py = re.sub(r"(?<![<>=!])=(?!=)", "==", s)
+    first: = -> ==, AND/OR/NOT -> and/or/not, aggregate calls -> .agg
+    props). String literals are pulled out BEFORE keyword rewriting so
+    values like 'AND' or 'a=b' survive untouched."""
+    literals: List[str] = []
+
+    def stash(m):
+        literals.append(m.group(1).replace("''", "'"))
+        return f"__lit{len(literals) - 1}__"
+
+    py = re.sub(r"'((?:[^']|'')*)'", stash, s)
+    py = re.sub(r"(?<![<>=!])=(?!=)", "==", py)
     # python's `and`/`or`/`not` have SQL's precedence (below comparisons);
     # the builder turns BoolOp into elementwise &/|
     py = re.sub(r"\bAND\b", "and", py, flags=re.IGNORECASE)
@@ -404,6 +424,9 @@ def _parse_expr(s: str) -> Expr:
         if isinstance(node, ast.Name):
             if node.id == "__star__":
                 return lit(1.0)
+            m = re.fullmatch(r"__lit(\d+)__", node.id)
+            if m:
+                return lit(literals[int(m.group(1))])
             return col(node.id)
         if isinstance(node, ast.Constant):
             return lit(node.value)

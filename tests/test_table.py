@@ -116,3 +116,23 @@ def test_right_and_full_outer_join():
     assert f.to_rows() == [(1, 10, None), (2, 20, 200), (3, None, 300)]
     with pytest.raises(ValueError):
         a.join(b, "k", how="cross")
+
+
+def test_sql_string_literals_with_keywords():
+    env = TableEnvironment.create()
+    t = env.from_columns({
+        "tag": ["AND", "a=b", "x", "o'k"],
+        "v": [1, 2, 3, 4],
+    })
+    env.register_table("t", t)
+    assert env.sql_query("SELECT v FROM t WHERE tag = 'AND'").to_rows() == [(1,)]
+    assert env.sql_query("SELECT v FROM t WHERE tag = 'a=b'").to_rows() == [(2,)]
+    assert env.sql_query("SELECT v FROM t WHERE tag = 'o''k'").to_rows() == [(4,)]
+
+
+def test_order_by_with_nulls_from_outer_join():
+    env = TableEnvironment.create()
+    a = env.from_columns({"k": [1, 2], "v": [10, 20]})
+    b = env.from_columns({"k": [1], "w": [100]})
+    out = a.join(b, "k", how="left").order_by("w")
+    assert out.to_rows() == [(1, 10, 100), (2, 20, None)]   # NULLS LAST
