@@ -1,0 +1,25 @@
+"""Batch WordCount (ref flink-examples-batch WordCount.java)."""
+
+from flink_tpu.dataset import ExecutionEnvironment
+
+TEXT = [
+    "to be or not to be that is the question",
+    "whether tis nobler in the mind to suffer",
+]
+
+
+def main():
+    env = ExecutionEnvironment.get_execution_environment()
+    (
+        env.from_collection(TEXT)
+        .flat_map(str.split)
+        .map(lambda w: (w, 1))
+        .group_by(0)
+        .sum(1)
+        .sort_partition(1, ascending=False)
+        .print_()
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""CEP fraud detection — small-amount probe followed by a large charge on
+the same account within a time window (the canonical flink-cep example
+shape)."""
+
+from collections import namedtuple
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.cep import CEP, Pattern
+from flink_tpu.core.time import TimeCharacteristic
+
+Tx = namedtuple("Tx", ["ts", "account", "amount"])
+
+
+def main():
+    txs = [
+        Tx(1000, "acct-1", 0.5), Tx(2000, "acct-1", 812.0),   # fraud shape
+        Tx(1500, "acct-2", 42.0), Tx(3000, "acct-2", 55.0),   # normal
+        Tx(4000, "acct-3", 0.9), Tx(90_000, "acct-3", 700.0),  # too far apart
+        Tx(120_000, "flush", 0.0),
+    ]
+    pattern = (
+        Pattern.begin("probe").where(lambda t: t.amount < 1.0)
+        .followed_by("charge").where(lambda t: t.amount > 500.0)
+        .within(60_000)
+    )
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    stream = (
+        env.from_collection(txs)
+        .assign_timestamps_and_watermarks(lambda t: t.ts)
+        .key_by(lambda t: t.account)
+    )
+    CEP.pattern(stream, pattern).select(
+        lambda m: f"ALERT {m['probe'].account}: probe "
+                  f"{m['probe'].amount} then charge {m['charge'].amount}"
+    ).print_()
+    env.execute("fraud-detection")
+
+
+if __name__ == "__main__":
+    main()

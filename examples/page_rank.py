@@ -1,0 +1,18 @@
+"""PageRank on the device graph library (ref flink-examples-batch
+PageRank.java / flink-gelly PageRank)."""
+
+from flink_tpu.gelly import Graph
+
+
+def main():
+    edges = [
+        ("news", "blog"), ("blog", "news"), ("wiki", "news"),
+        ("wiki", "blog"), ("shop", "news"), ("blog", "wiki"),
+    ]
+    pr = Graph.from_edge_list(edges).page_rank(num_iterations=50)
+    for page, rank in sorted(pr.items(), key=lambda kv: -kv[1]):
+        print(f"{page:6s} {rank:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""SocketWindowWordCount — the reference's flagship streaming example
+(flink-examples-streaming/.../socket/SocketWindowWordCount.java:76-79,
+BASELINE config #1):
+
+    nc -lk 9999                 # feed words
+    python examples/socket_window_word_count.py --port 9999
+
+Lines are split into words, keyed by word, counted over a 5s processing-
+time tumbling window, and printed.
+"""
+
+import argparse
+
+from flink_tpu import StreamExecutionEnvironment
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=9999)
+    args = ap.parse_args()
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    (
+        env.socket_text_stream(args.host, args.port)
+        .flat_map(str.split)
+        .key_by(lambda w: w)
+        .time_window(5000)
+        .count()
+        .map(lambda r: f"{r.key} : {int(r.value)}")
+        .print_()
+    )
+    env.execute("socket-window-word-count")
+
+
+if __name__ == "__main__":
+    main()

@@ -199,6 +199,30 @@ class KeyedStream(DataStream):
         )
         return DataStream(self.env, t)
 
+    def as_queryable_state(self, name: str, extractor=None,
+                           kind: str = "latest") -> DataStream:
+        """Expose this keyed stream's latest value (or a running sum) for
+        external point lookups under `name` (ref
+        KeyedStream.asQueryableState:578 + the KvState server, §2.2).
+        Query via env.query_state(name, key), the web monitor's
+        /jobs/<jid>/state/<name>?key=..., or QueryableStateClient."""
+        if kind == "latest":
+            factory = lambda: ReduceSpec(  # noqa: E731
+                "generic", jnp.float32, combine=lambda a, b: b, neutral=0.0
+            )
+        elif kind == "sum":
+            factory = lambda: ReduceSpec("sum", jnp.float32)  # noqa: E731
+        else:
+            raise ValueError(f"unsupported queryable kind {kind!r}")
+        t = sg.KeyedProcessTransformation(
+            name, self.transformation,
+            reduce_spec_factory=factory,
+            extractor=_field_extractor(extractor) if extractor is not None
+            else (lambda e: e),
+        )
+        ds = DataStream(self.env, t)
+        return ds.add_sink(sink_mod.DiscardingSink())
+
 
 class IterativeStream(DataStream):
     """Result of DataStream.iterate (ref IterativeStream.closeWith)."""

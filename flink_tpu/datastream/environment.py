@@ -35,9 +35,16 @@ class StreamExecutionEnvironment:
         self._sinks: List[sg.SinkTransformation] = []
         self.last_job = None  # JobHandle of the last execute()
         from flink_tpu.metrics import MetricRegistry
+        from flink_tpu.runtime.queryable import KvStateRegistry
 
         self.metric_registry = MetricRegistry()
         self._control = None  # cluster.JobControl when cluster-submitted
+        self._kv_registry = KvStateRegistry()
+
+    def query_state(self, name: str, key):
+        """Point lookup into a running/finished job's queryable state
+        (ref QueryableStateClient against the local environment)."""
+        return self._kv_registry.query(name, key)
 
     # -- configuration (fluent, reference-shaped) ------------------------
     @staticmethod

@@ -49,7 +49,13 @@ def get_lib() -> ctypes.CDLL:
     global _lib
     with _lock:
         if _lib is None:
-            lib = ctypes.CDLL(_build())
+            try:
+                lib = ctypes.CDLL(_build())
+            except OSError:
+                # a stale/foreign-platform cached binary: force a rebuild
+                if os.path.exists(_SO):
+                    os.remove(_SO)
+                lib = ctypes.CDLL(_build())
             u8p = ctypes.POINTER(ctypes.c_uint8)
             u64p = ctypes.POINTER(ctypes.c_uint64)
             i64p = ctypes.POINTER(ctypes.c_int64)
@@ -125,7 +131,10 @@ class RingBuffer:
         )
         if not self._h:
             raise OSError(f"ring buffer create failed (name={name!r})")
-        self._scratch = np.empty(capacity, np.uint8)
+        # when attaching, the creator's capacity governs (read from the
+        # shared header) — size the scratch buffer from the real value
+        self._scratch = np.empty(int(self._lib.rb_capacity(self._h)),
+                                 np.uint8)
 
     def close(self):
         if self._h:
@@ -143,6 +152,8 @@ class RingBuffer:
         return int(self._lib.rb_readable(self._h))
 
     def write_bytes(self, payload: bytes) -> bool:
+        if not payload:
+            return True  # nothing to enqueue
         buf = np.frombuffer(payload, np.uint8)
         return bool(self._lib.rb_write(self._h, _u8(buf), len(buf)))
 
@@ -153,6 +164,8 @@ class RingBuffer:
         ts_ms = np.ascontiguousarray(ts_ms, np.int64)
         values = np.ascontiguousarray(values, np.float32)
         n = len(keys)
+        if n == 0:
+            return True
         out = np.empty(n * RECORD_BYTES, np.uint8)
         wrote = self._lib.records_encode(
             keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -195,11 +208,11 @@ class SpillStore:
     def __init__(self, width: int = 1, initial_capacity: int = 1024,
                  _handle=None):
         self._lib = get_lib()
-        self.width = width
         self._h = (
             _handle if _handle is not None
             else self._lib.spill_create(initial_capacity, width)
         )
+        self.width = int(self._lib.spill_width(self._h))
 
     def close(self):
         if self._h:
@@ -266,13 +279,7 @@ class SpillStore:
 
     @classmethod
     def load(cls, path: str) -> "SpillStore":
-        lib = get_lib()
-        h = lib.spill_load(path.encode())
+        h = get_lib().spill_load(path.encode())
         if not h:
             raise OSError(f"spill load failed: {path}")
-        # width recoverable from the file header via a probe dump
-        s = cls.__new__(cls)
-        s._lib = lib
-        s._h = h
-        s.width = int(lib.spill_width(h))
-        return s
+        return cls(_handle=h)

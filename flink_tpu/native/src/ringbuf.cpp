@@ -69,10 +69,26 @@ Ring* rb_create(const char* name, uint64_t capacity, int create) {
       close(fd); shm_unlink(name); delete r; return nullptr;
     }
     if (!create) {
+      // attaching: the CREATOR's capacity governs — read it from the
+      // header before mapping the full region, else copy_in/out would
+      // index past a too-small mapping
       struct stat st;
-      if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < ring_total_size(1)) {
+      if (fstat(fd, &st) != 0 ||
+          (uint64_t)st.st_size < sizeof(RingHeader)) {
         close(fd); delete r; return nullptr;
       }
+      void* hmem = mmap(nullptr, sizeof(RingHeader), PROT_READ,
+                        MAP_SHARED, fd, 0);
+      if (hmem == MAP_FAILED) { close(fd); delete r; return nullptr; }
+      RingHeader* h = (RingHeader*)hmem;
+      uint64_t actual = h->capacity;
+      uint64_t magic = h->magic;
+      munmap(hmem, sizeof(RingHeader));
+      if (magic != RB_MAGIC ||
+          (uint64_t)st.st_size < ring_total_size(actual)) {
+        close(fd); delete r; return nullptr;
+      }
+      capacity = actual;
     }
     mem = mmap(nullptr, ring_total_size(capacity),
                PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
@@ -133,6 +149,8 @@ static void copy_out(Ring* r, uint64_t pos, uint8_t* dst, uint64_t n) {
 // Frame one batch in; returns 1 on success, 0 if the ring lacks space
 // (backpressure — the reference's buffer-pool-exhaustion signal).
 int rb_write(Ring* r, const uint8_t* buf, uint32_t len) {
+  if (len == 0) return 1;  // empty frames would collide with the
+                           // consumer's ring-empty sentinel
   uint64_t need = 4ull + len;
   uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
   uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);

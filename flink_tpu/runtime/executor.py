@@ -567,6 +567,55 @@ class LocalExecutor:
 
         self._savepoint_writer = write_savepoint
 
+        def kv_query(key):
+            """Live point lookup into the device window state (queryable
+            state read path, SURVEY §2.2): host-side probe of the shard's
+            hash table + pane ring for the key. Returns
+            {"panes": {pane_id: value}, "slide_ms", "size_ms"} or None."""
+            if td is None or state is None:
+                return None
+            from flink_tpu.core.keygroups import assign_to_key_group
+            from flink_tpu.ops.hashing import route_hash
+
+            hi, lo = codec.encode(
+                np.asarray([key]) if np.isscalar(key) or isinstance(
+                    key, (int, float)
+                ) else [key],
+                keep_reverse=False,
+            )
+            kg = int(assign_to_key_group(
+                route_hash(hi, lo, np), ctx.max_parallelism, np
+            )[0])
+            starts, ends = ctx.kg_bounds()
+            shard = int(np.searchsorted(np.asarray(ends), kg))
+            tkeys = np.asarray(state.table.keys[shard])
+            match = np.nonzero(
+                (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])
+            )[0]
+            if match.size == 0:
+                return None
+            slot = int(match[0])
+            R = win.ring
+            acc_s = np.asarray(state.acc[shard])
+            acc2 = acc_s.reshape((tkeys.shape[0], R) + acc_s.shape[1:])
+            touched = np.asarray(state.touched[shard]).reshape(-1, R)
+            pane_ids = np.asarray(state.pane_ids[shard])
+            panes = {}
+            for r in range(R):
+                if touched[slot, r] and pane_ids[r] != wk.PANE_NONE:
+                    panes[int(pane_ids[r])] = np.asarray(
+                        acc2[slot, r]
+                    ).tolist()
+            return {
+                "panes": panes,
+                "slide_ms": slide_ms,
+                "size_ms": size_ms,
+            }
+
+        reg = getattr(env, "_kv_registry", None)
+        if reg is not None:
+            reg.register(wagg.name, kv_query)
+
         def run_step(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
             wm_ticks = (
@@ -935,6 +984,7 @@ class LocalExecutor:
         if hasattr(fn, "bind_internals"):
             # operators needing namespaced timers/state (GenericWindowOperator)
             fn.bind_internals(backend, timers)
+        reg = getattr(env, "_kv_registry", None)
         if isinstance(fn, RichFunction):
             fn.open(RuntimeContext(
                 backend,
@@ -943,6 +993,14 @@ class LocalExecutor:
                     if self._job_group is not None else None
                 ),
             ))
+        if reg is not None:
+            # states created in open() become queryable under their
+            # descriptor names (ref KvStateRegistry registration)
+            for state_name in list(backend._tables):
+                reg.register(
+                    state_name,
+                    lambda key, n=state_name: backend.lookup(n, key),
+                )
 
         wm_strategy = (
             pipe.ts_transform.strategy if pipe.ts_transform is not None
@@ -1127,6 +1185,40 @@ class LocalExecutor:
         B = env.batch_size
         keep_rev = env.config.get_bool("keys.reverse-map", True)
         codec = KeyCodec()
+
+        def kv_query(key):
+            """Queryable rolling accumulator (ref asQueryableState)."""
+            from flink_tpu.core.keygroups import assign_to_key_group
+            from flink_tpu.ops.hashing import route_hash
+
+            hi, lo = codec.encode(
+                np.asarray([key]) if np.isscalar(key) or isinstance(
+                    key, (int, float)
+                ) else [key],
+                keep_reverse=False,
+            )
+            kg = int(assign_to_key_group(
+                route_hash(hi, lo, np), ctx.max_parallelism, np
+            )[0])
+            starts, ends = ctx.kg_bounds()
+            shard = int(np.searchsorted(np.asarray(ends), kg))
+            tkeys = np.asarray(state.table.keys[shard])
+            match = np.nonzero(
+                (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])
+            )[0]
+            if match.size == 0:
+                return None
+            slot = int(match[0])
+            if not bool(np.asarray(state.touched[shard])[slot]):
+                return None
+            v = np.asarray(state.acc[shard])[slot]
+            if roll.result_fn is not None:
+                v = np.asarray(roll.result_fn(v))
+            return v.tolist()
+
+        reg = getattr(env, "_kv_registry", None)
+        if reg is not None:
+            reg.register(roll.name, kv_query)
 
         end = False
         while not end:

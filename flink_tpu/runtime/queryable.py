@@ -1,0 +1,74 @@
+"""Queryable state — external point lookups into live keyed state.
+
+The reference runs a dedicated Netty KvState server per TaskManager with
+location lookup through the JobManager (SURVEY §2.2: KvStateRegistry /
+QueryableStateClient / KvStateServerHandler). Here the registry lives on
+the environment, stages register read closures over their LIVE state
+(device arrays for compiled stages — reads snapshot the current array
+without pausing the job; heap tables for the generality path), and the web
+monitor serves lookups over HTTP:
+
+    GET /jobs/<jid>/state/<name>?key=<k>
+
+QueryableStateClient wraps that endpoint (the reference client's
+getKvState role).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+
+class KvStateRegistry:
+    def __init__(self):
+        self._fns: Dict[str, Callable[[Any], Any]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, name: str, fn: Callable[[Any], Any]):
+        with self._lock:
+            self._fns[name] = fn
+
+    def names(self):
+        with self._lock:
+            return sorted(self._fns)
+
+    def query(self, name: str, key):
+        with self._lock:
+            fn = self._fns.get(name)
+        if fn is None:
+            raise KeyError(f"no queryable state named {name!r}")
+        return fn(key)
+
+
+def parse_key(raw: str):
+    """HTTP query keys arrive as strings; recover numerics (the client
+    sends typed keys as their repr)."""
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+class QueryableStateClient:
+    """ref QueryableStateClient: point lookups against a running job."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.base = f"http://{host}:{port}"
+        self.timeout_s = timeout_s
+
+    def get_kv_state(self, job_id: str, name: str, key) -> Any:
+        q = urllib.parse.quote(str(key))
+        url = f"{self.base}/jobs/{job_id}/state/{name}?key={q}"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            payload = json.loads(r.read())
+        if not payload.get("ok", False):
+            raise KeyError(payload.get("error", "state query failed"))
+        return payload["value"]

@@ -126,3 +126,16 @@ class QueueSink(Sink):
 
     def invoke_batch(self, elements):
         self.queue.extend(elements)
+
+
+class DiscardingSink(Sink):
+    """Swallows output (ref DiscardingSink test util; used by
+    asQueryableState where the state itself is the product)."""
+
+    columnar = True
+
+    def invoke_batch(self, elements):
+        pass
+
+    def invoke_columnar(self, cols):
+        pass

@@ -118,13 +118,26 @@ class RingBufferSource(ColumnarSource):
     on restore like the reference's socket source."""
 
     def __init__(self, ring=None, capacity: int = 1 << 22,
-                 shm_name: Optional[str] = None, stop_when_idle: bool = False):
+                 shm_name: Optional[str] = None, stop_when_idle: bool = False,
+                 shm_create: Optional[bool] = None):
+        """shm_create: True = initialize the named segment (producer-owner
+        role), False = attach to an existing one (consumer role; never
+        resets a live producer's ring), None = attach if it exists, else
+        create."""
         from flink_tpu.native import RingBuffer
 
         self._owns_ring = ring is None
-        self.ring = ring or RingBuffer(
-            capacity, name=shm_name, create=shm_name is not None
-        )
+        if ring is not None:
+            self.ring = ring
+        elif shm_name is None:
+            self.ring = RingBuffer(capacity)
+        elif shm_create is None:
+            try:
+                self.ring = RingBuffer(capacity, name=shm_name, create=False)
+            except OSError:
+                self.ring = RingBuffer(capacity, name=shm_name, create=True)
+        else:
+            self.ring = RingBuffer(capacity, name=shm_name, create=shm_create)
         self.stop_when_idle = stop_when_idle
         self._ended = False
 
@@ -133,6 +146,10 @@ class RingBufferSource(ColumnarSource):
         self._ended = True
 
     def poll(self, max_records: int):
+        # snapshot the end flag BEFORE draining: the producer writes its
+        # final batches and THEN signals, so anything written before the
+        # signal is visible to this drain — no final-batch race
+        ended_before = self._ended
         keys_l, ts_l, vals_l = [], [], []
         n = 0
         while n < max_records:
@@ -145,7 +162,7 @@ class RingBufferSource(ColumnarSource):
             vals_l.append(v)
             n += len(k)
         if not keys_l:
-            end = self._ended or self.stop_when_idle
+            end = ended_before or self.stop_when_idle
             return ({}, None), end
         keys = np.concatenate(keys_l)
         ts = np.concatenate(ts_l)

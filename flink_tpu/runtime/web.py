@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -37,7 +38,9 @@ class WebMonitor:
 
             def do_GET(self):
                 try:
-                    body = monitor._route(self.path)
+                    u = urllib.parse.urlsplit(self.path)
+                    query = dict(urllib.parse.parse_qsl(u.query))
+                    body = monitor._route(u.path, query)
                     code = 200 if body is not None else 404
                     body = body if body is not None else {"error": "not found"}
                 except Exception as e:
@@ -64,7 +67,8 @@ class WebMonitor:
         self._server.server_close()
 
     # -- routing ---------------------------------------------------------
-    def _route(self, path: str) -> Optional[dict]:
+    def _route(self, path: str, query: Optional[dict] = None) -> Optional[dict]:
+        query = query or {}
         if path in ("/", "/overview"):
             jobs = self.cluster.list_jobs()
             return {
@@ -88,6 +92,22 @@ class WebMonitor:
             if rec is None:
                 return None
             return rec.env.metric_registry.snapshot()
+        m = re.fullmatch(r"/jobs/([^/]+)/state/([^/]+)", path)
+        if m:
+            from flink_tpu.runtime.queryable import parse_key
+
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            if "key" not in query:
+                return {"ok": False, "error": "missing ?key="}
+            try:
+                value = rec.env._kv_registry.query(
+                    m.group(2), parse_key(query["key"])
+                )
+            except KeyError as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True, "value": value}
         m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)
         if m:
             rec = self.cluster.jobs.get(m.group(1))

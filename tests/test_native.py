@@ -39,7 +39,7 @@ def test_ring_backpressure_and_wraparound():
     assert writes == 4096 // (100 * RECORD_BYTES + 4)
     # drain one, write one: wraparound path
     for _ in range(50):
-        assert rb.read_batch() is not None or True
+        assert rb.read_batch() is not None
         rb.write_records(*batch)
     # drain everything
     drained = 0

@@ -1,0 +1,153 @@
+"""Queryable state: live point lookups into device window/rolling state and
+heap process state, locally and over the web monitor (ref SURVEY §2.2
+KvStateRegistry/QueryableStateClient; asQueryableState:578)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.datastream.functions import ProcessFunction
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.state.descriptors import ValueStateDescriptor
+
+
+def _poll_until(fn, timeout_s: float = 60.0):
+    """First device step compiles (~seconds on the CPU mesh); poll until the
+    queryable state materializes."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            v = fn()
+        except KeyError:   # stage not registered yet
+            v = None
+        if v is not None:
+            return v
+        time.sleep(0.2)
+    raise AssertionError("state never became queryable")
+
+
+def test_queryable_rolling_state_after_job():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 16
+    data = [("a", 1.0), ("b", 2.0), ("a", 3.0), ("a", 5.0)]
+    (
+        env.from_collection(data)
+        .key_by(lambda e: e[0])
+        .as_queryable_state("latest-value", extractor=lambda e: e[1])
+    )
+    env.execute("queryable")
+    assert env.query_state("latest-value", "a") == 5.0
+    assert env.query_state("latest-value", "b") == 2.0
+    assert env.query_state("latest-value", "zzz") is None
+
+
+def test_queryable_sum_state():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 16
+    (
+        env.from_collection([("a", 1.0), ("b", 2.0), ("a", 3.0)])
+        .key_by(lambda e: e[0])
+        .as_queryable_state("running-sum", extractor=lambda e: e[1],
+                            kind="sum")
+    )
+    env.execute("queryable-sum")
+    assert env.query_state("running-sum", "a") == 4.0
+
+
+def test_queryable_window_panes_live():
+    """Open (unfired) window panes are queryable WHILE the job runs; after
+    the end-of-stream flush they are purged (fired state is gone, matching
+    the reference's cleanup-on-fire semantics)."""
+    from flink_tpu.runtime.cluster import MiniCluster
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 64
+    env.set_state_capacity(2048)
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        time.sleep(0.003)
+        return (
+            {"key": idx % 10, "value": np.ones(n, np.float32)},
+            (idx * 2).astype(np.int64),
+        )
+
+    (
+        env.add_source(GeneratorSource(gen))        # infinite
+        .key_by(lambda c: c["key"])
+        .time_window(60_000)                        # stays open
+        .sum(lambda c: c["value"])
+        .add_sink(CollectSink())
+    )
+    cluster = MiniCluster()
+    jid = cluster.submit(env, "live-window-query")
+    try:
+        res = _poll_until(lambda: env.query_state("window_sum", 3))
+        assert sum(v for v in res["panes"].values()) > 0
+        assert env.query_state("window_sum", 12345) is None
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+
+
+def test_queryable_heap_process_state():
+    class Counter(ProcessFunction):
+        def open(self, ctx):
+            self.count = ctx.get_state(ValueStateDescriptor("count", default=0))
+
+        def process_element(self, e, ctx, out):
+            self.count.update(self.count.value() + 1)
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    (
+        env.from_collection(["x", "y", "x", "x"])
+        .key_by(lambda e: e)
+        .process(Counter())
+        .add_sink(CollectSink())
+    )
+    env.execute("heap-queryable")
+    assert env.query_state("count", "x") == 3
+    assert env.query_state("count", "y") == 1
+
+
+def test_queryable_over_web_monitor():
+    from flink_tpu.runtime.cluster import MiniCluster
+    from flink_tpu.runtime.queryable import QueryableStateClient
+    from flink_tpu.runtime.web import WebMonitor
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 64
+    env.set_state_capacity(2048)
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        time.sleep(0.003)
+        return {"key": idx % 10, "value": np.ones(n, np.float32)}, None
+
+    (
+        env.add_source(GeneratorSource(gen))    # infinite
+        .key_by(lambda c: c["key"])
+        .as_queryable_state("live-latest", extractor=lambda c: c["value"])
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "live-query")
+    try:
+        client = QueryableStateClient("127.0.0.1", port)
+        v = _poll_until(
+            lambda: client.get_kv_state(jid, "live-latest", 3)
+        )
+        assert v == 1.0
+        with pytest.raises(KeyError):
+            client.get_kv_state(jid, "no-such-state", 3)
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+        web.stop()
