@@ -86,7 +86,7 @@ def run_subject(total_events: int, warmup_events: int) -> tuple:
     env.set_parallelism(len(jax.devices()))
     env.set_max_parallelism(128)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
-    env.set_state_capacity(1 << 21)
+    env.set_state_capacity(1 << 22)
     env.batch_size = BATCH
 
     sink = CountingSink()

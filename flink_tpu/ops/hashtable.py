@@ -121,28 +121,47 @@ def _lookup_or_empty(table_keys, capacity, probe_len, hi, lo):
 def _upsert_impl(table_keys, hi, lo, static, valid):
     capacity, probe_len, max_rounds = static
 
-    def cond(carry):
-        table_keys, missing, rounds = carry
-        return jnp.any(missing) & (rounds < max_rounds)
+    # steady state (every key already present) pays exactly ONE [B, P]
+    # probe gather — the random-gather is the dominant per-record cost on
+    # TPU, so the whole insert path (claims + re-lookups) hides behind a
+    # cond taken only when a batch actually contains new keys
+    found0, slot0, _, _ = _lookup_or_empty(table_keys, capacity, probe_len,
+                                           hi, lo)
+    missing0 = valid & ~found0
 
-    def body(carry):
-        table_keys, missing, rounds = carry
-        found, _, has_empty, empty_slot = _lookup_or_empty(
+    def insert_path(table_keys):
+        def cond(carry):
+            table_keys, missing, rounds = carry
+            return jnp.any(missing) & (rounds < max_rounds)
+
+        def body(carry):
+            table_keys, missing, rounds = carry
+            found, _, has_empty, empty_slot = _lookup_or_empty(
+                table_keys, capacity, probe_len, hi, lo
+            )
+            claim = missing & ~found & has_empty
+            idx = jnp.where(claim, empty_slot, capacity)
+            rows = jnp.stack([hi, lo], axis=1)
+            table_keys = table_keys.at[idx].set(rows, mode="drop")
+            found2, _, _, _ = _lookup_or_empty(
+                table_keys, capacity, probe_len, hi, lo
+            )
+            return table_keys, missing & ~found2, rounds + 1
+
+        table_keys, _, _ = jax.lax.while_loop(
+            cond, body, (table_keys, missing0, jnp.int32(0))
+        )
+        found, slot, _, _ = _lookup_or_empty(
             table_keys, capacity, probe_len, hi, lo
         )
-        claim = missing & ~found & has_empty
-        idx = jnp.where(claim, empty_slot, capacity)
-        rows = jnp.stack([hi, lo], axis=1)
-        table_keys = table_keys.at[idx].set(rows, mode="drop")
-        found2, _, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
-        return table_keys, missing & ~found2, rounds + 1
+        return table_keys, slot, found
 
-    found, slot, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
-    missing0 = valid & ~found
-    table_keys, still_missing, _ = jax.lax.while_loop(
-        cond, body, (table_keys, missing0, jnp.int32(0))
+    table_keys, slot, found = jax.lax.cond(
+        jnp.any(missing0),
+        insert_path,
+        lambda tk: (tk, slot0, found0),
+        table_keys,
     )
-    found, slot, _, _ = _lookup_or_empty(table_keys, capacity, probe_len, hi, lo)
     ok = valid & found
     slot = jnp.where(ok, slot, capacity)
     return table_keys, slot, ok

@@ -71,15 +71,15 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
 
     khi_l, klo_l, pane_l, val_l, fresh_l = [], [], [], [], []
     for s in range(S):
-        t2 = touched[s].reshape(C, R)
-        slots, rings = np.nonzero(t2)
+        t2 = touched[s].reshape(R, C)   # ring-major device layout
+        rings, slots = np.nonzero(t2)
         if slots.size == 0:
             continue
         khi_l.append(keys[s, slots, 0])
         klo_l.append(keys[s, slots, 1])
         pane_l.append(pane_ids[s, rings])
-        val_l.append(acc[s].reshape((C, R) + acc.shape[2:])[slots, rings])
-        fresh_l.append(fresh[s].reshape(C, R)[slots, rings])
+        val_l.append(acc[s].reshape((R, C) + acc.shape[2:])[rings, slots])
+        fresh_l.append(fresh[s].reshape(R, C)[rings, slots])
     if khi_l:
         entries = {
             "key_hi": np.concatenate(khi_l),
@@ -168,7 +168,7 @@ def restore_window_state(entries, scalars, ctx, spec):
                     "restore: state does not fit the configured capacity"
                 )
             slots = np.asarray(slots)
-            flat = slots[inv] * R + (e_pane % R)
+            flat = (e_pane % R) * C + slots[inv]
             acc_s[flat] = e_val
             touched_s[flat] = True
             fresh_s[flat] = e_fr

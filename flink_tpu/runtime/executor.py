@@ -596,15 +596,16 @@ class LocalExecutor:
                 return None
             slot = int(match[0])
             R = win.ring
+            C_cap = tkeys.shape[0]
             acc_s = np.asarray(state.acc[shard])
-            acc2 = acc_s.reshape((tkeys.shape[0], R) + acc_s.shape[1:])
-            touched = np.asarray(state.touched[shard]).reshape(-1, R)
+            acc2 = acc_s.reshape((R, C_cap) + acc_s.shape[1:])
+            touched = np.asarray(state.touched[shard]).reshape(R, C_cap)
             pane_ids = np.asarray(state.pane_ids[shard])
             panes = {}
             for r in range(R):
-                if touched[slot, r] and pane_ids[r] != wk.PANE_NONE:
+                if touched[r, slot] and pane_ids[r] != wk.PANE_NONE:
                     panes[int(pane_ids[r])] = np.asarray(
-                        acc2[slot, r]
+                        acc2[r, slot]
                     ).tolist()
             return {
                 "panes": panes,

@@ -93,9 +93,11 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
         check_vma=False,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def step(state, hi, lo, ts, values, valid, wm):
-        """wm: int32[n_shards] watermark per shard (usually identical)."""
+        """wm: int32[n_shards] watermark per shard (usually identical).
+        State is DONATED: XLA updates the 100MB+ shard arrays in place
+        instead of copy-on-write; callers must not reuse the old state."""
         return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
 
     return step
