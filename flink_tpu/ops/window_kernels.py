@@ -374,6 +374,58 @@ class FireResult:
         return cls(*children)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CompactFires:
+    """Fire output packed on device so the host never transfers the dense
+    [Ft, C] mask/value planes or the [C, 2] key table: for lane f, entries
+    j < counts[f] are (key_hi[f, j], key_lo[f, j], values[f, j]) and the
+    whole lane shares window_end_ticks[f]. The host reads the small fields
+    (counts/lane_valid/window_end/n_fires), then slices only [:counts[f]]
+    of the packed arrays — O(actual fires) transferred instead of O(F*C).
+    """
+
+    key_hi: jax.Array           # uint32 [Ft, C]
+    key_lo: jax.Array           # uint32 [Ft, C]
+    values: jax.Array           # [Ft, C, *out_shape]
+    counts: jax.Array           # int32 [Ft] emitted keys per lane
+    window_end_ticks: jax.Array  # int32 [Ft]
+    n_fires: jax.Array          # int32 scalar: valid lanes
+    lane_valid: jax.Array       # bool [Ft]
+
+    def tree_flatten(self):
+        return (self.key_hi, self.key_lo, self.values, self.counts,
+                self.window_end_ticks, self.n_fires, self.lane_valid), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def compact_fires(table: SlotTable, fr: FireResult) -> CompactFires:
+    """Pack a dense FireResult into per-lane prefix buffers on device.
+
+    One cumsum + three row scatters per lane; the scatter target index of
+    a non-emitting slot is C (out of range) so mode='drop' discards it.
+    Replaces the host-side np.nonzero sweep over [Ft, C] masks and the
+    full table.keys transfer the round-1 emit path paid every step.
+    """
+    C = table.capacity
+    tk = table.keys
+
+    def pack(mask_f, vals_f):
+        pos = jnp.cumsum(mask_f.astype(jnp.int32)) - 1
+        idx = jnp.where(mask_f, pos, jnp.int32(C))
+        khi = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 0], mode="drop")
+        klo = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 1], mode="drop")
+        v = jnp.zeros_like(vals_f).at[idx].set(vals_f, mode="drop")
+        return khi, klo, v, jnp.sum(mask_f, dtype=jnp.int32)
+
+    khi, klo, v, counts = jax.vmap(pack)(fr.mask, fr.values)
+    return CompactFires(khi, klo, v, counts, fr.window_end_ticks,
+                        fr.n_fires, fr.lane_valid)
+
+
 def advance_and_fire(
     state: WindowShardState,
     win: WindowSpec,

@@ -17,6 +17,8 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
+import queue
+import threading
 import time
 from collections import namedtuple
 from typing import Any, Callable, List, Optional
@@ -32,7 +34,8 @@ from flink_tpu.ops import window_kernels as wk
 from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.runtime.step import (
     WindowStageSpec,
-    build_window_step,
+    build_window_fire_step,
+    build_window_update_step,
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
@@ -426,7 +429,8 @@ class LocalExecutor:
 
         win = None
         spec = None
-        step = None
+        update_step = None
+        fire_step = None
         state = None
         codec = KeyCodec()
         # reverse key map costs a python dict insert per record; benchmarks
@@ -439,7 +443,7 @@ class LocalExecutor:
         )
 
         def setup(origin_ms: int, fresh_state: bool = True):
-            nonlocal td, win, spec, step, state
+            nonlocal td, win, spec, update_step, fire_step, state
             td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
             ring = env.config.get_int("window.ring-panes", 0) or max(
                 8,
@@ -457,10 +461,18 @@ class LocalExecutor:
                 win=win, red=red,
                 capacity_per_shard=env.state_capacity_per_shard,
             )
-            if step is None:
-                step = build_window_step(ctx, spec)
+            if update_step is None:
+                update_step = build_window_update_step(ctx, spec)
+                fire_step = build_window_fire_step(ctx, spec)
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
+                # trigger both compiles NOW (inside any benchmark warmup)
+                # so the first real pane-boundary fire isn't a multi-second
+                # compile stall mid-measurement; firing at the MIN-sentinel
+                # watermark is a no-op on fresh state
+                self._empty_step(run_update, B, red, None)
+                cf = run_fire(None)
+                jax.block_until_ready(cf.counts)
 
         # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
         storage = None
@@ -478,12 +490,7 @@ class LocalExecutor:
             nonlocal next_cid, steps_at_ckpt, n_keys_logged
             # drain due fires so fired_through is uniform across shards and
             # the snapshot is an exact global cut (F-throttle divergence)
-            while True:
-                fr = self._empty_step(run_step, B, red,
-                                      int(wm_strategy.current()))
-                emit_fires(fr)
-                if int(np.asarray(fr.n_fires).sum()) == 0:
-                    break
+            drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
             if keep_rev:
                 items = list(
@@ -510,6 +517,8 @@ class LocalExecutor:
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
+            nonlocal host_fired_pane
+            host_fired_pane = -(2**62)   # re-arm boundary fire detection
             st = (
                 ckpt.CheckpointStorage(path_or_storage)
                 if isinstance(path_or_storage, str) else path_or_storage
@@ -545,12 +554,7 @@ class LocalExecutor:
             if td is None:
                 raise RuntimeError("no state to savepoint yet")
             sp = ckpt.CheckpointStorage(path, retain=10**9)
-            while True:
-                fr = self._empty_step(run_step, B, red,
-                                      int(wm_strategy.current()))
-                emit_fires(fr)
-                if int(np.asarray(fr.n_fires).sum()) == 0:
-                    break
+            drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
             if keep_rev:
                 sp.append_keymap(list(codec._rev.items()))
@@ -567,11 +571,14 @@ class LocalExecutor:
 
         self._savepoint_writer = write_savepoint
 
-        def kv_query(key):
+        def kv_read(key):
             """Live point lookup into the device window state (queryable
             state read path, SURVEY §2.2): host-side probe of the shard's
             hash table + pane ring for the key. Returns
-            {"panes": {pane_id: value}, "slide_ms", "size_ms"} or None."""
+            {"panes": {pane_id: value}, "slide_ms", "size_ms"} or None.
+            MUST run on the executor thread while the job is live: the
+            window step donates the state buffers, so reading them from
+            another thread races XLA's in-place reuse (round-1 bug)."""
             if td is None or state is None:
                 return None
             from flink_tpu.core.keygroups import assign_to_key_group
@@ -613,11 +620,50 @@ class LocalExecutor:
                 "size_ms": size_ms,
             }
 
+        # -- queryable-state mailbox: queries from web/HTTP threads are
+        # served by the executor thread at step boundaries (between steps
+        # the donated device buffers are stable). `owner` claims in `box`
+        # are GIL-atomic dict setdefaults, so a request is served exactly
+        # once even when the job quiesces while a waiter is queued.
+        kv_mailbox = queue.SimpleQueue()
+        job_live = threading.Event()
+
+        def kv_query(key):
+            if not job_live.is_set():
+                return kv_read(key)     # job quiescent: direct read is safe
+            box = {}
+            ev = threading.Event()
+            kv_mailbox.put((key, box, ev))
+            while not ev.wait(0.25):
+                if not job_live.is_set():
+                    if box.setdefault("owner", "waiter") == "waiter":
+                        return kv_read(key)
+                    ev.wait(5.0)
+                    break
+            if "err" in box:
+                raise box["err"]
+            return box.get("val")
+
+        def drain_kv_mailbox():
+            while not kv_mailbox.empty():
+                key, box, ev = kv_mailbox.get()
+                if box.setdefault("owner", "exec") != "exec":
+                    ev.set()
+                    continue
+                try:
+                    box["val"] = kv_read(key)
+                except Exception as e:   # deliver to the querying thread
+                    box["err"] = e
+                ev.set()
+
         reg = getattr(env, "_kv_registry", None)
         if reg is not None:
             reg.register(wagg.name, kv_query)
 
-        def run_step(hi, lo, ticks, values, valid, wm_ms):
+        def run_update(hi, lo, ticks, values, valid, wm_ms):
+            """Dispatch one update-only device step. No host sync: the
+            result is not read, so transfers and compute of successive
+            steps overlap (the round-1 loop blocked on every step)."""
             nonlocal state
             wm_ticks = (
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
@@ -626,12 +672,23 @@ class LocalExecutor:
             wmv = jnp.full((ctx.n_shards,), np.int32(
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
-            state, fr = step(
+            state = update_step(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
             metrics.steps += 1
-            return fr
+
+        def run_fire(wm_ms):
+            nonlocal state
+            wm_ticks = (
+                min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+                if wm_ms is not None else None
+            )
+            wmv = jnp.full((ctx.n_shards,), np.int32(
+                wm_ticks if wm_ticks is not None else -(2**31) + 1
+            ))
+            state, cf = fire_step(state, wmv)
+            return cf
 
         columnar_emit = (
             len(pipe.branches) == 1
@@ -639,29 +696,32 @@ class LocalExecutor:
             and all(s.columnar for s in pipe.all_sinks)
         )
 
-        def emit_fires(fr):
-            n_f = np.asarray(fr.n_fires)
-            if int(n_f.sum()) == 0:
-                return 0
-            mask = np.asarray(fr.mask)
-            vals = np.asarray(fr.values)
-            ends = np.asarray(fr.window_end_ticks)
-            lanes = np.asarray(fr.lane_valid)
-            tkeys = np.asarray(state.table.keys)
-            khi_l, klo_l, end_l, val_l = [], [], [], []
-            for sh in range(mask.shape[0]):
+        def emit_fires(cf):
+            """Emit one CompactFires: read the small per-lane fields, then
+            transfer only [:count] slices of the device-packed key/value
+            buffers (no dense masks, no key-table transfer)."""
+            counts, lanes, ends = jax.device_get(
+                (cf.counts, cf.lane_valid, cf.window_end_ticks)
+            )
+            slices, end_l = [], []
+            for sh in range(counts.shape[0]):
                 for f in np.nonzero(lanes[sh])[0]:
-                    sel = np.nonzero(mask[sh, f])[0]
-                    if sel.size == 0:
+                    n = int(counts[sh, f])
+                    if n == 0:
                         continue
-                    khi_l.append(tkeys[sh, sel, 0])
-                    klo_l.append(tkeys[sh, sel, 1])
+                    slices.append((cf.key_hi[sh, f, :n], cf.key_lo[sh, f, :n],
+                                   cf.values[sh, f, :n]))
                     end_l.append(
-                        np.full(sel.size, td.to_ms(int(ends[sh, f])), np.int64)
+                        np.full(n, td.to_ms(int(ends[sh, f])), np.int64)
                     )
-                    val_l.append(vals[sh, f, sel])
-            if not khi_l:
+            if not slices:
                 return 0
+            # one batched fetch: the lazy device slices transfer together
+            # instead of 3 blocking round trips per (shard, lane)
+            fetched = jax.device_get(slices)
+            khi_l = [s[0] for s in fetched]
+            klo_l = [s[1] for s in fetched]
+            val_l = [s[2] for s in fetched]
             khi = np.concatenate(khi_l)
             klo = np.concatenate(klo_l)
             end_ms = np.concatenate(end_l)
@@ -685,13 +745,42 @@ class LocalExecutor:
             ]
             return _emit_batch(pipe, out, metrics)
 
+        def drain_fires(wm_ms):
+            """Fire every due window end at watermark wm_ms. One fire step
+            evaluates up to F window ends (+ up to F late re-fires); loop
+            while a full lane set came back, meaning backlog may remain."""
+            total = 0
+            F = win.fires_per_step
+            while True:
+                cf = run_fire(wm_ms)
+                lanes = np.asarray(cf.lane_valid)   # [S, Ft]
+                total += emit_fires(cf)
+                on_time = int(lanes[:, :F].sum(axis=1).max(initial=0))
+                late = int(lanes[:, F:].sum(axis=1).max(initial=0))
+                if on_time < F and late < F:
+                    return total
+
         def batch_loop():
             end = False
             while not end:
                 end = poll_cycle()
 
+        # Host-side fire scheduling: a window only becomes due when the
+        # watermark crosses a pane boundary. The host computes the
+        # watermark, so between crossings it dispatches update-only steps
+        # with no device readback at all. With allowedLateness > 0, late
+        # records can make already-fired windows due again at ANY step, so
+        # fires are drained eagerly every cycle (matching round-1 timing).
+        host_fired_pane = -(2**62)
+        eager_fire = wagg.allowed_lateness_ms > 0
+
+        def wm_pane_of(wm_ms) -> int:
+            wm_ticks = min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+            b = max(wm_ticks, -(2**31) + 1 + slide_ms)
+            return (b + 1 - slide_ms) // slide_ms   # floor div, as on device
+
         def poll_cycle():
-            nonlocal td
+            nonlocal td, host_fired_pane
             self._poll_control()
             polled, end = pipe.source.poll(B)
             now_ms = int(time.time() * 1000)
@@ -777,23 +866,56 @@ class LocalExecutor:
                         groups.append(order[lo_i:hi_i])
                         lo_i = hi_i
                 else:
-                    groups = [np.arange(n)]
-                for sel in groups:
-                    m = len(sel)
-                    fr = run_step(
-                        _pad(hi[sel], B, np.uint32),
-                        _pad(lo[sel], B, np.uint32),
-                        _pad(ticks[sel], B, np.int32),
-                        _pad(values[sel], B, values.dtype),
-                        _pad(np.ones(m, bool), B, bool),
-                        wm_ms,
-                    )
-                    emit_fires(fr)
+                    groups = None   # single group, no reindex copy
+                catch_up = groups is not None
+                wp = wm_pane_of(wm_ms)
+                ooo_ms = wm_strategy.out_of_orderness_ms
+                for sel in (groups if catch_up else (None,)):
+                    if sel is None:
+                        g_hi, g_lo, g_ticks, g_vals, m = hi, lo, ticks, values, n
+                        g_wm = wm_ms
+                    else:
+                        g_hi, g_lo, g_ticks, g_vals, m = (
+                            hi[sel], lo[sel], ticks[sel], values[sel], len(sel)
+                        )
+                        # group-local watermark: a replay burst's watermark
+                        # trails the group being applied, or later groups'
+                        # records would be late against their own poll's
+                        # final watermark (the reference applies the whole
+                        # burst before the periodic watermark advances)
+                        g_wm = min(
+                            td.to_ms(int(g_ticks.max())) - ooo_ms - 1, wm_ms
+                        )
+                    # a host chain (flat_map) can expand one poll beyond B
+                    # lanes; feed the step in B-sized chunks. The watermark
+                    # rides only the LAST chunk so every record of the poll
+                    # is late-checked against the pre-poll watermark.
+                    for off in range(0, m, B):
+                        hi_off = min(off + B, m)
+                        run_update(
+                            _pad(g_hi[off:hi_off], B, np.uint32),
+                            _pad(g_lo[off:hi_off], B, np.uint32),
+                            _pad(g_ticks[off:hi_off], B, np.int32),
+                            _pad(g_vals[off:hi_off], B, g_vals.dtype),
+                            _pad(np.ones(hi_off - off, bool), B, bool),
+                            g_wm if hi_off == m else None,
+                        )
+                    # catch-up slices must fire between groups or newer
+                    # panes would evict older unfired ones from the ring
+                    if catch_up:
+                        drain_fires(g_wm)
+                if eager_fire or wp > host_fired_pane:
+                    drain_fires(wm_ms)
+                    host_fired_pane = wp
             elif td is not None:
                 # idle poll: advance processing-time watermark
                 if not event_time:
-                    fr = self._empty_step(run_step, B, red, now_ms - 1)
-                    emit_fires(fr)
+                    wp = wm_pane_of(now_ms - 1)
+                    if wp > host_fired_pane:
+                        drain_fires(now_ms - 1)
+                        host_fired_pane = wp
+            if not kv_mailbox.empty():
+                drain_kv_mailbox()
             if (
                 storage is not None
                 and env.checkpoint_interval_steps > 0
@@ -805,33 +927,36 @@ class LocalExecutor:
 
         # -- run with restore + restart (ref ExecutionGraph.restart + ------
         # -- CheckpointCoordinator.restoreLatestCheckpointedState) ---------
-        if restore_from:
-            restore_checkpoint(restore_from)
-        restart = self._restart_strategy()
-        while True:
-            try:
-                batch_loop()
-                break
-            except JobCancelledException:
-                raise
-            except Exception:
-                can = (
-                    storage is not None
-                    and storage.latest() is not None
-                    and restart.should_restart()
-                )
-                if not can:
-                    raise
-                metrics.restarts += 1
-                restore_checkpoint(storage)
-
-        # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
-        if td is not None:
-            final_wm = td.to_ms(2**31 - 4)
+        # go live BEFORE restore: once td/state exist, a direct kv_read off
+        # the executor thread would race the first donated step
+        job_live.set()
+        try:
+            if restore_from:
+                restore_checkpoint(restore_from)
+            restart = self._restart_strategy()
             while True:
-                fr = self._empty_step(run_step, B, red, int(final_wm))
-                if emit_fires(fr) == 0 and int(np.asarray(fr.n_fires).sum()) == 0:
+                try:
+                    batch_loop()
                     break
+                except JobCancelledException:
+                    raise
+                except Exception:
+                    can = (
+                        storage is not None
+                        and storage.latest() is not None
+                        and restart.should_restart()
+                    )
+                    if not can:
+                        raise
+                    metrics.restarts += 1
+                    restore_checkpoint(storage)
+
+            # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
+            if td is not None:
+                drain_fires(int(td.to_ms(2**31 - 4)))
+        finally:
+            job_live.clear()
+            drain_kv_mailbox()
 
         if state is not None:
             metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
@@ -1188,10 +1313,14 @@ class LocalExecutor:
         codec = KeyCodec()
 
         def kv_query(key):
-            """Queryable rolling accumulator (ref asQueryableState)."""
+            """Queryable rolling accumulator (ref asQueryableState). The
+            rolling step does NOT donate, so a single snapshot of the state
+            reference yields a consistent pytree even while the job runs
+            (reading `state` repeatedly could tear across a rebind)."""
             from flink_tpu.core.keygroups import assign_to_key_group
             from flink_tpu.ops.hashing import route_hash
 
+            st = state
             hi, lo = codec.encode(
                 np.asarray([key]) if np.isscalar(key) or isinstance(
                     key, (int, float)
@@ -1203,16 +1332,16 @@ class LocalExecutor:
             )[0])
             starts, ends = ctx.kg_bounds()
             shard = int(np.searchsorted(np.asarray(ends), kg))
-            tkeys = np.asarray(state.table.keys[shard])
+            tkeys = np.asarray(st.table.keys[shard])
             match = np.nonzero(
                 (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])
             )[0]
             if match.size == 0:
                 return None
             slot = int(match[0])
-            if not bool(np.asarray(state.touched[shard])[slot]):
+            if not bool(np.asarray(st.touched[shard])[slot]):
                 return None
-            v = np.asarray(state.acc[shard])[slot]
+            v = np.asarray(st.acc[shard])[slot]
             if roll.result_fn is not None:
                 v = np.asarray(roll.result_fn(v))
             return v.tolist()

@@ -103,6 +103,87 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
     return step
 
 
+def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
+    """Update-only half of the window step: apply a micro-batch and advance
+    the shard watermark, but do NOT evaluate fires. The reference evaluates
+    timers on every watermark advance (HeapInternalTimerService), but a
+    window only becomes due when the watermark crosses a pane boundary —
+    once per slide interval, i.e. once in ~hundreds of micro-batches. The
+    host computes the watermark, so it knows exactly when that happens and
+    calls the fire step (build_window_fire_step) only then. Between
+    boundaries every step is sync-free: state is donated, nothing is read
+    back, and dispatch overlaps device compute."""
+    import dataclasses as _dc
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        if spec.pre is not None:
+            values, ts, valid = spec.pre(values, ts, valid)
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+            kg <= kg_end.astype(jnp.uint32)
+        )
+        state = wk.update(state, spec.win, spec.red, hi, lo, ts, values, mine)
+        state = _dc.replace(
+            state, watermark=jnp.maximum(state.watermark, wm[0])
+        )
+        return jax.tree_util.tree_map(lambda x: x[None], state)
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(), P(),
+            P(SHARD_AXIS),
+        ),
+        out_specs=P(SHARD_AXIS),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def update_step(state, hi, lo, ts, values, valid, wm):
+        return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
+
+    return update_step
+
+
+def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
+    """Fire-only half: advance the watermark, evaluate due window ends for
+    the whole key population, and return device-compacted fires
+    (wk.CompactFires). Called by the host only at pane-boundary crossings
+    (or to drain at checkpoints / end of stream)."""
+    mesh = ctx.mesh
+
+    def shard_body(state, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        state, fr = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
+        cf = wk.compact_fires(state.table, fr)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return pack(state), pack(cf)
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def fire_step(state, wm):
+        return sharded(state, wm)
+
+    return fire_step
+
+
 def watermark_vector(ctx: MeshContext, wm: int):
     return jnp.full((ctx.n_shards,), np.int32(wm))
 
