@@ -36,6 +36,7 @@ from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_window_fire_step,
     build_window_update_step,
+    build_window_update_step_exchange,
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
@@ -462,7 +463,21 @@ class LocalExecutor:
                 capacity_per_shard=env.state_capacity_per_shard,
             )
             if update_step is None:
-                update_step = build_window_update_step(ctx, spec)
+                # exchange.mode: "mask" (replicate-and-mask, default) or
+                # "all_to_all" (ICI record shuffle; per-device work O(B/n))
+                mode = env.config.get_str("exchange.mode", "mask")
+                if mode == "all_to_all" and ctx.n_shards > 1:
+                    if B % ctx.n_shards:
+                        raise ValueError(
+                            f"exchange.mode=all_to_all needs batch size "
+                            f"divisible by {ctx.n_shards} shards, got {B}"
+                        )
+                    update_step = build_window_update_step_exchange(
+                        ctx, spec, B // ctx.n_shards,
+                        env.config.get_float("exchange.capacity-factor", 2.0),
+                    )
+                else:
+                    update_step = build_window_update_step(ctx, spec)
                 fire_step = build_window_fire_step(ctx, spec)
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
