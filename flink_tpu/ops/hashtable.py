@@ -20,8 +20,9 @@ All operations are batched and jit-compatible:
     missing lane scatters its key row into its first empty slot (single
     [2]-wide scatter => row-atomic; duplicate claims -> exactly one winner),
     then re-looks-up. Lanes that lost a claim race retry against the updated
-    table. Rounds are bounded; with a warm key set the loop exits after the
-    first check. Duplicate keys within a batch need no dedup: they follow
+    table. Rounds are STATICALLY UNROLLED (no device control flow — a cond
+    costs ~30ms/step on the tunneled TPU runtime, an extra probe gather
+    ~0.06ms). Duplicate keys within a batch need no dedup: they follow
     identical probe chains and claim identical slots with identical rows.
 
 Failure is explicit: a lane whose probe chain has neither its key nor an
@@ -121,47 +122,28 @@ def _lookup_or_empty(table_keys, capacity, probe_len, hi, lo):
 def _upsert_impl(table_keys, hi, lo, static, valid):
     capacity, probe_len, max_rounds = static
 
-    # steady state (every key already present) pays exactly ONE [B, P]
-    # probe gather — the random-gather is the dominant per-record cost on
-    # TPU, so the whole insert path (claims + re-lookups) hides behind a
-    # cond taken only when a batch actually contains new keys
-    found0, slot0, _, _ = _lookup_or_empty(table_keys, capacity, probe_len,
-                                           hi, lo)
-    missing0 = valid & ~found0
-
-    def insert_path(table_keys):
-        def cond(carry):
-            table_keys, missing, rounds = carry
-            return jnp.any(missing) & (rounds < max_rounds)
-
-        def body(carry):
-            table_keys, missing, rounds = carry
-            found, _, has_empty, empty_slot = _lookup_or_empty(
-                table_keys, capacity, probe_len, hi, lo
-            )
-            claim = missing & ~found & has_empty
-            idx = jnp.where(claim, empty_slot, capacity)
-            rows = jnp.stack([hi, lo], axis=1)
-            table_keys = table_keys.at[idx].set(rows, mode="drop")
-            found2, _, _, _ = _lookup_or_empty(
-                table_keys, capacity, probe_len, hi, lo
-            )
-            return table_keys, missing & ~found2, rounds + 1
-
-        table_keys, _, _ = jax.lax.while_loop(
-            cond, body, (table_keys, missing0, jnp.int32(0))
-        )
-        found, slot, _, _ = _lookup_or_empty(
+    # STATICALLY UNROLLED claim rounds — deliberately no lax.cond /
+    # lax.while_loop. On the tunneled TPU runtime, data-dependent control
+    # flow in the step costs tens of ms per invocation (measured ~30ms for
+    # a never-taken cond wrapping this insert path), while an extra [B, P]
+    # probe gather costs ~0.06ms. So every step unconditionally runs
+    # `max_rounds` claim+relookup rounds; with no missing keys the claim
+    # scatters write nothing (all indices out of range, mode='drop') and
+    # the relookups are pure gathers. A lane whose claim loses the
+    # slot race to a different key retries against the updated table next
+    # round; conflicts decay geometrically, and max_rounds=4 settles even
+    # cold-start insert storms at the load factors we run (<=0.5).
+    rows = jnp.stack([hi, lo], axis=1)
+    found, slot, has_empty, empty_slot = _lookup_or_empty(
+        table_keys, capacity, probe_len, hi, lo
+    )
+    for _ in range(max_rounds):
+        claim = valid & ~found & has_empty
+        idx = jnp.where(claim, empty_slot, capacity)
+        table_keys = table_keys.at[idx].set(rows, mode="drop")
+        found, slot, has_empty, empty_slot = _lookup_or_empty(
             table_keys, capacity, probe_len, hi, lo
         )
-        return table_keys, slot, found
-
-    table_keys, slot, found = jax.lax.cond(
-        jnp.any(missing0),
-        insert_path,
-        lambda tk: (tk, slot0, found0),
-        table_keys,
-    )
     ok = valid & found
     slot = jnp.where(ok, slot, capacity)
     return table_keys, slot, ok
@@ -169,7 +151,7 @@ def _upsert_impl(table_keys, hi, lo, static, valid):
 
 def upsert(
     table: SlotTable, hi: jax.Array, lo: jax.Array, valid: jax.Array,
-    max_rounds: int = 8,
+    max_rounds: int = 4,
 ) -> Tuple[SlotTable, jax.Array, jax.Array]:
     """Insert-or-find a batch of keys.
 

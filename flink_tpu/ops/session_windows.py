@@ -186,15 +186,12 @@ def update_and_fire(
     w_vals = new_acc
     w_end = new_last + G
 
-    def do_close(active, acc):
-        cleared = jnp.where(
-            _bshape(w_mask, acc), jnp.asarray(neutral, red.dtype), acc
-        )
-        return active & ~w_mask, cleared
-
-    new_active, new_acc = jax.lax.cond(
-        jnp.any(w_mask), do_close, lambda a, ac: (a, ac), new_active, new_acc
+    # unconditional masked close: a lax.cond here costs ~30ms/step on the
+    # tunneled TPU runtime, while the all-false where is a cheap sweep
+    new_acc = jnp.where(
+        _bshape(w_mask, new_acc), jnp.asarray(neutral, red.dtype), new_acc
     )
+    new_active = new_active & ~w_mask
 
     new_state = SessionShardState(
         table=table, start=new_start, last=new_last, acc=new_acc,

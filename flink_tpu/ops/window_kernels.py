@@ -552,19 +552,11 @@ def advance_and_fire(
             return (lmask, lvals, sel, sel_ok, fresh2b,
                     jnp.sum(fresh2b, dtype=jnp.int32))
 
-        def no_late(fresh2):
-            return (
-                jnp.zeros((F, C), bool),
-                jnp.zeros((F, C) + red.out_shape, red.out_dtype),
-                jnp.full((F,), big),
-                jnp.zeros((F,), bool),
-                fresh2,
-                state.n_fresh,
-            )
-
-        lmask, lvals, lsel, lsel_ok, fresh2, n_fresh = jax.lax.cond(
-            state.n_fresh > 0, do_late, no_late, fresh2
-        )
+        # unconditionally evaluated: with no fresh panes every selection
+        # comes back empty and the state is unchanged. A lax.cond here
+        # costs ~30ms per invocation on the tunneled TPU runtime — far
+        # more than the masked sweep it would skip.
+        lmask, lvals, lsel, lsel_ok, fresh2, n_fresh = do_late(fresh2)
         mask = jnp.concatenate([mask, lmask])
         values = jnp.concatenate([values, lvals])
         window_end = jnp.concatenate(
@@ -594,12 +586,7 @@ def advance_and_fire(
         & (state.pane_ids > state.purged_through)
     )
     if win.lateness_ticks > 0:
-        fresh_guard = jax.lax.cond(
-            n_fresh > 0,
-            lambda: jnp.any(fresh2, axis=1),
-            lambda: jnp.zeros((R,), bool),
-        )
-        purgeable = purgeable & ~fresh_guard
+        purgeable = purgeable & ~jnp.any(fresh2, axis=1)
     neutral = red.neutral_value()
 
     # unconditional sweep (see update(): conds copy the big carried buffers)
