@@ -124,10 +124,15 @@ class RingBuffer:
     is given — the cross-process DCN ingestion seam)."""
 
     def __init__(self, capacity: int = 1 << 22, name: Optional[str] = None,
-                 create: bool = True):
+                 create=True):
+        """create: True = owner create (resets even a stale segment),
+        False = attach to an existing initialized segment,
+        "exclusive" = create only if absent (fails if the name exists —
+        the race-safe attach-or-create probe)."""
         self._lib = get_lib()
+        mode = 2 if create == "exclusive" else int(bool(create))
         self._h = self._lib.rb_create(
-            name.encode() if name else None, capacity, int(create)
+            name.encode() if name else None, capacity, mode
         )
         if not self._h:
             raise OSError(f"ring buffer create failed (name={name!r})")

@@ -20,6 +20,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdlib>
+#include <cerrno>
 #include <cstring>
 #include <fcntl.h>
 #include <sys/mman.h>
@@ -51,10 +52,22 @@ static uint64_t ring_total_size(uint64_t capacity) {
 
 // name == nullptr -> process-private (malloc); else POSIX shm for
 // cross-process ingestion.
+//
+// create modes:
+//   0 = attach to an existing, fully-initialized segment (magic checked)
+//   1 = owner create: reset even a stale pre-existing segment
+//   2 = exclusive create: fail with EEXIST if the segment already exists
+//       (the attach-or-create caller's probe — can never clobber a live
+//       producer's ring)
+// Creation is race-safe: the segment is created with O_EXCL and the magic
+// word is published LAST with release ordering, so a concurrent attacher
+// either sees no segment, an unfinished header (magic mismatch -> retry),
+// or a fully initialized ring — never a half-written one it could then
+// "repair" by re-creating (the round-1 bug).
 Ring* rb_create(const char* name, uint64_t capacity, int create) {
   Ring* r = new Ring();
   r->shm_fd = -1;
-  r->owner = create;
+  r->owner = create != 0;
   r->name[0] = 0;
   void* mem = nullptr;
   if (name == nullptr) {
@@ -62,8 +75,18 @@ Ring* rb_create(const char* name, uint64_t capacity, int create) {
     if (!mem) { delete r; return nullptr; }
   } else {
     std::strncpy(r->name, name, sizeof(r->name) - 1);
-    int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
-    int fd = shm_open(name, flags, 0600);
+    int fd = -1;
+    if (create) {
+      fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+      if (fd < 0 && errno == EEXIST && create == 1) {
+        // owner reset of a stale segment: remove, then recreate
+        // exclusively (the owner role is single-writer by contract)
+        shm_unlink(name);
+        fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+      }
+    } else {
+      fd = shm_open(name, O_RDWR, 0600);
+    }
     if (fd < 0) { delete r; return nullptr; }
     if (create && ftruncate(fd, (off_t)ring_total_size(capacity)) != 0) {
       close(fd); shm_unlink(name); delete r; return nullptr;
@@ -81,8 +104,8 @@ Ring* rb_create(const char* name, uint64_t capacity, int create) {
                         MAP_SHARED, fd, 0);
       if (hmem == MAP_FAILED) { close(fd); delete r; return nullptr; }
       RingHeader* h = (RingHeader*)hmem;
+      uint64_t magic = __atomic_load_n(&h->magic, __ATOMIC_ACQUIRE);
       uint64_t actual = h->capacity;
-      uint64_t magic = h->magic;
       munmap(hmem, sizeof(RingHeader));
       if (magic != RB_MAGIC ||
           (uint64_t)st.st_size < ring_total_size(actual)) {
@@ -101,8 +124,10 @@ Ring* rb_create(const char* name, uint64_t capacity, int create) {
     r->hdr->head.store(0, std::memory_order_relaxed);
     r->hdr->tail.store(0, std::memory_order_relaxed);
     r->hdr->capacity = capacity;
-    r->hdr->magic = RB_MAGIC;
-  } else if (r->hdr->magic != RB_MAGIC) {
+    // publish magic LAST: an attacher acquiring it is guaranteed to see
+    // the initialized header fields
+    __atomic_store_n(&r->hdr->magic, RB_MAGIC, __ATOMIC_RELEASE);
+  } else if (__atomic_load_n(&r->hdr->magic, __ATOMIC_ACQUIRE) != RB_MAGIC) {
     munmap(mem, ring_total_size(capacity));
     close(r->shm_fd);
     delete r;

@@ -549,6 +549,12 @@ class LocalExecutor:
             pipe.source.restore_offsets(offsets)
             sink_states = aux.get("sink_states")
             if sink_states:
+                if len(sink_states) != len(pipe.all_sinks):
+                    raise ValueError(
+                        f"checkpoint has {len(sink_states)} sink states but "
+                        f"the job topology has {len(pipe.all_sinks)} sinks — "
+                        f"restore with the matching pipeline"
+                    )
                 for s, ss in zip(pipe.all_sinks, sink_states):
                     s.restore_state(ss)
             wm_strategy._current = aux["wm_current"]
@@ -565,7 +571,15 @@ class LocalExecutor:
             """Manually-triggered versioned snapshot into its own directory
             (ref SavepointStore + CliFrontend ACTION_SAVEPOINT). Unlike
             periodic checkpoints, the full key map is embedded so the
-            savepoint directory is self-contained."""
+            savepoint directory is self-contained.
+
+            DOCUMENTED DIVERGENCE from the reference: windows already due
+            at the current watermark are fired and emitted to the sinks
+            BEFORE the snapshot (the reference's savepoint barrier
+            snapshots pending fires instead). This keeps the savepoint an
+            exact between-steps cut — restoring never re-fires or loses a
+            due window — at the cost of output timing being advanced by a
+            control-plane action."""
             if td is None:
                 raise RuntimeError("no state to savepoint yet")
             sp = ckpt.CheckpointStorage(path, retain=10**9)
@@ -1135,13 +1149,13 @@ class LocalExecutor:
                 ),
             ))
         if reg is not None:
-            # states created in open() become queryable under their
-            # descriptor names (ref KvStateRegistry registration)
-            for state_name in list(backend._tables):
-                reg.register(
-                    state_name,
-                    lambda key, n=state_name: backend.lookup(n, key),
-                )
+            # resolve against the backend's live table set at query time so
+            # states created lazily on the first record are queryable too,
+            # not only those created in open() (ref KvStateRegistry)
+            reg.register_resolver(
+                lambda: list(backend._tables),
+                lambda n, key: backend.lookup(n, key),
+            )
 
         wm_strategy = (
             pipe.ts_transform.strategy if pipe.ts_transform is not None
@@ -1195,6 +1209,12 @@ class LocalExecutor:
             pipe.source.restore_offsets(payload["offsets"])
             sink_states = payload.get("sink_states")
             if sink_states:
+                if len(sink_states) != len(pipe.all_sinks):
+                    raise ValueError(
+                        f"checkpoint has {len(sink_states)} sink states but "
+                        f"the job topology has {len(pipe.all_sinks)} sinks — "
+                        f"restore with the matching pipeline"
+                    )
                 for s, ss in zip(pipe.all_sinks, sink_states):
                     s.restore_state(ss)
             wm_strategy._current = payload["wm_current"]

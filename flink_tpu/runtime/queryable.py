@@ -26,22 +26,39 @@ from typing import Any, Callable, Dict, Optional
 class KvStateRegistry:
     def __init__(self):
         self._fns: Dict[str, Callable[[Any], Any]] = {}
+        # (names_fn, query_fn) pairs resolving states created lazily after
+        # registration time (e.g. a ValueState first touched mid-stream —
+        # the heap backend only knows its name once a record creates it)
+        self._resolvers = []
         self._lock = threading.Lock()
 
     def register(self, name: str, fn: Callable[[Any], Any]):
         with self._lock:
             self._fns[name] = fn
 
+    def register_resolver(self, names_fn: Callable[[], list],
+                          query_fn: Callable[[str, Any], Any]):
+        with self._lock:
+            self._resolvers.append((names_fn, query_fn))
+
     def names(self):
         with self._lock:
-            return sorted(self._fns)
+            out = set(self._fns)
+            resolvers = list(self._resolvers)
+        for names_fn, _ in resolvers:
+            out.update(names_fn())
+        return sorted(out)
 
     def query(self, name: str, key):
         with self._lock:
             fn = self._fns.get(name)
-        if fn is None:
-            raise KeyError(f"no queryable state named {name!r}")
-        return fn(key)
+            resolvers = list(self._resolvers)
+        if fn is not None:
+            return fn(key)
+        for names_fn, query_fn in resolvers:
+            if name in names_fn():
+                return query_fn(name, key)
+        raise KeyError(f"no queryable state named {name!r}")
 
 
 def parse_key(raw: str):

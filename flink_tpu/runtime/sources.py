@@ -132,10 +132,29 @@ class RingBufferSource(ColumnarSource):
         elif shm_name is None:
             self.ring = RingBuffer(capacity)
         elif shm_create is None:
+            # race-safe attach-or-create: exclusive create wins atomically
+            # or fails because the segment exists, in which case attach —
+            # retrying briefly in case the creator is still mid-init
+            # (magic is published last). Never resets a live producer's
+            # ring: this path has no owner-create fallback.
             try:
-                self.ring = RingBuffer(capacity, name=shm_name, create=False)
+                self.ring = RingBuffer(capacity, name=shm_name,
+                                       create="exclusive")
             except OSError:
-                self.ring = RingBuffer(capacity, name=shm_name, create=True)
+                last = None
+                for _ in range(50):
+                    try:
+                        self.ring = RingBuffer(capacity, name=shm_name,
+                                               create=False)
+                        break
+                    except OSError as e:
+                        last = e
+                        time.sleep(0.01)
+                else:
+                    raise OSError(
+                        f"ring {shm_name!r} exists but never became "
+                        f"initialized"
+                    ) from last
         else:
             self.ring = RingBuffer(capacity, name=shm_name, create=shm_create)
         self.stop_when_idle = stop_when_idle

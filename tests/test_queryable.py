@@ -116,6 +116,34 @@ def test_queryable_heap_process_state():
     assert env.query_state("count", "y") == 1
 
 
+def test_queryable_lazily_created_state():
+    """States first created on a record (not in open()) must be queryable
+    too — the registry resolves against the backend's live table set."""
+    class LazyCounter(ProcessFunction):
+        def open(self, ctx):
+            self.rt = ctx   # keep the RuntimeContext; create state later
+
+        def process_element(self, e, ctx, out):
+            # state created lazily on first record, per element kind
+            st = self.rt.get_state(
+                ValueStateDescriptor(f"lazy-{e}", default=0)
+            )
+            st.update(st.value() + 1)
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 8
+    (
+        env.from_collection(["x", "y", "x"])
+        .key_by(lambda e: e)
+        .process(LazyCounter())
+        .add_sink(CollectSink())
+    )
+    env.execute("lazy-queryable")
+    assert env.query_state("lazy-x", "x") == 2
+    assert env.query_state("lazy-y", "y") == 1
+    assert "lazy-x" in env._kv_registry.names()
+
+
 def test_queryable_over_web_monitor():
     from flink_tpu.runtime.cluster import MiniCluster
     from flink_tpu.runtime.queryable import QueryableStateClient
