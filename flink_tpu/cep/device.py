@@ -1,0 +1,232 @@
+"""TPU-resident CEP: the NFA as a segmented associative matrix scan.
+
+The reference advances one NFA per key one event at a time
+(flink-cep/.../nfa/NFA.java:132, computeNextStates:229): per event, each
+live partial match either takes, ignores, or dies. The TPU-native insight:
+with per-stage PARTIAL COUNTS as state, that transition is LINEAR —
+
+    state vector v = [c_0, ..., c_{S-2}, M, 1]
+      c_s = number of live partials whose last matched stage is s
+      M   = cumulative completed matches
+      1   = homogeneous coordinate (lets "start a new partial" be linear)
+
+    per event e with stage-match bits m_0..m_{S-1}:
+      c_s'  = m_s * c_{s-1}              (take into stage s)
+            + keep_s * c_s               (keep_s = 1 iff stage s+1 is
+                                          relaxed: the ignore transition —
+                                          a strict successor consumes or
+                                          kills, NFA.java take/ignore edges)
+      c_0' += m_0 * 1                    (every event may start a partial)
+      M'    = M + m_{S-1} * c_{S-2}      (take into the final stage emits)
+
+so one event is a (S+1)x(S+1) matrix T(e), and a KEY's whole event
+sequence is the ordered product T(e_k) @ ... @ T(e_1). A micro-batch is
+processed by sorting lanes by key slot (stable — preserves arrival order
+within a key) and running ONE jax.lax.associative_scan with a segmented
+matrix-product combiner. No per-event control flow, no per-key loops;
+B events x (S+1)^3 x log2(B) MXU-friendly work.
+
+Semantics vs the host NFA (cep/nfa.py — which stays as the generality
+path): match COUNTS and completion positions are exact, including the
+relaxed-contiguity branching explosion. What the count representation
+drops is the per-partial event list — match *extraction* (the
+{stage: event} maps) is host-side: the executor replays only the keys
+that completed a match this batch through the host NFA (rare in
+detection workloads). `within` pruning needs per-partial start
+timestamps, so patterns with within() take the host path.
+
+Counts saturate at INT32_MAX via int32 wraparound guard (clamped adds);
+a pattern whose branching actually approaches 2^31 live partials is
+degenerate under the reference too (its SharedBuffer would OOM).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.cep.pattern import Pattern, RELAXED
+from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashtable import SlotTable
+
+INT_MAX = np.float32(2**31 - 1)
+
+
+@dataclass(frozen=True)
+class DevicePatternSpec:
+    """Static compile spec of a linear pattern for the device NFA.
+
+    relaxed[s] — stage s's contiguity (relaxed=True for followedBy).
+    Built from a Pattern via `from_pattern`; patterns with within() are
+    rejected (host path handles them)."""
+
+    n_stages: int
+    relaxed: Tuple[bool, ...]
+
+    @staticmethod
+    def from_pattern(p: Pattern) -> "DevicePatternSpec":
+        if p.within_ms is not None:
+            raise ValueError(
+                "device CEP does not support within() — per-partial start "
+                "timestamps do not fit the count representation; use the "
+                "host NFA path"
+            )
+        return DevicePatternSpec(
+            n_stages=len(p.stages),
+            relaxed=tuple(s.contiguity == RELAXED for s in p.stages),
+        )
+
+    @property
+    def dim(self) -> int:
+        # [c_0 .. c_{S-2}, M, 1]
+        return self.n_stages + 1
+
+
+def event_matrices(spec: DevicePatternSpec, masks: jax.Array) -> jax.Array:
+    """masks: bool[B, S] stage-match bits per event -> T: f32[B, D, D].
+
+    Row layout of v (column vector convention, v' = T @ v):
+      rows 0..S-2: stage counts; row S-1: M; row S: const 1.
+    """
+    S = spec.n_stages
+    D = spec.dim
+    B = masks.shape[0]
+    m = masks.astype(jnp.float32)
+    T = jnp.zeros((B, D, D), jnp.float32)
+    # const row stays 1
+    T = T.at[:, D - 1, D - 1].set(1.0)
+    # M row: M' = M + m_{S-1} * c_{S-2}   (S == 1: + m_0 * 1)
+    T = T.at[:, S - 1, S - 1].set(1.0)
+    if S == 1:
+        T = T.at[:, 0, D - 1].add(m[:, 0])
+    else:
+        T = T.at[:, S - 1, S - 2].add(m[:, S - 1])
+        # stage rows
+        for s in range(S - 1):
+            keep = 1.0 if spec.relaxed[s + 1] else 0.0
+            T = T.at[:, s, s].add(keep)
+            if s == 0:
+                T = T.at[:, 0, D - 1].add(m[:, 0])   # start transition
+            else:
+                T = T.at[:, s, s - 1].add(m[:, s])   # take into stage s
+    return T
+
+
+def _seg_matmul(a, b):
+    """Segmented combiner for associative_scan: a/b = (seg_id, matrix).
+    Within a segment matrices compose; across a boundary the right
+    element resets the product."""
+    sa, Ma = a
+    sb, Mb = b
+    same = (sa == sb)[..., None, None]
+    return sb, jnp.where(same, Mb @ Ma, Mb)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CepShardState:
+    table: SlotTable
+    carry: jax.Array          # f32 [C+1, D] per-key state vector (+1 spill row)
+    dropped_capacity: jax.Array
+
+    def tree_flatten(self):
+        return (self.table, self.carry, self.dropped_capacity), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def init_state(capacity: int, probe_len: int,
+               spec: DevicePatternSpec) -> CepShardState:
+    D = spec.dim
+    carry = jnp.zeros((capacity + 1, D), jnp.float32)
+    carry = carry.at[:, D - 1].set(1.0)   # homogeneous 1
+    return CepShardState(
+        table=hashtable.create(capacity, probe_len),
+        carry=carry,
+        dropped_capacity=jnp.zeros((), jnp.int32),
+    )
+
+
+def advance(
+    state: CepShardState,
+    spec: DevicePatternSpec,
+    hi: jax.Array,
+    lo: jax.Array,
+    masks: jax.Array,     # bool [B, S]
+    valid: jax.Array,     # bool [B]
+) -> Tuple[CepShardState, jax.Array, jax.Array]:
+    """Advance every key's NFA by this micro-batch.
+
+    Returns (state', match_delta f32[B], match_total_per_lane) where
+    match_delta[i] = completed matches triggered exactly at lane i (in the
+    ORIGINAL lane order) — the host uses nonzero lanes for extraction."""
+    B = hi.shape[0]
+    C = state.table.capacity
+    D = spec.dim
+
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid)
+    n_nofit = jnp.sum(valid & ~ok, dtype=jnp.int32)
+    live = valid & ok
+    seg = jnp.where(live, slot, jnp.int32(C))   # dead lanes -> spill row
+
+    # stable sort by key slot: per-key event order preserved
+    order = jnp.argsort(seg, stable=True)
+    seg_s = seg[order]
+    masks_s = masks[order] & live[order, None]
+
+    T = event_matrices(spec, masks_s)
+    # invalid lanes: identity (no transition)
+    eye = jnp.eye(D, dtype=jnp.float32)
+    T = jnp.where(live[order][:, None, None], T, eye[None])
+
+    _, P = jax.lax.associative_scan(_seg_matmul, (seg_s, T))
+
+    v0 = state.carry[seg_s]                       # [B, D] per-lane carry
+    v = jnp.einsum("bij,bj->bi", P, v0)
+    v = jnp.minimum(v, INT_MAX)                   # saturate counts
+
+    # matches completed AT each sorted lane = M_i - M_{i-1} (same segment)
+    M = v[:, D - 2]
+    M_prev = jnp.concatenate([jnp.zeros(1, jnp.float32), M[:-1]])
+    same_prev = jnp.concatenate(
+        [jnp.zeros(1, bool), seg_s[1:] == seg_s[:-1]]
+    )
+    M0 = v0[:, D - 2]                             # carry M is 0 by reset
+    delta_s = M - jnp.where(same_prev, M_prev, M0)
+
+    # new carry = v of each segment's LAST lane, with M reset to 0
+    is_last = jnp.concatenate([seg_s[1:] != seg_s[:-1], jnp.ones(1, bool)])
+    v_out = v.at[:, D - 2].set(0.0)
+    carry = state.carry.at[jnp.where(is_last, seg_s, C + 0)].set(
+        jnp.where(is_last[:, None], v_out, 0.0), mode="drop"
+    )
+    # spill row stays the neutral vector
+    neutral = jnp.zeros(D, jnp.float32).at[D - 1].set(1.0)
+    carry = carry.at[C].set(neutral)
+
+    # scatter deltas back to original lane order
+    delta = jnp.zeros(B, jnp.float32).at[order].set(delta_s)
+
+    new_state = CepShardState(
+        table=table,
+        carry=carry,
+        dropped_capacity=state.dropped_capacity + n_nofit,
+    )
+    return new_state, delta, jnp.sum(delta_s)
+
+
+def host_masks(pattern: Pattern, events: Sequence) -> np.ndarray:
+    """Bridge for object-event tests: evaluate each stage's scalar
+    predicate over a list of host events -> bool[B, S]."""
+    S = len(pattern.stages)
+    out = np.zeros((len(events), S), bool)
+    for j, st in enumerate(pattern.stages):
+        out[:, j] = [bool(st.matches(e)) for e in events]
+    return out

@@ -1,0 +1,150 @@
+"""Device CEP (segmented associative matrix scan) vs the host NFA: match
+counts and completion positions must be identical on the reference-semantics
+vectors (ref NFA.java:132 computeNextStates:229)."""
+
+from collections import namedtuple
+
+import numpy as np
+import jax
+import pytest
+
+from flink_tpu.cep import NFA, Pattern
+from flink_tpu.cep import device as dcep
+
+Event = namedtuple("Event", ["ts", "name", "value"])
+
+
+def host_deltas(pattern, events):
+    """Per-event completed-match counts from the host NFA."""
+    nfa = NFA(pattern)
+    partials = nfa.initial_state()
+    out = []
+    for e in events:
+        partials, matches = nfa.process(partials, e, e.ts)
+        out.append(len(matches))
+    return out
+
+
+def device_run(pattern, key_events, capacity=64, batches=None):
+    """key_events: list of (key_id, event). Returns per-lane deltas."""
+    spec = dcep.DevicePatternSpec.from_pattern(pattern)
+    state = dcep.init_state(capacity, 8, spec)
+    keys = np.asarray([k for k, _ in key_events], np.uint64)
+    events = [e for _, e in key_events]
+    hi = (keys >> np.uint64(32)).astype(np.uint32) | np.uint32(0x80000000)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    masks = dcep.host_masks(pattern, events)
+    deltas = []
+    spans = batches or [(0, len(events))]
+    for a, b in spans:
+        state, d, _tot = dcep.advance(
+            state, spec, jax.numpy.asarray(hi[a:b]),
+            jax.numpy.asarray(lo[a:b]), jax.numpy.asarray(masks[a:b]),
+            jax.numpy.asarray(np.ones(b - a, bool)),
+        )
+        deltas.extend(np.asarray(d).astype(int).tolist())
+    assert int(np.asarray(state.dropped_capacity)) == 0
+    return deltas
+
+
+def test_strict_contiguity_matches_host():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b")
+    )
+    events = [Event(0, "a", 1), Event(1, "b", 2), Event(2, "a", 3),
+              Event(3, "x", 0), Event(4, "b", 4)]
+    hd = host_deltas(p, events)
+    dd = device_run(p, [(7, e) for e in events])
+    assert dd == hd == [0, 1, 0, 0, 0]
+
+
+def test_relaxed_branching_matches_host():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    events = [Event(0, "a", 1), Event(1, "x", 0), Event(2, "b", 2),
+              Event(3, "b", 3), Event(4, "a", 5), Event(5, "b", 6)]
+    hd = host_deltas(p, events)
+    dd = device_run(p, [(9, e) for e in events])
+    assert dd == hd
+    # branching: the final b completes against BOTH live a-partials
+    assert hd[-1] == 2
+
+
+def test_three_stage_conjunction_matches_host():
+    p = (
+        Pattern.begin("first").where(lambda e: e.name == "a")
+        .followed_by("mid").where(lambda e: e.name == "b")
+        .where(lambda e: e.value > 10)
+        .followed_by("last").where(lambda e: e.name == "c")
+    )
+    events = [Event(0, "a", 1), Event(1, "b", 5), Event(2, "b", 20),
+              Event(3, "c", 7), Event(4, "c", 8)]
+    hd = host_deltas(p, events)
+    dd = device_run(p, [(3, e) for e in events])
+    assert dd == hd
+    assert sum(hd) == 2
+
+
+def test_single_stage_or_predicate():
+    p = Pattern.begin("x").where(lambda e: e.name == "a").or_(
+        lambda e: e.value > 100
+    )
+    events = [Event(0, "a", 1), Event(1, "z", 500), Event(2, "z", 3)]
+    hd = host_deltas(p, events)
+    dd = device_run(p, [(1, e) for e in events])
+    assert dd == hd == [1, 1, 0]
+
+
+def test_cross_batch_carry():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    events = [Event(0, "a", 1), Event(1, "x", 0), Event(2, "b", 2),
+              Event(3, "b", 3)]
+    hd = host_deltas(p, events)
+    # split mid-stream: the a-partial must survive the batch boundary
+    dd = device_run(p, [(5, e) for e in events], batches=[(0, 2), (2, 4)])
+    assert dd == hd == [0, 0, 1, 1]
+
+
+def test_interleaved_keys_independent():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b")
+    )
+    # key 1 sees a,b (match); key 2 sees a,x,b (broken by x)
+    ke = [(1, Event(0, "a", 1)), (2, Event(1, "a", 9)),
+          (2, Event(2, "x", 0)), (1, Event(3, "b", 2)),
+          (2, Event(4, "b", 8))]
+    dd = device_run(p, ke)
+    assert dd == [0, 0, 0, 1, 0]
+    # host equivalent per key
+    assert host_deltas(p, [e for k, e in ke if k == 1]) == [0, 1]
+    assert host_deltas(p, [e for k, e in ke if k == 2]) == [0, 0, 0]
+
+
+def test_within_rejected_for_device_path():
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b").within(10)
+    )
+    with pytest.raises(ValueError, match="within"):
+        dcep.DevicePatternSpec.from_pattern(p)
+
+
+def test_branching_explosion_exactness():
+    """n a's followed by one b -> n matches (count exactness under
+    branching)."""
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    events = [Event(i, "a", i) for i in range(20)] + [Event(99, "b", 0)]
+    hd = host_deltas(p, events)
+    dd = device_run(p, [(4, e) for e in events])
+    assert dd == hd
+    assert dd[-1] == 20
