@@ -147,25 +147,16 @@ class WindowShardState:
     fired_through: jax.Array  # int32 scalar: last window-end pane emitted
     purged_through: jax.Array  # int32 scalar: panes <= this are known clean
     dropped_late: jax.Array     # int32 counter
-    dropped_capacity: jax.Array  # int32 counter (records genuinely lost)
+    dropped_capacity: jax.Array  # int32 counter (table full or ring overflow)
     fresh: jax.Array            # bool [C*R]: late-updated, pending re-fire
     n_fresh: jax.Array          # int32 scalar: count of set fresh flags
-    # overflow ring — records whose key found no table slot are appended
-    # here instead of dropped; the host drains them into the spill store
-    # (the RocksDB-analog tier) at sync boundaries. [O] lanes; O=0 disables.
-    ovf_hi: jax.Array           # uint32 [O]
-    ovf_lo: jax.Array           # uint32 [O]
-    ovf_pane: jax.Array         # int32 [O]
-    ovf_val: jax.Array          # [O, *value_shape] red.dtype
-    ovf_n: jax.Array            # int32 scalar: filled lanes
 
     def tree_flatten(self):
         return (
             (self.table, self.acc, self.touched, self.pane_ids, self.max_pane,
              self.min_pane, self.watermark, self.fired_through,
              self.purged_through, self.dropped_late, self.dropped_capacity,
-             self.fresh, self.n_fresh, self.ovf_hi, self.ovf_lo,
-             self.ovf_pane, self.ovf_val, self.ovf_n),
+             self.fresh, self.n_fresh),
             None,
         )
 
