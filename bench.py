@@ -25,7 +25,7 @@ import numpy as np
 N_KEYS = 1_000_000
 WINDOW_MS = 5_000
 EVENTS_PER_MS = 2_000          # event-time rate: 10M events per 5s window
-BATCH = 65_536
+BATCH = 262_144
 
 
 def gen_batch(offset, n):
@@ -36,10 +36,16 @@ def gen_batch(offset, n):
 
 
 # ---------------------------------------------------------------- baseline
-def run_baseline(total_events: int) -> float:
-    """Scalar per-record loop with dict-probe state + per-key fire drain."""
+def run_baseline(total_events: int):
+    """Scalar per-record loop with dict-probe state + per-key fire drain.
+
+    Returns (events/s, fire-latency samples [(n_windows, ms), ...]) where
+    latency is watermark-crossing -> emission, chunked every 8192 windows
+    of the sequential per-key timer drain (ref WindowOperator.onEventTime:
+    one callback per key on the task thread)."""
     state = {}          # (key, pane) -> acc   (the StateTable analog)
     fired = []
+    lat = []            # (n_windows, ms) weighted fire-latency samples
     wm_pane = -1
     done = 0
     t0 = time.perf_counter()
@@ -58,13 +64,38 @@ def run_baseline(total_events: int) -> float:
         # watermark advance: fire panes older than max ts (timer drain)
         new_wm_pane = tl[-1] // WINDOW_MS - 1
         if new_wm_pane > wm_pane:
+            t_cross = time.perf_counter()
+            chunk = 0
             for p in range(wm_pane + 1, new_wm_pane + 1):
                 drain = [sk for sk in state if sk[1] == p]
                 for sk in drain:
                     fired.append((sk[0], state.pop(sk)))
+                    chunk += 1
+                    if chunk >= 8192:
+                        lat.append(
+                            (chunk, (time.perf_counter() - t_cross) * 1e3)
+                        )
+                        chunk = 0
+            if chunk:
+                lat.append((chunk, (time.perf_counter() - t_cross) * 1e3))
             wm_pane = new_wm_pane
+    # end-of-stream drain of still-open panes (MAX-watermark analog)
+    t_cross = time.perf_counter()
+    n_left = len(state)
+    for sk in list(state):
+        fired.append((sk[0], state.pop(sk)))
+    if n_left:
+        lat.append((n_left, (time.perf_counter() - t_cross) * 1e3))
     dt = time.perf_counter() - t0
-    return done / dt
+    return done / dt, lat
+
+
+def _weighted_pct(samples, q):
+    """Percentile over windows from weighted (n, ms) samples (shared
+    implementation with JobMetrics.fire_latency_pct)."""
+    from flink_tpu.metrics.latency import weighted_percentile
+
+    return weighted_percentile(samples, q)
 
 
 # ---------------------------------------------------------------- subject
@@ -121,23 +152,42 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="CPU mesh instead of TPU")
     ap.add_argument("--events", type=int, default=30_000_000)
     ap.add_argument("--baseline-events", type=int, default=2_000_000)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="micro-batch size (default BATCH)")
     args = ap.parse_args()
+    if args.batch:
+        global BATCH
+        BATCH = args.batch
 
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
 
-    baseline_eps = run_baseline(args.baseline_events)
-    print(f"baseline (scalar heap path): {baseline_eps:,.0f} events/s",
-          file=sys.stderr)
+    def fmt(ms):
+        return f"{ms:.1f}ms" if ms is not None else "n/a"
+
+    def rnd(ms):
+        return round(ms, 2) if ms is not None else None
+
+    baseline_eps, base_lat = run_baseline(args.baseline_events)
+    base_p50 = _weighted_pct(base_lat, 50)
+    base_p99 = _weighted_pct(base_lat, 99)
+    print(
+        f"baseline (scalar heap path): {baseline_eps:,.0f} events/s | "
+        f"fire p50={fmt(base_p50)} p99={fmt(base_p99)}",
+        file=sys.stderr,
+    )
 
     warmup = min(args.events // 3, 5_000_000)
     subject_eps, job, sink = run_subject(args.events, warmup)
+    subj_p50 = job.metrics.fire_latency_pct(50)
+    subj_p99 = job.metrics.fire_latency_pct(99)
     print(
         f"subject: {subject_eps:,.0f} events/s steady-state | fires={sink.count:,}"
         f" | steps={job.metrics.steps} | late={job.metrics.dropped_late}"
-        f" | cap={job.metrics.dropped_capacity}",
+        f" | cap={job.metrics.dropped_capacity}"
+        f" | fire p50={fmt(subj_p50)} p99={fmt(subj_p99)}",
         file=sys.stderr,
     )
 
@@ -146,6 +196,11 @@ def main():
         "value": round(subject_eps),
         "unit": "events/s",
         "vs_baseline": round(subject_eps / baseline_eps, 2),
+        "p99_fire_ms": rnd(subj_p99),
+        "p50_fire_ms": rnd(subj_p50),
+        "baseline_p99_fire_ms": rnd(base_p99),
+        "baseline_p50_fire_ms": rnd(base_p50),
+        "batch": BATCH,
     }))
 
 

@@ -1,0 +1,310 @@
+"""Type serializer registry — the per-type serialization seam.
+
+The reference routes every record and every state value through a
+`TypeSerializer` chosen from `TypeInformation`, with user-registered
+custom serializers layered on top (ref
+flink-core/.../api/common/typeutils/TypeSerializer.java:39 and
+ExecutionConfig.registerTypeWithKryoSerializer). Round 1 shipped arbitrary
+Python objects through blanket pickle; this module restores the seam:
+
+  * ``TypeSerializer`` — serialize/deserialize one value to/from bytes,
+    plus a config-snapshot string used for restore-compatibility checks
+    (the analog of TypeSerializerConfigSnapshot).
+  * built-ins for the primitive lattice (long/double/bool/str/bytes),
+    tuples, lists, dicts and numpy arrays — all self-describing and
+    version-tagged.
+  * ``PickleSerializer`` — the explicit fallback (the Kryo-analog), still
+    available but now a *registered default* rather than the only path.
+  * ``SerializerRegistry`` — type -> serializer mapping with a
+    type-tagged envelope (``dumps_typed``/``loads_typed``) so
+    heterogeneous state maps round-trip through registered serializers.
+
+State snapshot/restore (state/backend.py) and checkpoint streams consult
+the active registry; ``StateDescriptor(serializer=...)`` pins one state to
+a specific serializer, mirroring descriptor-level serializer injection in
+the reference (StateDescriptor.java:50).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+
+class SerializationError(RuntimeError):
+    pass
+
+
+class TypeSerializer:
+    """One value <-> bytes. Subclasses must be stateless/reusable."""
+
+    #: short stable identifier written into snapshots for compat checks
+    uid: str = ""
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def config_snapshot(self) -> str:
+        """Restore-compatibility token (TypeSerializerConfigSnapshot
+        analog): restoring with a serializer whose snapshot differs is
+        refused rather than silently misread."""
+        return f"{type(self).__name__}:{self.uid}:v1"
+
+
+class _StructSerializer(TypeSerializer):
+    fmt = ""
+    cast: Callable = None
+
+    def serialize(self, value) -> bytes:
+        return struct.pack(self.fmt, self.cast(value))
+
+    def deserialize(self, data: bytes):
+        return struct.unpack(self.fmt, data)[0]
+
+
+class LongSerializer(_StructSerializer):
+    uid = "long"
+    fmt = "<q"
+    cast = staticmethod(int)
+
+
+class DoubleSerializer(_StructSerializer):
+    uid = "double"
+    fmt = "<d"
+    cast = staticmethod(float)
+
+
+class BoolSerializer(_StructSerializer):
+    uid = "bool"
+    fmt = "<?"
+    cast = staticmethod(bool)
+
+
+class StringSerializer(TypeSerializer):
+    uid = "string"
+
+    def serialize(self, value) -> bytes:
+        return str(value).encode("utf-8")
+
+    def deserialize(self, data: bytes):
+        return data.decode("utf-8")
+
+
+class BytesSerializer(TypeSerializer):
+    uid = "bytes"
+
+    def serialize(self, value) -> bytes:
+        return bytes(value)
+
+    def deserialize(self, data: bytes):
+        return data
+
+
+class NumpySerializer(TypeSerializer):
+    """Arrays via the npy wire format (self-describing dtype + shape)."""
+
+    uid = "ndarray"
+
+    def serialize(self, value) -> bytes:
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(value), allow_pickle=False)
+        return buf.getvalue()
+
+    def deserialize(self, data: bytes):
+        return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class PickleSerializer(TypeSerializer):
+    """The explicit generic fallback (Kryo-analog)."""
+
+    uid = "pickle"
+
+    def serialize(self, value) -> bytes:
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, data: bytes):
+        return pickle.loads(data)
+
+
+class TupleSerializer(TypeSerializer):
+    """Field-wise composite over a registry (TupleSerializer analog).
+
+    Self-describing: each field rides the registry's typed envelope, so
+    heterogeneous tuples round-trip without a schema."""
+
+    uid = "tuple"
+
+    def __init__(self, registry: "SerializerRegistry"):
+        self._reg = registry
+
+    def serialize(self, value) -> bytes:
+        out = [struct.pack("<I", len(value))]
+        for f in value:
+            blob = self._reg.dumps_typed(f)
+            out.append(struct.pack("<I", len(blob)))
+            out.append(blob)
+        return b"".join(out)
+
+    def deserialize(self, data: bytes):
+        (n,) = struct.unpack_from("<I", data, 0)
+        off = 4
+        fields = []
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            fields.append(self._reg.loads_typed(data[off:off + ln]))
+            off += ln
+        return tuple(fields)
+
+
+class ListSerializer(TupleSerializer):
+    uid = "list"
+
+    def deserialize(self, data: bytes):
+        return list(super().deserialize(data))
+
+
+class DictSerializer(TypeSerializer):
+    uid = "dict"
+
+    def __init__(self, registry: "SerializerRegistry"):
+        self._reg = registry
+
+    def serialize(self, value) -> bytes:
+        items = list(value.items())
+        out = [struct.pack("<I", len(items))]
+        for k, v in items:
+            for blob in (self._reg.dumps_typed(k), self._reg.dumps_typed(v)):
+                out.append(struct.pack("<I", len(blob)))
+                out.append(blob)
+        return b"".join(out)
+
+    def deserialize(self, data: bytes):
+        (n,) = struct.unpack_from("<I", data, 0)
+        off = 4
+        out = {}
+        for _ in range(n):
+            kv = []
+            for _ in range(2):
+                (ln,) = struct.unpack_from("<I", data, off)
+                off += 4
+                kv.append(self._reg.loads_typed(data[off:off + ln]))
+                off += ln
+            out[kv[0]] = kv[1]
+        return out
+
+
+class SerializerRegistry:
+    """type -> TypeSerializer with a type-tagged byte envelope.
+
+    Envelope: uid '\\0' payload. Registered uids resolve to their
+    serializer on read; unknown uids are a hard error (never silently
+    pickled), so a snapshot written with a custom serializer demands the
+    same registration to restore — the reference's restore-compat stance.
+    """
+
+    def __init__(self, copy_from: Optional["SerializerRegistry"] = None):
+        self._by_type: Dict[type, TypeSerializer] = {}
+        self._by_uid: Dict[str, TypeSerializer] = {}
+        self._builtin_types: set = set()
+        self._fallback = PickleSerializer()
+        for t, s in (
+            (bool, BoolSerializer()),      # before int: bool is an int
+            (int, LongSerializer()),
+            (float, DoubleSerializer()),
+            (str, StringSerializer()),
+            (bytes, BytesSerializer()),
+            (np.ndarray, NumpySerializer()),
+        ):
+            self.register(t, s)
+        self.register(tuple, TupleSerializer(self))
+        self.register(list, ListSerializer(self))
+        self.register(dict, DictSerializer(self))
+        self._builtin_types = set(self._by_type)
+        self._register_uid(self._fallback)
+        if copy_from is not None:
+            # fork: carry over the source's user registrations so a
+            # job-scoped registry extends (never shadows) the process one
+            for t, s in copy_from._by_type.items():
+                if t not in copy_from._builtin_types:
+                    self.register(t, s)
+
+    # -- registration (ExecutionConfig.registerTypeWithKryoSerializer) ---
+    def register(self, py_type: type, serializer: TypeSerializer):
+        if not serializer.uid:
+            raise ValueError("serializer needs a stable non-empty uid")
+        self._by_type[py_type] = serializer
+        self._register_uid(serializer)
+        return serializer
+
+    def _register_uid(self, serializer: TypeSerializer):
+        prev = self._by_uid.get(serializer.uid)
+        if prev is not None and type(prev) is not type(serializer):
+            raise ValueError(
+                f"uid {serializer.uid!r} already bound to {type(prev).__name__}"
+            )
+        self._by_uid[serializer.uid] = serializer
+
+    def serializer_for(self, value) -> TypeSerializer:
+        s = self._by_type.get(type(value))
+        if s is not None:
+            return s
+        # Subclass walk over USER registrations only. Builtin container/
+        # primitive serializers must not catch subclasses: a namedtuple or
+        # IntEnum riding TupleSerializer/LongSerializer would silently
+        # come back as a bare tuple/int after restore — those fall back to
+        # pickle, which preserves the type.
+        for t, s in self._by_type.items():
+            if t not in self._builtin_types and isinstance(value, t):
+                return s
+        return self._fallback
+
+    def by_uid(self, uid: str) -> TypeSerializer:
+        s = self._by_uid.get(uid)
+        if s is None:
+            raise SerializationError(
+                f"no serializer registered for uid {uid!r}; register the "
+                f"custom serializer used to write this snapshot"
+            )
+        return s
+
+    # -- typed envelope ---------------------------------------------------
+    def dumps_typed(self, value) -> bytes:
+        s = self.serializer_for(value)
+        try:
+            blob = s.serialize(value)
+        except (struct.error, OverflowError, ValueError):
+            # value outside the builtin wire format's range (int > int64,
+            # object-dtype ndarray, ...): ride the generic fallback rather
+            # than failing the snapshot. User-registered serializers do NOT
+            # get this safety net — their failures are real errors.
+            if s is not self._fallback and type(s) not in _BUILTIN_SER_TYPES:
+                raise
+            s = self._fallback
+            blob = s.serialize(value)
+        return s.uid.encode("ascii") + b"\0" + blob
+
+    def loads_typed(self, blob: bytes):
+        sep = blob.index(b"\0")
+        return self.by_uid(blob[:sep].decode("ascii")).deserialize(
+            blob[sep + 1:]
+        )
+
+
+#: builtin serializer classes eligible for the fallback safety net in
+#: dumps_typed (user serializers fail loudly instead)
+_BUILTIN_SER_TYPES = frozenset({
+    BoolSerializer, LongSerializer, DoubleSerializer, StringSerializer,
+    BytesSerializer, NumpySerializer, TupleSerializer, ListSerializer,
+    DictSerializer,
+})
+
+#: process-wide default; jobs may carry their own via the environment
+DEFAULT_REGISTRY = SerializerRegistry()

@@ -40,6 +40,25 @@ class StreamExecutionEnvironment:
         self.metric_registry = MetricRegistry()
         self._control = None  # cluster.JobControl when cluster-submitted
         self._kv_registry = KvStateRegistry()
+        # job-scoped TypeSerializer registry (lazily forked from the
+        # process default on first registration; ref
+        # ExecutionConfig.registerTypeWithKryoSerializer)
+        self.serializer_registry = None
+
+    def register_type_serializer(self, py_type, serializer):
+        """Pin a custom TypeSerializer for a Python type; state snapshots
+        of this job route values of that type through it."""
+        from flink_tpu.core.serializers import (
+            DEFAULT_REGISTRY,
+            SerializerRegistry,
+        )
+
+        if self.serializer_registry is None:
+            self.serializer_registry = SerializerRegistry(
+                copy_from=DEFAULT_REGISTRY
+            )
+        self.serializer_registry.register(py_type, serializer)
+        return self
 
     def query_state(self, name: str, key):
         """Point lookup into a running/finished job's queryable state

@@ -69,6 +69,23 @@ class JobMetrics:
     dropped_capacity: int = 0
     restarts: int = 0
     wall_time_s: float = 0.0
+    # fire latency: bounded weighted samples — latency is watermark-
+    # crossing -> sink invoke for every window in one emission
+    # (ref LatencyMarker / the p99 half of the north-star metric)
+    fire_latency: Any = None
+
+    def record_fire_latency(self, n_windows: int, ms: float):
+        from flink_tpu.metrics.latency import LatencySamples
+
+        if self.fire_latency is None:
+            self.fire_latency = LatencySamples()
+        self.fire_latency.record(n_windows, ms)
+
+    def fire_latency_pct(self, q: float):
+        """Weighted percentile (0..100) over emitted windows; None if none."""
+        if not self.fire_latency:
+            return None
+        return self.fire_latency.percentile(q)
 
     # the counter fields exported as live gauges (also consumed by the
     # MiniCluster's job detail endpoint)
@@ -774,16 +791,31 @@ class LocalExecutor:
             ]
             return _emit_batch(pipe, out, metrics)
 
-        def drain_fires(wm_ms):
+        def drain_fires(wm_ms, t_cross=None):
             """Fire every due window end at watermark wm_ms. One fire step
             evaluates up to F window ends (+ up to F late re-fires); loop
-            while a full lane set came back, meaning backlog may remain."""
+            while a full lane set came back, meaning backlog may remain.
+
+            t_cross: perf_counter() at the moment the host observed the
+            watermark crossing; every window emitted by this drain records
+            (now - t_cross) as its fire latency (the p99 half of the
+            north-star metric; ref WindowOperator.onEventTime drain)."""
             total = 0
             F = win.fires_per_step
             while True:
                 cf = run_fire(wm_ms)
                 lanes = np.asarray(cf.lane_valid)   # [S, Ft]
-                total += emit_fires(cf)
+                fires_before = metrics.fires
+                n_emit = emit_fires(cf)
+                total += n_emit
+                if t_cross is not None:
+                    # weight by WINDOWS fired (metrics.fires delta), not by
+                    # post-chain records out — a filter/flatMap after the
+                    # window must not skew the per-window percentile
+                    metrics.record_fire_latency(
+                        metrics.fires - fires_before,
+                        (time.perf_counter() - t_cross) * 1e3,
+                    )
                 on_time = int(lanes[:, :F].sum(axis=1).max(initial=0))
                 late = int(lanes[:, F:].sum(axis=1).max(initial=0))
                 if on_time < F and late < F:
@@ -932,16 +964,16 @@ class LocalExecutor:
                     # catch-up slices must fire between groups or newer
                     # panes would evict older unfired ones from the ring
                     if catch_up:
-                        drain_fires(g_wm)
+                        drain_fires(g_wm, time.perf_counter())
                 if eager_fire or wp > host_fired_pane:
-                    drain_fires(wm_ms)
+                    drain_fires(wm_ms, time.perf_counter())
                     host_fired_pane = wp
             elif td is not None:
                 # idle poll: advance processing-time watermark
                 if not event_time:
                     wp = wm_pane_of(now_ms - 1)
                     if wp > host_fired_pane:
-                        drain_fires(now_ms - 1)
+                        drain_fires(now_ms - 1, time.perf_counter())
                         host_fired_pane = wp
             if not kv_mailbox.empty():
                 drain_kv_mailbox()
@@ -982,7 +1014,7 @@ class LocalExecutor:
 
             # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
             if td is not None:
-                drain_fires(int(td.to_ms(2**31 - 4)))
+                drain_fires(int(td.to_ms(2**31 - 4)), time.perf_counter())
         finally:
             job_live.clear()
             drain_kv_mailbox()
@@ -1114,6 +1146,7 @@ class LocalExecutor:
         fn = pipe.process.fn
         event_time = env.time_characteristic == TimeCharacteristic.EventTime
         backend = HeapKeyedStateBackend(max_parallelism=env.max_parallelism)
+        backend.serializer_registry = env.serializer_registry
         timers = InternalTimerService(env.max_parallelism)
         collector = Collector()
         timer_svc = TimerService(timers, lambda: backend.current_key)

@@ -25,6 +25,11 @@ class StateDescriptor:
     name: str
     dtype: Any = jnp.float32
     value_shape: Tuple[int, ...] = ()
+    # optional per-state TypeSerializer (core/serializers.py) pinning how
+    # this state's values are written into snapshots — the descriptor-level
+    # serializer injection of the reference (StateDescriptor.java:50).
+    # None = the job's SerializerRegistry picks by value type.
+    serializer: Any = None
 
     def to_reduce_spec(self) -> ReduceSpec:
         raise NotImplementedError
