@@ -280,13 +280,20 @@ class SerializerRegistry:
         s = self.serializer_for(value)
         try:
             blob = s.serialize(value)
-        except (struct.error, OverflowError, ValueError):
+        except SerializationError:
+            raise
+        except (struct.error, OverflowError, ValueError) as e:
             # value outside the builtin wire format's range (int > int64,
             # object-dtype ndarray, ...): ride the generic fallback rather
             # than failing the snapshot. User-registered serializers do NOT
-            # get this safety net — their failures are real errors.
+            # get this safety net — their failures are real errors, wrapped
+            # as SerializationError so an enclosing builtin container
+            # cannot swallow them into its own fallback.
             if s is not self._fallback and type(s) not in _BUILTIN_SER_TYPES:
-                raise
+                raise SerializationError(
+                    f"serializer {s.uid!r} failed for "
+                    f"{type(value).__name__}: {e}"
+                ) from e
             s = self._fallback
             blob = s.serialize(value)
         return s.uid.encode("ascii") + b"\0" + blob

@@ -118,6 +118,11 @@ class WindowSpec:
     ring: int = 8
     fires_per_step: int = 2
     lateness_ticks: int = 0  # allowedLateness: late updates re-fire windows
+    # overflow ring lanes (0 = disabled): records whose key finds no table
+    # slot append (key, pane, value) here instead of being dropped; the
+    # host drains the ring into the spill-store tier at fire boundaries
+    # (the RocksDB-analog seam, RocksDBKeyedStateBackend.java:82)
+    overflow: int = 0
 
     def __post_init__(self):
         if self.size_ticks % self.slide_ticks:
@@ -147,22 +152,37 @@ class WindowShardState:
     fired_through: jax.Array  # int32 scalar: last window-end pane emitted
     purged_through: jax.Array  # int32 scalar: panes <= this are known clean
     dropped_late: jax.Array     # int32 counter
-    dropped_capacity: jax.Array  # int32 counter (table full or ring overflow)
+    dropped_capacity: jax.Array  # int32 counter (records genuinely lost)
     fresh: jax.Array            # bool [C*R]: late-updated, pending re-fire
     n_fresh: jax.Array          # int32 scalar: count of set fresh flags
+    # overflow ring [O] (O = win.overflow, possibly 0): records whose key
+    # found no table slot, appended for host drain into the spill tier
+    ovf_hi: jax.Array           # uint32 [O]
+    ovf_lo: jax.Array           # uint32 [O]
+    ovf_pane: jax.Array         # int32 [O]
+    ovf_val: jax.Array          # [O, *value_shape] red.dtype
+    ovf_n: jax.Array            # int32 scalar: filled lanes
 
     def tree_flatten(self):
         return (
             (self.table, self.acc, self.touched, self.pane_ids, self.max_pane,
              self.min_pane, self.watermark, self.fired_through,
              self.purged_through, self.dropped_late, self.dropped_capacity,
-             self.fresh, self.n_fresh),
+             self.fresh, self.n_fresh, self.ovf_hi, self.ovf_lo,
+             self.ovf_pane, self.ovf_val, self.ovf_n),
             None,
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+
+def overflow_supported(red: ReduceSpec) -> bool:
+    """The overflow tier stores raw record contributions and merges them
+    host-side, so it needs a host-computable builtin combine over plain
+    scalar blocks and no kernel-side finalize."""
+    return red.kind in ("sum", "count", "min", "max") and red.finalize is None
 
 
 def init_state(capacity: int, probe_len: int, win: WindowSpec,
@@ -174,8 +194,14 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
             f"accumulator of {n_elems} elements overflows int32 scatter "
             f"indices; lower capacity/ring or the sketch register count"
         )
+    if win.overflow and not overflow_supported(red):
+        raise ValueError(
+            f"overflow ring requires a builtin scalar reduce without "
+            f"finalize, got kind={red.kind!r}"
+        )
     neutral = red.neutral_value()
     acc = jnp.broadcast_to(neutral, (capacity * R,) + red.value_shape).astype(red.dtype)
+    O = win.overflow
     return WindowShardState(
         table=hashtable.create(capacity, probe_len),
         acc=acc + jnp.zeros_like(acc),  # materialize (broadcast_to is a view)
@@ -190,12 +216,113 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
         dropped_capacity=jnp.zeros((), jnp.int32),
         fresh=jnp.zeros(capacity * R, bool),
         n_fresh=jnp.zeros((), jnp.int32),
+        ovf_hi=jnp.zeros(O, jnp.uint32),
+        ovf_lo=jnp.zeros(O, jnp.uint32),
+        ovf_pane=jnp.full((O,), PANE_NONE, jnp.int32),
+        ovf_val=jnp.zeros((O,) + red.value_shape, red.dtype),
+        ovf_n=jnp.zeros((), jnp.int32),
     )
 
 
 def _floor_div_pane(ts, slide: int):
     # floor division for possibly-negative ticks
     return jnp.floor_divide(ts, jnp.int32(slide)).astype(jnp.int32)
+
+
+def compact_table(state: WindowShardState, win: WindowSpec,
+                  red: ReduceSpec) -> WindowShardState:
+    """Rebuild the key table keeping only keys with live (touched) panes.
+
+    The table never frees slots on purge (linear-probe chains must stay
+    intact, hashtable.remove_slots), so long-running streams with key
+    churn fill it with dead identities. This whole-shard rebuild is the
+    batched analog of RocksDB compaction: re-upsert live keys into a
+    fresh table and remap the pane accumulators to the new slots. Run by
+    the host at fire boundaries when the overflow ring reported pressure.
+    """
+    C = state.table.capacity
+    R = win.ring
+    touched2 = state.touched.reshape(R, C)
+    fresh2 = state.fresh.reshape(R, C)
+    alive = touched2.any(axis=0) | fresh2.any(axis=0)   # [C]
+
+    keys = state.table.keys                              # [C, 2]
+    fresh_table = hashtable.create(C, state.table.probe_len)
+    # re-inserting a whole shard at once has far heavier claim-race
+    # contention than incremental batches: probe_len rounds (not the step
+    # path's 4) so every key that fit before fits again
+    new_keys, slot, ok = hashtable._upsert_impl(
+        fresh_table.keys, keys[:, 0], keys[:, 1],
+        (C, state.table.probe_len, state.table.probe_len), alive,
+    )
+    # Parallel re-insert resolves claim races in a different order than
+    # the incremental inserts did, so a live key can fail to fit the new
+    # arrangement even though it fit the old one. Its pane state must NOT
+    # be lost: export (key, pane, acc) rows into the overflow ring — the
+    # host drained it immediately before compacting — and only count a
+    # drop if even the ring is full.
+    failed = alive & ~ok                                 # [C]
+    idx = jnp.where(alive & ok, slot, C)                 # old slot -> new
+
+    acc3 = state.acc.reshape((R, C) + red.value_shape)
+    neutral = red.neutral_value().astype(red.dtype)
+
+    ovf_hi, ovf_lo = state.ovf_hi, state.ovf_lo
+    ovf_pane, ovf_val, ovf_n = state.ovf_pane, state.ovf_val, state.ovf_n
+    lost = jnp.zeros((), jnp.int32)
+    if win.overflow:
+        O = jnp.int32(win.overflow)
+        ent = (touched2 & failed[None, :]).reshape(-1)   # [R*C]
+        pos = ovf_n + jnp.cumsum(ent.astype(jnp.int32)) - 1
+        fits = ent & (pos < O)
+        eidx = jnp.where(fits, pos, O)
+        key_rc = jnp.broadcast_to(keys[None, :, :], (R, C, 2)).reshape(-1, 2)
+        pane_rc = jnp.broadcast_to(
+            state.pane_ids[:, None], (R, C)
+        ).reshape(-1)
+        ovf_hi = ovf_hi.at[eidx].set(key_rc[:, 0], mode="drop")
+        ovf_lo = ovf_lo.at[eidx].set(key_rc[:, 1], mode="drop")
+        ovf_pane = ovf_pane.at[eidx].set(pane_rc, mode="drop")
+        ovf_val = ovf_val.at[eidx].set(
+            acc3.reshape((R * C,) + red.value_shape), mode="drop"
+        )
+        n_ent = jnp.sum(ent, dtype=jnp.int32)
+        ovf_n = jnp.minimum(ovf_n + n_ent, O)
+        lost = n_ent - jnp.sum(fits, dtype=jnp.int32)
+    else:
+        lost = jnp.sum(
+            jnp.where(failed[None, :], touched2, False), dtype=jnp.int32
+        )
+
+    def remap_row(row):
+        base = jnp.broadcast_to(neutral, (C,) + red.value_shape).astype(
+            red.dtype
+        ) + jnp.zeros((), red.dtype)
+        return base.at[idx].set(row, mode="drop")
+
+    new_acc3 = jax.vmap(remap_row)(acc3)
+    new_touched2 = jax.vmap(
+        lambda row: jnp.zeros(C, bool).at[idx].set(row, mode="drop")
+    )(touched2)
+    new_fresh2 = jax.vmap(
+        lambda row: jnp.zeros(C, bool).at[idx].set(row, mode="drop")
+    )(fresh2)
+
+    import dataclasses as _dc
+
+    return _dc.replace(
+        state,
+        table=hashtable.SlotTable(new_keys, state.table.probe_len),
+        acc=new_acc3.reshape((C * R,) + red.value_shape),
+        touched=new_touched2.reshape(C * R),
+        fresh=new_fresh2.reshape(C * R),
+        dropped_capacity=state.dropped_capacity + lost,
+        ovf_hi=ovf_hi,
+        ovf_lo=ovf_lo,
+        ovf_pane=ovf_pane,
+        ovf_val=ovf_val,
+        ovf_n=ovf_n,
+    )
 
 
 def update(
@@ -278,8 +405,32 @@ def update(
 
     # -- key upsert ---------------------------------------------------------
     table, slot, ok = hashtable.upsert(state.table, hi, lo, live)
-    n_nofit = jnp.sum(live & ~ok, dtype=jnp.int32)
+    nofit = live & ~ok
     live = live & ok
+
+    # -- overflow ring: nofit records append (key, pane, value) for the
+    # host to drain into the spill tier; only ring exhaustion drops
+    ovf_hi, ovf_lo = state.ovf_hi, state.ovf_lo
+    ovf_pane, ovf_val, ovf_n = state.ovf_pane, state.ovf_val, state.ovf_n
+    if win.overflow:
+        O = jnp.int32(win.overflow)
+        pos = ovf_n + jnp.cumsum(nofit.astype(jnp.int32)) - 1
+        fits = nofit & (pos < O)
+        idx = jnp.where(fits, pos, O)
+        ovf_hi = ovf_hi.at[idx].set(hi, mode="drop")
+        ovf_lo = ovf_lo.at[idx].set(lo, mode="drop")
+        ovf_pane = ovf_pane.at[idx].set(pane, mode="drop")
+        contrib = (
+            jnp.ones_like(values) if red.kind == "count" else values
+        ).astype(red.dtype)
+        ovf_val = ovf_val.at[idx].set(contrib, mode="drop")
+        n_kept = jnp.sum(fits, dtype=jnp.int32)
+        ovf_n = jnp.minimum(
+            ovf_n + jnp.sum(nofit, dtype=jnp.int32), O
+        )
+        n_nofit = jnp.sum(nofit, dtype=jnp.int32) - n_kept  # truly lost
+    else:
+        n_nofit = jnp.sum(nofit, dtype=jnp.int32)
 
     # -- scatter-combine into (slot, pane-ring) accumulators ----------------
     ring = jnp.mod(pane, jnp.int32(R))
@@ -338,6 +489,11 @@ def update(
         dropped_capacity=state.dropped_capacity + n_too_old + n_nofit + n_evicted,
         fresh=fresh,
         n_fresh=n_fresh,
+        ovf_hi=ovf_hi,
+        ovf_lo=ovf_lo,
+        ovf_pane=ovf_pane,
+        ovf_val=ovf_val,
+        ovf_n=ovf_n,
     )
 
 
@@ -617,5 +773,10 @@ def advance_and_fire(
         dropped_capacity=state.dropped_capacity,
         fresh=fresh2.reshape(C * R),
         n_fresh=n_fresh,
+        ovf_hi=state.ovf_hi,
+        ovf_lo=state.ovf_lo,
+        ovf_pane=state.ovf_pane,
+        ovf_val=state.ovf_val,
+        ovf_n=state.ovf_n,
     )
     return new_state, FireResult(mask, values, window_end, n_fires, lane_valid)

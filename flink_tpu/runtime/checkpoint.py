@@ -107,12 +107,18 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
     return entries, scalars
 
 
-def restore_window_state(entries, scalars, ctx, spec):
+def restore_window_state(entries, scalars, ctx, spec, leftover=None):
     """Logical entries -> device state on a (possibly different) mesh.
 
     Re-buckets every entry by key group onto ctx's shard ranges, re-inserts
     keys into fresh hash tables, scatters pane values. The ring is
     re-registered from the global max_pane.
+
+    leftover: optional list — entries whose key does not fit the table
+    (snapshot taken with a spill tier, restored into a smaller/equal
+    capacity) are appended as (key_hi, key_lo, pane, value) arrays for the
+    caller to route back into its spill tier; without the list the
+    overrun raises.
     """
     R = spec.win.ring
     C = spec.capacity_per_shard
@@ -163,10 +169,21 @@ def restore_window_state(entries, scalars, ctx, spec):
                 table, jnp.asarray(u_hi), jnp.asarray(u_lo),
                 jnp.ones(len(u_hi), dtype=bool),
             )
-            if not bool(np.asarray(ok).all()):
-                raise RuntimeError(
-                    "restore: state does not fit the configured capacity"
+            ok = np.asarray(ok)
+            if not bool(ok.all()):
+                if leftover is None:
+                    raise RuntimeError(
+                        "restore: state does not fit the configured capacity"
+                    )
+                lost = ~ok[inv]          # per-entry mask of unfitted keys
+                leftover.append((
+                    e_hi[lost], e_lo[lost], e_pane[lost], e_val[lost]
+                ))
+                keep_e = ~lost
+                e_pane, e_val, e_fr = (
+                    e_pane[keep_e], e_val[keep_e], e_fr[keep_e]
                 )
+                inv = inv[keep_e]
             slots = np.asarray(slots)
             flat = (e_pane % R) * C + slots[inv]
             acc_s[flat] = e_val
@@ -213,6 +230,19 @@ def restore_window_state(entries, scalars, ctx, spec):
             np.asarray([int(f.sum()) for f in shard_fresh], np.int32),
             ctx.state_sharding,
         ),
+        # overflow ring restores empty: a checkpoint is taken at a fire
+        # boundary where the ring was drained into the spill tier, and the
+        # spill entries ride the snapshot as regular logical entries
+        ovf_hi=stack_put([np.zeros(spec.win.overflow, np.uint32)] * S),
+        ovf_lo=stack_put([np.zeros(spec.win.overflow, np.uint32)] * S),
+        ovf_pane=stack_put(
+            [np.full(spec.win.overflow, int(wk.PANE_NONE), np.int32)] * S
+        ),
+        ovf_val=stack_put(
+            [np.zeros((spec.win.overflow,) + spec.red.value_shape,
+                      np.asarray(jnp.zeros((), spec.red.dtype)).dtype)] * S
+        ),
+        ovf_n=_scal(S, 0, ctx, split=True),
     )
     return new_state
 

@@ -34,9 +34,11 @@ from flink_tpu.ops import window_kernels as wk
 from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.runtime.step import (
     WindowStageSpec,
+    build_compact_step,
     build_window_fire_step,
     build_window_update_step,
     build_window_update_step_exchange,
+    clear_overflow,
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
@@ -470,10 +472,32 @@ class LocalExecutor:
                 // slide_ms
                 + 2,
             )
+            # overflow ring: spill-tier support for builtin float32 scalar
+            # reduces (kill the hard over-capacity failure; VERDICT item 7)
+            ovf = 0
+            if (
+                wk.overflow_supported(red)
+                and jnp.zeros((), red.dtype).dtype == jnp.float32
+                and len(red.value_shape) <= 1
+                # the spill tier cannot replay late re-fires for evicted
+                # keys (host stores carry no freshness); with allowed
+                # lateness the job keeps strict-capacity semantics instead
+                # of being silently wrong for that corner
+                and wagg.allowed_lateness_ms == 0
+            ):
+                # -1/unset = auto: absorbs OVF_LAG+1 steps of full-batch
+                # overflow between lagged detection and drain (no loss);
+                # 0 disables; an explicit positive value wins (and may
+                # lose under sustained pressure, surfaced by the
+                # strict-capacity error)
+                ovf = env.config.get_int("state.backend.overflow-ring", -1)
+                if ovf < 0:
+                    ovf = 6 * B + 8192
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
                 ring=ring, fires_per_step=4,
                 lateness_ticks=wagg.allowed_lateness_ms,
+                overflow=ovf,
             )
             spec = WindowStageSpec(
                 win=win, red=red,
@@ -518,12 +542,67 @@ class LocalExecutor:
         steps_at_ckpt = 0
         n_keys_logged = 0
 
+        def _append_spill_entries(entries):
+            """Spill-tier contents ride the snapshot as regular logical
+            (key, pane, value) entries; duplicates with device rows are
+            pre-combined because restore scatters (last write wins)."""
+            if not ovf_stores:
+                return entries
+            a_hi, a_lo, a_pane, a_val = [], [], [], []
+            for p, store in ovf_stores.items():
+                ks, vs = store.dump()
+                if not len(ks):
+                    continue
+                a_hi.append((ks >> np.uint64(32)).astype(np.uint32))
+                a_lo.append((ks & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+                a_pane.append(np.full(len(ks), p, np.int32))
+                a_val.append(
+                    vs.reshape((len(ks),) + tuple(red.value_shape))
+                )
+            if not a_hi:
+                return entries
+            khi = np.concatenate([entries["key_hi"]] + a_hi)
+            klo = np.concatenate([entries["key_lo"]] + a_lo)
+            pane = np.concatenate([entries["pane"]] + a_pane)
+            value = np.concatenate(
+                [entries["value"].astype(np.float32)] + a_val
+            )
+            fresh = np.concatenate([
+                entries["fresh"],
+                np.zeros(len(khi) - len(entries["fresh"]), bool),
+            ])
+            # combine duplicate (key, pane) rows (device + spill split)
+            comp = (
+                (khi.astype(np.uint64) << np.uint64(32)) | klo
+            ).astype(np.uint64)
+            uniq, inv = np.unique(
+                np.stack([comp, pane.astype(np.uint64)], 1), axis=0,
+                return_inverse=True,
+            )
+            W = max(1, int(np.prod(red.value_shape, dtype=np.int64) or 1))
+            agg = np.full((len(uniq), W), _NEUTRAL[red.kind], np.float32)
+            ufunc = {"sum": np.add, "count": np.add,
+                     "min": np.minimum, "max": np.maximum}[red.kind]
+            ufunc.at(agg, inv, value.reshape(len(value), W))
+            fr = np.zeros(len(uniq), bool)
+            np.logical_or.at(fr, inv, fresh)
+            return {
+                "key_hi": (uniq[:, 0] >> np.uint64(32)).astype(np.uint32),
+                "key_lo": (uniq[:, 0] & np.uint64(0xFFFFFFFF)).astype(
+                    np.uint32
+                ),
+                "pane": uniq[:, 1].astype(np.int32),
+                "value": agg.reshape((len(uniq),) + tuple(red.value_shape)),
+                "fresh": fr,
+            }
+
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt, n_keys_logged
             # drain due fires so fired_through is uniform across shards and
             # the snapshot is an exact global cut (F-throttle divergence)
             drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
+            entries = _append_spill_entries(entries)
             if keep_rev:
                 items = list(
                     itertools.islice(codec._rev.items(), n_keys_logged, None)
@@ -551,6 +630,11 @@ class LocalExecutor:
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
+            # spill contents were folded into the snapshot's entries; the
+            # restored device state supersedes the host tier
+            for store in ovf_stores.values():
+                store.close()
+            ovf_stores.clear()
             st = (
                 ckpt.CheckpointStorage(path_or_storage)
                 if isinstance(path_or_storage, str) else path_or_storage
@@ -562,7 +646,30 @@ class LocalExecutor:
             if (aux["size_ms"], aux["slide_ms"]) != (size_ms, slide_ms):
                 raise ValueError("checkpoint window spec mismatch")
             setup(aux["origin_ms"], fresh_state=False)
-            state = ckpt.restore_window_state(entries, scalars, ctx, spec)
+            leftover = [] if win.overflow else None
+            state = ckpt.restore_window_state(
+                entries, scalars, ctx, spec, leftover=leftover
+            )
+            if leftover:
+                # snapshot rows that no longer fit the table go back to the
+                # host spill tier they came from
+                from flink_tpu.native import SpillStore
+
+                for l_hi, l_lo, l_pane, l_val in leftover:
+                    k64 = (
+                        l_hi.astype(np.uint64) << np.uint64(32)
+                    ) | l_lo.astype(np.uint64)
+                    for p in np.unique(l_pane):
+                        m = l_pane == p
+                        store = ovf_stores.get(int(p))
+                        if store is None:
+                            store = ovf_stores[int(p)] = SpillStore(
+                                width=ovf_w, initial_capacity=1024
+                            )
+                        store.put(
+                            k64[m],
+                            l_val[m].reshape(-1, ovf_w).astype(np.float32),
+                        )
             pipe.source.restore_offsets(offsets)
             sink_states = aux.get("sink_states")
             if sink_states:
@@ -602,6 +709,7 @@ class LocalExecutor:
             sp = ckpt.CheckpointStorage(path, retain=10**9)
             drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
+            entries = _append_spill_entries(entries)
             if keep_rev:
                 sp.append_keymap(list(codec._rev.items()))
             aux = {
@@ -709,7 +817,10 @@ class LocalExecutor:
         def run_update(hi, lo, ticks, values, valid, wm_ms):
             """Dispatch one update-only device step. No host sync: the
             result is not read, so transfers and compute of successive
-            steps overlap (the round-1 loop blocked on every step)."""
+            steps overlap (the round-1 loop blocked on every step). The
+            step's tiny ovf_n output handle is queued for LAGGED overflow
+            monitoring — inspected a few steps later when it has already
+            materialized, so the pipeline never stalls."""
             nonlocal state
             wm_ticks = (
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
@@ -718,11 +829,14 @@ class LocalExecutor:
             wmv = jnp.full((ctx.n_shards,), np.int32(
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
-            state = update_step(
+            state, ovf_handle = update_step(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
             metrics.steps += 1
+            if win.overflow:
+                ovf_watch.append(ovf_handle)
+                check_overflow_pressure()
 
         def run_fire(wm_ms):
             nonlocal state
@@ -736,22 +850,201 @@ class LocalExecutor:
             state, cf = fire_step(state, wmv)
             return cf
 
+        # -- spill tier: overflow-ring drain + host pane stores ------------
+        # Records whose key found no table slot land in the device overflow
+        # ring; at fire boundaries the host drains the ring into per-pane
+        # native SpillStores (the RocksDB-analog tier, SURVEY §2.10 item 2 /
+        # RocksDBKeyedStateBackend.java:82), compacts the device table to
+        # free dead-key slots, and merges spill contributions into window
+        # emissions. State capacity overruns therefore degrade to host
+        # memory instead of failing the job.
+        ovf_stores = {}          # pane -> native SpillStore
+        compact_step_fn = None
+        ovf_w = max(1, int(np.prod(red.value_shape, dtype=np.int64) or 1))
+        # lagged ring monitoring: per-step ovf_n output handles; the oldest
+        # is inspected once OVF_LAG newer steps have been dispatched — its
+        # value is long since computed, so the read costs ~nothing
+        ovf_watch = []
+        OVF_LAG = 4
+
+        def check_overflow_pressure():
+            if len(ovf_watch) <= OVF_LAG:
+                return
+            h = ovf_watch.pop(0)
+            fill = int(np.asarray(h).max(initial=0))
+            if fill > max(1, B // 8):
+                # meaningful pressure: drain NOW rather than waiting for
+                # the next pane boundary. The auto-sized ring (~6*B lanes)
+                # absorbs the <= (OVF_LAG+1) steps of lag, so nothing is
+                # lost; the sync + compaction is the degraded-mode price.
+                drain_overflow()
+
+        def host_combine(a, b):
+            if red.kind in ("sum", "count"):
+                return a + b
+            return np.minimum(a, b) if red.kind == "min" else np.maximum(a, b)
+
+        _NEUTRAL = {
+            "sum": 0.0, "count": 0.0, "min": np.inf, "max": -np.inf,
+        }
+
+        def _merge_ring_into_stores():
+            """One pass: fetch + clear the device ring into pane stores.
+            Returns True if anything was drained."""
+            nonlocal state
+            counts = np.asarray(jax.device_get(state.ovf_n))   # [S]
+            if counts.max(initial=0) <= 0:
+                return False
+            slices = []
+            for s in range(ctx.n_shards):
+                n = int(counts[s])
+                if n:
+                    slices.append((state.ovf_hi[s, :n], state.ovf_lo[s, :n],
+                                   state.ovf_pane[s, :n], state.ovf_val[s, :n]))
+            fetched = jax.device_get(slices)
+            hi = np.concatenate([f[0] for f in fetched])
+            lo = np.concatenate([f[1] for f in fetched])
+            panes = np.concatenate([f[2] for f in fetched])
+            vals = np.concatenate([f[3] for f in fetched]).reshape(-1, ovf_w)
+            k64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(
+                np.uint64
+            )
+            from flink_tpu.native import SpillStore
+
+            for p in np.unique(panes):
+                sel = panes == p
+                uk, inv = np.unique(k64[sel], return_inverse=True)
+                agg = np.full((len(uk), ovf_w), _NEUTRAL[red.kind],
+                              np.float32)
+                ufunc = {"sum": np.add, "count": np.add,
+                         "min": np.minimum, "max": np.maximum}[red.kind]
+                ufunc.at(agg, inv, vals[sel].astype(np.float32))
+                store = ovf_stores.get(int(p))
+                if store is None:
+                    store = ovf_stores[int(p)] = SpillStore(
+                        width=ovf_w, initial_capacity=1024
+                    )
+                old, found = store.get(uk)
+                merged = np.where(found[:, None], host_combine(old, agg), agg)
+                store.put(uk, merged)
+            state = clear_overflow(state)
+            return True
+
+        def drain_overflow():
+            """Drain the device overflow ring into the host pane stores and
+            compact the table to make room. Compaction can itself evict
+            non-refitting keys' state INTO the just-cleared ring, so a
+            second merge pass picks those up before any emission."""
+            nonlocal state, compact_step_fn
+            if win is None or not win.overflow or state is None:
+                return
+            if not _merge_ring_into_stores():
+                return
+            ovf_watch.clear()     # queued handles reflect pre-drain fill
+            # free dead-key slots so future records fit (RocksDB-compaction
+            # analog); compiled lazily — overflow is the rare path
+            if compact_step_fn is None:
+                compact_step_fn = build_compact_step(ctx, spec)
+            state = compact_step_fn(state)
+            _merge_ring_into_stores()   # compaction evictees
+
+        def spill_window_contrib(end_pane: int):
+            """Combined spill contributions {key64: [W] float32} for the
+            window ending at pane end_pane (composes its k panes)."""
+            k = win.panes_per_window
+            out = {}
+            for q in range(end_pane - k + 1, end_pane + 1):
+                store = ovf_stores.get(q)
+                if store is None or len(store) == 0:
+                    continue
+                ks, vs = store.dump()
+                for kk, vv in zip(ks.tolist(), vs):
+                    cur = out.get(kk)
+                    out[kk] = vv if cur is None else host_combine(cur, vv)
+            return out
+
+        def prune_stores(wm_ms):
+            """Drop pane stores past the same horizon the device purges:
+            every containing window fired AND the lateness horizon passed."""
+            if not ovf_stores:
+                return
+            k = win.panes_per_window
+            wm_ticks = min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+            base = max(
+                wm_ticks - win.lateness_ticks,
+                -(2**31) + 1 + win.slide_ticks,
+            )
+            wm_pane_l = (base + 1 - win.slide_ticks) // win.slide_ticks
+            cutoff = min(host_fired_pane, wm_pane_l)
+            for q in [q for q in ovf_stores if q + k - 1 <= cutoff]:
+                ovf_stores.pop(q).close()
+
         columnar_emit = (
             len(pipe.branches) == 1
             and not pipe.branches[0][0]
             and all(s.columnar for s in pipe.all_sinks)
         )
 
+        def _merge_spill(khi, klo, end_ms, v, due_end_ticks,
+                         appendable_ends=()):
+            """Merge host spill-tier contributions into an emission: keys
+            present in both get combined (a key's records can split across
+            device and spill when the table filled mid-pane); spill-only
+            keys append as new emission rows."""
+            k64 = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
+                np.uint64
+            )
+            v2 = v.reshape(len(v), ovf_w).astype(np.float32, copy=True)
+            add_hi, add_lo, add_end, add_val = [], [], [], []
+            for e_ticks in due_end_ticks:
+                end_pane = e_ticks // win.slide_ticks - 1
+                contrib = spill_window_contrib(end_pane)
+                if not contrib:
+                    continue
+                e_ms = td.to_ms(e_ticks)
+                sel = np.nonzero(end_ms == e_ms)[0]
+                for i in sel:
+                    c = contrib.pop(int(k64[i]), None)
+                    if c is not None:
+                        v2[i] = host_combine(v2[i], c)
+                if contrib and e_ticks in appendable_ends:
+                    # spill-only keys fire too (on-time lanes only)
+                    ks = np.fromiter(contrib.keys(), np.uint64,
+                                     count=len(contrib))
+                    add_hi.append((ks >> np.uint64(32)).astype(np.uint32))
+                    add_lo.append((ks & np.uint64(0xFFFFFFFF)).astype(
+                        np.uint32
+                    ))
+                    add_end.append(np.full(len(ks), e_ms, np.int64))
+                    add_val.append(np.stack(list(contrib.values())))
+            if add_hi:
+                khi = np.concatenate([khi] + add_hi)
+                klo = np.concatenate([klo] + add_lo)
+                end_ms = np.concatenate([end_ms] + add_end)
+                v2 = np.concatenate([v2] + add_val)
+            return khi, klo, end_ms, v2.reshape((len(v2),) + v.shape[1:])
+
         def emit_fires(cf):
             """Emit one CompactFires: read the small per-lane fields, then
             transfer only [:count] slices of the device-packed key/value
-            buffers (no dense masks, no key-table transfer)."""
+            buffers (no dense masks, no key-table transfer). Spill-tier
+            contributions merge in BEFORE any result projection."""
             counts, lanes, ends = jax.device_get(
                 (cf.counts, cf.lane_valid, cf.window_end_ticks)
             )
             slices, end_l = [], []
+            # distinct due window ends (ticks). Spill contributions merge
+            # into every fired value, but spill-ONLY keys append as new
+            # rows solely for ON-TIME lanes (f < F): late lanes are
+            # per-key corrections and must not re-emit unrelated keys.
+            due_ends = set()
+            appendable_ends = set()
+            F_on = win.fires_per_step
             for sh in range(counts.shape[0]):
                 for f in np.nonzero(lanes[sh])[0]:
+                    due_ends.add(int(ends[sh, f]))
+                    if f < F_on:
+                        appendable_ends.add(int(ends[sh, f]))
                     n = int(counts[sh, f])
                     if n == 0:
                         continue
@@ -760,7 +1053,7 @@ class LocalExecutor:
                     end_l.append(
                         np.full(n, td.to_ms(int(ends[sh, f])), np.int64)
                     )
-            if not slices:
+            if not slices and not ovf_stores:
                 return 0
             # one batched fetch: the lazy device slices transfer together
             # instead of 3 blocking round trips per (shard, lane)
@@ -768,10 +1061,22 @@ class LocalExecutor:
             khi_l = [s[0] for s in fetched]
             klo_l = [s[1] for s in fetched]
             val_l = [s[2] for s in fetched]
-            khi = np.concatenate(khi_l)
-            klo = np.concatenate(klo_l)
-            end_ms = np.concatenate(end_l)
-            v = np.concatenate(val_l)
+            if slices:
+                khi = np.concatenate(khi_l)
+                klo = np.concatenate(klo_l)
+                end_ms = np.concatenate(end_l)
+                v = np.concatenate(val_l)
+            else:
+                khi = np.zeros(0, np.uint32)
+                klo = np.zeros(0, np.uint32)
+                end_ms = np.zeros(0, np.int64)
+                v = np.zeros((0,) + tuple(np.shape(cf.values)[3:]), np.float32)
+            if ovf_stores and due_ends:
+                khi, klo, end_ms, v = _merge_spill(
+                    khi, klo, end_ms, v, sorted(due_ends), appendable_ends
+                )
+            if len(v) == 0:
+                return 0
             if wagg.result_fn is not None:
                 v = np.asarray(wagg.result_fn(v))
             metrics.fires += len(v)
@@ -800,6 +1105,7 @@ class LocalExecutor:
             watermark crossing; every window emitted by this drain records
             (now - t_cross) as its fire latency (the p99 half of the
             north-star metric; ref WindowOperator.onEventTime drain)."""
+            drain_overflow()     # ring -> pane stores before any emission
             total = 0
             F = win.fires_per_step
             while True:
@@ -819,6 +1125,7 @@ class LocalExecutor:
                 on_time = int(lanes[:, :F].sum(axis=1).max(initial=0))
                 late = int(lanes[:, F:].sum(axis=1).max(initial=0))
                 if on_time < F and late < F:
+                    prune_stores(wm_ms)
                     return total
 
         def batch_loop():

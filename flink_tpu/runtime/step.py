@@ -134,7 +134,10 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
         state = _dc.replace(
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
-        return jax.tree_util.tree_map(lambda x: x[None], state)
+        ovf_n = state.ovf_n
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], state), ovf_n[None]
+        )
 
     sharded = shard_map(
         shard_body,
@@ -144,12 +147,17 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
             P(), P(), P(), P(), P(),
             P(SHARD_AXIS),
         ),
-        out_specs=P(SHARD_AXIS),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def update_step(state, hi, lo, ts, values, valid, wm):
+        """Returns (state', ovf_n). ovf_n is a tiny NON-donated copy of the
+        overflow-ring fill level: the host queues the handle and inspects
+        it a few steps later — by then the value has materialized, so the
+        read never stalls the step pipeline (overflow monitoring with lag).
+        """
         return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
 
     return update_step
@@ -200,7 +208,10 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             watermark=jnp.maximum(state.watermark, wm[0]),
             dropped_capacity=state.dropped_capacity + n_over,
         )
-        return jax.tree_util.tree_map(lambda x: x[None], state)
+        ovf_n = state.ovf_n
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], state), ovf_n[None]
+        )
 
     sharded = shard_map(
         shard_body,
@@ -212,7 +223,7 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS),
             P(SHARD_AXIS),  # per-shard watermark
         ),
-        out_specs=P(SHARD_AXIS),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
     )
 
@@ -255,6 +266,42 @@ def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
         return sharded(state, wm)
 
     return fire_step
+
+
+def build_compact_step(ctx: MeshContext, spec: WindowStageSpec):
+    """Whole-shard table compaction (wk.compact_table) over the mesh; run
+    by the host at fire boundaries when the overflow ring reported
+    pressure (the RocksDB-compaction analog)."""
+    mesh = ctx.mesh
+
+    def shard_body(state):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        state = wk.compact_table(state, spec.win, spec.red)
+        return jax.tree_util.tree_map(lambda x: x[None], state)
+
+    sharded = shard_map(
+        shard_body, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS), check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def compact_step(state):
+        return sharded(state)
+
+    return compact_step
+
+
+def clear_overflow(state):
+    """Host-side: zero the overflow counter after draining the ring (the
+    entry arrays may keep stale rows — only [:ovf_n] is ever read)."""
+    import dataclasses as _dc
+
+    return _dc.replace(
+        state,
+        ovf_n=jax.device_put(
+            np.zeros(state.ovf_n.shape, np.int32), state.ovf_n.sharding
+        ),
+    )
 
 
 def watermark_vector(ctx: MeshContext, wm: int):

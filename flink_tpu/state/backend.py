@@ -430,8 +430,27 @@ class HeapKeyedStateBackend(KeyedStateBackend):
 
         reg = self._registry()
         out: Dict[int, bytes] = {}
+        # still-deferred restore entries (state restored but its pinned
+        # descriptor never opened since) must survive into the next
+        # snapshot verbatim, or an untouched state silently vanishes
+        pending_by_kg: Dict[int, list] = {}
+        for name, pend in self._pending_restore.items():
+            for kg, uid, cfg, ns_b, k_b, v_b in pend:
+                pending_by_kg.setdefault(kg, []).append(
+                    (name, uid, cfg, ns_b, k_b, v_b)
+                )
         for kg in self.kgr:
             states = []
+            for name, uid, cfg, ns_b, k_b, v_b in pending_by_kg.get(kg, ()):
+                buf: list = []
+                self._frame(buf, name.encode("utf-8"))
+                self._frame(buf, uid.encode("ascii"))
+                self._frame(buf, cfg.encode("utf-8"))
+                buf.append(_st.pack("<I", 1))
+                self._frame(buf, ns_b)
+                self._frame(buf, k_b)
+                self._frame(buf, v_b)
+                states.append(b"".join(buf))
             for name, table in self._tables.items():
                 m = table._map_for(kg)
                 if not m:
@@ -476,6 +495,9 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         reg = self._registry()
         for table in self._tables.values():
             table.maps = [{} for _ in range(self.kgr.num_key_groups)]
+        # deferred entries from any PREVIOUS restore are part of the state
+        # being replaced — never resurrect them after this restore
+        self._pending_restore.clear()
         for kg, blob in key_group_blobs.items():
             if kg < self.kgr.start or kg > self.kgr.end:
                 continue

@@ -81,10 +81,10 @@ def test_exchange_matches_mask_and_scales_work():
     s_ex = init_sharded_state(ctx, spec)
     for hi, lo, ts, vals in batches:
         valid = np.ones(B, bool)
-        s_mask = upd_mask(s_mask, jnp.asarray(hi), jnp.asarray(lo),
+        s_mask, _ = upd_mask(s_mask, jnp.asarray(hi), jnp.asarray(lo),
                           jnp.asarray(ts), jnp.asarray(vals),
                           jnp.asarray(valid), wm)
-        s_ex = upd_ex(s_ex, jnp.asarray(hi), jnp.asarray(lo),
+        s_ex, _ = upd_ex(s_ex, jnp.asarray(hi), jnp.asarray(lo),
                       jnp.asarray(ts), jnp.asarray(vals),
                       jnp.asarray(valid), wm)
 
@@ -164,7 +164,7 @@ def test_exchange_overflow_is_counted_not_lost_silently():
     hi, lo, ts, vals = _batch(rng, B, n_keys=1)   # all lanes -> one shard
     wm = jnp.full((N_DEV,), np.int32(0))
     s = init_sharded_state(ctx, spec)
-    s = upd_ex(s, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ts),
+    s, _ = upd_ex(s, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ts),
                jnp.asarray(vals), jnp.asarray(np.ones(B, bool)), wm)
     dropped = int(np.asarray(s.dropped_capacity).sum())
     assert dropped > 0

@@ -281,8 +281,14 @@ def test_custom_serializer_failure_is_not_swallowed():
 
     reg = SerializerRegistry()
     reg.register(Point, Fussy())
-    with pytest.raises(ValueError, match="bad value"):
+    with pytest.raises(SerializationError, match="bad value"):
         reg.dumps_typed(Point(1, 2))
+    # ... including when nested inside a builtin container: the container's
+    # own fallback must NOT swallow the user serializer's failure
+    with pytest.raises(SerializationError, match="bad value"):
+        reg.dumps_typed((Point(1, 2),))
+    with pytest.raises(SerializationError, match="bad value"):
+        reg.dumps_typed({"k": [Point(1, 2)]})
 
 
 def test_lazy_descriptor_pinned_restore_defers_until_registration():
@@ -302,6 +308,38 @@ def test_lazy_descriptor_pinned_restore_defers_until_registration():
     st = b2.get_partitioned_state(desc)     # registration resolves it
     assert st.value() == Point(5, 6)
     assert not b2._pending_restore
+
+
+def test_pending_entries_survive_snapshot_before_registration():
+    # restore entries for a lazily-pinned state, snapshot WITHOUT ever
+    # opening that state: the re-snapshot must carry the entries verbatim
+    desc = ValueStateDescriptor("lazy", serializer=PointSerializer())
+    b = HeapKeyedStateBackend(max_parallelism=8)
+    b.set_current_key("k")
+    b.get_partitioned_state(desc).update(Point(5, 6))
+    blobs = b.snapshot()
+
+    b2 = HeapKeyedStateBackend(max_parallelism=8)
+    b2.restore(blobs)                 # defers (descriptor unknown)
+    blobs2 = b2.snapshot()            # state untouched since restore
+    b3 = HeapKeyedStateBackend(max_parallelism=8)
+    b3.restore(blobs2)
+    b3.set_current_key("k")
+    assert b3.get_partitioned_state(desc).value() == Point(5, 6)
+
+
+def test_second_restore_discards_stale_pending_entries():
+    desc = ValueStateDescriptor("lazy", serializer=PointSerializer())
+    b = HeapKeyedStateBackend(max_parallelism=8)
+    b.set_current_key("k")
+    b.get_partitioned_state(desc).update(Point(5, 6))
+    blobs_a = b.snapshot()
+
+    b2 = HeapKeyedStateBackend(max_parallelism=8)
+    b2.restore(blobs_a)               # defers A's entries
+    b2.restore({})                    # checkpoint B: state empty
+    b2.set_current_key("k")
+    assert b2.get_partitioned_state(desc).value() is None  # A must not leak
 
 
 def test_config_snapshot_mismatch_refused():
