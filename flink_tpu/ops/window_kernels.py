@@ -178,6 +178,29 @@ class WindowShardState:
         return cls(*children)
 
 
+def ring_append(ovf, mask, hi, lo, pane, vals, O: int):
+    """Append masked lanes to the overflow ring (shared by the update hot
+    path and compaction eviction so the lost-record accounting cannot
+    diverge).
+
+    ovf: (ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n) current ring.
+    Returns (new_ovf, n_lost) where n_lost counts lanes beyond capacity.
+    """
+    ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n = ovf
+    O = jnp.int32(O)
+    pos = ovf_n + jnp.cumsum(mask.astype(jnp.int32)) - 1
+    fits = mask & (pos < O)
+    idx = jnp.where(fits, pos, O)
+    ovf_hi = ovf_hi.at[idx].set(hi, mode="drop")
+    ovf_lo = ovf_lo.at[idx].set(lo, mode="drop")
+    ovf_pane = ovf_pane.at[idx].set(pane, mode="drop")
+    ovf_val = ovf_val.at[idx].set(vals, mode="drop")
+    n_total = jnp.sum(mask, dtype=jnp.int32)
+    n_lost = n_total - jnp.sum(fits, dtype=jnp.int32)
+    ovf_n = jnp.minimum(ovf_n + n_total, O)
+    return (ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n), n_lost
+
+
 def overflow_supported(red: ReduceSpec) -> bool:
     """The overflow tier stores raw record contributions and merges them
     host-side, so it needs a host-computable builtin combine over plain
@@ -267,32 +290,23 @@ def compact_table(state: WindowShardState, win: WindowSpec,
     acc3 = state.acc.reshape((R, C) + red.value_shape)
     neutral = red.neutral_value().astype(red.dtype)
 
-    ovf_hi, ovf_lo = state.ovf_hi, state.ovf_lo
-    ovf_pane, ovf_val, ovf_n = state.ovf_pane, state.ovf_val, state.ovf_n
-    lost = jnp.zeros((), jnp.int32)
+    ovf = (state.ovf_hi, state.ovf_lo, state.ovf_pane, state.ovf_val,
+           state.ovf_n)
     if win.overflow:
-        O = jnp.int32(win.overflow)
         ent = (touched2 & failed[None, :]).reshape(-1)   # [R*C]
-        pos = ovf_n + jnp.cumsum(ent.astype(jnp.int32)) - 1
-        fits = ent & (pos < O)
-        eidx = jnp.where(fits, pos, O)
         key_rc = jnp.broadcast_to(keys[None, :, :], (R, C, 2)).reshape(-1, 2)
         pane_rc = jnp.broadcast_to(
             state.pane_ids[:, None], (R, C)
         ).reshape(-1)
-        ovf_hi = ovf_hi.at[eidx].set(key_rc[:, 0], mode="drop")
-        ovf_lo = ovf_lo.at[eidx].set(key_rc[:, 1], mode="drop")
-        ovf_pane = ovf_pane.at[eidx].set(pane_rc, mode="drop")
-        ovf_val = ovf_val.at[eidx].set(
-            acc3.reshape((R * C,) + red.value_shape), mode="drop"
+        ovf, lost = ring_append(
+            ovf, ent, key_rc[:, 0], key_rc[:, 1], pane_rc,
+            acc3.reshape((R * C,) + red.value_shape), win.overflow,
         )
-        n_ent = jnp.sum(ent, dtype=jnp.int32)
-        ovf_n = jnp.minimum(ovf_n + n_ent, O)
-        lost = n_ent - jnp.sum(fits, dtype=jnp.int32)
     else:
         lost = jnp.sum(
             jnp.where(failed[None, :], touched2, False), dtype=jnp.int32
         )
+    ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n = ovf
 
     def remap_row(row):
         base = jnp.broadcast_to(neutral, (C,) + red.value_shape).astype(
@@ -410,27 +424,18 @@ def update(
 
     # -- overflow ring: nofit records append (key, pane, value) for the
     # host to drain into the spill tier; only ring exhaustion drops
-    ovf_hi, ovf_lo = state.ovf_hi, state.ovf_lo
-    ovf_pane, ovf_val, ovf_n = state.ovf_pane, state.ovf_val, state.ovf_n
+    ovf = (state.ovf_hi, state.ovf_lo, state.ovf_pane, state.ovf_val,
+           state.ovf_n)
     if win.overflow:
-        O = jnp.int32(win.overflow)
-        pos = ovf_n + jnp.cumsum(nofit.astype(jnp.int32)) - 1
-        fits = nofit & (pos < O)
-        idx = jnp.where(fits, pos, O)
-        ovf_hi = ovf_hi.at[idx].set(hi, mode="drop")
-        ovf_lo = ovf_lo.at[idx].set(lo, mode="drop")
-        ovf_pane = ovf_pane.at[idx].set(pane, mode="drop")
         contrib = (
             jnp.ones_like(values) if red.kind == "count" else values
         ).astype(red.dtype)
-        ovf_val = ovf_val.at[idx].set(contrib, mode="drop")
-        n_kept = jnp.sum(fits, dtype=jnp.int32)
-        ovf_n = jnp.minimum(
-            ovf_n + jnp.sum(nofit, dtype=jnp.int32), O
+        ovf, n_nofit = ring_append(
+            ovf, nofit, hi, lo, pane, contrib, win.overflow
         )
-        n_nofit = jnp.sum(nofit, dtype=jnp.int32) - n_kept  # truly lost
     else:
         n_nofit = jnp.sum(nofit, dtype=jnp.int32)
+    ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n = ovf
 
     # -- scatter-combine into (slot, pane-ring) accumulators ----------------
     ring = jnp.mod(pane, jnp.int32(R))

@@ -120,15 +120,33 @@ class WebMonitor:
             count = hist.get("count", 0)
             p99 = hist.get("p99", 0)
             p50 = hist.get("p50", 0) or 1e-9
-            # heuristic classification in the spirit of the reference's
-            # OK/LOW/HIGH ratio thresholds (BackPressureStatsTracker)
+            # ratio in the spirit of the reference's OK/LOW/HIGH
+            # thresholds (BackPressureStatsTracker)
             ratio = min(1.0, (p99 / p50 - 1.0) / 10.0) if count else 0.0
             level = ("ok" if ratio <= 0.10
                      else "low" if ratio <= 0.5 else "high")
-            return {
+            out = {
                 "status": "ok",
                 "backpressure-level": level,
                 "ratio": ratio,
                 "cycle-time-ms": hist,
             }
+            # cause attribution: measured per-cycle phase decomposition
+            # (source-starved / host-bound / device-bound / sink-bound)
+            # replacing the reference's stack-trace sampling
+            report_fn = getattr(rec.env, "_backpressure_report", None)
+            if report_fn is not None:
+                out["attribution"] = report_fn()
+            # per-phase histograms + end-to-end latency markers
+            phases = rec.env.metric_registry.snapshot(
+                f"jobs.{rec.name}.phase_"
+            )
+            if phases:
+                out["phase-histograms-ms"] = phases
+            lat = rec.env.metric_registry.snapshot(
+                f"jobs.{rec.name}.record_latency_ms"
+            )
+            if lat:
+                out["record-latency-ms"] = next(iter(lat.values()))
+            return out
         return None

@@ -152,6 +152,18 @@ def test_web_monitor_endpoints():
         assert detail["metrics"]["records_in"] > 0
         bp = get(f"/jobs/{jid}/backpressure")
         assert bp["backpressure-level"] in ("ok", "low", "high")
+        # cause attribution (BackPressureStatsTracker analog): measured
+        # per-phase decomposition, not just cycle-time percentiles
+        attr = bp["attribution"]
+        assert attr["classification"] in (
+            "ok", "source-starved", "host-bound", "device-bound",
+            "sink-bound",
+        )
+        assert set(attr["phase-ewma-ms"]) == {
+            "source", "host", "dispatch", "emit"
+        }
+        # counts may still be 0 here (first cycle compiles); the
+        # completed-job counts are asserted in test_backpressure.py
         snap = get(f"/jobs/{jid}/metrics")
         assert any(k.endswith("records_in") for k in snap)
     finally:
