@@ -20,4 +20,19 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 
 __version__ = "0.1.0"
 
+# An explicit JAX_PLATFORMS environment variable wins over any
+# sitecustomize-forced platform config. Without this, worker subprocesses
+# (runtime/worker.py) and user scripts spawned with JAX_PLATFORMS=cpu can
+# still dial an accelerator backend forced by the host's sitecustomize —
+# jax.config is process state the env var does not override once set.
+# Must run before any jax operation the imports below may perform.
+import os as _os  # noqa: E402
+
+_plat = _os.environ.get("JAX_PLATFORMS")
+if _plat:
+    import jax as _jax  # noqa: E402
+
+    if _jax.config.jax_platforms != _plat:
+        _jax.config.update("jax_platforms", _plat)
+
 from flink_tpu.datastream.environment import StreamExecutionEnvironment  # noqa: F401,E402

@@ -37,7 +37,11 @@ from flink_tpu.ops import hashtable
 from flink_tpu.ops.hashtable import SlotTable
 from flink_tpu.ops.segment import preaggregate, scatter_combine
 
-PANE_NONE = jnp.int32(-(2**31) + 1)
+# np scalar, not jnp: a module-level jnp call would initialize the JAX
+# backend at import time (hanging any process whose platform override
+# comes after `import flink_tpu`); np.int32 behaves identically inside
+# jnp expressions
+PANE_NONE = np.int32(-(2**31) + 1)
 
 
 @dataclass(frozen=True)

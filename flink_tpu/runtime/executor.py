@@ -331,12 +331,17 @@ class CycleAttribution:
         self.ewma = {p: 0.0 for p in self.PHASES}
         self.idle = 0
         self.busy = 0
+        # decaying idle fraction: classification must reflect the RECENT
+        # regime, not the job's lifetime (a job idle overnight then
+        # saturated must flip to device-bound, not stay source-starved)
+        self.idle_ewma = 0.0
         self.hists = (
             {p: group.histogram(f"phase_{p}_ms") for p in self.PHASES}
             if group is not None else None
         )
 
     def record(self, idle: bool, **phase_ms):
+        self.idle_ewma += self.alpha * ((1.0 if idle else 0.0) - self.idle_ewma)
         if idle:
             self.idle += 1
             return
@@ -351,7 +356,7 @@ class CycleAttribution:
         total = self.idle + self.busy
         if total == 0:
             return "ok"
-        if self.idle > 0.5 * total:
+        if self.idle_ewma > 0.5:
             return "source-starved"
         dominant = max(self.ewma, key=self.ewma.get)
         cycle = sum(self.ewma.values()) or 1e-9

@@ -1,0 +1,255 @@
+"""ProcessCluster — controller for real multi-process workers.
+
+The first step toward the reference's distributed runtime story
+(VERDICT item 10): the controller plays the JobManager role for worker
+OS processes — spawn, registration, heartbeat liveness (the Akka
+DeathWatch analog: a worker is dead on heartbeat timeout OR process
+exit, TaskManager.scala:296 / ExecutionGraph.java:848), and
+restart-from-latest-checkpoint when a worker dies mid-job, governed by a
+fixed-delay restart budget (restart/FixedDelayRestartStrategy.java:33).
+
+Control traffic rides the same JSON-over-TCP line protocol the CLI uses
+(cluster.py); bulk data between local processes rides the native shm
+ring (runtime/sources.RingBufferSource) — neither path depends on being
+in one process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class WorkerRecord:
+    worker_id: str
+    proc: subprocess.Popen
+    job_name: str
+    builder_ref: str
+    checkpoint_dir: str
+    attempt: int = 1
+    status: str = "LAUNCHED"   # LAUNCHED|REGISTERED|RUNNING|FINISHED|FAILED|DEAD
+    last_heartbeat: float = field(default_factory=time.time)
+    error: Optional[str] = None
+    restarts: int = 0
+    extra_env: Optional[dict] = None
+
+
+class ProcessCluster:
+    """Controller process: spawn/monitor worker processes, recover jobs."""
+
+    def __init__(self, heartbeat_timeout_s: float = 3.0,
+                 max_restarts: int = 3, monitor_interval_s: float = 0.25,
+                 startup_grace_s: float = 60.0):
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.max_restarts = max_restarts
+        self.monitor_interval_s = monitor_interval_s
+        # a LAUNCHED worker is importing the framework (several seconds);
+        # the heartbeat liveness contract starts once it registers
+        self.startup_grace_s = startup_grace_s
+        self.workers: Dict[str, WorkerRecord] = {}
+        self._lock = threading.Lock()
+        self._server = None
+        self._port: Optional[int] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.events: List[dict] = []    # observable lifecycle log
+
+    # -- control server ---------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        cluster = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                line = self.rfile.readline()
+                if not line:
+                    return
+                try:
+                    resp = cluster._dispatch(json.loads(line))
+                except Exception as e:
+                    resp = {"ok": False, "error": str(e)}
+                self.wfile.write(
+                    (json.dumps(resp, default=str) + "\n").encode()
+                )
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="process-cluster-control",
+        ).start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="process-cluster-monitor",
+        )
+        self._monitor.start()
+        return self._port
+
+    def shutdown(self):
+        self._stop.set()
+        with self._lock:
+            recs = list(self.workers.values())
+        for rec in recs:
+            if rec.proc.poll() is None:
+                rec.proc.kill()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    def _event(self, kind: str, **kw):
+        self.events.append({"event": kind, "t": time.time(), **kw})
+
+    def _dispatch(self, req: dict) -> dict:
+        action = req.get("action")
+        if action == "register-worker":
+            with self._lock:
+                rec = self.workers.get(req["worker_id"])
+                if rec is not None:
+                    rec.status = "REGISTERED"
+                    rec.last_heartbeat = time.time()
+            self._event("registered", worker=req["worker_id"],
+                        pid=req.get("pid"))
+            return {"ok": True}
+        if action == "heartbeat":
+            with self._lock:
+                rec = self.workers.get(req["worker_id"])
+                if rec is not None:
+                    rec.last_heartbeat = time.time()
+                    if rec.status == "REGISTERED":
+                        rec.status = "RUNNING"
+            return {"ok": True}
+        if action == "worker-status":
+            with self._lock:
+                rec = self.workers.get(req["worker_id"])
+                if rec is not None:
+                    rec.status = req["status"]
+                    rec.error = req.get("error")
+            self._event("status", worker=req["worker_id"],
+                        status=req["status"])
+            return {"ok": True}
+        if action == "list":
+            with self._lock:
+                return {"ok": True, "workers": [
+                    {"worker_id": r.worker_id, "status": r.status,
+                     "attempt": r.attempt, "restarts": r.restarts}
+                    for r in self.workers.values()
+                ]}
+        raise ValueError(f"unknown action {action!r}")
+
+    # -- job lifecycle ----------------------------------------------------
+    def submit(self, builder_ref: str, job_name: str,
+               checkpoint_dir: str, worker_id: Optional[str] = None,
+               extra_env: Optional[dict] = None) -> str:
+        worker_id = worker_id or f"worker-{len(self.workers) + 1:03d}"
+        rec = WorkerRecord(
+            worker_id=worker_id,
+            proc=self._spawn(worker_id, builder_ref, job_name,
+                             checkpoint_dir, restore=False,
+                             extra_env=extra_env),
+            job_name=job_name, builder_ref=builder_ref,
+            checkpoint_dir=checkpoint_dir, extra_env=extra_env,
+        )
+        with self._lock:
+            self.workers[worker_id] = rec
+        self._event("launched", worker=worker_id, attempt=1)
+        return worker_id
+
+    def _spawn(self, worker_id: str, builder_ref: str, job_name: str,
+               checkpoint_dir: str, restore: bool,
+               extra_env: Optional[dict] = None) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m", "flink_tpu.runtime.worker",
+            "--controller", str(self._port),
+            "--worker-id", worker_id,
+            "--builder", builder_ref,
+            "--job-name", job_name,
+            "--checkpoint-dir", checkpoint_dir,
+        ]
+        if restore:
+            cmd.append("--restore")
+        env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
+        # worker output goes to a per-worker log (the TaskManager .log /
+        # .out files of the reference's bin scripts)
+        log = subprocess.DEVNULL
+        if checkpoint_dir:
+            os.makedirs(checkpoint_dir, exist_ok=True)
+            log = open(
+                os.path.join(checkpoint_dir, f"{worker_id}.log"), "ab"
+            )
+        return subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+
+    # -- DeathWatch + restart ---------------------------------------------
+    def _monitor_loop(self):
+        while not self._stop.wait(self.monitor_interval_s):
+            now = time.time()
+            with self._lock:
+                recs = list(self.workers.values())
+            for rec in recs:
+                if rec.status in ("FINISHED", "FAILED", "DEAD"):
+                    continue
+                exited = rec.proc.poll() is not None
+                timeout = (
+                    self.startup_grace_s if rec.status == "LAUNCHED"
+                    else self.heartbeat_timeout_s
+                )
+                stale = now - rec.last_heartbeat > timeout
+                if not (exited or stale):
+                    continue
+                # the worker may have exited cleanly right after its
+                # terminal status message raced in — re-check
+                with self._lock:
+                    if rec.status in ("FINISHED", "FAILED"):
+                        continue
+                    cause = "exit" if exited else "heartbeat-timeout"
+                    self._event("death", worker=rec.worker_id, cause=cause,
+                                attempt=rec.attempt)
+                    if rec.proc.poll() is None:
+                        rec.proc.kill()
+                    if rec.restarts >= self.max_restarts:
+                        rec.status = "DEAD"
+                        self._event("gave-up", worker=rec.worker_id)
+                        continue
+                    rec.restarts += 1
+                    rec.attempt += 1
+                    rec.status = "LAUNCHED"
+                    rec.last_heartbeat = time.time()
+                    rec.proc = self._spawn(
+                        rec.worker_id, rec.builder_ref, rec.job_name,
+                        rec.checkpoint_dir, restore=True,
+                        extra_env=rec.extra_env,
+                    )
+                    self._event("restarted", worker=rec.worker_id,
+                                attempt=rec.attempt)
+
+    def wait(self, worker_id: str, timeout_s: float = 120.0) -> str:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                rec = self.workers[worker_id]
+                if rec.status in ("FINISHED", "FAILED", "DEAD"):
+                    return rec.status
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"worker {worker_id} still {rec.status} after {timeout_s}s"
+        )
+
+    def kill_worker(self, worker_id: str):
+        """Test hook: SIGKILL the worker process (fault injection, ref
+        ProcessFailureCancelingITCase-style recovery tests)."""
+        with self._lock:
+            rec = self.workers[worker_id]
+        rec.proc.kill()

@@ -1,0 +1,136 @@
+"""Worker process — a TaskManager-analog running one job attempt.
+
+The reference runs long-lived TaskManager actors that register with the
+JobManager, host task slots and heartbeat over Akka
+(TaskManager.scala:296 registration+heartbeats; DeathWatch at :311).
+TPU-adapted prototype: a worker is one OS process owning the accelerator
+for one job attempt (the per-job container pattern the reference's
+YARN/Mesos modes use). It:
+
+  1. registers with the controller over the JSON/TCP control protocol,
+  2. heartbeats on an interval (controller marks it dead on timeout OR
+     on process exit — the DeathWatch analog),
+  3. builds the job from an importable builder reference
+     ("pkg.mod:fn" or "path/to/file.py:fn" — the user-code shipping
+     seam, ref BlobServer/jar distribution),
+  4. executes with checkpointing enabled, restoring from the latest
+     checkpoint when respawned after a failure,
+  5. reports terminal status back to the controller.
+
+Run: python -m flink_tpu.runtime.worker --controller PORT --worker-id W
+     --builder REF --job-name NAME --checkpoint-dir DIR [--restore]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import os
+import sys
+import threading
+import time
+import traceback
+
+
+def load_builder(ref: str):
+    """Resolve "module:function" or "/path/file.py:function"."""
+    modpart, _, fnname = ref.rpartition(":")
+    if not modpart:
+        raise ValueError(f"builder ref {ref!r} must be 'module:function'")
+    if modpart.endswith(".py") or os.path.sep in modpart:
+        spec = importlib.util.spec_from_file_location("_job_builder", modpart)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(modpart)
+    return getattr(mod, fnname)
+
+
+def _send(port: int, msg: dict, timeout_s: float = 5.0) -> dict:
+    from flink_tpu.runtime.cluster import control_request
+
+    return control_request("127.0.0.1", port, msg, timeout_s=timeout_s)
+
+
+def run_worker(controller_port: int, worker_id: str, builder_ref: str,
+               job_name: str, checkpoint_dir: str, restore: bool,
+               heartbeat_s: float = 0.5) -> int:
+    _send(controller_port, {
+        "action": "register-worker", "worker_id": worker_id,
+        "pid": os.getpid(),
+    })
+
+    stop = threading.Event()
+
+    def beat():
+        while not stop.is_set():
+            try:
+                _send(controller_port, {
+                    "action": "heartbeat", "worker_id": worker_id,
+                })
+            except OSError:
+                pass          # controller briefly unreachable; keep trying
+            stop.wait(heartbeat_s)
+
+    hb = threading.Thread(target=beat, daemon=True, name="worker-heartbeat")
+    hb.start()
+
+    status, error = "FINISHED", None
+    try:
+        builder = load_builder(builder_ref)
+        env = builder()
+        if checkpoint_dir:
+            interval = env.checkpoint_interval_steps or 4
+            env.enable_checkpointing(interval, checkpoint_dir)
+        restore_from = None
+        if restore and checkpoint_dir:
+            from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+            st = CheckpointStorage(checkpoint_dir)
+            if st.latest() is not None:
+                restore_from = checkpoint_dir
+        env.execute(job_name, restore_from=restore_from)
+    except Exception as e:
+        status, error = "FAILED", "".join(
+            traceback.format_exception_only(type(e), e)
+        ).strip()
+    finally:
+        stop.set()
+        try:
+            _send(controller_port, {
+                "action": "worker-status", "worker_id": worker_id,
+                "status": status, "error": error,
+            })
+        except OSError:
+            pass
+    return 0 if status == "FINISHED" else 1
+
+
+def main(argv=None) -> int:
+    # respect an explicit JAX_PLATFORMS env even where sitecustomize
+    # force-dials an accelerator platform (test workers run on the
+    # virtual CPU mesh)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+        print(f"[worker] jax_platforms={jax.config.jax_platforms} "
+              f"env={plat}", flush=True)
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--controller", type=int, required=True)
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--builder", required=True)
+    ap.add_argument("--job-name", default="job")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--restore", action="store_true")
+    ap.add_argument("--heartbeat-s", type=float, default=0.5)
+    a = ap.parse_args(argv)
+    return run_worker(a.controller, a.worker_id, a.builder, a.job_name,
+                      a.checkpoint_dir, a.restore, a.heartbeat_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,10 @@ multi-"worker" behavior is exercised on one host by faking 8 devices.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE (not setdefault): the host environment may export
+# JAX_PLATFORMS=axon, and worker subprocesses spawned by tests inherit
+# os.environ — they must come up on the virtual CPU mesh too
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,71 @@
+"""Job builders loaded BY WORKER PROCESSES in process-cluster tests.
+
+Parameterized through environment variables (the controller ships them at
+spawn — the user-code + config distribution seam):
+
+  FLINK_TPU_TEST_OUT     BucketingFileSink base path
+  FLINK_TPU_TEST_TOTAL   total records to generate
+  FLINK_TPU_TEST_SLEEP_S per-poll throttle (keeps the job alive long
+                         enough for fault injection)
+"""
+
+import os
+import time
+
+import numpy as np
+
+N_KEYS = 64
+WINDOW_MS = 1000
+
+
+def build_window_job():
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.connectors.files import BucketingFileSink
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    out = os.environ["FLINK_TPU_TEST_OUT"]
+    total = int(os.environ["FLINK_TPU_TEST_TOTAL"])
+    sleep_s = float(os.environ.get("FLINK_TPU_TEST_SLEEP_S", "0"))
+
+    env = StreamExecutionEnvironment(Configuration({"keys.reverse-map": True}))
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(4096)
+    env.batch_size = 512
+    env.checkpoint_interval_steps = 4
+
+    def gen(offset, n):
+        if sleep_s:
+            time.sleep(sleep_s)
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        keys = idx % N_KEYS
+        # ~8 windows over the run
+        ts = (idx * 8 * WINDOW_MS) // total
+        return {"key": keys, "value": np.ones(n, np.float32)}, ts
+
+    sink = BucketingFileSink(
+        out,
+        formatter=lambda r: f"{r.key},{r.window_end_ms},{r.value:.0f}",
+    )
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW_MS)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    return env
+
+
+def expected_cells(total):
+    """Scalar model: {(key, window_end_ms): value}."""
+    exp = {}
+    for i in range(total):
+        k = i % N_KEYS
+        pane = ((i * 8 * WINDOW_MS) // total) // WINDOW_MS
+        cell = (k, (pane + 1) * WINDOW_MS)
+        exp[cell] = exp.get(cell, 0.0) + 1.0
+    return exp

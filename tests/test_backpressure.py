@@ -20,11 +20,16 @@ from flink_tpu.runtime.sources import GeneratorSource
 def test_classification_rules():
     a = CycleAttribution()
     assert a.classify() == "ok"
-    # mostly idle -> source-starved
-    for _ in range(10):
+    # mostly idle -> source-starved (decaying fraction, alpha=0.05)
+    for _ in range(30):
         a.record(idle=True)
     a.record(idle=False, source=1, host=1, dispatch=1, emit=1)
     assert a.classify() == "source-starved"
+    # regime change: sustained device saturation must FLIP the verdict
+    # even though lifetime idle count still dominates
+    for _ in range(60):
+        a.record(idle=False, source=1, host=1, dispatch=50, emit=1)
+    assert a.classify() == "device-bound"
 
     b = CycleAttribution(alpha=1.0)
     b.record(idle=False, source=1, host=1, dispatch=30, emit=2)
