@@ -1,0 +1,99 @@
+"""Microbenchmark the hot-path primitives on the real device."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print("devices:", jax.devices(), flush=True)
+
+C = 1 << 22
+R = 8
+N = C * R
+
+
+def timeit(name, fn, *args, reps=5):
+    fn_j = jax.jit(fn)
+    out = fn_j(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn_j(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{name:55s} {dt*1e3:9.2f} ms", flush=True)
+    return dt
+
+
+rng = np.random.default_rng(0)
+
+# dispatch overhead
+x_small = jnp.ones(8)
+timeit("dispatch (tiny add)", lambda x: x + 1, x_small, reps=20)
+
+acc = jnp.zeros(N, jnp.float32)
+
+for B in (65_536, 262_144, 1_048_576):
+    idx = jnp.asarray(rng.integers(0, N, B).astype(np.int32))
+    idx_sorted = jnp.sort(idx)
+    vals = jnp.ones(B, jnp.float32)
+    print(f"--- B={B}")
+    timeit("scatter-add random dup", lambda a, i, v: a.at[i].add(v), acc, idx, vals)
+    timeit("scatter-add sorted dup",
+           lambda a, i, v: a.at[i].add(v, indices_are_sorted=True),
+           acc, idx_sorted, vals)
+    uq = jnp.asarray(np.unique(rng.integers(0, N, B).astype(np.int32))[:B])
+    uv = jnp.ones(uq.shape, jnp.float32)
+    timeit("scatter-add sorted unique",
+           lambda a, i, v: a.at[i].add(v, indices_are_sorted=True,
+                                       unique_indices=True),
+           acc, uq, uv)
+    timeit("scatter-set sorted unique",
+           lambda a, i, v: a.at[i].set(v, indices_are_sorted=True,
+                                       unique_indices=True),
+           acc, uq, uv)
+    timeit("sort B int32", lambda i: jnp.sort(i), idx)
+    timeit("argsort B int32", lambda i: jnp.argsort(i), idx)
+    k64 = jnp.asarray(rng.integers(0, 2**63, B).astype(np.int64))
+    timeit("sort B int64", lambda i: jnp.sort(i), k64)
+    tbl = jnp.full((C, 2), 0xFFFFFFFF, jnp.uint32)
+    cand = jnp.asarray(rng.integers(0, C, (B, 16)).astype(np.int32))
+    timeit("[B,16] gather rows", lambda t, c: t[c], tbl, cand)
+    seg = jnp.concatenate([jnp.ones((1,), bool),
+                           idx_sorted[1:] != idx_sorted[:-1]])
+
+    def segsum(v, s):
+        def comb(a, b):
+            af, av = a
+            bf, bv = b
+            return af | bf, jnp.where(bf, bv, av + bv)
+        return jax.lax.associative_scan(comb, (s, v))[1]
+
+    timeit("assoc-scan segsum", segsum, vals, seg)
+    big = jnp.zeros((R, C), jnp.float32)
+    timeit("full-state where-sweep [R,C]",
+           lambda a: jnp.where(jnp.zeros((R, 1), bool), 0.0, a), big)
+
+# the actual update step, isolated, B=65536
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops import hashtable
+
+spec_win = wk.WindowSpec(size_ticks=5000, slide_ticks=5000, ring=R,
+                         fires_per_step=2)
+spec_red = wk.ReduceSpec("sum", jnp.float32)
+state = wk.init_state(C, 16, spec_win, spec_red)
+
+for B in (65_536, 262_144):
+    hi = jnp.asarray(rng.integers(0, 2**32, B).astype(np.uint32))
+    lo = jnp.asarray(rng.integers(0, 2**32, B).astype(np.uint32))
+    ts = jnp.asarray(rng.integers(0, 5000, B).astype(np.int32))
+    vals = jnp.ones(B, jnp.float32)
+    valid = jnp.ones(B, bool)
+    print(f"--- update step B={B}")
+    timeit("hashtable.upsert",
+           lambda tk, h, l, v: hashtable._upsert_impl(tk, h, l, (C, 16, 4), v),
+           state.table.keys, hi, lo, valid, reps=3)
+    timeit("wk.update full",
+           lambda s, h, l, t, v, m: wk.update(s, spec_win, spec_red, h, l, t, v, m),
+           state, hi, lo, ts, vals, valid, reps=3)
