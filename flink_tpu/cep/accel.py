@@ -1,0 +1,216 @@
+"""Production bridge putting the device CEP kernel behind CEP.pattern().
+
+Division of labor (ref flink-cep NFA.java:132 / SharedBuffer — redesigned
+for the batch/SPMD execution model instead of per-event JVM calls):
+
+  * DEVICE (cep/device.py): per micro-batch, the segmented associative
+    matrix scan advances EVERY key's match-count NFA and reports, per
+    lane, how many matches COMPLETED there (`delta`). This is the
+    detection engine — exact counts, no per-key host work.
+  * HOST (this module): keeps, per key, only the COMPACTED stream of
+    stage-matching events (the SharedBuffer analog — non-matching events
+    are never stored) plus a one-bit gap marker per stored event ("were
+    there intervening non-matching events of this key?"), which is all a
+    linear NFA needs: a gap kills partials waiting on a STRICT stage and
+    is invisible to RELAXED stages. Only when the device reports a
+    completion for a key does the host replay that key's pending
+    compacted events through the exact host NFA (cep/nfa.py) to build the
+    {stage: event} match dicts.
+
+Result: per-event Python work is O(predicate hits), NFA branching work is
+O(events of completing keys), and the device scan decides both. For
+detection workloads (rare matches over dense streams) this removes the
+per-record NFA from the hot path entirely, the same way the window
+kernels removed HeapReducingState.add.
+
+Eligibility (executor falls back to the host operator otherwise):
+patterns without within() — per-partial start timestamps do not fit the
+count representation — in processing-time mode (arrival order; the
+event-time buffer-and-sort drain stays host-side), single logical shard,
+no checkpointing.
+
+Memory note: a key's compacted events stay buffered while it has live
+partials that could still complete (exactly the events the reference's
+SharedBuffer would be holding); keys whose device count-state is all
+zero hold no buffer entries after their next replay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.cep.device import (
+    CepShardState, DevicePatternSpec, advance, init_state,
+)
+from flink_tpu.cep.nfa import NFA
+from flink_tpu.cep.pattern import Pattern, RELAXED
+from flink_tpu.core.types import KeyCodec
+
+
+def batch_gaps(inv: np.ndarray, hit: np.ndarray,
+               trailing_in: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-hit-lane gap bits for one micro-batch, vectorized.
+
+    inv[B]        factorized key id per lane (0..G-1)
+    hit[B]        lane matched >=1 stage predicate
+    trailing_in[G] per key-group: non-matching events of this key were
+                  seen after its last stored event (carried across batches)
+
+    Returns (gap[B] — True at hit lanes whose key saw >=1 non-hit event
+    since its previous hit event; False elsewhere — and trailing_out[G]).
+    """
+    B = len(inv)
+    if B == 0:
+        return np.zeros(0, bool), trailing_in.copy()
+    perm = np.argsort(inv, kind="stable")     # group by key, arrival order
+    inv_s = inv[perm]
+    hit_s = hit[perm]
+    idx = np.arange(B)
+
+    is_new = np.r_[True, inv_s[1:] != inv_s[:-1]]
+    grp_id = np.cumsum(is_new) - 1            # dense group ids, sorted order
+    grp_start = np.nonzero(is_new)[0]
+    grp_key = inv_s[grp_start]                # group -> key factor id
+
+    nh_before = np.cumsum(~hit_s) - (~hit_s)  # non-hits strictly before lane
+    nhw = nh_before - nh_before[grp_start][grp_id]   # ...within the group
+
+    ph = np.maximum.accumulate(np.where(hit_s, idx, -1))
+    prev_hit = np.r_[-1, ph[:-1]]             # last hit at or before lane-1
+    has_prev = prev_hit >= grp_start[grp_id]  # ...within the same group
+    prev_nhw = np.where(has_prev, nhw[np.clip(prev_hit, 0, B - 1)], 0)
+
+    tin_s = trailing_in[grp_key][grp_id]      # per-lane carried trailing bit
+    gap_s = np.where(
+        has_prev, (nhw - prev_nhw) > 0, (nhw > 0) | tin_s
+    ) & hit_s
+
+    # carry-out per key: non-hits after the key's last hit in this batch
+    # (whole batch counts if the key had no hit — OR with the carried bit)
+    grp_end = np.r_[grp_start[1:], B] - 1
+    nh_total = nhw[grp_end] + (~hit_s[grp_end])
+    last_hit = ph[grp_end]
+    had_hit = last_hit >= grp_start
+    nh_after = np.where(
+        had_hit,
+        nh_total - (nhw[np.clip(last_hit, 0, B - 1)]
+                    + 0),                     # last_hit lane is a hit
+        nh_total,
+    )
+    trailing_out = trailing_in.copy()
+    trailing_out[grp_key] = np.where(
+        had_hit, nh_after > 0, trailing_in[grp_key] | (nh_total > 0)
+    )
+
+    gap = np.zeros(B, bool)
+    gap[perm] = gap_s
+    return gap, trailing_out
+
+
+class DeviceCepOperator:
+    """Keyed CEP over micro-batches: device count-NFA detection + lazy
+    host replay extraction. One instance per job (single logical shard)."""
+
+    def __init__(self, pattern: Pattern, capacity: int = 1 << 16,
+                 probe_len: int = 16):
+        self.pattern = pattern
+        self.spec = DevicePatternSpec.from_pattern(pattern)
+        self.nfa = NFA(pattern)
+        self.stages = pattern.stages
+        self.codec = KeyCodec()
+        self.capacity = int(capacity)
+        self.state: CepShardState = init_state(self.capacity, probe_len,
+                                               self.spec)
+        self._advance = jax.jit(
+            advance, static_argnums=1, donate_argnums=0
+        )
+        # per-key host side (keyed by the 64-bit codec hash; original key
+        # objects ride inside the buffered events for match extraction)
+        self.buffers: Dict[int, List[Tuple[Any, bool, int]]] = {}
+        self.partials: Dict[int, list] = {}
+        self.trailing: Dict[int, bool] = {}
+        # honesty metrics: the device count and host extraction must agree
+        self.matches_detected = 0      # device-side completions
+        self.matches_extracted = 0     # host-replay match dicts
+        self.steps = 0
+
+    @property
+    def dropped_capacity(self) -> int:
+        return int(np.asarray(self.state.dropped_capacity))
+
+    def _masks(self, elements: Sequence) -> np.ndarray:
+        S = len(self.stages)
+        m = np.zeros((len(elements), S), bool)
+        for j, st in enumerate(self.stages):
+            m[:, j] = [bool(st.matches(e)) for e in elements]
+        return m
+
+    def process_batch(self, elements: Sequence, keys: Sequence,
+                      ts: int, pad_to: Optional[int] = None) -> List[dict]:
+        """Advance by one micro-batch (arrival order); returns the list of
+        completed match dicts {stage_name: event}."""
+        B = len(elements)
+        if B == 0:
+            return []
+        masks = self._masks(elements)
+        hi, lo = self.codec.encode(list(keys), keep_reverse=False)
+        hi = np.asarray(hi, np.uint32)
+        lo = np.asarray(lo, np.uint32)
+        k64 = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
+        n = pad_to or B
+        valid = np.zeros(n, bool)
+        valid[:B] = True
+        if n != B:
+            hi = np.pad(hi, (0, n - B))
+            lo = np.pad(lo, (0, n - B))
+            masks = np.pad(masks, ((0, n - B), (0, 0)))
+
+        self.state, delta, _total = self._advance(
+            self.state, self.spec, hi, lo, masks, valid
+        )
+        delta = np.asarray(delta)[:B]
+        masks = masks[:B]
+        self.steps += 1
+
+        # ---- host compaction: store hit events (+ gap bits) per key ----
+        hit = masks.any(axis=1)
+        uniq, inv = np.unique(k64, return_inverse=True)
+        tin = np.fromiter(
+            (self.trailing.get(int(u), False) for u in uniq),
+            bool, count=len(uniq),
+        )
+        gap, tout = batch_gaps(inv, hit, tin)
+        for g, u in zip(tout, uniq):
+            self.trailing[int(u)] = bool(g)
+        for i in np.nonzero(hit)[0]:
+            self.buffers.setdefault(int(k64[i]), []).append(
+                (elements[i], bool(gap[i]), ts)
+            )
+
+        # ---- lazy extraction: replay only keys the device flags --------
+        out: List[dict] = []
+        done = np.nonzero(delta > 0)[0]
+        if len(done):
+            self.matches_detected += int(round(float(delta[done].sum())))
+            for u in np.unique(k64[done]):
+                out.extend(self._replay(int(u)))
+        self.matches_extracted += len(out)
+        return out
+
+    def _replay(self, k: int) -> List[dict]:
+        partials = self.partials.get(k, [])
+        matches: List[dict] = []
+        for ev, gap_before, ts in self.buffers.pop(k, []):
+            if gap_before and partials:
+                partials = [
+                    p for p in partials
+                    if self.stages[p.stage_idx + 1].contiguity == RELAXED
+                ]
+            partials, ms = self.nfa.process(partials, ev, ts)
+            matches.extend(ms)
+        self.partials[k] = partials
+        return matches

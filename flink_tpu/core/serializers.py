@@ -299,7 +299,13 @@ class SerializerRegistry:
         return s.uid.encode("ascii") + b"\0" + blob
 
     def loads_typed(self, blob: bytes):
-        sep = blob.index(b"\0")
+        sep = blob.find(b"\0")
+        if sep < 0:
+            raise SerializationError(
+                "corrupt typed envelope: no uid separator in "
+                f"{blob[:32]!r}{'...' if len(blob) > 32 else ''} "
+                f"({len(blob)} bytes)"
+            )
         return self.by_uid(blob[:sep].decode("ascii")).deserialize(
             blob[sep + 1:]
         )

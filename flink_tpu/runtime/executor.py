@@ -850,21 +850,49 @@ class LocalExecutor:
             match = np.nonzero(
                 (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])
             )[0]
-            if match.size == 0:
-                return None
-            slot = int(match[0])
-            R = win.ring
-            C_cap = tkeys.shape[0]
-            acc_s = np.asarray(state.acc[shard])
-            acc2 = acc_s.reshape((R, C_cap) + acc_s.shape[1:])
-            touched = np.asarray(state.touched[shard]).reshape(R, C_cap)
-            pane_ids = np.asarray(state.pane_ids[shard])
             panes = {}
-            for r in range(R):
-                if touched[r, slot] and pane_ids[r] != wk.PANE_NONE:
-                    panes[int(pane_ids[r])] = np.asarray(
-                        acc2[r, slot]
-                    ).tolist()
+            if match.size:
+                slot = int(match[0])
+                R = win.ring
+                C_cap = tkeys.shape[0]
+                acc_s = np.asarray(state.acc[shard])
+                acc2 = acc_s.reshape((R, C_cap) + acc_s.shape[1:])
+                touched = np.asarray(state.touched[shard]).reshape(R, C_cap)
+                pane_ids = np.asarray(state.pane_ids[shard])
+                for r in range(R):
+                    if touched[r, slot] and pane_ids[r] != wk.PANE_NONE:
+                        panes[int(pane_ids[r])] = np.asarray(
+                            acc2[r, slot]
+                        ).tolist()
+            # degraded mode: contributions for this key may live in the host
+            # spill tier (table filled mid-pane, or the key was evicted by
+            # compaction) — combine them so queryable state matches what a
+            # fire would emit (round-2 ADVICE: spill rows were omitted).
+            if ovf_stores:
+                k64 = np.asarray(
+                    [(np.uint64(hi[0]) << np.uint64(32)) | np.uint64(lo[0])],
+                    np.uint64,
+                )
+                for p, store in ovf_stores.items():
+                    if len(store) == 0:
+                        continue
+                    old, found = store.get(k64)
+                    if not bool(found[0]):
+                        continue
+                    sv = old.reshape(1, ovf_w)
+                    if p in panes:
+                        dev = np.asarray(panes[p], np.float32).reshape(
+                            1, ovf_w
+                        )
+                        panes[p] = host_combine(sv, dev).reshape(
+                            tuple(red.value_shape) or ()
+                        ).tolist()
+                    else:
+                        panes[p] = sv.reshape(
+                            tuple(red.value_shape) or ()
+                        ).tolist()
+            if not panes:
+                return None
             return {
                 "panes": panes,
                 "slide_ms": slide_ms,

@@ -26,8 +26,12 @@ the filesystem:
     restore path already scans for the latest durable checkpoint).
 
 On a shared filesystem this extends to multi-host control-plane HA;
-single-host it provides real controller-failover semantics (tested by
-killing the leader).
+single-host it provides real controller-failover semantics. Wired into
+``runtime/process_cluster.py`` (leadership gates the control server; the
+job registry drives takeover recovery) and exercised by
+``tests/test_process_cluster.py::test_leader_failover_resumes_jobs``,
+which SIGKILLs the leader controller process and asserts the standby
+finishes its jobs from their latest checkpoints.
 """
 
 from __future__ import annotations

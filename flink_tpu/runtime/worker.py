@@ -47,16 +47,28 @@ def load_builder(ref: str):
     return getattr(mod, fnname)
 
 
-def _send(port: int, msg: dict, timeout_s: float = 5.0) -> dict:
+def parse_controller(addr: str) -> tuple:
+    """'HOST:PORT' (multi-host registration, TaskManager.scala:296) or a
+    bare port (single-host back-compat) -> (host, port)."""
+    if ":" in addr:
+        host, _, port = addr.rpartition(":")
+        return host, int(port)
+    return "127.0.0.1", int(addr)
+
+
+def _send(controller: tuple, msg: dict, timeout_s: float = 5.0) -> dict:
     from flink_tpu.runtime.cluster import control_request
 
-    return control_request("127.0.0.1", port, msg, timeout_s=timeout_s)
+    host, port = controller
+    return control_request(host, port, msg, timeout_s=timeout_s)
 
 
-def run_worker(controller_port: int, worker_id: str, builder_ref: str,
+def run_worker(controller, worker_id: str, builder_ref: str,
                job_name: str, checkpoint_dir: str, restore: bool,
                heartbeat_s: float = 0.5) -> int:
-    _send(controller_port, {
+    if isinstance(controller, int):
+        controller = ("127.0.0.1", controller)
+    _send(controller, {
         "action": "register-worker", "worker_id": worker_id,
         "pid": os.getpid(),
     })
@@ -66,7 +78,7 @@ def run_worker(controller_port: int, worker_id: str, builder_ref: str,
     def beat():
         while not stop.is_set():
             try:
-                _send(controller_port, {
+                _send(controller, {
                     "action": "heartbeat", "worker_id": worker_id,
                 })
             except OSError:
@@ -98,7 +110,7 @@ def run_worker(controller_port: int, worker_id: str, builder_ref: str,
     finally:
         stop.set()
         try:
-            _send(controller_port, {
+            _send(controller, {
                 "action": "worker-status", "worker_id": worker_id,
                 "status": status, "error": error,
             })
@@ -120,7 +132,8 @@ def main(argv=None) -> int:
               f"env={plat}", flush=True)
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--controller", type=int, required=True)
+    ap.add_argument("--controller", required=True,
+                    help="HOST:PORT of the controller (or bare port)")
     ap.add_argument("--worker-id", required=True)
     ap.add_argument("--builder", required=True)
     ap.add_argument("--job-name", default="job")
@@ -128,8 +141,9 @@ def main(argv=None) -> int:
     ap.add_argument("--restore", action="store_true")
     ap.add_argument("--heartbeat-s", type=float, default=0.5)
     a = ap.parse_args(argv)
-    return run_worker(a.controller, a.worker_id, a.builder, a.job_name,
-                      a.checkpoint_dir, a.restore, a.heartbeat_s)
+    return run_worker(parse_controller(a.controller), a.worker_id,
+                      a.builder, a.job_name, a.checkpoint_dir, a.restore,
+                      a.heartbeat_s)
 
 
 if __name__ == "__main__":

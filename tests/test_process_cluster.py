@@ -1,19 +1,24 @@
 """Multi-process worker runtime: registration, heartbeats, DeathWatch,
-kill-the-worker recovery from the last checkpoint (VERDICT item 10).
+kill-the-worker recovery from the last checkpoint, and HA leader
+failover (kill-the-leader recovery).
 
 Ref: TaskManager registration + heartbeats (TaskManager.scala:296),
 Akka DeathWatch -> ExecutionGraph.restart (ExecutionGraph.java:848),
-process-kill recovery ITCases (flink-tests/.../recovery/).
+process-kill recovery ITCases (flink-tests/.../recovery/),
+ZooKeeperLeaderElectionService.java:47 + SubmittedJobGraphStore.
 """
 
 import glob
 import os
 import signal
+import subprocess
+import sys
 import time
 
 import pytest
 
 from flink_tpu.runtime.cluster import control_request
+from flink_tpu.runtime.ha import HAJobRegistry, leader_info
 from flink_tpu.runtime.process_cluster import ProcessCluster
 
 JOBS = os.path.join(os.path.dirname(__file__), "process_jobs.py")
@@ -103,6 +108,85 @@ def test_kill_worker_recovers_from_checkpoint(cluster, tmp_path):
     cells, dups = _read_cells(out)
     assert dups == 0, f"{dups} duplicate (key, window) emissions"
     assert cells == expected_cells(total)
+
+
+def _start_controller(ha_dir, name):
+    """Spawn a standalone controller process contending in ha_dir."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "flink_tpu.runtime.process_cluster",
+         "--ha-dir", str(ha_dir), "--contender-id", name,
+         "--heartbeat-timeout-s", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def test_leader_failover_resumes_jobs(tmp_path):
+    """Kill the leader controller: the standby acquires the leader lock,
+    recovers the job from the HA registry, and finishes it from its
+    latest checkpoint with no lost or duplicated windows.
+
+    The dead leader's worker dies with it (PDEATHSIG task lease), so the
+    standby's respawn is the only live attempt — the reference's
+    TM-task-cancellation-on-JM-loss + new-leader job recovery semantics.
+    """
+    ha = tmp_path / "ha"
+    total = 120_000
+    out = str(tmp_path / "out")
+    chk = str(tmp_path / "chk")
+
+    ctl_a = _start_controller(ha, "ctl-a")
+    ctl_b = None
+    try:
+        _wait_for(lambda: leader_info(str(ha)) is not None, 30,
+                  "first leader published")
+        info = leader_info(str(ha))
+        assert info["leader_id"] == "ctl-a"
+
+        resp = control_request("127.0.0.1", info["port"], {
+            "action": "submit", "builder": BUILDER, "job_name": "ha-job",
+            "checkpoint_dir": chk,
+            "extra_env": {
+                "FLINK_TPU_TEST_OUT": out,
+                "FLINK_TPU_TEST_TOTAL": str(total),
+                "FLINK_TPU_TEST_SLEEP_S": "0.05",
+            },
+        })
+        assert resp["ok"]
+        wid = resp["worker_id"]
+        assert HAJobRegistry(str(ha)).get(wid)["status"] == "RUNNING"
+
+        ctl_b = _start_controller(ha, "ctl-b")
+        _wait_for(lambda: glob.glob(os.path.join(chk, "chk-*")), 120,
+                  "first durable checkpoint")
+
+        ctl_a.kill()        # flock released by the OS -> standby takes over
+        ctl_a.wait(10)
+        _wait_for(
+            lambda: (leader_info(str(ha)) or {}).get("leader_id") == "ctl-b",
+            30, "standby takeover",
+        )
+        new_port = leader_info(str(ha))["port"]
+
+        def finished():
+            try:
+                reg = HAJobRegistry(str(ha)).get(wid)
+                return reg is not None and reg["status"] == "FINISHED"
+            except OSError:
+                return False
+
+        _wait_for(finished, 240, "job resumed and finished by new leader")
+        resp = control_request("127.0.0.1", new_port, {"action": "list"})
+        assert resp["workers"][0]["status"] == "FINISHED"
+
+        from process_jobs import expected_cells
+
+        cells, dups = _read_cells(out)
+        assert dups == 0, f"{dups} duplicate (key, window) emissions"
+        assert cells == expected_cells(total)
+    finally:
+        for p in (ctl_a, ctl_b):
+            if p is not None and p.poll() is None:
+                p.kill()
 
 
 def test_heartbeat_timeout_detects_frozen_worker(cluster, tmp_path):
