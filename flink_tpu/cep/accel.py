@@ -121,7 +121,7 @@ class DeviceCepOperator:
         self.nfa = NFA(pattern)
         self.stages = pattern.stages
         self.codec = KeyCodec()
-        self.capacity = int(capacity)
+        self.capacity = 1 << max(1, int(capacity) - 1).bit_length()
         self.state: CepShardState = init_state(self.capacity, probe_len,
                                                self.spec)
         self._advance = jax.jit(
@@ -201,10 +201,60 @@ class DeviceCepOperator:
         self.matches_extracted += len(out)
         return out
 
-    def _replay(self, k: int) -> List[dict]:
-        partials = self.partials.get(k, [])
+    # -- checkpoint / savepoint / queryable seams -----------------------
+    def snapshot(self) -> dict:
+        """Full operator state as host objects (device arrays fetched),
+        ready for CheckpointStorage.write_generic. The barrier is the
+        step boundary, as everywhere in this framework (SURVEY §3.4)."""
+        return {
+            "device": jax.tree_util.tree_map(
+                lambda x: np.asarray(x), jax.device_get(self.state)
+            ),
+            "buffers": dict(self.buffers),
+            "partials": dict(self.partials),
+            "trailing": dict(self.trailing),
+            "matches_detected": self.matches_detected,
+            "matches_extracted": self.matches_extracted,
+            "steps": self.steps,
+            "capacity": self.capacity,
+        }
+
+    def restore(self, snap: dict):
+        import jax.numpy as jnp
+
+        if snap["capacity"] != self.capacity:
+            raise ValueError(
+                f"device CEP capacity mismatch: snapshot {snap['capacity']} "
+                f"vs configured {self.capacity}"
+            )
+        self.state = jax.tree_util.tree_map(jnp.asarray, snap["device"])
+        self.buffers = dict(snap["buffers"])
+        self.partials = dict(snap["partials"])
+        self.trailing = dict(snap["trailing"])
+        self.matches_detected = snap["matches_detected"]
+        self.matches_extracted = snap["matches_extracted"]
+        self.steps = snap["steps"]
+
+    def peek_state(self, key):
+        """Queryable-state read: this key's live partial matches, with
+        pending (unreplayed) compacted events applied NON-destructively —
+        pending events never contain a completion (the device would have
+        flagged it), so no match is swallowed. Returns None when the key
+        has no live partials (host-path 'cep-nfa-state' parity)."""
+        hi, lo = self.codec.encode([key], keep_reverse=False)
+        k = int((np.uint64(hi[0]) << np.uint64(32)) | np.uint64(lo[0]))
+        partials, _ms = self._advance_partials(
+            list(self.partials.get(k, [])), list(self.buffers.get(k, []))
+        )
+        return partials or None
+
+    def _advance_partials(self, partials: list,
+                          buf: Sequence) -> Tuple[list, List[dict]]:
+        """The single replay loop shared by extraction and queryable
+        reads: gap bits kill partials waiting on a STRICT stage, then the
+        exact host NFA advances."""
         matches: List[dict] = []
-        for ev, gap_before, ts in self.buffers.pop(k, []):
+        for ev, gap_before, ts in buf:
             if gap_before and partials:
                 partials = [
                     p for p in partials
@@ -212,5 +262,55 @@ class DeviceCepOperator:
                 ]
             partials, ms = self.nfa.process(partials, ev, ts)
             matches.extend(ms)
+        return partials, matches
+
+    def _replay(self, k: int) -> List[dict]:
+        partials, matches = self._advance_partials(
+            self.partials.get(k, []), self.buffers.pop(k, [])
+        )
         self.partials[k] = partials
         return matches
+
+    def prune_dead_keys(self) -> List[dict]:
+        """Bound host memory to true NFA-partials size (the SharedBuffer
+        pruning analog). Pending buffers of unflagged keys contain NO
+        completions (the device would have flagged them), so they can be
+        drained destructively into each key's partials; dead 'a x a x'
+        histories then collapse to the <=1 live partial the host NFA
+        would hold. Keys that never won a table slot (capacity overflow,
+        counted in dropped_capacity) can never be flagged for replay —
+        their state is freed outright. Returns any matches found during
+        the drain (expected empty; emitted defensively by the runner
+        rather than swallowed). One device fetch per call."""
+        if not (self.buffers or self.partials or self.trailing):
+            return []
+        tk, occ = jax.device_get(
+            (self.state.table.keys, self.state.table.used_mask())
+        )
+        tk, occ = np.asarray(tk), np.asarray(occ)
+        k64 = (tk[:, 0].astype(np.uint64) << np.uint64(32)) | \
+            tk[:, 1].astype(np.uint64)
+        in_table = set(int(v) for v in k64[occ])
+
+        unexpected: List[dict] = []
+        for k in list(self.buffers):
+            if k not in in_table:
+                del self.buffers[k]          # capacity-dropped key
+                continue
+            partials, ms = self._advance_partials(
+                self.partials.get(k, []), self.buffers.pop(k)
+            )
+            unexpected.extend(ms)
+            if partials:
+                self.partials[k] = partials
+            else:
+                self.partials.pop(k, None)
+        for k in [k for k in self.partials
+                  if not self.partials[k] or k not in in_table]:
+            del self.partials[k]
+        # trailing bits only matter for keys with live strict-waiting
+        # partials; everything else regrows from scratch
+        for k in [k for k in self.trailing if k not in self.partials]:
+            del self.trailing[k]
+        self.matches_extracted += len(unexpected)
+        return unexpected

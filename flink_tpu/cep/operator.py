@@ -25,6 +25,7 @@ from flink_tpu.state.descriptors import ValueStateDescriptor
 class CEPProcessFunction(ProcessFunction):
     def __init__(self, pattern, select_fn: Callable, flat: bool,
                  event_time: bool):
+        self.pattern = pattern     # executor routing: device kernel checks
         self.nfa = NFA(pattern)
         self.select_fn = select_fn
         self.flat = flat

@@ -71,6 +71,11 @@ class JobMetrics:
     dropped_capacity: int = 0
     restarts: int = 0
     wall_time_s: float = 0.0
+    # CEP: device count-NFA detections vs host-replay extractions — the
+    # two must agree (honesty cross-check for the accelerated path)
+    cep_device_steps: int = 0
+    cep_matches_detected: int = 0
+    cep_matches_extracted: int = 0
     # fire latency: bounded weighted samples — latency is watermark-
     # crossing -> sink invoke for every window in one emission
     # (ref LatencyMarker / the p99 half of the north-star metric)
@@ -487,8 +492,12 @@ class LocalExecutor:
                 handle = self._run_windowed(pipe, metrics, job_name,
                                             restore_from)
             elif pipe.process is not None:
-                handle = self._run_process(pipe, metrics, job_name,
-                                           restore_from)
+                if self._cep_device_eligible(pipe, restore_from):
+                    handle = self._run_cep_device(pipe, metrics, job_name,
+                                                  restore_from)
+                else:
+                    handle = self._run_process(pipe, metrics, job_name,
+                                               restore_from)
             elif pipe.rolling is not None:
                 handle = self._run_rolling(pipe, metrics, job_name, restore_from)
             else:
@@ -1601,6 +1610,196 @@ class LocalExecutor:
         metrics.fires += op.fires
         return handle
 
+    def _cep_device_eligible(self, pipe: _Pipeline, restore_from) -> bool:
+        """Route CEP.pattern() to the TPU-resident count-NFA kernel when
+        the pattern fits its representation (VERDICT r2 item 3; ref
+        NFA.java:132 in production position, BASELINE config #5).
+
+        Host-NFA fallback (the generality path) when: within() — per-
+        partial start timestamps don't fit count state; event-time — the
+        buffer-and-sort watermark drain is host-side; parallelism>1 —
+        single logical shard for now. Checkpoint/savepoint/restore and
+        queryable state are supported on the device path (parity with
+        _run_process); a checkpoint written by one path cannot be
+        restored by the other (validated, clear error)."""
+        from flink_tpu.cep.operator import CEPProcessFunction
+
+        fn = pipe.process.fn
+        ok = (
+            isinstance(fn, CEPProcessFunction)
+            and not fn.event_time
+            and fn.pattern.within_ms is None
+            and self.env.parallelism == 1
+        )
+        if ok and restore_from:
+            # route by what the checkpoint actually contains: a host-path
+            # checkpoint of a (now) device-eligible job must restore on
+            # the host path, not die with a payload-kind error
+            try:
+                st = ckpt.CheckpointStorage(restore_from)
+                cid = st.latest()
+                if cid is not None:
+                    return bool(st.read_generic(cid).get("cep_device"))
+            except (OSError, ValueError):
+                pass
+        return ok
+
+    def _run_cep_device(self, pipe: _Pipeline, metrics: JobMetrics,
+                        job_name, restore_from=None):
+        """Device CEP: per micro-batch, vectorized stage masks + the
+        segmented-matrix-scan count NFA on device decide WHICH keys
+        completed matches; the host replays only those keys' compacted
+        events for extraction (cep/accel.py)."""
+        from flink_tpu.cep.accel import DeviceCepOperator
+
+        env = self.env
+        fn = pipe.process.fn
+        op = DeviceCepOperator(
+            fn.pattern,
+            capacity=env.state_capacity_per_shard or (1 << 16),
+        )
+        key_selector = pipe.key_by.key_selector
+        select_fn = fn.select_fn
+        flat = fn.flat
+
+        reg = getattr(env, "_kv_registry", None)
+        if reg is not None:
+            # host-path parity: the per-key live partial matches are
+            # queryable under the same name _run_process registers
+            reg.register_resolver(
+                lambda: ["cep-nfa-state"],
+                lambda name, key: op.peek_state(key),
+            )
+
+        storage = None
+        if env.checkpoint_dir:
+            storage = ckpt.CheckpointStorage(
+                env.checkpoint_dir,
+                retain=env.config.get_int("checkpoint.retain", 2),
+            )
+        next_cid = (storage.latest() or 0) + 1 if storage else 1
+        steps_at_ckpt = 0
+
+        def _payload():
+            return {
+                "cep_device": True,
+                "op": op.snapshot(),
+                "offsets": pipe.source.snapshot_offsets(),
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+            }
+
+        def write_checkpoint():
+            nonlocal next_cid, steps_at_ckpt
+            payload = _payload()
+            storage.write_generic(next_cid, payload)
+            pipe.source.notify_checkpoint_complete(next_cid,
+                                                   payload["offsets"])
+            for s in pipe.all_sinks:
+                s.notify_checkpoint_complete(next_cid)
+            next_cid += 1
+            steps_at_ckpt = metrics.steps
+
+        def restore_checkpoint(path_or_storage, cid=None):
+            nonlocal steps_at_ckpt
+            st = (
+                ckpt.CheckpointStorage(path_or_storage)
+                if isinstance(path_or_storage, str) else path_or_storage
+            )
+            cid = cid if cid is not None else st.latest()
+            if cid is None:
+                raise FileNotFoundError(f"no checkpoint in {st.dir}")
+            payload = st.read_generic(cid)
+            if not payload.get("cep_device"):
+                raise ValueError(
+                    "checkpoint was written by the host CEP path; restore "
+                    "it with the same configuration (event-time/within/"
+                    "parallelism) it was created under"
+                )
+            op.restore(payload["op"])
+            pipe.source.restore_offsets(payload["offsets"])
+            sink_states = payload.get("sink_states")
+            if sink_states:
+                for s, ss in zip(pipe.all_sinks, sink_states):
+                    s.restore_state(ss)
+            steps_at_ckpt = metrics.steps
+
+        def write_savepoint(path: str) -> str:
+            sp = ckpt.CheckpointStorage(path, retain=10**9)
+            cid = (sp.latest() or 0) + 1
+            return sp.write_generic(cid, _payload())
+
+        self._savepoint_writer = write_savepoint
+
+        def batch_loop():
+            end = False
+            while not end:
+                self._poll_control()
+                polled, end = pipe.source.poll(env.batch_size)
+                elements = _apply_chain(pipe.pre_chain,
+                                        self._to_elements(polled))
+                if not elements:
+                    continue
+                metrics.records_in += len(elements)
+                keys = [key_selector(e) for e in elements]
+                now_ms = int(time.time() * 1000)
+                # pre-chain ops (flat_map) can expand past batch_size: pad
+                # to the next batch_size multiple (small jit cache)
+                bs = max(1, env.batch_size)
+                pad = ((len(elements) + bs - 1) // bs) * bs
+                matches = op.process_batch(elements, keys, now_ms,
+                                           pad_to=pad)
+                metrics.steps += 1
+                if metrics.steps % 64 == 0:
+                    # bound host buffers to live-partial size; any matches
+                    # surfacing here indicate a count/extraction skew —
+                    # emit rather than swallow
+                    matches = op.prune_dead_keys()
+                    if matches:
+                        out = ([r for m in matches for r in select_fn(m)]
+                               if flat else [select_fn(m) for m in matches])
+                        _emit_batch(pipe, out, metrics)
+                if matches:
+                    if flat:
+                        out = [r for m in matches for r in select_fn(m)]
+                    else:
+                        out = [select_fn(m) for m in matches]
+                    _emit_batch(pipe, out, metrics)
+                if (
+                    storage is not None
+                    and env.checkpoint_interval_steps > 0
+                    and metrics.steps - steps_at_ckpt
+                    >= env.checkpoint_interval_steps
+                ):
+                    write_checkpoint()
+
+        if restore_from:
+            restore_checkpoint(restore_from)
+        restart = self._restart_strategy()
+        while True:
+            try:
+                batch_loop()
+                break
+            except JobCancelledException:
+                raise
+            except Exception:
+                can = (
+                    storage is not None
+                    and storage.latest() is not None
+                    and restart.should_restart()
+                )
+                if not can:
+                    raise
+                metrics.restarts += 1
+                restore_checkpoint(storage)
+
+        # end of stream: live partials simply die (a CEP match emits the
+        # moment it completes; there is no pending-fire flush)
+        metrics.cep_device_steps = op.steps
+        metrics.cep_matches_detected = op.matches_detected
+        metrics.cep_matches_extracted = op.matches_extracted
+        metrics.dropped_capacity += op.dropped_capacity
+        return JobHandle(job_name, metrics)
+
     def _run_process(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
                      restore_from=None):
         """Keyed ProcessFunction stage: host generality path over the heap
@@ -1705,6 +1904,12 @@ class LocalExecutor:
             if cid is None:
                 raise FileNotFoundError(f"no checkpoint in {st.dir}")
             payload = st.read_generic(cid)
+            if payload.get("cep_device"):
+                raise ValueError(
+                    "checkpoint was written by the device CEP path; "
+                    "restoring it requires a device-eligible configuration "
+                    "(no within(), processing time, parallelism 1)"
+                )
             if payload["max_parallelism"] != env.max_parallelism:
                 raise ValueError("checkpoint max-parallelism mismatch")
             backend.restore(payload["backend"])
