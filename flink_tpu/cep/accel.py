@@ -26,8 +26,9 @@ kernels removed HeapReducingState.add.
 Eligibility (executor falls back to the host operator otherwise):
 patterns without within() — per-partial start timestamps do not fit the
 count representation — in processing-time mode (arrival order; the
-event-time buffer-and-sort drain stays host-side), single logical shard,
-no checkpointing.
+event-time buffer-and-sort drain stays host-side), single logical shard.
+Checkpoint/savepoint/restore are fully supported (snapshot()/restore()
+below; the barrier is the step boundary).
 
 Memory note: a key's compacted events stay buffered while it has live
 partials that could still complete (exactly the events the reference's
@@ -246,6 +247,15 @@ class DeviceCepOperator:
         partials, _ms = self._advance_partials(
             list(self.partials.get(k, [])), list(self.buffers.get(k, []))
         )
+        # the carried trailing bit (non-matching events after the last
+        # stored event) is normally folded into the NEXT hit's gap bit;
+        # the host path kills strict-waiting partials the moment the
+        # non-match arrives, so a parity read must apply it eagerly
+        if partials and self.trailing.get(k, False):
+            partials = [
+                p for p in partials
+                if self.stages[p.stage_idx + 1].contiguity == RELAXED
+            ]
         return partials or None
 
     def _advance_partials(self, partials: list,

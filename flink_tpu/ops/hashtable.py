@@ -137,6 +137,7 @@ def _upsert_impl(table_keys, hi, lo, static, valid):
     found, slot, has_empty, empty_slot = _lookup_or_empty(
         table_keys, capacity, probe_len, hi, lo
     )
+    n_new = jnp.sum(valid & ~found, dtype=jnp.int32)
     for _ in range(max_rounds):
         claim = valid & ~found & has_empty
         idx = jnp.where(claim, empty_slot, capacity)
@@ -146,7 +147,7 @@ def _upsert_impl(table_keys, hi, lo, static, valid):
         )
     ok = valid & found
     slot = jnp.where(ok, slot, capacity)
-    return table_keys, slot, ok
+    return table_keys, slot, ok, n_new
 
 
 def upsert(
@@ -158,10 +159,25 @@ def upsert(
     Returns (new_table, slot int32[B], ok bool[B]). ok=False lanes were valid
     records whose key could not be placed (chain exhausted — table too full).
     """
-    new_keys, slot, ok = _upsert_impl(
+    new_keys, slot, ok, _ = _upsert_impl(
         table.keys, hi, lo, (table.capacity, table.probe_len, max_rounds), valid
     )
     return SlotTable(new_keys, table.probe_len), slot, ok
+
+
+def upsert_counted(
+    table: SlotTable, hi: jax.Array, lo: jax.Array, valid: jax.Array,
+    max_rounds: int = 4,
+) -> Tuple[SlotTable, jax.Array, jax.Array, jax.Array]:
+    """upsert() that also reports n_new: how many valid lanes were NOT
+    already present before this call (keys newly claimed this batch, plus
+    lanes that failed to place). n_new == 0 certifies the batch was a pure
+    lookup — the signal the executor's adaptive step tiering uses to switch
+    to the insert-free fast path (see runtime/step.py)."""
+    new_keys, slot, ok, n_new = _upsert_impl(
+        table.keys, hi, lo, (table.capacity, table.probe_len, max_rounds), valid
+    )
+    return SlotTable(new_keys, table.probe_len), slot, ok, n_new
 
 
 def remove_slots(table: SlotTable, slots: jax.Array, mask: jax.Array) -> SlotTable:

@@ -278,7 +278,7 @@ def compact_table(state: WindowShardState, win: WindowSpec,
     # re-inserting a whole shard at once has far heavier claim-race
     # contention than incremental batches: probe_len rounds (not the step
     # path's 4) so every key that fit before fits again
-    new_keys, slot, ok = hashtable._upsert_impl(
+    new_keys, slot, ok, _ = hashtable._upsert_impl(
         fresh_table.keys, keys[:, 0], keys[:, 1],
         (C, state.table.probe_len, state.table.probe_len), alive,
     )
@@ -348,12 +348,29 @@ def update(
     win: WindowSpec,
     red: ReduceSpec,
     hi, lo, ts, values, valid,
-) -> WindowShardState:
+    insert: bool = True,
+):
     """Apply one micro-batch of records to shard state (pure function).
 
     The caller has already routed records: `valid` is False for lanes not
     owned by this shard. Replaces WindowOperator.processElement +
     HeapReducingState.add for the whole batch at once.
+
+    Returns ``(new_state, activity)`` where activity (int32 scalar) counts
+    lanes whose key was NOT already resident in the table: newly inserted
+    keys plus overflowed lanes. ``activity == 0`` certifies the batch was a
+    pure in-place update.
+
+    ``insert=False`` compiles the steady-state FAST path: the key table is
+    never mutated — one probe gather instead of upsert's five, and no claim
+    scatters (~6x cheaper on TPU, where the statically-unrolled claim
+    rounds dominate the step even when every key is already resident).
+    Records whose key is absent take the overflow ring -> host spill tier
+    (win.overflow must be > 0; their contributions merge back into window
+    emissions exactly like capacity overflow). The executor watches
+    ``activity`` through the lagged monitoring channel and flips back to
+    the insert step while new keys are arriving, so the fast path only
+    ever runs when misses are rare (runtime/executor.py step tiering).
     """
     C = state.table.capacity
     R = win.ring
@@ -421,9 +438,18 @@ def update(
     n_too_old = jnp.sum(too_old, dtype=jnp.int32)
     live = live & ~too_old
 
-    # -- key upsert ---------------------------------------------------------
-    table, slot, ok = hashtable.upsert(state.table, hi, lo, live)
+    # -- key upsert / lookup ------------------------------------------------
+    if insert:
+        table, slot, ok, n_new = hashtable.upsert_counted(
+            state.table, hi, lo, live
+        )
+    else:
+        table = state.table
+        slot, found = hashtable.lookup(state.table, hi, lo)
+        ok = found & live
+        n_new = jnp.zeros((), jnp.int32)   # misses counted via nofit below
     nofit = live & ~ok
+    activity = n_new + jnp.sum(nofit, dtype=jnp.int32)
     live = live & ok
 
     # -- overflow ring: nofit records append (key, pane, value) for the
@@ -503,7 +529,7 @@ def update(
         ovf_pane=ovf_pane,
         ovf_val=ovf_val,
         ovf_n=ovf_n,
-    )
+    ), activity
 
 
 def _expand(flag, val):

@@ -67,6 +67,7 @@ class JobMetrics:
     records_out: int = 0
     fires: int = 0
     steps: int = 0
+    steps_fast: int = 0   # steps run on the lookup-only fast tier
     dropped_late: int = 0
     dropped_capacity: int = 0
     restarts: int = 0
@@ -97,7 +98,7 @@ class JobMetrics:
     # the counter fields exported as live gauges (also consumed by the
     # MiniCluster's job detail endpoint)
     GAUGE_FIELDS = (
-        "records_in", "records_out", "fires", "steps",
+        "records_in", "records_out", "fires", "steps", "steps_fast",
         "dropped_late", "dropped_capacity", "restarts",
     )
 
@@ -550,8 +551,14 @@ class LocalExecutor:
         win = None
         spec = None
         update_step = None
+        update_step_fast = None   # lookup-only steady-state variant
         fire_step = None
         state = None
+        # adaptive step tiering (see wk.update insert flag): holders are
+        # 1-element lists so nested closures can flip them
+        step_mode = ["insert"]
+        tier_quiet = [0]          # consecutive zero-activity lagged checks
+        TIER_QUIET_CHECKS = 3
         codec = KeyCodec()
         # reverse key map costs a python dict insert per record; benchmarks
         # and columnar sinks that accept 64-bit key ids can turn it off
@@ -563,7 +570,8 @@ class LocalExecutor:
         )
 
         def setup(origin_ms: int, fresh_state: bool = True):
-            nonlocal td, win, spec, update_step, fire_step, state
+            nonlocal td, win, spec, update_step, update_step_fast
+            nonlocal fire_step, state
             td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
             ring = env.config.get_int("window.ring-panes", 0) or max(
                 8,
@@ -621,20 +629,41 @@ class LocalExecutor:
                             f"exchange.mode=all_to_all needs batch size "
                             f"divisible by {ctx.n_shards} shards, got {B}"
                         )
+                    bpd = B // ctx.n_shards
+                    capf = env.config.get_float("exchange.capacity-factor",
+                                                2.0)
                     update_step = build_window_update_step_exchange(
-                        ctx, spec, B // ctx.n_shards,
-                        env.config.get_float("exchange.capacity-factor", 2.0),
+                        ctx, spec, bpd, capf,
                     )
+                    if spillable and win.overflow:
+                        update_step_fast = build_window_update_step_exchange(
+                            ctx, spec, bpd, capf, insert=False,
+                        )
                 else:
                     update_step = build_window_update_step(ctx, spec)
+                    if spillable and win.overflow:
+                        update_step_fast = build_window_update_step(
+                            ctx, spec, insert=False,
+                        )
                 fire_step = build_window_fire_step(ctx, spec)
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
-                # trigger both compiles NOW (inside any benchmark warmup)
-                # so the first real pane-boundary fire isn't a multi-second
-                # compile stall mid-measurement; firing at the MIN-sentinel
-                # watermark is a no-op on fresh state
+                # trigger ALL compiles NOW (inside any benchmark warmup)
+                # so neither the first pane-boundary fire nor the first
+                # insert->fast tier switch is a multi-second compile stall
+                # mid-measurement; firing at the MIN-sentinel watermark is
+                # a no-op on fresh state
+                steps0, fast0 = metrics.steps, metrics.steps_fast
                 self._empty_step(run_update, B, red, None)
+                if update_step_fast is not None:
+                    step_mode[0] = "fast"
+                    self._empty_step(run_update, B, red, None)
+                    step_mode[0] = "insert"
+                    tier_quiet[0] = 0
+                    mon_watch.clear()
+                # warmup dispatches must not pollute the step counters the
+                # operator (and the tiering test) reads
+                metrics.steps, metrics.steps_fast = steps0, fast0
                 cf = run_fire(None)
                 jax.block_until_ready(cf.counts)
 
@@ -736,6 +765,11 @@ class LocalExecutor:
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
+            # restored table contents differ from the running population:
+            # re-enter insert mode until the lagged signal proves quiet
+            step_mode[0] = "insert"
+            tier_quiet[0] = 0
+            mon_watch.clear()
             # spill contents were folded into the snapshot's entries; the
             # restored device state supersedes the host tier
             for store in ovf_stores.values():
@@ -956,9 +990,10 @@ class LocalExecutor:
             """Dispatch one update-only device step. No host sync: the
             result is not read, so transfers and compute of successive
             steps overlap (the round-1 loop blocked on every step). The
-            step's tiny ovf_n output handle is queued for LAGGED overflow
-            monitoring — inspected a few steps later when it has already
-            materialized, so the pipeline never stalls."""
+            step's tiny (ovf_n, activity) output handles are queued for
+            LAGGED monitoring — inspected a few steps later when they have
+            already materialized, so the pipeline never stalls. `activity`
+            drives the insert<->fast step tiering (wk.update insert flag)."""
             nonlocal state
             wm_ticks = (
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
@@ -968,7 +1003,12 @@ class LocalExecutor:
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
             t_d0 = time.perf_counter()
-            state, ovf_handle = update_step(
+            active = (
+                update_step_fast
+                if step_mode[0] == "fast" and update_step_fast is not None
+                else update_step
+            )
+            state, (ovf_handle, act_handle) = active(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
@@ -976,8 +1016,18 @@ class LocalExecutor:
             # device pipeline is saturated -> the device-bound signal
             phase_acc["dispatch"] += time.perf_counter() - t_d0
             metrics.steps += 1
+            if active is update_step_fast:
+                metrics.steps_fast += 1
             if win.overflow:
-                ovf_watch.append(ovf_handle)
+                # start the d2h copy NOW, in the background: a cold
+                # device->host fetch on this runtime costs ~70ms of fixed
+                # round-trip latency, but by the time the lagged check
+                # reads the handle the async copy has long completed and
+                # np.asarray is a host-cache hit
+                for h in (ovf_handle, act_handle):
+                    if hasattr(h, "copy_to_host_async"):
+                        h.copy_to_host_async()
+                mon_watch.append((ovf_handle, act_handle))
                 check_overflow_pressure()
 
         def run_fire(wm_ms):
@@ -1006,17 +1056,36 @@ class LocalExecutor:
         # single host-side dispatch table for the builtin reduce kinds the
         # spill tier supports: (accumulating ufunc, neutral element)
         ufunc, ovf_neutral = _HOST_REDUCE.get(red.kind, (None, None))
-        # lagged ring monitoring: per-step ovf_n output handles; the oldest
-        # is inspected once OVF_LAG newer steps have been dispatched — its
-        # value is long since computed, so the read costs ~nothing
-        ovf_watch = []
+        # lagged ring monitoring: per-step (ovf_n, activity) output handles;
+        # the oldest is inspected once OVF_LAG newer steps have been
+        # dispatched — its async host copy is long since complete, so the
+        # read costs ~nothing
+        mon_watch = []
         OVF_LAG = 4
 
         def check_overflow_pressure():
-            if len(ovf_watch) <= OVF_LAG:
+            if len(mon_watch) <= OVF_LAG:
                 return
-            h = ovf_watch.pop(0)
-            fill = int(np.asarray(h).max(initial=0))
+            ovf_h, act_h = mon_watch.pop(0)
+            fill = int(np.asarray(ovf_h).max(initial=0))
+            act = int(np.asarray(act_h).sum())
+            # -- adaptive step tiering: while new keys are arriving, run
+            # the upsert step; once the key population is resident
+            # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
+            # to the lookup-only fast step (~6x cheaper). Any miss in fast
+            # mode flips back immediately — the missed records are already
+            # safe in the overflow ring -> spill tier.
+            if update_step_fast is not None:
+                if step_mode[0] == "insert":
+                    if act == 0:
+                        tier_quiet[0] += 1
+                        if tier_quiet[0] >= TIER_QUIET_CHECKS:
+                            step_mode[0] = "fast"
+                    else:
+                        tier_quiet[0] = 0
+                elif act > 0:
+                    step_mode[0] = "insert"
+                    tier_quiet[0] = 0
             if fill > max(1, B // 8):
                 # meaningful pressure: drain NOW rather than waiting for
                 # the next pane boundary. The auto-sized ring (~6*B lanes)
@@ -1076,7 +1145,7 @@ class LocalExecutor:
                 return
             if not _merge_ring_into_stores():
                 return
-            ovf_watch.clear()     # queued handles reflect pre-drain fill
+            mon_watch.clear()     # queued handles reflect pre-drain fill
             # free dead-key slots so future records fit (RocksDB-compaction
             # analog); compiled lazily — overflow is the rare path
             if compact_step_fn is None:
@@ -1752,11 +1821,12 @@ class LocalExecutor:
                 if metrics.steps % 64 == 0:
                     # bound host buffers to live-partial size; any matches
                     # surfacing here indicate a count/extraction skew —
-                    # emit rather than swallow
-                    matches = op.prune_dead_keys()
-                    if matches:
-                        out = ([r for m in matches for r in select_fn(m)]
-                               if flat else [select_fn(m) for m in matches])
+                    # emit rather than swallow (but never clobber the
+                    # batch's own matches, still pending below)
+                    pruned = op.prune_dead_keys()
+                    if pruned:
+                        out = ([r for m in pruned for r in select_fn(m)]
+                               if flat else [select_fn(m) for m in pruned])
                         _emit_batch(pipe, out, metrics)
                 if matches:
                     if flat:

@@ -73,7 +73,8 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
         mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
             kg <= kg_end.astype(jnp.uint32)
         )
-        state = wk.update(state, spec.win, spec.red, hi, lo, ts, values, mine)
+        state, _ = wk.update(state, spec.win, spec.red, hi, lo, ts, values,
+                             mine)
         state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
         state = jax.tree_util.tree_map(lambda x: x[None], state)
         fires = jax.tree_util.tree_map(lambda x: x[None], fires)
@@ -103,7 +104,8 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
     return step
 
 
-def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
+def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
+                             insert: bool = True):
     """Update-only half of the window step: apply a micro-batch and advance
     the shard watermark, but do NOT evaluate fires. The reference evaluates
     timers on every watermark advance (HeapInternalTimerService), but a
@@ -112,7 +114,12 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
     host computes the watermark, so it knows exactly when that happens and
     calls the fire step (build_window_fire_step) only then. Between
     boundaries every step is sync-free: state is donated, nothing is read
-    back, and dispatch overlaps device compute."""
+    back, and dispatch overlaps device compute.
+
+    ``insert=False`` builds the lookup-only FAST variant (wk.update's
+    insert flag): same state layout, so the executor switches between the
+    two compiled steps per micro-batch at zero cost, driven by the lagged
+    activity signal in the monitoring output."""
     import dataclasses as _dc
 
     starts, ends = ctx.kg_bounds()
@@ -130,13 +137,15 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
         mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
             kg <= kg_end.astype(jnp.uint32)
         )
-        state = wk.update(state, spec.win, spec.red, hi, lo, ts, values, mine)
+        state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
+                                    values, mine, insert=insert)
         state = _dc.replace(
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
         ovf_n = state.ovf_n
         return (
-            jax.tree_util.tree_map(lambda x: x[None], state), ovf_n[None]
+            jax.tree_util.tree_map(lambda x: x[None], state),
+            ovf_n[None], activity[None],
         )
 
     sharded = shard_map(
@@ -147,25 +156,30 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec):
             P(), P(), P(), P(), P(),
             P(SHARD_AXIS),
         ),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def update_step(state, hi, lo, ts, values, valid, wm):
-        """Returns (state', ovf_n). ovf_n is a tiny NON-donated copy of the
-        overflow-ring fill level: the host queues the handle and inspects
-        it a few steps later — by then the value has materialized, so the
-        read never stalls the step pipeline (overflow monitoring with lag).
+        """Returns (state', (ovf_n, activity)). The second element is a
+        tiny NON-donated monitoring pair: overflow-ring fill level and
+        not-already-resident lane count. The host queues the handle and
+        inspects it a few steps later — by then the values have
+        materialized, so the read never stalls the step pipeline (lagged
+        monitoring). `activity` drives the insert<->fast step tiering.
         """
-        return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
+        st, ovf_n, act = sharded(state, starts, ends, hi, lo, ts, values,
+                                 valid, wm)
+        return st, (ovf_n, act)
 
     return update_step
 
 
 def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
                                       batch_per_device: int,
-                                      capacity_factor: float = 2.0):
+                                      capacity_factor: float = 2.0,
+                                      insert: bool = True):
     """Update step with a real ICI record exchange instead of
     replicate-and-mask: the host splits the batch over devices (each holds
     B/n lanes), each device buckets its lanes by owning shard and ONE
@@ -201,8 +215,8 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
         mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
             kg <= kg_end.astype(jnp.uint32)
         )
-        state = wk.update(state, spec.win, spec.red, r_hi, r_lo, r_ts,
-                          r_values, mine)
+        state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
+                                    r_ts, r_values, mine, insert=insert)
         state = _dc.replace(
             state,
             watermark=jnp.maximum(state.watermark, wm[0]),
@@ -210,7 +224,8 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
         )
         ovf_n = state.ovf_n
         return (
-            jax.tree_util.tree_map(lambda x: x[None], state), ovf_n[None]
+            jax.tree_util.tree_map(lambda x: x[None], state),
+            ovf_n[None], activity[None],
         )
 
     sharded = shard_map(
@@ -223,13 +238,15 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS),
             P(SHARD_AXIS),  # per-shard watermark
         ),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def _jit_step(state, hi, lo, ts, values, valid, wm):
-        return sharded(state, starts, ends, hi, lo, ts, values, valid, wm)
+        st, ovf_n, act = sharded(state, starts, ends, hi, lo, ts, values,
+                                 valid, wm)
+        return st, (ovf_n, act)
 
     def update_step(state, hi, lo, ts, values, valid, wm):
         return _jit_step(state, hi, lo, ts, values, valid, wm)

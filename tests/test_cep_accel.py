@@ -369,3 +369,34 @@ def test_within_falls_back_to_host():
     job = env.execute("cep-within-host")
     assert job.metrics.cep_device_steps == 0
     assert sink.results == [1]
+
+
+def test_match_completing_on_prune_step_is_emitted():
+    """Regression: the 64-step prune pass in the device CEP batch loop
+    used to overwrite the batch's own matches with prune_dead_keys()'s
+    return value, silently dropping every match that completed on a
+    step divisible by 64."""
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 1          # one step per event -> step count is exact
+    env.set_parallelism(1)
+    sink = CollectSink()
+    # 62 filler non-matching events, then two 'a','b' pairs (same key);
+    # the first completion lands exactly on step 64 (event index 63)
+    events = [Event(0, "x", 1) for _ in range(62)] + [
+        Event(100, "a", 1), Event(101, "b", 1),
+        Event(200, "a", 1), Event(201, "b", 1),
+    ]
+    pattern = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    stream = env.from_collection(events).key_by(lambda e: e.value)
+    CEP.pattern(stream, pattern).select(
+        lambda m: (m["a"].ts, m["b"].ts)
+    ).add_sink(sink)
+    job = env.execute("cep-prune-step-match")
+    assert job.metrics.cep_device_steps >= 64
+    # followed_by is RELAXED: the a@100 partial also pairs with b@201
+    assert sorted(sink.results) == [(100, 101), (100, 201), (200, 201)]
+    assert job.metrics.cep_matches_detected == \
+        job.metrics.cep_matches_extracted == 3

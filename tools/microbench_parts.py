@@ -1,0 +1,135 @@
+"""Component-level costs of the window update step on the real TPU.
+
+Measures, in isolation:
+  * [B,P] arbitrary-index gather of probe chains (current hashtable._probe)
+  * [B] gather with contiguous slice_sizes=(P,2) (candidate redesign)
+  * scatter-add of B lanes into a C*R accumulator
+  * scatter-set of B bool lanes
+  * single lookup vs full 5-round upsert
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(f, *args, iters=10):
+    out = f(*args)
+    import jax
+
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=262_144)
+    ap.add_argument("--capacity", type=int, default=1 << 22)
+    ap.add_argument("--probe", type=int, default=16)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import hashtable
+
+    B, C, P, R = args.batch, args.capacity, args.probe, 8
+    rng = np.random.default_rng(0)
+
+    keys64 = rng.integers(0, 2**63, size=B, dtype=np.int64)
+    h = keys64.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    hi = jnp.asarray((h >> np.uint64(32)).astype(np.uint32))
+    lo = jnp.asarray((h & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    valid = jnp.ones(B, bool)
+
+    table = hashtable.create(C, P)
+    table, slot, ok = hashtable.upsert(table, hi, lo, valid)
+    jax.block_until_ready(table.keys)
+    tk = table.keys
+
+    base = np.asarray(
+        jax.jit(lambda h_, l_: hashtable._chain(h_, l_, C, 1))(hi, lo)
+    )[:, 0]
+    cand = jnp.asarray(
+        (base[:, None] + np.arange(P)[None, :]) % C, np.int32
+    )
+    base_j = jnp.asarray(base, np.int32)
+
+    @jax.jit
+    def gather_arbitrary(tk_, cand_):
+        return tk_[cand_]                     # [B, P, 2]
+
+    @jax.jit
+    def gather_slices(tk_, base_):
+        # one gather of B contiguous (P, 2) slices
+        import jax.lax as lax
+
+        return lax.gather(
+            tk_, base_[:, None],
+            lax.GatherDimensionNumbers(
+                offset_dims=(1, 2), collapsed_slice_dims=(),
+                start_index_map=(0,),
+            ),
+            slice_sizes=(P, 2), mode="clip",
+        )
+
+    print(f"gather [B,P] arbitrary: {timeit(gather_arbitrary, tk, cand):8.2f} ms")
+    print(f"gather B slices (P,2):  {timeit(gather_slices, tk, base_j):8.2f} ms")
+
+    acc = jnp.zeros(C * R, jnp.float32)
+    flat = jnp.asarray(rng.integers(0, C * R, B), np.int32)
+    upd = jnp.ones(B, jnp.float32)
+
+    @jax.jit
+    def scatter_add(acc_, flat_, upd_):
+        return acc_.at[flat_].add(upd_)
+
+    touched = jnp.zeros(C * R, bool)
+
+    @jax.jit
+    def scatter_set(t_, flat_):
+        return t_.at[flat_].set(True)
+
+    print(f"scatter-add B->C*R:     {timeit(scatter_add, acc, flat, upd):8.2f} ms")
+    print(f"scatter-set B->C*R:     {timeit(scatter_set, touched, flat):8.2f} ms")
+
+    @jax.jit
+    def one_lookup(tk_, hi_, lo_):
+        return hashtable._lookup_or_empty(tk_, C, P, hi_, lo_)
+
+    print(f"single lookup:          {timeit(one_lookup, tk, hi, lo):8.2f} ms")
+
+    def full_upsert(tk_, hi_, lo_):
+        return hashtable._upsert_impl(tk_, hi_, lo_, (C, P, 4), valid)
+
+    print(f"upsert (1+4 rounds):    {timeit(full_upsert, tk, hi, lo):8.2f} ms")
+
+    # h2d: one fused transfer vs 5 separate
+    cols = [np.asarray(rng.random(B), np.float32) for _ in range(5)]
+
+    def h2d_sep():
+        return [jnp.asarray(c) for c in cols]
+
+    packed = np.stack(cols)
+
+    def h2d_packed():
+        return jnp.asarray(packed)
+
+    print(f"h2d 5 separate arrays:  {timeit(h2d_sep, iters=5):8.2f} ms")
+    print(f"h2d 1 packed array:     {timeit(h2d_packed, iters=5):8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

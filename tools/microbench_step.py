@@ -1,0 +1,102 @@
+"""Direct device-time microbenchmark of the window update/fire steps.
+
+Times N dispatches of build_window_update_step with block_until_ready,
+isolating pure device step time from bench.py's host pipeline. Sweep
+batch and capacity to find the throughput-optimal config.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=262_144)
+    ap.add_argument("--capacity", type=int, default=1 << 22)
+    ap.add_argument("--probe", type=int, default=16)
+    ap.add_argument("--ring", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=12)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec, build_window_fire_step, build_window_update_step,
+        init_sharded_state,
+    )
+
+    B = args.batch
+    ctx = MeshContext.create(len(jax.devices()), 128)
+    win = wk.WindowSpec(size_ticks=5000, slide_ticks=5000, ring=args.ring,
+                        fires_per_step=4, lateness_ticks=0, overflow=0)
+    red = wk.ReduceSpec(kind="sum")
+    spec = WindowStageSpec(win=win, red=red, capacity_per_shard=args.capacity,
+                           probe_len=args.probe)
+    state = init_sharded_state(ctx, spec)
+    upd = build_window_update_step(ctx, spec)
+    fire = build_window_fire_step(ctx, spec)
+
+    rng = np.random.default_rng(0)
+    N_KEYS = 1_000_000
+
+    def mk(i):
+        idx = np.arange(i * B, (i + 1) * B, dtype=np.int64)
+        keys = (idx * 2862933555777941757) % N_KEYS
+        h = keys.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+        hi = (h >> np.uint64(32)).astype(np.uint32)
+        lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        ts = (idx // 2000).astype(np.int32)
+        vals = np.ones(B, np.float32)
+        valid = np.ones(B, bool)
+        return hi, lo, ts, vals, valid
+
+    wmv = jnp.full((ctx.n_shards,), np.int32(-(2**31) + 1))
+    batches = [mk(i) for i in range(4)]
+    dev_batches = [
+        tuple(jnp.asarray(a) for a in b) for b in batches
+    ]
+
+    # warmup/compile
+    state, ovf = upd(state, *dev_batches[0], wmv)
+    jax.block_until_ready(ovf)
+
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        state, ovf = upd(state, *dev_batches[i % 4], wmv)
+    jax.block_until_ready(ovf)
+    dt = (time.perf_counter() - t0) / args.iters
+    print(f"update step: {dt*1e3:.2f} ms/step -> "
+          f"{B/dt/1e6:.2f} M events/s (B={B}, cap={args.capacity}, "
+          f"probe={args.probe}, ring={args.ring})")
+
+    # host->device transfer cost for one batch
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        arrs = [jnp.asarray(a) for a in batches[i % 4]]
+    jax.block_until_ready(arrs)
+    dt_x = (time.perf_counter() - t0) / args.iters
+    print(f"h2d transfer: {dt_x*1e3:.2f} ms/batch")
+
+    # fire step cost (all 1M keys resident)
+    st2, cf = fire(state, jnp.full((ctx.n_shards,), np.int32(10_000)))
+    jax.block_until_ready(cf.counts)
+    t0 = time.perf_counter()
+    st3, cf = fire(st2, jnp.full((ctx.n_shards,), np.int32(10_001)))
+    jax.block_until_ready(cf.counts)
+    print(f"fire step: {(time.perf_counter()-t0)*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Phase-level profile of the north-star pipeline (bench.py subject).
+
+Runs the same 1M-key tumbling-window sum as bench.py and prints the
+executor's CycleAttribution report (source/host/dispatch/emit EWMAs) plus
+wall-clock totals, so optimization targets the measured bottleneck instead
+of a guess. Usage:
+
+    python tools/profile_northstar.py [--events N] [--batch B] [--cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=4_000_000)
+    ap.add_argument("--batch", type=int, default=262_144)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--trace", default=None,
+                    help="directory for a JAX profiler trace of the run")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    import bench
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    bench.BATCH = args.batch
+
+    def gen(offset, n):
+        keys, ts, vals = bench.gen_batch(offset, n)
+        return {"key": keys, "value": vals}, ts
+
+    cfg = Configuration({"keys.reverse-map": False})
+    env = StreamExecutionEnvironment(cfg)
+    env.set_parallelism(len(jax.devices()))
+    env.set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1 << 22)
+    env.batch_size = args.batch
+
+    sink = CountingSink()
+    (
+        env.add_source(GeneratorSource(gen, total=args.events))
+        .key_by(lambda c: c["key"])
+        .time_window(bench.WINDOW_MS)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    if args.trace:
+        jax.profiler.start_trace(args.trace)
+    t0 = time.perf_counter()
+    job = env.execute("profile-northstar")
+    dt = time.perf_counter() - t0
+    if args.trace:
+        jax.profiler.stop_trace()
+
+    rep = env._backpressure_report()
+    n_busy = rep.get("busy-cycles", 0) or 1
+    print(json.dumps({
+        "events_per_s": round(args.events / dt),
+        "wall_s": round(dt, 2),
+        "steps": job.metrics.steps,
+        "steps_fast": job.metrics.steps_fast,
+        "fires": job.metrics.fires,
+        "classification": rep.get("classification"),
+        "phase_ewma_ms": rep.get("phase-ewma-ms"),
+        "approx_phase_totals_s": {
+            k: round(v * n_busy / 1e3, 2)
+            for k, v in (rep.get("phase-ewma-ms") or {}).items()
+        },
+        "busy_cycles": rep.get("busy-cycles"),
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()
