@@ -17,7 +17,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from flink_tpu.ops.hashing import hash64_host
+from flink_tpu.ops.hashing import hash64_host, key_identity64  # noqa: F401
 
 
 @dataclass(frozen=True)
@@ -112,9 +112,11 @@ def make_batch(
 class KeyCodec:
     """Maps arbitrary host keys <-> 64-bit device key identities.
 
-    Numeric keys hash vectorized (splitmix64); other keys via a cached
-    per-object stable hash. Keeps the reverse map so fired windows can be
-    reported with original keys (the device only ever sees the 64-bit id).
+    Numeric keys map to their raw 64-bit bits (collision-free identity;
+    device-side probe/route hashes do the mixing — see
+    hashing.key_identity64); other keys via a cached per-object stable
+    hash. Keeps the reverse map so fired windows can be reported with
+    original keys (the device only ever sees the 64-bit id).
     """
 
     def __init__(self):
@@ -122,7 +124,7 @@ class KeyCodec:
 
     def encode(self, keys, keep_reverse: bool = True):
         """keys: numeric array (vectorized) or sequence of objects."""
-        h = hash64_host(keys)
+        h = key_identity64(keys)
         if keep_reverse:
             klist = keys.tolist() if isinstance(keys, np.ndarray) else keys
             for k, hv in zip(klist, h.tolist()):

@@ -137,7 +137,7 @@ def _upsert_impl(table_keys, hi, lo, static, valid):
     found, slot, has_empty, empty_slot = _lookup_or_empty(
         table_keys, capacity, probe_len, hi, lo
     )
-    n_new = jnp.sum(valid & ~found, dtype=jnp.int32)
+    found0 = found
     for _ in range(max_rounds):
         claim = valid & ~found & has_empty
         idx = jnp.where(claim, empty_slot, capacity)
@@ -146,6 +146,13 @@ def _upsert_impl(table_keys, hi, lo, static, valid):
             table_keys, capacity, probe_len, hi, lo
         )
     ok = valid & found
+    # n_new counts lanes whose key was PLACED this call (absent before,
+    # resident after). Lanes that fail to place (chain exhausted) are
+    # deliberately excluded: they can never be placed by re-running the
+    # insert step either — they belong to the overflow/spill tier, and
+    # counting them would permanently pin the executor's step tiering in
+    # insert mode for a key population that partially overflows.
+    n_new = jnp.sum(valid & ~found0 & found, dtype=jnp.int32)
     slot = jnp.where(ok, slot, capacity)
     return table_keys, slot, ok, n_new
 
@@ -169,11 +176,12 @@ def upsert_counted(
     table: SlotTable, hi: jax.Array, lo: jax.Array, valid: jax.Array,
     max_rounds: int = 4,
 ) -> Tuple[SlotTable, jax.Array, jax.Array, jax.Array]:
-    """upsert() that also reports n_new: how many valid lanes were NOT
-    already present before this call (keys newly claimed this batch, plus
-    lanes that failed to place). n_new == 0 certifies the batch was a pure
-    lookup — the signal the executor's adaptive step tiering uses to switch
-    to the insert-free fast path (see runtime/step.py)."""
+    """upsert() that also reports n_new: how many valid lanes' keys were
+    PLACED by this call (absent before, resident after). Lanes that fail
+    to place (probe chain exhausted) are excluded — re-running insert can
+    never place them, so they must not hold the executor's adaptive step
+    tiering in insert mode. n_new == 0 certifies the batch changed no
+    table row (see runtime/step.py / executor tiering)."""
     new_keys, slot, ok, n_new = _upsert_impl(
         table.keys, hi, lo, (table.capacity, table.probe_len, max_rounds), valid
     )

@@ -213,7 +213,17 @@ def overflow_supported(red: ReduceSpec) -> bool:
 
 
 def init_state(capacity: int, probe_len: int, win: WindowSpec,
-               red: ReduceSpec) -> WindowShardState:
+               red: ReduceSpec, layout: str = "hash") -> WindowShardState:
+    """layout="direct": the DIRECT-INDEX state backend. For keys that are
+    bounded non-negative ints (identity hi==0, lo < capacity — see
+    hashing.key_identity64), the key IS its slot: no probe gathers, no
+    claim scatters, no insert phase at all. The table is prefilled with
+    identity rows (0, slot), so every consumer of table.keys (fire
+    packing, snapshots, queryable reads) works unchanged; keys outside
+    the bound take the overflow ring -> spill tier like any other
+    non-resident key. The reference has no analog — its HeapKeyedState-
+    Backend always pays the HashMap probe (StateTable, SURVEY §2.4);
+    array-indexed state is the layout a TPU wants."""
     R = win.ring
     n_elems = capacity * R * int(np.prod(red.value_shape, dtype=np.int64))
     if n_elems > 2**31 - 1:
@@ -229,8 +239,17 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
     neutral = red.neutral_value()
     acc = jnp.broadcast_to(neutral, (capacity * R,) + red.value_shape).astype(red.dtype)
     O = win.overflow
+    if layout == "direct":
+        iota = jnp.arange(capacity, dtype=jnp.uint32)
+        table = hashtable.SlotTable(
+            jnp.stack([jnp.zeros_like(iota), iota], axis=1), probe_len
+        )
+    elif layout == "hash":
+        table = hashtable.create(capacity, probe_len)
+    else:
+        raise ValueError(f"unknown state layout {layout!r}")
     return WindowShardState(
-        table=hashtable.create(capacity, probe_len),
+        table=table,
         acc=acc + jnp.zeros_like(acc),  # materialize (broadcast_to is a view)
         touched=jnp.zeros(capacity * R, bool),
         pane_ids=jnp.full((R,), PANE_NONE, jnp.int32),
@@ -349,6 +368,7 @@ def update(
     red: ReduceSpec,
     hi, lo, ts, values, valid,
     insert: bool = True,
+    direct: bool = False,
 ):
     """Apply one micro-batch of records to shard state (pure function).
 
@@ -439,17 +459,31 @@ def update(
     live = live & ~too_old
 
     # -- key upsert / lookup ------------------------------------------------
-    if insert:
-        table, slot, ok, n_new = hashtable.upsert_counted(
+    # activity = lanes the CURRENT mode failed to handle natively:
+    #   insert mode -> newly PLACED keys (population still growing; lanes
+    #     that exhaust their probe chain are excluded — re-running insert
+    #     can never place them, they belong to the spill tier)
+    #   fast mode   -> missing lanes (spilled; the host flips back to
+    #     insert mode only when these exceed a churn threshold)
+    if direct:
+        # direct-index layout (init_state layout="direct"): the key IS the
+        # slot. No probe, no table mutation; out-of-bound keys spill.
+        table = state.table
+        ok = live & (hi == jnp.uint32(0)) & (lo < jnp.uint32(C))
+        slot = jnp.where(ok, lo, jnp.uint32(C)).astype(jnp.int32)
+        nofit = live & ~ok
+        activity = jnp.zeros((), jnp.int32)   # no insert phase to tier
+    elif insert:
+        table, slot, ok, activity = hashtable.upsert_counted(
             state.table, hi, lo, live
         )
+        nofit = live & ~ok
     else:
         table = state.table
         slot, found = hashtable.lookup(state.table, hi, lo)
         ok = found & live
-        n_new = jnp.zeros((), jnp.int32)   # misses counted via nofit below
-    nofit = live & ~ok
-    activity = n_new + jnp.sum(nofit, dtype=jnp.int32)
+        nofit = live & ~ok
+        activity = jnp.sum(nofit, dtype=jnp.int32)
     live = live & ok
 
     # -- overflow ring: nofit records append (key, pane, value) for the
@@ -583,10 +617,17 @@ class CompactFires:
     window_end_ticks: jax.Array  # int32 [Ft]
     n_fires: jax.Array          # int32 scalar: valid lanes
     lane_valid: jax.Array       # bool [Ft]
+    # per-lane scalar reduction of the packed values (sum over emitted
+    # slots; unused lanes pack zeros so no mask is needed). Lets a
+    # device_reduce sink consume a drain by reading ONLY the small fields
+    # — no O(fires) device->host transfer (runtime/sinks.py Sink.
+    # device_reduce).
+    value_sums: jax.Array       # float32 [Ft]
 
     def tree_flatten(self):
         return (self.key_hi, self.key_lo, self.values, self.counts,
-                self.window_end_ticks, self.n_fires, self.lane_valid), None
+                self.window_end_ticks, self.n_fires, self.lane_valid,
+                self.value_sums), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -610,11 +651,14 @@ def compact_fires(table: SlotTable, fr: FireResult) -> CompactFires:
         khi = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 0], mode="drop")
         klo = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 1], mode="drop")
         v = jnp.zeros_like(vals_f).at[idx].set(vals_f, mode="drop")
-        return khi, klo, v, jnp.sum(mask_f, dtype=jnp.int32)
+        vsum = jnp.sum(
+            jnp.where(_expand(mask_f, vals_f), vals_f, 0.0)
+        ).astype(jnp.float32)
+        return khi, klo, v, jnp.sum(mask_f, dtype=jnp.int32), vsum
 
-    khi, klo, v, counts = jax.vmap(pack)(fr.mask, fr.values)
+    khi, klo, v, counts, vsums = jax.vmap(pack)(fr.mask, fr.values)
     return CompactFires(khi, klo, v, counts, fr.window_end_ticks,
-                        fr.n_fires, fr.lane_valid)
+                        fr.n_fires, fr.lane_valid, vsums)
 
 
 def advance_and_fire(

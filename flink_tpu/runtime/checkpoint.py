@@ -145,12 +145,65 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
     shard_fresh = []
     pane_rows = []
     starts, ends = ctx.kg_bounds()
+    direct = getattr(spec, "layout", "hash") == "direct"
     for s in range(ctx.n_shards):
         sel = (kg >= starts[s]) & (kg <= ends[s])
         e_hi, e_lo = khi[sel], klo[sel]
         e_pane, e_val = pane[sel], value[sel]
         e_fr = e_fresh[sel]
-        table = hashtable.create(C, spec.probe_len)
+
+        # layout-specific half: build the table and resolve each entry to
+        # its slot; entries that do not fit go to leftover (the caller's
+        # spill tier) in either layout
+        def _spill(lost):
+            if leftover is None:
+                raise RuntimeError(
+                    "restore: state does not fit the configured capacity"
+                )
+            leftover.append((
+                e_hi[lost], e_lo[lost], e_pane[lost], e_val[lost]
+            ))
+
+        if direct:
+            # direct-index layout: slot == key (identity table, see
+            # wk.init_state layout="direct")
+            fit = (e_hi == 0) & (e_lo < C)
+            if not bool(fit.all()):
+                _spill(~fit)
+                e_lo, e_pane, e_val, e_fr = (
+                    e_lo[fit], e_pane[fit], e_val[fit], e_fr[fit]
+                )
+            entry_slots = e_lo.astype(np.int64)
+            iota = np.arange(C, dtype=np.uint32)
+            table_keys = np.stack([np.zeros_like(iota), iota], axis=1)
+        elif len(e_hi):
+            # unique keys (entries repeat per pane)
+            u_keys, inv = np.unique(
+                (e_hi.astype(np.uint64) << np.uint64(32)) | e_lo,
+                return_inverse=True,
+            )
+            u_hi = (u_keys >> np.uint64(32)).astype(np.uint32)
+            u_lo = (u_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            table, slots, ok = hashtable.upsert(
+                hashtable.create(C, spec.probe_len),
+                jnp.asarray(u_hi), jnp.asarray(u_lo),
+                jnp.ones(len(u_hi), dtype=bool),
+            )
+            ok = np.asarray(ok)
+            if not bool(ok.all()):
+                _spill(~ok[inv])         # per-entry mask of unfitted keys
+                keep_e = ok[inv]
+                e_pane, e_val, e_fr = (
+                    e_pane[keep_e], e_val[keep_e], e_fr[keep_e]
+                )
+                inv = inv[keep_e]
+            entry_slots = np.asarray(slots)[inv]
+            table_keys = np.asarray(table.keys)
+        else:
+            entry_slots = np.zeros(0, np.int64)
+            table_keys = np.asarray(hashtable.create(C, spec.probe_len).keys)
+
+        # shared half: scatter entries into the ring-major pane arrays
         acc_s = np.asarray(
             jnp.broadcast_to(
                 spec.red.neutral_value(), (C * R,) + spec.red.value_shape
@@ -158,38 +211,12 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
         ).copy()
         touched_s = np.zeros(C * R, bool)
         fresh_s = np.zeros(C * R, bool)
-        if len(e_hi):
-            # unique keys (entries repeat per pane)
-            u_keys, inv = np.unique(
-                (e_hi.astype(np.uint64) << np.uint64(32)) | e_lo, return_inverse=True
-            )
-            u_hi = (u_keys >> np.uint64(32)).astype(np.uint32)
-            u_lo = (u_keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            table, slots, ok = hashtable.upsert(
-                table, jnp.asarray(u_hi), jnp.asarray(u_lo),
-                jnp.ones(len(u_hi), dtype=bool),
-            )
-            ok = np.asarray(ok)
-            if not bool(ok.all()):
-                if leftover is None:
-                    raise RuntimeError(
-                        "restore: state does not fit the configured capacity"
-                    )
-                lost = ~ok[inv]          # per-entry mask of unfitted keys
-                leftover.append((
-                    e_hi[lost], e_lo[lost], e_pane[lost], e_val[lost]
-                ))
-                keep_e = ~lost
-                e_pane, e_val, e_fr = (
-                    e_pane[keep_e], e_val[keep_e], e_fr[keep_e]
-                )
-                inv = inv[keep_e]
-            slots = np.asarray(slots)
-            flat = (e_pane % R) * C + slots[inv]
+        if len(entry_slots):
+            flat = (e_pane % R) * C + entry_slots
             acc_s[flat] = e_val
             touched_s[flat] = True
             fresh_s[flat] = e_fr
-        shard_tables.append(np.asarray(table.keys))
+        shard_tables.append(table_keys)
         shard_accs.append(acc_s)
         shard_touched.append(touched_s)
         shard_fresh.append(fresh_s)

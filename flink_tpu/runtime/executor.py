@@ -18,6 +18,7 @@ import dataclasses
 import itertools
 import os
 import queue
+import sys
 import threading
 import time
 from collections import namedtuple
@@ -68,6 +69,7 @@ class JobMetrics:
     fires: int = 0
     steps: int = 0
     steps_fast: int = 0   # steps run on the lookup-only fast tier
+    state_layout: str = ""  # "hash" | "direct" once the stage is set up
     dropped_late: int = 0
     dropped_capacity: int = 0
     restarts: int = 0
@@ -81,6 +83,23 @@ class JobMetrics:
     # crossing -> sink invoke for every window in one emission
     # (ref LatencyMarker / the p99 half of the north-star metric)
     fire_latency: Any = None
+    # checkpoint history (ref CheckpointStatsTracker): bounded list of
+    # {"id", "trigger_ms", "duration_ms", "bytes", "entries"} dicts,
+    # newest last — served by the web monitor's /checkpoints handler
+    checkpoint_stats: Any = None
+
+    def record_checkpoint(self, cid: int, trigger_ms: float,
+                          duration_ms: float, nbytes: int, entries: int):
+        if self.checkpoint_stats is None:
+            self.checkpoint_stats = []
+        self.checkpoint_stats.append({
+            "id": cid,
+            "trigger_ms": round(trigger_ms, 1),
+            "duration_ms": round(duration_ms, 2),
+            "bytes": nbytes,
+            "entries": entries,
+        })
+        del self.checkpoint_stats[:-200]      # bounded history
 
     def record_fire_latency(self, n_windows: int, ms: float):
         from flink_tpu.metrics.latency import LatencySamples
@@ -462,6 +481,9 @@ class LocalExecutor:
 
         pipe = _translate(sink_transforms)
         metrics = JobMetrics()
+        # live handle for web monitors (checkpoint stats are structured
+        # history, not gauges — the registry only carries scalars)
+        self.env._live_metrics = metrics
         self._init_metrics(job_name, metrics)
         t_start = time.perf_counter()
         for s in pipe.all_sinks:
@@ -554,11 +576,33 @@ class LocalExecutor:
         update_step_fast = None   # lookup-only steady-state variant
         fire_step = None
         state = None
+        # key-state layout, decided ONCE (the compiled steps bake it in):
+        # "hash" | "direct" | "auto" (resolved from the first batch's key
+        # identities in setup(); see wk.init_state layout="direct")
+        layout_cfg = env.config.get_str("state.backend.layout", "auto")
+        if layout_cfg not in ("auto", "hash", "direct"):
+            raise ValueError(
+                f"state.backend.layout must be auto|hash|direct, "
+                f"got {layout_cfg!r}"
+            )
+        layout = [None]
+        # set by poll_cycle from the first batch's key identities; setup()
+        # combines it with spillability to resolve layout "auto"
+        auto_direct_hint = [False]
         # adaptive step tiering (see wk.update insert flag): holders are
         # 1-element lists so nested closures can flip them
         step_mode = ["insert"]
         tier_quiet = [0]          # consecutive zero-activity lagged checks
-        TIER_QUIET_CHECKS = 3
+        # checks are SAMPLED every MON_EVERY steps, so 2 quiet checks span
+        # ~2*MON_EVERY steps of genuinely quiet stream before the switch
+        TIER_QUIET_CHECKS = 2
+        # futile-bounce damping: when a fast->insert bounce places NOTHING
+        # (the misses were chain-exhausted keys insert can never place),
+        # tolerate that miss level in fast mode instead of bouncing
+        # forever; reset when compaction/restore may change placeability
+        miss_tolerance = [0]
+        bounce_miss = [0]         # miss count that triggered current bounce
+        bounce_placed = [False]   # did the bounce place any key?
         codec = KeyCodec()
         # reverse key map costs a python dict insert per record; benchmarks
         # and columnar sinks that accept 64-bit key ids can turn it off
@@ -593,9 +637,10 @@ class LocalExecutor:
                 # of being silently wrong for that corner
                 and wagg.allowed_lateness_ms == 0
             )
-            # -1/unset = auto: absorbs OVF_LAG+1 steps of full-batch
-            # overflow between lagged detection and drain (no loss);
-            # 0 disables; an explicit positive value wins (and may
+            # -1/unset = auto: absorbs the full sampled-lagged detection
+            # window of full-batch overflow (MON_EVERY*(OVF_LAG+1) steps
+            # between a miss and its drain, plus dispatch slack) with no
+            # loss; 0 disables; an explicit positive value wins (and may
             # lose under sustained pressure, surfaced by the
             # strict-capacity error)
             ovf_cfg = env.config.get_int("state.backend.overflow-ring", -1)
@@ -608,17 +653,38 @@ class LocalExecutor:
                     "capacity"
                 )
             if spillable:
-                ovf = ovf_cfg if ovf_cfg >= 0 else 6 * B + 8192
+                auto = (MON_EVERY * (OVF_LAG + 1) + 4) * B + 8192
+                ovf = ovf_cfg if ovf_cfg >= 0 else auto
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
-                ring=ring, fires_per_step=4,
+                ring=ring,
+                # F window-ends evaluated per fire step: each lane costs 3
+                # full-capacity pack scatters, so fewer lanes = cheaper
+                # boundary drains; catch-up replay just loops more drains
+                fires_per_step=env.config.get_int("window.fires-per-step", 4),
                 lateness_ticks=wagg.allowed_lateness_ms,
                 overflow=ovf,
             )
+            if layout[0] is None:
+                if layout_cfg != "auto":
+                    layout[0] = layout_cfg
+                else:
+                    # auto picks direct only when the spill tier exists to
+                    # absorb later out-of-bound keys; a non-spillable
+                    # stage (e.g. allowed lateness > 0, generic reduce)
+                    # would DROP them where the hash layout would simply
+                    # insert them
+                    layout[0] = (
+                        "direct" if auto_direct_hint[0] and spillable
+                        else "hash"
+                    )
             spec = WindowStageSpec(
                 win=win, red=red,
                 capacity_per_shard=env.state_capacity_per_shard,
+                probe_len=env.config.get_int("state.probe-len", 16),
+                layout=layout[0],
             )
+            metrics.state_layout = layout[0]
             if update_step is None:
                 # exchange.mode: "mask" (replicate-and-mask, default) or
                 # "all_to_all" (ICI record shuffle; per-device work O(B/n))
@@ -635,13 +701,15 @@ class LocalExecutor:
                     update_step = build_window_update_step_exchange(
                         ctx, spec, bpd, capf,
                     )
-                    if spillable and win.overflow:
+                    if spillable and win.overflow and layout[0] != "direct":
                         update_step_fast = build_window_update_step_exchange(
                             ctx, spec, bpd, capf, insert=False,
                         )
                 else:
                     update_step = build_window_update_step(ctx, spec)
-                    if spillable and win.overflow:
+                    if spillable and win.overflow and layout[0] != "direct":
+                        # direct layout has no insert phase — one step
+                        # variant serves both regimes
                         update_step_fast = build_window_update_step(
                             ctx, spec, insert=False,
                         )
@@ -733,6 +801,8 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt, n_keys_logged
+            t_ck0 = time.perf_counter()
+            trigger_ms = time.time() * 1000
             # drain due fires so fired_through is uniform across shards and
             # the snapshot is an exact global cut (F-throttle divergence)
             drain_fires(int(wm_strategy.current()))
@@ -749,15 +819,25 @@ class LocalExecutor:
                 "wm_current": wm_strategy.current(),
                 "codec_rev_count": n_keys_logged if keep_rev else 0,
                 "size_ms": size_ms, "slide_ms": slide_ms,
+                "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
             offsets = pipe.source.snapshot_offsets()
-            storage.write(next_cid, entries, scalars, offsets, aux)
+            path = storage.write(next_cid, entries, scalars, offsets, aux)
             # the checkpoint is durable: commit offsets externally + let
             # sinks finalize (ref notifyCheckpointComplete fan-out)
             pipe.source.notify_checkpoint_complete(next_cid, offsets)
             for s in pipe.all_sinks:
                 s.notify_checkpoint_complete(next_cid)
+            nbytes = sum(
+                os.path.getsize(os.path.join(path, f))
+                for f in os.listdir(path)
+            ) if path and os.path.isdir(path) else 0
+            metrics.record_checkpoint(
+                next_cid, trigger_ms,
+                (time.perf_counter() - t_ck0) * 1e3,
+                nbytes, len(entries["key_hi"]),
+            )
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
@@ -769,6 +849,8 @@ class LocalExecutor:
             # re-enter insert mode until the lagged signal proves quiet
             step_mode[0] = "insert"
             tier_quiet[0] = 0
+            miss_tolerance[0] = 0
+            bounce_miss[0] = 0
             mon_watch.clear()
             # spill contents were folded into the snapshot's entries; the
             # restored device state supersedes the host tier
@@ -785,6 +867,17 @@ class LocalExecutor:
             entries, scalars, offsets, aux = st.read(cid)
             if (aux["size_ms"], aux["slide_ms"]) != (size_ms, slide_ms):
                 raise ValueError("checkpoint window spec mismatch")
+            # resume in the layout the snapshot was taken with (auto only;
+            # an explicit config wins): an auto-direct run restored as
+            # "hash" would upsert a dense key population into a table at
+            # ~100% load factor and fail. Snapshot entries are logical, so
+            # restore_window_state re-buckets them into whatever layout
+            # the stage runs; pre-layout checkpoints (no key) were hash.
+            if layout[0] is None:
+                layout[0] = (
+                    aux.get("state_layout", "hash")
+                    if layout_cfg == "auto" else layout_cfg
+                )
             setup(aux["origin_ms"], fresh_state=False)
             leftover = [] if win.overflow else None
             state = ckpt.restore_window_state(
@@ -857,6 +950,7 @@ class LocalExecutor:
                 "wm_current": wm_strategy.current(),
                 "codec_rev_count": len(codec._rev) if keep_rev else 0,
                 "size_ms": size_ms, "slide_ms": slide_ms,
+                "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
             cid = (sp.latest() or 0) + 1
@@ -999,7 +1093,10 @@ class LocalExecutor:
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
                 if wm_ms is not None else None
             )
-            wmv = jnp.full((ctx.n_shards,), np.int32(
+            # numpy, NOT jnp.full: an eager device op for this tiny vector
+            # costs a full ~100ms tunnel round trip per call; as a jit
+            # argument it rides the step's (queued, cheap) input transfer
+            wmv = np.full((ctx.n_shards,), np.int32(
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
             t_d0 = time.perf_counter()
@@ -1019,16 +1116,17 @@ class LocalExecutor:
             if active is update_step_fast:
                 metrics.steps_fast += 1
             if win.overflow:
-                # start the d2h copy NOW, in the background: a cold
-                # device->host fetch on this runtime costs ~70ms of fixed
-                # round-trip latency, but by the time the lagged check
-                # reads the handle the async copy has long completed and
-                # np.asarray is a host-cache hit
-                for h in (ovf_handle, act_handle):
-                    if hasattr(h, "copy_to_host_async"):
-                        h.copy_to_host_async()
-                mon_watch.append((ovf_handle, act_handle))
-                check_overflow_pressure()
+                # SAMPLED lagged monitoring: a cold device->host fetch on
+                # this runtime costs ~70ms of fixed round-trip latency
+                # (async pre-copy measured even slower), so only every
+                # MON_EVERY-th step's handles are retained and inspected;
+                # the overflow ring is auto-sized to absorb the whole
+                # detection lag (see setup())
+                mon_skip[0] += 1
+                if mon_skip[0] >= MON_EVERY:
+                    mon_skip[0] = 0
+                    mon_watch.append((ovf_handle, act_handle))
+                    check_overflow_pressure()
 
         def run_fire(wm_ms):
             nonlocal state
@@ -1036,7 +1134,7 @@ class LocalExecutor:
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
                 if wm_ms is not None else None
             )
-            wmv = jnp.full((ctx.n_shards,), np.int32(
+            wmv = np.full((ctx.n_shards,), np.int32(   # numpy: see run_update
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
             state, cf = fire_step(state, wmv)
@@ -1056,12 +1154,15 @@ class LocalExecutor:
         # single host-side dispatch table for the builtin reduce kinds the
         # spill tier supports: (accumulating ufunc, neutral element)
         ufunc, ovf_neutral = _HOST_REDUCE.get(red.kind, (None, None))
-        # lagged ring monitoring: per-step (ovf_n, activity) output handles;
-        # the oldest is inspected once OVF_LAG newer steps have been
-        # dispatched — its async host copy is long since complete, so the
-        # read costs ~nothing
+        # lagged + sampled ring monitoring: every MON_EVERY-th step's
+        # (ovf_n, activity) handles are retained; the oldest is inspected
+        # once OVF_LAG newer samples exist — by then its step has long
+        # finished, so the read is one settled round trip, amortized to
+        # ~1/MON_EVERY of the fixed d2h latency per step
         mon_watch = []
-        OVF_LAG = 4
+        mon_skip = [0]
+        MON_EVERY = 8
+        OVF_LAG = 1
 
         def check_overflow_pressure():
             if len(mon_watch) <= OVF_LAG:
@@ -1069,23 +1170,37 @@ class LocalExecutor:
             ovf_h, act_h = mon_watch.pop(0)
             fill = int(np.asarray(ovf_h).max(initial=0))
             act = int(np.asarray(act_h).sum())
-            # -- adaptive step tiering: while new keys are arriving, run
-            # the upsert step; once the key population is resident
+            # -- adaptive step tiering: while new keys are being PLACED,
+            # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
-            # to the lookup-only fast step (~6x cheaper). Any miss in fast
-            # mode flips back immediately — the missed records are already
-            # safe in the overflow ring -> spill tier.
+            # to the lookup-only fast step (~6x cheaper). A miss in fast
+            # mode flips back: a missed key that insert CAN place recurs
+            # as a miss on every subsequent batch, so leaving it on the
+            # spill tier compounds into expensive ring drains — bouncing
+            # to insert mode heals it permanently. A bounce that places
+            # NOTHING proves the missing keys are chain-exhausted (insert
+            # can never help); their miss level becomes the fast-mode
+            # tolerance so an over-capacity residue settles in fast mode
+            # instead of oscillating.
             if update_step_fast is not None:
                 if step_mode[0] == "insert":
                     if act == 0:
                         tier_quiet[0] += 1
                         if tier_quiet[0] >= TIER_QUIET_CHECKS:
                             step_mode[0] = "fast"
+                            if bounce_miss[0] and not bounce_placed[0]:
+                                miss_tolerance[0] = max(
+                                    miss_tolerance[0], bounce_miss[0]
+                                )
+                            bounce_miss[0] = 0
                     else:
                         tier_quiet[0] = 0
-                elif act > 0:
+                        bounce_placed[0] = True
+                elif act > miss_tolerance[0]:
                     step_mode[0] = "insert"
                     tier_quiet[0] = 0
+                    bounce_miss[0] = act
+                    bounce_placed[0] = False
             if fill > max(1, B // 8):
                 # meaningful pressure: drain NOW rather than waiting for
                 # the next pane boundary. The auto-sized ring (~6*B lanes)
@@ -1146,6 +1261,11 @@ class LocalExecutor:
             if not _merge_ring_into_stores():
                 return
             mon_watch.clear()     # queued handles reflect pre-drain fill
+            miss_tolerance[0] = 0  # compaction may change placeability
+            if spec.layout == "direct":
+                # no dead slots to free (slot == key, table immutable) —
+                # and a hash rebuild would destroy the identity rows
+                return
             # free dead-key slots so future records fit (RocksDB-compaction
             # analog); compiled lazily — overflow is the rare path
             if compact_step_fn is None:
@@ -1196,6 +1316,16 @@ class LocalExecutor:
             len(pipe.branches) == 1
             and not pipe.branches[0][0]
             and all(s.columnar for s in pipe.all_sinks)
+        )
+        # on-chip fire reduction (Sink.device_reduce): only aggregate
+        # scalars leave the device per drain. Requires the trivially
+        # columnar topology and no host-side result projection; the spill
+        # tier is checked per-drain (ovf_stores may appear mid-job).
+        sink_device_reduce = (
+            columnar_emit
+            and wagg.result_fn is None
+            and all(getattr(s, "device_reduce", False)
+                    for s in pipe.all_sinks)
         )
 
         def _merge_spill(khi, klo, end_ms, v, due_end_ticks,
@@ -1249,10 +1379,28 @@ class LocalExecutor:
             """Emit one CompactFires: read the small per-lane fields, then
             transfer only [:count] slices of the device-packed key/value
             buffers (no dense masks, no key-table transfer). Spill-tier
-            contributions merge in BEFORE any result projection."""
-            counts, lanes, ends = jax.device_get(
-                (cf.counts, cf.lane_valid, cf.window_end_ticks)
+            contributions merge in BEFORE any result projection.
+
+            When every sink is device_reduce-capable (and no spill tier /
+            result projection is in play), the drain completes from the
+            small fields alone: per-lane value sums were reduced on-chip
+            inside compact_fires, so NOTHING O(fires) crosses the
+            device->host link (~25MB/s on this runtime — the dominant
+            drain cost otherwise)."""
+            counts, lanes, ends, vsums = jax.device_get(
+                (cf.counts, cf.lane_valid, cf.window_end_ticks,
+                 cf.value_sums)
             )
+            if sink_device_reduce and not ovf_stores:
+                n = int((counts * lanes).sum())
+                if n == 0:
+                    return 0
+                vs = float((vsums * lanes).sum(dtype=np.float64))
+                metrics.fires += n
+                metrics.records_out += n
+                for s in pipe.all_sinks:
+                    s.invoke_reduced(n, vs)
+                return n
             slices, end_l = [], []
             # distinct due window ends (ticks). Spill contributions merge
             # into every fired value, but spill-ONLY keys append as new
@@ -1326,15 +1474,26 @@ class LocalExecutor:
             watermark crossing; every window emitted by this drain records
             (now - t_cross) as its fire latency (the p99 half of the
             north-star metric; ref WindowOperator.onEventTime drain)."""
+            dbg = os.environ.get("FLINK_TPU_DRAIN_DEBUG")
             t_e0 = time.perf_counter()
             drain_overflow()     # ring -> pane stores before any emission
+            t_ovf = time.perf_counter()
+            if dbg:
+                print(f"[drain] ovf={1e3*(t_ovf-t_e0):.0f}ms",
+                      file=sys.stderr)
             total = 0
             F = win.fires_per_step
             while True:
+                t_f0 = time.perf_counter()
                 cf = run_fire(wm_ms)
                 lanes = np.asarray(cf.lane_valid)   # [S, Ft]
+                t_f1 = time.perf_counter()
                 fires_before = metrics.fires
                 n_emit = emit_fires(cf)
+                if dbg:
+                    print(f"[drain] fire+lanes={1e3*(t_f1-t_f0):.0f}ms "
+                          f"emit={1e3*(time.perf_counter()-t_f1):.0f}ms "
+                          f"n={n_emit}", file=sys.stderr)
                 total += n_emit
                 if t_cross is not None:
                     # weight by WINDOWS fired (metrics.fires delta), not by
@@ -1445,6 +1604,17 @@ class LocalExecutor:
             if n:
                 last_ingest_t[0] = t_src
                 if td is None:
+                    # auto-layout hint: bounded non-negative int keys (the
+                    # identity fits hi==0, lo < capacity on the first
+                    # batch) are eligible for the direct-index backend —
+                    # key == slot, no probes, no inserts. setup() combines
+                    # this with spillability (out-of-bound keys must have
+                    # a spill tier to degrade to, not be dropped).
+                    auto_direct_hint[0] = (
+                        int(hi.max(initial=0)) == 0
+                        and int(lo.max(initial=0))
+                        < env.state_capacity_per_shard
+                    )
                     setup((int(np.min(ts_ms)) // size_ms) * size_ms)
                 ticks = td.to_ticks(ts_ms)
                 if event_time:
@@ -2282,9 +2452,9 @@ class LocalExecutor:
 
         def run_once(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
-            wmv = jnp.full((ctx.n_shards,), np.int32(
-                min(int(td.to_ticks(wm_ms)), 2**31 - 4)
-                if wm_ms is not None else -(2**31) + 1
+            wmv = np.full((ctx.n_shards,), np.int32(   # numpy: eager tiny
+                min(int(td.to_ticks(wm_ms)), 2**31 - 4)  # ops cost a full
+                if wm_ms is not None else -(2**31) + 1    # tunnel round trip
             ))
             state, old_f, mid_f, wm_f = step(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),

@@ -9,6 +9,13 @@ from typing import Any, Callable, List
 class Sink:
     #: set True when invoke_columnar is overridden (vectorized fast path)
     columnar = False
+    #: set True when the sink only consumes per-emission AGGREGATES
+    #: (count, value sum) and therefore never needs the fired keys/values
+    #: transferred off-device. The executor then reduces window fires
+    #: on-chip and delivers two scalars per drain instead of O(fires)
+    #: bytes over the (slow) device->host link — the TPU-native analog of
+    #: a pre-aggregating sink. invoke_reduced() receives the aggregates.
+    device_reduce = False
 
     def open(self):
         pass
@@ -37,9 +44,14 @@ class Sink:
 
 
 class CountingSink(Sink):
-    """Benchmark sink: O(1) per batch, tallies count and value sum."""
+    """Benchmark sink: O(1) per batch, tallies count and value sum.
+
+    device_reduce: fired (key, window, value) rows are reduced on-chip and
+    only (n, value_sum) cross the wire per drain — results identical to
+    the columnar path, minus the per-row transfer."""
 
     columnar = True
+    device_reduce = True
 
     def __init__(self):
         self.count = 0
@@ -56,6 +68,10 @@ class CountingSink(Sink):
 
         self.count += len(cols["value"])
         self.value_sum += float(np.sum(cols["value"]))
+
+    def invoke_reduced(self, n: int, value_sum: float):
+        self.count += int(n)
+        self.value_sum += float(value_sum)
 
 
 class CollectSink(Sink):

@@ -42,13 +42,16 @@ class WindowStageSpec:
     # jnp-traceable pre-keyed chain: (values_dict, ts, valid) -> (value, ts, valid)
     # applied on-device before keying (fused maps/filters).
     pre: Optional[Callable] = None
+    # "hash" (open-addressing SlotTable) or "direct" (key == slot for
+    # bounded non-negative int keys; see wk.init_state layout="direct")
+    layout: str = "hash"
 
 
 def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
     """Per-shard window state stacked on a leading [n_shards] axis."""
     def one(_):
         return wk.init_state(spec.capacity_per_shard, spec.probe_len,
-                             spec.win, spec.red)
+                             spec.win, spec.red, layout=spec.layout)
 
     states = [one(i) for i in range(ctx.n_shards)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
@@ -74,7 +77,7 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
             kg <= kg_end.astype(jnp.uint32)
         )
         state, _ = wk.update(state, spec.win, spec.red, hi, lo, ts, values,
-                             mine)
+                             mine, direct=spec.layout == "direct")
         state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
         state = jax.tree_util.tree_map(lambda x: x[None], state)
         fires = jax.tree_util.tree_map(lambda x: x[None], fires)
@@ -138,7 +141,8 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
             kg <= kg_end.astype(jnp.uint32)
         )
         state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
-                                    values, mine, insert=insert)
+                                    values, mine, insert=insert,
+                                    direct=spec.layout == "direct")
         state = _dc.replace(
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
@@ -216,7 +220,8 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             kg <= kg_end.astype(jnp.uint32)
         )
         state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
-                                    r_ts, r_values, mine, insert=insert)
+                                    r_ts, r_values, mine, insert=insert,
+                                    direct=spec.layout == "direct")
         state = _dc.replace(
             state,
             watermark=jnp.maximum(state.watermark, wm[0]),

@@ -12,6 +12,9 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>               job detail incl. JobMetrics
     /jobs/<jid>/metrics       full metric snapshot for the job
     /jobs/<jid>/backpressure  cycle-time percentiles
+    /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
+                              (ref CheckpointStatsTracker + handlers/checkpoints/)
+    /web                      single-page HTML dashboard over these routes
 """
 
 from __future__ import annotations
@@ -37,6 +40,15 @@ class WebMonitor:
                 pass
 
             def do_GET(self):
+                if urllib.parse.urlsplit(self.path).path in ("/web", "/web/"):
+                    data = _DASHBOARD_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 try:
                     u = urllib.parse.urlsplit(self.path)
                     query = dict(urllib.parse.parse_qsl(u.query))
@@ -108,6 +120,33 @@ class WebMonitor:
             except KeyError as e:
                 return {"ok": False, "error": str(e)}
             return {"ok": True, "value": value}
+        m = re.fullmatch(r"/jobs/([^/]+)/checkpoints", path)
+        if m:
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            live = getattr(rec.env, "_live_metrics", None)
+            stats = (getattr(live, "checkpoint_stats", None) or [])
+            if not stats and rec.handle is not None:
+                stats = rec.handle.metrics.checkpoint_stats or []
+            durs = [s["duration_ms"] for s in stats]
+            sizes = [s["bytes"] for s in stats]
+            return {
+                "counts": {"completed": len(stats)},
+                "summary": {
+                    "duration-ms": {
+                        "min": min(durs) if durs else 0,
+                        "max": max(durs) if durs else 0,
+                        "avg": sum(durs) / len(durs) if durs else 0,
+                    },
+                    "state-size-bytes": {
+                        "min": min(sizes) if sizes else 0,
+                        "max": max(sizes) if sizes else 0,
+                        "avg": sum(sizes) / len(sizes) if sizes else 0,
+                    },
+                },
+                "history": stats[-50:],
+            }
         m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)
         if m:
             rec = self.cluster.jobs.get(m.group(1))
@@ -150,3 +189,101 @@ class WebMonitor:
                 out["record-latency-ms"] = next(iter(lat.values()))
             return out
         return None
+
+
+# Single-page dashboard over the JSON routes (the role of the reference's
+# AngularJS web-dashboard, flink-runtime-web/web-dashboard — rebuilt as one
+# dependency-free page: job list -> per-job metrics, back-pressure
+# attribution, and checkpoint history, auto-refreshing).
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>flink-tpu dashboard</title>
+<style>
+ body{font:13px/1.5 system-ui,sans-serif;margin:0;background:#f4f5f7;color:#172b4d}
+ header{background:#172b4d;color:#fff;padding:10px 18px;font-size:16px}
+ header span{opacity:.65;font-size:12px;margin-left:10px}
+ main{padding:14px 18px;max-width:1100px}
+ table{border-collapse:collapse;width:100%;background:#fff;margin:8px 0 18px}
+ th,td{padding:6px 10px;border:1px solid #dfe1e6;text-align:left;font-size:12px}
+ th{background:#fafbfc}
+ .state{font-weight:600}
+ .RUNNING{color:#0747a6}.FINISHED{color:#006644}.FAILED{color:#bf2600}
+ .CANCELED{color:#6b778c}
+ h2{font-size:14px;margin:16px 0 4px}
+ .pill{display:inline-block;padding:1px 8px;border-radius:9px;background:#dfe1e6;
+       font-size:11px;margin-left:6px}
+ .ok{background:#abf5d1}.low{background:#fff0b3}.high{background:#ffbdad}
+ tr.sel{outline:2px solid #4c9aff}
+ #err{color:#bf2600}
+</style></head><body>
+<header>flink-tpu<span>web dashboard — click a job for details</span></header>
+<main>
+ <div id="err"></div>
+ <h2>Overview <span id="ov" class="pill"></span></h2>
+ <h2>Jobs</h2><table id="jobs"><tr><th>id</th><th>name</th><th>state</th>
+  <th>duration</th></tr></table>
+ <div id="detail" style="display:none">
+  <h2>Metrics — <span id="jname"></span></h2><table id="mx"></table>
+  <h2>Back-pressure <span id="bp" class="pill"></span></h2><table id="bpt"></table>
+  <h2>Checkpoints <span id="ckn" class="pill"></span></h2>
+  <table id="ck"><tr><th>id</th><th>duration ms</th><th>bytes</th>
+   <th>entries</th></tr></table>
+ </div>
+</main><script>
+let sel=null;
+const J=async p=>{const r=await fetch(p);if(!r.ok)throw new Error(p+" -> "+r.status);
+ return r.json()};
+const fmtDur=ms=>ms<0?"-":(ms/1000).toFixed(1)+"s";
+async function tick(){
+ try{
+  document.getElementById("err").textContent="";
+  const ov=await J("/overview");
+  document.getElementById("ov").textContent=
+   `running ${ov["jobs-running"]} / finished ${ov["jobs-finished"]} / failed ${ov["jobs-failed"]}`;
+  const jobs=(await J("/jobs")).jobs;
+  const t=document.getElementById("jobs");
+  while(t.rows.length>1)t.deleteRow(1);
+  for(const j of jobs){
+   const r=t.insertRow();r.style.cursor="pointer";
+   if(j.jid===sel)r.className="sel";
+   r.onclick=()=>{sel=j.jid;tick()};
+   r.insertCell().textContent=j.jid;
+   r.insertCell().textContent=j.name;
+   const c=r.insertCell();c.textContent=j.state;c.className="state "+j.state;
+   r.insertCell().textContent=fmtDur(j.duration);
+  }
+  if(!sel&&jobs.length)sel=jobs[jobs.length-1].jid;
+  if(!sel)return;
+  const d=await J("/jobs/"+sel);
+  document.getElementById("detail").style.display="";
+  document.getElementById("jname").textContent=d.name;
+  const mx=document.getElementById("mx");mx.innerHTML="";
+  for(const[k,v]of Object.entries(d.metrics||{})){
+   const r=mx.insertRow();r.insertCell().textContent=k;
+   r.insertCell().textContent=v;
+  }
+  const bp=await J("/jobs/"+sel+"/backpressure");
+  const lv=bp["backpressure-level"]||"ok";
+  const pb=document.getElementById("bp");
+  pb.textContent=(bp.attribution&&bp.attribution.classification)||lv;
+  pb.className="pill "+lv;
+  const bt=document.getElementById("bpt");bt.innerHTML="";
+  for(const[k,v]of Object.entries((bp.attribution||{})["phase-ewma-ms"]||{})){
+   const r=bt.insertRow();r.insertCell().textContent=k+" ms/cycle";
+   r.insertCell().textContent=v;
+  }
+  const ck=await J("/jobs/"+sel+"/checkpoints");
+  document.getElementById("ckn").textContent=
+   (ck.counts?ck.counts.completed:0)+" completed";
+  const kt=document.getElementById("ck");
+  while(kt.rows.length>1)kt.deleteRow(1);
+  for(const c of(ck.history||[]).slice(-12).reverse()){
+   const r=kt.insertRow();
+   r.insertCell().textContent=c.id;
+   r.insertCell().textContent=c.duration_ms;
+   r.insertCell().textContent=c.bytes;
+   r.insertCell().textContent=c.entries;
+  }
+ }catch(e){document.getElementById("err").textContent=String(e)}
+}
+tick();setInterval(tick,2000);
+</script></body></html>"""

@@ -201,3 +201,64 @@ def test_job_metrics_gauges_registered_on_execute():
     snap = env.metric_registry.snapshot("jobs.metered")
     assert snap["jobs.metered.records_in"] == 3
     assert snap["jobs.metered.records_out"] == 3
+
+
+def test_web_checkpoint_stats_and_dashboard(tmp_path):
+    """/jobs/<jid>/checkpoints serves the CheckpointStatsTracker-analog
+    history (id/duration/bytes/entries + summary), and /web serves the
+    HTML dashboard page."""
+    import urllib.request
+
+    from flink_tpu.runtime.web import WebMonitor
+
+    import numpy as np
+
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return {"key": idx % 8, "value": np.ones(n, np.float32)}, idx // 8
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(64)
+    env.batch_size = 32
+    env.checkpoint_dir = str(tmp_path / "ck")
+    env.checkpoint_interval_steps = 4
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=32 * 12))
+        .key_by(lambda c: c["key"])
+        .time_window(1000)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "ck-web-job")
+    try:
+        assert cluster.wait(jid, 120) == "FINISHED"
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return r.read()
+
+        ck = json.loads(get(f"/jobs/{jid}/checkpoints"))
+        assert ck["counts"]["completed"] >= 2
+        h = ck["history"][-1]
+        assert h["bytes"] > 0 and h["duration_ms"] > 0 and h["entries"] > 0
+        assert ck["summary"]["state-size-bytes"]["max"] >= h["bytes"]
+
+        page = get("/web").decode()
+        assert "<html" in page and "flink-tpu" in page
+        assert "/jobs/" in page          # the page drives the JSON routes
+    finally:
+        web.stop()

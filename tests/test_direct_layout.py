@@ -1,0 +1,150 @@
+"""Direct-index state layout: key == slot for bounded non-negative int
+keys (wk.init_state layout="direct"; auto-selected by the executor from
+the first batch's key identities). No probe gathers, no insert phase;
+out-of-bound keys take the overflow ring -> spill tier.
+"""
+
+import numpy as np
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink, CountingSink
+from flink_tpu.runtime.sources import GeneratorSource
+
+
+def _env(capacity, **cfg):
+    env = StreamExecutionEnvironment(Configuration(cfg))
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(capacity)
+    return env
+
+
+def test_auto_selects_direct_and_results_exact():
+    B, n_keys, total = 128, 200, 128 * 30
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return {"key": idx % n_keys, "value": np.ones(n, np.float32)}, idx // 32
+
+    env = _env(256)
+    env.batch_size = B
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(40)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("direct-auto")
+    assert job.metrics.state_layout == "direct"
+    got = {}
+    for r in sink.results:
+        got[(r.key, r.window_end_ms)] = got.get((r.key, r.window_end_ms),
+                                                0) + r.value
+    exp = {}
+    for i in range(total):
+        k, w = i % n_keys, ((i // 32) // 40 + 1) * 40
+        exp[(k, w)] = exp.get((k, w), 0) + 1.0
+    assert got == exp
+    assert job.metrics.dropped_capacity == 0
+
+
+def test_auto_falls_back_to_hash_for_unbounded_keys():
+    B, total = 64, 64 * 6
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        # 64-bit ids far above capacity -> hash layout
+        return ({"key": (idx % 16) * 10_000_000_019,
+                 "value": np.ones(n, np.float32)}, idx // 16)
+
+    env = _env(256)
+    env.batch_size = B
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(1000)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("hash-fallback")
+    assert job.metrics.state_layout == "hash"
+    assert sum(r.value for r in sink.results) == total
+
+
+def test_direct_out_of_bound_keys_take_spill_tier():
+    """Keys beyond capacity spill (overflow ring -> host stores) and still
+    emit exact sums — the same degraded-mode contract as hash overflow."""
+    B, total = 64, 64 * 20
+    cap = 64
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        # first batches fit (auto picks direct), then keys 0..199 rotate:
+        # 136 of them are out of the 64-slot bound every batch
+        key = idx % 200 if offset > 0 else idx % 50
+        return {"key": key, "value": np.ones(n, np.float32)}, idx // 16
+
+    env = _env(cap)
+    env.batch_size = B
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(1000)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("direct-spill")
+    assert job.metrics.state_layout == "direct"
+    assert job.metrics.dropped_capacity == 0
+    assert sum(r.value for r in sink.results) == total
+
+
+def test_direct_checkpoint_restore_roundtrip(tmp_path):
+    """Snapshot in direct layout restores exactly (identity table
+    rebuilt, pane values scattered by key)."""
+    from flink_tpu.runtime import checkpoint as ckpt
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec, build_window_update_step, init_sharded_state,
+    )
+    import jax
+    import jax.numpy as jnp
+
+    ctx = MeshContext.create(1, 8)
+    win = wk.WindowSpec(size_ticks=100, slide_ticks=100, ring=8,
+                        fires_per_step=2, overflow=16)
+    red = wk.ReduceSpec(kind="sum")
+    spec = WindowStageSpec(win=win, red=red, capacity_per_shard=64,
+                           layout="direct")
+    state = init_sharded_state(ctx, spec)
+    upd = build_window_update_step(ctx, spec)
+
+    keys = np.asarray([3, 7, 3, 60], np.uint32)
+    hi = np.zeros(4, np.uint32)
+    ts = np.asarray([0, 10, 20, 130], np.int32)
+    vals = np.asarray([1.0, 2.0, 4.0, 8.0], np.float32)
+    wm = np.full((1,), np.int32(-(2**31) + 1))
+    state, _ = upd(state, hi, keys, ts, vals, np.ones(4, bool), wm)
+
+    entries, scalars = ckpt.snapshot_window_state(state, win)
+    restored = ckpt.restore_window_state(entries, scalars, ctx, spec)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.table.keys)),
+        np.asarray(jax.device_get(state.table.keys)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(restored.acc)),
+        np.asarray(jax.device_get(state.acc)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.touched)),
+        np.asarray(jax.device_get(state.touched)),
+    )

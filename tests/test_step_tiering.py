@@ -109,7 +109,12 @@ def test_executor_engages_fast_tier_and_stays_correct():
             idx // 64,             # event-time ms: ~40ms span per window
         )
 
-    env = StreamExecutionEnvironment(Configuration({"keys.reverse-map": True}))
+    env = StreamExecutionEnvironment(Configuration({
+        "keys.reverse-map": True,
+        # force the hash layout: bounded int keys would auto-select the
+        # direct-index backend, which has no insert phase to tier
+        "state.backend.layout": "hash",
+    }))
     env.set_parallelism(1)
     env.set_max_parallelism(8)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
@@ -133,3 +138,53 @@ def test_executor_engages_fast_tier_and_stays_correct():
         "fast tier never engaged in a steady-state stream"
     )
     assert job.metrics.dropped_capacity == 0
+
+
+def test_counting_sink_device_reduce_exact():
+    """CountingSink consumes drains via on-chip reduction (Sink.
+    device_reduce): totals must match the host columnar path exactly."""
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    B, n_keys, total = 128, 32, 128 * 24
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return (
+            {"key": idx % n_keys,
+             "value": (idx % 5).astype(np.float32)},
+            idx // 16,             # several window boundaries mid-stream
+        )
+
+    class HostCountingSink(CountingSink):
+        device_reduce = False     # force the host columnar emit path
+
+    def run(sink):
+        env = StreamExecutionEnvironment(
+            Configuration({"keys.reverse-map": False}))
+        env.set_parallelism(1)
+        env.set_max_parallelism(8)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(256)
+        env.batch_size = B
+        (
+            env.add_source(GeneratorSource(gen, total=total))
+            .key_by(lambda c: c["key"])
+            .time_window(50)
+            .sum(lambda c: c["value"])
+            .add_sink(sink)
+        )
+        env.execute("device-reduce-sink")
+        return sink
+
+    dev = run(CountingSink())
+    host = run(HostCountingSink())
+    exp_sum = float(sum(i % 5 for i in range(total)))
+    assert dev.value_sum == host.value_sum == exp_sum
+    # every (key, window) pair fires exactly once: windows span 50ms of
+    # event time = 800 events; all 32 keys appear in each window
+    n_windows = (total // 16 + 49) // 50
+    assert dev.count == host.count == n_windows * n_keys

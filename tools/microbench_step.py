@@ -46,6 +46,7 @@ def main():
                            probe_len=args.probe)
     state = init_sharded_state(ctx, spec)
     upd = build_window_update_step(ctx, spec)
+    upd_fast = build_window_update_step(ctx, spec, insert=False)
     fire = build_window_fire_step(ctx, spec)
 
     rng = np.random.default_rng(0)
@@ -77,9 +78,19 @@ def main():
         state, ovf = upd(state, *dev_batches[i % 4], wmv)
     jax.block_until_ready(ovf)
     dt = (time.perf_counter() - t0) / args.iters
-    print(f"update step: {dt*1e3:.2f} ms/step -> "
+    print(f"update step (insert): {dt*1e3:.2f} ms/step -> "
           f"{B/dt/1e6:.2f} M events/s (B={B}, cap={args.capacity}, "
           f"probe={args.probe}, ring={args.ring})")
+
+    state, ovf = upd_fast(state, *dev_batches[0], wmv)
+    jax.block_until_ready(ovf)
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        state, ovf = upd_fast(state, *dev_batches[i % 4], wmv)
+    jax.block_until_ready(ovf)
+    dt = (time.perf_counter() - t0) / args.iters
+    print(f"update step (fast):   {dt*1e3:.2f} ms/step -> "
+          f"{B/dt/1e6:.2f} M events/s")
 
     # host->device transfer cost for one batch
     t0 = time.perf_counter()

@@ -70,7 +70,17 @@ def main():
         jax.profiler.stop_trace()
 
     rep = env._backpressure_report()
-    n_busy = rep.get("busy-cycles", 0) or 1
+    snap = env.metric_registry.snapshot("jobs.profile-northstar")
+    phases = {}
+    for k, v in snap.items():
+        if "phase_" in k and isinstance(v, dict) and v.get("count"):
+            name = k.split("phase_")[1].replace("_ms", "")
+            phases[name] = {
+                "p50": round(v.get("p50", 0), 1),
+                "p90": round(v.get("p90", v.get("p95", 0)) or 0, 1),
+                "max": round(v.get("max", 0), 1),
+                "mean": round(v.get("mean", 0), 1),
+            }
     print(json.dumps({
         "events_per_s": round(args.events / dt),
         "wall_s": round(dt, 2),
@@ -78,11 +88,7 @@ def main():
         "steps_fast": job.metrics.steps_fast,
         "fires": job.metrics.fires,
         "classification": rep.get("classification"),
-        "phase_ewma_ms": rep.get("phase-ewma-ms"),
-        "approx_phase_totals_s": {
-            k: round(v * n_busy / 1e3, 2)
-            for k, v in (rep.get("phase-ewma-ms") or {}).items()
-        },
+        "phase_hists_ms": phases,
         "busy_cycles": rep.get("busy-cycles"),
     }, indent=2))
 
