@@ -1,0 +1,365 @@
+"""BASELINE.md configs #1/#3/#4/#5: subject vs scalar-reference baseline.
+
+Four measured rows (the north-star config #2 lives in bench.py):
+  socket_wc    SocketWindowWordCount: socket text -> split -> keyBy word ->
+               5s tumbling count (ref flink-examples SocketWindowWordCount
+               .java:76-79)
+  count_min    sliding-window Count-Min sketch aggregation (8s/4s)
+  sessions     event-time session windows, mergeable sum, 500ms gap
+  cep          CEP pattern a -> followed_by b over a keyed stream
+               (ref flink-cep NFA.java:132)
+
+Each baseline re-implements the reference's scalar hot path in-process
+(per-record dict/NFA work — the HeapKeyedStateBackend / NFA analog, see
+BASELINE.md). Prints ONE JSON line per config:
+  {"config": ..., "subject_eps": ..., "baseline_eps": ..., "ratio": ...}
+
+Usage: python bench_configs.py [--cpu] [--only NAME] [--events N]
+"""
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+from bench import probe_backend
+
+WORDS = [f"w{i:04d}" for i in range(500)]
+
+
+# ------------------------------------------------------------ socket WC
+def run_socket_wc(total_events: int, cpu: bool):
+    """Lines of "<ts_ms> <word> <word> ..." over a real TCP socket."""
+    words_per_line = 8
+    n_lines = total_events // words_per_line
+    rng = np.random.default_rng(0)
+    widx = rng.integers(0, len(WORDS), total_events)
+    lines = []
+    for i in range(n_lines):
+        ws = widx[i * words_per_line:(i + 1) * words_per_line]
+        lines.append(
+            (f"{i * 2} " + " ".join(WORDS[j] for j in ws) + "\n").encode()
+        )
+    payload = b"".join(lines)
+
+    # baseline: scalar split -> dict[(word, window)] += 1 with drains
+    t0 = time.perf_counter()
+    state, fired, wm_pane = {}, 0, -1
+    for i in range(n_lines):
+        parts = lines[i].decode().split()
+        ts = int(parts[0])
+        pane = ts // 5000
+        for w in parts[1:]:
+            k = (w, pane)
+            state[k] = state.get(k, 0) + 1
+        if pane - 1 > wm_pane:
+            wm_pane = pane - 1
+            for k in [k for k in state if k[1] <= wm_pane]:
+                fired += 1
+                state.pop(k)
+    fired += len(state)
+    base_dt = time.perf_counter() - t0
+    baseline_eps = total_events / base_dt
+
+    # subject: real socket ingestion through the framework
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def feed():
+        conn, _ = srv.accept()
+        with conn:
+            conn.sendall(payload)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.set_max_parallelism(32)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(4096)
+    env.batch_size = 8192
+    sink = CountingSink()
+    t0 = time.perf_counter()
+    (
+        env.socket_text_stream("127.0.0.1", port)
+        .flat_map(lambda line: [
+            (int(line.split()[0]), w) for w in line.split()[1:]
+        ])
+        .assign_timestamps_and_watermarks(
+            lambda e: e[0], WatermarkStrategy.for_monotonous_timestamps()
+        )
+        .key_by(lambda e: e[1])
+        .time_window(5000)
+        .count()
+        .add_sink(sink)
+    )
+    env.execute("socket-wc")
+    dt = time.perf_counter() - t0
+    srv.close()
+    assert sink.count > 0
+    return total_events / dt, baseline_eps
+
+
+# ------------------------------------------------------------ count-min
+def run_count_min(total_events: int, cpu: bool):
+    depth, width = 4, 1024
+    rng = np.random.default_rng(1)
+    items = rng.zipf(1.3, total_events).astype(np.int64) % 100_000
+    ts = (np.arange(total_events, dtype=np.int64) // 500)
+
+    # baseline: scalar CM update (depth hashes + row increments per item)
+    from flink_tpu.ops.hashing import splitmix64
+
+    seeds = splitmix64(np.arange(1, depth + 1, dtype=np.uint64))
+    t0 = time.perf_counter()
+    sketch = np.zeros((depth, width), np.int64)
+    wm_pane = -1
+    n_done = 0
+    CH = 65536
+    for off in range(0, total_events, CH):
+        it = items[off:off + CH].tolist()
+        tss = ts[off:off + CH]
+        seed_i = [int(s) for s in seeds]
+        for i in range(len(it)):
+            x = it[i]
+            for d in range(depth):
+                h = (((x * seed_i[d]) & 0xFFFFFFFFFFFFFFFF)
+                     >> (64 - 10)) % width
+                sketch[d, h] += 1
+            n_done += 1
+        pane = int(tss[-1]) // 4000 - 1
+        if pane > wm_pane:
+            wm_pane = pane
+            sketch[:] = 0          # pane rotation stand-in
+    base_dt = time.perf_counter() - t0
+    baseline_eps = total_events / base_dt
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.set_max_parallelism(32)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(64)
+    env.batch_size = 131_072
+    sink = CountingSink()
+
+    def gen(offset, n):
+        s = slice(offset, offset + n)
+        m = len(items[s])
+        return {"key": np.zeros(m, np.int32), "item": items[s]}, ts[s]
+
+    t0 = time.perf_counter()
+    (
+        env.add_source(GeneratorSource(gen, total=total_events))
+        .key_by(lambda cols: cols["key"])
+        .time_window(8000, 4000)
+        .count_min(lambda cols: cols["item"], depth=depth, width=width,
+                   query=[1, 2, 3])
+        .add_sink(sink)
+    )
+    env.execute("count-min")
+    dt = time.perf_counter() - t0
+    assert sink.count > 0
+    return total_events / dt, baseline_eps
+
+
+# ------------------------------------------------------------- sessions
+def run_sessions(total_events: int, cpu: bool):
+    n_keys = 50_000
+    gap = 500
+    rng = np.random.default_rng(2)
+    keys = rng.integers(0, n_keys, total_events).astype(np.int64)
+    ts = (np.arange(total_events, dtype=np.int64) // 200)
+    vals = np.ones(total_events, np.float32)
+
+    # baseline: scalar session tracking (key -> [start, last, acc]),
+    # close-on-gap at watermark advances (per-key timer analog)
+    t0 = time.perf_counter()
+    live = {}
+    closed = 0
+    CH = 65536
+    kl, tl = keys.tolist(), ts.tolist()
+    last_scan_wm = -1
+    for off in range(0, total_events, CH):
+        hi_i = min(off + CH, total_events)
+        for i in range(off, hi_i):
+            k = kl[i]
+            t = tl[i]
+            s = live.get(k)
+            if s is None:
+                live[k] = [t, t, 1.0]
+            elif t - s[1] > gap:
+                closed += 1
+                live[k] = [t, t, 1.0]
+            else:
+                s[1] = t
+                s[2] += 1.0
+        wm = tl[hi_i - 1]
+        if wm - last_scan_wm >= gap:       # timer sweep
+            last_scan_wm = wm
+            for k in [k for k, s in live.items() if wm - s[1] > gap]:
+                closed += 1
+                live.pop(k)
+    closed += len(live)
+    base_dt = time.perf_counter() - t0
+    baseline_eps = total_events / base_dt
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.datastream.window.assigners import EventTimeSessionWindows
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.set_max_parallelism(32)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1 << 18)   # load ~0.2 at 50k live sessions
+    env.batch_size = 131_072
+    sink = CountingSink()
+
+    def gen(offset, n):
+        s = slice(offset, offset + n)
+        return {"key": keys[s], "value": vals[s]}, ts[s]
+
+    t0 = time.perf_counter()
+    (
+        env.add_source(GeneratorSource(gen, total=total_events))
+        .key_by(lambda c: c["key"])
+        .window(EventTimeSessionWindows.with_gap(gap))
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("sessions-bench")
+    dt = time.perf_counter() - t0
+    assert sink.count > 0
+    return total_events / dt, baseline_eps
+
+
+# ------------------------------------------------------------------ CEP
+def run_cep(total_events: int, cpu: bool):
+    from flink_tpu.cep import CEP, NFA, Pattern
+
+    n_keys = 1000
+    rng = np.random.default_rng(3)
+    names = rng.choice(["a", "b", "x", "y"], total_events,
+                       p=[0.05, 0.05, 0.45, 0.45])
+    keyarr = rng.integers(0, n_keys, total_events)
+
+    class Ev:
+        __slots__ = ("name", "key", "i")
+
+        def __init__(self, name, key, i):
+            self.name = name
+            self.key = key
+            self.i = i
+
+    events = [Ev(str(n), int(k), i)
+              for i, (n, k) in enumerate(zip(names, keyarr))]
+
+    pattern = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+
+    # baseline: the host NFA driven per record per key (the reference's
+    # per-event NFA.process path)
+    nfa = NFA(pattern)
+    t0 = time.perf_counter()
+    partials = {}
+    n_matches = 0
+    for e in events:
+        p = partials.get(e.key, [])
+        p, ms = nfa.process(p, e, 0)
+        partials[e.key] = p
+        n_matches += len(ms)
+    base_dt = time.perf_counter() - t0
+    baseline_eps = total_events / base_dt
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.runtime.sinks import CountingSink
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.batch_size = 16_384
+    sink = CountingSink()
+    stream = env.from_collection(events).key_by(lambda e: e.key)
+    t0 = time.perf_counter()
+    CEP.pattern(stream, pattern).select(lambda m: 1.0).add_sink(sink)
+    job = env.execute("cep-bench")
+    dt = time.perf_counter() - t0
+    assert job.metrics.cep_device_steps > 0, "device CEP path not taken"
+    assert sink.count == n_matches, (sink.count, n_matches)
+    return total_events / dt, baseline_eps
+
+
+CONFIGS = {
+    "socket_wc": (run_socket_wc, 2_000_000),
+    "count_min": (run_count_min, 4_000_000),
+    "sessions": (run_sessions, 4_000_000),
+    "cep": (run_cep, 400_000),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--only", default=None, choices=list(CONFIGS))
+    ap.add_argument("--events", type=int, default=None)
+    ap.add_argument("--init-deadline", type=float, default=300.0)
+    args = ap.parse_args()
+
+    try:
+        probe_backend(args.cpu, deadline_s=args.init_deadline)
+    except RuntimeError as e:
+        print(json.dumps({"config": "all", "error": str(e)}))
+        return
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    for name, (fn, default_events) in CONFIGS.items():
+        if args.only and name != args.only:
+            continue
+        n = args.events or default_events
+        try:
+            subj, base = fn(n, args.cpu)
+            print(json.dumps({
+                "config": name,
+                "events": n,
+                "subject_eps": round(subj),
+                "baseline_eps": round(base),
+                "ratio": round(subj / base, 2),
+            }), flush=True)
+        except Exception as e:  # noqa: BLE001 — a row per config, always
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps({"config": name, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

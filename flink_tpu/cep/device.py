@@ -171,7 +171,9 @@ def advance(
     C = state.table.capacity
     D = spec.dim
 
-    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid)
+    # 8 claim rounds: no spill tier here — see session_windows.py
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid,
+                                       max_rounds=8)
     n_nofit = jnp.sum(valid & ~ok, dtype=jnp.int32)
     live = valid & ok
     seg = jnp.where(live, slot, jnp.int32(C))   # dead lanes -> spill row

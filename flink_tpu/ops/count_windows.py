@@ -66,7 +66,9 @@ def update(
     combine = red.combine_fn()
     neutral = red.neutral_value()
 
-    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid)
+    # 8 claim rounds: no spill tier here — see session_windows.py
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, valid,
+                                       max_rounds=8)
     n_nofit = jnp.sum(valid & ~ok, dtype=jnp.int32)
     live = valid & ok
 

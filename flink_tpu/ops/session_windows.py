@@ -107,7 +107,11 @@ def update_and_fire(
     n_late = jnp.sum(late, dtype=jnp.int32)
     live = valid & ~late
 
-    table, slot, ok = hashtable.upsert(state.table, hi, lo, live)
+    # 8 claim rounds: this stage has NO spill tier, so a cold-start claim
+    # storm that fails to settle is a counted record LOSS (strict
+    # capacity); the extra probe gathers are cheap insurance
+    table, slot, ok = hashtable.upsert(state.table, hi, lo, live,
+                                       max_rounds=8)
     n_nofit = jnp.sum(live & ~ok, dtype=jnp.int32)
     live = live & ok
 

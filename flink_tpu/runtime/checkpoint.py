@@ -41,7 +41,11 @@ from flink_tpu.ops import hashtable
 from flink_tpu.ops import window_kernels as wk
 from flink_tpu.ops.hashing import route_hash
 
-FORMAT_VERSION = 1
+# v2: numeric key identities are raw 64-bit key bits (hashing.
+# key_identity64), not splitmix64 hashes — v1 snapshots' khi/klo would
+# silently mismatch records encoded under the new identity, so the
+# version gate turns that into a clean format error instead
+FORMAT_VERSION = 2
 
 
 @dataclass

@@ -69,7 +69,10 @@ class JobMetrics:
     fires: int = 0
     steps: int = 0
     steps_fast: int = 0   # steps run on the lookup-only fast tier
+    steps_exchanged: int = 0  # steps routed through the ICI all_to_all
     state_layout: str = ""  # "hash" | "direct" once the stage is set up
+    # "mask" | "all_to_all" | "adaptive" once the stage is set up
+    exchange_mode: str = ""
     dropped_late: int = 0
     dropped_capacity: int = 0
     restarts: int = 0
@@ -572,8 +575,14 @@ class LocalExecutor:
 
         win = None
         spec = None
-        update_step = None
-        update_step_fast = None   # lookup-only steady-state variant
+        # compiled update-step variants: steps_by_route[route][tier] with
+        # route in {"mask", "exchange"} (record routing to owning shards)
+        # and tier in {"insert", "fast"} (adaptive step tiering); the host
+        # picks a variant per micro-batch at zero switch cost (shared
+        # state layout)
+        steps_by_route = {}
+        exchange_cap = [0]        # per-(src,dst) bucket lanes of the exchange
+        force_route = [None]      # warmup override
         fire_step = None
         state = None
         # key-state layout, decided ONCE (the compiled steps bake it in):
@@ -603,6 +612,9 @@ class LocalExecutor:
         miss_tolerance = [0]
         bounce_miss = [0]         # miss count that triggered current bounce
         bounce_placed = [False]   # did the bounce place any key?
+        # step lane count: == B, or B rounded up to a multiple of the
+        # shard count when the ICI exchange splits the batch over devices
+        B_step = [None]
         codec = KeyCodec()
         # reverse key map costs a python dict insert per record; benchmarks
         # and columnar sinks that accept 64-bit key ids can turn it off
@@ -614,8 +626,7 @@ class LocalExecutor:
         )
 
         def setup(origin_ms: int, fresh_state: bool = True):
-            nonlocal td, win, spec, update_step, update_step_fast
-            nonlocal fire_step, state
+            nonlocal td, win, spec, fire_step, state
             td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
             ring = env.config.get_int("window.ring-panes", 0) or max(
                 8,
@@ -685,53 +696,86 @@ class LocalExecutor:
                 layout=layout[0],
             )
             metrics.state_layout = layout[0]
-            if update_step is None:
-                # exchange.mode: "mask" (replicate-and-mask, default) or
-                # "all_to_all" (ICI record shuffle; per-device work O(B/n))
-                mode = env.config.get_str("exchange.mode", "mask")
-                if mode == "all_to_all" and ctx.n_shards > 1:
-                    if B % ctx.n_shards:
-                        raise ValueError(
-                            f"exchange.mode=all_to_all needs batch size "
-                            f"divisible by {ctx.n_shards} shards, got {B}"
-                        )
-                    bpd = B // ctx.n_shards
+            if not steps_by_route:
+                # exchange.mode — how records reach their owning shard on
+                # a multi-device mesh (the reference's keyed shuffle,
+                # KeyGroupStreamPartitioner.java:53):
+                #   "auto" (default): PER-BATCH adaptive. The host computes
+                #     exact shard counts for each batch (cheap numpy) and
+                #     dispatches the O(B/n)-per-device all_to_all step only
+                #     when every shard's records provably fit its static
+                #     bucket; skewed batches take the replicate-and-mask
+                #     step instead. Never lossy, scalable whenever the
+                #     batch actually balances.
+                #   "all_to_all": always exchange; bucket overflow is
+                #     counted into dropped_capacity (strict-capacity
+                #     surfaces it).
+                #   "mask": always replicate-and-mask (O(B) per chip).
+                # The batch auto-pads up to a multiple of the shard count.
+                mode = env.config.get_str("exchange.mode", "auto")
+                if mode not in ("auto", "all_to_all", "mask"):
+                    raise ValueError(
+                        f"exchange.mode must be auto|all_to_all|mask, "
+                        f"got {mode!r}"
+                    )
+                want_ex = ctx.n_shards > 1 and mode in ("auto", "all_to_all")
+                B_step[0] = (
+                    ((B + ctx.n_shards - 1) // ctx.n_shards) * ctx.n_shards
+                    if want_ex else B
+                )
+                metrics.exchange_mode = (
+                    "adaptive" if want_ex and mode == "auto"
+                    else "all_to_all" if want_ex else "mask"
+                )
+                build_fast = spillable and win.overflow and \
+                    layout[0] != "direct"
+                if not want_ex or mode == "auto":
+                    steps_by_route["mask"] = {
+                        "insert": build_window_update_step(ctx, spec),
+                        "fast": build_window_update_step(
+                            ctx, spec, insert=False,
+                        ) if build_fast else None,
+                    }
+                if want_ex:
+                    bpd = B_step[0] // ctx.n_shards
                     capf = env.config.get_float("exchange.capacity-factor",
                                                 2.0)
-                    update_step = build_window_update_step_exchange(
+                    ex_insert = build_window_update_step_exchange(
                         ctx, spec, bpd, capf,
                     )
-                    if spillable and win.overflow and layout[0] != "direct":
-                        update_step_fast = build_window_update_step_exchange(
+                    steps_by_route["exchange"] = {
+                        "insert": ex_insert,
+                        "fast": build_window_update_step_exchange(
                             ctx, spec, bpd, capf, insert=False,
-                        )
-                else:
-                    update_step = build_window_update_step(ctx, spec)
-                    if spillable and win.overflow and layout[0] != "direct":
-                        # direct layout has no insert phase — one step
-                        # variant serves both regimes
-                        update_step_fast = build_window_update_step(
-                            ctx, spec, insert=False,
-                        )
+                        ) if build_fast else None,
+                    }
+                    exchange_cap[0] = ex_insert.bucket_cap
                 fire_step = build_window_fire_step(ctx, spec)
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
                 # trigger ALL compiles NOW (inside any benchmark warmup)
                 # so neither the first pane-boundary fire nor the first
-                # insert->fast tier switch is a multi-second compile stall
-                # mid-measurement; firing at the MIN-sentinel watermark is
-                # a no-op on fresh state
-                steps0, fast0 = metrics.steps, metrics.steps_fast
-                self._empty_step(run_update, B, red, None)
-                if update_step_fast is not None:
-                    step_mode[0] = "fast"
-                    self._empty_step(run_update, B, red, None)
-                    step_mode[0] = "insert"
-                    tier_quiet[0] = 0
-                    mon_watch.clear()
+                # insert->fast tier switch nor the first adaptive route
+                # flip is a multi-second compile stall mid-measurement;
+                # firing at the MIN-sentinel watermark is a no-op on
+                # fresh state
+                steps0, fast0, ex0 = (metrics.steps, metrics.steps_fast,
+                                      metrics.steps_exchanged)
+                for route in steps_by_route:
+                    for tier in ("insert", "fast"):
+                        if steps_by_route[route][tier] is None:
+                            continue
+                        step_mode[0] = tier
+                        force_route[0] = route
+                        self._empty_step(run_update, B_step[0], red, None)
+                step_mode[0] = "insert"
+                force_route[0] = None
+                tier_quiet[0] = 0
+                mon_watch.clear()
                 # warmup dispatches must not pollute the step counters the
                 # operator (and the tiering test) reads
                 metrics.steps, metrics.steps_fast = steps0, fast0
+                metrics.steps_exchanged = ex0
                 cf = run_fire(None)
                 jax.block_until_ready(cf.counts)
 
@@ -1080,6 +1124,43 @@ class LocalExecutor:
         phase_acc = {"dispatch": 0.0, "emit": 0.0}
         last_ingest_t = [None]
 
+        # precomputed for the per-batch adaptive route choice
+        _kg_ends = np.asarray(ctx.kg_bounds()[1])
+
+        def _pick_route(hi, lo, valid):
+            """Exact per-batch feasibility of the ICI exchange: the host
+            computes every lane's owning shard (the same murmur key-group
+            math the device uses) and takes the all_to_all step only when
+            every shard's records fit its static bucket — skew falls back
+            to replicate-and-mask, so the adaptive default is NEVER lossy.
+            ~2-4ms of numpy per 262k batch vs an O(B) vs O(B/n) device
+            step."""
+            if force_route[0] is not None:
+                return force_route[0]
+            if "exchange" not in steps_by_route:
+                return "mask"
+            if "mask" not in steps_by_route:
+                return "exchange"       # exchange.mode=all_to_all forced
+            from flink_tpu.core.keygroups import assign_to_key_group
+            from flink_tpu.ops.hashing import route_hash
+
+            n = ctx.n_shards
+            kg = assign_to_key_group(
+                route_hash(hi, lo, np), ctx.max_parallelism, np,
+            )
+            shard = np.searchsorted(_kg_ends, kg)
+            # the exchange's bound is PER (source device, dest shard)
+            # bucket: lanes are split over devices in contiguous chunks,
+            # and each src's records for each dst must fit its bucket
+            bpd = len(hi) // n
+            src = np.arange(len(hi)) // bpd
+            pair = np.where(valid, src * n + shard, n * n)
+            counts = np.bincount(pair, minlength=n * n + 1)[:n * n]
+            return (
+                "exchange" if counts.max(initial=0) <= exchange_cap[0]
+                else "mask"
+            )
+
         def run_update(hi, lo, ticks, values, valid, wm_ms):
             """Dispatch one update-only device step. No host sync: the
             result is not read, so transfers and compute of successive
@@ -1100,11 +1181,14 @@ class LocalExecutor:
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
             t_d0 = time.perf_counter()
-            active = (
-                update_step_fast
-                if step_mode[0] == "fast" and update_step_fast is not None
-                else update_step
+            route = _pick_route(hi, lo, valid)
+            tiers = steps_by_route[route]
+            tier = (
+                "fast"
+                if step_mode[0] == "fast" and tiers["fast"] is not None
+                else "insert"
             )
+            active = tiers[tier]
             state, (ovf_handle, act_handle) = active(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
                 jnp.asarray(values), jnp.asarray(valid), wmv,
@@ -1113,8 +1197,10 @@ class LocalExecutor:
             # device pipeline is saturated -> the device-bound signal
             phase_acc["dispatch"] += time.perf_counter() - t_d0
             metrics.steps += 1
-            if active is update_step_fast:
+            if tier == "fast":
                 metrics.steps_fast += 1
+            if route == "exchange":
+                metrics.steps_exchanged += 1
             if win.overflow:
                 # SAMPLED lagged monitoring: a cold device->host fetch on
                 # this runtime costs ~70ms of fixed round-trip latency
@@ -1182,7 +1268,10 @@ class LocalExecutor:
             # can never help); their miss level becomes the fast-mode
             # tolerance so an over-capacity residue settles in fast mode
             # instead of oscillating.
-            if update_step_fast is not None:
+            has_fast = any(
+                t["fast"] is not None for t in steps_by_route.values()
+            )
+            if has_fast:
                 if step_mode[0] == "insert":
                     if act == 0:
                         tier_quiet[0] += 1
@@ -1659,17 +1748,20 @@ class LocalExecutor:
                             td.to_ms(int(g_ticks.max())) - ooo_ms - 1, wm_ms
                         )
                     # a host chain (flat_map) can expand one poll beyond B
-                    # lanes; feed the step in B-sized chunks. The watermark
-                    # rides only the LAST chunk so every record of the poll
-                    # is late-checked against the pre-poll watermark.
+                    # lanes; feed the step in B-sized chunks padded to the
+                    # step lane count (B_step > B only when the exchange
+                    # splits lanes over shards). The watermark rides only
+                    # the LAST chunk so every record of the poll is
+                    # late-checked against the pre-poll watermark.
+                    Bs = B_step[0]
                     for off in range(0, m, B):
                         hi_off = min(off + B, m)
                         run_update(
-                            _pad(g_hi[off:hi_off], B, np.uint32),
-                            _pad(g_lo[off:hi_off], B, np.uint32),
-                            _pad(g_ticks[off:hi_off], B, np.int32),
-                            _pad(g_vals[off:hi_off], B, g_vals.dtype),
-                            _pad(np.ones(hi_off - off, bool), B, bool),
+                            _pad(g_hi[off:hi_off], Bs, np.uint32),
+                            _pad(g_lo[off:hi_off], Bs, np.uint32),
+                            _pad(g_ticks[off:hi_off], Bs, np.int32),
+                            _pad(g_vals[off:hi_off], Bs, g_vals.dtype),
+                            _pad(np.ones(hi_off - off, bool), Bs, bool),
                             g_wm if hi_off == m else None,
                         )
                     # catch-up slices must fire between groups or newer

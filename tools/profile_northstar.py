@@ -77,7 +77,7 @@ def main():
             name = k.split("phase_")[1].replace("_ms", "")
             phases[name] = {
                 "p50": round(v.get("p50", 0), 1),
-                "p90": round(v.get("p90", v.get("p95", 0)) or 0, 1),
+                "p95": round(v.get("p95", 0) or 0, 1),
                 "max": round(v.get("max", 0), 1),
                 "mean": round(v.get("mean", 0), 1),
             }
