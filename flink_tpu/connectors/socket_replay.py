@@ -1,0 +1,284 @@
+"""Wire-protocol replayable source: a real external broker over TCP.
+
+The proof-of-exactly-once seam VERDICT r2 item 6 asks for: unlike
+InMemoryPartitionedSource (a test double inside the job process), the
+ReplayServer is a SEPARATE OS process holding partitioned, offset-
+addressable records — the Kafka-broker role. The consumer speaks a small
+line protocol and plugs into PartitionedConsumerBase, inheriting the
+snapshot-offsets / commit-on-checkpoint-complete contract
+(ref FlinkKafkaConsumerBase.java:336 snapshotState, :384
+notifyCheckpointComplete).
+
+Protocol (text lines over one TCP connection):
+    LIST                          -> "<p0> <p1> ...\\n"
+    FETCH <part> <offset> <n>     -> "<count> <new_offset> <exhausted>\\n"
+                                     then <count> lines "<key> <value> <ts>"
+    COMMIT <cid> <part>:<off>[,...] -> "OK\\n"  (persisted to commit file)
+    COMMITTED                     -> "<cid> <part>:<off>[,...]\\n"
+
+Determinism: records are derived from a seed, so FETCH(part, offset) is
+reproducible across server restarts — the replay property exactly-once
+restore depends on.
+
+Run standalone:  python -m flink_tpu.connectors.socket_replay \
+                     --port 0 --partitions 3 --records 10000 --seed 7 \
+                     --commit-file /tmp/commits.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import socketserver
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.connectors.partitioned import PartitionedConsumerBase
+
+
+def gen_partition_records(seed: int, part: int, offset: int, n: int,
+                          total: int):
+    """Deterministic records of one partition: (key, value, ts_ms)."""
+    n = max(0, min(n, total - offset))
+    if n == 0:
+        return []
+    idx = np.arange(offset, offset + n, dtype=np.int64)
+    rng_mix = (
+        idx.astype(np.uint64) * np.uint64(6364136223846793005)
+        + np.uint64((seed * 1442695040888963407 + part) % (1 << 64))
+    )
+    keys = (rng_mix % np.uint64(97)).astype(np.int64)
+    vals = ((idx % 5) + 1).astype(np.int64)
+    ts = idx * 2 + part
+    return list(zip(keys.tolist(), vals.tolist(), ts.tolist()))
+
+
+class ReplayServer:
+    """External broker process body (also embeddable for tests)."""
+
+    def __init__(self, partitions: int, records: int, seed: int,
+                 commit_file: Optional[str] = None, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.n_partitions = partitions
+        self.total = records
+        self.seed = seed
+        self.commit_file = commit_file
+        self._commit_lock = threading.Lock()
+        self._last_commit: Tuple[int, Dict[int, int]] = (0, {})
+        # a restarted broker resumes from its durable commit record — the
+        # property consumers rely on to resume from the external commit
+        if commit_file and os.path.exists(commit_file):
+            with open(commit_file) as f:
+                rec = json.load(f)
+            self._last_commit = (
+                rec["cid"], {int(p): o for p, o in rec["offsets"].items()}
+            )
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        out = outer._dispatch(line.decode().strip())
+                    except Exception as e:  # noqa: BLE001 — protocol error
+                        out = f"ERR {type(e).__name__}: {e}\n"
+                    self.wfile.write(out.encode())
+                    self.wfile.flush()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="replay-server",
+        )
+
+    def start(self):
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- protocol --------------------------------------------------------
+    def _dispatch(self, line: str) -> str:
+        parts = line.split()
+        if not parts:
+            return "ERR empty\n"
+        cmd = parts[0].upper()
+        if cmd == "LIST":
+            return " ".join(str(p) for p in range(self.n_partitions)) + "\n"
+        if cmd == "FETCH":
+            part, offset, n = int(parts[1]), int(parts[2]), int(parts[3])
+            recs = gen_partition_records(self.seed, part, offset, n,
+                                         self.total)
+            new_off = offset + len(recs)
+            exhausted = int(new_off >= self.total)
+            body = "".join(f"{k} {v} {t}\n" for k, v, t in recs)
+            return f"{len(recs)} {new_off} {exhausted}\n" + body
+        if cmd == "COMMIT":
+            cid = int(parts[1])
+            offs = {}
+            for item in parts[2].split(","):
+                p, o = item.split(":")
+                offs[int(p)] = int(o)
+            # serialized write+replace: handler threads sharing one tmp
+            # path would interleave and corrupt the durable record
+            with self._commit_lock:
+                if self.commit_file:
+                    tmp = self.commit_file + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump({"cid": cid, "offsets": offs}, f)
+                    os.replace(tmp, self.commit_file)
+                self._last_commit = (cid, offs)
+            return "OK\n"
+        if cmd == "COMMITTED":
+            cid, offs = self._last_commit
+            body = ",".join(f"{p}:{o}" for p, o in sorted(offs.items()))
+            return f"{cid} {body}\n"
+        return "ERR unknown command\n"
+
+
+class SocketReplayConsumer(PartitionedConsumerBase):
+    """Wire client for ReplayServer, with reconnect-on-failure (a broker
+    restart mid-job must not fail the source — fetches are deterministic,
+    so a reconnected FETCH resumes exactly)."""
+
+    def __init__(self, host: str, port: int, connect_timeout_s: float = 10.0,
+                 retry_s: float = 20.0):
+        super().__init__()
+        self.host, self.port = host, port
+        self.connect_timeout_s = connect_timeout_s
+        self.retry_s = retry_s
+        self._sock: Optional[socket.socket] = None
+        self._rf = None
+
+    # -- wire ------------------------------------------------------------
+    def _connect(self):
+        self._close()
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        self._rf = self._sock.makefile("rb")
+
+    def _close(self):
+        for x in (self._rf, self._sock):
+            try:
+                if x is not None:
+                    x.close()
+            except OSError:
+                pass
+        self._sock = self._rf = None
+
+    def _request(self, line: str) -> str:
+        """Send one command, return the header line; retries with
+        reconnect until retry_s elapses (broker restart tolerance)."""
+        deadline = time.monotonic() + self.retry_s
+        last: Exception = RuntimeError("no attempt")
+        while time.monotonic() < deadline:
+            try:
+                if self._sock is None:
+                    self._connect()
+                self._sock.sendall(line.encode())
+                hdr = self._rf.readline()
+                if not hdr:
+                    raise ConnectionError("server closed connection")
+                hdr = hdr.decode().strip()
+                if hdr.startswith("ERR"):
+                    raise RuntimeError(f"server error: {hdr}")
+                return hdr
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._close()
+                time.sleep(0.2)
+        raise ConnectionError(
+            f"replay server unreachable after {self.retry_s}s: {last}"
+        )
+
+    def _read_lines(self, n: int) -> List[str]:
+        out = []
+        for _ in range(n):
+            ln = self._rf.readline()
+            if not ln:
+                raise ConnectionError("short read")
+            out.append(ln.decode().strip())
+        return out
+
+    # -- PartitionedConsumerBase contract --------------------------------
+    def discover_partitions(self):
+        hdr = self._request("LIST\n")
+        return [int(p) for p in hdr.split()]
+
+    def fetch(self, partition, offset: int, max_records: int
+              ) -> Tuple[List[Tuple[int, int, int]], int, bool]:
+        last: Exception = ConnectionError("no attempt")
+        for _ in range(2):
+            # _request already reconnect-loops for retry_s; only the BODY
+            # read below gets the local one-retry (a connection dying
+            # mid-body re-issues the deterministic fetch once)
+            hdr = self._request(f"FETCH {partition} {offset} {max_records}\n")
+            count, new_off, exhausted = (int(x) for x in hdr.split())
+            try:
+                recs = []
+                for ln in self._read_lines(count):
+                    k, v, t = ln.split()
+                    recs.append((int(k), int(v), int(t)))
+                return recs, new_off, bool(exhausted)
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._close()     # body read failed mid-stream: one retry
+        raise ConnectionError("fetch body failed after reconnect") from last
+
+    def commit_offsets(self, offsets: Dict[int, int], checkpoint_id: int):
+        body = ",".join(f"{p}:{o}" for p, o in sorted(offsets.items()))
+        self._request(f"COMMIT {checkpoint_id} {body}\n")
+        self.committed = dict(offsets)
+
+    def committed_on_server(self) -> Tuple[int, Dict[int, int]]:
+        hdr = self._request("COMMITTED\n")
+        cid, _, body = hdr.partition(" ")
+        offs = {}
+        for item in body.split(","):
+            if item:
+                p, o = item.split(":")
+                offs[int(p)] = int(o)
+        return int(cid), offs
+
+    def close(self):
+        self._close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--partitions", type=int, default=3)
+    ap.add_argument("--records", type=int, default=10_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--commit-file", default=None)
+    args = ap.parse_args()
+    srv = ReplayServer(args.partitions, args.records, args.seed,
+                       args.commit_file, port=args.port)
+    port = srv.start()
+    print(f"READY {port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

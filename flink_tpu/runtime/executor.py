@@ -1811,6 +1811,15 @@ class LocalExecutor:
             while True:
                 try:
                     batch_loop()
+                    # end of stream: MAX watermark flush (ref Watermark.
+                    # MAX_WATERMARK). INSIDE the restart protection: a
+                    # sink failing during the final flush must recover
+                    # like any mid-stream failure — restore rewinds state,
+                    # source offsets, and sink state to the checkpoint
+                    # cut, so the re-run re-emits without duplication.
+                    if td is not None:
+                        drain_fires(int(td.to_ms(2**31 - 4)),
+                                    time.perf_counter())
                     break
                 except JobCancelledException:
                     raise
@@ -1824,10 +1833,6 @@ class LocalExecutor:
                         raise
                     metrics.restarts += 1
                     restore_checkpoint(storage)
-
-            # end of stream: MAX watermark flush (ref Watermark.MAX_WATERMARK)
-            if td is not None:
-                drain_fires(int(td.to_ms(2**31 - 4)), time.perf_counter())
         finally:
             job_live.clear()
             drain_kv_mailbox()
