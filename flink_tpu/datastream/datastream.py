@@ -507,9 +507,17 @@ class WindowedStream:
         )
 
     def count(self) -> DataStream:
+        def ones(e):
+            # columnar batches need a per-lane column; scalar per element
+            if isinstance(e, dict):
+                import numpy as _np
+
+                n = len(next(iter(e.values())))
+                return _np.ones(n, _np.float32)
+            return 1.0
+
         return self._agg(
-            "window_count", lambda: ReduceSpec("count", jnp.float32),
-            lambda e: 1.0,
+            "window_count", lambda: ReduceSpec("count", jnp.float32), ones,
         )
 
     def mean(self, pos=None) -> DataStream:

@@ -1,5 +1,7 @@
 """Table API + SQL subset (ref flink-table, SURVEY §2.7)."""
 
+from flink_tpu.table.streaming import StreamTableEnvironment
 from flink_tpu.table.table import Expr, Table, TableEnvironment, col, lit
 
-__all__ = ["Table", "TableEnvironment", "Expr", "col", "lit"]
+__all__ = ["Table", "TableEnvironment", "StreamTableEnvironment", "Expr",
+           "col", "lit"]

@@ -10,10 +10,15 @@ Janino functions, this design lowers to XLA).
 
 Expression DSL:    col("a") + 1, (col("a") > 5) & (col("b") == "x"),
                    col("a").sum.alias("total")
-SQL subset:        SELECT ... FROM t [WHERE ...] [GROUP BY ...]
-                   [ORDER BY ... [DESC]] [LIMIT n]
+SQL subset:        SELECT ... FROM t [JOIN u ON t.k = u.k] [WHERE ...]
+                   [GROUP BY ...] [ORDER BY ... [DESC]] [LIMIT n]
+                   (JOIN: equi-joins, INNER/LEFT/RIGHT/FULL, lowered to the
+                   columnar hash join; select columns post-join by their
+                   bare names, right-side clashes as r_<name>)
 The SQL front-end parses via Python's ast over translated operators —
 deliberately small, covering the SELECT shape the reference's examples use.
+Streaming GROUP BY over event-time windows lives in
+table/streaming.py (StreamTableEnvironment: TUMBLE/HOP/SESSION).
 """
 
 from __future__ import annotations
@@ -189,10 +194,16 @@ class Table:
     def _aggregate(self, keys: Optional[List[str]], exprs) -> "Table":
         if keys:
             key_arrays = [self.cols[k] for k in keys]
-            packed = np.empty(self.n, dtype=object)
             rows = list(zip(*[a.tolist() for a in key_arrays]))
-            packed[:] = rows
-            uniq, gid = np.unique(packed, return_inverse=True)
+            # dict-based grouping (insertion order): np.unique cannot sort
+            # object rows containing None (outer-join gaps) — SQL groups
+            # NULL keys as their own group
+            first: Dict[tuple, int] = {}
+            gid = np.empty(self.n, np.int64)
+            for i, r in enumerate(rows):
+                g = first.setdefault(r, len(first))
+                gid[i] = g
+            uniq = list(first)
             G = len(uniq)
             out: Dict[str, np.ndarray] = {}
             for i, k in enumerate(keys):
@@ -336,6 +347,9 @@ class TableEnvironment:
     # -- SQL subset ------------------------------------------------------
     _SQL = re.compile(
         r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<from>\w+)"
+        r"(?:\s+(?P<jhow>INNER|LEFT(?:\s+OUTER)?|RIGHT(?:\s+OUTER)?"
+        r"|FULL(?:\s+OUTER)?)?\s*JOIN\s+(?P<jtable>\w+)\s+ON\s+"
+        r"(?P<jleft>\w+(?:\.\w+)?)\s*=\s*(?P<jright>\w+(?:\.\w+)?))?"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
         r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
@@ -348,6 +362,19 @@ class TableEnvironment:
         if not m:
             raise ValueError(f"unsupported SQL shape: {query!r}")
         t = self.scan(m.group("from"))
+        if m.group("jtable"):
+            # equi-JOIN lowered to the columnar hash join (Table.join);
+            # `a.k` qualifiers resolve to the bare column names (clashing
+            # right columns surface under the r_ prefix, see join())
+            how = (m.group("jhow") or "inner").split()[0].lower()
+            right = self.scan(m.group("jtable"))
+            lk = m.group("jleft").split(".")[-1]
+            rk = m.group("jright").split(".")[-1]
+            # the grammar captures "left = right" in either order; the
+            # left key must name a column of the FROM table
+            if lk not in t.schema and rk in t.schema:
+                lk, rk = rk, lk
+            t = t.join(right, lk, rk, how=how)
         if m.group("where"):
             t = t.where(_parse_expr(m.group("where")))
         select_items = _split_commas(m.group("select"))

@@ -1,0 +1,218 @@
+"""SQL JOIN lowering + streaming windowed GROUP BY (VERDICT r2 item 8).
+
+Ref: flink-table StreamTableEnvironment.scala (streaming Table/SQL) and
+the batch SQL JOIN planning the reference does via Calcite — here lowered
+directly to the columnar hash join and the device window kernels.
+"""
+
+import numpy as np
+
+from flink_tpu.table import StreamTableEnvironment, TableEnvironment
+
+
+def _tenv():
+    te = TableEnvironment.create()
+    te.register_table("orders", te.from_columns({
+        "oid": [1, 2, 3, 4],
+        "cust": [10, 20, 10, 30],
+        "amount": [5.0, 7.0, 11.0, 13.0],
+    }))
+    te.register_table("customers", te.from_columns({
+        "cust": [10, 20, 40],
+        "region": ["eu", "us", "ap"],
+    }))
+    return te
+
+
+def test_sql_inner_join():
+    t = _tenv().sql_query(
+        "SELECT oid, region, amount FROM orders "
+        "JOIN customers ON orders.cust = customers.cust "
+        "ORDER BY oid"
+    )
+    assert t.to_rows() == [
+        (1, "eu", 5.0), (2, "us", 7.0), (3, "eu", 11.0),
+    ]
+
+
+def test_sql_left_join_with_group_by():
+    t = _tenv().sql_query(
+        "SELECT region, SUM(amount) AS total FROM orders "
+        "LEFT JOIN customers ON orders.cust = customers.cust "
+        "GROUP BY region ORDER BY region"
+    )
+    rows = t.to_rows()
+    assert (None, 13.0) in rows          # cust 30 has no region
+    assert ("eu", 16.0) in rows and ("us", 7.0) in rows
+
+
+def test_sql_full_join():
+    t = _tenv().sql_query(
+        "SELECT cust, region FROM orders "
+        "FULL JOIN customers ON orders.cust = customers.cust"
+    )
+    custs = set(t.cols["cust"].tolist())
+    assert custs == {10, 20, 30, 40}     # both unmatched sides present
+
+
+# ---------------------------------------------------------------- streaming
+
+def _stream_env(total=2000, n_keys=4):
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    def build():
+        env = StreamExecutionEnvironment(Configuration())
+        env.set_parallelism(1)
+        env.set_max_parallelism(8)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(256)
+        env.batch_size = 128
+
+        def gen(offset, n):
+            idx = np.arange(offset, offset + n, dtype=np.int64)
+            return ({
+                "k": idx % n_keys,
+                "v": (idx % 7).astype(np.float32),
+                "rowtime": idx * 2,        # 2ms per record, as a COLUMN
+            }, None)
+
+        return env, env.add_source(GeneratorSource(gen, total=total))
+
+    te = StreamTableEnvironment.create()
+    te.register_stream("events", build)
+    return te
+
+
+def test_streaming_tumble_sum():
+    te = _stream_env(total=2000, n_keys=4)
+    t = te.sql_query(
+        "SELECT k, SUM(v) AS total FROM events "
+        "GROUP BY k, TUMBLE(rowtime, INTERVAL '1' SECOND)"
+    )
+    # exact per-(key, window) sums
+    exp = {}
+    for i in range(2000):
+        w = ((i * 2) // 1000 + 1) * 1000
+        exp[(i % 4, w)] = exp.get((i % 4, w), 0.0) + float(i % 7)
+    got = {}
+    for k, wend, v in zip(t.cols["k"].tolist(),
+                          t.cols["window_end_ms"].tolist(),
+                          t.cols["total"].tolist()):
+        got[(k, wend)] = got.get((k, wend), 0.0) + v
+    assert got == exp
+
+
+def test_streaming_hop_count():
+    te = _stream_env(total=1000, n_keys=2)
+    t = te.sql_query(
+        "SELECT k, COUNT(v) AS n FROM events "
+        "GROUP BY k, HOP(rowtime, INTERVAL '1' SECOND, "
+        "INTERVAL '2' SECOND)"
+    )
+    # sliding 2s/1s windows: interior windows hold 2s of each key's
+    # records = 500 per key
+    interior = [
+        n for k, wend, n in zip(t.cols["k"].tolist(),
+                                t.cols["window_end_ms"].tolist(),
+                                t.cols["n"].tolist())
+        if 2000 <= wend <= 2000  # exactly covers [0, 2000)
+    ]
+    assert interior and all(n == 500 for n in interior)
+    assert int(np.sum(t.cols["n"][t.cols["window_end_ms"] <= 2000])) > 0
+
+
+def test_streaming_session_with_where():
+    te = _stream_env(total=600, n_keys=3)
+    t = te.sql_query(
+        "SELECT k, SUM(v) AS total FROM events WHERE v > 0 "
+        "GROUP BY k, SESSION(rowtime, INTERVAL '5' SECOND)"
+    )
+    # 2ms cadence << 5s gap: one session per key spanning everything
+    assert len(t.cols["k"]) == 3
+    assert set(t.cols["k"].tolist()) == {0, 1, 2}
+    exp_total = sum(float(i % 7) for i in range(600) if i % 7 > 0)
+    assert float(np.sum(t.cols["total"])) == exp_total
+    assert "window_start_ms" in t.cols
+
+
+def test_streaming_requires_window():
+    te = _stream_env()
+    try:
+        te.sql_query("SELECT k, SUM(v) FROM events GROUP BY k")
+    except ValueError as e:
+        assert "TUMBLE" in str(e)
+    else:
+        raise AssertionError("window-less streaming GROUP BY must refuse")
+
+
+def test_streaming_where_keeps_window_alignment():
+    """Regression: WHERE used to shrink the columns while source-side
+    timestamps kept pre-filter length, pairing surviving records with
+    the wrong rows' times. Rowtime now derives from the named column
+    post-filter, so per-window sums stay exact."""
+    te = _stream_env(total=2000, n_keys=4)
+    t = te.sql_query(
+        "SELECT k, SUM(v) AS total FROM events WHERE k > 0 "
+        "GROUP BY k, TUMBLE(rowtime, INTERVAL '1' SECOND)"
+    )
+    exp = {}
+    for i in range(2000):
+        if i % 4 > 0:
+            w = ((i * 2) // 1000 + 1) * 1000
+            exp[(i % 4, w)] = exp.get((i % 4, w), 0.0) + float(i % 7)
+    got = {}
+    for k, wend, v in zip(t.cols["k"].tolist(),
+                          t.cols["window_end_ms"].tolist(),
+                          t.cols["total"].tolist()):
+        got[(k, wend)] = got.get((k, wend), 0.0) + v
+    assert got == exp
+
+
+def test_streaming_composite_group_key():
+    """Multiple GROUP BY keys pack into tuple keys (object identities)."""
+    te = _stream_env(total=800, n_keys=2)
+
+    # add a second key column derived in the registered stream
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    def build():
+        env = StreamExecutionEnvironment(Configuration())
+        env.set_parallelism(1)
+        env.set_max_parallelism(8)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(256)
+        env.batch_size = 128
+
+        def gen(offset, n):
+            idx = np.arange(offset, offset + n, dtype=np.int64)
+            return ({
+                "a": idx % 2,
+                "b": idx % 3,
+                "v": np.ones(n, np.float32),
+                "rowtime": idx * 2,
+            }, None)
+
+        return env, env.add_source(GeneratorSource(gen, total=800))
+
+    te = StreamTableEnvironment.create()
+    te.register_stream("ev2", build)
+    t = te.sql_query(
+        "SELECT a, b, SUM(v) AS n FROM ev2 "
+        "GROUP BY a, b, TUMBLE(rowtime, INTERVAL '2' SECOND)"
+    )
+    exp = {}
+    for i in range(800):
+        w = ((i * 2) // 2000 + 1) * 2000
+        exp[(i % 2, i % 3, w)] = exp.get((i % 2, i % 3, w), 0.0) + 1.0
+    got = {}
+    for a, b, wend, n in zip(t.cols["a"].tolist(), t.cols["b"].tolist(),
+                             t.cols["window_end_ms"].tolist(),
+                             t.cols["n"].tolist()):
+        got[(a, b, wend)] = got.get((a, b, wend), 0.0) + n
+    assert got == exp
