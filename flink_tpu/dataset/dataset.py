@@ -42,18 +42,74 @@ def _extract(pos):
     return lambda e: e[pos]
 
 
+#: per-operator output-size selectivity relative to the (max) input — the
+#: optimizer's size-estimation heuristics (ref Optimizer.java cost model /
+#: CompilerHints; filters halve, flat_maps can expand, joins ~max side)
+_SELECTIVITY = {
+    "filter": 0.5,
+    "flat_map": 1.5,
+    "distinct": 0.7,
+    "reduce": 0.0,
+    "group_reduce": 0.3,
+    "inner_join": 1.0,
+    "left_join": 1.0,
+    "right_join": 1.0,
+    "full_join": 1.2,
+    "cogroup_join": 0.5,
+    "grouped_reduce": 0.3,
+}
+
+
 class DataSet:
-    def __init__(self, env, compute: Callable[[], List[Any]], name="op"):
+    def __init__(self, env, compute: Callable[[], List[Any]], name="op",
+                 parents: tuple = ()):
         self.env = env
         self._compute = compute
         self._cache: Optional[List[Any]] = None
         self.name = name
+        self.parents = parents
+        #: strategy notes recorded by cost-based choices (explain())
+        self.strategy: Optional[str] = None
 
     # -- evaluation ------------------------------------------------------
     def _data(self) -> List[Any]:
         if self._cache is None:
             self._cache = list(self._compute())
         return self._cache
+
+    # -- cost model (ref flink-optimizer Optimizer.java:396) -------------
+    def estimate_size(self) -> float:
+        """Estimated row count WITHOUT executing: materialized caches are
+        exact, sources use their declared size hint (from_collection sets
+        it; file sources stay unknown until read — never forced here),
+        union sums its inputs, cross multiplies, everything else applies
+        per-operator selectivities to parent estimates."""
+        if self._cache is not None:
+            return float(len(self._cache))
+        if not self.parents:
+            hint = getattr(self, "size_hint", None)
+            return float(hint) if hint is not None else 1000.0
+        sizes = [p.estimate_size() for p in self.parents]
+        if self.name == "union":
+            return float(sum(sizes))
+        if self.name == "cross":
+            out = 1.0
+            for v in sizes:
+                out *= v
+            return out
+        return max(sizes) * _SELECTIVITY.get(self.name, 1.0)
+
+    def explain(self, _depth: int = 0) -> str:
+        """Operator tree with size estimates and chosen physical
+        strategies (the reference's plan JSON / explain analog)."""
+        pad = "  " * _depth
+        line = f"{pad}{self.name} (est. {self.estimate_size():.0f} rows"
+        if self.strategy:
+            line += f", {self.strategy}"
+        line += ")"
+        return "\n".join(
+            [line] + [p.explain(_depth + 1) for p in self.parents]
+        )
 
     def collect(self) -> List[Any]:
         return list(self._data())
@@ -75,8 +131,8 @@ class DataSet:
             fn(e)
 
     # -- element-wise ----------------------------------------------------
-    def _derive(self, fn, name) -> "DataSet":
-        return DataSet(self.env, fn, name)
+    def _derive(self, fn, name, *extra_parents) -> "DataSet":
+        return DataSet(self.env, fn, name, parents=(self, *extra_parents))
 
     def map(self, fn) -> "DataSet":
         return self._derive(lambda: [fn(e) for e in self._data()], "map")
@@ -143,7 +199,7 @@ class DataSet:
                 out.extend(o._data())
             return out
 
-        return self._derive(run, "union")
+        return self._derive(run, "union", *others)
 
     def distinct(self, pos=None) -> "DataSet":
         ex = _extract(pos)
@@ -207,7 +263,7 @@ class DataSet:
                 (a, b) for a in self._data() for b in other._data()
             ]
 
-        return self._derive(run, "cross")
+        return self._derive(run, "cross", other)
 
     # -- iterations --------------------------------------------------------
     def iterate(self, max_iterations: int,
@@ -367,11 +423,16 @@ class GroupedDataSet:
 
 class JoinBuilder:
     """a.join(b).where(k1).equal_to(k2).apply(fn) — hash-join execution
-    (build right, probe left; ref JoinDriver/MutableHashTable strategy)."""
+    with COST-BASED build-side selection (ref Optimizer.java:396 picking
+    HASH_BUILD_FIRST vs HASH_BUILD_SECOND from size estimates, and the
+    JoinHint the user may force): the hash table is built over the side
+    estimated smaller, probed from the larger. Outer joins keep their
+    side semantics regardless of the physical build side."""
 
     def __init__(self, left: DataSet, right: DataSet, kind: str):
         self.left, self.right, self.kind = left, right, kind
         self.k1 = self.k2 = None
+        self.hint = "auto"   # auto | build-left | build-right
 
     def where(self, pos=None) -> "JoinBuilder":
         self.k1 = _extract(pos)
@@ -381,18 +442,27 @@ class JoinBuilder:
         self.k2 = _extract(pos)
         return self
 
+    def with_hint(self, hint: str) -> "JoinBuilder":
+        """ref JoinOperatorBase.JoinHint (BROADCAST_HASH_FIRST/SECOND):
+        force the build side instead of the cost model's choice."""
+        if hint not in ("auto", "build-left", "build-right"):
+            raise ValueError(f"unknown join hint {hint!r}")
+        self.hint = hint
+        return self
+
     def apply(self, fn: Optional[Callable] = None) -> DataSet:
         if self.k1 is None or self.k2 is None:
             raise ValueError("join requires where(...).equal_to(...)")
         k1, k2, kind = self.k1, self.k2, self.kind
+        node_holder = []
 
         def run():
             lefts, rights = self.left._data(), self.right._data()
-            build: Dict[Any, List[Any]] = {}
-            for r in rights:
-                build.setdefault(k2(r), []).append(r)
             out = []
             if kind == "cogroup":
+                build: Dict[Any, List[Any]] = {}
+                for r in rights:
+                    build.setdefault(k2(r), []).append(r)
                 probe: Dict[Any, List[Any]] = {}
                 for l in lefts:
                     probe.setdefault(k1(l), []).append(l)
@@ -400,23 +470,60 @@ class JoinBuilder:
                 for k in {**build, **probe}:
                     out.extend(f(probe.get(k, []), build.get(k, [])))
                 return out
+            # cost model: build over the smaller side (estimates are free
+            # here — both inputs are materialized just above, making the
+            # estimate exact), unless a hint forces it
+            if self.hint == "build-left":
+                build_left = True
+            elif self.hint == "build-right":
+                build_left = False
+            else:
+                build_left = len(lefts) < len(rights)
+            if node_holder:
+                node_holder[0].strategy = (
+                    f"hash build-{'left' if build_left else 'right'}"
+                    + ("" if self.hint == "auto" else " (hinted)")
+                )
             f = fn or (lambda l, r: (l, r))
-            matched_right = set()
-            for l in lefts:
-                key = k1(l)
-                rs = build.get(key)
-                if rs:
-                    matched_right.add(key)
-                    out.extend(f(l, r) for r in rs)
-                elif kind in ("left", "full"):
-                    out.append(f(l, None))
-            if kind in ("right", "full"):
-                for key, rs in build.items():
-                    if key not in matched_right:
-                        out.extend(f(None, r) for r in rs)
+            if build_left:
+                build = {}
+                for l in lefts:
+                    build.setdefault(k1(l), []).append(l)
+                matched = set()
+                for r in rights:
+                    key = k2(r)
+                    ls = build.get(key)
+                    if ls:
+                        matched.add(key)
+                        out.extend(f(l, r) for l in ls)
+                    elif kind in ("right", "full"):
+                        out.append(f(None, r))
+                if kind in ("left", "full"):
+                    for key, ls in build.items():
+                        if key not in matched:
+                            out.extend(f(l, None) for l in ls)
+            else:
+                build = {}
+                for r in rights:
+                    build.setdefault(k2(r), []).append(r)
+                matched = set()
+                for l in lefts:
+                    key = k1(l)
+                    rs = build.get(key)
+                    if rs:
+                        matched.add(key)
+                        out.extend(f(l, r) for r in rs)
+                    elif kind in ("left", "full"):
+                        out.append(f(l, None))
+                if kind in ("right", "full"):
+                    for key, rs in build.items():
+                        if key not in matched:
+                            out.extend(f(None, r) for r in rs)
             return out
 
-        return self.left._derive(run, f"{kind}_join")
+        node = self.left._derive(run, f"{kind}_join", self.right)
+        node_holder.append(node)
+        return node
 
     # joining without a function yields (left, right) pairs, matching the
     # reference's DefaultJoin

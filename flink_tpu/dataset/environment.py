@@ -18,7 +18,9 @@ class ExecutionEnvironment:
 
     def from_collection(self, data: Iterable[Any]) -> DataSet:
         data = list(data)
-        return DataSet(self, lambda: data, "source")
+        ds = DataSet(self, lambda: data, "source")
+        ds.size_hint = len(data)   # exact, free: feeds the cost model
+        return ds
 
     def from_elements(self, *elements: Any) -> DataSet:
         return self.from_collection(list(elements))

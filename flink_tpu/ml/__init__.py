@@ -1,6 +1,7 @@
 """ML pipelines — the FlinkML analog (ref flink-ml, SURVEY §2.7)."""
 
 from flink_tpu.ml.pipeline import (
+    ALS,
     KNN,
     SVM,
     KMeans,
@@ -14,7 +15,7 @@ from flink_tpu.ml.pipeline import (
 )
 
 __all__ = [
-    "Pipeline", "Transformer", "Predictor", "StandardScaler",
+    "ALS", "Pipeline", "Transformer", "Predictor", "StandardScaler",
     "MinMaxScaler", "PolynomialFeatures", "MultipleLinearRegression",
     "SVM", "KMeans", "KNN",
 ]

@@ -364,16 +364,41 @@ class TableEnvironment:
         t = self.scan(m.group("from"))
         if m.group("jtable"):
             # equi-JOIN lowered to the columnar hash join (Table.join);
-            # `a.k` qualifiers resolve to the bare column names (clashing
-            # right columns surface under the r_ prefix, see join())
+            # `a.k` qualifiers bind the key to its table — the ON clause
+            # may list the two sides in either order (clashing right
+            # columns surface under the r_ prefix, see join())
             how = (m.group("jhow") or "inner").split()[0].lower()
-            right = self.scan(m.group("jtable"))
-            lk = m.group("jleft").split(".")[-1]
-            rk = m.group("jright").split(".")[-1]
-            # the grammar captures "left = right" in either order; the
-            # left key must name a column of the FROM table
-            if lk not in t.schema and rk in t.schema:
-                lk, rk = rk, lk
+            jt = m.group("jtable")
+            right = self.scan(jt)
+            ft = m.group("from")
+
+            def side_of(ref: str) -> Optional[str]:
+                if "." in ref:
+                    qual = ref.split(".")[0]
+                    if qual not in (ft, jt):
+                        raise ValueError(
+                            f"ON qualifier {qual!r} names neither "
+                            f"{ft!r} nor {jt!r}"
+                        )
+                    return "left" if qual == ft else "right"
+                return None      # unqualified: resolve by schema below
+
+            refs = [m.group("jleft"), m.group("jright")]
+            sides = [side_of(r) for r in refs]
+            cols_ = [r.split(".")[-1] for r in refs]
+            if sides[0] == sides[1] and sides[0] is not None:
+                raise ValueError("ON clause must reference both tables")
+            if "left" in sides:
+                lk = cols_[sides.index("left")]
+                rk = cols_[1 - sides.index("left")]
+            elif "right" in sides:
+                rk = cols_[sides.index("right")]
+                lk = cols_[1 - sides.index("right")]
+            else:
+                # both unqualified: bind by schema membership
+                lk, rk = cols_
+                if lk not in t.schema and rk in t.schema:
+                    lk, rk = rk, lk
             t = t.join(right, lk, rk, how=how)
         if m.group("where"):
             t = t.where(_parse_expr(m.group("where")))

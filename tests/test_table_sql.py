@@ -216,3 +216,18 @@ def test_streaming_composite_group_key():
                              t.cols["n"].tolist()):
         got[(a, b, wend)] = got.get((a, b, wend), 0.0) + n
     assert got == exp
+
+
+def test_sql_join_respects_on_qualifiers():
+    """Regression: ON qualifiers used to be discarded — with clashing
+    bare names the join silently paired the wrong columns."""
+    te = TableEnvironment.create()
+    te.register_table("l", te.from_columns({
+        "id": [1, 2, 3], "ref": [30, 10, 20]}))
+    te.register_table("r", te.from_columns({
+        "id": [10, 20, 30], "ref": [9, 9, 9], "tag": ["a", "b", "c"]}))
+    # join l.ref with r.id, stated right-side-first: qualifiers must win
+    t = te.sql_query(
+        "SELECT id, tag FROM l JOIN r ON r.id = l.ref ORDER BY id"
+    )
+    assert t.to_rows() == [(1, "c"), (2, "a"), (3, "b")]

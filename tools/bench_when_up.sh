@@ -1,0 +1,24 @@
+#!/bin/bash
+# Poll the TPU tunnel; when it answers, run the four-config bench and the
+# north-star bench back-to-back, saving results. One-shot.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 200); do
+  if timeout 60 python - <<'EOF' 2>/dev/null
+import subprocess, sys
+r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
+                   timeout=45, capture_output=True)
+sys.exit(0 if r.returncode == 0 else 1)
+EOF
+  then
+    echo "tunnel up after $i probes" >&2
+    timeout 560 python bench_configs.py --init-deadline 60 \
+        > /tmp/bench_configs_tpu.txt 2>&1
+    timeout 560 python bench.py --events 30000000 --baseline-events 3000000 \
+        --init-deadline 60 > /tmp/bench_north_tpu.txt 2>&1
+    echo DONE >&2
+    exit 0
+  fi
+  sleep 90
+done
+echo "tunnel never came up" >&2
+exit 1
