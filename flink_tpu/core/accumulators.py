@@ -1,0 +1,148 @@
+"""Accumulators — the flink-core accumulator API (SURVEY §2.1,
+ref org.apache.flink.api.common.accumulators: Accumulator, IntCounter,
+DoubleCounter, LongCounter, AverageAccumulator, Histogram).
+
+User functions add values during execution; the job result exposes the
+merged totals (`JobHandle.accumulator_results` / the DataSet
+environment's last-job map). Single-controller runtime: merge across
+subtasks collapses to merging per-operator instances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class Accumulator:
+    def add(self, value):
+        raise NotImplementedError
+
+    def get_local_value(self):
+        raise NotImplementedError
+
+    def merge(self, other: "Accumulator"):
+        raise NotImplementedError
+
+    def reset_local(self):
+        raise NotImplementedError
+
+
+class IntCounter(Accumulator):
+    def __init__(self):
+        self.value = 0
+
+    def add(self, value=1):
+        self.value += int(value)
+
+    def get_local_value(self):
+        return self.value
+
+    def merge(self, other):
+        self.value += other.get_local_value()
+
+    def reset_local(self):
+        self.value = 0
+
+
+class LongCounter(IntCounter):
+    pass
+
+
+class DoubleCounter(Accumulator):
+    def __init__(self):
+        self.value = 0.0
+
+    def add(self, value):
+        self.value += float(value)
+
+    def get_local_value(self):
+        return self.value
+
+    def merge(self, other):
+        self.value += other.get_local_value()
+
+    def reset_local(self):
+        self.value = 0.0
+
+
+class AverageAccumulator(Accumulator):
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def add(self, value):
+        self.total += float(value)
+        self.count += 1
+
+    def get_local_value(self):
+        return self.total / self.count if self.count else 0.0
+
+    def merge(self, other):
+        self.total += other.total
+        self.count += other.count
+
+    def reset_local(self):
+        self.total = 0.0
+        self.count = 0
+
+
+class Histogram(Accumulator):
+    """Integer-bucket histogram (ref accumulators.Histogram: TreeMap of
+    value -> count)."""
+
+    def __init__(self):
+        self.counts: Dict[int, int] = {}
+
+    def add(self, value):
+        v = int(value)
+        self.counts[v] = self.counts.get(v, 0) + 1
+
+    def get_local_value(self):
+        return dict(sorted(self.counts.items()))
+
+    def merge(self, other):
+        for v, c in other.counts.items():
+            self.counts[v] = self.counts.get(v, 0) + c
+
+    def reset_local(self):
+        self.counts.clear()
+
+
+class AccumulatorRegistry:
+    """Per-job registry (ref StreamingRuntimeContext.addAccumulator /
+    getAccumulator + JobExecutionResult.getAccumulatorResult)."""
+
+    def __init__(self):
+        self._acc: Dict[str, Accumulator] = {}
+
+    def add(self, name: str, accumulator: Accumulator):
+        cur = self._acc.get(name)
+        if cur is not None and cur is not accumulator:
+            raise ValueError(f"accumulator {name!r} already registered")
+        self._acc[name] = accumulator
+
+    def get(self, name: str) -> Accumulator:
+        return self._acc[name]
+
+    def results(self) -> Dict[str, Any]:
+        return {n: a.get_local_value() for n, a in self._acc.items()}
+
+    # -- checkpoint integration (the reference discards a failed
+    # attempt's accumulator values; here values roll back to the
+    # checkpoint cut so recovery neither loses nor double-counts) -------
+    def snapshot(self) -> Dict[str, Accumulator]:
+        import copy
+
+        return {n: copy.deepcopy(a) for n, a in self._acc.items()}
+
+    def restore(self, snap: Dict[str, Accumulator]):
+        """In-place rollback: user functions hold live references to
+        their accumulator objects, so values are reset and re-merged
+        rather than replaced."""
+        for n, a in self._acc.items():
+            a.reset_local()
+            saved = snap.get(n)
+            if saved is not None:
+                a.merge(saved)
+        for n, saved in snap.items():       # registered pre-crash only
+            self._acc.setdefault(n, saved)

@@ -122,9 +122,26 @@ class DataSet:
             print(e)
 
     def write_as_text(self, path: str):
-        with open(path, "w") as f:
+        from flink_tpu.core.filesystem import get_filesystem
+
+        fs, p = get_filesystem(path)
+        with fs.open(p, "w") as f:
             for e in self._data():
                 f.write(str(e) + "\n")
+
+    def write_as_csv(self, path: str, delimiter: str = ","):
+        """ref CsvOutputFormat: tuples/lists become delimited rows."""
+        import csv as _csv
+
+        from flink_tpu.core.filesystem import get_filesystem
+
+        fs, p = get_filesystem(path)
+        with fs.open(p, "w", newline="") as f:
+            w = _csv.writer(f, delimiter=delimiter)
+            for e in self._data():
+                w.writerow(
+                    e if isinstance(e, (tuple, list)) else (e,)
+                )
 
     def output(self, fn: Callable[[Any], None]):
         for e in self._data():

@@ -32,15 +32,21 @@ class ExecutionEnvironment:
 
     def read_text_file(self, path: str) -> DataSet:
         def run():
-            with open(path) as f:
+            from flink_tpu.core.filesystem import get_filesystem
+
+            fs, p = get_filesystem(path)
+            with fs.open(p, "r") as f:
                 return [line.rstrip("\n") for line in f]
 
         return DataSet(self, run, "text_file")
 
     def read_csv_file(self, path: str, types=None, delimiter=",") -> DataSet:
         def run():
+            from flink_tpu.core.filesystem import get_filesystem
+
+            fs, p = get_filesystem(path)
             out = []
-            with open(path) as f:
+            with fs.open(p, "r", newline="") as f:
                 for row in _csv.reader(f, delimiter=delimiter):
                     if types:
                         row = [t(v) for t, v in zip(types, row)]

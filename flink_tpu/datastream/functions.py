@@ -44,14 +44,16 @@ class RichFunction:
 class RuntimeContext:
     """Keyed-state access for rich functions (ref RuntimeContext.java +
     KeyedStateStore): get_state/get_list_state/... bound to the operator's
-    keyed backend and the current key set by the runtime."""
+    keyed backend and the current key set by the runtime. Also carries the
+    job's accumulator registry (ref addAccumulator/getAccumulator)."""
 
     def __init__(self, backend, metrics_group=None, subtask_index: int = 0,
-                 parallelism: int = 1):
+                 parallelism: int = 1, accumulators=None):
         self._backend = backend
         self.metrics_group = metrics_group
         self.subtask_index = subtask_index
         self.parallelism = parallelism
+        self._accumulators = accumulators
 
     def get_state(self, descriptor):
         return self._backend.get_partitioned_state(descriptor)
@@ -61,6 +63,30 @@ class RuntimeContext:
     get_reducing_state = get_state
     get_aggregating_state = get_state
     get_map_state = get_state
+
+    # -- accumulators (ref RuntimeContext.addAccumulator) ----------------
+    def add_accumulator(self, name: str, accumulator):
+        if self._accumulators is None:
+            raise RuntimeError("no accumulator registry bound to this job")
+        self._accumulators.add(name, accumulator)
+
+    def get_accumulator(self, name: str):
+        if self._accumulators is None:
+            raise RuntimeError("no accumulator registry bound to this job")
+        return self._accumulators.get(name)
+
+    def get_int_counter(self, name: str):
+        """Convenience matching getIntCounter: register-or-get."""
+        from flink_tpu.core.accumulators import IntCounter
+
+        if self._accumulators is None:
+            raise RuntimeError("no accumulator registry bound to this job")
+        try:
+            return self._accumulators.get(name)
+        except KeyError:
+            acc = IntCounter()
+            self._accumulators.add(name, acc)
+            return acc
 
 
 class TimerService:

@@ -131,6 +131,12 @@ class JobHandle:
     metrics: JobMetrics
     state: Any = None      # final device state (windowed stages)
     ctx: Any = None
+    # merged accumulator values (ref JobExecutionResult.getAllAccumulator-
+    # Results); empty when no rich function registered any
+    accumulator_results: Any = None
+
+    def accumulator_result(self, name: str):
+        return (self.accumulator_results or {})[name]
 
 
 @dataclasses.dataclass
@@ -2182,6 +2188,9 @@ class LocalExecutor:
             # operators needing namespaced timers/state (GenericWindowOperator)
             fn.bind_internals(backend, timers)
         reg = getattr(env, "_kv_registry", None)
+        from flink_tpu.core.accumulators import AccumulatorRegistry
+
+        accumulators = AccumulatorRegistry()
         if isinstance(fn, RichFunction):
             fn.open(RuntimeContext(
                 backend,
@@ -2189,6 +2198,7 @@ class LocalExecutor:
                     self._job_group.add_group("user")
                     if self._job_group is not None else None
                 ),
+                accumulators=accumulators,
             ))
         if reg is not None:
             # resolve against the backend's live table set at query time so
@@ -2224,6 +2234,7 @@ class LocalExecutor:
                 "proc_time": timers.current_processing_time,
                 "max_parallelism": env.max_parallelism,
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+                "accumulators": accumulators.snapshot(),
             })
             pipe.source.notify_checkpoint_complete(next_cid, offsets)
             for s in pipe.all_sinks:
@@ -2270,6 +2281,9 @@ class LocalExecutor:
             timers.current_processing_time = payload.get(
                 "proc_time", timers.current_processing_time
             )
+            # roll accumulators back to the cut: the replayed records
+            # re-add their contributions exactly once
+            accumulators.restore(payload.get("accumulators", {}))
             steps_at_ckpt = metrics.steps
 
         def write_savepoint(path: str) -> str:
@@ -2283,6 +2297,7 @@ class LocalExecutor:
                 "proc_time": timers.current_processing_time,
                 "max_parallelism": env.max_parallelism,
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+                "accumulators": accumulators.snapshot(),
             })
 
         self._savepoint_writer = write_savepoint
@@ -2368,7 +2383,8 @@ class LocalExecutor:
         emit()
         if isinstance(fn, RichFunction):
             fn.close()
-        return JobHandle(job_name, metrics, state=backend)
+        return JobHandle(job_name, metrics, state=backend,
+                         accumulator_results=accumulators.results())
 
     # ------------------------------------------------------------------
     def _run_rolling(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
