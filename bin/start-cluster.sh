@@ -1,0 +1,10 @@
+#!/bin/bash
+# Start the flink-tpu process-cluster controller (ref bin/start-cluster.sh).
+#
+#   bin/start-cluster.sh [--host 0.0.0.0] [--port 6123]
+#                        [--advertise-host HOST] [--ha-dir DIR]
+#
+# The controller prints its control endpoint; point workers and the CLI at
+# it. Multi-host: bind 0.0.0.0 and advertise the machine's reachable IP.
+cd "$(dirname "$0")/.."
+exec python -m flink_tpu.runtime.process_cluster "$@"

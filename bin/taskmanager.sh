@@ -1,0 +1,7 @@
+#!/bin/bash
+# Start a worker process registering with a controller
+# (ref bin/taskmanager.sh; TaskManager.scala:296 registration).
+#
+#   bin/taskmanager.sh --controller HOST:PORT --worker-id W1 [...]
+cd "$(dirname "$0")/.."
+exec python -m flink_tpu.runtime.worker "$@"

@@ -120,8 +120,11 @@ class MemoryFileSystem(FileSystem):
         text = "b" not in mode
         if "w" in mode or "a" in mode:
             w = MemoryFileSystem._Writer(self, path, text)
-            if "a" in mode and path in self._files:
-                w.write(self._files[path])
+            if "a" in mode:
+                with self._lock:
+                    existing = self._files.get(path)
+                if existing is not None:
+                    io.BytesIO.write(w, existing)
             return w
         with self._lock:
             if path not in self._files:
@@ -157,16 +160,31 @@ class MemoryFileSystem(FileSystem):
 
     def delete(self, path: str, recursive: bool = False):
         with self._lock:
+            prefix = path.rstrip("/") + "/"
+            children = [f for f in self._files if f.startswith(prefix)]
+            if children and not recursive:
+                # match LocalFileSystem: os.rmdir refuses non-empty dirs
+                raise OSError(f"directory not empty: {path!r}")
             self._files.pop(path, None)
             self._dirs.discard(path.rstrip("/"))
             if recursive:
-                prefix = path.rstrip("/") + "/"
-                for f in [f for f in self._files if f.startswith(prefix)]:
+                for f in children:
                     del self._files[f]
 
     def rename(self, src: str, dst: str):
         with self._lock:
-            self._files[dst] = self._files.pop(src)
+            if src in self._files:
+                self._files[dst] = self._files.pop(src)
+                return
+            # directory rename: move every child under the prefix
+            prefix = src.rstrip("/") + "/"
+            children = [f for f in self._files if f.startswith(prefix)]
+            if not children and src.rstrip("/") not in self._dirs:
+                raise FileNotFoundError(src)   # match os.replace
+            for f in children:
+                self._files[dst.rstrip("/") + "/" + f[len(prefix):]] =                     self._files.pop(f)
+            self._dirs.discard(src.rstrip("/"))
+            self._dirs.add(dst.rstrip("/"))
 
     def size(self, path: str) -> int:
         with self._lock:

@@ -1,0 +1,7 @@
+"""Storm compatibility layer (ref flink-contrib/flink-storm)."""
+
+from flink_tpu.storm.topology import (
+    BasicBolt, BasicSpout, FlinkTopology, TopologyBuilder,
+)
+
+__all__ = ["TopologyBuilder", "FlinkTopology", "BasicSpout", "BasicBolt"]

@@ -1,0 +1,244 @@
+"""Storm topology compatibility — the flink-storm role (SURVEY §2.7,
+ref flink-contrib/flink-storm: FlinkTopologyBuilder wrapping spouts/bolts
+as Flink operators).
+
+Spouts and bolts written against the (simplified) Storm programming model
+run unchanged as a flink_tpu streaming job:
+
+    builder = TopologyBuilder()
+    builder.set_spout("lines", LineSpout())
+    builder.set_bolt("split", SplitBolt()).shuffle_grouping("lines")
+    builder.set_bolt("count", CountBolt()).fields_grouping("split", 0)
+    results = FlinkTopology(builder).execute(env)
+
+Lowering: a spout becomes a Source (next_tuple pull loop), a
+shuffle/global-grouped bolt a host flat_map in the pre-keyBy chain, and a
+fields-grouped bolt a keyed ProcessFunction over the grouping field —
+exactly the operator roles the reference's SpoutWrapper/BoltWrapper give
+them. Linear topologies (each bolt one upstream), the shape the
+reference's examples use; no acking (Flink checkpoints replace Storm's
+tuple tracking, as in the reference wrapper).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class BasicSpout:
+    """Simplified IRichSpout: open() then next_tuple() until None/[] —
+    emit via the collector passed to open."""
+
+    def open(self, collector: "SpoutCollector"):
+        pass
+
+    def next_tuple(self) -> bool:
+        """Emit zero or more tuples via the collector; return False when
+        exhausted (finite topologies run to completion)."""
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class BasicBolt:
+    """Simplified IRichBolt: prepare() then execute(tuple) emitting via
+    the collector."""
+
+    def prepare(self, collector: "BoltCollector"):
+        self.collector = collector
+
+    def execute(self, tup: tuple):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class SpoutCollector:
+    def __init__(self):
+        self.buf: List[tuple] = []
+
+    def emit(self, tup):
+        self.buf.append(tuple(tup))
+
+
+class BoltCollector(SpoutCollector):
+    pass
+
+
+class _BoltDecl:
+    def __init__(self, name: str, bolt: BasicBolt):
+        self.name = name
+        self.bolt = bolt
+        self.upstream: Optional[str] = None
+        self.grouping: Optional[Tuple[str, Any]] = None
+
+    def shuffle_grouping(self, upstream: str) -> "_BoltDecl":
+        self.upstream = upstream
+        self.grouping = ("shuffle", None)
+        return self
+
+    def global_grouping(self, upstream: str) -> "_BoltDecl":
+        self.upstream = upstream
+        self.grouping = ("global", None)
+        return self
+
+    def fields_grouping(self, upstream: str, field) -> "_BoltDecl":
+        """field: tuple POSITION (int). The simplified model carries
+        positional tuples, not named fields — a string name cannot be
+        resolved and must not silently key by the whole tuple."""
+        if not isinstance(field, int):
+            raise TypeError(
+                f"fields_grouping takes a tuple position (int), got "
+                f"{field!r}; declare emissions positionally"
+            )
+        self.upstream = upstream
+        self.grouping = ("fields", field)
+        return self
+
+
+class TopologyBuilder:
+    """ref TopologyBuilder.setSpout/setBolt."""
+
+    def __init__(self):
+        self.spout_name: Optional[str] = None
+        self.spout: Optional[BasicSpout] = None
+        self.bolts: Dict[str, _BoltDecl] = {}
+
+    def set_spout(self, name: str, spout: BasicSpout):
+        if self.spout is not None:
+            raise ValueError("one spout per topology (linear topologies)")
+        self.spout_name, self.spout = name, spout
+        return self
+
+    def set_bolt(self, name: str, bolt: BasicBolt) -> _BoltDecl:
+        if name in self.bolts or name == self.spout_name:
+            raise ValueError(f"duplicate component id {name!r}")
+        decl = _BoltDecl(name, bolt)
+        self.bolts[name] = decl
+        return decl
+
+
+class FlinkTopology:
+    """ref FlinkTopology.createTopology + LocalCluster.submitTopology:
+    lowers the declared topology onto the DataStream API and executes."""
+
+    def __init__(self, builder: TopologyBuilder):
+        if builder.spout is None:
+            raise ValueError("topology needs a spout")
+        self.builder = builder
+
+    def _chain_order(self) -> List[_BoltDecl]:
+        """Topological order of the linear chain from the spout."""
+        by_upstream = {}
+        for d in self.builder.bolts.values():
+            if d.upstream is None:
+                raise ValueError(f"bolt {d.name!r} has no grouping")
+            if d.upstream in by_upstream:
+                raise ValueError("linear topologies only (one consumer "
+                                 "per component)")
+            by_upstream[d.upstream] = d
+        chain, cur = [], self.builder.spout_name
+        while cur in by_upstream:
+            chain.append(by_upstream[cur])
+            cur = by_upstream[cur].name
+        if len(chain) != len(self.builder.bolts):
+            raise ValueError("disconnected bolts in topology")
+        return chain
+
+    def execute(self, env, job_name: str = "storm-topology"):
+        """Run to completion; returns the collected output tuples of the
+        last component."""
+        from flink_tpu.datastream.functions import ProcessFunction
+        from flink_tpu.runtime.sinks import CollectSink
+        from flink_tpu.runtime.sources import Source
+
+        chain = self._chain_order()   # validate before touching the env
+        builder = self.builder
+
+        class _SpoutSource(Source):
+            def __init__(self):
+                self.collector = SpoutCollector()
+                self._opened = False
+                self._done = False
+
+            def open(self):
+                if not self._opened:
+                    builder.spout.open(self.collector)
+                    self._opened = True
+
+            def poll(self, max_records: int):
+                out = []
+                while len(out) < max_records and not self._done:
+                    self.collector.buf = []
+                    alive = builder.spout.next_tuple()
+                    out.extend(self.collector.buf)
+                    if not alive:
+                        self._done = True
+                return out, self._done
+
+            def snapshot_offsets(self):
+                return None
+
+            def restore_offsets(self, state):
+                pass
+
+        stream = env.add_source(_SpoutSource())
+
+        def bolt_flat_map(bolt: BasicBolt):
+            state = {"prepared": False}
+            coll = BoltCollector()
+            bolt_ref = bolt
+
+            def fm(tup):
+                if not state["prepared"]:
+                    bolt_ref.prepare(coll)
+                    state["prepared"] = True
+                coll.buf = []
+                bolt_ref.execute(tuple(tup) if isinstance(tup, (tuple, list))
+                                 else (tup,))
+                return list(coll.buf)
+
+            return fm
+
+        sink = CollectSink()
+        i = 0
+        while i < len(chain):
+            decl = chain[i]
+            kind, field = decl.grouping
+            if kind in ("shuffle", "global"):
+                # operator chaining, like the reference wrapping the bolt
+                # as a chained flatMap
+                stream = stream.flat_map(bolt_flat_map(decl.bolt))
+                i += 1
+                continue
+            # fields grouping: keyed execution of THIS bolt
+            bolt = decl.bolt
+
+            class _KeyedBolt(ProcessFunction):
+                def __init__(self, b):
+                    self._b = b
+                    self._coll = BoltCollector()
+                    self._prepared = False
+
+                def process_element(self, value, ctx, out):
+                    if not self._prepared:
+                        self._b.prepare(self._coll)
+                        self._prepared = True
+                    self._coll.buf = []
+                    self._b.execute(tuple(value))
+                    for t in self._coll.buf:
+                        out.collect(t)
+
+            f = field
+            stream = stream.key_by(
+                lambda t, _f=f: t[_f]
+            ).process(_KeyedBolt(bolt))
+            i += 1
+        stream.add_sink(sink)
+        job = env.execute(job_name)
+        builder.spout.close()
+        for d in chain:
+            d.bolt.close()
+        return sink.results

@@ -5,7 +5,6 @@ Ref: api/common/accumulators/*, api/common/io/CsvInputFormat+
 CsvOutputFormat, core/fs/FileSystem.
 """
 
-import numpy as np
 import pytest
 
 from flink_tpu.core.accumulators import (
