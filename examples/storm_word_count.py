@@ -1,0 +1,70 @@
+"""Storm-compatibility example: the classic word-count topology running
+unchanged on the flink_tpu runtime (ref flink-storm WordCountTopology).
+
+Run: JAX_PLATFORMS=cpu python examples/storm_word_count.py
+"""
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.storm import (
+    BasicBolt, BasicSpout, FlinkTopology, TopologyBuilder,
+)
+
+SENTENCES = [
+    "the cow jumped over the moon",
+    "an apple a day keeps the doctor away",
+    "four score and seven years ago",
+    "snow white and the seven dwarfs",
+    "i am at two with nature",
+] * 4
+
+
+class SentenceSpout(BasicSpout):
+    def open(self, collector):
+        self.collector = collector
+        self.i = 0
+
+    def next_tuple(self):
+        if self.i >= len(SENTENCES):
+            return False
+        self.collector.emit((SENTENCES[self.i],))
+        self.i += 1
+        return True
+
+
+class SplitBolt(BasicBolt):
+    def execute(self, tup):
+        for word in tup[0].split():
+            self.collector.emit((word, 1))
+
+
+class CountBolt(BasicBolt):
+    def prepare(self, collector):
+        super().prepare(collector)
+        self.counts = {}
+
+    def execute(self, tup):
+        word, n = tup
+        self.counts[word] = self.counts.get(word, 0) + n
+        self.collector.emit((word, self.counts[word]))
+
+
+def main():
+    builder = TopologyBuilder()
+    builder.set_spout("sentences", SentenceSpout())
+    builder.set_bolt("split", SplitBolt()).shuffle_grouping("sentences")
+    builder.set_bolt("count", CountBolt()).fields_grouping("split", 0)
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 16
+    env.set_parallelism(1)
+    results = FlinkTopology(builder).execute(env)
+
+    finals = {}
+    for word, n in results:
+        finals[word] = max(finals.get(word, 0), n)
+    for word, n in sorted(finals.items(), key=lambda kv: -kv[1])[:10]:
+        print(f"{word:>10}: {n}")
+
+
+if __name__ == "__main__":
+    main()
