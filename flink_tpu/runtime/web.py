@@ -14,6 +14,9 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>/backpressure  cycle-time percentiles
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
                               (ref CheckpointStatsTracker + handlers/checkpoints/)
+    /jobs/<jid>/plan          logical operator DAG (ref JobPlanHandler)
+    /jobs/<jid>/exceptions    failure causes (ref JobExceptionsHandler)
+    /config                   effective configuration (ref JobManagerConfigHandler)
     /web                      single-page HTML dashboard over these routes
 """
 
@@ -120,6 +123,61 @@ class WebMonitor:
             except KeyError as e:
                 return {"ok": False, "error": str(e)}
             return {"ok": True, "value": value}
+        m = re.fullmatch(r"/jobs/([^/]+)/plan", path)
+        if m:
+            # ref JobPlanHandler: the logical operator DAG as JSON
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            nodes, seen = [], set()
+
+            def walk(t):
+                if t is None or t.id in seen:
+                    return
+                seen.add(t.id)
+                parents = (
+                    [t.parent] if t.parent is not None else []
+                ) + list(getattr(t, "parents", []) or [])
+                for p in parents:
+                    walk(p)
+                nodes.append({
+                    "id": t.id,
+                    "type": type(t).__name__.replace("Transformation", ""),
+                    "description": getattr(t, "kind", None) or t.name,
+                    "inputs": [p.id for p in parents],
+                })
+
+            for sink in getattr(rec.env, "_sinks", []):
+                walk(sink)
+            return {"jid": m.group(1), "plan": {"nodes": nodes}}
+        m = re.fullmatch(r"/jobs/([^/]+)/exceptions", path)
+        if m:
+            # ref JobExceptionsHandler
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            return {
+                "root-exception": rec.error,
+                "truncated": False,
+                "all-exceptions": [rec.error] if rec.error else [],
+            }
+        if path in ("/config", "/jobmanager/config"):
+            # ref JobManagerConfigHandler serves cluster-level config; the
+            # MiniCluster has no separate cluster Configuration, so the
+            # MERGED view over every job's config is served (later
+            # submissions win on key clashes). Snapshot under no lock
+            # hazard: list() copies before iterating (submit() mutates
+            # the dict from other threads).
+            merged = {}
+            for rec in list(self.cluster.jobs.values()):
+                data = getattr(getattr(rec.env, "config", None), "_data",
+                               None)
+                if data:
+                    merged.update(data)
+            return [
+                {"key": k, "value": str(v)}
+                for k, v in sorted(merged.items())
+            ]
         m = re.fullmatch(r"/jobs/([^/]+)/checkpoints", path)
         if m:
             rec = self.cluster.jobs.get(m.group(1))

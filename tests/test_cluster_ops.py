@@ -262,3 +262,41 @@ def test_web_checkpoint_stats_and_dashboard(tmp_path):
         assert "/jobs/" in page          # the page drives the JSON routes
     finally:
         web.stop()
+
+
+def test_web_plan_exceptions_config_routes():
+    """ref JobPlanHandler / JobExceptionsHandler / JobManagerConfigHandler."""
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    env.config.set("taskmanager.test-knob", "42")
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "plan-job")
+    try:
+        time.sleep(0.5)
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        plan = get(f"/jobs/{jid}/plan")["plan"]["nodes"]
+        types = [n["type"] for n in plan]
+        assert "Source" in types and "Sink" in types
+        # the DAG is topologically emitted: every input precedes its node
+        pos = {n["id"]: i for i, n in enumerate(plan)}
+        for n in plan:
+            assert all(pos[i] < pos[n["id"]] for i in n["inputs"])
+
+        exc = get(f"/jobs/{jid}/exceptions")
+        assert exc["root-exception"] is None
+
+        cfg = get("/config")
+        assert {"key": "taskmanager.test-knob", "value": "42"} in cfg
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+        web.stop()
