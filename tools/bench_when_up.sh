@@ -1,20 +1,25 @@
 #!/bin/bash
 # Poll the TPU tunnel; when it answers, run the four-config bench and the
-# north-star bench back-to-back, saving results. One-shot.
+# north-star bench back-to-back. Results land IN THE REPO so an
+# end-of-round commit captures them even if the tunnel recovers late.
 cd "$(dirname "$0")/.."
-for i in $(seq 1 200); do
-  if timeout 60 python - <<'EOF' 2>/dev/null
+for i in $(seq 1 120); do
+  if timeout 60 python - <<'PYEOF' 2>/dev/null
 import subprocess, sys
 r = subprocess.run([sys.executable, "-c", "import jax; jax.devices()"],
                    timeout=45, capture_output=True)
 sys.exit(0 if r.returncode == 0 else 1)
-EOF
+PYEOF
   then
     echo "tunnel up after $i probes" >&2
     timeout 560 python bench_configs.py --init-deadline 60 \
         > /tmp/bench_configs_tpu.txt 2>&1
+    grep -h '"config"' /tmp/bench_configs_tpu.txt \
+        > BENCH_CONFIGS_r03.jsonl || true
     timeout 560 python bench.py --events 30000000 --baseline-events 3000000 \
         --init-deadline 60 > /tmp/bench_north_tpu.txt 2>&1
+    grep -h '"metric"' /tmp/bench_north_tpu.txt \
+        >> BENCH_CONFIGS_r03.jsonl || true
     echo DONE >&2
     exit 0
   fi
