@@ -22,6 +22,22 @@ def _env(capacity, **cfg):
     return env
 
 
+def _sums(results):
+    got = {}
+    for r in results:
+        got[(r.key, r.window_end_ms)] = got.get((r.key, r.window_end_ms),
+                                                0) + r.value
+    return got
+
+
+def _expected(total, n_keys, ts_div, win):
+    exp = {}
+    for i in range(total):
+        k, w = i % n_keys, ((i // ts_div) // win + 1) * win
+        exp[(k, w)] = exp.get((k, w), 0) + 1.0
+    return exp
+
+
 def test_auto_selects_direct_and_results_exact():
     B, n_keys, total = 128, 200, 128 * 30
 
@@ -41,15 +57,7 @@ def test_auto_selects_direct_and_results_exact():
     )
     job = env.execute("direct-auto")
     assert job.metrics.state_layout == "direct"
-    got = {}
-    for r in sink.results:
-        got[(r.key, r.window_end_ms)] = got.get((r.key, r.window_end_ms),
-                                                0) + r.value
-    exp = {}
-    for i in range(total):
-        k, w = i % n_keys, ((i // 32) // 40 + 1) * 40
-        exp[(k, w)] = exp.get((k, w), 0) + 1.0
-    assert got == exp
+    assert _sums(sink.results) == _expected(total, n_keys, 32, 40)
     assert job.metrics.dropped_capacity == 0
 
 
@@ -148,3 +156,77 @@ def test_direct_checkpoint_restore_roundtrip(tmp_path):
         np.asarray(jax.device_get(restored.touched)),
         np.asarray(jax.device_get(state.touched)),
     )
+
+
+def test_direct_layout_multi_device_with_exchange():
+    """Direct layout at parallelism 8 under the default adaptive
+    exchange: each shard owns its key groups at slot == key; results
+    must be exact and the ICI route must engage for balanced batches."""
+    B, n_keys, total = 96, 60, 96 * 25
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return {"key": idx % n_keys, "value": np.ones(n, np.float32)}, idx // 12
+
+    env = _env(256, **{"exchange.capacity-factor": 4.0})
+    env.set_parallelism(8)
+    env.batch_size = B
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(50)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("direct-multidev")
+    assert job.metrics.state_layout == "direct"
+    assert job.metrics.exchange_mode == "adaptive"
+    assert job.metrics.steps_exchanged > 0
+    assert _sums(sink.results) == _expected(total, n_keys, 12, 50)
+    assert job.metrics.dropped_capacity == 0
+
+
+def test_direct_layout_job_checkpoint_restore_roundtrip(tmp_path):
+    """Kill-and-recover a direct-layout job: the checkpoint records the
+    layout and restore resumes in it (aux['state_layout'])."""
+    B, n_keys, total = 64, 40, 64 * 30
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        return {"key": idx % n_keys, "value": np.ones(n, np.float32)}, idx // 8
+
+    class FailingSink(CollectSink):
+        armed = [True]
+
+        def invoke_batch(self, elements):
+            if FailingSink.armed[0] and len(self.results) > 0:
+                FailingSink.armed[0] = False
+                raise RuntimeError("injected")
+            super().invoke_batch(elements)
+
+        def snapshot_state(self):
+            return list(self.results)
+
+        def restore_state(self, state):
+            self.results = list(state)
+
+    env = _env(64, **{
+        "restart-strategy": "fixed-delay",
+        "restart-strategy.fixed-delay.attempts": 3,
+    })
+    env.batch_size = B
+    env.checkpoint_dir = str(tmp_path / "ck")
+    env.checkpoint_interval_steps = 3
+    sink = FailingSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(40)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("direct-ck")
+    assert job.metrics.state_layout == "direct"
+    assert job.metrics.restarts >= 1
+    assert _sums(sink.results) == _expected(total, n_keys, 8, 40)
