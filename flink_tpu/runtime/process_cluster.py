@@ -78,6 +78,10 @@ class WorkerRecord:
     error: Optional[str] = None
     restarts: int = 0
     extra_env: Optional[dict] = None
+    # True for workers the controller did NOT spawn: independently
+    # launched TaskManagers (bin/taskmanager.sh on another host) that
+    # registered themselves — tracked and death-watched, never respawned
+    external: bool = False
 
 
 class ProcessCluster:
@@ -247,6 +251,17 @@ class ProcessCluster:
         for worker_id, rec in self.registry.all().items():
             if rec.get("status") != "RUNNING":
                 continue
+            # insert the record BEFORE spawning (as submit() does): the
+            # worker can register the instant it forks, and an unknown id
+            # at that moment would be mis-adopted as an external worker
+            wrec = WorkerRecord(
+                worker_id=worker_id, proc=None,
+                job_name=rec["job_name"], builder_ref=rec["builder_ref"],
+                checkpoint_dir=rec["checkpoint_dir"],
+                extra_env=rec.get("extra_env"),
+            )
+            with self._lock:
+                self.workers[worker_id] = wrec
             try:
                 proc = self._spawn(worker_id, rec["builder_ref"],
                                    rec["job_name"], rec["checkpoint_dir"],
@@ -255,16 +270,13 @@ class ProcessCluster:
             except Exception as e:  # one bad job must not block the rest
                 self._event("recover-failed", worker=worker_id,
                             error=str(e))
+                with self._lock:
+                    wrec.status = "FAILED"
+                    wrec.error = str(e)
                 self.registry.update_status(worker_id, "FAILED")
                 continue
-            wrec = WorkerRecord(
-                worker_id=worker_id, proc=proc,
-                job_name=rec["job_name"], builder_ref=rec["builder_ref"],
-                checkpoint_dir=rec["checkpoint_dir"],
-                extra_env=rec.get("extra_env"),
-            )
             with self._lock:
-                self.workers[worker_id] = wrec
+                wrec.proc = proc
             self._event("recovered", worker=worker_id)
 
     def shutdown(self):
@@ -289,18 +301,44 @@ class ProcessCluster:
         if action == "register-worker":
             with self._lock:
                 rec = self.workers.get(req["worker_id"])
+                adopted = rec is None
                 if rec is not None:
+                    # re-registration revives even a DEAD external record:
+                    # the worker proving liveness IS the revival signal
+                    # (its transient network gap is over)
                     rec.status = "REGISTERED"
                     rec.last_heartbeat = time.time()
+                    external = rec.external
+                else:
+                    # ADOPT an independently launched worker — the
+                    # reference's TaskManager-registers-itself flow
+                    # (TaskManager.scala:296): it appears in the worker
+                    # list, heartbeats drive its status, and the
+                    # DeathWatch flags it DEAD on silence (it cannot be
+                    # respawned — its process belongs to another host)
+                    self.workers[req["worker_id"]] = WorkerRecord(
+                        worker_id=req["worker_id"], proc=None,
+                        job_name=req.get("job_name", ""),
+                        builder_ref=req.get("builder", ""),
+                        checkpoint_dir=req.get("checkpoint_dir", ""),
+                        status="REGISTERED", external=True,
+                    )
+                    external = True
             self._event("registered", worker=req["worker_id"],
-                        pid=req.get("pid"))
+                        pid=req.get("pid"), external=external,
+                        adopted=adopted)
             return {"ok": True}
         if action == "heartbeat":
             with self._lock:
                 rec = self.workers.get(req["worker_id"])
                 if rec is not None:
                     rec.last_heartbeat = time.time()
-                    if rec.status == "REGISTERED":
+                    if rec.status == "REGISTERED" or (
+                        rec.external and rec.status == "DEAD"
+                    ):
+                        # an external record flagged DEAD by a transient
+                        # heartbeat gap revives on the next beat — the
+                        # worker never stopped, only its signal did
                         rec.status = "RUNNING"
             return {"ok": True}
         if action == "worker-status":
@@ -420,6 +458,25 @@ class ProcessCluster:
             for rec in recs:
                 if rec.status in ("FINISHED", "FAILED", "DEAD",
                                   "SPAWNING", "RESPAWNING"):
+                    continue
+                if rec.external:
+                    # adopted worker: heartbeat silence is the only death
+                    # signal, and there is no process to respawn (a later
+                    # heartbeat/re-registration revives the record)
+                    if now - rec.last_heartbeat > self.heartbeat_timeout_s:
+                        with self._lock:
+                            # re-check under the lock: a beat may have
+                            # landed since the unlocked staleness read
+                            if (
+                                rec.status in ("FINISHED", "FAILED")
+                                or time.time() - rec.last_heartbeat
+                                <= self.heartbeat_timeout_s
+                            ):
+                                continue
+                            rec.status = "DEAD"
+                        self._event("death", worker=rec.worker_id,
+                                    cause="heartbeat-timeout",
+                                    external=True)
                     continue
                 if rec.proc is None:     # spawn still in flight
                     continue

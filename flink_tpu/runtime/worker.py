@@ -71,6 +71,10 @@ def run_worker(controller, worker_id: str, builder_ref: str,
     _send(controller, {
         "action": "register-worker", "worker_id": worker_id,
         "pid": os.getpid(),
+        # lets a controller that did not spawn this worker ADOPT it with
+        # full context (external TaskManager registration)
+        "builder": builder_ref, "job_name": job_name,
+        "checkpoint_dir": checkpoint_dir,
     })
 
     stop = threading.Event()

@@ -268,3 +268,74 @@ def test_multihost_registration_over_non_loopback(tmp_path):
         assert cells == expected_cells(total)
     finally:
         c.shutdown()
+
+
+def test_external_worker_adoption_and_deathwatch(tmp_path):
+    """An independently launched worker (bin/taskmanager.sh path, the
+    reference's TaskManager-registers-itself flow) is ADOPTED by the
+    controller: it appears in the worker list, runs to FINISHED, and a
+    killed external worker is flagged DEAD by the DeathWatch."""
+    c = ProcessCluster(heartbeat_timeout_s=2.0, max_restarts=1)
+    c.start()
+    try:
+        out = str(tmp_path / "out")
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "FLINK_TPU_TEST_OUT": out,
+            "FLINK_TPU_TEST_TOTAL": "8000",
+            "PYTHONPATH": os.path.dirname(JOBS) + os.pathsep
+            + env.get("PYTHONPATH", ""),
+        })
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu.runtime.worker",
+             "--controller", f"127.0.0.1:{c._port}",
+             "--worker-id", "EXT1", "--builder", BUILDER,
+             "--job-name", "ext-job",
+             "--checkpoint-dir", str(tmp_path / "chk")],
+            env=env,
+        )
+        try:
+            _wait_for(
+                lambda: getattr(
+                    c.workers.get("EXT1"), "status", None
+                ) == "FINISHED",
+                120, "external worker to finish",
+            )
+        finally:
+            proc.wait(timeout=30)
+        rec = c.workers["EXT1"]
+        assert rec.external and rec.proc is None
+        from process_jobs import expected_cells
+
+        cells, dups = _read_cells(out)
+        assert dups == 0 and cells == expected_cells(8000)
+
+        # second external worker killed mid-run -> DeathWatch flags DEAD
+        env2 = dict(env)
+        env2["FLINK_TPU_TEST_OUT"] = str(tmp_path / "out2")
+        env2["FLINK_TPU_TEST_TOTAL"] = "4000000"   # long enough to kill
+        proc2 = subprocess.Popen(
+            [sys.executable, "-m", "flink_tpu.runtime.worker",
+             "--controller", f"127.0.0.1:{c._port}",
+             "--worker-id", "EXT2", "--builder", BUILDER,
+             "--job-name", "ext-kill",
+             "--checkpoint-dir", str(tmp_path / "chk2")],
+            env=env2,
+        )
+        _wait_for(
+            lambda: "EXT2" in c.workers, 60, "EXT2 registration",
+        )
+        proc2.kill()
+        proc2.wait(timeout=30)
+        _wait_for(
+            lambda: c.workers["EXT2"].status == "DEAD",
+            30, "DeathWatch to flag the killed external worker",
+        )
+        assert not any(
+            e["event"] == "death" and e.get("worker") == "EXT2"
+            and not e.get("external")
+            for e in c.events
+        )
+    finally:
+        c.shutdown()
