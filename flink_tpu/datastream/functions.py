@@ -48,12 +48,14 @@ class RuntimeContext:
     job's accumulator registry (ref addAccumulator/getAccumulator)."""
 
     def __init__(self, backend, metrics_group=None, subtask_index: int = 0,
-                 parallelism: int = 1, accumulators=None):
+                 parallelism: int = 1, accumulators=None,
+                 operator_state=None):
         self._backend = backend
         self.metrics_group = metrics_group
         self.subtask_index = subtask_index
         self.parallelism = parallelism
         self._accumulators = accumulators
+        self._operator_state = operator_state
 
     def get_state(self, descriptor):
         return self._backend.get_partitioned_state(descriptor)
@@ -63,6 +65,18 @@ class RuntimeContext:
     get_reducing_state = get_state
     get_aggregating_state = get_state
     get_map_state = get_state
+
+    # -- operator (non-keyed) state (ref OperatorStateStore) -------------
+    def get_operator_list_state(self, name: str):
+        """Per-operator list state snapshotting into checkpoints (ref
+        CheckpointedFunction's OperatorStateStore.getListState)."""
+        if self._operator_state is None:
+            raise RuntimeError(
+                "no operator state store bound to this operator"
+            )
+        return self._operator_state.get_list_state(name)
+
+    get_union_list_state = get_operator_list_state
 
     # -- accumulators (ref RuntimeContext.addAccumulator) ----------------
     def add_accumulator(self, name: str, accumulator):

@@ -2189,8 +2189,10 @@ class LocalExecutor:
             fn.bind_internals(backend, timers)
         reg = getattr(env, "_kv_registry", None)
         from flink_tpu.core.accumulators import AccumulatorRegistry
+        from flink_tpu.state.operator_state import OperatorStateStore
 
         accumulators = AccumulatorRegistry()
+        operator_state = OperatorStateStore()
         if isinstance(fn, RichFunction):
             fn.open(RuntimeContext(
                 backend,
@@ -2199,6 +2201,7 @@ class LocalExecutor:
                     if self._job_group is not None else None
                 ),
                 accumulators=accumulators,
+                operator_state=operator_state,
             ))
         if reg is not None:
             # resolve against the backend's live table set at query time so
@@ -2235,6 +2238,7 @@ class LocalExecutor:
                 "max_parallelism": env.max_parallelism,
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
                 "accumulators": accumulators.snapshot(),
+                "operator_state": operator_state.snapshot(),
             })
             pipe.source.notify_checkpoint_complete(next_cid, offsets)
             for s in pipe.all_sinks:
@@ -2281,9 +2285,10 @@ class LocalExecutor:
             timers.current_processing_time = payload.get(
                 "proc_time", timers.current_processing_time
             )
-            # roll accumulators back to the cut: the replayed records
-            # re-add their contributions exactly once
+            # roll accumulators + operator state back to the cut: the
+            # replayed records re-apply their contributions exactly once
             accumulators.restore(payload.get("accumulators", {}))
+            operator_state.restore(payload.get("operator_state", {}))
             steps_at_ckpt = metrics.steps
 
         def write_savepoint(path: str) -> str:
@@ -2298,6 +2303,7 @@ class LocalExecutor:
                 "max_parallelism": env.max_parallelism,
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
                 "accumulators": accumulators.snapshot(),
+                "operator_state": operator_state.snapshot(),
             })
 
         self._savepoint_writer = write_savepoint

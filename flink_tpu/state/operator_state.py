@@ -1,0 +1,63 @@
+"""Non-keyed operator state — the OperatorStateStore analog (SURVEY §2.4,
+ref api/common/state/OperatorStateStore + DefaultOperatorStateBackend):
+per-OPERATOR (not per-key) list state that snapshots into checkpoints and
+restores on recovery. The reference's redistribution modes collapse in
+the single-host plan: SPLIT_DISTRIBUTE and UNION both restore the full
+list to the one operator instance (documented divergence — with one
+subtask they are the same thing).
+
+User functions reach it through RuntimeContext.get_operator_list_state;
+objects stay LIVE across checkpoint/restore (contents are swapped in
+place, the same contract as accumulators)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List
+
+
+class OperatorListState:
+    """ref ListState under the operator (non-keyed) backend."""
+
+    def __init__(self):
+        self._items: List[Any] = []
+
+    def get(self) -> List[Any]:
+        return list(self._items)
+
+    def add(self, value):
+        self._items.append(value)
+
+    def update(self, values):
+        self._items = list(values)
+
+    def clear(self):
+        self._items.clear()
+
+    def __len__(self):
+        return len(self._items)
+
+
+class OperatorStateStore:
+    """Named operator states of one operator instance."""
+
+    def __init__(self):
+        self._states: Dict[str, OperatorListState] = {}
+
+    def get_list_state(self, name: str) -> OperatorListState:
+        return self._states.setdefault(name, OperatorListState())
+
+    # union-state alias: identical under a single subtask (see module doc)
+    get_union_list_state = get_list_state
+
+    def snapshot(self) -> Dict[str, List[Any]]:
+        return {n: copy.deepcopy(s._items) for n, s in self._states.items()}
+
+    def restore(self, snap: Dict[str, List[Any]]):
+        """In place: user functions hold live references to their state
+        objects, so contents are replaced rather than the objects."""
+        for n, items in snap.items():
+            self.get_list_state(n)._items = list(items)
+        for n, s in self._states.items():
+            if n not in snap:
+                s._items.clear()
