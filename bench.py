@@ -18,6 +18,7 @@ Prints ONE JSON line:
 import argparse
 import json
 import os
+import platform
 import subprocess
 import sys
 import time
@@ -28,6 +29,12 @@ N_KEYS = 1_000_000
 WINDOW_MS = 5_000
 EVENTS_PER_MS = 2_000          # event-time rate: 10M events per 5s window
 BATCH = 262_144
+# candidate micro-batch sizes for the on-TPU calibration sweep: a larger
+# batch amortizes the fixed per-step dispatch round trip of the tunneled
+# runtime; the sweep measures instead of guessing
+SWEEP = (262_144, 524_288, 1_048_576)
+PIN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE_PIN.json")
 
 
 def gen_batch(offset, n):
@@ -153,8 +160,53 @@ def _weighted_pct(samples, q):
     return weighted_percentile(samples, q)
 
 
+# ---------------------------------------------------------------- pinning
+def pin_baseline(n_runs: int, events: int):
+    """Measure the baseline n_runs times on a quiet host and pin the BEST
+    run (throughput and its fire latencies) to BASELINE_PIN.json.
+
+    VERDICT r3 item 3: the co-measured baseline swings ~7x with host load,
+    so ratios against it are not defensible. The pinned number is the
+    baseline's best case — every future ratio quoted against it is
+    conservative. Protocol recorded in the artifact itself."""
+    runs = []
+    for i in range(n_runs):
+        eps, lat = run_baseline(events)
+        runs.append({
+            "events_per_s": round(eps),
+            "fire_p50_ms": round(_weighted_pct(lat, 50) or 0, 2),
+            "fire_p99_ms": round(_weighted_pct(lat, 99) or 0, 2),
+        })
+        print(f"pin run {i + 1}/{n_runs}: {eps:,.0f} events/s",
+              file=sys.stderr)
+    best = max(runs, key=lambda r: r["events_per_s"])
+    pin = {
+        "baseline_events_per_s": best["events_per_s"],
+        "baseline_fire_p50_ms": best["fire_p50_ms"],
+        "baseline_fire_p99_ms": best["fire_p99_ms"],
+        "protocol": {
+            "runs": n_runs, "pick": "best-of-N throughput",
+            "events": events, "batch": BATCH,
+            "host": platform.node(), "python": platform.python_version(),
+            "pinned_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        },
+        "all_runs": runs,
+    }
+    with open(PIN_PATH, "w") as f:
+        json.dump(pin, f, indent=1)
+    print(json.dumps(pin["all_runs"]), file=sys.stderr)
+    print(f"pinned best-of-{n_runs} -> {PIN_PATH}", file=sys.stderr)
+
+
+def load_pin():
+    if not os.path.exists(PIN_PATH):
+        return None
+    with open(PIN_PATH) as f:
+        return json.load(f)
+
+
 # ---------------------------------------------------------------- subject
-def run_subject(total_events: int, warmup_events: int) -> tuple:
+def run_subject(total_events: int, warmup_events: int, batch: int = None) -> tuple:
     import jax
 
     from flink_tpu import StreamExecutionEnvironment
@@ -183,7 +235,7 @@ def run_subject(total_events: int, warmup_events: int) -> tuple:
     # the layout a user tuning this job would pick, like choosing the
     # heap vs RocksDB backend in the reference
     env.set_state_capacity(N_KEYS)
-    env.batch_size = BATCH
+    env.batch_size = batch or BATCH
 
     sink = CountingSink()
 
@@ -221,10 +273,23 @@ def main():
                     help="micro-batch size (default BATCH)")
     ap.add_argument("--init-deadline", type=float, default=480.0,
                     help="seconds to keep retrying backend init")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the batch-size calibration sweep")
+    ap.add_argument("--pin-baseline", type=int, default=0, metavar="N",
+                    help="measure the baseline N times on this (quiet) "
+                         "host, write best-of-N to BASELINE_PIN.json, exit")
     args = ap.parse_args()
+    global BATCH
     if args.batch:
-        global BATCH
         BATCH = args.batch
+
+    if args.pin_baseline:
+        pin_baseline(args.pin_baseline, args.baseline_events)
+        return
+
+    # persistent XLA compilation cache: repeat bench runs (and the batch
+    # sweep's final run) skip the ~20-40s compiles
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache")
 
     def fail(msg: str):
         # Still emit the one structured JSON line so the driver records a
@@ -266,10 +331,39 @@ def main():
         file=sys.stderr,
     )
 
+    # -- batch-size calibration sweep (TPU only; CPU smoke is compile-
+    # dominated and would mis-calibrate): short steady-state run per
+    # candidate, full run at the winner. Events scale with the batch so
+    # every candidate measures the same ~18 steady steps.
+    sweep_rows = {}
+    if not args.cpu and args.batch is None and not args.no_sweep:
+        for cand in SWEEP:
+            try:
+                # warmup in STEPS, not events: compile + any adaptive
+                # tiering settle per step (~25 steps), so each candidate
+                # must be measured in the same post-settle regime
+                eps_c, job_c, _ = run_subject(
+                    43 * cand, 25 * cand, batch=cand
+                )
+            except Exception as e:  # noqa: BLE001 — sweep is best-effort
+                print(f"sweep batch={cand} failed: {e}", file=sys.stderr)
+                continue
+            sweep_rows[cand] = round(eps_c)
+            print(
+                f"sweep batch={cand}: {eps_c:,.0f} events/s "
+                f"(p99 fire {fmt(job_c.metrics.fire_latency_pct(99))})",
+                file=sys.stderr,
+            )
+        if sweep_rows:
+            BATCH = max(sweep_rows, key=sweep_rows.get)
+            print(f"sweep winner: batch={BATCH}", file=sys.stderr)
+
     # warmup covers backend init + cold-start key inserts + the adaptive
-    # switch to the lookup-only fast tier (~25 steps); steady-state
-    # throughput is what the metric claims
-    warmup = min(args.events // 3, 8_000_000)
+    # switch to the lookup-only fast tier. The tier switch is STEP-count
+    # driven (~25 steps: MON_EVERY x TIER_QUIET_CHECKS sampling), so the
+    # warmup floor scales with the batch size the sweep picked;
+    # steady-state throughput is what the metric claims
+    warmup = min(max(args.events // 3, 25 * BATCH), args.events // 2)
     try:
         subject_eps, job, sink = run_subject(args.events, warmup)
     except Exception as e:  # noqa: BLE001 — one JSON line even on crash
@@ -287,17 +381,31 @@ def main():
         file=sys.stderr,
     )
 
-    print(json.dumps({
+    # ratio policy (VERDICT r3 item 3): quote against the PINNED quiet-host
+    # best-of-N baseline when one exists — conservative and reproducible —
+    # and carry the co-measured ratio alongside for context
+    pin = load_pin()
+    pinned_eps = pin["baseline_events_per_s"] if pin else None
+    primary = pinned_eps or baseline_eps
+    out = {
         "metric": "events/sec/chip, 1M-key 5s tumbling-window sum",
         "value": round(subject_eps),
         "unit": "events/s",
-        "vs_baseline": round(subject_eps / baseline_eps, 2),
+        "vs_baseline": round(subject_eps / primary, 2),
+        "baseline_source": "pinned-best-of-N" if pin else "co-measured",
+        "vs_baseline_comeasured": round(subject_eps / baseline_eps, 2),
         "p99_fire_ms": rnd(subj_p99),
         "p50_fire_ms": rnd(subj_p50),
         "baseline_p99_fire_ms": rnd(base_p99),
         "baseline_p50_fire_ms": rnd(base_p50),
         "batch": BATCH,
-    }))
+    }
+    if pin:
+        out["baseline_pinned_events_per_s"] = pinned_eps
+        out["baseline_pinned_p99_fire_ms"] = pin["baseline_fire_p99_ms"]
+    if sweep_rows:
+        out["sweep"] = {str(k): v for k, v in sweep_rows.items()}
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

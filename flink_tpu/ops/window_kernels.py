@@ -634,6 +634,45 @@ class CompactFires:
         return cls(*children)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class ReducedFires:
+    """Fire output reduced ON DEVICE to per-lane scalars — the drain path
+    for device_reduce-capable sinks (runtime/sinks.py). Nothing O(C) is
+    packed or transferred: the host reads five [Ft]-sized fields and the
+    drain is done. Compared to CompactFires this skips the 3 full-capacity
+    pack scatters per lane that dominate the fire step's cost (the
+    reference's timer drain materializes every (key, window, value) triple;
+    a counting/aggregating sink never needs them —
+    ref WindowOperator.java:222 emit path).
+    """
+
+    counts: jax.Array            # int32 [Ft] fired keys per lane
+    window_end_ticks: jax.Array  # int32 [Ft]
+    n_fires: jax.Array           # int32 scalar: valid lanes
+    lane_valid: jax.Array        # bool [Ft]
+    value_sums: jax.Array        # float32 [Ft]
+
+    def tree_flatten(self):
+        return (self.counts, self.window_end_ticks, self.n_fires,
+                self.lane_valid, self.value_sums), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def reduce_fires(fr: FireResult) -> ReducedFires:
+    """Reduce a dense FireResult to per-lane (count, value-sum) scalars."""
+    counts = jnp.sum(fr.mask, axis=1, dtype=jnp.int32)          # [Ft]
+    masked = jnp.where(_expand(fr.mask, fr.values), fr.values, 0)
+    vsums = jnp.sum(
+        masked.reshape(masked.shape[0], -1), axis=1
+    ).astype(jnp.float32)                                        # [Ft]
+    return ReducedFires(counts, fr.window_end_ticks, fr.n_fires,
+                        fr.lane_valid, vsums)
+
+
 def compact_fires(table: SlotTable, fr: FireResult) -> CompactFires:
     """Pack a dense FireResult into per-lane prefix buffers on device.
 

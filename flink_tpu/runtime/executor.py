@@ -21,7 +21,7 @@ import queue
 import sys
 import threading
 import time
-from collections import namedtuple
+from collections import deque, namedtuple
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -36,6 +36,7 @@ from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_compact_step,
+    build_window_fire_reduced_step,
     build_window_fire_step,
     build_window_update_step,
     build_window_update_step_exchange,
@@ -590,6 +591,7 @@ class LocalExecutor:
         exchange_cap = [0]        # per-(src,dst) bucket lanes of the exchange
         force_route = [None]      # warmup override
         fire_step = None
+        fire_reduced_step = None   # ReducedFires variant (device_reduce sinks)
         state = None
         # key-state layout, decided ONCE (the compiled steps bake it in):
         # "hash" | "direct" | "auto" (resolved from the first batch's key
@@ -632,7 +634,7 @@ class LocalExecutor:
         )
 
         def setup(origin_ms: int, fresh_state: bool = True):
-            nonlocal td, win, spec, fire_step, state
+            nonlocal td, win, spec, fire_step, fire_reduced_step, state
             td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
             ring = env.config.get_int("window.ring-panes", 0) or max(
                 8,
@@ -757,6 +759,13 @@ class LocalExecutor:
                     }
                     exchange_cap[0] = ex_insert.bucket_cap
                 fire_step = build_window_fire_step(ctx, spec)
+                if sink_device_reduce:
+                    # a second compiled fire variant with NO key/value
+                    # packing; the drain picks per-iteration (the spill
+                    # tier may appear mid-job, forcing the full variant)
+                    fire_reduced_step = build_window_fire_reduced_step(
+                        ctx, spec
+                    )
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
                 # trigger ALL compiles NOW (inside any benchmark warmup)
@@ -784,6 +793,9 @@ class LocalExecutor:
                 metrics.steps_exchanged = ex0
                 cf = run_fire(None)
                 jax.block_until_ready(cf.counts)
+                if fire_reduced_step is not None:
+                    rf = run_fire(None, reduced=True)
+                    jax.block_until_ready(rf.counts)
 
         # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
         storage = None
@@ -1130,6 +1142,18 @@ class LocalExecutor:
         phase_acc = {"dispatch": 0.0, "emit": 0.0}
         last_ingest_t = [None]
 
+        # Bounded step pipelining: async dispatch lets the host run ahead
+        # of the device, but an UNBOUNDED queue means a pane-boundary fire
+        # — and therefore every fired window's latency — waits behind the
+        # whole backlog (the round-3 p99 was ~3x the reference drain's for
+        # exactly this reason). Keep at most `max_inflight` update steps
+        # in flight by waiting on the tiny monitoring handle from
+        # `max_inflight` steps back before dispatching further: the wait
+        # overlaps with the queued steps, costs nothing while the device
+        # keeps up, and caps the fire wait at ~max_inflight step times.
+        inflight = deque()
+        max_inflight = env.config.get_int("pipeline.max-inflight-steps", 4)
+
         # precomputed for the per-batch adaptive route choice
         _kg_ends = np.asarray(ctx.kg_bounds()[1])
 
@@ -1200,7 +1224,12 @@ class LocalExecutor:
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
             # dispatch normally returns immediately; it BLOCKS when the
-            # device pipeline is saturated -> the device-bound signal
+            # device pipeline is saturated -> the device-bound signal.
+            # The depth-cap wait below is part of the same device-bound
+            # attribution: it only takes time when the device lags.
+            inflight.append(act_handle)
+            if len(inflight) > max_inflight:
+                inflight.popleft().block_until_ready()
             phase_acc["dispatch"] += time.perf_counter() - t_d0
             metrics.steps += 1
             if tier == "fast":
@@ -1220,7 +1249,7 @@ class LocalExecutor:
                     mon_watch.append((ovf_handle, act_handle))
                     check_overflow_pressure()
 
-        def run_fire(wm_ms):
+        def run_fire(wm_ms, reduced: bool = False):
             nonlocal state
             wm_ticks = (
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
@@ -1229,7 +1258,8 @@ class LocalExecutor:
             wmv = np.full((ctx.n_shards,), np.int32(   # numpy: see run_update
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
-            state, cf = fire_step(state, wmv)
+            active = fire_reduced_step if reduced else fire_step
+            state, cf = active(state, wmv)
             return cf
 
         # -- spill tier: overflow-ring drain + host pane stores ------------
@@ -1470,23 +1500,20 @@ class LocalExecutor:
                 v2 = np.concatenate([v2] + add_val)
             return khi, klo, end_ms, v2.reshape((len(v2),) + v.shape[1:])
 
-        def emit_fires(cf):
-            """Emit one CompactFires: read the small per-lane fields, then
-            transfer only [:count] slices of the device-packed key/value
-            buffers (no dense masks, no key-table transfer). Spill-tier
-            contributions merge in BEFORE any result projection.
+        def emit_fires(cf, counts, lanes, ends, vsums, reduced):
+            """Emit one fire result. `counts/lanes/ends/vsums` are the
+            already-fetched small per-lane fields (ONE batched d2h in
+            drain_fires — a cold read costs ~70ms fixed on this runtime,
+            so the drain never pays it twice per iteration).
 
-            When every sink is device_reduce-capable (and no spill tier /
-            result projection is in play), the drain completes from the
-            small fields alone: per-lane value sums were reduced on-chip
-            inside compact_fires, so NOTHING O(fires) crosses the
-            device->host link (~25MB/s on this runtime — the dominant
-            drain cost otherwise)."""
-            counts, lanes, ends, vsums = jax.device_get(
-                (cf.counts, cf.lane_valid, cf.window_end_ticks,
-                 cf.value_sums)
-            )
-            if sink_device_reduce and not ovf_stores:
+            reduced=True: cf is a wk.ReducedFires — per-lane scalars were
+            reduced on-chip, the drain completes from the small fields
+            alone and NOTHING O(fires) exists on device, let alone crosses
+            the ~25MB/s device->host link. Otherwise cf is a CompactFires
+            and only [:count] slices of the device-packed key/value
+            buffers transfer. Spill-tier contributions merge in BEFORE any
+            result projection."""
+            if reduced or (sink_device_reduce and not ovf_stores):
                 n = int((counts * lanes).sum())
                 if n == 0:
                     return 0
@@ -1578,13 +1605,22 @@ class LocalExecutor:
                       file=sys.stderr)
             total = 0
             F = win.fires_per_step
+            # spill-tier presence is fixed for the whole drain
+            # (drain_overflow above was its only producer), so the choice
+            # of fire variant is loop-invariant
+            use_reduced = fire_reduced_step is not None and not ovf_stores
             while True:
                 t_f0 = time.perf_counter()
-                cf = run_fire(wm_ms)
-                lanes = np.asarray(cf.lane_valid)   # [S, Ft]
+                cf = run_fire(wm_ms, reduced=use_reduced)
+                # ONE batched fetch of all small per-lane fields
+                counts, lanes, ends, vsums = jax.device_get(
+                    (cf.counts, cf.lane_valid, cf.window_end_ticks,
+                     cf.value_sums)
+                )
                 t_f1 = time.perf_counter()
                 fires_before = metrics.fires
-                n_emit = emit_fires(cf)
+                n_emit = emit_fires(cf, counts, lanes, ends, vsums,
+                                    use_reduced)
                 if dbg:
                     print(f"[drain] fire+lanes={1e3*(t_f1-t_f0):.0f}ms "
                           f"emit={1e3*(time.perf_counter()-t_f1):.0f}ms "
@@ -1631,15 +1667,18 @@ class LocalExecutor:
             b = max(wm_ticks, -(2**31) + 1 + slide_ms)
             return (b + 1 - slide_ms) // slide_ms   # floor div, as on device
 
-        def poll_cycle():
-            nonlocal td, host_fired_pane
-            self._poll_control()
-            t_c0 = time.perf_counter()
-            phase_acc["dispatch"] = phase_acc["emit"] = 0.0
+        def prep_batch():
+            """Front half of a cycle: source poll + host chain + key/value/
+            timestamp encode. Pure host numpy with no dependence on mutable
+            executor state (watermarks, time domain, device handles), so
+            the prefetch thread can run it strictly ahead of the apply
+            half — the encode of batch k+1 overlaps the device step of
+            batch k instead of serializing with it."""
             polled, end = pipe.source.poll(B)
             t_src = time.perf_counter()
             now_ms = int(time.time() * 1000)
-            hi = lo = ticks = values = None
+            hi = lo = values = None
+            ts_ms = None
             n = 0
             if pipe.source.columnar and isinstance(polled, tuple):
                 cols, ts_ms = polled
@@ -1692,12 +1731,90 @@ class LocalExecutor:
                         )
                     else:
                         ts_ms = np.full(n, now_ms, np.int64)
-                else:
-                    ts_ms = None
+            return dict(end=end, n=n, hi=hi, lo=lo, values=values,
+                        ts_ms=ts_ms, now_ms=now_ms, t_src=t_src)
+
+        # -- prefetch: double-buffer the prep half on a worker thread ------
+        # Gated off whenever checkpointing is on: offsets snapshot at the
+        # consume point (write_checkpoint -> source.snapshot_offsets), and
+        # a polled-ahead batch would make a checkpoint skip records on
+        # restore. The reference overlaps the same way structurally — its
+        # netty IO threads fill input buffers while the task thread
+        # processes (SURVEY §2.3); here one thread is enough because the
+        # prep half is vectorized numpy, not per-record work.
+        prefetch_cfg = env.config.get_str("pipeline.prefetch", "auto")
+        if prefetch_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.prefetch must be auto|on|off, got {prefetch_cfg!r}"
+            )
+        if prefetch_cfg == "on" and storage is not None:
+            raise ValueError(
+                "pipeline.prefetch=on is incompatible with checkpointing: "
+                "the prefetch thread polls the source ahead of the applied "
+                "state, so offset snapshots would skip records on restore"
+            )
+        use_prefetch = prefetch_cfg != "off" and storage is None
+        prefetch_q: queue.Queue = queue.Queue(maxsize=2)
+        prefetch_stop = threading.Event()
+        prefetch_thread = [None]
+
+        def _prefetch_main():
+            try:
+                while not prefetch_stop.is_set():
+                    item = prep_batch()
+                    while not prefetch_stop.is_set():
+                        try:
+                            prefetch_q.put(("ok", item), timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if item["end"]:
+                        return
+            except BaseException as e:  # deliver to the consuming thread
+                # same stop-checking retry as the ok path: a consumer can
+                # legitimately stall for seconds in a pane-boundary drain,
+                # and a dropped error would leave it blocked on get()
+                # forever with no producer alive
+                while not prefetch_stop.is_set():
+                    try:
+                        prefetch_q.put(("err", e), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+
+        def next_batch():
+            if not use_prefetch:
+                return prep_batch()
+            if prefetch_thread[0] is None:
+                t = threading.Thread(
+                    target=_prefetch_main, daemon=True,
+                    name="flink-tpu-prefetch",
+                )
+                prefetch_thread[0] = t
+                t.start()
+            kind, item = prefetch_q.get()
+            if kind == "err":
+                raise item
+            return item
+
+        def poll_cycle():
+            nonlocal td, host_fired_pane
+            self._poll_control()
+            t_c0 = time.perf_counter()
+            phase_acc["dispatch"] = phase_acc["emit"] = 0.0
+            pb = next_batch()
+            # attribution: with prefetch on, "source" time is only the
+            # wait for the prep thread (~0 while it keeps ahead)
+            t_src = time.perf_counter()
+            end, n = pb["end"], pb["n"]
+            hi, lo, values, ts_ms = (pb["hi"], pb["lo"], pb["values"],
+                                     pb["ts_ms"])
+            now_ms = pb["now_ms"]
+            ticks = None
 
             metrics.records_in += n
             if n:
-                last_ingest_t[0] = t_src
+                last_ingest_t[0] = pb["t_src"]
                 if td is None:
                     # auto-layout hint: bounded non-negative int keys (the
                     # identity fits hi==0, lo < capacity on the first
@@ -1841,6 +1958,7 @@ class LocalExecutor:
                     restore_checkpoint(storage)
         finally:
             job_live.clear()
+            prefetch_stop.set()
             drain_kv_mailbox()
 
         if state is not None:

@@ -1,0 +1,105 @@
+"""Round-4 drain/pipeline fast paths stay semantics-preserving:
+
+* the ReducedFires drain (device-reduced fire step, no key/value packing)
+  produces the same totals as the full CompactFires drain,
+* the prep-half prefetch thread (pipeline.prefetch) changes no results,
+* the bounded in-flight step depth (pipeline.max-inflight-steps) changes
+  no results.
+
+Mirrors the reference's approach of testing the WindowOperator emission
+path against per-record expectations (SURVEY §4; WindowOperatorTest).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink, CountingSink
+from flink_tpu.runtime.sources import GeneratorSource
+
+B, N_KEYS, TOTAL, TS_DIV, WIN = 256, 300, 256 * 40, 64, 40
+
+
+def _gen(offset, n):
+    idx = np.arange(offset, offset + n, dtype=np.int64)
+    keys = (idx * 7) % N_KEYS
+    return {"key": keys, "value": np.ones(n, np.float32)}, idx // TS_DIV
+
+
+def _run(sink, **cfg):
+    env = StreamExecutionEnvironment(Configuration(cfg))
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(N_KEYS)
+    env.batch_size = B
+    (
+        env.add_source(GeneratorSource(_gen, total=TOTAL))
+        .key_by(lambda c: c["key"])
+        .time_window(WIN)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    return env.execute("fast-drain")
+
+
+def _expected_windows():
+    exp = {}
+    for i in range(TOTAL):
+        k, w = (i * 7) % N_KEYS, ((i // TS_DIV) // WIN + 1) * WIN
+        exp[(k, w)] = exp.get((k, w), 0) + 1.0
+    return exp
+
+
+def test_reduced_drain_matches_full_drain():
+    exp = _expected_windows()
+    # CountingSink is device_reduce -> ReducedFires drain
+    counting = CountingSink()
+    job = _run(counting)
+    assert counting.count == len(exp)
+    assert counting.value_sum == sum(exp.values())
+    assert job.metrics.fires == len(exp)
+    # CollectSink is not device_reduce -> full CompactFires drain
+    collect = CollectSink()
+    _run(collect)
+    got = {}
+    for r in collect.results:
+        got[(r.key, r.window_end_ms)] = got.get((r.key, r.window_end_ms),
+                                                0) + r.value
+    assert got == exp
+
+
+@pytest.mark.parametrize("cfg", [
+    {"pipeline.prefetch": "off"},
+    {"pipeline.prefetch": "on"},
+    {"pipeline.max-inflight-steps": 1},
+])
+def test_pipeline_knobs_preserve_results(cfg):
+    sink = CountingSink()
+    _run(sink, **cfg)
+    exp = _expected_windows()
+    assert sink.count == len(exp)
+    assert sink.value_sum == sum(exp.values())
+
+
+def test_prefetch_on_with_checkpointing_rejected(tmp_path):
+    env = StreamExecutionEnvironment(
+        Configuration({"pipeline.prefetch": "on"})
+    )
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(N_KEYS)
+    env.batch_size = B
+    env.enable_checkpointing(interval_steps=5, directory=str(tmp_path))
+    (
+        env.add_source(GeneratorSource(_gen, total=TOTAL))
+        .key_by(lambda c: c["key"])
+        .time_window(WIN)
+        .sum(lambda c: c["value"])
+        .add_sink(CountingSink())
+    )
+    with pytest.raises(ValueError, match="prefetch"):
+        env.execute("prefetch-vs-ckpt")
