@@ -1,0 +1,497 @@
+"""Cross-host data plane: multi-host keyed windows over ONE global mesh.
+
+The reference's data fabric is every-TaskManager-shuffles-to-every-
+TaskManager over TCP (RecordWriter.java:82 feeding Netty subpartitions,
+TaskManager.scala:296 registration). The TPU-native redesign
+(docs/DCN_INGESTION.md) inverts it:
+
+  * each HOST ingests whatever its source partitions contain (any keys)
+    and feeds only its LOCAL devices — records cross the slow network
+    once, as ingestion bytes;
+  * ONE ``jax.lax.all_to_all`` over the global mesh routes every record
+    to the device owning its key group (parallel/exchange.py) — the
+    keyed shuffle rides the accelerator fabric (ICI on a pod; the
+    cross-process collective transport stands in for it here);
+  * control decisions ride the SAME collectives: the global watermark is
+    an on-device ``pmin`` of per-host watermarks, and loop termination is
+    an on-device conjunction of per-host "source exhausted" flags — so
+    every process executes an identical lockstep sequence of compiled
+    steps (the SPMD invariant), with no out-of-band consensus protocol.
+
+Worker processes join the mesh with ``jax.distributed.initialize``
+(the ``--coordinator`` seam the design doc specified); on CPU test
+meshes the collectives run over Gloo/TCP, which is exactly the DCN hop
+being modeled. Checkpoints are per-process shard snapshots written at a
+deterministic lockstep cadence, so a killed ensemble restarts from the
+latest cut that EVERY process completed (the reference's
+full-job-restart-from-checkpoint failure model, ExecutionGraph restart +
+CheckpointCoordinator.restoreLatestCheckpointedState).
+
+Run one worker:
+  python -m flink_tpu.runtime.dcn --coordinator H:P --num-processes N
+      --process-id K --builder pkg.mod:fn --out результат.npz
+      [--checkpoint-dir D --ckpt-every C --restore]
+
+``builder()`` returns a DCNJobSpec.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+MAX_TICKS = 2**31 - 4
+
+
+@dataclass
+class DCNJobSpec:
+    """One keyed tumbling-window aggregation fed from per-host partitions.
+
+    source_factory(process_id, num_processes) -> object with
+        poll(max_records) -> (keys int64[n], ts_ms int64[n],
+                              values float32[n], exhausted bool)
+        snapshot() -> json-able offset state
+        restore(state)
+    (the per-host slice of the partitioned-consumer contract,
+    connectors/partitioned.py / FlinkKafkaConsumerBase.java:65).
+    """
+
+    source_factory: Callable
+    size_ms: int
+    capacity_per_shard: int
+    max_parallelism: int = 128
+    batch_per_host: int = 4096
+    fires_per_step: int = 4
+    out_of_orderness_ms: int = 0
+    reduce_kind: str = "sum"
+
+
+class GeneratorPartitionSource:
+    """fn(offset, n) -> (keys, ts_ms, values) up to ``total`` records —
+    the replayable test/bench partition (deterministic fetch, so offset
+    restore gives exactly-once replay)."""
+
+    def __init__(self, fn, total: int):
+        self.fn = fn
+        self.total = total
+        self.offset = 0
+
+    def poll(self, max_records):
+        n = min(max_records, self.total - self.offset)
+        if n <= 0:
+            e = np.zeros(0, np.int64)
+            return e, e, np.zeros(0, np.float32), True
+        keys, ts, vals = self.fn(self.offset, n)
+        self.offset += n
+        return (np.asarray(keys, np.int64), np.asarray(ts, np.int64),
+                np.asarray(vals, np.float32), self.offset >= self.total)
+
+    def snapshot(self):
+        return {"offset": self.offset}
+
+    def restore(self, state):
+        self.offset = int(state["offset"])
+
+
+class DCNWindowRunner:
+    """One process's half of the lockstep multi-host window job."""
+
+    def __init__(self, spec: DCNJobSpec, process_id: int,
+                 num_processes: int,
+                 checkpoint_dir: Optional[str] = None,
+                 ckpt_every: int = 0, restore: bool = False):
+        import jax
+
+        self.spec = spec
+        self.pid = process_id
+        self.nproc = num_processes
+        self.ckpt_dir = checkpoint_dir
+        self.ckpt_every = ckpt_every
+        self.want_restore = restore
+        self.source = spec.source_factory(process_id, num_processes)
+        self.rows_key = []      # emitted (key_id, window_end_ms, value)
+        self.rows_end = []
+        self.rows_val = []
+        self._persisted_chunks = 0   # rows chunks already in a checkpoint
+        self.cycle = 0
+        self._next_cid = 1
+
+        from flink_tpu.parallel.mesh import MeshContext
+
+        self.n = len(jax.devices())
+        self.L = len(jax.local_devices())
+        if self.n != self.L * num_processes:
+            raise RuntimeError(
+                f"expected {self.L}x{num_processes} global devices, "
+                f"got {self.n}"
+            )
+        self.ctx = MeshContext.create(self.n, spec.max_parallelism)
+        # per-host lane budget, one equal slice per local device
+        self.B_local = max(self.L, (spec.batch_per_host // self.L) * self.L)
+        self._build_step()
+        self._init_state()
+
+    # -- compiled lockstep step -------------------------------------------
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from flink_tpu.ops import window_kernels as wk
+        from flink_tpu.parallel.exchange import bucket_capacity
+        from flink_tpu.parallel.mesh import SHARD_AXIS
+        from flink_tpu.runtime.step import (
+            WindowStageSpec,
+            exchange_update_shard,
+        )
+
+        spec = self.spec
+        n = self.n
+        maxp = spec.max_parallelism
+        ring = max(8, 2 * 1 + spec.out_of_orderness_ms // spec.size_ms + 4)
+        self.win = wk.WindowSpec(
+            size_ticks=spec.size_ms, slide_ticks=spec.size_ms,
+            ring=ring, fires_per_step=spec.fires_per_step,
+        )
+        self.red = wk.ReduceSpec(kind=spec.reduce_kind)
+        win, red = self.win, self.red
+        bpd = self.B_local // self.L    # lanes per device
+        cap = bucket_capacity(bpd, n, 2.0)
+        self.bucket_cap = cap
+        starts, ends = self.ctx.kg_bounds()
+        starts_j = jnp.asarray(starts)
+        ends_j = jnp.asarray(ends)
+        F = spec.fires_per_step
+        C = spec.capacity_per_shard
+        probe_len = 16
+        mesh = self.ctx.mesh
+
+        stage = WindowStageSpec(win=win, red=red, capacity_per_shard=C,
+                                probe_len=probe_len)
+
+        def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                       wm, done):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            kg_start, kg_end = kg_start[0], kg_end[0]
+            # global control values: decisions ride the same fabric as
+            # records, so every process sees identical results and the
+            # lockstep invariant holds by construction
+            gwm = jax.lax.pmin(wm[0], SHARD_AXIS)
+            gdone = jax.lax.pmin(done[0], SHARD_AXIS)
+            # the cross-host keyed shuffle: ONE all_to_all over the
+            # global mesh (RecordWriter.java:82 redesigned) — shared body
+            # with the single-host exchange step (runtime/step.py)
+            state, _ = exchange_update_shard(
+                state, stage, kg_start, kg_end, hi, lo, ts, values, valid,
+                n, maxp, cap,
+            )
+            state, fr = wk.advance_and_fire(state, win, red, gwm)
+            cf = wk.compact_fires(state.table, fr)
+            # fire backlog: a full on-time lane set means more window ends
+            # may be due — every process must keep stepping
+            pending = (jnp.sum(fr.lane_valid[:F], dtype=jnp.int32)
+                       >= jnp.int32(F)).astype(jnp.int32)
+            gpending = jax.lax.pmax(pending, SHARD_AXIS)
+            stop = gdone * (1 - gpending)
+            pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            return pack(state), pack(cf), stop, gwm
+
+        sharded = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                # batch lanes are SPLIT over the global mesh: each host's
+                # records sit on its local devices only
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+            ),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
+            check_vma=False,
+        )
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, hi, lo, ts, values, valid, wm, done):
+            return sharded(state, starts_j, ends_j, hi, lo, ts, values,
+                           valid, wm, done)
+
+        self._step = step
+
+        def sharded_init():
+            st = wk.init_state(C, probe_len, win, red)
+            return jax.tree_util.tree_map(lambda x: x[None], st)
+
+        self._init_fn = jax.jit(shard_map(
+            sharded_init, mesh=mesh, in_specs=(),
+            out_specs=P(SHARD_AXIS), check_vma=False,
+        ))
+        self._lane_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+
+    def _init_state(self):
+        self.state = self._init_fn()
+        self.local_wm_ticks = -(2**31) + 1
+        if self.want_restore and self.ckpt_dir:
+            self._restore_latest()
+
+    # -- host loop ---------------------------------------------------------
+    def _global(self, local: np.ndarray):
+        """Assemble a global [nproc*B_local] mesh-sharded array from this
+        process's local lanes (jax.make_array_from_process_local_data:
+        the host→local-device feed of the ingestion design)."""
+        import jax
+
+        return jax.make_array_from_process_local_data(
+            self._lane_sharding, local
+        )
+
+    def run(self) -> dict:
+        from flink_tpu.ops.hashing import key_identity64
+
+        spec = self.spec
+        B = self.B_local
+        exhausted = False
+        while True:
+            if not exhausted:
+                keys, ts_ms, vals, exhausted = self.source.poll(B)
+            else:
+                keys = np.zeros(0, np.int64)
+                ts_ms = np.zeros(0, np.int64)
+                vals = np.zeros(0, np.float32)
+            m = len(keys)
+            h = key_identity64(keys) if m else np.zeros(0, np.uint64)
+            hi = np.zeros(B, np.uint32)
+            lo = np.zeros(B, np.uint32)
+            hi[:m] = (h >> np.uint64(32)).astype(np.uint32)
+            lo[:m] = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            ts = np.zeros(B, np.int32)
+            ts[:m] = np.minimum(ts_ms, MAX_TICKS).astype(np.int32)
+            values = np.zeros(B, np.float32)
+            values[:m] = vals
+            valid = np.zeros(B, bool)
+            valid[:m] = True
+            if m:
+                # clamp like ts above: an epoch-ms timestamp exceeds int32
+                self.local_wm_ticks = min(max(
+                    self.local_wm_ticks,
+                    int(ts_ms.max()) - spec.out_of_orderness_ms - 1,
+                ), MAX_TICKS)
+            wm_now = MAX_TICKS if exhausted else self.local_wm_ticks
+            wm = np.full(self.L, np.int32(wm_now))
+            done = np.full(self.L, np.int32(1 if exhausted else 0))
+
+            self.state, cf, stop, _gwm = self._step(
+                self.state, self._global(hi), self._global(lo),
+                self._global(ts), self._global(values), self._global(valid),
+                self._global(wm), self._global(done),
+            )
+            self._emit_local(cf)
+            self.cycle += 1
+            # NO exhausted gate: with unequal partitions one host drains
+            # early, and gating on the local flag would leave the ensemble
+            # unable to ever complete another checkpoint (a drained
+            # source's offset snapshot is simply its final offset)
+            if self.ckpt_dir and self.ckpt_every and \
+                    self.cycle % self.ckpt_every == 0:
+                self._write_checkpoint()
+            if int(np.asarray(stop)) == 1:
+                break
+        return {
+            "key_id": (np.concatenate(self.rows_key)
+                       if self.rows_key else np.zeros(0, np.uint64)),
+            "window_end_ms": (np.concatenate(self.rows_end)
+                              if self.rows_end else np.zeros(0, np.int64)),
+            "value": (np.concatenate(self.rows_val)
+                      if self.rows_val else np.zeros(0, np.float32)),
+            "cycles": self.cycle,
+        }
+
+    def _emit_local(self, cf):
+        """Each process emits fires from ITS addressable shards only —
+        "records enter on host A, fire from host B" is literal: the keys
+        in these rows arrived via the all_to_all from whichever host
+        ingested them."""
+        for leaf_idx, (counts_sh, lanes_sh, ends_sh, khi_sh, klo_sh,
+                       vals_sh) in enumerate(zip(
+                cf.counts.addressable_shards, cf.lane_valid.addressable_shards,
+                cf.window_end_ticks.addressable_shards,
+                cf.key_hi.addressable_shards, cf.key_lo.addressable_shards,
+                cf.values.addressable_shards)):
+            counts = np.asarray(counts_sh.data)[0]
+            lanes = np.asarray(lanes_sh.data)[0]
+            ends = np.asarray(ends_sh.data)[0]
+            khi = None
+            for f in np.nonzero(lanes)[0]:
+                c = int(counts[f])
+                if c == 0:
+                    continue
+                if khi is None:
+                    khi = np.asarray(khi_sh.data)[0]
+                    klo = np.asarray(klo_sh.data)[0]
+                    vv = np.asarray(vals_sh.data)[0]
+                k64 = (khi[f, :c].astype(np.uint64) << np.uint64(32)) \
+                    | klo[f, :c].astype(np.uint64)
+                self.rows_key.append(k64)
+                self.rows_end.append(
+                    np.full(c, int(ends[f]), np.int64)
+                )
+                self.rows_val.append(vv[f, :c].astype(np.float32))
+
+    # -- checkpoint / restore ---------------------------------------------
+    # Deterministic lockstep cadence: every process reaches cycle k
+    # together, so "all P proc files for cid exist" is a consistent global
+    # cut (the step boundary IS the barrier, SURVEY §3.4).
+    def _write_checkpoint(self):
+        import jax
+
+        cid = self._next_cid
+        d = os.path.join(self.ckpt_dir, f"chk-{cid:06d}")
+        os.makedirs(d, exist_ok=True)
+        leaves, _ = jax.tree_util.tree_flatten(self.state)
+        arrs = {}
+        for i, leaf in enumerate(leaves):
+            shards = sorted(leaf.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            arrs[f"leaf{i}"] = np.concatenate(
+                [np.asarray(s.data) for s in shards], axis=0
+            )
+        # emission DELTA since the previous checkpoint: each checkpoint is
+        # O(new rows), and restore replays the deltas in cid order (the
+        # per-checkpoint sink-offset pattern of runtime/checkpoint.py)
+        dk = self.rows_key[self._persisted_chunks:]
+        de = self.rows_end[self._persisted_chunks:]
+        dv = self.rows_val[self._persisted_chunks:]
+        arrs["rows_key"] = (np.concatenate(dk) if dk
+                            else np.zeros(0, np.uint64))
+        arrs["rows_end"] = (np.concatenate(de) if de
+                            else np.zeros(0, np.int64))
+        arrs["rows_val"] = (np.concatenate(dv) if dv
+                            else np.zeros(0, np.float32))
+        tmpf = tempfile.NamedTemporaryFile(
+            dir=d, prefix=f"proc-{self.pid}.", suffix=".tmp", delete=False
+        )
+        np.savez(tmpf, **arrs)
+        tmpf.close()
+        os.replace(tmpf.name, os.path.join(d, f"proc-{self.pid}.npz"))
+        meta = {
+            "cycle": self.cycle,
+            "wm_ticks": self.local_wm_ticks,
+            "source": self.source.snapshot(),
+            "next_cid": cid + 1,
+        }
+        tmp = os.path.join(d, f"proc-{self.pid}.meta.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, os.path.join(d, f"proc-{self.pid}.meta.json"))
+        self._next_cid = cid + 1
+        self._persisted_chunks = len(self.rows_key)
+
+    def _latest_complete(self) -> Optional[str]:
+        if not os.path.isdir(self.ckpt_dir):
+            return None
+        best = None
+        for name in sorted(os.listdir(self.ckpt_dir)):
+            if not name.startswith("chk-"):
+                continue
+            d = os.path.join(self.ckpt_dir, name)
+            if all(
+                os.path.exists(os.path.join(d, f"proc-{p}.meta.json"))
+                for p in range(self.nproc)
+            ):
+                best = d
+        return best
+
+    def _restore_latest(self):
+        import jax
+
+        d = self._latest_complete()
+        if d is None:
+            return
+        with open(os.path.join(d, f"proc-{self.pid}.meta.json")) as f:
+            meta = json.load(f)
+        data = np.load(os.path.join(d, f"proc-{self.pid}.npz"))
+        leaves, treedef = jax.tree_util.tree_flatten(self.state)
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            new_leaves.append(jax.make_array_from_process_local_data(
+                leaf.sharding, data[f"leaf{i}"]
+            ))
+        self.state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        # emissions = concatenation of every delta up to (and including)
+        # the restored cut; deltas past it belong to a globally
+        # incomplete checkpoint and will be re-emitted by replay
+        self.rows_key, self.rows_end, self.rows_val = [], [], []
+        chosen = os.path.basename(d)
+        for name in sorted(os.listdir(self.ckpt_dir)):
+            if not name.startswith("chk-") or name > chosen:
+                continue
+            delta = np.load(os.path.join(
+                self.ckpt_dir, name, f"proc-{self.pid}.npz"
+            ))
+            if len(delta["rows_key"]):
+                self.rows_key.append(delta["rows_key"])
+                self.rows_end.append(delta["rows_end"])
+                self.rows_val.append(delta["rows_val"])
+        self._persisted_chunks = len(self.rows_key)
+        self.cycle = int(meta["cycle"])
+        self._next_cid = int(meta["next_cid"])
+        self.local_wm_ticks = int(meta["wm_ticks"])
+        self.source.restore(meta["source"])
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True, help="HOST:PORT")
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--builder", required=True,
+                    help="module:function returning a DCNJobSpec")
+    ap.add_argument("--out", required=True, help="result .npz path")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--ckpt-every", type=int, default=0)
+    ap.add_argument("--restore", action="store_true")
+    a = ap.parse_args(argv)
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    import jax
+
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    jax.distributed.initialize(
+        coordinator_address=a.coordinator,
+        num_processes=a.num_processes, process_id=a.process_id,
+    )
+    from flink_tpu.runtime.worker import load_builder
+
+    spec = load_builder(a.builder)()
+    runner = DCNWindowRunner(
+        spec, a.process_id, a.num_processes,
+        checkpoint_dir=a.checkpoint_dir or None,
+        ckpt_every=a.ckpt_every, restore=a.restore,
+    )
+    out = runner.run()
+    tmp = a.out + ".tmp"
+    with open(tmp, "wb") as f:    # file object: savez appends no suffix
+        np.savez(f, key_id=out["key_id"],
+                 window_end_ms=out["window_end_ms"], value=out["value"])
+    os.replace(tmp, a.out)
+    print(json.dumps({"rows": int(len(out["key_id"])),
+                      "cycles": out["cycles"], "pid": a.process_id}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

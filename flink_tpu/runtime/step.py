@@ -180,6 +180,38 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
     return update_step
 
 
+def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
+                          hi, lo, ts, values, valid, n: int, maxp: int,
+                          cap: int, insert: bool = True):
+    """Shared per-shard body: route this device's lane slice to owning
+    shards over the mesh all_to_all, mask to owned key groups, and apply
+    the window update. Used by the single-host exchange step and the
+    cross-host DCN runner (runtime/dcn.py) so the shuffle semantics
+    cannot diverge. Returns (state', activity) with bucket overflow
+    already counted into dropped_capacity."""
+    import dataclasses as _dc
+
+    from flink_tpu.parallel.exchange import exchange_records
+
+    if spec.pre is not None:
+        values, ts, valid = spec.pre(values, ts, valid)
+    cols, r_hi, r_lo, r_valid, n_over = exchange_records(
+        {"ts": ts, "values": values}, hi, lo, valid, n, maxp, cap
+    )
+    kg = assign_to_key_group(route_hash(r_hi, r_lo, jnp), maxp, jnp)
+    mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
+        kg <= kg_end.astype(jnp.uint32)
+    )
+    state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
+                                cols["ts"], cols["values"], mine,
+                                insert=insert,
+                                direct=spec.layout == "direct")
+    state = _dc.replace(
+        state, dropped_capacity=state.dropped_capacity + n_over
+    )
+    return state, activity
+
+
 def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
                                       batch_per_device: int,
                                       capacity_factor: float = 2.0,
@@ -196,7 +228,7 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
     counted into dropped_capacity — surfaced, never silent."""
     import dataclasses as _dc
 
-    from flink_tpu.parallel.exchange import bucket_capacity, exchange_records
+    from flink_tpu.parallel.exchange import bucket_capacity
 
     starts, ends = ctx.kg_bounds()
     starts = jnp.asarray(starts)
@@ -209,23 +241,12 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
     def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
-        if spec.pre is not None:
-            values, ts, valid = spec.pre(values, ts, valid)
-        cols, r_hi, r_lo, r_valid, n_over = exchange_records(
-            {"ts": ts, "values": values}, hi, lo, valid, n, maxp, cap
+        state, activity = exchange_update_shard(
+            state, spec, kg_start, kg_end, hi, lo, ts, values, valid,
+            n, maxp, cap, insert=insert,
         )
-        r_ts, r_values = cols["ts"], cols["values"]
-        kg = assign_to_key_group(route_hash(r_hi, r_lo, jnp), maxp, jnp)
-        mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
-            kg <= kg_end.astype(jnp.uint32)
-        )
-        state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
-                                    r_ts, r_values, mine, insert=insert,
-                                    direct=spec.layout == "direct")
         state = _dc.replace(
-            state,
-            watermark=jnp.maximum(state.watermark, wm[0]),
-            dropped_capacity=state.dropped_capacity + n_over,
+            state, watermark=jnp.maximum(state.watermark, wm[0])
         )
         ovf_n = state.ovf_n
         return (

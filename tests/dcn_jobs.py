@@ -1,0 +1,50 @@
+"""Builder functions for the cross-host data-plane tests (imported by the
+dcn worker subprocesses via --builder tests/dcn_jobs.py:NAME)."""
+
+import numpy as np
+
+from flink_tpu.runtime.dcn import DCNJobSpec, GeneratorPartitionSource
+
+N_KEYS = 977           # prime: keys spread over all key groups
+TOTAL_PER_HOST = 40_000
+WIN_MS = 1_000
+TS_DIV = 16            # ts advances 1ms per TS_DIV records
+
+
+def _source(pid, nproc):
+    # host p ingests ONLY keys congruent to p mod nproc — a genuinely
+    # DISJOINT key slice per host (key % nproc identifies the ingesting
+    # host), so any key firing on the other host provably crossed the
+    # process boundary through the all_to_all
+    per_host = N_KEYS // nproc
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        keys = pid + nproc * (idx % per_host)
+        ts = idx // TS_DIV
+        return keys, ts, np.ones(n, np.float32)
+
+    return GeneratorPartitionSource(gen, TOTAL_PER_HOST)
+
+
+def two_host_window():
+    return DCNJobSpec(
+        source_factory=_source,
+        size_ms=WIN_MS,
+        capacity_per_shard=2048,
+        max_parallelism=64,
+        batch_per_host=2048,
+        fires_per_step=4,
+    )
+
+
+def expected(nproc):
+    """Per-(key, window_end) expected sums across all hosts."""
+    per_host = N_KEYS // nproc
+    exp = {}
+    for pid in range(nproc):
+        for i in range(TOTAL_PER_HOST):
+            k = pid + nproc * (i % per_host)
+            w = ((i // TS_DIV) // WIN_MS + 1) * WIN_MS
+            exp[(k, w)] = exp.get((k, w), 0) + 1.0
+    return exp
