@@ -24,11 +24,14 @@ per-record NFA from the hot path entirely, the same way the window
 kernels removed HeapReducingState.add.
 
 Eligibility (executor falls back to the host operator otherwise):
-patterns without within() — per-partial start timestamps do not fit the
-count representation — in processing-time mode (arrival order; the
-event-time buffer-and-sort drain stays host-side), single logical shard.
-Checkpoint/savepoint/restore are fully supported (snapshot()/restore()
-below; the barrier is the step boundary).
+processing-time mode (arrival order; the event-time buffer-and-sort
+drain stays host-side), single logical shard. within() IS supported
+(round 4): partial counts are bucketed by start-time pane on device
+(cep/device.py ring rotation = expiry), semantics equal to the host NFA
+on pane-quantized timestamps (cep.device.within-buckets config, default
+8 buckets per within horizon). Checkpoint/savepoint/restore are fully
+supported (snapshot()/restore() below; the barrier is the step
+boundary).
 
 Memory note: a key's compacted events stay buffered while it has live
 partials that could still complete (exactly the events the reference's
@@ -116,9 +119,11 @@ class DeviceCepOperator:
     host replay extraction. One instance per job (single logical shard)."""
 
     def __init__(self, pattern: Pattern, capacity: int = 1 << 16,
-                 probe_len: int = 16):
+                 probe_len: int = 16, within_buckets: int = 8):
         self.pattern = pattern
-        self.spec = DevicePatternSpec.from_pattern(pattern)
+        self.spec = DevicePatternSpec.from_pattern(
+            pattern, within_buckets=within_buckets
+        )
         self.nfa = NFA(pattern)
         self.stages = pattern.stages
         self.codec = KeyCodec()
@@ -137,6 +142,9 @@ class DeviceCepOperator:
         self.matches_detected = 0      # device-side completions
         self.matches_extracted = 0     # host-replay match dicts
         self.steps = 0
+        # within(): panes rebase to the first batch's pane so epoch-ms
+        # timestamps fit the device's int32 pane arithmetic
+        self._pane_origin: Optional[int] = None
 
     @property
     def dropped_capacity(self) -> int:
@@ -156,6 +164,16 @@ class DeviceCepOperator:
         B = len(elements)
         if B == 0:
             return []
+        # within(): device pruning is pane-bucketed (device.py), so the
+        # host replay must see the SAME quantized timestamps or its exact
+        # within check could disagree with the device's count decisions
+        pane = 0
+        if self.spec.pane_ms:
+            pane = int(ts) // self.spec.pane_ms
+            ts = pane * self.spec.pane_ms
+            if self._pane_origin is None:
+                self._pane_origin = pane
+            pane -= self._pane_origin
         masks = self._masks(elements)
         hi, lo = self.codec.encode(list(keys), keep_reverse=False)
         hi = np.asarray(hi, np.uint32)
@@ -171,7 +189,7 @@ class DeviceCepOperator:
             masks = np.pad(masks, ((0, n - B), (0, 0)))
 
         self.state, delta, _total = self._advance(
-            self.state, self.spec, hi, lo, masks, valid
+            self.state, self.spec, hi, lo, masks, valid, np.int32(pane)
         )
         delta = np.asarray(delta)[:B]
         masks = masks[:B]
@@ -218,6 +236,11 @@ class DeviceCepOperator:
             "matches_extracted": self.matches_extracted,
             "steps": self.steps,
             "capacity": self.capacity,
+            "pane_origin": self._pane_origin,
+            # within() bucketing params: a restore under a different
+            # cep.device.within-buckets would reinterpret the ring
+            "pane_ms": self.spec.pane_ms,
+            "within_panes": self.spec.within_panes,
         }
 
     def restore(self, snap: dict):
@@ -228,6 +251,16 @@ class DeviceCepOperator:
                 f"device CEP capacity mismatch: snapshot {snap['capacity']} "
                 f"vs configured {self.capacity}"
             )
+        snap_pane = (snap.get("pane_ms", self.spec.pane_ms),
+                     snap.get("within_panes", self.spec.within_panes))
+        if snap_pane != (self.spec.pane_ms, self.spec.within_panes):
+            raise ValueError(
+                f"device CEP within() bucketing mismatch: snapshot used "
+                f"pane_ms={snap_pane[0]}, ring={snap_pane[1]} but the job "
+                f"is configured for pane_ms={self.spec.pane_ms}, ring="
+                f"{self.spec.within_panes} — restore with the same "
+                f"cep.device.within-buckets setting"
+            )
         self.state = jax.tree_util.tree_map(jnp.asarray, snap["device"])
         self.buffers = dict(snap["buffers"])
         self.partials = dict(snap["partials"])
@@ -235,6 +268,7 @@ class DeviceCepOperator:
         self.matches_detected = snap["matches_detected"]
         self.matches_extracted = snap["matches_extracted"]
         self.steps = snap["steps"]
+        self._pane_origin = snap.get("pane_origin")
 
     def peek_state(self, key):
         """Queryable-state read: this key's live partial matches, with

@@ -62,58 +62,94 @@ class DevicePatternSpec:
     """Static compile spec of a linear pattern for the device NFA.
 
     relaxed[s] — stage s's contiguity (relaxed=True for followedBy).
-    Built from a Pattern via `from_pattern`; patterns with within() are
-    rejected (host path handles them)."""
+
+    within() support (round 4): per-stage counts are BUCKETED by the
+    partial's START time pane — state becomes c_{s,q} over a ring of Q
+    panes of `pane_ms` each (the pane-ring trick of the window kernels
+    applied to NFA state). A partial keeps its start bucket as it
+    advances stages; expiry is the ring rotation zeroing a bucket column
+    when its pane slot is reused — no per-partial timestamps needed, and
+    the transition stays LINEAR, so the same segmented matrix scan runs.
+    Semantics are exactly the host NFA's (Pattern.java:141 window
+    pruning) on timestamps quantized to `pane_ms` buckets: with Q-1 =
+    within // pane_ms live panes, a partial advances iff
+    (pane(e) - pane(start)) * pane_ms <= within.
+
+    Q == 1 (no within) degenerates to the original flat representation:
+    one bucket, never rotated."""
 
     n_stages: int
     relaxed: Tuple[bool, ...]
+    within_panes: int = 1            # Q: ring size (1 = no within)
+    pane_ms: int = 0                 # bucket width (0 = no within)
 
     @staticmethod
-    def from_pattern(p: Pattern) -> "DevicePatternSpec":
-        if p.within_ms is not None:
-            raise ValueError(
-                "device CEP does not support within() — per-partial start "
-                "timestamps do not fit the count representation; use the "
-                "host NFA path"
-            )
+    def from_pattern(p: Pattern,
+                     within_buckets: int = 8) -> "DevicePatternSpec":
+        S = len(p.stages)
+        Q, pane_ms = 1, 0
+        # single-stage patterns complete on their first event (duration
+        # 0), so within() can never prune — keep the flat representation
+        if p.within_ms is not None and S > 1:
+            pane_ms = max(1, -(-p.within_ms // max(1, within_buckets)))
+            Q = p.within_ms // pane_ms + 1
         return DevicePatternSpec(
-            n_stages=len(p.stages),
+            n_stages=S,
             relaxed=tuple(s.contiguity == RELAXED for s in p.stages),
+            within_panes=Q,
+            pane_ms=pane_ms,
         )
 
     @property
     def dim(self) -> int:
-        # [c_0 .. c_{S-2}, M, 1]
-        return self.n_stages + 1
+        # [c_{0,0} .. c_{S-2,Q-1}, M, 1]
+        return (self.n_stages - 1) * self.within_panes + 2
 
 
-def event_matrices(spec: DevicePatternSpec, masks: jax.Array) -> jax.Array:
+def event_matrices(spec: DevicePatternSpec, masks: jax.Array,
+                   q_t=None) -> jax.Array:
     """masks: bool[B, S] stage-match bits per event -> T: f32[B, D, D].
 
     Row layout of v (column vector convention, v' = T @ v):
-      rows 0..S-2: stage counts; row S-1: M; row S: const 1.
+      rows s*Q+q (s in 0..S-2, q in 0..Q-1): stage-s partials whose
+      start fell in ring pane q; row D-2: M; row D-1: const 1.
+    A partial keeps its start bucket q as it advances stages; expired
+    buckets are zeroed by the ring rotation in advance(), so no aliveness
+    terms appear here. ``q_t`` (traced int32 scalar) is the current
+    batch's ring slot — new partials start there; None with Q == 1.
     """
     S = spec.n_stages
+    Q = spec.within_panes
     D = spec.dim
     B = masks.shape[0]
     m = masks.astype(jnp.float32)
     T = jnp.zeros((B, D, D), jnp.float32)
     # const row stays 1
     T = T.at[:, D - 1, D - 1].set(1.0)
-    # M row: M' = M + m_{S-1} * c_{S-2}   (S == 1: + m_0 * 1)
-    T = T.at[:, S - 1, S - 1].set(1.0)
+    # M row: M' = M (+ completion terms below)
+    T = T.at[:, D - 2, D - 2].set(1.0)
     if S == 1:
-        T = T.at[:, 0, D - 1].add(m[:, 0])
+        T = T.at[:, D - 2, D - 1].add(m[:, 0])   # instant completion
+        return T
+    # start bucket one-hot (Q == 1: always bucket 0)
+    if Q == 1:
+        start_hot = jnp.ones((1,), jnp.float32)
     else:
-        T = T.at[:, S - 1, S - 2].add(m[:, S - 1])
-        # stage rows
+        start_hot = (jnp.arange(Q, dtype=jnp.int32) == q_t).astype(
+            jnp.float32
+        )
+    for q in range(Q):
+        # completion: M += m_{S-1} * c_{S-2, q} (every live bucket)
+        T = T.at[:, D - 2, (S - 2) * Q + q].add(m[:, S - 1])
+        # start transition: c_{0, q_t} += m_0
+        T = T.at[:, 0 * Q + q, D - 1].add(m[:, 0] * start_hot[q])
         for s in range(S - 1):
             keep = 1.0 if spec.relaxed[s + 1] else 0.0
-            T = T.at[:, s, s].add(keep)
-            if s == 0:
-                T = T.at[:, 0, D - 1].add(m[:, 0])   # start transition
-            else:
-                T = T.at[:, s, s - 1].add(m[:, s])   # take into stage s
+            if keep:
+                T = T.at[:, s * Q + q, s * Q + q].add(keep)
+            if s > 0:
+                # take into stage s: the partial keeps its start bucket
+                T = T.at[:, s * Q + q, (s - 1) * Q + q].add(m[:, s])
     return T
 
 
@@ -127,15 +163,20 @@ def _seg_matmul(a, b):
     return sb, jnp.where(same, Mb @ Ma, Mb)
 
 
+PANE_NONE = np.int32(-(2**31) + 1)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class CepShardState:
     table: SlotTable
     carry: jax.Array          # f32 [C+1, D] per-key state vector (+1 spill row)
+    pane_ids: jax.Array       # int32 [Q]: absolute pane in each ring slot
     dropped_capacity: jax.Array
 
     def tree_flatten(self):
-        return (self.table, self.carry, self.dropped_capacity), None
+        return (self.table, self.carry, self.pane_ids,
+                self.dropped_capacity), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -150,6 +191,7 @@ def init_state(capacity: int, probe_len: int,
     return CepShardState(
         table=hashtable.create(capacity, probe_len),
         carry=carry,
+        pane_ids=jnp.full((spec.within_panes,), PANE_NONE, jnp.int32),
         dropped_capacity=jnp.zeros((), jnp.int32),
     )
 
@@ -161,15 +203,43 @@ def advance(
     lo: jax.Array,
     masks: jax.Array,     # bool [B, S]
     valid: jax.Array,     # bool [B]
+    pane=0,               # int32 scalar: this batch's absolute time pane
 ) -> Tuple[CepShardState, jax.Array, jax.Array]:
     """Advance every key's NFA by this micro-batch.
 
     Returns (state', match_delta f32[B], match_total_per_lane) where
     match_delta[i] = completed matches triggered exactly at lane i (in the
-    ORIGINAL lane order) — the host uses nonzero lanes for extraction."""
+    ORIGINAL lane order) — the host uses nonzero lanes for extraction.
+
+    ``pane`` = ts // spec.pane_ms (0 without within): partials are
+    bucketed by start pane, and rotation below IS the within() expiry —
+    a ring slot reused for a newer pane zeroes every key's counts for
+    partials started in the expired pane (window_kernels' stale sweep
+    applied to NFA state)."""
     B = hi.shape[0]
     C = state.table.capacity
     D = spec.dim
+    S = spec.n_stages
+    Q = spec.within_panes
+
+    # -- within() ring rotation: register this batch's pane coverage; any
+    # slot whose newest covered pane changed holds expired partials —
+    # zero that bucket's column across all keys and stages
+    pane = jnp.asarray(pane, jnp.int32)
+    carry = state.carry
+    if Q > 1:
+        r_idx = jnp.arange(Q, dtype=jnp.int32)
+        p_r = pane - jnp.mod(pane - r_idx, jnp.int32(Q))
+        stale = p_r != state.pane_ids                      # [Q]
+        col_stale = jnp.zeros(D, bool)
+        for s in range(S - 1):
+            col_stale = col_stale.at[s * Q:(s + 1) * Q].set(stale)
+        carry = jnp.where(col_stale[None, :], 0.0, carry)
+        pane_ids = p_r
+        q_t = jnp.mod(pane, jnp.int32(Q))
+    else:
+        pane_ids = state.pane_ids
+        q_t = None
 
     # 8 claim rounds: no spill tier here — see session_windows.py
     table, slot, ok = hashtable.upsert(state.table, hi, lo, valid,
@@ -183,14 +253,14 @@ def advance(
     seg_s = seg[order]
     masks_s = masks[order] & live[order, None]
 
-    T = event_matrices(spec, masks_s)
+    T = event_matrices(spec, masks_s, q_t)
     # invalid lanes: identity (no transition)
     eye = jnp.eye(D, dtype=jnp.float32)
     T = jnp.where(live[order][:, None, None], T, eye[None])
 
     _, P = jax.lax.associative_scan(_seg_matmul, (seg_s, T))
 
-    v0 = state.carry[seg_s]                       # [B, D] per-lane carry
+    v0 = carry[seg_s]                             # [B, D] per-lane carry
     v = jnp.einsum("bij,bj->bi", P, v0)
     v = jnp.minimum(v, INT_MAX)                   # saturate counts
 
@@ -206,7 +276,7 @@ def advance(
     # new carry = v of each segment's LAST lane, with M reset to 0
     is_last = jnp.concatenate([seg_s[1:] != seg_s[:-1], jnp.ones(1, bool)])
     v_out = v.at[:, D - 2].set(0.0)
-    carry = state.carry.at[jnp.where(is_last, seg_s, C + 0)].set(
+    carry = carry.at[jnp.where(is_last, seg_s, C + 0)].set(
         jnp.where(is_last[:, None], v_out, 0.0), mode="drop"
     )
     # spill row stays the neutral vector
@@ -219,6 +289,7 @@ def advance(
     new_state = CepShardState(
         table=table,
         carry=carry,
+        pane_ids=pane_ids,
         dropped_capacity=state.dropped_capacity + n_nofit,
     )
     return new_state, delta, jnp.sum(delta_s)

@@ -231,6 +231,12 @@ class MiniCluster:
             }
         if metrics:
             out["metrics"] = metrics
+        # non-numeric engine tag (gauges carry only scalars): which CEP
+        # engine ran — "device" count-NFA kernel or "host" NFA fallback
+        live = getattr(rec.env, "_live_metrics", None)
+        src = live or (rec.handle.metrics if rec.handle else None)
+        if src is not None and getattr(src, "cep_engine", ""):
+            out["cep-engine"] = src.cep_engine
         return out
 
     # -- control server (CliFrontend <-> JobManager channel) -------------

@@ -29,7 +29,7 @@ CheckpointCoordinator.restoreLatestCheckpointedState).
 
 Run one worker:
   python -m flink_tpu.runtime.dcn --coordinator H:P --num-processes N
-      --process-id K --builder pkg.mod:fn --out результат.npz
+      --process-id K --builder pkg.mod:fn --out result.npz
       [--checkpoint-dir D --ckpt-every C --restore]
 
 ``builder()`` returns a DCNJobSpec.
@@ -70,6 +70,11 @@ class DCNJobSpec:
     fires_per_step: int = 4
     out_of_orderness_ms: int = 0
     reduce_kind: str = "sum"
+    # epoch-ms timestamps exceed int32 ticks: the runner rebases every
+    # ts to this origin. A SPEC field (not derived from data) so all
+    # lockstep processes agree without coordination; set it to e.g. the
+    # job's start-of-day epoch ms for wall-clock sources.
+    origin_ms: int = 0
 
 
 class GeneratorPartitionSource:
@@ -273,16 +278,26 @@ class DCNWindowRunner:
             hi[:m] = (h >> np.uint64(32)).astype(np.uint32)
             lo[:m] = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             ts = np.zeros(B, np.int32)
-            ts[:m] = np.minimum(ts_ms, MAX_TICKS).astype(np.int32)
+            if m:
+                rts = np.asarray(ts_ms, np.int64) - spec.origin_ms
+                if int(rts.max()) > MAX_TICKS or int(rts.min()) < 0:
+                    # refuse rather than silently clamp (clamped records
+                    # would all collapse into the MAX_TICKS window)
+                    raise ValueError(
+                        f"timestamp {int(rts.max()) + spec.origin_ms} out "
+                        f"of int32 tick range relative to origin_ms="
+                        f"{spec.origin_ms}; set DCNJobSpec.origin_ms near "
+                        f"the stream's first timestamp"
+                    )
+                ts[:m] = rts.astype(np.int32)
             values = np.zeros(B, np.float32)
             values[:m] = vals
             valid = np.zeros(B, bool)
             valid[:m] = True
             if m:
-                # clamp like ts above: an epoch-ms timestamp exceeds int32
                 self.local_wm_ticks = min(max(
                     self.local_wm_ticks,
-                    int(ts_ms.max()) - spec.out_of_orderness_ms - 1,
+                    int(rts.max()) - spec.out_of_orderness_ms - 1,
                 ), MAX_TICKS)
             wm_now = MAX_TICKS if exhausted else self.local_wm_ticks
             wm = np.full(self.L, np.int32(wm_now))
@@ -340,9 +355,9 @@ class DCNWindowRunner:
                 k64 = (khi[f, :c].astype(np.uint64) << np.uint64(32)) \
                     | klo[f, :c].astype(np.uint64)
                 self.rows_key.append(k64)
-                self.rows_end.append(
-                    np.full(c, int(ends[f]), np.int64)
-                )
+                self.rows_end.append(np.full(
+                    c, int(ends[f]) + self.spec.origin_ms, np.int64
+                ))
                 self.rows_val.append(vv[f, :c].astype(np.float32))
 
     # -- checkpoint / restore ---------------------------------------------

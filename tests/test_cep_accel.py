@@ -354,7 +354,9 @@ def test_prune_keeps_live_relaxed_partials():
     assert len(ms) == 1 and ms[0]["a"].ts == 0
 
 
-def test_within_falls_back_to_host():
+def test_within_runs_on_device():
+    """Round 4: within() patterns take the device path (pane-bucketed
+    partial expiry); the engine that ran is surfaced in metrics."""
     env = StreamExecutionEnvironment.get_execution_environment()
     env.batch_size = 8
     env.set_parallelism(1)
@@ -366,8 +368,31 @@ def test_within_falls_back_to_host():
     )
     stream = env.from_collection(events).key_by(lambda e: e.value)
     CEP.pattern(stream, pattern).select(lambda m: 1).add_sink(sink)
-    job = env.execute("cep-within-host")
+    job = env.execute("cep-within-device")
+    assert job.metrics.cep_device_steps > 0
+    assert job.metrics.cep_engine == "device"
+    assert sink.results == [1]
+
+
+def test_device_engine_can_be_disabled():
+    from flink_tpu.core.config import Configuration
+
+    env = StreamExecutionEnvironment(
+        Configuration({"cep.device.enabled": False})
+    )
+    env.batch_size = 8
+    env.set_parallelism(1)
+    sink = CollectSink()
+    events = [Event(0, "a", 1), Event(1, "b", 1)]
+    pattern = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b").within(10)
+    )
+    stream = env.from_collection(events).key_by(lambda e: e.value)
+    CEP.pattern(stream, pattern).select(lambda m: 1).add_sink(sink)
+    job = env.execute("cep-within-host-forced")
     assert job.metrics.cep_device_steps == 0
+    assert job.metrics.cep_engine == "host"
     assert sink.results == [1]
 
 

@@ -127,13 +127,20 @@ def test_interleaved_keys_independent():
     assert host_deltas(p, [e for k, e in ke if k == 2]) == [0, 0, 0]
 
 
-def test_within_rejected_for_device_path():
+def test_within_spec_buckets():
+    """within() compiles to a pane ring: Q-1 live panes of pane_ms each
+    cover the horizon; Q == 1 (flat) without within."""
     p = (
         Pattern.begin("a").where(lambda e: e.name == "a")
         .followed_by("b").where(lambda e: e.name == "b").within(10)
     )
-    with pytest.raises(ValueError, match="within"):
-        dcep.DevicePatternSpec.from_pattern(p)
+    spec = dcep.DevicePatternSpec.from_pattern(p, within_buckets=8)
+    assert spec.pane_ms == 2 and spec.within_panes == 6
+    assert spec.dim == (2 - 1) * 6 + 2
+    spec_flat = dcep.DevicePatternSpec.from_pattern(
+        Pattern.begin("a").where(lambda e: e.name == "a")
+    )
+    assert spec_flat.within_panes == 1 and spec_flat.dim == 2
 
 
 def test_branching_explosion_exactness():
@@ -148,3 +155,112 @@ def test_branching_explosion_exactness():
     dd = device_run(p, [(4, e) for e in events])
     assert dd == hd
     assert dd[-1] == 20
+
+
+# ---------------------------------------------------------------- within()
+def device_run_within(pattern, key_events_ts, capacity=64, buckets=8):
+    """key_events_ts: list of (key_id, event, batch_ts). Consecutive
+    entries with the same batch_ts form one micro-batch (the executor
+    passes one timestamp per batch). Returns per-lane deltas."""
+    spec = dcep.DevicePatternSpec.from_pattern(pattern,
+                                               within_buckets=buckets)
+    state = dcep.init_state(capacity, 8, spec)
+    deltas = []
+    i = 0
+    while i < len(key_events_ts):
+        j = i
+        while j < len(key_events_ts) and \
+                key_events_ts[j][2] == key_events_ts[i][2]:
+            j += 1
+        chunk = key_events_ts[i:j]
+        keys = np.asarray([k for k, _e, _t in chunk], np.uint64)
+        events = [e for _k, e, _t in chunk]
+        hi = (keys >> np.uint64(32)).astype(np.uint32) | np.uint32(0x80000000)
+        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        masks = dcep.host_masks(pattern, events)
+        pane = (chunk[0][2] // spec.pane_ms) if spec.pane_ms else 0
+        state, d, _ = dcep.advance(
+            state, spec, jax.numpy.asarray(hi), jax.numpy.asarray(lo),
+            jax.numpy.asarray(masks),
+            jax.numpy.asarray(np.ones(len(chunk), bool)),
+            np.int32(pane),
+        )
+        deltas.extend(np.asarray(d).astype(int).tolist())
+        i = j
+    assert int(np.asarray(state.dropped_capacity)) == 0
+    return deltas
+
+
+def host_deltas_quantized(pattern, events_ts, pane_ms):
+    """Host NFA on pane-quantized timestamps — the semantics the device
+    path guarantees (device == host on quantized ts)."""
+    nfa = NFA(pattern)
+    partials = nfa.initial_state()
+    out = []
+    for e, ts in events_ts:
+        tq = (ts // pane_ms) * pane_ms if pane_ms else ts
+        partials, matches = nfa.process(partials, e, tq)
+        out.append(len(matches))
+    return out
+
+
+def _p_ab(within):
+    return (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b").within(within)
+    )
+
+
+def test_within_kills_expired_partials():
+    p = _p_ab(100)
+    spec = dcep.DevicePatternSpec.from_pattern(p, within_buckets=4)
+    # an 'a' at t=0 must match a 'b' at t<=100 and not one at t=200
+    seq = [(5, Event(0, "a", 1), 0), (5, Event(200, "b", 1), 200)]
+    assert device_run_within(p, seq, buckets=4) == [0, 0]
+    seq2 = [(5, Event(0, "a", 1), 0), (5, Event(100, "b", 1), 100)]
+    assert device_run_within(p, seq2, buckets=4) == [0, 1]
+
+
+def test_within_equals_host_on_quantized_ts():
+    p = _p_ab(40)
+    spec = dcep.DevicePatternSpec.from_pattern(p, within_buckets=8)
+    events = [
+        ("a", 0), ("x", 10), ("b", 20), ("a", 30), ("b", 45),
+        ("b", 80), ("a", 90), ("x", 100), ("b", 120), ("b", 131),
+    ]
+    seq = [(3, Event(t, n, 1), t) for n, t in events]
+    dd = device_run_within(p, seq, buckets=8)
+    hd = host_deltas_quantized(
+        p, [(Event(t, n, 1), t) for n, t in events], spec.pane_ms
+    )
+    assert dd == hd
+
+
+def test_within_strict_stage_and_multikey_fuzz():
+    rng = np.random.default_rng(11)
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b")
+        .followed_by("c").where(lambda e: e.name == "c").within(64)
+    )
+    spec = dcep.DevicePatternSpec.from_pattern(p, within_buckets=8)
+    names = np.array(["a", "b", "c", "x"])
+    n_ev, n_keys = 160, 5
+    # monotone batch timestamps, several events per batch
+    ts = np.cumsum(rng.integers(0, 24, n_ev))
+    seq, per_key = [], {k: [] for k in range(n_keys)}
+    for i in range(n_ev):
+        k = int(rng.integers(0, n_keys))
+        e = Event(int(ts[i]), str(rng.choice(names)), k)
+        seq.append((k, e, int(ts[i])))
+        per_key[k].append((e, int(ts[i])))
+    dd = device_run_within(p, seq, buckets=8)
+    # compare per-key totals against the quantized host NFA
+    got = {k: 0 for k in range(n_keys)}
+    for (k, _e, _t), d in zip(seq, dd):
+        got[k] += d
+    want = {
+        k: sum(host_deltas_quantized(p, evs, spec.pane_ms))
+        for k, evs in per_key.items()
+    }
+    assert got == want
