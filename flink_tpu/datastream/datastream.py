@@ -82,9 +82,12 @@ class DataStream:
         )
         return DataStream(self.env, t)
 
-    def connect(self, other: "DataStream") -> "ConnectedStreams":
+    def connect(self, other) -> "ConnectedStreams":
         """Two differently-typed streams sharing one operator (ref
-        DataStream.connect / ConnectedStreams)."""
+        DataStream.connect / ConnectedStreams). Connecting a
+        BroadcastStream yields the broadcast state pattern instead."""
+        if isinstance(other, BroadcastStream):
+            return BroadcastConnectedStream(self.env, self, other)
         return ConnectedStreams(self.env, self, other)
 
     def join(self, other: "DataStream") -> "JoinedStreams":
@@ -119,7 +122,18 @@ class DataStream:
         t = sg.PartitionTransformation(mode, self.transformation, mode=mode)
         return DataStream(self.env, t)
 
-    def broadcast(self) -> "DataStream":
+    def broadcast(self, *descriptors) -> "DataStream":
+        """Without arguments: the physical-replication annotation (ref
+        BroadcastPartitioner.java:30 — on this runtime replicate-and-mask
+        already places every record in every shard's address space, so
+        the annotation is a no-op declaration). With MapStateDescriptor
+        arguments: the broadcast STATE pattern — returns a
+        BroadcastStream to connect() against a keyed stream, where every
+        parallel instance applies every broadcast element to replicated
+        named state (ref KeyedBroadcastProcessFunction)."""
+        if descriptors:
+            return BroadcastStream(self.env, self.transformation,
+                                   descriptors)
         return self._partition("broadcast")
 
     def rebalance(self) -> "DataStream":
@@ -316,6 +330,142 @@ class ConnectedStreams:
 
 
 from flink_tpu.datastream.functions import RichFunction as _RichFunction
+
+# broadcast-tagged elements carry no user key; they process under this
+# sentinel so the keyed backend's current-key contract holds
+_BROADCAST_KEY = "__broadcast__"
+
+
+class BroadcastStream:
+    """A stream declared broadcast with named state descriptors (ref
+    BroadcastStream): connect it to a keyed stream and process with a
+    KeyedBroadcastProcessFunction."""
+
+    def __init__(self, env, transformation, descriptors):
+        self.env = env
+        self.transformation = transformation
+        self.descriptors = tuple(descriptors)
+
+
+class BroadcastConnectedStream:
+    """Keyed main stream + broadcast control stream (ref
+    BroadcastConnectedStream). Lowered as a tagged union re-keyed so
+    broadcast elements ride a sentinel key; the adapter below dispatches
+    and owns the replicated state."""
+
+    def __init__(self, env, main, bcast: BroadcastStream):
+        self.env = env
+        self.main = main
+        self.bcast = bcast
+
+    def process(self, fn) -> DataStream:
+        if not isinstance(self.main, KeyedStream):
+            raise ValueError(
+                "connect(broadcast_stream) requires a keyed main stream: "
+                "call key_by(...) before connect(...)"
+            )
+        ksel = self.main.transformation.key_selector
+        main_parent = self.main.transformation.parent
+        union = sg.UnionTransformation(
+            "broadcast_connect",
+            parents=[main_parent, self.bcast.transformation],
+            tagged=True,
+        )
+        keyed = sg.KeyByTransformation(
+            "key_by", union,
+            key_selector=lambda e: (
+                ksel(e.value) if e.tag == 0 else _BROADCAST_KEY
+            ),
+        )
+        t = sg.ProcessTransformation(
+            "broadcast_process", keyed,
+            fn=_KeyedBroadcastAdapter(fn, self.bcast.descriptors),
+        )
+        return DataStream(self.env, t)
+
+
+class _KeyedBroadcastAdapter(_RichFunction):
+    """Dispatches tagged elements to process_element /
+    process_broadcast_element and owns the replicated broadcast states.
+
+    State lives in the operator (non-keyed) state store — one dict per
+    descriptor boxed as the single item of a named list state — so it
+    snapshots into every checkpoint/savepoint and restores with the job
+    (ref BroadcastState backed by the operator state backend)."""
+
+    def __init__(self, fn, descriptors):
+        self.fn = fn
+        self.descriptors = tuple(descriptors)
+        self._store = None
+
+    def open(self, ctx):
+        self._store = ctx
+        if hasattr(self.fn, "open"):
+            self.fn.open(ctx)
+
+    def close(self):
+        if hasattr(self.fn, "close"):
+            self.fn.close()
+
+    def _states(self):
+        # re-fetched per call: restore swaps list contents in place, so
+        # cached dict references would go stale across a recovery
+        out = {}
+        for d in self.descriptors:
+            ls = self._store.get_operator_list_state(f"broadcast:{d.name}")
+            items = ls.get()
+            if not items:
+                ls.add({})
+                items = ls.get()
+            out[d.name] = ls._items[0]
+        return out
+
+    def process_element(self, e, ctx, out):
+        from flink_tpu.datastream.functions import (
+            BroadcastProcessContext, ReadOnlyBroadcastContext,
+        )
+
+        states = self._states()
+        if e.tag == 1:
+            self.fn.process_broadcast_element(
+                e.value, BroadcastProcessContext(states, ctx), out
+            )
+        else:
+            self.fn.process_element(
+                e.value, ReadOnlyBroadcastContext(states, ctx), out
+            )
+
+    def on_timer(self, timestamp, ctx, out):
+        # timers fired from keyed processing see broadcast state read-only
+        # (ref OnTimerContext extends ReadOnlyContext) — proxy the timer
+        # ctx and add the accessor
+        self.fn.on_timer(
+            timestamp, _BroadcastTimerContext(self._states(), ctx), out
+        )
+
+
+class _BroadcastTimerContext:
+    """OnTimerContext + read-only broadcast_state access (attribute calls
+    delegate to the wrapped timer context)."""
+
+    def __init__(self, states, base):
+        self._states = states
+        self._base = base
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+    def broadcast_state(self, descriptor_or_name):
+        import types
+
+        name = getattr(descriptor_or_name, "name", descriptor_or_name)
+        try:
+            return types.MappingProxyType(self._states[name])
+        except KeyError:
+            raise ValueError(
+                f"unknown broadcast state {name!r}; declare its "
+                f"MapStateDescriptor in stream.broadcast(...)"
+            ) from None
 
 
 class _CoProcessAdapter(_RichFunction):

@@ -204,3 +204,72 @@ class CoProcessFunction(RichFunction):
 
     def on_timer(self, timestamp: int, ctx: OnTimerContext, out: Collector):
         pass
+
+
+class BroadcastProcessContext:
+    """Writable context for process_broadcast_element: mutate the named
+    broadcast states (ref KeyedBroadcastProcessFunction.Context — the
+    broadcast state pattern; the reference's transport half is
+    BroadcastPartitioner.java:30, the state half arrived in Flink 1.5)."""
+
+    def __init__(self, states, base_ctx):
+        self._states = states
+        self._base = base_ctx
+
+    def broadcast_state(self, descriptor_or_name) -> dict:
+        name = getattr(descriptor_or_name, "name", descriptor_or_name)
+        try:
+            return self._states[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown broadcast state {name!r}; declare its "
+                f"MapStateDescriptor in stream.broadcast(...)"
+            ) from None
+
+    def timestamp(self):
+        return self._base.timestamp()
+
+
+class ReadOnlyBroadcastContext(ProcessContext):
+    """Context for process_element on the keyed side: broadcast states
+    are READ-ONLY here (per-key mutation of replicated state would
+    diverge across parallel instances — ref ReadOnlyContext), keyed
+    state and timers work as in any ProcessFunction context."""
+
+    def __init__(self, states, base_ctx):
+        super().__init__(base_ctx._ts)
+        self._states = states
+        self._base = base_ctx
+
+    def timestamp(self):
+        return self._base.timestamp()
+
+    def broadcast_state(self, descriptor_or_name):
+        import types
+
+        name = getattr(descriptor_or_name, "name", descriptor_or_name)
+        try:
+            return types.MappingProxyType(self._states[name])
+        except KeyError:
+            raise ValueError(
+                f"unknown broadcast state {name!r}; declare its "
+                f"MapStateDescriptor in stream.broadcast(...)"
+            ) from None
+
+
+class KeyedBroadcastProcessFunction(RichFunction):
+    """Two-input function over keyed main + broadcast control streams
+    (ref KeyedBroadcastProcessFunction): every parallel instance sees
+    EVERY broadcast element, so identical state updates replicate
+    deterministically; keyed elements read the replicated state."""
+
+    def process_element(self, value, ctx: ReadOnlyBroadcastContext,
+                        out: Collector):
+        raise NotImplementedError
+
+    def process_broadcast_element(self, value, ctx: BroadcastProcessContext,
+                                  out: Collector):
+        raise NotImplementedError
+
+    def on_timer(self, timestamp: int, ctx: OnTimerContext, out: Collector):
+        pass

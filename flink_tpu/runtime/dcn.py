@@ -283,11 +283,13 @@ class DCNWindowRunner:
                 if int(rts.max()) > MAX_TICKS or int(rts.min()) < 0:
                     # refuse rather than silently clamp (clamped records
                     # would all collapse into the MAX_TICKS window)
+                    bad = (int(rts.min()) if int(rts.min()) < 0
+                           else int(rts.max()))
                     raise ValueError(
-                        f"timestamp {int(rts.max()) + spec.origin_ms} out "
-                        f"of int32 tick range relative to origin_ms="
-                        f"{spec.origin_ms}; set DCNJobSpec.origin_ms near "
-                        f"the stream's first timestamp"
+                        f"timestamp {bad + spec.origin_ms} out of int32 "
+                        f"tick range relative to origin_ms="
+                        f"{spec.origin_ms}; set DCNJobSpec.origin_ms to "
+                        f"(at most) the stream's first timestamp"
                     )
                 ts[:m] = rts.astype(np.int32)
             values = np.zeros(B, np.float32)

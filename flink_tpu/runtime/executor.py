@@ -1738,25 +1738,32 @@ class LocalExecutor:
                         ts_ms=ts_ms, now_ms=now_ms, t_src=t_src)
 
         # -- prefetch: double-buffer the prep half on a worker thread ------
-        # Gated off whenever checkpointing is on: offsets snapshot at the
-        # consume point (write_checkpoint -> source.snapshot_offsets), and
-        # a polled-ahead batch would make a checkpoint skip records on
-        # restore. The reference overlaps the same way structurally — its
-        # netty IO threads fill input buffers while the task thread
-        # processes (SURVEY §2.3); here one thread is enough because the
-        # prep half is vectorized numpy, not per-record work.
+        # Gated off whenever a snapshot could be taken — checkpointing on,
+        # OR a cluster control channel that can request a savepoint at any
+        # batch boundary: offset snapshots happen at the consume point
+        # (source.snapshot_offsets), and a polled-ahead batch would make
+        # the snapshot skip records on restore. The reference overlaps
+        # the same way structurally — its netty IO threads fill input
+        # buffers while the task thread processes (SURVEY §2.3); here one
+        # thread is enough because the prep half is vectorized numpy, not
+        # per-record work.
         prefetch_cfg = env.config.get_str("pipeline.prefetch", "auto")
         if prefetch_cfg not in ("auto", "on", "off"):
             raise ValueError(
                 f"pipeline.prefetch must be auto|on|off, got {prefetch_cfg!r}"
             )
-        if prefetch_cfg == "on" and storage is not None:
+        can_snapshot = (
+            storage is not None
+            or getattr(env, "_control", None) is not None
+        )
+        if prefetch_cfg == "on" and can_snapshot:
             raise ValueError(
-                "pipeline.prefetch=on is incompatible with checkpointing: "
-                "the prefetch thread polls the source ahead of the applied "
-                "state, so offset snapshots would skip records on restore"
+                "pipeline.prefetch=on is incompatible with checkpointing/"
+                "savepoints: the prefetch thread polls the source ahead of "
+                "the applied state, so offset snapshots would skip records "
+                "on restore"
             )
-        use_prefetch = prefetch_cfg != "off" and storage is None
+        use_prefetch = prefetch_cfg != "off" and not can_snapshot
         prefetch_q: queue.Queue = queue.Queue(maxsize=2)
         prefetch_stop = threading.Event()
         prefetch_thread = [None]

@@ -61,3 +61,55 @@ class OperatorStateStore:
         for n, s in self._states.items():
             if n not in snap:
                 s._items.clear()
+
+
+def repartition_round_robin(snapshots: List[Dict[str, List[Any]]],
+                            new_parallelism: int
+                            ) -> List[Dict[str, List[Any]]]:
+    """SPLIT_DISTRIBUTE redistribution across a parallelism change (ref
+    RoundRobinOperatorStateRepartitioner.java): all subtasks' items of
+    each named state are collected in subtask order, then dealt
+    round-robin to the new subtasks — every item lands exactly once, and
+    adjacent items spread across instances (the reference's fair
+    re-split; exact per-slot placement is unspecified there too, only
+    the partition property matters).
+
+    snapshots: per-OLD-subtask OperatorStateStore.snapshot() dicts.
+    Returns per-NEW-subtask snapshot dicts (restore() each)."""
+    if new_parallelism < 1:
+        raise ValueError("new_parallelism must be >= 1")
+    names = []
+    for snap in snapshots:
+        for n in snap:
+            if n not in names:
+                names.append(n)
+    out: List[Dict[str, List[Any]]] = [
+        {n: [] for n in names} for _ in range(new_parallelism)
+    ]
+    for n in names:
+        merged = [it for snap in snapshots for it in snap.get(n, [])]
+        for i, item in enumerate(merged):
+            out[i % new_parallelism][n].append(item)
+    return out
+
+
+def repartition_union(snapshots: List[Dict[str, List[Any]]],
+                      new_parallelism: int
+                      ) -> List[Dict[str, List[Any]]]:
+    """UNION redistribution (ref union state in
+    RoundRobinOperatorStateRepartitioner.repartitionUnionState): every
+    new subtask receives ALL items of every named state (each instance
+    rebuilds its view from the full set — the Kafka-partition-offsets
+    pattern)."""
+    if new_parallelism < 1:
+        raise ValueError("new_parallelism must be >= 1")
+    names = []
+    for snap in snapshots:
+        for n in snap:
+            if n not in names:
+                names.append(n)
+    full = {
+        n: [it for snap in snapshots for it in snap.get(n, [])]
+        for n in names
+    }
+    return [copy.deepcopy(full) for _ in range(new_parallelism)]

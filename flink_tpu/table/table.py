@@ -144,6 +144,132 @@ def lit(v: Any) -> Expr:
     return Expr(lambda c, n, _v=v: np.full(n, _v), repr(v))
 
 
+# -- scalar function catalog (the Calcite operator-table slice the
+# reference's examples use; flink-table/.../codegen/calls/ScalarOperators.
+# scala generates Janino for these — here each is one vectorized numpy op)
+def _str_map(fn):
+    ufn = np.frompyfunc(fn, 1, 1)
+
+    def apply(a):
+        return ufn(np.asarray(a, object))
+
+    return apply
+
+
+def _like_to_re(pat: str):
+    out = []
+    for ch in pat:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+_MS = {"second": 1000, "minute": 60_000, "hour": 3_600_000,
+       "day": 86_400_000}
+
+
+def _extract(unit: str, ms):
+    """EXTRACT(unit FROM epoch_ms) — temporal field access in UTC (ref
+    Calcite EXTRACT lowering in ScalarOperators.scala)."""
+    import datetime as _dt
+
+    unit = unit.lower()
+    if unit not in ("year", "month", "day", "hour", "minute", "second"):
+        raise ValueError(f"EXTRACT unit {unit!r} unsupported")
+    arr = np.asarray(ms, np.int64)
+
+    def one(v):
+        d = _dt.datetime.fromtimestamp(v / 1000, _dt.timezone.utc)
+        return getattr(d, unit)
+
+    return np.frompyfunc(one, 1, 1)(arr).astype(np.int64)
+
+
+def _fn1(name, f):
+    def make(a: Expr) -> Expr:
+        return Expr(lambda c, n: f(a.eval(c, n)), f"{name}({a.name})")
+
+    return make
+
+
+_SCALAR_FNS: Dict[str, Callable] = {
+    # arithmetic
+    "abs": _fn1("ABS", np.abs),
+    "round": _fn1("ROUND", np.round),
+    "floor": _fn1("FLOOR", np.floor),
+    "ceil": _fn1("CEIL", np.ceil),
+    "sqrt": _fn1("SQRT", np.sqrt),
+    "exp": _fn1("EXP", np.exp),
+    "ln": _fn1("LN", np.log),
+    "log10": _fn1("LOG10", np.log10),
+    # string
+    "upper": _fn1("UPPER", _str_map(lambda s: s.upper())),
+    "lower": _fn1("LOWER", _str_map(lambda s: s.lower())),
+    "trim": _fn1("TRIM", _str_map(lambda s: s.strip())),
+    "length": _fn1("LENGTH", lambda a: np.asarray(
+        [len(s) for s in np.asarray(a, object)], np.int64
+    )),
+}
+
+
+def power(a: Expr, b: Expr) -> Expr:
+    return Expr(lambda c, n: np.power(a.eval(c, n), b.eval(c, n)),
+                f"POWER({a.name},{b.name})")
+
+
+def concat(*parts: Expr) -> Expr:
+    def f(c, n):
+        evs = [np.asarray(p.eval(c, n), object) for p in parts]
+        out = evs[0]
+        for e in evs[1:]:
+            out = np.asarray(
+                [str(x) + str(y) for x, y in zip(out, e)], object
+            )
+        return out
+
+    return Expr(f, f"CONCAT({','.join(p.name for p in parts)})")
+
+
+def substring(a: Expr, start: Expr, length: Optional[Expr] = None) -> Expr:
+    def f(c, n):
+        s0 = np.asarray(start.eval(c, n), np.int64)
+        ln = (np.asarray(length.eval(c, n), np.int64)
+              if length is not None else None)
+        vals = np.asarray(a.eval(c, n), object)
+        out = []
+        for i, s in enumerate(vals):
+            b = max(0, int(s0[i]) - 1)          # SQL: 1-based
+            out.append(
+                s[b:b + int(ln[i])] if ln is not None else s[b:]
+            )
+        return np.asarray(out, object)
+
+    return Expr(f, f"SUBSTRING({a.name})")
+
+
+def like(a: Expr, pattern: str) -> Expr:
+    rx = _like_to_re(pattern)
+
+    def f(c, n):
+        return np.asarray(
+            [bool(rx.match(str(s))) for s in np.asarray(a.eval(c, n), object)]
+        )
+
+    return Expr(f, f"({a.name} LIKE {pattern!r})")
+
+
+def if_(cond: Expr, then: Expr, else_: Expr) -> Expr:
+    return Expr(
+        lambda c, n: np.where(cond.eval(c, n), then.eval(c, n),
+                              else_.eval(c, n)),
+        f"IF({cond.name},{then.name},{else_.name})",
+    )
+
+
 from flink_tpu.ops.segment import grouped_reduce as _segment  # noqa: E402
 # (shared device scatter-reduce; same kernel the DataSet group_by path uses)
 
@@ -227,32 +353,76 @@ class Table:
             out[e.name] = _segment(kind, gid, vals, G)
         return Table(out)
 
-    def join(self, other: "Table", left_key: str,
-             right_key: Optional[str] = None, how: str = "inner") -> "Table":
+    def join(self, other: "Table", left_key,
+             right_key=None, how: str = "inner",
+             residual: Optional[Expr] = None,
+             _plan: Optional[List[str]] = None) -> "Table":
+        """Hash join, single or composite keys (pass lists for multi-key
+        ON conjuncts). For INNER joins the hash table is BUILT over the
+        smaller side (the reference's cost-based build-side choice,
+        JoinOperatorBase.JoinHint OPTIMIZER_CHOOSES); outer joins keep
+        the right side as build (their missing-row bookkeeping is
+        side-specific). `residual` filters the joined rows — the
+        non-equi remainder of a composite ON clause."""
         if how not in ("inner", "left", "right", "full"):
             raise ValueError(f"unsupported join type {how!r}")
-        rk = right_key or left_key
-        build: Dict[Any, List[int]] = {}
-        for i, v in enumerate(other.cols[rk].tolist()):
-            build.setdefault(v, []).append(i)
+        lks = [left_key] if isinstance(left_key, str) else list(left_key)
+        rks = (
+            [right_key] if isinstance(right_key, str)
+            else list(right_key) if right_key is not None else list(lks)
+        )
+        if len(lks) != len(rks):
+            raise ValueError("left/right join key counts differ")
+
+        def keyrows(t: "Table", names):
+            arrays = [t.cols[k].tolist() for k in names]
+            return (
+                list(zip(*arrays)) if len(arrays) > 1 else arrays[0]
+            )
+
+        lrows = keyrows(self, lks)
+        rrows = keyrows(other, rks)
+        # cost-based build side: probe the bigger input, hash the smaller
+        build_left = how == "inner" and self.n < other.n
+        if _plan is not None:
+            _plan.append(
+                f"HashJoin(how={how}, keys={list(zip(lks, rks))}, "
+                f"build={'left' if build_left else 'right'}"
+                f"[{self.n if build_left else other.n} rows], "
+                f"probe={other.n if build_left else self.n} rows"
+                + (f", residual={residual.name}" if residual is not None
+                   else "") + ")"
+            )
         li, ri = [], []
-        matched_right = set()
-        for i, v in enumerate(self.cols[left_key].tolist()):
-            rows = build.get(v)
-            if rows:
-                matched_right.add(v)
-                for j in rows:
+        if build_left:
+            build: Dict[Any, List[int]] = {}
+            for i, v in enumerate(lrows):
+                build.setdefault(v, []).append(i)
+            for j, v in enumerate(rrows):
+                for i in build.get(v, ()):
                     li.append(i)
                     ri.append(j)
-            elif how in ("left", "full"):
-                li.append(i)
-                ri.append(-1)
-        if how in ("right", "full"):
-            for v, rows in build.items():
-                if v not in matched_right:
+        else:
+            build = {}
+            for j, v in enumerate(rrows):
+                build.setdefault(v, []).append(j)
+            matched_right = set()
+            for i, v in enumerate(lrows):
+                rows = build.get(v)
+                if rows:
+                    matched_right.add(v)
                     for j in rows:
-                        li.append(-1)
+                        li.append(i)
                         ri.append(j)
+                elif how in ("left", "full"):
+                    li.append(i)
+                    ri.append(-1)
+            if how in ("right", "full"):
+                for v, rows in build.items():
+                    if v not in matched_right:
+                        for j in rows:
+                            li.append(-1)
+                            ri.append(j)
         li = np.asarray(li, np.int64)
         ri = np.asarray(ri, np.int64)
 
@@ -262,13 +432,38 @@ class Table:
 
         out = {k: take(v, li) for k, v in self.cols.items()}
         for k, v in other.cols.items():
-            if k == rk and rk == left_key:
+            if k in rks and lks[rks.index(k)] == k:
                 # shared key column: fill left-side gaps from the right
                 out[k] = np.where(li >= 0, out[k], take(v, ri))
                 continue
             name = k if k not in out else f"r_{k}"
             out[name] = take(v, ri)
-        return Table(out)
+        joined = Table(out)
+        if residual is not None:
+            joined = joined.where(residual)
+        return joined
+
+    def cross_join(self, other: "Table",
+                   residual: Optional[Expr] = None,
+                   _plan: Optional[List[str]] = None) -> "Table":
+        """Nested-loop product for joins with NO equi conjunct (pure
+        theta joins, ref NestedLoopJoin); `residual` is the ON predicate."""
+        li = np.repeat(np.arange(self.n, dtype=np.int64), other.n)
+        ri = np.tile(np.arange(other.n, dtype=np.int64), self.n)
+        if _plan is not None:
+            _plan.append(
+                f"NestedLoopJoin({self.n}x{other.n} rows"
+                + (f", on={residual.name}" if residual is not None else "")
+                + ")"
+            )
+        out = {k: v[li] for k, v in self.cols.items()}
+        for k, v in other.cols.items():
+            name = k if k not in out else f"r_{k}"
+            out[name] = v[ri]
+        joined = Table(out)
+        if residual is not None:
+            joined = joined.where(residual)
+        return joined
 
     def order_by(self, key: str, ascending: bool = True) -> "Table":
         k = key.name if isinstance(key, Expr) else key
@@ -349,7 +544,7 @@ class TableEnvironment:
         r"^\s*SELECT\s+(?P<select>.+?)\s+FROM\s+(?P<from>\w+)"
         r"(?:\s+(?P<jhow>INNER|LEFT(?:\s+OUTER)?|RIGHT(?:\s+OUTER)?"
         r"|FULL(?:\s+OUTER)?)?\s*JOIN\s+(?P<jtable>\w+)\s+ON\s+"
-        r"(?P<jleft>\w+(?:\.\w+)?)\s*=\s*(?P<jright>\w+(?:\.\w+)?))?"
+        r"(?P<on>.+?))?"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
         r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
@@ -357,51 +552,117 @@ class TableEnvironment:
         re.IGNORECASE | re.DOTALL,
     )
 
-    def sql_query(self, query: str) -> Table:
+    def _lower_join(self, t: Table, ft: str, jt: str, on_sql: str,
+                    how: str, plan: Optional[List[str]]) -> Table:
+        """ON condition -> equi conjuncts (composite hash-join keys) +
+        residual predicate (the non-equi remainder, filtered post-join).
+        No equi conjunct at all lowers to the nested-loop product (inner
+        only) — ref FlinkPlannerImpl's join condition split between
+        hash-join keys and the remaining filter."""
+        right = self.scan(jt)
+
+        def side_of(ref: str) -> Optional[str]:
+            if "." in ref:
+                qual = ref.split(".")[0]
+                if qual not in (ft, jt):
+                    raise ValueError(
+                        f"ON qualifier {qual!r} names neither "
+                        f"{ft!r} nor {jt!r}"
+                    )
+                return "left" if qual == ft else "right"
+            return None
+
+        conjuncts = re.split(r"\s+AND\s+", on_sql, flags=re.IGNORECASE)
+        lks, rks, residual_sql = [], [], []
+        for cj in conjuncts:
+            m = re.fullmatch(
+                r"\s*(\w+(?:\.\w+)?)\s*=\s*(\w+(?:\.\w+)?)\s*", cj
+            )
+            if m:
+                refs = [m.group(1), m.group(2)]
+                sides = [side_of(r) for r in refs]
+                cols_ = [r.split(".")[-1] for r in refs]
+                if sides[0] == sides[1] and sides[0] is not None:
+                    residual_sql.append(cj)     # same-side equality
+                    continue
+                if "left" in sides:
+                    i = sides.index("left")
+                    lk, rk = cols_[i], cols_[1 - i]
+                elif "right" in sides:
+                    i = sides.index("right")
+                    rk, lk = cols_[i], cols_[1 - i]
+                else:
+                    lk, rk = cols_
+                    if lk not in t.schema and rk in t.schema:
+                        lk, rk = rk, lk
+                lks.append(lk)
+                rks.append(rk)
+            else:
+                residual_sql.append(cj)
+
+        residual = None
+        if residual_sql:
+            # rewrite qualified refs to post-join column names: left
+            # names stay bare, clashing right names carry the r_ prefix
+            clash = (set(t.schema) & set(right.schema)) - {
+                rk for lk, rk in zip(lks, rks) if lk == rk
+            }
+
+            def rw(s: str) -> str:
+                def sub(m):
+                    qual, name = m.group(1), m.group(2)
+                    if qual == jt and name in clash:
+                        return f"r_{name}"
+                    return name
+
+                return re.sub(r"\b(\w+)\.(\w+)\b", sub, s)
+
+            residual = _parse_expr(
+                " AND ".join(rw(c) for c in residual_sql)
+            )
+        if residual is not None and how != "inner":
+            # correct outer-join ON-residual semantics gate MATCHING (the
+            # unmatched row stays, null-extended) — a post-join filter
+            # would be silently wrong, so refuse instead
+            raise ValueError(
+                "non-equi ON conditions are supported for INNER joins "
+                "only; move the predicate to WHERE for filter semantics"
+            )
+        if lks:
+            return t.join(right, lks, rks, how=how, residual=residual,
+                          _plan=plan)
+        if how != "inner":
+            raise ValueError(
+                "outer joins require at least one equi condition in ON"
+            )
+        return t.cross_join(right, residual=residual, _plan=plan)
+
+    def sql_query(self, query: str, _plan: Optional[List[str]] = None
+                  ) -> Table:
         m = self._SQL.match(query)
         if not m:
             raise ValueError(f"unsupported SQL shape: {query!r}")
         t = self.scan(m.group("from"))
+        if _plan is not None:
+            _plan.append(f"Scan({m.group('from')}, {t.n} rows)")
         if m.group("jtable"):
-            # equi-JOIN lowered to the columnar hash join (Table.join);
-            # `a.k` qualifiers bind the key to its table — the ON clause
-            # may list the two sides in either order (clashing right
-            # columns surface under the r_ prefix, see join())
             how = (m.group("jhow") or "inner").split()[0].lower()
-            jt = m.group("jtable")
-            right = self.scan(jt)
-            ft = m.group("from")
-
-            def side_of(ref: str) -> Optional[str]:
-                if "." in ref:
-                    qual = ref.split(".")[0]
-                    if qual not in (ft, jt):
-                        raise ValueError(
-                            f"ON qualifier {qual!r} names neither "
-                            f"{ft!r} nor {jt!r}"
-                        )
-                    return "left" if qual == ft else "right"
-                return None      # unqualified: resolve by schema below
-
-            refs = [m.group("jleft"), m.group("jright")]
-            sides = [side_of(r) for r in refs]
-            cols_ = [r.split(".")[-1] for r in refs]
-            if sides[0] == sides[1] and sides[0] is not None:
-                raise ValueError("ON clause must reference both tables")
-            if "left" in sides:
-                lk = cols_[sides.index("left")]
-                rk = cols_[1 - sides.index("left")]
-            elif "right" in sides:
-                rk = cols_[sides.index("right")]
-                lk = cols_[1 - sides.index("right")]
-            else:
-                # both unqualified: bind by schema membership
-                lk, rk = cols_
-                if lk not in t.schema and rk in t.schema:
-                    lk, rk = rk, lk
-            t = t.join(right, lk, rk, how=how)
+            if _plan is not None:
+                _plan.append(
+                    f"Scan({m.group('jtable')}, "
+                    f"{self.scan(m.group('jtable')).n} rows)"
+                )
+            t = self._lower_join(t, m.group("from"), m.group("jtable"),
+                                 m.group("on"), how, _plan)
         if m.group("where"):
+            n_in = t.n
             t = t.where(_parse_expr(m.group("where")))
+            if _plan is not None:
+                _plan.append(
+                    f"Filter({m.group('where').strip()}, {n_in} -> "
+                    f"{t.n} rows, selectivity "
+                    f"{t.n / n_in if n_in else 0:.2f})"
+                )
         select_items = _split_commas(m.group("select"))
         exprs = (
             None if select_items == ["*"]
@@ -410,16 +671,35 @@ class TableEnvironment:
         if m.group("group"):
             keys = [k.strip() for k in _split_commas(m.group("group"))]
             t = t.group_by(*keys).select(*(exprs or keys))
+            if _plan is not None:
+                _plan.append(
+                    f"HashAggregate(keys={keys}, {t.n} groups)"
+                )
         elif exprs is not None:
             t = t.select(*exprs)
+            if _plan is not None:
+                _plan.append(f"Project({[e.name for e in exprs]})")
         if m.group("order"):
             spec = m.group("order").strip()
             desc = bool(re.search(r"\s+DESC$", spec, re.IGNORECASE))
             key = re.sub(r"\s+(DESC|ASC)$", "", spec, flags=re.IGNORECASE)
             t = t.order_by(key.strip(), ascending=not desc)
+            if _plan is not None:
+                _plan.append(f"Sort({spec})")
         if m.group("limit"):
             t = t.limit(int(m.group("limit")))
+            if _plan is not None:
+                _plan.append(f"Limit({m.group('limit')})")
         return t
+
+    def explain(self, query: str) -> str:
+        """Physical plan + cost annotations for a SQL query (ref
+        TableEnvironment.explain / FlinkPlannerImpl.scala:46 — a planner
+        SEAM with measured row counts and build-side choices, not a
+        Calcite port)."""
+        plan: List[str] = []
+        self.sql_query(query, _plan=plan)
+        return "== Physical Plan ==\n" + "\n".join(plan)
 
 
 def _split_commas(s: str) -> List[str]:
@@ -460,6 +740,11 @@ def _parse_expr(s: str) -> Expr:
         return f"__lit{len(literals) - 1}__"
 
     py = re.sub(r"'((?:[^']|'')*)'", stash, s)
+    # SQL-only syntactic forms -> plain calls the Python ast can parse
+    py = re.sub(r"\bEXTRACT\s*\(\s*(\w+)\s+FROM\s+", r"extract_\1(",
+                py, flags=re.IGNORECASE)
+    py = re.sub(r"(\w+(?:\.\w+)?|__lit\d+__)\s+LIKE\s+(__lit\d+__)",
+                r"like(\1, \2)", py, flags=re.IGNORECASE)
     py = re.sub(r"(?<![<>=!])=(?!=)", "==", py)
     # python's `and`/`or`/`not` have SQL's precedence (below comparisons);
     # the builder turns BoolOp into elementwise &/|
@@ -515,6 +800,52 @@ def _parse_expr(s: str) -> Expr:
             if fname in _AGGS:
                 inner = build(node.args[0])
                 return inner._mk_agg(fname)
+            if fname == "round" and len(node.args) == 2:
+                a, d = build(node.args[0]), node.args[1]
+                if not (isinstance(d, ast.Constant)
+                        and isinstance(d.value, int)):
+                    raise ValueError("ROUND precision must be an int literal")
+                return Expr(
+                    lambda c, n, _a=a, _d=d.value: np.round(
+                        _a.eval(c, n), _d
+                    ),
+                    f"ROUND({a.name},{d.value})",
+                )
+            if fname in _SCALAR_FNS:
+                if len(node.args) != 1:
+                    raise ValueError(
+                        f"{fname.upper()} takes exactly 1 argument, "
+                        f"got {len(node.args)}"
+                    )
+                return _SCALAR_FNS[fname](build(node.args[0]))
+            if fname == "power":
+                return power(build(node.args[0]), build(node.args[1]))
+            if fname == "concat":
+                return concat(*[build(a) for a in node.args])
+            if fname == "substring":
+                return substring(*[build(a) for a in node.args])
+            if fname == "if":
+                return if_(*[build(a) for a in node.args])
+            if fname == "like":
+                pat_node = node.args[1]
+                if isinstance(pat_node, ast.Name):
+                    m2 = re.fullmatch(r"__lit(\d+)__", pat_node.id)
+                    pat = literals[int(m2.group(1))]
+                elif isinstance(pat_node, ast.Constant):
+                    pat = str(pat_node.value)
+                else:
+                    raise ValueError("LIKE pattern must be a literal")
+                return like(build(node.args[0]), pat)
+            m2 = re.fullmatch(r"extract_(\w+)", fname)
+            if m2:
+                unit = m2.group(1)
+                inner = build(node.args[0])
+                return Expr(
+                    lambda c, n, _u=unit, _i=inner: _extract(
+                        _u, _i.eval(c, n)
+                    ),
+                    f"EXTRACT({unit.upper()} FROM {inner.name})",
+                )
         raise ValueError(f"unsupported SQL expression: {s!r}")
 
     return build(tree)

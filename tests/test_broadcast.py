@@ -1,0 +1,128 @@
+"""Physical broadcast (round 4, VERDICT item 7):
+
+* broadcast STATE pattern — keyed main stream + broadcast control stream
+  through KeyedBroadcastProcessFunction, state updates visible to keyed
+  processing, checkpointed and restored (ref KeyedBroadcastProcessFunction
+  / BroadcastPartitioner.java:30),
+* device broadcast JOIN — build side replicated to all 8 shards via the
+  mesh sharding declaration, every shard probing its own record slice
+  against the FULL table (ref BROADCAST_HASH_FIRST/SECOND join hints).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.datastream.functions import KeyedBroadcastProcessFunction
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.state.descriptors import MapStateDescriptor
+
+
+class Enrich(KeyedBroadcastProcessFunction):
+    """Control stream carries (word, factor) rules; main stream emits
+    value * factor[word] for known words."""
+
+    def process_element(self, value, ctx, out):
+        rules = ctx.broadcast_state("rules")
+        word, v = value
+        if word in rules:
+            out.collect((word, v * rules[word]))
+
+    def process_broadcast_element(self, value, ctx, out):
+        word, factor = value
+        ctx.broadcast_state("rules")[word] = factor
+
+
+def test_broadcast_state_pattern():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    sink = CollectSink()
+    rules = env.from_collection([("a", 10.0), ("b", 100.0)])
+    main = env.from_collection(
+        [("a", 1.0), ("b", 2.0), ("c", 3.0), ("a", 4.0)]
+    ).key_by(lambda e: e[0])
+    desc = MapStateDescriptor("rules", str, float)
+    main.connect(rules.broadcast(desc)).process(Enrich()).add_sink(sink)
+    env.execute("broadcast-enrich")
+    # cross-stream arrival order is round-robin (not deterministic wrt
+    # rules-vs-records), so assert the order-independent guarantees:
+    # every emission used the exact broadcast rule for its word, and the
+    # LAST main element — which provably arrives after the (shorter)
+    # rules stream drained — was enriched
+    assert ("a", 40.0) in sink.results
+    assert set(sink.results) <= {("a", 10.0), ("a", 40.0), ("b", 200.0)}
+
+
+def test_broadcast_state_is_readonly_on_keyed_side():
+    class Bad(KeyedBroadcastProcessFunction):
+        def process_element(self, value, ctx, out):
+            ctx.broadcast_state("rules")["x"] = 1.0   # must raise
+
+        def process_broadcast_element(self, value, ctx, out):
+            pass
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 2
+    env.set_parallelism(1)
+    rules = env.from_collection([("a", 1.0)])
+    main = env.from_collection([("a", 1.0)]).key_by(lambda e: e[0])
+    desc = MapStateDescriptor("rules", str, float)
+    main.connect(rules.broadcast(desc)).process(Bad()).add_sink(CollectSink())
+    with pytest.raises(TypeError):
+        env.execute("broadcast-readonly")
+
+
+def test_broadcast_state_checkpoints(tmp_path):
+    """Broadcast state rides the operator-state store: snapshot a job
+    mid-stream, restore into a fresh run, rules survive."""
+    from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+    def build(restore_from=None, rules_ev=(), main_ev=()):
+        env = StreamExecutionEnvironment.get_execution_environment()
+        env.batch_size = 2
+        env.set_parallelism(1)
+        env.enable_checkpointing(interval_steps=1, directory=str(tmp_path))
+        sink = CollectSink()
+        rules = env.from_collection(list(rules_ev))
+        main = env.from_collection(list(main_ev)).key_by(lambda e: e[0])
+        desc = MapStateDescriptor("rules", str, float)
+        main.connect(rules.broadcast(desc)).process(Enrich()).add_sink(sink)
+        env.execute("broadcast-ckpt", restore_from=restore_from)
+        return sink
+
+    # first run inserts the rules, checkpoints at end of stream
+    base_main = [("a", 1.0), ("b", 1.0)]
+    build(rules_ev=[("a", 5.0), ("b", 7.0)], main_ev=base_main)
+    assert CheckpointStorage(str(tmp_path)).latest() is not None
+    # restored run: NO rule events at all, main stream extended past the
+    # checkpointed offset — the new elements' enrichment can only come
+    # from the RESTORED broadcast state
+    sink = build(
+        restore_from=str(tmp_path), rules_ev=[],
+        main_ev=base_main + [("a", 2.0), ("b", 3.0)],
+    )
+    assert ("a", 10.0) in sink.results and ("b", 21.0) in sink.results
+
+
+def test_device_broadcast_join_8_shards():
+    import jax
+
+    from flink_tpu.parallel.broadcast import broadcast_join
+    from flink_tpu.parallel.mesh import MeshContext
+
+    assert len(jax.devices()) == 8
+    ctx = MeshContext.create(8)
+    rng = np.random.default_rng(3)
+    # build side: 200 dimension rows; stream: 10k records over 300 keys
+    tkeys = np.arange(0, 400, 2, dtype=np.int64)         # even keys only
+    tvals = (tkeys * 0.5).astype(np.float32)
+    keys = rng.integers(0, 300, 10_000).astype(np.int64)
+    vals = np.ones(10_000, np.float32)
+    joined, hit = broadcast_join(keys, vals, tkeys, tvals, ctx)
+    # every lane — regardless of which shard probed it — joined against
+    # the FULL table: evens matched with key*0.5, odds unmatched
+    want_hit = (keys % 2 == 0) & (keys < 400)
+    assert np.array_equal(hit, want_hit)
+    assert np.allclose(joined[want_hit], keys[want_hit] * 0.5)
+    assert np.all(joined[~want_hit] == 0)

@@ -203,3 +203,61 @@ def test_operator_state_checkpoint_restore(tmp_path):
     # exactly-once: every record buffered once despite the replay
     assert sorted(fn.buf.get()) == list(range(total))
     assert len(sink.results) == total
+
+
+# ---------------------------------------------------- rescale repartitioning
+def test_operator_state_round_robin_repartition():
+    """SPLIT_DISTRIBUTE rescale (ref RoundRobinOperatorStateRepartitioner):
+    3 old subtasks -> 2 new: every item placed exactly once, fair spread
+    (counts differ by at most 1 per name)."""
+    from flink_tpu.state.operator_state import (
+        OperatorStateStore,
+        repartition_round_robin,
+    )
+
+    olds = []
+    for p in range(3):
+        st = OperatorStateStore()
+        ls = st.get_list_state("offsets")
+        for i in range(4):
+            ls.add(("part", p, i))
+        st.get_list_state("buffers").add(f"buf-{p}")
+        olds.append(st.snapshot())
+
+    news = repartition_round_robin(olds, 2)
+    assert len(news) == 2
+    all_offsets = [it for s in news for it in s["offsets"]]
+    assert sorted(all_offsets) == sorted(
+        [("part", p, i) for p in range(3) for i in range(4)]
+    )
+    # fairness: 12 items -> 6/6; 3 buffers -> 2/1
+    assert {len(s["offsets"]) for s in news} == {6}
+    assert sorted(len(s["buffers"]) for s in news) == [1, 2]
+
+    # restore into fresh stores: disjoint, complete
+    stores = [OperatorStateStore() for _ in range(2)]
+    for st, snap in zip(stores, news):
+        st.restore(snap)
+    merged = [it for st in stores for it in st.get_list_state("offsets").get()]
+    assert sorted(merged) == sorted(all_offsets)
+
+
+def test_operator_state_union_repartition():
+    from flink_tpu.state.operator_state import repartition_union
+
+    olds = [{"offs": [1, 2]}, {"offs": [3]}]
+    news = repartition_union(olds, 3)
+    assert len(news) == 3
+    for s in news:
+        assert s["offs"] == [1, 2, 3]
+    # deep copies: mutating one subtask's view must not leak
+    news[0]["offs"].append(99)
+    assert news[1]["offs"] == [1, 2, 3]
+
+
+def test_rescale_down_to_one_collapses_to_union_of_items():
+    from flink_tpu.state.operator_state import repartition_round_robin
+
+    olds = [{"s": [1]}, {"s": [2]}, {"s": [3, 4]}]
+    (one,) = repartition_round_robin(olds, 1)
+    assert sorted(one["s"]) == [1, 2, 3, 4]

@@ -231,3 +231,120 @@ def test_sql_join_respects_on_qualifiers():
         "SELECT id, tag FROM l JOIN r ON r.id = l.ref ORDER BY id"
     )
     assert t.to_rows() == [(1, "c"), (2, "a"), (3, "b")]
+
+
+# ----------------------------------------------------- round-4 SQL breadth
+def _env2():
+    from flink_tpu.table.table import TableEnvironment
+
+    tenv = TableEnvironment.create()
+    tenv.register_table("orders", tenv.from_columns({
+        "id": [1, 2, 3, 4], "cust": [10, 20, 10, 30],
+        "amount": [5.0, 15.0, 25.0, 40.0], "ts": [0, 61_000, 3_700_000, 90_000_000],
+        "tag": ["Alpha", "beta", "Gamma", "beta"],
+    }))
+    tenv.register_table("customers", tenv.from_columns({
+        "cust": [10, 20, 30], "tier": [1, 2, 3],
+        "credit": [20.0, 10.0, 50.0],
+    }))
+    return tenv
+
+
+def test_scalar_functions():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, ABS(amount - 20.0) AS dist, UPPER(tag) AS utag, "
+        "LENGTH(tag) AS ln, POWER(tier, 2) AS t2 "
+        "FROM orders JOIN customers ON orders.cust = customers.cust "
+        "ORDER BY id"
+    )
+    rows = t.to_dicts()
+    assert [r["dist"] for r in rows] == [15.0, 5.0, 5.0, 20.0]
+    assert [r["utag"] for r in rows] == ["ALPHA", "BETA", "GAMMA", "BETA"]
+    assert [r["ln"] for r in rows] == [5, 4, 5, 4]
+    assert [r["t2"] for r in rows] == [1, 4, 1, 9]
+
+
+def test_like_and_concat_and_substring():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, CONCAT(tag, '-', tag) AS dbl, SUBSTRING(tag, 1, 3) AS pre "
+        "FROM orders WHERE tag LIKE '%eta' ORDER BY id"
+    )
+    rows = t.to_dicts()
+    assert [r["id"] for r in rows] == [2, 4]
+    assert rows[0]["dbl"] == "beta-beta" and rows[0]["pre"] == "bet"
+
+
+def test_temporal_extract():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, EXTRACT(HOUR FROM ts) AS h, EXTRACT(DAY FROM ts) AS d "
+        "FROM orders ORDER BY id"
+    )
+    rows = t.to_dicts()
+    assert [r["h"] for r in rows] == [0, 0, 1, 1]   # 0ms, 61s, ~1.03h, ~25h
+    assert [r["d"] for r in rows] == [1, 1, 1, 2]
+
+
+def test_non_equi_join_residual():
+    tenv = _env2()
+    # equi conjunct + residual: only orders within the customer's credit
+    t = tenv.sql_query(
+        "SELECT id, amount, credit FROM orders "
+        "JOIN customers ON orders.cust = customers.cust "
+        "AND orders.amount < customers.credit ORDER BY id"
+    )
+    rows = t.to_dicts()
+    assert [r["id"] for r in rows] == [1, 4]        # 5<20, 40<50
+
+
+def test_pure_theta_join_nested_loop():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, tier FROM orders JOIN customers "
+        "ON orders.amount > customers.credit ORDER BY id"
+    )
+    got = {(r["id"], r["tier"]) for r in t.to_dicts()}
+    # amount > credit pairs: 15>10(t2), 25>20(t1), 25>10(t2), 40>20, 40>10
+    assert got == {(2, 2), (3, 1), (3, 2), (4, 1), (4, 2)}
+
+
+def test_if_expression():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, IF(amount > 20.0, 1, 0) AS big FROM orders ORDER BY id"
+    )
+    assert [r["big"] for r in t.to_dicts()] == [0, 0, 1, 1]
+
+
+def test_explain_shows_plan_and_build_side():
+    tenv = _env2()
+    plan = tenv.explain(
+        "SELECT id, SUM(amount) AS total FROM orders "
+        "JOIN customers ON orders.cust = customers.cust "
+        "AND orders.amount < customers.credit "
+        "WHERE amount > 1.0 GROUP BY id ORDER BY id LIMIT 3"
+    )
+    assert "Physical Plan" in plan
+    assert "Scan(orders, 4 rows)" in plan
+    assert "HashJoin" in plan and "build=right[3 rows]" in plan
+    assert "residual=" in plan
+    assert "Filter" in plan and "selectivity" in plan
+    assert "HashAggregate" in plan and "Sort" in plan and "Limit(3)" in plan
+
+
+def test_multi_key_equi_join():
+    from flink_tpu.table.table import TableEnvironment
+
+    tenv = TableEnvironment.create()
+    tenv.register_table("a", tenv.from_columns({
+        "k1": [1, 1, 2], "k2": [1, 2, 1], "v": [10.0, 20.0, 30.0],
+    }))
+    tenv.register_table("b", tenv.from_columns({
+        "k1": [1, 2, 1], "k2": [2, 1, 9], "w": [1.0, 2.0, 3.0],
+    }))
+    t = tenv.sql_query(
+        "SELECT v, w FROM a JOIN b ON a.k1 = b.k1 AND a.k2 = b.k2"
+    )
+    assert sorted(t.to_rows()) == [(20.0, 1.0), (30.0, 2.0)]
