@@ -38,6 +38,26 @@ class StreamExecutionEnvironment:
         from flink_tpu.runtime.queryable import KvStateRegistry
 
         self.metric_registry = MetricRegistry()
+        # config-driven wire reporters (metrics.reporters: "a,b" +
+        # metrics.reporter.<name>.class keys, ref MetricRegistry-
+        # Configuration.fromConfiguration)
+        if self.config.get_str("metrics.reporters", ""):
+            import weakref
+
+            from flink_tpu.metrics.reporters import (
+                configure_reporters,
+                stop_reporters,
+            )
+
+            self._reporter_threads = configure_reporters(
+                self.metric_registry, self.config
+            )
+            # reporter threads + sockets die with the environment: the
+            # finalizer closes over (threads, registry) only, never the
+            # env itself, so GC of a dropped env reclaims them instead of
+            # leaking a thread + socket per environment forever
+            weakref.finalize(self, stop_reporters,
+                             self._reporter_threads, self.metric_registry)
         self._control = None  # cluster.JobControl when cluster-submitted
         self._kv_registry = KvStateRegistry()
         # job-scoped TypeSerializer registry (lazily forked from the

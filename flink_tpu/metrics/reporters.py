@@ -1,0 +1,177 @@
+"""Wire-protocol metric reporters — the flink-metrics-* module analogs.
+
+The reference ships reporter jars per system (flink-metrics-statsd
+StatsDReporter.java, flink-metrics-graphite wrapping dropwizard's
+GraphiteReporter, flink-metrics-jmx). Here each is a small class on the
+same Reporter SPI (metrics/core.py), plus `configure_reporters` which
+reads the reference's configuration shape:
+
+    metrics.reporters: "stsd,graph"
+    metrics.reporter.stsd.class: statsd
+    metrics.reporter.stsd.host: 127.0.0.1
+    metrics.reporter.stsd.port: 8125
+    metrics.reporter.stsd.interval: 10       # seconds
+    metrics.reporter.graph.class: graphite
+    ...
+
+(ref MetricRegistryConfiguration.fromConfiguration /
+metrics.reporter.<name>.<option> keys). JMX has no analog outside a JVM;
+the JSON-file and logging reporters (metrics/core.py) cover the
+file/console roles.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, List
+
+from flink_tpu.metrics.core import (
+    JsonFileReporter,
+    LoggingReporter,
+    MetricRegistry,
+    Reporter,
+    ScheduledReporter,
+)
+
+
+def _flatten(snapshot: Dict) -> Dict[str, float]:
+    """Registry snapshot -> flat {path: numeric} (histograms expand to
+    per-statistic paths, the dropwizard convention)."""
+    out: Dict[str, float] = {}
+    for k, v in snapshot.items():
+        if isinstance(v, dict):
+            for stat, sv in v.items():
+                if isinstance(sv, (int, float)):
+                    out[f"{k}.{stat}"] = sv
+        elif isinstance(v, bool):
+            out[k] = int(v)
+        elif isinstance(v, (int, float)):
+            out[k] = v
+    return out
+
+
+def _sanitize(path: str, sep: str = ".") -> str:
+    out = []
+    for ch in path:
+        if ch.isalnum() or ch in ("-", "_", sep):
+            out.append(ch)
+        else:
+            out.append("_")
+    return "".join(out)
+
+
+class StatsDReporter(Reporter):
+    """StatsD line protocol over UDP (ref flink-metrics-statsd
+    StatsDReporter.java:report): every numeric metric as a gauge
+    `<path>:<value>|g`, one datagram per metric (the protocol's safe
+    framing — servers may drop oversized batches silently)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125):
+        self.addr = (host, int(port))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def report(self):
+        for path, v in _flatten(self.registry.snapshot()).items():
+            line = f"{_sanitize(path)}:{v}|g"
+            try:
+                self._sock.sendto(line.encode(), self.addr)
+            except OSError:
+                pass      # UDP best-effort, like the reference
+
+    def close(self):
+        self._sock.close()
+
+
+class GraphiteReporter(Reporter):
+    """Graphite plaintext protocol over TCP (`<path> <value> <epoch>\\n`),
+    reconnecting on failure (ref flink-metrics-graphite via dropwizard
+    GraphiteReporter). One connection per report() batch."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 2003,
+                 prefix: str = "flink_tpu"):
+        self.addr = (host, int(port))
+        self.prefix = prefix
+
+    def report(self):
+        flat = _flatten(self.registry.snapshot())
+        if not flat:
+            return
+        now = int(time.time())
+        payload = "".join(
+            f"{self.prefix}.{_sanitize(p)} {v} {now}\n"
+            for p, v in flat.items()
+        ).encode()
+        try:
+            with socket.create_connection(self.addr, timeout=5) as s:
+                s.sendall(payload)
+        except OSError:
+            pass          # transient carbon outage: next interval retries
+
+    def close(self):
+        pass
+
+
+_KINDS = {
+    "statsd": StatsDReporter,
+    "graphite": GraphiteReporter,
+    "jsonfile": JsonFileReporter,
+    "logging": LoggingReporter,
+}
+
+
+def stop_reporters(threads: List[ScheduledReporter],
+                   registry: MetricRegistry):
+    """Teardown half of configure_reporters: stop the scheduler threads
+    and close every reporter's socket/file handle. Safe to call more
+    than once; used as the environment's GC finalizer."""
+    for t in threads:
+        t.stop()
+    try:
+        registry.close()
+    except Exception:
+        pass
+
+
+def configure_reporters(registry: MetricRegistry, config
+                        ) -> List[ScheduledReporter]:
+    """Instantiate + schedule the reporters named in `metrics.reporters`
+    (ref MetricRegistryConfiguration). Returns the started scheduler
+    threads (daemons; stop() them on env teardown, or let them die with
+    the process like the reference's reporter executor)."""
+    names = [
+        n.strip()
+        for n in config.get_str("metrics.reporters", "").split(",")
+        if n.strip()
+    ]
+    started: List[ScheduledReporter] = []
+    for name in names:
+        pre = f"metrics.reporter.{name}."
+        kind = config.get_str(pre + "class", "")
+        cls = _KINDS.get(kind)
+        if cls is None:
+            raise ValueError(
+                f"metrics.reporter.{name}.class must be one of "
+                f"{sorted(_KINDS)}, got {kind!r}"
+            )
+        if cls is StatsDReporter:
+            rep = StatsDReporter(config.get_str(pre + "host", "127.0.0.1"),
+                                 config.get_int(pre + "port", 8125))
+        elif cls is GraphiteReporter:
+            rep = GraphiteReporter(
+                config.get_str(pre + "host", "127.0.0.1"),
+                config.get_int(pre + "port", 2003),
+                config.get_str(pre + "prefix", "flink_tpu"),
+            )
+        elif cls is JsonFileReporter:
+            rep = JsonFileReporter(config.get_str(pre + "path",
+                                                  "/tmp/flink_tpu_metrics.json"))
+        else:
+            rep = LoggingReporter()
+        registry.add_reporter(rep)
+        sched = ScheduledReporter(
+            rep, config.get_float(pre + "interval", 10.0)
+        )
+        sched.start()
+        started.append(sched)
+    return started

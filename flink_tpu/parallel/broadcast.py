@@ -31,8 +31,8 @@ from flink_tpu.parallel.mesh import SHARD_AXIS, MeshContext
 def build_broadcast_join_step(ctx: MeshContext):
     """Compile a broadcast-join step over the mesh.
 
-    step(keys, values, valid, tkeys, tvals) with
-      keys/values/valid: [B] record lanes, SPLIT over shards (each device
+    step(keys, valid, tkeys, tvals) with
+      keys/valid: [B] record lanes, SPLIT over shards (each device
         probes only its B/n slice — work scales with chips),
       tkeys: [K] SORTED unique build-side keys, REPLICATED to every shard,
       tvals: [K] build-side payload, replicated.
@@ -41,7 +41,7 @@ def build_broadcast_join_step(ctx: MeshContext):
     """
     mesh = ctx.mesh
 
-    def shard_body(keys, values, valid, tkeys, tvals):
+    def shard_body(keys, valid, tkeys, tvals):
         pos = jnp.searchsorted(tkeys, keys)
         pos_c = jnp.minimum(pos, tkeys.shape[0] - 1)
         hit = valid & (tkeys[pos_c] == keys)
@@ -51,7 +51,7 @@ def build_broadcast_join_step(ctx: MeshContext):
     sharded = shard_map(
         shard_body, mesh=mesh,
         in_specs=(
-            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS), P(SHARD_AXIS),
             P(), P(),     # build side REPLICATED: the physical broadcast
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
@@ -59,22 +59,21 @@ def build_broadcast_join_step(ctx: MeshContext):
     )
 
     @jax.jit
-    def step(keys, values, valid, tkeys, tvals):
-        return sharded(keys, values, valid, tkeys, tvals)
+    def step(keys, valid, tkeys, tvals):
+        return sharded(keys, valid, tkeys, tvals)
 
     return step
 
 
-def broadcast_join(keys, values, tkeys, tvals, ctx: MeshContext = None):
+def broadcast_join(keys, tkeys, tvals, ctx: MeshContext = None):
     """One-shot broadcast join of host arrays over all devices.
 
-    keys/values: record stream ([B] int64/float); tkeys/tvals: build side
+    keys: record stream keys ([B] int); tkeys/tvals: build side
     (unsorted ok, [K]). Returns (joined [B] float, matched [B] bool).
     B is padded up to a shard multiple internally."""
     ctx = ctx or MeshContext.create()
     n = ctx.n_shards
     keys = np.asarray(keys)
-    values = np.asarray(values, np.float32)
     order = np.argsort(tkeys, kind="stable")
     tkeys_s = np.asarray(tkeys)[order]
     tvals_s = np.asarray(tvals, np.float32)[order]
@@ -82,8 +81,7 @@ def broadcast_join(keys, values, tkeys, tvals, ctx: MeshContext = None):
     Bp = ((B + n - 1) // n) * n
     pad = Bp - B
     kp = np.concatenate([keys, np.zeros(pad, keys.dtype)])
-    vp = np.concatenate([values, np.zeros(pad, np.float32)])
     valid = np.concatenate([np.ones(B, bool), np.zeros(pad, bool)])
     step = build_broadcast_join_step(ctx)
-    joined, hit = step(kp, vp, valid, tkeys_s, tvals_s)
+    joined, hit = step(kp, valid, tkeys_s, tvals_s)
     return np.asarray(joined)[:B], np.asarray(hit)[:B]

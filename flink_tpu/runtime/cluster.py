@@ -241,8 +241,14 @@ class MiniCluster:
 
     # -- control server (CliFrontend <-> JobManager channel) -------------
     def start_control_server(self, host: str = "127.0.0.1",
-                             port: int = 0) -> int:
+                             port: int = 0, config=None) -> int:
+        """`config` (a core.config.Configuration) lets the operator set
+        security.auth.token[-file] explicitly; otherwise the environment
+        variables resolve (runtime/security.get_token)."""
+        from flink_tpu.runtime import security
+
         cluster = self
+        token = security.get_token(config)
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
@@ -251,6 +257,7 @@ class MiniCluster:
                     return
                 try:
                     req = json.loads(line)
+                    security.check(token, req)
                     resp = cluster._dispatch(req)
                 except Exception as e:
                     resp = {"ok": False, "error": str(e)}
@@ -293,7 +300,12 @@ class MiniCluster:
 
 def control_request(host: str, port: int, req: Dict[str, Any],
                     timeout_s: float = 130.0) -> Dict[str, Any]:
-    """Client side of the control protocol (used by the CLI)."""
+    """Client side of the control protocol (used by the CLI). Attaches
+    the shared auth token when one is configured in the environment
+    (runtime/security.py — SecurityContext.java:53 analog)."""
+    from flink_tpu.runtime import security
+
+    req = security.attach(req, security.get_token())
     with socket.create_connection((host, port), timeout=timeout_s) as s:
         s.sendall((json.dumps(req) + "\n").encode())
         buf = b""

@@ -92,7 +92,11 @@ class ProcessCluster:
                  startup_grace_s: float = 60.0,
                  ha_dir: Optional[str] = None,
                  contender_id: Optional[str] = None,
-                 advertise_host: str = "127.0.0.1"):
+                 advertise_host: str = "127.0.0.1",
+                 auth_token: Optional[str] = None):
+        # explicit token wins; else the FLINK_TPU_AUTH_TOKEN[_FILE]
+        # environment resolves (runtime/security.py); None = open cluster
+        self.auth_token = auth_token
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.max_restarts = max_restarts
         self.monitor_interval_s = monitor_interval_s
@@ -211,7 +215,10 @@ class ProcessCluster:
         return self._port
 
     def _start_serving(self, host: str, port: int):
+        from flink_tpu.runtime import security
+
         cluster = self
+        token = self.auth_token or security.get_token()
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
@@ -219,7 +226,12 @@ class ProcessCluster:
                 if not line:
                     return
                 try:
-                    resp = cluster._dispatch(json.loads(line))
+                    req = json.loads(line)
+                    # authenticate BEFORE dispatch: an unauthenticated
+                    # caller cannot submit/cancel/register
+                    # (SecurityContext.java:53 analog, runtime/security.py)
+                    security.check(token, req)
+                    resp = cluster._dispatch(req)
                 except Exception as e:
                     resp = {"ok": False, "error": str(e)}
                 self.wfile.write(
@@ -433,6 +445,12 @@ class ProcessCluster:
         if restore:
             cmd.append("--restore")
         env = dict(os.environ)
+        if self.auth_token:
+            # an explicitly-passed token must reach spawned workers too
+            # (they authenticate via control_request's env lookup)
+            from flink_tpu.runtime import security
+
+            env[security.ENV_TOKEN] = self.auth_token
         if extra_env:
             env.update(extra_env)
         # worker output goes to a per-worker log (the TaskManager .log /

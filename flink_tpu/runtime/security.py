@@ -1,0 +1,70 @@
+"""Control-plane authentication — the SecurityContext analog.
+
+The reference's SecurityContext (flink-runtime/.../security/
+SecurityContext.java:53) installs Kerberos/JAAS credentials around
+cluster communication. The TPU-native control plane is JSON-over-TCP
+(runtime/cluster.py line protocol), so its security model is a shared
+secret on every request:
+
+  * the operator sets ``FLINK_TPU_AUTH_TOKEN`` (or points
+    ``FLINK_TPU_AUTH_TOKEN_FILE`` at a secret file, the k8s-secret
+    pattern) on controller AND clients/workers;
+  * every control request carries ``auth: <token>``;
+  * a token-configured server rejects requests whose token mismatches
+    (constant-time compare), BEFORE dispatch — an unauthenticated caller
+    cannot submit, cancel, or register.
+
+Worker subprocesses inherit the controller's environment, so spawned
+TaskManagers authenticate automatically; externally launched workers
+must carry the same secret (exactly the reference's shared-keytab
+deployment story).
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+from typing import Optional
+
+ENV_TOKEN = "FLINK_TPU_AUTH_TOKEN"
+ENV_TOKEN_FILE = "FLINK_TPU_AUTH_TOKEN_FILE"
+
+
+def get_token(config=None) -> Optional[str]:
+    """Resolve the shared secret: explicit config key
+    (``security.auth.token`` / ``security.auth.token-file``) wins over
+    the environment; None = auth disabled (open cluster, the default —
+    like the reference without a configured SecurityContext)."""
+    if config is not None:
+        tok = config.get_str("security.auth.token", "")
+        if tok:
+            return tok
+        path = config.get_str("security.auth.token-file", "")
+        if path:
+            with open(path) as f:
+                return f.read().strip()
+    tok = os.environ.get(ENV_TOKEN)
+    if tok:
+        return tok
+    path = os.environ.get(ENV_TOKEN_FILE)
+    if path:
+        with open(path) as f:
+            return f.read().strip()
+    return None
+
+
+def check(expected: Optional[str], req: dict) -> None:
+    """Server-side gate: raises PermissionError unless the request's
+    ``auth`` matches the configured token (no-op when auth is off)."""
+    if expected is None:
+        return
+    got = req.get("auth")
+    if not isinstance(got, str) or not hmac.compare_digest(got, expected):
+        raise PermissionError("control request rejected: bad auth token")
+
+
+def attach(req: dict, token: Optional[str]) -> dict:
+    if token is not None:
+        req = dict(req)
+        req["auth"] = token
+    return req

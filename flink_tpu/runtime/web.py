@@ -15,7 +15,12 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
                               (ref CheckpointStatsTracker + handlers/checkpoints/)
     /jobs/<jid>/plan          logical operator DAG (ref JobPlanHandler)
+    /jobs/<jid>/vertices      plan nodes + job throughput (ref JobDetailsHandler)
+    /jobs/<jid>/accumulators  user accumulators (ref JobAccumulatorsHandler)
+    /jobs/<jid>/config        execution config (ref JobConfigHandler)
     /jobs/<jid>/exceptions    failure causes (ref JobExceptionsHandler)
+    /joboverview[/running|/completed]  (ref CurrentJobsOverviewHandler)
+    /taskmanagers[/<id>]      device-slot view (ref TaskManagersHandler)
     /config                   effective configuration (ref JobManagerConfigHandler)
     /web                      single-page HTML dashboard over these routes
 """
@@ -95,6 +100,43 @@ class WebMonitor:
             }
         if path == "/jobs":
             return {"jobs": self.cluster.list_jobs()}
+        if path in ("/joboverview", "/joboverview/running",
+                    "/joboverview/completed"):
+            # ref CurrentJobsOverviewHandler + its running/completed splits
+            jobs = self.cluster.list_jobs()
+            running = [j for j in jobs if j["state"] == "RUNNING"]
+            done = [j for j in jobs if j["state"] != "RUNNING"]
+            if path.endswith("/running"):
+                return {"jobs": running}
+            if path.endswith("/completed"):
+                return {"jobs": done}
+            return {"running": running, "finished": done}
+        if path == "/taskmanagers":
+            # ref TaskManagersHandler: the in-process MiniCluster is one
+            # logical TM whose "slots" are the accelerator devices
+            import jax
+
+            devs = jax.devices()
+            return {"taskmanagers": [{
+                "id": "tm-local",
+                "path": "inprocess://minicluster",
+                "slotsNumber": len(devs),
+                "freeSlots": len(devs) - sum(
+                    j["state"] == "RUNNING"
+                    for j in self.cluster.list_jobs()
+                ),
+                "hardware": {
+                    "devices": [str(d) for d in devs],
+                    "platform": devs[0].platform if devs else "none",
+                },
+            }]}
+        m = re.fullmatch(r"/taskmanagers/([^/]+)", path)
+        if m:
+            tms = self._route("/taskmanagers")["taskmanagers"]
+            for tm in tms:
+                if tm["id"] == m.group(1):
+                    return tm
+            return None
         m = re.fullmatch(r"/jobs/([^/]+)", path)
         if m:
             try:
@@ -150,6 +192,56 @@ class WebMonitor:
             for sink in getattr(rec.env, "_sinks", []):
                 walk(sink)
             return {"jid": m.group(1), "plan": {"nodes": nodes}}
+        m = re.fullmatch(r"/jobs/([^/]+)/vertices", path)
+        if m:
+            # ref JobDetailsHandler's vertices array: the plan nodes with
+            # job-level throughput attached (the micro-batch design runs
+            # one fused step, so per-vertex counters collapse to the
+            # job's — served explicitly rather than faked per vertex)
+            plan = self._route(f"/jobs/{m.group(1)}/plan")
+            if plan is None:
+                return None
+            detail = self.cluster.job_detail(m.group(1))
+            return {
+                "jid": m.group(1),
+                "vertices": plan["plan"]["nodes"],
+                "job-metrics": detail.get("metrics", {}),
+            }
+        m = re.fullmatch(r"/jobs/([^/]+)/accumulators", path)
+        if m:
+            # ref JobAccumulatorsHandler
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            accs = {}
+            if rec.handle is not None and rec.handle.accumulator_results:
+                accs = rec.handle.accumulator_results
+            return {"job-accumulators": [], "user-task-accumulators": [
+                {"name": k, "value": str(v)} for k, v in sorted(accs.items())
+            ]}
+        m = re.fullmatch(r"/jobs/([^/]+)/config", path)
+        if m:
+            # ref JobConfigHandler: per-job execution configuration
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            env = rec.env
+            return {
+                "jid": m.group(1),
+                "name": rec.name,
+                "execution-config": {
+                    "execution-mode": "PIPELINED",
+                    "job-parallelism": getattr(env, "parallelism", 1),
+                    "max-parallelism": getattr(env, "max_parallelism", 128),
+                    "batch-size": getattr(env, "batch_size", None),
+                    "object-reuse-mode": False,
+                    "user-config": {
+                        k: str(v) for k, v in sorted(getattr(
+                            getattr(env, "config", None), "_data", {}
+                        ).items())
+                    },
+                },
+            }
         m = re.fullmatch(r"/jobs/([^/]+)/exceptions", path)
         if m:
             # ref JobExceptionsHandler

@@ -15,9 +15,16 @@ Lowering: a spout becomes a Source (next_tuple pull loop), a
 shuffle/global-grouped bolt a host flat_map in the pre-keyBy chain, and a
 fields-grouped bolt a keyed ProcessFunction over the grouping field —
 exactly the operator roles the reference's SpoutWrapper/BoltWrapper give
-them. Linear topologies (each bolt one upstream), the shape the
-reference's examples use; no acking (Flink checkpoints replace Storm's
-tuple tracking, as in the reference wrapper).
+them.
+
+DAG topologies (round 4, ref flink-storm-examples' multi-input shapes):
+multiple spouts, a bolt consuming SEVERAL upstreams (their streams union
+before the bolt, the FlinkTopology.createTopology merge), and fan-out
+(one component feeding several bolts; every leaf collects its own
+output). At most one fields-grouped bolt per topology, with a linear
+chain below it (one keyed stage per job — the SPMD executor's shape);
+richer keyed DAGs belong on the native DataStream API. No acking: Flink
+checkpoints replace Storm's tuple tracking, as in the reference wrapper.
 """
 
 from __future__ import annotations
@@ -71,17 +78,16 @@ class _BoltDecl:
     def __init__(self, name: str, bolt: BasicBolt):
         self.name = name
         self.bolt = bolt
-        self.upstream: Optional[str] = None
-        self.grouping: Optional[Tuple[str, Any]] = None
+        # per-edge groupings: [(upstream, kind, field)] — a bolt may
+        # subscribe to several components (ref InputDeclarer chaining)
+        self.inputs: List[Tuple[str, str, Any]] = []
 
     def shuffle_grouping(self, upstream: str) -> "_BoltDecl":
-        self.upstream = upstream
-        self.grouping = ("shuffle", None)
+        self.inputs.append((upstream, "shuffle", None))
         return self
 
     def global_grouping(self, upstream: str) -> "_BoltDecl":
-        self.upstream = upstream
-        self.grouping = ("global", None)
+        self.inputs.append((upstream, "global", None))
         return self
 
     def fields_grouping(self, upstream: str, field) -> "_BoltDecl":
@@ -93,8 +99,7 @@ class _BoltDecl:
                 f"fields_grouping takes a tuple position (int), got "
                 f"{field!r}; declare emissions positionally"
             )
-        self.upstream = upstream
-        self.grouping = ("fields", field)
+        self.inputs.append((upstream, "fields", field))
         return self
 
 
@@ -102,22 +107,37 @@ class TopologyBuilder:
     """ref TopologyBuilder.setSpout/setBolt."""
 
     def __init__(self):
-        self.spout_name: Optional[str] = None
-        self.spout: Optional[BasicSpout] = None
+        self.spouts: Dict[str, BasicSpout] = {}
         self.bolts: Dict[str, _BoltDecl] = {}
 
     def set_spout(self, name: str, spout: BasicSpout):
-        if self.spout is not None:
-            raise ValueError("one spout per topology (linear topologies)")
-        self.spout_name, self.spout = name, spout
+        if name in self.spouts or name in self.bolts:
+            raise ValueError(f"duplicate component id {name!r}")
+        self.spouts[name] = spout
         return self
 
     def set_bolt(self, name: str, bolt: BasicBolt) -> _BoltDecl:
-        if name in self.bolts or name == self.spout_name:
+        if name in self.bolts or name in self.spouts:
             raise ValueError(f"duplicate component id {name!r}")
         decl = _BoltDecl(name, bolt)
         self.bolts[name] = decl
         return decl
+
+
+def _bolt_flat_map(bolt: BasicBolt):
+    state = {"prepared": False}
+    coll = BoltCollector()
+
+    def fm(tup):
+        if not state["prepared"]:
+            bolt.prepare(coll)
+            state["prepared"] = True
+        coll.buf = []
+        bolt.execute(tuple(tup) if isinstance(tup, (tuple, list))
+                     else (tup,))
+        return list(coll.buf)
+
+    return fm
 
 
 class FlinkTopology:
@@ -125,54 +145,87 @@ class FlinkTopology:
     lowers the declared topology onto the DataStream API and executes."""
 
     def __init__(self, builder: TopologyBuilder):
-        if builder.spout is None:
-            raise ValueError("topology needs a spout")
+        if not builder.spouts:
+            raise ValueError("topology needs at least one spout")
         self.builder = builder
 
-    def _chain_order(self) -> List[_BoltDecl]:
-        """Topological order of the linear chain from the spout."""
-        by_upstream = {}
-        for d in self.builder.bolts.values():
-            if d.upstream is None:
+    def _topo_order(self) -> List[_BoltDecl]:
+        """Topological order of the bolt DAG; validates connectivity,
+        acyclicity, and the one-keyed-stage constraint."""
+        b = self.builder
+        for d in b.bolts.values():
+            if not d.inputs:
                 raise ValueError(f"bolt {d.name!r} has no grouping")
-            if d.upstream in by_upstream:
-                raise ValueError("linear topologies only (one consumer "
-                                 "per component)")
-            by_upstream[d.upstream] = d
-        chain, cur = [], self.builder.spout_name
-        while cur in by_upstream:
-            chain.append(by_upstream[cur])
-            cur = by_upstream[cur].name
-        if len(chain) != len(self.builder.bolts):
-            raise ValueError("disconnected bolts in topology")
-        return chain
+            for up, _k, _f in d.inputs:
+                if up not in b.spouts and up not in b.bolts:
+                    raise ValueError(
+                        f"bolt {d.name!r} subscribes to unknown "
+                        f"component {up!r}"
+                    )
+        order: List[_BoltDecl] = []
+        done = set(b.spouts)
+        remaining = dict(b.bolts)
+        while remaining:
+            ready = [
+                d for d in remaining.values()
+                if all(up in done for up, _k, _f in d.inputs)
+            ]
+            if not ready:
+                raise ValueError("topology contains a cycle")
+            for d in sorted(ready, key=lambda d: d.name):
+                order.append(d)
+                done.add(d.name)
+                del remaining[d.name]
+        keyed = [d for d in order if any(k == "fields" for _u, k, _f
+                                         in d.inputs)]
+        if len(keyed) > 1:
+            raise ValueError(
+                "at most one fields-grouped bolt per topology (one keyed "
+                "stage per job); use the DataStream API for richer shapes"
+            )
+        if keyed:
+            # everything downstream of the keyed bolt must be linear
+            kname = keyed[0].name
+            below = {kname}
+            for d in order:
+                ups = {u for u, _k, _f in d.inputs}
+                if ups & below:
+                    if len(d.inputs) > 1:
+                        raise ValueError(
+                            "the chain below a fields-grouped bolt must "
+                            "be linear (single-input bolts)"
+                        )
+                    below.add(d.name)
+        return order
 
     def execute(self, env, job_name: str = "storm-topology"):
-        """Run to completion; returns the collected output tuples of the
-        last component."""
+        """Run to completion. Returns the collected tuples of the single
+        leaf component, or {leaf_name: tuples} when the DAG fans out to
+        several leaves."""
         from flink_tpu.datastream.functions import ProcessFunction
         from flink_tpu.runtime.sinks import CollectSink
         from flink_tpu.runtime.sources import Source
 
-        chain = self._chain_order()   # validate before touching the env
+        order = self._topo_order()   # validate before touching the env
         builder = self.builder
 
         class _SpoutSource(Source):
-            def __init__(self):
+            def __init__(self, spout):
+                self.spout = spout
                 self.collector = SpoutCollector()
                 self._opened = False
                 self._done = False
 
             def open(self):
                 if not self._opened:
-                    builder.spout.open(self.collector)
+                    self.spout.open(self.collector)
                     self._opened = True
 
             def poll(self, max_records: int):
                 out = []
                 while len(out) < max_records and not self._done:
                     self.collector.buf = []
-                    alive = builder.spout.next_tuple()
+                    alive = self.spout.next_tuple()
                     out.extend(self.collector.buf)
                     if not alive:
                         self._done = True
@@ -184,36 +237,28 @@ class FlinkTopology:
             def restore_offsets(self, state):
                 pass
 
-        stream = env.add_source(_SpoutSource())
+        streams = {
+            name: env.add_source(_SpoutSource(spout))
+            for name, spout in builder.spouts.items()
+        }
 
-        def bolt_flat_map(bolt: BasicBolt):
-            state = {"prepared": False}
-            coll = BoltCollector()
-            bolt_ref = bolt
-
-            def fm(tup):
-                if not state["prepared"]:
-                    bolt_ref.prepare(coll)
-                    state["prepared"] = True
-                coll.buf = []
-                bolt_ref.execute(tuple(tup) if isinstance(tup, (tuple, list))
-                                 else (tup,))
-                return list(coll.buf)
-
-            return fm
-
-        sink = CollectSink()
-        i = 0
-        while i < len(chain):
-            decl = chain[i]
-            kind, field = decl.grouping
-            if kind in ("shuffle", "global"):
-                # operator chaining, like the reference wrapping the bolt
-                # as a chained flatMap
-                stream = stream.flat_map(bolt_flat_map(decl.bolt))
-                i += 1
+        for decl in order:
+            ups = [streams[u] for u, _k, _f in decl.inputs]
+            # multiple subscriptions union into one input stream (the
+            # reference unions the input DataStreams in createTopology)
+            stream = ups[0].union(*ups[1:]) if len(ups) > 1 else ups[0]
+            kinds = {k for _u, k, _f in decl.inputs}
+            if kinds <= {"shuffle", "global"}:
+                streams[decl.name] = stream.flat_map(
+                    _bolt_flat_map(decl.bolt)
+                )
                 continue
-            # fields grouping: keyed execution of THIS bolt
+            fields = {f for _u, k, f in decl.inputs if k == "fields"}
+            if len(fields) != 1 or kinds != {"fields"}:
+                raise ValueError(
+                    f"bolt {decl.name!r}: every subscription of a "
+                    f"fields-grouped bolt must use the same field position"
+                )
             bolt = decl.bolt
 
             class _KeyedBolt(ProcessFunction):
@@ -231,14 +276,22 @@ class FlinkTopology:
                     for t in self._coll.buf:
                         out.collect(t)
 
-            f = field
-            stream = stream.key_by(
+            f = fields.pop()
+            streams[decl.name] = stream.key_by(
                 lambda t, _f=f: t[_f]
             ).process(_KeyedBolt(bolt))
-            i += 1
-        stream.add_sink(sink)
-        job = env.execute(job_name)
-        builder.spout.close()
-        for d in chain:
+
+        consumed = {u for d in order for u, _k, _f in d.inputs}
+        leaves = [n for n in streams if n not in consumed]
+        sinks = {}
+        for n in leaves:
+            sinks[n] = CollectSink()
+            streams[n].add_sink(sinks[n])
+        env.execute(job_name)
+        for spout in builder.spouts.values():
+            spout.close()
+        for d in order:
             d.bolt.close()
-        return sink.results
+        if len(leaves) == 1:
+            return sinks[leaves[0]].results
+        return {n: s.results for n, s in sinks.items()}

@@ -615,7 +615,11 @@ class TableEnvironment:
                         return f"r_{name}"
                     return name
 
-                return re.sub(r"\b(\w+)\.(\w+)\b", sub, s)
+                # identifiers only: a decimal literal like 1.5 must NOT
+                # match as qual=1, name=5
+                return re.sub(
+                    r"\b([A-Za-z_]\w*)\.([A-Za-z_]\w*)\b", sub, s
+                )
 
             residual = _parse_expr(
                 " AND ".join(rw(c) for c in residual_sql)

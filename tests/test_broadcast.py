@@ -118,8 +118,7 @@ def test_device_broadcast_join_8_shards():
     tkeys = np.arange(0, 400, 2, dtype=np.int64)         # even keys only
     tvals = (tkeys * 0.5).astype(np.float32)
     keys = rng.integers(0, 300, 10_000).astype(np.int64)
-    vals = np.ones(10_000, np.float32)
-    joined, hit = broadcast_join(keys, vals, tkeys, tvals, ctx)
+    joined, hit = broadcast_join(keys, tkeys, tvals, ctx)
     # every lane — regardless of which shard probed it — joined against
     # the FULL table: evens matched with key*0.5, odds unmatched
     want_hit = (keys % 2 == 0) & (keys < 400)

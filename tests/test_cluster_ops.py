@@ -300,3 +300,53 @@ def test_web_plan_exceptions_config_routes():
         cluster.cancel(jid)
         cluster.wait(jid, 30)
         web.stop()
+
+
+def test_web_round4_handler_breadth():
+    """ref CurrentJobsOverviewHandler / TaskManagersHandler /
+    JobDetailsHandler vertices / JobAccumulatorsHandler / JobConfigHandler."""
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "breadth-job")
+    try:
+        time.sleep(0.5)
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        ov = get("/joboverview")
+        assert any(j["jid"] == jid for j in ov["running"])
+        assert get("/joboverview/running")["jobs"]
+        assert get("/joboverview/completed")["jobs"] == [
+            j for j in get("/jobs")["jobs"] if j["state"] != "RUNNING"
+        ]
+
+        tms = get("/taskmanagers")["taskmanagers"]
+        assert len(tms) == 1 and tms[0]["slotsNumber"] == 8
+        assert get("/taskmanagers/tm-local")["id"] == "tm-local"
+        try:
+            get("/taskmanagers/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+        verts = get(f"/jobs/{jid}/vertices")
+        assert {n["type"] for n in verts["vertices"]} >= {"Source", "Sink"}
+
+        acc = get(f"/jobs/{jid}/accumulators")
+        assert "user-task-accumulators" in acc
+
+        jcfg = get(f"/jobs/{jid}/config")["execution-config"]
+        assert jcfg["job-parallelism"] >= 1
+        assert "user-config" in jcfg
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+        web.stop()

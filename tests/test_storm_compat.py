@@ -4,6 +4,8 @@ canonical example) runs unchanged on the DataStream runtime.
 Ref: flink-contrib/flink-storm FlinkTopology/SpoutWrapper/BoltWrapper.
 """
 
+import pytest
+
 from flink_tpu import StreamExecutionEnvironment
 from flink_tpu.storm import BasicBolt, BasicSpout, FlinkTopology, \
     TopologyBuilder
@@ -75,3 +77,103 @@ def test_topology_validation():
         assert "grouping" in str(e)
     else:
         raise AssertionError("must refuse ungrouped bolts")
+
+
+# ------------------------------------------------------- DAG topologies (r4)
+class _ListSpout(BasicSpout):
+    def __init__(self, items):
+        self.items = list(items)
+        self.i = 0
+
+    def open(self, collector):
+        self.collector = collector
+
+    def next_tuple(self):
+        if self.i >= len(self.items):
+            return False
+        self.collector.emit(self.items[self.i])
+        self.i += 1
+        return True
+
+
+class _TagBolt(BasicBolt):
+    def __init__(self, tag):
+        self.tag = tag
+
+    def execute(self, tup):
+        self.collector.emit((self.tag,) + tup)
+
+
+class _CountBolt(BasicBolt):
+    def __init__(self):
+        self.counts = {}
+
+    def execute(self, tup):
+        w = tup[0]
+        self.counts[w] = self.counts.get(w, 0) + 1
+        self.collector.emit((w, self.counts[w]))
+
+
+def test_multi_spout_union_into_one_bolt():
+    """Two spouts feed one bolt — the createTopology union (ref
+    flink-storm-examples multi-input shapes)."""
+    b = TopologyBuilder()
+    b.set_spout("a", _ListSpout([("x",), ("y",)]))
+    b.set_spout("b", _ListSpout([("z",)]))
+    b.set_bolt("merge", _TagBolt("m")) \
+        .shuffle_grouping("a").shuffle_grouping("b")
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    out = FlinkTopology(b).execute(env)
+    assert sorted(out) == [("m", "x"), ("m", "y"), ("m", "z")]
+
+
+def test_fan_out_to_multiple_leaves():
+    b = TopologyBuilder()
+    b.set_spout("src", _ListSpout([("p",), ("q",)]))
+    b.set_bolt("left", _TagBolt("L")).shuffle_grouping("src")
+    b.set_bolt("right", _TagBolt("R")).shuffle_grouping("src")
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    out = FlinkTopology(b).execute(env)
+    assert set(out) == {"left", "right"}
+    assert sorted(out["left"]) == [("L", "p"), ("L", "q")]
+    assert sorted(out["right"]) == [("R", "p"), ("R", "q")]
+
+
+def test_multi_input_keyed_bolt():
+    """Two upstream bolts union into a fields-grouped counter."""
+    b = TopologyBuilder()
+    b.set_spout("s1", _ListSpout([("dog",), ("cat",)]))
+    b.set_spout("s2", _ListSpout([("dog",), ("dog",)]))
+    b.set_bolt("count", _CountBolt()) \
+        .fields_grouping("s1", 0).fields_grouping("s2", 0)
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    out = FlinkTopology(b).execute(env)
+    got = {}
+    for w, c in out:
+        got[w] = max(got.get(w, 0), c)
+    assert got == {"dog": 3, "cat": 1}
+
+
+def test_two_keyed_bolts_rejected():
+    b = TopologyBuilder()
+    b.set_spout("s", _ListSpout([("a",)]))
+    b.set_bolt("k1", _CountBolt()).fields_grouping("s", 0)
+    b.set_bolt("k2", _CountBolt()).fields_grouping("k1", 0)
+    with pytest.raises(ValueError, match="one fields-grouped"):
+        FlinkTopology(b)._topo_order()
+
+
+def test_cycle_rejected():
+    b = TopologyBuilder()
+    b.set_spout("s", _ListSpout([("a",)]))
+    b.set_bolt("b1", _TagBolt("1")).shuffle_grouping("s") \
+        .shuffle_grouping("b2")
+    b.set_bolt("b2", _TagBolt("2")).shuffle_grouping("b1")
+    with pytest.raises(ValueError, match="cycle"):
+        FlinkTopology(b)._topo_order()

@@ -348,3 +348,15 @@ def test_multi_key_equi_join():
         "SELECT v, w FROM a JOIN b ON a.k1 = b.k1 AND a.k2 = b.k2"
     )
     assert sorted(t.to_rows()) == [(20.0, 1.0), (30.0, 2.0)]
+
+
+def test_non_equi_residual_with_decimal_literal():
+    """Regression: a float literal in the ON residual must not be mangled
+    by the qualified-ref rewrite (1.5 is not qual=1, name=5)."""
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id FROM orders JOIN customers "
+        "ON orders.cust = customers.cust AND orders.amount > 14.5 "
+        "ORDER BY id"
+    )
+    assert [r["id"] for r in t.to_dicts()] == [2, 3, 4]
