@@ -40,6 +40,29 @@ class ExecutionEnvironment:
 
         return DataSet(self, run, "text_file")
 
+    def read_avro_file(self, path: str) -> DataSet:
+        """Avro object-container file -> records as dicts (ref
+        AvroInputFormat; spec-implemented codec, connectors/avro.py)."""
+        def run():
+            from flink_tpu.connectors.avro import AvroInputFormat
+
+            return AvroInputFormat(path).read_all()
+
+        return DataSet(self, run, "avro_file")
+
+    def read_jdbc(self, connection_factory, query: str,
+                  parameters=None) -> DataSet:
+        """Database query (splits per parameter tuple) -> row tuples
+        (ref JDBCInputFormat over DB-API, connectors/jdbc.py)."""
+        def run():
+            from flink_tpu.connectors.jdbc import DbApiInputFormat
+
+            return DbApiInputFormat(
+                connection_factory, query, parameters
+            ).read_all()
+
+        return DataSet(self, run, "jdbc")
+
     def read_csv_file(self, path: str, types=None, delimiter=",") -> DataSet:
         def run():
             from flink_tpu.core.filesystem import get_filesystem

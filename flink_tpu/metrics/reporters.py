@@ -144,16 +144,20 @@ def configure_reporters(registry: MetricRegistry, config
         for n in config.get_str("metrics.reporters", "").split(",")
         if n.strip()
     ]
-    started: List[ScheduledReporter] = []
+    # validate EVERY declared reporter before starting ANY thread: a
+    # later typo'd class must not leak already-started threads/sockets
+    # with no handle to stop them
     for name in names:
-        pre = f"metrics.reporter.{name}."
-        kind = config.get_str(pre + "class", "")
-        cls = _KINDS.get(kind)
-        if cls is None:
+        kind = config.get_str(f"metrics.reporter.{name}.class", "")
+        if kind not in _KINDS:
             raise ValueError(
                 f"metrics.reporter.{name}.class must be one of "
                 f"{sorted(_KINDS)}, got {kind!r}"
             )
+    started: List[ScheduledReporter] = []
+    for name in names:
+        pre = f"metrics.reporter.{name}."
+        cls = _KINDS[config.get_str(pre + "class", "")]
         if cls is StatsDReporter:
             rep = StatsDReporter(config.get_str(pre + "host", "127.0.0.1"),
                                  config.get_int(pre + "port", 8125))

@@ -1,0 +1,286 @@
+"""ResourceManager — slot accounting + placement over the worker fleet.
+
+The reference's FlinkResourceManager (flink-runtime/.../clusterframework/
+FlinkResourceManager.java:95) sits between the JobManager and the cluster
+framework: it tracks registered TaskManagers and their slots, satisfies
+slot requests, and asks the framework (YARN/Mesos) for more containers
+when the pool runs dry. TPU-native redesign: the resource unit is an
+ACCELERATOR LEASE — one worker process owning a device (or a virtual-mesh
+slice) for one job attempt — so a "slot" is a lease grant and scaling up
+means launching another worker process (the per-job container pattern the
+reference's YARN session uses, YarnFlinkResourceManager).
+
+Pieces:
+  * TaskManagerPool — registered executors with declared slot counts,
+    allocation/release bookkeeping, pending-request queue (ref
+    SlotManager in later reference versions; InstanceManager in 1.2).
+  * ResourceManager — placement policy over the pool + an optional
+    `launcher` callback standing in for the cluster framework: when a
+    request cannot be satisfied it may start a new worker
+    (ref FlinkResourceManager.requestNewWorkers).
+  * ProcessClusterResourceManager — binds the pool to a live
+    ProcessCluster: registration/death events feed the pool, placement
+    drives ProcessCluster.submit onto a chosen worker's environment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class TaskManagerInfo:
+    tm_id: str
+    slots: int
+    allocated: int = 0
+    tags: dict = field(default_factory=dict)   # e.g. {"host": ..., "devices": N}
+    registered_at: float = field(default_factory=time.time)
+
+    @property
+    def free(self) -> int:
+        return self.slots - self.allocated
+
+
+@dataclass
+class SlotRequest:
+    request_id: str
+    job_name: str
+    slots: int = 1
+
+
+@dataclass
+class SlotGrant:
+    request_id: str
+    tm_id: str
+    slots: int
+
+
+class TaskManagerPool:
+    """Slot bookkeeping (ref InstanceManager + slot availability)."""
+
+    def __init__(self):
+        self._tms: Dict[str, TaskManagerInfo] = {}
+        self._lock = threading.Lock()
+
+    def register(self, tm_id: str, slots: int, **tags):
+        if slots < 1:
+            raise ValueError("a TaskManager needs >= 1 slot")
+        with self._lock:
+            if tm_id in self._tms:
+                # re-registration keeps existing allocations (the worker
+                # proved liveness; its leases are still valid)
+                self._tms[tm_id].slots = slots
+                self._tms[tm_id].tags.update(tags)
+            else:
+                self._tms[tm_id] = TaskManagerInfo(tm_id, slots, tags=tags)
+
+    def unregister(self, tm_id: str) -> Optional[TaskManagerInfo]:
+        with self._lock:
+            return self._tms.pop(tm_id, None)
+
+    def allocate(self, slots: int = 1) -> Optional[str]:
+        """Pick the TM with the most free slots (spread placement, the
+        reference's default)."""
+        with self._lock:
+            best = None
+            for tm in self._tms.values():
+                if tm.free >= slots and (
+                    best is None or tm.free > best.free
+                ):
+                    best = tm
+            if best is None:
+                return None
+            best.allocated += slots
+            return best.tm_id
+
+    def release(self, tm_id: str, slots: int = 1):
+        with self._lock:
+            tm = self._tms.get(tm_id)
+            if tm is not None:
+                tm.allocated = max(0, tm.allocated - slots)
+
+    def overview(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"id": tm.tm_id, "slots": tm.slots, "free": tm.free,
+                 **tm.tags}
+                for tm in self._tms.values()
+            ]
+
+    @property
+    def total_free(self) -> int:
+        with self._lock:
+            return sum(tm.free for tm in self._tms.values())
+
+
+class ResourceManager:
+    """Placement + elastic scale-up (ref FlinkResourceManager.java:95).
+
+    `launcher(n)` is the cluster-framework seam: called when a request
+    cannot be satisfied, it should (asynchronously) bring up n more
+    workers which then register — exactly requestNewWorkers' contract.
+    Requests wait until a grant or timeout."""
+
+    def __init__(self, pool: Optional[TaskManagerPool] = None,
+                 launcher: Optional[Callable[[int], None]] = None):
+        self.pool = pool or TaskManagerPool()
+        self.launcher = launcher
+        self._pending: List[tuple] = []   # (SlotRequest, event, box)
+        self._lock = threading.Lock()
+        self._grants: Dict[str, SlotGrant] = {}
+        self.events: List[dict] = []
+
+    def _event(self, kind: str, **kw):
+        self.events.append({"event": kind, "t": time.time(), **kw})
+
+    def notify_registered(self, tm_id: str, slots: int, **tags):
+        self.pool.register(tm_id, slots, **tags)
+        self._event("tm-registered", tm=tm_id, slots=slots)
+        self._satisfy_pending()
+
+    def notify_dead(self, tm_id: str):
+        """DeathWatch feed: a dead TM's grants are void; jobs on it are
+        the restart machinery's problem (ProcessCluster), the RM just
+        reclaims the accounting."""
+        info = self.pool.unregister(tm_id)
+        if info is not None:
+            self._event("tm-dead", tm=tm_id, lost_slots=info.slots)
+
+    def request_slots(self, req: SlotRequest,
+                      timeout_s: float = 30.0) -> SlotGrant:
+        """Block until granted (or raise TimeoutError). Triggers the
+        launcher when the pool cannot satisfy the request now.
+
+        allocate-or-enqueue is ATOMIC under the RM lock — the same lock
+        _satisfy_pending allocates under — so a release landing between
+        a failed allocate and the enqueue cannot be lost (it either
+        precedes the allocate and satisfies it, or follows the enqueue
+        and finds the request pending)."""
+        ev = threading.Event()
+        box: dict = {}
+        with self._lock:
+            tm = self.pool.allocate(req.slots)
+            if tm is not None:
+                g = SlotGrant(req.request_id, tm, req.slots)
+                self._grants[req.request_id] = g
+            else:
+                self._pending.append((req, ev, box))
+        if tm is not None:
+            self._event("granted", request=req.request_id, tm=tm)
+            return SlotGrant(req.request_id, tm, req.slots)
+        if self.launcher is not None:
+            self._event("scale-up", want=req.slots)
+            self.launcher(req.slots)
+        if not ev.wait(timeout_s):
+            with self._lock:
+                self._pending = [
+                    p for p in self._pending if p[1] is not ev
+                ]
+                # the grant may have landed in the race window
+                if "grant" not in box:
+                    raise TimeoutError(
+                        f"no TaskManager could satisfy {req.slots} "
+                        f"slot(s) within {timeout_s}s "
+                        f"(pool free={self.pool.total_free})"
+                    )
+        return box["grant"]
+
+    def release(self, request_id: str):
+        g = self._grants.pop(request_id, None)
+        if g is not None:
+            self.pool.release(g.tm_id, g.slots)
+            self._event("released", request=request_id, tm=g.tm_id)
+            self._satisfy_pending()
+
+    def _satisfy_pending(self):
+        """Grant waiting requests. Allocation + pending-list mutation run
+        atomically under the RM lock so concurrent triggers (a release
+        racing a registration) cannot both allocate for one request."""
+        granted = []
+        with self._lock:
+            remaining = []
+            for req, ev, box in self._pending:
+                tm = self.pool.allocate(req.slots)
+                if tm is None:
+                    remaining.append((req, ev, box))
+                    continue
+                g = SlotGrant(req.request_id, tm, req.slots)
+                self._grants[req.request_id] = g
+                box["grant"] = g
+                granted.append((req, ev, tm))
+            self._pending = remaining
+        for req, ev, tm in granted:
+            self._event("granted", request=req.request_id, tm=tm)
+            ev.set()
+
+
+class ProcessClusterResourceManager:
+    """Admission control over a ProcessCluster's per-job worker
+    containers (ref YarnFlinkResourceManager: the container IS the
+    resource). One synthetic TaskManager per host models the machine's
+    accelerator capacity — at most `capacity` concurrent job-workers
+    hold a lease. submit_with_lease blocks for a free lease before
+    spawning; a job's lease is released when its worker reaches a
+    TERMINAL state (FINISHED/FAILED/gave-up) in the cluster's event log
+    — a mid-job death-and-respawn keeps the lease, matching the
+    reference's container retention across task restarts."""
+
+    def __init__(self, cluster, capacity: int = 1,
+                 host_id: str = "accelerator-pool"):
+        self.cluster = cluster
+        self.rm = ResourceManager()
+        self.rm.notify_registered(host_id, capacity)
+        self._seen_events = 0
+        self._leases: Dict[str, str] = {}   # worker_id -> request_id
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher = threading.Thread(
+            target=self._watch, daemon=True, name="resource-manager-watch"
+        )
+        self._watcher.start()
+
+    def _watch(self):
+        while not self._stop.wait(0.1):
+            self.poll_events()
+
+    def poll_events(self):
+        events = self.cluster.events
+        while self._seen_events < len(events):
+            e = events[self._seen_events]
+            self._seen_events += 1
+            terminal = (
+                e["event"] == "gave-up"
+                or (e["event"] == "status"
+                    and e.get("status") in ("FINISHED", "FAILED"))
+            )
+            if terminal:
+                self._release_worker(e.get("worker"))
+
+    def _release_worker(self, worker_id):
+        with self._lock:
+            req_id = self._leases.pop(worker_id, None)
+        if req_id is not None:
+            self.rm.release(req_id)
+
+    def stop(self):
+        self._stop.set()
+
+    def submit_with_lease(self, builder_ref: str, job_name: str,
+                          checkpoint_dir: str, timeout_s: float = 30.0,
+                          extra_env: Optional[dict] = None) -> str:
+        """Grant-then-place: the job only spawns once a lease is held, so
+        the accelerator is never oversubscribed by concurrent submits."""
+        req = SlotRequest(f"req-{job_name}-{time.time_ns()}", job_name)
+        self.rm.request_slots(req, timeout_s=timeout_s)
+        try:
+            wid = self.cluster.submit(builder_ref, job_name,
+                                      checkpoint_dir, extra_env=extra_env)
+        except Exception:
+            self.rm.release(req.request_id)
+            raise
+        with self._lock:
+            self._leases[wid] = req.request_id
+        return wid

@@ -121,10 +121,13 @@ class WebMonitor:
                 "id": "tm-local",
                 "path": "inprocess://minicluster",
                 "slotsNumber": len(devs),
-                "freeSlots": len(devs) - sum(
+                # clamped: concurrent jobs can exceed devices (each runs
+                # SPMD over all of them), and the reference shape
+                # guarantees 0..slotsNumber
+                "freeSlots": max(0, len(devs) - sum(
                     j["state"] == "RUNNING"
                     for j in self.cluster.list_jobs()
-                ),
+                )),
                 "hardware": {
                     "devices": [str(d) for d in devs],
                     "platform": devs[0].platform if devs else "none",

@@ -178,6 +178,17 @@ class FlinkTopology:
                 del remaining[d.name]
         keyed = [d for d in order if any(k == "fields" for _u, k, _f
                                          in d.inputs)]
+        for d in keyed:
+            # consistency validated HERE, before execute() touches the
+            # env: a failure mid-lowering would leave orphan sources
+            kinds = {k for _u, k, _f in d.inputs}
+            fields = {f for _u, k, f in d.inputs if k == "fields"}
+            if kinds != {"fields"} or len(fields) != 1:
+                raise ValueError(
+                    f"bolt {d.name!r}: every subscription of a fields-"
+                    f"grouped bolt must use fields grouping on the same "
+                    f"field position"
+                )
         if len(keyed) > 1:
             raise ValueError(
                 "at most one fields-grouped bolt per topology (one keyed "
@@ -253,12 +264,8 @@ class FlinkTopology:
                     _bolt_flat_map(decl.bolt)
                 )
                 continue
+            # consistency already validated by _topo_order
             fields = {f for _u, k, f in decl.inputs if k == "fields"}
-            if len(fields) != 1 or kinds != {"fields"}:
-                raise ValueError(
-                    f"bolt {decl.name!r}: every subscription of a "
-                    f"fields-grouped bolt must use the same field position"
-                )
             bolt = decl.bolt
 
             class _KeyedBolt(ProcessFunction):
