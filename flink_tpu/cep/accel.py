@@ -25,13 +25,14 @@ kernels removed HeapReducingState.add.
 
 Eligibility (executor falls back to the host operator otherwise):
 processing-time mode (arrival order; the event-time buffer-and-sort
-drain stays host-side), single logical shard. within() IS supported
-(round 4): partial counts are bucketed by start-time pane on device
-(cep/device.py ring rotation = expiry), semantics equal to the host NFA
-on pane-quantized timestamps (cep.device.within-buckets config, default
-8 buckets per within horizon). Checkpoint/savepoint/restore are fully
-supported (snapshot()/restore() below; the barrier is the step
-boundary).
+drain stays host-side). within() IS supported (round 4): partial counts
+are bucketed by start-time pane on device (cep/device.py ring rotation
+= expiry), semantics equal to the host NFA on pane-quantized timestamps
+(cep.device.within-buckets config, default 8 buckets per within
+horizon). parallelism>1 shards the count-NFA over the mesh by key group
+(round 4, n_shards; replicate-and-mask + one psum). Checkpoint/
+savepoint/restore are fully supported (snapshot()/restore() below; the
+barrier is the step boundary).
 
 Memory note: a key's compacted events stay buffered while it has live
 partials that could still complete (exactly the events the reference's
@@ -116,10 +117,20 @@ def batch_gaps(inv: np.ndarray, hit: np.ndarray,
 
 class DeviceCepOperator:
     """Keyed CEP over micro-batches: device count-NFA detection + lazy
-    host replay extraction. One instance per job (single logical shard)."""
+    host replay extraction. One instance per job.
+
+    n_shards > 1 (round 4): the count-NFA state shards over the device
+    mesh by key group — each shard holds its keys' tables and carry
+    vectors and masks the batch to the key groups it owns
+    (replicate-and-mask, the same exchange the window kernels default
+    from at small batch); per-lane completion deltas are disjoint across
+    shards, so one psum over the mesh axis reassembles the global [B]
+    delta. The host side (compacted buffers, replay extraction) stays a
+    single process, exactly like the executor's windowed path."""
 
     def __init__(self, pattern: Pattern, capacity: int = 1 << 16,
-                 probe_len: int = 16, within_buckets: int = 8):
+                 probe_len: int = 16, within_buckets: int = 8,
+                 n_shards: int = 1, max_parallelism: int = 128):
         self.pattern = pattern
         self.spec = DevicePatternSpec.from_pattern(
             pattern, within_buckets=within_buckets
@@ -128,11 +139,17 @@ class DeviceCepOperator:
         self.stages = pattern.stages
         self.codec = KeyCodec()
         self.capacity = 1 << max(1, int(capacity) - 1).bit_length()
-        self.state: CepShardState = init_state(self.capacity, probe_len,
-                                               self.spec)
-        self._advance = jax.jit(
-            advance, static_argnums=1, donate_argnums=0
-        )
+        self.n_shards = n_shards
+        self.max_parallelism = max_parallelism
+        if n_shards > 1:
+            self._init_sharded(probe_len)
+        else:
+            self.state: CepShardState = init_state(
+                self.capacity, probe_len, self.spec
+            )
+            self._advance = jax.jit(
+                advance, static_argnums=1, donate_argnums=0
+            )
         # per-key host side (keyed by the 64-bit codec hash; original key
         # objects ride inside the buffered events for match extraction)
         self.buffers: Dict[int, List[Tuple[Any, bool, int]]] = {}
@@ -146,9 +163,86 @@ class DeviceCepOperator:
         # timestamps fit the device's int32 pane arithmetic
         self._pane_origin: Optional[int] = None
 
+    def _init_sharded(self, probe_len: int):
+        """Build the SPMD advance step: state sharded [S, ...] over the
+        mesh, batch replicated, key-group masking per shard, deltas
+        reassembled with one psum."""
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from flink_tpu.core.keygroups import assign_to_key_group
+        from flink_tpu.ops.hashing import route_hash
+        from flink_tpu.parallel.mesh import SHARD_AXIS, MeshContext
+
+        ctx = MeshContext.create(self.n_shards, self.max_parallelism)
+        self._ctx = ctx
+        starts, ends = ctx.kg_bounds()
+        starts_j = jnp.asarray(starts)
+        ends_j = jnp.asarray(ends)
+        spec = self.spec
+        maxp = self.max_parallelism
+        # `capacity` is PER SHARD (matching env.state_capacity_per_shard
+        # and the single-shard path)
+        cap_per_shard = self.capacity
+
+        def shard_body(state, kg_start, kg_end, hi, lo, masks, valid,
+                       pane):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            kg_start, kg_end = kg_start[0], kg_end[0]
+            kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+            mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+                kg <= kg_end.astype(jnp.uint32)
+            )
+            state, delta, _tot = advance(state, spec, hi, lo, masks,
+                                         mine, pane)
+            # owned lanes are disjoint across shards: psum reassembles
+            total_delta = jax.lax.psum(delta, SHARD_AXIS)
+            return (
+                jax.tree_util.tree_map(lambda x: x[None], state),
+                total_delta,
+            )
+
+        sharded = shard_map(
+            shard_body, mesh=ctx.mesh,
+            in_specs=(
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(), P(), P(), P(), P(),
+            ),
+            out_specs=(P(SHARD_AXIS), P()),
+            check_vma=False,
+        )
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, hi, lo, masks, valid, pane):
+            return sharded(state, starts_j, ends_j, hi, lo, masks, valid,
+                           pane)
+
+        def sharded_init():
+            st = init_state(cap_per_shard, probe_len, spec)
+            return jax.tree_util.tree_map(lambda x: x[None], st)
+
+        init_fn = jax.jit(shard_map(
+            sharded_init, mesh=ctx.mesh, in_specs=(),
+            out_specs=P(SHARD_AXIS), check_vma=False,
+        ))
+        self.state = init_fn()
+        self._sharded_step = step
+
+        def adv(state, _spec, hi, lo, masks, valid, pane):
+            st, delta = step(state, hi, lo, masks, valid, pane)
+            # the caller discards the per-batch total; never pay an
+            # extra eager device op for it on the hot path (per-op
+            # dispatch latency is the cost model on this runtime)
+            return st, delta, None
+
+        self._advance = adv
+
     @property
     def dropped_capacity(self) -> int:
-        return int(np.asarray(self.state.dropped_capacity))
+        return int(np.asarray(self.state.dropped_capacity).sum())
 
     def _masks(self, elements: Sequence) -> np.ndarray:
         S = len(self.stages)
@@ -241,6 +335,8 @@ class DeviceCepOperator:
             # cep.device.within-buckets would reinterpret the ring
             "pane_ms": self.spec.pane_ms,
             "within_panes": self.spec.within_panes,
+            "n_shards": self.n_shards,
+            "max_parallelism": self.max_parallelism,
         }
 
     def restore(self, snap: dict):
@@ -250,6 +346,20 @@ class DeviceCepOperator:
             raise ValueError(
                 f"device CEP capacity mismatch: snapshot {snap['capacity']} "
                 f"vs configured {self.capacity}"
+            )
+        if snap.get("n_shards", 1) != self.n_shards:
+            raise ValueError(
+                f"device CEP shard-count mismatch: snapshot has "
+                f"{snap.get('n_shards', 1)} shard(s), job configured for "
+                f"{self.n_shards} — restore with the same parallelism"
+            )
+        snap_maxp = snap.get("max_parallelism", self.max_parallelism)
+        if snap_maxp != self.max_parallelism:
+            # the key-group routing baked into shard tables would silently
+            # misroute keys (same contract as the executor's keyed paths)
+            raise ValueError(
+                f"device CEP max-parallelism mismatch: snapshot "
+                f"{snap_maxp} vs configured {self.max_parallelism}"
             )
         snap_pane = (snap.get("pane_ms", self.spec.pane_ms),
                      snap.get("within_panes", self.spec.within_panes))
@@ -328,10 +438,11 @@ class DeviceCepOperator:
         rather than swallowed). One device fetch per call."""
         if not (self.buffers or self.partials or self.trailing):
             return []
-        tk, occ = jax.device_get(
-            (self.state.table.keys, self.state.table.used_mask())
-        )
-        tk, occ = np.asarray(tk), np.asarray(occ)
+        from flink_tpu.ops.hashtable import EMPTY
+
+        # flattens both layouts: single-shard [C, 2] and sharded [S, C, 2]
+        tk = np.asarray(jax.device_get(self.state.table.keys)).reshape(-1, 2)
+        occ = ~np.all(tk == EMPTY, axis=1)
         k64 = (tk[:, 0].astype(np.uint64) << np.uint64(32)) | \
             tk[:, 1].astype(np.uint64)
         in_table = set(int(v) for v in k64[occ])

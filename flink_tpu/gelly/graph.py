@@ -244,11 +244,7 @@ class Graph:
     def triangle_count(self) -> int:
         """ref TriangleEnumerator/Count: A ⊙ (A @ A) over the symmetric
         adjacency — a dense MXU matmul for small/medium graphs."""
-        V = self.num_vertices
-        A = jnp.zeros((V, V), jnp.float32)
-        A = A.at[self.src, self.dst].set(1.0)
-        A = jnp.maximum(A, A.T)
-        A = A * (1 - jnp.eye(V))
+        A = _sym_adjacency(self)
         tri = jnp.sum(A * (A @ A)) / 6.0
         return int(tri)
 
@@ -321,10 +317,7 @@ class Graph:
         connected vertex pair — dense A@A over the symmetric adjacency
         (one MXU matmul), results for edges only."""
         V = self.num_vertices
-        A = jnp.zeros((V, V), jnp.float32)
-        A = A.at[self.src, self.dst].set(1.0)
-        A = jnp.maximum(A, A.T)
-        A = A * (1 - jnp.eye(V))
+        A = _sym_adjacency(self)
         common = A @ A                     # [V,V] shared-neighbor counts
         deg = jnp.sum(A, axis=1)
         union = deg[:, None] + deg[None, :] - common
@@ -401,3 +394,219 @@ class Graph:
             jnp.asarray(d[keep].astype(np.int32)),
             jnp.asarray(ev[keep]), self.ids,
         )
+
+
+# -- round-4 library breadth: neighborhood reduces, clustering metrics,
+# -- similarity, and graph mutations (ref flink-gelly Graph.java
+# -- reduceOnEdges/reduceOnNeighbors, library/clustering +
+# -- library/similarity, addVertex/removeVertex/addEdge/removeEdge)
+def _neighbor_reduce(graph: "Graph", values_per_edge, combine: str,
+                     neutral: float):
+    """Segment-reduce per-edge values onto their DESTINATION vertex —
+    one scatter, the Sum half of GSA (shared by the methods below)."""
+    V = graph.num_vertices
+    from flink_tpu.ops.segment import scatter_combine
+
+    acc = jnp.full((V,), neutral, jnp.float32)
+    return scatter_combine(
+        acc, graph.dst, values_per_edge.astype(jnp.float32),
+        jnp.ones_like(graph.dst, bool),
+        {"sum": "add", "min": "min", "max": "max"}[combine],
+    )
+
+
+def _ext_reduce_on_edges(self, combine: str = "sum",
+                         direction: str = "in") -> Dict[Any, float]:
+    """ref Graph.reduceOnEdges(EdgesFunction): per-vertex reduce of edge
+    VALUES over its in-/out-/all edges."""
+    ev = (self.edge_values if self.edge_values is not None
+          else jnp.ones_like(self.src, jnp.float32))
+    neutral = {"sum": 0.0, "min": np.inf, "max": -np.inf}[combine]
+    g = {"in": self, "out": self.reverse(),
+         "all": None}.get(direction, "bad")
+    if g == "bad":
+        raise ValueError("direction must be in|out|all")
+    if g is None:
+        both = Graph(self.vertex_values,
+                     jnp.concatenate([self.src, self.dst]),
+                     jnp.concatenate([self.dst, self.src]),
+                     jnp.concatenate([ev, ev]), self.ids)
+        return both.reduce_on_edges(combine, "in")
+    out = _neighbor_reduce(g, ev, combine, neutral)
+    return self._resolve(out)
+
+
+def _ext_reduce_on_neighbors(self, combine: str = "sum",
+                             direction: str = "in") -> Dict[Any, float]:
+    """ref Graph.reduceOnNeighbors(ReduceNeighborsFunction): per-vertex
+    reduce of NEIGHBOR vertex values."""
+    neutral = {"sum": 0.0, "min": np.inf, "max": -np.inf}[combine]
+    if direction == "all":
+        both = Graph(self.vertex_values,
+                     jnp.concatenate([self.src, self.dst]),
+                     jnp.concatenate([self.dst, self.src]),
+                     None, self.ids)
+        return both.reduce_on_neighbors(combine, "in")
+    g = {"in": self, "out": self.reverse()}.get(direction)
+    if g is None:
+        raise ValueError("direction must be in|out|all")
+    vals = g.vertex_values[g.src]
+    out = _neighbor_reduce(g, vals, combine, neutral)
+    return self._resolve(out)
+
+
+def _sym_adjacency(self) -> jnp.ndarray:
+    """Symmetric simple-graph adjacency [V, V] (duplicates collapse via
+    set, self-loops masked) — the ONE recipe shared by every dense
+    metric (triangle_count, jaccard_index, clustering coefficients,
+    adamic_adar), so adjacency semantics cannot drift between them."""
+    V = self.num_vertices
+    A = jnp.zeros((V, V), jnp.float32)
+    A = A.at[self.src, self.dst].set(1.0)
+    A = jnp.maximum(A, A.T)
+    return A * (1 - jnp.eye(V))
+
+
+def _ext_local_clustering_coefficient(self) -> Dict[Any, float]:
+    """ref library/clustering LocalClusteringCoefficient: per vertex,
+    2 * triangles(v) / (deg(v) * (deg(v) - 1)) over the undirected
+    simple graph. Triangle counting per vertex via the dense adjacency
+    matmul A @ A (MXU work) masked by A."""
+    A = _sym_adjacency(self)
+    paths2 = A @ A                      # [V, V] 2-paths between pairs
+    tri_v = jnp.sum(paths2 * A, axis=1) / 2.0   # triangles through v
+    deg = jnp.sum(A, axis=1)
+    denom = deg * (deg - 1.0)
+    coef = jnp.where(denom > 0, 2.0 * tri_v / denom, 0.0)
+    return self._resolve(coef)
+
+
+def _ext_global_clustering_coefficient(self) -> float:
+    """ref library/clustering GlobalClusteringCoefficient:
+    3 * triangles / open-or-closed triplets."""
+    A = _sym_adjacency(self)
+    tri = float(jnp.trace(A @ A @ A)) / 6.0
+    deg = jnp.sum(A, axis=1)
+    triplets = float(jnp.sum(deg * (deg - 1.0))) / 2.0
+    return 3.0 * tri / triplets if triplets else 0.0
+
+
+def _ext_adamic_adar(self) -> Dict[Tuple[Any, Any], float]:
+    """ref library/similarity AdamicAdar: for vertex pairs sharing >= 1
+    neighbor, sum of 1/log(deg(shared neighbor)) — computed as one
+    weighted adjacency matmul (A_w = A / log deg broadcast)."""
+    V = self.num_vertices
+    A = _sym_adjacency(self)
+    deg = jnp.sum(A, axis=1)
+    w = jnp.where(deg > 1, 1.0 / jnp.log(jnp.maximum(deg, 2.0)), 0.0)
+    S = A @ (A * w[:, None])           # S[i,j] = sum_k A[i,k] w[k] A[k,j]
+    S = np.asarray(S)
+    ids = self.ids if self.ids is not None else np.arange(V)
+    out = {}
+    ii, jj = np.nonzero(np.triu(S, k=1) > 1e-9)
+    adj = np.asarray(A) > 0
+    for i, j in zip(ii.tolist(), jj.tolist()):
+        if not adj[i, j]:              # score only non-adjacent pairs
+            out[(ids[i], ids[j])] = float(S[i, j])
+    return out
+
+
+def _ext_add_edges(self, edges, edge_values=None) -> "Graph":
+    """ref Graph.addEdges: endpoints must already exist (unknown ids
+    raise, matching the reference's semantics of ignoring invalid
+    edges loudly rather than silently here)."""
+    ids = self.ids if self.ids is not None else np.arange(self.num_vertices)
+    index = {k: i for i, k in enumerate(ids.tolist())}
+    try:
+        s = np.asarray([index[a] for a, _b in edges], np.int32)
+        d = np.asarray([index[b] for _a, b in edges], np.int32)
+    except KeyError as e:
+        raise ValueError(f"add_edges: unknown vertex {e.args[0]!r}; "
+                         f"add_vertices first") from None
+    ev = self.edge_values
+    if ev is not None or edge_values is not None:
+        old = (np.asarray(ev) if ev is not None
+               else np.ones(self.num_edges, np.float32))
+        new = (np.asarray(edge_values, np.float32)
+               if edge_values is not None
+               else np.ones(len(edges), np.float32))
+        ev = jnp.asarray(np.concatenate([old, new]))
+    return Graph(
+        self.vertex_values,
+        jnp.concatenate([self.src, jnp.asarray(s)]),
+        jnp.concatenate([self.dst, jnp.asarray(d)]),
+        ev, self.ids,
+    )
+
+
+def _ext_add_vertices(self, new_ids, values=None) -> "Graph":
+    ids = self.ids if self.ids is not None else np.arange(self.num_vertices)
+    existing = set(ids.tolist())
+    new_ids = list(new_ids)
+    if values is not None and len(values) != len(new_ids):
+        raise ValueError(
+            f"add_vertices: {len(new_ids)} ids but {len(values)} values"
+        )
+    keep = [j for j, i in enumerate(new_ids) if i not in existing]
+    fresh = [new_ids[j] for j in keep]
+    if not fresh:
+        return self
+    # values selected BY POSITION OF THE SURVIVING IDS — a duplicate id
+    # must not shift its neighbor's value onto the wrong vertex
+    vals = (np.asarray(values, np.float32)[keep]
+            if values is not None else np.zeros(len(fresh), np.float32))
+    return Graph(
+        jnp.concatenate([self.vertex_values, jnp.asarray(vals)]),
+        self.src, self.dst, self.edge_values,
+        np.concatenate([np.asarray(ids, object),
+                        np.asarray(fresh, object)]),
+    )
+
+
+def _ext_remove_vertices(self, victim_ids) -> "Graph":
+    """ref Graph.removeVertices: drops the vertices AND every incident
+    edge, recompacting indices."""
+    ids = self.ids if self.ids is not None else np.arange(self.num_vertices)
+    victims = set(victim_ids)
+    keep_mask = np.asarray([i not in victims for i in ids.tolist()])
+    remap = np.cumsum(keep_mask) - 1
+    s = np.asarray(self.src)
+    d = np.asarray(self.dst)
+    ekeep = keep_mask[s] & keep_mask[d]
+    ev = self.edge_values
+    return Graph(
+        jnp.asarray(np.asarray(self.vertex_values)[keep_mask]),
+        jnp.asarray(remap[s[ekeep]].astype(np.int32)),
+        jnp.asarray(remap[d[ekeep]].astype(np.int32)),
+        jnp.asarray(np.asarray(ev)[ekeep]) if ev is not None else None,
+        np.asarray(ids, object)[keep_mask],
+    )
+
+
+def _ext_remove_edges(self, edges) -> "Graph":
+    ids = self.ids if self.ids is not None else np.arange(self.num_vertices)
+    index = {k: i for i, k in enumerate(ids.tolist())}
+    drop = {(index.get(a, -1), index.get(b, -2)) for a, b in edges}
+    s = np.asarray(self.src)
+    d = np.asarray(self.dst)
+    keep = np.asarray([
+        (int(a), int(b)) not in drop for a, b in zip(s, d)
+    ])
+    ev = self.edge_values
+    return Graph(
+        self.vertex_values,
+        jnp.asarray(s[keep]), jnp.asarray(d[keep]),
+        jnp.asarray(np.asarray(ev)[keep]) if ev is not None else None,
+        self.ids,
+    )
+
+
+Graph.reduce_on_edges = _ext_reduce_on_edges
+Graph.reduce_on_neighbors = _ext_reduce_on_neighbors
+Graph.local_clustering_coefficient = _ext_local_clustering_coefficient
+Graph.global_clustering_coefficient = _ext_global_clustering_coefficient
+Graph.adamic_adar = _ext_adamic_adar
+Graph.add_edges = _ext_add_edges
+Graph.add_vertices = _ext_add_vertices
+Graph.remove_vertices = _ext_remove_vertices
+Graph.remove_edges = _ext_remove_edges

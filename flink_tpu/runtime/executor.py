@@ -2085,13 +2085,14 @@ class LocalExecutor:
         the pattern fits its representation (VERDICT r2 item 3; ref
         NFA.java:132 in production position, BASELINE config #5).
 
-        Host-NFA fallback (the generality path) when: event-time — the
-        buffer-and-sort watermark drain is host-side; parallelism>1 —
-        single logical shard for now. within() runs on device since
-        round 4 (pane-bucketed partial expiry, cep/device.py); semantics
-        equal the host NFA on pane-quantized timestamps, so a job
-        needing millisecond-exact within boundaries can force the host
-        path with cep.device.enabled=false. Checkpoint/savepoint/restore
+        Host-NFA fallback (the generality path) only when: event-time —
+        the buffer-and-sort watermark drain is host-side — or
+        cep.device.enabled=false (the explicit escape hatch, e.g. for
+        millisecond-exact within() boundaries). within() runs on device
+        since round 4 (pane-bucketed partial expiry, cep/device.py;
+        semantics equal the host NFA on pane-quantized timestamps), and
+        parallelism>1 shards the count-NFA state over the mesh by key
+        group (DeviceCepOperator n_shards). Checkpoint/savepoint/restore
         and queryable state are supported on the device path (parity
         with _run_process); a checkpoint written by one path cannot be
         restored by the other (validated, clear error). The engine that
@@ -2103,7 +2104,6 @@ class LocalExecutor:
         ok = (
             isinstance(fn, CEPProcessFunction)
             and not fn.event_time
-            and self.env.parallelism == 1
             and self.env.config.get_bool("cep.device.enabled", True)
         )
         if ok and restore_from:
@@ -2130,12 +2130,17 @@ class LocalExecutor:
         env = self.env
         fn = pipe.process.fn
         metrics.cep_engine = "device"
+        n_shards = max(1, min(env.parallelism, len(jax.devices())))
         op = DeviceCepOperator(
             fn.pattern,
             capacity=env.state_capacity_per_shard or (1 << 16),
             within_buckets=env.config.get_int(
                 "cep.device.within-buckets", 8
             ),
+            # parallelism > 1: key-group shards over the mesh
+            # (replicate-and-mask; VERDICT r3 item 6 multi-shard)
+            n_shards=n_shards,
+            max_parallelism=env.max_parallelism,
         )
         key_selector = pipe.key_by.key_selector
         select_fn = fn.select_fn

@@ -425,3 +425,84 @@ def test_match_completing_on_prune_step_is_emitted():
     assert sorted(sink.results) == [(100, 101), (100, 201), (200, 201)]
     assert job.metrics.cep_matches_detected == \
         job.metrics.cep_matches_extracted == 3
+
+
+# --------------------------------------------------- multi-shard device CEP
+def _rand_events(n=400, keys=24, seed=5):
+    rng = __import__("numpy").random.default_rng(seed)
+    names = ["a", "b", "x"]
+    return [
+        Event(i, names[int(rng.integers(0, 3))], int(rng.integers(0, keys)))
+        for i in range(n)
+    ]
+
+
+def _run_cep_job(events, parallelism, pattern=None):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 64
+    env.set_parallelism(parallelism)
+    env.set_max_parallelism(64)
+    sink = CollectSink()
+    pattern = pattern or (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    stream = env.from_collection(events).key_by(lambda e: e.value)
+    CEP.pattern(stream, pattern).select(
+        lambda m: (m["a"].value, m["a"].ts, m["b"].ts)
+    ).add_sink(sink)
+    job = env.execute(f"cep-p{parallelism}")
+    assert job.metrics.cep_engine == "device"
+    assert job.metrics.cep_device_steps > 0
+    assert (job.metrics.cep_matches_detected
+            == job.metrics.cep_matches_extracted)
+    return sorted(sink.results)
+
+
+def test_multi_shard_matches_single_shard():
+    """8 key-group shards over the virtual mesh produce exactly the
+    single-shard match set (VERDICT r3 item 6: multi-shard device CEP)."""
+    events = _rand_events()
+    assert _run_cep_job(events, 8) == _run_cep_job(events, 1)
+
+
+def test_multi_shard_with_within():
+    """within() under sharding, DETERMINISTIC timestamps: the job path
+    stamps batches with wall-clock processing time (so match counts
+    legitimately vary with execution speed), so this drives the operator
+    directly with explicit batch timestamps and compares 8 shards vs 1."""
+    from flink_tpu.cep.accel import DeviceCepOperator
+
+    events = _rand_events(n=300, keys=10, seed=9)
+    pat = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .next("b").where(lambda e: e.name == "b").within(50)
+    )
+
+    def run(n_shards):
+        op = DeviceCepOperator(pat, capacity=64, n_shards=n_shards,
+                               max_parallelism=64)
+        got = []
+        for off in range(0, len(events), 48):
+            chunk = events[off:off + 48]
+            got.extend(op.process_batch(
+                chunk, [e.value for e in chunk], ts=chunk[0].ts
+            ))
+        assert op.matches_detected == op.matches_extracted
+        return sorted(
+            (m["a"].value, m["a"].ts, m["b"].ts) for m in got
+        ), op.matches_detected
+
+    r8, n8 = run(8)
+    r1, n1 = run(1)
+    assert r8 == r1 and n8 == n1 and n8 > 0
+
+
+def test_shard_count_restore_mismatch_rejected():
+    from flink_tpu.cep.accel import DeviceCepOperator
+
+    pat = Pattern.begin("a").where(lambda e: e.name == "a")
+    op1 = DeviceCepOperator(pat, capacity=64, n_shards=1)
+    op8 = DeviceCepOperator(pat, capacity=64, n_shards=8)
+    with pytest.raises(ValueError, match="shard-count"):
+        op8.restore(op1.snapshot())

@@ -7,6 +7,7 @@ flink-optimizer Optimizer.java:396 (+ JoinHint).
 """
 
 import numpy as np
+import pytest
 
 from flink_tpu.gelly import Graph
 
@@ -144,3 +145,83 @@ def test_outer_join_semantics_stable_under_either_build_side():
             key=lambda t: t[0],
         )
         assert out == [(1, None), (2, "x"), (3, None)], hint
+
+
+# ----------------------------------------------------- gelly breadth (r4)
+def _square_with_diagonal():
+    # square a-b-c-d-a plus diagonal a-c: two triangles (abc, acd)
+    from flink_tpu.gelly.graph import Graph
+
+    return Graph.from_edge_list(
+        [("a", "b"), ("b", "c"), ("c", "d"), ("d", "a"), ("a", "c")],
+        undirected=True,
+    )
+
+
+def test_clustering_coefficients():
+    g = _square_with_diagonal()
+    local = g.local_clustering_coefficient()
+    # a: deg 3, 2 triangles through it -> 2*2/(3*2) = 2/3; b: deg 2,
+    # 1 triangle -> 1.0; same for d; c symmetric to a
+    assert abs(local["a"] - 2 / 3) < 1e-6
+    assert abs(local["b"] - 1.0) < 1e-6
+    assert abs(local["c"] - 2 / 3) < 1e-6
+    assert abs(local["d"] - 1.0) < 1e-6
+    # global: 2 triangles, triplets = sum C(deg,2) = 3+1+3+1 = 8
+    assert abs(g.global_clustering_coefficient() - 6 / 8) < 1e-6
+
+
+def test_adamic_adar_scores_non_adjacent_pairs():
+    g = _square_with_diagonal()
+    aa = g.adamic_adar()
+    # only non-adjacent pair is (b, d): shared neighbors a and c, both
+    # degree 3 -> 2 / ln(3)
+    assert set(aa) == {("b", "d")}
+    assert abs(aa[("b", "d")] - 2 / np.log(3)) < 1e-6
+
+
+def test_reduce_on_edges_and_neighbors():
+    from flink_tpu.gelly.graph import Graph
+
+    g = Graph.from_edge_list(
+        [("a", "b"), ("a", "c"), ("b", "c")],
+        edge_values=[1.0, 2.0, 4.0],
+        vertex_init=lambda k: {"a": 10.0, "b": 20.0, "c": 30.0}[k],
+    )
+    assert g.reduce_on_edges("sum", "in") == {"a": 0, "b": 1.0, "c": 6.0}
+    assert g.reduce_on_edges("sum", "out") == {"a": 3.0, "b": 4.0, "c": 0}
+    assert g.reduce_on_edges("max", "all")["a"] == 2.0
+    # neighbor VALUES: in-neighbors of c are a and b
+    assert g.reduce_on_neighbors("sum", "in")["c"] == 30.0
+    assert g.reduce_on_neighbors("min", "all")["b"] == 10.0
+
+
+def test_graph_mutations():
+    from flink_tpu.gelly.graph import Graph
+
+    g = Graph.from_edge_list([("a", "b"), ("b", "c")])
+    g2 = g.add_vertices(["d"]).add_edges([("c", "d")])
+    assert g2.num_vertices == 4 and g2.num_edges == 3
+    assert g2.out_degrees()["c"] == 1
+    with pytest.raises(ValueError, match="unknown vertex"):
+        g2.add_edges([("a", "zzz")])
+    g3 = g2.remove_vertices(["b"])
+    assert g3.num_vertices == 3 and g3.num_edges == 1   # only c->d left
+    assert set(g3.out_degrees()) == {"a", "c", "d"}
+    g4 = g2.remove_edges([("b", "c")])
+    assert g4.num_edges == 2
+
+
+def test_add_vertices_value_alignment():
+    """Regression: values align to their ids when some ids already exist."""
+    from flink_tpu.gelly.graph import Graph
+
+    g = Graph.from_edge_list([("a", "b")])
+    g2 = g.add_vertices(["a", "e"], values=[5.0, 7.0])
+    vals = dict(zip(
+        (g2.ids if g2.ids is not None else range(g2.num_vertices)).tolist(),
+        np.asarray(g2.vertex_values).tolist(),
+    ))
+    assert vals["e"] == 7.0
+    with pytest.raises(ValueError, match="values"):
+        g.add_vertices(["x", "y"], values=[1.0])
