@@ -1,0 +1,50 @@
+"""Dynamic rule enrichment via the broadcast state pattern (ref
+KeyedBroadcastProcessFunction — the canonical rules+events shape):
+a control stream of (currency, rate) updates broadcast to every parallel
+instance; the keyed payment stream converts amounts with the LATEST
+rates and flags currencies without one."""
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.datastream.functions import KeyedBroadcastProcessFunction
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.state.descriptors import MapStateDescriptor
+
+RATES = [("EUR", 1.09), ("GBP", 1.27), ("JPY", 0.0067)]
+PAYMENTS = [
+    ("EUR", 100.0), ("GBP", 250.0), ("JPY", 10000.0),
+    ("EUR", 42.0), ("CHF", 7.0),        # CHF has no rate yet
+]
+
+
+class ConvertToUsd(KeyedBroadcastProcessFunction):
+    def process_element(self, payment, ctx, out):
+        currency, amount = payment
+        rate = ctx.broadcast_state("rates").get(currency)
+        if rate is None:
+            out.collect(("UNPRICED", currency, amount))
+        else:
+            out.collect(("USD", currency, round(amount * rate, 2)))
+
+    def process_broadcast_element(self, update, ctx, out):
+        currency, rate = update
+        ctx.broadcast_state("rates")[currency] = rate
+
+
+def main():
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(1)
+    env.batch_size = 4
+    sink = CollectSink()
+    rates = env.from_collection(RATES)
+    payments = env.from_collection(PAYMENTS).key_by(lambda p: p[0])
+    desc = MapStateDescriptor("rates", str, float)
+    payments.connect(rates.broadcast(desc)).process(
+        ConvertToUsd()
+    ).add_sink(sink)
+    env.execute("dynamic-rules")
+    for row in sink.results:
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""SQL analytics with the round-4 expression breadth: scalar functions,
+non-equi join residuals, and EXPLAIN physical plans (ref flink-table's
+WordCountSQL + the Calcite operator table slice)."""
+
+from flink_tpu.table.table import TableEnvironment
+
+ORDERS = {
+    "id": [1, 2, 3, 4, 5],
+    "cust": [10, 20, 10, 30, 20],
+    "amount": [99.5, 15.0, 250.0, 75.0, 300.0],
+    "ts": [0, 3_600_000, 7_200_000, 86_400_000, 90_000_000],
+    "note": [" rush ", "std", "RUSH", "std", "bulk "],
+}
+CUSTOMERS = {
+    "cust": [10, 20, 30],
+    "name": ["ada", "bob", "cyd"],
+    "credit": [100.0, 400.0, 50.0],
+}
+
+QUERY = (
+    "SELECT UPPER(name) AS who, ROUND(amount, 0) AS amt, "
+    "EXTRACT(DAY FROM ts) AS d, TRIM(note) AS note "
+    "FROM orders JOIN customers ON orders.cust = customers.cust "
+    "AND orders.amount < customers.credit "
+    "WHERE NOT note LIKE '%bulk%' "
+    "ORDER BY amt DESC LIMIT 3"
+)
+
+
+def main():
+    tenv = TableEnvironment.create()
+    tenv.register_table("orders", tenv.from_columns(ORDERS))
+    tenv.register_table("customers", tenv.from_columns(CUSTOMERS))
+    print(tenv.explain(QUERY))
+    print()
+    for row in tenv.sql_query(QUERY).to_dicts():
+        print(row)
+
+
+if __name__ == "__main__":
+    main()

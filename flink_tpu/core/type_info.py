@@ -1,0 +1,261 @@
+"""TypeInformation + extraction — the TypeExtractor analog (SURVEY §2.1,
+ref flink-core api/common/typeinfo/TypeInformation.java +
+api/java/typeutils/TypeExtractor.java).
+
+The reference walks Java generics/POJO fields to build a TypeInformation
+tree that picks serializers and comparators. The Python analog extracts
+the same tree two ways:
+
+  * ``of(sample)``   — from a runtime value (TypeExtractor.getForObject):
+    scalars -> BasicTypeInfo, numpy arrays -> PrimitiveArrayTypeInfo,
+    tuples -> TupleTypeInfo, NamedTuples/dataclasses -> RowTypeInfo (the
+    PojoTypeInfo role: named, typed fields), dicts -> MapTypeInfo,
+    lists -> ListTypeInfo, anything else -> GenericTypeInfo (the
+    Kryo-fallback role, served by the registry's pickle fallback).
+  * ``from_hint(tp)`` — from a typing annotation
+    (TypeExtractor.createTypeInfo): ``int``, ``Tuple[int, str]``,
+    ``List[float]``, ``Dict[str, int]``, ``Optional[T]``.
+
+``create_serializer(registry)`` binds the tree to the job's
+SerializerRegistry (TypeInformation.createSerializer), and flat numeric
+rows expose ``to_schema()`` — the bridge onto the columnar RecordBatch
+layout the device path consumes (core/types.Schema).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.core.types import Field, Schema
+
+_BASIC_DTYPES = {
+    bool: np.dtype(bool),
+    int: np.dtype(np.int64),
+    float: np.dtype(np.float64),
+    str: None,
+    bytes: None,
+}
+
+
+class TypeInformation:
+    """Base (ref TypeInformation.java): arity + serializer binding."""
+
+    @property
+    def arity(self) -> int:
+        return 1
+
+    def create_serializer(self, registry):
+        """Default: the registry's envelope dispatch handles the value
+        (TypeInformation.createSerializer)."""
+        return registry
+
+    def to_schema(self) -> Schema:
+        raise TypeError(f"{self} has no flat columnar schema")
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __hash__(self):
+        return hash(repr(self))
+
+
+@dataclass(frozen=True, eq=False)
+class BasicTypeInfo(TypeInformation):
+    """ref BasicTypeInfo: the primitive leaf types."""
+
+    py_type: type
+
+    def __repr__(self):
+        return f"Basic<{self.py_type.__name__}>"
+
+    @property
+    def np_dtype(self):
+        return _BASIC_DTYPES[self.py_type]
+
+
+@dataclass(frozen=True, eq=False)
+class PrimitiveArrayTypeInfo(TypeInformation):
+    """ref PrimitiveArrayTypeInfo: fixed-dtype numpy arrays."""
+
+    dtype: Any
+    shape: Tuple[int, ...] = ()
+
+    def __repr__(self):
+        return f"Array<{np.dtype(self.dtype).name}{list(self.shape)}>"
+
+
+@dataclass(frozen=True, eq=False)
+class TupleTypeInfo(TypeInformation):
+    """ref TupleTypeInfo: positional composite."""
+
+    types: Tuple[TypeInformation, ...]
+
+    @property
+    def arity(self) -> int:
+        return len(self.types)
+
+    def __repr__(self):
+        return f"Tuple<{', '.join(map(repr, self.types))}>"
+
+    def to_schema(self) -> Schema:
+        fields = []
+        for i, t in enumerate(self.types):
+            if not isinstance(t, BasicTypeInfo) or t.np_dtype is None:
+                raise TypeError(
+                    f"field {i} ({t!r}) is not a numeric scalar; no "
+                    f"columnar schema"
+                )
+            fields.append(Field(f"f{i}", t.np_dtype))
+        return Schema(tuple(fields))
+
+
+@dataclass(frozen=True, eq=False)
+class RowTypeInfo(TypeInformation):
+    """ref RowTypeInfo / PojoTypeInfo: NAMED, typed fields (extracted
+    from NamedTuples, dataclasses, or given explicitly)."""
+
+    names: Tuple[str, ...]
+    types: Tuple[TypeInformation, ...]
+
+    @property
+    def arity(self) -> int:
+        return len(self.types)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}: {t!r}" for n, t in zip(self.names,
+                                                        self.types))
+        return f"Row<{inner}>"
+
+    def to_schema(self) -> Schema:
+        fields = []
+        for n, t in zip(self.names, self.types):
+            if isinstance(t, BasicTypeInfo) and t.np_dtype is not None:
+                fields.append(Field(n, t.np_dtype))
+            elif isinstance(t, PrimitiveArrayTypeInfo):
+                fields.append(Field(n, np.dtype(t.dtype), t.shape))
+            else:
+                raise TypeError(
+                    f"field {n!r} ({t!r}) is not columnar-layout eligible"
+                )
+        return Schema(tuple(fields))
+
+
+@dataclass(frozen=True, eq=False)
+class ListTypeInfo(TypeInformation):
+    element: TypeInformation
+
+    def __repr__(self):
+        return f"List<{self.element!r}>"
+
+
+@dataclass(frozen=True, eq=False)
+class MapTypeInfo(TypeInformation):
+    key: TypeInformation
+    value: TypeInformation
+
+    def __repr__(self):
+        return f"Map<{self.key!r}, {self.value!r}>"
+
+
+@dataclass(frozen=True, eq=False)
+class GenericTypeInfo(TypeInformation):
+    """ref GenericTypeInfo: the Kryo-fallback role — the registry's
+    pickle fallback (or a user-registered serializer) handles it."""
+
+    py_type: type
+
+    def __repr__(self):
+        return f"Generic<{self.py_type.__name__}>"
+
+
+def of(value: Any) -> TypeInformation:
+    """Extract from a sample value (ref TypeExtractor.getForObject)."""
+    if isinstance(value, bool):
+        return BasicTypeInfo(bool)
+    if isinstance(value, int):
+        return BasicTypeInfo(int)
+    if isinstance(value, float):
+        return BasicTypeInfo(float)
+    if isinstance(value, str):
+        return BasicTypeInfo(str)
+    if isinstance(value, bytes):
+        return BasicTypeInfo(bytes)
+    if isinstance(value, np.generic):
+        return PrimitiveArrayTypeInfo(value.dtype, ())
+    if isinstance(value, np.ndarray):
+        return PrimitiveArrayTypeInfo(value.dtype, tuple(value.shape))
+    if isinstance(value, tuple):
+        fields = getattr(value, "_fields", None)
+        if fields is not None:          # NamedTuple -> named row
+            return RowTypeInfo(tuple(fields),
+                               tuple(of(v) for v in value))
+        return TupleTypeInfo(tuple(of(v) for v in value))
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        fs = dataclasses.fields(value)
+        return RowTypeInfo(
+            tuple(f.name for f in fs),
+            tuple(of(getattr(value, f.name)) for f in fs),
+        )
+    if isinstance(value, dict):
+        if value:
+            k, v = next(iter(value.items()))
+            return MapTypeInfo(of(k), of(v))
+        return MapTypeInfo(GenericTypeInfo(object), GenericTypeInfo(object))
+    if isinstance(value, list):
+        return ListTypeInfo(
+            of(value[0]) if value else GenericTypeInfo(object)
+        )
+    return GenericTypeInfo(type(value))
+
+
+def from_hint(tp) -> TypeInformation:
+    """Extract from a typing annotation (ref TypeExtractor.createTypeInfo)."""
+    if tp in _BASIC_DTYPES:
+        return BasicTypeInfo(tp)
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is tuple:
+        if len(args) == 2 and args[1] is Ellipsis:
+            return ListTypeInfo(from_hint(args[0]))
+        return TupleTypeInfo(tuple(from_hint(a) for a in args))
+    if origin is list:
+        return ListTypeInfo(from_hint(args[0]) if args
+                            else GenericTypeInfo(object))
+    if origin is dict:
+        if args:
+            return MapTypeInfo(from_hint(args[0]), from_hint(args[1]))
+        return MapTypeInfo(GenericTypeInfo(object), GenericTypeInfo(object))
+    import types as _types
+
+    if origin is typing.Union or origin is getattr(_types, "UnionType",
+                                                   None):
+        # Optional[T]: the reference treats nullable fields as T
+        non_none = [a for a in args if a is not type(None)]
+        if len(non_none) == 1:
+            return from_hint(non_none[0])
+        return GenericTypeInfo(object)
+    if isinstance(tp, type):
+        if issubclass(tp, tuple) and hasattr(tp, "_fields"):
+            hints = typing.get_type_hints(tp)
+            return RowTypeInfo(
+                tuple(tp._fields),
+                tuple(from_hint(hints.get(f, object))
+                      if hints.get(f) is not None else GenericTypeInfo(object)
+                      for f in tp._fields),
+            )
+        if dataclasses.is_dataclass(tp):
+            hints = typing.get_type_hints(tp)
+            fs = dataclasses.fields(tp)
+            return RowTypeInfo(
+                tuple(f.name for f in fs),
+                tuple(from_hint(hints[f.name]) for f in fs),
+            )
+        if tp is np.ndarray:
+            return PrimitiveArrayTypeInfo(np.float32, ())
+        return GenericTypeInfo(tp)
+    return GenericTypeInfo(object)

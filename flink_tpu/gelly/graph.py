@@ -415,6 +415,18 @@ def _neighbor_reduce(graph: "Graph", values_per_edge, combine: str,
     )
 
 
+def _drop_edgeless(orig: "Graph", g: "Graph", out) -> Dict[Any, float]:
+    """The reference's reduceOnEdges/reduceOnNeighbors emit NO result for
+    vertices without edges in the requested direction; the scatter
+    neutral (inf/-inf/0) must not leak into the user-facing dict."""
+    has = np.zeros(orig.num_vertices, bool)
+    has[np.asarray(g.dst)] = True
+    full = orig._resolve(out)
+    ids = (orig.ids if orig.ids is not None
+           else np.arange(orig.num_vertices))
+    return {k: v for k, v, h in zip(ids.tolist(), full.values(), has) if h}
+
+
 def _ext_reduce_on_edges(self, combine: str = "sum",
                          direction: str = "in") -> Dict[Any, float]:
     """ref Graph.reduceOnEdges(EdgesFunction): per-vertex reduce of edge
@@ -433,7 +445,7 @@ def _ext_reduce_on_edges(self, combine: str = "sum",
                      jnp.concatenate([ev, ev]), self.ids)
         return both.reduce_on_edges(combine, "in")
     out = _neighbor_reduce(g, ev, combine, neutral)
-    return self._resolve(out)
+    return _drop_edgeless(self, g, out)
 
 
 def _ext_reduce_on_neighbors(self, combine: str = "sum",
@@ -452,7 +464,7 @@ def _ext_reduce_on_neighbors(self, combine: str = "sum",
         raise ValueError("direction must be in|out|all")
     vals = g.vertex_values[g.src]
     out = _neighbor_reduce(g, vals, combine, neutral)
-    return self._resolve(out)
+    return _drop_edgeless(self, g, out)
 
 
 def _sym_adjacency(self) -> jnp.ndarray:
@@ -547,7 +559,12 @@ def _ext_add_vertices(self, new_ids, values=None) -> "Graph":
         raise ValueError(
             f"add_vertices: {len(new_ids)} ids but {len(values)} values"
         )
-    keep = [j for j, i in enumerate(new_ids) if i not in existing]
+    seen = set(existing)
+    keep = []
+    for j, i in enumerate(new_ids):
+        if i not in seen:                # dedup within new_ids too
+            seen.add(i)
+            keep.append(j)
     fresh = [new_ids[j] for j in keep]
     if not fresh:
         return self
@@ -591,7 +608,7 @@ def _ext_remove_edges(self, edges) -> "Graph":
     d = np.asarray(self.dst)
     keep = np.asarray([
         (int(a), int(b)) not in drop for a, b in zip(s, d)
-    ])
+    ], bool)                             # explicit dtype: E == 0 edges
     ev = self.edge_values
     return Graph(
         self.vertex_values,

@@ -1,0 +1,73 @@
+"""Distance metrics — the flink-ml metrics.distances package analog
+(ref flink-libraries/flink-ml/.../metrics/distances/: Euclidean,
+SquaredEuclidean, Manhattan, Chebyshev, Minkowski, Cosine, Tanimoto).
+
+Each metric is a vectorized pairwise function: distance(A [n, d],
+B [m, d]) -> [n, m], one fused XLA program (the reference computes one
+scalar per vector pair in a JVM UDF)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _ab(a, b):
+    A = jnp.asarray(a, jnp.float32)
+    B = jnp.asarray(b, jnp.float32)
+    if A.ndim == 1:
+        A = A[None, :]
+    if B.ndim == 1:
+        B = B[None, :]
+    return A, B
+
+
+def squared_euclidean_distance(a, b) -> np.ndarray:
+    A, B = _ab(a, b)
+    sq = (
+        jnp.sum(A * A, axis=1)[:, None]
+        + jnp.sum(B * B, axis=1)[None, :]
+        - 2.0 * A @ B.T
+    )
+    return np.asarray(jnp.maximum(sq, 0.0))
+
+
+def euclidean_distance(a, b) -> np.ndarray:
+    return np.sqrt(squared_euclidean_distance(a, b))
+
+
+def manhattan_distance(a, b) -> np.ndarray:
+    A, B = _ab(a, b)
+    return np.asarray(jnp.sum(jnp.abs(A[:, None, :] - B[None, :, :]),
+                              axis=2))
+
+
+def chebyshev_distance(a, b) -> np.ndarray:
+    A, B = _ab(a, b)
+    return np.asarray(jnp.max(jnp.abs(A[:, None, :] - B[None, :, :]),
+                              axis=2))
+
+
+def minkowski_distance(a, b, p: float = 3.0) -> np.ndarray:
+    A, B = _ab(a, b)
+    return np.asarray(
+        jnp.sum(jnp.abs(A[:, None, :] - B[None, :, :]) ** p, axis=2)
+        ** (1.0 / p)
+    )
+
+
+def cosine_distance(a, b) -> np.ndarray:
+    A, B = _ab(a, b)
+    na = jnp.linalg.norm(A, axis=1)[:, None]
+    nb = jnp.linalg.norm(B, axis=1)[None, :]
+    sim = (A @ B.T) / jnp.maximum(na * nb, 1e-12)
+    return np.asarray(1.0 - sim)
+
+
+def tanimoto_distance(a, b) -> np.ndarray:
+    A, B = _ab(a, b)
+    dot = A @ B.T
+    na = jnp.sum(A * A, axis=1)[:, None]
+    nb = jnp.sum(B * B, axis=1)[None, :]
+    sim = dot / jnp.maximum(na + nb - dot, 1e-12)
+    return np.asarray(1.0 - sim)

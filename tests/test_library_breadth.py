@@ -188,8 +188,10 @@ def test_reduce_on_edges_and_neighbors():
         edge_values=[1.0, 2.0, 4.0],
         vertex_init=lambda k: {"a": 10.0, "b": 20.0, "c": 30.0}[k],
     )
-    assert g.reduce_on_edges("sum", "in") == {"a": 0, "b": 1.0, "c": 6.0}
-    assert g.reduce_on_edges("sum", "out") == {"a": 3.0, "b": 4.0, "c": 0}
+    # reference semantics: NO result for vertices without edges in the
+    # requested direction (a has no in-edges, c no out-edges)
+    assert g.reduce_on_edges("sum", "in") == {"b": 1.0, "c": 6.0}
+    assert g.reduce_on_edges("sum", "out") == {"a": 3.0, "b": 4.0}
     assert g.reduce_on_edges("max", "all")["a"] == 2.0
     # neighbor VALUES: in-neighbors of c are a and b
     assert g.reduce_on_neighbors("sum", "in")["c"] == 30.0
@@ -225,3 +227,85 @@ def test_add_vertices_value_alignment():
     assert vals["e"] == 7.0
     with pytest.raises(ValueError, match="values"):
         g.add_vertices(["x", "y"], values=[1.0])
+
+
+# ------------------------------------------------------- ml breadth (r4)
+def test_gradient_descent_losses_and_penalties():
+    """ref optimization/GradientDescent + LossFunction +
+    RegularizationPenalty: recover a known linear model; L1 zeroes
+    irrelevant coordinates."""
+    from flink_tpu.ml.optimization import (
+        GradientDescent,
+        HingeLoss,
+        L1Regularization,
+        LogisticLoss,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 3)).astype(np.float32)
+    w_true = np.array([2.0, -1.0, 0.0], np.float32)
+    y = X @ w_true + 0.5
+
+    gd = GradientDescent(iterations=400, stepsize=0.5)
+    w, b = gd.optimize(X, y)
+    assert np.allclose(w, w_true, atol=0.05) and abs(b - 0.5) < 0.05
+    assert gd.empirical_loss(X, y, w, b) < 1e-3
+
+    # L1 drives the dead coordinate to exactly zero
+    gd1 = GradientDescent(penalty=L1Regularization(), regularization=0.02,
+                          iterations=400, stepsize=0.5)
+    w1, _ = gd1.optimize(X, y)
+    assert w1[2] == 0.0 and abs(w1[0] - 2.0) < 0.2
+
+    # classification losses separate a linearly separable set
+    yc = np.where(X[:, 0] > 0, 1.0, -1.0).astype(np.float32)
+    for loss in (HingeLoss(), LogisticLoss()):
+        wc, bc = GradientDescent(loss=loss, iterations=300,
+                                 stepsize=1.0).optimize(X, yc)
+        acc = np.mean(np.sign(X @ wc + bc) == yc)
+        assert acc > 0.97, (type(loss).__name__, acc)
+
+
+def test_distance_metrics():
+    from flink_tpu.ml import metrics as dm
+
+    a = np.array([[0.0, 0.0], [1.0, 1.0]])
+    b = np.array([[3.0, 4.0]])
+    assert np.allclose(dm.euclidean_distance(a, b), [[5.0],
+                                                     [np.sqrt(13)]])
+    assert np.allclose(dm.squared_euclidean_distance(a, b), [[25.0],
+                                                             [13.0]])
+    assert np.allclose(dm.manhattan_distance(a, b), [[7.0], [5.0]])
+    assert np.allclose(dm.chebyshev_distance(a, b), [[4.0], [3.0]])
+    assert np.allclose(
+        dm.minkowski_distance(a, b, 2.0), dm.euclidean_distance(a, b)
+    )
+    # cosine: parallel vectors have distance 0
+    assert abs(dm.cosine_distance([[2.0, 0.0]], [[5.0, 0.0]])[0, 0]) < 1e-6
+    assert abs(dm.tanimoto_distance([[1.0, 1.0]], [[1.0, 1.0]])[0, 0]) < 1e-6
+
+
+def test_libsvm_round_trip(tmp_path):
+    from flink_tpu.ml.utils import read_libsvm, write_libsvm
+
+    X = np.array([[0.0, 2.5, 0.0], [1.0, 0.0, -3.0]], np.float32)
+    y = np.array([1.0, -1.0], np.float32)
+    p = str(tmp_path / "data.svm")
+    write_libsvm(p, X, y)
+    X2, y2 = read_libsvm(p)
+    assert np.allclose(X2, X) and np.allclose(y2, y)
+    # 1-based index validation
+    (tmp_path / "bad.svm").write_text("1.0 0:5.0\n")
+    with pytest.raises(ValueError, match="1-based"):
+        read_libsvm(str(tmp_path / "bad.svm"))
+
+
+def test_remove_edges_on_empty_and_duplicate_add_vertices():
+    from flink_tpu.gelly.graph import Graph
+
+    g = Graph.from_edge_list([("a", "b")])
+    g0 = g.remove_edges([("a", "b")])
+    assert g0.num_edges == 0
+    assert g0.remove_edges([("a", "b")]).num_edges == 0  # E == 0 safe
+    g2 = g.add_vertices(["e", "e"], values=[1.0, 2.0])
+    assert g2.num_vertices == 3                          # one 'e' only

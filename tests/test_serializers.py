@@ -375,3 +375,95 @@ def test_latency_samples_bounded_and_accurate():
     p99 = ls.percentile(99)
     true_p99 = float(np.percentile(vals, 99))
     assert abs(p99 - true_p99) / true_p99 < 0.05
+
+
+# ----------------------------------------------- TypeExtractor analog (r4)
+def test_type_extraction_from_samples():
+    from collections import namedtuple
+    from dataclasses import dataclass
+
+    from flink_tpu.core import type_info as ti
+
+    assert ti.of(3) == ti.BasicTypeInfo(int)
+    assert ti.of(True) == ti.BasicTypeInfo(bool)       # bool before int
+    assert ti.of(1.5) == ti.BasicTypeInfo(float)
+    assert ti.of("x") == ti.BasicTypeInfo(str)
+    t = ti.of((1, "a", 2.0))
+    assert isinstance(t, ti.TupleTypeInfo) and t.arity == 3
+
+    Point = namedtuple("Point", ["x", "y"])
+    r = ti.of(Point(1.0, 2.0))
+    assert isinstance(r, ti.RowTypeInfo)
+    assert r.names == ("x", "y")
+
+    @dataclass
+    class Ev:
+        key: int
+        value: float
+
+    r2 = ti.of(Ev(1, 2.0))
+    assert r2.names == ("key", "value")
+    assert r2.types == (ti.BasicTypeInfo(int), ti.BasicTypeInfo(float))
+
+    arr = ti.of(np.zeros((4, 2), np.float32))
+    assert isinstance(arr, ti.PrimitiveArrayTypeInfo)
+    assert arr.shape == (4, 2)
+
+    m = ti.of({"a": 1})
+    assert m == ti.MapTypeInfo(ti.BasicTypeInfo(str), ti.BasicTypeInfo(int))
+
+    class Weird:
+        pass
+
+    assert isinstance(ti.of(Weird()), ti.GenericTypeInfo)
+
+
+def test_type_extraction_from_hints():
+    from typing import Dict, List, Optional, Tuple
+
+    from flink_tpu.core import type_info as ti
+
+    assert ti.from_hint(int) == ti.BasicTypeInfo(int)
+    t = ti.from_hint(Tuple[int, str])
+    assert t == ti.TupleTypeInfo((ti.BasicTypeInfo(int),
+                                  ti.BasicTypeInfo(str)))
+    assert ti.from_hint(List[float]) == ti.ListTypeInfo(
+        ti.BasicTypeInfo(float)
+    )
+    assert ti.from_hint(Dict[str, int]) == ti.MapTypeInfo(
+        ti.BasicTypeInfo(str), ti.BasicTypeInfo(int)
+    )
+    # Optional[T] -> T (nullable fields keep their base type)
+    assert ti.from_hint(Optional[int]) == ti.BasicTypeInfo(int)
+    # Tuple[int, ...] -> homogeneous list
+    assert ti.from_hint(Tuple[int, ...]) == ti.ListTypeInfo(
+        ti.BasicTypeInfo(int)
+    )
+
+
+def test_type_info_schema_bridge_and_serializer_binding():
+    """Flat numeric rows bridge onto the columnar Schema the device path
+    consumes; every extracted type round-trips through the registry."""
+    from collections import namedtuple
+
+    from flink_tpu.core import type_info as ti
+    from flink_tpu.core.serializers import SerializerRegistry
+
+    Ev = namedtuple("Ev", ["key", "value"])
+    row = ti.of(Ev(1, 2.0))
+    sch = row.to_schema()
+    assert sch.names() == ["key", "value"]
+    assert sch.fields[0].dtype == np.dtype(np.int64)
+
+    # non-columnar rows refuse a schema loudly
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError, match="columnar"):
+        ti.of(("a", object())).to_schema()
+
+    reg = SerializerRegistry()
+    for sample in (7, 3.5, "s", b"b", (1, "x"), [1, 2], {"k": 1.0}):
+        t = ti.of(sample)
+        bound = t.create_serializer(reg)
+        blob = bound.dumps_typed(sample)
+        assert bound.loads_typed(blob) == sample
