@@ -1,8 +1,14 @@
 """Dynamic rule enrichment via the broadcast state pattern (ref
 KeyedBroadcastProcessFunction — the canonical rules+events shape):
 a control stream of (currency, rate) updates broadcast to every parallel
-instance; the keyed payment stream converts amounts with the LATEST
-rates and flags currencies without one."""
+instance; the keyed payment stream converts each amount with whatever
+rates have ARRIVED by then and flags the rest UNPRICED.
+
+Stream semantics on display: the two sources interleave in arrival
+order, so early payments (the first EUR/GBP below) race their own rates
+and print UNPRICED, while later ones (EUR 42 after the EUR rate landed)
+convert — exactly the behavior a production rules stream has, and why
+such jobs replay or side-output unpriced events."""
 
 from flink_tpu import StreamExecutionEnvironment
 from flink_tpu.datastream.functions import KeyedBroadcastProcessFunction
@@ -11,8 +17,9 @@ from flink_tpu.state.descriptors import MapStateDescriptor
 
 RATES = [("EUR", 1.09), ("GBP", 1.27), ("JPY", 0.0067)]
 PAYMENTS = [
-    ("EUR", 100.0), ("GBP", 250.0), ("JPY", 10000.0),
-    ("EUR", 42.0), ("CHF", 7.0),        # CHF has no rate yet
+    ("EUR", 100.0), ("GBP", 250.0),     # race their own rates: UNPRICED
+    ("JPY", 10000.0), ("EUR", 42.0),    # arrive after the rates: convert
+    ("CHF", 7.0),                       # never gets a rate
 ]
 
 

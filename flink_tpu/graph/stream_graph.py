@@ -134,3 +134,31 @@ def lineage(t: Transformation) -> List[Transformation]:
         chain.append(cur)
         cur = cur.parent
     return list(reversed(chain))
+
+
+def walk_dag(sinks) -> List[Transformation]:
+    """Every transformation reachable from `sinks`, topologically ordered
+    (all inputs precede their node). The ONE reachability walk shared by
+    the web plan handler and the ExecutionGraph builder, so the two
+    views cannot disagree on the node set (ref StreamGraph traversal)."""
+    order: List[Transformation] = []
+    seen = set()
+
+    def walk(t):
+        if t is None or t.id in seen:
+            return
+        seen.add(t.id)
+        for p in parents_of(t):
+            walk(p)
+        order.append(t)
+
+    for s in sinks:
+        walk(s)
+    return order
+
+
+def parents_of(t: Transformation) -> List[Transformation]:
+    """All upstream transformations (single parent + union parents)."""
+    out = [t.parent] if getattr(t, "parent", None) is not None else []
+    out += list(getattr(t, "parents", []) or [])
+    return out

@@ -51,7 +51,7 @@ def write_libsvm(path: str, X, y):
     with open(path, "w") as f:
         for r in range(len(X)):
             feats = " ".join(
-                f"{i + 1}:{X[r, i]:g}"
+                f"{i + 1}:{X[r, i]:.9g}"
                 for i in np.nonzero(X[r])[0]
             )
-            f.write(f"{y[r]:g} {feats}".rstrip() + "\n")
+            f.write(f"{y[r]:.9g} {feats}".rstrip() + "\n")

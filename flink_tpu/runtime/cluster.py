@@ -99,6 +99,7 @@ class JobRecord:
     end_time: Optional[float] = None
     error: Optional[str] = None
     handle: Any = None
+    execution_graph: Any = None   # runtime/execution_graph.ExecutionGraph
 
     def summary(self) -> Dict[str, Any]:
         out = {
@@ -136,22 +137,47 @@ class MiniCluster:
         control = JobControl()
         env._control = control
         rec = JobRecord(job_id, job_name, env, control)
+        # attach the ExecutionGraph: per-vertex attempts + the job state
+        # machine (ref JobGraph -> ExecutionGraph.attachJobGraph)
+        from flink_tpu.runtime.execution_graph import ExecutionGraph
+
+        eg = ExecutionGraph.from_transformations(
+            job_id, job_name, getattr(env, "_sinks", []),
+            parallelism=getattr(env, "parallelism", 1),
+        )
+        rec.execution_graph = eg
+
+        def on_execution_event(kind, cause="restart"):
+            if kind == "restart":
+                # the executor only notifies when it IS restarting, so
+                # the graph always cycles to new attempts here; the real
+                # exception rides in as the failure cause
+                eg.fail_all(cause, will_restart=True)
+
+        env._execution_listener = on_execution_event
 
         def run():
             rec.status = "RUNNING"
+            eg.deploy_all()
             try:
                 rec.handle = env.execute(job_name, restore_from=restore_from)
                 rec.status = "FINISHED"
+                eg.finish_all()
             except JobCancelledException:
                 rec.status = "CANCELED"
+                eg.cancel_all()
             except Exception as e:
                 rec.status = "FAILED"
                 rec.error = "".join(
                     traceback.format_exception_only(type(e), e)
                 ).strip()
+                eg.fail_all(rec.error, will_restart=False)
             finally:
                 rec.end_time = time.time()
                 env._control = None
+                # the graph is terminal: a later direct env.execute() of
+                # a reused environment must not mutate this job's history
+                env._execution_listener = None
                 # a savepoint request the loop never observed must fail
                 # promptly, not time out its waiter
                 req = control.take_savepoint_request()

@@ -1,0 +1,230 @@
+"""ExecutionGraph — per-vertex execution attempts + the job state machine
+(ref flink-runtime executiongraph/ExecutionGraph.java,
+ExecutionVertex.java, Execution.java, ExecutionState.java, JobStatus).
+
+The reference tracks one Execution (attempt) per subtask with a strict
+state machine (CREATED -> SCHEDULED -> DEPLOYING -> RUNNING -> terminal)
+and a job-level JobStatus; restarts create NEW attempts rather than
+mutating old ones, preserving failure history. The single-controller
+SPMD runtime executes a job as one fused step-loop, so "deployment" is
+compilation and a restart restores the whole pipeline — but the
+OBSERVABLE model is kept: every logical operator of the stream graph
+becomes an ExecutionJobVertex whose vertices advance through the same
+states together, attempts accumulate across restarts with their failure
+causes, and illegal transitions raise (the reference's
+ConcurrentModification guard against state races).
+
+Wired by MiniCluster: submission builds the graph from the job's
+transformations; the executor's restart loop notifies it through the
+environment's execution listener; the web monitor's /jobs/<id>/vertices
+serves it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ref ExecutionState.java — the per-attempt machine
+STATES = ("CREATED", "SCHEDULED", "DEPLOYING", "RUNNING", "FINISHED",
+          "CANCELING", "CANCELED", "FAILED")
+_LEGAL = {
+    "CREATED": {"SCHEDULED", "CANCELED", "FAILED"},
+    "SCHEDULED": {"DEPLOYING", "CANCELED", "FAILED"},
+    "DEPLOYING": {"RUNNING", "CANCELING", "CANCELED", "FAILED"},
+    "RUNNING": {"FINISHED", "CANCELING", "CANCELED", "FAILED"},
+    "CANCELING": {"CANCELED", "FAILED"},
+    "FINISHED": set(),
+    "CANCELED": set(),
+    "FAILED": set(),
+}
+
+# ref JobStatus — the job-level machine
+JOB_STATES = ("CREATED", "RUNNING", "FAILING", "FAILED", "CANCELLING",
+              "CANCELED", "FINISHED", "RESTARTING")
+_JOB_LEGAL = {
+    "CREATED": {"RUNNING", "FAILED", "CANCELED"},
+    "RUNNING": {"FINISHED", "FAILING", "CANCELLING", "RESTARTING"},
+    "FAILING": {"FAILED", "RESTARTING"},
+    "RESTARTING": {"RUNNING", "FAILED", "CANCELED"},
+    "CANCELLING": {"CANCELED"},
+    "FINISHED": set(),
+    "FAILED": set(),
+    "CANCELED": set(),
+}
+
+
+class IllegalTransition(RuntimeError):
+    pass
+
+
+@dataclass
+class ExecutionAttempt:
+    """One Execution (ref Execution.java): attempt number + timestamped
+    state history + failure cause."""
+
+    attempt: int
+    state: str = "CREATED"
+    state_times: Dict[str, float] = field(default_factory=dict)
+    failure_cause: Optional[str] = None
+
+    def __post_init__(self):
+        self.state_times.setdefault("CREATED", time.time())
+
+    def transition(self, new: str, cause: Optional[str] = None):
+        if new not in STATES:
+            raise ValueError(f"unknown state {new!r}")
+        if new not in _LEGAL[self.state]:
+            raise IllegalTransition(
+                f"attempt {self.attempt}: {self.state} -> {new} is illegal"
+            )
+        self.state = new
+        self.state_times[new] = time.time()
+        if cause is not None:
+            self.failure_cause = cause
+
+
+@dataclass
+class ExecutionVertex:
+    """One subtask of an operator (ref ExecutionVertex.java): the current
+    attempt plus the full prior-attempt history."""
+
+    task_name: str
+    subtask_index: int
+    attempts: List[ExecutionAttempt] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.attempts:
+            self.attempts.append(ExecutionAttempt(1))
+
+    @property
+    def current(self) -> ExecutionAttempt:
+        return self.attempts[-1]
+
+    def reset_for_restart(self):
+        """ref ExecutionVertex.resetForNewExecution: a NEW attempt,
+        history preserved."""
+        self.attempts.append(ExecutionAttempt(len(self.attempts) + 1))
+
+
+@dataclass
+class ExecutionJobVertex:
+    """One logical operator (ref ExecutionJobVertex.java)."""
+
+    name: str
+    kind: str
+    parallelism: int
+    inputs: List[int] = field(default_factory=list)   # upstream vertex ids
+    vertices: List[ExecutionVertex] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.vertices:
+            self.vertices = [
+                ExecutionVertex(self.name, i) for i in range(self.parallelism)
+            ]
+
+
+class ExecutionGraph:
+    """Job-level graph + state machine (ref ExecutionGraph.java)."""
+
+    def __init__(self, job_id: str, job_name: str):
+        self.job_id = job_id
+        self.job_name = job_name
+        self.state = "CREATED"
+        self.state_times: Dict[str, float] = {"CREATED": time.time()}
+        self.job_vertices: Dict[int, ExecutionJobVertex] = {}
+        self.restarts = 0
+        self.failure_causes: List[str] = []
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def from_transformations(job_id: str, job_name: str, sinks,
+                             parallelism: int = 1) -> "ExecutionGraph":
+        """Build from the stream graph reachable from the sink
+        transformations (the JobGraph -> ExecutionGraph attach step)."""
+        from flink_tpu.graph.stream_graph import parents_of, walk_dag
+
+        eg = ExecutionGraph(job_id, job_name)
+        for t in walk_dag(sinks):
+            eg.job_vertices[t.id] = ExecutionJobVertex(
+                name=t.name,
+                kind=type(t).__name__.replace("Transformation", ""),
+                parallelism=parallelism,
+                inputs=[p.id for p in parents_of(t)],
+            )
+        return eg
+
+    # -- job state machine ------------------------------------------------
+    def transition_job(self, new: str):
+        if new not in JOB_STATES:
+            raise ValueError(f"unknown job state {new!r}")
+        if new not in _JOB_LEGAL[self.state]:
+            raise IllegalTransition(
+                f"job {self.job_id}: {self.state} -> {new} is illegal"
+            )
+        self.state = new
+        self.state_times[new] = time.time()
+
+    def _all(self, fn):
+        for jv in self.job_vertices.values():
+            for v in jv.vertices:
+                fn(v)
+
+    def deploy_all(self):
+        """CREATED -> SCHEDULED -> DEPLOYING -> RUNNING for every vertex
+        (one fused pipeline: the whole graph deploys together)."""
+        self.transition_job("RUNNING")
+        for s in ("SCHEDULED", "DEPLOYING", "RUNNING"):
+            self._all(lambda v, _s=s: v.current.transition(_s))
+
+    def finish_all(self):
+        self._all(lambda v: v.current.transition("FINISHED"))
+        self.transition_job("FINISHED")
+
+    def cancel_all(self):
+        self.transition_job("CANCELLING")
+        self._all(lambda v: v.current.transition("CANCELING"))
+        self._all(lambda v: v.current.transition("CANCELED"))
+        self.transition_job("CANCELED")
+
+    def fail_all(self, cause: str, will_restart: bool):
+        self.failure_causes.append(cause)
+        self.transition_job("FAILING")
+        self._all(lambda v: v.current.transition("FAILED", cause))
+        if will_restart:
+            # ref ExecutionGraph.restart: new attempts, history kept
+            self.restarts += 1
+            self.transition_job("RESTARTING")
+            self._all(lambda v: v.reset_for_restart())
+            self.deploy_all()
+        else:
+            self.transition_job("FAILED")
+
+    # -- observability (web /jobs/<id>/vertices) --------------------------
+    def vertices_summary(self) -> List[dict]:
+        out = []
+        for vid, jv in self.job_vertices.items():
+            cur = [v.current for v in jv.vertices]
+            out.append({
+                "id": vid,
+                "name": jv.name,
+                "type": jv.kind,
+                "parallelism": jv.parallelism,
+                "inputs": jv.inputs,
+                "status": cur[0].state if cur else "CREATED",
+                "attempt": cur[0].attempt if cur else 0,
+                "start-time": int(
+                    min(a.state_times.get("CREATED", 0) for a in cur) * 1000
+                ) if cur else -1,
+            })
+        return out
+
+    def summary(self) -> dict:
+        return {
+            "jid": self.job_id,
+            "state": self.state,
+            "restarts": self.restarts,
+            "failure-causes": self.failure_causes,
+            "vertices": self.vertices_summary(),
+        }

@@ -473,6 +473,23 @@ class LocalExecutor:
             lambda: self._attribution.report() if self._attribution else {}
         )
 
+    def _notify_restart(self):
+        """ExecutionGraph hook: a restart creates new execution attempts
+        (ref ExecutionGraph.restart). Called inside the restart `except`
+        block, so the ACTIVE exception is the failure cause the attempt
+        history records. Listener installed by MiniCluster."""
+        listener = getattr(self.env, "_execution_listener", None)
+        if listener is not None:
+            exc = sys.exc_info()[1]
+            cause = (
+                f"{type(exc).__name__}: {exc}" if exc is not None
+                else "restart"
+            )
+            try:
+                listener("restart", cause)
+            except Exception:
+                pass      # observability must never kill the job
+
     def _restart_strategy(self) -> ckpt.RestartStrategy:
         cfg = self.env.config
         kind = cfg.get_str("restart-strategy", "none")
@@ -1965,6 +1982,7 @@ class LocalExecutor:
                     if not can:
                         raise
                     metrics.restarts += 1
+                    self._notify_restart()
                     restore_checkpoint(storage)
         finally:
             job_live.clear()
@@ -2275,6 +2293,7 @@ class LocalExecutor:
                 if not can:
                     raise
                 metrics.restarts += 1
+                self._notify_restart()
                 restore_checkpoint(storage)
 
         # end of stream: live partials simply die (a CEP match emits the
@@ -2525,6 +2544,7 @@ class LocalExecutor:
                 if not can:
                     raise
                 metrics.restarts += 1
+                self._notify_restart()
                 collector.drain()  # discard partial output of the failed run
                 restore_checkpoint(storage)
 

@@ -174,37 +174,39 @@ class WebMonitor:
             rec = self.cluster.jobs.get(m.group(1))
             if rec is None:
                 return None
-            nodes, seen = [], set()
+            from flink_tpu.graph.stream_graph import parents_of, walk_dag
 
-            def walk(t):
-                if t is None or t.id in seen:
-                    return
-                seen.add(t.id)
-                parents = (
-                    [t.parent] if t.parent is not None else []
-                ) + list(getattr(t, "parents", []) or [])
-                for p in parents:
-                    walk(p)
-                nodes.append({
+            nodes = [
+                {
                     "id": t.id,
                     "type": type(t).__name__.replace("Transformation", ""),
                     "description": getattr(t, "kind", None) or t.name,
-                    "inputs": [p.id for p in parents],
-                })
-
-            for sink in getattr(rec.env, "_sinks", []):
-                walk(sink)
+                    "inputs": [p.id for p in parents_of(t)],
+                }
+                for t in walk_dag(getattr(rec.env, "_sinks", []))
+            ]
             return {"jid": m.group(1), "plan": {"nodes": nodes}}
         m = re.fullmatch(r"/jobs/([^/]+)/vertices", path)
         if m:
-            # ref JobDetailsHandler's vertices array: the plan nodes with
+            # ref JobDetailsHandler's vertices array: served from the
+            # ExecutionGraph (per-vertex state + attempt counters) with
             # job-level throughput attached (the micro-batch design runs
             # one fused step, so per-vertex counters collapse to the
             # job's — served explicitly rather than faked per vertex)
-            plan = self._route(f"/jobs/{m.group(1)}/plan")
-            if plan is None:
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
                 return None
             detail = self.cluster.job_detail(m.group(1))
+            eg = getattr(rec, "execution_graph", None)
+            if eg is not None:
+                return {
+                    "jid": m.group(1),
+                    "state": eg.state,
+                    "restarts": eg.restarts,
+                    "vertices": eg.vertices_summary(),
+                    "job-metrics": detail.get("metrics", {}),
+                }
+            plan = self._route(f"/jobs/{m.group(1)}/plan")
             return {
                 "jid": m.group(1),
                 "vertices": plan["plan"]["nodes"],
