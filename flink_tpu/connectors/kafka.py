@@ -1,0 +1,605 @@
+"""Kafka wire-protocol connector — the flink-connector-kafka analog
+(SURVEY §2.8, ref flink-streaming-connectors/flink-connector-kafka-0.9/
+FlinkKafkaConsumer09 + FlinkKafkaConsumerBase.java:65 +
+FlinkKafkaProducerBase).
+
+This is a WIRE client: it speaks the public Apache Kafka binary protocol
+(the 0.9/0.10-era core APIs, implemented from the protocol guide —
+request framing `size int32 | api_key int16 | api_version int16 |
+correlation_id int32 | client_id string`, and the v0 bodies of:
+
+    Metadata    (api 3)  — topic/partition/leader discovery
+    Produce     (api 0)  — MessageSet append, acks
+    Fetch       (api 1)  — offset-addressed log reads
+    ListOffsets (api 2)  — earliest/latest offset lookup
+
+MessageSet v0 entries are `offset int64 | size int32 | crc uint32 |
+magic int8 | attrs int8 | key bytes | value bytes` with CRC32 over the
+message from the magic byte; the client validates CRCs on fetch.
+
+No Kafka server exists in this image (zero egress), so tests run the
+client against `MiniKafkaBroker` below — an in-repo broker implementing
+the same public spec on a real TCP socket. That proves the byte-level
+seam; against a genuine cluster only the host:port changes.
+
+KafkaConsumer plugs into the PartitionedConsumerBase contract
+(connectors/partitioned.py): partition discovery at open, per-partition
+offsets snapshot into checkpoints, deterministic re-fetch on restore —
+the exactly-once replay story of the reference consumer.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.connectors.partitioned import PartitionedConsumerBase
+from flink_tpu.runtime.sinks import Sink
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+
+
+# ------------------------------------------------------------ wire encoding
+def _str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack(">h", -1)
+    b = s.encode()
+    return struct.pack(">h", len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.o = 0
+
+    def i8(self):
+        v = struct.unpack_from(">b", self.d, self.o)[0]
+        self.o += 1
+        return v
+
+    def i16(self):
+        v = struct.unpack_from(">h", self.d, self.o)[0]
+        self.o += 2
+        return v
+
+    def i32(self):
+        v = struct.unpack_from(">i", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def i64(self):
+        v = struct.unpack_from(">q", self.d, self.o)[0]
+        self.o += 8
+        return v
+
+    def u32(self):
+        v = struct.unpack_from(">I", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def string(self):
+        n = self.i16()
+        if n < 0:
+            return None
+        v = self.d[self.o:self.o + n].decode()
+        self.o += n
+        return v
+
+    def nbytes(self):
+        n = self.i32()
+        if n < 0:
+            return None
+        v = self.d[self.o:self.o + n]
+        self.o += n
+        return v
+
+
+def encode_message(key: Optional[bytes], value: Optional[bytes]) -> bytes:
+    """One MessageSet v0 entry body (magic 0): crc | magic | attrs |
+    key | value, CRC32 from the magic byte."""
+    body = struct.pack(">bb", 0, 0) + _bytes(key) + _bytes(value)
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack(">I", crc) + body
+
+
+def encode_message_set(messages, base_offset: int = 0) -> bytes:
+    out = []
+    for i, (k, v) in enumerate(messages):
+        m = encode_message(k, v)
+        out.append(struct.pack(">qi", base_offset + i, len(m)))
+        out.append(m)
+    return b"".join(out)
+
+
+def decode_message_set(data: bytes) -> List[Tuple[int, bytes, bytes]]:
+    """-> [(offset, key, value)]; trailing partial messages (a Fetch may
+    cut one off mid-stream, per spec) are dropped. CRC mismatches raise."""
+    out = []
+    o = 0
+    while o + 12 <= len(data):
+        offset, size = struct.unpack_from(">qi", data, o)
+        o += 12
+        if o + size > len(data):
+            break                      # partial trailing message
+        r = _Reader(data[o:o + size])
+        crc = r.u32()
+        body = data[o + 4:o + size]
+        if zlib.crc32(body) & 0xFFFFFFFF != crc:
+            raise IOError(f"Kafka message CRC mismatch at offset {offset}")
+        r.i8()                         # magic
+        r.i8()                         # attributes
+        key = r.nbytes()
+        value = r.nbytes()
+        out.append((offset, key, value))
+        o += size
+    return out
+
+
+# ------------------------------------------------------------ client core
+class KafkaWireClient:
+    """Minimal broker connection: framed request/response with correlation
+    ids (one in flight, reconnect on failure — the reference's
+    NetworkClient role at its simplest)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "flink-tpu",
+                 timeout_s: float = 30.0):
+        self.addr = (host, port)
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._corr = 0
+
+    def _connect(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self.addr, timeout=self.timeout_s
+            )
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def request(self, api_key: int, api_version: int, body: bytes) -> _Reader:
+        self._corr += 1
+        hdr = struct.pack(">hhi", api_key, api_version, self._corr) + \
+            _str(self.client_id)
+        payload = hdr + body
+        framed = struct.pack(">i", len(payload)) + payload
+        try:
+            self._connect()
+            self._sock.sendall(framed)
+            resp = self._read_frame()
+        except OSError:
+            # one reconnect attempt (the broker may have restarted —
+            # the reference consumer's transparent reconnect)
+            self.close()
+            self._connect()
+            self._sock.sendall(framed)
+            resp = self._read_frame()
+        r = _Reader(resp)
+        corr = r.i32()
+        if corr != self._corr:
+            raise IOError(f"correlation id mismatch: {corr} != {self._corr}")
+        return r
+
+    def _read_frame(self) -> bytes:
+        raw = self._recvn(4)
+        (n,) = struct.unpack(">i", raw)
+        return self._recvn(n)
+
+    def _recvn(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise IOError("broker closed connection")
+            buf += chunk
+        return buf
+
+    # -- api calls --------------------------------------------------------
+    def metadata(self, topics: List[str]) -> Dict[str, List[int]]:
+        body = struct.pack(">i", len(topics)) + b"".join(
+            _str(t) for t in topics
+        )
+        r = self.request(API_METADATA, 0, body)
+        n_brokers = r.i32()
+        for _ in range(n_brokers):
+            r.i32()          # node id
+            r.string()       # host
+            r.i32()          # port
+        out: Dict[str, List[int]] = {}
+        errors: Dict[str, int] = {}
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            err = r.i16()
+            topic = r.string()
+            parts = []
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i16()      # partition error
+                pid = r.i32()
+                r.i32()      # leader
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+                parts.append(pid)
+            if err == 0:
+                out[topic] = sorted(parts)
+            else:
+                errors[topic] = err
+        if errors:
+            # NEVER silently drop an errored topic: a retriable
+            # LEADER_NOT_AVAILABLE (or a typo'd name) would otherwise
+            # read as "zero partitions" and the job would finish
+            # instantly having consumed nothing
+            raise IOError(
+                f"metadata errors: "
+                f"{', '.join(f'{t}: code {e}' for t, e in errors.items())}"
+            )
+        return out
+
+    def produce(self, topic: str, partition: int,
+                messages: List[Tuple[Optional[bytes], bytes]]) -> int:
+        """-> base offset assigned by the broker."""
+        ms = encode_message_set(messages)
+        body = (
+            struct.pack(">hi", 1, 10_000)          # acks=1, timeout
+            + struct.pack(">i", 1) + _str(topic)
+            + struct.pack(">i", 1) + struct.pack(">i", partition)
+            + struct.pack(">i", len(ms)) + ms
+        )
+        r = self.request(API_PRODUCE, 0, body)
+        n_topics = r.i32()
+        base = -1
+        for _ in range(n_topics):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()                            # partition
+                err = r.i16()
+                base = r.i64()
+                if err:
+                    raise IOError(f"produce failed: error code {err}")
+        return base
+
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 1 << 20) -> Tuple[List, int]:
+        """-> ([(offset, key, value)], high_watermark)."""
+        body = (
+            struct.pack(">iii", -1, 100, 1)        # replica, wait, min
+            + struct.pack(">i", 1) + _str(topic)
+            + struct.pack(">i", 1)
+            + struct.pack(">iqi", partition, offset, max_bytes)
+        )
+        r = self.request(API_FETCH, 0, body)
+        msgs: List = []
+        hw = -1
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()                            # partition
+                err = r.i16()
+                hw = r.i64()
+                ms = r.d[r.o + 4:r.o + 4 + r.i32()]
+                r.o += len(ms)
+                if err:
+                    raise IOError(f"fetch failed: error code {err}")
+                msgs.extend(decode_message_set(ms))
+        return msgs, hw
+
+    def list_offsets(self, topic: str, partition: int,
+                     time_val: int = -1) -> int:
+        """time -1 = latest, -2 = earliest (ListOffsets v0)."""
+        body = (
+            struct.pack(">i", -1)
+            + struct.pack(">i", 1) + _str(topic)
+            + struct.pack(">i", 1)
+            + struct.pack(">iqi", partition, time_val, 1)
+        )
+        r = self.request(API_LIST_OFFSETS, 0, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                n = r.i32()
+                offs = [r.i64() for _ in range(n)]
+                if err:
+                    raise IOError(f"list_offsets failed: {err}")
+                return offs[0] if offs else 0
+        return 0
+
+
+# ------------------------------------------------------------ consumer/sink
+class KafkaConsumer(PartitionedConsumerBase):
+    """FlinkKafkaConsumer analog over the wire client: partitions from
+    Metadata, records from Fetch, offsets checkpointed by the framework
+    (exactly-once replay via deterministic offset-addressed re-fetch).
+    `deserializer(key_bytes, value_bytes) -> record` (the
+    DeserializationSchema role); default: value utf-8 text."""
+
+    def __init__(self, host: str, port: int, topic: str,
+                 deserializer=None, stop_at_latest: bool = True):
+        super().__init__()
+        self.client = KafkaWireClient(host, port)
+        self.topic = topic
+        self.deserializer = deserializer or (
+            lambda k, v: v.decode() if v is not None else None
+        )
+        # bounded run for batch-style jobs: stop at the high watermark
+        # observed per fetch (a live stream sets False and polls forever)
+        self.stop_at_latest = stop_at_latest
+        # wire fetches pull up to max_bytes; messages beyond the caller's
+        # max_records buffer here instead of being re-downloaded on the
+        # next poll (one wire fetch serves many polls)
+        self._pending: Dict[int, List[Tuple[int, Any]]] = {}
+        self._hw: Dict[int, int] = {}
+
+    def discover_partitions(self):
+        return self.client.metadata([self.topic]).get(self.topic, [])
+
+    def fetch(self, partition, offset, max_records):
+        pend = self._pending.get(partition)
+        if not (pend and pend[0][0] == offset):
+            # cold or restored to a different offset: wire fetch
+            msgs, hw = self.client.fetch(self.topic, partition, offset)
+            self._hw[partition] = hw
+            pend = [(off, self.deserializer(k, v))
+                    for off, k, v in msgs]
+            self._pending[partition] = pend
+        serve = pend[:max_records]
+        self._pending[partition] = pend[max_records:]
+        records = [rec for _off, rec in serve]
+        new_off = serve[-1][0] + 1 if serve else offset
+        exhausted = (
+            self.stop_at_latest
+            and not self._pending[partition]
+            and new_off >= self._hw.get(partition, 0)
+        )
+        return records, new_off, exhausted
+
+    def close(self):
+        self.client.close()
+
+
+class KafkaProducerSink(Sink):
+    """FlinkKafkaProducer analog: serialize + Produce per batch.
+    `serializer(record) -> (key_bytes|None, value_bytes)`."""
+
+    def __init__(self, host: str, port: int, topic: str, partition: int = 0,
+                 serializer=None):
+        self.client = KafkaWireClient(host, port)
+        self.topic = topic
+        self.partition = partition
+        self.serializer = serializer or (
+            lambda r: (None, str(r).encode())
+        )
+        self.records_written = 0
+
+    def invoke_batch(self, elements):
+        if not elements:
+            return
+        msgs = [self.serializer(e) for e in elements]
+        self.client.produce(self.topic, self.partition, msgs)
+        self.records_written += len(elements)
+
+    def close(self):
+        self.client.close()
+
+
+# ------------------------------------------------------------ mini broker
+class MiniKafkaBroker:
+    """In-repo broker speaking the same public wire protocol on a real
+    TCP socket (the test double standing in for a Kafka cluster; ref the
+    reference's KafkaTestEnvironment embedded brokers). Append-only
+    in-memory logs per (topic, partition); thread-safe."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 topics: Optional[Dict[str, int]] = None):
+        self.logs: Dict[Tuple[str, int], List[Tuple[bytes, bytes]]] = {}
+        self.topics: Dict[str, int] = dict(topics or {})
+        self._lock = threading.Lock()
+        for t, n in self.topics.items():
+            for p in range(n):
+                self.logs[(t, p)] = []
+        broker = self
+
+        self._conns: list = []
+
+        class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                broker._conns.append(self.request)
+
+            def finish(self):
+                # no unbounded dead-socket accumulation across the
+                # broker's lifetime
+                try:
+                    broker._conns.remove(self.request)
+                except ValueError:
+                    pass
+
+            def handle(self):
+                try:
+                    while True:
+                        raw = self._recvn(4)
+                        if raw is None:
+                            return
+                        (n,) = struct.unpack(">i", raw)
+                        payload = self._recvn(n)
+                        if payload is None:
+                            return
+                        resp = broker._dispatch(payload)
+                        self.request.sendall(
+                            struct.pack(">i", len(resp)) + resp
+                        )
+                except OSError:
+                    pass
+
+            def _recvn(self, n):
+                buf = b""
+                while len(buf) < n:
+                    chunk = self.request.recv(n - len(buf))
+                    if not chunk:
+                        return None
+                    buf += chunk
+                return buf
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.host = host
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="mini-kafka-broker").start()
+
+    def create_topic(self, topic: str, partitions: int = 1):
+        with self._lock:
+            self.topics[topic] = partitions
+            for p in range(partitions):
+                self.logs.setdefault((topic, p), [])
+
+    def append(self, topic: str, partition: int, key, value):
+        """Direct append (producer-side test hook)."""
+        with self._lock:
+            self.logs[(topic, partition)].append((key, value))
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+        # sever live client connections too (a real broker restart RSTs
+        # them; lingering handler threads would otherwise keep serving
+        # the dead broker's in-memory logs)
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns.clear()
+
+    # -- request dispatch -------------------------------------------------
+    def _dispatch(self, payload: bytes) -> bytes:
+        r = _Reader(payload)
+        api = r.i16()
+        r.i16()                        # api version (v0 served)
+        corr = r.i32()
+        r.string()                     # client id
+        body = {
+            API_METADATA: self._metadata,
+            API_PRODUCE: self._produce,
+            API_FETCH: self._fetch,
+            API_LIST_OFFSETS: self._list_offsets,
+        }[api](r)
+        return struct.pack(">i", corr) + body
+
+    def _metadata(self, r: _Reader) -> bytes:
+        n = r.i32()
+        want = [r.string() for _ in range(n)] or list(self.topics)
+        out = [struct.pack(">i", 1),                 # one broker
+               struct.pack(">i", 0), _str(self.host),
+               struct.pack(">i", self.port)]
+        out.append(struct.pack(">i", len(want)))
+        for t in want:
+            known = t in self.topics
+            out.append(struct.pack(">h", 0 if known else 3))  # 3 = unknown
+            out.append(_str(t))
+            nparts = self.topics.get(t, 0)
+            out.append(struct.pack(">i", nparts))
+            for p in range(nparts):
+                out.append(struct.pack(">hiii", 0, p, 0, 1))  # leader 0
+                out.append(struct.pack(">i", 0))              # replicas
+                out.append(struct.pack(">i", 0))              # isr...
+        return b"".join(out)
+
+    def _produce(self, r: _Reader) -> bytes:
+        r.i16()                        # acks
+        r.i32()                        # timeout
+        out_topics = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                ms = r.d[r.o + 4:r.o + 4 + r.i32()]
+                r.o += len(ms)
+                msgs = decode_message_set(ms)
+                with self._lock:
+                    log = self.logs.get((topic, pid))
+                    if log is None:
+                        parts_out.append(struct.pack(">ihq", pid, 3, -1))
+                        continue
+                    base = len(log)
+                    for _off, k, v in msgs:
+                        log.append((k, v))
+                parts_out.append(struct.pack(">ihq", pid, 0, base))
+            out_topics.append(
+                _str(topic) + struct.pack(">i", len(parts_out))
+                + b"".join(parts_out)
+            )
+        return struct.pack(">i", len(out_topics)) + b"".join(out_topics)
+
+    def _fetch(self, r: _Reader) -> bytes:
+        r.i32(); r.i32(); r.i32()      # replica, max wait, min bytes
+        out_topics = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                offset = r.i64()
+                max_bytes = r.i32()
+                with self._lock:
+                    log = list(self.logs.get((topic, pid), []))
+                hw = len(log)
+                ms = encode_message_set(
+                    log[offset:offset + 512], base_offset=offset
+                )[:max(0, max_bytes)]
+                parts_out.append(
+                    struct.pack(">ihq", pid, 0, hw)
+                    + struct.pack(">i", len(ms)) + ms
+                )
+            out_topics.append(
+                _str(topic) + struct.pack(">i", len(parts_out))
+                + b"".join(parts_out)
+            )
+        return struct.pack(">i", len(out_topics)) + b"".join(out_topics)
+
+    def _list_offsets(self, r: _Reader) -> bytes:
+        r.i32()                        # replica
+        out_topics = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            parts_out = []
+            for _ in range(r.i32()):
+                pid = r.i32()
+                tv = r.i64()
+                r.i32()                # max offsets
+                with self._lock:
+                    n = len(self.logs.get((topic, pid), []))
+                off = 0 if tv == -2 else n
+                parts_out.append(
+                    struct.pack(">ih", pid, 0)
+                    + struct.pack(">i", 1) + struct.pack(">q", off)
+                )
+            out_topics.append(
+                _str(topic) + struct.pack(">i", len(parts_out))
+                + b"".join(parts_out)
+            )
+        return struct.pack(">i", len(out_topics)) + b"".join(out_topics)

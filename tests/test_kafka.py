@@ -1,0 +1,133 @@
+"""Kafka wire-protocol connector: the client speaks the public binary
+protocol (Metadata/Produce/Fetch/ListOffsets v0, MessageSet v0 with
+CRC32) against a real TCP broker (MiniKafkaBroker — in-repo, same public
+spec; no Kafka server exists in this image). Covers byte-level framing,
+CRC validation, producer/consumer round trips through real jobs, and
+the checkpoint-offset replay contract."""
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from flink_tpu.connectors.kafka import (
+    KafkaConsumer,
+    KafkaProducerSink,
+    KafkaWireClient,
+    MiniKafkaBroker,
+    decode_message_set,
+    encode_message_set,
+)
+
+
+@pytest.fixture()
+def broker():
+    b = MiniKafkaBroker(topics={"events": 2})
+    yield b
+    b.shutdown()
+
+
+def test_message_set_round_trip_and_crc():
+    ms = encode_message_set([(b"k1", b"v1"), (None, b"v2")], base_offset=5)
+    out = decode_message_set(ms)
+    assert out == [(5, b"k1", b"v1"), (6, None, b"v2")]
+    # flip one payload byte: CRC must catch it
+    bad = bytearray(ms)
+    bad[-1] ^= 0xFF
+    with pytest.raises(IOError, match="CRC"):
+        decode_message_set(bytes(bad))
+    # partial trailing message is dropped, not an error (spec behavior)
+    assert decode_message_set(ms[:-3]) == [(5, b"k1", b"v1")]
+
+
+def test_wire_client_apis(broker):
+    c = KafkaWireClient(broker.host, broker.port)
+    assert c.metadata(["events"]) == {"events": [0, 1]}
+    with pytest.raises(IOError, match="nope"):
+        c.metadata(["nope"])        # errored topics raise, never vanish
+    base = c.produce("events", 0, [(None, b"a"), (b"key", b"b")])
+    assert base == 0
+    assert c.produce("events", 0, [(None, b"c")]) == 2
+    msgs, hw = c.fetch("events", 0, 0)
+    assert hw == 3
+    assert [(o, v) for o, _k, v in msgs] == [(0, b"a"), (1, b"b"), (2, b"c")]
+    # offset-addressed re-fetch (the replay primitive)
+    msgs2, _ = c.fetch("events", 0, 1)
+    assert [v for _o, _k, v in msgs2] == [b"b", b"c"]
+    assert c.list_offsets("events", 0, -2) == 0      # earliest
+    assert c.list_offsets("events", 0, -1) == 3      # latest
+    c.close()
+
+
+def test_consumer_through_streaming_job(broker):
+    """Broker -> KafkaConsumer -> keyed window -> sink, exact counts."""
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.runtime.sinks import CollectSink
+
+    for i in range(120):
+        broker.append("events", i % 2, None, f"w{i % 6}".encode())
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(1)
+    env.batch_size = 16
+    sink = CollectSink()
+    src = KafkaConsumer(broker.host, broker.port, "events")
+    (
+        env.add_source(src)
+        .key_by(lambda w: w)
+        .reduce(lambda a, b: a + b, extractor=lambda w: 1.0)
+        .add_sink(sink)
+    )
+    env.execute("kafka-wordcount")
+    finals = {}
+    for key, value in sink.results:
+        finals[key] = max(finals.get(key, 0), value)
+    assert finals == {f"w{j}": 20.0 for j in range(6)}
+    src.close()
+
+
+def test_offset_snapshot_replay_exactly_once(broker):
+    """Consume some, snapshot offsets, resume a FRESH consumer from the
+    snapshot: union is exactly the log, no loss, no duplicates (ref
+    FlinkKafkaConsumerBase.snapshotState/restoreState)."""
+    for i in range(40):
+        broker.append("events", i % 2, None, str(i).encode())
+
+    a = KafkaConsumer(broker.host, broker.port, "events")
+    a.open()
+    seen = []
+    got, _end = a.poll(10)
+    seen.extend(got)
+    offs = a.snapshot_offsets()
+    a.close()
+
+    b = KafkaConsumer(broker.host, broker.port, "events")
+    b.restore_offsets(offs)
+    b.open()
+    end = False
+    while not end:
+        got, end = b.poll(16)
+        seen.extend(got)
+    b.close()
+    assert sorted(int(v) for v in seen) == list(range(40))
+
+
+def test_producer_sink_and_broker_restart(broker):
+    """Producer sink writes over the wire; the client reconnects through
+    a broker restart on the same port (reference reconnect behavior)."""
+    sink = KafkaProducerSink(broker.host, broker.port, "events",
+                             partition=1)
+    sink.invoke_batch(["alpha", "beta"])
+    assert [v for _k, v in broker.logs[("events", 1)]] == [b"alpha",
+                                                           b"beta"]
+    # restart the broker on the SAME port; topic state is fresh
+    port = broker.port
+    broker.shutdown()
+    b2 = MiniKafkaBroker(port=port, topics={"events": 2})
+    try:
+        sink.invoke_batch(["gamma"])
+        assert [v for _k, v in b2.logs[("events", 1)]] == [b"gamma"]
+        assert sink.records_written == 3
+    finally:
+        b2.shutdown()
+        sink.close()
