@@ -29,6 +29,8 @@ N_KEYS = 1_000_000
 WINDOW_MS = 5_000
 EVENTS_PER_MS = 2_000          # event-time rate: 10M events per 5s window
 BATCH = 262_144
+FIRES_PER_STEP = 2
+MAX_INFLIGHT = None            # None = runtime default
 # candidate micro-batch sizes for the on-TPU calibration sweep: a larger
 # batch amortizes the fixed per-step dispatch round trip of the tunneled
 # runtime; the sweep measures instead of guessing
@@ -222,9 +224,15 @@ def run_subject(total_events: int, warmup_events: int, batch: int = None) -> tup
     cfg = Configuration({
         "keys.reverse-map": False,
         # 2 fire lanes per drain step: each lane costs 3 full-capacity
-        # pack scatters, and a tumbling boundary only ever has 1 due end
-        "window.fires-per-step": 2,
+        # pack scatters in the packed variant (the CountingSink rides
+        # the ReducedFires drain, where lanes are nearly free), and a
+        # tumbling boundary only ever has 1 due end
+        "window.fires-per-step": FIRES_PER_STEP,
     })
+    if MAX_INFLIGHT is not None:
+        # tunable fire-wait vs throughput tradeoff: the p99 drain waits
+        # behind up to this many queued update steps
+        cfg.set("pipeline.max-inflight-steps", MAX_INFLIGHT)
     env = StreamExecutionEnvironment(cfg)
     env.set_parallelism(len(jax.devices()))
     env.set_max_parallelism(128)
@@ -265,6 +273,8 @@ def run_subject(total_events: int, warmup_events: int, batch: int = None) -> tup
 
 
 def main():
+    global BATCH, FIRES_PER_STEP, MAX_INFLIGHT
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true", help="CPU mesh instead of TPU")
     ap.add_argument("--events", type=int, default=30_000_000)
@@ -275,13 +285,18 @@ def main():
                     help="seconds to keep retrying backend init")
     ap.add_argument("--no-sweep", action="store_true",
                     help="skip the batch-size calibration sweep")
+    ap.add_argument("--inflight", type=int, default=None,
+                    help="pipeline.max-inflight-steps (p99 vs throughput)")
+    ap.add_argument("--fires", type=int, default=FIRES_PER_STEP,
+                    help="window.fires-per-step")
     ap.add_argument("--pin-baseline", type=int, default=0, metavar="N",
                     help="measure the baseline N times on this (quiet) "
                          "host, write best-of-N to BASELINE_PIN.json, exit")
     args = ap.parse_args()
-    global BATCH
     if args.batch:
         BATCH = args.batch
+    FIRES_PER_STEP = args.fires
+    MAX_INFLIGHT = args.inflight
 
     if args.pin_baseline:
         pin_baseline(args.pin_baseline, args.baseline_events)
@@ -399,6 +414,8 @@ def main():
         "baseline_p99_fire_ms": rnd(base_p99),
         "baseline_p50_fire_ms": rnd(base_p50),
         "batch": BATCH,
+        "fires_per_step": FIRES_PER_STEP,
+        "max_inflight": MAX_INFLIGHT,
     }
     if pin:
         out["baseline_pinned_events_per_s"] = pinned_eps
