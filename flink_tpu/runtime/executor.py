@@ -54,6 +54,37 @@ SessionResult = namedtuple(
 )
 
 
+class _LaggedEmitter:
+    """Pipelined emission for per-step output handles: reading a step's
+    outputs immediately blocks on it (a cold d2h costs ~70ms fixed on
+    this runtime), so up to ``lag`` steps' handles are retained and read
+    only when they fall off the window — the read then overlaps the
+    subsequent dispatches. FIFO order is preserved; ``idle()`` drains
+    everything the moment the source has nothing new (computed results
+    must never be withheld behind an idle stream); ``lag == 0`` is fully
+    synchronous (the pre-pipelining behavior). Shared by the rolling and
+    session runners."""
+
+    CONFIG_KEY = "pipeline.max-inflight-steps"
+
+    def __init__(self, env, emit_fn):
+        self.lag = max(0, env.config.get_int(self.CONFIG_KEY, 4))
+        self.emit_fn = emit_fn
+        self._q = deque()
+
+    def push(self, item):
+        self._q.append(item)
+        while len(self._q) > self.lag:
+            self.emit_fn(self._q.popleft())
+
+    def idle(self):
+        self.drain()
+
+    def drain(self):
+        while self._q:
+            self.emit_fn(self._q.popleft())
+
+
 def _pad(arr, size, dtype):
     arr = np.asarray(arr, dtype)
     if len(arr) == size:
@@ -2621,12 +2652,27 @@ class LocalExecutor:
         if reg is not None:
             reg.register(roll.name, kv_query)
 
+        def emit_one(item):
+            outputs, out_valid, klist, n = item
+            out_np = np.asarray(outputs)[:n]
+            ok_np = np.asarray(out_valid)[:n]
+            if roll.result_fn is not None:
+                out_np = np.asarray(roll.result_fn(out_np))
+            out = [
+                (k, v) for k, v, okv in zip(klist, out_np.tolist(), ok_np)
+                if okv
+            ]
+            _emit_batch(pipe, out, metrics)
+
+        emitter = _LaggedEmitter(env, emit_one)
+
         end = False
         while not end:
             self._poll_control()
             polled, end = pipe.source.poll(B)
             prepped = self._prep_keyed_batch(pipe, polled, roll.extractor)
             if prepped is None:
+                emitter.idle()    # an idle source must not withhold results
                 continue
             key_list, values = prepped
             hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
@@ -2640,19 +2686,12 @@ class LocalExecutor:
                 jnp.asarray(_pad(np.ones(n, bool), B, bool)),
             )
             metrics.steps += 1
-            out_np = np.asarray(outputs)[:n]
-            ok_np = np.asarray(out_valid)[:n]
-            if roll.result_fn is not None:
-                out_np = np.asarray(roll.result_fn(out_np))
             klist = (
                 key_list.tolist() if isinstance(key_list, np.ndarray)
                 else key_list
             )
-            out = [
-                (k, v) for k, v, okv in zip(klist, out_np.tolist(), ok_np)
-                if okv
-            ]
-            _emit_batch(pipe, out, metrics)
+            emitter.push((outputs, out_valid, klist, n))
+        emitter.drain()
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped
@@ -2697,9 +2736,14 @@ class LocalExecutor:
             else WatermarkStrategy.for_monotonous_timestamps()
         )
 
-        def emit(old_f, mid_f, wm_f):
+        # lagged emission (_LaggedEmitter): fires + the step's table-key
+        # handle are retained and read `lag` steps later, so the d2h read
+        # overlaps subsequent dispatches. The session step does NOT donate
+        # state, so the captured keys handle is an immutable snapshot.
+        def emit(item):
+            old_f, mid_f, wm_f, tkeys_handle = item
             out = []
-            tkeys = np.asarray(state.table.keys)
+            tkeys = np.asarray(tkeys_handle)
             for fire in (old_f, mid_f):
                 khi, klo, f_start, f_end, f_vals, f_mask = map(np.asarray, fire)
                 for sh in range(khi.shape[0]):
@@ -2735,6 +2779,8 @@ class LocalExecutor:
             metrics.fires += len(out)
             _emit_batch(pipe, out, metrics)
 
+        emitter = _LaggedEmitter(env, emit)
+
         def run_once(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
             wmv = np.full((ctx.n_shards,), np.int32(   # numpy: eager tiny
@@ -2746,7 +2792,7 @@ class LocalExecutor:
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
             metrics.steps += 1
-            emit(old_f, mid_f, wm_f)
+            emitter.push((old_f, mid_f, wm_f, state.table.keys))
 
         end = False
         while not end:
@@ -2756,6 +2802,7 @@ class LocalExecutor:
             if pipe.source.columnar and isinstance(polled, tuple):
                 cols, ts_ms = polled
                 if not cols:
+                    emitter.idle()
                     continue
                 for t in pipe.pre_chain:
                     if t.kind != "map":
@@ -2773,6 +2820,7 @@ class LocalExecutor:
             else:
                 elements = _apply_chain(pipe.pre_chain, self._to_elements(polled))
                 if not elements:
+                    emitter.idle()
                     continue
                 key_list = [pipe.key_by.key_selector(e) for e in elements]
                 values = np.asarray(
@@ -2810,6 +2858,7 @@ class LocalExecutor:
                 np.zeros((B,) + tuple(red.value_shape), np.float32),
                 np.zeros(B, bool), final_wm,
             )
+        emitter.drain()
 
         metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
         dropped = int(np.asarray(state.dropped_capacity).sum())
