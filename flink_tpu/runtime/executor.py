@@ -2894,12 +2894,34 @@ class LocalExecutor:
         keep_rev = env.config.get_bool("keys.reverse-map", True)
         codec = KeyCodec()
 
+        def emit_one(item):
+            khi, klo, w, vals, mask = item
+            mask_np = np.asarray(mask)
+            if not mask_np.any():
+                return
+            khi_np = np.asarray(khi)[mask_np]
+            klo_np = np.asarray(klo)[mask_np]
+            w_np = np.asarray(w)[mask_np]
+            v_np = np.asarray(vals)[mask_np]
+            if wagg.result_fn is not None:
+                v_np = np.asarray(wagg.result_fn(v_np))
+            keys = codec.decode(khi_np, klo_np)
+            out = [
+                WindowResult(k, int(wi), vv)
+                for k, wi, vv in zip(keys, w_np.tolist(), v_np.tolist())
+            ]
+            metrics.fires += len(out)
+            _emit_batch(pipe, out, metrics)
+
+        emitter = _LaggedEmitter(env, emit_one)
+
         end = False
         while not end:
             self._poll_control()
             polled, end = pipe.source.poll(B)
             prepped = self._prep_keyed_batch(pipe, polled, wagg.extractor)
             if prepped is None:
+                emitter.idle()
                 continue
             key_list, values = prepped
             hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
@@ -2913,21 +2935,8 @@ class LocalExecutor:
                 jnp.asarray(_pad(np.ones(n, bool), B, bool)),
             )
             metrics.steps += 1
-            mask_np = np.asarray(mask)
-            if mask_np.any():
-                khi_np = np.asarray(khi)[mask_np]
-                klo_np = np.asarray(klo)[mask_np]
-                w_np = np.asarray(w)[mask_np]
-                v_np = np.asarray(vals)[mask_np]
-                if wagg.result_fn is not None:
-                    v_np = np.asarray(wagg.result_fn(v_np))
-                keys = codec.decode(khi_np, klo_np)
-                out = [
-                    WindowResult(k, int(wi), vv)
-                    for k, wi, vv in zip(keys, w_np.tolist(), v_np.tolist())
-                ]
-                metrics.fires += len(out)
-                _emit_batch(pipe, out, metrics)
+            emitter.push((khi, klo, w, vals, mask))
+        emitter.drain()
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped
