@@ -377,6 +377,9 @@ _DASHBOARD_HTML = """<!doctype html>
  <h2>Jobs</h2><table id="jobs"><tr><th>id</th><th>name</th><th>state</th>
   <th>duration</th></tr></table>
  <div id="detail" style="display:none">
+  <h2>Vertices <span id="jstate" class="pill"></span></h2>
+  <table id="vx"><tr><th>operator</th><th>type</th><th>status</th>
+   <th>attempt</th></tr></table>
   <h2>Metrics — <span id="jname"></span></h2><table id="mx"></table>
   <h2>Back-pressure <span id="bp" class="pill"></span></h2><table id="bpt"></table>
   <h2>Checkpoints <span id="ckn" class="pill"></span></h2>
@@ -415,6 +418,19 @@ async function tick(){
   for(const[k,v]of Object.entries(d.metrics||{})){
    const r=mx.insertRow();r.insertCell().textContent=k;
    r.insertCell().textContent=v;
+  }
+  const vx=await J("/jobs/"+sel+"/vertices");
+  const js=document.getElementById("jstate");
+  js.textContent=(vx.state||"")+(vx.restarts?` / ${vx.restarts} restarts`:"");
+  const vt=document.getElementById("vx");
+  while(vt.rows.length>1)vt.deleteRow(1);
+  for(const v of vx.vertices||[]){
+   const r=vt.insertRow();
+   r.insertCell().textContent=v.name||v.description||"";
+   r.insertCell().textContent=v.type;
+   const c=r.insertCell();c.textContent=v.status||"";
+   c.className="state "+(v.status||"");
+   r.insertCell().textContent=v.attempt||"";
   }
   const bp=await J("/jobs/"+sel+"/backpressure");
   const lv=bp["backpressure-level"]||"ok";
