@@ -84,6 +84,12 @@ class _LaggedEmitter:
         while self._q:
             self.emit_fn(self._q.popleft())
 
+    def discard(self):
+        """Drop retained handles WITHOUT emitting — restore rewinds the
+        sink to the checkpoint cut, and replay re-fires everything after
+        it; emitting the stale handles would double-count."""
+        self._q.clear()
+
 
 def _pad(arr, size, dtype):
     arr = np.asarray(arr, dtype)
@@ -2710,7 +2716,6 @@ class LocalExecutor:
             SessionStageSpec, build_session_step, init_session_state,
         )
 
-        self._check_no_checkpointing("session-window", restore_from)
         env = self.env
         wagg = pipe.window_agg
         assigner = wagg.assigner
@@ -2781,6 +2786,140 @@ class LocalExecutor:
 
         emitter = _LaggedEmitter(env, emit)
 
+        # -- checkpoint/restore (round 4: closes the session-path
+        # NotImplementedError). The session state pytree is a flat set of
+        # per-shard arrays, so the snapshot is a raw device_get at the
+        # step boundary (the structural barrier, SURVEY §3.4) + source
+        # offsets + sink states + the codec reverse map; restore places
+        # the arrays back onto the mesh sharding. Pending lagged fires
+        # are DRAINED before a snapshot (the cut must include their sink
+        # effects) and DISCARDED on restore (replay re-fires them).
+        storage = None
+        if env.checkpoint_dir:
+            storage = ckpt.CheckpointStorage(
+                env.checkpoint_dir,
+                retain=env.config.get_int("checkpoint.retain", 2),
+            )
+        next_cid = (storage.latest() or 0) + 1 if storage else 1
+        steps_at_ckpt = 0
+        n_keys_logged = 0
+
+        def _payload(store):
+            # codec reverse map rides the APPEND-ONLY keymap log (the
+            # windowed path's machinery): each checkpoint writes only the
+            # keys seen since the last one, not the whole O(keys) dict
+            nonlocal n_keys_logged
+            if keep_rev:
+                items = list(
+                    itertools.islice(codec._rev.items(), n_keys_logged,
+                                     None)
+                )
+                store.append_keymap(items)
+                n_keys_logged = len(codec._rev)
+            leaves, _ = jax.tree_util.tree_flatten(state)
+            return {
+                "session_state": [np.asarray(jax.device_get(x))
+                                  for x in leaves],
+                "offsets": pipe.source.snapshot_offsets(),
+                "wm_current": wm_strategy.current(),
+                "origin_ms": td.origin_ms if td is not None else None,
+                "codec_rev_count": n_keys_logged if keep_rev else 0,
+                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
+                "max_parallelism": env.max_parallelism,
+                "n_shards": ctx.n_shards,
+                "gap_ms": assigner.gap_ms,
+                "capacity_per_shard": env.state_capacity_per_shard,
+                "session_window": True,
+            }
+
+        def write_checkpoint():
+            nonlocal next_cid, steps_at_ckpt
+            emitter.drain()
+            payload = _payload(storage)
+            storage.write_generic(next_cid, payload)
+            pipe.source.notify_checkpoint_complete(next_cid,
+                                                   payload["offsets"])
+            for s in pipe.all_sinks:
+                s.notify_checkpoint_complete(next_cid)
+            next_cid += 1
+            steps_at_ckpt = metrics.steps
+
+        def restore_checkpoint(path_or_storage, cid=None):
+            nonlocal state, td, steps_at_ckpt
+            st = (
+                ckpt.CheckpointStorage(path_or_storage)
+                if isinstance(path_or_storage, str) else path_or_storage
+            )
+            cid = cid if cid is not None else st.latest()
+            if cid is None:
+                raise FileNotFoundError(f"no checkpoint in {st.dir}")
+            payload = st.read_generic(cid)
+            if not payload.get("session_window"):
+                raise ValueError(
+                    "checkpoint was not written by a session-window job"
+                )
+            if payload["max_parallelism"] != env.max_parallelism:
+                raise ValueError("checkpoint max-parallelism mismatch")
+            if payload["n_shards"] != ctx.n_shards:
+                raise ValueError(
+                    f"checkpoint has {payload['n_shards']} shard(s), job "
+                    f"configured for {ctx.n_shards}"
+                )
+            if payload["gap_ms"] != assigner.gap_ms:
+                raise ValueError("session gap mismatch vs checkpoint")
+            snap_cap = payload.get("capacity_per_shard",
+                                   env.state_capacity_per_shard)
+            if snap_cap != env.state_capacity_per_shard:
+                # the compiled step bakes the capacity into its masks;
+                # mismatched arrays would corrupt silently (clamped
+                # gathers), so fail fast like every other keyed path
+                raise ValueError(
+                    f"checkpoint state capacity {snap_cap} != configured "
+                    f"{env.state_capacity_per_shard}"
+                )
+            emitter.discard()
+            _leaves, treedef = jax.tree_util.tree_flatten(state)
+            state = jax.tree_util.tree_unflatten(treedef, [
+                jax.device_put(x, ctx.state_sharding)
+                for x in payload["session_state"]
+            ])
+            pipe.source.restore_offsets(payload["offsets"])
+            sink_states = payload.get("sink_states")
+            if sink_states:
+                if len(sink_states) != len(pipe.all_sinks):
+                    raise ValueError(
+                        f"checkpoint has {len(sink_states)} sink states "
+                        f"but the job topology has {len(pipe.all_sinks)} "
+                        f"sinks — restore with the matching pipeline"
+                    )
+                for s, ss in zip(pipe.all_sinks, sink_states):
+                    s.restore_state(ss)
+            nonlocal n_keys_logged
+            count = payload.get("codec_rev_count", 0)
+            if keep_rev and count:
+                codec._rev = st.read_keymap(count)
+                n_keys_logged = count
+            wm_strategy._current = payload["wm_current"]
+            if payload["origin_ms"] is not None:
+                td = TimeDomain(origin_ms=payload["origin_ms"],
+                                ms_per_tick=1)
+            steps_at_ckpt = metrics.steps
+
+        def write_savepoint(path: str) -> str:
+            nonlocal n_keys_logged
+            emitter.drain()
+            sp = ckpt.CheckpointStorage(path, retain=10**9)
+            cid = (sp.latest() or 0) + 1
+            # self-contained savepoint: full keymap into ITS directory
+            logged = n_keys_logged
+            n_keys_logged = 0
+            try:
+                return sp.write_generic(cid, _payload(sp))
+            finally:
+                n_keys_logged = logged
+
+        self._savepoint_writer = write_savepoint
+
         def run_once(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
             wmv = np.full((ctx.n_shards,), np.int32(   # numpy: eager tiny
@@ -2794,71 +2933,110 @@ class LocalExecutor:
             metrics.steps += 1
             emitter.push((old_f, mid_f, wm_f, state.table.keys))
 
-        end = False
-        while not end:
-            self._poll_control()
-            polled, end = pipe.source.poll(B)
-            now_ms = int(time.time() * 1000)
-            if pipe.source.columnar and isinstance(polled, tuple):
-                cols, ts_ms = polled
-                if not cols:
-                    emitter.idle()
-                    continue
-                for t in pipe.pre_chain:
-                    if t.kind != "map":
-                        raise NotImplementedError(
-                            "columnar sources support only 'map' before key_by"
-                        )
-                    cols = t.fn(cols)
-                key_list = np.asarray(pipe.key_by.key_selector(cols))
-                values = np.asarray(wagg.extractor(cols))
-                if event_time and pipe.ts_transform is not None:
-                    ts_ms = np.asarray(
-                        pipe.ts_transform.timestamp_fn(cols), np.int64)
-                elif not event_time or ts_ms is None:
-                    ts_ms = np.full(len(key_list), now_ms, np.int64)
-            else:
-                elements = _apply_chain(pipe.pre_chain, self._to_elements(polled))
-                if not elements:
-                    emitter.idle()
-                    continue
-                key_list = [pipe.key_by.key_selector(e) for e in elements]
-                values = np.asarray(
-                    [wagg.extractor(e) for e in elements], np.float32
-                )
-                if event_time and pipe.ts_transform is not None:
-                    ts_ms = np.asarray(
-                        [pipe.ts_transform.timestamp_fn(e) for e in elements],
-                        np.int64,
-                    )
+        def batch_loop():
+            nonlocal td
+            end = False
+            while not end:
+                self._poll_control()
+                polled, end = pipe.source.poll(B)
+                now_ms = int(time.time() * 1000)
+                if pipe.source.columnar and isinstance(polled, tuple):
+                    cols, ts_ms = polled
+                    if not cols:
+                        emitter.idle()
+                        continue
+                    for t in pipe.pre_chain:
+                        if t.kind != "map":
+                            raise NotImplementedError(
+                                "columnar sources support only 'map' "
+                                "before key_by"
+                            )
+                        cols = t.fn(cols)
+                    key_list = np.asarray(pipe.key_by.key_selector(cols))
+                    values = np.asarray(wagg.extractor(cols))
+                    if event_time and pipe.ts_transform is not None:
+                        ts_ms = np.asarray(
+                            pipe.ts_transform.timestamp_fn(cols), np.int64)
+                    elif not event_time or ts_ms is None:
+                        ts_ms = np.full(len(key_list), now_ms, np.int64)
                 else:
-                    ts_ms = np.full(len(key_list), now_ms, np.int64)
-            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
-            n = len(hi)
-            metrics.records_in += n
-            if td is None:
-                td = TimeDomain(origin_ms=int(np.min(ts_ms)), ms_per_tick=1)
-            ticks = td.to_ticks(ts_ms)
-            wm_ms = (
-                wm_strategy.on_batch(int(np.max(ts_ms))) if event_time
-                else now_ms - 1
-            )
-            run_once(
-                _pad(hi, B, np.uint32), _pad(lo, B, np.uint32),
-                _pad(ticks, B, np.int32), _pad(values, B, np.float32),
-                _pad(np.ones(n, bool), B, bool), wm_ms,
-            )
+                    elements = _apply_chain(pipe.pre_chain,
+                                            self._to_elements(polled))
+                    if not elements:
+                        emitter.idle()
+                        continue
+                    key_list = [pipe.key_by.key_selector(e)
+                                for e in elements]
+                    values = np.asarray(
+                        [wagg.extractor(e) for e in elements], np.float32
+                    )
+                    if event_time and pipe.ts_transform is not None:
+                        ts_ms = np.asarray(
+                            [pipe.ts_transform.timestamp_fn(e)
+                             for e in elements],
+                            np.int64,
+                        )
+                    else:
+                        ts_ms = np.full(len(key_list), now_ms, np.int64)
+                hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+                n = len(hi)
+                metrics.records_in += n
+                if td is None:
+                    td = TimeDomain(origin_ms=int(np.min(ts_ms)),
+                                    ms_per_tick=1)
+                ticks = td.to_ticks(ts_ms)
+                wm_ms = (
+                    wm_strategy.on_batch(int(np.max(ts_ms))) if event_time
+                    else now_ms - 1
+                )
+                run_once(
+                    _pad(hi, B, np.uint32), _pad(lo, B, np.uint32),
+                    _pad(ticks, B, np.int32), _pad(values, B, np.float32),
+                    _pad(np.ones(n, bool), B, bool), wm_ms,
+                )
+                if (
+                    storage is not None
+                    and env.checkpoint_interval_steps > 0
+                    and metrics.steps - steps_at_ckpt
+                    >= env.checkpoint_interval_steps
+                    and td is not None
+                ):
+                    write_checkpoint()
 
-        if td is not None:
-            # end of stream: close all open sessions
-            final_wm = int(td.to_ms(2**31 - 4))
-            run_once(
-                np.zeros(B, np.uint32), np.zeros(B, np.uint32),
-                np.zeros(B, np.int32),
-                np.zeros((B,) + tuple(red.value_shape), np.float32),
-                np.zeros(B, bool), final_wm,
-            )
-        emitter.drain()
+        # restore + restart protection (ref ExecutionGraph.restart; the
+        # final MAX-watermark flush sits INSIDE it, like the tumbling path)
+        if restore_from:
+            restore_checkpoint(restore_from)
+        restart = self._restart_strategy()
+        while True:
+            try:
+                batch_loop()
+                if td is not None:
+                    # end of stream: close all open sessions. INSIDE the
+                    # restart protection — a sink failing during the
+                    # final flush recovers like any mid-stream failure.
+                    final_wm = int(td.to_ms(2**31 - 4))
+                    run_once(
+                        np.zeros(B, np.uint32), np.zeros(B, np.uint32),
+                        np.zeros(B, np.int32),
+                        np.zeros((B,) + tuple(red.value_shape), np.float32),
+                        np.zeros(B, bool), final_wm,
+                    )
+                emitter.drain()
+                break
+            except JobCancelledException:
+                raise
+            except Exception:
+                can = (
+                    storage is not None
+                    and storage.latest() is not None
+                    and restart.should_restart()
+                )
+                if not can:
+                    raise
+                metrics.restarts += 1
+                self._notify_restart()
+                restore_checkpoint(storage)
 
         metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
         dropped = int(np.asarray(state.dropped_capacity).sum())
