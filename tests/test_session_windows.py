@@ -136,7 +136,7 @@ def _session_events(n_keys=6, sessions=3, per=5):
     return ev
 
 
-def _session_env(tmpdir, events, sink, extra_cfg=None, batch=16):
+def _session_env(tmpdir, events, sink, extra_cfg=None, batch=16, gap=500):
     from flink_tpu.core.config import Configuration
 
     cfg = {"restart-strategy": "fixed-delay",
@@ -166,7 +166,7 @@ def _session_env(tmpdir, events, sink, extra_cfg=None, batch=16):
     (
         env.add_source(GeneratorSource(gen, total=len(events)))
         .key_by(lambda c: c["key"])
-        .window(EventTimeSessionWindows.with_gap(500))
+        .window(EventTimeSessionWindows.with_gap(gap))
         .sum(lambda c: c["value"])
         .add_sink(sink)
     )
@@ -244,3 +244,35 @@ def test_session_kill_and_resume_from_checkpoint(tmp_path):
            for r in s2.results}
     assert len(got) == 18
     assert all(v == 5.0 for v in got.values())
+
+
+def test_session_restore_validation_failures(tmp_path):
+    """Mismatched configuration fails fast at restore, never corrupts."""
+    events = _session_events()
+
+    class Snap(CollectSink):
+        def snapshot_state(self):
+            return list(self.results)
+
+        def restore_state(self, state):
+            self.results[:] = state
+
+    env = _session_env(tmp_path, events, Snap())
+    env.execute("session-src")          # leaves checkpoints behind
+
+    # different state capacity
+    env2 = _session_env(tmp_path, events, Snap())
+    env2.set_state_capacity(512)
+    with pytest.raises(ValueError, match="capacity"):
+        env2.execute("bad-cap", restore_from=str(tmp_path))
+
+    # different gap
+    env3 = _session_env(tmp_path, events, Snap(), gap=999)
+    with pytest.raises(ValueError, match="gap"):
+        env3.execute("bad-gap", restore_from=str(tmp_path))
+
+    # different max-parallelism
+    env4 = _session_env(tmp_path, events, Snap())
+    env4.set_max_parallelism(16)
+    with pytest.raises(ValueError, match="parallelism"):
+        env4.execute("bad-maxp", restore_from=str(tmp_path))
