@@ -100,6 +100,191 @@ def _pad(arr, size, dtype):
     return out
 
 
+class _FlatStageCheckpointer:
+    """Step-boundary checkpoint/savepoint/restore for keyed stage kinds
+    whose device state is ONE flat pytree of per-shard arrays (rolling
+    reduce, count windows). The reference snapshots EVERY operator's
+    state (AbstractStreamOperator.java:367; rolling aggregates live in
+    ValueState via StreamGroupedReduce), so these stage kinds must
+    participate in the same fault-tolerance story as the windowed paths.
+
+    Mirrors the session runner's inline machinery: a raw device_get of
+    the state leaves at the step boundary (the structural barrier,
+    SURVEY §3.4) + source offsets + sink states + the codec reverse map
+    riding the append-only keymap log. Pending lagged fires are DRAINED
+    before a cut (their sink effects belong to it) and DISCARDED on
+    restore (source replay re-fires them). Stage-shape scalars that the
+    compiled step bakes into its masks (capacity, count-window N, reduce
+    kind) are validated at restore — mismatched arrays would corrupt
+    silently via clamped gathers, so fail fast instead."""
+
+    def __init__(self, executor, pipe, ctx, codec, keep_rev, emitter,
+                 metrics, get_state, set_state, stage_kind, meta):
+        env = executor.env
+        self.executor = executor
+        self.env = env
+        self.pipe = pipe
+        self.ctx = ctx
+        self.codec = codec
+        self.keep_rev = keep_rev
+        self.emitter = emitter
+        self.metrics = metrics
+        self.get_state = get_state
+        self.set_state = set_state
+        self.stage_kind = stage_kind
+        self.meta = dict(meta)
+        self.storage = None
+        if env.checkpoint_dir:
+            self.storage = ckpt.CheckpointStorage(
+                env.checkpoint_dir,
+                retain=env.config.get_int("checkpoint.retain", 2),
+            )
+        self.next_cid = (
+            (self.storage.latest() or 0) + 1 if self.storage else 1
+        )
+        self.steps_at_ckpt = 0
+        self.n_keys_logged = 0
+        executor._savepoint_writer = self.write_savepoint
+
+    def _payload(self, store):
+        # codec reverse map rides the APPEND-ONLY keymap log: each
+        # checkpoint writes only the keys seen since the last one
+        if self.keep_rev:
+            items = list(itertools.islice(
+                self.codec._rev.items(), self.n_keys_logged, None))
+            store.append_keymap(items)
+            self.n_keys_logged = len(self.codec._rev)
+        leaves, _ = jax.tree_util.tree_flatten(self.get_state())
+        return {
+            "stage_state": [np.asarray(jax.device_get(x)) for x in leaves],
+            "offsets": self.pipe.source.snapshot_offsets(),
+            "codec_rev_count": self.n_keys_logged if self.keep_rev else 0,
+            "sink_states": [
+                s.snapshot_state() for s in self.pipe.all_sinks
+            ],
+            "max_parallelism": self.env.max_parallelism,
+            "n_shards": self.ctx.n_shards,
+            "stage_kind": self.stage_kind,
+            "stage_meta": dict(self.meta),
+        }
+
+    def maybe_checkpoint(self):
+        if (
+            self.storage is not None
+            and self.env.checkpoint_interval_steps > 0
+            and self.metrics.steps - self.steps_at_ckpt
+            >= self.env.checkpoint_interval_steps
+        ):
+            self.write_checkpoint()
+
+    def write_checkpoint(self):
+        self.emitter.drain()
+        payload = self._payload(self.storage)
+        self.storage.write_generic(self.next_cid, payload)
+        self.pipe.source.notify_checkpoint_complete(
+            self.next_cid, payload["offsets"]
+        )
+        for s in self.pipe.all_sinks:
+            s.notify_checkpoint_complete(self.next_cid)
+        self.next_cid += 1
+        self.steps_at_ckpt = self.metrics.steps
+
+    def restore(self, path_or_storage, cid=None):
+        st = (
+            ckpt.CheckpointStorage(path_or_storage)
+            if isinstance(path_or_storage, str) else path_or_storage
+        )
+        cid = cid if cid is not None else st.latest()
+        if cid is None:
+            raise FileNotFoundError(f"no checkpoint in {st.dir}")
+        payload = st.read_generic(cid)
+        if payload.get("stage_kind") != self.stage_kind:
+            raise ValueError(
+                f"checkpoint was not written by a {self.stage_kind} "
+                f"stage (found {payload.get('stage_kind')!r})"
+            )
+        if payload["max_parallelism"] != self.env.max_parallelism:
+            raise ValueError("checkpoint max-parallelism mismatch")
+        if payload["n_shards"] != self.ctx.n_shards:
+            raise ValueError(
+                f"checkpoint has {payload['n_shards']} shard(s), job "
+                f"configured for {self.ctx.n_shards}"
+            )
+        snap_meta = payload.get("stage_meta", {})
+        for k, v in self.meta.items():
+            if snap_meta.get(k) != v:
+                raise ValueError(
+                    f"checkpoint {k} {snap_meta.get(k)!r} != "
+                    f"configured {v!r}"
+                )
+        self.emitter.discard()
+        _leaves, treedef = jax.tree_util.tree_flatten(self.get_state())
+        self.set_state(jax.tree_util.tree_unflatten(treedef, [
+            jax.device_put(x, self.ctx.state_sharding)
+            for x in payload["stage_state"]
+        ]))
+        self.pipe.source.restore_offsets(payload["offsets"])
+        sink_states = payload.get("sink_states")
+        if sink_states:
+            if len(sink_states) != len(self.pipe.all_sinks):
+                raise ValueError(
+                    f"checkpoint has {len(sink_states)} sink states "
+                    f"but the job topology has {len(self.pipe.all_sinks)} "
+                    f"sinks — restore with the matching pipeline"
+                )
+            for s, ss in zip(self.pipe.all_sinks, sink_states):
+                s.restore_state(ss)
+        count = payload.get("codec_rev_count", 0)
+        if self.keep_rev and count:
+            self.codec._rev = st.read_keymap(count)
+            # restoring from a FOREIGN directory (savepoint): the job's
+            # own keymap log has none of these keys, so the next
+            # checkpoint must append them all (n_keys_logged=0);
+            # same-dir restores resume the append-only log where it is
+            same_dir = self.storage is not None and (
+                os.path.abspath(st.dir)
+                == os.path.abspath(self.storage.dir)
+            )
+            self.n_keys_logged = len(self.codec._rev) if same_dir else 0
+        self.steps_at_ckpt = self.metrics.steps
+
+    def write_savepoint(self, path: str) -> str:
+        self.emitter.drain()
+        sp = ckpt.CheckpointStorage(path, retain=10**9)
+        cid = (sp.latest() or 0) + 1
+        # self-contained savepoint: full keymap into ITS directory
+        logged = self.n_keys_logged
+        self.n_keys_logged = 0
+        try:
+            return sp.write_generic(cid, self._payload(sp))
+        finally:
+            self.n_keys_logged = logged
+
+    def run_with_restarts(self, batch_loop, restore_from):
+        """Restore + restart protection around the stage's batch loop
+        (ref ExecutionGraph.restart)."""
+        if restore_from:
+            self.restore(restore_from)
+        restart = self.executor._restart_strategy()
+        while True:
+            try:
+                batch_loop()
+                break
+            except JobCancelledException:
+                raise
+            except Exception:
+                can = (
+                    self.storage is not None
+                    and self.storage.latest() is not None
+                    and restart.should_restart()
+                )
+                if not can:
+                    raise
+                self.metrics.restarts += 1
+                self.executor._notify_restart()
+                self.restore(self.storage)
+
+
 @dataclasses.dataclass
 class JobMetrics:
     records_in: int = 0
@@ -2066,12 +2251,6 @@ class LocalExecutor:
         values = np.asarray([extractor(e) for e in elements], np.float32)
         return key_list, values
 
-    def _check_no_checkpointing(self, kind: str, restore_from=None):
-        if self.env.checkpoint_interval_steps or self.env.checkpoint_dir or restore_from:
-            raise NotImplementedError(
-                f"checkpoint/restore is not implemented yet for {kind} stages"
-            )
-
     def _run_generic_window(self, pipe: _Pipeline, metrics: JobMetrics,
                             job_name, restore_from=None):
         """Windows with custom triggers/evictors/apply functions or
@@ -2604,7 +2783,6 @@ class LocalExecutor:
             RollingStageSpec, build_rolling_step, init_rolling_state,
         )
 
-        self._check_no_checkpointing("rolling-reduce", restore_from)
         env = self.env
         roll = pipe.rolling
         red = roll.reduce_spec_factory()
@@ -2672,32 +2850,54 @@ class LocalExecutor:
 
         emitter = _LaggedEmitter(env, emit_one)
 
-        end = False
-        while not end:
-            self._poll_control()
-            polled, end = pipe.source.poll(B)
-            prepped = self._prep_keyed_batch(pipe, polled, roll.extractor)
-            if prepped is None:
-                emitter.idle()    # an idle source must not withhold results
-                continue
-            key_list, values = prepped
-            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
-            n = len(hi)
-            metrics.records_in += n
-            state, outputs, out_valid = step(
-                state,
-                jnp.asarray(_pad(hi, B, np.uint32)),
-                jnp.asarray(_pad(lo, B, np.uint32)),
-                jnp.asarray(_pad(values, B, values.dtype)),
-                jnp.asarray(_pad(np.ones(n, bool), B, bool)),
-            )
-            metrics.steps += 1
-            klist = (
-                key_list.tolist() if isinstance(key_list, np.ndarray)
-                else key_list
-            )
-            emitter.push((outputs, out_valid, klist, n))
-        emitter.drain()
+        def _set_state(s):
+            nonlocal state
+            state = s
+
+        ckptr = _FlatStageCheckpointer(
+            self, pipe, ctx, codec, keep_rev, emitter, metrics,
+            get_state=lambda: state, set_state=_set_state,
+            stage_kind="rolling-reduce",
+            meta={
+                "capacity_per_shard": env.state_capacity_per_shard,
+                "red_kind": red.kind,
+            },
+        )
+
+        def batch_loop():
+            nonlocal state
+            end = False
+            while not end:
+                self._poll_control()
+                polled, end = pipe.source.poll(B)
+                prepped = self._prep_keyed_batch(pipe, polled,
+                                                 roll.extractor)
+                if prepped is None:
+                    emitter.idle()  # idle source must not withhold results
+                    continue
+                key_list, values = prepped
+                hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+                n = len(hi)
+                metrics.records_in += n
+                state, outputs, out_valid = step(
+                    state,
+                    jnp.asarray(_pad(hi, B, np.uint32)),
+                    jnp.asarray(_pad(lo, B, np.uint32)),
+                    jnp.asarray(_pad(values, B, values.dtype)),
+                    jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+                )
+                metrics.steps += 1
+                klist = (
+                    key_list.tolist() if isinstance(key_list, np.ndarray)
+                    else key_list
+                )
+                emitter.push((outputs, out_valid, klist, n))
+                ckptr.maybe_checkpoint()
+            # end of stream INSIDE restart protection: a sink failing
+            # during the final drain recovers like any mid-stream failure
+            emitter.drain()
+
+        ckptr.run_with_restarts(batch_loop, restore_from)
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped
@@ -2898,7 +3098,13 @@ class LocalExecutor:
             count = payload.get("codec_rev_count", 0)
             if keep_rev and count:
                 codec._rev = st.read_keymap(count)
-                n_keys_logged = count
+                # foreign-dir (savepoint) restore: the job's own keymap
+                # log lacks these keys — re-append all on next checkpoint
+                same_dir = storage is not None and (
+                    os.path.abspath(st.dir)
+                    == os.path.abspath(storage.dir)
+                )
+                n_keys_logged = len(codec._rev) if same_dir else 0
             wm_strategy._current = payload["wm_current"]
             if payload["origin_ms"] is not None:
                 td = TimeDomain(origin_ms=payload["origin_ms"],
@@ -3055,7 +3261,6 @@ class LocalExecutor:
             CountStageSpec, build_count_step, init_count_state,
         )
 
-        self._check_no_checkpointing("count-window", restore_from)
         env = self.env
         wagg = pipe.window_agg
         red = wagg.reduce_spec_factory()
@@ -3093,28 +3298,49 @@ class LocalExecutor:
 
         emitter = _LaggedEmitter(env, emit_one)
 
-        end = False
-        while not end:
-            self._poll_control()
-            polled, end = pipe.source.poll(B)
-            prepped = self._prep_keyed_batch(pipe, polled, wagg.extractor)
-            if prepped is None:
-                emitter.idle()
-                continue
-            key_list, values = prepped
-            hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
-            n = len(hi)
-            metrics.records_in += n
-            state, khi, klo, w, vals, mask = step(
-                state,
-                jnp.asarray(_pad(hi, B, np.uint32)),
-                jnp.asarray(_pad(lo, B, np.uint32)),
-                jnp.asarray(_pad(values, B, values.dtype)),
-                jnp.asarray(_pad(np.ones(n, bool), B, bool)),
-            )
-            metrics.steps += 1
-            emitter.push((khi, klo, w, vals, mask))
-        emitter.drain()
+        def _set_state(s):
+            nonlocal state
+            state = s
+
+        ckptr = _FlatStageCheckpointer(
+            self, pipe, ctx, codec, keep_rev, emitter, metrics,
+            get_state=lambda: state, set_state=_set_state,
+            stage_kind="count-window",
+            meta={
+                "capacity_per_shard": env.state_capacity_per_shard,
+                "red_kind": red.kind,
+                "n_per_window": wagg.assigner.size_n,
+            },
+        )
+
+        def batch_loop():
+            nonlocal state
+            end = False
+            while not end:
+                self._poll_control()
+                polled, end = pipe.source.poll(B)
+                prepped = self._prep_keyed_batch(pipe, polled,
+                                                 wagg.extractor)
+                if prepped is None:
+                    emitter.idle()
+                    continue
+                key_list, values = prepped
+                hi, lo = codec.encode(key_list, keep_reverse=keep_rev)
+                n = len(hi)
+                metrics.records_in += n
+                state, khi, klo, w, vals, mask = step(
+                    state,
+                    jnp.asarray(_pad(hi, B, np.uint32)),
+                    jnp.asarray(_pad(lo, B, np.uint32)),
+                    jnp.asarray(_pad(values, B, values.dtype)),
+                    jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+                )
+                metrics.steps += 1
+                emitter.push((khi, klo, w, vals, mask))
+                ckptr.maybe_checkpoint()
+            emitter.drain()
+
+        ckptr.run_with_restarts(batch_loop, restore_from)
 
         dropped = int(np.asarray(state.dropped_capacity).sum())
         metrics.dropped_capacity = dropped

@@ -75,16 +75,25 @@ def parse_key(raw: str):
 
 
 class QueryableStateClient:
-    """ref QueryableStateClient: point lookups against a running job."""
+    """ref QueryableStateClient: point lookups against a running job.
+    Attaches the shared secret (runtime/security.py) as a Bearer token
+    when one is configured — the server side 401s without it."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 token: Optional[str] = None):
+        from flink_tpu.runtime import security
+
         self.base = f"http://{host}:{port}"
         self.timeout_s = timeout_s
+        self.token = token if token is not None else security.get_token()
 
     def get_kv_state(self, job_id: str, name: str, key) -> Any:
         q = urllib.parse.quote(str(key))
         url = f"{self.base}/jobs/{job_id}/state/{name}?key={q}"
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+        req = urllib.request.Request(url)
+        if self.token is not None:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
             payload = json.loads(r.read())
         if not payload.get("ok", False):
             raise KeyError(payload.get("error", "state query failed"))

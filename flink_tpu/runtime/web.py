@@ -35,19 +35,49 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from flink_tpu.runtime.cluster import MiniCluster
+from flink_tpu.runtime import security
 
 
 class WebMonitor:
+    """HTTP plane. When a shared secret is configured (see
+    runtime/security.py — config keys or FLINK_TPU_AUTH_TOKEN), EVERY
+    route requires it, queryable-state reads included: state values are
+    exactly the data worth protecting (ref KvStateServerHandler).
+    Clients send ``Authorization: Bearer <token>`` or ``?token=``."""
+
     def __init__(self, cluster: MiniCluster, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, config=None):
         self.cluster = cluster
+        self._token = security.get_token(config)
         monitor = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
+            def _authorized(self) -> bool:
+                if monitor._token is None:
+                    return True
+                import hmac as _hmac
+                auth = self.headers.get("Authorization", "")
+                got = auth[7:] if auth.startswith("Bearer ") else None
+                if got is None:
+                    q = dict(urllib.parse.parse_qsl(
+                        urllib.parse.urlsplit(self.path).query))
+                    got = q.get("token")
+                return isinstance(got, str) and _hmac.compare_digest(
+                    got, monitor._token)
+
             def do_GET(self):
+                if not self._authorized():
+                    data = json.dumps({"error": "unauthorized"}).encode()
+                    self.send_response(401)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("WWW-Authenticate", "Bearer")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if urllib.parse.urlsplit(self.path).path in ("/web", "/web/"):
                     data = _DASHBOARD_HTML.encode()
                     self.send_response(200)
@@ -388,7 +418,8 @@ _DASHBOARD_HTML = """<!doctype html>
  </div>
 </main><script>
 let sel=null;
-const J=async p=>{const r=await fetch(p);if(!r.ok)throw new Error(p+" -> "+r.status);
+const TOK=new URLSearchParams(location.search).get("token");
+const J=async p=>{if(TOK)p+=(p.includes("?")?"&":"?")+"token="+encodeURIComponent(TOK);const r=await fetch(p);if(!r.ok)throw new Error(p+" -> "+r.status);
  return r.json()};
 const fmtDur=ms=>ms<0?"-":(ms/1000).toFixed(1)+"s";
 async function tick(){

@@ -1,0 +1,256 @@
+"""Checkpoint/savepoint/restore for rolling-reduce and count-window
+stages (round 5: removes the last two `_check_no_checkpointing` refusals;
+ref AbstractStreamOperator.java:367 — EVERY operator snapshots its state;
+rolling aggregates live in ValueState via StreamGroupedReduce)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.runtime.sinks import CollectSink
+
+
+class SnapSink(CollectSink):
+    """CollectSink that participates in checkpoints."""
+
+    def snapshot_state(self):
+        return list(self.results)
+
+    def restore_state(self, state):
+        self.results[:] = state
+
+
+class FailOnceSink(SnapSink):
+    """Raises once mid-stream after `trip_at` results, then behaves."""
+
+    def __init__(self, trip_at):
+        super().__init__()
+        self.trip_at = trip_at
+        self.tripped = False
+
+    def invoke_batch(self, elements):
+        if not self.tripped and len(self.results) >= self.trip_at:
+            self.tripped = True
+            raise RuntimeError("induced sink failure")
+        super().invoke_batch(elements)
+
+
+class KillSink(SnapSink):
+    """Simulated process kill: KeyboardInterrupt is not restartable."""
+
+    def __init__(self, kill_at):
+        super().__init__()
+        self.kill_at = kill_at
+
+    def invoke_batch(self, elements):
+        super().invoke_batch(elements)
+        if len(self.results) >= self.kill_at:
+            raise KeyboardInterrupt("simulated kill")
+
+
+def _env(tmpdir, capacity=256, extra_cfg=None):
+    cfg = {"restart-strategy": "fixed-delay",
+           "restart-strategy.fixed-delay.attempts": 3,
+           "restart-strategy.fixed-delay.delay": 0}
+    cfg.update(extra_cfg or {})
+    env = StreamExecutionEnvironment(Configuration(cfg))
+    env.set_parallelism(2)
+    env.set_max_parallelism(8)
+    env.set_state_capacity(capacity)
+    env.batch_size = 8
+    env.enable_checkpointing(interval_steps=2, directory=str(tmpdir))
+    return env
+
+
+# ---------------------------------------------------------------- rolling
+
+def _rolling_events():
+    rng = np.random.default_rng(7)
+    return [(int(rng.integers(0, 5)), float(rng.integers(1, 4)))
+            for _ in range(120)]
+
+
+def _rolling_expect(events):
+    acc, out = {}, []
+    for k, v in events:
+        acc[k] = acc.get(k, 0.0) + v
+        out.append((k, acc[k]))
+    return out
+
+
+def _rolling_job(env, events, sink):
+    (
+        env.from_collection(events)
+        .key_by(lambda e: e[0])
+        .sum(lambda e: e[1])
+        .add_sink(sink)
+    )
+    return env
+
+
+def test_rolling_checkpoint_restart_exactness(tmp_path):
+    """Induced sink failure mid-stream: restore from the last checkpoint
+    and the per-record output sequence is exact (no loss, no dupes)."""
+    events = _rolling_events()
+    sink = FailOnceSink(trip_at=40)
+    env = _rolling_job(_env(tmp_path), events, sink)
+    job = env.execute("rolling-ckpt")
+    assert job.metrics.restarts >= 1
+    assert sink.results == _rolling_expect(events)
+
+
+def test_rolling_kill_and_resume_from_checkpoint(tmp_path):
+    """Half the stream, 'kill' (abandon the env), resume a FRESH env from
+    the checkpoint directory: output sequence is exact."""
+    events = _rolling_events()
+    s1 = KillSink(kill_at=60)
+    env1 = _rolling_job(_env(tmp_path), events, s1)
+    with pytest.raises(KeyboardInterrupt):
+        env1.execute("rolling-kill")
+
+    s2 = SnapSink()
+    env2 = _rolling_job(_env(tmp_path), events, s2)
+    env2.execute("rolling-resume", restore_from=str(tmp_path))
+    assert s2.results == _rolling_expect(events)
+
+
+def test_rolling_restore_validation_failures(tmp_path):
+    """Mismatched configuration fails fast at restore, never corrupts."""
+    events = _rolling_events()
+    env = _rolling_job(_env(tmp_path), events, SnapSink())
+    env.execute("rolling-write")
+
+    # wrong state capacity (the compiled step bakes it into its masks)
+    bad = _rolling_job(_env(tmp_path, capacity=512), events, SnapSink())
+    with pytest.raises(ValueError, match="capacity_per_shard"):
+        bad.execute("rolling-bad-cap", restore_from=str(tmp_path))
+
+    # wrong stage kind: a count-window job must refuse this checkpoint
+    cnt = _env(tmp_path)
+    (
+        cnt.from_collection(events)
+        .key_by(lambda e: e[0])
+        .count_window(3)
+        .sum(lambda e: e[1])
+        .add_sink(SnapSink())
+    )
+    with pytest.raises(ValueError, match="count-window"):
+        cnt.execute("rolling-bad-kind", restore_from=str(tmp_path))
+
+    # wrong max-parallelism
+    bad_mp = _env(tmp_path)
+    bad_mp.set_max_parallelism(16)
+    _rolling_job(bad_mp, events, SnapSink())
+    with pytest.raises(ValueError, match="max-parallelism"):
+        bad_mp.execute("rolling-bad-mp", restore_from=str(tmp_path))
+
+
+# ------------------------------------------------------------ count window
+
+def _count_events():
+    rng = np.random.default_rng(11)
+    return [(int(rng.integers(0, 4)), float(rng.integers(1, 4)))
+            for _ in range(150)]
+
+
+def _count_expect(events, n):
+    acc, cnt, widx = {}, {}, {}
+    fires = []
+    for k, v in events:
+        acc[k] = acc.get(k, 0.0) + v
+        cnt[k] = cnt.get(k, 0) + 1
+        if cnt[k] == n:
+            fires.append((k, widx.get(k, 0), acc[k]))
+            widx[k] = widx.get(k, 0) + 1
+            acc[k], cnt[k] = 0.0, 0
+    return fires
+
+
+def _count_job(env, events, sink, n=5):
+    (
+        env.from_collection(events)
+        .key_by(lambda e: e[0])
+        .count_window(n)
+        .sum(lambda e: e[1])
+        .add_sink(sink)
+    )
+    return env
+
+
+def test_count_checkpoint_restart_exactness(tmp_path):
+    events = _count_events()
+    sink = FailOnceSink(trip_at=10)
+    env = _count_job(_env(tmp_path), events, sink)
+    job = env.execute("count-ckpt")
+    assert job.metrics.restarts >= 1
+    got = [(r.key, r.window_end_ms, r.value) for r in sink.results]
+    assert sorted(got) == sorted(_count_expect(events, 5))
+
+
+def test_count_kill_and_resume_from_checkpoint(tmp_path):
+    events = _count_events()
+    s1 = KillSink(kill_at=12)
+    env1 = _count_job(_env(tmp_path), events, s1)
+    with pytest.raises(KeyboardInterrupt):
+        env1.execute("count-kill")
+
+    s2 = SnapSink()
+    env2 = _count_job(_env(tmp_path), events, s2)
+    env2.execute("count-resume", restore_from=str(tmp_path))
+    got = [(r.key, r.window_end_ms, r.value) for r in s2.results]
+    assert sorted(got) == sorted(_count_expect(events, 5))
+
+
+def test_count_restore_validation_failures(tmp_path):
+    events = _count_events()
+    env = _count_job(_env(tmp_path), events, SnapSink())
+    env.execute("count-write")
+
+    # wrong window size N (baked into the compiled step)
+    bad_n = _count_job(_env(tmp_path), events, SnapSink(), n=7)
+    with pytest.raises(ValueError, match="n_per_window"):
+        bad_n.execute("count-bad-n", restore_from=str(tmp_path))
+
+    # wrong stage kind: a rolling job must refuse this checkpoint
+    roll = _env(tmp_path)
+    (
+        roll.from_collection(events)
+        .key_by(lambda e: e[0])
+        .sum(lambda e: e[1])
+        .add_sink(SnapSink())
+    )
+    with pytest.raises(ValueError, match="rolling-reduce"):
+        roll.execute("count-bad-kind", restore_from=str(tmp_path))
+
+    # wrong shard count
+    bad_sh = _env(tmp_path)
+    bad_sh.set_parallelism(4)
+    _count_job(bad_sh, events, SnapSink())
+    with pytest.raises(ValueError, match="shard"):
+        bad_sh.execute("count-bad-shards", restore_from=str(tmp_path))
+
+
+def test_rolling_foreign_dir_restore_keymap(tmp_path):
+    """Restore from a FOREIGN directory (the savepoint story: job A's
+    checkpoints seed job B with its own checkpoint dir), then fail and
+    restart from job B's OWN storage: the codec reverse map must survive
+    both hops — string keys would otherwise decode to raw hash garbage."""
+    rng = np.random.default_rng(13)
+    events = [("key-%d" % rng.integers(0, 5), float(rng.integers(1, 4)))
+              for _ in range(120)]
+    dir_a, dir_b = tmp_path / "a", tmp_path / "b"
+
+    s1 = KillSink(kill_at=40)
+    env1 = _rolling_job(_env(dir_a), events, s1)
+    with pytest.raises(KeyboardInterrupt):
+        env1.execute("foreign-seed")
+
+    # resumes from A, checkpoints into B, trips once, restarts from B
+    s2 = FailOnceSink(trip_at=80)
+    env2 = _rolling_job(_env(dir_b), events, s2)
+    job = env2.execute("foreign-resume", restore_from=str(dir_a))
+    assert job.metrics.restarts >= 1
+    assert s2.results == _rolling_expect(events)
+    assert all(isinstance(k, str) and k.startswith("key-")
+               for k, _ in s2.results)

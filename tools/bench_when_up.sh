@@ -10,7 +10,7 @@
 # Then keeps watching: after a success it sleeps 30 min and re-runs, so a
 # later code improvement or a quieter tunnel refreshes the numbers.
 cd "$(dirname "$0")/.."
-ROUND=r04
+ROUND=${ROUND:-r05}
 while true; do
   if timeout 60 python - <<'PYEOF' 2>/dev/null
 import subprocess, sys
@@ -39,6 +39,15 @@ PYEOF
       grep -h '"config"' /tmp/bench_configs_tpu.txt \
           > BENCH_CONFIGS_${ROUND}.jsonl
       echo "$(date -u +%FT%TZ) configs captured" >&2
+    fi
+    # commit any captured artifacts so a session end can't lose them
+    if [ "$captured" = 1 ] || grep -qh '"config"' /tmp/bench_configs_tpu.txt 2>/dev/null; then
+      for f in BENCH_${ROUND}.json BENCH_SESSION_${ROUND}.json \
+               BENCH_SESSION_${ROUND}.log BENCH_CONFIGS_${ROUND}.jsonl; do
+        [ -f "$f" ] && git add "$f"
+      done
+      git diff --cached --quiet || \
+          git commit -m "Capture TPU bench results (${ROUND} watcher)" >&2
     fi
     # long refresh pause only after a real capture; a mid-bench tunnel
     # drop goes back to the fast probe cadence (short up-windows matter)
