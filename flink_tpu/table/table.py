@@ -274,6 +274,23 @@ from flink_tpu.ops.segment import grouped_reduce as _segment  # noqa: E402
 # (shared device scatter-reduce; same kernel the DataSet group_by path uses)
 
 
+def join_output_names(lschema, rschema, lks, rks) -> Dict[str, str]:
+    """Right-column -> post-join name, shared by plan-time schema
+    inference (TableEnvironment._build_logical) and the join executors
+    so the two can never drift: merged key columns (same-named equi key)
+    are absent (the left column carries them), clashing names get the
+    ``r_`` prefix."""
+    out_names = set(lschema)
+    mapping: Dict[str, str] = {}
+    for k in rschema:
+        if k in rks and lks[rks.index(k)] == k:
+            continue
+        name = k if k not in out_names else f"r_{k}"
+        mapping[k] = name
+        out_names.add(name)
+    return mapping
+
+
 class Table:
     def __init__(self, cols: Dict[str, np.ndarray]):
         self.cols = {k: np.asarray(v) for k, v in cols.items()}
@@ -431,13 +448,14 @@ class Table:
             return np.where(idx >= 0, t, None) if (idx < 0).any() else t
 
         out = {k: take(v, li) for k, v in self.cols.items()}
+        names = join_output_names(list(self.cols), list(other.cols),
+                                  lks, rks)
         for k, v in other.cols.items():
-            if k in rks and lks[rks.index(k)] == k:
+            if k not in names:
                 # shared key column: fill left-side gaps from the right
                 out[k] = np.where(li >= 0, out[k], take(v, ri))
                 continue
-            name = k if k not in out else f"r_{k}"
-            out[name] = take(v, ri)
+            out[names[k]] = take(v, ri)
         joined = Table(out)
         if residual is not None:
             joined = joined.where(residual)
@@ -457,9 +475,10 @@ class Table:
                 + ")"
             )
         out = {k: v[li] for k, v in self.cols.items()}
+        names = join_output_names(list(self.cols), list(other.cols),
+                                  [], [])
         for k, v in other.cols.items():
-            name = k if k not in out else f"r_{k}"
-            out[name] = v[ri]
+            out[names[k]] = v[ri]
         joined = Table(out)
         if residual is not None:
             joined = joined.where(residual)
@@ -552,14 +571,15 @@ class TableEnvironment:
         re.IGNORECASE | re.DOTALL,
     )
 
-    def _lower_join(self, t: Table, ft: str, jt: str, on_sql: str,
-                    how: str, plan: Optional[List[str]]) -> Table:
+    def _analyze_on(self, ft: str, jt: str, on_sql: str, how: str,
+                    lschema: List[str], rschema: List[str]):
         """ON condition -> equi conjuncts (composite hash-join keys) +
-        residual predicate (the non-equi remainder, filtered post-join).
-        No equi conjunct at all lowers to the nested-loop product (inner
-        only) — ref FlinkPlannerImpl's join condition split between
-        hash-join keys and the remaining filter."""
-        right = self.scan(jt)
+        residual predicate (the non-equi remainder, filtered post-join,
+        rewritten to post-join column names). No equi conjunct at all
+        lowers to the nested-loop product (inner only) — ref
+        FlinkPlannerImpl's join condition split between hash-join keys
+        and the remaining filter. Returns (lks, rks, residual_sql,
+        clash)."""
 
         def side_of(ref: str) -> Optional[str]:
             if "." in ref:
@@ -573,7 +593,7 @@ class TableEnvironment:
             return None
 
         conjuncts = re.split(r"\s+AND\s+", on_sql, flags=re.IGNORECASE)
-        lks, rks, residual_sql = [], [], []
+        lks, rks, residual_parts = [], [], []
         for cj in conjuncts:
             m = re.fullmatch(
                 r"\s*(\w+(?:\.\w+)?)\s*=\s*(\w+(?:\.\w+)?)\s*", cj
@@ -583,7 +603,7 @@ class TableEnvironment:
                 sides = [side_of(r) for r in refs]
                 cols_ = [r.split(".")[-1] for r in refs]
                 if sides[0] == sides[1] and sides[0] is not None:
-                    residual_sql.append(cj)     # same-side equality
+                    residual_parts.append(cj)    # same-side equality
                     continue
                 if "left" in sides:
                     i = sides.index("left")
@@ -593,20 +613,20 @@ class TableEnvironment:
                     rk, lk = cols_[i], cols_[1 - i]
                 else:
                     lk, rk = cols_
-                    if lk not in t.schema and rk in t.schema:
+                    if lk not in lschema and rk in lschema:
                         lk, rk = rk, lk
                 lks.append(lk)
                 rks.append(rk)
             else:
-                residual_sql.append(cj)
+                residual_parts.append(cj)
 
-        residual = None
-        if residual_sql:
+        clash = (set(lschema) & set(rschema)) - {
+            rk for lk, rk in zip(lks, rks) if lk == rk
+        }
+        residual_sql = None
+        if residual_parts:
             # rewrite qualified refs to post-join column names: left
             # names stay bare, clashing right names carry the r_ prefix
-            clash = (set(t.schema) & set(right.schema)) - {
-                rk for lk, rk in zip(lks, rks) if lk == rk
-            }
 
             def rw(s: str) -> str:
                 def sub(m):
@@ -621,10 +641,8 @@ class TableEnvironment:
                     r"\b([A-Za-z_]\w*)\.([A-Za-z_]\w*)\b", sub, s
                 )
 
-            residual = _parse_expr(
-                " AND ".join(rw(c) for c in residual_sql)
-            )
-        if residual is not None and how != "inner":
+            residual_sql = " AND ".join(rw(c) for c in residual_parts)
+        if residual_sql is not None and how != "inner":
             # correct outer-join ON-residual semantics gate MATCHING (the
             # unmatched row stays, null-extended) — a post-join filter
             # would be silently wrong, so refuse instead
@@ -632,78 +650,161 @@ class TableEnvironment:
                 "non-equi ON conditions are supported for INNER joins "
                 "only; move the predicate to WHERE for filter semantics"
             )
-        if lks:
-            return t.join(right, lks, rks, how=how, residual=residual,
-                          _plan=plan)
-        if how != "inner":
+        if not lks and how != "inner":
             raise ValueError(
                 "outer joins require at least one equi condition in ON"
             )
-        return t.cross_join(right, residual=residual, _plan=plan)
+        return lks, rks, residual_sql, clash
 
-    def sql_query(self, query: str, _plan: Optional[List[str]] = None
-                  ) -> Table:
-        m = self._SQL.match(query)
-        if not m:
-            raise ValueError(f"unsupported SQL shape: {query!r}")
-        t = self.scan(m.group("from"))
-        if _plan is not None:
-            _plan.append(f"Scan({m.group('from')}, {t.n} rows)")
+    # -- logical planning (see table/planner.py) -------------------------
+    def _build_logical(self, m):
+        """Parsed query -> unoptimized logical tree (the AST the rule
+        pipeline rewrites — ref FlinkPlannerImpl's rel() step)."""
+        from flink_tpu.table import planner as pl
+
+        ft = m.group("from")
+        t = self.scan(ft)
+        node: object = pl.LScan(ft, t.n, list(t.schema))
         if m.group("jtable"):
+            jt = m.group("jtable")
+            right = self.scan(jt)
             how = (m.group("jhow") or "inner").split()[0].lower()
-            if _plan is not None:
-                _plan.append(
-                    f"Scan({m.group('jtable')}, "
-                    f"{self.scan(m.group('jtable')).n} rows)"
-                )
-            t = self._lower_join(t, m.group("from"), m.group("jtable"),
-                                 m.group("on"), how, _plan)
+            lks, rks, residual_sql, clash = self._analyze_on(
+                ft, jt, m.group("on"), how, list(t.schema),
+                list(right.schema),
+            )
+            names = join_output_names(list(t.schema),
+                                      list(right.schema), lks, rks)
+            out = list(t.schema) + list(names.values())
+            node = pl.LJoin(
+                node, pl.LScan(jt, right.n, list(right.schema)),
+                how, lks, rks, residual_sql, out, clash,
+            )
         if m.group("where"):
+            node = pl.LFilter(node, pl.split_conjuncts(m.group("where")))
+        select_items = _split_commas(m.group("select"))
+        star = select_items == ["*"]
+        if m.group("group"):
+            keys = [k.strip() for k in _split_commas(m.group("group"))]
+            items = keys if star else select_items
+            node = pl.LAggregate(node, keys, items, list(items))
+        elif not star:
+            node = pl.LProject(node, select_items, list(select_items))
+        if m.group("order"):
+            node = pl.LSort(node, m.group("order").strip())
+        if m.group("limit"):
+            node = pl.LLimit(node, int(m.group("limit")))
+        return node
+
+    def _execute_logical(self, node, plan: Optional[List[str]]) -> Table:
+        """Lower the (optimized) logical tree onto the columnar Table
+        operators, recording the measured physical plan."""
+        from flink_tpu.table import planner as pl
+
+        if isinstance(node, pl.LScan):
+            t = self.scan(node.name)
+            if node.empty:
+                t = t.limit(0)
+            if node.keep is not None:
+                t = Table({k: t.cols[k] for k in node.keep})
+            if plan is not None:
+                extra = (
+                    f", cols={node.keep}" if node.keep is not None else ""
+                )
+                plan.append(f"Scan({node.name}, {t.n} rows{extra})")
+            return t
+        if isinstance(node, pl.LFilter):
+            t = self._execute_logical(node.input, plan)
             n_in = t.n
-            t = t.where(_parse_expr(m.group("where")))
-            if _plan is not None:
-                _plan.append(
-                    f"Filter({m.group('where').strip()}, {n_in} -> "
+            sql = " AND ".join(f"({c})" for c in node.conjuncts)
+            t = t.where(_parse_expr(sql))
+            if plan is not None:
+                plan.append(
+                    f"Filter({' AND '.join(node.conjuncts)}, {n_in} -> "
                     f"{t.n} rows, selectivity "
                     f"{t.n / n_in if n_in else 0:.2f})"
                 )
-        select_items = _split_commas(m.group("select"))
-        exprs = (
-            None if select_items == ["*"]
-            else [_parse_select_item(s) for s in select_items]
-        )
-        if m.group("group"):
-            keys = [k.strip() for k in _split_commas(m.group("group"))]
-            t = t.group_by(*keys).select(*(exprs or keys))
-            if _plan is not None:
-                _plan.append(
-                    f"HashAggregate(keys={keys}, {t.n} groups)"
+            return t
+        if isinstance(node, pl.LJoin):
+            left = self._execute_logical(node.left, plan)
+            right = self._execute_logical(node.right, plan)
+            residual = (
+                _parse_expr(node.residual_sql)
+                if node.residual_sql else None
+            )
+            if node.lks:
+                return left.join(right, node.lks, node.rks, how=node.how,
+                                 residual=residual, _plan=plan)
+            return left.cross_join(right, residual=residual, _plan=plan)
+        if isinstance(node, pl.LAggregate):
+            t = self._execute_logical(node.input, plan)
+            exprs = [_parse_select_item(s) for s in node.items]
+            t = t.group_by(*node.keys).select(*exprs)
+            if plan is not None:
+                plan.append(
+                    f"HashAggregate(keys={node.keys}, {t.n} groups)"
                 )
-        elif exprs is not None:
+            return t
+        if isinstance(node, pl.LProject):
+            t = self._execute_logical(node.input, plan)
+            exprs = [_parse_select_item(s) for s in node.items]
             t = t.select(*exprs)
-            if _plan is not None:
-                _plan.append(f"Project({[e.name for e in exprs]})")
-        if m.group("order"):
-            spec = m.group("order").strip()
+            if plan is not None:
+                plan.append(f"Project({[e.name for e in exprs]})")
+            return t
+        if isinstance(node, pl.LSort):
+            t = self._execute_logical(node.input, plan)
+            spec = node.spec
             desc = bool(re.search(r"\s+DESC$", spec, re.IGNORECASE))
             key = re.sub(r"\s+(DESC|ASC)$", "", spec, flags=re.IGNORECASE)
             t = t.order_by(key.strip(), ascending=not desc)
-            if _plan is not None:
-                _plan.append(f"Sort({spec})")
-        if m.group("limit"):
-            t = t.limit(int(m.group("limit")))
-            if _plan is not None:
-                _plan.append(f"Limit({m.group('limit')})")
-        return t
+            if plan is not None:
+                plan.append(f"Sort({spec})")
+            return t
+        if isinstance(node, pl.LLimit):
+            t = self._execute_logical(node.input, plan)
+            t = t.limit(node.n)
+            if plan is not None:
+                plan.append(f"Limit({node.n})")
+            return t
+        raise TypeError(f"unknown logical node {type(node).__name__}")
+
+    def sql_query(self, query: str, _plan: Optional[List[str]] = None,
+                  optimize: bool = True) -> Table:
+        """Parse -> logical plan -> rule rewriting -> execute.
+        ``optimize=False`` runs the unrewritten tree (the baseline for
+        plan-diff tests and the planner benchmark)."""
+        from flink_tpu.table import planner as pl
+
+        m = self._SQL.match(query)
+        if not m:
+            raise ValueError(f"unsupported SQL shape: {query!r}")
+        root = self._build_logical(m)
+        if optimize:
+            root, _ = pl.optimize(root)
+        return self._execute_logical(root, _plan)
 
     def explain(self, query: str) -> str:
-        """Physical plan + cost annotations for a SQL query (ref
-        TableEnvironment.explain / FlinkPlannerImpl.scala:46 — a planner
-        SEAM with measured row counts and build-side choices, not a
-        Calcite port)."""
+        """AST + rewritten logical plan + measured physical plan (ref
+        TableEnvironment.explain / FlinkPlannerImpl.scala:46 — a rule
+        pipeline over a logical tree, not a Calcite port)."""
+        from flink_tpu.table import planner as pl
+
+        m = self._SQL.match(query)
+        if not m:
+            raise ValueError(f"unsupported SQL shape: {query!r}")
+        root = self._build_logical(m)
+        ast_txt = pl.render(root)
+        opt, rules = pl.optimize(root)
         plan: List[str] = []
-        self.sql_query(query, _plan=plan)
-        return "== Physical Plan ==\n" + "\n".join(plan)
+        self._execute_logical(opt, plan)
+        return (
+            "== Abstract Syntax Tree ==\n" + ast_txt
+            + "\n\n== Optimized Logical Plan ==\n" + pl.render(opt)
+            + "\napplied: "
+            + (", ".join(rules) if rules else "(none)")
+            + "\n\n== Physical Plan ==\n" + "\n".join(plan)
+        )
 
 
 def _split_commas(s: str) -> List[str]:

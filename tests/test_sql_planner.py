@@ -1,0 +1,241 @@
+"""Rule-driven logical planner (table/planner.py — the
+FlinkPlannerImpl.scala:46 seam): plan-diff tests per rewrite rule plus
+optimized/unoptimized result equivalence."""
+
+import re
+
+import numpy as np
+import pytest
+
+from flink_tpu.table.table import TableEnvironment
+
+
+def _env():
+    tenv = TableEnvironment.create()
+    rng = np.random.default_rng(5)
+    n = 2000
+    tenv.register_table("orders", tenv.from_columns({
+        "oid": np.arange(n),
+        "cust": rng.integers(0, 50, n),
+        "amount": rng.uniform(1.0, 100.0, n).round(2),
+        "region": rng.integers(0, 4, n),
+        "pad1": np.zeros(n), "pad2": np.zeros(n), "pad3": np.zeros(n),
+    }))
+    tenv.register_table("customers", tenv.from_columns({
+        "cust": np.arange(50),
+        "credit": rng.uniform(10.0, 90.0, 50).round(2),
+        "tier": rng.integers(1, 4, 50),
+        "pad4": np.zeros(50),
+    }))
+    return tenv
+
+
+def _rows(t):
+    return sorted(map(tuple, t.to_rows()), key=repr)
+
+
+def _probe_rows(plan_lines):
+    for ln in plan_lines:
+        m = re.search(r"probe=(\d+) rows", ln)
+        if m:
+            return int(m.group(1))
+    raise AssertionError(f"no HashJoin in {plan_lines}")
+
+
+def test_filter_pushdown_shrinks_join_probe():
+    """A WHERE conjunct on one join side moves below the join: the probe
+    input shrinks from 2000 rows to the filtered count, and results are
+    identical to the unoptimized plan."""
+    tenv = _env()
+    q = ("SELECT oid, tier FROM orders JOIN customers "
+         "ON orders.cust = customers.cust WHERE amount > 90.0")
+    p_opt, p_raw = [], []
+    t_opt = tenv.sql_query(q, _plan=p_opt)
+    t_raw = tenv.sql_query(q, _plan=p_raw, optimize=False)
+    assert _rows(t_opt) == _rows(t_raw)
+    assert _probe_rows(p_opt) < _probe_rows(p_raw)
+    assert _probe_rows(p_raw) == 2000
+    plan = tenv.explain(q)
+    assert "FilterPushdown" in plan
+    # optimized tree: Filter sits under the Join, above the orders scan
+    opt_section = plan.split("== Optimized Logical Plan ==")[1]
+    assert opt_section.index("Join(") < opt_section.index("Filter(")
+
+
+def test_filter_pushdown_splits_conjuncts_both_sides():
+    tenv = _env()
+    q = ("SELECT oid FROM orders JOIN customers "
+         "ON orders.cust = customers.cust "
+         "WHERE amount > 50.0 AND tier = 2 AND oid + tier > 0")
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert _rows(t_opt) == _rows(t_raw)
+    plan = tenv.explain(q)
+    opt = plan.split("== Optimized Logical Plan ==")[1].split("applied")[0]
+    # both single-side conjuncts pushed below the join; the cross-side
+    # conjunct stays above it
+    join_at = opt.index("Join(")
+    assert opt.index("Filter(amount > 50.0") > join_at
+    assert opt.index("Filter(tier = 2") > join_at
+    assert opt.index("Filter(oid + tier > 0") < join_at
+
+
+def test_outer_join_pushdown_legality():
+    """LEFT join: left-side predicates commute with null-extension and
+    push; right-side predicates must NOT (they would drop the
+    null-extended rows a WHERE keeps visible for filtering)."""
+    tenv = TableEnvironment.create()
+    tenv.register_table("a", tenv.from_columns({
+        "k": [1, 2, 3], "x": [10.0, 20.0, 30.0]}))
+    tenv.register_table("b", tenv.from_columns({
+        "k": [1, 9], "y": [5.0, 6.0]}))
+    q = "SELECT k, x FROM a LEFT JOIN b ON a.k = b.k WHERE x > 15.0"
+    assert _rows(tenv.sql_query(q)) == _rows(
+        tenv.sql_query(q, optimize=False))
+    opt = tenv.explain(q).split("== Optimized Logical Plan ==")[1]
+    assert opt.index("Join(") < opt.index("Filter(")   # pushed
+
+    # right-side predicate on a LEFT join: the rule must refuse (plan
+    # level — filtering a null-extended column is a separate limitation)
+    from flink_tpu.table import planner as pl
+
+    m = tenv._SQL.match(
+        "SELECT k, x FROM a LEFT JOIN b ON a.k = b.k WHERE y > 5.5")
+    root, rules = pl.optimize(tenv._build_logical(m))
+    assert "FilterPushdown" not in rules
+    assert isinstance(root, pl.LProject)
+    assert isinstance(root.input, pl.LFilter)           # still above
+    assert isinstance(root.input.input, pl.LJoin)
+
+
+def test_constant_filter_true_drops_and_false_empties():
+    tenv = _env()
+    q = "SELECT oid FROM orders WHERE 1 = 1 AND amount > 95.0"
+    plan = tenv.explain(q)
+    assert "ConstantFilter" in plan
+    opt = plan.split("== Optimized Logical Plan ==")[1]
+    assert "1 = 1" not in opt
+    assert _rows(tenv.sql_query(q)) == _rows(
+        tenv.sql_query(q, optimize=False))
+
+    q2 = ("SELECT oid, tier FROM orders JOIN customers "
+          "ON orders.cust = customers.cust WHERE 1 = 0")
+    p2 = []
+    t2 = tenv.sql_query(q2, _plan=p2)
+    assert t2.n == 0
+    # both scans under the false filter run emptied: the join is free
+    assert any("orders, 0 rows" in ln for ln in p2)
+    assert any("customers, 0 rows" in ln for ln in p2)
+
+
+def test_column_pruning_narrows_scans():
+    tenv = _env()
+    q = ("SELECT oid, tier FROM orders JOIN customers "
+         "ON orders.cust = customers.cust")
+    plan = tenv.explain(q)
+    assert "ColumnPruning" in plan
+    opt = plan.split("== Optimized Logical Plan ==")[1]
+    # pad columns never referenced -> not materialized
+    assert "pad1" not in opt and "pad4" not in opt
+    m = re.search(r"Scan\(orders, cols=\[([^\]]*)\]", opt)
+    assert m and set(re.findall(r"'(\w+)'", m.group(1))) == {
+        "oid", "cust"}
+    assert _rows(tenv.sql_query(q)) == _rows(
+        tenv.sql_query(q, optimize=False))
+
+
+def test_pruning_preserves_clash_naming():
+    """Pruning must not un-clash a renamed right column: r_credit keeps
+    meaning the RIGHT side's credit even when the left copy is unused."""
+    tenv = TableEnvironment.create()
+    tenv.register_table("l", tenv.from_columns({
+        "k": [1, 2], "credit": [100.0, 200.0], "unused": [0.0, 0.0]}))
+    tenv.register_table("r", tenv.from_columns({
+        "k": [1, 2], "credit": [7.0, 8.0]}))
+    q = "SELECT k, r_credit FROM l JOIN r ON l.k = r.k ORDER BY k"
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert t_opt.to_rows() == t_raw.to_rows() == [(1, 7.0), (2, 8.0)]
+
+
+def test_aggregate_query_prunes_and_matches():
+    tenv = _env()
+    q = ("SELECT region, SUM(amount) AS total FROM orders "
+         "WHERE amount > 10.0 GROUP BY region ORDER BY region")
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert t_opt.to_rows() == t_raw.to_rows()
+    opt = tenv.explain(q).split("== Optimized Logical Plan ==")[1]
+    m = re.search(r"Scan\(orders, cols=\[([^\]]*)\]", opt)
+    assert m and set(re.findall(r"'(\w+)'", m.group(1))) == {
+        "region", "amount"}
+
+
+def test_select_star_is_never_pruned():
+    tenv = _env()
+    q = "SELECT * FROM orders WHERE amount > 99.0"
+    plan = tenv.explain(q)
+    assert "ColumnPruning" not in plan
+    t = tenv.sql_query(q)
+    assert set(t.schema) == {"oid", "cust", "amount", "region",
+                             "pad1", "pad2", "pad3"}
+
+
+def test_string_literal_with_and_survives_conjunct_split():
+    tenv = TableEnvironment.create()
+    tenv.register_table("t", tenv.from_columns({
+        "name": ["x AND y", "z"], "v": [1.0, 2.0]}))
+    t = tenv.sql_query("SELECT v FROM t WHERE name = 'x AND y' AND v > 0.5")
+    assert t.to_rows() == [(1.0,)]
+
+
+def test_planner_benchmark_query_improves():
+    """The benchmark query (selective filter + wide join): the optimized
+    plan probes an order of magnitude fewer rows AND runs measurably
+    faster on a scaled-up input (wall-clock sanity, generous margin)."""
+    import time
+
+    tenv = TableEnvironment.create()
+    rng = np.random.default_rng(9)
+    n = 200_000
+    tenv.register_table("facts", tenv.from_columns({
+        "k": rng.integers(0, 1000, n),
+        "v": rng.uniform(0, 100, n),
+        **{f"w{i}": np.zeros(n) for i in range(8)},
+    }))
+    tenv.register_table("dims", tenv.from_columns({
+        "k": np.arange(1000), "label": np.arange(1000) % 7,
+        **{f"d{i}": np.zeros(1000) for i in range(4)},
+    }))
+    q = ("SELECT k, label FROM facts JOIN dims ON facts.k = dims.k "
+         "WHERE v > 99.0")
+    p_opt, p_raw = [], []
+    t0 = time.perf_counter()
+    t_opt = tenv.sql_query(q, _plan=p_opt)
+    t_o = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    t_raw = tenv.sql_query(q, _plan=p_raw, optimize=False)
+    t_r = time.perf_counter() - t0
+    assert _rows(t_opt) == _rows(t_raw)
+    assert _probe_rows(p_raw) == n
+    assert _probe_rows(p_opt) < n // 50      # ~1% selectivity
+    # generous wall-clock check (1.5x slack for loaded CI machines): the
+    # deterministic proof is the probe-row assertion above
+    assert t_o < t_r * 1.5, (t_o, t_r)
+
+
+def test_pushdown_rename_spares_string_literals():
+    """Regression: pushing a right-side conjunct rewrites r_X column refs
+    to X but must NOT touch a string literal that happens to read
+    'r_<clash>'."""
+    tenv = TableEnvironment.create()
+    tenv.register_table("l", tenv.from_columns({
+        "k": [1, 2], "credit": [100.0, 200.0]}))
+    tenv.register_table("r", tenv.from_columns({
+        "k": [1, 2], "credit": [7.0, 8.0],
+        "name": ["r_credit", "credit"]}))
+    q = ("SELECT k, name FROM l JOIN r ON l.k = r.k "
+         "WHERE name = 'r_credit'")
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert t_opt.to_rows() == t_raw.to_rows() == [(1, "r_credit")]

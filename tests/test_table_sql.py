@@ -327,7 +327,7 @@ def test_explain_shows_plan_and_build_side():
         "WHERE amount > 1.0 GROUP BY id ORDER BY id LIMIT 3"
     )
     assert "Physical Plan" in plan
-    assert "Scan(orders, 4 rows)" in plan
+    assert "Scan(orders, 4 rows" in plan
     assert "HashJoin" in plan and "build=right[3 rows]" in plan
     assert "residual=" in plan
     assert "Filter" in plan and "selectivity" in plan
