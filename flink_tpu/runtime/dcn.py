@@ -8,15 +8,23 @@ TaskManager.scala:296 registration). The TPU-native redesign
   * each HOST ingests whatever its source partitions contain (any keys)
     and feeds only its LOCAL devices — records cross the slow network
     once, as ingestion bytes;
-  * ONE ``jax.lax.all_to_all`` over the global mesh routes every record
-    to the device owning its key group (parallel/exchange.py) — the
-    keyed shuffle rides the accelerator fabric (ICI on a pod; the
-    cross-process collective transport stands in for it here);
+  * ONE collective over the global mesh routes every record to the
+    device owning its key group (``all_to_all`` for the pane-ring time
+    windows, ``all_gather`` + mask for the replicate-and-mask session
+    kernel) — the keyed shuffle rides the accelerator fabric (ICI on a
+    pod; the cross-process collective transport stands in for it here);
   * control decisions ride the SAME collectives: the global watermark is
     an on-device ``pmin`` of per-host watermarks, and loop termination is
     an on-device conjunction of per-host "source exhausted" flags — so
     every process executes an identical lockstep sequence of compiled
     steps (the SPMD invariant), with no out-of-band consensus protocol.
+
+Round 5 generalizes the plane beyond the original tumbling-sum runner:
+sliding windows (any size/slide via the pane ring), session windows
+(gap-merged, ``DCNSessionRunner``), any built-in reduce kind, and the
+standard ``StreamExecutionEnvironment.execute()`` path selects it when
+``dcn.coordinator`` is configured (runtime/executor.py _run_dcn) — the
+reference's "same program on every TaskManager" deployment story.
 
 Worker processes join the mesh with ``jax.distributed.initialize``
 (the ``--coordinator`` seam the design doc specified); on CPU test
@@ -51,7 +59,7 @@ MAX_TICKS = 2**31 - 4
 
 @dataclass
 class DCNJobSpec:
-    """One keyed tumbling-window aggregation fed from per-host partitions.
+    """One keyed windowed aggregation fed from per-host partitions.
 
     source_factory(process_id, num_processes) -> object with
         poll(max_records) -> (keys int64[n], ts_ms int64[n],
@@ -60,16 +68,22 @@ class DCNJobSpec:
         restore(state)
     (the per-host slice of the partitioned-consumer contract,
     connectors/partitioned.py / FlinkKafkaConsumerBase.java:65).
+
+    window_kind "time" covers tumbling (slide_ms None/== size_ms) and
+    sliding windows; "session" uses gap_ms-merged session windows.
     """
 
     source_factory: Callable
-    size_ms: int
-    capacity_per_shard: int
+    size_ms: int = 0
+    capacity_per_shard: int = 1 << 16
     max_parallelism: int = 128
     batch_per_host: int = 4096
     fires_per_step: int = 4
     out_of_orderness_ms: int = 0
     reduce_kind: str = "sum"
+    slide_ms: Optional[int] = None
+    window_kind: str = "time"      # "time" | "session"
+    gap_ms: int = 0                # session gap
     # epoch-ms timestamps exceed int32 ticks: the runner rebases every
     # ts to this origin. A SPEC field (not derived from data) so all
     # lockstep processes agree without coordination; set it to e.g. the
@@ -104,8 +118,13 @@ class GeneratorPartitionSource:
         self.offset = int(state["offset"])
 
 
-class DCNWindowRunner:
-    """One process's half of the lockstep multi-host window job."""
+class _DCNRunnerBase:
+    """One process's half of a lockstep multi-host keyed job: global-mesh
+    setup, the ingest/step/emit loop, and checkpoint/restore. Subclasses
+    compile the stage step (``_build_step`` setting ``self._step``) and
+    decode its per-shard fire outputs (``_emit_local``). The step
+    contract: step(state, hi, lo, ts, values, valid, wm, done) ->
+    (state, aux, stop) with stop an all-shards-identical int32."""
 
     def __init__(self, spec: DCNJobSpec, process_id: int,
                  num_processes: int,
@@ -120,7 +139,9 @@ class DCNWindowRunner:
         self.ckpt_every = ckpt_every
         self.want_restore = restore
         self.source = spec.source_factory(process_id, num_processes)
-        self.rows_key = []      # emitted (key_id, window_end_ms, value)
+        # emitted (key_id, window_start_ms, window_end_ms, value)
+        self.rows_key = []
+        self.rows_start = []
         self.rows_end = []
         self.rows_val = []
         self._persisted_chunks = 0   # rows chunks already in a checkpoint
@@ -142,103 +163,11 @@ class DCNWindowRunner:
         self._build_step()
         self._init_state()
 
-    # -- compiled lockstep step -------------------------------------------
-    def _build_step(self):
-        import jax
-        import jax.numpy as jnp
-        from jax import shard_map
+    # -- mesh plumbing ----------------------------------------------------
+    def _mk_lane_sharding(self, mesh):
         from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from flink_tpu.ops import window_kernels as wk
-        from flink_tpu.parallel.exchange import bucket_capacity
         from flink_tpu.parallel.mesh import SHARD_AXIS
-        from flink_tpu.runtime.step import (
-            WindowStageSpec,
-            exchange_update_shard,
-        )
 
-        spec = self.spec
-        n = self.n
-        maxp = spec.max_parallelism
-        ring = max(8, 2 * 1 + spec.out_of_orderness_ms // spec.size_ms + 4)
-        self.win = wk.WindowSpec(
-            size_ticks=spec.size_ms, slide_ticks=spec.size_ms,
-            ring=ring, fires_per_step=spec.fires_per_step,
-        )
-        self.red = wk.ReduceSpec(kind=spec.reduce_kind)
-        win, red = self.win, self.red
-        bpd = self.B_local // self.L    # lanes per device
-        cap = bucket_capacity(bpd, n, 2.0)
-        self.bucket_cap = cap
-        starts, ends = self.ctx.kg_bounds()
-        starts_j = jnp.asarray(starts)
-        ends_j = jnp.asarray(ends)
-        F = spec.fires_per_step
-        C = spec.capacity_per_shard
-        probe_len = 16
-        mesh = self.ctx.mesh
-
-        stage = WindowStageSpec(win=win, red=red, capacity_per_shard=C,
-                                probe_len=probe_len)
-
-        def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
-                       wm, done):
-            state = jax.tree_util.tree_map(lambda x: x[0], state)
-            kg_start, kg_end = kg_start[0], kg_end[0]
-            # global control values: decisions ride the same fabric as
-            # records, so every process sees identical results and the
-            # lockstep invariant holds by construction
-            gwm = jax.lax.pmin(wm[0], SHARD_AXIS)
-            gdone = jax.lax.pmin(done[0], SHARD_AXIS)
-            # the cross-host keyed shuffle: ONE all_to_all over the
-            # global mesh (RecordWriter.java:82 redesigned) — shared body
-            # with the single-host exchange step (runtime/step.py)
-            state, _ = exchange_update_shard(
-                state, stage, kg_start, kg_end, hi, lo, ts, values, valid,
-                n, maxp, cap,
-            )
-            state, fr = wk.advance_and_fire(state, win, red, gwm)
-            cf = wk.compact_fires(state.table, fr)
-            # fire backlog: a full on-time lane set means more window ends
-            # may be due — every process must keep stepping
-            pending = (jnp.sum(fr.lane_valid[:F], dtype=jnp.int32)
-                       >= jnp.int32(F)).astype(jnp.int32)
-            gpending = jax.lax.pmax(pending, SHARD_AXIS)
-            stop = gdone * (1 - gpending)
-            pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-            return pack(state), pack(cf), stop, gwm
-
-        sharded = shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(
-                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                # batch lanes are SPLIT over the global mesh: each host's
-                # records sit on its local devices only
-                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                P(SHARD_AXIS),
-                P(SHARD_AXIS), P(SHARD_AXIS),
-            ),
-            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P()),
-            check_vma=False,
-        )
-
-        from functools import partial
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def step(state, hi, lo, ts, values, valid, wm, done):
-            return sharded(state, starts_j, ends_j, hi, lo, ts, values,
-                           valid, wm, done)
-
-        self._step = step
-
-        def sharded_init():
-            st = wk.init_state(C, probe_len, win, red)
-            return jax.tree_util.tree_map(lambda x: x[None], st)
-
-        self._init_fn = jax.jit(shard_map(
-            sharded_init, mesh=mesh, in_specs=(),
-            out_specs=P(SHARD_AXIS), check_vma=False,
-        ))
         self._lane_sharding = NamedSharding(mesh, P(SHARD_AXIS))
 
     def _init_state(self):
@@ -247,7 +176,6 @@ class DCNWindowRunner:
         if self.want_restore and self.ckpt_dir:
             self._restore_latest()
 
-    # -- host loop ---------------------------------------------------------
     def _global(self, local: np.ndarray):
         """Assemble a global [nproc*B_local] mesh-sharded array from this
         process's local lanes (jax.make_array_from_process_local_data:
@@ -258,6 +186,7 @@ class DCNWindowRunner:
             self._lane_sharding, local
         )
 
+    # -- host loop ---------------------------------------------------------
     def run(self) -> dict:
         from flink_tpu.ops.hashing import key_identity64
 
@@ -305,12 +234,12 @@ class DCNWindowRunner:
             wm = np.full(self.L, np.int32(wm_now))
             done = np.full(self.L, np.int32(1 if exhausted else 0))
 
-            self.state, cf, stop, _gwm = self._step(
+            self.state, aux, stop = self._step(
                 self.state, self._global(hi), self._global(lo),
                 self._global(ts), self._global(values), self._global(valid),
                 self._global(wm), self._global(done),
             )
-            self._emit_local(cf)
+            self._emit_local(aux)
             self.cycle += 1
             # NO exhausted gate: with unequal partitions one host drains
             # early, and gating on the local flag would leave the ensemble
@@ -324,43 +253,15 @@ class DCNWindowRunner:
         return {
             "key_id": (np.concatenate(self.rows_key)
                        if self.rows_key else np.zeros(0, np.uint64)),
+            "window_start_ms": (np.concatenate(self.rows_start)
+                                if self.rows_start
+                                else np.zeros(0, np.int64)),
             "window_end_ms": (np.concatenate(self.rows_end)
                               if self.rows_end else np.zeros(0, np.int64)),
             "value": (np.concatenate(self.rows_val)
                       if self.rows_val else np.zeros(0, np.float32)),
             "cycles": self.cycle,
         }
-
-    def _emit_local(self, cf):
-        """Each process emits fires from ITS addressable shards only —
-        "records enter on host A, fire from host B" is literal: the keys
-        in these rows arrived via the all_to_all from whichever host
-        ingested them."""
-        for leaf_idx, (counts_sh, lanes_sh, ends_sh, khi_sh, klo_sh,
-                       vals_sh) in enumerate(zip(
-                cf.counts.addressable_shards, cf.lane_valid.addressable_shards,
-                cf.window_end_ticks.addressable_shards,
-                cf.key_hi.addressable_shards, cf.key_lo.addressable_shards,
-                cf.values.addressable_shards)):
-            counts = np.asarray(counts_sh.data)[0]
-            lanes = np.asarray(lanes_sh.data)[0]
-            ends = np.asarray(ends_sh.data)[0]
-            khi = None
-            for f in np.nonzero(lanes)[0]:
-                c = int(counts[f])
-                if c == 0:
-                    continue
-                if khi is None:
-                    khi = np.asarray(khi_sh.data)[0]
-                    klo = np.asarray(klo_sh.data)[0]
-                    vv = np.asarray(vals_sh.data)[0]
-                k64 = (khi[f, :c].astype(np.uint64) << np.uint64(32)) \
-                    | klo[f, :c].astype(np.uint64)
-                self.rows_key.append(k64)
-                self.rows_end.append(np.full(
-                    c, int(ends[f]) + self.spec.origin_ms, np.int64
-                ))
-                self.rows_val.append(vv[f, :c].astype(np.float32))
 
     # -- checkpoint / restore ---------------------------------------------
     # Deterministic lockstep cadence: every process reaches cycle k
@@ -384,10 +285,13 @@ class DCNWindowRunner:
         # O(new rows), and restore replays the deltas in cid order (the
         # per-checkpoint sink-offset pattern of runtime/checkpoint.py)
         dk = self.rows_key[self._persisted_chunks:]
+        ds = self.rows_start[self._persisted_chunks:]
         de = self.rows_end[self._persisted_chunks:]
         dv = self.rows_val[self._persisted_chunks:]
         arrs["rows_key"] = (np.concatenate(dk) if dk
                             else np.zeros(0, np.uint64))
+        arrs["rows_start"] = (np.concatenate(ds) if ds
+                              else np.zeros(0, np.int64))
         arrs["rows_end"] = (np.concatenate(de) if de
                             else np.zeros(0, np.int64))
         arrs["rows_val"] = (np.concatenate(dv) if dv
@@ -445,7 +349,8 @@ class DCNWindowRunner:
         # emissions = concatenation of every delta up to (and including)
         # the restored cut; deltas past it belong to a globally
         # incomplete checkpoint and will be re-emitted by replay
-        self.rows_key, self.rows_end, self.rows_val = [], [], []
+        self.rows_key, self.rows_start = [], []
+        self.rows_end, self.rows_val = [], []
         chosen = os.path.basename(d)
         for name in sorted(os.listdir(self.ckpt_dir)):
             if not name.startswith("chk-") or name > chosen:
@@ -455,6 +360,10 @@ class DCNWindowRunner:
             ))
             if len(delta["rows_key"]):
                 self.rows_key.append(delta["rows_key"])
+                self.rows_start.append(
+                    delta["rows_start"] if "rows_start" in delta
+                    else np.zeros(len(delta["rows_key"]), np.int64)
+                )
                 self.rows_end.append(delta["rows_end"])
                 self.rows_val.append(delta["rows_val"])
         self._persisted_chunks = len(self.rows_key)
@@ -462,6 +371,324 @@ class DCNWindowRunner:
         self._next_cid = int(meta["next_cid"])
         self.local_wm_ticks = int(meta["wm_ticks"])
         self.source.restore(meta["source"])
+
+
+class DCNWindowRunner(_DCNRunnerBase):
+    """Aligned time windows (tumbling AND sliding via the pane ring) over
+    the global mesh; the keyed shuffle is ONE all_to_all
+    (RecordWriter.java:82 redesigned)."""
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from flink_tpu.ops import window_kernels as wk
+        from flink_tpu.parallel.exchange import bucket_capacity
+        from flink_tpu.parallel.mesh import SHARD_AXIS
+        from flink_tpu.runtime.step import (
+            WindowStageSpec,
+            exchange_update_shard,
+        )
+
+        spec = self.spec
+        n = self.n
+        maxp = spec.max_parallelism
+        if spec.size_ms <= 0:
+            raise ValueError(
+                "time-window DCN job requires size_ms > 0 "
+                "(set DCNJobSpec.size_ms)"
+            )
+        slide = spec.slide_ms or spec.size_ms
+        if spec.size_ms % slide:
+            raise ValueError(
+                f"size_ms {spec.size_ms} must be a multiple of slide_ms "
+                f"{slide}"
+            )
+        ppw = spec.size_ms // slide
+        # ring covers in-flight windows + out-of-orderness backlog (the
+        # executor's sizing, executor.py setup())
+        ring = max(8, 2 * ppw + spec.out_of_orderness_ms // slide + 4)
+        self.win = wk.WindowSpec(
+            size_ticks=spec.size_ms, slide_ticks=slide,
+            ring=ring, fires_per_step=spec.fires_per_step,
+        )
+        self.red = wk.ReduceSpec(kind=spec.reduce_kind)
+        win, red = self.win, self.red
+        bpd = self.B_local // self.L    # lanes per device
+        cap = bucket_capacity(bpd, n, 2.0)
+        self.bucket_cap = cap
+        starts, ends = self.ctx.kg_bounds()
+        starts_j = jnp.asarray(starts)
+        ends_j = jnp.asarray(ends)
+        F = spec.fires_per_step
+        C = spec.capacity_per_shard
+        probe_len = 16
+        mesh = self.ctx.mesh
+
+        stage = WindowStageSpec(win=win, red=red, capacity_per_shard=C,
+                                probe_len=probe_len)
+
+        def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                       wm, done):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            kg_start, kg_end = kg_start[0], kg_end[0]
+            # global control values: decisions ride the same fabric as
+            # records, so every process sees identical results and the
+            # lockstep invariant holds by construction
+            gwm = jax.lax.pmin(wm[0], SHARD_AXIS)
+            gdone = jax.lax.pmin(done[0], SHARD_AXIS)
+            # the cross-host keyed shuffle: ONE all_to_all over the
+            # global mesh (RecordWriter.java:82 redesigned) — shared body
+            # with the single-host exchange step (runtime/step.py)
+            state, _ = exchange_update_shard(
+                state, stage, kg_start, kg_end, hi, lo, ts, values, valid,
+                n, maxp, cap,
+            )
+            state, fr = wk.advance_and_fire(state, win, red, gwm)
+            cf = wk.compact_fires(state.table, fr)
+            # fire backlog: a full on-time lane set means more window ends
+            # may be due — every process must keep stepping
+            pending = (jnp.sum(fr.lane_valid[:F], dtype=jnp.int32)
+                       >= jnp.int32(F)).astype(jnp.int32)
+            gpending = jax.lax.pmax(pending, SHARD_AXIS)
+            stop = gdone * (1 - gpending)
+            pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            return pack(state), pack(cf), stop
+
+        sharded = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                # batch lanes are SPLIT over the global mesh: each host's
+                # records sit on its local devices only
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+            ),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+            check_vma=False,
+        )
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, hi, lo, ts, values, valid, wm, done):
+            return sharded(state, starts_j, ends_j, hi, lo, ts, values,
+                           valid, wm, done)
+
+        self._step = step
+
+        def sharded_init():
+            st = wk.init_state(C, probe_len, win, red)
+            return jax.tree_util.tree_map(lambda x: x[None], st)
+
+        self._init_fn = jax.jit(shard_map(
+            sharded_init, mesh=mesh, in_specs=(),
+            out_specs=P(SHARD_AXIS), check_vma=False,
+        ))
+        self._mk_lane_sharding(mesh)
+
+    def _emit_local(self, cf):
+        """Each process emits fires from ITS addressable shards only —
+        "records enter on host A, fire from host B" is literal: the keys
+        in these rows arrived via the all_to_all from whichever host
+        ingested them."""
+        size = self.spec.size_ms
+        for (counts_sh, lanes_sh, ends_sh, khi_sh, klo_sh,
+             vals_sh) in zip(
+                cf.counts.addressable_shards,
+                cf.lane_valid.addressable_shards,
+                cf.window_end_ticks.addressable_shards,
+                cf.key_hi.addressable_shards, cf.key_lo.addressable_shards,
+                cf.values.addressable_shards):
+            counts = np.asarray(counts_sh.data)[0]
+            lanes = np.asarray(lanes_sh.data)[0]
+            ends = np.asarray(ends_sh.data)[0]
+            khi = None
+            for f in np.nonzero(lanes)[0]:
+                c = int(counts[f])
+                if c == 0:
+                    continue
+                if khi is None:
+                    khi = np.asarray(khi_sh.data)[0]
+                    klo = np.asarray(klo_sh.data)[0]
+                    vv = np.asarray(vals_sh.data)[0]
+                k64 = (khi[f, :c].astype(np.uint64) << np.uint64(32)) \
+                    | klo[f, :c].astype(np.uint64)
+                end_ms = int(ends[f]) + self.spec.origin_ms
+                self.rows_key.append(k64)
+                self.rows_start.append(np.full(c, end_ms - size, np.int64))
+                self.rows_end.append(np.full(c, end_ms, np.int64))
+                self.rows_val.append(vv[f, :c].astype(np.float32))
+
+
+class DCNSessionRunner(_DCNRunnerBase):
+    """Gap-merged session windows over the global mesh. The session
+    kernel is replicate-and-mask (ops/session_windows — every shard scans
+    the batch and keeps its key groups), so the DCN hop is ONE
+    ``all_gather`` of each host's lanes onto every shard; watermark and
+    termination ride pmin exactly like the time-window runner. Sessions
+    merging records from DIFFERENT hosts is the point: the gap merge
+    happens in the owning shard's device state wherever the records
+    entered."""
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from flink_tpu.ops import session_windows as sw
+        from flink_tpu.ops import window_kernels as wk
+        from flink_tpu.ops.hashing import route_hash
+        from flink_tpu.core.keygroups import assign_to_key_group
+        from flink_tpu.parallel.mesh import SHARD_AXIS
+
+        spec = self.spec
+        if spec.gap_ms <= 0:
+            raise ValueError("session DCN job requires gap_ms > 0")
+        maxp = spec.max_parallelism
+        self.red = wk.ReduceSpec(kind=spec.reduce_kind)
+        red = self.red
+        gap = spec.gap_ms
+        starts, ends = self.ctx.kg_bounds()
+        starts_j = jnp.asarray(starts)
+        ends_j = jnp.asarray(ends)
+        C = spec.capacity_per_shard
+        probe_len = 16
+        mesh = self.ctx.mesh
+
+        def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                       wm, done):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            kg_start, kg_end = kg_start[0], kg_end[0]
+            gwm = jax.lax.pmin(wm[0], SHARD_AXIS)
+            gdone = jax.lax.pmin(done[0], SHARD_AXIS)
+            # the DCN hop: every shard sees every host's lanes (the
+            # replicate side of replicate-and-mask; traffic-equivalent to
+            # the single-host step's replicated batch feed)
+            hi_g = jax.lax.all_gather(hi, SHARD_AXIS, tiled=True)
+            lo_g = jax.lax.all_gather(lo, SHARD_AXIS, tiled=True)
+            ts_g = jax.lax.all_gather(ts, SHARD_AXIS, tiled=True)
+            va_g = jax.lax.all_gather(values, SHARD_AXIS, tiled=True)
+            ok_g = jax.lax.all_gather(valid, SHARD_AXIS, tiled=True)
+            kg = assign_to_key_group(route_hash(hi_g, lo_g, jnp), maxp,
+                                     jnp)
+            mine = ok_g & (kg >= kg_start.astype(jnp.uint32)) & (
+                kg <= kg_end.astype(jnp.uint32)
+            )
+            state, old_f, mid_f, wm_f = sw.update_and_fire(
+                state, red, gap, hi_g, lo_g, ts_g, va_g, mine, gwm
+            )
+            # slot-space wm fires carry no keys — attach them here so the
+            # host never needs the (donated) state
+            wkeys = state.table.keys
+            wm_out = (wkeys[:, 0], wkeys[:, 1]) + tuple(wm_f)
+            # any records this step? sessions opened by the final batch
+            # need ONE empty follow-up step at wm=MAX to flush, so stop
+            # only on a globally record-free exhausted step
+            has_rec = jnp.any(ok_g).astype(jnp.int32)
+            stop = gdone * (1 - jax.lax.pmax(has_rec, SHARD_AXIS))
+            pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            return (pack(state), (pack(old_f), pack(mid_f), pack(wm_out)),
+                    stop)
+
+        sharded = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+            ),
+            out_specs=(
+                P(SHARD_AXIS),
+                (P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+                P(),
+            ),
+            check_vma=False,
+        )
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, hi, lo, ts, values, valid, wm, done):
+            return sharded(state, starts_j, ends_j, hi, lo, ts, values,
+                           valid, wm, done)
+
+        self._step = step
+
+        def sharded_init():
+            st = sw.init_state(C, probe_len, red)
+            return jax.tree_util.tree_map(lambda x: x[None], st)
+
+        self._init_fn = jax.jit(shard_map(
+            sharded_init, mesh=mesh, in_specs=(),
+            out_specs=P(SHARD_AXIS), check_vma=False,
+        ))
+        self._mk_lane_sharding(mesh)
+
+    def _emit_local(self, aux):
+        """Session fires from this process's addressable shards: two
+        lane-space sets (superseded/merged) carrying their own keys, plus
+        the slot-space watermark-close set keyed by the table rows."""
+        old_f, mid_f, wm_out = aux
+        origin = self.spec.origin_ms
+        for fire in (old_f, mid_f):
+            khi_l, klo_l, st_l, en_l, va_l, mk_l = (
+                a.addressable_shards for a in fire
+            )
+            for khi_s, klo_s, st_s, en_s, va_s, mk_s in zip(
+                    khi_l, klo_l, st_l, en_l, va_l, mk_l):
+                mask = np.asarray(mk_s.data)[0]
+                sel = np.nonzero(mask)[0]
+                if not sel.size:
+                    continue
+                khi = np.asarray(khi_s.data)[0][sel]
+                klo = np.asarray(klo_s.data)[0][sel]
+                self._push_rows(
+                    khi, klo,
+                    np.asarray(st_s.data)[0][sel],
+                    np.asarray(en_s.data)[0][sel],
+                    np.asarray(va_s.data)[0][sel], origin,
+                )
+        wkhi_l, wklo_l, st_l, en_l, va_l, mk_l = (
+            a.addressable_shards for a in wm_out
+        )
+        for khi_s, klo_s, st_s, en_s, va_s, mk_s in zip(
+                wkhi_l, wklo_l, st_l, en_l, va_l, mk_l):
+            mask = np.asarray(mk_s.data)[0]
+            sel = np.nonzero(mask)[0]
+            if not sel.size:
+                continue
+            self._push_rows(
+                np.asarray(khi_s.data)[0][sel],
+                np.asarray(klo_s.data)[0][sel],
+                np.asarray(st_s.data)[0][sel],
+                np.asarray(en_s.data)[0][sel],
+                np.asarray(va_s.data)[0][sel], origin,
+            )
+
+    def _push_rows(self, khi, klo, starts, ends, vals, origin):
+        k64 = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
+            np.uint64)
+        self.rows_key.append(k64)
+        self.rows_start.append(starts.astype(np.int64) + origin)
+        # kernel fire `end` is already last + gap (session TimeWindow
+        # semantics, ops/session_windows.update_and_fire docstring)
+        self.rows_end.append(ends.astype(np.int64) + origin)
+        self.rows_val.append(vals.astype(np.float32))
+
+
+def runner_for_spec(spec: DCNJobSpec, process_id: int, num_processes: int,
+                    **kw) -> _DCNRunnerBase:
+    if spec.window_kind == "session":
+        return DCNSessionRunner(spec, process_id, num_processes, **kw)
+    if spec.window_kind == "time":
+        return DCNWindowRunner(spec, process_id, num_processes, **kw)
+    raise ValueError(f"unknown window_kind {spec.window_kind!r}")
 
 
 def main(argv=None) -> int:
@@ -491,7 +718,7 @@ def main(argv=None) -> int:
     from flink_tpu.runtime.worker import load_builder
 
     spec = load_builder(a.builder)()
-    runner = DCNWindowRunner(
+    runner = runner_for_spec(
         spec, a.process_id, a.num_processes,
         checkpoint_dir=a.checkpoint_dir or None,
         ckpt_every=a.ckpt_every, restore=a.restore,
@@ -500,6 +727,7 @@ def main(argv=None) -> int:
     tmp = a.out + ".tmp"
     with open(tmp, "wb") as f:    # file object: savez appends no suffix
         np.savez(f, key_id=out["key_id"],
+                 window_start_ms=out["window_start_ms"],
                  window_end_ms=out["window_end_ms"], value=out["value"])
     os.replace(tmp, a.out)
     print(json.dumps({"rows": int(len(out["key_id"])),

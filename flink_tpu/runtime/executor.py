@@ -746,7 +746,10 @@ class LocalExecutor:
                 CountWindowAssigner, GlobalWindows,
             )
 
-            if pipe.window_agg is not None and (
+            if self.env.config.get_str("dcn.coordinator", ""):
+                handle = self._run_dcn(pipe, metrics, job_name,
+                                       restore_from)
+            elif pipe.window_agg is not None and (
                 pipe.window_agg.trigger is not None
                 or pipe.window_agg.evictor is not None
                 or pipe.window_agg.window_fn is not None
@@ -784,6 +787,210 @@ class LocalExecutor:
                 s.close()
         metrics.wall_time_s = time.perf_counter() - t_start
         return handle
+
+    # ------------------------------------------------------------------
+    def _run_dcn(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
+                 restore_from=None):
+        """Multi-host execution over the DCN global mesh: the SAME
+        program runs in every worker process (ref TaskManager.scala:296
+        deployment model); ``dcn.coordinator`` + ``dcn.num-processes`` +
+        ``dcn.process-id`` select this path from the standard
+        ``env.execute()``. The pipeline's windowed keyed stage lowers to
+        a DCNJobSpec; each process ingests ITS source's records and the
+        keyed shuffle rides the global-mesh collectives (runtime/dcn.py).
+
+        Supported: event-time tumbling/sliding/session windows over
+        integer keys with built-in reduces — the stage kinds the
+        cross-host kernels implement. Everything else raises rather than
+        silently running single-host."""
+        import jax
+
+        from flink_tpu.core.time import TimeCharacteristic
+        from flink_tpu.datastream.window.assigners import WindowAssigner
+        from flink_tpu.runtime import dcn
+
+        env = self.env
+        coord = env.config.get_str("dcn.coordinator")
+        nproc = env.config.get_int("dcn.num-processes", 1)
+        pid = env.config.get_int("dcn.process-id", 0)
+        wagg = pipe.window_agg
+        if wagg is None or pipe.key_by is None:
+            raise NotImplementedError(
+                "dcn execution covers windowed keyed stages "
+                "(tumbling/sliding/session); run other stage kinds "
+                "single-host or restructure the job"
+            )
+        if env.time_characteristic != TimeCharacteristic.EventTime or (
+            pipe.ts_transform is None and not pipe.source.columnar
+        ):
+            raise NotImplementedError(
+                "dcn execution requires event time, with an "
+                "assign_timestamps_and_watermarks stage or a columnar "
+                "source carrying a timestamp array (the lockstep "
+                "watermark is the pmin of per-host event-time watermarks)"
+            )
+        if (wagg.trigger is not None or wagg.evictor is not None
+                or wagg.window_fn is not None
+                or wagg.allowed_lateness_ms):
+            raise NotImplementedError(
+                "dcn execution does not cover custom triggers/evictors/"
+                "window functions or allowed lateness — these stage "
+                "shapes run single-host (the generic window operator)"
+            )
+        if wagg.reduce_spec_factory is None:
+            raise NotImplementedError(
+                "dcn execution requires a reduce aggregation "
+                "(sum/min/max/count)"
+            )
+        red = wagg.reduce_spec_factory()
+        if red.kind not in ("sum", "min", "max", "count") or \
+                getattr(red, "finalize", None) is not None or \
+                tuple(getattr(red, "value_shape", ()) or ()) not in (
+                    (), (1,)):
+            raise NotImplementedError(
+                f"dcn execution supports scalar built-in reduces, not "
+                f"{red.kind!r} with value shape "
+                f"{getattr(red, 'value_shape', ())!r} (e.g. mean() "
+                f"needs the composite-accumulator fire path)"
+            )
+        if wagg.result_fn is not None:
+            raise NotImplementedError(
+                "dcn execution does not apply result_fn finalization; "
+                "use a plain sum/min/max/count reduce"
+            )
+        assigner = wagg.assigner
+        spec_kw = dict(
+            capacity_per_shard=env.state_capacity_per_shard,
+            max_parallelism=env.max_parallelism,
+            batch_per_host=env.batch_size,
+            reduce_kind=red.kind,
+            out_of_orderness_ms=(
+                getattr(pipe.ts_transform.strategy,
+                        "out_of_orderness_ms", 0)
+                if pipe.ts_transform is not None else 0
+            ),
+            origin_ms=env.config.get_int("dcn.origin-ms", 0),
+        )
+        if getattr(assigner, "is_session", False):
+            spec_kw.update(window_kind="session",
+                           gap_ms=assigner.gap_ms)
+        elif isinstance(assigner, WindowAssigner) and \
+                assigner.is_event_time:
+            spec_kw.update(
+                size_ms=assigner.size_ms,
+                slide_ms=assigner.slide_ms,
+                fires_per_step=env.config.get_int(
+                    "window.fires-per-step", 4
+                ),
+            )
+        else:
+            raise NotImplementedError(
+                f"dcn execution does not cover "
+                f"{type(assigner).__name__} windows"
+            )
+
+        key_sel = pipe.key_by.key_selector
+        extractor = wagg.extractor
+        ts_fn = (pipe.ts_transform.timestamp_fn
+                 if pipe.ts_transform is not None else None)
+
+        class _PipeSource:
+            """Adapts this process's pipeline source to the per-host
+            partition contract (poll/snapshot/restore)."""
+
+            def poll(self_, max_records):
+                polled, end = pipe.source.poll(max_records)
+                if pipe.source.columnar and isinstance(polled, tuple):
+                    cols, src_ts = polled
+                    if not cols:
+                        z = np.zeros(0, np.int64)
+                        return z, z, np.zeros(0, np.float32), end
+                    for t in pipe.pre_chain:
+                        if t.kind != "map":
+                            raise NotImplementedError(
+                                "columnar sources support only 'map' "
+                                "before key_by"
+                            )
+                        cols = t.fn(cols)
+                    keys = np.asarray(key_sel(cols))
+                    vals = np.asarray(extractor(cols), np.float32)
+                    ts = np.asarray(
+                        ts_fn(cols) if ts_fn is not None else src_ts,
+                        np.int64,
+                    )
+                else:
+                    elements = _apply_chain(pipe.pre_chain,
+                                            self._to_elements(polled))
+                    if not elements:
+                        z = np.zeros(0, np.int64)
+                        return z, z, np.zeros(0, np.float32), end
+                    keys = np.asarray([key_sel(e) for e in elements])
+                    vals = np.asarray([extractor(e) for e in elements],
+                                      np.float32)
+                    ts = np.asarray([ts_fn(e) for e in elements],
+                                    np.int64)
+                if not np.issubdtype(keys.dtype, np.integer):
+                    raise NotImplementedError(
+                        "dcn execution requires integer keys (the key "
+                        "id IS the 64-bit routing identity across "
+                        "processes; string keys would need a "
+                        "coordinated codec)"
+                    )
+                metrics.records_in += len(keys)
+                return keys.astype(np.int64), ts, vals, end
+
+            def snapshot(self_):
+                return pipe.source.snapshot_offsets()
+
+            def restore(self_, state):
+                pipe.source.restore_offsets(state)
+
+        spec = dcn.DCNJobSpec(
+            source_factory=lambda _pid, _nproc: _PipeSource(),
+            **spec_kw,
+        )
+        if not getattr(jax.distributed, "is_initialized", lambda: False)():
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nproc,
+                process_id=pid,
+            )
+        if restore_from and (
+            not env.checkpoint_dir
+            or os.path.abspath(str(restore_from))
+            != os.path.abspath(env.checkpoint_dir)
+        ):
+            # the DCN runner restores the latest GLOBAL cut from the
+            # job's own lockstep checkpoint dir; silently substituting it
+            # for a named savepoint would resume from different state
+            raise NotImplementedError(
+                "dcn execution restores from the job's configured "
+                "checkpoint directory (the lockstep global cut); pass "
+                "restore_from equal to the checkpoint directory, or "
+                "point enable_checkpointing at the savepoint"
+            )
+        ckpt_every = env.checkpoint_interval_steps or 0
+        runner = dcn.runner_for_spec(
+            spec, pid, nproc,
+            checkpoint_dir=env.checkpoint_dir or None,
+            ckpt_every=ckpt_every,
+            restore=bool(restore_from),
+        )
+        out = runner.run()
+        metrics.steps = out["cycles"]
+        is_session = spec_kw.get("window_kind") == "session"
+        rows = []
+        for k64, st_, en_, v in zip(
+                out["key_id"], out["window_start_ms"],
+                out["window_end_ms"], out["value"]):
+            key = int(np.int64(np.uint64(k64)))
+            if is_session:
+                rows.append(SessionResult(key, int(st_), int(en_),
+                                          float(v)))
+            else:
+                rows.append(WindowResult(key, int(en_), float(v)))
+        metrics.fires += len(rows)
+        _emit_batch(pipe, rows, metrics)
+        return JobHandle(job_name, metrics)
 
     # ------------------------------------------------------------------
     def _run_stateless(self, pipe: _Pipeline, metrics: JobMetrics):

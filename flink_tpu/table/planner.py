@@ -42,11 +42,30 @@ _KEYWORDS = {
 }
 
 
+def stash_literals(sql: str):
+    """Pull SQL string literals out before any keyword/identifier regex
+    work (shared by refs/split_conjuncts/pushdown rename). Returns
+    (stashed_sql, restore_fn)."""
+    lits: List[str] = []
+
+    def stash(m):
+        lits.append(m.group(0))
+        return f"\x00{len(lits) - 1}\x00"
+
+    s = re.sub(r"'(?:[^']|'')*'", stash, sql)
+
+    def restore(p: str) -> str:
+        return re.sub(r"\x00(\d+)\x00",
+                      lambda m: lits[int(m.group(1))], p)
+
+    return s, restore
+
+
 def refs(sql: str) -> Optional[Set[str]]:
     """Column identifiers a SQL fragment references. None = cannot be
     analyzed confidently (qualified refs survive only in ON clauses,
     which are handled separately) — callers must then be conservative."""
-    s = re.sub(r"'(?:[^']|'')*'", " ", sql)          # string literals out
+    s, _ = stash_literals(sql)
     if re.search(r"\b[A-Za-z_]\w*\s*\.\s*[A-Za-z_]\w*", s):
         return None                                   # qualified ref
     out = set()
@@ -60,14 +79,18 @@ def refs(sql: str) -> Optional[Set[str]]:
 
 def split_conjuncts(sql: str) -> List[str]:
     """Top-level AND split (parenthesized ORs stay whole; ANDs inside
-    string literals don't split)."""
-    lits: List[str] = []
-
-    def stash(m):
-        lits.append(m.group(0))
-        return f"\x00{len(lits) - 1}\x00"
-
-    s = re.sub(r"'(?:[^']|'')*'", stash, sql)
+    string literals don't split). A top-level un-parenthesized OR binds
+    LOOSER than AND, so the expression is not a conjunction at all —
+    return it whole rather than severing an OR operand."""
+    s, restore = stash_literals(sql)
+    depth = 0
+    for tok in re.split(r"(\(|\))", s):
+        if tok == "(":
+            depth += 1
+        elif tok == ")":
+            depth -= 1
+        elif depth == 0 and re.search(r"\bOR\b", tok, re.IGNORECASE):
+            return [sql.strip()]
     parts, depth, cur = [], 0, []
     tokens = re.split(r"(\(|\)|\bAND\b)", s, flags=re.IGNORECASE)
     for tok in tokens:
@@ -83,9 +106,6 @@ def split_conjuncts(sql: str) -> List[str]:
         cur.append(tok or "")
     if cur:
         parts.append("".join(cur).strip())
-    restore = lambda p: re.sub(
-        r"\x00(\d+)\x00", lambda m: lits[int(m.group(1))], p
-    )
     return [restore(p) for p in parts if p]
 
 
@@ -250,22 +270,14 @@ def rule_filter_pushdown(node):
         elif side == "right" and join.how in ("inner", "right"):
             # post-join names r_X -> right-side X; string literals are
             # stashed first so a value like 'r_credit' stays untouched
-            lits: List[str] = []
-
-            def stash(m):
-                lits.append(m.group(0))
-                return f"\x00{len(lits) - 1}\x00"
-
-            s = re.sub(r"'(?:[^']|'')*'", stash, cj)
+            s, restore = stash_literals(cj)
             s = re.sub(
                 r"\br_([A-Za-z_]\w*)\b",
                 lambda m: m.group(1) if m.group(1) in join.clash
                 else m.group(0),
                 s,
             )
-            to_right.append(re.sub(
-                r"\x00(\d+)\x00", lambda m: lits[int(m.group(1))], s
-            ))
+            to_right.append(restore(s))
         else:
             stay.append(cj)
     if not to_left and not to_right:

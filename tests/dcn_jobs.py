@@ -48,3 +48,101 @@ def expected(nproc):
             w = ((i // TS_DIV) // WIN_MS + 1) * WIN_MS
             exp[(k, w)] = exp.get((k, w), 0) + 1.0
     return exp
+
+
+# -- round 5: generalized plane (sliding + sessions + env.execute) --------
+
+SLIDE_MS = 500
+GAP_MS = 40
+SESSION_TOTAL = 12_000
+SESSION_KEYS = 61        # small key set: sessions interleave heavily
+BURST = 5                # events per session burst
+IDLE = 120               # ms between a key's bursts (> GAP_MS: new session)
+
+
+def two_host_sliding():
+    """size=1000/slide=500: every record lands in 2 windows."""
+    return DCNJobSpec(
+        source_factory=_source,
+        size_ms=WIN_MS,
+        slide_ms=SLIDE_MS,
+        capacity_per_shard=2048,
+        max_parallelism=64,
+        batch_per_host=2048,
+        fires_per_step=4,
+    )
+
+
+def expected_sliding(nproc):
+    per_host = N_KEYS // nproc
+    exp = {}
+    for pid in range(nproc):
+        for i in range(TOTAL_PER_HOST):
+            k = pid + nproc * (i % per_host)
+            ts = i // TS_DIV
+            # windows [end-size, end) containing ts, ends on slide grid
+            first_end = (ts // SLIDE_MS + 1) * SLIDE_MS
+            end = first_end
+            while end < ts + WIN_MS + 1:
+                if end - WIN_MS <= ts < end:
+                    exp[(k, end)] = exp.get((k, end), 0) + 1.0
+                end += SLIDE_MS
+    return exp
+
+
+def _session_source(pid, nproc):
+    """Host p ingests keys ≡ p (mod nproc); each key emits bursts of
+    BURST events 1ms apart, separated by IDLE ms (> gap: session break).
+    ts is globally nondecreasing per host so the monotonic watermark is
+    valid."""
+    per_host = SESSION_KEYS // nproc
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        keys = pid + nproc * (idx % per_host)
+        burst = idx // (per_host * BURST)       # which burst round
+        within = (idx // per_host) % BURST      # position inside burst
+        ts = burst * IDLE + within
+        return keys, ts, np.ones(n, np.float32)
+
+    return GeneratorPartitionSource(gen, SESSION_TOTAL)
+
+
+def two_host_session():
+    return DCNJobSpec(
+        source_factory=_session_source,
+        window_kind="session",
+        gap_ms=GAP_MS,
+        capacity_per_shard=1024,
+        max_parallelism=64,
+        batch_per_host=1024,
+    )
+
+
+def expected_sessions(nproc):
+    """{(key, start, end): sum} from the scalar merging model."""
+    events = []
+    per_host = SESSION_KEYS // nproc
+    for pid in range(nproc):
+        for i in range(SESSION_TOTAL):
+            k = pid + nproc * (i % per_host)
+            burst = i // (per_host * BURST)
+            within = (i // per_host) % BURST
+            events.append((k, burst * IDLE + within))
+    sessions = {}
+    for k, ts in events:
+        lst = sessions.setdefault(k, [])
+        hit = None
+        for s in lst:
+            if s[0] - GAP_MS <= ts <= s[1] + GAP_MS:
+                s[0] = min(s[0], ts)
+                s[1] = max(s[1], ts)
+                s[2] += 1.0
+                hit = s
+                break
+        if hit is None:
+            lst.append([ts, ts, 1.0])
+    return {
+        (k, s[0], s[1] + GAP_MS): s[2]
+        for k, lst in sessions.items() for s in lst
+    }

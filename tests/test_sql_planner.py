@@ -239,3 +239,24 @@ def test_pushdown_rename_spares_string_literals():
     t_opt = tenv.sql_query(q)
     t_raw = tenv.sql_query(q, optimize=False)
     assert t_opt.to_rows() == t_raw.to_rows() == [(1, "r_credit")]
+
+
+def test_top_level_or_not_severed():
+    """Regression: `A OR B AND C` is A OR (B AND C) — a top-level
+    un-parenthesized OR means the WHERE is not a conjunction, so the
+    planner must keep it whole (severing 'C' changed results)."""
+    tenv = TableEnvironment.create()
+    tenv.register_table("t", tenv.from_columns({
+        "a": [1, 0, 0], "b": [0, 2, 0], "c": [0, 3, 0],
+        "v": [1.0, 2.0, 3.0]}))
+    q = "SELECT v FROM t WHERE a = 1 OR b = 2 AND c = 3"
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert t_opt.to_rows() == t_raw.to_rows() == [(1.0,), (2.0,)]
+    # parenthesized OR operands still split into two conjuncts
+    from flink_tpu.table import planner as pl
+
+    assert pl.split_conjuncts("(a = 1 OR b = 2) AND c = 3") == [
+        "(a = 1 OR b = 2)", "c = 3"]
+    assert pl.split_conjuncts("a = 1 OR b = 2 AND c = 3") == [
+        "a = 1 OR b = 2 AND c = 3"]
