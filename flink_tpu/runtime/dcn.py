@@ -89,6 +89,15 @@ class DCNJobSpec:
     # lockstep processes agree without coordination; set it to e.g. the
     # job's start-of-day epoch ms for wall-clock sources.
     origin_ms: int = 0
+    # physical rebalance (ref RebalancePartitioner.java:30): underfull
+    # hosts borrow ingest lanes from their ring neighbor's backlog over a
+    # host-to-host TCP side channel, so a skewed partition assignment
+    # keeps every host's lane budget busy (see _RebalanceRing). Device-
+    # side lane spreading cannot do this — per-host lane counts are fixed
+    # by the sharding, so extra ingest capacity must arrive as records
+    # over the network, exactly like the reference's rebalance edge.
+    rebalance: bool = False
+    rebalance_addrs: Optional[list] = None   # "host:port" per process-id
 
 
 class GeneratorPartitionSource:
@@ -116,6 +125,124 @@ class GeneratorPartitionSource:
 
     def restore(self, state):
         self.offset = int(state["offset"])
+
+
+class _RebalanceRing:
+    """Host-level physical rebalance (ref RebalancePartitioner.java:30,
+    RecordWriter round-robin edges): each cycle, process p asks its ring
+    neighbor (p+1) % nproc to fill p's spare ingest lanes from the
+    neighbor's source backlog; records cross hosts as length-prefixed
+    numpy frames over TCP — the reference's records-over-the-network
+    rebalance, applied at the ingestion edge where this architecture's
+    skew cost actually lives (a skewed host needs proportionally more
+    lockstep cycles; the keyed all_to_all already balances compute).
+
+    Protocol per cycle:
+      1. send REQUEST(my spare lanes) on the next-link,
+      2. serve the prev-link: read its spare, poll up to that many extra
+         records from MY source, send them (+ my exhausted flag),
+      3. read the donation from the next-link into my spare lanes.
+    Lockstep safety: every process runs all three phases every cycle.
+    Deadlock safety: phase-2 sends happen before anyone's phase-3 read,
+    so a donation frame must never need the peer to drain it — frames
+    are capped at DONATE_CAP records (≤64 KiB) and both socket buffers
+    are raised to hold a full frame, so sendall always completes into
+    kernel buffers even when every ring link donates at once (sources
+    that trickle below max_records can leave every host with both spare
+    lanes AND backlog).
+    """
+
+    _REQ = "<I"      # spare lane count
+    _HDR = "<IB"     # donated record count, donor-exhausted flag
+    DONATE_CAP = 3200             # 3200 * 20 B = 62.5 KiB per frame
+    _SOCKBUF = 1 << 18            # 256 KiB send/recv buffers
+
+    def __init__(self, pid: int, nproc: int, addrs):
+        import socket
+        import struct
+
+        self.struct = struct
+        self.pid = pid
+        self.nproc = nproc
+        if not addrs or len(addrs) != nproc:
+            raise ValueError(
+                "rebalance requires rebalance_addrs with one host:port "
+                "per process"
+            )
+        host, port = addrs[pid].rsplit(":", 1)
+        srv = socket.create_server((host, int(port)))
+        srv.settimeout(120)
+        # connect to next; accept from prev (with nproc == 2 both links
+        # connect the same pair, one in each role)
+        nhost, nport = addrs[(pid + 1) % nproc].rsplit(":", 1)
+        deadline = time.time() + 120
+        self.next_sock = None
+        while self.next_sock is None:
+            try:
+                self.next_sock = socket.create_connection(
+                    (nhost, int(nport)), timeout=5
+                )
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.1)
+        self.prev_sock, _ = srv.accept()
+        srv.close()
+        for s in (self.next_sock, self.prev_sock):
+            s.settimeout(120)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                         self._SOCKBUF)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
+                         self._SOCKBUF)
+
+    def _recv_exact(self, sock, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("rebalance peer closed")
+            buf += chunk
+        return buf
+
+    def exchange(self, spare: int, poll_extra):
+        """One rebalance round. ``poll_extra(n)`` polls up to n records
+        from this host's source, returning (keys, ts_ms, vals,
+        exhausted). Returns (keys, ts_ms, vals, donor_done) received into
+        this host's spare lanes."""
+        st = self.struct
+        self.next_sock.sendall(st.pack(self._REQ, int(spare)))
+        # serve the prev neighbor
+        (want,) = st.unpack(
+            self._REQ, self._recv_exact(self.prev_sock,
+                                        st.calcsize(self._REQ))
+        )
+        want = min(int(want), self.DONATE_CAP)
+        keys, ts, vals, done = poll_extra(want) if want else (
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.float32), False,
+        )
+        n = len(keys)
+        self.prev_sock.sendall(
+            st.pack(self._HDR, n, 1 if done else 0)
+            + np.asarray(keys, np.int64).tobytes()
+            + np.asarray(ts, np.int64).tobytes()
+            + np.asarray(vals, np.float32).tobytes()
+        )
+        # collect my donation
+        hdr = self._recv_exact(self.next_sock, st.calcsize(self._HDR))
+        m, ddone = st.unpack(self._HDR, hdr)
+        payload = self._recv_exact(self.next_sock, m * (8 + 8 + 4))
+        rk = np.frombuffer(payload[: 8 * m], np.int64)
+        rt = np.frombuffer(payload[8 * m: 16 * m], np.int64)
+        rv = np.frombuffer(payload[16 * m:], np.float32)
+        return rk, rt, rv, bool(ddone)
+
+    def close(self):
+        for s in (self.next_sock, self.prev_sock):
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class _DCNRunnerBase:
@@ -160,6 +287,11 @@ class _DCNRunnerBase:
         self.ctx = MeshContext.create(self.n, spec.max_parallelism)
         # per-host lane budget, one equal slice per local device
         self.B_local = max(self.L, (spec.batch_per_host // self.L) * self.L)
+        self._ring = (
+            _RebalanceRing(process_id, num_processes,
+                           spec.rebalance_addrs)
+            if spec.rebalance and num_processes > 1 else None
+        )
         self._build_step()
         self._init_state()
 
@@ -200,6 +332,20 @@ class _DCNRunnerBase:
                 keys = np.zeros(0, np.int64)
                 ts_ms = np.zeros(0, np.int64)
                 vals = np.zeros(0, np.float32)
+            done_now = exhausted
+            if self._ring is not None:
+                # physical rebalance: offer spare lanes to the ring
+                # neighbor's backlog, serve the other neighbor's request
+                # from MY backlog (every process, every cycle — lockstep)
+                rk, rt, rv, donor_done = self._ring.exchange(
+                    B - len(keys), self.source.poll
+                )
+                if len(rk):
+                    keys = np.concatenate([keys, rk])
+                    ts_ms = np.concatenate([ts_ms, rt])
+                    vals = np.concatenate([vals, rv])
+                # keep cycling while the donor neighbor still has records
+                done_now = exhausted and donor_done and not len(rk)
             m = len(keys)
             h = key_identity64(keys) if m else np.zeros(0, np.uint64)
             hi = np.zeros(B, np.uint32)
@@ -230,9 +376,9 @@ class _DCNRunnerBase:
                     self.local_wm_ticks,
                     int(rts.max()) - spec.out_of_orderness_ms - 1,
                 ), MAX_TICKS)
-            wm_now = MAX_TICKS if exhausted else self.local_wm_ticks
+            wm_now = MAX_TICKS if done_now else self.local_wm_ticks
             wm = np.full(self.L, np.int32(wm_now))
-            done = np.full(self.L, np.int32(1 if exhausted else 0))
+            done = np.full(self.L, np.int32(1 if done_now else 0))
 
             self.state, aux, stop = self._step(
                 self.state, self._global(hi), self._global(lo),
@@ -250,6 +396,8 @@ class _DCNRunnerBase:
                 self._write_checkpoint()
             if int(np.asarray(stop)) == 1:
                 break
+        if self._ring is not None:
+            self._ring.close()
         return {
             "key_id": (np.concatenate(self.rows_key)
                        if self.rows_key else np.zeros(0, np.uint64)),

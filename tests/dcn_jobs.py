@@ -146,3 +146,61 @@ def expected_sessions(nproc):
         (k, s[0], s[1] + GAP_MS): s[2]
         for k, lst in sessions.items() for s in lst
     }
+
+
+# -- round 5: physical rebalance (90/10 skewed hosts) ---------------------
+
+SKEW_TOTAL = 60_000      # records across BOTH hosts
+SKEW_FRAC = 0.9          # host 0 ingests 90%
+
+
+def _skewed_source(pid, nproc):
+    """Host 0 holds 90% of the stream, host 1 the rest (the skewed
+    partition assignment RebalancePartitioner exists for). Keys/ts are a
+    GLOBAL schedule indexed by each host's slice so expectations don't
+    depend on which host processes a record."""
+    assert nproc == 2
+    n0 = int(SKEW_TOTAL * SKEW_FRAC)
+    base = 0 if pid == 0 else n0
+    total = n0 if pid == 0 else SKEW_TOTAL - n0
+
+    def gen(offset, n):
+        idx = np.arange(base + offset, base + offset + n, dtype=np.int64)
+        keys = idx % N_KEYS
+        ts = idx // TS_DIV     # monotonic in idx: per-host watermarks valid
+        return keys, ts, np.ones(n, np.float32)
+
+    return GeneratorPartitionSource(gen, total)
+
+
+def skewed_window(rebalance_addrs=None):
+    return DCNJobSpec(
+        source_factory=_skewed_source,
+        size_ms=WIN_MS,
+        capacity_per_shard=2048,
+        max_parallelism=64,
+        batch_per_host=2048,
+        fires_per_step=4,
+        rebalance=rebalance_addrs is not None,
+        rebalance_addrs=rebalance_addrs,
+    )
+
+
+def skewed_window_plain():
+    return skewed_window(None)
+
+
+def skewed_window_rebalanced():
+    import os
+
+    addrs = os.environ["FLINK_TPU_TEST_REBALANCE_ADDRS"].split(",")
+    return skewed_window(addrs)
+
+
+def expected_skewed():
+    exp = {}
+    for i in range(SKEW_TOTAL):
+        k = i % N_KEYS
+        w = ((i // TS_DIV) // WIN_MS + 1) * WIN_MS
+        exp[(k, w)] = exp.get((k, w), 0) + 1.0
+    return exp
