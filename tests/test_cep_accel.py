@@ -506,3 +506,96 @@ def test_shard_count_restore_mismatch_rejected():
     op8 = DeviceCepOperator(pat, capacity=64, n_shards=8)
     with pytest.raises(ValueError, match="shard-count"):
         op8.restore(op1.snapshot())
+
+
+# -------------------------------------------------- event-time device mode
+
+def _run_et_job(events, pattern, device: bool, batch=16,
+                tmpdir=None, fail_trip=None):
+    """Event-time CEP pipeline; device flag toggles cep.device.enabled.
+    Returns (sorted results, cep_engine, restarts)."""
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+
+    cfg = {"cep.device.enabled": device}
+    if tmpdir:
+        cfg.update({"restart-strategy": "fixed-delay",
+                    "restart-strategy.fixed-delay.attempts": 3,
+                    "restart-strategy.fixed-delay.delay": 0})
+    env = StreamExecutionEnvironment(Configuration(cfg))
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = batch
+    if tmpdir:
+        env.enable_checkpointing(2, str(tmpdir))
+
+    class Sink(CollectSink):
+        def snapshot_state(self):
+            return list(self.results)
+
+        def restore_state(self, state):
+            self.results[:] = state
+
+        def invoke_batch(self, elements):
+            if (fail_trip is not None and not fail_trip["tripped"]
+                    and len(self.results) >= fail_trip["at"]):
+                fail_trip["tripped"] = True
+                raise RuntimeError("induced failure")
+            super().invoke_batch(elements)
+
+    sink = Sink()
+    stream = (
+        env.from_collection(events)
+        .assign_timestamps_and_watermarks(lambda e: e.ts)
+        .key_by(lambda e: e.value)
+    )
+    CEP.pattern(stream, pattern).select(
+        lambda m: (m["a"].value, m["a"].ts, m["b"].ts)
+    ).add_sink(sink)
+    job = env.execute("cep-et")
+    return (sorted(sink.results), job.metrics.cep_engine,
+            job.metrics.restarts)
+
+
+def _shuffled_et_events(seed, n=300, n_keys=5, ooo=0):
+    """Timestamped a/b/x events, arrival order locally shuffled within
+    +-ooo of timestamp order (bounded out-of-orderness)."""
+    rng = np.random.default_rng(seed)
+    names = rng.choice(["a", "b", "x"], size=n, p=[0.35, 0.3, 0.35])
+    keys = rng.integers(0, n_keys, n)
+    events = [Event(i, str(names[i]), int(keys[i])) for i in range(n)]
+    if ooo:
+        arrival = np.argsort(np.arange(n) + rng.uniform(0, ooo, n))
+        events = [events[i] for i in arrival]
+    return events
+
+
+@pytest.mark.parametrize("strict", [True, False])
+def test_event_time_device_equals_host(strict):
+    """Out-of-order event-time CEP: the device path (reorder buffer +
+    count NFA) emits exactly the host NFA's matches, and actually runs
+    on the device engine."""
+    p = Pattern.begin("a").where(lambda e: e.name == "a")
+    p = (p.next("b") if strict else p.followed_by("b")).where(
+        lambda e: e.name == "b")
+    for seed in range(3):
+        events = _shuffled_et_events(seed, ooo=6)
+        got_d, eng_d, _ = _run_et_job(events, p, device=True)
+        got_h, eng_h, _ = _run_et_job(events, p, device=False)
+        assert eng_d == "device" and eng_h == "host"
+        assert got_d == got_h, (seed, len(got_d), len(got_h))
+
+
+def test_event_time_device_checkpoint_restart(tmp_path):
+    """Mid-stream failure with a half-full reorder buffer: restore
+    brings back the et heap + device state and results stay exact."""
+    p = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+    events = _shuffled_et_events(7, n=400, ooo=8)
+    trip = {"tripped": False, "at": 8}
+    got, engine, restarts = _run_et_job(
+        events, p, device=True, tmpdir=tmp_path / "chk", fail_trip=trip)
+    assert engine == "device" and restarts >= 1
+    ref, _, _ = _run_et_job(events, p, device=False)
+    assert got == ref
