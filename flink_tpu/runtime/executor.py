@@ -2739,8 +2739,10 @@ class LocalExecutor:
         def batch_loop():
             nonlocal et_seq
             end = False
+            n_batches = 0
             while not end:
                 self._poll_control()
+                n_batches += 1
                 polled, end = pipe.source.poll(env.batch_size)
                 elements = _apply_chain(pipe.pre_chain,
                                         self._to_elements(polled))
@@ -2778,11 +2780,14 @@ class LocalExecutor:
                     matches = op.process_batch(elements, keys, now_ms,
                                                pad_to=pad)
                     metrics.steps += 1
-                if metrics.steps % 64 == 0:
-                    # bound host buffers to live-partial size; any matches
-                    # surfacing here indicate a count/extraction skew —
-                    # emit rather than swallow (but never clobber the
-                    # batch's own matches, still pending below)
+                if n_batches % 64 == 0:
+                    # bound host buffers to live-partial size (a BATCH
+                    # counter: event-time releases can take several device
+                    # steps per batch, so metrics.steps may stride over
+                    # any fixed modulus); any matches surfacing here
+                    # indicate a count/extraction skew — emit rather than
+                    # swallow (but never clobber the batch's own matches,
+                    # still pending below)
                     pruned = op.prune_dead_keys()
                     if pruned:
                         out = ([r for m in pruned for r in select_fn(m)]

@@ -21,10 +21,21 @@ DAG topologies (round 4, ref flink-storm-examples' multi-input shapes):
 multiple spouts, a bolt consuming SEVERAL upstreams (their streams union
 before the bolt, the FlinkTopology.createTopology merge), and fan-out
 (one component feeding several bolts; every leaf collects its own
-output). At most one fields-grouped bolt per topology, with a linear
-chain below it (one keyed stage per job — the SPMD executor's shape);
-richer keyed DAGs belong on the native DataStream API. No acking: Flink
-checkpoints replace Storm's tuple tracking, as in the reference wrapper.
+output).
+
+Multiple fields-grouped bolts (round 5): a topology whose keyed shape
+fits one SPMD job (at most one fields-grouped bolt, single-input bolts
+below it — fan-out below the keyed bolt included, via sink branches)
+lowers to a single streaming job as before; richer shapes — several
+`fieldsGrouping` hops, multi-input bolts below a keyed one — run as a
+CHAIN of pipeline stages: stateless
+bolts fold on the host between stages and every keyed bolt runs its own
+keyed process stage over the mesh, its input materialized from the
+previous stage's output. Storm topologies here are finite (next_tuple
+returns False at exhaustion), so staged execution is exact: stage k runs
+to completion before stage k+1 consumes it, and per-key order is
+preserved through each collection. No acking: Flink checkpoints replace
+Storm's tuple tracking, as in the reference wrapper.
 """
 
 from __future__ import annotations
@@ -124,6 +135,28 @@ class TopologyBuilder:
         return decl
 
 
+def _keyed_bolt_fn(bolt: BasicBolt):
+    """Wrap a bolt as a keyed ProcessFunction (lowered per-key stage)."""
+    from flink_tpu.datastream.functions import ProcessFunction
+
+    class _KeyedBolt(ProcessFunction):
+        def __init__(self, b):
+            self._b = b
+            self._coll = BoltCollector()
+            self._prepared = False
+
+        def process_element(self, value, ctx, out):
+            if not self._prepared:
+                self._b.prepare(self._coll)
+                self._prepared = True
+            self._coll.buf = []
+            self._b.execute(tuple(value))
+            for t in self._coll.buf:
+                out.collect(t)
+
+    return _KeyedBolt(bolt)
+
+
 def _bolt_flat_map(bolt: BasicBolt):
     state = {"prepared": False}
     coll = BoltCollector()
@@ -189,35 +222,41 @@ class FlinkTopology:
                     f"grouped bolt must use fields grouping on the same "
                     f"field position"
                 )
+        return order
+
+    def _single_job_ok(self, order: List[_BoltDecl]) -> bool:
+        """One streaming job covers: at most one fields-grouped bolt,
+        linear stateless chain below it (the SPMD executor's shape).
+        Everything else goes through the staged path."""
+        keyed = [d for d in order if any(k == "fields" for _u, k, _f
+                                         in d.inputs)]
         if len(keyed) > 1:
-            raise ValueError(
-                "at most one fields-grouped bolt per topology (one keyed "
-                "stage per job); use the DataStream API for richer shapes"
-            )
+            return False
         if keyed:
-            # everything downstream of the keyed bolt must be linear
-            kname = keyed[0].name
-            below = {kname}
+            below = {keyed[0].name}
             for d in order:
                 ups = {u for u, _k, _f in d.inputs}
                 if ups & below:
                     if len(d.inputs) > 1:
-                        raise ValueError(
-                            "the chain below a fields-grouped bolt must "
-                            "be linear (single-input bolts)"
-                        )
+                        return False
                     below.add(d.name)
-        return order
+        return True
 
     def execute(self, env, job_name: str = "storm-topology"):
         """Run to completion. Returns the collected tuples of the single
         leaf component, or {leaf_name: tuples} when the DAG fans out to
-        several leaves."""
-        from flink_tpu.datastream.functions import ProcessFunction
+        several leaves. Topologies whose keyed shape exceeds one SPMD job
+        (several fields-grouped bolts, fan-out below one) run as a chain
+        of pipeline stages — see module docstring."""
+        order = self._topo_order()   # validate before touching the env
+        if not self._single_job_ok(order):
+            return self._execute_staged(env, order, job_name)
+        return self._execute_single(env, order, job_name)
+
+    def _execute_single(self, env, order, job_name):
         from flink_tpu.runtime.sinks import CollectSink
         from flink_tpu.runtime.sources import Source
 
-        order = self._topo_order()   # validate before touching the env
         builder = self.builder
 
         class _SpoutSource(Source):
@@ -266,27 +305,10 @@ class FlinkTopology:
                 continue
             # consistency already validated by _topo_order
             fields = {f for _u, k, f in decl.inputs if k == "fields"}
-            bolt = decl.bolt
-
-            class _KeyedBolt(ProcessFunction):
-                def __init__(self, b):
-                    self._b = b
-                    self._coll = BoltCollector()
-                    self._prepared = False
-
-                def process_element(self, value, ctx, out):
-                    if not self._prepared:
-                        self._b.prepare(self._coll)
-                        self._prepared = True
-                    self._coll.buf = []
-                    self._b.execute(tuple(value))
-                    for t in self._coll.buf:
-                        out.collect(t)
-
             f = fields.pop()
             streams[decl.name] = stream.key_by(
                 lambda t, _f=f: t[_f]
-            ).process(_KeyedBolt(bolt))
+            ).process(_keyed_bolt_fn(decl.bolt))
 
         consumed = {u for d in order for u, _k, _f in d.inputs}
         leaves = [n for n in streams if n not in consumed]
@@ -302,3 +324,80 @@ class FlinkTopology:
         if len(leaves) == 1:
             return sinks[leaves[0]].results
         return {n: s.results for n, s in sinks.items()}
+
+    # -- staged execution (round 5: several fields-grouped hops) ---------
+    @staticmethod
+    def _fresh_env(env):
+        """A stage env sharing the job's configuration knobs (each keyed
+        stage is its own pipeline execution)."""
+        cls = type(env)
+        stage = cls(getattr(env, "config", None))
+        for attr in ("parallelism", "max_parallelism", "batch_size",
+                     "state_capacity_per_shard"):
+            if hasattr(env, attr):
+                setattr(stage, attr, getattr(env, attr))
+        # stages must NOT share the job's checkpoint directory: each is a
+        # finite batch whose failure story is re-running the stage from
+        # its materialized input, and a shared dir would let stage k+1
+        # restore stage k's operator state
+        stage.checkpoint_interval_steps = 0
+        stage.checkpoint_dir = None
+        return stage
+
+    def _execute_staged(self, env, order, job_name):
+        """Chain of pipeline stages: spouts drain on the host, stateless
+        bolts fold between stages, every fields-grouped bolt runs its own
+        keyed process stage over the mesh on the materialized output of
+        the previous stage. Exact for finite topologies (the only kind
+        this compat layer runs): stage k completes before stage k+1
+        consumes it, preserving per-key order through each collection."""
+        from flink_tpu.runtime.sinks import CollectSink
+
+        builder = self.builder
+        outputs: Dict[str, List[tuple]] = {}
+        for name, spout in builder.spouts.items():
+            coll = SpoutCollector()
+            spout.open(coll)
+            tuples: List[tuple] = []
+            alive = True
+            while alive:
+                coll.buf = []
+                alive = spout.next_tuple()
+                tuples.extend(coll.buf)
+            outputs[name] = tuples
+
+        seg = 0
+        for decl in order:
+            ins: List[tuple] = []
+            for u, _k, _f in decl.inputs:
+                ins.extend(outputs[u])
+            kinds = {k for _u, k, _f in decl.inputs}
+            if kinds <= {"shuffle", "global"}:
+                fm = _bolt_flat_map(decl.bolt)
+                out: List[tuple] = []
+                for t in ins:
+                    out.extend(fm(t))
+                outputs[decl.name] = out
+                continue
+            f = next(f for _u, k, f in decl.inputs if k == "fields")
+            seg += 1
+            stage_env = self._fresh_env(env)
+            sink = CollectSink()
+            (
+                stage_env.from_collection(ins)
+                .key_by(lambda t, _f=f: t[_f])
+                .process(_keyed_bolt_fn(decl.bolt))
+                .add_sink(sink)
+            )
+            stage_env.execute(f"{job_name}-stage{seg}-{decl.name}")
+            outputs[decl.name] = list(sink.results)
+
+        for spout in builder.spouts.values():
+            spout.close()
+        for d in order:
+            d.bolt.close()
+        consumed = {u for d in order for u, _k, _f in d.inputs}
+        leaves = [n for n in outputs if n not in consumed]
+        if len(leaves) == 1:
+            return outputs[leaves[0]]
+        return {n: outputs[n] for n in leaves}

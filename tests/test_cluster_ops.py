@@ -350,3 +350,83 @@ def test_web_round4_handler_breadth():
         cluster.cancel(jid)
         cluster.wait(jid, 30)
         web.stop()
+
+
+def test_web_subtask_and_checkpoint_detail_routes(tmp_path):
+    """Round-5 REST breadth: per-vertex subtask endpoints + checkpoint
+    config/details (ref JobVertexDetailsHandler, SubtasksTimesHandler,
+    SubtaskCurrentAttemptDetailsHandler, CheckpointConfigHandler,
+    CheckpointStatsDetailsHandler)."""
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    env.enable_checkpointing(interval_steps=2, directory=str(tmp_path))
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "subtask-routes-job")
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        def get_code(path):
+            import urllib.error
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10
+                ) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        time.sleep(1.2)
+        vx = get(f"/jobs/{jid}/vertices")["vertices"]
+        assert vx
+        vid = vx[0]["id"]
+        # vertex detail: one row per subtask
+        vd = get(f"/jobs/{jid}/vertices/{vid}")
+        assert vd["name"] and len(vd["subtasks"]) == vd["parallelism"]
+        row = vd["subtasks"][0]
+        assert {"subtask", "status", "attempt", "host",
+                "start-time"} <= set(row)
+        assert get(f"/jobs/{jid}/vertices/{vid}/subtasks") == vd
+        # subtask times: per-state timestamps
+        st = get(f"/jobs/{jid}/vertices/{vid}/subtasktimes")
+        assert st["subtasks"][0]["timestamps"].get("CREATED", 0) > 0
+        # one subtask's current attempt + addressable attempt history
+        s0 = get(f"/jobs/{jid}/vertices/{vid}/subtasks/0")
+        assert s0["attempt"] >= 1 and "state-times" in s0
+        assert s0["prior-attempts"] == []
+        a1 = get(f"/jobs/{jid}/vertices/{vid}/subtasks/0/attempts/1")
+        assert a1["attempt"] == 1
+        assert get_code(
+            f"/jobs/{jid}/vertices/{vid}/subtasks/0/attempts/99") == 404
+        assert get_code(f"/jobs/{jid}/vertices/{vid}/subtasks/99") == 404
+        assert get_code(f"/jobs/{jid}/vertices/9999") == 404
+        # checkpoint config
+        cc = get(f"/jobs/{jid}/checkpoints/config")
+        assert cc["mode"] == "exactly_once"
+        assert cc["interval-steps"] == 2
+        assert cc["directory"] == str(tmp_path)
+        # checkpoint details for a real completed checkpoint
+        deadline = time.time() + 60
+        hist = []
+        while time.time() < deadline:
+            hist = get(f"/jobs/{jid}/checkpoints").get("history", [])
+            if hist:
+                break
+            time.sleep(0.3)
+        assert hist, "no checkpoint completed in time"
+        cid = hist[-1]["id"]
+        cd = get(f"/jobs/{jid}/checkpoints/details/{cid}")
+        assert cd["id"] == cid and cd["status"] == "COMPLETED"
+        assert cd["duration-ms"] >= 0 and "fused-stage" in cd
+        assert cd["tasks"]       # per-operator rows
+        assert get_code(f"/jobs/{jid}/checkpoints/details/999999") == 404
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+        web.stop()

@@ -160,13 +160,102 @@ def test_multi_input_keyed_bolt():
     assert got == {"dog": 3, "cat": 1}
 
 
-def test_two_keyed_bolts_rejected():
+class _PosCountBolt(BasicBolt):
+    """Counts occurrences of tuple position `pos`, emits (value, n)."""
+
+    def __init__(self, pos):
+        self.pos = pos
+        self.counts = {}
+
+    def execute(self, tup):
+        v = tup[self.pos]
+        self.counts[v] = self.counts.get(v, 0) + 1
+        self.collector.emit((v, self.counts[v]))
+
+
+def test_two_keyed_hops_word_count_then_count_histogram():
+    """Round 5: TWO fieldsGrouping hops run as chained pipeline stages
+    (the one-keyed-stage-per-topology limit is lifted). Stage 1 counts
+    words; stage 2 keys the running counts BY COUNT VALUE and tallies
+    how many emissions carried each count."""
+    b = TopologyBuilder()
+    b.set_spout("lines", LineSpout())
+    b.set_bolt("split", SplitBolt()).shuffle_grouping("lines")
+    b.set_bolt("count", CountBolt()).fields_grouping("split", 0)
+    # second keyed hop: histogram of running-count values
+    b.set_bolt("hist", _PosCountBolt(1)).fields_grouping("count", 1)
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    results = FlinkTopology(b).execute(env)
+
+    # scalar model of the same two hops
+    counts = {}
+    emissions = []
+    for line in LINES:
+        for w in line.split():
+            counts[w] = counts.get(w, 0) + 1
+            emissions.append((w, counts[w]))
+    hist = {}
+    expect = []
+    for _w, c in emissions:
+        hist[c] = hist.get(c, 0) + 1
+        expect.append((c, hist[c]))
+    assert sorted(results) == sorted(expect)
+
+
+def test_multi_input_bolt_below_keyed_runs_staged():
+    """A MULTI-INPUT bolt below a fields-grouped one is not expressible
+    as one SPMD job (the staged path must carry it): the merge bolt
+    unions the keyed output with a side stream."""
+    b = TopologyBuilder()
+    b.set_spout("s", _ListSpout([("a", 1), ("b", 1), ("a", 1)]))
+    b.set_spout("side", _ListSpout([("side", 0)]))
+    b.set_bolt("k", _CountBolt()).fields_grouping("s", 0)
+    b.set_bolt("merge", _TagBolt("m")).shuffle_grouping("k") \
+        .shuffle_grouping("side")
+
+    topo = FlinkTopology(b)
+    assert not topo._single_job_ok(topo._topo_order())
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    out = topo.execute(env)
+    expect = [("m", "a", 1), ("m", "b", 1), ("m", "a", 2),
+              ("m", "side", 0)]
+    assert sorted(out) == sorted(expect)
+
+
+def test_fan_out_below_keyed_stays_single_job():
+    """Fan-out below a keyed bolt IS one SPMD job (trailing stateless
+    sink branches): both leaves see every keyed emission."""
+    b = TopologyBuilder()
+    b.set_spout("s", _ListSpout([("a", 1), ("b", 1), ("a", 1)]))
+    b.set_bolt("k", _CountBolt()).fields_grouping("s", 0)
+    b.set_bolt("t1", _TagBolt("x")).shuffle_grouping("k")
+    b.set_bolt("t2", _TagBolt("y")).shuffle_grouping("k")
+
+    topo = FlinkTopology(b)
+    assert topo._single_job_ok(topo._topo_order())
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.batch_size = 4
+    env.set_parallelism(1)
+    out = topo.execute(env)
+    assert set(out) == {"t1", "t2"}
+    keyed = [("a", 1), ("b", 1), ("a", 2)]
+    assert sorted(out["t1"]) == sorted(("x", k, c) for k, c in keyed)
+    assert sorted(out["t2"]) == sorted(("y", k, c) for k, c in keyed)
+
+
+def test_two_keyed_hops_route_staged():
+    """The two-hop topology must actually take the staged path."""
     b = TopologyBuilder()
     b.set_spout("s", _ListSpout([("a",)]))
     b.set_bolt("k1", _CountBolt()).fields_grouping("s", 0)
-    b.set_bolt("k2", _CountBolt()).fields_grouping("k1", 0)
-    with pytest.raises(ValueError, match="one fields-grouped"):
-        FlinkTopology(b)._topo_order()
+    b.set_bolt("k2", _PosCountBolt(1)).fields_grouping("k1", 1)
+    topo = FlinkTopology(b)
+    assert not topo._single_job_ok(topo._topo_order())
 
 
 def test_cycle_rejected():
