@@ -1,0 +1,375 @@
+"""Elasticsearch connector — the flink-connector-elasticsearch2 analog
+(SURVEY §2.8, ref flink-streaming-connectors/flink-connector-
+elasticsearch2/ ElasticsearchSink.java + BulkProcessorIndexer; the
+reference wraps the ES TransportClient's BulkProcessor).
+
+This is a WIRE client: it speaks the public Elasticsearch REST protocol
+over plain HTTP — `POST /_bulk` with NDJSON action/source line pairs,
+per-item result statuses in the bulk response, `GET /` version ping —
+implemented from the public API docs, not from any client library.
+
+No Elasticsearch server exists in this image (zero egress), so tests run
+the sink against ``MiniElasticsearch`` below — an in-repo HTTP server
+implementing the same public spec (bulk indexing, doc get, search with
+match_all/term, injectable 429 throttling). That proves the byte-level
+seam; against a genuine cluster only the host:port changes.
+
+Semantics (the reference's):
+  * buffered bulk flushing — ``bulk.flush.max.actions`` and explicit
+    flush, the BulkProcessor knobs;
+  * FLUSH-ON-CHECKPOINT: ``snapshot_state`` drains the buffer, so a
+    checkpoint never covers unsent actions (ElasticsearchSinkBase's
+    flushOnCheckpoint=true is the at-least-once story);
+  * retry on 429/503 with bounded backoff (BulkProcessor's backoff
+    policy); other per-item failures go to the failure handler seam
+    (ref ActionRequestFailureHandler) which defaults to raising;
+  * exactly-once via DETERMINISTIC DOCUMENT IDS: replayed actions
+    overwrite the same `_id` instead of duplicating — the reference's
+    documented recipe for idempotent writes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from flink_tpu.runtime.sinks import Sink
+
+
+class ElasticsearchSink(Sink):
+    """ref ElasticsearchSink: elements -> index actions -> buffered
+    `_bulk` requests.
+
+    ``emitter(element) -> action dict or list of action dicts``; an
+    action is ``{"index": <index>, "id": <id or None>, "source": doc}``
+    (the IndexRequest shape). Deterministic ids give idempotent replay.
+    """
+
+    def __init__(self, host: str, port: int,
+                 emitter: Callable[[Any], Any],
+                 flush_max_actions: int = 500,
+                 max_retries: int = 5,
+                 failure_handler: Optional[Callable] = None,
+                 timeout_s: float = 10.0):
+        self.host = host
+        self.port = port
+        self.emitter = emitter
+        self.flush_max_actions = flush_max_actions
+        self.max_retries = max_retries
+        self.failure_handler = failure_handler
+        self.timeout_s = timeout_s
+        self._buf: List[dict] = []
+        self.stats = {"bulk_requests": 0, "actions": 0, "retries": 0}
+
+    # -- Sink contract ---------------------------------------------------
+    def open(self):
+        info = self._request("GET", "/")
+        if "version" not in info:
+            raise ConnectionError(
+                f"not an Elasticsearch endpoint: {info!r}"
+            )
+
+    def invoke_batch(self, elements: List[Any]):
+        for e in elements:
+            actions = self.emitter(e)
+            if isinstance(actions, dict):
+                actions = [actions]
+            self._buf.extend(actions)
+            # threshold INSIDE the loop (BulkProcessor behavior): one
+            # oversized element batch must not become one oversized bulk
+            # body (real ES rejects those with 413)
+            if len(self._buf) >= self.flush_max_actions:
+                self.flush()
+
+    def close(self):
+        self.flush()
+
+    def snapshot_state(self):
+        # flush-on-checkpoint: the cut must not cover unsent actions
+        self.flush()
+        return None
+
+    # -- bulk protocol ---------------------------------------------------
+    def flush(self):
+        if not self._buf:
+            return
+        actions, self._buf = self._buf, []
+        try:
+            self._send_with_retries(actions)
+        except Exception:
+            # transport failure / retry exhaustion: put the actions back
+            # so a caller-level retry (or the checkpoint-restart replay)
+            # still covers them — at-least-once, never silent loss
+            self._buf = actions + self._buf
+            raise
+
+    def _send_with_retries(self, actions: List[dict]):
+        delay = 0.05
+        for attempt in range(self.max_retries + 1):
+            status, resp = self._request_raw(
+                "POST", "/_bulk", self._bulk_body(actions),
+                "application/x-ndjson",
+            )
+            if status in (429, 503):
+                # the whole bulk was throttled: back off and resend
+                # (BulkProcessor's backoff policy)
+                self.stats["retries"] += 1
+                if attempt == self.max_retries:
+                    raise ConnectionError(
+                        f"bulk rejected with {status} after "
+                        f"{self.max_retries} retries"
+                    )
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+                continue
+            if status != 200:
+                raise ConnectionError(f"bulk failed: HTTP {status}")
+            resp = json.loads(resp)
+            self.stats["bulk_requests"] += 1
+            self.stats["actions"] += len(actions)
+            if not resp.get("errors"):
+                return
+            # per-item results: 429s are TRANSIENT (a loaded cluster
+            # throttles individual items inside an HTTP 200 bulk
+            # response) — resend just those with backoff; other
+            # failures go to the handler seam
+            retry = []
+            for item, action in zip(resp["items"], actions):
+                st = item.get("index", {}).get("status", 200)
+                if st == 429:
+                    retry.append(action)
+                elif st >= 300:
+                    if self.failure_handler is not None:
+                        self.failure_handler(action, st, item)
+                    else:
+                        raise RuntimeError(
+                            f"index action failed with status {st}: "
+                            f"{item}"
+                        )
+            if not retry:
+                return
+            self.stats["retries"] += 1
+            if attempt == self.max_retries:
+                raise ConnectionError(
+                    f"{len(retry)} bulk item(s) still throttled (429) "
+                    f"after {self.max_retries} retries"
+                )
+            actions = retry
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+
+    @staticmethod
+    def _bulk_body(actions: List[dict]) -> bytes:
+        lines = []
+        for a in actions:
+            meta: Dict[str, Any] = {"_index": a["index"]}
+            if a.get("id") is not None:
+                meta["_id"] = str(a["id"])
+            lines.append(json.dumps({"index": meta}))
+            lines.append(json.dumps(a["source"]))
+        return ("\n".join(lines) + "\n").encode()
+
+    # -- HTTP plumbing ---------------------------------------------------
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 ctype: str = "application/json") -> dict:
+        status, data = self._request_raw(method, path, body, ctype)
+        if status >= 300:
+            raise ConnectionError(f"{method} {path} -> HTTP {status}")
+        return json.loads(data)
+
+    def _request_raw(self, method, path, body=b"", ctype=""):
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        try:
+            headers = {"Content-Type": ctype} if ctype else {}
+            conn.request(method, path, body, headers)
+            r = conn.getresponse()
+            return r.status, r.read()
+        finally:
+            conn.close()
+
+
+# ---------------------------------------------------------------- test peer
+class MiniElasticsearch:
+    """In-repo HTTP server implementing the public Elasticsearch REST
+    subset the sink speaks (the MiniKafkaBroker pattern: a spec
+    implementation on a real socket, so the connector's bytes are tested
+    end to end).
+
+    Supported: GET / (version ping), POST /_bulk (NDJSON index actions),
+    GET /<index>/_doc/<id>, GET|POST /<index>/_search with match_all or
+    one-field term query, GET /<index>/_count. ``throttle(n)`` makes the
+    next n bulk requests return 429 (retry-path testing);
+    ``fail_ids(ids)`` rejects those document ids with per-item 400s
+    (failure-handler testing)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.indices: Dict[str, Dict[str, dict]] = {}
+        self.bulk_requests = 0
+        self._throttle = 0
+        self._fail_ids: set = set()
+        self._item_throttle: Dict[str, int] = {}   # id -> remaining 429s
+        self._lock = threading.Lock()
+        mini = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, payload: dict):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("?")[0].split("/")
+                         if p]
+                if not parts:
+                    return self._send(200, {
+                        "name": "mini-es", "cluster_name": "flink-tpu",
+                        "version": {"number": "2.3.0"},
+                    })
+                with mini._lock:
+                    if len(parts) == 3 and parts[1] == "_doc":
+                        doc = mini.indices.get(parts[0], {}).get(parts[2])
+                        if doc is None:
+                            return self._send(404, {"found": False})
+                        return self._send(200, {
+                            "_index": parts[0], "_id": parts[2],
+                            "found": True, "_source": doc,
+                        })
+                    if len(parts) == 2 and parts[1] == "_count":
+                        return self._send(200, {
+                            "count": len(mini.indices.get(parts[0], {}))
+                        })
+                    if len(parts) == 2 and parts[1] == "_search":
+                        return self._search(parts[0], {})
+                return self._send(404, {"error": "unknown route"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                path = self.path.split("?")[0]
+                if path == "/_bulk":
+                    return self._bulk(body)
+                parts = [p for p in path.split("/") if p]
+                if len(parts) == 2 and parts[1] == "_search":
+                    query = json.loads(body) if body else {}
+                    with mini._lock:
+                        return self._search(parts[0], query)
+                return self._send(404, {"error": "unknown route"})
+
+            def _bulk(self, body: bytes):
+                with mini._lock:
+                    mini.bulk_requests += 1
+                    if mini._throttle > 0:
+                        mini._throttle -= 1
+                        return self._send(429, {
+                            "error": "es_rejected_execution_exception"
+                        })
+                    lines = [ln for ln in body.decode().splitlines()
+                             if ln.strip()]
+                    items, errors = [], False
+                    i = 0
+                    while i < len(lines):
+                        meta = json.loads(lines[i])
+                        action = next(iter(meta))
+                        m = meta[action]
+                        src = json.loads(lines[i + 1])
+                        i += 2
+                        idx = m["_index"]
+                        did = str(m.get("_id", len(
+                            mini.indices.get(idx, {})
+                        )))
+                        if mini._item_throttle.get(did, 0) > 0:
+                            # per-ITEM throttling: HTTP 200 bulk response
+                            # carrying item-level 429s (a loaded real
+                            # cluster's shape)
+                            mini._item_throttle[did] -= 1
+                            errors = True
+                            items.append({"index": {
+                                "_index": idx, "_id": did, "status": 429,
+                                "error":
+                                    "es_rejected_execution_exception",
+                            }})
+                            continue
+                        if did in mini._fail_ids:
+                            errors = True
+                            items.append({"index": {
+                                "_index": idx, "_id": did, "status": 400,
+                                "error": "mapper_parsing_exception",
+                            }})
+                            continue
+                        created = did not in mini.indices.setdefault(
+                            idx, {})
+                        mini.indices[idx][did] = src
+                        items.append({"index": {
+                            "_index": idx, "_id": did,
+                            "status": 201 if created else 200,
+                        }})
+                    return self._send(200, {
+                        "took": 1, "errors": errors, "items": items,
+                    })
+
+            def _search(self, index: str, query: dict):
+                docs = mini.indices.get(index, {})
+                q = query.get("query", {"match_all": {}})
+                if "term" in q:
+                    field, want = next(iter(q["term"].items()))
+                    if isinstance(want, dict):
+                        want = want["value"]
+                    hits = [
+                        {"_index": index, "_id": did, "_source": d}
+                        for did, d in docs.items()
+                        if d.get(field) == want
+                    ]
+                else:
+                    hits = [
+                        {"_index": index, "_id": did, "_source": d}
+                        for did, d in docs.items()
+                    ]
+                return self._send(200, {"hits": {
+                    "total": len(hits), "hits": hits,
+                }})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mini-elasticsearch",
+        )
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def throttle(self, n: int):
+        with self._lock:
+            self._throttle = n
+
+    def fail_ids(self, ids):
+        with self._lock:
+            self._fail_ids = {str(i) for i in ids}
+
+    def throttle_ids(self, ids, times: int = 1):
+        """The next ``times`` index attempts for each id return a
+        per-item 429 inside an HTTP 200 bulk response."""
+        with self._lock:
+            for i in ids:
+                self._item_throttle[str(i)] = times
+
+    def doc_count(self, index: str) -> int:
+        with self._lock:
+            return len(self.indices.get(index, {}))

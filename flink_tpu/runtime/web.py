@@ -153,6 +153,14 @@ class WebMonitor:
         }
 
     @staticmethod
+    def _checkpoint_stats(rec) -> list:
+        live = getattr(rec.env, "_live_metrics", None)
+        stats = (getattr(live, "checkpoint_stats", None) or [])
+        if not stats and rec.handle is not None:
+            stats = rec.handle.metrics.checkpoint_stats or []
+        return stats
+
+    @staticmethod
     def _attempt_row(v, a) -> dict:
         return {
             "subtask": v.subtask_index,
@@ -384,10 +392,7 @@ class WebMonitor:
             if rec is None:
                 return None
             cid = int(m.group(2))
-            live = getattr(rec.env, "_live_metrics", None)
-            stats = (getattr(live, "checkpoint_stats", None) or [])
-            if not stats and rec.handle is not None:
-                stats = rec.handle.metrics.checkpoint_stats or []
+            stats = self._checkpoint_stats(rec)
             row = next((s for s in stats if s["id"] == cid), None)
             if row is None:
                 return None
@@ -481,10 +486,7 @@ class WebMonitor:
             rec = self.cluster.jobs.get(m.group(1))
             if rec is None:
                 return None
-            live = getattr(rec.env, "_live_metrics", None)
-            stats = (getattr(live, "checkpoint_stats", None) or [])
-            if not stats and rec.handle is not None:
-                stats = rec.handle.metrics.checkpoint_stats or []
+            stats = self._checkpoint_stats(rec)
             durs = [s["duration_ms"] for s in stats]
             sizes = [s["bytes"] for s in stats]
             return {
