@@ -40,6 +40,17 @@ from typing import Any, Callable, Dict, List, Optional
 from flink_tpu.runtime.sinks import Sink
 
 
+class BulkTransportError(ConnectionError):
+    """A bulk could not be (fully) delivered; ``unsent`` carries exactly
+    the actions that were NOT acknowledged, so the sink re-buffers only
+    those — re-buffering already-indexed actions would duplicate auto-id
+    documents and double-invoke the failure handler."""
+
+    def __init__(self, message: str, unsent: List[dict]):
+        super().__init__(message)
+        self.unsent = unsent
+
+
 class ElasticsearchSink(Sink):
     """ref ElasticsearchSink: elements -> index actions -> buffered
     `_bulk` requests.
@@ -63,6 +74,7 @@ class ElasticsearchSink(Sink):
         self.failure_handler = failure_handler
         self.timeout_s = timeout_s
         self._buf: List[dict] = []
+        self._conn: Optional[http.client.HTTPConnection] = None
         self.stats = {"bulk_requests": 0, "actions": 0, "retries": 0}
 
     # -- Sink contract ---------------------------------------------------
@@ -87,6 +99,9 @@ class ElasticsearchSink(Sink):
 
     def close(self):
         self.flush()
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
 
     def snapshot_state(self):
         # flush-on-checkpoint: the cut must not cover unsent actions
@@ -99,46 +114,57 @@ class ElasticsearchSink(Sink):
             return
         actions, self._buf = self._buf, []
         try:
-            self._send_with_retries(actions)
-        except Exception:
-            # transport failure / retry exhaustion: put the actions back
-            # so a caller-level retry (or the checkpoint-restart replay)
-            # still covers them — at-least-once, never silent loss
-            self._buf = actions + self._buf
+            self._send_rounds(actions)
+        except BulkTransportError as e:
+            # put ONLY the unacknowledged actions back so a caller-level
+            # retry (or the checkpoint-restart replay) still covers them
+            # — at-least-once, never silent loss, never a duplicate of
+            # an already-indexed auto-id document
+            self._buf = list(e.unsent) + self._buf
             raise
 
-    def _send_with_retries(self, actions: List[dict]):
+    def _send_rounds(self, current: List[dict]):
+        """Deliver `current` with bounded backoff; raises
+        BulkTransportError carrying the UNSENT subset on transport
+        failures, RuntimeError (no re-buffer: poison item, the
+        checkpoint replay covers it) when the default handler rejects a
+        permanent per-item failure."""
         delay = 0.05
         for attempt in range(self.max_retries + 1):
-            status, resp = self._request_raw(
-                "POST", "/_bulk", self._bulk_body(actions),
-                "application/x-ndjson",
-            )
+            try:
+                status, resp = self._request_raw(
+                    "POST", "/_bulk", self._bulk_body(current),
+                    "application/x-ndjson",
+                )
+            except OSError as e:
+                raise BulkTransportError(str(e), current) from e
             if status in (429, 503):
                 # the whole bulk was throttled: back off and resend
                 # (BulkProcessor's backoff policy)
                 self.stats["retries"] += 1
                 if attempt == self.max_retries:
-                    raise ConnectionError(
+                    raise BulkTransportError(
                         f"bulk rejected with {status} after "
-                        f"{self.max_retries} retries"
+                        f"{self.max_retries} retries", current,
                     )
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
                 continue
             if status != 200:
-                raise ConnectionError(f"bulk failed: HTTP {status}")
+                raise BulkTransportError(
+                    f"bulk failed: HTTP {status}", current
+                )
             resp = json.loads(resp)
             self.stats["bulk_requests"] += 1
-            self.stats["actions"] += len(actions)
             if not resp.get("errors"):
+                self.stats["actions"] += len(current)
                 return
             # per-item results: 429s are TRANSIENT (a loaded cluster
             # throttles individual items inside an HTTP 200 bulk
             # response) — resend just those with backoff; other
             # failures go to the handler seam
             retry = []
-            for item, action in zip(resp["items"], actions):
+            for item, action in zip(resp["items"], current):
                 st = item.get("index", {}).get("status", 200)
                 if st == 429:
                     retry.append(action)
@@ -150,15 +176,17 @@ class ElasticsearchSink(Sink):
                             f"index action failed with status {st}: "
                             f"{item}"
                         )
+                else:
+                    self.stats["actions"] += 1   # delivered exactly here
             if not retry:
                 return
             self.stats["retries"] += 1
             if attempt == self.max_retries:
-                raise ConnectionError(
+                raise BulkTransportError(
                     f"{len(retry)} bulk item(s) still throttled (429) "
-                    f"after {self.max_retries} retries"
+                    f"after {self.max_retries} retries", retry,
                 )
-            actions = retry
+            current = retry
             time.sleep(delay)
             delay = min(delay * 2, 2.0)
 
@@ -182,16 +210,26 @@ class ElasticsearchSink(Sink):
         return json.loads(data)
 
     def _request_raw(self, method, path, body=b"", ctype=""):
-        conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout_s
-        )
-        try:
-            headers = {"Content-Type": ctype} if ctype else {}
-            conn.request(method, path, body, headers)
-            r = conn.getresponse()
-            return r.status, r.read()
-        finally:
-            conn.close()
+        """One persistent keep-alive connection (a bulk per request must
+        not pay a TCP handshake RTT); reconnect once on a broken pipe."""
+        headers = {"Content-Type": ctype} if ctype else {}
+        for fresh in (False, True):
+            if self._conn is None or fresh:
+                if self._conn is not None:
+                    self._conn.close()
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s
+                )
+            try:
+                self._conn.request(method, path, body, headers)
+                r = self._conn.getresponse()
+                return r.status, r.read()
+            except (http.client.HTTPException, OSError):
+                self._conn.close()
+                self._conn = None
+                if fresh:
+                    raise
+        raise AssertionError("unreachable")
 
 
 # ---------------------------------------------------------------- test peer
