@@ -104,9 +104,14 @@ def _read_bytes(buf: bytes, off: int) -> Tuple[Optional[bytes], int]:
     return buf[off:off + n], off + n
 
 
-def encode_value(v: Any) -> bytes:
+def encode_value(v: Any) -> Optional[bytes]:
     """Python value -> CQL serialized bytes (the varchar/bigint/double
-    subset the connector binds)."""
+    subset the connector binds); None -> CQL null (length -1 on the
+    wire), raw bytes pass through."""
+    if v is None:
+        return None
+    if isinstance(v, bytes):
+        return v
     if isinstance(v, bool):
         return b"\x01" if v else b"\x00"
     if isinstance(v, int):

@@ -51,6 +51,17 @@ class BulkTransportError(ConnectionError):
         self.unsent = unsent
 
 
+class BulkItemError(RuntimeError):
+    """A permanent per-item failure with no failure handler configured.
+    ``unsent`` carries the TRANSIENT (429) items of the same response so
+    the sink re-buffers them — a poison item must not drop its throttled
+    batch-mates."""
+
+    def __init__(self, message: str, unsent: List[dict]):
+        super().__init__(message)
+        self.unsent = unsent
+
+
 class ElasticsearchSink(Sink):
     """ref ElasticsearchSink: elements -> index actions -> buffered
     `_bulk` requests.
@@ -115,7 +126,7 @@ class ElasticsearchSink(Sink):
         actions, self._buf = self._buf, []
         try:
             self._send_rounds(actions)
-        except BulkTransportError as e:
+        except (BulkTransportError, BulkItemError) as e:
             # put ONLY the unacknowledged actions back so a caller-level
             # retry (or the checkpoint-restart replay) still covers them
             # — at-least-once, never silent loss, never a duplicate of
@@ -136,7 +147,7 @@ class ElasticsearchSink(Sink):
                     "POST", "/_bulk", self._bulk_body(current),
                     "application/x-ndjson",
                 )
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
                 raise BulkTransportError(str(e), current) from e
             if status in (429, 503):
                 # the whole bulk was throttled: back off and resend
@@ -162,8 +173,10 @@ class ElasticsearchSink(Sink):
             # per-item results: 429s are TRANSIENT (a loaded cluster
             # throttles individual items inside an HTTP 200 bulk
             # response) — resend just those with backoff; other
-            # failures go to the handler seam
-            retry = []
+            # failures go to the handler seam. The whole item list is
+            # processed BEFORE any raise so a poison item can't drop its
+            # throttled batch-mates.
+            retry, permanent = [], []
             for item, action in zip(resp["items"], current):
                 st = item.get("index", {}).get("status", 200)
                 if st == 429:
@@ -172,12 +185,15 @@ class ElasticsearchSink(Sink):
                     if self.failure_handler is not None:
                         self.failure_handler(action, st, item)
                     else:
-                        raise RuntimeError(
-                            f"index action failed with status {st}: "
-                            f"{item}"
-                        )
+                        permanent.append((st, item))
                 else:
                     self.stats["actions"] += 1   # delivered exactly here
+            if permanent:
+                st, item = permanent[0]
+                raise BulkItemError(
+                    f"index action failed with status {st}: {item} "
+                    f"({len(permanent)} permanent failure(s))", retry,
+                )
             if not retry:
                 return
             self.stats["retries"] += 1
@@ -211,9 +227,15 @@ class ElasticsearchSink(Sink):
 
     def _request_raw(self, method, path, body=b"", ctype=""):
         """One persistent keep-alive connection (a bulk per request must
-        not pay a TCP handshake RTT); reconnect once on a broken pipe."""
+        not pay a TCP handshake RTT). A SEND-phase failure on a reused
+        connection is the stale keep-alive race — retried once on a
+        fresh socket. A RECEIVE-phase failure is NEVER blindly resent:
+        the server may already have processed the request, and a resend
+        would duplicate auto-id documents; the error propagates so the
+        sink's unsent-tracking (at-least-once) decides."""
         headers = {"Content-Type": ctype} if ctype else {}
         for fresh in (False, True):
+            reused = self._conn is not None and not fresh
             if self._conn is None or fresh:
                 if self._conn is not None:
                     self._conn.close()
@@ -222,13 +244,19 @@ class ElasticsearchSink(Sink):
                 )
             try:
                 self._conn.request(method, path, body, headers)
+            except (http.client.HTTPException, OSError):
+                self._conn.close()
+                self._conn = None
+                if reused:
+                    continue        # stale keep-alive: one fresh retry
+                raise
+            try:
                 r = self._conn.getresponse()
                 return r.status, r.read()
             except (http.client.HTTPException, OSError):
                 self._conn.close()
                 self._conn = None
-                if fresh:
-                    raise
+                raise
         raise AssertionError("unreachable")
 
 

@@ -119,3 +119,14 @@ def test_pipeline_end_to_end(cass):
     rows = conn.query("SELECT total FROM windows WHERE wk = '1@1000'")
     assert struct.unpack(">q", rows[0][0])[0] == 25
     conn.close()
+
+
+def test_null_bind_value_round_trips(cass):
+    """None binds as a CQL null (wire length -1), not the string 'None'."""
+    conn = CqlConnection("127.0.0.1", cass.port)
+    conn.query("CREATE TABLE n (k text, v bigint, PRIMARY KEY (k))")
+    stmt = conn.prepare("INSERT INTO n (k, v) VALUES (?, ?)")
+    conn.execute(stmt, ["a", None])
+    rows = conn.query("SELECT v FROM n WHERE k = 'a'")
+    assert rows[0][0] is None          # null cell, not b"None"
+    conn.close()
