@@ -107,18 +107,30 @@ def _read_bytes(buf: bytes, off: int) -> Tuple[Optional[bytes], int]:
 def encode_value(v: Any) -> Optional[bytes]:
     """Python value -> CQL serialized bytes (the varchar/bigint/double
     subset the connector binds); None -> CQL null (length -1 on the
-    wire), raw bytes pass through."""
+    wire), raw bytes pass through. Numeric ABCs cover numpy scalars
+    (np.int64/np.float32 — the natural output of the pipeline) so they
+    serialize as proper bigint/double wire bytes, and anything
+    unrecognized is REJECTED rather than silently str()-encoded."""
+    import numbers
+
     if v is None:
         return None
     if isinstance(v, bytes):
         return v
-    if isinstance(v, bool):
-        return b"\x01" if v else b"\x00"
-    if isinstance(v, int):
-        return struct.pack(">q", v)
-    if isinstance(v, float):
-        return struct.pack(">d", v)
-    return str(v).encode()
+    if isinstance(v, bool) or (
+        hasattr(v, "dtype") and getattr(v.dtype, "kind", "") == "b"
+    ):
+        return b"\x01" if bool(v) else b"\x00"
+    if isinstance(v, numbers.Integral):
+        return struct.pack(">q", int(v))
+    if isinstance(v, numbers.Real):
+        return struct.pack(">d", float(v))
+    if isinstance(v, str):
+        return v.encode()
+    raise TypeError(
+        f"cannot bind {type(v).__name__} as a CQL value; pass "
+        f"str/int/float/bool/bytes/None"
+    )
 
 
 class CqlError(RuntimeError):

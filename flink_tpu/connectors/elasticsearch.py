@@ -167,6 +167,16 @@ class ElasticsearchSink(Sink):
                 )
             resp = json.loads(resp)
             self.stats["bulk_requests"] += 1
+            if resp.get("errors") and \
+                    len(resp.get("items", [])) != len(current):
+                # a malformed/truncated response must not silently drop
+                # the unmatched tail from delivery accounting: treat the
+                # whole round as undelivered (at-least-once re-buffer)
+                raise BulkTransportError(
+                    f"bulk response item count "
+                    f"{len(resp.get('items', []))} != {len(current)} "
+                    f"actions sent", current,
+                )
             if not resp.get("errors"):
                 self.stats["actions"] += len(current)
                 return
@@ -431,10 +441,10 @@ class MiniElasticsearch:
 
     def throttle_ids(self, ids, times: int = 1):
         """The next ``times`` index attempts for each id return a
-        per-item 429 inside an HTTP 200 bulk response."""
+        per-item 429 inside an HTTP 200 bulk response (REPLACES the
+        current throttle set; an empty list clears it)."""
         with self._lock:
-            for i in ids:
-                self._item_throttle[str(i)] = times
+            self._item_throttle = {str(i): times for i in ids}
 
     def doc_count(self, index: str) -> int:
         with self._lock:

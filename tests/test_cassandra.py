@@ -130,3 +130,25 @@ def test_null_bind_value_round_trips(cass):
     rows = conn.query("SELECT v FROM n WHERE k = 'a'")
     assert rows[0][0] is None          # null cell, not b"None"
     conn.close()
+
+
+def test_numpy_scalars_bind_as_proper_wire_types(cass):
+    """np.int64/np.float64 (the pipeline's natural outputs) serialize as
+    bigint/double wire bytes, not str(); unknown types are rejected."""
+    from flink_tpu.connectors.cassandra import encode_value
+
+    assert encode_value(np.int64(42)) == struct.pack(">q", 42)
+    assert encode_value(np.float64(1.5)) == struct.pack(">d", 1.5)
+    assert encode_value(np.float32(2.0)) == struct.pack(">d", 2.0)
+    assert encode_value(np.bool_(True)) == b"\x01"
+    assert encode_value(None) is None
+    with pytest.raises(TypeError, match="cannot bind"):
+        encode_value({"not": "a scalar"})
+
+    conn = CqlConnection("127.0.0.1", cass.port)
+    conn.query("CREATE TABLE np (k text, v bigint, PRIMARY KEY (k))")
+    stmt = conn.prepare("INSERT INTO np (k, v) VALUES (?, ?)")
+    conn.execute(stmt, ["a", np.int64(7)])
+    rows = conn.query("SELECT v FROM np WHERE k = 'a'")
+    assert struct.unpack(">q", rows[0][0])[0] == 7
+    conn.close()

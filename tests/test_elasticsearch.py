@@ -222,3 +222,48 @@ def test_oversized_element_batch_splits_bulks(es):
     assert len(sink._buf) == 5
     sink.close()
     assert es.doc_count("events") == 35
+
+
+def test_poison_item_keeps_throttled_batchmates(es):
+    """A response mixing a permanent failure (default handler raises)
+    with per-item 429s must re-buffer the THROTTLED items — the poison
+    item cannot drop its batch-mates."""
+    sink = _sink(es, flush_max_actions=3, max_retries=3)
+    sink.open()
+    es.fail_ids([2])            # permanent 400 for id 2
+    es.throttle_ids([3], times=10)   # transient 429 for id 3
+    with pytest.raises(RuntimeError, match="status 400"):
+        sink.invoke_batch([(1, 1.0), (2, 2.0), (3, 3.0)])
+    # id 1 delivered; id 3 (throttled) back in the buffer, id 2 not
+    assert es.doc_count("events") == 1
+    assert [a["id"] for a in sink._buf] == [3]
+    es.fail_ids([])
+    es.throttle_ids([], times=0)
+    sink.flush()
+    assert es.doc_count("events") == 2      # id 3 delivered on retry
+
+
+def test_truncated_bulk_response_rebuffers_everything(es, monkeypatch):
+    """A response with fewer items than actions (broken proxy) must not
+    silently drop the unmatched tail: the whole round re-buffers."""
+    from flink_tpu.connectors.elasticsearch import BulkTransportError
+
+    sink = _sink(es, flush_max_actions=100, max_retries=0)
+    sink.open()
+    real = sink._request_raw
+
+    def truncating(method, path, body=b"", ctype=""):
+        status, resp = real(method, path, body, ctype)
+        if path == "/_bulk":
+            import json as _json
+            payload = _json.loads(resp)
+            payload["errors"] = True
+            payload["items"] = payload["items"][:1]
+            resp = _json.dumps(payload).encode()
+        return status, resp
+
+    monkeypatch.setattr(sink, "_request_raw", truncating)
+    sink.invoke_batch([(1, 1.0), (2, 2.0), (3, 3.0)])
+    with pytest.raises(BulkTransportError, match="item count"):
+        sink.flush()
+    assert len(sink._buf) == 3              # nothing silently lost
