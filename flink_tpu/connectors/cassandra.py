@@ -119,6 +119,7 @@ def encode_value(v: Any) -> Optional[bytes]:
         return v
     if isinstance(v, bool) or (
         hasattr(v, "dtype") and getattr(v.dtype, "kind", "") == "b"
+        and getattr(v, "ndim", 0) == 0      # scalar only, never arrays
     ):
         return b"\x01" if bool(v) else b"\x00"
     if isinstance(v, numbers.Integral):

@@ -119,7 +119,8 @@ class _FlatStageCheckpointer:
     silently via clamped gathers, so fail fast instead."""
 
     def __init__(self, executor, pipe, ctx, codec, keep_rev, emitter,
-                 metrics, get_state, set_state, stage_kind, meta):
+                 metrics, get_state, set_state, stage_kind, meta,
+                 extra_payload=None, apply_extra=None):
         env = executor.env
         self.executor = executor
         self.env = env
@@ -133,6 +134,10 @@ class _FlatStageCheckpointer:
         self.set_state = set_state
         self.stage_kind = stage_kind
         self.meta = dict(meta)
+        # stage-specific non-array state riding the payload (e.g. the
+        # session path's watermark + time-domain origin)
+        self.extra_payload = extra_payload
+        self.apply_extra = apply_extra
         self.storage = None
         if env.checkpoint_dir:
             self.storage = ckpt.CheckpointStorage(
@@ -166,6 +171,9 @@ class _FlatStageCheckpointer:
             "n_shards": self.ctx.n_shards,
             "stage_kind": self.stage_kind,
             "stage_meta": dict(self.meta),
+            "stage_extra": (
+                self.extra_payload() if self.extra_payload else {}
+            ),
         }
 
     def maybe_checkpoint(self):
@@ -246,6 +254,8 @@ class _FlatStageCheckpointer:
                 == os.path.abspath(self.storage.dir)
             )
             self.n_keys_logged = len(self.codec._rev) if same_dir else 0
+        if self.apply_extra is not None:
+            self.apply_extra(payload.get("stage_extra", {}))
         self.steps_at_ckpt = self.metrics.steps
 
     def write_savepoint(self, path: str) -> str:
@@ -3302,145 +3312,38 @@ class LocalExecutor:
 
         emitter = _LaggedEmitter(env, emit)
 
-        # -- checkpoint/restore (round 4: closes the session-path
-        # NotImplementedError). The session state pytree is a flat set of
-        # per-shard arrays, so the snapshot is a raw device_get at the
-        # step boundary (the structural barrier, SURVEY §3.4) + source
-        # offsets + sink states + the codec reverse map; restore places
-        # the arrays back onto the mesh sharding. Pending lagged fires
-        # are DRAINED before a snapshot (the cut must include their sink
-        # effects) and DISCARDED on restore (replay re-fires them).
-        storage = None
-        if env.checkpoint_dir:
-            storage = ckpt.CheckpointStorage(
-                env.checkpoint_dir,
-                retain=env.config.get_int("checkpoint.retain", 2),
-            )
-        next_cid = (storage.latest() or 0) + 1 if storage else 1
-        steps_at_ckpt = 0
-        n_keys_logged = 0
+        # -- checkpoint/restore: the shared flat-pytree machinery
+        # (_FlatStageCheckpointer — round 4 introduced the session
+        # support inline, round 5 unified it with rolling/count). The
+        # session-specific non-array state (watermark + time-domain
+        # origin) rides the payload's stage_extra hooks.
+        def _set_state(s):
+            nonlocal state
+            state = s
 
-        def _payload(store):
-            # codec reverse map rides the APPEND-ONLY keymap log (the
-            # windowed path's machinery): each checkpoint writes only the
-            # keys seen since the last one, not the whole O(keys) dict
-            nonlocal n_keys_logged
-            if keep_rev:
-                items = list(
-                    itertools.islice(codec._rev.items(), n_keys_logged,
-                                     None)
-                )
-                store.append_keymap(items)
-                n_keys_logged = len(codec._rev)
-            leaves, _ = jax.tree_util.tree_flatten(state)
+        def _extra():
             return {
-                "session_state": [np.asarray(jax.device_get(x))
-                                  for x in leaves],
-                "offsets": pipe.source.snapshot_offsets(),
                 "wm_current": wm_strategy.current(),
                 "origin_ms": td.origin_ms if td is not None else None,
-                "codec_rev_count": n_keys_logged if keep_rev else 0,
-                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
-                "max_parallelism": env.max_parallelism,
-                "n_shards": ctx.n_shards,
-                "gap_ms": assigner.gap_ms,
-                "capacity_per_shard": env.state_capacity_per_shard,
-                "session_window": True,
             }
 
-        def write_checkpoint():
-            nonlocal next_cid, steps_at_ckpt
-            emitter.drain()
-            payload = _payload(storage)
-            storage.write_generic(next_cid, payload)
-            pipe.source.notify_checkpoint_complete(next_cid,
-                                                   payload["offsets"])
-            for s in pipe.all_sinks:
-                s.notify_checkpoint_complete(next_cid)
-            next_cid += 1
-            steps_at_ckpt = metrics.steps
-
-        def restore_checkpoint(path_or_storage, cid=None):
-            nonlocal state, td, steps_at_ckpt
-            st = (
-                ckpt.CheckpointStorage(path_or_storage)
-                if isinstance(path_or_storage, str) else path_or_storage
-            )
-            cid = cid if cid is not None else st.latest()
-            if cid is None:
-                raise FileNotFoundError(f"no checkpoint in {st.dir}")
-            payload = st.read_generic(cid)
-            if not payload.get("session_window"):
-                raise ValueError(
-                    "checkpoint was not written by a session-window job"
-                )
-            if payload["max_parallelism"] != env.max_parallelism:
-                raise ValueError("checkpoint max-parallelism mismatch")
-            if payload["n_shards"] != ctx.n_shards:
-                raise ValueError(
-                    f"checkpoint has {payload['n_shards']} shard(s), job "
-                    f"configured for {ctx.n_shards}"
-                )
-            if payload["gap_ms"] != assigner.gap_ms:
-                raise ValueError("session gap mismatch vs checkpoint")
-            snap_cap = payload.get("capacity_per_shard",
-                                   env.state_capacity_per_shard)
-            if snap_cap != env.state_capacity_per_shard:
-                # the compiled step bakes the capacity into its masks;
-                # mismatched arrays would corrupt silently (clamped
-                # gathers), so fail fast like every other keyed path
-                raise ValueError(
-                    f"checkpoint state capacity {snap_cap} != configured "
-                    f"{env.state_capacity_per_shard}"
-                )
-            emitter.discard()
-            _leaves, treedef = jax.tree_util.tree_flatten(state)
-            state = jax.tree_util.tree_unflatten(treedef, [
-                jax.device_put(x, ctx.state_sharding)
-                for x in payload["session_state"]
-            ])
-            pipe.source.restore_offsets(payload["offsets"])
-            sink_states = payload.get("sink_states")
-            if sink_states:
-                if len(sink_states) != len(pipe.all_sinks):
-                    raise ValueError(
-                        f"checkpoint has {len(sink_states)} sink states "
-                        f"but the job topology has {len(pipe.all_sinks)} "
-                        f"sinks — restore with the matching pipeline"
-                    )
-                for s, ss in zip(pipe.all_sinks, sink_states):
-                    s.restore_state(ss)
-            nonlocal n_keys_logged
-            count = payload.get("codec_rev_count", 0)
-            if keep_rev and count:
-                codec._rev = st.read_keymap(count)
-                # foreign-dir (savepoint) restore: the job's own keymap
-                # log lacks these keys — re-append all on next checkpoint
-                same_dir = storage is not None and (
-                    os.path.abspath(st.dir)
-                    == os.path.abspath(storage.dir)
-                )
-                n_keys_logged = len(codec._rev) if same_dir else 0
-            wm_strategy._current = payload["wm_current"]
-            if payload["origin_ms"] is not None:
-                td = TimeDomain(origin_ms=payload["origin_ms"],
+        def _apply_extra(extra):
+            nonlocal td
+            wm_strategy._current = extra["wm_current"]
+            if extra["origin_ms"] is not None:
+                td = TimeDomain(origin_ms=extra["origin_ms"],
                                 ms_per_tick=1)
-            steps_at_ckpt = metrics.steps
 
-        def write_savepoint(path: str) -> str:
-            nonlocal n_keys_logged
-            emitter.drain()
-            sp = ckpt.CheckpointStorage(path, retain=10**9)
-            cid = (sp.latest() or 0) + 1
-            # self-contained savepoint: full keymap into ITS directory
-            logged = n_keys_logged
-            n_keys_logged = 0
-            try:
-                return sp.write_generic(cid, _payload(sp))
-            finally:
-                n_keys_logged = logged
-
-        self._savepoint_writer = write_savepoint
+        ckptr = _FlatStageCheckpointer(
+            self, pipe, ctx, codec, keep_rev, emitter, metrics,
+            get_state=lambda: state, set_state=_set_state,
+            stage_kind="session-window",
+            meta={
+                "gap_ms": assigner.gap_ms,
+                "capacity_per_shard": env.state_capacity_per_shard,
+            },
+            extra_payload=_extra, apply_extra=_apply_extra,
+        )
 
         def run_once(hi, lo, ticks, values, valid, wm_ms):
             nonlocal state
@@ -3516,49 +3419,22 @@ class LocalExecutor:
                     _pad(ticks, B, np.int32), _pad(values, B, np.float32),
                     _pad(np.ones(n, bool), B, bool), wm_ms,
                 )
-                if (
-                    storage is not None
-                    and env.checkpoint_interval_steps > 0
-                    and metrics.steps - steps_at_ckpt
-                    >= env.checkpoint_interval_steps
-                    and td is not None
-                ):
-                    write_checkpoint()
-
-        # restore + restart protection (ref ExecutionGraph.restart; the
-        # final MAX-watermark flush sits INSIDE it, like the tumbling path)
-        if restore_from:
-            restore_checkpoint(restore_from)
-        restart = self._restart_strategy()
-        while True:
-            try:
-                batch_loop()
                 if td is not None:
-                    # end of stream: close all open sessions. INSIDE the
-                    # restart protection — a sink failing during the
-                    # final flush recovers like any mid-stream failure.
-                    final_wm = int(td.to_ms(2**31 - 4))
-                    run_once(
-                        np.zeros(B, np.uint32), np.zeros(B, np.uint32),
-                        np.zeros(B, np.int32),
-                        np.zeros((B,) + tuple(red.value_shape), np.float32),
-                        np.zeros(B, bool), final_wm,
-                    )
-                emitter.drain()
-                break
-            except JobCancelledException:
-                raise
-            except Exception:
-                can = (
-                    storage is not None
-                    and storage.latest() is not None
-                    and restart.should_restart()
+                    ckptr.maybe_checkpoint()
+            if td is not None:
+                # end of stream: close all open sessions. INSIDE the
+                # restart protection — a sink failing during the final
+                # flush recovers like any mid-stream failure.
+                final_wm = int(td.to_ms(2**31 - 4))
+                run_once(
+                    np.zeros(B, np.uint32), np.zeros(B, np.uint32),
+                    np.zeros(B, np.int32),
+                    np.zeros((B,) + tuple(red.value_shape), np.float32),
+                    np.zeros(B, bool), final_wm,
                 )
-                if not can:
-                    raise
-                metrics.restarts += 1
-                self._notify_restart()
-                restore_checkpoint(storage)
+            emitter.drain()
+
+        ckptr.run_with_restarts(batch_loop, restore_from)
 
         metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
         dropped = int(np.asarray(state.dropped_capacity).sum())

@@ -296,6 +296,39 @@ def rule_filter_merge(node):
     return node, False
 
 
+def rule_having_pushdown(node):
+    """HAVING conjuncts that reference only GROUP BY keys filter BEFORE
+    the aggregation (the classic aggregate-pushdown: a key predicate
+    selects whole groups, so applying it to the rows is equivalent and
+    shrinks the hash-aggregate input)."""
+    if not (isinstance(node, LFilter)
+            and isinstance(node.input, LAggregate)):
+        return node, False
+    agg = node.input
+    keys = set(agg.keys)
+    # a SELECT alias that reuses a key's name SHADOWS it in the output:
+    # `SUM(amount) AS region ... HAVING region > 3` filters the sum, so
+    # pushing that conjunct to the raw key column would change results
+    shadowed = set()
+    for item in agg.items:
+        m = re.match(r"^(.+?)\s+AS\s+(\w+)\s*$", item, re.IGNORECASE)
+        if m and m.group(2) in keys and m.group(1).strip() != m.group(2):
+            shadowed.add(m.group(2))
+    pushable = keys - shadowed
+    stay, push = [], []
+    for cj in node.conjuncts:
+        r = refs(cj)
+        if r is not None and r and r <= pushable:
+            push.append(cj)
+        else:
+            stay.append(cj)
+    if not push:
+        return node, False
+    new_agg = LAggregate(LFilter(agg.input, push), agg.keys, agg.items,
+                         agg.schema)
+    return (LFilter(new_agg, stay) if stay else new_agg), True
+
+
 def _empty_scans(node):
     if isinstance(node, LScan):
         return LScan(node.name, 0, node.schema, node.keep, empty=True)
@@ -430,6 +463,7 @@ def rule_column_pruning(root):
 _LOCAL_RULES = [
     ("ConstantFilter", rule_constant_filter),
     ("FilterMerge", rule_filter_merge),
+    ("HavingPushdown", rule_having_pushdown),
     ("FilterPushdown", rule_filter_pushdown),
 ]
 

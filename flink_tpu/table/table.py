@@ -566,6 +566,7 @@ class TableEnvironment:
         r"(?P<on>.+?))?"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
+        r"(?:\s+HAVING\s+(?P<having>.+?))?"
         r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
@@ -690,6 +691,18 @@ class TableEnvironment:
             node = pl.LAggregate(node, keys, items, list(items))
         elif not star:
             node = pl.LProject(node, select_items, list(select_items))
+        if m.group("having"):
+            if not m.group("group"):
+                raise ValueError("HAVING requires GROUP BY")
+            hv = m.group("having")
+            if re.search(r"\b(SUM|COUNT|AVG|MIN|MAX)\s*\(", hv,
+                         re.IGNORECASE):
+                raise ValueError(
+                    "HAVING references SELECT aliases and group keys; "
+                    "alias the aggregate in SELECT (e.g. SUM(x) AS "
+                    "total) and write HAVING total > ..."
+                )
+            node = pl.LFilter(node, pl.split_conjuncts(hv))
         if m.group("order"):
             node = pl.LSort(node, m.group("order").strip())
         if m.group("limit"):

@@ -152,3 +152,12 @@ def test_numpy_scalars_bind_as_proper_wire_types(cass):
     rows = conn.query("SELECT v FROM np WHERE k = 'a'")
     assert struct.unpack(">q", rows[0][0])[0] == 7
     conn.close()
+
+
+def test_bool_arrays_rejected(cass):
+    from flink_tpu.connectors.cassandra import encode_value
+
+    with pytest.raises(TypeError, match="cannot bind"):
+        encode_value(np.array([True, False]))
+    with pytest.raises(TypeError, match="cannot bind"):
+        encode_value(np.array([True]))

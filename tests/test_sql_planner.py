@@ -260,3 +260,62 @@ def test_top_level_or_not_severed():
         "(a = 1 OR b = 2)", "c = 3"]
     assert pl.split_conjuncts("a = 1 OR b = 2 AND c = 3") == [
         "a = 1 OR b = 2 AND c = 3"]
+
+
+# ----------------------------------------------------------------- HAVING
+
+def test_having_filters_aggregates():
+    tenv = _env()
+    q = ("SELECT region, SUM(amount) AS total FROM orders "
+         "GROUP BY region HAVING total > 20000.0 ORDER BY region")
+    t = tenv.sql_query(q)
+    raw = tenv.sql_query(
+        "SELECT region, SUM(amount) AS total FROM orders "
+        "GROUP BY region ORDER BY region")
+    expect = [r for r in map(tuple, raw.to_rows()) if r[1] > 20000.0]
+    assert list(map(tuple, t.to_rows())) == expect
+    assert t.n > 0
+
+
+def test_having_on_group_key_pushes_below_aggregate():
+    """A HAVING conjunct on the group key selects whole groups: the
+    planner moves it below the aggregate, shrinking its input; mixed
+    conjuncts split."""
+    tenv = _env()
+    q = ("SELECT region, SUM(amount) AS total FROM orders "
+         "GROUP BY region HAVING region > 1 AND total > 0.0")
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert sorted(map(tuple, t_opt.to_rows())) == sorted(
+        map(tuple, t_raw.to_rows()))
+    plan = tenv.explain(q)
+    assert "HavingPushdown" in plan
+    opt = plan.split("== Optimized Logical Plan ==")[1].split("applied")[0]
+    agg_at = opt.index("Aggregate(")
+    assert opt.index("Filter(region > 1") > agg_at     # below: pushed
+    assert opt.index("Filter(total > 0.0") < agg_at    # above: stays
+
+
+def test_having_requires_group_by_and_aliased_aggregates():
+    tenv = _env()
+    with pytest.raises(ValueError, match="HAVING requires GROUP BY"):
+        tenv.sql_query("SELECT oid FROM orders HAVING oid > 1")
+    with pytest.raises(ValueError, match="alias the aggregate"):
+        tenv.sql_query(
+            "SELECT region FROM orders GROUP BY region "
+            "HAVING SUM(amount) > 10.0")
+
+
+def test_having_alias_shadowing_key_not_pushed():
+    """Regression: `SUM(amount) AS region` shadows the group key name —
+    HAVING region filters the SUM, so the conjunct must NOT push below
+    the aggregate."""
+    tenv = _env()
+    q = ("SELECT SUM(amount) AS region FROM orders "
+         "GROUP BY region HAVING region > 3")
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert sorted(map(tuple, t_opt.to_rows())) == sorted(
+        map(tuple, t_raw.to_rows()))
+    assert t_opt.n > 0
+    assert "HavingPushdown" not in tenv.explain(q)
