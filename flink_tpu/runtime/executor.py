@@ -206,6 +206,22 @@ class _FlatStageCheckpointer:
         if cid is None:
             raise FileNotFoundError(f"no checkpoint in {st.dir}")
         payload = st.read_generic(cid)
+        if payload.get("session_window") and "stage_kind" not in payload:
+            # round-4 inline session format: adapt to the unified shape
+            # so retained checkpoints/savepoints stay restorable
+            payload = {
+                **payload,
+                "stage_kind": "session-window",
+                "stage_state": payload["session_state"],
+                "stage_meta": {
+                    "gap_ms": payload["gap_ms"],
+                    "capacity_per_shard": payload["capacity_per_shard"],
+                },
+                "stage_extra": {
+                    "wm_current": payload["wm_current"],
+                    "origin_ms": payload["origin_ms"],
+                },
+            }
         if payload.get("stage_kind") != self.stage_kind:
             raise ValueError(
                 f"checkpoint was not written by a {self.stage_kind} "

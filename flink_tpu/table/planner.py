@@ -83,6 +83,17 @@ def split_conjuncts(sql: str) -> List[str]:
     LOOSER than AND, so the expression is not a conjunction at all —
     return it whole rather than severing an OR operand."""
     s, restore = stash_literals(sql)
+    # `X BETWEEN a AND b`: that AND is part of the operator, not a
+    # conjunction — mask it before splitting, restore after
+    s = re.sub(
+        r"(\bBETWEEN\b\s+\S+\s+)\bAND\b", "\\1\x02", s,
+        flags=re.IGNORECASE,
+    )
+    orig_restore = restore
+
+    def restore(p: str) -> str:  # noqa: F811 — layered restore
+        return orig_restore(p.replace("\x02", "AND"))
+
     depth = 0
     for tok in re.split(r"(\(|\))", s):
         if tok == "(":

@@ -695,8 +695,12 @@ class TableEnvironment:
             if not m.group("group"):
                 raise ValueError("HAVING requires GROUP BY")
             hv = m.group("having")
-            if re.search(r"\b(SUM|COUNT|AVG|MIN|MAX)\s*\(", hv,
-                         re.IGNORECASE):
+            from flink_tpu.table.planner import stash_literals
+            hv_no_lit, _ = stash_literals(hv)
+            if re.search(
+                r"\b(" + "|".join(_AGGS) + r")\s*\(", hv_no_lit,
+                re.IGNORECASE,
+            ):
                 raise ValueError(
                     "HAVING references SELECT aliases and group keys; "
                     "alias the aggregate in SELECT (e.g. SUM(x) AS "
@@ -863,12 +867,34 @@ def _parse_expr(s: str) -> Expr:
                 py, flags=re.IGNORECASE)
     py = re.sub(r"(\w+(?:\.\w+)?|__lit\d+__)\s+LIKE\s+(__lit\d+__)",
                 r"like(\1, \2)", py, flags=re.IGNORECASE)
+    # [NOT] BETWEEN: the left operand may be an arithmetic chain
+    # (`a + b BETWEEN lo AND hi` bounds the SUM); the parenthesization
+    # keeps the inner `and`/`or` below any surrounding OR. NOT BETWEEN
+    # must rewrite FIRST or the plain rule would mis-bind it.
+    _chain = (r"((?:-?[\w.]+|__lit\d+__)"
+              r"(?:\s*[-+*/%]\s*(?:-?[\w.]+|__lit\d+__))*)")
+    _operand = r"(-?[\w.]+|__lit\d+__)"
+    py = re.sub(
+        _chain + r"\s+NOT\s+BETWEEN\s+" + _operand + r"\s+AND\s+"
+        + _operand,
+        r"((\1 < \2) or (\1 > \3))", py, flags=re.IGNORECASE,
+    )
+    py = re.sub(
+        _chain + r"\s+BETWEEN\s+" + _operand + r"\s+AND\s+" + _operand,
+        r"((\1 >= \2) and (\1 <= \3))", py, flags=re.IGNORECASE,
+    )
+    if re.search(r"\bBETWEEN\b", py, re.IGNORECASE):
+        raise ValueError(
+            f"unsupported BETWEEN shape in {s!r}: operands must be "
+            f"columns, literals, or arithmetic chains of them"
+        )
     py = re.sub(r"(?<![<>=!])=(?!=)", "==", py)
     # python's `and`/`or`/`not` have SQL's precedence (below comparisons);
     # the builder turns BoolOp into elementwise &/|
     py = re.sub(r"\bAND\b", "and", py, flags=re.IGNORECASE)
     py = re.sub(r"\bOR\b", "or", py, flags=re.IGNORECASE)
     py = re.sub(r"\bNOT\b", "not", py, flags=re.IGNORECASE)
+    py = re.sub(r"\bIN\b", "in", py, flags=re.IGNORECASE)
     py = re.sub(r"\bCOUNT\s*\(\s*\*\s*\)", "COUNT(__star__)", py,
                 flags=re.IGNORECASE)
     tree = ast.parse(py, mode="eval")
@@ -887,6 +913,23 @@ def _parse_expr(s: str) -> Expr:
             return lit(node.value)
         if isinstance(node, ast.Compare):
             left = build(node.left)
+            if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                # X IN (a, b, c): membership as an OR of equalities.
+                # `X IN (a)` parses as a parenthesized scalar, not a
+                # tuple — standard SQL, so treat it as a one-element list
+                members = node.comparators[0]
+                elts = (
+                    members.elts
+                    if isinstance(members, (ast.Tuple, ast.List))
+                    else [members]
+                )
+                acc = None
+                for elt in elts:
+                    eq = Expr.__eq__(left, build(elt))
+                    acc = eq if acc is None else (acc | eq)
+                if acc is None:
+                    return lit(False)
+                return ~acc if isinstance(node.ops[0], ast.NotIn) else acc
             right = build(node.comparators[0])
             opmap = {
                 ast.Gt: Expr.__gt__, ast.GtE: Expr.__ge__,

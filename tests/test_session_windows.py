@@ -276,3 +276,65 @@ def test_session_restore_validation_failures(tmp_path):
     env4.set_max_parallelism(16)
     with pytest.raises(ValueError, match="parallelism"):
         env4.execute("bad-maxp", restore_from=str(tmp_path))
+
+
+def test_round4_session_checkpoint_format_restores(tmp_path):
+    """Retained checkpoints from the round-4 inline session format (keys
+    session_window/session_state) restore through the unified
+    checkpointer's compatibility shim."""
+    from flink_tpu.runtime import checkpoint as ckpt
+
+    events = _session_events()
+
+    class Kill(CollectSink):
+        def invoke_batch(self, elements):
+            super().invoke_batch(elements)
+            if len(self.results) >= 13:
+                raise KeyboardInterrupt("simulated kill")
+
+        def snapshot_state(self):
+            return list(self.results)
+
+        def restore_state(self, state):
+            self.results[:] = state
+
+    env1 = _session_env(tmp_path, events, Kill())
+    try:
+        env1.execute("legacy-seed")
+        assert False
+    except KeyboardInterrupt:
+        pass
+
+    # rewrite every retained checkpoint into the ROUND-4 payload shape
+    st = ckpt.CheckpointStorage(str(tmp_path), retain=10**9)
+    for cid in st.list_checkpoints():
+        p = st.read_generic(cid)
+        legacy = {
+            "session_window": True,
+            "session_state": p["stage_state"],
+            "gap_ms": p["stage_meta"]["gap_ms"],
+            "capacity_per_shard": p["stage_meta"]["capacity_per_shard"],
+            "wm_current": p["stage_extra"]["wm_current"],
+            "origin_ms": p["stage_extra"]["origin_ms"],
+            "offsets": p["offsets"],
+            "codec_rev_count": p["codec_rev_count"],
+            "sink_states": p["sink_states"],
+            "max_parallelism": p["max_parallelism"],
+            "n_shards": p["n_shards"],
+        }
+        st.write_generic(cid, legacy)
+
+    class Plain(CollectSink):
+        def snapshot_state(self):
+            return list(self.results)
+
+        def restore_state(self, state):
+            self.results[:] = state
+
+    s2 = Plain()
+    env2 = _session_env(tmp_path, events, s2)
+    env2.execute("legacy-resume", restore_from=str(tmp_path))
+    got = {(r.key, r.window_start_ms, r.window_end_ms): r.value
+           for r in s2.results}
+    assert len(got) == 18
+    assert all(v == 5.0 for v in got.values())

@@ -360,3 +360,63 @@ def test_non_equi_residual_with_decimal_literal():
         "ORDER BY id"
     )
     assert [r["id"] for r in t.to_dicts()] == [2, 3, 4]
+
+
+def test_in_operator():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id FROM orders WHERE cust IN (10, 30) ORDER BY id")
+    got = [r["id"] for r in t.to_dicts()]
+    t2 = tenv.sql_query(
+        "SELECT id FROM orders WHERE cust NOT IN (10, 30) ORDER BY id")
+    got2 = [r["id"] for r in t2.to_dicts()]
+    all_ids = [r["id"] for r in tenv.sql_query(
+        "SELECT id FROM orders ORDER BY id").to_dicts()]
+    assert sorted(got + got2) == all_ids
+    assert got and got2
+
+
+def test_between_operator():
+    tenv = _env2()
+    t = tenv.sql_query(
+        "SELECT id, amount FROM orders "
+        "WHERE amount BETWEEN 20.0 AND 100.0 ORDER BY id")
+    assert all(20.0 <= r["amount"] <= 100.0 for r in t.to_dicts())
+    assert t.n > 0
+    # BETWEEN's AND must not be severed by the conjunct splitter, and a
+    # trailing real conjunct still splits
+    t2 = tenv.sql_query(
+        "SELECT id FROM orders "
+        "WHERE amount BETWEEN 20.0 AND 100.0 AND cust = 10")
+    ref = tenv.sql_query(
+        "SELECT id FROM orders "
+        "WHERE amount BETWEEN 20.0 AND 100.0 AND cust = 10",
+        optimize=False)
+    assert sorted(map(tuple, t2.to_rows())) == sorted(
+        map(tuple, ref.to_rows()))
+
+
+def test_between_compound_and_not_between():
+    tenv = _env2()
+    # arithmetic chain as the left operand bounds the whole expression
+    t = tenv.sql_query(
+        "SELECT id FROM orders "
+        "WHERE amount + amount BETWEEN 40.0 AND 200.0 ORDER BY id")
+    amounts = {r["id"]: r["amount"] for r in tenv.sql_query(
+        "SELECT id, amount FROM orders").to_dicts()}
+    expect = sorted(i for i, a in amounts.items() if 40.0 <= 2 * a <= 200.0)
+    assert [r["id"] for r in t.to_dicts()] == expect
+    # NOT BETWEEN is the complement
+    t2 = tenv.sql_query(
+        "SELECT id FROM orders "
+        "WHERE amount NOT BETWEEN 20.0 AND 100.0 ORDER BY id")
+    expect2 = sorted(i for i, a in amounts.items()
+                     if not (20.0 <= a <= 100.0))
+    assert [r["id"] for r in t2.to_dicts()] == expect2
+
+
+def test_single_element_in_list():
+    tenv = _env2()
+    t = tenv.sql_query("SELECT id FROM orders WHERE cust IN (10)")
+    ref = tenv.sql_query("SELECT id FROM orders WHERE cust = 10")
+    assert sorted(t.to_rows()) == sorted(ref.to_rows())
