@@ -34,6 +34,18 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /taskmanagers[/<id>]      device-slot view (ref TaskManagersHandler)
     /config                   effective configuration (ref JobManagerConfigHandler)
     /web                      single-page HTML dashboard over these routes
+
+HTTP job submission (ref JarUploadHandler / JarListHandler /
+JarRunHandler / JarDeleteHandler — the Web UI's submission path; the
+"jar" here is a Python module defining a builder function that returns a
+ready-to-submit StreamExecutionEnvironment):
+    POST   /jars/upload?name=<n>   body = module source -> {"id": ...}
+    GET    /jars                   uploaded program list
+    POST   /jars/<id>/run?entry=<fn>&job-name=<n>  -> {"jobid": ...}
+    DELETE /jars/<id>
+Like the reference, uploading a program means trusting it: the run
+handler executes the module. The shared-secret auth (when configured)
+gates these routes exactly like the read paths.
 """
 
 from __future__ import annotations
@@ -57,9 +69,14 @@ class WebMonitor:
     Clients send ``Authorization: Bearer <token>`` or ``?token=``."""
 
     def __init__(self, cluster: MiniCluster, host: str = "127.0.0.1",
-                 port: int = 0, config=None):
+                 port: int = 0, config=None, jar_dir: Optional[str] = None):
         self.cluster = cluster
         self._token = security.get_token(config)
+        self._jar_dir = jar_dir    # created lazily on first upload
+        self._jar_dir_owned = False
+        self._jars = {}            # id -> {"name", "path", "uploaded"}
+        self._next_jar = 1
+        self._jar_lock = threading.Lock()
         monitor = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -106,12 +123,66 @@ class WebMonitor:
                     body = body if body is not None else {"error": "not found"}
                 except Exception as e:
                     code, body = 500, {"error": str(e)}
+                self._json(code, body)
+
+            def _json(self, code: int, body: dict):
                 data = json.dumps(body, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _read_body(self):
+                """(payload, error) — drains the body even on auth
+                failure so the client gets a response, not a reset."""
+                if "chunked" in self.headers.get(
+                        "Transfer-Encoding", "").lower():
+                    return None, (411, {"error": "length required"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                except ValueError:
+                    return None, (400, {"error": "bad Content-Length"})
+                return (self.rfile.read(n) if n > 0 else b""), None
+
+            def do_POST(self):
+                payload, err = self._read_body()
+                if not self._authorized():
+                    self.send_response(401)
+                    data = json.dumps({"error": "unauthorized"}).encode()
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("WWW-Authenticate", "Bearer")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if err is not None:
+                    return self._json(*err)
+                u = urllib.parse.urlsplit(self.path)
+                query = dict(urllib.parse.parse_qsl(u.query))
+                try:
+                    code, body = monitor._route_post(u.path, query,
+                                                     payload)
+                except Exception as e:
+                    code, body = 500, {"error": str(e)}
+                self._json(code, body)
+
+            def do_DELETE(self):
+                if not self._authorized():
+                    self.send_response(401)
+                    data = json.dumps({"error": "unauthorized"}).encode()
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("WWW-Authenticate", "Bearer")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                u = urllib.parse.urlsplit(self.path)
+                try:
+                    code, body = monitor._route_delete(u.path)
+                except Exception as e:
+                    code, body = 500, {"error": str(e)}
+                self._json(code, body)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -126,6 +197,12 @@ class WebMonitor:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+        if self._jar_dir_owned and self._jar_dir:
+            import shutil
+
+            shutil.rmtree(self._jar_dir, ignore_errors=True)
+            self._jar_dir = None
+            self._jar_dir_owned = False
 
     # -- helpers ---------------------------------------------------------
     def _job_vertex(self, jid: str, vid: int):
@@ -172,6 +249,65 @@ class WebMonitor:
             "failure-cause": a.failure_cause,
         }
 
+    # -- job submission (ref JarUploadHandler / JarRunHandler) -----------
+    def _route_post(self, path, query, payload):
+        import os
+        import tempfile
+        import time as _time
+
+        if path == "/jars/upload":
+            if not payload:
+                return 400, {"error": "empty program body"}
+            with self._jar_lock:
+                if self._jar_dir is None:
+                    self._jar_dir = tempfile.mkdtemp(
+                        prefix="flink-tpu-jars-")
+                    self._jar_dir_owned = True
+                os.makedirs(self._jar_dir, exist_ok=True)
+                jid = f"prog-{self._next_jar}"
+                self._next_jar += 1
+                name = query.get("name", f"{jid}.py")
+                dest = os.path.join(self._jar_dir, f"{jid}.py")
+                with open(dest, "wb") as f:
+                    f.write(payload)
+                self._jars[jid] = {
+                    "id": jid, "name": name, "path": dest,
+                    "uploaded": int(_time.time() * 1000),
+                }
+            return 200, {"id": jid, "status": "success"}
+        m = re.fullmatch(r"/jars/([^/]+)/run", path)
+        if m:
+            with self._jar_lock:
+                jar = self._jars.get(m.group(1))
+            if jar is None:
+                return 404, {"error": f"no program {m.group(1)!r}"}
+            from flink_tpu.runtime.worker import load_builder
+
+            entry = query.get("entry", "build")
+            builder = load_builder(f"{jar['path']}:{entry}")
+            env = builder()
+            jobid = self.cluster.submit(
+                env, query.get("job-name", jar["name"])
+            )
+            return 200, {"jobid": jobid}
+        return 404, {"error": "not found"}
+
+    def _route_delete(self, path):
+        import os
+
+        m = re.fullmatch(r"/jars/([^/]+)", path)
+        if m:
+            with self._jar_lock:
+                jar = self._jars.pop(m.group(1), None)
+            if jar is None:
+                return 404, {"error": f"no program {m.group(1)!r}"}
+            try:
+                os.unlink(jar["path"])
+            except OSError:
+                pass
+            return 200, {"status": "success"}
+        return 404, {"error": "not found"}
+
     # -- routing ---------------------------------------------------------
     def _route(self, path: str, query: Optional[dict] = None) -> Optional[dict]:
         query = query or {}
@@ -186,6 +322,12 @@ class WebMonitor:
             }
         if path == "/jobs":
             return {"jobs": self.cluster.list_jobs()}
+        if path == "/jars":
+            # ref JarListHandler (upload order, not lexicographic ids)
+            with self._jar_lock:
+                files = sorted(self._jars.values(),
+                               key=lambda j: j["uploaded"])
+            return {"files": files}
         if path in ("/joboverview", "/joboverview/running",
                     "/joboverview/completed"):
             # ref CurrentJobsOverviewHandler + its running/completed splits
