@@ -43,6 +43,9 @@ ready-to-submit StreamExecutionEnvironment):
     GET    /jars                   uploaded program list
     POST   /jars/<id>/run?entry=<fn>&job-name=<n>  -> {"jobid": ...}
     DELETE /jars/<id>
+    POST   /jobs/<jid>/cancel | /jobs/<jid>/stop   (ref
+           JobCancellationHandler / JobStoppingHandler)
+    DELETE /jobs/<jid>         cancel, REST-style
 Like the reference, uploading a program means trusting it: the run
 handler executes the module. The shared-secret auth (when configured)
 gates these routes exactly like the read paths.
@@ -96,16 +99,19 @@ class WebMonitor:
                 return isinstance(got, str) and _hmac.compare_digest(
                     got, monitor._token)
 
+            def _deny(self):
+                data = json.dumps({"error": "unauthorized"}).encode()
+                self.send_response(401)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("WWW-Authenticate", "Bearer")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 if not self._authorized():
-                    data = json.dumps({"error": "unauthorized"}).encode()
-                    self.send_response(401)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("WWW-Authenticate", "Bearer")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    return
+                    return self._deny()
                 if urllib.parse.urlsplit(self.path).path in ("/web", "/web/"):
                     data = _DASHBOARD_HTML.encode()
                     self.send_response(200)
@@ -133,9 +139,12 @@ class WebMonitor:
                 self.end_headers()
                 self.wfile.write(data)
 
+            MAX_UPLOAD = 16 << 20      # program source size cap
+
             def _read_body(self):
-                """(payload, error) — drains the body even on auth
-                failure so the client gets a response, not a reset."""
+                """(payload, error). Oversized bodies are NEVER buffered
+                (413 without reading) — an unauthenticated or abusive
+                client must not be able to exhaust server memory."""
                 if "chunked" in self.headers.get(
                         "Transfer-Encoding", "").lower():
                     return None, (411, {"error": "length required"})
@@ -143,19 +152,24 @@ class WebMonitor:
                     n = int(self.headers.get("Content-Length", 0) or 0)
                 except ValueError:
                     return None, (400, {"error": "bad Content-Length"})
+                if n > self.MAX_UPLOAD:
+                    return None, (413, {"error": "body too large"})
                 return (self.rfile.read(n) if n > 0 else b""), None
 
             def do_POST(self):
-                payload, err = self._read_body()
                 if not self._authorized():
-                    self.send_response(401)
-                    data = json.dumps({"error": "unauthorized"}).encode()
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("WWW-Authenticate", "Bearer")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    return
+                    # drain a BOUNDED prefix so well-behaved clients see
+                    # the 401 instead of a reset; huge bodies get cut off
+                    # by Connection: close rather than buffered
+                    try:
+                        n = int(self.headers.get("Content-Length", 0)
+                                or 0)
+                    except ValueError:
+                        n = 0
+                    if 0 < n <= (64 << 10):
+                        self.rfile.read(n)
+                    return self._deny()
+                payload, err = self._read_body()
                 if err is not None:
                     return self._json(*err)
                 u = urllib.parse.urlsplit(self.path)
@@ -169,14 +183,7 @@ class WebMonitor:
 
             def do_DELETE(self):
                 if not self._authorized():
-                    self.send_response(401)
-                    data = json.dumps({"error": "unauthorized"}).encode()
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("WWW-Authenticate", "Bearer")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    return
+                    return self._deny()
                 u = urllib.parse.urlsplit(self.path)
                 try:
                     code, body = monitor._route_delete(u.path)
@@ -275,6 +282,17 @@ class WebMonitor:
                     "uploaded": int(_time.time() * 1000),
                 }
             return 200, {"id": jid, "status": "success"}
+        m = re.fullmatch(r"/jobs/([^/]+)/(cancel|stop)", path)
+        if m:
+            # ref JobCancellationHandler / JobStoppingHandler
+            try:
+                if m.group(2) == "cancel":
+                    self.cluster.cancel(m.group(1))
+                else:
+                    self.cluster.stop(m.group(1))
+            except KeyError:
+                return 404, {"error": f"no job {m.group(1)!r}"}
+            return 202, {"status": f"{m.group(2)}-requested"}
         m = re.fullmatch(r"/jars/([^/]+)/run", path)
         if m:
             with self._jar_lock:
@@ -284,7 +302,11 @@ class WebMonitor:
             from flink_tpu.runtime.worker import load_builder
 
             entry = query.get("entry", "build")
-            builder = load_builder(f"{jar['path']}:{entry}")
+            try:
+                builder = load_builder(f"{jar['path']}:{entry}")
+            except (FileNotFoundError, OSError):
+                # raced with DELETE /jars/<id>: the program is gone
+                return 404, {"error": f"no program {m.group(1)!r}"}
             env = builder()
             jobid = self.cluster.submit(
                 env, query.get("job-name", jar["name"])
@@ -306,6 +328,15 @@ class WebMonitor:
             except OSError:
                 pass
             return 200, {"status": "success"}
+        m = re.fullmatch(r"/jobs/([^/]+)", path)
+        if m:
+            # ref JobCancellationHandler (DELETE /jobs/:jobid and the
+            # legacy GET /jobs/:jobid/cancel both cancel)
+            try:
+                self.cluster.cancel(m.group(1))
+            except KeyError:
+                return 404, {"error": f"no job {m.group(1)!r}"}
+            return 202, {"status": "cancellation-requested"}
         return 404, {"error": "not found"}
 
     # -- routing ---------------------------------------------------------
@@ -323,10 +354,14 @@ class WebMonitor:
         if path == "/jobs":
             return {"jobs": self.cluster.list_jobs()}
         if path == "/jars":
-            # ref JarListHandler (upload order, not lexicographic ids)
+            # ref JarListHandler (upload order; server paths stay private)
             with self._jar_lock:
-                files = sorted(self._jars.values(),
-                               key=lambda j: j["uploaded"])
+                files = [
+                    {"id": j["id"], "name": j["name"],
+                     "uploaded": j["uploaded"]}
+                    for j in sorted(self._jars.values(),
+                                    key=lambda j: j["uploaded"])
+                ]
             return {"files": files}
         if path in ("/joboverview", "/joboverview/running",
                     "/joboverview/completed"):

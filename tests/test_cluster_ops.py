@@ -525,3 +525,53 @@ def test_http_submission_requires_token(tmp_path):
             req2, timeout=10).read())["status"] == "success"
     finally:
         web.stop()
+
+
+def test_http_job_cancellation():
+    """Round-5 cancel/stop REST handlers (ref JobCancellationHandler)."""
+    from flink_tpu.runtime.web import WebMonitor
+    import urllib.error
+
+    env, _ = _slow_infinite_env()
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "cancel-me")
+    try:
+        time.sleep(0.8)
+
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=b"",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("/jobs/nope/cancel")
+        assert ei.value.code == 404
+
+        code, body = post(f"/jobs/{jid}/cancel")
+        assert code == 202 and "cancel" in body["status"]
+        assert cluster.wait(jid, 60) in ("CANCELED", "FINISHED")
+    finally:
+        web.stop()
+
+
+def test_http_job_delete_cancels():
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "delete-me")
+    try:
+        time.sleep(0.8)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/jobs/{jid}", method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 202
+        assert cluster.wait(jid, 60) in ("CANCELED", "FINISHED")
+    finally:
+        web.stop()
