@@ -575,3 +575,46 @@ def test_http_job_delete_cancels():
         assert cluster.wait(jid, 60) in ("CANCELED", "FINISHED")
     finally:
         web.stop()
+
+
+def test_http_savepoint_and_vertex_metrics(tmp_path):
+    """POST /jobs/<jid>/savepoints triggers a live savepoint; per-vertex
+    metrics route serves the job snapshot with explicit attribution."""
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    jid = cluster.submit(env, "sp-http")
+    try:
+        time.sleep(1.0)
+
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=b"",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=180) as r:
+                return r.status, json.loads(r.read())
+
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(f"/jobs/{jid}/savepoints")       # missing target
+        assert ei.value.code == 400
+        code, body = post(
+            f"/jobs/{jid}/savepoints?target-directory={tmp_path}/sp")
+        assert code == 200 and body["savepoint-path"]
+        import os
+        assert os.path.isdir(body["savepoint-path"])
+
+        vx = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/jobs/{jid}/vertices",
+            timeout=10).read())["vertices"]
+        vm = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/jobs/{jid}/vertices/"
+            f"{vx[0]['id']}/metrics", timeout=10).read())
+        assert "attribution" in vm and isinstance(vm["metrics"], dict)
+    finally:
+        cluster.cancel(jid)
+        cluster.wait(jid, 30)
+        web.stop()
