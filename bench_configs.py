@@ -1,6 +1,6 @@
 """BASELINE.md configs #1/#3/#4/#5: subject vs scalar-reference baseline.
 
-Four measured rows (the north-star config #2 lives in bench.py):
+Five measured rows (the north-star config #2 lives in bench.py):
   socket_wc    SocketWindowWordCount: socket text -> split -> keyBy word ->
                5s tumbling count (ref flink-examples SocketWindowWordCount
                .java:76-79)
@@ -8,6 +8,9 @@ Four measured rows (the north-star config #2 lives in bench.py):
   sessions     event-time session windows, mergeable sum, 500ms gap
   cep          CEP pattern a -> followed_by b over a keyed stream
                (ref flink-cep NFA.java:132)
+  cep_event_time  the same pattern on an out-of-order EVENT-TIME stream
+               (round 5: host reorder buffer fronting the device NFA,
+               baseline = per-key ts-sorted host NFA)
 
 Each baseline re-implements the reference's scalar hot path in-process
 (per-record dict/NFA work — the HeapKeyedStateBackend / NFA analog, see
@@ -255,43 +258,10 @@ def run_sessions(total_events: int, cpu: bool):
 
 # ------------------------------------------------------------------ CEP
 def run_cep(total_events: int, cpu: bool):
-    from flink_tpu.cep import CEP, NFA, Pattern
+    from flink_tpu.cep import CEP
 
-    n_keys = 1000
-    rng = np.random.default_rng(3)
-    names = rng.choice(["a", "b", "x", "y"], total_events,
-                       p=[0.05, 0.05, 0.45, 0.45])
-    keyarr = rng.integers(0, n_keys, total_events)
-
-    class Ev:
-        __slots__ = ("name", "key", "i")
-
-        def __init__(self, name, key, i):
-            self.name = name
-            self.key = key
-            self.i = i
-
-    events = [Ev(str(n), int(k), i)
-              for i, (n, k) in enumerate(zip(names, keyarr))]
-
-    pattern = (
-        Pattern.begin("a").where(lambda e: e.name == "a")
-        .followed_by("b").where(lambda e: e.name == "b")
-    )
-
-    # baseline: the host NFA driven per record per key (the reference's
-    # per-event NFA.process path)
-    nfa = NFA(pattern)
-    t0 = time.perf_counter()
-    partials = {}
-    n_matches = 0
-    for e in events:
-        p = partials.get(e.key, [])
-        p, ms = nfa.process(p, e, 0)
-        partials[e.key] = p
-        n_matches += len(ms)
-    base_dt = time.perf_counter() - t0
-    baseline_eps = total_events / base_dt
+    events = _cep_events(total_events, seed=3)
+    baseline_eps, n_matches = _cep_host_baseline(events, total_events)
 
     from flink_tpu import StreamExecutionEnvironment
     from flink_tpu.core.config import Configuration
@@ -303,10 +273,98 @@ def run_cep(total_events: int, cpu: bool):
     sink = CountingSink()
     stream = env.from_collection(events).key_by(lambda e: e.key)
     t0 = time.perf_counter()
-    CEP.pattern(stream, pattern).select(lambda m: 1.0).add_sink(sink)
+    CEP.pattern(stream, _cep_pattern()).select(lambda m: 1.0).add_sink(
+        sink)
     job = env.execute("cep-bench")
     dt = time.perf_counter() - t0
     assert job.metrics.cep_device_steps > 0, "device CEP path not taken"
+    assert sink.count == n_matches, (sink.count, n_matches)
+    return total_events / dt, baseline_eps
+
+
+def _cep_events(total_events, seed, ooo=0):
+    """Shared CEP bench stream: names/keys from `seed`; ooo>0 shuffles
+    arrival order within +-ooo of timestamp order."""
+    rng = np.random.default_rng(seed)
+    names = rng.choice(["a", "b", "x", "y"], total_events,
+                       p=[0.05, 0.05, 0.45, 0.45])
+    keyarr = rng.integers(0, 1000, total_events)
+
+    class Ev:
+        __slots__ = ("name", "key", "ts")
+
+        def __init__(self, name, key, ts):
+            self.name = name
+            self.key = key
+            self.ts = ts
+
+    order = (np.argsort(np.arange(total_events)
+                        + rng.uniform(0, ooo, total_events))
+             if ooo else range(total_events))
+    return [Ev(str(names[i]), int(keyarr[i]), int(i)) for i in order]
+
+
+def _cep_pattern():
+    from flink_tpu.cep import Pattern
+
+    return (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+    )
+
+
+def _cep_host_baseline(events, total_events, ordered=False):
+    """Per-record host NFA (the reference's NFA.process path); with
+    `ordered`, per-key ts-sorted feed (the event-time operator's work)."""
+    from flink_tpu.cep import NFA
+
+    nfa = NFA(_cep_pattern())
+    feed = sorted(events, key=lambda e: e.ts) if ordered else events
+    t0 = time.perf_counter()
+    partials = {}
+    n_matches = 0
+    for e in feed:
+        p = partials.get(e.key, [])
+        p, ms = nfa.process(p, e, e.ts)
+        partials[e.key] = p
+        n_matches += len(ms)
+    return total_events / (time.perf_counter() - t0), n_matches
+
+
+def run_cep_event_time(total_events: int, cpu: bool):
+    """Event-time device CEP (round 5): the host reorder buffer fronting
+    the count-NFA kernel, measured against the per-record host NFA fed
+    the same timestamp-ordered stream."""
+    from flink_tpu.cep import CEP
+    from flink_tpu.core.time import TimeCharacteristic
+
+    events = _cep_events(total_events, seed=5, ooo=16)
+    baseline_eps, n_matches = _cep_host_baseline(
+        events, total_events, ordered=True)
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+    env = StreamExecutionEnvironment(Configuration())
+    env.set_parallelism(1)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.batch_size = 16_384
+    sink = CountingSink()
+    stream = (
+        env.from_collection(events)
+        .assign_timestamps_and_watermarks(
+            lambda e: e.ts,
+            WatermarkStrategy.for_bounded_out_of_orderness(16))
+        .key_by(lambda e: e.key)
+    )
+    t0 = time.perf_counter()
+    CEP.pattern(stream, _cep_pattern()).select(lambda m: 1.0).add_sink(
+        sink)
+    job = env.execute("cep-et-bench")
+    dt = time.perf_counter() - t0
+    assert job.metrics.cep_engine == "device", job.metrics.cep_engine
     assert sink.count == n_matches, (sink.count, n_matches)
     return total_events / dt, baseline_eps
 
@@ -316,6 +374,7 @@ CONFIGS = {
     "count_min": (run_count_min, 4_000_000),
     "sessions": (run_sessions, 4_000_000),
     "cep": (run_cep, 400_000),
+    "cep_event_time": (run_cep_event_time, 400_000),
 }
 
 
