@@ -471,11 +471,34 @@ def rule_column_pruning(root):
     return _prune(root, None)
 
 
+def rule_limit_pushdown(node):
+    """LIMIT under a NON-AGGREGATING projection: project only the
+    surviving rows (projection is row-wise and order-preserving, so the
+    same rows come out — just fewer expression evaluations). A
+    projection carrying aggregates is a global aggregation (one output
+    row from ALL inputs) and must see every row, so it is skipped; a
+    Sort between them never arises (the grammar orders LIMIT above
+    ORDER BY above the projection)."""
+    if not (isinstance(node, LLimit) and isinstance(node.input, LProject)):
+        return node, False
+    from flink_tpu.table.table import _AGGS
+
+    proj = node.input
+    for item in proj.items:
+        s, _ = stash_literals(item)
+        if re.search(r"\b(" + "|".join(_AGGS) + r")\s*\(", s,
+                     re.IGNORECASE):
+            return node, False
+    return LProject(LLimit(proj.input, node.n), proj.items,
+                    proj.schema), True
+
+
 _LOCAL_RULES = [
     ("ConstantFilter", rule_constant_filter),
     ("FilterMerge", rule_filter_merge),
     ("HavingPushdown", rule_having_pushdown),
     ("FilterPushdown", rule_filter_pushdown),
+    ("LimitPushdown", rule_limit_pushdown),
 ]
 
 

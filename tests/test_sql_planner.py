@@ -319,3 +319,25 @@ def test_having_alias_shadowing_key_not_pushed():
         map(tuple, t_raw.to_rows()))
     assert t_opt.n > 0
     assert "HavingPushdown" not in tenv.explain(q)
+
+
+def test_limit_pushes_below_projection():
+    """LIMIT under a scalar projection evaluates expressions for only
+    the surviving rows; a global-aggregate projection must see every row
+    and is never reordered."""
+    tenv = _env()
+    q = "SELECT oid, amount * 2.0 AS dbl FROM orders LIMIT 5"
+    t_opt = tenv.sql_query(q)
+    t_raw = tenv.sql_query(q, optimize=False)
+    assert t_opt.to_rows() == t_raw.to_rows() and t_opt.n == 5
+    plan = tenv.explain(q)
+    assert "LimitPushdown" in plan
+    opt = plan.split("== Optimized Logical Plan ==")[1].split("applied")[0]
+    assert opt.index("Project(") < opt.index("Limit(")   # limit below
+
+    # global aggregation: LIMIT stays above (one row FROM all inputs)
+    q2 = "SELECT SUM(amount) AS total FROM orders LIMIT 5"
+    t2 = tenv.sql_query(q2)
+    r2 = tenv.sql_query(q2, optimize=False)
+    assert t2.to_rows() == r2.to_rows() and t2.n == 1
+    assert "LimitPushdown" not in tenv.explain(q2)
