@@ -618,3 +618,31 @@ def test_http_savepoint_and_vertex_metrics(tmp_path):
         cluster.cancel(jid)
         cluster.wait(jid, 30)
         web.stop()
+
+
+def test_dashboard_html_integrity():
+    """The /web dashboard is hand-edited JS with no browser in CI: lock
+    in structural integrity — balanced delimiters, every fetched element
+    id present in the HTML, and the script's static fetch paths served
+    by the router."""
+    import re as _re
+
+    from flink_tpu.runtime.web import _DASHBOARD_HTML, WebMonitor
+
+    m = _re.search(r"<script>(.*?)</script>", _DASHBOARD_HTML, _re.S)
+    js = m.group(1)
+    for pair in ["()", "{}", "[]"]:
+        assert js.count(pair[0]) == js.count(pair[1]), pair
+    ids_used = set(_re.findall(r'getElementById\("(\w+)"\)', js))
+    ids_defined = set(_re.findall(r'id="(\w+)"', _DASHBOARD_HTML))
+    assert ids_used <= ids_defined, ids_used - ids_defined
+
+    # static fetch paths (no JS-variable segments) must resolve; the
+    # dynamic /jobs/<sel>/... paths are covered by the live-route tests
+    web = WebMonitor(MiniCluster())
+    web.start()   # stop() blocks unless serve_forever is running
+    try:
+        for path in set(_re.findall(r'J\("(/[^"]*)"\)', js)):
+            assert web._route(path) is not None, path
+    finally:
+        web.stop()
