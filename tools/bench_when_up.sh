@@ -41,6 +41,21 @@ PYEOF
     else
       echo "$(date -u +%FT%TZ) north-star run failed/outage" >&2
     fi
+    # exploration: the r5 sweep showed throughput still rising at the
+    # largest candidate (tunnel RTT amortization), so probe 2M/4M
+    # micro-batches after the official row; short runs, appended rows
+    if [ "$captured" = 1 ]; then
+      for eb in 2097152 4194304; do
+        timeout 900 python bench.py --events $((eb * 40)) \
+            --baseline-events 200000 --no-sweep --batch $eb \
+            --init-deadline 45 > /tmp/bench_explore_tpu.txt 2>&1
+        eline=$(grep -h '"metric"' /tmp/bench_explore_tpu.txt | tail -1)
+        if [ -n "$eline" ] && ! echo "$eline" | grep -q '"error"'; then
+          echo "$eline" >> BENCH_EXPLORE_${ROUND}.jsonl
+          echo "$(date -u +%FT%TZ) explore batch=$eb: $eline" >&2
+        fi
+      done
+    fi
     timeout 1800 python bench_configs.py --init-deadline 60 \
         > /tmp/bench_configs_tpu.txt 2>&1
     if grep -qh '"config"' /tmp/bench_configs_tpu.txt; then
@@ -51,7 +66,8 @@ PYEOF
     # commit any captured artifacts so a session end can't lose them
     if [ "$captured" = 1 ] || grep -qh '"config"' /tmp/bench_configs_tpu.txt 2>/dev/null; then
       for f in BENCH_${ROUND}.json BENCH_SESSION_${ROUND}.json \
-               BENCH_SESSION_${ROUND}.log BENCH_CONFIGS_${ROUND}.jsonl; do
+               BENCH_SESSION_${ROUND}.log BENCH_CONFIGS_${ROUND}.jsonl \
+               BENCH_EXPLORE_${ROUND}.jsonl; do
         [ -f "$f" ] && git add "$f"
       done
       git diff --cached --quiet || \
