@@ -48,8 +48,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from flink_tpu.connectors.partitioned import PartitionedConsumerBase
 from flink_tpu.runtime.sinks import Sink
-from flink_tpu.runtime.sources import Source
 
 _ALGO = "AWS4-HMAC-SHA256"
 MAX_HASH_KEY = 1 << 128   # partition-key space: MD5 is 128 bits
@@ -96,11 +96,19 @@ def sign_v4(method: str, path: str, headers: Dict[str, str], payload: bytes,
             f"SignedHeaders={signed_headers}, Signature={signature}")
 
 
-class ThroughputExceeded(ConnectionError):
+class KinesisApiError(Exception):
+    """A non-200 API response (validation, missing resource, rejected
+    signature, …). Deliberately NOT an OSError subclass: transport-level
+    retry handlers catch OSError, and a permanent API failure
+    masquerading as a transient transport failure would be re-buffered
+    and retried forever instead of propagating."""
+
+
+class ThroughputExceeded(KinesisApiError):
     """ProvisionedThroughputExceededException — transient, retried."""
 
 
-class PutUndelivered(ConnectionError):
+class PutUndelivered(ConnectionError):  # transport-flavored: retryable
     """A PutRecords batch could not be fully delivered; ``unsent``
     carries exactly the records NOT acknowledged so the sink re-buffers
     only those — re-buffering acknowledged records would duplicate
@@ -117,10 +125,12 @@ class KinesisClient:
     def __init__(self, host: str, port: int, region: str = "us-east-1",
                  access_key: str = "AKIDEXAMPLE",
                  secret_key: str = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, use_tls: bool = False):
         self.host, self.port, self.region = host, port, region
         self.access_key, self.secret_key = access_key, secret_key
         self.timeout_s = timeout_s
+        # genuine AWS endpoints are HTTPS-only; MiniKinesis is plain HTTP
+        self.use_tls = use_tls
         self._conn: Optional[http.client.HTTPConnection] = None
 
     def close(self):
@@ -142,8 +152,9 @@ class KinesisClient:
             self.access_key, self.secret_key, amz_date,
         )
         if self._conn is None:
-            self._conn = http.client.HTTPConnection(
-                self.host, self.port, timeout=self.timeout_s)
+            cls = (http.client.HTTPSConnection if self.use_tls
+                   else http.client.HTTPConnection)
+            self._conn = cls(self.host, self.port, timeout=self.timeout_s)
         try:
             self._conn.request("POST", "/", payload, headers)
             resp = self._conn.getresponse()
@@ -156,7 +167,7 @@ class KinesisClient:
                 "ProvisionedThroughputExceeded" in out.get("__type", ""):
             raise ThroughputExceeded(out.get("message", ""))
         if resp.status != 200:
-            raise ConnectionError(
+            raise KinesisApiError(
                 f"{action} failed: HTTP {resp.status} {out!r}")
         return out
 
@@ -183,9 +194,21 @@ class KinesisClient:
 
 
 # ---------------------------------------------------------------- source
-class KinesisSource(Source):
-    """ref FlinkKinesisConsumer: every shard consumed with per-shard
-    sequence-number state riding checkpoints.
+class KinesisSource(PartitionedConsumerBase):
+    """ref FlinkKinesisConsumer: every shard consumed with the per-shard
+    sequence-number map as checkpoint state (sequenceNumsToRestore).
+
+    Built on ``PartitionedConsumerBase`` — the repo's Kafka-consumer
+    contract: partitions are shard ids, the per-shard "offset" is the
+    last-emitted SequenceNumber string (``0`` = not started -> the
+    configured initial position). ``fetch`` is deterministic given
+    (shard, sequence): GetShardIterator AFTER_SEQUENCE_NUMBER +
+    GetRecords is exactly Kinesis's replay story, so a restored source
+    re-emits precisely the records since the checkpoint cut. The live
+    iterator cache advances only after a successful GetRecords, so a
+    mid-poll transport error or deserializer failure never skips
+    records. A closed shard (post-reshard) drains to
+    ``NextShardIterator: null`` and is marked exhausted.
 
     ``deserializer(data_bytes, partition_key) -> element`` (the
     KinesisDeserializationSchema seam); default decodes UTF-8.
@@ -194,54 +217,58 @@ class KinesisSource(Source):
     def __init__(self, host: str, port: int, stream: str,
                  deserializer: Optional[Callable[[bytes, str], Any]] = None,
                  initial_position: str = "TRIM_HORIZON",
-                 per_shard_limit: int = 1000, **client_kw):
+                 bounded: bool = False, **client_kw):
+        super().__init__()
         self.stream = stream
         self.deserializer = deserializer or (lambda b, pk: b.decode())
         self.initial_position = initial_position
-        self.per_shard_limit = per_shard_limit
+        # bounded: a shard is exhausted once caught up to the tip
+        # (GetRecords: no records, MillisBehindLatest 0) — a finite read
+        # of the current stream contents, for batch-style jobs and tests;
+        # default is the streaming behavior (open shards never exhaust)
+        self.bounded = bounded
         self._client = KinesisClient(host, port, **client_kw)
-        self._iters: Dict[str, str] = {}          # shard id -> iterator
-        self._seqs: Dict[str, Optional[str]] = {}  # shard id -> last seq
-        self._restored: Optional[Dict[str, Optional[str]]] = None
+        self._iters: Dict[str, Optional[str]] = {}  # shard -> live iter
 
-    def open(self):
-        shards = self._client.list_shards(self.stream)
-        for sh in shards:
-            sid = sh["ShardId"]
-            seq = (self._restored or {}).get(sid)
-            if seq is not None:
+    # -- PartitionedConsumerBase contract --------------------------------
+    def discover_partitions(self):
+        return [sh["ShardId"]
+                for sh in self._client.list_shards(self.stream)]
+
+    def fetch(self, shard, offset, max_records):
+        it = self._iters.get(shard)
+        if it is None:
+            if offset == 0:        # not started: the initial position
                 it = self._client.get_shard_iterator(
-                    self.stream, sid, "AFTER_SEQUENCE_NUMBER", seq)
-            else:
+                    self.stream, shard, self.initial_position)
+            else:                  # resume AFTER the checkpointed seq
                 it = self._client.get_shard_iterator(
-                    self.stream, sid, self.initial_position)
-            self._iters[sid] = it
-            self._seqs.setdefault(sid, seq)
+                    self.stream, shard, "AFTER_SEQUENCE_NUMBER",
+                    str(offset))
+        resp = self._client.get_records(it, max_records)
+        records = [
+            self.deserializer(base64.b64decode(r["Data"]),
+                              r["PartitionKey"])
+            for r in resp["Records"]
+        ]
+        # commit the advance only now: everything above either fully
+        # succeeded or left (iterator, offset) untouched for a clean retry
+        nxt = resp.get("NextShardIterator")
+        self._iters[shard] = nxt
+        new_off = (resp["Records"][-1]["SequenceNumber"]
+                   if resp["Records"] else offset)
+        caught_up = (not resp["Records"]
+                     and resp.get("MillisBehindLatest", 1) == 0)
+        exhausted = (nxt is None and not resp["Records"]) or \
+            (self.bounded and caught_up)
+        return records, new_off, exhausted
+
+    def restore_offsets(self, state):
+        super().restore_offsets(state)
+        self._iters = {}           # stale iterators don't survive a seek
 
     def close(self):
         self._client.close()
-
-    def poll(self, max_records: int) -> List[Any]:
-        out: List[Any] = []
-        per_shard = max(1, min(self.per_shard_limit,
-                               max_records // max(1, len(self._iters))))
-        for sid in list(self._iters):
-            resp = self._client.get_records(self._iters[sid], per_shard)
-            for rec in resp["Records"]:
-                out.append(self.deserializer(
-                    base64.b64decode(rec["Data"]), rec["PartitionKey"]))
-                self._seqs[sid] = rec["SequenceNumber"]
-            self._iters[sid] = resp["NextShardIterator"]
-        return out
-
-    # sequence map AS the offset state: the checkpoint cut resumes each
-    # shard AFTER its last-emitted sequence number (exactly-once replay)
-    def snapshot_offsets(self):
-        return dict(self._seqs)
-
-    def restore_offsets(self, state):
-        self._restored = dict(state or {})
-        self._seqs = dict(self._restored)
 
 
 # ---------------------------------------------------------------- sink
@@ -262,7 +289,8 @@ class KinesisSink(Sink):
                  **client_kw):
         self.stream = stream
         self.emitter = emitter
-        self.flush_max_records = min(flush_max_records, self.API_MAX_BATCH)
+        self.flush_max_records = max(
+            1, min(flush_max_records, self.API_MAX_BATCH))
         self.max_retries = max_retries
         self._client = KinesisClient(host, port, **client_kw)
         self._buf: List[dict] = []

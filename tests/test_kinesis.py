@@ -14,6 +14,7 @@ import pytest
 from flink_tpu import StreamExecutionEnvironment
 from flink_tpu.connectors.kinesis import (
     MAX_HASH_KEY,
+    KinesisApiError,
     KinesisClient,
     KinesisSink,
     KinesisSource,
@@ -87,7 +88,7 @@ def test_server_verifies_signature(mk):
     assert mk.auth_failures == 0
 
     bad = KinesisClient("127.0.0.1", mk.port, secret_key="WRONG")
-    with pytest.raises(ConnectionError):
+    with pytest.raises(KinesisApiError):
         bad.list_shards("events")
     assert mk.auth_failures == 1
     good.close()
@@ -104,9 +105,10 @@ def test_put_get_roundtrip_across_shards(mk):
 
     src = _source(mk)
     src.open()
-    got = sorted(int(v) for v in src.poll(100))
+    out, end = src.poll(100)
     src.close()
-    assert got == [i * 10 for i in range(20)]
+    assert sorted(int(v) for v in out) == [i * 10 for i in range(20)]
+    assert end is False                    # open shards never exhaust
     # records actually spread over the 3 shards by MD5 hash-key routing
     assert sum(1 for s in mk.streams["events"] if s) >= 2
 
@@ -141,9 +143,10 @@ def test_sequence_state_snapshot_restore_exactly_once(mk):
     sink.invoke_batch([(i, i) for i in range(10)])
     sink.close()
 
-    src = _source(mk, per_shard_limit=2)
+    src = _source(mk)
     src.open()
-    first = list(src.poll(6))
+    first, _ = src.poll(6)                 # ~2 records per shard
+    first = list(first)
     cut = src.snapshot_offsets()
     src.close()
 
@@ -158,7 +161,8 @@ def test_sequence_state_snapshot_restore_exactly_once(mk):
     restored.open()
     rest = []
     for _ in range(10):
-        rest.extend(restored.poll(100))
+        out, _end = restored.poll(100)
+        rest.extend(out)
     restored.close()
     assert sorted(int(v) for v in first + rest) == list(range(14))
 
@@ -170,12 +174,12 @@ def test_latest_iterator_skips_history(mk):
     sink.close()
     src = _source(mk, initial_position="LATEST")
     src.open()
-    assert src.poll(100) == []
+    assert src.poll(100)[0] == []
     sink2 = _sink(mk)
     sink2.open()
     sink2.invoke_batch([(99, 99)])
     sink2.close()
-    assert [int(v) for v in src.poll(100)] == [99]
+    assert [int(v) for v in src.poll(100)[0]] == [99]
     src.close()
 
 
@@ -186,7 +190,7 @@ def test_deserializer_seam(mk):
     sink.close()
     src = _source(mk, deserializer=lambda data, pk: (pk, data))
     src.open()
-    assert src.poll(10) == [("7", b"x")]
+    assert src.poll(10)[0] == [("7", b"x")]
     src.close()
 
 
@@ -289,7 +293,8 @@ def test_pipeline_end_to_end(mk):
     src.open()
     rows = []
     for _ in range(5):
-        rows.extend(src.poll(1000))
+        out, _end = src.poll(1000)
+        rows.extend(out)
     src.close()
     assert len(rows) == 50
     by_key = {}
@@ -297,3 +302,33 @@ def test_pipeline_end_to_end(mk):
         k, _, total = r.split(":")
         by_key[k] = by_key.get(k, 0.0) + float(total)
     assert by_key == {str(k): 200.0 for k in range(5)}
+
+
+def test_consumer_through_streaming_job(mk):
+    """Kinesis -> KinesisSource (bounded) -> keyed reduce -> sink through
+    the real executor: the Source contract (poll -> (elements, end)) is
+    exercised end-to-end, not just by direct calls."""
+    from flink_tpu.runtime.sinks import CollectSink
+
+    sink_w = _sink(mk)
+    sink_w.open()
+    sink_w.invoke_batch([(f"w{i % 6}", f"w{i % 6}") for i in range(120)])
+    sink_w.close()
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(1)
+    env.batch_size = 16
+    out = CollectSink()
+    src = _source(mk, bounded=True)
+    (
+        env.add_source(src)
+        .key_by(lambda w: w)
+        .reduce(lambda a, b: a + b, extractor=lambda w: 1.0)
+        .add_sink(out)
+    )
+    env.execute("kinesis-wordcount")
+    finals = {}
+    for key, value in out.results:
+        finals[key] = max(finals.get(key, 0), value)
+    assert finals == {f"w{j}": 20.0 for j in range(6)}
+    src.close()
