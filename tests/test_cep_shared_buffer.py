@@ -1,0 +1,215 @@
+"""The versioned shared buffer under the CEP NFA (ref flink-cep
+SharedBuffer.java:76 page/entry/edge structure, DeweyNumber.java version
+gating, SharedBuffer.extractPatterns multi-path extraction).
+
+The buffer is the match store in production position: these tests pin
+the properties the reference's structure exists for — prefix sharing,
+stale-run invisibility, converged-run dedup — plus an independent
+brute-force oracle over randomized streams."""
+
+import pickle
+import types
+
+import numpy as np
+import pytest
+
+from flink_tpu.cep.nfa import NFA, Partial
+from flink_tpu.cep.pattern import Pattern, RELAXED, STRICT
+
+
+class E:
+    def __init__(self, tag, ts):
+        self.tag, self.ts = tag, ts
+
+    def __repr__(self):
+        return f"E({self.tag}@{self.ts})"
+
+
+def run(nfa, events):
+    partials, out = nfa.initial_state(), []
+    for e in events:
+        partials, ms = nfa.process(partials, e, e.ts)
+        out.extend(ms)
+    return partials, out
+
+
+def tags(match):
+    return tuple(ev.tag for ev in match.values())
+
+
+# ---------------------------------------------------------------- sharing
+def test_shared_event_is_one_entry():
+    """Two runs taking the same 'b' event share ONE buffer node with one
+    back edge per run (the per-(state, event) page of SharedBuffer)."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .followed_by("b").where(lambda e: e.tag == "b")
+         .followed_by("c").where(lambda e: e.tag == "c"))
+    nfa = NFA(p)
+    partials, _ = run(nfa, [E("a1", 0), E("a2", 1), E("b", 2)])
+    at_b = [q for q in partials if q.stage_idx == 1]
+    assert len(at_b) == 2
+    assert at_b[0].ptr is at_b[1].ptr          # one shared Entry object
+    assert len(at_b[0].ptr.edges) == 2         # one edge per run
+    assert at_b[0].version != at_b[1].version  # distinct run stamps
+
+
+def test_pickle_preserves_sharing():
+    """Checkpointing a key's partials keeps the prefix compression:
+    pickle memoizes the shared Entry, so the snapshot stores it once."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .followed_by("b").where(lambda e: e.tag == "b")
+         .followed_by("c").where(lambda e: e.tag == "c"))
+    partials, _ = run(NFA(p), [E("a1", 0), E("a2", 1), E("b", 2)])
+    restored = pickle.loads(pickle.dumps(partials))
+    at_b = [q for q in restored if q.stage_idx == 1]
+    assert at_b[0].ptr is at_b[1].ptr
+
+
+def test_entry_count_is_events_not_paths():
+    """N runs through one (b, c) suffix store N+2 entries, not 3N event
+    slots — the memory claim of the shared design."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .followed_by("b").where(lambda e: e.tag == "b")
+         .followed_by("c").where(lambda e: e.tag == "c")
+         .followed_by("d").where(lambda e: e.tag == "d"))
+    n = 16
+    events = [E(f"a{i}", i) for i in range(n)] + [E("b", 50), E("c", 51)]
+    partials, _ = run(NFA(p), events)
+    seen, stack = set(), [q.ptr for q in partials]
+    while stack:
+        ent = stack.pop()
+        if id(ent) in seen:
+            continue
+        seen.add(id(ent))
+        stack.extend(pr for pr, _v in ent.edges if pr is not None)
+    assert len(seen) == n + 2
+
+
+# ---------------------------------------------------------------- versions
+def test_expired_run_edges_invisible_to_live_run():
+    """THE version-gating case (DeweyNumber's job): an expired run and a
+    live run share the 'b' entry; extraction at 'c' must see only the
+    live run's back edge, or the expired (a1, b, c) would wrongly emit."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .followed_by("b").where(lambda e: e.tag == "b")
+         .followed_by("c").where(lambda e: e.tag == "c")
+         .within(10))
+    nfa = NFA(p)
+    #  a1@0  a2@6  b@7  c@12: run(a1) is expired at c (12-0 > 10) but its
+    #  edge into the shared b entry still exists
+    partials, _ = run(nfa, [E("a1", 0), E("a2", 6), E("b", 7)])
+    at_b = [q for q in partials if q.stage_idx == 1]
+    assert len({id(q.ptr) for q in at_b}) == 1     # genuinely shared
+    partials, out = nfa.process(partials, E("c", 12), 12)
+    assert [tags(m) for m in out] == [("a2", "b", "c")]
+
+
+def test_dead_run_number_reuse_is_safe():
+    """A new run may reuse a dead run's version number; its chain can
+    never reach the dead run's entries, so extraction stays exact."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .next("b").where(lambda e: e.tag == "b"))
+    nfa = NFA(p)
+    partials, out = run(nfa, [
+        E("a1", 0), E("x", 1),      # strict miss kills run 0
+        E("a2", 2), E("b", 3),      # new run also numbered 0 completes
+    ])
+    assert [tags(m) for m in out] == [("a2", "b")]
+    assert partials == []
+
+
+# ---------------------------------------------------------------- dedup
+def test_converged_runs_dedupe_then_extract_all_paths():
+    """Two branches of one run converge on the same (stage, entry,
+    version): ONE computation state remains, and the single completion
+    extracts BOTH paths exactly once (SharedBuffer.extractPatterns)."""
+    p = (Pattern.begin("a").where(lambda e: e.tag == "a")
+         .followed_by("b").where(lambda e: e.tag.startswith("b"))
+         .followed_by("c").where(lambda e: e.tag == "c")
+         .followed_by("d").where(lambda e: e.tag == "d"))
+    nfa = NFA(p)
+    partials, out = run(nfa, [
+        E("a", 0), E("b1", 1), E("b2", 2), E("c", 3),
+    ])
+    at_c = [q for q in partials if q.stage_idx == 2]
+    assert len(at_c) == 1                          # converged + deduped
+    assert len(at_c[0].ptr.edges) == 2             # both paths retained
+    partials, out = nfa.process(partials, E("d", 4), 4)
+    assert sorted(tags(m) for m in out) == [
+        ("a", "b1", "c", "d"), ("a", "b2", "c", "d"),
+    ]
+
+
+def test_sibling_completions_do_not_cross_emit():
+    """Two runs completing on the same final event each walk only their
+    own just-laid edge — no duplicate or crossed extraction."""
+    p = (Pattern.begin("a").where(lambda e: e.tag.startswith("a"))
+         .followed_by("b").where(lambda e: e.tag == "b"))
+    nfa = NFA(p)
+    _, out = run(nfa, [E("a1", 0), E("a2", 1), E("b", 2)])
+    assert sorted(tags(m) for m in out) == [("a1", "b"), ("a2", "b")]
+
+
+# ---------------------------------------------------------------- legacy
+def test_legacy_event_tuple_partials_upgrade():
+    """Pre-shared-buffer checkpoints stored full event tuples; they
+    continue as unshared chains after restore."""
+    p = (Pattern.begin("a").where(lambda e: e.tag == "a")
+         .followed_by("b").where(lambda e: e.tag == "b"))
+    nfa = NFA(p)
+    a = E("a", 0)
+    legacy = types.SimpleNamespace(stage_idx=0, events=(a,), start_ts=0)
+    partials, out = nfa.process([legacy], E("b", 1), 1)
+    assert [tags(m) for m in out] == [("a", "b")]
+
+
+# ---------------------------------------------------------------- oracle
+def _oracle(pattern, events):
+    """Independent brute force: every index sequence satisfying the
+    stage predicates, contiguity (strict = adjacent), and within bound."""
+    stages = pattern.stages
+    out = []
+
+    def extend(seq, last_idx):
+        k = len(seq)
+        if k == len(stages):
+            out.append(tuple(events[i] for i in seq))
+            return
+        start = last_idx + 1
+        end = last_idx + 2 if (k and stages[k].contiguity == STRICT) \
+            else len(events)
+        for i in range(start, min(end, len(events))):
+            if not stages[k].matches(events[i]):
+                continue
+            if k and pattern.within_ms is not None and \
+                    events[i].ts - events[seq[0]].ts > pattern.within_ms:
+                continue
+            extend(seq + [i], i)
+
+    extend([], -1)
+    return sorted(tuple(e.tag for e in seq) for seq in out)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_equivalence_vs_brute_force(seed):
+    rng = np.random.default_rng(seed)
+    pats = {
+        "fb": (Pattern.begin("s0").where(lambda e: e.tag == "a")
+               .followed_by("s1").where(lambda e: e.tag == "b")
+               .followed_by("s2").where(lambda e: e.tag == "c")),
+        "strict": (Pattern.begin("s0").where(lambda e: e.tag == "a")
+                   .next("s1").where(lambda e: e.tag == "b")
+                   .followed_by("s2").where(lambda e: e.tag == "c")),
+        "within": (Pattern.begin("s0").where(lambda e: e.tag == "a")
+                   .followed_by("s1").where(lambda e: e.tag == "b")
+                   .followed_by("s2").where(lambda e: e.tag == "c")
+                   .within(6)),
+    }
+    pat = pats[["fb", "strict", "within"][seed % 3]]
+    n = int(rng.integers(10, 26))
+    events = [
+        E(str(rng.choice(["a", "b", "c", "x"])), int(t))
+        for t in np.sort(rng.integers(0, 20, n))
+    ]
+    _, got = run(NFA(pat), events)
+    assert sorted(tags(m) for m in got) == _oracle(pat, events)
