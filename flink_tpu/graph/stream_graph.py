@@ -117,11 +117,17 @@ class IterateTransformation(Transformation):
 @dataclass
 class PartitionTransformation(Transformation):
     """Explicit exchange annotation (ref Rebalance/Rescale/Shuffle/Broadcast/
-    Global/ForwardPartitioner, SURVEY §2.5). On this architecture the only
-    physical exchange is the keyed all_to_all inside the compiled SPMD step;
-    non-keyed repartitioning of the host micro-batch stream is a no-op (a
-    single host loop feeds the whole mesh), so these nodes are recorded for
-    graph fidelity and skipped at translation."""
+    Global/ForwardPartitioner, SURVEY §2.5). On this architecture the
+    keyed all_to_all inside the compiled SPMD step is the main physical
+    exchange. Single-host, non-keyed repartitioning of the host
+    micro-batch stream is a no-op (one host loop feeds the whole mesh)
+    and the annotation is recorded for graph fidelity. On the MULTI-HOST
+    path (dcn.coordinator configured), rebalance/shuffle/global are
+    PHYSICAL at the ingestion edge: rebalance borrows ring-neighbor
+    backlog into spare lanes, shuffle routes every record to a uniformly
+    random host via the targeted ring, global routes everything to host
+    0 (runtime/dcn.py _RebalanceRing/_TargetRing; executor._run_dcn
+    reads the annotation). rescale stays host-local by definition."""
 
     mode: str = "rebalance"  # rebalance|rescale|shuffle|broadcast|global|forward
 

@@ -98,6 +98,20 @@ class DCNJobSpec:
     # over the network, exactly like the reference's rebalance edge.
     rebalance: bool = False
     rebalance_addrs: Optional[list] = None   # "host:port" per process-id
+    # host-level ingest partitioner (ref StreamPartitioner catalog,
+    # SURVEY §2.11): "forward" (records process on the host whose
+    # partition holds them), "rebalance" (deficit-driven neighbor
+    # borrowing, equivalent to rebalance=True), "shuffle" (every record
+    # routed to a uniformly random host via the targeted ring — the
+    # ShufflePartitioner, with per-cycle balanced assignment so no
+    # host's lane budget overflows), "global" (every record routed to
+    # host 0 — the GlobalPartitioner, whose single-subtask bottleneck
+    # cost becomes visible as host-0-bound cycle counts). "rescale" is
+    # accepted as an alias of "forward": the reference's rescale keeps
+    # records within the local TaskManager group, which is exactly what
+    # forward ingestion does here. shuffle/global use the same
+    # rebalance_addrs side channel.
+    ingest_partitioner: str = "forward"
 
 
 class GeneratorPartitionSource:
@@ -245,6 +259,77 @@ class _RebalanceRing:
                 pass
 
 
+class _TargetRing(_RebalanceRing):
+    """Targeted ring router for the shuffle/global ingest partitioners
+    (ref ShufflePartitioner.java / GlobalPartitioner.java): each cycle,
+    every host stamps its polled records with a destination host and the
+    ring relays frames ``nproc - 1`` hops (records flow p+1 -> p, the
+    donation direction the sockets already run), so every record sits at
+    its destination before the cycle's device step. Routing completes
+    WITHIN the cycle — no cross-cycle in-flight records — so the
+    cycle-boundary checkpoint cut stays a consistent exactly-once
+    barrier without any new snapshot state.
+
+    Termination: every frame carries the sender's accumulated
+    all-sources-exhausted flag; after ``nproc - 1`` hops the AND covers
+    the whole ring, and a host is done once that holds and it ingested
+    nothing this cycle (the device-side stop conjunction still gates the
+    ensemble, as for forward ingestion).
+
+    Frames are (count, done, targets u8[n], keys i64[n], ts i64[n],
+    vals f32[n]); the caller bounds per-cycle polls so the merged inflow
+    never exceeds the lane budget (see _DCNRunnerBase._poll_budget).
+    """
+
+    def route(self, keys, ts_ms, vals, targets, exhausted: bool):
+        """Returns (keys, ts_ms, vals, all_done) of the records whose
+        destination is this host."""
+        st = self.struct
+        mine_k, mine_t, mine_v = [], [], []
+
+        def split(k, t, v, tgt):
+            here = tgt == self.pid
+            if here.any():
+                mine_k.append(k[here])
+                mine_t.append(t[here])
+                mine_v.append(v[here])
+            away = ~here
+            return k[away], t[away], v[away], tgt[away]
+
+        pk, pt, pv, ptgt = split(
+            np.asarray(keys, np.int64), np.asarray(ts_ms, np.int64),
+            np.asarray(vals, np.float32), np.asarray(targets, np.uint8),
+        )
+        all_done = bool(exhausted)
+        for _hop in range(self.nproc - 1):
+            n = len(pk)
+            self.prev_sock.sendall(
+                st.pack(self._HDR, n, 1 if all_done else 0)
+                + ptgt.tobytes() + pk.tobytes() + pt.tobytes()
+                + pv.tobytes()
+            )
+            hdr = self._recv_exact(self.next_sock,
+                                   st.calcsize(self._HDR))
+            m, done_flag = st.unpack(self._HDR, hdr)
+            payload = self._recv_exact(self.next_sock, m * (1 + 8 + 8 + 4))
+            rtgt = np.frombuffer(payload[:m], np.uint8)
+            rk = np.frombuffer(payload[m: m + 8 * m], np.int64)
+            rt = np.frombuffer(payload[m + 8 * m: m + 16 * m], np.int64)
+            rv = np.frombuffer(payload[m + 16 * m:], np.float32)
+            all_done = all_done and bool(done_flag)
+            pk, pt, pv, ptgt = split(rk, rt, rv, rtgt)
+        if len(pk):
+            raise RuntimeError(
+                f"{len(pk)} record(s) undeliverable after "
+                f"{self.nproc - 1} ring hops (bad target?)"
+            )
+        if mine_k:
+            return (np.concatenate(mine_k), np.concatenate(mine_t),
+                    np.concatenate(mine_v), all_done)
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                np.zeros(0, np.float32), all_done)
+
+
 class _DCNRunnerBase:
     """One process's half of a lockstep multi-host keyed job: global-mesh
     setup, the ingest/step/emit loop, and checkpoint/restore. Subclasses
@@ -287,11 +372,25 @@ class _DCNRunnerBase:
         self.ctx = MeshContext.create(self.n, spec.max_parallelism)
         # per-host lane budget, one equal slice per local device
         self.B_local = max(self.L, (spec.batch_per_host // self.L) * self.L)
-        self._ring = (
-            _RebalanceRing(process_id, num_processes,
-                           spec.rebalance_addrs)
-            if spec.rebalance and num_processes > 1 else None
-        )
+        mode = spec.ingest_partitioner
+        if spec.rebalance:
+            mode = "rebalance"
+        if mode in ("forward", "rescale") or num_processes == 1:
+            self._ring, self._router = None, None
+        elif mode == "rebalance":
+            self._ring = _RebalanceRing(process_id, num_processes,
+                                        spec.rebalance_addrs)
+            self._router = None
+        elif mode in ("shuffle", "global"):
+            self._ring = None
+            self._router = _TargetRing(process_id, num_processes,
+                                       spec.rebalance_addrs)
+        else:
+            raise ValueError(
+                f"unknown ingest_partitioner {mode!r} (forward | rescale "
+                f"| rebalance | shuffle | global)")
+        self._mode = mode
+        self.ingested_local = 0   # records this host's lanes carried
         self._build_step()
         self._init_state()
 
@@ -318,21 +417,75 @@ class _DCNRunnerBase:
             self._lane_sharding, local
         )
 
+    # -- ingest partitioning ----------------------------------------------
+    def _poll_budget(self) -> int:
+        """Per-cycle source poll bound. Routed modes bound the MERGED
+        inflow by the lane budget: global concentrates every host's poll
+        on host 0 (sum of polls <= B), shuffle's balanced per-donor split
+        hands each receiver at most ceil(poll/nproc) per donor (sum <= B
+        after the nproc safety margin). A frame must also fit the ring
+        sockets' buffers so sendall can't deadlock the lockstep."""
+        B = self.B_local
+        if self._router is None:
+            return B
+        frame_cap = _RebalanceRing._SOCKBUF // 32   # ~21 B/record + slack
+        if self._mode == "global":
+            return max(1, min(B // self.nproc, frame_cap))
+        return max(1, min(B - self.nproc, frame_cap))
+
+    def _targets(self, n: int) -> np.ndarray:
+        """Destination host per polled record. shuffle: a balanced random
+        assignment — each record's destination is uniform, each cycle's
+        per-donor counts are equal to within one, so lane budgets hold
+        (the reference's ShufflePartitioner draws per record and relies
+        on elastic buffers; fixed lane budgets need the balance).
+        global: everything to host 0 (GlobalPartitioner.java)."""
+        if self._mode == "global":
+            return np.zeros(n, np.uint8)
+        # modulo in int64: uint8 arange wraps at 256, which would skew
+        # the per-target counts past the lane-budget margin for any
+        # nproc that doesn't divide 256
+        base = (np.arange(n, dtype=np.int64) % self.nproc).astype(np.uint8)
+        rng = np.random.default_rng((self.pid, self.cycle))
+        return rng.permutation(base)
+
     # -- host loop ---------------------------------------------------------
     def run(self) -> dict:
         from flink_tpu.ops.hashing import key_identity64
 
         spec = self.spec
         B = self.B_local
+        poll_budget = self._poll_budget()
         exhausted = False
         while True:
             if not exhausted:
-                keys, ts_ms, vals, exhausted = self.source.poll(B)
+                keys, ts_ms, vals, exhausted = self.source.poll(poll_budget)
             else:
                 keys = np.zeros(0, np.int64)
                 ts_ms = np.zeros(0, np.int64)
                 vals = np.zeros(0, np.float32)
             done_now = exhausted
+            if self._router is not None:
+                # targeted routing (shuffle/global): stamp destinations,
+                # relay around the ring, ingest what lands here. The
+                # per-host watermark advances from the SOURCE's (pre-
+                # route) timestamps: the routed mix contains other
+                # hosts' later timestamps, and a watermark read off the
+                # merged batch would push the global pmin past records a
+                # slower source hasn't polled yet (late-dropping them).
+                # Source-side watermarks keep pmin = the true low mark.
+                if len(ts_ms):
+                    rel_max = int(np.asarray(ts_ms, np.int64).max()) \
+                        - spec.origin_ms
+                    self.local_wm_ticks = min(max(
+                        self.local_wm_ticks,
+                        rel_max - spec.out_of_orderness_ms - 1,
+                    ), MAX_TICKS)
+                keys, ts_ms, vals, all_done = self._router.route(
+                    keys, ts_ms, vals,
+                    self._targets(len(keys)), exhausted,
+                )
+                done_now = all_done and len(keys) == 0
             if self._ring is not None:
                 # physical rebalance: offer spare lanes to the ring
                 # neighbor's backlog, serve the other neighbor's request
@@ -347,6 +500,7 @@ class _DCNRunnerBase:
                 # keep cycling while the donor neighbor still has records
                 done_now = exhausted and donor_done and not len(rk)
             m = len(keys)
+            self.ingested_local += m
             h = key_identity64(keys) if m else np.zeros(0, np.uint64)
             hi = np.zeros(B, np.uint32)
             lo = np.zeros(B, np.uint32)
@@ -371,7 +525,8 @@ class _DCNRunnerBase:
             values[:m] = vals
             valid = np.zeros(B, bool)
             valid[:m] = True
-            if m:
+            if m and self._router is None:
+                # routed modes advanced the watermark pre-route (above)
                 self.local_wm_ticks = min(max(
                     self.local_wm_ticks,
                     int(rts.max()) - spec.out_of_orderness_ms - 1,
@@ -398,6 +553,8 @@ class _DCNRunnerBase:
                 break
         if self._ring is not None:
             self._ring.close()
+        if self._router is not None:
+            self._router.close()
         return {
             "key_id": (np.concatenate(self.rows_key)
                        if self.rows_key else np.zeros(0, np.uint64)),
@@ -409,6 +566,7 @@ class _DCNRunnerBase:
             "value": (np.concatenate(self.rows_val)
                       if self.rows_val else np.zeros(0, np.float32)),
             "cycles": self.cycle,
+            "ingested_local": self.ingested_local,
         }
 
     # -- checkpoint / restore ---------------------------------------------
@@ -879,7 +1037,8 @@ def main(argv=None) -> int:
                  window_end_ms=out["window_end_ms"], value=out["value"])
     os.replace(tmp, a.out)
     print(json.dumps({"rows": int(len(out["key_id"])),
-                      "cycles": out["cycles"], "pid": a.process_id}),
+                      "cycles": out["cycles"], "pid": a.process_id,
+                      "ingested_local": int(out["ingested_local"])}),
           flush=True)
     return 0
 

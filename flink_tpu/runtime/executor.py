@@ -325,6 +325,9 @@ class JobMetrics:
     dropped_late: int = 0
     dropped_capacity: int = 0
     restarts: int = 0
+    # DCN path: records THIS host's lanes carried (post ingest
+    # partitioning — shows rebalance/shuffle/global routing physically)
+    dcn_ingested_local: int = 0
     wall_time_s: float = 0.0
     # CEP: which engine actually ran ("device" | "host"; VERDICT r3 —
     # a user must be able to tell without diffing step counters), plus
@@ -404,6 +407,11 @@ class _Pipeline:
     # (the role of the reference's Output broadcasting to multiple edges)
     branches: List[Any]
     process: Optional[sg.ProcessTransformation] = None
+    # explicit exchange annotation upstream of key_by (rebalance /
+    # shuffle / global / rescale / forward); physical on the DCN path's
+    # ingestion edge, a recorded no-op single-host (see
+    # PartitionTransformation)
+    ingest_partition: Optional[str] = None
 
     @property
     def all_sinks(self):
@@ -552,7 +560,8 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
         elif isinstance(t, sg.OneInputTransformation):
             pipe.pre_chain.append(t)
         elif isinstance(t, sg.PartitionTransformation):
-            pass
+            if t.mode not in ("broadcast", "forward"):
+                pipe.ingest_partition = t.mode
         else:
             raise NotImplementedError(f"transformation {type(t).__name__}")
     if pipe.source is None:
@@ -897,6 +906,24 @@ class LocalExecutor:
             ),
             origin_ms=env.config.get_int("dcn.origin-ms", 0),
         )
+        # physical ingest partitioner: the API annotation (.shuffle(),
+        # .global_(), .rebalance(), .rescale() before key_by) wins, the
+        # dcn.ingest-partitioner config is the fallback; the ring/router
+        # side channel gets one host:port per process from
+        # dcn.rebalance-addrs
+        part = pipe.ingest_partition or env.config.get_str(
+            "dcn.ingest-partitioner", "forward")
+        if part == "rebalance":
+            spec_kw.update(rebalance=True)
+        elif part != "forward":
+            spec_kw.update(ingest_partitioner=part)
+        if part not in ("forward", "rescale") and nproc > 1:
+            addrs = env.config.get_str("dcn.rebalance-addrs", "")
+            if not addrs:
+                raise ValueError(
+                    f"ingest partitioner {part!r} needs "
+                    f"dcn.rebalance-addrs (one host:port per process)")
+            spec_kw.update(rebalance_addrs=addrs.split(","))
         if getattr(assigner, "is_session", False):
             if not assigner.is_event_time:
                 raise NotImplementedError(
@@ -1009,6 +1036,7 @@ class LocalExecutor:
         )
         out = runner.run()
         metrics.steps = out["cycles"]
+        metrics.dcn_ingested_local = int(out.get("ingested_local", 0))
         is_session = spec_kw.get("window_kind") == "session"
         rows = []
         for k64, st_, en_, v in zip(

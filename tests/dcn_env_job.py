@@ -25,6 +25,9 @@ def main():
     ap.add_argument("--process-id", type=int, required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--session", action="store_true")
+    ap.add_argument("--shuffle", action="store_true",
+                    help="apply .shuffle() before key_by over the "
+                         "skewed source (physical ingest shuffle)")
     a = ap.parse_args()
 
     import jax
@@ -42,11 +45,15 @@ def main():
     from flink_tpu.runtime.sinks import CollectSink
     from flink_tpu.runtime.sources import GeneratorSource
 
-    env = StreamExecutionEnvironment(Configuration({
+    conf = {
         "dcn.coordinator": a.coordinator,
         "dcn.num-processes": a.num_processes,
         "dcn.process-id": a.process_id,
-    }))
+    }
+    if a.shuffle:
+        conf["dcn.rebalance-addrs"] = \
+            os.environ["FLINK_TPU_TEST_REBALANCE_ADDRS"]
+    env = StreamExecutionEnvironment(Configuration(conf))
     env.set_max_parallelism(64)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     env.set_state_capacity(2048)
@@ -54,9 +61,9 @@ def main():
 
     # THIS process's partition: the dcn_jobs source sliced by process id
     # (the raw deterministic fetch fn, so offset replay stays exact)
-    part = (J._session_source if a.session else J._source)(
-        a.process_id, a.num_processes
-    )
+    src_fn = (J._session_source if a.session
+              else J._skewed_source if a.shuffle else J._source)
+    part = src_fn(a.process_id, a.num_processes)
 
     def gen(offset, n):
         keys, ts, vals = part.fn(offset, n)
@@ -66,20 +73,25 @@ def main():
             np.asarray(ts, np.int64),
         )
 
-    total = J.SESSION_TOTAL if a.session else J.TOTAL_PER_HOST
+    total = (J.SESSION_TOTAL if a.session
+             else part.total if a.shuffle else J.TOTAL_PER_HOST)
     sink = CollectSink()
     assigner = (
         EventTimeSessionWindows.with_gap(J.GAP_MS) if a.session
-        else SlidingEventTimeWindows.of(J.WIN_MS, J.SLIDE_MS)
+        else SlidingEventTimeWindows.of(
+            J.WIN_MS, J.WIN_MS if a.shuffle else J.SLIDE_MS)
     )
+    stream = env.add_source(GeneratorSource(gen, total=total))
+    if a.shuffle:
+        stream = stream.shuffle()      # the API annotation under test
     (
-        env.add_source(GeneratorSource(gen, total=total))
+        stream
         .key_by(lambda c: c["key"])
         .window(assigner)
         .sum(lambda c: c["value"])
         .add_sink(sink)
     )
-    env.execute("dcn-env-job")
+    job = env.execute("dcn-env-job")
 
     if a.session:
         key = np.asarray([r.key for r in sink.results], np.int64)
@@ -97,7 +109,8 @@ def main():
         np.savez(f, key_id=key, window_start_ms=start, window_end_ms=end,
                  value=val)
     os.replace(tmp, a.out)
-    print(f"rows={len(key)} pid={a.process_id}", flush=True)
+    print(f"rows={len(key)} pid={a.process_id} "
+          f"ingested={job.metrics.dcn_ingested_local}", flush=True)
     return 0
 
 

@@ -204,3 +204,28 @@ def expected_skewed():
         w = ((i // TS_DIV) // WIN_MS + 1) * WIN_MS
         exp[(k, w)] = exp.get((k, w), 0) + 1.0
     return exp
+
+
+def skewed_window_shuffled():
+    """shuffle ingest partitioner over the same 90/10 skew: the targeted
+    ring routes every record to a uniformly random host, restoring lane
+    utilization like rebalance does (ref ShufflePartitioner.java)."""
+    import os
+
+    spec = skewed_window(None)
+    spec.ingest_partitioner = "shuffle"
+    spec.rebalance_addrs = \
+        os.environ["FLINK_TPU_TEST_REBALANCE_ADDRS"].split(",")
+    return spec
+
+
+def skewed_window_global():
+    """global ingest partitioner: every record routed to host 0 (ref
+    GlobalPartitioner.java) — results stay exact, host 1's lanes idle."""
+    import os
+
+    spec = skewed_window(None)
+    spec.ingest_partitioner = "global"
+    spec.rebalance_addrs = \
+        os.environ["FLINK_TPU_TEST_REBALANCE_ADDRS"].split(",")
+    return spec

@@ -50,13 +50,17 @@ def _spawn_dcn(pid, coord, out, builder, extra=()):
     )
 
 
-def _spawn_env_job(pid, coord, out, session):
+def _spawn_env_job(pid, coord, out, session, shuffle=False,
+                   extra_env=None):
+    env = _env_for(pid)
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tests", "dcn_env_job.py"),
          "--coordinator", coord, "--num-processes", str(NPROC),
          "--process-id", str(pid), "--out", out,
-         *(["--session"] if session else [])],
-        env=_env_for(pid), stdout=subprocess.PIPE,
+         *(["--session"] if session else []),
+         *(["--shuffle"] if shuffle else [])],
+        env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
     )
 
@@ -188,6 +192,20 @@ def test_env_execute_selects_dcn_sliding(tmp_path):
     assert crossed > len(got) // 4
 
 
+
+
+def _collect_rows(outs):
+    """Merge per-process npz emissions, asserting cross-host dedup."""
+    got = {}
+    for path in outs:
+        data = np.load(path)
+        for k64, e, v in zip(data["key_id"], data["window_end_ms"],
+                             data["value"]):
+            key = (int(k64), int(e))
+            assert key not in got, f"duplicate {key}"
+            got[key] = float(v)
+    return got
+
 def _run_skew(tmp_path, tag, builder, extra_env=None):
     coord = f"127.0.0.1:{_free_port()}"
     outs = [str(tmp_path / f"{tag}-{p}.npz") for p in range(NPROC)]
@@ -206,21 +224,15 @@ def _run_skew(tmp_path, tag, builder, extra_env=None):
     logs = _wait_all(procs)
     import json as _json
 
-    cycles = None
+    cycles, stats = None, {}
     for p, log in zip(procs, logs):
         assert p.returncode == 0, log[-2000:]
         for line in log.splitlines():
             if line.startswith("{"):
-                cycles = _json.loads(line)["cycles"]
-    got = {}
-    for path in outs:
-        data = np.load(path)
-        for k64, e, v in zip(data["key_id"], data["window_end_ms"],
-                             data["value"]):
-            key = (int(k64), int(e))
-            assert key not in got, f"duplicate {key}"
-            got[key] = float(v)
-    return got, cycles
+                row = _json.loads(line)
+                cycles = row["cycles"]
+                stats[row["pid"]] = row
+    return _collect_rows(outs), cycles, stats
 
 
 def test_rebalance_restores_throughput_on_skewed_hosts(tmp_path):
@@ -230,10 +242,10 @@ def test_rebalance_restores_throughput_on_skewed_hosts(tmp_path):
     backlog and the cycle count drops to ~total/(nproc*B) — throughput
     parity with a balanced assignment. Results exact either way (ref
     RebalancePartitioner.java:30)."""
-    got_plain, cyc_plain = _run_skew(
+    got_plain, cyc_plain, _ = _run_skew(
         tmp_path, "plain", "skewed_window_plain")
     addrs = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
-    got_reb, cyc_reb = _run_skew(
+    got_reb, cyc_reb, _ = _run_skew(
         tmp_path, "reb", "skewed_window_rebalanced",
         {"FLINK_TPU_TEST_REBALANCE_ADDRS": addrs})
     exp = J.expected_skewed()
@@ -243,3 +255,73 @@ def test_rebalance_restores_throughput_on_skewed_hosts(tmp_path):
     # count (0.9 -> ~0.5 of the skewed run's cycles; allow slack for
     # flush/fire cycles)
     assert cyc_reb < 0.7 * cyc_plain, (cyc_reb, cyc_plain)
+
+
+def test_shuffle_partitioner_balances_skewed_hosts(tmp_path):
+    """Physical shuffle (ref ShufflePartitioner.java): the targeted ring
+    routes every record to a uniformly random host, so even a 90/10
+    partition skew leaves BOTH hosts' lanes carrying an equal share of
+    the downstream work — the partitioner's decorrelation contract.
+    (Shuffle does NOT drain a skewed SOURCE faster: each host still
+    polls its own partition at most one budget per cycle; dynamic
+    source borrowing is rebalance's job. The reference's shuffle
+    likewise balances downstream subtasks, not upstream production.)
+    Results stay exact and cycle count doesn't regress."""
+    got_plain, cyc_plain, _ = _run_skew(
+        tmp_path, "plain-s", "skewed_window_plain")
+    addrs = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    got_shuf, cyc_shuf, stats = _run_skew(
+        tmp_path, "shuf", "skewed_window_shuffled",
+        {"FLINK_TPU_TEST_REBALANCE_ADDRS": addrs})
+    exp = J.expected_skewed()
+    assert got_plain == exp
+    assert got_shuf == exp
+    assert cyc_shuf <= cyc_plain + 3, (cyc_shuf, cyc_plain)
+    # uniform routing: each host ingested ~total/nproc despite the
+    # 90/10 partition assignment (vs 54000/6000 unshuffled)
+    ing = [stats[p]["ingested_local"] for p in range(NPROC)]
+    assert sum(ing) == J.SKEW_TOTAL
+    share = [x / sum(ing) for x in ing]
+    assert all(abs(f - 1 / NPROC) < 0.05 for f in share), share
+
+
+def test_global_partitioner_routes_everything_to_host0(tmp_path):
+    """Physical global (ref GlobalPartitioner.java): every record lands
+    on host 0\'s lanes; results stay exact and host 1 ingests nothing —
+    the single-subtask semantics, with its bottleneck cost visible."""
+    addrs = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    got, _cyc, stats = _run_skew(
+        tmp_path, "glob", "skewed_window_global",
+        {"FLINK_TPU_TEST_REBALANCE_ADDRS": addrs})
+    assert got == J.expected_skewed()
+    assert stats[0]["ingested_local"] == sum(
+        stats[p]["ingested_local"] for p in range(NPROC))
+    assert stats[1]["ingested_local"] == 0
+
+
+def test_env_execute_shuffle_annotation_is_physical(tmp_path):
+    """`.shuffle()` before key_by on the STANDARD env.execute() path
+    engages the targeted ring over the DCN plane: the 90/10-skewed
+    ingest lands near-uniformly on both hosts' lanes, results exact
+    (ref ShufflePartitioner.java routed through the API annotation)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    addrs = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"se-{p}.npz") for p in range(NPROC)]
+    procs = [
+        _spawn_env_job(p, coord, outs[p], session=False, shuffle=True,
+                       extra_env={"FLINK_TPU_TEST_REBALANCE_ADDRS": addrs})
+        for p in range(NPROC)
+    ]
+    logs = _wait_all(procs)
+    ing = {}
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+        for line in log.splitlines():
+            if line.startswith("rows="):
+                parts = dict(kv.split("=") for kv in line.split())
+                ing[int(parts["pid"])] = int(parts["ingested"])
+    got = _collect_rows(outs)
+    assert got == J.expected_skewed()
+    assert sum(ing.values()) == J.SKEW_TOTAL
+    share = [ing[p] / J.SKEW_TOTAL for p in range(NPROC)]
+    assert all(abs(f - 1 / NPROC) < 0.05 for f in share), share
