@@ -45,16 +45,24 @@ PYEOF
     # largest candidate (tunnel RTT amortization), so probe 2M/4M
     # micro-batches after the official row; short runs, appended rows
     if [ "$captured" = 1 ]; then
-      for eb in 2097152 4194304; do
-        timeout 900 python bench.py --events $((eb * 40)) \
-            --baseline-events 200000 --no-sweep --batch $eb \
-            --init-deadline 45 > /tmp/bench_explore_tpu.txt 2>&1
+      explore() {  # explore <events> <extra bench args...>
+        local ev=$1; shift
+        timeout 900 python bench.py --events "$ev" \
+            --baseline-events 200000 --no-sweep --init-deadline 45 \
+            "$@" > /tmp/bench_explore_tpu.txt 2>&1
+        local eline
         eline=$(grep -h '"metric"' /tmp/bench_explore_tpu.txt | tail -1)
         if [ -n "$eline" ] && ! echo "$eline" | grep -q '"error"'; then
           echo "$eline" >> BENCH_EXPLORE_${ROUND}.jsonl
-          echo "$(date -u +%FT%TZ) explore batch=$eb: $eline" >&2
+          echo "$(date -u +%FT%TZ) explore $*: $eline" >&2
         fi
-      done
+      }
+      # larger micro-batches amortize the tunneled dispatch RTT further
+      explore 83886080 --batch 2097152
+      explore 167772160 --batch 4194304
+      # deeper in-flight pipelining overlaps dispatch RTTs outright
+      explore 41943040 --batch 1048576 --inflight 4
+      explore 41943040 --batch 1048576 --inflight 8
     fi
     timeout 1800 python bench_configs.py --init-deadline 60 \
         > /tmp/bench_configs_tpu.txt 2>&1
