@@ -60,6 +60,56 @@ _SELECTIVITY = {
 }
 
 
+#: ship-strategy planner knobs (ref flink-optimizer CostEstimator /
+#: Optimizer.java:396 shipping-strategy choice; overridable per
+#: ExecutionEnvironment attribute of the same name)
+BROADCAST_THRESHOLD_ROWS = 10_000   # a side this small may be broadcast
+BROADCAST_SKEW_FACTOR = 4           # ...if the other side is ≥4x larger
+HASH_MAX_BUILD_ROWS = 1_000_000     # past this, hash gives way to merge
+
+
+def _decide_join_strategies(n_left: float, n_right: float, hint: str,
+                            env) -> tuple:
+    """(ship, local, build_left) from side sizes — the optimizer's
+    shipping/local strategy assignment (ref Optimizer.java:396,
+    JoinOperatorBase.JoinHint). Used with ESTIMATES at plan time and
+    with exact materialized counts at run time, so EXPLAIN shows the
+    same decision procedure the execution applies.
+
+    ship:  broadcast-hash-first/second — the small side replicated to
+           every parallel instance (cost ~ small * parallelism);
+           repartition-hash — both sides hashed over the mesh
+           (cost ~ left + right network volume).
+    local: hash build-left/right, or sort-merge when neither side's
+           hash table is expected to fit the build budget (the
+           reference's hybrid-hash-vs-merge memory rationale).
+    """
+    bthresh = getattr(env, "broadcast_threshold_rows",
+                      BROADCAST_THRESHOLD_ROWS)
+    hmax = getattr(env, "hash_max_build_rows", HASH_MAX_BUILD_ROWS)
+    skew = getattr(env, "broadcast_skew_factor", BROADCAST_SKEW_FACTOR)
+    if hint == "build-left":
+        ship = ("broadcast-hash-first" if n_left <= bthresh
+                else "repartition-hash")
+        return ship, "hash build-left (hinted)", True
+    if hint == "build-right":
+        ship = ("broadcast-hash-second" if n_right <= bthresh
+                else "repartition-hash")
+        return ship, "hash build-right (hinted)", False
+    small, large = min(n_left, n_right), max(n_left, n_right)
+    build_left = n_left <= n_right
+    side = "first" if build_left else "second"
+    if small <= bthresh and large >= skew * small:
+        return (f"broadcast-hash-{side}",
+                f"hash build-{'left' if build_left else 'right'}",
+                build_left)
+    if small > hmax:
+        return "repartition-hash", "sort-merge", build_left
+    return ("repartition-hash",
+            f"hash build-{'left' if build_left else 'right'}",
+            build_left)
+
+
 class DataSet:
     def __init__(self, env, compute: Callable[[], List[Any]], name="op",
                  parents: tuple = ()):
@@ -70,6 +120,28 @@ class DataSet:
         self.parents = parents
         #: strategy notes recorded by cost-based choices (explain())
         self.strategy: Optional[str] = None
+        #: set on join nodes so plan() can re-derive strategies from
+        #: estimates without executing
+        self.join_hint: Optional[str] = None
+
+    # -- planner ---------------------------------------------------------
+    def plan(self) -> str:
+        """Assign ship/local strategies to every join in the DAG from
+        the cost model's ESTIMATES — without executing anything — and
+        return the annotated plan (the reference optimizer's pre-flight
+        plan, Optimizer.java compile() -> OptimizedPlan)."""
+        def annotate(node):
+            for p in node.parents:
+                annotate(p)
+            if node.join_hint is not None and len(node.parents) == 2:
+                ship, local, _bl = _decide_join_strategies(
+                    node.parents[0].estimate_size(),
+                    node.parents[1].estimate_size(),
+                    node.join_hint, node.env,
+                )
+                node.strategy = f"ship={ship}, local={local}"
+        annotate(self)
+        return self.explain()
 
     # -- evaluation ------------------------------------------------------
     def _data(self) -> List[Any]:
@@ -438,6 +510,93 @@ class GroupedDataSet:
         )
 
 
+def _sort_merge_join(lefts, rights, k1, k2, kind, f):
+    """Sort-merge local strategy (ref the optimizer's MERGE driver,
+    flink-runtime operators/sort/MergeIterator + SortMergeJoinDriver
+    rationale: chosen when no side's hash table fits the build budget —
+    sorting spills gracefully where a hash table cannot). Returns None
+    when keys don't admit a total order (mixed types): the caller falls
+    back to hash and records it."""
+    try:
+        ls = sorted(((k1(e), e) for e in lefts), key=lambda p: p[0])
+        rs = sorted(((k2(e), e) for e in rights), key=lambda p: p[0])
+    except TypeError:
+        return None
+    out = []
+    i = j = 0
+    nl, nr = len(ls), len(rs)
+    while i < nl and j < nr:
+        kl, kr = ls[i][0], rs[j][0]
+        if kl < kr:
+            if kind in ("left", "full"):
+                out.append(f(ls[i][1], None))
+            i += 1
+        elif kr < kl:
+            if kind in ("right", "full"):
+                out.append(f(None, rs[j][1]))
+            j += 1
+        else:
+            # equal-key group: emit the cross product of both runs
+            i2 = i
+            while i2 < nl and ls[i2][0] == kl:
+                i2 += 1
+            j2 = j
+            while j2 < nr and rs[j2][0] == kr:
+                j2 += 1
+            for a in range(i, i2):
+                for b in range(j, j2):
+                    out.append(f(ls[a][1], rs[b][1]))
+            i, j = i2, j2
+    if kind in ("left", "full"):
+        out.extend(f(ls[a][1], None) for a in range(i, nl))
+    if kind in ("right", "full"):
+        out.extend(f(None, rs[b][1]) for b in range(j, nr))
+    return out
+
+
+def _device_broadcast_join(lefts, rights, k1, k2, build_left, f):
+    """Physical broadcast ship on the device mesh for the common fast
+    case: INNER join, unique integer build keys. The build side is
+    replicated to every shard as a sharding declaration and each shard
+    probes its slice (parallel/broadcast.py — the accelerator form of
+    BROADCAST_HASH_FIRST/SECOND's copy-to-every-subtask). The kernel
+    returns per-probe build-row INDICES, so arbitrary Python payloads
+    join host-side from the positions. Returns None when the shape
+    doesn't qualify (caller keeps the host hash path)."""
+    build, probe = (lefts, rights) if build_left else (rights, lefts)
+    bk, pk = (k1, k2) if build_left else (k2, k1)
+    if len(build) == 0 or len(probe) == 0:
+        return []
+    try:
+        bkeys = np.asarray([bk(e) for e in build])
+        pkeys = np.asarray([pk(e) for e in probe])
+    except (TypeError, ValueError, OverflowError):
+        return None
+    # GENUINE int64 keys only: float keys would silently truncate
+    # (1.5 'matching' 1), big ints / mixed types land as object dtype
+    if bkeys.dtype.kind != "i" or pkeys.dtype.kind != "i":
+        return None
+    bkeys = bkeys.astype(np.int64)
+    pkeys = pkeys.astype(np.int64)
+    if len(np.unique(bkeys)) != len(bkeys):
+        return None                     # duplicate build keys: host path
+    try:
+        from flink_tpu.parallel.broadcast import broadcast_join
+        # payload = build-row index; float32 is exact through 2^24
+        if len(build) >= (1 << 24):
+            return None
+        idx, hit = broadcast_join(
+            pkeys, bkeys, np.arange(len(build), dtype=np.float32))
+    except Exception:                   # no usable mesh: host path
+        return None
+    out = []
+    pos = idx.astype(np.int64)
+    for i in np.nonzero(hit)[0]:
+        b, p = build[int(pos[i])], probe[int(i)]
+        out.append(f(b, p) if build_left else f(p, b))
+    return out
+
+
 class JoinBuilder:
     """a.join(b).where(k1).equal_to(k2).apply(fn) — hash-join execution
     with COST-BASED build-side selection (ref Optimizer.java:396 picking
@@ -487,21 +646,34 @@ class JoinBuilder:
                 for k in {**build, **probe}:
                     out.extend(f(probe.get(k, []), build.get(k, [])))
                 return out
-            # cost model: build over the smaller side (estimates are free
-            # here — both inputs are materialized just above, making the
-            # estimate exact), unless a hint forces it
-            if self.hint == "build-left":
-                build_left = True
-            elif self.hint == "build-right":
-                build_left = False
-            else:
-                build_left = len(lefts) < len(rights)
-            if node_holder:
-                node_holder[0].strategy = (
-                    f"hash build-{'left' if build_left else 'right'}"
-                    + ("" if self.hint == "auto" else " (hinted)")
-                )
+            # strategy decision with EXACT sizes (both inputs are
+            # materialized just above) through the same procedure the
+            # plan-time estimate pass uses
+            ship, local, build_left = _decide_join_strategies(
+                len(lefts), len(rights), self.hint,
+                self.left.env,
+            )
             f = fn or (lambda l, r: (l, r))
+            if local == "sort-merge":
+                merged = _sort_merge_join(lefts, rights, k1, k2, kind, f)
+                if merged is not None:
+                    if node_holder:
+                        node_holder[0].strategy = \
+                            f"ship={ship}, local=sort-merge"
+                    return merged
+                local = (f"hash build-"
+                         f"{'left' if build_left else 'right'} "
+                         f"(keys unsortable)")
+            if ship.startswith("broadcast-hash") and kind == "inner":
+                dev = _device_broadcast_join(
+                    lefts, rights, k1, k2, build_left, f)
+                if dev is not None:
+                    if node_holder:
+                        node_holder[0].strategy = (
+                            f"ship={ship} (device mesh), local={local}")
+                    return dev
+            if node_holder:
+                node_holder[0].strategy = f"ship={ship}, local={local}"
             if build_left:
                 build = {}
                 for l in lefts:
@@ -539,6 +711,8 @@ class JoinBuilder:
             return out
 
         node = self.left._derive(run, f"{kind}_join", self.right)
+        if kind != "cogroup":          # cogroup never consults ship/local
+            node.join_hint = self.hint  # plan() re-derives from estimates
         node_holder.append(node)
         return node
 

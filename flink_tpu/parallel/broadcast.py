@@ -28,8 +28,13 @@ from jax.sharding import PartitionSpec as P
 from flink_tpu.parallel.mesh import SHARD_AXIS, MeshContext
 
 
+_STEP_CACHE: dict = {}
+
+
 def build_broadcast_join_step(ctx: MeshContext):
-    """Compile a broadcast-join step over the mesh.
+    """Compile a broadcast-join step over the mesh (memoized per mesh:
+    jax.jit caches by function identity, so rebuilding the shard_map
+    closure per call would recompile the kernel on every join).
 
     step(keys, valid, tkeys, tvals) with
       keys/valid: [B] record lanes, SPLIT over shards (each device
@@ -40,6 +45,9 @@ def build_broadcast_join_step(ctx: MeshContext):
     tvals[searchsorted(tkeys, keys[i])] where keys match; 0 otherwise.
     """
     mesh = ctx.mesh
+    cached = _STEP_CACHE.get(id(mesh))
+    if cached is not None:
+        return cached
 
     def shard_body(keys, valid, tkeys, tvals):
         pos = jnp.searchsorted(tkeys, keys)
@@ -62,6 +70,7 @@ def build_broadcast_join_step(ctx: MeshContext):
     def step(keys, valid, tkeys, tvals):
         return sharded(keys, valid, tkeys, tvals)
 
+    _STEP_CACHE[id(mesh)] = step
     return step
 
 

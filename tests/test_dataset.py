@@ -176,3 +176,106 @@ def test_csv_and_text_sources(tmp_path):
     t = tmp_path / "t.txt"
     t.write_text("x\ny\n")
     assert env.read_text_file(str(t)).collect() == ["x", "y"]
+
+
+# -- round 5: ship/local strategy planner (ref Optimizer.java:396) --------
+def _pairs(n, keymod, seed):
+    import numpy as _np
+
+    rng = _np.random.default_rng(seed)
+    return [(int(k), i) for i, k in
+            enumerate(rng.integers(0, keymod, n))]
+
+
+def test_plan_assigns_ship_and_local_strategies_without_executing():
+    env = ExecutionEnvironment.get_execution_environment()
+    small = env.from_collection([(k, k) for k in range(50)])
+    big = env.from_collection(_pairs(5000, 100, 0))
+    j = big.join(small).where(0).equal_to(0).apply(lambda l, r: (l, r))
+    plan = j.plan()
+    # small side broadcast (50 <= threshold, 5000 >= 4*50), built over
+    assert "ship=broadcast-hash-second" in plan
+    assert "local=hash build-right" in plan
+    assert j._cache is None                    # nothing executed
+
+
+def test_plan_repartition_for_comparable_sides():
+    env = ExecutionEnvironment.get_execution_environment()
+    a = env.from_collection(_pairs(4000, 100, 1))
+    b = env.from_collection(_pairs(5000, 100, 2))
+    plan = a.join(b).where(0).equal_to(0).apply(
+        lambda l, r: (l, r)).plan()
+    assert "ship=repartition-hash" in plan
+    assert "local=hash build-left" in plan
+
+
+def test_plan_sort_merge_when_hash_exceeds_budget():
+    env = ExecutionEnvironment.get_execution_environment()
+    env.hash_max_build_rows = 100          # shrink the build budget
+    a = env.from_collection(_pairs(4000, 50, 3))
+    b = env.from_collection(_pairs(5000, 50, 4))
+    j = a.join(b).where(0).equal_to(0).apply(lambda l, r: (l, r))
+    assert "local=sort-merge" in j.plan()
+    # the run-time decision matches and the merge is exact
+    got = sorted(j.collect())
+    exp = sorted(
+        (l, r) for l in a.collect() for r in b.collect() if l[0] == r[0]
+    )
+    assert got == exp
+    assert "sort-merge" in j.strategy
+
+
+@pytest.mark.parametrize("kind,method", [
+    ("inner", "join"), ("left", "left_outer_join"),
+    ("right", "right_outer_join"), ("full", "full_outer_join"),
+])
+def test_sort_merge_equals_hash_all_kinds(kind, method):
+    env_h = ExecutionEnvironment.get_execution_environment()
+    env_m = ExecutionEnvironment.get_execution_environment()
+    env_m.hash_max_build_rows = 0          # force sort-merge
+    outs = []
+    for env in (env_h, env_m):
+        a = env.from_collection(_pairs(300, 40, 5))
+        b = env.from_collection(_pairs(260, 40, 6))
+        j = getattr(a, method)(b).where(0).equal_to(0).apply(
+            lambda l, r: (l, r))
+        outs.append(sorted(j.collect(), key=repr))
+    assert outs[0] == outs[1]
+
+
+def test_sort_merge_unsortable_keys_fall_back_to_hash():
+    env = ExecutionEnvironment.get_execution_environment()
+    env.hash_max_build_rows = 0
+    a = env.from_collection([(1, "a"), ("x", "b")])   # mixed key types
+    b = env.from_collection([(1, "c"), ("x", "d")])
+    j = a.join(b).where(0).equal_to(0).apply(lambda l, r: (l[1], r[1]))
+    assert sorted(j.collect()) == [("a", "c"), ("b", "d")]
+    assert "keys unsortable" in j.strategy
+
+
+def test_device_broadcast_ship_for_int_keyed_inner_join():
+    """The physical broadcast ship: unique-int-key build side replicated
+    over the device mesh, probe positions joined host-side — results
+    identical to the host hash path (parallel/broadcast.py)."""
+    env = ExecutionEnvironment.get_execution_environment()
+    dim = env.from_collection([(k, f"name-{k}") for k in range(64)])
+    facts = env.from_collection(_pairs(4000, 64, 7))
+    j = facts.join(dim).where(0).equal_to(0).apply(
+        lambda l, r: (l[0], l[1], r[1]))
+    got = sorted(j.collect())
+    assert j.strategy and "device mesh" in j.strategy, j.strategy
+    exp = sorted(
+        (l[0], l[1], f"name-{l[0]}") for l in facts.collect()
+    )
+    assert got == exp
+
+
+def test_join_hint_forces_build_side_in_plan_and_run():
+    env = ExecutionEnvironment.get_execution_environment()
+    big = env.from_collection(_pairs(5000, 100, 8))
+    small = env.from_collection([(k, k) for k in range(50)])
+    j = big.join(small).where(0).equal_to(0).with_hint(
+        "build-left").apply(lambda l, r: (l, r))
+    assert "local=hash build-left (hinted)" in j.plan()
+    j.collect()
+    assert "build-left (hinted)" in j.strategy
