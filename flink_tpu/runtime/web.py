@@ -22,6 +22,16 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
                               (ref JobVertexMetricsHandler)
     /jobs/<jid>/vertices/<vid>/subtasktimes  per-subtask state timestamps
                               (ref SubtasksTimesHandler)
+    /jobs/<jid>/vertices/<vid>/accumulators  per-vertex accumulators
+                              (ref JobVertexAccumulatorsHandler)
+    /jobs/<jid>/vertices/<vid>/subtasks/accumulators  all subtasks'
+                              accumulators (ref SubtasksAllAccumulatorsHandler)
+    /jobs/<jid>/vertices/<vid>/taskmanagers  subtasks grouped by TM
+                              (ref JobVertexTaskManagersHandler)
+    /jobs/<jid>/vertices/<vid>/checkpoints   vertex-scoped checkpoint
+                              stats (ref JobVertexCheckpointsHandler)
+    /jars/<id>/plan           dry-run plan of an uploaded program
+                              (ref JarPlanHandler)
     /jobs/<jid>/vertices/<vid>/subtasks/<n>[/attempts/<a>]  one subtask's
                               current or historical attempt (ref
                               SubtaskCurrentAttemptDetailsHandler /
@@ -49,9 +59,14 @@ ready-to-submit StreamExecutionEnvironment):
            JobCancellationHandler / JobStoppingHandler)
     POST   /jobs/<jid>/savepoints?target-directory=D  live savepoint
            trigger (the CLI ACTION_SAVEPOINT role over HTTP)
+    POST   /jobs/<jid>/cancel-with-savepoint?target-directory=D
+           savepoint-then-cancel, one synchronous response (ref
+           JobCancellationWithSavepointHandlers)
     DELETE /jobs/<jid>         cancel, REST-style
 Like the reference, uploading a program means trusting it: the run
-handler executes the module. The shared-secret auth (when configured)
+handler executes the module, and the plan handler also executes its
+top-level code and builder to derive the DAG (a "dry run" only in that
+nothing is submitted). The shared-secret auth (when configured)
 gates these routes exactly like the read paths.
 """
 
@@ -216,6 +231,22 @@ class WebMonitor:
             self._jar_dir_owned = False
 
     # -- helpers ---------------------------------------------------------
+    @staticmethod
+    def _plan_nodes(env) -> list:
+        """The logical operator DAG of an environment as plan-JSON rows
+        (shared by JobPlanHandler and JarPlanHandler analogs)."""
+        from flink_tpu.graph.stream_graph import parents_of, walk_dag
+
+        return [
+            {
+                "id": t.id,
+                "type": type(t).__name__.replace("Transformation", ""),
+                "description": getattr(t, "kind", None) or t.name,
+                "inputs": [p.id for p in parents_of(t)],
+            }
+            for t in walk_dag(getattr(env, "_sinks", []))
+        ]
+
     def _job_vertex(self, jid: str, vid: int):
         rec = self.cluster.jobs.get(jid)
         eg = getattr(rec, "execution_graph", None) if rec else None
@@ -290,17 +321,9 @@ class WebMonitor:
         if m:
             # savepoint trigger over HTTP (the CLI's ACTION_SAVEPOINT
             # role; the reference added the REST form in later versions)
-            target = query.get("target-directory")
-            if not target:
-                return 400, {"error": "missing ?target-directory="}
-            try:
-                sp = self.cluster.trigger_savepoint(m.group(1), target)
-            except KeyError:
-                return 404, {"error": f"no job {m.group(1)!r}"}
-            except NotImplementedError as e:
-                return 501, {"error": str(e)}    # stage can't savepoint
-            except RuntimeError as e:
-                return 409, {"error": str(e)}
+            sp, err = self._trigger_savepoint(m.group(1), query)
+            if err is not None:
+                return err
             return 200, {"status": "completed", "savepoint-path": sp}
         m = re.fullmatch(r"/jobs/([^/]+)/(cancel|stop)", path)
         if m:
@@ -313,6 +336,20 @@ class WebMonitor:
             except KeyError:
                 return 404, {"error": f"no job {m.group(1)!r}"}
             return 202, {"status": f"{m.group(2)}-requested"}
+        m = re.fullmatch(r"/jobs/([^/]+)/cancel-with-savepoint", path)
+        if m:
+            # ref JobCancellationWithSavepointHandlers: savepoint, then
+            # cancel only once the savepoint completed (never lose the
+            # state cut). The reference splits this into trigger +
+            # in-progress polling handlers; the step-boundary savepoint
+            # here completes synchronously, so one response carries the
+            # path (the polling handler's terminal payload).
+            sp, err = self._trigger_savepoint(m.group(1), query)
+            if err is not None:
+                return err
+            self.cluster.cancel(m.group(1))
+            return 200, {"status": "success", "savepoint-path": sp,
+                         "cancellation": "requested"}
         m = re.fullmatch(r"/jars/([^/]+)/run", path)
         if m:
             with self._jar_lock:
@@ -333,6 +370,22 @@ class WebMonitor:
             )
             return 200, {"jobid": jobid}
         return 404, {"error": "not found"}
+
+    def _trigger_savepoint(self, jid: str, query: dict):
+        """-> (savepoint_path, None) or (None, (code, body)) — the one
+        trigger/error mapping shared by /savepoints and
+        /cancel-with-savepoint."""
+        target = query.get("target-directory")
+        if not target:
+            return None, (400, {"error": "missing ?target-directory="})
+        try:
+            return self.cluster.trigger_savepoint(jid, target), None
+        except KeyError:
+            return None, (404, {"error": f"no job {jid!r}"})
+        except NotImplementedError as e:
+            return None, (501, {"error": str(e)})  # stage can't savepoint
+        except RuntimeError as e:
+            return None, (409, {"error": str(e)})
 
     def _route_delete(self, path):
         import os
@@ -457,18 +510,28 @@ class WebMonitor:
             rec = self.cluster.jobs.get(m.group(1))
             if rec is None:
                 return None
-            from flink_tpu.graph.stream_graph import parents_of, walk_dag
+            return {"jid": m.group(1),
+                    "plan": {"nodes": self._plan_nodes(rec.env)}}
+        m = re.fullmatch(r"/jars/([^/]+)/plan", path)
+        if m:
+            # ref JarPlanHandler: build the program's plan WITHOUT
+            # submitting it — the dry-run the reference offers before
+            # JarRunHandler
+            with self._jar_lock:
+                jar = self._jars.get(m.group(1))
+            if jar is None:
+                return None
+            from flink_tpu.runtime.worker import load_builder
 
-            nodes = [
-                {
-                    "id": t.id,
-                    "type": type(t).__name__.replace("Transformation", ""),
-                    "description": getattr(t, "kind", None) or t.name,
-                    "inputs": [p.id for p in parents_of(t)],
-                }
-                for t in walk_dag(getattr(rec.env, "_sinks", []))
-            ]
-            return {"jid": m.group(1), "plan": {"nodes": nodes}}
+            entry = query.get("entry", "build")
+            try:
+                builder = load_builder(f"{jar['path']}:{entry}")
+            except (FileNotFoundError, OSError):
+                return None            # raced with DELETE /jars/<id>
+            # builder errors surface as 500 with the real message (the
+            # /run handler's idiom) — a program bug is not a 404
+            return {"id": m.group(1),
+                    "plan": {"nodes": self._plan_nodes(builder())}}
         m = re.fullmatch(r"/jobs/([^/]+)/vertices", path)
         if m:
             # ref JobDetailsHandler's vertices array: served from the
@@ -527,6 +590,80 @@ class WebMonitor:
                 "subtasks": [
                     self._subtask_row(v) for v in jv.vertices
                 ],
+            }
+        m = re.fullmatch(r"/jobs/([^/]+)/vertices/(\d+)/accumulators",
+                         path)
+        if m:
+            # ref JobVertexAccumulatorsHandler: the fused micro-batch
+            # step accumulates at job scope, served per vertex for
+            # handler parity with the attribution explicit (the same
+            # honesty as /vertices/<v>/metrics)
+            jv = self._job_vertex(m.group(1), int(m.group(2)))
+            if jv is None:
+                return None
+            job_accs = self._route(f"/jobs/{m.group(1)}/accumulators")
+            return {
+                "id": int(m.group(2)),
+                "attribution": "job-level (fused micro-batch step)",
+                "user-accumulators":
+                    job_accs["user-task-accumulators"],
+            }
+        m = re.fullmatch(
+            r"/jobs/([^/]+)/vertices/(\d+)/subtasks/accumulators", path)
+        if m:
+            # ref SubtasksAllAccumulatorsHandler
+            jv = self._job_vertex(m.group(1), int(m.group(2)))
+            if jv is None:
+                return None
+            job_accs = self._route(f"/jobs/{m.group(1)}/accumulators")
+            return {
+                "id": int(m.group(2)),
+                "parallelism": jv.parallelism,
+                "subtasks": [{
+                    "subtask": v.subtask_index,
+                    "attempt": v.current.attempt,
+                    "host": "tm-local",
+                    "user-accumulators":
+                        job_accs["user-task-accumulators"],
+                } for v in jv.vertices],
+            }
+        m = re.fullmatch(r"/jobs/([^/]+)/vertices/(\d+)/taskmanagers",
+                         path)
+        if m:
+            # ref JobVertexTaskManagersHandler: subtask rows aggregated
+            # by host TaskManager (the MiniCluster is one logical TM)
+            jv = self._job_vertex(m.group(1), int(m.group(2)))
+            if jv is None:
+                return None
+            counts: dict = {}
+            for v in jv.vertices:
+                counts[v.current.state] = counts.get(
+                    v.current.state, 0) + 1
+            return {
+                "id": int(m.group(2)),
+                "name": jv.name,
+                "taskmanagers": [{
+                    "host": "tm-local",
+                    "status-counts": counts,
+                    "subtasks": len(jv.vertices),
+                }],
+            }
+        m = re.fullmatch(r"/jobs/([^/]+)/vertices/(\d+)/checkpoints",
+                         path)
+        if m:
+            # ref JobVertexCheckpointsHandler: checkpoint stats scoped
+            # to one vertex. One fused stage snapshots at the step
+            # boundary, so the job rows are the vertex rows with the
+            # attribution explicit.
+            jv = self._job_vertex(m.group(1), int(m.group(2)))
+            if jv is None:
+                return None
+            rec = self.cluster.jobs[m.group(1)]
+            return {
+                "id": int(m.group(2)),
+                "name": jv.name,
+                "attribution": "job-level (fused stage snapshot)",
+                "checkpoints": self._checkpoint_stats(rec),
             }
         m = re.fullmatch(r"/jobs/([^/]+)/vertices/(\d+)/subtasktimes",
                          path)

@@ -2,6 +2,7 @@
 metrics, CLI (ref SURVEY §2.2 JobManager registry, §2.9 CLI/web)."""
 
 import json
+import os
 import time
 import urllib.request
 
@@ -645,4 +646,86 @@ def test_dashboard_html_integrity():
         for path in set(_re.findall(r'J\("(/[^"]*)"\)', js)):
             assert web._route(path) is not None, path
     finally:
+        web.stop()
+
+
+def test_web_vertex_scoped_and_jar_plan_routes(tmp_path):
+    """Round-5 handler-set completion: vertex accumulators, subtask
+    accumulators, vertex taskmanagers, vertex checkpoints, jar dry-run
+    plan, cancel-with-savepoint (ref JobVertexAccumulatorsHandler,
+    SubtasksAllAccumulatorsHandler, JobVertexTaskManagersHandler,
+    JobVertexCheckpointsHandler, JarPlanHandler,
+    JobCancellationWithSavepointHandlers)."""
+    import urllib.error
+
+    from flink_tpu.runtime.web import WebMonitor
+
+    env, _ = _slow_infinite_env()
+    env.enable_checkpointing(interval_steps=2, directory=str(tmp_path))
+    cluster = MiniCluster()
+    web = WebMonitor(cluster, jar_dir=str(tmp_path / "jars"))
+    port = web.start()
+    jid = cluster.submit(env, "vertex-routes-job")
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        def post(path, body=b""):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=body,
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+
+        time.sleep(1.2)
+        vx = get(f"/jobs/{jid}/vertices")["vertices"]
+        vid = vx[0]["id"]
+        va = get(f"/jobs/{jid}/vertices/{vid}/accumulators")
+        assert va["id"] == vid and "user-accumulators" in va
+        sa = get(f"/jobs/{jid}/vertices/{vid}/subtasks/accumulators")
+        assert len(sa["subtasks"]) == sa["parallelism"]
+        assert sa["subtasks"][0]["host"] == "tm-local"
+        tm = get(f"/jobs/{jid}/vertices/{vid}/taskmanagers")
+        assert tm["taskmanagers"][0]["host"] == "tm-local"
+        assert tm["taskmanagers"][0]["subtasks"] >= 1
+        assert sum(tm["taskmanagers"][0]["status-counts"].values()) \
+            == tm["taskmanagers"][0]["subtasks"]
+        vc = get(f"/jobs/{jid}/vertices/{vid}/checkpoints")
+        assert vc["id"] == vid and "checkpoints" in vc
+
+        # jar dry-run plan: the DAG without a submission
+        program = (
+            "from flink_tpu import StreamExecutionEnvironment\n"
+            "from flink_tpu.runtime.sinks import DiscardingSink\n"
+            "def build():\n"
+            "    env = StreamExecutionEnvironment"
+            ".get_execution_environment()\n"
+            "    env.from_collection([1, 2, 3])"
+            ".map(lambda x: x).add_sink(DiscardingSink())\n"
+            "    return env\n"
+        )
+        _, up = post("/jars/upload?name=planonly.py", program.encode())
+        plan = get(f"/jars/{up['id']}/plan")
+        types = {n["type"] for n in plan["plan"]["nodes"]}
+        assert {"Source", "Sink"} <= types
+        assert get(f"/jobs/{jid}").get("state") == "RUNNING"  # no submit
+
+        # cancel-with-savepoint: path returned, job cancels
+        code, body = post(
+            f"/jobs/{jid}/cancel-with-savepoint"
+            f"?target-directory={tmp_path / 'sp'}")
+        assert code == 200 and body["savepoint-path"]
+        assert os.path.isdir(body["savepoint-path"])
+        cluster.wait(jid, 30)
+        assert cluster.jobs[jid].status in ("CANCELED", "FINISHED")
+    finally:
+        try:
+            cluster.cancel(jid)
+            cluster.wait(jid, 30)
+        except Exception:
+            pass
         web.stop()
