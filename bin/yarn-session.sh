@@ -1,0 +1,7 @@
+#!/bin/bash
+# Deploy a flink_tpu session cluster on YARN
+# (ref bin/yarn-session.sh; flink-yarn/.../cli/FlinkYarnSessionCli.java).
+#
+#   bin/yarn-session.sh --rm http://rm-host:8088 [--name N] [...]
+cd "$(dirname "$0")/.."
+exec python -m flink_tpu.deploy.yarn "$@"

@@ -1,0 +1,18 @@
+"""Cluster-framework deployment glue (ref flink-yarn/, flink-mesos/).
+
+The reference ships YARN and Mesos modes whose job is to (1) submit an
+ApplicationMaster to the cluster framework, (2) have the AM request
+worker containers, and (3) wire the launched TaskManagers back to the
+JobManager. Here the same three steps drive the TPU-native runtime:
+the AM is a ``ProcessCluster`` controller, a worker container runs
+``flink_tpu.runtime.worker`` (the per-job container pattern), and the
+framework protocol is the public YARN ResourceManager REST API spoken
+by a from-spec client (``deploy/yarn.py``).
+"""
+
+from flink_tpu.deploy.yarn import (  # noqa: F401
+    MiniYarnRM,
+    YarnClusterClient,
+    YarnClusterDescriptor,
+    YarnRestClient,
+)

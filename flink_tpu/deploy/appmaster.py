@@ -1,0 +1,192 @@
+"""YARN ApplicationMaster: the controller runtime inside the AM container.
+
+Ref ``YarnApplicationMasterRunner.java`` (starts the JobManager actor
+system inside the AM container) + ``YarnFlinkResourceManager.java``
+(requests TaskManager containers from YARN and re-requests them when
+containers complete unexpectedly). TPU-native mapping: the AM runs the
+ordinary ``ProcessCluster`` controller, and ``YarnProcessCluster``
+redirects the single spawn seam — worker processes become YARN container
+requests, and the returned handle speaks the RM's container-report API
+in place of ``Popen.poll``. Everything above the seam (registration,
+heartbeats, DeathWatch, restart-with-restore, HA, leases) is unchanged,
+so a container death flows through the same restart machinery as a local
+process death; the re-request happens because the restart loop calls the
+same spawn seam again (YarnFlinkResourceManager.java's
+``onContainersCompleted`` -> re-request loop, expressed structurally).
+
+The RM coordinates arrive through the container environment
+(``FLINK_TPU_YARN_RM_URL`` / ``FLINK_TPU_YARN_APP_ID``), the way the
+reference ships them via ``YarnConfigKeys`` env entries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from flink_tpu.deploy.yarn import (
+    ENV_APP_ID,
+    ENV_RM_URL,
+    YarnError,
+    YarnRestClient,
+)
+from flink_tpu.runtime.process_cluster import ProcessCluster
+
+
+class _YarnContainerHandle:
+    """Duck-types the ``subprocess.Popen`` surface the controller's
+    DeathWatch uses (``poll``/``kill``/``pid``) against the RM's
+    container-report API, so ``ProcessCluster._monitor_loop`` watches a
+    remote container exactly like a local child process."""
+
+    # the DeathWatch scan runs every 0.25s over every worker; container
+    # reports ride HTTP, so polls use a short-timeout client and a 1s
+    # result cache to keep a slow RM from serializing death detection
+    POLL_INTERVAL_S = 1.0
+
+    def __init__(self, rest: YarnRestClient, app_id: str,
+                 container_id: str):
+        self._rest = YarnRestClient(rest.base, timeout_s=2.0)
+        self._app_id = app_id
+        self.container_id = container_id
+        self.pid = container_id          # identifier for event logs
+        self._exit: Optional[int] = None
+        self._last_poll = 0.0
+
+    def poll(self) -> Optional[int]:
+        if self._exit is not None:
+            return self._exit
+        now = time.time()
+        if now - self._last_poll < self.POLL_INTERVAL_S:
+            return None
+        self._last_poll = now
+        try:
+            report = self._rest.container_report(
+                self._app_id, self.container_id
+            )
+        except YarnError:
+            # RM briefly unreachable: report liveness; heartbeat
+            # staleness still catches a truly dead worker
+            return None
+        if report["state"] == "COMPLETE":
+            self._exit = report.get("exitStatus")
+            if self._exit is None:
+                self._exit = -1
+        return self._exit
+
+    def kill(self):
+        """Stop the container and CONFIRM it stopped before recording an
+        exit. Pretending an unconfirmed kill succeeded would let the
+        restart loop respawn a replacement while the old worker still
+        runs — two writers, duplicate emissions. If the RM is
+        unreachable the exit stays unrecorded; the subsequent respawn's
+        ``request_container`` fails against the same dead RM, so no
+        second writer can start either way."""
+        if self._exit is not None:
+            return
+        for _ in range(5):
+            try:
+                self._rest.stop_container(self._app_id, self.container_id)
+                report = self._rest.container_report(
+                    self._app_id, self.container_id
+                )
+            except YarnError:
+                time.sleep(0.2)
+                continue
+            if report["state"] == "COMPLETE":
+                self._exit = report.get("exitStatus", -137)
+                return
+            time.sleep(0.2)
+
+
+class YarnProcessCluster(ProcessCluster):
+    """ProcessCluster whose worker spawns are YARN container requests."""
+
+    def __init__(self, rest: YarnRestClient, app_id: str,
+                 worker_resource: Optional[dict] = None, **kw):
+        super().__init__(**kw)
+        self._rest = rest
+        self._app_id = app_id
+        self._worker_resource = worker_resource or {
+            "memory": 1024, "vCores": 1,
+        }
+
+    def _spawn_inner(self, worker_id, builder_ref, job_name,
+                     checkpoint_dir, restore, extra_env=None):
+        cmd = [
+            sys.executable, "-m", "flink_tpu.runtime.worker",
+            "--controller", f"{self.advertise_host}:{self._port}",
+            "--worker-id", worker_id,
+            "--builder", builder_ref,
+            "--job-name", job_name,
+            "--checkpoint-dir", checkpoint_dir,
+        ]
+        if restore:
+            cmd.append("--restore")
+        env = {}
+        if self.auth_token:
+            from flink_tpu.runtime import security
+
+            env[security.ENV_TOKEN] = self.auth_token
+        if extra_env:
+            env.update(extra_env)
+        cid = self._rest.request_container(
+            self._app_id, shlex.join(cmd), environment=env,
+            resource=self._worker_resource,
+        )
+        self._event("container-requested", worker=worker_id,
+                    container=cid)
+        return _YarnContainerHandle(self._rest, self._app_id, cid)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="flink-tpu-appmaster")
+    ap.add_argument("--rm", default=os.environ.get(ENV_RM_URL))
+    ap.add_argument("--app-id", default=os.environ.get(ENV_APP_ID))
+    ap.add_argument("--worker-resource", default=None,
+                    help="JSON resource dict for worker containers")
+    ap.add_argument("--heartbeat-timeout-s", type=float, default=10.0)
+    a = ap.parse_args(argv)
+    if not a.rm or not a.app_id:
+        print("appmaster: missing RM url / application id "
+              f"({ENV_RM_URL}/{ENV_APP_ID})", file=sys.stderr)
+        return 2
+    rest = YarnRestClient(a.rm)
+    cluster = YarnProcessCluster(
+        rest, a.app_id,
+        worker_resource=(
+            json.loads(a.worker_resource) if a.worker_resource else None
+        ),
+        heartbeat_timeout_s=a.heartbeat_timeout_s,
+    )
+    port = cluster.start()
+    rest.register_am(a.app_id, f"{cluster.advertise_host}:{port}")
+    print(f"[appmaster] {a.app_id} serving on {port}", flush=True)
+
+    done = threading.Event()
+
+    def on_term(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    while not done.wait(0.5):
+        pass
+    cluster.shutdown()
+    try:
+        rest.finish_am(a.app_id, "SUCCEEDED")
+    except YarnError:
+        pass                     # RM already gone or app already killed
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

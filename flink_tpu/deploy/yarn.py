@@ -1,0 +1,684 @@
+"""YARN deployment glue: REST client, cluster descriptor, session client,
+and an in-repo spec ResourceManager for tests.
+
+Reference shape (flink-yarn/):
+  - ``AbstractYarnClusterDescriptor.java`` /``YarnClusterDescriptor.java``
+    — the client side: create a YARN application, build the AM container
+    launch context (command + environment), submit it, poll the
+    application report until the AM is up, hand back a cluster client.
+  - ``YarnApplicationMasterRunner.java`` — the AM process: starts the
+    JobManager runtime and the YARN-aware resource manager.
+  - ``YarnFlinkResourceManager.java`` — requests/launches TaskManager
+    containers and re-requests them when containers die.
+  - ``YarnClusterClient.java`` — job submission against the deployed
+    session plus ``shutdownCluster`` (kills the YARN application).
+
+TPU-native mapping: the AM is a ``ProcessCluster`` controller
+(runtime/process_cluster.py) whose worker spawns are redirected to YARN
+container requests (deploy/appmaster.py); a TaskManager container runs
+``python -m flink_tpu.runtime.worker`` — the per-job container pattern.
+The framework protocol is the public Hadoop ResourceManager REST API
+(``/ws/v1/cluster/...``: new-application, app submission with an
+am-container-spec, application report, state PUT for kill), implemented
+here from the spec with stdlib HTTP — no Hadoop client libraries. The
+container-allocation leg (in Hadoop an RPC protocol between AM and
+RM/NodeManagers, ``AMRMClient``/``NMClient``) is carried over the same
+REST surface via ``/apps/<id>/containers`` routes; ``MiniYarnRM``
+implements both the RM and NodeManager roles, launching container
+commands as real OS processes, so the full deploy→AM→container→register
+→run→kill loop is exercised end-to-end in tests (the seam where a real
+Hadoop deployment would swap in the RPC clients is ``YarnRestClient``'s
+``register_am``/``request_container``/``stop_container`` trio).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shlex
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from flink_tpu.runtime.process_cluster import _die_with_parent
+
+# environment keys the descriptor plants in the AM container spec, the
+# way the reference ships cluster coordinates through container env
+# (YarnConfigKeys.java: ENV_APP_ID, ENV_CLIENT_HOME_DIR, ...)
+ENV_RM_URL = "FLINK_TPU_YARN_RM_URL"
+ENV_APP_ID = "FLINK_TPU_YARN_APP_ID"
+
+
+# --------------------------------------------------------------------------
+# REST client (the YarnClient / AMRMClient / NMClient stand-in)
+# --------------------------------------------------------------------------
+class YarnRestClient:
+    """From-spec client for the Hadoop RM REST API (v1 JSON).
+
+    Client-side routes are the public Hadoop ones (Cluster Information,
+    Cluster New Application, Cluster Applications Submission, Cluster
+    Application State). AM-side routes (register/master, containers)
+    carry the AM↔RM/NM protocols over the same HTTP surface — see the
+    module docstring for the seam.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None,
+              ok=(200, 202)) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                if r.status not in ok:
+                    raise YarnError(f"{method} {path} -> HTTP {r.status}")
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:300]
+            raise YarnError(
+                f"{method} {path} -> HTTP {e.code}: {detail}"
+            ) from None
+        except (urllib.error.URLError, OSError) as e:
+            # connection-level failures (refused, reset, timeout) must be
+            # YarnError too: liveness guards catch YarnError to mean "RM
+            # unreachable right now", and a raw URLError would instead
+            # escape into ProcessCluster's monitor thread and kill it
+            raise YarnError(f"{method} {path} -> {e}") from None
+        return json.loads(payload) if payload else {}
+
+    # -- client side -----------------------------------------------------
+    def cluster_info(self) -> dict:
+        return self._call("GET", "/ws/v1/cluster/info")["clusterInfo"]
+
+    def new_application(self) -> dict:
+        """POST Cluster New Application API -> application-id + caps."""
+        return self._call("POST", "/ws/v1/cluster/apps/new-application")
+
+    def submit_application(self, ctx: dict) -> None:
+        """POST Cluster Applications API (Submit Application)."""
+        self._call("POST", "/ws/v1/cluster/apps", ctx)
+
+    def app_report(self, app_id: str) -> dict:
+        return self._call("GET", f"/ws/v1/cluster/apps/{app_id}")["app"]
+
+    def kill(self, app_id: str) -> None:
+        """PUT Cluster Application State API with KILLED."""
+        self._call("PUT", f"/ws/v1/cluster/apps/{app_id}/state",
+                   {"state": "KILLED"})
+
+    # -- AM side (AMRMClient / NMClient over REST) -----------------------
+    def register_am(self, app_id: str, tracking_url: str) -> None:
+        """registerApplicationMaster: flips the app ACCEPTED->RUNNING and
+        publishes the tracking URL clients connect to."""
+        self._call("POST", f"/ws/v1/cluster/apps/{app_id}/master",
+                   {"trackingUrl": tracking_url})
+
+    def finish_am(self, app_id: str, final_status: str = "SUCCEEDED"):
+        self._call("POST", f"/ws/v1/cluster/apps/{app_id}/finish",
+                   {"finalStatus": final_status})
+
+    def request_container(self, app_id: str, command: str,
+                          environment: Optional[Dict[str, str]] = None,
+                          resource: Optional[dict] = None) -> str:
+        """Allocate + launch a worker container; returns the container id
+        (the AMRMClient.addContainerRequest -> NMClient.startContainer
+        pair, collapsed because MiniYarnRM plays both roles)."""
+        out = self._call(
+            "POST", f"/ws/v1/cluster/apps/{app_id}/containers",
+            {"command": command, "environment": environment or {},
+             "resource": resource or {"memory": 1024, "vCores": 1}},
+        )
+        return out["container-id"]
+
+    def container_report(self, app_id: str, container_id: str) -> dict:
+        return self._call(
+            "GET", f"/ws/v1/cluster/apps/{app_id}/containers/{container_id}"
+        )["container"]
+
+    def list_containers(self, app_id: str) -> List[dict]:
+        return self._call(
+            "GET", f"/ws/v1/cluster/apps/{app_id}/containers"
+        )["containers"]
+
+    def stop_container(self, app_id: str, container_id: str) -> None:
+        self._call(
+            "DELETE",
+            f"/ws/v1/cluster/apps/{app_id}/containers/{container_id}",
+        )
+
+
+class YarnError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Cluster descriptor + session client
+# --------------------------------------------------------------------------
+class YarnClusterDescriptor:
+    """Deploys a flink_tpu session cluster onto YARN.
+
+    Mirrors ``AbstractYarnClusterDescriptor.deploySessionCluster``:
+    new-application -> build the AM container launch context (command +
+    environment entries) -> submit -> poll the application report until
+    the AM registered (RUNNING + tracking URL) -> return a client.
+    """
+
+    def __init__(self, rm_url: str, am_resource: Optional[dict] = None,
+                 worker_resource: Optional[dict] = None):
+        self.rest = YarnRestClient(rm_url)
+        self.rm_url = rm_url
+        self.am_resource = am_resource or {"memory": 2048, "vCores": 1}
+        self.worker_resource = worker_resource or {
+            "memory": 1024, "vCores": 1,
+        }
+
+    def deploy_session_cluster(
+        self, name: str = "flink-tpu-session",
+        extra_env: Optional[Dict[str, str]] = None,
+        deploy_timeout_s: float = 120.0,
+    ) -> "YarnClusterClient":
+        app = self.rest.new_application()
+        app_id = app["application-id"]
+        env = {ENV_RM_URL: self.rm_url, ENV_APP_ID: app_id}
+        env.update(extra_env or {})
+        worker_res = json.dumps(self.worker_resource)
+        ctx = {
+            "application-id": app_id,
+            "application-name": name,
+            "application-type": "flink-tpu",
+            "am-container-spec": {
+                "commands": {
+                    "command": (
+                        f"{shlex.quote(sys.executable)} -m "
+                        f"flink_tpu.deploy.appmaster "
+                        f"--worker-resource {shlex.quote(worker_res)}"
+                    ),
+                },
+                "environment": {
+                    "entry": [
+                        {"key": k, "value": v} for k, v in env.items()
+                    ],
+                },
+            },
+            "resource": self.am_resource,
+            "max-app-attempts": 1,
+        }
+        self.rest.submit_application(ctx)
+        deadline = time.time() + deploy_timeout_s
+        while True:
+            report = self.rest.app_report(app_id)
+            state = report["state"]
+            if state == "RUNNING" and report.get("trackingUrl"):
+                url = report["trackingUrl"]
+                host, _, port = url.rpartition(":")
+                try:
+                    return YarnClusterClient(
+                        self.rest, app_id, host, int(port)
+                    )
+                except ValueError:
+                    raise YarnError(
+                        f"application {app_id} published a tracking URL "
+                        f"without a host:port controller address: {url!r}"
+                    ) from None
+            if state in ("FAILED", "KILLED", "FINISHED"):
+                raise YarnError(
+                    f"application {app_id} went {state} during deploy: "
+                    f"{report.get('diagnostics', '')}"
+                )
+            if time.time() > deadline:
+                raise YarnError(
+                    f"application {app_id} still {state} after "
+                    f"{deploy_timeout_s}s"
+                )
+            time.sleep(0.2)
+
+
+class YarnClusterClient:
+    """Job submission against a deployed session (YarnClusterClient.java):
+    jobs go to the AM's controller over the normal control protocol;
+    ``shutdown_cluster`` kills the YARN application via the RM."""
+
+    def __init__(self, rest: YarnRestClient, app_id: str,
+                 controller_host: str, controller_port: int):
+        self.rest = rest
+        self.app_id = app_id
+        self.controller = (controller_host, controller_port)
+
+    def _control(self, msg: dict) -> dict:
+        from flink_tpu.runtime.cluster import control_request
+
+        resp = control_request(*self.controller, msg)
+        if not resp.get("ok", False):
+            raise YarnError(f"controller error: {resp.get('error')}")
+        return resp
+
+    def submit_job(self, builder_ref: str, job_name: str = "job",
+                   checkpoint_dir: str = "",
+                   extra_env: Optional[dict] = None) -> str:
+        return self._control({
+            "action": "submit", "builder": builder_ref,
+            "job_name": job_name, "checkpoint_dir": checkpoint_dir,
+            "extra_env": extra_env,
+        })["worker_id"]
+
+    def list_workers(self) -> List[dict]:
+        return self._control({"action": "list"})["workers"]
+
+    def wait_job(self, worker_id: str, timeout_s: float = 180.0) -> str:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            for w in self.list_workers():
+                if w["worker_id"] == worker_id and w["status"] in (
+                    "FINISHED", "FAILED", "DEAD"
+                ):
+                    return w["status"]
+            time.sleep(0.2)
+        raise TimeoutError(f"job {worker_id} not terminal in {timeout_s}s")
+
+    def app_report(self) -> dict:
+        return self.rest.app_report(self.app_id)
+
+    def shutdown_cluster(self, timeout_s: float = 30.0) -> dict:
+        self.rest.kill(self.app_id)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            report = self.rest.app_report(self.app_id)
+            if report["state"] in ("KILLED", "FINISHED", "FAILED"):
+                return report
+            time.sleep(0.2)
+        raise TimeoutError(f"application {self.app_id} did not stop")
+
+
+# --------------------------------------------------------------------------
+# In-repo spec ResourceManager (RM + NodeManager roles)
+# --------------------------------------------------------------------------
+@dataclass
+class _Container:
+    container_id: str
+    proc: subprocess.Popen
+    command: str
+    log_path: str
+    state: str = "RUNNING"      # RUNNING|COMPLETE
+    exit_status: Optional[int] = None
+
+
+@dataclass
+class _App:
+    app_id: str
+    name: str = ""
+    app_type: str = ""
+    state: str = "NEW"          # spec lifecycle subset:
+    #                             NEW->SUBMITTED->ACCEPTED->RUNNING->final
+    final_status: str = "UNDEFINED"
+    tracking_url: str = ""
+    diagnostics: str = ""
+    am: Optional[_Container] = None
+    containers: Dict[str, _Container] = field(default_factory=dict)
+    seq: int = 0
+
+
+class MiniYarnRM:
+    """In-repo Hadoop RM speaking the REST surface ``YarnRestClient``
+    targets, playing the NodeManager too: an accepted application's AM
+    command and every requested container command run as real OS
+    processes (env from the launch context over the RM's own env, logs
+    per container), so the glue is tested against real process
+    lifecycles, not fakes. Same pattern as MiniKafkaBroker /
+    MiniElasticsearch: the service is absent from the image, so the spec
+    is implemented in-repo and the real client is pointed at it."""
+
+    def __init__(self, workdir: str):
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.cluster_ts = int(time.time() * 1000)
+        self.apps: Dict[str, _App] = {}
+        self._new_seq = 0
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self.port: Optional[int] = None
+        # forks must come from a long-lived thread: PR_SET_PDEATHSIG
+        # fires when the forking THREAD exits, and HTTP handler threads
+        # are per-request (see ProcessCluster._spawner_loop)
+        self._spawn_q: queue.Queue = queue.Queue()
+        self._spawner = threading.Thread(
+            target=self._spawner_loop, daemon=True, name="miniyarn-spawner"
+        )
+        self._spawner.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        rm = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: Optional[dict] = None):
+                payload = json.dumps(body or {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self, method: str):
+                try:
+                    code, body = rm._dispatch(
+                        method, self.path, self._body()
+                    )
+                except KeyError as e:
+                    code, body = 404, {"RemoteException": {
+                        "message": f"not found: {e}",
+                    }}
+                except Exception as e:
+                    code, body = 400, {"RemoteException": {
+                        "message": str(e),
+                    }}
+                self._reply(code, body)
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="miniyarn-http",
+        ).start()
+        return self.port
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        with self._lock:
+            apps = list(self.apps.values())
+        for app in apps:
+            self._kill_app(app, "RM shutdown")
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._spawn_q.put(None)
+
+    # -- spawner (NodeManager ContainerExecutor role) --------------------
+    def _spawner_loop(self):
+        while True:
+            item = self._spawn_q.get()
+            if item is None:
+                return
+            command, env, log_path, box, ev = item
+            # GIL-atomic claim (ProcessCluster._spawner_loop protocol): a
+            # requester that timed out owns the box, and its container
+            # must not be forked — or must not outlive the abandonment —
+            # untracked by any _Container record
+            if box.setdefault("owner", "spawner") != "spawner":
+                ev.set()
+                continue
+            try:
+                log = open(log_path, "ab")
+                # ``exec``: the container process must BE the command, not
+                # a shell wrapping it — a SIGKILL aimed at the container
+                # otherwise kills only the shell and orphans the worker,
+                # which then runs CONCURRENTLY with its replacement
+                # (duplicate emissions). Launch contexts here are single
+                # commands, so exec is always legal. start_new_session
+                # gives each container its own process group so the kill
+                # paths can sweep descendants too.
+                proc = subprocess.Popen(
+                    ["/bin/sh", "-c", "exec " + command],
+                    env=env, stdout=log, stderr=log,
+                    start_new_session=True,
+                    preexec_fn=_die_with_parent,
+                )
+                if box.setdefault("result", "delivered") == "abandoned":
+                    proc.kill()
+                else:
+                    box["proc"] = proc
+            except Exception as e:
+                box["err"] = e
+            ev.set()
+
+    def _launch(self, app: _App, kind: str, command: str,
+                env_entries: Dict[str, str]) -> _Container:
+        with self._lock:
+            app.seq += 1
+            cid = (f"container_{self.cluster_ts}_"
+                   f"{app.app_id.rsplit('_', 1)[1]}_01_{app.seq:06d}")
+        cdir = os.path.join(self.workdir, app.app_id, cid)
+        os.makedirs(cdir, exist_ok=True)
+        env = dict(os.environ)
+        env.update(env_entries)
+        env["CONTAINER_ID"] = cid
+        log_path = os.path.join(cdir, f"{kind}.log")
+        box, ev = {}, threading.Event()
+        self._spawn_q.put((command, env, log_path, box, ev))
+        if not ev.wait(30):
+            if box.setdefault("owner", "caller") == "caller":
+                raise YarnError("container spawner unresponsive")
+            ev.wait(30)   # spawner claimed it concurrently: let it finish
+        if "err" in box:
+            raise YarnError(f"container launch failed: {box['err']}")
+        proc = box.get("proc")
+        if proc is None:
+            if box.setdefault("result", "abandoned") == "abandoned":
+                # the spawner kills the Popen if the fork ever lands
+                raise YarnError("container fork did not complete in time")
+            proc = box.get("proc")   # delivered in the race window
+            if proc is None:
+                raise YarnError("container spawn result lost")
+        return _Container(container_id=cid, proc=proc,
+                          command=command, log_path=log_path)
+
+    def _refresh(self, c: _Container):
+        if c.state == "RUNNING" and c.proc.poll() is not None:
+            c.state = "COMPLETE"
+            c.exit_status = c.proc.returncode
+
+    @staticmethod
+    def _kill_container(c: _Container):
+        """SIGKILL the container's whole process group (the container is
+        its own session), falling back to the direct child."""
+        try:
+            os.killpg(os.getpgid(c.proc.pid), 9)
+        except (ProcessLookupError, PermissionError, OSError):
+            c.proc.kill()
+        c.state = "COMPLETE"
+        c.exit_status = -137
+
+    def _kill_app(self, app: _App, why: str):
+        for c in ([app.am] if app.am else []) + list(
+            app.containers.values()
+        ):
+            self._refresh(c)
+            if c.state == "RUNNING":
+                self._kill_container(c)
+        if app.state not in ("FINISHED", "FAILED", "KILLED"):
+            app.state = "KILLED"
+            app.final_status = "KILLED"
+            app.diagnostics = why
+
+    # -- REST dispatch ---------------------------------------------------
+    def _dispatch(self, method: str, path: str, body: dict):
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] != ["ws", "v1"] or parts[2] != "cluster":
+            raise KeyError(path)
+        rest = parts[3:]
+        if rest == ["info"] and method == "GET":
+            return 200, {"clusterInfo": {
+                "id": self.cluster_ts, "state": "STARTED",
+                "resourceManagerVersion": "flink-tpu-mini",
+            }}
+        if rest == ["apps", "new-application"] and method == "POST":
+            with self._lock:
+                self._new_seq += 1
+                app_id = f"application_{self.cluster_ts}_{self._new_seq:04d}"
+                self.apps[app_id] = _App(app_id=app_id)
+            return 200, {
+                "application-id": app_id,
+                "maximum-resource-capability": {
+                    "memory": 8192, "vCores": 8,
+                },
+            }
+        if rest == ["apps"] and method == "POST":
+            return self._submit(body)
+        if len(rest) >= 2 and rest[0] == "apps":
+            app = self.apps[rest[1]]
+            return self._app_route(method, app, rest[2:], body)
+        raise KeyError(path)
+
+    def _submit(self, ctx: dict):
+        app = self.apps[ctx["application-id"]]   # KeyError -> 404
+        if app.state != "NEW":
+            raise ValueError(f"application already {app.state}")
+        app.name = ctx.get("application-name", "")
+        app.app_type = ctx.get("application-type", "")
+        spec = ctx["am-container-spec"]
+        command = spec["commands"]["command"]
+        env_entries = {
+            e["key"]: e["value"]
+            for e in spec.get("environment", {}).get("entry", [])
+        }
+        app.state = "ACCEPTED"
+        try:
+            app.am = self._launch(app, "am", command, env_entries)
+        except Exception as e:
+            app.state = "FAILED"
+            app.final_status = "FAILED"
+            app.diagnostics = str(e)
+            raise
+        return 202, {}
+
+    def _app_route(self, method: str, app: _App, rest: List[str],
+                   body: dict):
+        if rest == [] and method == "GET":
+            if app.am is not None:
+                self._refresh(app.am)
+                if app.am.state == "COMPLETE" and app.state in (
+                    "ACCEPTED", "RUNNING"
+                ):
+                    # AM death ends the application (max-app-attempts=1)
+                    ok = app.am.exit_status == 0
+                    app.state = "FINISHED" if ok else "FAILED"
+                    app.final_status = "SUCCEEDED" if ok else "FAILED"
+            return 200, {"app": {
+                "id": app.app_id, "name": app.name,
+                "applicationType": app.app_type, "state": app.state,
+                "finalStatus": app.final_status,
+                "trackingUrl": app.tracking_url,
+                "diagnostics": app.diagnostics,
+                "runningContainers": 1 + sum(
+                    1 for c in app.containers.values()
+                    if c.state == "RUNNING"
+                ) if app.state == "RUNNING" else 0,
+            }}
+        if rest == ["state"] and method == "PUT":
+            if body.get("state") != "KILLED":
+                raise ValueError(
+                    f"only KILLED is a valid target state, "
+                    f"got {body.get('state')!r}"
+                )
+            self._kill_app(app, "killed via REST state API")
+            return 202, {"state": app.state}
+        if rest == ["master"] and method == "POST":
+            app.tracking_url = body["trackingUrl"]
+            app.state = "RUNNING"
+            return 200, {}
+        if rest == ["finish"] and method == "POST":
+            app.final_status = body.get("finalStatus", "SUCCEEDED")
+            app.state = (
+                "FINISHED" if app.final_status == "SUCCEEDED" else "FAILED"
+            )
+            return 200, {}
+        if rest == ["containers"] and method == "POST":
+            if app.state != "RUNNING":
+                raise ValueError(
+                    f"containers can only be requested by a RUNNING "
+                    f"application (state={app.state})"
+                )
+            c = self._launch(app, "worker", body["command"],
+                             dict(body.get("environment") or {}))
+            app.containers[c.container_id] = c
+            return 200, {"container-id": c.container_id}
+        if rest == ["containers"] and method == "GET":
+            out = []
+            for c in app.containers.values():
+                self._refresh(c)
+                out.append(self._container_json(c))
+            return 200, {"containers": out}
+        if len(rest) == 2 and rest[0] == "containers":
+            c = app.containers[rest[1]]
+            self._refresh(c)
+            if method == "GET":
+                return 200, {"container": self._container_json(c)}
+            if method == "DELETE":
+                if c.state == "RUNNING":
+                    self._kill_container(c)
+                return 200, {}
+        raise KeyError("/".join(rest))
+
+    @staticmethod
+    def _container_json(c: _Container) -> dict:
+        return {
+            "id": c.container_id, "state": c.state,
+            "exitStatus": c.exit_status, "logUrl": c.log_path,
+        }
+
+
+# --------------------------------------------------------------------------
+# CLI (bin/yarn-session.sh analog, ref flink-yarn/.../cli/FlinkYarnSessionCli)
+# --------------------------------------------------------------------------
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="yarn-session",
+        description="Deploy a flink_tpu session cluster on YARN",
+    )
+    ap.add_argument("--rm", required=True,
+                    help="ResourceManager REST URL, e.g. http://rm:8088")
+    ap.add_argument("--name", default="flink-tpu-session")
+    ap.add_argument("--am-memory", type=int, default=2048)
+    ap.add_argument("--worker-memory", type=int, default=1024)
+    a = ap.parse_args(argv)
+    desc = YarnClusterDescriptor(
+        a.rm, am_resource={"memory": a.am_memory, "vCores": 1},
+        worker_resource={"memory": a.worker_memory, "vCores": 1},
+    )
+    client = desc.deploy_session_cluster(a.name)
+    print(json.dumps({
+        "application-id": client.app_id,
+        "controller": f"{client.controller[0]}:{client.controller[1]}",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,220 @@
+"""YARN deployment glue, end-to-end against the in-repo spec RM.
+
+The full reference loop (flink-yarn/): descriptor creates a YARN
+application and submits the AM container -> the AM starts the controller
+runtime and registers (AbstractYarnClusterDescriptor.java,
+YarnApplicationMasterRunner.java) -> jobs submitted through the session
+client run in worker containers requested from the RM
+(YarnFlinkResourceManager.java) -> a dead container is re-requested and
+the job resumes from its checkpoint -> killing the application tears
+down every process (YarnClusterClient.java shutdownCluster).
+
+MiniYarnRM launches AM/worker commands as REAL OS processes, so these
+are process-lifecycle tests, not protocol fakes (the MiniKafkaBroker
+pattern).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+from flink_tpu.deploy.yarn import (
+    MiniYarnRM,
+    YarnClusterDescriptor,
+    YarnError,
+    YarnRestClient,
+)
+
+JOBS = os.path.join(os.path.dirname(__file__), "process_jobs.py")
+BUILDER = f"{JOBS}:build_window_job"
+
+
+@pytest.fixture
+def rm(tmp_path):
+    m = MiniYarnRM(str(tmp_path / "yarn"))
+    m.start()
+    yield m
+    m.stop()
+
+
+def _wait(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# -------------------------------------------------------------- protocol
+def test_rest_protocol_surface(rm):
+    rest = YarnRestClient(rm.url)
+    info = rest.cluster_info()
+    assert info["state"] == "STARTED"
+
+    app = rest.new_application()
+    app_id = app["application-id"]
+    assert app_id.startswith("application_")
+    assert app["maximum-resource-capability"]["memory"] >= 1024
+
+    # unknown application -> 404 RemoteException
+    with pytest.raises(YarnError, match="404"):
+        rest.app_report("application_0_9999")
+
+    # submit a trivial AM that registers and sleeps
+    rest.submit_application({
+        "application-id": app_id,
+        "application-name": "proto-test",
+        "am-container-spec": {
+            "commands": {"command": (
+                "python -c \"import os,time,json,urllib.request;"
+                "u=os.environ['RM']+'/ws/v1/cluster/apps/'"
+                "+os.environ['APP']+'/master';"
+                "r=urllib.request.Request(u,"
+                "json.dumps({'trackingUrl':'127.0.0.1:1'}).encode(),"
+                "{'Content-Type':'application/json'});"
+                "urllib.request.urlopen(r); time.sleep(600)\""
+            )},
+            "environment": {"entry": [
+                {"key": "RM", "value": rm.url},
+                {"key": "APP", "value": app_id},
+            ]},
+        },
+        "resource": {"memory": 256, "vCores": 1},
+    })
+    _wait(lambda: rest.app_report(app_id)["state"] == "RUNNING",
+          30, "AM registration")
+    report = rest.app_report(app_id)
+    assert report["trackingUrl"] == "127.0.0.1:1"
+    assert report["name"] == "proto-test"
+
+    # double submission is rejected
+    with pytest.raises(YarnError, match="already"):
+        rest.submit_application({
+            "application-id": app_id,
+            "am-container-spec": {"commands": {"command": "true"}},
+        })
+
+    # only KILLED is a legal target state
+    with pytest.raises(YarnError, match="KILLED"):
+        rest._call("PUT", f"/ws/v1/cluster/apps/{app_id}/state",
+                   {"state": "RUNNING"})
+
+    rest.kill(app_id)
+    _wait(lambda: rest.app_report(app_id)["state"] == "KILLED",
+          10, "kill")
+    am = rm.apps[app_id].am
+    _wait(lambda: am.proc.poll() is not None, 10,
+          "AM process death after kill")
+
+
+def test_failed_am_command_fails_application(rm):
+    rest = YarnRestClient(rm.url)
+    app_id = rest.new_application()["application-id"]
+    rest.submit_application({
+        "application-id": app_id,
+        "am-container-spec": {"commands": {"command": "exit 3"}},
+    })
+    _wait(lambda: rest.app_report(app_id)["state"] == "FAILED",
+          30, "AM exit to fail the app")
+    assert rest.app_report(app_id)["finalStatus"] == "FAILED"
+
+
+# ------------------------------------------------------------ end-to-end
+def test_session_deploy_job_and_teardown(rm, tmp_path):
+    desc = YarnClusterDescriptor(rm.url)
+    client = desc.deploy_session_cluster("e2e-session")
+    assert client.app_report()["state"] == "RUNNING"
+
+    total = 20_000
+    out = str(tmp_path / "out")
+    wid = client.submit_job(
+        BUILDER, "yarn-job", str(tmp_path / "chk"),
+        extra_env={
+            "FLINK_TPU_TEST_OUT": out,
+            "FLINK_TPU_TEST_TOTAL": str(total),
+        },
+    )
+    assert client.wait_job(wid, timeout_s=180) == "FINISHED"
+
+    # the worker genuinely ran in a YARN container (its terminal status
+    # message races slightly ahead of the process exit, so poll)
+    containers = client.rest.list_containers(client.app_id)
+    assert len(containers) == 1
+    _wait(
+        lambda: client.rest.list_containers(client.app_id)[0]["state"]
+        == "COMPLETE",
+        15, "worker container exit",
+    )
+    assert client.rest.list_containers(client.app_id)[0]["exitStatus"] == 0
+
+    import sys
+    sys.path.insert(0, os.path.dirname(JOBS))
+    from process_jobs import expected_cells
+
+    cells = {}
+    import glob
+    for path in glob.glob(os.path.join(out, "**", "part-0"),
+                          recursive=True):
+        with open(path) as f:
+            for line in f:
+                k, wend, v = line.strip().split(",")
+                cells[(int(k), int(wend))] = (
+                    cells.get((int(k), int(wend)), 0.0) + float(v)
+                )
+    assert cells == expected_cells(total)
+
+    report = client.shutdown_cluster()
+    assert report["state"] == "KILLED"
+    am = rm.apps[client.app_id].am
+    _wait(lambda: am.proc.poll() is not None, 10, "AM teardown")
+
+
+def test_container_death_rerequests_and_job_recovers(rm, tmp_path):
+    desc = YarnClusterDescriptor(rm.url)
+    client = desc.deploy_session_cluster("recovery-session")
+    total = 120_000
+    out = str(tmp_path / "out")
+    chk = str(tmp_path / "chk")
+    wid = client.submit_job(
+        BUILDER, "recover-job", chk,
+        extra_env={
+            "FLINK_TPU_TEST_OUT": out,
+            "FLINK_TPU_TEST_TOTAL": str(total),
+            "FLINK_TPU_TEST_SLEEP_S": "0.05",   # keep it alive to kill
+        },
+    )
+    # wait for a durable checkpoint, then kill the container PROCESS out
+    # from under the AM (node failure, not a graceful stop)
+    import glob as _glob
+    _wait(lambda: _glob.glob(os.path.join(chk, "chk-*")), 120,
+          "first checkpoint")
+    first = client.rest.list_containers(client.app_id)[0]["id"]
+    app = rm.apps[client.app_id]
+    app.containers[first].proc.kill()
+
+    assert client.wait_job(wid, timeout_s=240) == "FINISHED"
+    containers = client.rest.list_containers(client.app_id)
+    assert len(containers) >= 2, (
+        "a replacement container must have been requested"
+    )
+
+    import sys
+    sys.path.insert(0, os.path.dirname(JOBS))
+    from process_jobs import expected_cells
+
+    cells, dups = {}, 0
+    for path in _glob.glob(os.path.join(out, "**", "part-0"),
+                           recursive=True):
+        with open(path) as f:
+            for line in f:
+                k, wend, v = line.strip().split(",")
+                cell = (int(k), int(wend))
+                if cell in cells:
+                    dups += 1
+                cells[cell] = cells.get(cell, 0.0) + float(v)
+    assert dups == 0, f"{dups} duplicate (key, window) emissions"
+    assert cells == expected_cells(total)
+    client.shutdown_cluster()

@@ -42,12 +42,17 @@ PYEOF
 }
 
 commit_artifacts() {
+  # pathspec'd commit: the builder may have unrelated work staged in the
+  # same repo while the watcher runs — sweep ONLY the bench artifacts
+  local files=()
   for f in BENCH_${ROUND}.json BENCH_SESSION_${ROUND}.json \
            BENCH_SESSION_${ROUND}.log BENCH_CONFIGS_${ROUND}.jsonl \
            BENCH_EXPLORE_${ROUND}.jsonl; do
-    [ -f "$f" ] && git add "$f"
+    [ -f "$f" ] && git add "$f" && files+=("$f")
   done
-  git diff --cached --quiet || git commit -m "$1" >&2
+  [ ${#files[@]} -gt 0 ] || return 0
+  git diff --cached --quiet -- "${files[@]}" || \
+      git commit -m "$1" -- "${files[@]}" >&2
 }
 
 fail_count() { grep -c "^$1\$" "$FAIL_STATE"; }
@@ -163,9 +168,16 @@ run_config() {  # $1 = config name, $2 = keep_best (refresh mode)
   fi
   echo "$(date -u +%FT%TZ) config $name failed/outage" >&2
   note_fail "cfg_$name"
-  if [ "$(fail_count "cfg_$name")" -ge "$MAX_UNIT_FAILS" ] && [ -n "$line" ]; then
-    # deterministic failure: record the error row so the ladder moves on
-    merge_config_row "$name" "$line"
+  if [ "$(fail_count "cfg_$name")" -ge "$MAX_UNIT_FAILS" ]; then
+    # deterministic failure: synthesize THIS config's error row (the raw
+    # failure line may be bench_configs' {"config": "all"} outage row,
+    # which would never satisfy have_config and gets purged on the next
+    # merge) so the ladder moves on with a durable record
+    local detail
+    detail=$(echo "$line" | python -c "import json,sys
+try: print(json.load(sys.stdin).get('error','')[:200])
+except Exception: print('')" 2>/dev/null)
+    merge_config_row "$name" "$(python -c "import json,sys; print(json.dumps({'config': sys.argv[1], 'error': 'capped after $MAX_UNIT_FAILS failures: ' + sys.argv[2]}))" "$name" "${detail:-no output}")"
     commit_artifacts "Record failing TPU config bench row: ${name} (${ROUND} watcher)"
   fi
   return 1
