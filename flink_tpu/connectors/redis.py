@@ -1,0 +1,371 @@
+"""Redis connector — the flink-connector-redis analog (SURVEY §2.8,
+ref flink-streaming-connectors/flink-connector-redis/RedisSink.java +
+common/mapper/RedisCommand.java + common/container/RedisContainer.java;
+the reference wraps the Jedis client library).
+
+This is a WIRE client: it speaks RESP2, the public REdis Serialization
+Protocol (inline framing ``*<n>\\r\\n`` arrays of ``$<len>\\r\\n`` bulk
+strings for requests; ``+simple``, ``-error``, ``:integer``, ``$bulk``
+and ``*array`` replies), implemented from the protocol spec — no redis
+client library.
+
+No Redis server exists in this image (zero egress), so tests run the
+client against ``MiniRedis`` below — an in-repo server implementing the
+same public RESP protocol on a real TCP socket over a small keyspace
+(strings, hashes, lists, sets, sorted sets, pub/sub counters). That
+proves the byte-level seam; against a genuine server only host:port
+changes.
+
+Semantics (the reference's):
+  * ``RedisSink`` writes one command per element through a
+    ``RedisMapper`` (command + key + value extraction —
+    RedisMapper.java's getCommandDescription/getKeyFromData/
+    getValueFromData triple);
+  * the command catalog matches RedisCommand.java: LPUSH RPUSH SADD
+    SET PFADD PUBLISH ZADD HSET, each bound to its data type so
+    misconfiguration fails fast (RedisCommandDescription.java validates
+    the additional-key requirement for HASH/SORTED_SET);
+  * at-least-once via flush-on-checkpoint (writes are synchronous
+    request/reply, so the sink is flushed at every invoke return);
+    exactly-once effect for SET/HSET/ZADD/SADD/PFADD through Redis's
+    native last-write-wins/set semantics — deterministic keys make
+    replay idempotent; LPUSH/RPUSH/PUBLISH replay at-least-once (the
+    reference documents the same split by data type).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from flink_tpu.runtime.sinks import Sink
+
+# command -> (data type, needs additional key) — RedisCommand.java +
+# RedisCommandDescription.java's validation table
+COMMANDS: Dict[str, Tuple[str, bool]] = {
+    "LPUSH": ("LIST", False),
+    "RPUSH": ("LIST", False),
+    "SADD": ("SET", False),
+    "SET": ("STRING", False),
+    "PFADD": ("HYPER_LOG_LOG", False),
+    "PUBLISH": ("PUBSUB", False),
+    "ZADD": ("SORTED_SET", True),
+    "HSET": ("HASH", True),
+}
+
+
+class RedisError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# RESP2 wire protocol
+# --------------------------------------------------------------------------
+def encode_command(*parts: str) -> bytes:
+    """Request framing: an array of bulk strings (RESP spec,
+    'Sending commands to a Redis server')."""
+    out = [b"*%d\r\n" % len(parts)]
+    for p in parts:
+        b = p.encode() if isinstance(p, str) else bytes(p)
+        out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+    return b"".join(out)
+
+
+class _Reader:
+    """Incremental RESP reply parser over a socket file."""
+
+    def __init__(self, rfile):
+        self.rfile = rfile
+
+    def _line(self) -> bytes:
+        line = self.rfile.readline()
+        if not line:
+            raise RedisError("connection closed mid-reply")
+        return line.rstrip(b"\r\n")
+
+    def read(self):
+        line = self._line()
+        kind, rest = line[:1], line[1:]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            body = self.rfile.read(n + 2)
+            return body[:-2].decode()
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self.read() for _ in range(n)]
+        raise RedisError(f"bad RESP type byte {kind!r}")
+
+
+class RedisConnection:
+    """One RESP connection (the Jedis-instance analog in
+    RedisContainer.java)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout_s)
+        self.rfile = self.sock.makefile("rb")
+        self._reader = _Reader(self.rfile)
+        self._lock = threading.Lock()
+
+    def execute(self, *parts: str):
+        with self._lock:
+            self.sock.sendall(encode_command(*parts))
+            return self._reader.read()
+
+    def close(self):
+        try:
+            self.rfile.close()
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------
+# Sink
+# --------------------------------------------------------------------------
+class RedisMapper:
+    """Command + key/value extraction triple (RedisMapper.java).
+    ``additional_key`` names the hash / sorted set that HSET / ZADD
+    target (RedisCommandDescription.java)."""
+
+    def __init__(self, command: str,
+                 key_from: Callable[[Any], str],
+                 value_from: Callable[[Any], str],
+                 additional_key: Optional[str] = None):
+        cmd = command.upper()
+        if cmd not in COMMANDS:
+            raise ValueError(
+                f"unknown redis command {command!r}; "
+                f"supported: {sorted(COMMANDS)}"
+            )
+        dtype, needs_extra = COMMANDS[cmd]
+        if needs_extra and additional_key is None:
+            # fail at construction, not on the hot path
+            raise ValueError(
+                f"{cmd} writes to a {dtype}: additional_key (the "
+                f"{dtype.lower()} name) is required"
+            )
+        self.command = cmd
+        self.data_type = dtype
+        self.key_from = key_from
+        self.value_from = value_from
+        self.additional_key = additional_key
+
+
+class RedisSink(Sink):
+    """Per-element command writes through a RedisMapper
+    (RedisSink.java invoke -> RedisCommandsContainer dispatch)."""
+
+    def __init__(self, host: str, port: int, mapper: RedisMapper):
+        self.host = host
+        self.port = port
+        self.mapper = mapper
+        self._conn: Optional[RedisConnection] = None
+
+    def open(self, ctx=None):
+        self._conn = RedisConnection(self.host, self.port)
+
+    def invoke_batch(self, elements):
+        if self._conn is None:
+            self.open()
+        m = self.mapper
+        for e in elements:
+            key, value = m.key_from(e), m.value_from(e)
+            if m.command == "ZADD":
+                # ZADD <set> <score> <member>: the mapped "value" is the
+                # score and the key is the member (RedisContainer.zadd)
+                self._conn.execute("ZADD", m.additional_key, value, key)
+            elif m.command == "HSET":
+                self._conn.execute("HSET", m.additional_key, key, value)
+            else:
+                self._conn.execute(m.command, key, value)
+
+    def close(self):
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+# --------------------------------------------------------------------------
+# In-repo spec server
+# --------------------------------------------------------------------------
+class _Simple(str):
+    """Marker: encode as a RESP simple string (+OK) rather than a bulk
+    string. A plain-``str`` reply is ALWAYS bulk-encoded — user data may
+    legitimately start with '+' or contain CRLF, and simple-string
+    framing would corrupt it / desync the connection."""
+
+
+class MiniRedis:
+    """In-repo RESP2 server over a real TCP socket: strings, hashes,
+    lists, sets, sorted sets, PFADD (exact-set stand-in), PUBLISH
+    (delivery counted), PING/ECHO/DEL/FLUSHALL and read-back commands
+    for tests. The MiniKafkaBroker pattern: the public protocol is the
+    test boundary, not a mock of the client."""
+
+    def __init__(self):
+        self.strings: Dict[str, str] = {}
+        self.hashes: Dict[str, Dict[str, str]] = {}
+        self.lists: Dict[str, List[str]] = {}
+        self.sets: Dict[str, set] = {}
+        self.zsets: Dict[str, Dict[str, float]] = {}
+        self.published: Dict[str, List[str]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self.port: Optional[int] = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        store = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                reader = _Reader(self.rfile)
+                while True:
+                    try:
+                        parts = reader.read()
+                    except RedisError:
+                        return
+                    if not isinstance(parts, list) or not parts:
+                        return
+                    try:
+                        reply = store._exec([str(p) for p in parts])
+                    except RedisError as e:
+                        reply = e
+                    except Exception as e:
+                        # malformed arguments (bad ZADD score, missing
+                        # args) answer -ERR like a real server instead of
+                        # killing the connection with a stack trace
+                        reply = RedisError(
+                            f"{type(e).__name__}: {e}"
+                        )
+                    self.wfile.write(store._encode_reply(reply))
+                    self.wfile.flush()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="miniredis").start()
+        return self.port
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @staticmethod
+    def _encode_reply(r) -> bytes:
+        if isinstance(r, RedisError):
+            return b"-ERR %s\r\n" % str(r).encode()
+        if isinstance(r, bool):
+            return b":%d\r\n" % int(r)
+        if isinstance(r, int):
+            return b":%d\r\n" % r
+        if r is None:
+            return b"$-1\r\n"
+        if isinstance(r, _Simple):
+            return b"+%s\r\n" % str(r).encode()
+        if isinstance(r, str):
+            b = r.encode()
+            return b"$%d\r\n%s\r\n" % (len(b), b)
+        if isinstance(r, list):
+            return b"*%d\r\n" % len(r) + b"".join(
+                MiniRedis._encode_reply(x) for x in r
+            )
+        raise TypeError(type(r))
+
+    def _exec(self, parts: List[str]):
+        cmd, args = parts[0].upper(), parts[1:]
+        with self._lock:
+            if cmd == "PING":
+                return _Simple("PONG")
+            if cmd == "ECHO":
+                return args[0]
+            if cmd == "SET":
+                self.strings[args[0]] = args[1]
+                return _Simple("OK")
+            if cmd == "GET":
+                return self.strings.get(args[0])
+            if cmd == "DEL":
+                n = 0
+                for k in args:
+                    for store in (self.strings, self.hashes, self.lists,
+                                  self.sets, self.zsets):
+                        if k in store:
+                            del store[k]
+                            n += 1
+                return n
+            if cmd == "FLUSHALL":
+                for store in (self.strings, self.hashes, self.lists,
+                              self.sets, self.zsets, self.published):
+                    store.clear()
+                return _Simple("OK")
+            if cmd == "HSET":
+                h = self.hashes.setdefault(args[0], {})
+                new = args[1] not in h
+                h[args[1]] = args[2]
+                return new
+            if cmd == "HGET":
+                return self.hashes.get(args[0], {}).get(args[1])
+            if cmd == "HGETALL":
+                out: List[str] = []
+                for k, v in self.hashes.get(args[0], {}).items():
+                    out.extend((k, v))
+                return out
+            if cmd in ("LPUSH", "RPUSH"):
+                lst = self.lists.setdefault(args[0], [])
+                for v in args[1:]:
+                    lst.insert(0, v) if cmd == "LPUSH" else lst.append(v)
+                return len(lst)
+            if cmd == "LRANGE":
+                lst = self.lists.get(args[0], [])
+                start, stop = int(args[1]), int(args[2])
+                stop = len(lst) if stop == -1 else stop + 1
+                return lst[start:stop]
+            if cmd in ("SADD", "PFADD"):
+                s = self.sets.setdefault(args[0], set())
+                n = sum(1 for v in args[1:] if v not in s)
+                s.update(args[1:])
+                return n
+            if cmd == "SCARD":
+                return len(self.sets.get(args[0], set()))
+            if cmd == "SMEMBERS":
+                return sorted(self.sets.get(args[0], set()))
+            if cmd == "ZADD":
+                z = self.zsets.setdefault(args[0], {})
+                n = 0
+                for score, member in zip(args[1::2], args[2::2]):
+                    if member not in z:
+                        n += 1
+                    z[member] = float(score)
+                return n
+            if cmd == "ZSCORE":
+                v = self.zsets.get(args[0], {}).get(args[1])
+                return None if v is None else repr(v) if v != int(v) \
+                    else str(int(v))
+            if cmd == "ZRANGE":
+                z = self.zsets.get(args[0], {})
+                members = sorted(z, key=lambda m: (z[m], m))
+                start, stop = int(args[1]), int(args[2])
+                stop = len(members) if stop == -1 else stop + 1
+                return members[start:stop]
+            if cmd == "PUBLISH":
+                self.published.setdefault(args[0], []).append(args[1])
+                return 1
+            raise RedisError(f"unknown command '{cmd}'")

@@ -90,7 +90,7 @@ class _YarnContainerHandle:
         second writer can start either way."""
         if self._exit is not None:
             return
-        for _ in range(5):
+        for _ in range(25):             # ~5s: covers a slow NM stop
             try:
                 self._rest.stop_container(self._app_id, self.container_id)
                 report = self._rest.container_report(
@@ -116,9 +116,31 @@ class YarnProcessCluster(ProcessCluster):
         self._worker_resource = worker_resource or {
             "memory": 1024, "vCores": 1,
         }
+        # worker_id -> last issued handle, for the replacement barrier
+        self._handles: dict = {}
 
     def _spawn_inner(self, worker_id, builder_ref, job_name,
                      checkpoint_dir, restore, extra_env=None):
+        # replacement barrier: NEVER request a new container for a worker
+        # whose previous container is not confirmed dead — kill() gives
+        # up quietly when the stop cannot be confirmed, and two live
+        # containers for one worker means two writers and duplicate
+        # emissions. Failing the spawn here surfaces as restart-failed
+        # (job FAILED) instead of silent corruption.
+        prior = self._handles.get(worker_id)
+        if prior is not None and prior.poll() is None:
+            deadline = time.time() + 15.0
+            while time.time() < deadline:
+                prior.kill()
+                if prior.poll() is not None:
+                    break
+                time.sleep(0.3)
+            if prior.poll() is None:
+                raise YarnError(
+                    f"previous container {prior.container_id} for "
+                    f"{worker_id} cannot be confirmed stopped; refusing "
+                    f"to start a concurrent replacement"
+                )
         cmd = [
             sys.executable, "-m", "flink_tpu.runtime.worker",
             "--controller", f"{self.advertise_host}:{self._port}",
@@ -142,7 +164,9 @@ class YarnProcessCluster(ProcessCluster):
         )
         self._event("container-requested", worker=worker_id,
                     container=cid)
-        return _YarnContainerHandle(self._rest, self._app_id, cid)
+        handle = _YarnContainerHandle(self._rest, self._app_id, cid)
+        self._handles[worker_id] = handle
+        return handle
 
 
 def main(argv=None) -> int:

@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import json
 import os
-import queue
 import shlex
 import subprocess
 import sys
@@ -48,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from flink_tpu.runtime.process_cluster import _die_with_parent
+from flink_tpu.runtime.spawner import AbandonableSpawner
 
 # environment keys the descriptor plants in the AM container spec, the
 # way the reference ships cluster coordinates through container env
@@ -350,12 +350,10 @@ class MiniYarnRM:
         self.port: Optional[int] = None
         # forks must come from a long-lived thread: PR_SET_PDEATHSIG
         # fires when the forking THREAD exits, and HTTP handler threads
-        # are per-request (see ProcessCluster._spawner_loop)
-        self._spawn_q: queue.Queue = queue.Queue()
-        self._spawner = threading.Thread(
-            target=self._spawner_loop, daemon=True, name="miniyarn-spawner"
-        )
-        self._spawner.start()
+        # are per-request (runtime/spawner.py has the full rationale and
+        # the abandoned-request claim protocol, shared with
+        # ProcessCluster)
+        self._spawner = AbandonableSpawner("miniyarn-spawner")
 
     # -- lifecycle -------------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -419,53 +417,15 @@ class MiniYarnRM:
 
     def stop(self):
         with self._lock:
-            apps = list(self.apps.values())
-        for app in apps:
-            self._kill_app(app, "RM shutdown")
+            for app in self.apps.values():
+                self._kill_app_locked(app, "RM shutdown")
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
-        self._spawn_q.put(None)
+        self._spawner.stop()
 
     # -- spawner (NodeManager ContainerExecutor role) --------------------
-    def _spawner_loop(self):
-        while True:
-            item = self._spawn_q.get()
-            if item is None:
-                return
-            command, env, log_path, box, ev = item
-            # GIL-atomic claim (ProcessCluster._spawner_loop protocol): a
-            # requester that timed out owns the box, and its container
-            # must not be forked — or must not outlive the abandonment —
-            # untracked by any _Container record
-            if box.setdefault("owner", "spawner") != "spawner":
-                ev.set()
-                continue
-            try:
-                log = open(log_path, "ab")
-                # ``exec``: the container process must BE the command, not
-                # a shell wrapping it — a SIGKILL aimed at the container
-                # otherwise kills only the shell and orphans the worker,
-                # which then runs CONCURRENTLY with its replacement
-                # (duplicate emissions). Launch contexts here are single
-                # commands, so exec is always legal. start_new_session
-                # gives each container its own process group so the kill
-                # paths can sweep descendants too.
-                proc = subprocess.Popen(
-                    ["/bin/sh", "-c", "exec " + command],
-                    env=env, stdout=log, stderr=log,
-                    start_new_session=True,
-                    preexec_fn=_die_with_parent,
-                )
-                if box.setdefault("result", "delivered") == "abandoned":
-                    proc.kill()
-                else:
-                    box["proc"] = proc
-            except Exception as e:
-                box["err"] = e
-            ev.set()
-
     def _launch(self, app: _App, kind: str, command: str,
                 env_entries: Dict[str, str]) -> _Container:
         with self._lock:
@@ -478,22 +438,30 @@ class MiniYarnRM:
         env.update(env_entries)
         env["CONTAINER_ID"] = cid
         log_path = os.path.join(cdir, f"{kind}.log")
-        box, ev = {}, threading.Event()
-        self._spawn_q.put((command, env, log_path, box, ev))
-        if not ev.wait(30):
-            if box.setdefault("owner", "caller") == "caller":
-                raise YarnError("container spawner unresponsive")
-            ev.wait(30)   # spawner claimed it concurrently: let it finish
-        if "err" in box:
-            raise YarnError(f"container launch failed: {box['err']}")
-        proc = box.get("proc")
-        if proc is None:
-            if box.setdefault("result", "abandoned") == "abandoned":
-                # the spawner kills the Popen if the fork ever lands
-                raise YarnError("container fork did not complete in time")
-            proc = box.get("proc")   # delivered in the race window
-            if proc is None:
-                raise YarnError("container spawn result lost")
+
+        def fork():
+            log = open(log_path, "ab")
+            # ``exec``: the container process must BE the command, not a
+            # shell wrapping it — a SIGKILL aimed at the container
+            # otherwise kills only the shell and orphans the worker,
+            # which then runs CONCURRENTLY with its replacement
+            # (duplicate emissions). Launch contexts here are single
+            # commands, so exec is always legal. start_new_session gives
+            # each container its own process group so the kill paths can
+            # sweep descendants too.
+            return subprocess.Popen(
+                ["/bin/sh", "-c", "exec " + command],
+                env=env, stdout=log, stderr=log,
+                start_new_session=True,
+                preexec_fn=_die_with_parent,
+            )
+
+        try:
+            proc = self._spawner.submit(
+                fork, on_abandon=lambda p: p.kill(), timeout_s=30,
+            )
+        except Exception as e:
+            raise YarnError(f"container launch failed: {e}") from None
         return _Container(container_id=cid, proc=proc,
                           command=command, log_path=log_path)
 
@@ -513,7 +481,11 @@ class MiniYarnRM:
         c.state = "COMPLETE"
         c.exit_status = -137
 
-    def _kill_app(self, app: _App, why: str):
+    def _kill_app_locked(self, app: _App, why: str):
+        """Caller holds ``self._lock``; killpg is fast enough to hold it
+        through the sweep, and flipping state under the same lock closes
+        the register-after-kill race (a /master arriving mid-kill must
+        not flip a KILLED app back to RUNNING)."""
         for c in ([app.am] if app.am else []) + list(
             app.containers.values()
         ):
@@ -555,93 +527,128 @@ class MiniYarnRM:
         raise KeyError(path)
 
     def _submit(self, ctx: dict):
-        app = self.apps[ctx["application-id"]]   # KeyError -> 404
-        if app.state != "NEW":
-            raise ValueError(f"application already {app.state}")
-        app.name = ctx.get("application-name", "")
-        app.app_type = ctx.get("application-type", "")
         spec = ctx["am-container-spec"]
         command = spec["commands"]["command"]
         env_entries = {
             e["key"]: e["value"]
             for e in spec.get("environment", {}).get("entry", [])
         }
-        app.state = "ACCEPTED"
+        with self._lock:
+            app = self.apps[ctx["application-id"]]   # KeyError -> 404
+            if app.state != "NEW":
+                raise ValueError(f"application already {app.state}")
+            app.name = ctx.get("application-name", "")
+            app.app_type = ctx.get("application-type", "")
+            app.state = "ACCEPTED"
+        # fork outside the lock (spawner round-trips up to 30s)
         try:
-            app.am = self._launch(app, "am", command, env_entries)
+            am = self._launch(app, "am", command, env_entries)
         except Exception as e:
-            app.state = "FAILED"
-            app.final_status = "FAILED"
-            app.diagnostics = str(e)
+            with self._lock:
+                app.state = "FAILED"
+                app.final_status = "FAILED"
+                app.diagnostics = str(e)
             raise
+        with self._lock:
+            if app.state == "ACCEPTED":
+                app.am = am
+            else:                     # killed while the AM was forking
+                self._kill_container(am)
         return 202, {}
 
     def _app_route(self, method: str, app: _App, rest: List[str],
                    body: dict):
         if rest == [] and method == "GET":
-            if app.am is not None:
-                self._refresh(app.am)
-                if app.am.state == "COMPLETE" and app.state in (
-                    "ACCEPTED", "RUNNING"
-                ):
-                    # AM death ends the application (max-app-attempts=1)
-                    ok = app.am.exit_status == 0
-                    app.state = "FINISHED" if ok else "FAILED"
-                    app.final_status = "SUCCEEDED" if ok else "FAILED"
-            return 200, {"app": {
-                "id": app.app_id, "name": app.name,
-                "applicationType": app.app_type, "state": app.state,
-                "finalStatus": app.final_status,
-                "trackingUrl": app.tracking_url,
-                "diagnostics": app.diagnostics,
-                "runningContainers": 1 + sum(
-                    1 for c in app.containers.values()
-                    if c.state == "RUNNING"
-                ) if app.state == "RUNNING" else 0,
-            }}
+            with self._lock:
+                if app.am is not None:
+                    self._refresh(app.am)
+                    if app.am.state == "COMPLETE" and app.state in (
+                        "ACCEPTED", "RUNNING"
+                    ):
+                        # AM death ends the app (max-app-attempts=1)
+                        ok = app.am.exit_status == 0
+                        app.state = "FINISHED" if ok else "FAILED"
+                        app.final_status = "SUCCEEDED" if ok else "FAILED"
+                return 200, {"app": {
+                    "id": app.app_id, "name": app.name,
+                    "applicationType": app.app_type, "state": app.state,
+                    "finalStatus": app.final_status,
+                    "trackingUrl": app.tracking_url,
+                    "diagnostics": app.diagnostics,
+                    "runningContainers": 1 + sum(
+                        1 for c in app.containers.values()
+                        if c.state == "RUNNING"
+                    ) if app.state == "RUNNING" else 0,
+                }}
         if rest == ["state"] and method == "PUT":
             if body.get("state") != "KILLED":
                 raise ValueError(
                     f"only KILLED is a valid target state, "
                     f"got {body.get('state')!r}"
                 )
-            self._kill_app(app, "killed via REST state API")
-            return 202, {"state": app.state}
+            with self._lock:
+                self._kill_app_locked(app, "killed via REST state API")
+                return 202, {"state": app.state}
         if rest == ["master"] and method == "POST":
-            app.tracking_url = body["trackingUrl"]
-            app.state = "RUNNING"
+            with self._lock:
+                # register is only legal while the submission is live —
+                # an AM whose app was killed mid-startup must not flip
+                # KILLED back to RUNNING (shutdown_cluster would spin)
+                if app.state != "ACCEPTED":
+                    raise ValueError(
+                        f"cannot register master: application is "
+                        f"{app.state}"
+                    )
+                app.tracking_url = body["trackingUrl"]
+                app.state = "RUNNING"
             return 200, {}
         if rest == ["finish"] and method == "POST":
-            app.final_status = body.get("finalStatus", "SUCCEEDED")
-            app.state = (
-                "FINISHED" if app.final_status == "SUCCEEDED" else "FAILED"
-            )
+            with self._lock:
+                if app.state in ("ACCEPTED", "RUNNING"):
+                    app.final_status = body.get(
+                        "finalStatus", "SUCCEEDED"
+                    )
+                    app.state = (
+                        "FINISHED" if app.final_status == "SUCCEEDED"
+                        else "FAILED"
+                    )
             return 200, {}
         if rest == ["containers"] and method == "POST":
-            if app.state != "RUNNING":
-                raise ValueError(
-                    f"containers can only be requested by a RUNNING "
-                    f"application (state={app.state})"
-                )
+            with self._lock:
+                if app.state != "RUNNING":
+                    raise ValueError(
+                        f"containers can only be requested by a RUNNING "
+                        f"application (state={app.state})"
+                    )
+            # fork outside the lock, re-check on insert
             c = self._launch(app, "worker", body["command"],
                              dict(body.get("environment") or {}))
-            app.containers[c.container_id] = c
+            with self._lock:
+                if app.state != "RUNNING":   # killed while forking
+                    self._kill_container(c)
+                    raise ValueError(
+                        f"application went {app.state} during the "
+                        f"container launch"
+                    )
+                app.containers[c.container_id] = c
             return 200, {"container-id": c.container_id}
         if rest == ["containers"] and method == "GET":
-            out = []
-            for c in app.containers.values():
-                self._refresh(c)
-                out.append(self._container_json(c))
-            return 200, {"containers": out}
+            with self._lock:
+                out = []
+                for c in app.containers.values():
+                    self._refresh(c)
+                    out.append(self._container_json(c))
+                return 200, {"containers": out}
         if len(rest) == 2 and rest[0] == "containers":
-            c = app.containers[rest[1]]
-            self._refresh(c)
-            if method == "GET":
-                return 200, {"container": self._container_json(c)}
-            if method == "DELETE":
-                if c.state == "RUNNING":
-                    self._kill_container(c)
-                return 200, {}
+            with self._lock:
+                c = app.containers[rest[1]]
+                self._refresh(c)
+                if method == "GET":
+                    return 200, {"container": self._container_json(c)}
+                if method == "DELETE":
+                    if c.state == "RUNNING":
+                        self._kill_container(c)
+                    return 200, {}
         raise KeyError("/".join(rest))
 
     @staticmethod

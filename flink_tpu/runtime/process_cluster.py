@@ -31,7 +31,6 @@ from __future__ import annotations
 import ctypes
 import json
 import os
-import queue
 import signal as _signal
 import socketserver
 import subprocess
@@ -120,60 +119,18 @@ class ProcessCluster:
         )
         self.leadership = threading.Event()
         self.failed = threading.Event()    # leadership won but serving died
-        # PR_SET_PDEATHSIG fires when the FORKING THREAD dies, not the
-        # process — spawning from a short-lived request-handler (or
-        # election) thread would SIGKILL the worker the moment that
-        # thread exits. All forks therefore run on this one long-lived
-        # spawner thread, whose lifetime is the controller's.
-        self._spawn_q: queue.Queue = queue.Queue()
-        threading.Thread(
-            target=self._spawner_loop, daemon=True,
-            name="process-cluster-spawner",
-        ).start()
+        # all forks run on one long-lived spawner thread, whose lifetime
+        # is the controller's — see runtime/spawner.py for why (PDEATHSIG
+        # thread semantics + the abandoned-request claim protocol)
+        from flink_tpu.runtime.spawner import AbandonableSpawner
 
-    def _spawner_loop(self):
-        while True:
-            item = self._spawn_q.get()
-            if item is None:
-                return
-            args, kw, box, ev = item
-            # GIL-atomic claim: a caller that timed out owns the box and
-            # the request must NOT fork (an abandoned Popen would run the
-            # job untracked)
-            if box.setdefault("owner", "spawner") != "spawner":
-                ev.set()
-                continue
-            try:
-                proc = self._spawn_inner(*args, **kw)
-                # second claim point: a caller that timed out AFTER we
-                # claimed the request owns "result" — its worker must not
-                # outlive the abandonment untracked
-                if box.setdefault("result", "delivered") == "abandoned":
-                    proc.kill()
-                else:
-                    box["proc"] = proc
-            except Exception as e:   # surfaced to the requesting thread
-                box["err"] = e
-            ev.set()
+        self._spawner = AbandonableSpawner("process-cluster-spawner")
 
     def _spawn(self, *args, **kw) -> subprocess.Popen:
-        box, ev = {}, threading.Event()
-        self._spawn_q.put((args, kw, box, ev))
-        if not ev.wait(60):
-            if box.setdefault("owner", "caller") == "caller":
-                raise TimeoutError("spawner thread unresponsive")
-            ev.wait(60)   # spawner claimed it concurrently: let it finish
-        if "err" in box:
-            raise box["err"]
-        proc = box.get("proc")
-        if proc is None:
-            if box.setdefault("result", "abandoned") == "abandoned":
-                # the spawner will kill the Popen if the fork ever lands
-                raise TimeoutError("fork did not complete in time")
-            proc = box.get("proc")   # delivered in the race window
-            if proc is None:
-                raise TimeoutError("spawn result lost")
-        return proc
+        return self._spawner.submit(
+            lambda: self._spawn_inner(*args, **kw),
+            on_abandon=lambda proc: proc.kill(),
+        )
 
     # -- control server ---------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0,
@@ -294,7 +251,7 @@ class ProcessCluster:
     def shutdown(self):
         self._stop.set()
         self.election.stop()
-        self._spawn_q.put(None)   # stop the spawner thread
+        self._spawner.stop()
         with self._lock:
             recs = list(self.workers.values())
         for rec in recs:

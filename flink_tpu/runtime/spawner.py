@@ -1,0 +1,92 @@
+"""Long-lived fork thread with the abandoned-request claim protocol.
+
+Two independent constraints force every process fork through ONE
+long-lived thread:
+
+  * ``PR_SET_PDEATHSIG`` fires when the forking THREAD dies, not the
+    process — forking from a short-lived request-handler thread would
+    SIGKILL the child the moment that thread exits
+    (``process_cluster._die_with_parent``).
+  * a requester that times out must either prevent the fork or
+    guarantee the forked process does not outlive the abandonment
+    untracked — otherwise a job/container runs with no record owning
+    it.
+
+The claim protocol (two GIL-atomic ``setdefault`` points) resolves the
+requester/spawner race in both windows: before the fork ("owner") and
+after it ("result"). Extracted from ``ProcessCluster`` so its one
+subtle concurrency dance has exactly one implementation; the YARN
+MiniYarnRM NodeManager role reuses it for container launches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+
+class AbandonableSpawner:
+    """Runs fork callables on one long-lived thread; abandoned results
+    are destroyed via the request's ``on_abandon`` callback."""
+
+    def __init__(self, name: str = "spawner"):
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, on_abandon, box, ev = item
+            # GIL-atomic claim: a caller that timed out owns the box and
+            # the request must NOT fork (an abandoned child would run
+            # untracked)
+            if box.setdefault("owner", "spawner") != "spawner":
+                ev.set()
+                continue
+            try:
+                res = fn()
+                # second claim point: a caller that timed out AFTER the
+                # fork owns "result" — its child must not outlive the
+                # abandonment untracked
+                if box.setdefault("result", "delivered") == "abandoned":
+                    if on_abandon is not None:
+                        on_abandon(res)
+                else:
+                    box["res"] = res
+            except Exception as e:   # surfaced to the requesting thread
+                box["err"] = e
+            ev.set()
+
+    def submit(self, fn: Callable[[], Any],
+               on_abandon: Optional[Callable[[Any], None]] = None,
+               timeout_s: float = 60.0) -> Any:
+        """Run ``fn`` on the spawner thread; return its result or raise
+        its exception. On timeout the request is abandoned: either the
+        fork never happens, or ``on_abandon(result)`` destroys it."""
+        box: dict = {}
+        ev = threading.Event()
+        self._q.put((fn, on_abandon, box, ev))
+        if not ev.wait(timeout_s):
+            if box.setdefault("owner", "caller") == "caller":
+                raise TimeoutError("spawner thread unresponsive")
+            ev.wait(timeout_s)   # spawner claimed it concurrently
+        if "err" in box:
+            raise box["err"]
+        res = box.get("res")
+        if res is None:
+            if box.setdefault("result", "abandoned") == "abandoned":
+                # the spawner destroys the result if the fork ever lands
+                raise TimeoutError("fork did not complete in time")
+            res = box.get("res")   # delivered in the race window
+            if res is None:
+                raise TimeoutError("spawn result lost")
+        return res
+
+    def stop(self):
+        self._q.put(None)

@@ -109,7 +109,9 @@ def test_join_cost_model_builds_small_side_and_explains():
     )
     rows = sorted(joined.collect())
     assert rows == [(i * 100, f"L{i * 100}", f"R{i}") for i in range(5)]
-    assert joined.strategy == "hash build-right"   # small side built
+    # the round-5 ship/local planner prefixes the ship strategy; the
+    # local strategy must still build the small side
+    assert joined.strategy.endswith("hash build-right")  # small side built
     plan = joined.explain()
     assert "inner_join" in plan and "hash build-right" in plan
 
@@ -119,7 +121,7 @@ def test_join_cost_model_builds_small_side_and_explains():
         .apply(lambda l, r: (l[0],))
     )
     j2.collect()
-    assert j2.strategy == "hash build-left"
+    assert j2.strategy.endswith("hash build-left")
 
     # hint overrides the cost model
     j3 = (
