@@ -80,17 +80,23 @@ class _YarnContainerHandle:
                 self._exit = -1
         return self._exit
 
-    def kill(self):
+    def kill(self, budget_s: float = 6.0):
         """Stop the container and CONFIRM it stopped before recording an
         exit. Pretending an unconfirmed kill succeeded would let the
         restart loop respawn a replacement while the old worker still
         runs — two writers, duplicate emissions. If the RM is
         unreachable the exit stays unrecorded; the subsequent respawn's
         ``request_container`` fails against the same dead RM, so no
-        second writer can start either way."""
+        second writer can start either way.
+
+        Wall-clock budgeted, NOT iteration-counted: against a hung RM
+        every HTTP call burns its own 2s timeout, and kill() runs on the
+        single shared spawner thread — an unbounded loop there would
+        stall every other worker's respawn behind one stuck stop."""
         if self._exit is not None:
             return
-        for _ in range(25):             # ~5s: covers a slow NM stop
+        deadline = time.time() + budget_s
+        while time.time() < deadline:
             try:
                 self._rest.stop_container(self._app_id, self.container_id)
                 report = self._rest.container_report(
@@ -131,7 +137,11 @@ class YarnProcessCluster(ProcessCluster):
         if prior is not None and prior.poll() is None:
             deadline = time.time() + 15.0
             while time.time() < deadline:
-                prior.kill()
+                # cap each kill attempt so the barrier's own deadline is
+                # honored even against a hung RM (kill() runs HTTP calls)
+                prior.kill(
+                    budget_s=min(3.0, max(0.5, deadline - time.time()))
+                )
                 if prior.poll() is not None:
                     break
                 time.sleep(0.3)

@@ -545,9 +545,10 @@ class MiniYarnRM:
             am = self._launch(app, "am", command, env_entries)
         except Exception as e:
             with self._lock:
-                app.state = "FAILED"
-                app.final_status = "FAILED"
-                app.diagnostics = str(e)
+                if app.state == "ACCEPTED":   # a concurrent kill wins
+                    app.state = "FAILED"
+                    app.final_status = "FAILED"
+                    app.diagnostics = str(e)
             raise
         with self._lock:
             if app.state == "ACCEPTED":

@@ -1414,8 +1414,9 @@ class LocalExecutor:
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
-            nonlocal host_fired_pane
+            nonlocal host_fired_pane, applied_max_pane
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
+            applied_max_pane = None      # re-armed from the snapshot below
             # restored table contents differ from the running population:
             # re-enter insert mode until the lagged signal proves quiet
             step_mode[0] = "insert"
@@ -1438,6 +1439,14 @@ class LocalExecutor:
             entries, scalars, offsets, aux = st.read(cid)
             if (aux["size_ms"], aux["slide_ms"]) != (size_ms, slide_ms):
                 raise ValueError("checkpoint window spec mismatch")
+            # re-arm the between-polls jump guard from the snapshot: the
+            # restored ring holds unfired panes up to this id, and the
+            # first post-restore batch may arrive after an arbitrary
+            # event-time gap (the resume-after-gap scenario is exactly a
+            # restore) — with the guard disarmed it would rotate the ring
+            # over them
+            if len(entries["pane"]):
+                applied_max_pane = int(entries["pane"].max())
             # resume in the layout the snapshot was taken with (auto only;
             # an explicit config wins): an auto-direct run restored as
             # "hash" would upsert a dense key population into a table at
@@ -2169,6 +2178,10 @@ class LocalExecutor:
         # records can make already-fired windows due again at ANY step, so
         # fires are drained eagerly every cycle (matching round-1 timing).
         host_fired_pane = -(2**62)
+        # newest pane the ring has absorbed; guards the BETWEEN-polls time
+        # jump (see the pre-fire in poll_cycle — the catch-up slicing only
+        # covers a jump WITHIN one poll)
+        applied_max_pane = None
         eager_fire = wagg.allowed_lateness_ms > 0
 
         def wm_pane_of(wm_ms) -> int:
@@ -2314,7 +2327,7 @@ class LocalExecutor:
             return item
 
         def poll_cycle():
-            nonlocal td, host_fired_pane
+            nonlocal td, host_fired_pane, applied_max_pane
             self._poll_control()
             t_c0 = time.perf_counter()
             phase_acc["dispatch"] = phase_acc["emit"] = 0.0
@@ -2352,9 +2365,15 @@ class LocalExecutor:
                 values = np.asarray(values)
                 # A batch spanning more panes than the ring holds (replay /
                 # catch-up) must be time-sliced, or fresh panes would evict
-                # unfired ones. Slice so each sub-step spans <= ring-2 panes.
+                # unfired ones. The span bound leaves size/slide panes of
+                # headroom (not just 2): every pane the rotation can evict
+                # must have ALL of its windows end below the group's min
+                # pane, so the safe pre-fire between groups (below) can
+                # close them without touching windows the group feeds.
                 panes = ticks // np.int32(win.slide_ticks)
-                span_limit = win.ring - 2
+                span_limit = win.ring - max(
+                    2, int(win.size_ticks // win.slide_ticks) + 1
+                )
                 if int(panes.max()) - int(panes.min()) >= span_limit:
                     order = np.argsort(panes, kind="stable")
                     sorted_panes = panes[order]
@@ -2386,6 +2405,44 @@ class LocalExecutor:
                         g_wm = min(
                             td.to_ms(int(g_ticks.max())) - ooo_ms - 1, wm_ms
                         )
+                    # BETWEEN-polls time jump: if this group's panes sit
+                    # ahead of everything the ring has absorbed, applying
+                    # them could rotate the ring past still-unfired panes
+                    # — fire those panes' windows FIRST. (The catch-up
+                    # slicing above only bounds the span WITHIN one poll;
+                    # a quiet source resuming after an event-time gap —
+                    # or a processing-time job resuming after a
+                    # compile/GC pause — jumps between polls instead.)
+                    # The pre-fire watermark is capped at the group's min
+                    # pane boundary: a window ending there or earlier
+                    # receives NOTHING from this group, so firing it
+                    # before the update cannot split a window's records
+                    # across two emissions; capping at g_wm keeps the
+                    # watermark contract (nothing past the out-of-
+                    # orderness horizon closes early). Every pane the
+                    # rotation can evict ends all its windows below BOTH
+                    # caps — by the span bound above and the ring's
+                    # ooo-panes headroom (setup()) — so eviction only
+                    # ever discards already-fired state. Threshold 2:
+                    # steady-state polls advance at most one pane, so the
+                    # hot path never pays an extra drain.
+                    g_max_pane = int(g_ticks.max()) // int(win.slide_ticks)
+                    if (
+                        applied_max_pane is not None
+                        and g_max_pane - applied_max_pane >= 2
+                    ):
+                        g_min_pane = (
+                            int(g_ticks.min()) // int(win.slide_ticks)
+                        )
+                        fire_wm = min(
+                            g_wm,
+                            td.to_ms(g_min_pane * int(win.slide_ticks)) - 1,
+                        )
+                        drain_fires(fire_wm, time.perf_counter())
+                    applied_max_pane = (
+                        g_max_pane if applied_max_pane is None
+                        else max(applied_max_pane, g_max_pane)
+                    )
                     # a host chain (flat_map) can expand one poll beyond B
                     # lanes; feed the step in B-sized chunks padded to the
                     # step lane count (B_step > B only when the exchange

@@ -78,15 +78,16 @@ class AbandonableSpawner:
             ev.wait(timeout_s)   # spawner claimed it concurrently
         if "err" in box:
             raise box["err"]
-        res = box.get("res")
-        if res is None:
+        # presence-keyed, not value-keyed: fn may legitimately return None
+        if "res" not in box:
             if box.setdefault("result", "abandoned") == "abandoned":
                 # the spawner destroys the result if the fork ever lands
                 raise TimeoutError("fork did not complete in time")
-            res = box.get("res")   # delivered in the race window
-            if res is None:
+            # spawner claimed delivery first: it stores res then sets ev
+            ev.wait(5)
+            if "res" not in box:
                 raise TimeoutError("spawn result lost")
-        return res
+        return box["res"]
 
     def stop(self):
         self._q.put(None)

@@ -1,0 +1,174 @@
+"""Inter-poll event-time jumps must not evict unfired pane state.
+
+Round-5 regression: the catch-up time-slicing bounds the pane span
+WITHIN one poll, but a time gap BETWEEN polls (a quiet source resuming
+after an event-time gap; a processing-time job resuming after a
+compile/GC pause) rotated the pane ring past still-unfired panes — one
+pane of ACCUMULATED per-key state vanished, with only the state-entry
+count surfacing in dropped_capacity. The executor now pre-fires due
+panes before applying a group that jumps the ring
+(executor.py poll_cycle; ref WindowOperator.java:222's
+processElement-then-timer ordering, where pending window state can
+never be destroyed by later elements).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+
+
+def _run(win_ms, slide_ms, gen, total, batch=8192, ooo_ms=None):
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(4096)
+    env.batch_size = batch
+    sink = CollectSink()
+    stream = env.add_source(GeneratorSource(gen, total=total))
+    if ooo_ms is not None:
+        from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+        stream = stream.assign_timestamps_and_watermarks(
+            lambda c: c["ts"],
+            WatermarkStrategy.for_bounded_out_of_orderness(ooo_ms),
+        )
+    stream = stream.key_by(lambda c: c["key"])
+    if slide_ms == win_ms:
+        w = stream.time_window(win_ms)
+    else:
+        w = stream.time_window(win_ms, slide_ms)
+    w.sum(lambda c: c["value"]).add_sink(sink)
+    job = env.execute("time-gap")
+    return sink.results, job.metrics
+
+
+@pytest.mark.parametrize("gap_ms", [30_000, 300_000])
+def test_tumbling_survives_inter_poll_gap(gap_ms):
+    """A mid-stream gap far larger than the pane ring: every record
+    before AND after the gap must be emitted exactly once."""
+    total, n_keys, win = 60_000, 50, 1000
+    jump_at = 30_000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        ts = idx // 20
+        ts = np.where(idx >= jump_at, ts + gap_ms, ts)
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32)},
+                ts.astype(np.int64))
+
+    results, metrics = _run(win, win, gen, total)
+    assert metrics.dropped_capacity == 0
+    assert metrics.dropped_late == 0
+    assert sum(float(r.value) for r in results) == float(total)
+    # exact per-cell totals
+    cells = {}
+    for r in results:
+        cells[(int(r.key), int(r.window_end_ms))] = (
+            cells.get((int(r.key), int(r.window_end_ms)), 0.0)
+            + float(r.value)
+        )
+    exp = {}
+    for i in range(total):
+        t = i // 20 + (gap_ms if i >= jump_at else 0)
+        cell = (i % n_keys, (t // win + 1) * win)
+        exp[cell] = exp.get(cell, 0.0) + 1.0
+    assert cells == exp
+
+
+def test_sliding_windows_all_fire_across_gap():
+    """Sliding windows: each pre-gap pane participates in size/slide
+    windows; all of them must fire before the jump rotates the ring."""
+    total, n_keys = 40_000, 20
+    win, slide = 2000, 500
+    jump_at, gap_ms = 20_000, 60_000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        ts = idx // 10
+        ts = np.where(idx >= jump_at, ts + gap_ms, ts)
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32)},
+                ts.astype(np.int64))
+
+    results, metrics = _run(win, slide, gen, total)
+    assert metrics.dropped_capacity == 0
+    # each record belongs to size/slide = 4 windows
+    assert sum(float(r.value) for r in results) == float(total) * 4
+    cells = {}
+    for r in results:
+        cells[(int(r.key), int(r.window_end_ms))] = (
+            cells.get((int(r.key), int(r.window_end_ms)), 0.0)
+            + float(r.value)
+        )
+    exp = {}
+    for i in range(total):
+        t = i // 10 + (gap_ms if i >= jump_at else 0)
+        pane = t // slide
+        for w in range(4):   # windows ending at (pane+1+w)*slide
+            cell = (i % n_keys, (pane + 1 + w) * slide)
+            exp[cell] = exp.get(cell, 0.0) + 1.0
+    assert cells == exp
+
+
+def test_mid_size_gap_inside_ring_span_with_out_of_orderness():
+    """The review-flagged band: a jump LARGER than the unfired horizon
+    but SMALLER than the ring span. With 1s windows and 10s
+    out-of-orderness the ring is ~14 panes; a 6-pane jump rotated
+    unfired panes out under the original span_limit-sized threshold
+    while never triggering the pre-fire. The >=2-pane threshold fires
+    first."""
+    total, n_keys, win = 40_000, 25, 1000
+    jump_at, gap_ms = 20_000, 6_000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        ts = idx // 20
+        ts = np.where(idx >= jump_at, ts + gap_ms, ts)
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32),
+                 "ts": ts.astype(np.int64)},
+                ts.astype(np.int64))
+
+    results, metrics = _run(win, win, gen, total, ooo_ms=10_000)
+    assert metrics.dropped_capacity == 0
+    assert metrics.dropped_late == 0
+    assert sum(float(r.value) for r in results) == float(total)
+
+
+def test_sliding_mid_size_gap():
+    """Sliding windows, jump of ~6 panes: below the old span_limit
+    threshold (2*size/slide + 2 = 10) but beyond the safe horizon
+    (size/slide + 1 = 5)."""
+    total, n_keys = 30_000, 15
+    win, slide = 2000, 500
+    jump_at, gap_ms = 15_000, 3_000   # 6 panes of 500ms
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        ts = idx // 10
+        ts = np.where(idx >= jump_at, ts + gap_ms, ts)
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32)},
+                ts.astype(np.int64))
+
+    results, metrics = _run(win, slide, gen, total)
+    assert metrics.dropped_capacity == 0
+    assert sum(float(r.value) for r in results) == float(total) * 4
+
+
+def test_repeated_gaps():
+    """Several successive jumps, each larger than the ring."""
+    total, n_keys, win = 50_000, 25, 1000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        # a 20s jump every 10k records
+        ts = idx // 20 + (idx // 10_000) * 20_000
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32)},
+                ts.astype(np.int64))
+
+    results, metrics = _run(win, win, gen, total)
+    assert metrics.dropped_capacity == 0
+    assert metrics.dropped_late == 0
+    assert sum(float(r.value) for r in results) == float(total)
