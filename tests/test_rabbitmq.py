@@ -1,0 +1,281 @@
+"""RabbitMQ connector: AMQP 0-9-1 wire client vs the in-repo MiniRabbit
+broker over real TCP; checkpoint-gated acks; correlation-id
+exactly-once across a crash (the reference's RMQSource contract).
+
+Ref flink-streaming-connectors/flink-connector-rabbitmq: RMQSource.java
+(MultipleIdsMessageAcknowledgingSourceBase — tags acked on checkpoint
+complete, ids dedupe redelivery), RMQSink.java.
+"""
+
+import time
+
+import pytest
+
+from flink_tpu.connectors.rabbitmq import (
+    AMQPConnection,
+    MiniRabbit,
+    RMQSink,
+    RMQSource,
+)
+
+
+@pytest.fixture
+def broker():
+    b = MiniRabbit()
+    b.start()
+    yield b
+    b.stop()
+
+
+def _drain(conn, n, timeout_s=10.0):
+    got = []
+    deadline = time.time() + timeout_s
+    while len(got) < n and time.time() < deadline:
+        got.extend(conn.drain_deliveries())
+        time.sleep(0.01)
+    return got
+
+
+# ------------------------------------------------------------------ wire
+def test_publish_consume_roundtrip(broker):
+    pub = AMQPConnection("127.0.0.1", broker.port)
+    pub.queue_declare("q1")
+    for i in range(20):
+        pub.basic_publish("q1", f"msg-{i}".encode(),
+                          correlation_id=f"id-{i}")
+
+    sub = AMQPConnection("127.0.0.1", broker.port)
+    sub.queue_declare("q1")
+    sub.basic_consume("q1")
+    got = _drain(sub, 20)
+    assert [d["body"].decode() for d in got] == [
+        f"msg-{i}" for i in range(20)
+    ]
+    assert [d["correlation_id"] for d in got] == [
+        f"id-{i}" for i in range(20)
+    ]
+    assert all(not d["redelivered"] for d in got)
+    sub.basic_ack(got[-1]["delivery_tag"], multiple=True)
+    time.sleep(0.1)
+    pub.close()
+    sub.close()
+
+
+def test_unacked_requeue_on_disconnect_with_redelivered_flag(broker):
+    pub = AMQPConnection("127.0.0.1", broker.port)
+    pub.queue_declare("q2")
+    for i in range(10):
+        pub.basic_publish("q2", f"m{i}".encode(), correlation_id=f"c{i}")
+
+    sub1 = AMQPConnection("127.0.0.1", broker.port)
+    sub1.queue_declare("q2")
+    sub1.basic_consume("q2")
+    got1 = _drain(sub1, 10)
+    assert len(got1) == 10
+    # ack only the first 4, then die
+    sub1.basic_ack(got1[3]["delivery_tag"], multiple=True)
+    time.sleep(0.2)          # let the ack land before the hangup
+    sub1.close()
+    time.sleep(0.2)          # broker notices + requeues
+
+    sub2 = AMQPConnection("127.0.0.1", broker.port)
+    sub2.queue_declare("q2")
+    sub2.basic_consume("q2")
+    got2 = _drain(sub2, 6)
+    assert sorted(d["body"].decode() for d in got2) == [
+        f"m{i}" for i in range(4, 10)
+    ]
+    assert all(d["redelivered"] for d in got2)
+    pub.close()
+    sub2.close()
+
+
+def test_large_and_empty_bodies_and_extra_properties(broker):
+    """Interop band: a body larger than frame_max crosses as several
+    body frames; a zero-length body has NO body frame; a header whose
+    flag word carries properties besides correlation-id still parses
+    the right id (properties serialize in descending flag-bit order)."""
+    from flink_tpu.connectors.rabbitmq import (
+        BASIC,
+        FRAME_BODY,
+        PROP_CORRELATION_ID,
+        content_header,
+        frame,
+        method,
+        shortstr,
+        struct,
+    )
+
+    pub = AMQPConnection("127.0.0.1", broker.port)
+    pub.queue_declare("big")
+    big = bytes(range(256)) * 1024          # 256 KiB > frame_max 128 KiB
+    pub.basic_publish("big", big, correlation_id="big-1")
+    pub.basic_publish("big", b"", correlation_id="empty-1")
+    # hand-rolled publish with content-type + delivery-mode + priority
+    # set IN ADDITION to correlation-id (what pika emits routinely)
+    flags = (1 << 15) | (1 << 12) | (1 << 11) | PROP_CORRELATION_ID
+    props = (shortstr("text/plain")        # content-type   (bit 15)
+             + bytes([2])                  # delivery-mode  (bit 12)
+             + bytes([5])                  # priority       (bit 11)
+             + shortstr("props-1"))        # correlation-id (bit 10)
+    header = frame(
+        2, AMQPConnection.CHANNEL_ID,
+        struct.pack(">HHQH", BASIC, 0, 5, flags) + props,
+    )
+    pub._send(
+        method(AMQPConnection.CHANNEL_ID, BASIC, 40,
+               struct.pack(">H", 0) + shortstr("") + shortstr("big")
+               + b"\x00")
+        + header + frame(FRAME_BODY, AMQPConnection.CHANNEL_ID, b"hello")
+    )
+
+    sub = AMQPConnection("127.0.0.1", broker.port)
+    sub.queue_declare("big")
+    sub.basic_consume("big")
+    got = _drain(sub, 3)
+    assert len(got) == 3
+    by_cid = {d["correlation_id"]: d["body"] for d in got}
+    assert by_cid["big-1"] == big
+    assert by_cid["empty-1"] == b""
+    assert by_cid["props-1"] == b"hello"
+    pub.close()
+    sub.close()
+
+
+# ------------------------------------------------- exactly-once protocol
+def test_source_exactly_once_across_crash(broker):
+    """Drive the Source checkpoint protocol by hand: snapshot taken,
+    crash BEFORE the ack, restore — redeliveries of
+    processed-but-unacked records are swallowed; nothing is lost or
+    duplicated."""
+    pub = AMQPConnection("127.0.0.1", broker.port)
+    pub.queue_declare("jobq")
+    for i in range(100):
+        pub.basic_publish("jobq", f"r{i}".encode(), correlation_id=f"u{i}")
+
+    src_a = RMQSource("127.0.0.1", broker.port, "jobq",
+                      uses_correlation_id=True)
+    src_a.open()
+    emitted_a = []
+    deadline = time.time() + 10
+    while len(emitted_a) < 100 and time.time() < deadline:
+        recs, _ = src_a.poll(1000)
+        emitted_a.extend(recs)
+    assert len(emitted_a) == 100
+    # checkpoint 1 completes: everything so far is acked
+    s1 = src_a.snapshot_offsets()
+    src_a.notify_checkpoint_complete(1, s1)
+    time.sleep(0.2)
+
+    # 50 more records arrive and are emitted
+    for i in range(100, 150):
+        pub.basic_publish("jobq", f"r{i}".encode(), correlation_id=f"u{i}")
+    more = []
+    deadline = time.time() + 10
+    while len(more) < 50 and time.time() < deadline:
+        recs, _ = src_a.poll(1000)
+        more.extend(recs)
+    assert len(more) == 50
+    emitted_a.extend(more)
+    # checkpoint 2 is WRITTEN (snapshot) but the job crashes before the
+    # ack fires
+    s2 = src_a.snapshot_offsets()
+    src_a.close()
+    time.sleep(0.3)           # broker requeues the 50 unacked
+
+    src_b = RMQSource("127.0.0.1", broker.port, "jobq",
+                      uses_correlation_id=True)
+    src_b.restore_offsets(s2)
+    src_b.open()
+    # publish a post-recovery tail
+    for i in range(150, 180):
+        pub.basic_publish("jobq", f"r{i}".encode(), correlation_id=f"u{i}")
+    emitted_b = []
+    deadline = time.time() + 10
+    while len(emitted_b) < 30 and time.time() < deadline:
+        recs, _ = src_b.poll(1000)
+        emitted_b.extend(recs)
+    # give any late duplicates a chance to show up
+    t0 = time.time()
+    while time.time() - t0 < 0.5:
+        recs, _ = src_b.poll(1000)
+        emitted_b.extend(recs)
+
+    # restored state already covers r100..r149 (checkpoint 2 cut): the
+    # redeliveries are swallowed; only the fresh tail is emitted
+    assert sorted(emitted_b) == [f"r{i}" for i in range(150, 180)]
+    total = emitted_a + emitted_b
+    assert len(total) == len(set(total)) == 180
+
+    # checkpoint 3 completes on the new incarnation: acks swallow-tags
+    # and fresh tags, emptying the broker's unacked ledger
+    s3 = src_b.snapshot_offsets()
+    assert len(s3["unacked"]) == 80   # 50 swallowed + 30 fresh
+    src_b.notify_checkpoint_complete(3, s3)
+    time.sleep(0.3)
+    src_b.close()
+    time.sleep(0.3)
+    # a third consumer sees an EMPTY queue: everything was acked
+    probe = RMQSource("127.0.0.1", broker.port, "jobq",
+                      uses_correlation_id=True, idle_eof_polls=5)
+    probe.open()
+    leftovers = []
+    for _ in range(10):
+        recs, eof = probe.poll(1000)
+        leftovers.extend(recs)
+        if eof:
+            break
+    assert leftovers == []
+    probe.close()
+    pub.close()
+
+
+# -------------------------------------------------------------- pipeline
+def test_windowed_pipeline_from_rabbitmq(broker):
+    """RMQSink publishes -> RMQSource feeds a keyed windowed job: exact
+    per-key totals."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.runtime.sinks import CollectSink
+
+    total, n_keys = 4_000, 8
+    sink_side = RMQSink(
+        "127.0.0.1", broker.port, "events",
+        serializer=lambda e: f"{e[0]},{e[1]}".encode(),
+        correlation_id_from=lambda e: f"e{e[2]}",
+    )
+    sink_side.open()
+    sink_side.invoke_batch([
+        (i % n_keys, i // 4, i) for i in range(total)
+    ])
+    sink_side.close()
+    # basic.publish is asynchronous (no reply method): wait for the
+    # broker's handler thread to drain the socket before asserting
+    deadline = time.time() + 10
+    while (broker.message_count("events") < total
+           and time.time() < deadline):
+        time.sleep(0.05)
+    assert broker.message_count("events") == total   # no consumer yet
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(8)
+    out = CollectSink()
+    (
+        env.add_source(RMQSource(
+            "127.0.0.1", broker.port, "events",
+            deserializer=lambda b: tuple(
+                int(x) for x in b.decode().split(",")
+            ),
+            uses_correlation_id=True,
+            idle_eof_polls=25,
+        ))
+        .assign_timestamps_and_watermarks(lambda e: e[1])
+        .key_by(lambda e: e[0])
+        .time_window(500)
+        .sum(lambda e: 1.0)
+        .add_sink(out)
+    )
+    env.execute("rmq-pipeline")
+    assert sum(float(r.value) for r in out.results) == float(total)
