@@ -1,0 +1,8 @@
+#!/bin/bash
+# Interactive flink-tpu shell (ref bin/start-scala-shell.sh).
+#
+#   bin/flink-shell.sh [--controller HOST:PORT] [--execute FILE]
+cd "$(dirname "$0")/.."
+# default config dir (ref config.sh: FLINK_CONF_DIR fallback)
+export FLINK_TPU_CONF_DIR="${FLINK_TPU_CONF_DIR:-$PWD/conf}"
+exec python -m flink_tpu.shell "$@"

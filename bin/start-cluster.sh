@@ -7,4 +7,6 @@
 # The controller prints its control endpoint; point workers and the CLI at
 # it. Multi-host: bind 0.0.0.0 and advertise the machine's reachable IP.
 cd "$(dirname "$0")/.."
+# default config dir (ref config.sh: FLINK_CONF_DIR fallback)
+export FLINK_TPU_CONF_DIR="${FLINK_TPU_CONF_DIR:-$PWD/conf}"
 exec python -m flink_tpu.runtime.process_cluster "$@"

@@ -4,4 +4,6 @@
 #
 #   bin/taskmanager.sh --controller HOST:PORT --worker-id W1 [...]
 cd "$(dirname "$0")/.."
+# default config dir (ref config.sh: FLINK_CONF_DIR fallback)
+export FLINK_TPU_CONF_DIR="${FLINK_TPU_CONF_DIR:-$PWD/conf}"
 exec python -m flink_tpu.runtime.worker "$@"

@@ -4,4 +4,6 @@
 #
 #   bin/yarn-session.sh --rm http://rm-host:8088 [--name N] [...]
 cd "$(dirname "$0")/.."
+# default config dir (ref config.sh: FLINK_CONF_DIR fallback)
+export FLINK_TPU_CONF_DIR="${FLINK_TPU_CONF_DIR:-$PWD/conf}"
 exec python -m flink_tpu.deploy.yarn "$@"

@@ -1,0 +1,185 @@
+"""Interactive shell — the flink-scala-shell analog (SURVEY §2.9,
+ref flink-scala-shell/.../FlinkShell.scala + FlinkILoop.scala).
+
+The reference starts a Scala REPL with pre-bound execution environments
+(``benv``/``senv``) and ships the REPL session's compiled classes to the
+cluster on execute. Redesigned for Python: a ``code.InteractiveConsole``
+with pre-bound
+
+  * ``env``   — StreamExecutionEnvironment (the ``senv`` analog)
+  * ``benv``  — dataset ExecutionEnvironment (the ``benv`` analog)
+  * ``submit(fn)`` — remote execution: the SESSION SOURCE (every line
+    the console accepted, the FlinkILoop class-shipping analog) is
+    written to a job file and submitted to the controller as a
+    ``file.py:fn`` builder ref, so functions DEFINED IN THE REPL run on
+    the cluster with their session context.
+
+Local mode executes in-process; ``--controller HOST:PORT`` targets a
+running ProcessCluster (bin/start-cluster.sh). ``--execute FILE`` runs
+a script through the same console and exits (scripting/test seam).
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import os
+import sys
+import tempfile
+import time
+from typing import List, Optional
+
+BANNER = r"""
+      __ _ _       _        _
+     / _| (_)_ __ | | __   | |_ _ __  _   _
+    | |_| | | '_ \| |/ /   | __| '_ \| | | |
+    |  _| | | | | |   <    | |_| |_) | |_| |
+    |_| |_|_|_| |_|_|\_\____\__| .__/ \__,_|
+                               |_|
+  env   = StreamExecutionEnvironment (streaming)
+  benv  = ExecutionEnvironment (batch / DataSet)
+  submit(fn [, job_name, checkpoint_dir]) -> worker id (remote mode)
+"""
+
+
+class ShellConsole(code.InteractiveConsole):
+    """Console that RECORDS accepted source — the session transcript is
+    what remote submission ships (FlinkILoop's class shipping,
+    expressed as source shipping)."""
+
+    def __init__(self, namespace: dict):
+        super().__init__(namespace)
+        self.session_lines: List[str] = []
+        self._pending: List[str] = []
+
+    def push(self, line: str) -> bool:
+        self._pending.append(line)
+        more = super().push(line)
+        if not more:
+            src = "\n".join(self._pending)
+            self._pending = []
+            # record only source that COMPILED (runsource returned a
+            # complete, syntactically valid block); runtime errors still
+            # record — the reference ships every compiled REPL class too
+            try:
+                compile(src, "<shell>", "exec")
+                if src.strip():
+                    self.session_lines.append(src)
+            except SyntaxError:
+                pass
+        return more
+
+
+class FlinkShell:
+    def __init__(self, controller: Optional[str] = None,
+                 job_dir: Optional[str] = None):
+        self.controller = None
+        if controller:
+            host, _, port = controller.rpartition(":")
+            self.controller = (host or "127.0.0.1", int(port))
+        self.job_dir = job_dir or tempfile.mkdtemp(prefix="flink-shell-")
+        self._job_seq = 0
+        from flink_tpu import StreamExecutionEnvironment
+        from flink_tpu.dataset import ExecutionEnvironment
+
+        self.namespace = {
+            "env": StreamExecutionEnvironment.get_execution_environment(),
+            "benv": ExecutionEnvironment.get_execution_environment(),
+            "submit": self.submit,
+            "__name__": "__console__",
+        }
+        self.console = ShellConsole(self.namespace)
+
+    # -- remote submission ----------------------------------------------
+    def submit(self, fn, job_name: Optional[str] = None,
+               checkpoint_dir: str = "") -> str:
+        """Ship the session source + run ``fn`` as the job builder on
+        the cluster (fn must return a configured
+        StreamExecutionEnvironment, the worker builder contract)."""
+        if self.controller is None:
+            raise RuntimeError(
+                "submit() needs a cluster: start the shell with "
+                "--controller HOST:PORT (bin/start-cluster.sh)"
+            )
+        name = getattr(fn, "__name__", None)
+        if not name or name == "<lambda>":
+            raise ValueError("submit() needs a named function")
+        self._job_seq += 1
+        path = os.path.join(self.job_dir, f"session_{self._job_seq}.py")
+        with open(path, "w") as f:
+            f.write(
+                "# flink-tpu shell session shipment "
+                "(FlinkILoop analog)\n"
+            )
+            f.write("\n\n".join(self.console.session_lines))
+            f.write("\n")
+        from flink_tpu.runtime.cluster import control_request
+
+        resp = control_request(*self.controller, {
+            "action": "submit", "builder": f"{path}:{name}",
+            "job_name": job_name or f"shell-job-{self._job_seq}",
+            "checkpoint_dir": checkpoint_dir,
+        })
+        if not resp.get("ok"):
+            raise RuntimeError(f"submit failed: {resp.get('error')}")
+        return resp["worker_id"]
+
+    def wait(self, worker_id: str, timeout_s: float = 180.0) -> str:
+        from flink_tpu.runtime.cluster import control_request
+
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            resp = control_request(
+                *self.controller, {"action": "list"}
+            )
+            for w in resp.get("workers", []):
+                if w["worker_id"] == worker_id and w["status"] in (
+                    "FINISHED", "FAILED", "DEAD"
+                ):
+                    return w["status"]
+            time.sleep(0.2)
+        raise TimeoutError(worker_id)
+
+    # -- driving ---------------------------------------------------------
+    def run_source(self, source: str):
+        """Feed a block of source through the console (the --execute /
+        test seam). Statements run top-level like typed input; an open
+        indented block is closed before the next top-level statement
+        (the blank line a human would type)."""
+        more = False
+        for line in source.splitlines():
+            if more and line and not line[0].isspace():
+                more = self.console.push("")
+            more = self.console.push(line)
+        if more:
+            self.console.push("")    # flush any open block
+
+    def interact(self):
+        self.namespace["shell"] = self
+        self.console.interact(banner=BANNER, exitmsg="bye")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flink-shell",
+        description="Interactive flink-tpu shell (scala-shell analog)",
+    )
+    ap.add_argument("--controller", default=None,
+                    help="HOST:PORT of a running cluster for submit()")
+    ap.add_argument("--execute", default=None,
+                    help="run a script through the shell and exit")
+    ap.add_argument("--job-dir", default=None,
+                    help="where shipped session jobs are written "
+                         "(must be visible to the cluster's workers)")
+    a = ap.parse_args(argv)
+    sh = FlinkShell(controller=a.controller, job_dir=a.job_dir)
+    if a.execute:
+        with open(a.execute) as f:
+            sh.run_source(f.read())
+        return 0
+    sh.interact()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Interactive shell: local execution through the console, session
+transcript recording, and remote submit() shipping REPL-defined
+builders to a live ProcessCluster.
+
+Ref flink-scala-shell/.../FlinkShell.scala (pre-bound benv/senv),
+FlinkILoop.scala (session class shipping on execute).
+"""
+
+import glob
+import os
+import time
+
+import pytest
+
+from flink_tpu.shell import FlinkShell
+
+
+def test_local_pipeline_through_console():
+    sh = FlinkShell()
+    sh.run_source(
+        "import numpy as np\n"
+        "from flink_tpu.runtime.sources import GeneratorSource\n"
+        "from flink_tpu.runtime.sinks import CollectSink\n"
+        "def gen(offset, n):\n"
+        "    idx = np.arange(offset, offset + n, dtype=np.int64)\n"
+        "    return ({'key': idx % 16,\n"
+        "             'value': np.ones(n, np.float32)}, idx // 40)\n"
+        "sink = CollectSink()\n"
+        "(env.add_source(GeneratorSource(gen, total=20000))\n"
+        "    .key_by(lambda c: c['key'])\n"
+        "    .time_window(500).sum(lambda c: c['value'])\n"
+        "    .add_sink(sink))\n"
+        "job = env.execute('shell-local')\n"
+        "total = sum(float(r.value) for r in sink.results)\n"
+    )
+    assert sh.namespace["total"] == 20000.0
+
+
+def test_batch_env_bound():
+    sh = FlinkShell()
+    sh.run_source(
+        "ds = benv.from_collection([1, 2, 3, 4])\n"
+        "squares = sorted(ds.map(lambda x: x * x).collect())\n"
+    )
+    assert sh.namespace["squares"] == [1, 4, 9, 16]
+
+
+def test_session_transcript_records_compiled_blocks():
+    sh = FlinkShell()
+    sh.run_source("x = 1\n")
+    sh.run_source("def f():\n    return x + 1\n")
+    sh.run_source("this is a syntax error(\n")
+    src = "\n".join(sh.console.session_lines)
+    assert "x = 1" in src and "def f():" in src
+    assert "syntax error" not in src
+
+
+def test_submit_requires_cluster_and_named_fn():
+    sh = FlinkShell()
+    with pytest.raises(RuntimeError, match="--controller"):
+        sh.submit(lambda: None)
+    sh2 = FlinkShell(controller="127.0.0.1:1")
+    with pytest.raises(ValueError, match="named function"):
+        sh2.submit(lambda: None)
+
+
+def test_remote_submit_ships_repl_defined_builder(tmp_path):
+    """A builder DEFINED IN THE SHELL runs on a worker process: the
+    session source travels as the job file (FlinkILoop shipping)."""
+    from flink_tpu.runtime.process_cluster import ProcessCluster
+
+    cluster = ProcessCluster(heartbeat_timeout_s=10.0)
+    cluster.start()
+    try:
+        sh = FlinkShell(
+            controller=f"127.0.0.1:{cluster._port}",
+            job_dir=str(tmp_path / "jobs"),
+        )
+        os.makedirs(sh.job_dir, exist_ok=True)
+        out = str(tmp_path / "out")
+        sh.run_source(
+            "import os\n"
+            "import numpy as np\n"
+            "def build_job():\n"
+            "    from flink_tpu import StreamExecutionEnvironment\n"
+            "    from flink_tpu.core.time import TimeCharacteristic\n"
+            "    from flink_tpu.connectors.files import BucketingFileSink\n"
+            "    from flink_tpu.runtime.sources import GeneratorSource\n"
+            "    e = StreamExecutionEnvironment.get_execution_environment()\n"
+            "    e.set_parallelism(1)\n"
+            "    e.set_max_parallelism(8)\n"
+            "    e.set_stream_time_characteristic("
+            "TimeCharacteristic.EventTime)\n"
+            "    def gen(offset, n):\n"
+            "        idx = np.arange(offset, offset + n, dtype=np.int64)\n"
+            "        return ({'key': idx % 8,\n"
+            "                 'value': np.ones(n, np.float32)},\n"
+            "                (idx * 8000) // 20000)\n"
+            "    sink = BucketingFileSink(\n"
+            f"        {out!r},\n"
+            "        formatter=lambda r:"
+            " f'{r.key},{r.window_end_ms},{r.value:.0f}')\n"
+            "    (e.add_source(GeneratorSource(gen, total=20000))\n"
+            "       .key_by(lambda c: c['key'])\n"
+            "       .time_window(1000).sum(lambda c: c['value'])\n"
+            "       .add_sink(sink))\n"
+            "    return e\n"
+        )
+        wid = sh.submit(sh.namespace["build_job"], job_name="shell-remote")
+        assert sh.wait(wid, timeout_s=180) == "FINISHED"
+        total = 0.0
+        for path in glob.glob(os.path.join(out, "**", "part-0"),
+                              recursive=True):
+            with open(path) as f:
+                for line in f:
+                    total += float(line.strip().split(",")[2])
+        assert total == 20000.0
+    finally:
+        cluster.shutdown()
