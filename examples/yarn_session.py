@@ -1,0 +1,59 @@
+"""YARN session deployment end to end (ref flink-yarn: yarn-session.sh
+-> submit jobs -> shutdown): deploy a session cluster through the
+public RM REST API, run a windowed job in a worker container, and tear
+the application down. Runs against the in-repo MiniYarnRM (which plays
+RM + NodeManager, launching real OS processes); point the descriptor at
+a genuine RM and the AM/worker processes land in real containers."""
+
+import glob
+import os
+import tempfile
+
+from flink_tpu.deploy.yarn import MiniYarnRM, YarnClusterDescriptor
+
+JOBS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "..", "tests", "process_jobs.py")
+
+
+def main():
+    work = tempfile.mkdtemp(prefix="yarn-example-")
+    rm = MiniYarnRM(os.path.join(work, "yarn"))
+    rm.start()
+    try:
+        print(f"RM REST endpoint: {rm.url}")
+        desc = YarnClusterDescriptor(rm.url)
+        client = desc.deploy_session_cluster("example-session")
+        report = client.app_report()
+        print(f"application {client.app_id} is {report['state']}, "
+              f"AM tracking {report['trackingUrl']}")
+
+        out = os.path.join(work, "out")
+        wid = client.submit_job(
+            f"{os.path.abspath(JOBS)}:build_window_job",
+            "yarn-example-job", os.path.join(work, "chk"),
+            extra_env={
+                "FLINK_TPU_TEST_OUT": out,
+                "FLINK_TPU_TEST_TOTAL": "20000",
+            },
+        )
+        status = client.wait_job(wid, timeout_s=180)
+        containers = client.rest.list_containers(client.app_id)
+        print(f"job {wid}: {status}; ran in container "
+              f"{containers[0]['id']}")
+
+        total = 0.0
+        for path in glob.glob(os.path.join(out, "**", "part-0"),
+                              recursive=True):
+            with open(path) as f:
+                total += sum(float(l.strip().split(",")[2]) for l in f)
+        assert status == "FINISHED" and total == 20000.0, (status, total)
+
+        final = client.shutdown_cluster()
+        print(f"application torn down: {final['state']}")
+        print("OK")
+    finally:
+        rm.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -33,16 +33,24 @@ def restore_path():
     return os.environ.get("FLINK_TPU_SAVEPOINT") or None
 
 
-DEFAULT_PORT = 6123  # ref jobmanager.rpc.port default (flink-conf.yaml:33)
+# ref jobmanager.rpc.port default (flink-conf.yaml:33); overridable via
+# controller.rpc.port in conf/flink-tpu-conf.yaml ($FLINK_TPU_CONF_DIR)
+def _default_port() -> int:
+    from flink_tpu.core.config import load_global_configuration
+
+    return load_global_configuration().get_int("controller.rpc.port", 6123)
+
+
+DEFAULT_PORT = 6123
 
 
 def _addr(spec: str):
     if ":" not in spec:  # bare hostname
-        return spec or "127.0.0.1", DEFAULT_PORT
+        return spec or "127.0.0.1", _default_port()
     host, _, port = spec.rpartition(":")
     host = host or "127.0.0.1"
     if not port:
-        return host, DEFAULT_PORT
+        return host, _default_port()
     try:
         return host, int(port)
     except ValueError:

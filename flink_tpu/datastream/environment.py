@@ -20,7 +20,14 @@ from flink_tpu.runtime import sources as src_mod
 
 class StreamExecutionEnvironment:
     def __init__(self, config: Optional[Configuration] = None):
-        self.config = config or Configuration()
+        # global defaults (conf/flink-tpu-conf.yaml via $FLINK_TPU_CONF_DIR,
+        # the GlobalConfiguration role) under the program's explicit
+        # configuration — the reference's env.getConfig layering
+        from flink_tpu.core.config import load_global_configuration
+
+        self.config = load_global_configuration().merge(
+            config or Configuration()
+        )
         self.parallelism = self.config.get(CoreOptions.DEFAULT_PARALLELISM)
         self.max_parallelism = self.config.get(CoreOptions.MAX_PARALLELISM)
         self.batch_size = self.config.get(CoreOptions.BATCH_SIZE)

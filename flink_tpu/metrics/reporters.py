@@ -112,9 +112,82 @@ class GraphiteReporter(Reporter):
         pass
 
 
+class GangliaReporter(Reporter):
+    """Ganglia gmond protocol v3.1 over UDP (ref flink-metrics-ganglia,
+    which wraps gmetric4j's GMetric). XDR-encoded per the public
+    gm_protocol.x spec: a METADATA message (id 128: hostname, metric
+    name, spoof flag, then type/name/units/slope/tmax/dmax + extras)
+    followed by a DOUBLE VALUE message (id 135: hostname, name, spoof,
+    printf format, IEEE-754 big-endian double). XDR strings are
+    length-prefixed and zero-padded to 4-byte boundaries; all ints are
+    4-byte big-endian. Metadata rides every report (dmax=0 servers
+    drop metrics whose metadata aged out; resending is gmetric4j's
+    periodic-announce behavior collapsed to the report interval)."""
+
+    GMETADATA_FULL = 128
+    GMETRIC_DOUBLE = 135
+    SLOPE_BOTH = 3
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8649,
+                 tmax: int = 60, dmax: int = 0,
+                 hostname: str = ""):
+        self.addr = (host, int(port))
+        self.tmax = tmax
+        self.dmax = dmax
+        self.hostname = hostname or socket.gethostname()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    @staticmethod
+    def _xdr_int(v: int) -> bytes:
+        return int(v).to_bytes(4, "big", signed=False)
+
+    @staticmethod
+    def _xdr_string(s: str) -> bytes:
+        b = s.encode()
+        pad = (4 - len(b) % 4) % 4
+        return len(b).to_bytes(4, "big") + b + b"\x00" * pad
+
+    def _metadata(self, name: str) -> bytes:
+        x = (self._xdr_int(self.GMETADATA_FULL)
+             + self._xdr_string(self.hostname)
+             + self._xdr_string(name)
+             + self._xdr_int(0)                 # spoof
+             + self._xdr_string("double")       # type
+             + self._xdr_string(name)
+             + self._xdr_string("")             # units
+             + self._xdr_int(self.SLOPE_BOTH)
+             + self._xdr_int(self.tmax)
+             + self._xdr_int(self.dmax)
+             + self._xdr_int(0))                # no extra elements
+        return x
+
+    def _value(self, name: str, v: float) -> bytes:
+        import struct as _struct
+
+        return (self._xdr_int(self.GMETRIC_DOUBLE)
+                + self._xdr_string(self.hostname)
+                + self._xdr_string(name)
+                + self._xdr_int(0)              # spoof
+                + self._xdr_string("%f")
+                + _struct.pack(">d", float(v)))
+
+    def report(self):
+        for path, v in _flatten(self.registry.snapshot()).items():
+            name = _sanitize(path)
+            try:
+                self._sock.sendto(self._metadata(name), self.addr)
+                self._sock.sendto(self._value(name, v), self.addr)
+            except OSError:
+                pass      # UDP best-effort, like the reference
+
+    def close(self):
+        self._sock.close()
+
+
 _KINDS = {
     "statsd": StatsDReporter,
     "graphite": GraphiteReporter,
+    "ganglia": GangliaReporter,
     "jsonfile": JsonFileReporter,
     "logging": LoggingReporter,
 }
@@ -166,6 +239,14 @@ def configure_reporters(registry: MetricRegistry, config
                 config.get_str(pre + "host", "127.0.0.1"),
                 config.get_int(pre + "port", 2003),
                 config.get_str(pre + "prefix", "flink_tpu"),
+            )
+        elif cls is GangliaReporter:
+            rep = GangliaReporter(
+                config.get_str(pre + "host", "127.0.0.1"),
+                config.get_int(pre + "port", 8649),
+                config.get_int(pre + "tmax", 60),
+                config.get_int(pre + "dmax", 0),
+                config.get_str(pre + "hostname", ""),
             )
         elif cls is JsonFileReporter:
             rep = JsonFileReporter(config.get_str(pre + "path",

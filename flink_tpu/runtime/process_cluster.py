@@ -548,12 +548,23 @@ def main(argv=None) -> int:
     standby blocks on the leader lock and takes over on leader death."""
     import argparse
 
+    from flink_tpu.core.config import load_global_configuration
+    from flink_tpu.runtime import security
+
+    # flag > conf/flink-tpu-conf.yaml > built-in default (the reference's
+    # CLI-over-flink-conf.yaml precedence)
+    gconf = load_global_configuration()
     ap = argparse.ArgumentParser()
-    ap.add_argument("--host", default="127.0.0.1",
+    ap.add_argument("--host",
+                    default=gconf.get_str("controller.bind-host",
+                                          "127.0.0.1"),
                     help="bind address (0.0.0.0 for multi-host)")
-    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port", type=int,
+                    default=gconf.get_int("controller.rpc.port", 0))
     ap.add_argument("--advertise-host", default="127.0.0.1")
-    ap.add_argument("--ha-dir", default=None)
+    ap.add_argument("--ha-dir",
+                    default=gconf.get_str("high-availability.dir", "")
+                    or None)
     ap.add_argument("--contender-id", default=None)
     ap.add_argument("--heartbeat-timeout-s", type=float, default=3.0)
     ap.add_argument("--max-restarts", type=int, default=3)
@@ -564,6 +575,7 @@ def main(argv=None) -> int:
         max_restarts=a.max_restarts,
         ha_dir=a.ha_dir, contender_id=a.contender_id,
         advertise_host=a.advertise_host,
+        auth_token=security.get_token(gconf),
     )
     cluster.start(host=a.host, port=a.port)
     print(f"[controller {a.contender_id or os.getpid()}] contending "

@@ -90,12 +90,43 @@ class FlinkShell:
         }
         self.console = ShellConsole(self.namespace)
 
+    # console-only bindings that do not exist on a worker: a shipped
+    # top-level statement referencing any of them would NameError when
+    # the worker execs the session file
+    _CONSOLE_NAMES = frozenset({"env", "benv", "submit", "shell"})
+
+    def _shippable(self, block: str) -> bool:
+        """A session block ships if it is a definition (import, def,
+        class) or a statement free of console-only names — the
+        FlinkILoop analog ships REPL class definitions, not the REPL's
+        interactive actions (local executes, previous submit() calls)."""
+        import ast
+
+        try:
+            tree = ast.parse(block)
+        except SyntaxError:          # recorded pre-exec; defensive
+            return False
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom,
+                                 ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Name)
+                        and sub.id in self._CONSOLE_NAMES):
+                    return False
+        return True
+
     # -- remote submission ----------------------------------------------
     def submit(self, fn, job_name: Optional[str] = None,
                checkpoint_dir: str = "") -> str:
         """Ship the session source + run ``fn`` as the job builder on
         the cluster (fn must return a configured
-        StreamExecutionEnvironment, the worker builder contract)."""
+        StreamExecutionEnvironment, the worker builder contract).
+        Definitions and console-independent statements ship; top-level
+        statements touching the console's own bindings (env/benv/
+        submit/shell) stay local — they are interactive actions, not
+        session state a worker can replay."""
         if self.controller is None:
             raise RuntimeError(
                 "submit() needs a cluster: start the shell with "
@@ -105,13 +136,16 @@ class FlinkShell:
         if not name or name == "<lambda>":
             raise ValueError("submit() needs a named function")
         self._job_seq += 1
+        os.makedirs(self.job_dir, exist_ok=True)
         path = os.path.join(self.job_dir, f"session_{self._job_seq}.py")
+        shipped = [b for b in self.console.session_lines
+                   if self._shippable(b)]
         with open(path, "w") as f:
             f.write(
                 "# flink-tpu shell session shipment "
                 "(FlinkILoop analog)\n"
             )
-            f.write("\n\n".join(self.console.session_lines))
+            f.write("\n\n".join(shipped))
             f.write("\n")
         from flink_tpu.runtime.cluster import control_request
 
@@ -125,6 +159,11 @@ class FlinkShell:
         return resp["worker_id"]
 
     def wait(self, worker_id: str, timeout_s: float = 180.0) -> str:
+        if self.controller is None:
+            raise RuntimeError(
+                "wait() needs a cluster: start the shell with "
+                "--controller HOST:PORT"
+            )
         from flink_tpu.runtime.cluster import control_request
 
         deadline = time.time() + timeout_s
@@ -142,17 +181,20 @@ class FlinkShell:
 
     # -- driving ---------------------------------------------------------
     def run_source(self, source: str):
-        """Feed a block of source through the console (the --execute /
-        test seam). Statements run top-level like typed input; an open
-        indented block is closed before the next top-level statement
-        (the blank line a human would type)."""
-        more = False
-        for line in source.splitlines():
-            if more and line and not line[0].isspace():
-                more = self.console.push("")
-            more = self.console.push(line)
-        if more:
-            self.console.push("")    # flush any open block
+        """Feed source through the console (the --execute / test seam).
+        The source is split into TOP-LEVEL STATEMENTS by the parser —
+        not by indentation heuristics, which would split compound
+        statements (try/except, if/else, decorated defs) at their
+        dedented clauses — and each statement block runs and records
+        like typed input."""
+        import ast
+
+        tree = ast.parse(source)     # SyntaxError surfaces to the caller
+        lines = source.splitlines()
+        for node in tree.body:
+            block = "\n".join(lines[node.lineno - 1:node.end_lineno])
+            self.console.runsource(block, symbol="exec")
+            self.console.session_lines.append(block)
 
     def interact(self):
         self.namespace["shell"] = self

@@ -148,3 +148,67 @@ def test_unknown_reporter_class_rejected():
             "metrics.reporters": "x",
             "metrics.reporter.x.class": "nope",
         }))
+
+
+def test_ganglia_xdr_over_udp():
+    """Decode the gmond v3.1 XDR datagrams RECEIVER-SIDE: metadata
+    (id 128) declares type double with matching host/name; the value
+    message (id 135) carries the IEEE-754 big-endian double. Ref
+    flink-metrics-ganglia via gmetric4j; wire format from the public
+    gm_protocol.x spec."""
+    import struct
+
+    from flink_tpu.metrics.reporters import GangliaReporter
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5)
+    port = srv.getsockname()[1]
+
+    def xdr_int(data, off):
+        return int.from_bytes(data[off:off + 4], "big"), off + 4
+
+    def xdr_string(data, off):
+        n, off = xdr_int(data, off)
+        s = data[off:off + n].decode()
+        return s, off + n + ((4 - n % 4) % 4)
+
+    reg = _registry_with_metrics()
+    rep = GangliaReporter("127.0.0.1", port, hostname="testhost")
+    reg.add_reporter(rep)
+    rep.report()
+
+    meta, values = {}, {}
+    deadline = time.time() + 5
+    while time.time() < deadline and len(values) < 2:
+        try:
+            data, _ = srv.recvfrom(65536)
+        except socket.timeout:
+            break
+        mid, off = xdr_int(data, 0)
+        host, off = xdr_string(data, off)
+        name, off = xdr_string(data, off)
+        _spoof, off = xdr_int(data, off)
+        assert host == "testhost"
+        if mid == GangliaReporter.GMETADATA_FULL:
+            mtype, off = xdr_string(data, off)
+            name2, off = xdr_string(data, off)
+            _units, off = xdr_string(data, off)
+            slope, off = xdr_int(data, off)
+            tmax, off = xdr_int(data, off)
+            dmax, off = xdr_int(data, off)
+            nextra, off = xdr_int(data, off)
+            assert (mtype, name2, slope, tmax, dmax, nextra) == (
+                "double", name, 3, 60, 0, 0
+            )
+            meta[name] = mtype
+        elif mid == GangliaReporter.GMETRIC_DOUBLE:
+            fmt, off = xdr_string(data, off)
+            (v,) = struct.unpack_from(">d", data, off)
+            values[name] = v
+    assert values.get("jobs.j1.records_in") == 42.0
+    assert values.get("jobs.j1.steps") == 7.0
+    # every value had its metadata announced first
+    assert set(values) <= set(meta)
+    rep.close()
+    srv.close()

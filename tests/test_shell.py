@@ -49,10 +49,61 @@ def test_session_transcript_records_compiled_blocks():
     sh = FlinkShell()
     sh.run_source("x = 1\n")
     sh.run_source("def f():\n    return x + 1\n")
-    sh.run_source("this is a syntax error(\n")
+    # --execute scripts are programs: a syntax error raises (exit != 0)
+    with pytest.raises(SyntaxError):
+        sh.run_source("this is a syntax error(\n")
+    # interactive typing reports the error and records nothing
+    sh.console.push("also a syntax error(")
     src = "\n".join(sh.console.session_lines)
     assert "x = 1" in src and "def f():" in src
     assert "syntax error" not in src
+
+
+def test_compound_statements_run_whole():
+    """try/except, if/else, and decorated defs must not be split at
+    their dedented clauses (--execute scripts are full programs)."""
+    sh = FlinkShell()
+    sh.run_source(
+        "try:\n"
+        "    x = int('nope')\n"
+        "except ValueError:\n"
+        "    x = 7\n"
+        "if x == 7:\n"
+        "    y = 'taken'\n"
+        "else:\n"
+        "    y = 'not taken'\n"
+        "def deco(f):\n"
+        "    return f\n"
+        "@deco\n"
+        "def g():\n"
+        "    return y\n"
+        "z = g()\n"
+    )
+    assert sh.namespace["x"] == 7
+    assert sh.namespace["z"] == "taken"
+
+
+def test_shipping_filter_drops_console_actions():
+    """Top-level statements touching env/benv/submit stay local; defs,
+    imports, and console-independent assignments ship — a shipped file
+    must exec cleanly on a worker where the console names don't exist."""
+    sh = FlinkShell(controller="127.0.0.1:1")
+    sh.run_source(
+        "import math\n"
+        "N = 41\n"
+        "rolled = benv.from_collection([1]).collect()\n"   # console action
+        "def build_job():\n"
+        "    return N + 1\n"
+    )
+    blocks = [b for b in sh.console.session_lines if sh._shippable(b)]
+    src = "\n".join(blocks)
+    assert "import math" in src and "N = 41" in src
+    assert "def build_job" in src
+    assert "benv" not in src
+    # the shipped module execs standalone (the worker's exec_module)
+    ns = {}
+    exec(src, ns)
+    assert ns["build_job"]() == 42
 
 
 def test_submit_requires_cluster_and_named_fn():
