@@ -11,7 +11,7 @@ WIN_MS = 1_000
 TS_DIV = 16            # ts advances 1ms per TS_DIV records
 
 
-def _source(pid, nproc):
+def _source(pid, nproc, total=TOTAL_PER_HOST):
     # host p ingests ONLY keys congruent to p mod nproc — a genuinely
     # DISJOINT key slice per host (key % nproc identifies the ingesting
     # host), so any key firing on the other host provably crossed the
@@ -24,7 +24,7 @@ def _source(pid, nproc):
         ts = idx // TS_DIV
         return keys, ts, np.ones(n, np.float32)
 
-    return GeneratorPartitionSource(gen, TOTAL_PER_HOST)
+    return GeneratorPartitionSource(gen, total)
 
 
 def two_host_window():
@@ -229,3 +229,35 @@ def skewed_window_global():
     spec.rebalance_addrs = \
         os.environ["FLINK_TPU_TEST_REBALANCE_ADDRS"].split(",")
     return spec
+
+
+# -- round 5: rolling keyed reduce over the DCN plane ---------------------
+
+ROLL_TOTAL = 20_000
+
+
+def _rolling_source(pid, nproc):
+    return _source(pid, nproc, total=ROLL_TOTAL)
+
+
+def two_host_rolling():
+    """Rolling per-key count (sum of ones): every record emits its key's
+    updated running aggregate from the owner shard."""
+    return DCNJobSpec(
+        source_factory=_rolling_source,
+        window_kind="rolling",
+        capacity_per_shard=2048,
+        max_parallelism=64,
+        batch_per_host=2048,
+    )
+
+
+def expected_rolling(nproc):
+    """Per-key record count across hosts (the final rolling value)."""
+    per_host = N_KEYS // nproc
+    exp = {}
+    for pid in range(nproc):
+        for i in range(ROLL_TOTAL):
+            k = pid + nproc * (i % per_host)
+            exp[k] = exp.get(k, 0) + 1.0
+    return exp

@@ -325,3 +325,99 @@ def test_env_execute_shuffle_annotation_is_physical(tmp_path):
     assert sum(ing.values()) == J.SKEW_TOTAL
     share = [ing[p] / J.SKEW_TOTAL for p in range(NPROC)]
     assert all(abs(f - 1 / NPROC) < 0.05 for f in share), share
+
+
+def test_two_host_rolling_reduce(tmp_path):
+    """Rolling keyed reduce spanning two worker processes (round 5,
+    the VERDICT r4 'rolling cannot run multi-host' tail): per-record
+    updated aggregates emit from owner shards; the final value per key
+    is exact, every record produced exactly one emission, and per-key
+    running values are the contiguous 1..count sequence (per-key
+    channel order survives the exchange)."""
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"out-{p}.npz") for p in range(NPROC)]
+    procs = [_spawn_dcn(p, coord, outs[p], "two_host_rolling")
+             for p in range(NPROC)]
+    logs = _wait_all(procs)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+
+    finals, counts, by_host = {}, {}, {}
+    per_key_vals = {}
+    for host, path in enumerate(outs):
+        data = np.load(path)
+        for k64, v in zip(data["key_id"], data["value"]):
+            k = int(np.int64(np.uint64(k64)))
+            finals[k] = max(finals.get(k, 0.0), float(v))
+            counts[k] = counts.get(k, 0) + 1
+            per_key_vals.setdefault(k, []).append(float(v))
+            # a key's aggregate lives on ONE owner shard: all its
+            # emissions must come from one host
+            assert by_host.setdefault(k, host) == host
+    exp = J.expected_rolling(NPROC)
+    assert finals == exp
+    assert counts == {k: int(v) for k, v in exp.items()}
+    # per-key emission order is channel order: values are 1..count
+    for k, vals in per_key_vals.items():
+        assert vals == [float(i) for i in range(1, len(vals) + 1)], k
+    # keys ingested on host A emitting on host B prove the DCN crossing
+    crossed = sum(1 for k, h in by_host.items() if h != k % NPROC)
+    assert crossed > len(by_host) // 4
+
+
+def test_two_host_rolling_kill_recover(tmp_path):
+    """Kill the rolling ensemble mid-run, restart with --restore: the
+    union of emissions is exactly-once (final per-key aggregates and
+    per-key emission counts both exact)."""
+    ckpt = str(tmp_path / "ckpt")
+    os.makedirs(ckpt)
+    outs = [str(tmp_path / f"out-{p}.npz") for p in range(NPROC)]
+    extra = ["--checkpoint-dir", ckpt, "--ckpt-every", "2"]
+
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [_spawn_dcn(p, coord, outs[p], "two_host_rolling", extra)
+             for p in range(NPROC)]
+    deadline = time.time() + 300
+    complete = []
+    while time.time() < deadline:
+        chks = [d for d in os.listdir(ckpt) if d.startswith("chk-")]
+        complete = [
+            d for d in chks
+            if all(os.path.exists(
+                os.path.join(ckpt, d, f"proc-{p}.meta.json"))
+                for p in range(NPROC))
+        ]
+        if complete:
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.1)
+    alive = [p for p in procs if p.poll() is None]
+    assert complete, "no complete checkpoint appeared before the kill"
+    assert alive, "workers finished before the kill — raise ROLL_TOTAL"
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=60)
+
+    coord2 = f"127.0.0.1:{_free_port()}"
+    procs2 = [
+        _spawn_dcn(p, coord2, outs[p], "two_host_rolling",
+                   extra + ["--restore"])
+        for p in range(NPROC)
+    ]
+    logs = _wait_all(procs2)
+    for p, log in zip(procs2, logs):
+        assert p.returncode == 0, log[-2000:]
+
+    finals, counts = {}, {}
+    for path in outs:
+        data = np.load(path)
+        for k64, v in zip(data["key_id"], data["value"]):
+            k = int(np.int64(np.uint64(k64)))
+            finals[k] = max(finals.get(k, 0.0), float(v))
+            counts[k] = counts.get(k, 0) + 1
+    exp = J.expected_rolling(NPROC)
+    assert finals == exp
+    assert counts == {k: int(v) for k, v in exp.items()}
