@@ -97,3 +97,32 @@ def exchange_records(
     recv_valid = a2a(send_valid)
     recv_cols = {k: a2a(v) for k, v in send_cols.items()}
     return recv_cols, recv_hi, recv_lo, recv_valid, n_overflow
+
+
+def exchange_owned(
+    cols: Dict[str, jax.Array],
+    hi: jax.Array,
+    lo: jax.Array,
+    valid: jax.Array,
+    n_shards: int,
+    max_parallelism: int,
+    cap: int,
+    kg_start: jax.Array,
+    kg_end: jax.Array,
+) -> Tuple[Dict[str, jax.Array], jax.Array, jax.Array, jax.Array,
+           jax.Array]:
+    """``exchange_records`` + the owner mask: route this shard's lanes,
+    then keep only the received lanes whose key group falls in
+    [kg_start, kg_end]. ONE implementation of the route/mask pair so
+    the single-host exchange step (runtime/step.py) and every DCN
+    runner (runtime/dcn.py) cannot diverge in shuffle semantics.
+    Returns (cols', hi', lo', mine, n_overflow)."""
+    cols, r_hi, r_lo, r_valid, n_over = exchange_records(
+        cols, hi, lo, valid, n_shards, max_parallelism, cap
+    )
+    kg = assign_to_key_group(route_hash(r_hi, r_lo, jnp),
+                             max_parallelism, jnp)
+    mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
+        kg <= kg_end.astype(jnp.uint32)
+    )
+    return cols, r_hi, r_lo, mine, n_over

@@ -82,7 +82,14 @@ class DCNJobSpec:
     out_of_orderness_ms: int = 0
     reduce_kind: str = "sum"
     slide_ms: Optional[int] = None
-    window_kind: str = "time"      # "time" | "session" | "rolling"
+    window_kind: str = "time"      # "time" | "session" | "rolling" | "cep"
+    # window_kind "cep": a zero-arg factory returning the cep Pattern
+    # (factory, not instance: every lockstep process builds its own).
+    # The source's VALUE lane carries the per-event stage-match bits
+    # packed as a float32 integer (bit s = stage s's predicate; exact
+    # for <= 24 stages) — predicates evaluate at the ingesting host, the
+    # NFA advances on device, and the base ingest loop stays untouched.
+    cep_pattern_factory: Optional[Callable[[], object]] = None
     gap_ms: int = 0                # session gap
     # epoch-ms timestamps exceed int32 ticks: the runner rebases every
     # ts to this origin. A SPEC field (not derived from data) so all
@@ -576,9 +583,10 @@ class _DCNRunnerBase:
         overflow + table-full drops fold into it inside the step), so
         it survives kill-recover — a run that lost records can never
         report an affirmative zero."""
-        dc = getattr(self.state, "dropped_capacity", None)
-        if dc is None:
-            return 0
+        # no silent-zero guard: a runner state without the counter is a
+        # bug, and reporting an affirmative 0 for it would be exactly the
+        # false assurance this accessor exists to prevent
+        dc = self.state.dropped_capacity
         return int(sum(
             np.asarray(s.data).sum() for s in dc.addressable_shards
         ))
@@ -1019,13 +1027,11 @@ class DCNRollingRunner(_DCNRunnerBase):
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        from flink_tpu.core.keygroups import assign_to_key_group
         from flink_tpu.ops import rolling
         from flink_tpu.ops import window_kernels as wk
-        from flink_tpu.ops.hashing import route_hash
         from flink_tpu.parallel.exchange import (
             bucket_capacity,
-            exchange_records,
+            exchange_owned,
         )
         from flink_tpu.parallel.mesh import SHARD_AXIS
 
@@ -1050,13 +1056,9 @@ class DCNRollingRunner(_DCNRunnerBase):
             state = jax.tree_util.tree_map(lambda x: x[0], state)
             kg_start, kg_end = kg_start[0], kg_end[0]
             gdone = jax.lax.pmin(done[0], SHARD_AXIS)
-            cols, r_hi, r_lo, r_valid, n_over = exchange_records(
-                {"values": values}, hi, lo, valid, n, maxp, cap
-            )
-            kg = assign_to_key_group(route_hash(r_hi, r_lo, jnp),
-                                     maxp, jnp)
-            mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
-                kg <= kg_end.astype(jnp.uint32)
+            cols, r_hi, r_lo, mine, n_over = exchange_owned(
+                {"values": values}, hi, lo, valid, n, maxp, cap,
+                kg_start, kg_end,
             )
             state, outputs, out_valid = rolling.update(
                 state, red, r_hi, r_lo, cols["values"], mine
@@ -1129,6 +1131,147 @@ class DCNRollingRunner(_DCNRunnerBase):
             self.rows_val.append(vals.astype(np.float32))
 
 
+class DCNCEPRunner(_DCNRunnerBase):
+    """Device count-NFA pattern matching over the global mesh — CEP
+    multi-host, the last stage kind on VERDICT r4's cannot-run-multi-
+    host list. Replicate-and-mask like the session runner: ONE
+    all_gather puts every host's lanes on every shard, each shard
+    advances the NFA for its own key groups (cep/device.py's segmented
+    matrix scan), and match completions emit from the OWNER shard.
+    Cross-host event order is the deterministic lockstep lane order
+    (cycle-major, host-major) — the processing-time arrival-order
+    semantics of the reference's operator. Stage predicates evaluate at
+    the INGESTING host (bits packed in the value lane, see DCNJobSpec);
+    the device carries only the bit masks, so arbitrary Python
+    conditions cost nothing on the accelerator. within() is not carried
+    here yet: its pane ring needs pane-quantized batches (cep/accel.py's
+    host slicing), which the lockstep loop does not do — a
+    pattern.within_ms raises rather than silently ignoring the bound.
+    Match EXTRACTION stays host-side per the single-host engine's lazy
+    replay; rows here are (key, completion ts, completions-at-event) —
+    the match-count stream the CEP bench measures."""
+
+    def _build_step(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from flink_tpu.cep import device as cdev
+        from flink_tpu.core.keygroups import assign_to_key_group
+        from flink_tpu.ops.hashing import route_hash
+        from flink_tpu.parallel.mesh import SHARD_AXIS
+
+        spec = self.spec
+        if spec.cep_pattern_factory is None:
+            raise ValueError(
+                "cep DCN job requires DCNJobSpec.cep_pattern_factory"
+            )
+        pattern = spec.cep_pattern_factory()
+        if getattr(pattern, "within_ms", None):
+            raise ValueError(
+                "within() is not supported on the DCN CEP runner yet "
+                "(needs pane-quantized batches); run single-host via "
+                "cep/accel.py or drop the within bound"
+            )
+        dspec = cdev.DevicePatternSpec.from_pattern(pattern)
+        S = dspec.n_stages
+        if S > 24:
+            raise ValueError(
+                f"{S} stages exceed the 24 mask bits a float32 value "
+                f"lane carries exactly"
+            )
+        maxp = spec.max_parallelism
+        C = spec.capacity_per_shard
+        probe_len = 16
+        starts, ends = self.ctx.kg_bounds()
+        starts_j = jnp.asarray(starts)
+        ends_j = jnp.asarray(ends)
+        mesh = self.ctx.mesh
+
+        def shard_body(state, kg_start, kg_end, hi, lo, ts, values,
+                       valid, wm, done):
+            state = jax.tree_util.tree_map(lambda x: x[0], state)
+            kg_start, kg_end = kg_start[0], kg_end[0]
+            gdone = jax.lax.pmin(done[0], SHARD_AXIS)
+            # the DCN hop: every shard sees every host's lanes
+            hi_g = jax.lax.all_gather(hi, SHARD_AXIS, tiled=True)
+            lo_g = jax.lax.all_gather(lo, SHARD_AXIS, tiled=True)
+            ts_g = jax.lax.all_gather(ts, SHARD_AXIS, tiled=True)
+            va_g = jax.lax.all_gather(values, SHARD_AXIS, tiled=True)
+            ok_g = jax.lax.all_gather(valid, SHARD_AXIS, tiled=True)
+            bits = va_g.astype(jnp.int32)
+            masks = ((bits[:, None] >> jnp.arange(S, dtype=jnp.int32))
+                     & 1).astype(bool)
+            kg = assign_to_key_group(route_hash(hi_g, lo_g, jnp), maxp,
+                                     jnp)
+            mine = ok_g & (kg >= kg_start.astype(jnp.uint32)) & (
+                kg <= kg_end.astype(jnp.uint32)
+            )
+            state, delta, _total = cdev.advance(
+                state, dspec, hi_g, lo_g, masks, mine
+            )
+            pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            aux = (hi_g, lo_g, ts_g, delta)
+            # count-NFA matches complete on arrival: nothing flushes at
+            # end of stream, so stop when every source is drained
+            return pack(state), pack(aux), gdone
+
+        sharded = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+                P(SHARD_AXIS), P(SHARD_AXIS),
+            ),
+            out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+            check_vma=False,
+        )
+
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, hi, lo, ts, values, valid, wm, done):
+            return sharded(state, starts_j, ends_j, hi, lo, ts, values,
+                           valid, wm, done)
+
+        self._step = step
+
+        def sharded_init():
+            st = cdev.init_state(C, probe_len, dspec)
+            return jax.tree_util.tree_map(lambda x: x[None], st)
+
+        self._init_fn = jax.jit(shard_map(
+            sharded_init, mesh=mesh, in_specs=(),
+            out_specs=P(SHARD_AXIS), check_vma=False,
+        ))
+        self._mk_lane_sharding(mesh)
+
+    def _emit_local(self, aux):
+        """Emit (key, completion ts, matches-completed-at-event) from
+        THIS process's shards — deltas are nonzero only on lanes whose
+        key the shard owns."""
+        hi_g, lo_g, ts_g, delta = aux
+        origin = self.spec.origin_ms
+        for hi_sh, lo_sh, ts_sh, d_sh in zip(
+                hi_g.addressable_shards, lo_g.addressable_shards,
+                ts_g.addressable_shards, delta.addressable_shards):
+            d = np.asarray(d_sh.data)[0]
+            idx = np.nonzero(d)[0]
+            if not len(idx):
+                continue
+            khi = np.asarray(hi_sh.data)[0][idx]
+            klo = np.asarray(lo_sh.data)[0][idx]
+            ts = np.asarray(ts_sh.data)[0][idx]
+            k64 = (khi.astype(np.uint64) << np.uint64(32)) \
+                | klo.astype(np.uint64)
+            self.rows_key.append(k64)
+            self.rows_start.append(np.zeros(len(idx), np.int64))
+            self.rows_end.append(ts.astype(np.int64) + origin)
+            self.rows_val.append(d[idx].astype(np.float32))
+
+
 def runner_for_spec(spec: DCNJobSpec, process_id: int, num_processes: int,
                     **kw) -> _DCNRunnerBase:
     if spec.window_kind == "session":
@@ -1137,6 +1280,8 @@ def runner_for_spec(spec: DCNJobSpec, process_id: int, num_processes: int,
         return DCNWindowRunner(spec, process_id, num_processes, **kw)
     if spec.window_kind == "rolling":
         return DCNRollingRunner(spec, process_id, num_processes, **kw)
+    if spec.window_kind == "cep":
+        return DCNCEPRunner(spec, process_id, num_processes, **kw)
     raise ValueError(f"unknown window_kind {spec.window_kind!r}")
 
 
@@ -1177,10 +1322,12 @@ def main(argv=None) -> int:
     with open(tmp, "wb") as f:    # file object: savez appends no suffix
         np.savez(f, key_id=out["key_id"],
                  window_start_ms=out["window_start_ms"],
-                 window_end_ms=out["window_end_ms"], value=out["value"])
+                 window_end_ms=out["window_end_ms"], value=out["value"],
+                 dropped_capacity=out["dropped_capacity"])
     os.replace(tmp, a.out)
     print(json.dumps({"rows": int(len(out["key_id"])),
                       "cycles": out["cycles"], "pid": a.process_id,
+                      "dropped_capacity": out["dropped_capacity"],
                       "ingested_local": int(out["ingested_local"])}),
           flush=True)
     return 0

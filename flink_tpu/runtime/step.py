@@ -191,16 +191,13 @@ def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
     already counted into dropped_capacity."""
     import dataclasses as _dc
 
-    from flink_tpu.parallel.exchange import exchange_records
+    from flink_tpu.parallel.exchange import exchange_owned
 
     if spec.pre is not None:
         values, ts, valid = spec.pre(values, ts, valid)
-    cols, r_hi, r_lo, r_valid, n_over = exchange_records(
-        {"ts": ts, "values": values}, hi, lo, valid, n, maxp, cap
-    )
-    kg = assign_to_key_group(route_hash(r_hi, r_lo, jnp), maxp, jnp)
-    mine = r_valid & (kg >= kg_start.astype(jnp.uint32)) & (
-        kg <= kg_end.astype(jnp.uint32)
+    cols, r_hi, r_lo, mine, n_over = exchange_owned(
+        {"ts": ts, "values": values}, hi, lo, valid, n, maxp, cap,
+        kg_start, kg_end,
     )
     state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
                                 cols["ts"], cols["values"], mine,

@@ -261,3 +261,82 @@ def expected_rolling(nproc):
             k = pid + nproc * (i % per_host)
             exp[k] = exp.get(k, 0) + 1.0
     return exp
+
+
+# -- round 5: CEP pattern matching over the DCN plane ---------------------
+
+CEP_TOTAL = 12_000
+CEP_KEYS = 101
+CEP_STAGES = 3     # a -> followedBy b -> followedBy c
+
+
+def _cep_pattern():
+    from flink_tpu.cep.pattern import Pattern
+
+    return (Pattern.begin("a").where(lambda e: e == 0)
+            .followed_by("b").where(lambda e: e == 1)
+            .followed_by("c").where(lambda e: e == 2))
+
+
+def _cep_event_code(pid, idx):
+    """Deterministic per-record event code in {0,1,2,3} (3 = matches no
+    stage); mixes by key and position so keys see genuinely different
+    sequences."""
+    return (idx * 7 + idx // 13 + pid) % 4
+
+
+def _cep_source(pid, nproc):
+    per_host = CEP_KEYS // nproc
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        keys = pid + nproc * (idx % per_host)
+        ts = idx // TS_DIV
+        code = _cep_event_code(pid, idx)   # array-compatible helper
+        # stage-match bits packed into the value lane (bit s = stage s)
+        vals = np.zeros(n, np.float32)
+        for s in range(CEP_STAGES):
+            vals += (code == s).astype(np.float32) * (1 << s)
+        return keys, ts, vals
+
+    return GeneratorPartitionSource(gen, CEP_TOTAL)
+
+
+def two_host_cep():
+    return DCNJobSpec(
+        source_factory=_cep_source,
+        window_kind="cep",
+        cep_pattern_factory=_cep_pattern,
+        capacity_per_shard=1024,
+        max_parallelism=64,
+        batch_per_host=1024,
+    )
+
+
+def expected_cep(nproc):
+    """Per-key match totals from an INDEPENDENT numpy transcription of
+    the count-NFA recurrence (v' = T v applied to the old vector):
+      M  += m[S-1] * c[S-2]
+      c_s  = keep(s+1)*c_s + m[s]*c_{s-1}   (s > 0)
+      c_0  = keep(1)*c_0 + m[0]
+    keep(s) = 1 for followedBy (relaxed), 0 for next (strict)."""
+    per_host = CEP_KEYS // nproc
+    relaxed_keep = [1.0, 1.0]          # b and c are followedBy
+    totals = {}
+    seqs = {}
+    for pid in range(nproc):
+        for i in range(CEP_TOTAL):
+            k = pid + nproc * (i % per_host)
+            seqs.setdefault(k, []).append(_cep_event_code(pid, i))
+    for k, codes in seqs.items():
+        c = [0.0] * (CEP_STAGES - 1)
+        M = 0.0
+        for code in codes:
+            m = [1.0 if code == s else 0.0 for s in range(CEP_STAGES)]
+            old = list(c)
+            M += m[CEP_STAGES - 1] * old[CEP_STAGES - 2]
+            for s in range(CEP_STAGES - 2, 0, -1):
+                c[s] = relaxed_keep[s] * old[s] + m[s] * old[s - 1]
+            c[0] = relaxed_keep[0] * old[0] + m[0]
+        totals[k] = M
+    return totals

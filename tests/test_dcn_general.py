@@ -421,3 +421,33 @@ def test_two_host_rolling_kill_recover(tmp_path):
     exp = J.expected_rolling(NPROC)
     assert finals == exp
     assert counts == {k: int(v) for k, v in exp.items()}
+
+
+def test_two_host_cep(tmp_path):
+    """CEP pattern matching spanning two worker processes (round 5 —
+    the last 'cannot run multi-host' stage kind): per-key match totals
+    equal an independent numpy count-NFA oracle, every key's matches
+    emit from exactly one owner host, and keys ingested on host A
+    matching on host B prove the DCN crossing."""
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"out-{p}.npz") for p in range(NPROC)]
+    procs = [_spawn_dcn(p, coord, outs[p], "two_host_cep")
+             for p in range(NPROC)]
+    logs = _wait_all(procs)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+
+    totals, by_host = {}, {}
+    for host, path in enumerate(outs):
+        data = np.load(path)
+        assert int(data["dropped_capacity"]) == 0
+        for k64, v in zip(data["key_id"], data["value"]):
+            k = int(np.int64(np.uint64(k64)))
+            totals[k] = totals.get(k, 0.0) + float(v)
+            assert by_host.setdefault(k, host) == host
+    exp = J.expected_cep(NPROC)
+    exp = {k: v for k, v in exp.items() if v > 0}
+    assert totals == exp
+    crossed = sum(1 for k, h in by_host.items() if h != k % NPROC)
+    assert crossed > len(by_host) // 4
+    assert len(set(by_host.values())) == NPROC
