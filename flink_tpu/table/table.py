@@ -786,42 +786,188 @@ class TableEnvironment:
             return t
         raise TypeError(f"unknown logical node {type(node).__name__}")
 
+    @staticmethod
+    def _split_union(query: str):
+        """Top-level UNION [ALL] split, literal-aware (a quoted string
+        containing the word UNION never splits). Returns
+        ([branch_sql...], [op...]) with ops[i] the combinator between
+        branch i and i+1 ("all" | "distinct")."""
+        lits: List[str] = []
+
+        def stash(m):
+            lits.append(m.group(0))
+            return f"\x00{len(lits) - 1}\x00"
+
+        masked = re.sub(r"'(?:[^']|'')*'", stash, query)
+        parts = re.split(r"\bUNION(\s+ALL)?\b", masked,
+                         flags=re.IGNORECASE)
+        branches = parts[0::2]
+        ops = ["all" if a else "distinct" for a in parts[1::2]]
+
+        def unstash(s):
+            return re.sub(r"\x00(\d+)\x00",
+                          lambda m: lits[int(m.group(1))], s)
+
+        return [unstash(b).strip() for b in branches], ops
+
+    @staticmethod
+    def _strip_trailing(branch: str):
+        """Pull a trailing ORDER BY / LIMIT off a query. Used where the
+        clause must apply AFTER a set operation (DISTINCT dedupes before
+        ORDER BY/LIMIT; a union's trailing clauses order/bound the WHOLE
+        union, not its last branch). Returns (core, order_spec, limit)."""
+        limit = None
+        m = re.search(r"\s+LIMIT\s+(\d+)\s*;?\s*$", branch, re.IGNORECASE)
+        if m:
+            limit = int(m.group(1))
+            branch = branch[:m.start()]
+        order = None
+        m = re.search(
+            r"\s+ORDER\s+BY\s+"
+            r"((?:(?!\b(?:WHERE|GROUP|HAVING|UNION|LIMIT)\b).)+?)\s*;?\s*$",
+            branch, re.IGNORECASE | re.DOTALL,
+        )
+        if m:
+            order = m.group(1).strip()
+            branch = branch[:m.start()]
+        return branch, order, limit
+
+    @staticmethod
+    def _apply_trailing(t: Table, order: Optional[str],
+                        limit: Optional[int],
+                        plan: Optional[List[str]]) -> Table:
+        if order is not None:
+            desc = bool(re.search(r"\s+DESC$", order, re.IGNORECASE))
+            key = re.sub(r"\s+(DESC|ASC)$", "", order, flags=re.IGNORECASE)
+            t = t.order_by(key.strip(), ascending=not desc)
+            if plan is not None:
+                plan.append(f"Sort({order})")
+        if limit is not None:
+            t = t.limit(limit)
+            if plan is not None:
+                plan.append(f"Limit({limit})")
+        return t
+
+    def _sql_single(self, query: str, _plan: Optional[List[str]],
+                    optimize: bool) -> Table:
+        return self._exec_branch(query, _plan, optimize)[0]
+
+    def _exec_branch(self, branch: str, plan: Optional[List[str]],
+                     optimize: bool, want_render: bool = False):
+        """ONE implementation of the per-branch pipeline (DISTINCT strip
+        + clause reordering, parse, optimize, execute) shared by
+        sql_query and explain, so the two can never accept different
+        grammars. Returns (table, render) with render =
+        (ast_txt, optimized_txt, rules) when requested."""
+        from flink_tpu.table import planner as pl
+
+        branch, n_distinct = re.subn(
+            r"^(\s*SELECT)\s+DISTINCT\b", r"\1", branch, count=1,
+            flags=re.IGNORECASE,
+        )
+        order = limit = None
+        if n_distinct:
+            # SQL evaluates DISTINCT before ORDER BY/LIMIT: dedupe the
+            # full result, then sort and bound it
+            branch, order, limit = self._strip_trailing(branch)
+        m = self._SQL.match(branch)
+        if not m:
+            raise ValueError(f"unsupported SQL shape: {branch!r}")
+        root = self._build_logical(m)
+        opt, rules = pl.optimize(root) if optimize else (root, [])
+        render = (
+            (pl.render(root), pl.render(opt), rules) if want_render
+            else None
+        )
+        out = self._execute_logical(opt, plan)
+        if n_distinct:
+            out = out.distinct()
+            if plan is not None:
+                plan.append("Distinct(first occurrence)")
+            out = self._apply_trailing(out, order, limit, plan)
+        return out, render
+
+    @staticmethod
+    def _check_union_schemas(a: Table, b: Table):
+        if list(a.cols) != list(b.cols):
+            raise ValueError(
+                f"UNION branches must have the same columns: "
+                f"{list(a.cols)} vs {list(b.cols)}"
+            )
+        for k in a.cols:
+            sa = a.cols[k].dtype.kind in "OUS"
+            sb = b.cols[k].dtype.kind in "OUS"
+            if sa != sb:
+                raise ValueError(
+                    f"UNION column {k!r} mixes string and numeric "
+                    f"branches ({a.cols[k].dtype} vs {b.cols[k].dtype}); "
+                    f"numpy promotion would silently stringify values"
+                )
+
     def sql_query(self, query: str, _plan: Optional[List[str]] = None,
                   optimize: bool = True) -> Table:
         """Parse -> logical plan -> rule rewriting -> execute.
         ``optimize=False`` runs the unrewritten tree (the baseline for
-        plan-diff tests and the planner benchmark)."""
-        from flink_tpu.table import planner as pl
-
-        m = self._SQL.match(query)
-        if not m:
-            raise ValueError(f"unsupported SQL shape: {query!r}")
-        root = self._build_logical(m)
-        if optimize:
-            root, _ = pl.optimize(root)
-        return self._execute_logical(root, _plan)
+        plan-diff tests and the planner benchmark). UNION [ALL] runs
+        each branch through the same pipeline and concatenates
+        (deduplicating for plain UNION, SQL set semantics); a trailing
+        ORDER BY/LIMIT applies to the WHOLE union."""
+        branches, ops = self._split_union(query)
+        order = limit = None
+        if ops:
+            branches[-1], order, limit = self._strip_trailing(
+                branches[-1]
+            )
+        out = self._sql_single(branches[0], _plan, optimize)
+        for op, branch in zip(ops, branches[1:]):
+            nxt = self._sql_single(branch, _plan, optimize)
+            self._check_union_schemas(out, nxt)
+            out = out.union_all(nxt)
+            if op == "distinct":
+                out = out.distinct()
+            if _plan is not None:
+                _plan.append(f"Union({op})")
+        if ops:
+            out = self._apply_trailing(out, order, limit, _plan)
+        return out
 
     def explain(self, query: str) -> str:
         """AST + rewritten logical plan + measured physical plan (ref
         TableEnvironment.explain / FlinkPlannerImpl.scala:46 — a rule
-        pipeline over a logical tree, not a Calcite port)."""
-        from flink_tpu.table import planner as pl
-
-        m = self._SQL.match(query)
-        if not m:
-            raise ValueError(f"unsupported SQL shape: {query!r}")
-        root = self._build_logical(m)
-        ast_txt = pl.render(root)
-        opt, rules = pl.optimize(root)
-        plan: List[str] = []
-        self._execute_logical(opt, plan)
-        return (
-            "== Abstract Syntax Tree ==\n" + ast_txt
-            + "\n\n== Optimized Logical Plan ==\n" + pl.render(opt)
-            + "\napplied: "
-            + (", ".join(rules) if rules else "(none)")
-            + "\n\n== Physical Plan ==\n" + "\n".join(plan)
-        )
+        pipeline over a logical tree, not a Calcite port). UNION
+        queries explain each branch with the combinator between; the
+        same schema checks run, so explain never claims a plan for a
+        query sql_query would reject."""
+        branches, ops = self._split_union(query)
+        g_order = g_limit = None
+        if ops:
+            branches[-1], g_order, g_limit = self._strip_trailing(
+                branches[-1]
+            )
+        sections = []
+        prev: Optional[Table] = None
+        for i, branch in enumerate(branches):
+            plan: List[str] = []
+            t, render = self._exec_branch(branch, plan, optimize=True,
+                                          want_render=True)
+            ast_txt, opt_txt, rules = render
+            if prev is not None:
+                self._check_union_schemas(prev, t)
+            prev = t
+            sections.append(
+                "== Abstract Syntax Tree ==\n" + ast_txt
+                + "\n\n== Optimized Logical Plan ==\n" + opt_txt
+                + "\napplied: "
+                + (", ".join(rules) if rules else "(none)")
+                + "\n\n== Physical Plan ==\n" + "\n".join(plan)
+            )
+            if i < len(ops):
+                sections.append(f"== UNION {ops[i].upper()} ==")
+        if ops and (g_order is not None or g_limit is not None):
+            tail: List[str] = []
+            self._apply_trailing(prev, g_order, g_limit, tail)
+            sections.append("== Union Result ==\n" + "\n".join(tail))
+        return "\n\n".join(sections)
 
 
 def _split_commas(s: str) -> List[str]:
@@ -850,6 +996,53 @@ def _parse_select_item(s: str) -> Expr:
     return e.alias(alias) if alias else e
 
 
+def _rewrite_case(py: str) -> str:
+    """CASE expressions -> nested IF(cond, then, else) calls, both
+    forms: searched (CASE WHEN c THEN v ... ELSE d END) and simple
+    (CASE x WHEN v THEN r ... ELSE d END, each WHEN an equality on x).
+    Innermost-first so nested CASEs resolve bottom-up. ELSE is required:
+    the subset has no SQL NULL to default to, and a silent default
+    would be a wrong answer, not a convenience."""
+    pat = re.compile(
+        r"\bCASE\b((?:(?!\bCASE\b)(?!\bEND\b).)*?)\bEND\b",
+        re.IGNORECASE | re.DOTALL,
+    )
+
+    def one(m: "re.Match") -> str:
+        body = m.group(1)
+        pieces = re.split(r"\bWHEN\b", body, flags=re.IGNORECASE)
+        subject = pieces[0].strip()
+        if len(pieces) < 2:
+            raise ValueError(f"CASE without WHEN in {body!r}")
+        branches = []
+        else_val = None
+        for part in pieces[1:]:
+            seg = re.split(r"\bTHEN\b", part, flags=re.IGNORECASE)
+            if len(seg) != 2:
+                raise ValueError(f"WHEN without THEN in CASE {body!r}")
+            cond, rest = seg[0].strip(), seg[1]
+            er = re.split(r"\bELSE\b", rest, flags=re.IGNORECASE)
+            val = er[0].strip()
+            if len(er) == 2:
+                else_val = er[1].strip()
+            if subject:
+                cond = f"(({subject}) = ({cond}))"
+            branches.append((cond, val))
+        if else_val is None:
+            raise ValueError(
+                "CASE requires an ELSE branch (this SQL subset has no "
+                "NULL to default to)"
+            )
+        out = f"({else_val})"
+        for cond, val in reversed(branches):
+            out = f"IF(({cond}), ({val}), {out})"
+        return out
+
+    while pat.search(py):
+        py = pat.sub(one, py, count=1)
+    return py
+
+
 def _parse_expr(s: str) -> Expr:
     """SQL fragment -> Expr via the Python ast (SQL operators translated
     first: = -> ==, AND/OR/NOT -> and/or/not, aggregate calls -> .agg
@@ -863,6 +1056,7 @@ def _parse_expr(s: str) -> Expr:
 
     py = re.sub(r"'((?:[^']|'')*)'", stash, s)
     # SQL-only syntactic forms -> plain calls the Python ast can parse
+    py = _rewrite_case(py)
     py = re.sub(r"\bEXTRACT\s*\(\s*(\w+)\s+FROM\s+", r"extract_\1(",
                 py, flags=re.IGNORECASE)
     py = re.sub(r"(\w+(?:\.\w+)?|__lit\d+__)\s+LIKE\s+(__lit\d+__)",

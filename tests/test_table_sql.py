@@ -420,3 +420,133 @@ def test_single_element_in_list():
     t = tenv.sql_query("SELECT id FROM orders WHERE cust IN (10)")
     ref = tenv.sql_query("SELECT id FROM orders WHERE cust = 10")
     assert sorted(t.to_rows()) == sorted(ref.to_rows())
+
+
+def test_case_when_searched():
+    t = _tenv().sql_query(
+        "SELECT oid, CASE WHEN amount > 10 THEN 'big' "
+        "WHEN amount > 6 THEN 'mid' ELSE 'small' END AS bucket "
+        "FROM orders ORDER BY oid"
+    )
+    assert t.to_rows() == [
+        (1, "small"), (2, "mid"), (3, "big"), (4, "big"),
+    ]
+
+
+def test_case_when_simple_form_and_where():
+    t = _tenv().sql_query(
+        "SELECT oid, CASE cust WHEN 10 THEN 1 WHEN 20 THEN 2 ELSE 0 END "
+        "AS code FROM orders "
+        "WHERE CASE WHEN amount > 6 THEN 1 ELSE 0 END = 1 ORDER BY oid"
+    )
+    assert t.to_rows() == [(2, 2), (3, 1), (4, 0)]
+
+
+def test_case_requires_else():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="ELSE"):
+        _tenv().sql_query(
+            "SELECT CASE WHEN amount > 6 THEN 1 END AS x FROM orders"
+        )
+
+
+def test_nested_case():
+    t = _tenv().sql_query(
+        "SELECT oid, CASE WHEN amount > 6 THEN "
+        "CASE WHEN amount > 10 THEN 'big' ELSE 'mid' END "
+        "ELSE 'small' END AS bucket FROM orders ORDER BY oid"
+    )
+    assert t.to_rows() == [
+        (1, "small"), (2, "mid"), (3, "big"), (4, "big"),
+    ]
+
+
+def test_select_distinct():
+    t = _tenv().sql_query("SELECT DISTINCT cust FROM orders")
+    assert sorted(t.to_rows()) == [(10,), (20,), (30,)]
+
+
+def test_union_all_and_union():
+    te = _tenv()
+    t = te.sql_query(
+        "SELECT cust FROM orders WHERE amount > 6 "
+        "UNION ALL SELECT cust FROM customers"
+    )
+    assert sorted(t.to_rows()) == [
+        (10,), (10,), (20,), (20,), (30,), (40,),
+    ]
+    t2 = te.sql_query(
+        "SELECT cust FROM orders WHERE amount > 6 "
+        "UNION SELECT cust FROM customers"
+    )
+    assert sorted(t2.to_rows()) == [(10,), (20,), (30,), (40,)]
+
+
+def test_union_schema_mismatch_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="same columns"):
+        _tenv().sql_query(
+            "SELECT cust FROM orders UNION ALL "
+            "SELECT region FROM customers"
+        )
+
+
+def test_union_keyword_inside_literal_does_not_split():
+    te = _tenv()
+    t = te.sql_query(
+        "SELECT oid, 'credit UNION ALL debit' AS note FROM orders "
+        "WHERE oid = 1"
+    )
+    assert t.to_rows() == [(1, "credit UNION ALL debit")]
+
+
+def test_explain_union_and_distinct():
+    te = _tenv()
+    plan = te.explain(
+        "SELECT DISTINCT cust FROM orders UNION "
+        "SELECT cust FROM customers"
+    )
+    assert "== UNION DISTINCT ==" in plan
+    assert "Distinct(first occurrence)" in plan
+    assert plan.count("== Physical Plan ==") == 2
+    # explain runs the SAME schema checks as sql_query: a union that
+    # cannot execute must not get a plan
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="same columns"):
+        te.explain(
+            "SELECT cust FROM orders UNION ALL "
+            "SELECT region FROM customers"
+        )
+
+
+def test_union_trailing_order_and_limit_apply_to_whole_union():
+    te = _tenv()
+    t = te.sql_query(
+        "SELECT cust FROM orders WHERE amount > 6 "
+        "UNION ALL SELECT cust FROM customers ORDER BY cust DESC LIMIT 3"
+    )
+    assert t.to_rows() == [(40,), (30,), (20,)]
+
+
+def test_distinct_dedupes_before_limit():
+    # orders.cust = [10, 20, 10, 30]: SQL takes 3 DISTINCT values, not
+    # the distinct values of the first 3 rows
+    t = _tenv().sql_query("SELECT DISTINCT cust FROM orders LIMIT 3")
+    assert sorted(t.to_rows()) == [(10,), (20,), (30,)]
+    t2 = _tenv().sql_query(
+        "SELECT DISTINCT cust FROM orders ORDER BY cust DESC LIMIT 2"
+    )
+    assert t2.to_rows() == [(30,), (20,)]
+
+
+def test_union_dtype_mismatch_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="mixes string and numeric"):
+        _tenv().sql_query(
+            "SELECT region AS x FROM customers UNION ALL "
+            "SELECT cust AS x FROM orders"
+        )
