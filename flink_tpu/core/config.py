@@ -39,7 +39,17 @@ class Configuration:
 
     def get(self, option: ConfigOption, default=None):
         if option.key in self._data:
-            return self._data[option.key]
+            v = self._data[option.key]
+            ref = option.default if default is None else default
+            # conf-file values arrive as STRINGS (the flat-yaml loader
+            # stores text); coerce to the option's declared type so
+            # `parallelism.default: 4` never leaks '4' into arithmetic
+            if isinstance(v, str) and ref is not None \
+                    and not isinstance(ref, str):
+                if isinstance(ref, bool):
+                    return v.strip().lower() in ("true", "1", "yes")
+                return type(ref)(v)
+            return v
         return option.default if default is None else default
 
     def contains(self, option: ConfigOption) -> bool:

@@ -1,0 +1,83 @@
+"""Shipped-configuration loading and precedence (ref GlobalConfiguration
++ config.sh: flink-conf.yaml defaults under program/flag overrides).
+
+conf/flink-tpu-conf.yaml loads from $FLINK_TPU_CONF_DIR; the
+environment layers it UNDER the program's explicit Configuration, and
+the controller/CLI mains read port/HA/security defaults from it."""
+
+import os
+
+import pytest
+
+from flink_tpu.core.config import Configuration, load_global_configuration
+
+
+@pytest.fixture
+def conf_dir(tmp_path, monkeypatch):
+    d = tmp_path / "conf"
+    d.mkdir()
+    (d / "flink-tpu-conf.yaml").write_text(
+        "# comment line\n"
+        "parallelism.default: 4\n"
+        "controller.rpc.port: 7123\n"
+        "execution.micro-batch-size: 1024   # trailing comment\n"
+        "security.auth.token: sekrit\n"
+        "state.backend.strict-capacity: false\n"
+    )
+    monkeypatch.setenv("FLINK_TPU_CONF_DIR", str(d))
+    return d
+
+
+def test_load_global_configuration_parses_flat_yaml(conf_dir):
+    cfg = load_global_configuration()
+    assert cfg.get_int("parallelism.default", 0) == 4
+    assert cfg.get_int("controller.rpc.port", 0) == 7123
+    assert cfg.get_int("execution.micro-batch-size", 0) == 1024
+    assert cfg.get_str("security.auth.token") == "sekrit"
+    assert cfg.get_bool("state.backend.strict-capacity", True) is False
+
+
+def test_unset_conf_dir_loads_empty(monkeypatch):
+    monkeypatch.delenv("FLINK_TPU_CONF_DIR", raising=False)
+    assert load_global_configuration().to_dict() == {}
+
+
+def test_environment_layers_global_under_explicit(conf_dir):
+    from flink_tpu import StreamExecutionEnvironment
+
+    # conf default applies when the program says nothing
+    env = StreamExecutionEnvironment.get_execution_environment()
+    assert env.parallelism == 4
+    assert env.batch_size == 1024
+    # the program's explicit Configuration wins over the conf file
+    env2 = StreamExecutionEnvironment(
+        Configuration({"parallelism.default": 2})
+    )
+    assert env2.parallelism == 2
+    assert env2.batch_size == 1024          # untouched key still from conf
+
+
+def test_cli_default_port_honors_conf(conf_dir):
+    from flink_tpu.cli import _addr
+
+    assert _addr("somehost") == ("somehost", 7123)
+    assert _addr("somehost:9999") == ("somehost", 9999)   # explicit wins
+
+
+def test_controller_main_reads_conf_defaults(conf_dir, monkeypatch):
+    """The controller main resolves port/token from the conf file with
+    flags still winning — checked at the argparse/constructor seam
+    rather than by binding a real port 7123."""
+    import flink_tpu.runtime.process_cluster as pc
+
+    captured = {}
+
+    class FakeCluster:
+        def __init__(self, **kw):
+            captured.update(kw)
+            raise SystemExit(0)    # stop before serving
+
+    monkeypatch.setattr(pc, "ProcessCluster", FakeCluster)
+    with pytest.raises(SystemExit):
+        pc.main([])
+    assert captured["auth_token"] == "sekrit"
