@@ -27,7 +27,6 @@ import ast
 import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 _AGGS = ("sum", "avg", "min", "max", "count")
