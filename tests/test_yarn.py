@@ -218,3 +218,61 @@ def test_container_death_rerequests_and_job_recovers(rm, tmp_path):
     assert dups == 0, f"{dups} duplicate (key, window) emissions"
     assert cells == expected_cells(total)
     client.shutdown_cluster()
+
+
+def test_shell_submits_to_yarn_session(rm, tmp_path):
+    """The interactive shell targets a YARN-deployed session's AM
+    controller like any other cluster: a REPL-defined builder ships and
+    runs in a YARN worker container (scala-shell + yarn-session
+    composition, the reference's shell -> yarn attach flow)."""
+    from flink_tpu.deploy.yarn import YarnClusterDescriptor
+    from flink_tpu.shell import FlinkShell
+
+    desc = YarnClusterDescriptor(rm.url)
+    client = desc.deploy_session_cluster("shell-session")
+    sh = FlinkShell(
+        controller=f"{client.controller[0]}:{client.controller[1]}",
+        job_dir=str(tmp_path / "jobs"),
+    )
+    out = str(tmp_path / "out")
+    sh.run_source(
+        "import os\n"
+        "import numpy as np\n"
+        "def build_job():\n"
+        "    from flink_tpu import StreamExecutionEnvironment\n"
+        "    from flink_tpu.core.time import TimeCharacteristic\n"
+        "    from flink_tpu.connectors.files import BucketingFileSink\n"
+        "    from flink_tpu.runtime.sources import GeneratorSource\n"
+        "    e = StreamExecutionEnvironment.get_execution_environment()\n"
+        "    e.set_parallelism(1)\n"
+        "    e.set_max_parallelism(8)\n"
+        "    e.set_stream_time_characteristic("
+        "TimeCharacteristic.EventTime)\n"
+        "    def gen(offset, n):\n"
+        "        idx = np.arange(offset, offset + n, dtype=np.int64)\n"
+        "        return ({'key': idx % 8,\n"
+        "                 'value': np.ones(n, np.float32)},\n"
+        "                (idx * 4000) // 10000)\n"
+        "    sink = BucketingFileSink(\n"
+        f"        {out!r},\n"
+        "        formatter=lambda r:"
+        " f'{r.key},{r.window_end_ms},{r.value:.0f}')\n"
+        "    (e.add_source(GeneratorSource(gen, total=10000))\n"
+        "       .key_by(lambda c: c['key'])\n"
+        "       .time_window(1000).sum(lambda c: c['value'])\n"
+        "       .add_sink(sink))\n"
+        "    return e\n"
+    )
+    wid = sh.submit(sh.namespace["build_job"], job_name="shell-yarn-job")
+    assert sh.wait(wid, timeout_s=180) == "FINISHED"
+    # it genuinely ran in a YARN container
+    containers = client.rest.list_containers(client.app_id)
+    assert len(containers) == 1
+    import glob as _glob
+    total = 0.0
+    for path in _glob.glob(os.path.join(out, "**", "part-0"),
+                           recursive=True):
+        with open(path) as f:
+            total += sum(float(l.strip().split(",")[2]) for l in f)
+    assert total == 10000.0
+    client.shutdown_cluster()
