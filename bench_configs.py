@@ -68,11 +68,17 @@ def run_socket_wc(total_events: int, cpu: bool):
     base_dt = time.perf_counter() - t0
     baseline_eps = total_events / base_dt
 
-    # subject: real socket ingestion through the framework
+    # subject: real socket ingestion through the framework's columnar
+    # word source — the native one-pass tokenizer
+    # (native/src/textparse.cpp) plays the reference flatMap's
+    # split/parse role (SocketWindowWordCount.java:76-79), keys are
+    # 64-bit token identities, and the window count runs on device;
+    # word strings materialize lazily via source.word_of()
     from flink_tpu import StreamExecutionEnvironment
     from flink_tpu.core.config import Configuration
     from flink_tpu.core.time import TimeCharacteristic
     from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import SocketWordsSource
     from flink_tpu.runtime.watermarks import WatermarkStrategy
 
     srv = socket.create_server(("127.0.0.1", 0))
@@ -91,18 +97,16 @@ def run_socket_wc(total_events: int, cpu: bool):
     env.set_max_parallelism(32)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     env.set_state_capacity(4096)
-    env.batch_size = 8192
+    env.batch_size = 32768
     sink = CountingSink()
     t0 = time.perf_counter()
     (
-        env.socket_text_stream("127.0.0.1", port)
-        .flat_map(lambda line: [
-            (int(line.split()[0]), w) for w in line.split()[1:]
-        ])
+        env.add_source(SocketWordsSource("127.0.0.1", port))
         .assign_timestamps_and_watermarks(
-            lambda e: e[0], WatermarkStrategy.for_monotonous_timestamps()
+            lambda c: c["ts"],
+            WatermarkStrategy.for_monotonous_timestamps(),
         )
-        .key_by(lambda e: e[1])
+        .key_by(lambda c: c["key"])
         .time_window(5000)
         .count()
         .add_sink(sink)

@@ -70,8 +70,14 @@ def main():
         )
         for p in range(NPROC)
     ]
-    for p in procs:
-        assert p.wait(timeout=420) == 0
+    try:
+        codes = [p.wait(timeout=420) for p in procs]
+    finally:
+        for p in procs:               # never orphan the sibling worker
+            if p.poll() is None:
+                p.kill()
+    if any(codes):
+        raise SystemExit(f"worker exit codes: {codes}")
 
     total, crossed, windows = 0.0, 0, set()
     for host, path in enumerate(outs):

@@ -27,7 +27,8 @@ RECORD_BYTES = 20  # u64 key | i64 ts_ms | f32 value
 
 
 def _build() -> str:
-    srcs = [os.path.join(_SRC, f) for f in ("ringbuf.cpp", "spillstore.cpp")]
+    srcs = [os.path.join(_SRC, f)
+            for f in ("ringbuf.cpp", "spillstore.cpp", "textparse.cpp")]
     newest_src = max(os.path.getmtime(s) for s in srcs)
     if (
         os.path.exists(_SO)
@@ -82,6 +83,12 @@ def get_lib() -> ctypes.CDLL:
             lib.records_decode.restype = ctypes.c_int64
             lib.records_decode.argtypes = [
                 u8p, ctypes.c_uint64, u64p, i64p, f32p, ctypes.c_uint64,
+            ]
+
+            lib.tp_parse.restype = ctypes.c_int64
+            lib.tp_parse.argtypes = [
+                u8p, ctypes.c_int64, i64p, u64p, i64p,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, i64p,
             ]
 
             lib.spill_create.restype = ctypes.c_void_p
@@ -288,3 +295,60 @@ class SpillStore:
         if not h:
             raise OSError(f"spill load failed: {path}")
         return cls(_handle=h)
+
+
+def parse_ts_words(data, cap: Optional[int] = None
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                              np.ndarray, int]:
+    """One-pass native parse of newline-delimited "<ts> tok tok ..."
+    text (native/src/textparse.cpp — the SocketWindowWordCount split/
+    parse/hash done once per batch instead of per line in Python).
+
+    Returns (ts int64[n], ids uint64[n], offsets int64[n],
+    lengths int32[n], consumed_bytes). Only complete lines are
+    consumed; feed the unconsumed tail back with the next chunk.
+    ``cap`` bounds the tokens returned per call (line-atomic: parsing
+    stops BEFORE a line that would overflow, so a caller re-offers the
+    remainder — the poll-contract seam; a single line wider than cap is
+    still returned whole rather than wedging). Token ids are FNV-1a 64
+    over the token bytes (stable, checkpoint-safe); offsets/lengths
+    index into ``data`` so callers can materialize the strings of
+    first-seen ids only.
+    """
+    lib = get_lib()
+    buf = (np.frombuffer(data, np.uint8)
+           if isinstance(data, (bytes, bytearray, memoryview))
+           else np.ascontiguousarray(data, np.uint8))
+    nbytes = len(buf)
+    if nbytes == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, np.uint64),
+                np.zeros(0, np.int64), np.zeros(0, np.int32), 0)
+    # a token occupies >= 2 bytes (1 char + separator/newline)
+    hard_cap = nbytes // 2 + 1
+    use_cap = min(hard_cap, cap) if cap else hard_cap
+
+    def run(c):
+        ts = np.empty(c, np.int64)
+        ids = np.empty(c, np.uint64)
+        offs = np.empty(c, np.int64)
+        lens = np.empty(c, np.int32)
+        consumed = ctypes.c_int64(0)
+        n = lib.tp_parse(
+            _u8(buf), nbytes,
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            c, ctypes.byref(consumed),
+        )
+        return ts[:n], ids[:n], offs[:n], lens[:n], consumed.value
+
+    out = run(use_cap)
+    if out[4] == 0 and len(out[0]) == 0 and use_cap < hard_cap \
+            and 0x0A in buf:
+        # one line wider than the requested cap: grow until it fits
+        # (never wedge on a pathological line)
+        while len(out[0]) == 0 and use_cap < hard_cap:
+            use_cap = min(hard_cap, use_cap * 2)
+            out = run(use_cap)
+    return out

@@ -1,0 +1,102 @@
+// Columnar text ingestion: one-pass parse of newline-delimited
+// "<int_ts> tok tok ...\n" byte buffers into (ts, token-hash, offset,
+// length) arrays — the data-loader hot path of the SocketWindowWordCount
+// shape (ref flink-examples SocketWindowWordCount.java:76-79, where a
+// per-line Java flatMap does the splitting; here the split/parse/hash
+// runs native once per batch and the framework keys on 64-bit token
+// identities, materializing strings only for first-seen tokens).
+//
+// Contract (exported C ABI, bound via ctypes in native/__init__.py):
+//   tp_parse(buf, len, ts_out, id_out, off_out, len_out, cap, consumed)
+//     -> number of tokens written (>= 0)
+//   * only COMPLETE lines are consumed; *consumed reports the byte
+//     prefix processed, so a streaming caller keeps the partial tail.
+//   * a line whose first field is not a valid integer is skipped whole
+//     (robustness against noise on the socket, counted by the caller
+//     via consumed bookkeeping if desired).
+//   * if the next line's tokens would overflow `cap`, parsing stops
+//     BEFORE that line; the caller re-offers the remainder.
+//   * token hash: FNV-1a 64 over the token bytes (stable across runs
+//     and processes — ids are safe to checkpoint).
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+static inline uint64_t fnv1a64(const uint8_t* p, int64_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        h ^= (uint64_t)p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+int64_t tp_parse(const uint8_t* buf, int64_t len,
+                 int64_t* ts_out, uint64_t* id_out,
+                 int64_t* off_out, int32_t* len_out,
+                 int64_t cap, int64_t* consumed) {
+    int64_t n = 0;        // tokens written
+    int64_t pos = 0;      // scan position
+    *consumed = 0;
+    while (pos < len) {
+        // find the end of this line; an incomplete tail stays unconsumed
+        int64_t eol = pos;
+        while (eol < len && buf[eol] != '\n') ++eol;
+        if (eol == len) break;                 // no newline: partial line
+
+        int64_t i = pos;
+        while (i < eol && buf[i] == ' ') ++i;  // leading spaces
+        // parse the leading integer timestamp
+        bool neg = false;
+        if (i < eol && (buf[i] == '-' || buf[i] == '+')) {
+            neg = buf[i] == '-';
+            ++i;
+        }
+        int64_t ts = 0;
+        bool any_digit = false;
+        while (i < eol && buf[i] >= '0' && buf[i] <= '9') {
+            ts = ts * 10 + (buf[i] - '0');
+            any_digit = true;
+            ++i;
+        }
+        bool ok = any_digit && (i == eol || buf[i] == ' ');
+        if (!ok) {                             // malformed: skip the line
+            pos = eol + 1;
+            *consumed = pos;
+            continue;
+        }
+        if (neg) ts = -ts;
+
+        // count this line's tokens first: the line is all-or-nothing
+        // against cap so a caller never sees a line split across calls
+        int64_t count = 0;
+        int64_t j = i;
+        while (j < eol) {
+            while (j < eol && buf[j] == ' ') ++j;
+            if (j == eol) break;
+            ++count;
+            while (j < eol && buf[j] != ' ') ++j;
+        }
+        if (n + count > cap) break;            // re-offer from this line
+
+        j = i;
+        while (j < eol) {
+            while (j < eol && buf[j] == ' ') ++j;
+            if (j == eol) break;
+            int64_t tok = j;
+            while (j < eol && buf[j] != ' ') ++j;
+            ts_out[n] = ts;
+            id_out[n] = fnv1a64(buf + tok, j - tok);
+            off_out[n] = tok;
+            len_out[n] = (int32_t)(j - tok);
+            ++n;
+        }
+        pos = eol + 1;
+        *consumed = pos;
+    }
+    return n;
+}
+
+}  // extern "C"

@@ -274,3 +274,83 @@ class FileTextSource(Source):
         self.offset = int(state)
         if self._f:
             self._f.seek(self.offset)
+
+
+class SocketWordsSource(ColumnarSource):
+    """Columnar socket word ingestion: "<ts_ms> word word ..." lines
+    parsed by the NATIVE one-pass tokenizer (native/src/textparse.cpp)
+    into 64-bit token identities — the SocketWindowWordCount ingest
+    path (ref SocketWindowWordCount.java:76-79) without a per-line
+    Python flatMap. Keys are FNV-1a 64 token ids (stable across runs
+    and processes); ``word_of(id)`` materializes the string, recorded
+    once per first-seen token. Non-replayable like the socket text
+    source (at-most-once on restore, the reference's socket contract).
+    """
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 10.0):
+        self.host, self.port = host, port
+        self.connect_timeout_s = connect_timeout_s
+        self._sock = None
+        self._buf = b""
+        self._eof = False
+        self._words = {}          # id (int) -> word str
+
+    def open(self):
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.connect_timeout_s
+        )
+        self._sock.setblocking(False)
+
+    def close(self):
+        if self._sock:
+            self._sock.close()
+
+    def word_of(self, key_id: int) -> Optional[str]:
+        """The token string behind a key id (None if never seen). Accepts
+        the signed int64 view result rows carry."""
+        return self._words.get(int(key_id) & 0xFFFFFFFFFFFFFFFF)
+
+    def poll(self, max_records: int):
+        from flink_tpu.native import parse_ts_words
+
+        if not self._eof:
+            try:
+                while True:
+                    data = self._sock.recv(1 << 18)
+                    if not data:
+                        self._eof = True
+                        break
+                    self._buf += data
+                    if len(self._buf) >= max_records * 2:
+                        break    # enough bytes for a full batch
+            except (BlockingIOError, socket.timeout):
+                pass
+        data = self._buf
+        if self._eof and data and not data.endswith(b"\n"):
+            data += b"\n"        # flush the final unterminated line
+        # cap honors the poll contract: the non-chunking keyed stage
+        # paths pad to exactly B lanes, so an oversized return would
+        # break them; unconsumed lines re-offer next poll
+        ts, ids, offs, lens, consumed = parse_ts_words(
+            data, cap=max_records
+        )
+        if self._eof and consumed < len(data) and len(ids) == 0:
+            consumed = len(data)     # nothing parseable remains
+        self._buf = self._buf[min(consumed, len(self._buf)):]
+        # first-seen tokens: record their strings for word_of()
+        if len(ids):
+            uniq, first = np.unique(ids, return_index=True)
+            for u, i in zip(uniq.tolist(), first.tolist()):
+                if u not in self._words:
+                    o, l = int(offs[i]), int(lens[i])
+                    self._words[u] = data[o:o + l].decode(
+                        "utf-8", errors="replace"
+                    )
+        cols = {
+            "key": ids.view(np.int64),
+            "value": np.ones(len(ids), np.float32),
+            "ts": ts,    # for assign_timestamps_and_watermarks(c["ts"])
+        }
+        done = self._eof and not self._buf
+        return (cols, ts), done

@@ -1,0 +1,201 @@
+"""Native text tokenizer + columnar socket word source
+(native/src/textparse.cpp; ref SocketWindowWordCount.java:76-79 — the
+split/parse done once per batch in C++ instead of per line in Python).
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from flink_tpu.native import parse_ts_words
+
+
+def _pyref(data: bytes):
+    """Independent Python reference of the parser contract."""
+    out = []
+    consumed = 0
+    pos = 0
+    while True:
+        eol = data.find(b"\n", pos)
+        if eol < 0:
+            break
+        line = data[pos:eol]
+        pos = eol + 1
+        consumed = pos
+        parts = line.split()
+        if not parts:
+            continue
+        try:
+            ts = int(parts[0])
+        except ValueError:
+            continue
+        for w in parts[1:]:
+            out.append((ts, w.decode()))
+    return out, consumed
+
+
+def test_parse_matches_python_reference_on_random_text():
+    rng = np.random.default_rng(5)
+    words = ["alpha", "beta", "gamma", "x", "longer-token", "Zz9"]
+    lines = []
+    for i in range(2000):
+        n = int(rng.integers(0, 6))
+        ws = [words[int(rng.integers(0, len(words)))] for _ in range(n)]
+        sep = "  " if i % 7 == 0 else " "       # multi-space runs
+        lines.append(f"{i * 3}{sep}" + sep.join(ws) + "\n")
+    lines.insert(100, "\n")                     # empty line
+    lines.insert(200, "notanumber word\n")      # malformed ts: skipped
+    lines.insert(300, "-50 negative ts\n")
+    data = "".join(lines).encode() + b"17 partial-tail"
+
+    ts, ids, offs, lens, consumed = parse_ts_words(data)
+    ref, ref_consumed = _pyref(data)
+    assert consumed == ref_consumed
+    got = [
+        (int(t), data[int(o):int(o) + int(l)].decode())
+        for t, o, l in zip(ts, offs, lens)
+    ]
+    assert got == ref
+    # ids are stable hashes: equal tokens <=> equal ids (no collision
+    # among this vocabulary)
+    by_id = {}
+    for (t, w), i in zip(got, ids.tolist()):
+        assert by_id.setdefault(i, w) == w
+    assert len(set(by_id.values())) == len(by_id)
+
+
+def test_parse_respects_line_atomicity_and_tail():
+    # tail without newline is not consumed
+    ts, ids, offs, lens, consumed = parse_ts_words(b"1 a b\n2 c")
+    assert ts.tolist() == [1, 1]
+    assert consumed == len(b"1 a b\n")
+    # empty input
+    assert parse_ts_words(b"")[4] == 0
+
+
+def test_socket_words_source_end_to_end():
+    """source -> keyBy(token id) -> 5s windows -> counts equal the
+    scalar model; word_of() maps ids back to strings."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import SocketWordsSource
+    from flink_tpu.runtime.watermarks import WatermarkStrategy
+
+    words = ["to", "be", "or", "not", "that", "is", "the", "question"]
+    rng = np.random.default_rng(11)
+    n_lines, per_line = 3000, 6
+    widx = rng.integers(0, len(words), n_lines * per_line)
+    lines = []
+    for i in range(n_lines):
+        ws = widx[i * per_line:(i + 1) * per_line]
+        lines.append(
+            (f"{i * 4} " + " ".join(words[j] for j in ws) + "\n").encode()
+        )
+    payload = b"".join(lines)
+
+    exp = {}
+    for i in range(n_lines):
+        pane_end = ((i * 4) // 1000 + 1) * 1000
+        for j in widx[i * per_line:(i + 1) * per_line]:
+            k = (words[j], pane_end)
+            exp[k] = exp.get(k, 0) + 1
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def feed():
+        conn, _ = srv.accept()
+        with conn:
+            conn.sendall(payload)
+
+    threading.Thread(target=feed, daemon=True).start()
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(4096)
+    env.batch_size = 4096
+    sink = CollectSink()
+    src = SocketWordsSource("127.0.0.1", port)
+    (
+        env.add_source(src)
+        .assign_timestamps_and_watermarks(
+            lambda c: c["ts"],
+            WatermarkStrategy.for_monotonous_timestamps(),
+        )
+        .key_by(lambda c: c["key"])
+        .time_window(1000)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("socket-words")
+    srv.close()
+
+    got = {}
+    for r in sink.results:
+        w = src.word_of(int(r.key))
+        assert w is not None
+        got[(w, int(r.window_end_ms))] = (
+            got.get((w, int(r.window_end_ms)), 0) + int(float(r.value))
+        )
+    assert got == exp
+
+def test_parse_cap_is_line_atomic_and_reofferable():
+    data = b"1 a b c\n2 d e\n3 f\n"
+    # cap 4: first line (3 tokens) fits, second (2) would overflow
+    ts, ids, offs, lens, consumed = parse_ts_words(data, cap=4)
+    assert ts.tolist() == [1, 1, 1]
+    assert consumed == len(b"1 a b c\n")
+    # re-offer the remainder
+    rest = data[consumed:]
+    ts2, *_rest2, consumed2 = parse_ts_words(rest, cap=4)
+    assert ts2.tolist() == [2, 2, 3]
+    assert consumed2 == len(rest)
+    # a single line wider than cap still returns whole (no wedge)
+    wide = b"9 " + b" ".join(b"t%d" % i for i in range(50)) + b"\n"
+    ts3, ids3, *_r, consumed3 = parse_ts_words(wide, cap=4)
+    assert len(ts3) == 50 and consumed3 == len(wide)
+
+
+def test_socket_words_source_respects_poll_cap():
+    """poll(max_records) never returns more than one line's overshoot —
+    the non-chunking keyed paths pad to exactly B lanes."""
+    import socket as _socket
+    import threading as _threading
+
+    from flink_tpu.runtime.sources import SocketWordsSource
+
+    payload = b"".join(
+        (f"{i} " + " ".join(f"w{j}" for j in range(8)) + "\n").encode()
+        for i in range(2000)
+    )
+    srv = _socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def feed():
+        conn, _ = srv.accept()
+        with conn:
+            conn.sendall(payload)
+
+    _threading.Thread(target=feed, daemon=True).start()
+    src = SocketWordsSource("127.0.0.1", port)
+    src.open()
+    total = 0
+    import time as _time
+    deadline = _time.time() + 20
+    while _time.time() < deadline:
+        (cols, ts), done = src.poll(64)
+        n = len(cols.get("key", ()))
+        assert n <= 64, n           # cap holds (8-token lines divide 64)
+        total += n
+        if done:
+            break
+    src.close()
+    srv.close()
+    assert total == 2000 * 8
