@@ -338,3 +338,44 @@ def test_round4_session_checkpoint_format_restores(tmp_path):
            for r in s2.results}
     assert len(got) == 18
     assert all(v == 5.0 for v in got.values())
+
+
+def test_sessions_survive_inter_poll_time_jump():
+    """A mid-stream event-time jump far larger than any internal state
+    horizon: every pre-jump session closes exactly once and post-jump
+    sessions open fresh — the session-path counterpart of the windowed
+    inter-poll gap regression (tests/test_time_gap.py)."""
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    total, n_keys, gap = 30_000, 40, 300
+    jump_at, gap_ms = 15_000, 120_000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        ts = idx // 5
+        ts = np.where(idx >= jump_at, ts + gap_ms, ts)
+        return ({"key": idx % n_keys, "value": np.ones(n, np.float32)},
+                ts.astype(np.int64))
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(4096)
+    env.batch_size = 4096
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .window(EventTimeSessionWindows.with_gap(gap))
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    job = env.execute("session-inter-poll-jump")
+    assert job.metrics.dropped_capacity == 0
+    assert job.metrics.dropped_late == 0
+    # continuous per-key streams split into exactly 2 sessions per key
+    assert len(sink.results) == n_keys * 2
+    assert sum(float(r.value) for r in sink.results) == float(total)
