@@ -31,6 +31,7 @@ import time
 from typing import Optional
 
 from flink_tpu.deploy.yarn import (
+    ENV_AM_HA_DIR,
     ENV_APP_ID,
     ENV_RM_URL,
     YarnError,
@@ -125,6 +126,27 @@ class YarnProcessCluster(ProcessCluster):
         # worker_id -> last issued handle, for the replacement barrier
         self._handles: dict = {}
 
+    # -- recovery ordering (AM restart) ----------------------------------
+    # ProcessCluster recovers registered jobs the moment leadership is
+    # granted — but a recovered job's worker is a CONTAINER REQUEST, and
+    # the RM only grants containers to a REGISTERED (RUNNING) AM. Defer
+    # recovery until after register_am (YarnApplicationMasterRunner
+    # registers before the resource manager starts allocating).
+    _defer_recovery = True
+    _recovery_pending = False
+
+    def _recover_jobs(self):
+        if self._defer_recovery:
+            self._recovery_pending = True
+            return
+        super()._recover_jobs()
+
+    def recover_after_registration(self):
+        self._defer_recovery = False
+        if self._recovery_pending:
+            self._recovery_pending = False
+            super()._recover_jobs()
+
     def _spawn_inner(self, worker_id, builder_ref, job_name,
                      checkpoint_dir, restore, extra_env=None):
         # replacement barrier: NEVER request a new container for a worker
@@ -185,6 +207,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="flink-tpu-appmaster")
     ap.add_argument("--rm", default=os.environ.get(ENV_RM_URL))
     ap.add_argument("--app-id", default=os.environ.get(ENV_APP_ID))
+    ap.add_argument("--ha-dir",
+                    default=os.environ.get(ENV_AM_HA_DIR) or None,
+                    help="durable job-registry dir: a re-attempted AM "
+                         "recovers running jobs from it "
+                         "(yarn.application-attempts pairing)")
     ap.add_argument("--worker-resource", default=None,
                     help="JSON resource dict for worker containers")
     ap.add_argument("--heartbeat-timeout-s", type=float, default=10.0)
@@ -200,9 +227,13 @@ def main(argv=None) -> int:
             json.loads(a.worker_resource) if a.worker_resource else None
         ),
         heartbeat_timeout_s=a.heartbeat_timeout_s,
+        ha_dir=a.ha_dir,
     )
-    port = cluster.start()
+    # with ha_dir the previous attempt's flock released at its death, so
+    # leadership is immediate; recovery of registered jobs runs on grant
+    port = cluster.start(block_for_leadership_s=60.0)
     rest.register_am(a.app_id, f"{cluster.advertise_host}:{port}")
+    cluster.recover_after_registration()
     print(f"[appmaster] {a.app_id} serving on {port}", flush=True)
 
     done = threading.Event()

@@ -54,6 +54,7 @@ from flink_tpu.runtime.spawner import AbandonableSpawner
 # (YarnConfigKeys.java: ENV_APP_ID, ENV_CLIENT_HOME_DIR, ...)
 ENV_RM_URL = "FLINK_TPU_YARN_RM_URL"
 ENV_APP_ID = "FLINK_TPU_YARN_APP_ID"
+ENV_AM_HA_DIR = "FLINK_TPU_YARN_AM_HA_DIR"
 
 
 # --------------------------------------------------------------------------
@@ -163,6 +164,51 @@ class YarnError(RuntimeError):
     pass
 
 
+def resolve_controller(rest: "YarnRestClient", app_id: str,
+                       timeout_s: float) -> Tuple[str, int]:
+    """Poll the application report until the AM is registered (RUNNING
+    + tracking URL) and parse the controller address. ONE implementation
+    for the descriptor's deploy wait and the client's re-resolve after
+    an AM restart. Transient report errors (the RM may be busy forking
+    the replacement AM inside a report handler) retry until the
+    deadline."""
+    deadline = time.time() + timeout_s
+    last_err: Optional[str] = None
+    while True:
+        try:
+            report = rest.app_report(app_id)
+        except YarnError as e:
+            last_err = str(e)
+            if time.time() > deadline:
+                raise YarnError(
+                    f"application {app_id} report unavailable: {e}"
+                ) from None
+            time.sleep(0.3)
+            continue
+        state = report["state"]
+        if state in ("FAILED", "KILLED", "FINISHED"):
+            raise YarnError(
+                f"application {app_id} went {state}: "
+                f"{report.get('diagnostics', '')}"
+            )
+        url = report.get("trackingUrl")
+        if state == "RUNNING" and url:
+            host, _, port = url.rpartition(":")
+            try:
+                return host, int(port)
+            except ValueError:
+                raise YarnError(
+                    f"application {app_id} published a tracking URL "
+                    f"without a host:port controller address: {url!r}"
+                ) from None
+        if time.time() > deadline:
+            raise YarnError(
+                f"application {app_id} still {state} after {timeout_s}s"
+                + (f" (last error: {last_err})" if last_err else "")
+            )
+        time.sleep(0.2)
+
+
 # --------------------------------------------------------------------------
 # Cluster descriptor + session client
 # --------------------------------------------------------------------------
@@ -176,13 +222,27 @@ class YarnClusterDescriptor:
     """
 
     def __init__(self, rm_url: str, am_resource: Optional[dict] = None,
-                 worker_resource: Optional[dict] = None):
+                 worker_resource: Optional[dict] = None,
+                 max_app_attempts: int = 1,
+                 am_ha_dir: Optional[str] = None):
+        """``max_app_attempts`` > 1 enables AM restart; ``am_ha_dir``
+        (shared storage) is where the AM's HA job registry lives so a
+        re-attempted AM recovers running jobs from their checkpoints
+        (the reference's yarn.application-attempts +
+        high-availability.zookeeper pairing)."""
         self.rest = YarnRestClient(rm_url)
         self.rm_url = rm_url
         self.am_resource = am_resource or {"memory": 2048, "vCores": 1}
         self.worker_resource = worker_resource or {
             "memory": 1024, "vCores": 1,
         }
+        if max_app_attempts > 1 and not am_ha_dir:
+            raise ValueError(
+                "max_app_attempts > 1 requires am_ha_dir: without a "
+                "durable job registry a re-attempted AM recovers nothing"
+            )
+        self.max_app_attempts = max_app_attempts
+        self.am_ha_dir = am_ha_dir
 
     def deploy_session_cluster(
         self, name: str = "flink-tpu-session",
@@ -192,6 +252,8 @@ class YarnClusterDescriptor:
         app = self.rest.new_application()
         app_id = app["application-id"]
         env = {ENV_RM_URL: self.rm_url, ENV_APP_ID: app_id}
+        if self.am_ha_dir:
+            env[ENV_AM_HA_DIR] = self.am_ha_dir
         env.update(extra_env or {})
         worker_res = json.dumps(self.worker_resource)
         ctx = {
@@ -213,36 +275,12 @@ class YarnClusterDescriptor:
                 },
             },
             "resource": self.am_resource,
-            "max-app-attempts": 1,
+            "max-app-attempts": self.max_app_attempts,
         }
         self.rest.submit_application(ctx)
-        deadline = time.time() + deploy_timeout_s
-        while True:
-            report = self.rest.app_report(app_id)
-            state = report["state"]
-            if state == "RUNNING" and report.get("trackingUrl"):
-                url = report["trackingUrl"]
-                host, _, port = url.rpartition(":")
-                try:
-                    return YarnClusterClient(
-                        self.rest, app_id, host, int(port)
-                    )
-                except ValueError:
-                    raise YarnError(
-                        f"application {app_id} published a tracking URL "
-                        f"without a host:port controller address: {url!r}"
-                    ) from None
-            if state in ("FAILED", "KILLED", "FINISHED"):
-                raise YarnError(
-                    f"application {app_id} went {state} during deploy: "
-                    f"{report.get('diagnostics', '')}"
-                )
-            if time.time() > deadline:
-                raise YarnError(
-                    f"application {app_id} still {state} after "
-                    f"{deploy_timeout_s}s"
-                )
-            time.sleep(0.2)
+        host, port = resolve_controller(self.rest, app_id,
+                                        deploy_timeout_s)
+        return YarnClusterClient(self.rest, app_id, host, port)
 
 
 class YarnClusterClient:
@@ -259,7 +297,18 @@ class YarnClusterClient:
     def _control(self, msg: dict) -> dict:
         from flink_tpu.runtime.cluster import control_request
 
-        resp = control_request(*self.controller, msg)
+        try:
+            resp = control_request(*self.controller, msg)
+        except (OSError, ValueError):
+            # AM restart moved the controller (a dying AM can also cut a
+            # response short: json decode errors are ValueError, not
+            # OSError): re-resolve the tracking URL from the application
+            # report and retry once (the reference client's
+            # leader-retrieval-on-failure)
+            self.controller = resolve_controller(
+                self.rest, self.app_id, timeout_s=60
+            )
+            resp = control_request(*self.controller, msg)
         if not resp.get("ok", False):
             raise YarnError(f"controller error: {resp.get('error')}")
         return resp
@@ -327,6 +376,12 @@ class _App:
     am: Optional[_Container] = None
     containers: Dict[str, _Container] = field(default_factory=dict)
     seq: int = 0
+    # AM restart (ref YarnApplicationMasterRunner + max-app-attempts):
+    # the launch context is kept so a failed AM can be relaunched
+    max_attempts: int = 1
+    attempt: int = 1
+    am_command: str = ""
+    am_env: Dict[str, str] = field(default_factory=dict)
 
 
 class MiniYarnRM:
@@ -539,6 +594,9 @@ class MiniYarnRM:
                 raise ValueError(f"application already {app.state}")
             app.name = ctx.get("application-name", "")
             app.app_type = ctx.get("application-type", "")
+            app.max_attempts = int(ctx.get("max-app-attempts", 1))
+            app.am_command = command
+            app.am_env = dict(env_entries)
             app.state = "ACCEPTED"
         # fork outside the lock (spawner round-trips up to 30s)
         try:
@@ -560,27 +618,71 @@ class MiniYarnRM:
     def _app_route(self, method: str, app: _App, rest: List[str],
                    body: dict):
         if rest == [] and method == "GET":
+            relaunch = False
             with self._lock:
                 if app.am is not None:
                     self._refresh(app.am)
                     if app.am.state == "COMPLETE" and app.state in (
                         "ACCEPTED", "RUNNING"
                     ):
-                        # AM death ends the app (max-app-attempts=1)
-                        ok = app.am.exit_status == 0
-                        app.state = "FINISHED" if ok else "FAILED"
-                        app.final_status = "SUCCEEDED" if ok else "FAILED"
-                return 200, {"app": {
+                        if app.am.exit_status == 0:
+                            app.state = "FINISHED"
+                            app.final_status = "SUCCEEDED"
+                        elif app.attempt < app.max_attempts:
+                            # AM restart (YarnApplicationMasterRunner's
+                            # re-attempt): the dead attempt's worker
+                            # containers are killed first — the YARN
+                            # default without keep-containers-across-
+                            # application-attempts, and what prevents an
+                            # orphan writer running beside the new
+                            # attempt's recovered jobs
+                            for c in list(app.containers.values()):
+                                self._refresh(c)
+                                if c.state == "RUNNING":
+                                    self._kill_container(c)
+                            app.attempt += 1
+                            app.tracking_url = ""
+                            app.state = "ACCEPTED"
+                            # clear the dead handle UNDER the lock: a
+                            # concurrent GET during the (slow) fork
+                            # below must not re-detect the same death
+                            # and fail the app / launch a second AM
+                            app.am = None
+                            relaunch = True
+                        else:
+                            app.state = "FAILED"
+                            app.final_status = "FAILED"
+                report = {"app": {
                     "id": app.app_id, "name": app.name,
                     "applicationType": app.app_type, "state": app.state,
                     "finalStatus": app.final_status,
                     "trackingUrl": app.tracking_url,
                     "diagnostics": app.diagnostics,
+                    "currentAppAttemptId": app.attempt,
                     "runningContainers": 1 + sum(
                         1 for c in app.containers.values()
                         if c.state == "RUNNING"
                     ) if app.state == "RUNNING" else 0,
                 }}
+            if relaunch:
+                # fork outside the lock; a kill racing the relaunch is
+                # handled exactly like the submit path
+                try:
+                    am = self._launch(app, f"am-attempt{app.attempt}",
+                                      app.am_command, app.am_env)
+                except Exception as e:
+                    with self._lock:
+                        if app.state == "ACCEPTED":
+                            app.state = "FAILED"
+                            app.final_status = "FAILED"
+                            app.diagnostics = str(e)
+                    return 200, report
+                with self._lock:
+                    if app.state == "ACCEPTED" and app.am is None:
+                        app.am = am
+                    else:               # killed while relaunching
+                        self._kill_container(am)
+            return 200, report
         if rest == ["state"] and method == "PUT":
             if body.get("state") != "KILLED":
                 raise ValueError(
@@ -675,10 +777,17 @@ def main(argv=None) -> int:
     ap.add_argument("--name", default="flink-tpu-session")
     ap.add_argument("--am-memory", type=int, default=2048)
     ap.add_argument("--worker-memory", type=int, default=1024)
+    ap.add_argument("--max-app-attempts", type=int, default=1,
+                    help="> 1 enables AM restart (needs --am-ha-dir)")
+    ap.add_argument("--am-ha-dir", default=None,
+                    help="shared dir for the AM's HA job registry "
+                         "(yarn.application-attempts pairing)")
     a = ap.parse_args(argv)
     desc = YarnClusterDescriptor(
         a.rm, am_resource={"memory": a.am_memory, "vCores": 1},
         worker_resource={"memory": a.worker_memory, "vCores": 1},
+        max_app_attempts=a.max_app_attempts,
+        am_ha_dir=a.am_ha_dir,
     )
     client = desc.deploy_session_cluster(a.name)
     print(json.dumps({

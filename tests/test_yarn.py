@@ -276,3 +276,72 @@ def test_shell_submits_to_yarn_session(rm, tmp_path):
             total += sum(float(l.strip().split(",")[2]) for l in f)
     assert total == 10000.0
     client.shutdown_cluster()
+
+
+def test_am_restart_recovers_jobs_exactly_once(rm, tmp_path):
+    """Kill the ApplicationMaster mid-job with max-app-attempts=2: the
+    RM kills the dead attempt's worker containers (no keep-containers),
+    relaunches the AM, the new attempt recovers the job from the HA
+    registry and resumes it from its checkpoint in a FRESH container,
+    and the client re-resolves the moved controller — output exact with
+    zero duplicates (YarnApplicationMasterRunner re-attempt + the
+    reference's yarn.application-attempts/HA pairing)."""
+    import glob as _glob
+
+    desc = YarnClusterDescriptor(
+        rm.url, max_app_attempts=2, am_ha_dir=str(tmp_path / "ha"),
+    )
+    client = desc.deploy_session_cluster("ha-session")
+    total = 120_000
+    out = str(tmp_path / "out")
+    chk = str(tmp_path / "chk")
+    wid = client.submit_job(
+        BUILDER, "ha-job", chk,
+        extra_env={
+            "FLINK_TPU_TEST_OUT": out,
+            "FLINK_TPU_TEST_TOTAL": str(total),
+            "FLINK_TPU_TEST_SLEEP_S": "0.05",
+        },
+    )
+    _wait(lambda: _glob.glob(os.path.join(chk, "chk-*")), 120,
+          "first checkpoint")
+    app = rm.apps[client.app_id]
+    first_url = app.tracking_url
+    app.am.proc.kill()                      # AM dies hard
+
+    # polling the report is what detects the death and relaunches
+    _wait(
+        lambda: client.app_report()["currentAppAttemptId"] == 2
+        and client.app_report()["state"] == "RUNNING",
+        60, "AM re-attempt registration",
+    )
+    report = client.app_report()
+    assert report["trackingUrl"] and report["trackingUrl"] != first_url
+
+    # the client's next control call re-resolves the moved controller
+    assert client.wait_job(wid, timeout_s=240) == "FINISHED"
+
+    # the first attempt's worker container was killed by the RM; the
+    # job finished in a container requested by attempt 2
+    states = [(c["id"], c["state"], c["exitStatus"])
+              for c in client.rest.list_containers(client.app_id)]
+    assert len(states) >= 2
+    assert states[0][1] == "COMPLETE" and states[0][2] == -137
+
+    import sys
+    sys.path.insert(0, os.path.dirname(JOBS))
+    from process_jobs import expected_cells
+
+    cells, dups = {}, 0
+    for path in _glob.glob(os.path.join(out, "**", "part-0"),
+                           recursive=True):
+        with open(path) as f:
+            for line in f:
+                k, wend, v = line.strip().split(",")
+                cell = (int(k), int(wend))
+                if cell in cells:
+                    dups += 1
+                cells[cell] = cells.get(cell, 0.0) + float(v)
+    assert dups == 0, f"{dups} duplicate (key, window) emissions"
+    assert cells == expected_cells(total)
+    client.shutdown_cluster()
