@@ -32,7 +32,6 @@ import json
 import os
 import socket
 import socketserver
-import sys
 import threading
 import time
 from typing import Dict, List, Optional, Tuple

@@ -27,7 +27,6 @@ updates) as host loops — the reference's superstep synchronization
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from flink_tpu.ops import hashtable
 from flink_tpu.ops.hashtable import SlotTable

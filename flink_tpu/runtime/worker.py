@@ -29,7 +29,6 @@ import importlib.util
 import os
 import sys
 import threading
-import time
 import traceback
 
 

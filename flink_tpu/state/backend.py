@@ -20,8 +20,6 @@ with its StateTable.java:36 nested per-key-group maps), TPU-adapted:
 
 from __future__ import annotations
 
-import bisect
-import io
 import pickle
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
