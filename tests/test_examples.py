@@ -1,0 +1,36 @@
+"""Examples smoke: the runnable programs under examples/ are part of
+the user-facing surface (README enumerates them) — run a fast subset as
+real subprocesses so API drift breaks a test, not a reader.
+
+(The socket example needs an external feeder by design, and the
+heavier ones — YARN session, multi-host DCN, Kafka pipeline — are
+covered by their subsystem test files; this picks fast self-contained
+programs across batch, SQL, Storm, and wire-connector surfaces.)
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_EXAMPLES = [
+    "batch_word_count.py",
+    "planner_explain.py",
+    "streaming_sql.py",
+    "storm_word_count.py",
+    "message_queues.py",
+]
+
+
+@pytest.mark.parametrize("name", FAST_EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        env=env, capture_output=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
