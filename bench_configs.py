@@ -309,6 +309,8 @@ def _cep_events(total_events, seed, ooo=0):
 
 
 def _cep_pattern():
+    """Scalar per-record predicates — the baseline host NFA's form (the
+    reference's SimpleCondition is per-record by construction)."""
     from flink_tpu.cep import Pattern
 
     return (

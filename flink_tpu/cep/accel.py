@@ -248,7 +248,10 @@ class DeviceCepOperator:
         S = len(self.stages)
         m = np.zeros((len(elements), S), bool)
         for j, st in enumerate(self.stages):
-            m[:, j] = [bool(st.matches(e)) for e in elements]
+            # matches_batch evaluates vectorized where_batch predicates
+            # once per micro-batch (and is exactly per-event equivalent
+            # for scalar predicates)
+            m[:, j] = st.matches_batch(elements)
         return m
 
     def process_batch(self, elements: Sequence, keys: Sequence,

@@ -32,11 +32,41 @@ class Stage:
     contiguity: str            # STRICT for next(), RELAXED for followedBy()
     predicates: List[Callable] = field(default_factory=list)  # ANDed
     or_predicates: List[Callable] = field(default_factory=list)
+    # vectorized predicate: fn(Sequence[event]) -> bool array. ANDed with
+    # the scalar predicates like any other where() clause; the device
+    # engine evaluates it ONCE per micro-batch instead of per event
+    # (per-event Python predicate calls are the host-side cost of the
+    # CEP hot path — see cep/accel._masks)
+    batch_predicates: List[Callable] = field(default_factory=list)
 
     def matches(self, event) -> bool:
         base = all(p(event) for p in self.predicates)
+        if base and self.batch_predicates:
+            base = all(bool(p([event])[0]) for p in self.batch_predicates)
         if self.or_predicates:
             return base or any(p(event) for p in self.or_predicates)
+        return base
+
+    def matches_batch(self, events) -> "object":
+        """bool array over ``events`` — the vectorized form of
+        ``matches``, exact by construction: scalar predicates evaluate
+        per event, batch predicates once per batch, combined with the
+        same AND/OR structure."""
+        import numpy as np
+
+        n = len(events)
+        base = np.ones(n, bool)
+        for p in self.predicates:
+            base &= np.fromiter((bool(p(e)) for e in events), bool,
+                                count=n)
+        for p in self.batch_predicates:
+            base &= np.asarray(p(events), bool)
+        if self.or_predicates:
+            alt = np.zeros(n, bool)
+            for p in self.or_predicates:
+                alt |= np.fromiter((bool(p(e)) for e in events), bool,
+                                   count=n)
+            return base | alt
         return base
 
 
@@ -65,6 +95,18 @@ class Pattern:
 
     def where(self, predicate: Callable) -> "Pattern":
         self.stages[-1].predicates.append(predicate)
+        return self
+
+    def where_batch(self, predicate: Callable) -> "Pattern":
+        """Vectorized ``where``: ``predicate(events) -> bool array``
+        evaluated once per micro-batch by the device engine (and exactly
+        equivalent per event everywhere else). Worthwhile when the
+        per-event predicate itself is expensive; note the host match-
+        EXTRACTION replay evaluates conditions per event, where a batch
+        predicate degenerates to a singleton call — on match-dense
+        streams with cheap predicates the scalar ``where`` measures
+        faster end to end."""
+        self.stages[-1].batch_predicates.append(predicate)
         return self
 
     def or_(self, predicate: Callable) -> "Pattern":

@@ -149,3 +149,63 @@ def test_cep_non_keyed_stream():
     ).select(lambda m: (m["lo"], m["hi"])).add_sink(sink)
     env.execute("cep-global")
     assert sink.results == [(5, 200)]
+
+
+def test_where_batch_equivalent_to_where():
+    """Vectorized where_batch conditions produce exactly the matches of
+    the scalar where form, through BOTH the host NFA and the device
+    engine, including mixed scalar+batch conjunction and or_."""
+    import numpy as np
+
+    rng = np.random.default_rng(21)
+    names = rng.choice(["a", "b", "x"], 3000, p=[0.2, 0.2, 0.6])
+    events = [Event(int(i), str(names[i]), int(i % 7)) for i in range(3000)]
+
+    scalar = (
+        Pattern.begin("a").where(lambda e: e.name == "a")
+        .followed_by("b").where(lambda e: e.name == "b")
+        .where(lambda e: e.value != 3)
+    )
+    vec = (
+        Pattern.begin("a")
+        .where_batch(lambda evs: np.asarray(
+            [e.name for e in evs]) == "a")
+        .followed_by("b")
+        .where_batch(lambda evs: np.asarray(
+            [e.name for e in evs]) == "b")
+        .where(lambda e: e.value != 3)        # mixed conjunction
+    )
+    # host NFA equivalence
+    assert _run_nfa(scalar, events) == _run_nfa(vec, events)
+
+    # device engine equivalence end to end
+    def run(pattern):
+        env = StreamExecutionEnvironment.get_execution_environment()
+        env.batch_size = 256
+        sink = CollectSink()
+        stream = env.from_collection(events).key_by(lambda e: e.value)
+        CEP.pattern(stream, pattern).select(
+            lambda m: (m["a"].ts, m["b"].ts)
+        ).add_sink(sink)
+        job = env.execute("cep-vec")
+        assert job.metrics.cep_device_steps > 0
+        return sorted(sink.results)
+
+    assert run(scalar) == run(vec)
+
+    # or_ interplay: batch-AND base OR scalar alternative
+    scalar_or = (
+        Pattern.begin("s").where(lambda e: e.name == "a")
+        .or_(lambda e: e.value == 5)
+        .followed_by("t").where(lambda e: e.name == "b")
+    )
+    vec_or = (
+        Pattern.begin("s")
+        .where_batch(lambda evs: np.asarray(
+            [e.name for e in evs]) == "a")
+        .or_(lambda e: e.value == 5)
+        .followed_by("t")
+        .where_batch(lambda evs: np.asarray(
+            [e.name for e in evs]) == "b")
+    )
+    assert _run_nfa(scalar_or, events) == _run_nfa(vec_or, events)
