@@ -2740,7 +2740,11 @@ class LocalExecutor:
 
         def _feed_released(rel):
             """Feed timestamp-ordered released events to the device op,
-            one call per within() pane (without within, one call)."""
+            grouped by within() pane (without within, one group), in
+            FIXED batch_size-padded chunks. A variable pad
+            (ceil(n/bs)*bs) would give every release size its own XLA
+            shape — profiled at 13 distinct compiles eating 75% of the
+            event-time CEP run; one fixed shape compiles once."""
             matches = []
             bs = max(1, env.batch_size)
             i = 0
@@ -2752,12 +2756,14 @@ class LocalExecutor:
                         j += 1
                 else:
                     j = len(rel)
-                els = [r[3] for r in rel[i:j]]
-                ks = [r[2] for r in rel[i:j]]
-                pad = ((len(els) + bs - 1) // bs) * bs
-                matches += op.process_batch(els, ks, int(rel[i][0]),
-                                            pad_to=pad)
-                metrics.steps += 1
+                for off in range(i, j, bs):
+                    hi_off = min(off + bs, j)
+                    els = [r[3] for r in rel[off:hi_off]]
+                    ks = [r[2] for r in rel[off:hi_off]]
+                    matches += op.process_batch(
+                        els, ks, int(rel[off][0]), pad_to=bs,
+                    )
+                    metrics.steps += 1
                 i = j
             return matches
 
