@@ -78,20 +78,20 @@ have_config() {  # $1 = config name; 0 if any row (incl. capped error) exists
     grep -q "\"config\": \"$1\"" BENCH_CONFIGS_${ROUND}.jsonl
 }
 
-config_eps() {  # current subject_eps for config $1 (0 if absent/error)
-  python - "$1" <<PYEOF 2>/dev/null || echo 0
+config_row() {  # "eps events" for config $1 ("0 0" if absent/error)
+  python - "$1" <<PYEOF 2>/dev/null || echo "0 0"
 import json, sys
-best = 0
+eps = ev = 0
 try:
     for l in open("BENCH_CONFIGS_${ROUND}.jsonl"):
         if not l.strip():
             continue
         d = json.loads(l)
         if d.get("config") == sys.argv[1] and "error" not in d:
-            best = d.get("subject_eps", 0)
+            eps, ev = d.get("subject_eps", 0), d.get("events", 0)
 except FileNotFoundError:
     pass
-print(best)
+print(eps, ev)
 PYEOF
 }
 
@@ -146,18 +146,30 @@ run_official() {  # $1 = batch, $2 = inflight ('' = default), $3 = keep_best
 }
 
 run_config() {  # $1 = config name, $2 = keep_best (refresh mode)
-  local name=$1 keep_best=${2:-0}
+  local name=$1 keep_best=${2:-0} evargs=""
+  if [ "$keep_best" = 0 ]; then
+    # FIRST capture at half scale: a short up-window should land
+    # several rows (still millions of events — representative); the
+    # keep-best refresh phase re-runs at full scale and upgrades
+    case $name in
+      socket_wc) evargs="--events 1000000" ;;
+      count_min|sessions) evargs="--events 2000000" ;;
+      cep|cep_event_time) evargs="--events 200000" ;;
+    esac
+  fi
   timeout 900 python bench_configs.py --only "$name" --init-deadline 45 \
-      > /tmp/bench_cfg_${name}.txt 2>&1
+      $evargs > /tmp/bench_cfg_${name}.txt 2>&1
   local line
   line=$(grep -h '"config"' /tmp/bench_cfg_${name}.txt | tail -1)
   if [ -n "$line" ] && ! echo "$line" | grep -q '"error"'; then
     if [ "$keep_best" = 1 ]; then
-      local neweps oldeps
-      neweps=$(echo "$line" | python -c "import json,sys; print(json.load(sys.stdin).get('subject_eps',0))")
-      oldeps=$(config_eps "$name")
-      if [ "$(python -c "print(1 if float('$neweps') <= float('$oldeps') else 0)")" = 1 ]; then
-        echo "$(date -u +%FT%TZ) config $name refresh $neweps did not beat $oldeps — keeping" >&2
+      local neweps newev oldeps oldev
+      read -r neweps newev <<< "$(echo "$line" | python -c "import json,sys; d=json.load(sys.stdin); print(d.get('subject_eps',0), d.get('events',0))")"
+      read -r oldeps oldev <<< "$(config_row "$name")"
+      # a full-scale row always upgrades a half-scale first capture;
+      # at equal scale, keep the best throughput
+      if [ "$(python -c "print(1 if int('$newev') <= int('$oldev') and float('$neweps') <= float('$oldeps') else 0)")" = 1 ]; then
+        echo "$(date -u +%FT%TZ) config $name refresh $neweps@$newev did not beat $oldeps@$oldev — keeping" >&2
         return 0
       fi
     fi
