@@ -376,12 +376,92 @@ def run_cep_event_time(total_events: int, cpu: bool):
     return total_events / dt, baseline_eps
 
 
+# ------------------------------------------------- checkpoint overhead
+def run_checkpoint_overhead(total_events: int, cpu: bool):
+    """Checkpoint-overhead config (flink_tpu/checkpointing): the same
+    keyed windowed sum run with checkpointing off / sync-full /
+    async-incremental at a fixed step interval. Reports steady-state
+    throughput and the step-loop stall a checkpoint causes (the
+    sync-phase ms of every checkpoint; async mode only stalls for the
+    staging fetch, sync mode for the whole serialize+write).
+
+    subject = async-incremental eps, baseline = sync-full eps; a detail
+    JSON line carries per-mode eps + max/mean stall for BENCH_*.json.
+    """
+    import shutil
+    import tempfile
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    n_keys = 10_000
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            "key": (idx * 48271) % n_keys,
+            "value": np.ones(n, np.float32),
+        }
+        return cols, (idx // 4096) * 1000
+
+    def run(mode):
+        cfg = Configuration()
+        ckpt_dir = None
+        if mode != "off":
+            ckpt_dir = tempfile.mkdtemp(prefix=f"ckbench-{mode}-")
+            cfg.set("checkpoint.mode",
+                    "incremental" if mode == "async_incremental" else "full")
+            cfg.set("checkpoint.async", mode == "async_incremental")
+        env = StreamExecutionEnvironment(cfg)
+        env.set_parallelism(1)
+        env.set_max_parallelism(128)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(1 << 15)
+        env.batch_size = 32768
+        if ckpt_dir:
+            env.enable_checkpointing(8, ckpt_dir)
+        sink = CountingSink()
+        t0 = time.perf_counter()
+        (
+            env.add_source(GeneratorSource(gen, total=total_events))
+            .key_by(lambda c: c["key"])
+            .time_window(10_000)
+            .sum(lambda c: c["value"])
+            .add_sink(sink)
+        )
+        env.execute(f"ckpt-bench-{mode}")
+        dt = time.perf_counter() - t0
+        stats = env.last_job.metrics.checkpoint_stats or []
+        stalls = [s["sync_ms"] for s in stats]
+        if ckpt_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        assert sink.count > 0
+        return {
+            "eps": round(total_events / dt),
+            "checkpoints": len(stats),
+            "max_stall_ms": round(max(stalls), 2) if stalls else 0.0,
+            "mean_stall_ms": round(
+                sum(stalls) / len(stalls), 2) if stalls else 0.0,
+            "bytes_written": sum(s["bytes"] for s in stats),
+        }
+
+    detail = {m: run(m) for m in ("off", "sync_full", "async_incremental")}
+    print(json.dumps(
+        {"config": "checkpoint_overhead", "detail": detail}), flush=True)
+    return (detail["async_incremental"]["eps"],
+            detail["sync_full"]["eps"])
+
+
 CONFIGS = {
     "socket_wc": (run_socket_wc, 2_000_000),
     "count_min": (run_count_min, 4_000_000),
     "sessions": (run_sessions, 4_000_000),
     "cep": (run_cep, 400_000),
     "cep_event_time": (run_cep_event_time, 400_000),
+    "checkpoint_overhead": (run_checkpoint_overhead, 2_000_000),
 }
 
 

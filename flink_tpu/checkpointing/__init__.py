@@ -1,0 +1,44 @@
+"""Asynchronous incremental checkpointing.
+
+The reference makes snapshots *asynchronous* (CheckpointCoordinator +
+Chandy-Lamport barriers, SURVEY §3.4) so the processing thread never
+stalls on durability, and *incremental* (RocksDB incremental checkpoints)
+so a checkpoint's cost scales with what changed, not with what exists.
+This package is the micro-batch SPMD redesign of both:
+
+* ``changelog``   — which key groups changed since the last checkpoint.
+  The device half is a per-shard ``kg_dirty`` bool plane folded into the
+  window kernels' state struct (ops/window_kernels.py) and fetched with
+  the scalars at the step-boundary barrier; the host half is a dirty-set
+  tracker for heap state backends.
+* ``materializer`` — the background thread that serializes and writes a
+  staged snapshot while the step loop keeps running. The host staging
+  area is double-buffered: at most ``slots`` snapshots may be in flight,
+  and the sync phase blocks (backpressure, recorded) when both are busy.
+* ``manifest``    — the durable chain format: every checkpoint directory
+  carries a ``manifest.json`` naming its kind (full base | delta), the
+  chain of checkpoint ids it depends on, and the key groups its entries
+  cover. Retention GC never collects a directory still referenced by a
+  retained manifest.
+* ``recovery``    — replays base + deltas (last-writer-wins per key
+  group, purge-cutoff filtered) back into one logical snapshot, so
+  restore — including rescale re-bucketing — reuses the existing
+  ``restore_window_state`` path unchanged.
+"""
+
+from flink_tpu.checkpointing.changelog import (  # noqa: F401
+    HostChangelog,
+    dirty_shard_rows,
+    entry_key_groups,
+    filter_entries_to_key_groups,
+)
+from flink_tpu.checkpointing.manifest import (  # noqa: F401
+    MANIFEST_NAME,
+    build_manifest,
+    live_checkpoints,
+)
+from flink_tpu.checkpointing.materializer import (  # noqa: F401
+    Materializer,
+    MaterializerError,
+)
+from flink_tpu.checkpointing.recovery import replay_chain  # noqa: F401

@@ -1,0 +1,83 @@
+"""Changelog: which key groups changed since the last checkpoint.
+
+Device half: the window kernels fold a ``kg_dirty`` bool plane into the
+shard state struct (ops/window_kernels.py) — one route-hash + bool
+scatter per micro-batch marks the key groups each applied record belongs
+to. At the step-boundary barrier the host fetches the plane with the
+scalars and clears it (runtime/step.py ``clear_dirty``); the set of
+dirty groups decides which shards' state is staged and which entries
+ride the next delta.
+
+Host half: ``HostChangelog`` gives heap-style backends (state/backend.py)
+the same contract — mark-on-mutate, consume-at-snapshot — so a snapshot
+can skip re-serializing key groups nothing touched.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+import numpy as np
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.ops.hashing import route_hash
+
+
+def dirty_key_groups(kg_dirty_host: np.ndarray) -> np.ndarray:
+    """[S, KG] (or [KG]) fetched dirty planes -> sorted dirty group ids."""
+    arr = np.asarray(kg_dirty_host)
+    if arr.ndim > 1:
+        arr = arr.any(axis=tuple(range(arr.ndim - 1)))
+    return np.nonzero(arr)[0]
+
+
+def dirty_shard_rows(dirty_kgs, starts, ends) -> List[int]:
+    """Shard rows whose owned key-group range [starts[s], ends[s]]
+    intersects the dirty set — the only rows an incremental snapshot has
+    to fetch from the device."""
+    dirty_kgs = np.asarray(dirty_kgs)
+    rows = []
+    for s, (a, b) in enumerate(zip(np.asarray(starts), np.asarray(ends))):
+        if bool(((dirty_kgs >= a) & (dirty_kgs <= b)).any()):
+            rows.append(s)
+    return rows
+
+
+def entry_key_groups(key_hi, key_lo, max_parallelism: int) -> np.ndarray:
+    """Logical snapshot entries -> key group per entry (host numpy; the
+    same murmur route the device uses, so coverage filtering and device
+    routing can never disagree)."""
+    return assign_to_key_group(
+        route_hash(np.asarray(key_hi), np.asarray(key_lo), np),
+        max_parallelism, np,
+    )
+
+
+def filter_entries_to_key_groups(entries: dict, kgs,
+                                 max_parallelism: int) -> dict:
+    """Restrict a logical entries dict to the given key groups."""
+    khi = entries["key_hi"]
+    if len(khi) == 0:
+        return entries
+    kg = entry_key_groups(khi, entries["key_lo"], max_parallelism)
+    keep = np.isin(kg, np.asarray(list(kgs), dtype=kg.dtype))
+    return {k: v[keep] for k, v in entries.items()}
+
+
+class HostChangelog:
+    """Mark-on-mutate dirty-key-group set for host-side state backends.
+
+    Thread-compatible with the executor model (all mutations happen on
+    the task thread); ``consume()`` returns the dirty set and resets it —
+    exactly the fetch-and-clear the device plane gets at a barrier."""
+
+    def __init__(self):
+        self._dirty: Set[int] = set()
+
+    def mark(self, key_group: int) -> None:
+        self._dirty.add(key_group)
+
+    def consume(self) -> frozenset:
+        out = frozenset(self._dirty)
+        self._dirty.clear()
+        return out

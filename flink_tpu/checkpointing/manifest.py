@@ -1,0 +1,101 @@
+"""Manifest chain: the durable metadata tying deltas to their base.
+
+Every checkpoint directory written in incremental mode carries a
+``manifest.json``:
+
+    {
+      "manifest_version": 1,
+      "checkpoint_id":    7,
+      "kind":             "delta",          # or "full"
+      "chain":            [4, 5, 6, 7],     # base first, this cp last
+      "coverage":         [3, 17, 90],      # key groups in entries.npz
+      "max_parallelism":  128,
+      "entries":          1234,             # entry rows in this file
+      "bytes":            0                 # filled after serialization
+    }
+
+``kind: full`` checkpoints are self-contained (``chain == [cid]``,
+``coverage == "all"``); sync-full mode writes no manifest at all and is
+treated as such. Recovery walks ``chain`` and merges coverage
+last-writer-wins per key group (recovery.py). Retention GC keeps every
+directory reachable from a retained checkpoint's chain
+(``live_checkpoints``), so a base is never collected while a delta still
+references it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterable, List, Optional, Sequence, Union
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_VERSION = 1
+
+Coverage = Union[str, Sequence[int]]       # "all" | iterable of key groups
+
+
+def build_manifest(cid: int, kind: str, chain: Sequence[int],
+                   coverage: Coverage, max_parallelism: int,
+                   entries: int = 0, nbytes: int = 0) -> dict:
+    if kind not in ("full", "delta"):
+        raise ValueError(f"manifest kind must be full|delta, got {kind!r}")
+    if not chain or chain[-1] != cid:
+        raise ValueError(f"chain {chain!r} must end with checkpoint {cid}")
+    if kind == "full" and len(chain) != 1:
+        raise ValueError(f"a full checkpoint is its own chain, got {chain!r}")
+    return {
+        "manifest_version": MANIFEST_VERSION,
+        "checkpoint_id": int(cid),
+        "kind": kind,
+        "chain": [int(c) for c in chain],
+        "coverage": (
+            "all" if coverage == "all" else sorted(int(g) for g in coverage)
+        ),
+        "max_parallelism": int(max_parallelism),
+        "entries": int(entries),
+        "bytes": int(nbytes),
+    }
+
+
+def write_manifest(directory: str, manifest: dict) -> str:
+    path = os.path.join(directory, MANIFEST_NAME)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    return path
+
+
+def read_manifest(directory: str) -> Optional[dict]:
+    path = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        m = json.load(f)
+    if m.get("manifest_version") != MANIFEST_VERSION:
+        raise ValueError(f"unsupported checkpoint manifest: {m}")
+    return m
+
+
+def live_checkpoints(retained: Iterable[int],
+                     manifest_for: Callable[[int], Optional[dict]]
+                     ) -> set:
+    """Reference closure of the retained checkpoint ids.
+
+    ``manifest_for(cid)`` returns the cid's manifest dict or None (a
+    manifest-less directory — sync-full era — is self-contained). A
+    retained delta keeps its whole chain alive; GC may only collect
+    checkpoints OUTSIDE this set."""
+    live: set = set()
+    for cid in retained:
+        live.add(int(cid))
+        m = manifest_for(cid)
+        if m is not None:
+            live.update(int(c) for c in m.get("chain", ()))
+    return live
+
+
+def coverage_set(manifest: dict, max_parallelism: int) -> frozenset:
+    cov = manifest.get("coverage", "all")
+    if cov == "all":
+        return frozenset(range(max_parallelism))
+    return frozenset(int(g) for g in cov)

@@ -1,0 +1,162 @@
+"""Background materializer: the async phase of a checkpoint.
+
+The step loop's only blocking work at a barrier is the *sync phase*:
+drain due fires, fetch the (dirty subset of) device state into a host
+staging buffer, capture source offsets / sink states, clear the dirty
+bits. Everything downstream — entry extraction, delta filtering,
+serialization, the atomic directory publish, and retention GC — runs
+here, on one daemon thread, while the step loop is already dispatching
+the next micro-batch. (Completion notifications are only QUEUED by
+tasks; the step loop delivers them — connector callbacks mutate state
+the hot path touches.)
+
+Staging is double-buffered: at most ``slots`` snapshots may be pending.
+``submit`` blocks when the buffer is full (the step loop briefly
+backpressures instead of staging unboundedly — the wait is returned so
+the caller can record it), and tasks execute strictly FIFO so checkpoint
+ids publish in order and a delta can never be durable before its base.
+
+Failure model: a task that raises poisons the materializer — queued and
+subsequent tasks are dropped (their checkpoints never publish; a delta
+must not chain over a hole) and the error re-raises at the next
+``check()``/``submit()``/``flush()`` on the caller's thread, where the
+executor's restart machinery treats it like any checkpoint failure. The
+in-flight directory write goes through a ``.tmp`` staging dir + atomic
+rename (runtime/checkpoint.py), so a crash mid-write leaves the previous
+checkpoint fully recoverable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+
+class MaterializerError(RuntimeError):
+    """An async checkpoint write failed (original exception chained)."""
+
+
+class Materializer:
+    def __init__(self, slots: int = 2, name: str = "ckpt-materializer"):
+        if slots < 1:
+            raise ValueError("materializer needs at least one staging slot")
+        self.slots = slots
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._error: Optional[BaseException] = None
+        self._error_label: Optional[str] = None
+        self._closed = False
+        self._busy = False          # a task is executing right now
+        self._thread = threading.Thread(
+            target=self._main, daemon=True, name=name
+        )
+        self._thread.start()
+
+    # -- caller side ----------------------------------------------------
+    def pending(self) -> int:
+        """Occupied staging slots (queued + executing)."""
+        with self._cv:
+            return len(self._q) + (1 if self._busy else 0)
+
+    def check(self) -> None:
+        """Surface (and clear) a stored async failure on the caller's
+        thread. After the raise the materializer accepts work again —
+        the caller is expected to recover (restore) first."""
+        with self._cv:
+            err, label = self._error, self._error_label
+            if err is not None:
+                # purge poisoned tasks UNDER the same lock: clearing the
+                # error first would let the worker run a queued task whose
+                # checkpoint chains over the failed (never-published) one
+                self._q.clear()
+            self._error = None
+            self._error_label = None
+            self._cv.notify_all()
+        if err is not None:
+            raise MaterializerError(
+                f"async checkpoint {label!r} failed: {err}"
+            ) from err
+
+    def wait_for_slot(self) -> float:
+        """Block until a staging slot is free (or the materializer fails);
+        returns the seconds waited. Callers with a single submitting
+        thread use this to attribute the backpressure wait to the sync
+        phase BEFORE building the task."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while (len(self._q) + (1 if self._busy else 0)) >= self.slots \
+                    and self._error is None and not self._closed:
+                self._cv.wait(0.1)
+        return time.perf_counter() - t0
+
+    def submit(self, label: str, task: Callable[[], None]) -> None:
+        """Queue one materialization task. Blocks while all staging slots
+        are busy (callers that want the wait attributed separately call
+        wait_for_slot() first; with a single submitting thread the slot
+        cannot be stolen in between)."""
+        self.check()
+        self.wait_for_slot()
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("materializer is closed")
+            self._q.append((label, task))
+            self._cv.notify_all()
+        self.check()
+
+    def recover(self) -> None:
+        """Restore-time drain: let in-flight writes land (each is a valid
+        cut the restore may pick up), then drop queued tasks and any
+        stored failure — restoring IS the recovery from it."""
+        self.flush(raise_errors=False)
+        with self._cv:
+            self._q.clear()
+            self._error = None
+            self._error_label = None
+            self._cv.notify_all()
+
+    def flush(self, raise_errors: bool = True) -> None:
+        """Wait until every queued task has completed (or the materializer
+        failed). With raise_errors, surface the stored failure."""
+        with self._cv:
+            while (self._q or self._busy) and self._error is None \
+                    and not self._closed:
+                self._cv.wait(0.1)
+        if raise_errors:
+            self.check()
+
+    def close(self, flush: bool = True) -> None:
+        if flush:
+            self.flush(raise_errors=False)
+        with self._cv:
+            self._closed = True
+            self._q.clear()
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+
+    # -- worker side ----------------------------------------------------
+    def _main(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(0.2)
+                if self._closed:
+                    return
+                if self._error is not None:
+                    # poisoned: drop queued work (see module docstring)
+                    self._q.clear()
+                    self._cv.notify_all()
+                    continue
+                label, task = self._q.popleft()
+                self._busy = True
+            try:
+                task()
+            except BaseException as e:  # noqa: BLE001 — delivered via check()
+                with self._cv:
+                    self._error = e
+                    self._error_label = label
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()

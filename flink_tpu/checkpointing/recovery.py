@@ -1,0 +1,129 @@
+"""Recovery: replay a manifest chain back into one logical snapshot.
+
+A delta checkpoint's ``entries.npz`` holds the FULL current state of the
+key groups it covers (not an op log), so recovery is a per-key-group
+last-writer-wins merge over the chain: for every key group, take the
+entries of the NEWEST chain member covering it. Scalars (watermark,
+fired_through, max_pane, counters) are global and always fetched fully
+at every checkpoint, so the newest member's scalars win outright; the
+same goes for source offsets, sink states, and aux.
+
+Two filters reconcile merged entries with what the device itself would
+hold at the cut (older members may carry state the global sweeps have
+since retired — sweeps are deliberately NOT marked dirty, see
+ops/window_kernels.py):
+
+* ring horizon — entries whose pane fell off the R-pane ring are dropped
+  by ``restore_window_state`` already (pane <= max_pane - R);
+* purge cutoff — entries every containing window of which has fired and
+  passed the purge horizon are dropped HERE, mirroring the device's
+  purge sweep (advance_and_fire). With allowed lateness 0 this is exact:
+  cutoff = min(fired_through, watermark pane). Incremental mode is
+  restricted to lateness-0 stages (runtime/executor.py enforces it), so
+  the fresh/re-fire corner never reaches this code; a chain that somehow
+  carries lateness skips the filter (conservative: resurrecting an
+  already-purged pane never changes fires, only queryable reads).
+
+The merged result feeds the existing ``restore_window_state``
+re-bucketing unchanged, which is what makes chain recovery rescale-
+compatible for free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from flink_tpu.checkpointing import manifest as mf
+from flink_tpu.checkpointing.changelog import entry_key_groups
+
+PANE_NONE = -(2 ** 31) + 1
+
+
+def _purge_cutoff(scalars: dict, slide: int) -> int:
+    """The device's purge cutoff at the cut (advance_and_fire, L=0)."""
+    wm = int(scalars["watermark"])
+    base = max(wm, -(2 ** 31) + 1 + slide)
+    wm_pane = (base + 1 - slide) // slide
+    fired = int(scalars["fired_through"])
+    if fired == PANE_NONE:
+        return PANE_NONE
+    return min(fired, wm_pane)
+
+
+def replay_chain(storage, cid: int) -> Tuple[dict, dict, object, dict]:
+    """Merge checkpoint ``cid``'s chain into one logical snapshot.
+
+    ``storage`` is a CheckpointStorage (duck-typed: read_raw(cid) ->
+    (entries, scalars, offsets, aux) and read_manifest(cid) -> dict|None).
+    Returns the same 4-tuple ``read_raw`` does.
+    """
+    head = storage.read_manifest(cid)
+    if head is None or head.get("kind") != "delta":
+        return storage.read_raw(cid)
+    chain = head["chain"]
+    maxp = head["max_parallelism"]
+
+    members = []
+    for c in chain:
+        m = storage.read_manifest(c)
+        if c != chain[0] and (m is None or m.get("kind") != "delta"):
+            # only the chain head (base) may be full / manifest-less
+            raise ValueError(
+                f"checkpoint {cid} chains over {c}, which is "
+                f"{'missing its manifest' if m is None else repr(m.get('kind'))}"
+                f" — a non-head chain member must be a delta (chain "
+                f"broken or directory tampered with)"
+            )
+        cov = (
+            mf.coverage_set(m, maxp) if m is not None
+            else frozenset(range(maxp))
+        )
+        try:
+            entries, scalars, offsets, aux = storage.read_raw(c)
+        except FileNotFoundError as e:
+            raise FileNotFoundError(
+                f"checkpoint {cid} chains over missing member {c}: {e}"
+            ) from e
+        members.append((c, cov, entries, scalars, offsets, aux))
+
+    # last-writer-wins ownership per key group
+    owner = np.full(maxp, -1, np.int64)
+    for i, (_c, cov, *_rest) in enumerate(members):
+        owner[np.asarray(sorted(cov), np.int64)] = i
+
+    parts = []
+    for i, (_c, _cov, entries, *_rest) in enumerate(members):
+        khi = entries["key_hi"]
+        if len(khi) == 0:
+            continue
+        kg = entry_key_groups(khi, entries["key_lo"], maxp)
+        keep = owner[kg] == i
+        if keep.any():
+            parts.append({k: v[keep] for k, v in entries.items()})
+
+    newest = members[-1]
+    _c, _cov, newest_entries, scalars, offsets, aux = newest
+    if parts:
+        merged = {
+            k: np.concatenate([p[k] for p in parts])
+            for k in parts[0]
+        }
+    else:
+        merged = {k: v[:0] for k, v in newest_entries.items()}
+
+    # purge-cutoff filter (exact for lateness-0 stages; see module doc)
+    slide = int(aux.get("slide_ms", 0) or 0)
+    size = int(aux.get("size_ms", 0) or 0)
+    lateness = int(aux.get("lateness_ms", 0) or 0)
+    if slide > 0 and lateness == 0 and len(merged["pane"]):
+        k_panes = max(1, size // slide)
+        cutoff = _purge_cutoff(scalars, slide)
+        keep = merged["pane"].astype(np.int64) + (k_panes - 1) > cutoff
+        fresh = merged.get("fresh")
+        if fresh is not None and len(fresh):
+            keep = keep | fresh.astype(bool)
+        merged = {k: v[keep] for k, v in merged.items()}
+
+    return merged, scalars, offsets, aux

@@ -1,0 +1,39 @@
+"""Version compatibility shims for the jax API surface.
+
+One seam for symbols that have moved between jax releases, so a jax bump
+breaks loudly HERE (guarded by tests/test_compat.py) instead of at six
+scattered import sites.
+
+``shard_map``: promoted from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` in jax 0.6; ``from jax import shard_map``
+therefore fails on the 0.4.x line this repo pins. The replication-check
+kwarg was also renamed (``check_rep`` -> ``check_vma``), so the shim
+normalizes to the NEW spelling: callers write ``check_vma`` and the shim
+translates for an older jax.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):  # jax >= 0.6: stable top-level export
+    _shard_map = jax.shard_map
+else:  # jax 0.4.x/0.5.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# kwarg normalization applies to EITHER origin: the top-level promotion
+# and the check_rep->check_vma rename did not ship in the same release,
+# so the resolved symbol's own signature decides
+if "check_vma" in inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+__all__ = ["shard_map"]

@@ -113,6 +113,26 @@ class CoreOptions:
     STATE_PROBE_LENGTH = ConfigOption("state.backend.device.probe-length", 16)
     CHECKPOINT_INTERVAL_STEPS = ConfigOption("checkpoint.interval-steps", 0)
     CHECKPOINT_DIR = ConfigOption("checkpoint.dir", None)
+    # snapshot strategy (flink_tpu/checkpointing, ref incremental RocksDB
+    # checkpoints + asynchronous snapshots): "full" writes self-contained
+    # snapshots, "incremental" writes delta checkpoints covering only the
+    # dirty key groups, chained to a periodic full base via manifest.json
+    CHECKPOINT_MODE = ConfigOption(
+        "checkpoint.mode", "full",
+        "full | incremental (changelog delta + manifest chain)")
+    # serialize + write on the background materializer thread; the step
+    # loop blocks only for the staging fetch. Defaults on for incremental.
+    CHECKPOINT_ASYNC = ConfigOption(
+        "checkpoint.async", False,
+        "materialize checkpoints on a background thread")
+    CHECKPOINT_RETAIN = ConfigOption(
+        "checkpoint.retain", 2, "retained checkpoints (chain-closure aware)")
+    CHECKPOINT_COMPACT_EVERY = ConfigOption(
+        "checkpoint.compact-every", 8,
+        "write a fresh full base after this many chained checkpoints")
+    CHECKPOINT_STAGING_SLOTS = ConfigOption(
+        "checkpoint.staging-slots", 2,
+        "host staging buffers in flight (double-buffered by default)")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)

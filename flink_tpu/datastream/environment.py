@@ -36,6 +36,15 @@ class StreamExecutionEnvironment:
             CoreOptions.CHECKPOINT_INTERVAL_STEPS
         )
         self.checkpoint_dir = self.config.get(CoreOptions.CHECKPOINT_DIR)
+        # validate here, not per stage loop: every stage kind consults
+        # this key (a typo must fail loudly for ALL of them, not only
+        # the windowed path)
+        ck_mode = self.config.get_str("checkpoint.mode", "full")
+        if ck_mode not in ("full", "incremental"):
+            raise ValueError(
+                f"checkpoint.mode must be full|incremental, "
+                f"got {ck_mode!r}"
+            )
         self.state_capacity_per_shard = self.config.get(
             CoreOptions.STATE_SLOTS_PER_SHARD
         )

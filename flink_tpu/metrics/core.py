@@ -41,6 +41,20 @@ class Gauge:
         return self._fn()
 
 
+class SettableGauge(Gauge):
+    """Gauge holding a pushed value instead of polling a closure — for
+    producers that know the value only at irregular events (e.g. the
+    key-group coverage of the last incremental checkpoint), where a
+    polled closure would have to reach into producer internals."""
+
+    def __init__(self, initial: Any = None):
+        super().__init__(lambda: self._v)
+        self._v = initial
+
+    def set(self, value: Any):
+        self._v = value
+
+
 class Histogram:
     """Sliding-window histogram with percentile snapshots (ref
     DescriptiveStatisticsHistogram role). Updates come from the job thread
@@ -142,6 +156,9 @@ class MetricGroup:
 
     def counter(self, name: str) -> Counter:
         return self._register(name, Counter())
+
+    def settable_gauge(self, name: str, initial: Any = None) -> SettableGauge:
+        return self._register(name, SettableGauge(initial))
 
     def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
         return self._register(name, Gauge(fn))

@@ -33,7 +33,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from flink_tpu.core.keygroups import assign_to_key_group
 from flink_tpu.ops import hashtable
+from flink_tpu.ops.hashing import route_hash
 from flink_tpu.ops.hashtable import SlotTable
 from flink_tpu.ops.segment import preaggregate, scatter_combine
 
@@ -166,6 +168,13 @@ class WindowShardState:
     ovf_pane: jax.Array         # int32 [O]
     ovf_val: jax.Array          # [O, *value_shape] red.dtype
     ovf_n: jax.Array            # int32 scalar: filled lanes
+    # changelog dirty bits [n_key_groups] (size 0 = tracking off):
+    # kg_dirty[g] is set when a record of key group g touched this shard's
+    # state since the host last cleared it — the device half of
+    # incremental checkpointing (flink_tpu/checkpointing/): fetched with
+    # the scalars at the step-boundary barrier, it tells the snapshot
+    # which key groups' entries must ride the next delta
+    kg_dirty: jax.Array         # bool [n_key_groups]
 
     def tree_flatten(self):
         return (
@@ -173,7 +182,7 @@ class WindowShardState:
              self.min_pane, self.watermark, self.fired_through,
              self.purged_through, self.dropped_late, self.dropped_capacity,
              self.fresh, self.n_fresh, self.ovf_hi, self.ovf_lo,
-             self.ovf_pane, self.ovf_val, self.ovf_n),
+             self.ovf_pane, self.ovf_val, self.ovf_n, self.kg_dirty),
             None,
         )
 
@@ -213,7 +222,8 @@ def overflow_supported(red: ReduceSpec) -> bool:
 
 
 def init_state(capacity: int, probe_len: int, win: WindowSpec,
-               red: ReduceSpec, layout: str = "hash") -> WindowShardState:
+               red: ReduceSpec, layout: str = "hash",
+               n_key_groups: int = 0) -> WindowShardState:
     """layout="direct": the DIRECT-INDEX state backend. For keys that are
     bounded non-negative ints (identity hi==0, lo < capacity — see
     hashing.key_identity64), the key IS its slot: no probe gathers, no
@@ -267,6 +277,7 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
         ovf_pane=jnp.full((O,), PANE_NONE, jnp.int32),
         ovf_val=jnp.zeros((O,) + red.value_shape, red.dtype),
         ovf_n=jnp.zeros((), jnp.int32),
+        kg_dirty=jnp.zeros(n_key_groups, bool),
     )
 
 
@@ -369,6 +380,7 @@ def update(
     hi, lo, ts, values, valid,
     insert: bool = True,
     direct: bool = False,
+    kg=None,
 ):
     """Apply one micro-batch of records to shard state (pure function).
 
@@ -457,6 +469,24 @@ def update(
     too_old = live & (pane < oldest)
     n_too_old = jnp.sum(too_old, dtype=jnp.int32)
     live = live & ~too_old
+
+    # -- changelog dirty bits: every surviving lane is about to mutate
+    # this shard's state for its key group (table/accumulator scatter OR
+    # the overflow ring -> spill tier), so mark the group dirty BEFORE the
+    # fit check — over-marking a spilled lane's group is safe (its delta
+    # just covers a group that only changed host-side), under-marking
+    # would silently drop its state from the next incremental checkpoint.
+    # `kg`: the caller's precomputed per-lane key groups (the routing
+    # bodies in runtime/step.py already have them — skip the re-hash).
+    KG = state.kg_dirty.shape[0]
+    if KG:
+        if kg is None:
+            kg = assign_to_key_group(route_hash(hi, lo, jnp), KG, jnp)
+        kg_dirty = state.kg_dirty.at[
+            jnp.where(live, kg.astype(jnp.int32), jnp.int32(KG))
+        ].set(True, mode="drop")
+    else:
+        kg_dirty = state.kg_dirty
 
     # -- key upsert / lookup ------------------------------------------------
     # activity = lanes the CURRENT mode failed to handle natively:
@@ -563,6 +593,7 @@ def update(
         ovf_pane=ovf_pane,
         ovf_val=ovf_val,
         ovf_n=ovf_n,
+        kg_dirty=kg_dirty,
     ), activity
 
 
@@ -896,5 +927,10 @@ def advance_and_fire(
         ovf_pane=state.ovf_pane,
         ovf_val=state.ovf_val,
         ovf_n=state.ovf_n,
+        # fires/purges are NOT marked dirty: they are global sweeps fully
+        # determined by the scalars (fired_through/watermark), and chain
+        # recovery re-applies the same purge cutoff to merged entries
+        # (checkpointing/recovery.py), so per-group bits stay precise
+        kg_dirty=state.kg_dirty,
     )
     return new_state, FireResult(mask, values, window_end, n_fires, lane_valid)

@@ -62,28 +62,88 @@ class SnapshotMeta:
     aux: dict
 
 
-def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
-    """Device -> logical entries. state is the stacked [n_shards, ...] tree."""
-    keys = np.asarray(state.table.keys)          # [S, C, 2]
-    acc = np.asarray(state.acc)                  # [S, C*R, ...]
-    touched = np.asarray(state.touched)          # [S, C*R]
-    pane_ids = np.asarray(state.pane_ids)        # [S, R]
-    S, C, _ = keys.shape
+def stage_window_state(state: wk.WindowShardState, rows=None) -> dict:
+    """SYNC phase of a snapshot: device -> host staging buffer.
+
+    Fetches the bulk per-shard arrays of the selected shard ``rows``
+    (incremental checkpoints pass only the shards owning dirty key
+    groups; None = all) plus the tiny global scalars, in one batched
+    device_get. Everything returned is a host numpy COPY, so the caller
+    can hand the staging buffer to the background materializer and keep
+    donating the live device buffers to subsequent steps.
+    """
+    S = int(state.acc.shape[0])
+    all_rows = rows is None or len(rows) == S
+    rows = list(range(S)) if rows is None else sorted(int(r) for r in rows)
+    if all_rows:
+        bulk = {
+            "keys": state.table.keys, "acc": state.acc,
+            "touched": state.touched, "pane_ids": state.pane_ids,
+            "fresh": state.fresh,
+        }
+    else:
+        # lazy row slices: only the dirty shards' bytes cross the link
+        bulk = {
+            "keys": [state.table.keys[s] for s in rows],
+            "acc": [state.acc[s] for s in rows],
+            "touched": [state.touched[s] for s in rows],
+            "pane_ids": [state.pane_ids[s] for s in rows],
+            "fresh": [state.fresh[s] for s in rows],
+        }
+    small = {
+        "watermark": state.watermark, "fired_through": state.fired_through,
+        "max_pane": state.max_pane, "min_pane": state.min_pane,
+        "dropped_late": state.dropped_late,
+        "dropped_capacity": state.dropped_capacity,
+    }
+    bulk_h, small_h = jax.device_get((bulk, small))
+    shards = {}
+    for i, s in enumerate(rows):
+        shards[s] = {
+            k: np.asarray(bulk_h[k][s if all_rows else i])
+            for k in ("keys", "acc", "touched", "pane_ids", "fresh")
+        }
+    # value tail shape/dtype from the LIVE acc ([S, C*R, *tail]): an
+    # empty staging (zero dirty shards) must still write correctly-
+    # shaped empty entry arrays for vector / non-f32 reductions
+    value_tail = tuple(state.acc.shape[2:])
+    value_dtype = np.dtype(state.acc.dtype)
+    scalars = {
+        "watermark": int(np.asarray(small_h["watermark"]).min()),
+        "fired_through": int(np.asarray(small_h["fired_through"]).min()),
+        "max_pane": int(np.asarray(small_h["max_pane"]).max()),
+        "min_pane": int(np.asarray(small_h["min_pane"]).min()),
+        "dropped_late": int(np.asarray(small_h["dropped_late"]).sum()),
+        "dropped_capacity": int(
+            np.asarray(small_h["dropped_capacity"]).sum()
+        ),
+    }
+    return {"n_shards": S, "rows": rows, "shards": shards,
+            "scalars": scalars, "value_tail": value_tail,
+            "value_dtype": value_dtype}
+
+
+def extract_entries(staged: dict, win: wk.WindowSpec):
+    """ASYNC phase: staging buffer -> logical (key, pane, value) entries.
+
+    Pure host numpy over the staged copies — runs on the materializer
+    thread without touching device state. Returns (entries, scalars)."""
     R = win.ring
-
-    fresh = np.asarray(state.fresh)               # [S, C*R]
-
     khi_l, klo_l, pane_l, val_l, fresh_l = [], [], [], [], []
-    for s in range(S):
-        t2 = touched[s].reshape(R, C)   # ring-major device layout
+    for s in staged["rows"]:
+        sh = staged["shards"][s]
+        keys = sh["keys"]                       # [C, 2]
+        acc = sh["acc"]                         # [C*R, ...]
+        C = keys.shape[0]
+        t2 = sh["touched"].reshape(R, C)   # ring-major device layout
         rings, slots = np.nonzero(t2)
         if slots.size == 0:
             continue
-        khi_l.append(keys[s, slots, 0])
-        klo_l.append(keys[s, slots, 1])
-        pane_l.append(pane_ids[s, rings])
-        val_l.append(acc[s].reshape((R, C) + acc.shape[2:])[rings, slots])
-        fresh_l.append(fresh[s].reshape(R, C)[rings, slots])
+        khi_l.append(keys[slots, 0])
+        klo_l.append(keys[slots, 1])
+        pane_l.append(sh["pane_ids"][rings])
+        val_l.append(acc.reshape((R, C) + acc.shape[1:])[rings, slots])
+        fresh_l.append(sh["fresh"].reshape(R, C)[rings, slots])
     if khi_l:
         entries = {
             "key_hi": np.concatenate(khi_l),
@@ -97,18 +157,19 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
             "key_hi": np.zeros(0, np.uint32),
             "key_lo": np.zeros(0, np.uint32),
             "pane": np.zeros(0, np.int32),
-            "value": np.zeros((0,) + acc.shape[2:], acc.dtype),
+            "value": np.zeros(
+                (0,) + tuple(staged["value_tail"]), staged["value_dtype"]
+            ),
             "fresh": np.zeros(0, bool),
         }
-    scalars = {
-        "watermark": int(np.asarray(state.watermark).min()),
-        "fired_through": int(np.asarray(state.fired_through).min()),
-        "max_pane": int(np.asarray(state.max_pane).max()),
-        "min_pane": int(np.asarray(state.min_pane).min()),
-        "dropped_late": int(np.asarray(state.dropped_late).sum()),
-        "dropped_capacity": int(np.asarray(state.dropped_capacity).sum()),
-    }
-    return entries, scalars
+    return entries, dict(staged["scalars"])
+
+
+def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
+    """Device -> logical entries. state is the stacked [n_shards, ...]
+    tree. The synchronous composition of stage + extract — the sync-full
+    path and savepoints use it directly."""
+    return extract_entries(stage_window_state(state), win)
 
 
 def restore_window_state(entries, scalars, ctx, spec, leftover=None):
@@ -274,6 +335,9 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
                       np.asarray(jnp.zeros((), spec.red.dtype)).dtype)] * S
         ),
         ovf_n=_scal(S, 0, ctx, split=True),
+        # changelog restarts clean: the restored state IS the chain's
+        # state, so the next incremental checkpoint extends that chain
+        kg_dirty=stack_put([np.zeros(ctx.max_parallelism, bool)] * S),
     )
     return new_state
 
@@ -289,8 +353,13 @@ def _scal(S, v, ctx, split=False):
 
 
 class CheckpointStorage:
-    """Directory layout:  <dir>/chk-<id>/{meta.json, entries.npz, aux.pkl}
-    (ref FsStateBackend checkpoint stream role)."""
+    """Directory layout:  <dir>/chk-<id>/{meta.json, entries.npz, aux.pkl
+    [, manifest.json]}  (ref FsStateBackend checkpoint stream role).
+
+    Incremental checkpoints add a manifest.json (checkpointing/manifest)
+    naming the chain of checkpoint ids they depend on; retention GC keeps
+    every directory a retained manifest references, so a delta can never
+    outlive its base."""
 
     def __init__(self, directory: str, retain: int = 2):
         self.dir = directory
@@ -300,12 +369,21 @@ class CheckpointStorage:
     def path(self, cid: int) -> str:
         return os.path.join(self.dir, f"chk-{cid}")
 
-    def write(self, cid: int, entries, scalars, source_offsets, aux: dict):
+    def write(self, cid: int, entries, scalars, source_offsets=None,
+              aux: dict = None, manifest: dict = None, aux_bytes=None):
+        """aux_bytes: pre-pickled {"source_offsets", "aux"} payload — the
+        async path serializes it on the BARRIER thread (sink/source state
+        may keep mutating once the step loop resumes) and hands the
+        frozen bytes to the materializer."""
         tmp = self.path(cid) + ".tmp"
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "entries.npz"), **entries)
+        if aux_bytes is None:
+            aux_bytes = pickle.dumps(
+                {"source_offsets": source_offsets, "aux": aux}
+            )
         with open(os.path.join(tmp, "aux.pkl"), "wb") as f:
-            pickle.dump({"source_offsets": source_offsets, "aux": aux}, f)
+            f.write(aux_bytes)
         meta = {
             "format_version": FORMAT_VERSION,
             "checkpoint_id": cid,
@@ -314,6 +392,18 @@ class CheckpointStorage:
         }
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
+        if manifest is not None:
+            from flink_tpu.checkpointing import manifest as mf
+
+            nbytes = sum(
+                os.path.getsize(os.path.join(tmp, f))
+                for f in os.listdir(tmp)
+            )
+            mf.write_manifest(tmp, {
+                **manifest,
+                "entries": int(len(entries["key_hi"])),
+                "bytes": int(nbytes),
+            })
         final = self.path(cid)
         if os.path.exists(final):
             shutil.rmtree(final)
@@ -321,11 +411,24 @@ class CheckpointStorage:
         self._gc(keep_latest=cid)
         return final
 
+    def read_manifest(self, cid: int):
+        from flink_tpu.checkpointing import manifest as mf
+
+        return mf.read_manifest(self.path(cid))
+
     def _gc(self, keep_latest: int):
-        cids = [c for c in self.list_checkpoints() if c != keep_latest]
-        # keep the newest (retain-1) besides keep_latest
-        for cid in cids[: -(self.retain - 1)] if self.retain > 1 else cids:
-            shutil.rmtree(self.path(cid), ignore_errors=True)
+        from flink_tpu.checkpointing import manifest as mf
+
+        cids = self.list_checkpoints()
+        others = [c for c in cids if c != keep_latest]
+        retained = {keep_latest}
+        if self.retain > 1:
+            retained.update(others[-(self.retain - 1):])
+        # manifest closure: a retained delta keeps its whole chain alive
+        live = mf.live_checkpoints(retained, self.read_manifest)
+        for cid in cids:
+            if cid not in live:
+                shutil.rmtree(self.path(cid), ignore_errors=True)
 
     def list_checkpoints(self):
         out = []
@@ -340,9 +443,26 @@ class CheckpointStorage:
         return sorted(out)
 
     def read(self, cid: int):
+        """Logical snapshot at checkpoint ``cid``. A delta checkpoint is
+        transparently resolved through its manifest chain (base + deltas,
+        last-writer-wins per key group), so callers restore from either
+        kind through the same call."""
+        m = self.read_manifest(cid)
+        if m is not None and m.get("kind") == "delta":
+            from flink_tpu.checkpointing.recovery import replay_chain
+
+            return replay_chain(self, cid)
+        return self.read_raw(cid)
+
+    def read_raw(self, cid: int):
+        """One checkpoint directory's own files, chain-unresolved."""
         p = self.path(cid)
-        with open(os.path.join(p, "meta.json")) as f:
-            meta = json.load(f)
+        try:
+            with open(os.path.join(p, "meta.json")) as f:
+                meta = json.load(f)
+        except OSError as e:
+            raise FileNotFoundError(f"checkpoint {cid} unreadable: {e}") \
+                from e
         if meta.get("format_version") != FORMAT_VERSION:
             raise ValueError(f"unsupported checkpoint format: {meta}")
         with np.load(os.path.join(p, "entries.npz")) as z:
@@ -364,11 +484,17 @@ class CheckpointStorage:
     # Heap-backend stages (ProcessFunction, CEP, ...) snapshot pickled
     # key-group blobs instead of device arrays; same chk-<id> layout and
     # retention, different payload file.
-    def write_generic(self, cid: int, payload: dict):
+    def write_generic(self, cid: int, payload: dict = None,
+                      payload_bytes: bytes = None):
+        """payload_bytes: pre-pickled payload — the async path serializes
+        on the barrier thread and ships frozen bytes (see write())."""
         tmp = self.path(cid) + ".tmp"
         os.makedirs(tmp, exist_ok=True)
         with open(os.path.join(tmp, "state.pkl"), "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            if payload_bytes is not None:
+                f.write(payload_bytes)
+            else:
+                pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
         meta = {
             "format_version": FORMAT_VERSION,
             "checkpoint_id": cid,

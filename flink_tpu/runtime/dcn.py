@@ -709,7 +709,7 @@ class DCNWindowRunner(_DCNRunnerBase):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from flink_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from flink_tpu.ops import window_kernels as wk
@@ -865,7 +865,7 @@ class DCNSessionRunner(_DCNRunnerBase):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from flink_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from flink_tpu.ops import session_windows as sw
@@ -1024,7 +1024,7 @@ class DCNRollingRunner(_DCNRunnerBase):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from flink_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from flink_tpu.ops import rolling
@@ -1154,7 +1154,7 @@ class DCNCEPRunner(_DCNRunnerBase):
     def _build_step(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from flink_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from flink_tpu.cep import device as cdev

@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
+import pickle
 import queue
 import sys
 import threading
@@ -33,6 +34,9 @@ from flink_tpu.core.types import KeyCodec
 from flink_tpu.graph import stream_graph as sg
 from flink_tpu.ops import window_kernels as wk
 from flink_tpu.parallel.mesh import MeshContext
+from flink_tpu.checkpointing import changelog as cklog
+from flink_tpu.checkpointing import manifest as ckmf
+from flink_tpu.checkpointing.materializer import Materializer
 from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_compact_step,
@@ -40,6 +44,7 @@ from flink_tpu.runtime.step import (
     build_window_fire_step,
     build_window_update_step,
     build_window_update_step_exchange,
+    clear_dirty,
     clear_overflow,
     init_sharded_state,
 )
@@ -100,6 +105,98 @@ def _pad(arr, size, dtype):
     return out
 
 
+class _GenericCheckpointIO:
+    """Async write machinery shared by every generic (pickled-payload)
+    checkpoint path — flat-stage, keyed-process, and device-CEP. Owns
+    the optional Materializer, the completion-notification queue, and
+    the drain/flush/recover/close protocol, so the three paths cannot
+    diverge. (The windowed path has its own staged delta pipeline.)
+
+    checkpoint.async defaults on when checkpoint.mode=incremental —
+    the same rule as the windowed path, so /checkpoints/config reports
+    what actually runs. The generic payloads themselves are always full
+    snapshots (one small pytree/dict — nothing to delta)."""
+
+    def __init__(self, env, storage, pipe):
+        self.storage = storage
+        self.pipe = pipe
+        self.materializer = None
+        if storage is not None and env.config.get_bool(
+            "checkpoint.async",
+            env.config.get_str("checkpoint.mode", "full") == "incremental",
+        ):
+            self.materializer = Materializer(
+                slots=env.config.get_int("checkpoint.staging-slots", 2)
+            )
+        # (cid, offsets) of durable checkpoints awaiting completion
+        # fan-out: the materializer thread only QUEUES here — the step
+        # loop delivers, because notify_checkpoint_complete mutates
+        # connector state the hot path touches concurrently
+        self._notify_q = deque()
+
+    def queue_notification(self, cid, offsets):
+        """Record a now-durable checkpoint for fan-out at the next
+        drain. Called from the materializer thread by write paths that
+        serialize their own files (the windowed staged-delta pipeline)."""
+        self._notify_q.append((cid, offsets))
+
+    def drain(self):
+        """Deliver queued checkpoint-complete fan-outs ON THIS (the
+        step loop's) thread."""
+        while self._notify_q:
+            cid, offsets = self._notify_q.popleft()
+            self.pipe.source.notify_checkpoint_complete(cid, offsets)
+            for s in self.pipe.all_sinks:
+                s.notify_checkpoint_complete(cid)
+
+    def write(self, cid, payload):
+        """Write a generic checkpoint + schedule its completion fan-out.
+        Async mode pickles NOW (the live payload keeps mutating once the
+        step loop resumes) and ships frozen bytes to the materializer."""
+        self.drain()
+        if self.materializer is None:
+            self.storage.write_generic(cid, payload)
+            self.pipe.source.notify_checkpoint_complete(
+                cid, payload["offsets"]
+            )
+            for s in self.pipe.all_sinks:
+                s.notify_checkpoint_complete(cid)
+            return
+        self.materializer.check()
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        offsets = payload["offsets"]
+
+        def task():
+            self.storage.write_generic(cid, payload_bytes=blob)
+            self._notify_q.append((cid, offsets))
+
+        self.materializer.submit(f"chk-{cid}", task)
+
+    def recover(self):
+        """Restore-time drain: in-flight async writes land (each is a
+        valid cut the restore may pick up), stored failures drop."""
+        if self.materializer is not None:
+            self.materializer.recover()
+            self.drain()
+
+    def flush(self):
+        """Success-path barrier: a still-failing async write IS a
+        checkpoint failure — raises inside the caller's restart scope."""
+        if self.materializer is not None:
+            self.materializer.flush()
+            self.drain()
+
+    def settle(self):
+        """Failure-path barrier: let pending cuts become durable before
+        the caller checks whether a restartable checkpoint exists."""
+        if self.materializer is not None:
+            self.materializer.flush(raise_errors=False)
+
+    def close(self):
+        if self.materializer is not None:
+            self.materializer.close(flush=True)
+
+
 class _FlatStageCheckpointer:
     """Step-boundary checkpoint/savepoint/restore for keyed stage kinds
     whose device state is ONE flat pytree of per-shard arrays (rolling
@@ -147,6 +244,7 @@ class _FlatStageCheckpointer:
         self.next_cid = (
             (self.storage.latest() or 0) + 1 if self.storage else 1
         )
+        self.io = _GenericCheckpointIO(env, self.storage, pipe)
         self.steps_at_ckpt = 0
         self.n_keys_logged = 0
         executor._savepoint_writer = self.write_savepoint
@@ -177,6 +275,7 @@ class _FlatStageCheckpointer:
         }
 
     def maybe_checkpoint(self):
+        self.io.drain()
         if (
             self.storage is not None
             and self.env.checkpoint_interval_steps > 0
@@ -187,17 +286,12 @@ class _FlatStageCheckpointer:
 
     def write_checkpoint(self):
         self.emitter.drain()
-        payload = self._payload(self.storage)
-        self.storage.write_generic(self.next_cid, payload)
-        self.pipe.source.notify_checkpoint_complete(
-            self.next_cid, payload["offsets"]
-        )
-        for s in self.pipe.all_sinks:
-            s.notify_checkpoint_complete(self.next_cid)
+        self.io.write(self.next_cid, self._payload(self.storage))
         self.next_cid += 1
         self.steps_at_ckpt = self.metrics.steps
 
     def restore(self, path_or_storage, cid=None):
+        self.io.recover()             # durable cuts still notify
         st = (
             ckpt.CheckpointStorage(path_or_storage)
             if isinstance(path_or_storage, str) else path_or_storage
@@ -292,23 +386,28 @@ class _FlatStageCheckpointer:
         if restore_from:
             self.restore(restore_from)
         restart = self.executor._restart_strategy()
-        while True:
-            try:
-                batch_loop()
-                break
-            except JobCancelledException:
-                raise
-            except Exception:
-                can = (
-                    self.storage is not None
-                    and self.storage.latest() is not None
-                    and restart.should_restart()
-                )
-                if not can:
+        try:
+            while True:
+                try:
+                    batch_loop()
+                    self.io.flush()
+                    break
+                except JobCancelledException:
                     raise
-                self.metrics.restarts += 1
-                self.executor._notify_restart()
-                self.restore(self.storage)
+                except Exception:
+                    self.io.settle()
+                    can = (
+                        self.storage is not None
+                        and self.storage.latest() is not None
+                        and restart.should_restart()
+                    )
+                    if not can:
+                        raise
+                    self.metrics.restarts += 1
+                    self.executor._notify_restart()
+                    self.restore(self.storage)
+        finally:
+            self.io.close()
 
 
 @dataclasses.dataclass
@@ -347,16 +446,34 @@ class JobMetrics:
     checkpoint_stats: Any = None
 
     def record_checkpoint(self, cid: int, trigger_ms: float,
-                          duration_ms: float, nbytes: int, entries: int):
+                          duration_ms: float, nbytes: int, entries: int,
+                          kind: str = "full", sync_ms: float = None,
+                          async_ms: float = None, coverage: int = None,
+                          staging_wait_ms: float = 0.0,
+                          staging_occupancy: int = 0):
+        """kind: "full" | "delta". sync_ms is the step-loop stall (drain +
+        staging fetch + offset capture + staging-slot wait); async_ms the
+        background materialization (extract/serialize/publish). Sync-mode
+        checkpoints report the whole duration as sync_ms."""
         if self.checkpoint_stats is None:
             self.checkpoint_stats = []
-        self.checkpoint_stats.append({
+        row = {
             "id": cid,
             "trigger_ms": round(trigger_ms, 1),
             "duration_ms": round(duration_ms, 2),
             "bytes": nbytes,
             "entries": entries,
-        })
+            "kind": kind,
+            "sync_ms": round(
+                duration_ms if sync_ms is None else sync_ms, 2
+            ),
+            "async_ms": round(async_ms or 0.0, 2),
+            "staging_wait_ms": round(staging_wait_ms, 2),
+            "staging_occupancy": staging_occupancy,
+        }
+        if coverage is not None:
+            row["coverage"] = coverage
+        self.checkpoint_stats.append(row)
         del self.checkpoint_stats[:-200]      # bounded history
 
     def record_fire_latency(self, n_windows: int, ms: float):
@@ -1318,17 +1435,78 @@ class LocalExecutor:
         steps_at_ckpt = 0
         n_keys_logged = 0
 
-        def _append_spill_entries(entries):
+        # -- async / incremental subsystem (flink_tpu/checkpointing) -------
+        # checkpoint.mode:  full        -> every checkpoint is a
+        #                                  self-contained snapshot
+        #                   incremental -> delta checkpoints covering only
+        #                                  the dirty key groups, chained
+        #                                  to a periodic full base via
+        #                                  manifest.json
+        # checkpoint.async: serialize + write on a background materializer
+        #                   thread; the step loop blocks only for the
+        #                   staging fetch (defaults on for incremental)
+        ck_mode = env.config.get_str("checkpoint.mode", "full")
+        ck_compact_every = max(
+            1, env.config.get_int("checkpoint.compact-every", 8)
+        )
+        if ck_mode == "incremental" and wagg.allowed_lateness_ms:
+            # dirty bits deliberately skip the global fire/purge sweeps
+            # (recovery re-applies the purge cutoff), which is exact ONLY
+            # without late re-fires — see checkpointing/recovery.py
+            raise ValueError(
+                "checkpoint.mode=incremental does not cover allowed-"
+                "lateness window stages; use checkpoint.mode=full"
+            )
+        # the staged-delta pipeline below writes its own files, but the
+        # materializer + notify/failure protocol is the SHARED one — a
+        # fourth inline copy would drift from the generic paths'
+        ck_io = _GenericCheckpointIO(env, storage, pipe)
+        materializer = ck_io.materializer
+        # live manifest chain of the current incremental sequence (base
+        # first). Starts EMPTY even when the directory holds checkpoints:
+        # a delta may only chain onto a base whose state this job actually
+        # carries, so the chain is adopted exclusively by
+        # restore_checkpoint — a fresh job in an old directory writes a
+        # new full base instead of chaining over foreign state.
+        ck_chain: List[int] = []
+        # observability (metrics/core.py): phase histograms + staging
+        # gauges on the job's metric group, next to the cycle histograms
+        ck_hists = {}
+        ck_cov_gauge = None
+        if self._job_group is not None and storage is not None:
+            ck_hists = {
+                "sync": self._job_group.histogram("checkpoint_sync_ms"),
+                "async": self._job_group.histogram("checkpoint_async_ms"),
+            }
+            ck_cov_gauge = self._job_group.settable_gauge(
+                "checkpoint_coverage_groups", 0
+            )
+            if materializer is not None:
+                self._job_group.gauge(
+                    "checkpoint_staging_occupancy", materializer.pending
+                )
+
+        def _dump_spill_stores():
+            """SYNC phase: copy the host spill-tier contents out of the
+            live stores (the step loop keeps draining into them once it
+            resumes, so the async fold must work on frozen copies).
+            Returns [(pane, keys u64, values [n, W] f32), ...]."""
+            out = []
+            for p, store in ovf_stores.items():
+                ks, vs = store.dump()
+                if len(ks):
+                    out.append((int(p), np.array(ks, copy=True),
+                                np.array(vs, copy=True)))
+            return out
+
+        def _fold_spill_entries(entries, dumped):
             """Spill-tier contents ride the snapshot as regular logical
             (key, pane, value) entries; duplicates with device rows are
             pre-combined because restore scatters (last write wins)."""
-            if not ovf_stores:
+            if not dumped:
                 return entries
             a_hi, a_lo, a_pane, a_val = [], [], [], []
-            for p, store in ovf_stores.items():
-                ks, vs = store.dump()
-                if not len(ks):
-                    continue
+            for p, ks, vs in dumped:
                 a_hi.append((ks >> np.uint64(32)).astype(np.uint32))
                 a_lo.append((ks & np.uint64(0xFFFFFFFF)).astype(np.uint32))
                 a_pane.append(np.full(len(ks), p, np.int32))
@@ -1371,14 +1549,44 @@ class LocalExecutor:
             }
 
         def write_checkpoint():
-            nonlocal next_cid, steps_at_ckpt, n_keys_logged
+            nonlocal next_cid, steps_at_ckpt, n_keys_logged, state
+            if materializer is not None:
+                # surface an async write failure AT the barrier: it is a
+                # checkpoint failure and takes the restart path like one
+                materializer.check()
+                ck_io.drain()
             t_ck0 = time.perf_counter()
             trigger_ms = time.time() * 1000
+            cid = next_cid
+            # ---- SYNC phase (the only step-loop stall) -----------------
             # drain due fires so fired_through is uniform across shards and
             # the snapshot is an exact global cut (F-throttle divergence)
             drain_fires(int(wm_strategy.current()))
-            entries, scalars = ckpt.snapshot_window_state(state, win)
-            entries = _append_spill_entries(entries)
+            # changelog fetch: which key groups changed since the last cut
+            spill_dump = _dump_spill_stores()
+            kind, dirty_kgs, rows = "full", None, None
+            if ck_mode == "incremental":
+                dirty_kgs = cklog.dirty_key_groups(
+                    np.asarray(jax.device_get(state.kg_dirty))
+                )
+                # spill-tier key groups are always covered: their state
+                # mutates host-side (drains/prunes) without device bits
+                for _p, ks, _vs in spill_dump:
+                    dirty_kgs = np.union1d(dirty_kgs, cklog.entry_key_groups(
+                        (ks >> np.uint64(32)).astype(np.uint32),
+                        (ks & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                        ctx.max_parallelism,
+                    ))
+                if ck_chain and len(ck_chain) < ck_compact_every:
+                    kind = "delta"
+                    rows = cklog.dirty_shard_rows(
+                        dirty_kgs, *ctx.kg_bounds()
+                    )
+                # else: first checkpoint in the directory, or compaction
+                # due -> write a fresh full base
+            staged = ckpt.stage_window_state(state, rows=rows)
+            if ck_mode == "incremental":
+                state = clear_dirty(state)
             if keep_rev:
                 items = list(
                     itertools.islice(codec._rev.items(), n_keys_logged, None)
@@ -1390,31 +1598,98 @@ class LocalExecutor:
                 "wm_current": wm_strategy.current(),
                 "codec_rev_count": n_keys_logged if keep_rev else 0,
                 "size_ms": size_ms, "slide_ms": slide_ms,
+                "lateness_ms": wagg.allowed_lateness_ms,
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
             offsets = pipe.source.snapshot_offsets()
-            path = storage.write(next_cid, entries, scalars, offsets, aux)
-            # the checkpoint is durable: commit offsets externally + let
-            # sinks finalize (ref notifyCheckpointComplete fan-out)
-            pipe.source.notify_checkpoint_complete(next_cid, offsets)
-            for s in pipe.all_sinks:
-                s.notify_checkpoint_complete(next_cid)
-            nbytes = sum(
-                os.path.getsize(os.path.join(path, f))
-                for f in os.listdir(path)
-            ) if path and os.path.isdir(path) else 0
-            metrics.record_checkpoint(
-                next_cid, trigger_ms,
-                (time.perf_counter() - t_ck0) * 1e3,
-                nbytes, len(entries["key_hi"]),
+            # freeze offsets/sink states NOW: the step loop resumes before
+            # the write lands, and live sink state must not leak into it
+            aux_bytes = pickle.dumps(
+                {"source_offsets": offsets, "aux": aux}
             )
+            manifest = None
+            if ck_mode == "incremental":
+                new_chain = ck_chain + [cid] if kind == "delta" else [cid]
+                manifest = ckmf.build_manifest(
+                    cid, kind, new_chain,
+                    "all" if kind == "full"
+                    else sorted(int(g) for g in dirty_kgs),
+                    ctx.max_parallelism,
+                )
+                ck_chain[:] = new_chain
+                if ck_cov_gauge is not None:
+                    cov_n = (
+                        ctx.max_parallelism if kind == "full"
+                        else len(dirty_kgs)
+                    )
+                    ck_cov_gauge.set(cov_n)
+            staging_wait = (
+                materializer.wait_for_slot() if materializer is not None
+                else 0.0
+            )
+            occupancy = materializer.pending() if materializer else 0
+            sync_ms = (time.perf_counter() - t_ck0) * 1e3
+            if ck_hists:
+                ck_hists["sync"].update(sync_ms)
+
+            # ---- ASYNC phase (materializer thread; inline when sync) ---
+            def materialize():
+                t_a0 = time.perf_counter()
+                entries, scalars = ckpt.extract_entries(staged, win)
+                entries = _fold_spill_entries(entries, spill_dump)
+                if kind == "delta":
+                    entries = cklog.filter_entries_to_key_groups(
+                        entries, dirty_kgs, ctx.max_parallelism
+                    )
+                path = storage.write(
+                    cid, entries, scalars,
+                    manifest=manifest, aux_bytes=aux_bytes,
+                )
+                # the checkpoint is durable: commit offsets externally +
+                # let sinks finalize (ref notifyCheckpointComplete fan-
+                # out). Async mode queues — the step loop delivers.
+                if materializer is not None:
+                    ck_io.queue_notification(cid, offsets)
+                else:
+                    pipe.source.notify_checkpoint_complete(cid, offsets)
+                    for s in pipe.all_sinks:
+                        s.notify_checkpoint_complete(cid)
+                nbytes = sum(
+                    os.path.getsize(os.path.join(path, f))
+                    for f in os.listdir(path)
+                ) if path and os.path.isdir(path) else 0
+                async_ms = (time.perf_counter() - t_a0) * 1e3
+                if ck_hists:
+                    ck_hists["async"].update(async_ms)
+                metrics.record_checkpoint(
+                    cid, trigger_ms,
+                    (time.perf_counter() - t_ck0) * 1e3,
+                    nbytes, len(entries["key_hi"]),
+                    # sync mode: the WHOLE checkpoint stalls the loop
+                    kind=kind,
+                    sync_ms=sync_ms if materializer is not None else None,
+                    async_ms=async_ms if materializer is not None else 0.0,
+                    coverage=(
+                        None if dirty_kgs is None or kind == "full"
+                        else len(dirty_kgs)
+                    ),
+                    staging_wait_ms=staging_wait * 1e3,
+                    staging_occupancy=occupancy,
+                )
+
+            if materializer is not None:
+                materializer.submit(f"chk-{cid}", materialize)
+            else:
+                materialize()
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane, applied_max_pane
+            if materializer is not None:
+                ck_io.recover()           # durable cuts still notify
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
             applied_max_pane = None      # re-armed from the snapshot below
             # restored table contents differ from the running population:
@@ -1502,6 +1777,15 @@ class LocalExecutor:
                 os.path.abspath(st.dir) == os.path.abspath(storage.dir)
             )
             n_keys_logged = len(codec._rev) if same_dir else 0
+            if ck_mode == "incremental":
+                # extend the restored checkpoint's chain; a FOREIGN
+                # restore (savepoint) starts a fresh chain with a full
+                # base — its members don't exist in our directory
+                m = st.read_manifest(cid) if same_dir else None
+                ck_chain[:] = (
+                    list(m["chain"]) if m is not None
+                    else [cid] if same_dir else []
+                )
             steps_at_ckpt = metrics.steps
 
         def write_savepoint(path: str) -> str:
@@ -1522,7 +1806,7 @@ class LocalExecutor:
             sp = ckpt.CheckpointStorage(path, retain=10**9)
             drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
-            entries = _append_spill_entries(entries)
+            entries = _fold_spill_entries(entries, _dump_spill_stores())
             if keep_rev:
                 sp.append_keymap(list(codec._rev.items()))
             aux = {
@@ -1530,6 +1814,7 @@ class LocalExecutor:
                 "wm_current": wm_strategy.current(),
                 "codec_rev_count": len(codec._rev) if keep_rev else 0,
                 "size_ms": size_ms, "slide_ms": slide_ms,
+                "lateness_ms": wagg.allowed_lateness_ms,
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
@@ -2476,6 +2761,7 @@ class LocalExecutor:
                         host_fired_pane = wp
             if not kv_mailbox.empty():
                 drain_kv_mailbox()
+            ck_io.drain()
             if (
                 storage is not None
                 and env.checkpoint_interval_steps > 0
@@ -2516,10 +2802,19 @@ class LocalExecutor:
                     if td is not None:
                         drain_fires(int(td.to_ms(2**31 - 4)),
                                     time.perf_counter())
+                    if materializer is not None:
+                        # an async write still failing here IS a
+                        # checkpoint failure: raise inside the restart
+                        # protection so recovery treats it as one
+                        ck_io.flush()
                     break
                 except JobCancelledException:
                     raise
                 except Exception:
+                    if materializer is not None:
+                        # let pending async cuts become durable before
+                        # deciding whether a restartable checkpoint exists
+                        ck_io.settle()
                     can = (
                         storage is not None
                         and storage.latest() is not None
@@ -2534,6 +2829,7 @@ class LocalExecutor:
             job_live.clear()
             prefetch_stop.set()
             drain_kv_mailbox()
+            ck_io.close()
 
         if state is not None:
             metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
@@ -2784,6 +3080,7 @@ class LocalExecutor:
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0
+        ck_io = _GenericCheckpointIO(env, storage, pipe)
 
         def _payload():
             return {
@@ -2802,17 +3099,13 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt
-            payload = _payload()
-            storage.write_generic(next_cid, payload)
-            pipe.source.notify_checkpoint_complete(next_cid,
-                                                   payload["offsets"])
-            for s in pipe.all_sinks:
-                s.notify_checkpoint_complete(next_cid)
+            ck_io.write(next_cid, _payload())
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal steps_at_ckpt, et_heap, et_seq
+            ck_io.recover()           # durable cuts still notify
             st = (
                 ckpt.CheckpointStorage(path_or_storage)
                 if isinstance(path_or_storage, str) else path_or_storage
@@ -2916,6 +3209,7 @@ class LocalExecutor:
                     else:
                         out = [select_fn(m) for m in matches]
                     _emit_batch(pipe, out, metrics)
+                ck_io.drain()
                 if (
                     storage is not None
                     and env.checkpoint_interval_steps > 0
@@ -2927,23 +3221,28 @@ class LocalExecutor:
         if restore_from:
             restore_checkpoint(restore_from)
         restart = self._restart_strategy()
-        while True:
-            try:
-                batch_loop()
-                break
-            except JobCancelledException:
-                raise
-            except Exception:
-                can = (
-                    storage is not None
-                    and storage.latest() is not None
-                    and restart.should_restart()
-                )
-                if not can:
+        try:
+            while True:
+                try:
+                    batch_loop()
+                    ck_io.flush()
+                    break
+                except JobCancelledException:
                     raise
-                metrics.restarts += 1
-                self._notify_restart()
-                restore_checkpoint(storage)
+                except Exception:
+                    ck_io.settle()
+                    can = (
+                        storage is not None
+                        and storage.latest() is not None
+                        and restart.should_restart()
+                    )
+                    if not can:
+                        raise
+                    metrics.restarts += 1
+                    self._notify_restart()
+                    restore_checkpoint(storage)
+        finally:
+            ck_io.close()
 
         # end of stream: live partials simply die (a CEP match emits the
         # moment it completes; there is no pending-fire flush)
@@ -3039,14 +3338,14 @@ class LocalExecutor:
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0
+        ck_io = _GenericCheckpointIO(env, storage, pipe)
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt
-            offsets = pipe.source.snapshot_offsets()
-            storage.write_generic(next_cid, {
+            ck_io.write(next_cid, {
                 "backend": backend.snapshot(),
                 "timers": timers.snapshot(),
-                "offsets": offsets,
+                "offsets": pipe.source.snapshot_offsets(),
                 "wm_current": wm_strategy.current(),
                 "proc_time": timers.current_processing_time,
                 "max_parallelism": env.max_parallelism,
@@ -3054,14 +3353,12 @@ class LocalExecutor:
                 "accumulators": accumulators.snapshot(),
                 "operator_state": operator_state.snapshot(),
             })
-            pipe.source.notify_checkpoint_complete(next_cid, offsets)
-            for s in pipe.all_sinks:
-                s.notify_checkpoint_complete(next_cid)
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal steps_at_ckpt
+            ck_io.recover()           # durable cuts still notify
             st = (
                 ckpt.CheckpointStorage(path_or_storage)
                 if isinstance(path_or_storage, str) else path_or_storage
@@ -3167,6 +3464,7 @@ class LocalExecutor:
                 else:
                     timers.advance_processing_time(now_ms)
                 emit()
+                ck_io.drain()
                 if (
                     storage is not None
                     and env.checkpoint_interval_steps > 0
@@ -3178,24 +3476,29 @@ class LocalExecutor:
         if restore_from:
             restore_checkpoint(restore_from)
         restart = self._restart_strategy()
-        while True:
-            try:
-                batch_loop()
-                break
-            except JobCancelledException:
-                raise
-            except Exception:
-                can = (
-                    storage is not None
-                    and storage.latest() is not None
-                    and restart.should_restart()
-                )
-                if not can:
+        try:
+            while True:
+                try:
+                    batch_loop()
+                    ck_io.flush()
+                    break
+                except JobCancelledException:
                     raise
-                metrics.restarts += 1
-                self._notify_restart()
-                collector.drain()  # discard partial output of the failed run
-                restore_checkpoint(storage)
+                except Exception:
+                    ck_io.settle()
+                    can = (
+                        storage is not None
+                        and storage.latest() is not None
+                        and restart.should_restart()
+                    )
+                    if not can:
+                        raise
+                    metrics.restarts += 1
+                    self._notify_restart()
+                    collector.drain()  # discard partial output of failed run
+                    restore_checkpoint(storage)
+        finally:
+            ck_io.close()
 
         # end of stream: flush everything pending (the device stages'
         # MAX-watermark flush analog; finite sources always drain). Single

@@ -22,9 +22,9 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from flink_tpu.core.compat import shard_map
 from flink_tpu.core.keygroups import assign_to_key_group
 from flink_tpu.ops import window_kernels as wk
 from flink_tpu.ops.hashing import route_hash
@@ -48,10 +48,16 @@ class WindowStageSpec:
 
 
 def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
-    """Per-shard window state stacked on a leading [n_shards] axis."""
+    """Per-shard window state stacked on a leading [n_shards] axis.
+
+    Changelog tracking (kg_dirty, sized to the key-group space) is always
+    on: the per-batch cost is one route-hash + one bool scatter, and the
+    bits are what lets an incremental checkpoint fetch/serialize only the
+    key groups that changed (flink_tpu/checkpointing/)."""
     def one(_):
         return wk.init_state(spec.capacity_per_shard, spec.probe_len,
-                             spec.win, spec.red, layout=spec.layout)
+                             spec.win, spec.red, layout=spec.layout,
+                             n_key_groups=ctx.max_parallelism)
 
     states = [one(i) for i in range(ctx.n_shards)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
@@ -77,7 +83,7 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
             kg <= kg_end.astype(jnp.uint32)
         )
         state, _ = wk.update(state, spec.win, spec.red, hi, lo, ts, values,
-                             mine, direct=spec.layout == "direct")
+                             mine, direct=spec.layout == "direct", kg=kg)
         state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
         state = jax.tree_util.tree_map(lambda x: x[None], state)
         fires = jax.tree_util.tree_map(lambda x: x[None], fires)
@@ -142,7 +148,7 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
         )
         state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
                                     values, mine, insert=insert,
-                                    direct=spec.layout == "direct")
+                                    direct=spec.layout == "direct", kg=kg)
         state = _dc.replace(
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
@@ -371,6 +377,22 @@ def clear_overflow(state):
         state,
         ovf_n=jax.device_put(
             np.zeros(state.ovf_n.shape, np.int32), state.ovf_n.sharding
+        ),
+    )
+
+
+def clear_dirty(state):
+    """Host-side: reset the changelog dirty bits after a checkpoint staged
+    its device fetch — everything mutated from here on belongs to the NEXT
+    delta. Cheap device_put of a tiny bool plane (cf. clear_overflow)."""
+    import dataclasses as _dc
+
+    if state.kg_dirty.shape[-1] == 0:
+        return state
+    return _dc.replace(
+        state,
+        kg_dirty=jax.device_put(
+            np.zeros(state.kg_dirty.shape, bool), state.kg_dirty.sharding
         ),
     )
 

@@ -719,14 +719,27 @@ class WebMonitor:
             if rec is None:
                 return None
             env = rec.env
+            cfg = getattr(env, "config", None)
+            snap_mode = (
+                cfg.get_str("checkpoint.mode", "full")
+                if cfg is not None else "full"
+            )
             return {
                 "mode": "exactly_once",
                 "interval-steps": getattr(
                     env, "checkpoint_interval_steps", 0) or 0,
                 "directory": getattr(env, "checkpoint_dir", None),
                 "retained": getattr(
-                    getattr(env, "config", None), "get_int",
-                    lambda *a: 2)("checkpoint.retain", 2),
+                    cfg, "get_int", lambda *a: 2)("checkpoint.retain", 2),
+                "snapshot-mode": snap_mode,
+                "async": (
+                    cfg.get_bool("checkpoint.async",
+                                 snap_mode == "incremental")
+                    if cfg is not None else False
+                ),
+                "compact-every": getattr(
+                    cfg, "get_int", lambda *a: 8
+                )("checkpoint.compact-every", 8),
                 "externalization": {"enabled": True,
                                     "delete_on_cancellation": False},
             }
@@ -839,19 +852,39 @@ class WebMonitor:
             stats = self._checkpoint_stats(rec)
             durs = [s["duration_ms"] for s in stats]
             sizes = [s["bytes"] for s in stats]
+
+            def _mm(vals):
+                return {
+                    "min": min(vals) if vals else 0,
+                    "max": max(vals) if vals else 0,
+                    "avg": sum(vals) / len(vals) if vals else 0,
+                }
+
+            # async/incremental split (flink_tpu/checkpointing): sync-ms
+            # is the step-loop stall, async-ms the background
+            # materialization; bytes split by full base vs delta
+            full = [s for s in stats if s.get("kind", "full") == "full"]
+            delta = [s for s in stats if s.get("kind") == "delta"]
             return {
-                "counts": {"completed": len(stats)},
+                "counts": {
+                    "completed": len(stats),
+                    "full": len(full),
+                    "incremental": len(delta),
+                },
                 "summary": {
-                    "duration-ms": {
-                        "min": min(durs) if durs else 0,
-                        "max": max(durs) if durs else 0,
-                        "avg": sum(durs) / len(durs) if durs else 0,
-                    },
-                    "state-size-bytes": {
-                        "min": min(sizes) if sizes else 0,
-                        "max": max(sizes) if sizes else 0,
-                        "avg": sum(sizes) / len(sizes) if sizes else 0,
-                    },
+                    "duration-ms": _mm(durs),
+                    "state-size-bytes": _mm(sizes),
+                    "sync-ms": _mm([
+                        s.get("sync_ms", s["duration_ms"]) for s in stats
+                    ]),
+                    "async-ms": _mm([
+                        s.get("async_ms", 0.0) for s in stats
+                    ]),
+                    "bytes-full": sum(s["bytes"] for s in full),
+                    "bytes-incremental": sum(s["bytes"] for s in delta),
+                    "staging-wait-ms": _mm([
+                        s.get("staging_wait_ms", 0.0) for s in stats
+                    ]),
                 },
                 "history": stats[-50:],
             }

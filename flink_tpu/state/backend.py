@@ -122,18 +122,35 @@ class State:
         self._namespace = ns
 
     def _cell(self, default=None):
+        # reads mark too: accessors hand back LIVE containers
+        # (ListState.get() returns the stored list) that callers may
+        # mutate without ever calling update()/add(), and the snapshot
+        # blob cache must never serve bytes that predate such a
+        # mutation. Over-marking read-only access only costs the cache
+        # on groups that were touched at all — untouched groups (the
+        # point of the cache) still skip re-serialization.
+        self._mark()
         return self._table.get(
             self._b.current_key_group, self._namespace, self._b.current_key,
             default,
         )
 
+    def _mark(self):
+        # changelog seam (flink_tpu/checkpointing): every touch marks
+        # the key group dirty so an incremental snapshot re-serializes
+        # only changed groups. Mutators that bypass both _put and _cell
+        # must call this directly.
+        self._b.changelog.mark(self._b.current_key_group)
+
     def _put(self, value):
+        self._mark()
         self._table.put(
             self._b.current_key_group, self._namespace, self._b.current_key,
             value,
         )
 
     def clear(self):
+        self._mark()
         self._table.remove(
             self._b.current_key_group, self._namespace, self._b.current_key
         )
@@ -159,7 +176,7 @@ class ListState(State):
         return self._cell(default=[])
 
     def add(self, v):
-        cur = self._cell()
+        cur = self._cell()      # marks dirty (in-place append below)
         if cur is None:
             cur = []
             self._put(cur)
@@ -230,14 +247,14 @@ class MapState(State):
         return default if m is None else m.get(user_key, default)
 
     def put(self, user_key, v):
-        m = self._cell()
+        m = self._cell()        # marks dirty (in-place mutation below)
         if m is None:
             m = {}
             self._put(m)
         m[user_key] = v
 
     def remove(self, user_key):
-        m = self._cell()
+        m = self._cell()        # marks dirty
         if m:
             m.pop(user_key, None)
 
@@ -302,6 +319,15 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         self.max_parallelism = max_parallelism
         self._tables: Dict[str, StateTable] = {}
         self._descs: Dict[str, StateDescriptor] = {}
+        # changelog + per-key-group blob cache (flink_tpu/checkpointing):
+        # snapshot() re-serializes only the key groups the State views
+        # marked dirty since the last snapshot and reuses the cached
+        # bytes for clean ones — a 1M-key backend with 100 hot keys per
+        # interval re-pickles 100 keys' groups, not 1M
+        from flink_tpu.checkpointing.changelog import HostChangelog
+
+        self.changelog = HostChangelog()
+        self._blob_cache: Dict[int, Optional[bytes]] = {}
         self.current_key = None
         self.current_key_group = None
         # job-scoped SerializerRegistry; None -> process default
@@ -357,6 +383,7 @@ class HeapKeyedStateBackend(KeyedStateBackend):
             ns = reg.loads_typed(ns_b)
             k = reg.loads_typed(k_b)
             m.setdefault(ns, {})[k] = ser.deserialize(v_b)
+            self.changelog.mark(kg)     # bypasses the State-view seam
 
     def get_partitioned_state(self, descriptor, namespace=VoidNamespace):
         # Returns a FRESH view object per call: callers may hold several
@@ -437,52 +464,65 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                 pending_by_kg.setdefault(kg, []).append(
                     (name, uid, cfg, ns_b, k_b, v_b)
                 )
+        # changelog: key groups untouched since the last snapshot reuse
+        # their cached serialization (None = group was empty)
+        dirty = self.changelog.consume()
         for kg in self.kgr:
-            states = []
-            for name, uid, cfg, ns_b, k_b, v_b in pending_by_kg.get(kg, ()):
-                buf: list = []
-                self._frame(buf, name.encode("utf-8"))
-                self._frame(buf, uid.encode("ascii"))
-                self._frame(buf, cfg.encode("utf-8"))
-                buf.append(_st.pack("<I", 1))
-                self._frame(buf, ns_b)
-                self._frame(buf, k_b)
-                self._frame(buf, v_b)
-                states.append(b"".join(buf))
-            for name, table in self._tables.items():
-                m = table._map_for(kg)
-                if not m:
-                    continue
-                desc = self._descs.get(name)
-                pinned = getattr(desc, "serializer", None)
-                buf: list = []
-                self._frame(buf, name.encode("utf-8"))
-                self._frame(buf, (pinned.uid if pinned else "").encode("ascii"))
-                # restore-compatibility token (TypeSerializerConfigSnapshot
-                # role): restore refuses a same-uid serializer whose config
-                # snapshot differs instead of misreading bytes
-                self._frame(
-                    buf,
-                    (pinned.config_snapshot() if pinned else "").encode("utf-8"),
-                )
-                entries = [
-                    (ns, k, v) for ns, kv in m.items() for k, v in kv.items()
-                ]
-                buf.append(_st.pack("<I", len(entries)))
-                for ns, k, v in entries:
-                    self._frame(buf, reg.dumps_typed(ns))
-                    self._frame(buf, reg.dumps_typed(k))
-                    self._frame(
-                        buf, pinned.serialize(v) if pinned
-                        else reg.dumps_typed(v)
-                    )
-                states.append(b"".join(buf))
-            if states:
-                out[kg] = (
-                    self._SNAP_MAGIC + _st.pack("<I", len(states))
-                    + b"".join(states)
-                )
+            if kg not in dirty and kg in self._blob_cache:
+                blob = self._blob_cache[kg]
+                if blob is not None:
+                    out[kg] = blob
+                continue
+            self._snapshot_one(kg, pending_by_kg, reg, out, _st)
+            self._blob_cache[kg] = out.get(kg)
         return out
+
+    def _snapshot_one(self, kg, pending_by_kg, reg, out, _st):
+        """Serialize ONE key group into out[kg] (absent = empty group)."""
+        states = []
+        for name, uid, cfg, ns_b, k_b, v_b in pending_by_kg.get(kg, ()):
+            buf: list = []
+            self._frame(buf, name.encode("utf-8"))
+            self._frame(buf, uid.encode("ascii"))
+            self._frame(buf, cfg.encode("utf-8"))
+            buf.append(_st.pack("<I", 1))
+            self._frame(buf, ns_b)
+            self._frame(buf, k_b)
+            self._frame(buf, v_b)
+            states.append(b"".join(buf))
+        for name, table in self._tables.items():
+            m = table._map_for(kg)
+            if not m:
+                continue
+            desc = self._descs.get(name)
+            pinned = getattr(desc, "serializer", None)
+            buf: list = []
+            self._frame(buf, name.encode("utf-8"))
+            self._frame(buf, (pinned.uid if pinned else "").encode("ascii"))
+            # restore-compatibility token (TypeSerializerConfigSnapshot
+            # role): restore refuses a same-uid serializer whose config
+            # snapshot differs instead of misreading bytes
+            self._frame(
+                buf,
+                (pinned.config_snapshot() if pinned else "").encode("utf-8"),
+            )
+            entries = [
+                (ns, k, v) for ns, kv in m.items() for k, v in kv.items()
+            ]
+            buf.append(_st.pack("<I", len(entries)))
+            for ns, k, v in entries:
+                self._frame(buf, reg.dumps_typed(ns))
+                self._frame(buf, reg.dumps_typed(k))
+                self._frame(
+                    buf, pinned.serialize(v) if pinned
+                    else reg.dumps_typed(v)
+                )
+            states.append(b"".join(buf))
+        if states:
+            out[kg] = (
+                self._SNAP_MAGIC + _st.pack("<I", len(states))
+                + b"".join(states)
+            )
 
     def restore(self, key_group_blobs: Dict[int, bytes]) -> None:
         import struct as _st
@@ -491,6 +531,11 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         # snapshot were empty at checkpoint time and must be empty after
         # restore, or replayed records double-apply (exactly-once contract).
         reg = self._registry()
+        # the changelog/cache describe the REPLACED state: drop both (the
+        # restored blobs could seed the cache, but a restore may re-slice
+        # foreign-parallelism blobs, so correctness over cleverness)
+        self._blob_cache.clear()
+        self.changelog = type(self.changelog)()
         for table in self._tables.values():
             table.maps = [{} for _ in range(self.kgr.num_key_groups)]
         # deferred entries from any PREVIOUS restore are part of the state

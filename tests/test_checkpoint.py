@@ -1,6 +1,11 @@
 """Checkpoint/restore/rescale + failure recovery — the analogs of the
 reference's EventTimeWindowCheckpointingITCase, RescalingITCase and
-StateCheckpointedITCase (SURVEY §4)."""
+StateCheckpointedITCase (SURVEY §4), plus the async-incremental
+subsystem (flink_tpu/checkpointing): manifest chains, retention GC, and
+materializer fault injection."""
+
+import json
+import os
 
 import numpy as np
 import pytest
@@ -36,11 +41,18 @@ def expected(total):
     return out
 
 
-def build_env(parallelism, ckpt_dir=None, interval=0, restart=None):
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None,
+              mode=None, async_=None, compact_every=None):
     cfg = Configuration()
     if restart:
         cfg.set("restart-strategy", "fixed-delay")
         cfg.set("restart-strategy.fixed-delay.attempts", restart)
+    if mode is not None:
+        cfg.set("checkpoint.mode", mode)
+    if async_ is not None:
+        cfg.set("checkpoint.async", async_)
+    if compact_every is not None:
+        cfg.set("checkpoint.compact-every", compact_every)
     env = StreamExecutionEnvironment(cfg)
     env.set_parallelism(parallelism).set_max_parallelism(128)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
@@ -80,9 +92,15 @@ class FailingSource(GeneratorSource):
         return out
 
 
-def test_failure_recovery_exactly_once_state(tmp_path):
+@pytest.mark.parametrize("mode,async_", [
+    ("full", False),            # the classic sync-full path
+    ("full", True),             # full snapshots, background write
+    ("incremental", True),      # changelog deltas + manifest chain
+])
+def test_failure_recovery_exactly_once_state(tmp_path, mode, async_):
     total = 4096
-    env = build_env(4, tmp_path / "chk", interval=2, restart=3)
+    env = build_env(4, tmp_path / "chk", interval=2, restart=3,
+                    mode=mode, async_=async_)
     src = FailingSource(gen, total, fail_at=total // 2)
     got = run_job(env, total, source=src)
     assert env.last_job.metrics.restarts == 1
@@ -156,3 +174,234 @@ def test_restore_preserves_string_keys(tmp_path):
         expect[(k, we)] = expect.get((k, we), 0) + 1.0
     assert got == expect
     assert all(isinstance(k, str) for k, _ in got)
+
+
+# ---------------------------------------------------------------------------
+# Async-incremental subsystem (flink_tpu/checkpointing)
+# ---------------------------------------------------------------------------
+
+def _manifest(ckpt_dir, cid):
+    p = os.path.join(str(ckpt_dir), f"chk-{cid}", "manifest.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def _latest_cid(ckpt_dir):
+    from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+    return CheckpointStorage(str(ckpt_dir)).latest()
+
+
+@pytest.mark.parametrize("p2", [2, 4, 1])
+def test_incremental_chain_restore_equals_full_restore(tmp_path, p2):
+    """THE equivalence criterion: restoring a keyed windowed-aggregation
+    job from an async-incremental manifest chain (base + >= 2 deltas)
+    yields byte-identical sink results to restoring from a sync full
+    snapshot at the same cut — including across a rescale (p=2 -> 4/1)."""
+    total, half = 8192, 4096
+
+    # phase 1 twice over the identical stream: sync-full vs async-
+    # incremental. Checkpoint interval is counted in steps, so the two
+    # runs cut at identical offsets.
+    env_f = build_env(2, tmp_path / "full", interval=1,
+                      mode="full", async_=False)
+    got1_f = run_job(env_f, half)
+    env_i = build_env(2, tmp_path / "incr", interval=1,
+                      mode="incremental", async_=True, compact_every=100)
+    got1_i = run_job(env_i, half)
+    assert got1_f == got1_i
+
+    # the incremental run must actually have produced a chain with a
+    # full base + at least 2 deltas, and exercised the async phase
+    cid = _latest_cid(tmp_path / "incr")
+    m = _manifest(tmp_path / "incr", cid)
+    assert m is not None and m["kind"] == "delta"
+    assert len(m["chain"]) >= 3, m
+    base = _manifest(tmp_path / "incr", m["chain"][0])
+    assert base is not None and base["kind"] == "full"
+    stats = env_i.last_job.metrics.checkpoint_stats
+    deltas = [s for s in stats if s["kind"] == "delta"]
+    assert deltas, "no delta checkpoints recorded"
+    # presence-of-async-phase (not a timing threshold: CPU mode)
+    assert all(s["async_ms"] > 0 for s in deltas)
+    # the sync stall is a strict sub-phase of the whole checkpoint
+    # (epsilon covers independent 2-dp rounding of the two fields)
+    assert all(s["sync_ms"] <= s["duration_ms"] + 0.05 for s in deltas)
+    full_stats = [s for s in env_f.last_job.metrics.checkpoint_stats]
+    assert all(s["kind"] == "full" and s["async_ms"] == 0.0
+               for s in full_stats)
+
+    # phase 2: restore each at parallelism p2 and finish the stream
+    got2_f = run_job(build_env(p2), total,
+                     restore_from=str(tmp_path / "full"))
+    got2_i = run_job(build_env(p2), total,
+                     restore_from=str(tmp_path / "incr"))
+    assert got2_i == got2_f, "chain restore diverged from full restore"
+    assert {**got1_i, **got2_i} == expected(total)
+
+
+def test_delta_coverage_is_partial_for_skewed_updates(tmp_path):
+    """A delta only covers the key groups that changed: a stream that
+    updates ONE key between checkpoints must produce deltas whose
+    coverage (and entries) are a small subset of the key space."""
+    from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+    def gen_one_key(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            # first 512 records spray all keys; later records hit key 7
+            "key": np.where(idx < 512, (idx * 48271) % N_KEYS, 7),
+            "value": np.ones(n, np.float32),
+        }
+        return cols, (idx // 50) * 1000
+
+    env = build_env(2, tmp_path / "chk", interval=1,
+                    mode="incremental", async_=True, compact_every=100)
+    run_job(env, 4096, source=GeneratorSource(gen_one_key, total=4096))
+    st = CheckpointStorage(str(tmp_path / "chk"))
+    cid = st.latest()
+    m = _manifest(tmp_path / "chk", cid)
+    assert m["kind"] == "delta"
+    assert m["coverage"] != "all"
+    assert 1 <= len(m["coverage"]) < 8, m["coverage"]
+    # and the delta's entries are only that coverage's keys
+    entries, _s, _o, _a = st.read_raw(cid)
+    assert 0 < len(entries["key_hi"]) < N_KEYS
+
+
+def test_chain_compaction_writes_fresh_full_base(tmp_path):
+    env = build_env(2, tmp_path / "chk", interval=1,
+                    mode="incremental", async_=True, compact_every=3)
+    run_job(env, 8192)
+    st_dir = tmp_path / "chk"
+    cids = sorted(
+        int(d[4:]) for d in os.listdir(st_dir) if d.startswith("chk-")
+    )
+    kinds = {c: _manifest(st_dir, c)["kind"] for c in cids}
+    assert "full" in kinds.values() and "delta" in kinds.values()
+    # every chain is at most compact-every long
+    for c in cids:
+        assert len(_manifest(st_dir, c)["chain"]) <= 3
+
+
+def test_manifest_gc_never_collects_referenced_chain(tmp_path):
+    """CheckpointStorage._gc with retain=2: a base/delta referenced by a
+    retained manifest survives even when plain retention would drop it;
+    once a new full base supersedes the chain, the old one collects."""
+    from flink_tpu.checkpointing.manifest import build_manifest
+    from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+    st = CheckpointStorage(str(tmp_path / "chk"), retain=2)
+    ent = {
+        "key_hi": np.zeros(0, np.uint32), "key_lo": np.zeros(0, np.uint32),
+        "pane": np.zeros(0, np.int32), "value": np.zeros(0, np.float32),
+        "fresh": np.zeros(0, bool),
+    }
+    scal = {"watermark": 0, "fired_through": 0, "max_pane": 0,
+            "min_pane": 0, "dropped_late": 0, "dropped_capacity": 0}
+
+    def write(cid, kind, chain, cov):
+        st.write(cid, ent, scal, None, {}, manifest=build_manifest(
+            cid, kind, chain, cov, 128))
+
+    write(1, "full", [1], "all")
+    write(2, "delta", [1, 2], [3])
+    write(3, "delta", [1, 2, 3], [4])
+    write(4, "delta", [1, 2, 3, 4], [5])
+    # retain=2 would keep {3, 4}; the manifest closure keeps 1 and 2 too
+    assert st.list_checkpoints() == [1, 2, 3, 4]
+    # a fresh full base supersedes the chain: old members now collect
+    write(5, "full", [5], "all")
+    write(6, "delta", [5, 6], [7])
+    assert st.list_checkpoints() == [5, 6]
+    # and the retained chain still restores
+    entries, scalars, _o, _a = st.read(6)
+    assert scalars["watermark"] == 0
+
+
+def test_crash_during_async_write_leaves_recoverable_checkpoint(tmp_path):
+    """Materializer fault injection: a failing async write (simulating a
+    crash mid-materialization) must leave the PREVIOUS checkpoint fully
+    recoverable, surface the failure at the next barrier, and never
+    publish a partial directory."""
+    from flink_tpu.checkpointing.materializer import (
+        Materializer, MaterializerError,
+    )
+    from flink_tpu.runtime.checkpoint import CheckpointStorage
+
+    st = CheckpointStorage(str(tmp_path / "chk"), retain=5)
+    mat = Materializer(slots=2)
+    ent = {
+        "key_hi": np.asarray([1], np.uint32),
+        "key_lo": np.asarray([2], np.uint32),
+        "pane": np.asarray([0], np.int32),
+        "value": np.asarray([3.0], np.float32),
+        "fresh": np.asarray([False]),
+    }
+    scal = {"watermark": 5, "fired_through": 0, "max_pane": 0,
+            "min_pane": 0, "dropped_late": 0, "dropped_capacity": 0}
+    mat.submit("chk-1", lambda: st.write(1, ent, scal, None, {}))
+
+    def crash():
+        # partial write then death: only the .tmp staging dir exists
+        tmp = st.path(2) + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "entries.npz"), "wb") as f:
+            f.write(b"partial")
+        raise OSError("injected materializer crash")
+
+    mat.submit("chk-2", crash)
+    with pytest.raises(MaterializerError, match="chk-2"):
+        mat.flush()
+    # previous checkpoint untouched and recoverable; no partial publish
+    assert st.latest() == 1
+    entries, scalars, _o, _a = st.read(1)
+    assert scalars["watermark"] == 5 and len(entries["key_hi"]) == 1
+    # the queue is poisoned-then-cleared: later submits work again
+    mat.submit("chk-3", lambda: st.write(3, ent, scal, None, {}))
+    mat.flush()
+    assert st.latest() == 3
+    mat.close()
+
+
+def test_async_write_failure_triggers_restart_recovery(tmp_path, monkeypatch):
+    """End-to-end fault injection ON THE MATERIALIZER THREAD: one
+    checkpoint's directory write raises; the failure surfaces at the next
+    barrier, the job restarts from the last durable checkpoint, and the
+    final results are still exactly-once."""
+    from flink_tpu.runtime import checkpoint as ckpt
+
+    orig = ckpt.CheckpointStorage.write
+    fired = {"done": False}
+
+    def flaky(self, cid, *a, **k):
+        if cid == 3 and not fired["done"]:
+            fired["done"] = True
+            raise OSError("injected async write failure")
+        return orig(self, cid, *a, **k)
+
+    monkeypatch.setattr(ckpt.CheckpointStorage, "write", flaky)
+    total = 4096
+    env = build_env(2, tmp_path / "chk", interval=2, restart=3,
+                    mode="incremental", async_=True)
+    got = run_job(env, total)
+    assert fired["done"]
+    assert env.last_job.metrics.restarts == 1
+    assert got == expected(total)
+
+
+def test_incremental_rejects_allowed_lateness(tmp_path):
+    env = build_env(2, tmp_path / "chk", interval=1, mode="incremental")
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=512))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .allowed_lateness(5000)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    with pytest.raises(ValueError, match="allowed-lateness"):
+        env.execute("lateness-job")

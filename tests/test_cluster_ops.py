@@ -83,8 +83,14 @@ def test_savepoint_and_resume(tmp_path):
     )
     env2.execute("resumed", restore_from=str(tmp_path / "sp"))
     # total across all fires == total records (2000): nothing lost or
-    # double-counted despite the mid-stream cut
-    assert sum(r.value for r in sink2.results) == 2000.0
+    # double-counted despite the mid-stream cut. Windows that fired in
+    # phase 1 BEFORE the savepoint live in phase 1's sink (how many
+    # depends on how far the slow source got in 1s — load-dependent),
+    # and phase 2 re-fires corrected versions of anything after the
+    # cut, so merge with phase 2 overriding (the test_rescale pattern).
+    got1 = {(r.key, r.window_end_ms): r.value for r in sink.results}
+    got2 = {(r.key, r.window_end_ms): r.value for r in sink2.results}
+    assert sum({**got1, **got2}.values()) == 2000.0
 
 
 def test_control_server_and_cli_protocol():
