@@ -136,3 +136,33 @@ class CoreOptions:
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)
+    # -- observability (docs/observability.md) --------------------------
+    # step-loop span tracing: bounded ring of phase spans exported as
+    # Chrome-trace JSON via /jobs/<jid>/traces (metrics/tracing.py)
+    TRACING = ConfigOption(
+        "observability.tracing", False,
+        "record step-loop phase spans (off by default; negligible when "
+        "sampled)")
+    TRACE_SAMPLE_EVERY = ConfigOption(
+        "observability.trace-sample-every", 1,
+        "record spans for every N-th poll cycle only")
+    TRACE_BUFFER_SPANS = ConfigOption(
+        "observability.trace-buffer-spans", 65536,
+        "span ring-buffer capacity (old spans fall off)")
+    TRACE_DUMP = ConfigOption(
+        "observability.trace-dump", None,
+        "write the Chrome-trace JSON to this file when the job ends")
+    KG_STATS = ConfigOption(
+        "observability.kg-stats", None,
+        "enable key-group skew telemetry (per-batch fill scatter in the "
+        "compiled step + the occupancy kernel at fire boundaries); "
+        "defaults to whatever observability.tracing is — off means the "
+        "steps compile without any telemetry work")
+    KG_STATS_INTERVAL_MS = ConfigOption(
+        "observability.kg-stats-interval-ms", 1000,
+        "min interval between per-key-group occupancy kernel runs "
+        "(refreshed at fire boundaries)")
+    COMPILE_COST = ConfigOption(
+        "observability.compile-cost", False,
+        "record XLA cost_analysis (FLOPs/bytes) of the update step at "
+        "warmup — costs one extra trace+compile")

@@ -12,9 +12,10 @@ from flink_tpu.metrics.core import (
     Reporter,
     ScheduledReporter,
 )
+from flink_tpu.metrics.tracing import CompileEvents, SpanTracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Meter", "MetricGroup",
     "MetricRegistry", "Reporter", "JsonFileReporter", "LoggingReporter",
-    "ScheduledReporter",
+    "ScheduledReporter", "SpanTracer", "CompileEvents",
 ]

@@ -197,6 +197,15 @@ class MetricRegistry:
     def group(self, *scope: str) -> MetricGroup:
         return MetricGroup(self, list(scope))
 
+    def items(self, prefix: str = "") -> List:
+        """[(scope, metric)] — the TYPED view reporters that distinguish
+        counters from gauges need (snapshot() collapses to values)."""
+        with self._lock:
+            return [
+                (k, m) for k, m in self._metrics.items()
+                if k.startswith(prefix)
+            ]
+
     def snapshot(self, prefix: str = "") -> Dict[str, Any]:
         """Point-in-time values of every registered metric (the metric
         query service consumed by the web monitor, ref MetricDump)."""

@@ -27,8 +27,12 @@ import time
 from typing import Dict, List
 
 from flink_tpu.metrics.core import (
+    Counter,
+    Gauge,
+    Histogram,
     JsonFileReporter,
     LoggingReporter,
+    Meter,
     MetricRegistry,
     Reporter,
     ScheduledReporter,
@@ -184,12 +188,142 @@ class GangliaReporter(Reporter):
         self._sock.close()
 
 
+# ------------------------------------------------------------- prometheus
+
+def _prom_name(path: str) -> str:
+    """Metric-name charset [a-zA-Z0-9_:]; everything else collapses."""
+    out = []
+    for ch in path:
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch in "_:"
+                   else "_")
+    name = "".join(out)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _prom_split(scope: str):
+    """`jobs.<job>.<metric>` -> (metric, {"job": <job>}); other scopes
+    keep the full dotted path as the name with no labels. Job names ride
+    as a LABEL (the Prometheus idiom: one series family per metric, jobs
+    distinguished by label) instead of exploding the name space."""
+    parts = scope.split(".")
+    if len(parts) >= 3 and parts[0] == "jobs":
+        return ".".join(parts[2:]), {"job": parts[1]}
+    return scope, {}
+
+
+def _prom_line(name: str, labels: dict, value) -> str:
+    if labels:
+        lbl = ",".join(
+            f'{k}="{_prom_label(v)}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{lbl}}} {value}"
+    return f"{name} {value}"
+
+
+def prometheus_text(registry: MetricRegistry, namespace: str = "flink_tpu",
+                    prefix: str = "") -> str:
+    """Render a registry in the Prometheus text exposition format
+    (version 0.0.4 — the /metrics scrape payload).
+
+    Counters -> `counter`; Gauges -> `gauge` (non-numeric values are
+    skipped: exposition carries only numbers); Histograms -> `summary`
+    (quantile series + _count + _sum, sum reconstructed as mean*count);
+    Meters -> a `_total` counter plus a `_rate` gauge.
+    """
+    return prometheus_text_from_items(registry.items(prefix), namespace)
+
+
+def prometheus_text_from_items(items, namespace: str = "flink_tpu") -> str:
+    """Exposition over a merged [(scope, metric)] list — one TYPE header
+    per metric family even when the scopes come from several registries
+    (the web monitor scrapes every job's registry into ONE payload; a
+    family may legally appear only once)."""
+    families = {}     # name -> (type, [lines])
+
+    def add(name, typ, labels, value):
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            if isinstance(value, bool):
+                value = int(value)
+            else:
+                return
+        fam = families.setdefault(name, (typ, []))
+        fam[1].append(_prom_line(name, labels, value))
+
+    for scope, metric in items:
+        raw, labels = _prom_split(scope)
+        name = _prom_name(f"{namespace}_{raw}" if namespace else raw)
+        if isinstance(metric, Counter):
+            add(name, "counter", labels, metric.get_count())
+        elif isinstance(metric, Gauge):
+            try:
+                add(name, "gauge", labels, metric.get_value())
+            except Exception:
+                pass            # a broken gauge must not kill the scrape
+        elif isinstance(metric, Histogram):
+            snap = metric.snapshot()
+            n = snap.get("count", 0)
+            for q in ("p50", "p95", "p99"):
+                if q in snap:
+                    add(name, "summary",
+                        {**labels, "quantile": f"0.{q[1:]}"}, snap[q])
+            add(f"{name}_count", "summary", labels, n)
+            if n:
+                add(f"{name}_sum", "summary", labels,
+                    snap["mean"] * n)
+        elif isinstance(metric, Meter):
+            add(f"{name}_total", "counter", labels, metric.get_count())
+            add(f"{name}_rate", "gauge", labels, metric.get_rate())
+    lines = []
+    for name in sorted(families):
+        typ, rows = families[name]
+        # _count/_sum ride their parent summary family without their own
+        # TYPE header (the exposition format treats them as one family)
+        if not (name.endswith("_count") or name.endswith("_sum")) or \
+                name[: name.rfind("_")] not in families:
+            lines.append(f"# TYPE {name} {typ}")
+        lines.extend(rows)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class PrometheusReporter(Reporter):
+    """Pull-based Prometheus exposition (ref flink-metrics-prometheus
+    PrometheusReporter.java — there an embedded HTTP server; here the
+    existing web monitor serves /metrics on ITS port, no new listener).
+    `scrape()` renders the current exposition text; `report()` is a no-op
+    by design (Prometheus pulls), unless constructed with a `path` to
+    also drop the exposition to a file each interval (the node-exporter
+    textfile-collector pattern, for jobs with no web monitor)."""
+
+    def __init__(self, namespace: str = "flink_tpu", path: str = ""):
+        self.namespace = namespace
+        self.path = path
+
+    def scrape(self) -> str:
+        return prometheus_text(self.registry, self.namespace)
+
+    def report(self):
+        if self.path:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(self.scrape())
+            import os
+            os.replace(tmp, self.path)   # atomic: scrapers never see half
+
+
 _KINDS = {
     "statsd": StatsDReporter,
     "graphite": GraphiteReporter,
     "ganglia": GangliaReporter,
     "jsonfile": JsonFileReporter,
     "logging": LoggingReporter,
+    "prometheus": PrometheusReporter,
 }
 
 
@@ -251,6 +385,11 @@ def configure_reporters(registry: MetricRegistry, config
         elif cls is JsonFileReporter:
             rep = JsonFileReporter(config.get_str(pre + "path",
                                                   "/tmp/flink_tpu_metrics.json"))
+        elif cls is PrometheusReporter:
+            rep = PrometheusReporter(
+                config.get_str(pre + "namespace", "flink_tpu"),
+                config.get_str(pre + "path", ""),
+            )
         else:
             rep = LoggingReporter()
         registry.add_reporter(rep)

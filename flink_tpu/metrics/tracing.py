@@ -1,0 +1,341 @@
+"""Step-loop span tracing + XLA compile visibility.
+
+The reference samples per-record visibility out of a running job
+(LatencyMarker sentinels, BackPressureStatsTracker stack sampling). The
+micro-batch design makes that structurally impossible — and unnecessary:
+every cycle of the executor's step loop decomposes EXACTLY into named
+phases (source drain, key routing, device step dispatch, barrier/scalar
+fetch, fire extraction, emit, checkpoint sync). The tracer records those
+phases as spans into a bounded ring buffer and exports them as
+Chrome-trace JSON (chrome://tracing / Perfetto `traceEvents` array), so a
+tail-latency stall is attributable to a phase instead of a mystery
+(Hazelcast Jet's 99.99%-ile work, PAPERS.md: tails come from rare
+coordination stalls — here barrier fetches, transfers, recompiles).
+
+Design constraints:
+  * OFF by default. When off, the executor holds no tracer and the hot
+    path pays nothing. When on, the per-span cost is two perf_counter()
+    reads (usually reusing timestamps the cycle attribution already
+    takes) and one deque.append of a tuple.
+  * SAMPLED. `observability.trace-sample-every: N` records every N-th
+    cycle only; the skipped cycles pay one integer compare.
+  * BOUNDED. The ring holds `observability.trace-buffer-spans` records;
+    old spans fall off — a perpetual job cannot grow host memory.
+
+Compile visibility (`CompileEvents`): jax.monitoring emits an event per
+XLA backend compile (`/jax/core/compile/backend_compile_duration`). One
+process-wide listener counts them and records wall time, attributed to
+the stage label the executor sets around its step builds/warmups — a
+recompile storm shows up as a named counter moving, not a mystery stall.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# span record layout: (name, stage, t_start_s, dur_s, attrs_or_None)
+_Span = Tuple[str, str, float, float, Optional[dict]]
+
+# the step-loop phases the executor instruments; exported for tests and
+# the docs so the catalog cannot silently drift from the wiring
+STEP_PHASES = (
+    "source",           # source poll / prefetch wait + host chain/encode
+    "route",            # per-batch exchange-route feasibility (key routing)
+    "dispatch",         # device step dispatch (+ inflight-depth wait)
+    "fire",             # fire-step dispatch at a pane boundary
+    "barrier_fetch",    # step-boundary scalar/lane fetch (the d2h barrier)
+    "emit",             # fire extraction + sink invocation
+    "checkpoint_sync",  # checkpoint sync phase (the only ckpt loop stall)
+)
+
+
+class SpanTracer:
+    """Bounded ring buffer of step-loop phase spans.
+
+    One tracer per job run, owned by the executor thread; `snapshot()`
+    and the exporters may be called from web/reporter threads (the deque
+    append/iterate pair is guarded by a lock — spans are tiny, the
+    critical sections are nanoseconds).
+    """
+
+    def __init__(self, stage: str = "job", sample_every: int = 1,
+                 max_spans: int = 65536):
+        self.stage = stage
+        self.sample_every = max(1, int(sample_every))
+        self._spans: deque = deque(maxlen=max(16, int(max_spans)))
+        self._lock = threading.Lock()
+        # perf_counter origin for relative span timestamps + the wall
+        # clock at that origin so exported ts can be absolute-ish
+        self.t0 = time.perf_counter()
+        self.epoch_ms = time.time() * 1000.0
+        self._cycle = -1
+        self.active = False       # does the CURRENT cycle record spans?
+        self.dropped = 0          # spans recorded while ring was full
+
+    # -- recording (executor thread) ------------------------------------
+    def begin_cycle(self) -> bool:
+        """Advance the cycle counter; returns whether this cycle records."""
+        self._cycle += 1
+        self.active = (self._cycle % self.sample_every) == 0
+        return self.active
+
+    def rec(self, name: str, t_start: float, t_end: Optional[float] = None,
+            stage: Optional[str] = None, **attrs):
+        """Record one span from perf_counter() timestamps. Callers guard
+        with `if tr is not None and tr.active:` so the off path costs one
+        attribute read."""
+        if t_end is None:
+            t_end = time.perf_counter()
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append((
+                name, stage or self.stage, t_start, t_end - t_start,
+                attrs or None,
+            ))
+
+    def span(self, name: str, **attrs):
+        """Context-manager form for code paths without an existing
+        timestamp pair (the executor's occupancy refresh uses it). The
+        sampling decision is captured at ENTRY so a cycle boundary
+        inside the block cannot split the decision."""
+        return _SpanCtx(self, name, attrs)
+
+    # -- export (any thread) --------------------------------------------
+    def snapshot(self) -> List[_Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._spans)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace / Perfetto JSON object: complete ("ph": "X")
+        events with microsecond timestamps relative to the tracer origin.
+        Loadable directly in chrome://tracing and ui.perfetto.dev."""
+        events = []
+        for name, stage, t_start, dur, attrs in self.snapshot():
+            ev = {
+                "name": name,
+                "cat": stage,
+                "ph": "X",
+                "ts": round((t_start - self.t0) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": 1,
+                "tid": 1,
+            }
+            if attrs:
+                ev["args"] = attrs
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "stage": self.stage,
+                "sample_every": self.sample_every,
+                "origin_epoch_ms": round(self.epoch_ms, 1),
+                "spans_dropped": self.dropped,
+            },
+        }
+
+    def dump(self, path: str) -> str:
+        """Write the Chrome-trace JSON to a file; returns the path."""
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+class _SpanCtx:
+    __slots__ = ("tracer", "name", "attrs", "t0", "active")
+
+    def __init__(self, tracer: SpanTracer, name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.active = self.tracer.active
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self.active:
+            self.tracer.rec(self.name, self.t0, **self.attrs)
+        return False
+
+
+def tracer_from_config(config, stage: str = "job") -> Optional[SpanTracer]:
+    """Build a SpanTracer from the `observability.*` config keys, or None
+    when tracing is off (the default — the hot path then carries no
+    tracer reference at all)."""
+    if config is None or not config.get_bool("observability.tracing", False):
+        return None
+    return SpanTracer(
+        stage=stage,
+        sample_every=config.get_int("observability.trace-sample-every", 1),
+        max_spans=config.get_int("observability.trace-buffer-spans", 65536),
+    )
+
+
+# ---------------------------------------------------------------- compiles
+
+class CompileEvents:
+    """Process-wide XLA compile accounting via jax.monitoring.
+
+    jax has exactly one global listener list, so this is a singleton:
+    `install()` registers once and is idempotent. Each job snapshots the
+    counters at start (`mark()`) and exposes deltas as gauges — per-job
+    attribution over a process-global event stream, the same shape the
+    reference uses for JVM-global GC counters on per-job dashboards.
+
+    The executor labels compile bursts with `set_stage(...)` around its
+    step builds/warmups; an event arriving outside any labelled section
+    attributes to "steady". Small eager ops (device_put, tiny zeros)
+    also compile once per shape and land there, so the recompile-storm
+    alarm is a steady count that keeps GROWING while the job is in
+    steady state — the loop dispatches only pre-compiled steps, so
+    sustained growth means per-batch recompilation (a shape leak).
+    """
+
+    _lock = threading.Lock()
+    _installed = False
+    _stage = "steady"
+    # stage -> {"count": int, "time_s": float}
+    _by_stage: Dict[str, Dict[str, float]] = {}
+    total_count = 0
+    total_time_s = 0.0
+    # per-event sinks (e.g. a job's compile-time histogram); jobs MUST
+    # remove_sink on teardown or the process-global list leaks closures
+    _sinks: List[Any] = []
+    # trace-phase durations worth exporting alongside backend compiles
+    _EVENT = "/jax/core/compile/backend_compile_duration"
+
+    @classmethod
+    def install(cls):
+        with cls._lock:
+            if cls._installed:
+                return
+            try:
+                from jax import monitoring
+                monitoring.register_event_duration_secs_listener(
+                    cls._on_duration
+                )
+            except Exception:
+                # observability must never kill the job; without the
+                # monitoring API the counters just stay at zero
+                return
+            cls._installed = True
+
+    @classmethod
+    def _on_duration(cls, event: str, duration_s: float, **kw):
+        if event != cls._EVENT:
+            return
+        with cls._lock:
+            cls.total_count += 1
+            cls.total_time_s += duration_s
+            row = cls._by_stage.setdefault(
+                cls._stage, {"count": 0, "time_s": 0.0}
+            )
+            row["count"] += 1
+            row["time_s"] += duration_s
+            sinks = list(cls._sinks)
+        for s in sinks:      # outside the lock: sinks may take their own
+            try:
+                s(duration_s)
+            except Exception:
+                pass         # observability must never kill a compile
+
+    @classmethod
+    def add_sink(cls, fn):
+        with cls._lock:
+            cls._sinks.append(fn)
+        return fn
+
+    @classmethod
+    def remove_sink(cls, fn):
+        with cls._lock:
+            if fn in cls._sinks:
+                cls._sinks.remove(fn)
+
+    @classmethod
+    def set_stage(cls, stage: str):
+        with cls._lock:
+            cls._stage = stage
+
+    @classmethod
+    def stage(cls, name: str):
+        """Context manager labelling compiles triggered inside the block."""
+        return _StageCtx(cls, name)
+
+    @classmethod
+    def mark(cls) -> Tuple[int, float]:
+        """(count, time_s) baseline for per-job delta gauges."""
+        with cls._lock:
+            return cls.total_count, cls.total_time_s
+
+    @classmethod
+    def since(cls, mark: Tuple[int, float]) -> Tuple[int, float]:
+        with cls._lock:
+            return (cls.total_count - mark[0],
+                    cls.total_time_s - mark[1])
+
+    @classmethod
+    def report(cls) -> Dict[str, Any]:
+        with cls._lock:
+            return {
+                "compiles": cls.total_count,
+                "compile_time_ms": round(cls.total_time_s * 1e3, 2),
+                "by_stage": {
+                    k: {"count": v["count"],
+                        "time_ms": round(v["time_s"] * 1e3, 2)}
+                    for k, v in sorted(cls._by_stage.items())
+                },
+            }
+
+
+class _StageCtx:
+    __slots__ = ("cls", "name", "prev")
+
+    def __init__(self, cls, name):
+        self.cls = cls
+        self.name = name
+
+    def __enter__(self):
+        with self.cls._lock:
+            self.prev = self.cls._stage
+            self.cls._stage = self.name
+        return self
+
+    def __exit__(self, *exc):
+        with self.cls._lock:
+            self.cls._stage = self.prev
+        return False
+
+
+def cost_analysis_of(jitted, *args) -> Optional[Dict[str, float]]:
+    """FLOPs / bytes-accessed of one compiled step via the AOT
+    `lower().compile().cost_analysis()` path, where the backend provides
+    it (CPU and TPU do; some runtimes return None). This triggers a
+    second trace+compile of the function, so callers gate it behind
+    `observability.compile-cost` — it is a diagnosis tool, not an
+    always-on probe."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+    except Exception:
+        return None
+    if ca is None:
+        return None
+    # jax returns either a dict or a 1-element list of dicts by version
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    out = {}
+    for k in ("flops", "bytes accessed"):
+        v = ca.get(k)
+        if isinstance(v, (int, float)):
+            out[k.replace(" ", "_")] = float(v)
+    return out or None

@@ -281,6 +281,40 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
     )
 
 
+def kg_occupancy(state: WindowShardState, n_key_groups: int):
+    """Per-key-group live-key occupancy of one shard: how many table keys
+    with at least one touched pane hash into each key group. int32
+    [n_key_groups].
+
+    The device half of the skew telemetry (ISSUE 2): the reference can
+    walk its per-key-group StateTables on the heap, but here the key
+    population lives in HBM — a host-side sweep would fetch the whole
+    [C, 2] key table plus the touched plane every refresh. On device it
+    is one route-hash over the table keys and one scatter-add, and only
+    the [n_key_groups] counts cross the link at the existing step-
+    boundary barrier (same pattern as the kg_dirty changelog bits).
+    """
+    C = state.table.capacity
+    touched2 = state.touched.reshape(-1, C)              # [R, C]
+    alive = touched2.any(axis=0) | state.fresh.reshape(-1, C).any(axis=0)
+    keys = state.table.keys                              # [C, 2]
+    kg = assign_to_key_group(
+        route_hash(keys[:, 0], keys[:, 1], jnp), n_key_groups, jnp
+    )
+    return kg_batch_fill(kg, alive, n_key_groups)
+
+
+def kg_batch_fill(kg, mask, n_key_groups: int):
+    """Per-key-group record counts of one micro-batch: int32
+    [n_key_groups] with mask-selected lanes bincounted by their key
+    group. O(B) scatter riding the update step (the cheap half of the
+    skew telemetry — occupancy says who HOLDS state, fill says who is
+    RECEIVING traffic right now). Shared by the mask and exchange step
+    bodies so the two routes count identically."""
+    idx = jnp.where(mask, kg.astype(jnp.int32), jnp.int32(n_key_groups))
+    return jnp.zeros(n_key_groups, jnp.int32).at[idx].add(1, mode="drop")
+
+
 def _floor_div_pane(ts, slide: int):
     # floor division for possibly-negative ticks
     return jnp.floor_divide(ts, jnp.int32(slide)).astype(jnp.int32)

@@ -14,6 +14,7 @@ Chandy-Lamport cut is structural).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import os
@@ -37,9 +38,15 @@ from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.checkpointing import changelog as cklog
 from flink_tpu.checkpointing import manifest as ckmf
 from flink_tpu.checkpointing.materializer import Materializer
+from flink_tpu.metrics.tracing import (
+    CompileEvents,
+    cost_analysis_of,
+    tracer_from_config,
+)
 from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_compact_step,
+    build_kg_occupancy_step,
     build_window_fire_reduced_step,
     build_window_fire_step,
     build_window_update_step,
@@ -800,6 +807,10 @@ class LocalExecutor:
         self._last_cycle_t = None
         self._attribution = None
         self._latency_hist = None
+        # step-loop span tracer (metrics/tracing.py); None unless
+        # observability.tracing is on — the off path carries no tracer
+        self._tracer = None
+        self._compile_sink = None
 
     def _poll_control(self):
         """Observe cancel/savepoint requests at the micro-batch boundary
@@ -846,6 +857,24 @@ class LocalExecutor:
         self.env._backpressure_report = (
             lambda: self._attribution.report() if self._attribution else {}
         )
+        # XLA compile visibility: process-global event counters snapshotted
+        # at job start so the gauges report THIS job's compiles — a
+        # recompile storm mid-stream moves a named metric instead of
+        # presenting as a mystery stall (ISSUE 2 tentpole part 3)
+        CompileEvents.install()
+        mark = CompileEvents.mark()
+        grp.gauge(
+            "xla_compile_count", lambda: CompileEvents.since(mark)[0]
+        )
+        grp.gauge(
+            "xla_compile_time_ms",
+            lambda: round(CompileEvents.since(mark)[1] * 1e3, 2),
+        )
+        hist = grp.histogram("xla_compile_ms")
+        self._compile_sink = CompileEvents.add_sink(
+            lambda d, h=hist: h.update(d * 1e3)
+        )
+        self.env._compile_report = CompileEvents.report
 
     def _notify_restart(self):
         """ExecutionGraph hook: a restart creates new execution attempts
@@ -889,6 +918,13 @@ class LocalExecutor:
         # history, not gauges — the registry only carries scalars)
         self.env._live_metrics = metrics
         self._init_metrics(job_name, metrics)
+        # step-loop span tracing (observability.tracing; metrics/tracing):
+        # attached to the env so /jobs/<jid>/traces can serve it live AND
+        # after the job finishes
+        self._tracer = tracer_from_config(
+            getattr(self.env, "config", None), stage=job_name
+        )
+        self.env._span_tracer = self._tracer
         t_start = time.perf_counter()
         for s in pipe.all_sinks:
             s.open()
@@ -937,6 +973,18 @@ class LocalExecutor:
             pipe.source.close()
             for s in pipe.all_sinks:
                 s.close()
+            if self._compile_sink is not None:
+                CompileEvents.remove_sink(self._compile_sink)
+                self._compile_sink = None
+            if self._tracer is not None:
+                dump = self.env.config.get_str(
+                    "observability.trace-dump", ""
+                )
+                if dump:
+                    try:
+                        self._tracer.dump(dump)
+                    except OSError:
+                        pass   # observability must never kill the job
         metrics.wall_time_s = time.perf_counter() - t_start
         return handle
 
@@ -1365,9 +1413,11 @@ class LocalExecutor:
                     layout[0] != "direct"
                 if not want_ex or mode == "auto":
                     steps_by_route["mask"] = {
-                        "insert": build_window_update_step(ctx, spec),
+                        "insert": build_window_update_step(
+                            ctx, spec, kg_fill=kg_stats_on,
+                        ),
                         "fast": build_window_update_step(
-                            ctx, spec, insert=False,
+                            ctx, spec, insert=False, kg_fill=kg_stats_on,
                         ) if build_fast else None,
                     }
                 if want_ex:
@@ -1375,12 +1425,13 @@ class LocalExecutor:
                     capf = env.config.get_float("exchange.capacity-factor",
                                                 2.0)
                     ex_insert = build_window_update_step_exchange(
-                        ctx, spec, bpd, capf,
+                        ctx, spec, bpd, capf, kg_fill=kg_stats_on,
                     )
                     steps_by_route["exchange"] = {
                         "insert": ex_insert,
                         "fast": build_window_update_step_exchange(
                             ctx, spec, bpd, capf, insert=False,
+                            kg_fill=kg_stats_on,
                         ) if build_fast else None,
                     }
                     exchange_cap[0] = ex_insert.bucket_cap
@@ -1408,7 +1459,14 @@ class LocalExecutor:
                             continue
                         step_mode[0] = tier
                         force_route[0] = route
-                        self._empty_step(run_update, B_step[0], red, None)
+                        # label the compile burst so CompileEvents
+                        # attributes it; anything compiling later (the
+                        # "steady" bucket) is the recompile-storm alarm
+                        with CompileEvents.stage(
+                            f"window-update-{route}-{tier}"
+                        ):
+                            self._empty_step(run_update, B_step[0], red,
+                                             None)
                 step_mode[0] = "insert"
                 force_route[0] = None
                 tier_quiet[0] = 0
@@ -1417,11 +1475,48 @@ class LocalExecutor:
                 # operator (and the tiering test) reads
                 metrics.steps, metrics.steps_fast = steps0, fast0
                 metrics.steps_exchanged = ex0
-                cf = run_fire(None)
-                jax.block_until_ready(cf.counts)
-                if fire_reduced_step is not None:
-                    rf = run_fire(None, reduced=True)
-                    jax.block_until_ready(rf.counts)
+                with CompileEvents.stage("window-fire"):
+                    cf = run_fire(None)
+                    jax.block_until_ready(cf.counts)
+                    if fire_reduced_step is not None:
+                        rf = run_fire(None, reduced=True)
+                        jax.block_until_ready(rf.counts)
+                if env.config.get_bool("observability.compile-cost",
+                                       False) \
+                        and self._job_group is not None:
+                    # AOT cost_analysis of the primary update step (FLOPs
+                    # / bytes accessed where the backend reports them);
+                    # costs a second trace+compile, hence config-gated
+                    route0 = (
+                        "mask" if "mask" in steps_by_route else "exchange"
+                    )
+                    # the exchange route's entry is a plain wrapper; its
+                    # jitted inner step rides on .jit (cost_analysis
+                    # needs .lower())
+                    fn0 = steps_by_route[route0]["insert"]
+                    fn0 = getattr(fn0, "jit", fn0)
+                    Bs = B_step[0]
+                    vals0 = (
+                        np.zeros(Bs, np.uint32) if red.kind == "sketch"
+                        else np.zeros(
+                            (Bs,) + tuple(red.value_shape), np.float32
+                        )
+                    )
+                    # labelled: this second trace+compile must not land
+                    # in the "steady" recompile-storm bucket
+                    with CompileEvents.stage("cost-analysis"):
+                        ca = cost_analysis_of(
+                            fn0, state,
+                            np.zeros(Bs, np.uint32),
+                            np.zeros(Bs, np.uint32),
+                            np.zeros(Bs, np.int32), vals0,
+                            np.zeros(Bs, bool),
+                            np.zeros(ctx.n_shards, np.int32),
+                        )
+                    for k, v in (ca or {}).items():
+                        self._job_group.settable_gauge(
+                            f"xla_update_step_{k}", v
+                        )
 
         # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
         storage = None
@@ -1632,6 +1727,10 @@ class LocalExecutor:
             sync_ms = (time.perf_counter() - t_ck0) * 1e3
             if ck_hists:
                 ck_hists["sync"].update(sync_ms)
+            # checkpoints are rare and exactly the stalls worth seeing in
+            # a trace: record regardless of the cycle sampling decision
+            if tracer is not None:
+                tracer.rec("checkpoint_sync", t_ck0, cid=cid, kind=kind)
 
             # ---- ASYNC phase (materializer thread; inline when sync) ---
             def materialize():
@@ -1944,6 +2043,122 @@ class LocalExecutor:
         # cycle phase accumulators (CycleAttribution) + LatencyMarker stamp
         phase_acc = {"dispatch": 0.0, "emit": 0.0}
         last_ingest_t = [None]
+        # step-loop span tracer (observability.tracing); local alias so
+        # the hot path pays one load + None-check when tracing is off
+        tracer = self._tracer
+
+        # -- device-resident skew telemetry (ISSUE 2 tentpole part 2) ------
+        # kg_fill_total: cumulative per-key-group record counts from the
+        # SAMPLED lagged monitoring fetches (the traffic view — which
+        # groups are receiving records). kg_occ_cache: per-key-group live-
+        # key occupancy refreshed by the device kernel at fire boundaries
+        # on a wall-clock budget (the state view — which groups hold
+        # keys). Both are host numpy caches so gauges and the /keygroups
+        # endpoint read them from web threads without ever touching the
+        # donated device buffers.
+        maxp_kg = ctx.max_parallelism
+        kg_fill_total = np.zeros(maxp_kg, np.int64)
+        kg_fill_sampled = [0]          # batches the fill counts cover
+        kg_occ_cache = [None]          # np.int64 [maxp] or None
+        kg_occ_step_fn = [None]        # lazily compiled occupancy kernel
+        kg_last_refresh = [0.0]
+        kg_interval_s = env.config.get_float(
+            "observability.kg-stats-interval-ms", 1000.0
+        ) / 1e3
+        # observability.kg-stats gates the parts with a cost of their
+        # own: the occupancy kernel (one compile + an O(C) sweep per
+        # interval) and the sampled monitoring fetch for stages that
+        # never fetch otherwise (no overflow ring). Defaults to ON
+        # exactly when tracing is on — the shipping default's hot path
+        # is byte-identical to before, and the fill counts still ride
+        # the overflow monitoring fetch that spillable stages already
+        # pay for.
+        kg_stats_on = env.config.get_bool(
+            "observability.kg-stats", tracer is not None
+        )
+
+        def refresh_kg_occupancy(force: bool = False):
+            """Run the per-key-group occupancy kernel and cache the host
+            view. Called at fire boundaries (the loop is already syncing
+            for the barrier fetch there) at most once per interval."""
+            if not kg_stats_on or state is None or spec is None:
+                return
+            now = time.monotonic()
+            if not force and now - kg_last_refresh[0] < kg_interval_s:
+                return
+            kg_last_refresh[0] = now
+            if kg_occ_step_fn[0] is None:
+                kg_occ_step_fn[0] = build_kg_occupancy_step(ctx, spec)
+            span = (
+                tracer.span("kg_occupancy") if tracer is not None
+                else contextlib.nullcontext()
+            )
+            with span, CompileEvents.stage("kg-occupancy"):
+                occ = np.asarray(
+                    jax.device_get(kg_occ_step_fn[0](state))
+                ).sum(axis=0)
+            kg_occ_cache[0] = occ.astype(np.int64)
+
+        def _top_k(arr, k):
+            if arr is None or not len(arr):
+                return []
+            k = max(1, min(int(k), len(arr)))
+            idx = np.argsort(arr)[::-1][:k]
+            return [
+                {"group": int(g), "count": int(arr[g])}
+                for g in idx if arr[g] > 0
+            ]
+
+        def kg_report(k: int = 10) -> dict:
+            return {
+                "key_groups": maxp_kg,
+                "n_shards": ctx.n_shards,
+                "occupancy_top": _top_k(kg_occ_cache[0], k),
+                "fill_top": _top_k(kg_fill_total, k),
+                "fill_sampled_batches": kg_fill_sampled[0],
+                "occupied_groups": (
+                    int((kg_occ_cache[0] > 0).sum())
+                    if kg_occ_cache[0] is not None else None
+                ),
+            }
+
+        env._kg_report = kg_report
+        if self._job_group is not None:
+            grp = self._job_group
+
+            def _occ_stat(fn, default=0):
+                occ = kg_occ_cache[0]
+                if occ is None:
+                    return default
+                nz = occ[occ > 0]
+                return fn(nz) if len(nz) else default
+
+            grp.gauge("kg_occupied_groups",
+                      lambda: _occ_stat(len))
+            grp.gauge("kg_occupancy_max",
+                      lambda: _occ_stat(lambda nz: int(nz.max())))
+            grp.gauge("kg_occupancy_mean",
+                      lambda: _occ_stat(
+                          lambda nz: round(float(nz.mean()), 2)))
+            # skew = hottest group / mean over occupied groups; 1.0 is a
+            # perfectly balanced population, >> 1 is the untunable-skew
+            # signal (Multicore-SSP: you cannot tune what you cannot
+            # attribute)
+            grp.gauge("kg_skew_ratio",
+                      lambda: _occ_stat(lambda nz: round(
+                          float(nz.max() / nz.mean()), 3), default=1.0))
+            grp.gauge("kg_fill_max",
+                      lambda: int(kg_fill_total.max(initial=0)))
+            grp.gauge("kg_hot_group",
+                      lambda: int(kg_fill_total.argmax())
+                      if kg_fill_total.any() else -1)
+            # per-stage watermark + lag gauges (tentpole part 2): how far
+            # the watermark trails wall clock and the data it has seen
+            grp.gauge("watermark_ms", wm_strategy.current)
+            grp.gauge("watermark_lag_ms",
+                      lambda: wm_strategy.watermark_lag_ms(
+                          int(time.time() * 1000)))
+            grp.gauge("event_time_lag_ms", wm_strategy.event_time_lag_ms)
 
         # Bounded step pipelining: async dispatch lets the host run ahead
         # of the device, but an UNBOUNDED queue means a pane-boundary fire
@@ -2015,6 +2230,12 @@ class LocalExecutor:
             ))
             t_d0 = time.perf_counter()
             route = _pick_route(hi, lo, valid)
+            # route span: only a sampled-traced cycle pays the extra
+            # perf_counter read between routing and dispatch
+            t_r1 = (
+                time.perf_counter()
+                if tracer is not None and tracer.active else None
+            )
             tiers = steps_by_route[route]
             tier = (
                 "fast"
@@ -2022,7 +2243,7 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
-            state, (ovf_handle, act_handle) = active(
+            state, (ovf_handle, act_handle, kgf_handle) = active(
                 state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
                 jnp.asarray(values), jnp.asarray(valid), wmv,
             )
@@ -2033,23 +2254,32 @@ class LocalExecutor:
             inflight.append(act_handle)
             if len(inflight) > max_inflight:
                 inflight.popleft().block_until_ready()
-            phase_acc["dispatch"] += time.perf_counter() - t_d0
+            t_d1 = time.perf_counter()
+            phase_acc["dispatch"] += t_d1 - t_d0
+            if t_r1 is not None:
+                tracer.rec("route", t_d0, t_r1, route=route)
+                tracer.rec("dispatch", t_r1, t_d1, route=route, tier=tier,
+                           step=metrics.steps)
             metrics.steps += 1
             if tier == "fast":
                 metrics.steps_fast += 1
             if route == "exchange":
                 metrics.steps_exchanged += 1
-            if win.overflow:
-                # SAMPLED lagged monitoring: a cold device->host fetch on
-                # this runtime costs ~70ms of fixed round-trip latency
-                # (async pre-copy measured even slower), so only every
-                # MON_EVERY-th step's handles are retained and inspected;
-                # the overflow ring is auto-sized to absorb the whole
-                # detection lag (see setup())
+            # SAMPLED lagged monitoring: a cold device->host fetch on
+            # this runtime costs ~70ms of fixed round-trip latency
+            # (async pre-copy measured even slower), so only every
+            # MON_EVERY-th step's handles are retained and inspected;
+            # the overflow ring is auto-sized to absorb the whole
+            # detection lag (see setup()). The kg_fill skew counts ride
+            # the same sampled fetch for free; observability.kg-stats
+            # additionally enables it for stages with no overflow ring
+            # (strict capacity / direct layout), which otherwise never
+            # pay a monitoring fetch at all.
+            if win.overflow or kg_stats_on:
                 mon_skip[0] += 1
                 if mon_skip[0] >= MON_EVERY:
                     mon_skip[0] = 0
-                    mon_watch.append((ovf_handle, act_handle))
+                    mon_watch.append((ovf_handle, act_handle, kgf_handle))
                     check_overflow_pressure()
 
         def run_fire(wm_ms, reduced: bool = False):
@@ -2092,9 +2322,16 @@ class LocalExecutor:
         def check_overflow_pressure():
             if len(mon_watch) <= OVF_LAG:
                 return
-            ovf_h, act_h = mon_watch.pop(0)
+            ovf_h, act_h, kgf_h = mon_watch.pop(0)
             fill = int(np.asarray(ovf_h).max(initial=0))
             act = int(np.asarray(act_h).sum())
+            # skew telemetry: the sampled batch's per-key-group record
+            # counts ([n_shards, maxp] — shards are disjoint, sum them;
+            # [n_shards, 0] when the steps were built without kg_fill)
+            kgf = np.asarray(kgf_h)
+            if kgf.size:
+                kg_fill_total[:] += kgf.sum(axis=0)
+                kg_fill_sampled[0] += 1
             # -- adaptive step tiering: while new keys are being PLACED,
             # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
@@ -2402,6 +2639,11 @@ class LocalExecutor:
             dbg = os.environ.get("FLINK_TPU_DRAIN_DEBUG")
             t_e0 = time.perf_counter()
             drain_overflow()     # ring -> pane stores before any emission
+            # skew telemetry: refresh the per-key-group occupancy view ON
+            # ENTRY (interval-limited inside) — the fires below purge due
+            # panes, so sampling here sees the live population the stall
+            # is actually about
+            refresh_kg_occupancy()
             t_ovf = time.perf_counter()
             if dbg:
                 print(f"[drain] ovf={1e3*(t_ovf-t_e0):.0f}ms",
@@ -2412,9 +2654,14 @@ class LocalExecutor:
             # (drain_overflow above was its only producer), so the choice
             # of fire variant is loop-invariant
             use_reduced = fire_reduced_step is not None and not ovf_stores
+            traced = tracer is not None and tracer.active
             while True:
                 t_f0 = time.perf_counter()
                 cf = run_fire(wm_ms, reduced=use_reduced)
+                # fire dispatch returns immediately; the device_get below
+                # IS the step-boundary barrier — trace them separately so
+                # a stalled fetch is attributable (tentpole span catalog)
+                t_fd = time.perf_counter() if traced else None
                 # ONE batched fetch of all small per-lane fields
                 counts, lanes, ends, vsums = jax.device_get(
                     (cf.counts, cf.lane_valid, cf.window_end_ticks,
@@ -2424,6 +2671,11 @@ class LocalExecutor:
                 fires_before = metrics.fires
                 n_emit = emit_fires(cf, counts, lanes, ends, vsums,
                                     use_reduced)
+                if traced:
+                    t_em = time.perf_counter()
+                    tracer.rec("fire", t_f0, t_fd, reduced=use_reduced)
+                    tracer.rec("barrier_fetch", t_fd, t_f1)
+                    tracer.rec("emit", t_f1, t_em, fired=n_emit)
                 if dbg:
                     print(f"[drain] fire+lanes={1e3*(t_f1-t_f0):.0f}ms "
                           f"emit={1e3*(time.perf_counter()-t_f1):.0f}ms "
@@ -2614,12 +2866,18 @@ class LocalExecutor:
         def poll_cycle():
             nonlocal td, host_fired_pane, applied_max_pane
             self._poll_control()
+            if tracer is not None:
+                tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()
             phase_acc["dispatch"] = phase_acc["emit"] = 0.0
             pb = next_batch()
             # attribution: with prefetch on, "source" time is only the
             # wait for the prep thread (~0 while it keeps ahead)
             t_src = time.perf_counter()
+            if tracer is not None and tracer.active:
+                # source drain + host chain/encode (prefetch folds the
+                # encode into the wait; both are upstream of the device)
+                tracer.rec("source", t_c0, t_src, records=pb["n"])
             end, n = pb["end"], pb["n"]
             hi, lo, values, ts_ms = (pb["hi"], pb["lo"], pb["values"],
                                      pb["ts_ms"])

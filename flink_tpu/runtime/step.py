@@ -114,7 +114,8 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
 
 
 def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
-                             insert: bool = True):
+                             insert: bool = True,
+                             kg_fill: bool = False):
     """Update-only half of the window step: apply a micro-batch and advance
     the shard watermark, but do NOT evaluate fires. The reference evaluates
     timers on every watermark advance (HeapInternalTimerService), but a
@@ -153,9 +154,15 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
         ovf_n = state.ovf_n
+        # skew telemetry (observability.kg-stats): statically compiled
+        # out when off so the default step is identical to before
+        kgf = (
+            wk.kg_batch_fill(kg, mine, maxp) if kg_fill
+            else jnp.zeros(0, jnp.int32)
+        )
         return (
             jax.tree_util.tree_map(lambda x: x[None], state),
-            ovf_n[None], activity[None],
+            ovf_n[None], activity[None], kgf[None],
         )
 
     sharded = shard_map(
@@ -166,22 +173,26 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
             P(), P(), P(), P(), P(),
             P(SHARD_AXIS),
         ),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def update_step(state, hi, lo, ts, values, valid, wm):
-        """Returns (state', (ovf_n, activity)). The second element is a
-        tiny NON-donated monitoring pair: overflow-ring fill level and
-        not-already-resident lane count. The host queues the handle and
-        inspects it a few steps later — by then the values have
+        """Returns (state', (ovf_n, activity, kg_fill)). The second
+        element is a tiny NON-donated monitoring tuple: overflow-ring
+        fill level, not-already-resident lane count, and per-key-group
+        record counts of this batch ([n_shards, max_parallelism] — the
+        traffic half of the skew telemetry; [n_shards, 0] when the
+        builder's kg_fill flag is off). The host queues the handles
+        and inspects them a few steps later — by then the values have
         materialized, so the read never stalls the step pipeline (lagged
         monitoring). `activity` drives the insert<->fast step tiering.
         """
-        st, ovf_n, act = sharded(state, starts, ends, hi, lo, ts, values,
-                                 valid, wm)
-        return st, (ovf_n, act)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, hi, lo, ts,
+                                      values, valid, wm)
+        return st, (ovf_n, act, kgf)
 
     return update_step
 
@@ -218,7 +229,8 @@ def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
 def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
                                       batch_per_device: int,
                                       capacity_factor: float = 2.0,
-                                      insert: bool = True):
+                                      insert: bool = True,
+                                      kg_fill: bool = False):
     """Update step with a real ICI record exchange instead of
     replicate-and-mask: the host splits the batch over devices (each holds
     B/n lanes), each device buckets its lanes by owning shard and ONE
@@ -252,9 +264,20 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             state, watermark=jnp.maximum(state.watermark, wm[0])
         )
         ovf_n = state.ovf_n
+        # skew telemetry over THIS device's pre-exchange lane slice: each
+        # record is counted once at its source device, so the host-side
+        # shard sum equals the mask route's per-owner counts; compiled
+        # out when the builder's kg_fill flag is off
+        if kg_fill:
+            kg_local = assign_to_key_group(
+                route_hash(hi, lo, jnp), maxp, jnp
+            )
+            kgf = wk.kg_batch_fill(kg_local, valid, maxp)
+        else:
+            kgf = jnp.zeros(0, jnp.int32)
         return (
             jax.tree_util.tree_map(lambda x: x[None], state),
-            ovf_n[None], activity[None],
+            ovf_n[None], activity[None], kgf[None],
         )
 
     sharded = shard_map(
@@ -267,21 +290,25 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS),
             P(SHARD_AXIS),  # per-shard watermark
         ),
-        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def _jit_step(state, hi, lo, ts, values, valid, wm):
-        st, ovf_n, act = sharded(state, starts, ends, hi, lo, ts, values,
-                                 valid, wm)
-        return st, (ovf_n, act)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, hi, lo, ts,
+                                      values, valid, wm)
+        return st, (ovf_n, act, kgf)
 
     def update_step(state, hi, lo, ts, values, valid, wm):
         return _jit_step(state, hi, lo, ts, values, valid, wm)
 
     update_step.recv_lanes = n * cap
     update_step.bucket_cap = cap
+    # the jitted inner step, for AOT consumers (cost_analysis needs
+    # .lower(), which the plain wrapper doesn't have)
+    update_step.jit = _jit_step
     return update_step
 
 
@@ -343,6 +370,33 @@ def build_window_fire_reduced_step(ctx: MeshContext, spec: WindowStageSpec):
         return sharded(state, wm)
 
     return fire_step
+
+
+def build_kg_occupancy_step(ctx: MeshContext, spec: WindowStageSpec):
+    """Per-key-group live-key occupancy over the mesh (wk.kg_occupancy):
+    int32 [n_shards, max_parallelism], shards own disjoint groups so the
+    host's per-group view is the sum over the shard axis. State is NOT
+    donated — the telemetry read must never invalidate the live buffers.
+    Compiled lazily by the executor and run at fire boundaries on a wall-
+    clock budget (observability.kg-stats-interval-ms), where the barrier
+    fetch already syncs the loop."""
+    mesh = ctx.mesh
+    maxp = ctx.max_parallelism
+
+    def shard_body(state):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        return wk.kg_occupancy(state, maxp)[None]
+
+    sharded = shard_map(
+        shard_body, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+        out_specs=P(SHARD_AXIS), check_vma=False,
+    )
+
+    @jax.jit
+    def occupancy_step(state):
+        return sharded(state)
+
+    return occupancy_step
 
 
 def build_compact_step(ctx: MeshContext, spec: WindowStageSpec):

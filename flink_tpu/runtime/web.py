@@ -12,6 +12,12 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>               job detail incl. JobMetrics
     /jobs/<jid>/metrics       full metric snapshot for the job
     /jobs/<jid>/backpressure  cycle-time percentiles
+    /jobs/<jid>/traces        step-loop span traces as Chrome-trace JSON
+                              (observability.tracing; docs/observability.md)
+    /jobs/<jid>/keygroups     hot key-group top-k + occupancy/fill skew
+                              (device-resident telemetry; ?k= bounds)
+    /metrics                  Prometheus text exposition over every job's
+                              registry (text/plain, not JSON — scrape me)
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
                               (ref CheckpointStatsTracker + handlers/checkpoints/)
     /jobs/<jid>/plan          logical operator DAG (ref JobPlanHandler)
@@ -131,6 +137,19 @@ class WebMonitor:
             def do_GET(self):
                 if not self._authorized():
                     return self._deny()
+                if urllib.parse.urlsplit(self.path).path == "/metrics":
+                    # Prometheus scrape endpoint (text exposition, NOT
+                    # JSON): every job's registry on the existing port
+                    data = monitor._prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if urllib.parse.urlsplit(self.path).path in ("/web", "/web/"):
                     data = _DASHBOARD_HTML.encode()
                     self.send_response(200)
@@ -231,6 +250,25 @@ class WebMonitor:
             self._jar_dir_owned = False
 
     # -- helpers ---------------------------------------------------------
+    def _prometheus_text(self) -> str:
+        """Aggregate Prometheus exposition over every job's registry.
+        Job attribution needs no extra labelling: each registry already
+        scopes its metrics as jobs.<name>.<metric>, which the renderer
+        turns into {job="<name>"} labels."""
+        from flink_tpu.metrics.reporters import prometheus_text_from_items
+
+        items = []
+        seen = set()
+        for rec in list(self.cluster.jobs.values()):
+            reg = getattr(rec.env, "metric_registry", None)
+            # concurrent submissions may share one env/registry; collect
+            # each registry once or the scrape has duplicate series
+            if reg is None or id(reg) in seen:
+                continue
+            seen.add(id(reg))
+            items.extend(reg.items())
+        return prometheus_text_from_items(items)
+
     @staticmethod
     def _plan_nodes(env) -> list:
         """The logical operator DAG of an environment as plan-JSON rows
@@ -888,6 +926,44 @@ class WebMonitor:
                 },
                 "history": stats[-50:],
             }
+        m = re.fullmatch(r"/jobs/([^/]+)/traces", path)
+        if m:
+            # step-loop span traces as Chrome-trace JSON (metrics/tracing
+            # SpanTracer; load in chrome://tracing / ui.perfetto.dev).
+            # Served live while the job runs AND after it finishes (the
+            # tracer stays attached to the environment).
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            tracer = getattr(rec.env, "_span_tracer", None)
+            if tracer is None:
+                return {
+                    "enabled": False,
+                    "traceEvents": [],
+                    "hint": "set observability.tracing: true in the job "
+                            "configuration to record step-loop spans",
+                }
+            return {"enabled": True, **tracer.to_chrome_trace()}
+        m = re.fullmatch(r"/jobs/([^/]+)/keygroups", path)
+        if m:
+            # hot-key-group top-k: occupancy (who holds state) + sampled
+            # fill counts (who receives traffic) from the device-resident
+            # skew telemetry; ?k= bounds the list (default 10)
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None
+            report_fn = getattr(rec.env, "_kg_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "key-group telemetry is recorded by windowed "
+                            "keyed stages; this job has none (yet)",
+                }
+            try:
+                k = max(1, min(int(query.get("k", 10)), 1000))
+            except ValueError:
+                k = 10
+            return {"available": True, **report_fn(k)}
         m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)
         if m:
             rec = self.cluster.jobs.get(m.group(1))

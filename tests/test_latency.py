@@ -1,0 +1,106 @@
+"""metrics/latency.py: weighted sampling invariants (ISSUE 2 satellite).
+
+The fire-latency percentiles drive the north-star p99 claim, so the
+bounded-compaction machinery must provably (a) conserve total weight and
+(b) keep the percentiles it reports within bucket resolution of the
+exact distribution across REPEATED compactions — a drifting compactor
+would quietly corrupt the headline metric on any long-running job.
+"""
+
+import numpy as np
+
+from flink_tpu.metrics.latency import LatencySamples, weighted_percentile
+
+
+def _exact_percentile(weights, values, q):
+    order = np.argsort(values)
+    v, w = np.asarray(values)[order], np.asarray(weights)[order]
+    cdf = np.cumsum(w) / w.sum()
+    return float(v[min(int(np.searchsorted(cdf, q / 100.0)), len(v) - 1)])
+
+
+# -------------------------------------------------------------- compact
+
+def test_compact_conserves_total_weight():
+    ls = LatencySamples(max_samples=64)
+    rng = np.random.default_rng(7)
+    total = 0
+    for _ in range(1000):
+        n = int(rng.integers(1, 50))
+        total += n
+        ls.record(n, float(rng.exponential(10.0)))
+    # many compactions happened (1000 records into a 64-slot bound)
+    assert len(ls) <= 64
+    assert np.isclose(sum(n for n, _ in ls._samples), total)
+
+
+def test_compact_percentile_drift_bounded():
+    """p50/p95/p99 after repeated compaction stay within bucket
+    resolution of the exact weighted percentiles. Bucket resolution: one
+    compaction merges adjacent sorted pairs, so any value moves at most
+    to its merge-partner's weighted mean — bounded by the local bucket
+    width, measured here as the max adjacent gap among retained samples
+    at the compacted size."""
+    rng = np.random.default_rng(42)
+    n_emissions = 20_000
+    weights = rng.integers(1, 20, n_emissions).astype(float)
+    # lognormal latencies: a realistic long-tailed fire-latency shape
+    values = rng.lognormal(mean=3.0, sigma=0.7, size=n_emissions)
+
+    ls = LatencySamples(max_samples=512)
+    for w, v in zip(weights, values):
+        ls.record(int(w), float(v))
+    assert len(ls) <= 512          # compacted many times over
+
+    retained = sorted(v for _, v in ls._samples)
+    for q in (50.0, 95.0, 99.0):
+        exact = _exact_percentile(weights, values, q)
+        approx = ls.percentile(q)
+        # resolution near the quantile: the widest adjacent gap among
+        # retained samples in the exact value's neighborhood
+        i = int(np.searchsorted(retained, exact))
+        lo = max(0, i - 2)
+        hi = min(len(retained) - 1, i + 2)
+        resolution = max(
+            np.diff(retained[lo:hi + 1]).max(initial=0.0), 1e-9
+        )
+        assert abs(approx - exact) <= 2 * resolution, (
+            q, exact, approx, resolution
+        )
+
+
+def test_compact_handles_odd_sample_count():
+    ls = LatencySamples(max_samples=4)
+    for i in range(5):             # 5th record triggers an odd compact
+        ls.record(1, float(i))
+    assert len(ls) == 3            # 2 merged pairs + the odd tail
+    assert np.isclose(sum(n for n, _ in ls._samples), 5)
+
+
+# --------------------------------------------------- weighted_percentile
+
+def test_weighted_percentile_empty_and_single():
+    assert weighted_percentile([], 50) is None
+    # a single sample answers EVERY quantile with its own value
+    for q in (0.0, 50.0, 100.0):
+        assert weighted_percentile([(3.0, 42.5)], q) == 42.5
+
+
+def test_weighted_percentile_q0_and_q100():
+    samples = [(1.0, 10.0), (1.0, 20.0), (1.0, 30.0)]
+    assert weighted_percentile(samples, 0) == 10.0     # min
+    assert weighted_percentile(samples, 100) == 30.0   # max
+
+
+def test_weighted_percentile_respects_weights():
+    # 99 windows at 1ms, 1 window at 100ms: p50 is 1ms, p99.5 is 100ms
+    samples = [(99.0, 1.0), (1.0, 100.0)]
+    assert weighted_percentile(samples, 50) == 1.0
+    assert weighted_percentile(samples, 99.5) == 100.0
+
+
+def test_record_zero_weight_is_noop():
+    ls = LatencySamples()
+    ls.record(0, 5.0)
+    assert len(ls) == 0 and not ls
+    assert ls.percentile(50) is None

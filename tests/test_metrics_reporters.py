@@ -139,6 +139,76 @@ def test_config_driven_reporters_on_env():
     srv.close()
 
 
+def test_prometheus_text_exposition():
+    """Exposition format 0.0.4: one TYPE header per family, job names as
+    labels, histograms as summaries with quantile series + _count/_sum."""
+    from flink_tpu.metrics.reporters import prometheus_text
+
+    reg = _registry_with_metrics()
+    reg.group("jobs", "j1").meter("throughput").mark_event(5)
+    text = prometheus_text(reg)
+    lines = text.splitlines()
+    assert "# TYPE flink_tpu_records_in counter" in lines
+    assert 'flink_tpu_records_in{job="j1"} 42' in lines
+    assert "# TYPE flink_tpu_steps gauge" in lines
+    assert 'flink_tpu_steps{job="j1"} 7' in lines
+    # histogram -> summary family with quantile labels
+    assert "# TYPE flink_tpu_lat summary" in lines
+    assert 'flink_tpu_lat{job="j1",quantile="0.50"} 2.0' in lines
+    assert 'flink_tpu_lat_count{job="j1"} 3' in lines
+    assert 'flink_tpu_lat_sum{job="j1"} 6.0' in lines
+    # _count/_sum ride the parent family: no separate TYPE header
+    assert not any(ln.startswith("# TYPE flink_tpu_lat_count")
+                   for ln in lines)
+    # meter -> _total counter + _rate gauge
+    assert 'flink_tpu_throughput_total{job="j1"} 5' in lines
+    assert any(ln.startswith('flink_tpu_throughput_rate{job="j1"} ')
+               for ln in lines)
+    # exactly one TYPE line per family
+    types = [ln for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types))
+
+
+def test_prometheus_name_sanitization_and_merge():
+    from flink_tpu.metrics.core import MetricRegistry
+    from flink_tpu.metrics.reporters import prometheus_text_from_items
+
+    r1, r2 = MetricRegistry(), MetricRegistry()
+    r1.group("jobs", 'my "job"-1').counter("cycle-time.p99").inc(1)
+    r2.group("jobs", "other").counter("cycle-time.p99").inc(2)
+    text = prometheus_text_from_items(r1.items() + r2.items())
+    lines = text.splitlines()
+    # metric-name charset enforced; label values escaped, not mangled
+    assert 'flink_tpu_cycle_time_p99{job="my \\"job\\"-1"} 1' in lines
+    assert 'flink_tpu_cycle_time_p99{job="other"} 2' in lines
+    # merged registries still yield ONE header for the shared family
+    assert lines.count("# TYPE flink_tpu_cycle_time_p99 counter") == 1
+
+
+def test_prometheus_reporter_via_configure(tmp_path):
+    """configure_reporters instantiates the prometheus kind; the textfile
+    path makes report() drop the exposition for file-based scrapers."""
+    from flink_tpu.metrics.reporters import PrometheusReporter
+
+    out = tmp_path / "metrics.prom"
+    reg = _registry_with_metrics()
+    threads = configure_reporters(reg, Configuration({
+        "metrics.reporters": "prom",
+        "metrics.reporter.prom.class": "prometheus",
+        "metrics.reporter.prom.path": str(out),
+        "metrics.reporter.prom.interval": 3600,
+    }))
+    try:
+        rep = threads[0].reporter
+        assert isinstance(rep, PrometheusReporter)
+        assert 'flink_tpu_records_in{job="j1"} 42' in rep.scrape()
+        rep.report()
+        assert 'flink_tpu_records_in{job="j1"} 42' in out.read_text()
+    finally:
+        for t in threads:
+            t.stop()
+
+
 def test_unknown_reporter_class_rejected():
     import pytest
 
