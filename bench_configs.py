@@ -455,6 +455,90 @@ def run_checkpoint_overhead(total_events: int, cpu: bool):
             detail["sync_full"]["eps"])
 
 
+# -------------------------------------------------- pipelined ingest
+def run_ingest_pipeline(total_events: int, cpu: bool):
+    """Pipelined-ingest config (ISSUE 3, runtime/ingest.py): the 1M-key
+    tumbling-window sum run with prefetch off / on / on+checkpointing
+    (incremental+async, the production configuration). Prefetch overlaps
+    source poll + encode + device staging with the device step;
+    epoch-tagged applied-offset cuts make the overlap legal while
+    checkpoints are being written.
+
+    subject = prefetch-on **with** checkpointing eps, baseline =
+    prefetch-on without — the acceptance criterion is ratio >= 0.90
+    (checkpointing must not give the overlap back). The detail line
+    additionally carries the prefetch-off row (the escape hatch /
+    pre-pipelining throughput) and per-mode checkpoint stalls.
+    """
+    import shutil
+    import tempfile
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    n_keys = 1 << 20
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            "key": (idx * 2654435761) % n_keys,
+            "value": np.ones(n, np.float32),
+        }
+        return cols, (idx // 32768) * 1000
+
+    def run(mode):
+        cfg = Configuration()
+        cfg.set("pipeline.prefetch",
+                "off" if mode == "prefetch_off" else "on")
+        cfg.set("keys.reverse-map", False)   # 1M-key columnar fast path
+        ckpt_dir = None
+        if mode == "prefetch_on_ckpt":
+            ckpt_dir = tempfile.mkdtemp(prefix="ingestbench-")
+            cfg.set("checkpoint.mode", "incremental")
+            cfg.set("checkpoint.async", True)
+        env = StreamExecutionEnvironment(cfg)
+        env.set_parallelism(1)
+        env.set_max_parallelism(128)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(1 << 21)
+        env.batch_size = 131072
+        if ckpt_dir:
+            env.enable_checkpointing(8, ckpt_dir)
+        sink = CountingSink()
+        t0 = time.perf_counter()
+        (
+            env.add_source(GeneratorSource(gen, total=total_events))
+            .key_by(lambda c: c["key"])
+            .time_window(10_000)
+            .sum(lambda c: c["value"])
+            .add_sink(sink)
+        )
+        env.execute(f"ingest-bench-{mode}")
+        dt = time.perf_counter() - t0
+        stats = env.last_job.metrics.checkpoint_stats or []
+        if ckpt_dir:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        assert sink.count > 0
+        return {
+            "eps": round(total_events / dt),
+            "checkpoints": len(stats),
+            "max_stall_ms": round(
+                max((s["sync_ms"] for s in stats), default=0.0), 2),
+        }
+
+    detail = {
+        m: run(m)
+        for m in ("prefetch_off", "prefetch_on", "prefetch_on_ckpt")
+    }
+    print(json.dumps(
+        {"config": "ingest_pipeline", "detail": detail}), flush=True)
+    return (detail["prefetch_on_ckpt"]["eps"],
+            detail["prefetch_on"]["eps"])
+
+
 # ---------------------------------------------- observability overhead
 def run_observability_overhead(total_events: int, cpu: bool):
     """Observability-overhead config (ISSUE 2): the same keyed windowed
@@ -528,6 +612,7 @@ CONFIGS = {
     "cep_event_time": (run_cep_event_time, 400_000),
     "checkpoint_overhead": (run_checkpoint_overhead, 2_000_000),
     "observability_overhead": (run_observability_overhead, 2_000_000),
+    "ingest_pipeline": (run_ingest_pipeline, 4_000_000),
 }
 
 

@@ -24,6 +24,15 @@ This package is the micro-batch SPMD redesign of both:
   group, purge-cutoff filtered) back into one logical snapshot, so
   restore — including rescale re-bucketing — reuses the existing
   ``restore_window_state`` path unchanged.
+
+The source cut a snapshot carries is the **applied-offset cut**
+(runtime/ingest.py): with the pipelined ingest path, the prefetch
+thread may have polled the source several batches past the state the
+device has absorbed, so checkpoints/savepoints snapshot the offsets of
+the last *applied* batch — never the live source position. Restore
+rewinds the source to those offsets and the epoch bump discards every
+in-flight prefetched batch, which then replays; state, offsets, and
+sink state therefore always describe the same step boundary.
 """
 
 from flink_tpu.checkpointing.changelog import (  # noqa: F401

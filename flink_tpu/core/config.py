@@ -133,6 +133,30 @@ class CoreOptions:
     CHECKPOINT_STAGING_SLOTS = ConfigOption(
         "checkpoint.staging-slots", 2,
         "host staging buffers in flight (double-buffered by default)")
+    # -- pipelined ingest (runtime/ingest.py; docs/performance.md) ------
+    # prep-half prefetch thread: poll + encode of batch k+1 overlaps the
+    # device step of batch k. Checkpoint-compatible since the epoch-
+    # tagged applied-offset cut — "auto" is on for every windowed stage.
+    PIPELINE_PREFETCH = ConfigOption(
+        "pipeline.prefetch", "auto",
+        "auto | on | off — overlap source poll + host encode with device "
+        "compute (off is the fully-serial escape hatch)")
+    PIPELINE_MAX_INFLIGHT = ConfigOption(
+        "pipeline.max-inflight-steps", 4,
+        "bound on dispatched-but-unfinished update steps (caps the fire "
+        "wait behind the device backlog)")
+    PIPELINE_DEVICE_STAGING = ConfigOption(
+        "pipeline.device-staging", "auto",
+        "auto | on | off — pad + jax.device_put batches on the ingest "
+        "thread (route-aware sharding) so the H2D transfer of batch k+1 "
+        "overlaps the step of batch k; auto follows pipeline.prefetch")
+    PIPELINE_STAGING_RING = ConfigOption(
+        "pipeline.staging-ring-depth", 2,
+        "preallocated host padding buffers recycled by the ingest "
+        "thread (2 = double-buffered)")
+    PIPELINE_PREFETCH_DEPTH = ConfigOption(
+        "pipeline.prefetch-depth", 2,
+        "prepped batches the ingest queue holds ahead of the step loop")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)

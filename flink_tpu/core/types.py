@@ -11,6 +11,7 @@ RecordBatch is a registered pytree so it can flow through jit/shard_map.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -121,17 +122,39 @@ class KeyCodec:
 
     def __init__(self):
         self._rev: dict[int, Any] = {}
+        # encode may run on the ingest prefetch thread while a checkpoint
+        # lists newly-seen keys on the step-loop thread (runtime/ingest):
+        # the lock makes the per-batch insert burst and the keymap-log
+        # slice atomic against each other (one acquisition per BATCH, not
+        # per key — negligible against the encode itself)
+        self._lock = threading.Lock()
 
     def encode(self, keys, keep_reverse: bool = True):
         """keys: numeric array (vectorized) or sequence of objects."""
         h = key_identity64(keys)
         if keep_reverse:
             klist = keys.tolist() if isinstance(keys, np.ndarray) else keys
-            for k, hv in zip(klist, h.tolist()):
-                self._rev.setdefault(hv, k)
+            with self._lock:
+                for k, hv in zip(klist, h.tolist()):
+                    self._rev.setdefault(hv, k)
         hi = (h >> np.uint64(32)).astype(np.uint32)
         lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         return hi, lo
+
+    def rev_slice(self, start: int):
+        """Atomic snapshot of the reverse map's append-only tail:
+        ``(items[start:], len_at_snapshot)``. The checkpoint keymap log
+        appends `items` and records the returned count — under the same
+        lock encode inserts hold, so a concurrent prefetch-thread encode
+        can never tear the iteration (dicts preserve insertion order, so
+        the slice IS the keys seen since the last checkpoint)."""
+        import itertools
+
+        with self._lock:
+            return (
+                list(itertools.islice(self._rev.items(), start, None)),
+                len(self._rev),
+            )
 
     # kept as an alias for the columnar fast path's call sites
     encode_numeric = encode

@@ -44,7 +44,11 @@ _Span = Tuple[str, str, float, float, Optional[dict]]
 # the docs so the catalog cannot silently drift from the wiring
 STEP_PHASES = (
     "source",           # source poll / prefetch wait + host chain/encode
-    "route",            # per-batch exchange-route feasibility (key routing)
+    "route",            # per-batch exchange-route feasibility (key routing;
+                        #   recorded from the ingest thread when planned
+                        #   at prep time, runtime/ingest.py)
+    "stage",            # ingest-thread pad into the staging ring
+    "transfer",         # ingest-thread H2D device_put + completion wait
     "dispatch",         # device step dispatch (+ inflight-depth wait)
     "fire",             # fire-step dispatch at a pane boundary
     "barrier_fetch",    # step-boundary scalar/lane fetch (the d2h barrier)

@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import itertools
 import os
 import pickle
 import queue
@@ -43,6 +42,7 @@ from flink_tpu.metrics.tracing import (
     cost_analysis_of,
     tracer_from_config,
 )
+from flink_tpu.runtime import ingest as ingest_mod
 from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_compact_step,
@@ -127,6 +127,13 @@ class _GenericCheckpointIO:
     def __init__(self, env, storage, pipe):
         self.storage = storage
         self.pipe = pipe
+        # serializes source wire interactions against a pipelined-ingest
+        # producer (runtime/ingest.py): the windowed runner points this
+        # at its pipeline's source_lock — an offset commit may share the
+        # poll's connection, and an interleaved commit mid-fetch would
+        # corrupt the protocol. Runners that poll inline have no
+        # concurrent producer, so the no-op default costs nothing.
+        self.source_lock = contextlib.nullcontext()
         self.materializer = None
         if storage is not None and env.config.get_bool(
             "checkpoint.async",
@@ -152,7 +159,8 @@ class _GenericCheckpointIO:
         step loop's) thread."""
         while self._notify_q:
             cid, offsets = self._notify_q.popleft()
-            self.pipe.source.notify_checkpoint_complete(cid, offsets)
+            with self.source_lock:
+                self.pipe.source.notify_checkpoint_complete(cid, offsets)
             for s in self.pipe.all_sinks:
                 s.notify_checkpoint_complete(cid)
 
@@ -163,9 +171,10 @@ class _GenericCheckpointIO:
         self.drain()
         if self.materializer is None:
             self.storage.write_generic(cid, payload)
-            self.pipe.source.notify_checkpoint_complete(
-                cid, payload["offsets"]
-            )
+            with self.source_lock:
+                self.pipe.source.notify_checkpoint_complete(
+                    cid, payload["offsets"]
+                )
             for s in self.pipe.all_sinks:
                 s.notify_checkpoint_complete(cid)
             return
@@ -260,10 +269,10 @@ class _FlatStageCheckpointer:
         # codec reverse map rides the APPEND-ONLY keymap log: each
         # checkpoint writes only the keys seen since the last one
         if self.keep_rev:
-            items = list(itertools.islice(
-                self.codec._rev.items(), self.n_keys_logged, None))
+            items, self.n_keys_logged = self.codec.rev_slice(
+                self.n_keys_logged
+            )
             store.append_keymap(items)
-            self.n_keys_logged = len(self.codec._rev)
         leaves, _ = jax.tree_util.tree_flatten(self.get_state())
         return {
             "stage_state": [np.asarray(jax.device_get(x)) for x in leaves],
@@ -1297,6 +1306,10 @@ class LocalExecutor:
         # step lane count: == B, or B rounded up to a multiple of the
         # shard count when the ICI exchange splits the batch over devices
         B_step = [None]
+        # reused prefix-mask template (ingest.make_prefix_mask_template):
+        # the per-batch np.ones+pad valid mask becomes a view slice —
+        # one allocation per stage, immutable, safe under async transfer
+        valid_tmpl = [None]
         codec = KeyCodec()
         # reverse key map costs a python dict insert per record; benchmarks
         # and columnar sinks that accept 64-bit key ids can turn it off
@@ -1443,6 +1456,31 @@ class LocalExecutor:
                     fire_reduced_step = build_window_fire_reduced_step(
                         ctx, spec
                     )
+            # -- ingest plan (runtime/ingest.py): publish the time domain,
+            # lane geometry, exchange capacity and route shardings so the
+            # prep side can route-plan and device-stage batches off the
+            # step-loop thread. (Re-)installed on every setup — a restore
+            # changes the time-domain origin; the producer is paused there
+            # so the swap never races a batch mid-prep.
+            valid_tmpl[0] = ingest_mod.make_prefix_mask_template(B_step[0])
+            mask_sh, split_sh = ingest_mod.IngestPlan.shardings_for(ctx.mesh)
+            ingest.set_plan(ingest_mod.IngestPlan(
+                td=td, slide_ticks=int(win.slide_ticks),
+                span_limit=win.ring - max(
+                    2, int(win.size_ticks // win.slide_ticks) + 1
+                ),
+                B=B, B_step=B_step[0], n_shards=ctx.n_shards,
+                max_parallelism=ctx.max_parallelism, kg_ends=_kg_ends,
+                exchange_cap=exchange_cap[0],
+                routes=tuple(steps_by_route), staging=use_staging,
+                mask_sharding=mask_sh, split_sharding=split_sh,
+                value_shape=(
+                    () if red.kind == "sketch" else tuple(red.value_shape)
+                ),
+                value_dtype=(
+                    np.uint32 if red.kind == "sketch" else np.float32
+                ),
+            ))
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
                 # trigger ALL compiles NOW (inside any benchmark warmup)
@@ -1683,11 +1721,11 @@ class LocalExecutor:
             if ck_mode == "incremental":
                 state = clear_dirty(state)
             if keep_rev:
-                items = list(
-                    itertools.islice(codec._rev.items(), n_keys_logged, None)
-                )
+                # atomic against the ingest thread's concurrent encodes
+                # (the map may already hold keys from prefetched batches
+                # past the cut — harmless supersets on restore)
+                items, n_keys_logged = codec.rev_slice(n_keys_logged)
                 storage.append_keymap(items)
-                n_keys_logged = len(codec._rev)
             aux = {
                 "origin_ms": td.origin_ms,
                 "wm_current": wm_strategy.current(),
@@ -1697,7 +1735,12 @@ class LocalExecutor:
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
-            offsets = pipe.source.snapshot_offsets()
+            # the APPLIED-offset cut (runtime/ingest.py): the prefetch
+            # thread may have polled the source several batches ahead,
+            # so the snapshot names the offsets of the last batch the
+            # device state has absorbed — in-flight prepped batches are
+            # dropped + replayed on restore, never skipped
+            offsets = ingest.applied_offsets()
             # freeze offsets/sink states NOW: the step loop resumes before
             # the write lands, and live sink state must not leak into it
             aux_bytes = pickle.dumps(
@@ -1751,7 +1794,8 @@ class LocalExecutor:
                 if materializer is not None:
                     ck_io.queue_notification(cid, offsets)
                 else:
-                    pipe.source.notify_checkpoint_complete(cid, offsets)
+                    with ck_io.source_lock:
+                        pipe.source.notify_checkpoint_complete(cid, offsets)
                     for s in pipe.all_sinks:
                         s.notify_checkpoint_complete(cid)
                 nbytes = sum(
@@ -1787,6 +1831,11 @@ class LocalExecutor:
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane, applied_max_pane
+            # park the prefetch producer FIRST: everything below mutates
+            # state it reads (source offsets, the codec reverse map, the
+            # ingest plan); resume() at the end bumps the epoch so every
+            # batch prepped before this restore is discarded + replayed
+            ingest.pause()
             if materializer is not None:
                 ck_io.recover()           # durable cuts still notify
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
@@ -1886,6 +1935,10 @@ class LocalExecutor:
                     else [cid] if same_dir else []
                 )
             steps_at_ckpt = metrics.steps
+            # restart production from the rewound source; the restored
+            # snapshot's offsets ARE the applied cut until the first
+            # post-restore batch lands
+            ingest.resume(offsets)
 
         def write_savepoint(path: str) -> str:
             """Manually-triggered versioned snapshot into its own directory
@@ -1906,20 +1959,26 @@ class LocalExecutor:
             drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
             entries = _fold_spill_entries(entries, _dump_spill_stores())
+            n_rev = 0
             if keep_rev:
-                sp.append_keymap(list(codec._rev.items()))
+                # atomic snapshot vs concurrent ingest-thread encodes
+                items, n_rev = codec.rev_slice(0)
+                sp.append_keymap(items)
             aux = {
                 "origin_ms": td.origin_ms,
                 "wm_current": wm_strategy.current(),
-                "codec_rev_count": len(codec._rev) if keep_rev else 0,
+                "codec_rev_count": n_rev,
                 "size_ms": size_ms, "slide_ms": slide_ms,
                 "lateness_ms": wagg.allowed_lateness_ms,
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
             cid = (sp.latest() or 0) + 1
+            # applied-offset cut, like periodic checkpoints: prefetched-
+            # ahead batches are NOT part of the savepoint and replay on
+            # restore from the rewound source position
             return sp.write(cid, entries, scalars,
-                            pipe.source.snapshot_offsets(), aux)
+                            ingest.applied_offsets(), aux)
 
         self._savepoint_writer = write_savepoint
 
@@ -2176,47 +2235,37 @@ class LocalExecutor:
         _kg_ends = np.asarray(ctx.kg_bounds()[1])
 
         def _pick_route(hi, lo, valid):
-            """Exact per-batch feasibility of the ICI exchange: the host
-            computes every lane's owning shard (the same murmur key-group
-            math the device uses) and takes the all_to_all step only when
-            every shard's records fit its static bucket — skew falls back
-            to replicate-and-mask, so the adaptive default is NEVER lossy.
-            ~2-4ms of numpy per 262k batch vs an O(B) vs O(B/n) device
-            step."""
+            """Step-loop route fallback for batches the ingest side did
+            not plan (warmup, catch-up slices, chunked polls). ONE
+            implementation of the exchange-feasibility math exists —
+            ingest.plan_route — so prep-planned and loop-routed batches
+            can never disagree on bucket fit; callers pass prefix-valid
+            masks, so the valid lanes are exactly the leading
+            count_nonzero lanes (matching prep's unpadded view)."""
             if force_route[0] is not None:
                 return force_route[0]
-            if "exchange" not in steps_by_route:
-                return "mask"
-            if "mask" not in steps_by_route:
-                return "exchange"       # exchange.mode=all_to_all forced
-            from flink_tpu.core.keygroups import assign_to_key_group
-            from flink_tpu.ops.hashing import route_hash
-
-            n = ctx.n_shards
-            kg = assign_to_key_group(
-                route_hash(hi, lo, np), ctx.max_parallelism, np,
-            )
-            shard = np.searchsorted(_kg_ends, kg)
-            # the exchange's bound is PER (source device, dest shard)
-            # bucket: lanes are split over devices in contiguous chunks,
-            # and each src's records for each dst must fit its bucket
-            bpd = len(hi) // n
-            src = np.arange(len(hi)) // bpd
-            pair = np.where(valid, src * n + shard, n * n)
-            counts = np.bincount(pair, minlength=n * n + 1)[:n * n]
-            return (
-                "exchange" if counts.max(initial=0) <= exchange_cap[0]
-                else "mask"
+            n_valid = int(np.count_nonzero(valid))
+            return ingest_mod.plan_route(
+                ingest.plan, hi[:n_valid], lo[:n_valid]
             )
 
-        def run_update(hi, lo, ticks, values, valid, wm_ms):
+        def run_update(hi, lo, ticks, values, valid, wm_ms, staged=None,
+                       route=None):
             """Dispatch one update-only device step. No host sync: the
             result is not read, so transfers and compute of successive
             steps overlap (the round-1 loop blocked on every step). The
             step's tiny (ovf_n, activity) output handles are queued for
             LAGGED monitoring — inspected a few steps later when they have
             already materialized, so the pipeline never stalls. `activity`
-            drives the insert<->fast step tiering (wk.update insert flag)."""
+            drives the insert<->fast step tiering (wk.update insert flag).
+
+            `route`/`staged`: precomputed by the ingest side
+            (runtime/ingest.py) — the route plan and the device-resident
+            padded arrays of a prefetched batch. When the ingest plan has
+            staging on, host-array calls (warmup, catch-up slices) are
+            staged HERE with the same shardings, so every dispatch feeds
+            the compiled step identically-committed inputs and the step
+            never recompiles mid-stream."""
             nonlocal state
             wm_ticks = (
                 min(int(td.to_ticks(wm_ms)), 2**31 - 4)
@@ -2229,7 +2278,8 @@ class LocalExecutor:
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
             t_d0 = time.perf_counter()
-            route = _pick_route(hi, lo, valid)
+            if route is None:
+                route = _pick_route(hi, lo, valid)
             # route span: only a sampled-traced cycle pays the extra
             # perf_counter read between routing and dispatch
             t_r1 = (
@@ -2243,10 +2293,23 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
-            state, (ovf_handle, act_handle, kgf_handle) = active(
-                state, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(ticks),
-                jnp.asarray(values), jnp.asarray(valid), wmv,
-            )
+            plan = ingest.plan
+            if staged is None and plan is not None and plan.staging:
+                # enqueue-only device_put (no wait): the arrays are fresh
+                # per-call, so there is no buffer-recycle hazard here
+                staged = ingest_mod.stage_batch_arrays(
+                    plan, route, hi, lo, ticks, values, valid
+                )
+            if staged is not None:
+                state, (ovf_handle, act_handle, kgf_handle) = active(
+                    state, *staged, wmv,
+                )
+            else:
+                state, (ovf_handle, act_handle, kgf_handle) = active(
+                    state, jnp.asarray(hi), jnp.asarray(lo),
+                    jnp.asarray(ticks), jnp.asarray(values),
+                    jnp.asarray(valid), wmv,
+                )
             # dispatch normally returns immediately; it BLOCKS when the
             # device pipeline is saturated -> the device-bound signal.
             # The depth-cap wait below is part of the same device-bound
@@ -2314,7 +2377,7 @@ class LocalExecutor:
         # once OVF_LAG newer samples exist — by then its step has long
         # finished, so the read is one settled round trip, amortized to
         # ~1/MON_EVERY of the fixed d2h latency per step
-        mon_watch = []
+        mon_watch = deque()
         mon_skip = [0]
         MON_EVERY = 8
         OVF_LAG = 1
@@ -2322,7 +2385,7 @@ class LocalExecutor:
         def check_overflow_pressure():
             if len(mon_watch) <= OVF_LAG:
                 return
-            ovf_h, act_h, kgf_h = mon_watch.pop(0)
+            ovf_h, act_h, kgf_h = mon_watch.popleft()
             fill = int(np.asarray(ovf_h).max(initial=0))
             act = int(np.asarray(act_h).sum())
             # skew telemetry: the sampled batch's per-key-group record
@@ -2732,8 +2795,11 @@ class LocalExecutor:
             executor state (watermarks, time domain, device handles), so
             the prefetch thread can run it strictly ahead of the apply
             half — the encode of batch k+1 overlaps the device step of
-            batch k instead of serializing with it."""
-            polled, end = pipe.source.poll(B)
+            batch k instead of serializing with it. The post-poll offsets
+            ride the batch (the epoch-tagged replay point): checkpoints
+            snapshot the offsets of the last APPLIED batch, which is what
+            makes running ahead compatible with exactly-once cuts."""
+            polled, end, offsets = pipe.source.poll_with_offsets(B)
             t_src = time.perf_counter()
             now_ms = int(time.time() * 1000)
             hi = lo = values = None
@@ -2790,78 +2856,118 @@ class LocalExecutor:
                         )
                     else:
                         ts_ms = np.full(n, now_ms, np.int64)
-            return dict(end=end, n=n, hi=hi, lo=lo, values=values,
-                        ts_ms=ts_ms, now_ms=now_ms, t_src=t_src)
+            return ingest_mod.PreppedBatch(
+                end=end, n=n, now_ms=now_ms, t_src=t_src, offsets=offsets,
+                hi=hi, lo=lo, values=values, ts_ms=ts_ms,
+            )
 
-        # -- prefetch: double-buffer the prep half on a worker thread ------
-        # Gated off whenever a snapshot could be taken — checkpointing on,
-        # OR a cluster control channel that can request a savepoint at any
-        # batch boundary: offset snapshots happen at the consume point
-        # (source.snapshot_offsets), and a polled-ahead batch would make
-        # the snapshot skip records on restore. The reference overlaps
-        # the same way structurally — its netty IO threads fill input
-        # buffers while the task thread processes (SURVEY §2.3); here one
-        # thread is enough because the prep half is vectorized numpy, not
-        # per-record work.
+        # -- pipelined ingest (runtime/ingest.py): epoch-tagged prefetch,
+        # async device staging, off-thread route planning. Checkpoint-
+        # COMPATIBLE: every prepped batch carries its post-poll offsets,
+        # snapshots cut at the applied offsets, and a restore's epoch
+        # bump discards in-flight batches (they replay from the rewound
+        # source) — so the overlap runs in the production configuration
+        # too, where it used to be hard-disabled. The reference overlaps
+        # the same way structurally (netty IO threads fill input buffers
+        # while the task thread processes, SURVEY §2.3); one thread is
+        # enough because the prep half is vectorized numpy. "off" remains
+        # the fully-serial escape hatch.
         prefetch_cfg = env.config.get_str("pipeline.prefetch", "auto")
         if prefetch_cfg not in ("auto", "on", "off"):
             raise ValueError(
                 f"pipeline.prefetch must be auto|on|off, got {prefetch_cfg!r}"
             )
+        use_prefetch = prefetch_cfg != "off"
+        # the applied-offset cut only works when restore can REWIND the
+        # source to it: a non-replayable source (snapshot_offsets None —
+        # sockets, transient rings) cannot replay the batches a restore's
+        # epoch bump discards, so running ahead of a possible snapshot
+        # (checkpointing on, or a control channel that can request a
+        # savepoint) would turn at-most-once into silently-more-lost.
+        # auto falls back to inline prep there; an explicit "on" is a
+        # config error, not a silent downgrade.
         can_snapshot = (
             storage is not None
             or getattr(env, "_control", None) is not None
         )
-        if prefetch_cfg == "on" and can_snapshot:
-            raise ValueError(
-                "pipeline.prefetch=on is incompatible with checkpointing/"
-                "savepoints: the prefetch thread polls the source ahead of "
-                "the applied state, so offset snapshots would skip records "
-                "on restore"
-            )
-        use_prefetch = prefetch_cfg != "off" and not can_snapshot
-        prefetch_q: queue.Queue = queue.Queue(maxsize=2)
-        prefetch_stop = threading.Event()
-        prefetch_thread = [None]
-
-        def _prefetch_main():
-            try:
-                while not prefetch_stop.is_set():
-                    item = prep_batch()
-                    while not prefetch_stop.is_set():
-                        try:
-                            prefetch_q.put(("ok", item), timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-                    if item["end"]:
-                        return
-            except BaseException as e:  # deliver to the consuming thread
-                # same stop-checking retry as the ok path: a consumer can
-                # legitimately stall for seconds in a pane-boundary drain,
-                # and a dropped error would leave it blocked on get()
-                # forever with no producer alive
-                while not prefetch_stop.is_set():
-                    try:
-                        prefetch_q.put(("err", e), timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-
-        def next_batch():
-            if not use_prefetch:
-                return prep_batch()
-            if prefetch_thread[0] is None:
-                t = threading.Thread(
-                    target=_prefetch_main, daemon=True,
-                    name="flink-tpu-prefetch",
+        if can_snapshot and pipe.source.snapshot_offsets() is None:
+            if prefetch_cfg == "on":
+                raise ValueError(
+                    "pipeline.prefetch=on with checkpointing/savepoints "
+                    "requires a replayable source (snapshot_offsets "
+                    "returning a position): this source cannot rewind to "
+                    "the applied-offset cut, so batches prefetched past a "
+                    "snapshot would be lost on restore"
                 )
-                prefetch_thread[0] = t
-                t.start()
-            kind, item = prefetch_q.get()
-            if kind == "err":
-                raise item
-            return item
+            use_prefetch = False
+        staging_cfg = env.config.get_str("pipeline.device-staging", "auto")
+        if staging_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.device-staging must be auto|on|off, "
+                f"got {staging_cfg!r}"
+            )
+        if staging_cfg == "on" and not use_prefetch:
+            raise ValueError(
+                "pipeline.device-staging=on requires pipeline.prefetch: "
+                "the staging transfer-completion wait runs on the ingest "
+                "thread and would otherwise block the step loop"
+            )
+        use_staging = use_prefetch and staging_cfg != "off"
+        ingest = ingest_mod.IngestPipeline(
+            prep_batch, prefetch=use_prefetch,
+            initial_offsets=pipe.source.snapshot_offsets(),
+            depth=env.config.get_int("pipeline.prefetch-depth", 2),
+            ring_depth=env.config.get_int("pipeline.staging-ring-depth", 2),
+            tracer=tracer,
+        )
+        # checkpoint-complete offset commits may ride the poll's wire
+        # connection: serialize them with the producer's polls
+        ck_io.source_lock = ingest.source_lock
+
+        def _apply_planned(pb):
+            """Apply one PLANNED single-group batch: the ingest side
+            already chose the route and (with staging on) moved the
+            padded arrays to the device, so this path is watermark
+            arithmetic + one dispatch — no hashing, no padding, no
+            per-batch allocation on the step-loop thread."""
+            nonlocal applied_max_pane, host_fired_pane
+            wm_ms = (
+                wm_strategy.on_batch(pb.ts_max) if event_time
+                else pb.now_ms - 1
+            )
+            slide = int(win.slide_ticks)
+            # BETWEEN-polls time jump guard (see _apply_general): the
+            # planned batch is single-group by construction, but may
+            # still sit past everything the ring has absorbed
+            g_max_pane = pb.ticks_max // slide
+            if (
+                applied_max_pane is not None
+                and g_max_pane - applied_max_pane >= 2
+            ):
+                g_min_pane = pb.ticks_min // slide
+                fire_wm = min(wm_ms, int(td.to_ms(g_min_pane * slide)) - 1)
+                drain_fires(fire_wm, time.perf_counter())
+            applied_max_pane = (
+                g_max_pane if applied_max_pane is None
+                else max(applied_max_pane, g_max_pane)
+            )
+            if pb.staged is not None:
+                run_update(None, None, None, None, None, wm_ms,
+                           staged=pb.staged, route=pb.route)
+            else:
+                Bs = B_step[0]
+                run_update(
+                    _pad(pb.hi, Bs, np.uint32),
+                    _pad(pb.lo, Bs, np.uint32),
+                    _pad(pb.ticks, Bs, np.int32),
+                    _pad(pb.values, Bs, pb.values.dtype),
+                    ingest_mod.prefix_mask(valid_tmpl[0], pb.n),
+                    wm_ms, route=pb.route,
+                )
+            wp = wm_pane_of(wm_ms)
+            if eager_fire or wp > host_fired_pane:
+                drain_fires(wm_ms, time.perf_counter())
+                host_fired_pane = wp
 
         def poll_cycle():
             nonlocal td, host_fired_pane, applied_max_pane
@@ -2870,146 +2976,38 @@ class LocalExecutor:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()
             phase_acc["dispatch"] = phase_acc["emit"] = 0.0
-            pb = next_batch()
+            pb = ingest.next()
             # attribution: with prefetch on, "source" time is only the
             # wait for the prep thread (~0 while it keeps ahead)
             t_src = time.perf_counter()
             if tracer is not None and tracer.active:
                 # source drain + host chain/encode (prefetch folds the
                 # encode into the wait; both are upstream of the device)
-                tracer.rec("source", t_c0, t_src, records=pb["n"])
-            end, n = pb["end"], pb["n"]
-            hi, lo, values, ts_ms = (pb["hi"], pb["lo"], pb["values"],
-                                     pb["ts_ms"])
-            now_ms = pb["now_ms"]
-            ticks = None
+                tracer.rec("source", t_c0, t_src, records=pb.n)
+            end, n, now_ms = pb.end, pb.n, pb.now_ms
 
             metrics.records_in += n
             if n:
-                last_ingest_t[0] = pb["t_src"]
+                last_ingest_t[0] = pb.t_src
                 if td is None:
                     # auto-layout hint: bounded non-negative int keys (the
                     # identity fits hi==0, lo < capacity on the first
                     # batch) are eligible for the direct-index backend —
                     # key == slot, no probes, no inserts. setup() combines
                     # this with spillability (out-of-bound keys must have
-                    # a spill tier to degrade to, not be dropped).
+                    # a spill tier to degrade to, not be dropped). The
+                    # first batch is always unplanned (the plan is born in
+                    # setup), so its host arrays are present.
                     auto_direct_hint[0] = (
-                        int(hi.max(initial=0)) == 0
-                        and int(lo.max(initial=0))
+                        int(pb.hi.max(initial=0)) == 0
+                        and int(pb.lo.max(initial=0))
                         < env.state_capacity_per_shard
                     )
-                    setup((int(np.min(ts_ms)) // size_ms) * size_ms)
-                ticks = td.to_ticks(ts_ms)
-                if event_time:
-                    wm_ms = wm_strategy.on_batch(int(np.max(ts_ms)))
+                    setup((int(np.min(pb.ts_ms)) // size_ms) * size_ms)
+                if pb.route is not None:
+                    _apply_planned(pb)
                 else:
-                    wm_ms = now_ms - 1
-                values = np.asarray(values)
-                # A batch spanning more panes than the ring holds (replay /
-                # catch-up) must be time-sliced, or fresh panes would evict
-                # unfired ones. The span bound leaves size/slide panes of
-                # headroom (not just 2): every pane the rotation can evict
-                # must have ALL of its windows end below the group's min
-                # pane, so the safe pre-fire between groups (below) can
-                # close them without touching windows the group feeds.
-                panes = ticks // np.int32(win.slide_ticks)
-                span_limit = win.ring - max(
-                    2, int(win.size_ticks // win.slide_ticks) + 1
-                )
-                if int(panes.max()) - int(panes.min()) >= span_limit:
-                    order = np.argsort(panes, kind="stable")
-                    sorted_panes = panes[order]
-                    groups = []
-                    lo_i = 0
-                    while lo_i < n:
-                        cutoff = sorted_panes[lo_i] + span_limit
-                        hi_i = int(np.searchsorted(sorted_panes, cutoff, "left"))
-                        groups.append(order[lo_i:hi_i])
-                        lo_i = hi_i
-                else:
-                    groups = None   # single group, no reindex copy
-                catch_up = groups is not None
-                wp = wm_pane_of(wm_ms)
-                ooo_ms = wm_strategy.out_of_orderness_ms
-                for sel in (groups if catch_up else (None,)):
-                    if sel is None:
-                        g_hi, g_lo, g_ticks, g_vals, m = hi, lo, ticks, values, n
-                        g_wm = wm_ms
-                    else:
-                        g_hi, g_lo, g_ticks, g_vals, m = (
-                            hi[sel], lo[sel], ticks[sel], values[sel], len(sel)
-                        )
-                        # group-local watermark: a replay burst's watermark
-                        # trails the group being applied, or later groups'
-                        # records would be late against their own poll's
-                        # final watermark (the reference applies the whole
-                        # burst before the periodic watermark advances)
-                        g_wm = min(
-                            td.to_ms(int(g_ticks.max())) - ooo_ms - 1, wm_ms
-                        )
-                    # BETWEEN-polls time jump: if this group's panes sit
-                    # ahead of everything the ring has absorbed, applying
-                    # them could rotate the ring past still-unfired panes
-                    # — fire those panes' windows FIRST. (The catch-up
-                    # slicing above only bounds the span WITHIN one poll;
-                    # a quiet source resuming after an event-time gap —
-                    # or a processing-time job resuming after a
-                    # compile/GC pause — jumps between polls instead.)
-                    # The pre-fire watermark is capped at the group's min
-                    # pane boundary: a window ending there or earlier
-                    # receives NOTHING from this group, so firing it
-                    # before the update cannot split a window's records
-                    # across two emissions; capping at g_wm keeps the
-                    # watermark contract (nothing past the out-of-
-                    # orderness horizon closes early). Every pane the
-                    # rotation can evict ends all its windows below BOTH
-                    # caps — by the span bound above and the ring's
-                    # ooo-panes headroom (setup()) — so eviction only
-                    # ever discards already-fired state. Threshold 2:
-                    # steady-state polls advance at most one pane, so the
-                    # hot path never pays an extra drain.
-                    g_max_pane = int(g_ticks.max()) // int(win.slide_ticks)
-                    if (
-                        applied_max_pane is not None
-                        and g_max_pane - applied_max_pane >= 2
-                    ):
-                        g_min_pane = (
-                            int(g_ticks.min()) // int(win.slide_ticks)
-                        )
-                        fire_wm = min(
-                            g_wm,
-                            td.to_ms(g_min_pane * int(win.slide_ticks)) - 1,
-                        )
-                        drain_fires(fire_wm, time.perf_counter())
-                    applied_max_pane = (
-                        g_max_pane if applied_max_pane is None
-                        else max(applied_max_pane, g_max_pane)
-                    )
-                    # a host chain (flat_map) can expand one poll beyond B
-                    # lanes; feed the step in B-sized chunks padded to the
-                    # step lane count (B_step > B only when the exchange
-                    # splits lanes over shards). The watermark rides only
-                    # the LAST chunk so every record of the poll is
-                    # late-checked against the pre-poll watermark.
-                    Bs = B_step[0]
-                    for off in range(0, m, B):
-                        hi_off = min(off + B, m)
-                        run_update(
-                            _pad(g_hi[off:hi_off], Bs, np.uint32),
-                            _pad(g_lo[off:hi_off], Bs, np.uint32),
-                            _pad(g_ticks[off:hi_off], Bs, np.int32),
-                            _pad(g_vals[off:hi_off], Bs, g_vals.dtype),
-                            _pad(np.ones(hi_off - off, bool), Bs, bool),
-                            g_wm if hi_off == m else None,
-                        )
-                    # catch-up slices must fire between groups or newer
-                    # panes would evict older unfired ones from the ring
-                    if catch_up:
-                        drain_fires(g_wm, time.perf_counter())
-                if eager_fire or wp > host_fired_pane:
-                    drain_fires(wm_ms, time.perf_counter())
-                    host_fired_pane = wp
+                    _apply_general(pb)
             elif td is not None:
                 # idle poll: advance processing-time watermark
                 if not event_time:
@@ -3017,6 +3015,9 @@ class LocalExecutor:
                     if wp > host_fired_pane:
                         drain_fires(now_ms - 1, time.perf_counter())
                         host_fired_pane = wp
+            # this batch is now part of the device state: its offsets
+            # name the cut the next checkpoint/savepoint snapshots
+            ingest.mark_applied(pb)
             if not kv_mailbox.empty():
                 drain_kv_mailbox()
             ck_io.drain()
@@ -3038,6 +3039,128 @@ class LocalExecutor:
                     dispatch=disp_s * 1e3, emit=emit_s * 1e3,
                 )
             return end
+
+        def _apply_general(pb):
+            """The general apply path: unplanned batches (before setup, or
+            re-planned after restore), catch-up replay spans that must be
+            time-sliced, and host-chain polls expanded beyond B lanes."""
+            nonlocal host_fired_pane, applied_max_pane
+            hi, lo, values, ts_ms = pb.hi, pb.lo, pb.values, pb.ts_ms
+            n, now_ms = pb.n, pb.now_ms
+            ticks = td.to_ticks(ts_ms)
+            if event_time:
+                wm_ms = wm_strategy.on_batch(int(np.max(ts_ms)))
+            else:
+                wm_ms = now_ms - 1
+            values = np.asarray(values)
+            # A batch spanning more panes than the ring holds (replay /
+            # catch-up) must be time-sliced, or fresh panes would evict
+            # unfired ones. The span bound leaves size/slide panes of
+            # headroom (not just 2): every pane the rotation can evict
+            # must have ALL of its windows end below the group's min
+            # pane, so the safe pre-fire between groups (below) can
+            # close them without touching windows the group feeds.
+            panes = ticks // np.int32(win.slide_ticks)
+            span_limit = win.ring - max(
+                2, int(win.size_ticks // win.slide_ticks) + 1
+            )
+            if int(panes.max()) - int(panes.min()) >= span_limit:
+                order = np.argsort(panes, kind="stable")
+                sorted_panes = panes[order]
+                groups = []
+                lo_i = 0
+                while lo_i < n:
+                    cutoff = sorted_panes[lo_i] + span_limit
+                    hi_i = int(np.searchsorted(sorted_panes, cutoff, "left"))
+                    groups.append(order[lo_i:hi_i])
+                    lo_i = hi_i
+            else:
+                groups = None   # single group, no reindex copy
+            catch_up = groups is not None
+            wp = wm_pane_of(wm_ms)
+            ooo_ms = wm_strategy.out_of_orderness_ms
+            for sel in (groups if catch_up else (None,)):
+                if sel is None:
+                    g_hi, g_lo, g_ticks, g_vals, m = hi, lo, ticks, values, n
+                    g_wm = wm_ms
+                else:
+                    g_hi, g_lo, g_ticks, g_vals, m = (
+                        hi[sel], lo[sel], ticks[sel], values[sel], len(sel)
+                    )
+                    # group-local watermark: a replay burst's watermark
+                    # trails the group being applied, or later groups'
+                    # records would be late against their own poll's
+                    # final watermark (the reference applies the whole
+                    # burst before the periodic watermark advances)
+                    g_wm = min(
+                        td.to_ms(int(g_ticks.max())) - ooo_ms - 1, wm_ms
+                    )
+                # BETWEEN-polls time jump: if this group's panes sit
+                # ahead of everything the ring has absorbed, applying
+                # them could rotate the ring past still-unfired panes
+                # — fire those panes' windows FIRST. (The catch-up
+                # slicing above only bounds the span WITHIN one poll;
+                # a quiet source resuming after an event-time gap —
+                # or a processing-time job resuming after a
+                # compile/GC pause — jumps between polls instead.)
+                # The pre-fire watermark is capped at the group's min
+                # pane boundary: a window ending there or earlier
+                # receives NOTHING from this group, so firing it
+                # before the update cannot split a window's records
+                # across two emissions; capping at g_wm keeps the
+                # watermark contract (nothing past the out-of-
+                # orderness horizon closes early). Every pane the
+                # rotation can evict ends all its windows below BOTH
+                # caps — by the span bound above and the ring's
+                # ooo-panes headroom (setup()) — so eviction only
+                # ever discards already-fired state. Threshold 2:
+                # steady-state polls advance at most one pane, so the
+                # hot path never pays an extra drain.
+                g_max_pane = int(g_ticks.max()) // int(win.slide_ticks)
+                if (
+                    applied_max_pane is not None
+                    and g_max_pane - applied_max_pane >= 2
+                ):
+                    g_min_pane = (
+                        int(g_ticks.min()) // int(win.slide_ticks)
+                    )
+                    fire_wm = min(
+                        g_wm,
+                        td.to_ms(g_min_pane * int(win.slide_ticks)) - 1,
+                    )
+                    drain_fires(fire_wm, time.perf_counter())
+                applied_max_pane = (
+                    g_max_pane if applied_max_pane is None
+                    else max(applied_max_pane, g_max_pane)
+                )
+                # a host chain (flat_map) can expand one poll beyond B
+                # lanes; feed the step in B-sized chunks padded to the
+                # step lane count (B_step > B only when the exchange
+                # splits lanes over shards). The watermark rides only
+                # the LAST chunk so every record of the poll is
+                # late-checked against the pre-poll watermark.
+                Bs = B_step[0]
+                for off in range(0, m, B):
+                    hi_off = min(off + B, m)
+                    run_update(
+                        _pad(g_hi[off:hi_off], Bs, np.uint32),
+                        _pad(g_lo[off:hi_off], Bs, np.uint32),
+                        _pad(g_ticks[off:hi_off], Bs, np.int32),
+                        _pad(g_vals[off:hi_off], Bs, g_vals.dtype),
+                        # reused prefix-mask template: a frozen view,
+                        # not a per-chunk np.ones+pad allocation
+                        ingest_mod.prefix_mask(
+                            valid_tmpl[0], hi_off - off
+                        ),
+                        g_wm if hi_off == m else None,
+                    )
+                # catch-up slices must fire between groups or newer
+                # panes would evict older unfired ones from the ring
+                if catch_up:
+                    drain_fires(g_wm, time.perf_counter())
+            if eager_fire or wp > host_fired_pane:
+                drain_fires(wm_ms, time.perf_counter())
+                host_fired_pane = wp
 
         # -- run with restore + restart (ref ExecutionGraph.restart + ------
         # -- CheckpointCoordinator.restoreLatestCheckpointedState) ---------
@@ -3085,7 +3208,7 @@ class LocalExecutor:
                     restore_checkpoint(storage)
         finally:
             job_live.clear()
-            prefetch_stop.set()
+            ingest.close()
             drain_kv_mailbox()
             ck_io.close()
 
@@ -3789,6 +3912,9 @@ class LocalExecutor:
         step = build_rolling_step(ctx, spec)
         state = init_rolling_state(ctx, spec)
         B = env.batch_size
+        # reused prefix-mask template (one allocation per stage; the
+        # valid mask of each batch is a frozen view slice)
+        valid_tmpl = ingest_mod.make_prefix_mask_template(B)
         keep_rev = env.config.get_bool("keys.reverse-map", True)
         codec = KeyCodec()
 
@@ -3878,7 +4004,7 @@ class LocalExecutor:
                     jnp.asarray(_pad(hi, B, np.uint32)),
                     jnp.asarray(_pad(lo, B, np.uint32)),
                     jnp.asarray(_pad(values, B, values.dtype)),
-                    jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+                    jnp.asarray(ingest_mod.prefix_mask(valid_tmpl, n)),
                 )
                 metrics.steps += 1
                 klist = (
@@ -3927,6 +4053,9 @@ class LocalExecutor:
         step = build_session_step(ctx, spec)
         state = init_session_state(ctx, spec)
         B = env.batch_size
+        # reused prefix-mask template (one allocation per stage; the
+        # valid mask of each batch is a frozen view slice)
+        valid_tmpl = ingest_mod.make_prefix_mask_template(B)
         keep_rev = env.config.get_bool("keys.reverse-map", True)
         codec = KeyCodec()
         td: Optional[TimeDomain] = None
@@ -4085,7 +4214,7 @@ class LocalExecutor:
                 run_once(
                     _pad(hi, B, np.uint32), _pad(lo, B, np.uint32),
                     _pad(ticks, B, np.int32), _pad(values, B, np.float32),
-                    _pad(np.ones(n, bool), B, bool), wm_ms,
+                    ingest_mod.prefix_mask(valid_tmpl, n), wm_ms,
                 )
                 if td is not None:
                     ckptr.maybe_checkpoint()
@@ -4134,6 +4263,9 @@ class LocalExecutor:
         step = build_count_step(ctx, spec)
         state = init_count_state(ctx, spec)
         B = env.batch_size
+        # reused prefix-mask template (one allocation per stage; the
+        # valid mask of each batch is a frozen view slice)
+        valid_tmpl = ingest_mod.make_prefix_mask_template(B)
         keep_rev = env.config.get_bool("keys.reverse-map", True)
         codec = KeyCodec()
 
@@ -4193,7 +4325,7 @@ class LocalExecutor:
                     jnp.asarray(_pad(hi, B, np.uint32)),
                     jnp.asarray(_pad(lo, B, np.uint32)),
                     jnp.asarray(_pad(values, B, values.dtype)),
-                    jnp.asarray(_pad(np.ones(n, bool), B, bool)),
+                    jnp.asarray(ingest_mod.prefix_mask(valid_tmpl, n)),
                 )
                 metrics.steps += 1
                 emitter.push((khi, klo, w, vals, mask))

@@ -1,0 +1,507 @@
+"""Checkpoint-compatible pipelined ingest (the front half of the hot loop).
+
+The windowed step loop decomposes into a *prep* half (source poll, host
+chain, key/value/timestamp encode — pure host numpy) and an *apply* half
+(watermark advance, device step dispatch, fires). Historically the prep
+half could run ahead on a prefetch thread ONLY when no snapshot could
+ever be taken: offsets were captured live at the consume point, so a
+polled-ahead batch would make a checkpoint skip records on restore. The
+production configuration — checkpointing on — therefore serialized
+source poll + encode with device compute.
+
+This module makes the overlap checkpoint-compatible and pushes two more
+stages of the cycle off the step-loop thread:
+
+* **Epoch-tagged prefetch.** Every prepped batch carries the source
+  offsets captured immediately after ITS poll (``Source.
+  poll_with_offsets``) plus the pipeline epoch it was prepped under.
+  The executor records the offsets of the last *applied* batch; a
+  checkpoint/savepoint snapshots those applied offsets, so the cut is
+  exactly the state the device has absorbed — in-flight prefetched
+  batches are simply dropped on restore (the epoch bump invalidates
+  them) and replayed from the rewound source.
+
+* **Async device staging.** With a plan installed (``IngestPlan``, built
+  once the stage's compiled steps exist), the prefetch thread pads the
+  batch into a preallocated staging ring and ``jax.device_put``s the
+  ``hi/lo/ticks/values/valid`` arrays with the route's sharding
+  (replicated for the mask route, shard-split on the batch axis for the
+  exchange route). The H2D transfer of batch k+1 completes on the
+  ingest thread while the device runs the step for batch k; the step
+  loop dispatches committed arrays and never pays the pad-copy or the
+  transfer enqueue.
+
+* **Off-thread route planning.** The exchange-feasibility check
+  (``plan_route`` — the same murmur key-group math the device uses,
+  ~2-4 ms of numpy per 262k batch) runs at prep time, reusing its
+  key-group computation for the per-(src,dst) bucket fit check, so the
+  step loop reads a precomputed route instead of hashing the batch
+  again.
+
+Threading contract: ONE producer (the prefetch thread — or the step-loop
+thread itself when ``pipeline.prefetch=off``), one consumer (the step
+loop). ``pause()``/``resume()`` bracket every source mutation (restore):
+pause parks the producer, resume bumps the epoch so queued batches from
+the old stream position are discarded by the consumer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.ops.hashing import route_hash
+from flink_tpu.parallel.mesh import SHARD_AXIS
+
+
+# ---------------------------------------------------------------- masks
+
+def make_prefix_mask_template(size: int) -> np.ndarray:
+    """One bool template of length 2*size: [True]*size + [False]*size.
+    ``prefix_mask(tmpl, n)`` slices a VIEW whose first n lanes are True —
+    the per-batch ``np.ones(n) + pad`` allocation becomes one allocation
+    per stage. The template is frozen so a view handed to an async
+    transfer can never be corrupted by later batches."""
+    tmpl = np.zeros(2 * size, bool)
+    tmpl[:size] = True
+    tmpl.flags.writeable = False
+    return tmpl
+
+
+def prefix_mask(tmpl: np.ndarray, n: int) -> np.ndarray:
+    """bool[size] view of `tmpl` with lanes [0, n) True; 0 <= n <= size."""
+    size = len(tmpl) >> 1
+    return tmpl[size - n: 2 * size - n]
+
+
+# ------------------------------------------------------------- batches
+
+@dataclasses.dataclass
+class PreppedBatch:
+    """One prepped micro-batch flowing from the ingest side to the step
+    loop. ``offsets`` is the source position captured right after this
+    batch's poll — the epoch-tagged replay point; ``epoch`` stamps which
+    pipeline incarnation prepped it (batches from a pre-restore epoch
+    are dropped by the consumer)."""
+
+    end: bool
+    n: int
+    now_ms: int
+    t_src: float
+    offsets: Any = None
+    epoch: int = -1
+    # host-side encoded arrays (None once staged to device, or when n=0)
+    hi: Any = None
+    lo: Any = None
+    values: Any = None
+    ts_ms: Any = None
+    # filled by the ingest plan for single-group batches
+    ticks: Any = None            # host int32, planned-but-unstaged batches
+    ticks_min: Optional[int] = None
+    ticks_max: Optional[int] = None
+    ts_max: Optional[int] = None
+    route: Optional[str] = None  # "mask" | "exchange" | None (unplanned)
+    # device-staged (hi, lo, ticks, values, valid) committed arrays
+    staged: Optional[Tuple] = None
+
+
+@dataclasses.dataclass
+class IngestPlan:
+    """Everything the prep side needs once the stage is set up: the time
+    domain, the step lane geometry, the exchange capacity, and the
+    shardings each route's compiled step expects its batch arrays in.
+    Installed via ``IngestPipeline.set_plan`` after ``setup()`` builds
+    the compiled steps (and re-installed on restore — the time-domain
+    origin can change); batches prepped before that arrive unplanned and
+    take the executor's legacy host-array path."""
+
+    td: Any                      # core.time.TimeDomain
+    slide_ticks: int
+    span_limit: int              # catch-up slicing threshold (panes)
+    B: int                       # micro-batch lane count
+    B_step: int                  # step lane count (B padded to shards)
+    n_shards: int
+    max_parallelism: int
+    kg_ends: Any                 # np int32 [n_shards] key-group range ends
+    exchange_cap: int            # per-(src,dst) bucket lanes, 0 = no exchange
+    routes: Tuple[str, ...]      # available compiled routes
+    staging: bool                # device-stage on the ingest thread?
+    mask_sharding: Any = None    # replicated batch arrays (mask route)
+    split_sharding: Any = None   # batch-axis split (exchange route)
+    value_shape: Tuple = ()
+    value_dtype: Any = np.float32
+
+    @staticmethod
+    def shardings_for(mesh):
+        return NamedSharding(mesh, P()), NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def plan_route(plan: IngestPlan, hi: np.ndarray, lo: np.ndarray) -> str:
+    """Exact per-batch feasibility of the ICI exchange, at prep time.
+
+    Computes every lane's owning shard (the same murmur key-group math
+    the device uses) and picks the O(B/n)-per-device all_to_all step
+    only when each (source device, dest shard) bucket provably fits its
+    static capacity — skew falls back to replicate-and-mask, so the
+    adaptive route is never lossy. Runs on the UNPADDED arrays: padding
+    lanes are invalid on device and lane i's source device is i//bpd
+    either way, so the counts match the padded check exactly."""
+    if "exchange" not in plan.routes:
+        return "mask"
+    if "mask" not in plan.routes:
+        return "exchange"        # exchange.mode=all_to_all forced
+    n = plan.n_shards
+    kg = assign_to_key_group(route_hash(hi, lo, np), plan.max_parallelism,
+                             np)
+    shard = np.searchsorted(plan.kg_ends, kg)
+    bpd = plan.B_step // n
+    src = np.arange(len(hi)) // bpd
+    counts = np.bincount(src * n + shard, minlength=n * n)
+    return (
+        "exchange" if counts.max(initial=0) <= plan.exchange_cap
+        else "mask"
+    )
+
+
+def _route_sharding(plan: IngestPlan, route: str):
+    return (
+        plan.split_sharding if route == "exchange" else plan.mask_sharding
+    )
+
+
+def stage_batch_arrays(plan: IngestPlan, route: str, hi, lo, ticks,
+                       values, valid) -> Tuple:
+    """Step-loop-thread staging of already-padded FRESH arrays (the
+    executor's fallback call sites: warmup, catch-up slices, chunked
+    polls). Non-blocking — the transfer is enqueued and the arrays are
+    never reused by the caller, so there is no buffer-recycle hazard.
+    Exists so every update dispatch feeds the compiled step committed
+    arrays of the SAME sharding: mixing committed and uncommitted inputs
+    would recompile the step mid-stream."""
+    sh = _route_sharding(plan, route)
+    return tuple(
+        jax.device_put(x, sh) for x in (hi, lo, ticks, values, valid)
+    )
+
+
+def _host_probe_put_aliases(buf: np.ndarray, sharding) -> bool:
+    """One-time ring-init probe (host-side by contract): does
+    ``jax.device_put`` of THIS buffer alias its memory instead of
+    copying?  XLA's CPU client zero-copies suitably-aligned host
+    buffers — the "staged" array then IS the buffer, and recycling the
+    slot would corrupt every batch still referencing it. Aliasing is
+    decided per allocation (alignment), so each slot buffer is probed
+    individually. Mutates one lane and restores it."""
+    flat = buf.reshape(-1)
+    d = jax.device_put(flat[:1], sharding)
+    jax.block_until_ready(d)
+    old = flat[0]
+    flat[0] = 1 if old == 0 else 0
+    aliased = bool(np.asarray(d)[0] != old)
+    flat[0] = old
+    return aliased
+
+
+class StagingRing:
+    """Preallocated host padding buffers for the prefetch thread's
+    device staging — the per-batch ``np.zeros`` padding in ``_pad``
+    becomes a write into a recycled slot. A slot is reused only after
+    its transfer COMPLETED: ``stage()`` blocks on the put, on the ingest
+    thread, so the step loop never waits and the recycled bytes can
+    never race an in-flight H2D copy. Depth 2 double-buffers (one slot
+    being written while the previous one finishes transferring).
+
+    Backends whose ``device_put`` ZERO-COPIES host memory (XLA CPU with
+    aligned buffers) make recycling impossible: the staged array aliases
+    the slot forever, so ``stage()`` detects that at init (per-buffer
+    probe) and falls back to fresh per-batch buffers there — on such
+    backends there is no H2D copy to overlap anyway, so the ring's only
+    job is correctness."""
+
+    def __init__(self, plan: IngestPlan, depth: int = 2):
+        Bs = plan.B_step
+        vshape = (Bs,) + tuple(plan.value_shape)
+
+        def one_slot():
+            return {
+                "hi": np.zeros(Bs, np.uint32),
+                "lo": np.zeros(Bs, np.uint32),
+                "ticks": np.zeros(Bs, np.int32),
+                "values": np.zeros(vshape, plan.value_dtype),
+            }
+
+        self._make_slot = one_slot
+        self._slots = [one_slot() for _ in range(max(2, int(depth)))]
+        self._i = 0
+        self._mask_tmpl = make_prefix_mask_template(Bs)
+        self._reuse = not any(
+            _host_probe_put_aliases(buf, plan.mask_sharding)
+            for slot in self._slots for buf in slot.values()
+        )
+
+    @staticmethod
+    def _fill(buf: np.ndarray, arr: np.ndarray, n: int) -> np.ndarray:
+        if len(arr) == len(buf):
+            return arr           # full batch: fresh array, ship directly
+        buf[:n] = arr
+        buf[n:] = 0
+        return buf
+
+    def stage(self, plan: IngestPlan, hi, lo, ticks, values, n: int,
+              route: str, tracer=None) -> Tuple:
+        """Pad into the next ring slot and device_put with the route's
+        sharding; returns committed (hi, lo, ticks, values, valid)."""
+        if self._reuse:
+            slot = self._slots[self._i]
+            self._i = (self._i + 1) % len(self._slots)
+        else:
+            # zero-copy backend: the staged array will alias whatever we
+            # hand it — hand it single-use buffers, never the ring's
+            slot = self._make_slot()
+        t0 = time.perf_counter()
+        srcs = (
+            self._fill(slot["hi"], hi, n),
+            self._fill(slot["lo"], lo, n),
+            self._fill(slot["ticks"], ticks, n),
+            self._fill(slot["values"], values, n),
+            prefix_mask(self._mask_tmpl, n),
+        )
+        t_pad = time.perf_counter()
+        sh = _route_sharding(plan, route)
+        staged = tuple(jax.device_put(x, sh) for x in srcs)
+        # transfer completion ON THE INGEST THREAD: the slot may be
+        # recycled the moment the device owns the bytes, and the step
+        # loop receives arrays it can dispatch without ever waiting
+        jax.block_until_ready(staged)  # host-sync-ok: ingest-thread transfer completion, off the step loop
+        if tracer is not None and tracer.active:
+            tracer.rec("stage", t0, t_pad, n=n)
+            tracer.rec("transfer", t_pad, route=route)
+        return staged
+
+
+# ------------------------------------------------------------- pipeline
+
+class IngestPipeline:
+    """Single-producer single-consumer prep pipeline with restore-safe
+    epochs.
+
+    * ``next()`` — the step loop's batch intake. With prefetch on it
+      drains the bounded queue (stale-epoch batches are skipped,
+      producer errors re-raise on the consumer); with prefetch off it
+      runs the prep function inline. Either way the batch is finished
+      against the current plan (route planned, optionally staged).
+    * ``mark_applied(pb)`` — the step loop calls this once a batch's
+      updates are dispatched; ``applied_offsets()`` then names the cut a
+      checkpoint/savepoint must snapshot.
+    * ``pause()`` / ``resume(offsets)`` — bracket source mutation
+      (restore). Pause parks the producer (waits until it is off the
+      source); resume bumps the epoch, drops queued batches, re-arms the
+      applied cut, and unparks.
+
+    The producer parks itself after delivering an end-of-stream batch or
+    an error instead of exiting: a restore may rewind the source past
+    either, and ``resume`` simply continues the same thread.
+    """
+
+    def __init__(self, prep_fn: Callable[[], PreppedBatch], *,
+                 prefetch: bool, initial_offsets: Any = None,
+                 depth: int = 2, ring_depth: int = 2, tracer=None):
+        self.prep_fn = prep_fn
+        self.prefetch = bool(prefetch)
+        self.tracer = tracer
+        # serializes SOURCE WIRE interactions: the producer holds it
+        # across each poll, and the executor takes it around checkpoint-
+        # complete notifications (offset commits may share the poll's
+        # connection — e.g. the partitioned socket consumers — and an
+        # interleaved commit mid-fetch would corrupt the protocol)
+        self.source_lock = threading.RLock()
+        self._plan: Optional[IngestPlan] = None
+        self._ring: Optional[StagingRing] = None
+        self._ring_depth = max(2, int(ring_depth))
+        self._applied = initial_offsets
+        self._epoch = 0
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._gate = threading.Event()   # producer runs while set
+        self._pause_req = threading.Event()  # consumer-requested pause
+        self._parked = threading.Event()
+        self._gate.set()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- plan ------------------------------------------------------------
+    @property
+    def plan(self) -> Optional[IngestPlan]:
+        return self._plan
+
+    def set_plan(self, plan: IngestPlan):
+        """Install/replace the prep plan (attribute publish is atomic;
+        batches mid-prep finish under whichever plan they started —
+        the consumer handles both planned and unplanned batches)."""
+        if plan.staging:
+            self._ring = StagingRing(plan, self._ring_depth)
+        else:
+            self._ring = None
+        self._plan = plan
+
+    def _finish(self, pb: PreppedBatch) -> PreppedBatch:
+        """Apply the plan to a freshly prepped batch: time-domain ticks,
+        pane-span eligibility, route choice, optional device staging.
+        Ineligible batches (catch-up spans, host-chain expansion beyond
+        B, foreign value dtype) pass through unplanned and take the
+        executor's general path."""
+        plan = self._plan
+        if plan is None or pb.n == 0:
+            return pb
+        pb.ts_max = int(pb.ts_ms.max())
+        ticks = plan.td.to_ticks(pb.ts_ms)
+        t_min, t_max = int(ticks.min()), int(ticks.max())
+        values = pb.values
+        eligible = (
+            pb.n <= plan.B
+            and (t_max // plan.slide_ticks) - (t_min // plan.slide_ticks)
+            < plan.span_limit
+            and isinstance(values, np.ndarray)
+            and values.dtype == plan.value_dtype
+            and values.shape[1:] == tuple(plan.value_shape)
+        )
+        if not eligible:
+            return pb
+        pb.ticks_min, pb.ticks_max = t_min, t_max
+        t_r0 = time.perf_counter()
+        pb.route = plan_route(plan, pb.hi, pb.lo)
+        tracer = self.tracer
+        if tracer is not None and tracer.active:
+            tracer.rec("route", t_r0, route=pb.route, planned=True)
+        if self._ring is not None:
+            pb.staged = self._ring.stage(
+                plan, pb.hi, pb.lo, ticks, values, pb.n, pb.route,
+                tracer=tracer,
+            )
+            # the ring slot owns the padded copies; drop the host arrays
+            # so nothing can alias a recycled slot
+            pb.hi = pb.lo = pb.values = None
+            pb.ticks = None
+        else:
+            pb.ticks = ticks
+        return pb
+
+    # -- producer --------------------------------------------------------
+    def _producer(self):
+        while not self._stop.is_set():
+            if not self._gate.is_set():
+                self._parked.set()
+                self._gate.wait(0.1)
+                continue
+            self._parked.clear()
+            epoch = self._epoch
+            park_after = False
+            try:
+                with self.source_lock:
+                    pb = self.prep_fn()
+                pb.epoch = epoch
+                self._finish(pb)
+                item = ("ok", epoch, pb)
+                park_after = pb.end
+            except BaseException as e:   # deliver to the consumer
+                item = ("err", epoch, e)
+                park_after = True
+            if park_after:
+                # park BEFORE publishing: the consumer may pause+resume
+                # (restore) the instant it sees the item, and resume
+                # must find the producer already off the source
+                self._gate.clear()
+            self._put(item)
+        self._parked.set()
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            if self._pause_req.is_set():
+                # consumer is pausing: the epoch is being invalidated and
+                # the consumer would skip this item anyway — drop rather
+                # than deadlock on a full queue while pause() waits
+                return
+            try:
+                self._q.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            t = threading.Thread(
+                target=self._producer, daemon=True,
+                name="flink-tpu-ingest",
+            )
+            self._thread = t
+            t.start()
+
+    # -- consumer --------------------------------------------------------
+    def next(self) -> PreppedBatch:
+        if not self.prefetch:
+            with self.source_lock:
+                pb = self.prep_fn()
+            pb.epoch = self._epoch
+            return self._finish(pb)
+        self._ensure_thread()
+        while True:
+            try:
+                kind, epoch, item = self._q.get(timeout=1.0)
+            except queue.Empty:
+                if not self._thread.is_alive() and self._q.empty():
+                    raise RuntimeError(
+                        "ingest prefetch thread died without delivering "
+                        "a batch or an error"
+                    )
+                continue
+            if epoch != self._epoch:
+                continue     # pre-restore batch: dropped, source rewound
+            if kind == "err":
+                raise item
+            return item
+
+    def mark_applied(self, pb: PreppedBatch):
+        """Record pb's offsets as the applied cut — everything up to and
+        including this batch has been dispatched to device state, so a
+        snapshot taken from here restores without skipping or
+        double-applying records."""
+        self._applied = pb.offsets
+
+    def applied_offsets(self):
+        return self._applied
+
+    # -- restore protocol ------------------------------------------------
+    def pause(self):
+        """Park the producer; returns only when it is off the source (or
+        was never started / prefetch is off)."""
+        self._pause_req.set()
+        self._gate.clear()
+        if not self.prefetch or self._thread is None:
+            return
+        while self._thread.is_alive() and not self._parked.is_set():
+            self._parked.wait(0.1)
+
+    def resume(self, applied_offsets: Any):
+        """Invalidate every batch prepped before the pause and restart
+        production from the (rewound) source position. ``applied_offsets``
+        re-arms the cut — it IS the restored snapshot's offsets."""
+        self._epoch += 1
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._applied = applied_offsets
+        self._pause_req.clear()
+        self._gate.set()
+
+    def close(self):
+        self._stop.set()
+        self._gate.set()

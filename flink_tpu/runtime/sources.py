@@ -38,6 +38,20 @@ class Source:
     def poll(self, max_records: int):
         raise NotImplementedError
 
+    def poll_with_offsets(self, max_records: int):
+        """Poll one batch AND capture the post-poll offsets in one call:
+        ``(polled, end, offsets)``. This is the unit a prefetched batch
+        carries (runtime/ingest.py) — the offsets name the exact replay
+        point *after* this batch, so a checkpoint that snapshots the
+        offsets of the last applied batch restores without skipping or
+        double-applying records, no matter how far the prefetch thread
+        has polled ahead. The default composition is atomic for every
+        source polled from a single thread (the ingest pipeline
+        guarantees one producer); sources whose offsets can move outside
+        ``poll()`` should override to make the pair atomic."""
+        polled, end = self.poll(max_records)
+        return polled, end, self.snapshot_offsets()
+
     # -- checkpointing --------------------------------------------------
     def snapshot_offsets(self):
         return None
@@ -218,24 +232,31 @@ class SocketTextStreamSource(Source):
             self._sock.close()
 
     def poll(self, max_records: int):
-        if self._eof:
+        if self._eof and not self._buf:
             return [], True
-        try:
-            while True:
-                data = self._sock.recv(1 << 16)
-                if not data:
-                    self._eof = True
-                    break
-                self._buf += data
-                if self._buf.count(b"\n") >= max_records:
-                    break
-        except (BlockingIOError, socket.timeout):
-            pass
+        if not self._eof:
+            try:
+                while True:
+                    data = self._sock.recv(1 << 16)
+                    if not data:
+                        self._eof = True
+                        break
+                    self._buf += data
+                    if self._buf.count(b"\n") >= max_records:
+                        break
+            except (BlockingIOError, socket.timeout):
+                pass
         lines = []
         while len(lines) < max_records and b"\n" in self._buf:
             line, self._buf = self._buf.split(b"\n", 1)
             lines.append(line.decode("utf-8", errors="replace"))
-        if self._eof and self._buf:
+        # EOF flush covers ONLY a trailing unterminated line — a buffer
+        # still holding newline-terminated lines (EOF arrived while more
+        # than max_records lines were buffered) keeps draining on
+        # subsequent polls, one line per record, instead of being
+        # emitted as one mega-"line"
+        if self._eof and self._buf and b"\n" not in self._buf \
+                and len(lines) < max_records:
             lines.append(self._buf.decode("utf-8", errors="replace"))
             self._buf = b""
         return lines, self._eof and not self._buf

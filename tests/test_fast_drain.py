@@ -84,7 +84,11 @@ def test_pipeline_knobs_preserve_results(cfg):
     assert sink.value_sum == sum(exp.values())
 
 
-def test_prefetch_on_with_checkpointing_rejected(tmp_path):
+def test_prefetch_on_with_checkpointing_preserves_results(tmp_path):
+    """pipeline.prefetch=on + checkpointing no longer raises (ISSUE 3):
+    the epoch-tagged ingest pipeline snapshots the APPLIED-offset cut,
+    so running ahead of the source is checkpoint-compatible. Results
+    must stay exact with checkpoints being written throughout."""
     env = StreamExecutionEnvironment(
         Configuration({"pipeline.prefetch": "on"})
     )
@@ -94,12 +98,17 @@ def test_prefetch_on_with_checkpointing_rejected(tmp_path):
     env.set_state_capacity(N_KEYS)
     env.batch_size = B
     env.enable_checkpointing(interval_steps=5, directory=str(tmp_path))
+    sink = CountingSink()
     (
         env.add_source(GeneratorSource(_gen, total=TOTAL))
         .key_by(lambda c: c["key"])
         .time_window(WIN)
         .sum(lambda c: c["value"])
-        .add_sink(CountingSink())
+        .add_sink(sink)
     )
-    with pytest.raises(ValueError, match="prefetch"):
-        env.execute("prefetch-vs-ckpt")
+    env.execute("prefetch-with-ckpt")
+    exp = _expected_windows()
+    assert sink.count == len(exp)
+    assert sink.value_sum == sum(exp.values())
+    # checkpoints actually happened while prefetching ran ahead
+    assert (env.last_job.metrics.checkpoint_stats or [])

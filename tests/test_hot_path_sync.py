@@ -28,8 +28,22 @@ def test_repo_hot_paths_are_clean():
 def test_checker_scans_the_real_hot_paths():
     rels = {rel.replace(os.sep, "/") for _p, rel in hot_path_files(ROOT)}
     assert "flink_tpu/runtime/step.py" in rels
+    assert "flink_tpu/runtime/ingest.py" in rels
     assert "flink_tpu/ops/window_kernels.py" in rels
     assert len(rels) > 5
+
+
+def test_ingest_staging_path_has_no_unmarked_sync():
+    """The staging ring's transfer-completion wait is the ONLY allowed
+    block in runtime/ingest.py, and it must carry the inline marker —
+    stripping the marker must make the checker flag it."""
+    path = os.path.join(ROOT, "flink_tpu", "runtime", "ingest.py")
+    with open(path) as f:
+        src = f.read()
+    assert check_source(src, "flink_tpu/runtime/ingest.py") == []
+    stripped = src.replace("# host-sync-ok:", "# stripped:")
+    vs = check_source(stripped, "flink_tpu/runtime/ingest.py")
+    assert len(vs) == 1 and vs[0].what == ".block_until_ready()"
 
 
 def test_checker_flags_sync_constructs():

@@ -9,10 +9,15 @@ fixed ~70ms tunnel round trip per call on the TPU runtime (ISSUE 2;
 tools/microbench_d2h.py measured it). This checker fails the build when
 one of those host-sync constructs appears in the hot-path modules:
 
-    flink_tpu/ops/**.py        (device kernels)
-    flink_tpu/runtime/step.py  (compiled step builders)
+    flink_tpu/ops/**.py          (device kernels)
+    flink_tpu/runtime/step.py    (compiled step builders)
+    flink_tpu/runtime/ingest.py  (pipelined ingest / device staging)
 
-outside an allowlisted barrier section. Allowlisting, in order of
+outside an allowlisted barrier section. The ingest module's one
+legitimate wait — the staging ring's transfer-completion block, which
+runs on the ingest thread and exists precisely so the STEP LOOP never
+waits — carries an inline marker; anything else that blocks there would
+silently serialize the overlap the module exists to provide. Allowlisting, in order of
 preference:
 
   1. Naming convention — functions whose name contains ``host`` or ends
@@ -44,7 +49,11 @@ import sys
 from typing import List, NamedTuple, Tuple
 
 # hot-path locations, relative to the repo root
-HOT_PATHS = ("flink_tpu/ops", "flink_tpu/runtime/step.py")
+HOT_PATHS = (
+    "flink_tpu/ops",
+    "flink_tpu/runtime/step.py",
+    "flink_tpu/runtime/ingest.py",
+)
 
 # documented host-facing seams that live in hot-path modules but are
 # never called from inside the step loop
