@@ -604,6 +604,81 @@ def run_observability_overhead(total_events: int, cpu: bool):
     return detail["sampled"]["eps"], detail["off"]["eps"]
 
 
+# ------------------------------------------------- containment overhead
+def run_fault_overhead(total_events: int, cpu: bool):
+    """Failure-containment overhead config (ISSUE 4): the PR 3
+    production path (prefetch + async-incremental checkpointing) run
+    with the watchdog OFF vs ON — fault injection disabled in both, the
+    failure budget active in both (its bookkeeping is always-on). The
+    delta is the per-cycle phase arming plus the monitor thread, which
+    is the entire cost a healthy job pays for hang attribution.
+
+    subject = watchdog-on eps, baseline = watchdog-off eps; the
+    acceptance criterion is ratio >= 0.98 (<2% containment tax on the
+    PR 3 throughput path).
+    """
+    import shutil
+    import tempfile
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    n_keys = 1 << 20
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            "key": (idx * 2654435761) % n_keys,
+            "value": np.ones(n, np.float32),
+        }
+        return cols, (idx // 32768) * 1000
+
+    def run(mode):
+        cfg = Configuration()
+        cfg.set("pipeline.prefetch", "on")
+        cfg.set("keys.reverse-map", False)
+        cfg.set("checkpoint.mode", "incremental")
+        cfg.set("checkpoint.async", True)
+        cfg.set("checkpoint.tolerable-failures", 3)
+        cfg.set("watchdog.enabled", mode == "watchdog_on")
+        ckpt_dir = tempfile.mkdtemp(prefix="faultbench-")
+        env = StreamExecutionEnvironment(cfg)
+        env.set_parallelism(1)
+        env.set_max_parallelism(128)
+        env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+        env.set_state_capacity(1 << 21)
+        env.batch_size = 131072
+        env.enable_checkpointing(8, ckpt_dir)
+        sink = CountingSink()
+        t0 = time.perf_counter()
+        (
+            env.add_source(GeneratorSource(gen, total=total_events))
+            .key_by(lambda c: c["key"])
+            .time_window(10_000)
+            .sum(lambda c: c["value"])
+            .add_sink(sink)
+        )
+        env.execute(f"fault-bench-{mode}")
+        dt = time.perf_counter() - t0
+        m = env.last_job.metrics
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        assert sink.count > 0
+        assert m.checkpoints_aborted == 0    # no faults were injected
+        return {
+            "eps": round(total_events / dt),
+            "checkpoints": len(m.checkpoint_stats or []),
+            "watchdog_trips": m.watchdog_trips,
+        }
+
+    detail = {m: run(m) for m in ("watchdog_off", "watchdog_on")}
+    print(json.dumps(
+        {"config": "fault_overhead", "detail": detail}), flush=True)
+    return (detail["watchdog_on"]["eps"], detail["watchdog_off"]["eps"])
+
+
 CONFIGS = {
     "socket_wc": (run_socket_wc, 2_000_000),
     "count_min": (run_count_min, 4_000_000),
@@ -613,6 +688,7 @@ CONFIGS = {
     "checkpoint_overhead": (run_checkpoint_overhead, 2_000_000),
     "observability_overhead": (run_observability_overhead, 2_000_000),
     "ingest_pipeline": (run_ingest_pipeline, 4_000_000),
+    "fault_overhead": (run_fault_overhead, 4_000_000),
 }
 
 

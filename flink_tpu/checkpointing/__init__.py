@@ -24,6 +24,11 @@ This package is the micro-batch SPMD redesign of both:
   group, purge-cutoff filtered) back into one logical snapshot, so
   restore — including rescale re-bucketing — reuses the existing
   ``restore_window_state`` path unchanged.
+* ``policy``      — the coordinator-side failure budget (ref
+  CheckpointFailureManager): ``checkpoint.tolerable-failures`` /
+  ``checkpoint.timeout`` / ``checkpoint.min-pause``, so a transient
+  write failure aborts ONE checkpoint instead of restarting the job
+  (docs/fault-tolerance.md).
 
 The source cut a snapshot carries is the **applied-offset cut**
 (runtime/ingest.py): with the pipelined ingest path, the prefetch
@@ -49,5 +54,10 @@ from flink_tpu.checkpointing.manifest import (  # noqa: F401
 from flink_tpu.checkpointing.materializer import (  # noqa: F401
     Materializer,
     MaterializerError,
+)
+from flink_tpu.checkpointing.policy import (  # noqa: F401
+    CheckpointFailureBudgetExceeded,
+    CheckpointFailurePolicy,
+    policy_from_config,
 )
 from flink_tpu.checkpointing.recovery import replay_chain  # noqa: F401

@@ -29,6 +29,8 @@ import json
 import os
 from typing import Callable, Iterable, List, Optional, Sequence, Union
 
+from flink_tpu.testing import faults
+
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
 
@@ -60,8 +62,22 @@ def build_manifest(cid: int, kind: str, chain: Sequence[int],
 
 def write_manifest(directory: str, manifest: dict) -> str:
     path = os.path.join(directory, MANIFEST_NAME)
+    body = json.dumps(manifest)
+    torn = None
+    try:
+        faults.inject("ckpt.manifest.write", path=path)
+    except faults.TornWrite as tw:
+        # a torn write leaves PARTIAL bytes on disk before failing —
+        # the checkpoint directory is only ever published (renamed from
+        # .tmp) after this returns, so the tear must surface as a write
+        # failure the checkpoint policy aborts, never as a half-manifest
+        # in a published directory
+        body = body[: max(1, len(body) // 2)]
+        torn = tw
     with open(path, "w") as f:
-        json.dump(manifest, f)
+        f.write(body)
+    if torn is not None:
+        raise OSError(f"torn manifest write: {path}") from torn
     return path
 
 

@@ -33,9 +33,18 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from flink_tpu.testing import faults
+
 
 class MaterializerError(RuntimeError):
     """An async checkpoint write failed (original exception chained)."""
+
+
+class MaterializerStall(MaterializerError):
+    """A bounded staging-slot wait expired: the in-flight
+    materialization is not finishing. Surfaced on the CALLER's thread so
+    the checkpoint policy can abort-and-count instead of the step loop
+    blocking behind a wedged write forever."""
 
 
 class Materializer:
@@ -79,15 +88,26 @@ class Materializer:
                 f"async checkpoint {label!r} failed: {err}"
             ) from err
 
-    def wait_for_slot(self) -> float:
+    def wait_for_slot(self, timeout: Optional[float] = None) -> float:
         """Block until a staging slot is free (or the materializer fails);
         returns the seconds waited. Callers with a single submitting
         thread use this to attribute the backpressure wait to the sync
-        phase BEFORE building the task."""
+        phase BEFORE building the task. ``timeout`` bounds the wait and
+        raises :class:`MaterializerStall` on expiry (the failure-
+        containment path: a wedged write becomes an abortable checkpoint
+        failure, not an unbounded step-loop stall)."""
         t0 = time.perf_counter()
         with self._cv:
             while (len(self._q) + (1 if self._busy else 0)) >= self.slots \
                     and self._error is None and not self._closed:
+                waited = time.perf_counter() - t0
+                if timeout is not None and waited >= timeout:
+                    raise MaterializerStall(
+                        f"no staging slot freed in {waited:.1f}s "
+                        f"(timeout {timeout:.1f}s, {len(self._q)} queued"
+                        f"{', one executing' if self._busy else ''}) — "
+                        f"the in-flight checkpoint write appears wedged"
+                    )
                 self._cv.wait(0.1)
         return time.perf_counter() - t0
 
@@ -105,30 +125,47 @@ class Materializer:
             self._cv.notify_all()
         self.check()
 
-    def recover(self) -> None:
+    def recover(self, timeout: Optional[float] = None) -> None:
         """Restore-time drain: let in-flight writes land (each is a valid
         cut the restore may pick up), then drop queued tasks and any
-        stored failure — restoring IS the recovery from it."""
-        self.flush(raise_errors=False)
+        stored failure — restoring IS the recovery from it. ``timeout``
+        bounds the drain: a WEDGED write must not turn recovery into the
+        indefinite hang it is recovering from — the abandoned task keeps
+        running on the daemon thread, and whatever it eventually
+        publishes (or fails) is a pre-restore cut the caller has already
+        accounted for."""
+        self.flush(raise_errors=False, timeout=timeout)
         with self._cv:
             self._q.clear()
             self._error = None
             self._error_label = None
             self._cv.notify_all()
 
-    def flush(self, raise_errors: bool = True) -> None:
-        """Wait until every queued task has completed (or the materializer
-        failed). With raise_errors, surface the stored failure."""
+    def flush(self, raise_errors: bool = True,
+              timeout: Optional[float] = None) -> bool:
+        """Wait until every queued task has completed (or the
+        materializer failed, or ``timeout`` seconds elapsed). With
+        raise_errors, surface the stored failure. Returns False when the
+        timeout expired with work still in flight."""
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        done = True
         with self._cv:
             while (self._q or self._busy) and self._error is None \
                     and not self._closed:
+                if deadline is not None and time.monotonic() >= deadline:
+                    done = False
+                    break
                 self._cv.wait(0.1)
         if raise_errors:
             self.check()
+        return done
 
-    def close(self, flush: bool = True) -> None:
+    def close(self, flush: bool = True,
+              timeout: Optional[float] = None) -> None:
         if flush:
-            self.flush(raise_errors=False)
+            self.flush(raise_errors=False, timeout=timeout)
         with self._cv:
             self._closed = True
             self._q.clear()
@@ -151,6 +188,10 @@ class Materializer:
                 label, task = self._q.popleft()
                 self._busy = True
             try:
+                # fault seam: slow-I/O (sleep) and write-error injection
+                # land here, on the materializer thread, exactly where a
+                # slow/flaky filesystem would surface
+                faults.inject("materializer.task", label=label)
                 task()
             except BaseException as e:  # noqa: BLE001 — delivered via check()
                 with self._cv:

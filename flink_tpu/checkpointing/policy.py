@@ -1,0 +1,126 @@
+"""Checkpoint failure containment (ref CheckpointFailureManager +
+CheckpointCoordinator's tolerable-failure / timeout / min-pause knobs).
+
+A production checkpoint failure is usually TRANSIENT — a filesystem
+blip, a slow object store, one wedged materialization — and the
+reference contains it: the checkpoint is *aborted and counted*, the job
+keeps running, and only exhausting ``tolerable-checkpoint-failure-
+number`` escalates to the restart strategy. This module is the
+coordinator-side budget for the micro-batch design:
+
+* ``tolerable_failures`` — CONSECUTIVE aborted checkpoints allowed
+  before escalation (a completed checkpoint resets the count). The
+  default 0 preserves the historical behavior: the first failure
+  escalates.
+* ``timeout_s`` — an async checkpoint still unpublished this long after
+  its barrier is declared failed (the executor cancels its publish).
+* ``min_pause_s`` — minimum pause between the END of one checkpoint
+  attempt and the next trigger, so a struggling backend is not hammered
+  with back-to-back snapshots.
+
+The policy is bookkeeping only: the executor owns the abort mechanics
+(tmp-dir discard, manifest-chain reset so a delta never chains over the
+hole, publish cancellation). Thread-safe — completions land on the
+materializer thread while triggers run on the step loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+
+class CheckpointFailureBudgetExceeded(RuntimeError):
+    """Consecutive checkpoint failures exceeded
+    ``checkpoint.tolerable-failures``; escalate to the restart
+    strategy."""
+
+
+class CheckpointFailurePolicy:
+    def __init__(self, tolerable_failures: int = 0,
+                 timeout_s: float = 600.0, min_pause_s: float = 0.0):
+        self.tolerable_failures = max(0, int(tolerable_failures))
+        self.timeout_s = float(timeout_s)
+        self.min_pause_s = max(0.0, float(min_pause_s))
+        self._lock = threading.Lock()
+        self._continuous_failures = 0
+        self._total_failures = 0
+        self._completed = 0
+        self._last_attempt_end: Optional[float] = None   # monotonic
+        self._aborts: List[dict] = []                    # bounded log
+
+    # -- trigger gate ---------------------------------------------------
+    def can_trigger(self, now: Optional[float] = None) -> bool:
+        """min-pause gate: measured from the end of the last attempt
+        (completed or aborted) to the next trigger, like the
+        reference's minPauseBetweenCheckpoints."""
+        if self.min_pause_s <= 0:
+            return True
+        with self._lock:
+            last = self._last_attempt_end
+        if last is None:
+            return True
+        return (now or time.monotonic()) - last >= self.min_pause_s
+
+    # -- outcomes -------------------------------------------------------
+    def on_completed(self, cid: int) -> None:
+        with self._lock:
+            self._continuous_failures = 0
+            self._completed += 1
+            self._last_attempt_end = time.monotonic()
+
+    def on_aborted(self, cid: int, reason: str) -> bool:
+        """Count one aborted checkpoint; returns True when the budget is
+        now exhausted (caller escalates)."""
+        with self._lock:
+            self._continuous_failures += 1
+            self._total_failures += 1
+            self._last_attempt_end = time.monotonic()
+            self._aborts.append({"id": int(cid), "reason": str(reason)})
+            del self._aborts[:-20]
+            return self._continuous_failures > self.tolerable_failures
+
+    def exhausted_error(self, cid: int,
+                        cause: Optional[BaseException] = None
+                        ) -> CheckpointFailureBudgetExceeded:
+        with self._lock:
+            k = self._continuous_failures
+        err = CheckpointFailureBudgetExceeded(
+            f"checkpoint {cid} failed and {k} consecutive checkpoint "
+            f"failure(s) exceed checkpoint.tolerable-failures="
+            f"{self.tolerable_failures}"
+            + (f": {cause}" if cause is not None else "")
+        )
+        err.__cause__ = cause
+        return err
+
+    # -- observability --------------------------------------------------
+    def state(self) -> dict:
+        """JSON-able budget snapshot for /jobs/<jid>/checkpoints."""
+        with self._lock:
+            return {
+                "tolerable-failures": self.tolerable_failures,
+                "continuous-failures": self._continuous_failures,
+                "remaining": max(
+                    0, self.tolerable_failures - self._continuous_failures
+                ),
+                "total-failures": self._total_failures,
+                "completed": self._completed,
+                "timeout-s": self.timeout_s,
+                "min-pause-s": self.min_pause_s,
+                "recent-aborts": list(self._aborts),
+            }
+
+
+def policy_from_config(config) -> CheckpointFailurePolicy:
+    """Reads go through the declared ConfigOptions (core/config.py) so
+    conf-file strings coerce strictly and parse failures name the
+    key."""
+    from flink_tpu.core.config import CoreOptions as CO
+
+    return CheckpointFailurePolicy(
+        tolerable_failures=config.get(CO.CHECKPOINT_TOLERABLE_FAILURES),
+        timeout_s=config.get(CO.CHECKPOINT_TIMEOUT),
+        min_pause_s=config.get(CO.CHECKPOINT_MIN_PAUSE),
+    )

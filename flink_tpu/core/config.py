@@ -21,9 +21,55 @@ class ConfigOption(Generic[T]):
     key: str
     default: Optional[T] = None
     description: str = ""
+    # declared value type; inferred from the default when omitted. An
+    # option whose default is None (e.g. checkpoint.dir) can still
+    # declare one, so conf-file strings coerce — and mis-parse loudly —
+    # regardless of whether a default exists.
+    type: Optional[type] = None
 
     def with_default(self, default: T) -> "ConfigOption[T]":
-        return ConfigOption(self.key, default, self.description)
+        return ConfigOption(self.key, default, self.description, self.type)
+
+    def value_type(self) -> Optional[type]:
+        if self.type is not None:
+            return self.type
+        if self.default is not None:
+            return builtins_type(self.default)
+        return None
+
+
+def builtins_type(v) -> type:
+    # bool before int: isinstance(True, int) holds, and a bool option
+    # must parse "false" as False, not int("false")
+    return bool if isinstance(v, bool) else type(v)
+
+
+_TRUE = ("true", "1", "yes", "on")
+_FALSE = ("false", "0", "no", "off")
+
+
+def coerce_value(key: str, v: str, t: type):
+    """Parse a conf-file string as declared type ``t``; failures name
+    the config key (an anonymous ``ValueError: invalid literal`` from
+    deep inside a job setup is undebuggable) and unrecognized boolean
+    strings are REJECTED rather than silently mapped to False."""
+    s = v.strip()
+    if t is bool:
+        low = s.lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ValueError(
+            f"config {key!r}: {v!r} is not a boolean "
+            f"(expected one of {_TRUE + _FALSE})"
+        )
+    try:
+        return t(s)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"config {key!r}: cannot parse {v!r} as {t.__name__}"
+        ) from e
 
 
 class Configuration:
@@ -40,15 +86,16 @@ class Configuration:
     def get(self, option: ConfigOption, default=None):
         if option.key in self._data:
             v = self._data[option.key]
-            ref = option.default if default is None else default
             # conf-file values arrive as STRINGS (the flat-yaml loader
-            # stores text); coerce to the option's declared type so
-            # `parallelism.default: 4` never leaks '4' into arithmetic
-            if isinstance(v, str) and ref is not None \
-                    and not isinstance(ref, str):
-                if isinstance(ref, bool):
-                    return v.strip().lower() in ("true", "1", "yes")
-                return type(ref)(v)
+            # stores text); coerce to the option's DECLARED type — not
+            # the default's presence — so `parallelism.default: 4`
+            # never leaks '4' into arithmetic and a default-None option
+            # still parses (and mis-parses loudly, with the key named)
+            t = option.value_type()
+            if t is None and default is not None:
+                t = builtins_type(default)
+            if isinstance(v, str) and t is not None and t is not str:
+                return coerce_value(option.key, v, t)
             return v
         return option.default if default is None else default
 
@@ -112,7 +159,7 @@ class CoreOptions:
     STATE_SLOTS_PER_SHARD = ConfigOption("state.backend.device.slots-per-shard", 1 << 16)
     STATE_PROBE_LENGTH = ConfigOption("state.backend.device.probe-length", 16)
     CHECKPOINT_INTERVAL_STEPS = ConfigOption("checkpoint.interval-steps", 0)
-    CHECKPOINT_DIR = ConfigOption("checkpoint.dir", None)
+    CHECKPOINT_DIR = ConfigOption("checkpoint.dir", None, type=str)
     # snapshot strategy (flink_tpu/checkpointing, ref incremental RocksDB
     # checkpoints + asynchronous snapshots): "full" writes self-contained
     # snapshots, "incremental" writes delta checkpoints covering only the
@@ -160,6 +207,49 @@ class CoreOptions:
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)
+    # -- failure containment (docs/fault-tolerance.md) ------------------
+    # checkpoint failure budget (checkpointing/policy.py, ref
+    # CheckpointFailureManager): a failed/timed-out checkpoint is
+    # aborted + counted; only exhausting the consecutive-failure budget
+    # escalates to the restart strategy
+    CHECKPOINT_TOLERABLE_FAILURES = ConfigOption(
+        "checkpoint.tolerable-failures", 0,
+        "consecutive checkpoint failures tolerated (aborted + counted) "
+        "before escalating to the restart strategy; 0 = the first "
+        "failure escalates (the pre-budget behavior)")
+    CHECKPOINT_TIMEOUT = ConfigOption(
+        "checkpoint.timeout", 600.0,
+        "seconds an async checkpoint may stay unpublished after its "
+        "barrier before it is declared failed (its publish is "
+        "cancelled and the failure counts against the budget)")
+    CHECKPOINT_MIN_PAUSE = ConfigOption(
+        "checkpoint.min-pause", 0.0,
+        "minimum pause in seconds between the end of one checkpoint "
+        "attempt and the next trigger")
+    # step-loop watchdog (runtime/watchdog.py): per-phase deadlines that
+    # convert a distributed hang into a clean, attributed job failure
+    WATCHDOG_ENABLED = ConfigOption(
+        "watchdog.enabled", True,
+        "supervise step-loop phases; a phase overrunning its deadline "
+        "raises an attributed WatchdogError in the step loop")
+    WATCHDOG_INTERVAL = ConfigOption(
+        "watchdog.interval", 1.0, "watchdog check period in seconds")
+    WATCHDOG_SOURCE_TIMEOUT = ConfigOption(
+        "watchdog.source-timeout", 0.0,
+        "deadline (s) on the ingest wait per cycle; 0 disables — a "
+        "legitimate source may idle indefinitely")
+    WATCHDOG_FIRE_TIMEOUT = ConfigOption(
+        "watchdog.fire-timeout", 600.0,
+        "deadline (s) on one fire-step dispatch")
+    WATCHDOG_FETCH_TIMEOUT = ConfigOption(
+        "watchdog.fetch-timeout", 600.0,
+        "deadline (s) on the barrier device fetch")
+    WATCHDOG_CKPT_SYNC_TIMEOUT = ConfigOption(
+        "watchdog.checkpoint-sync-timeout", 600.0,
+        "deadline (s) on a checkpoint's synchronous phase")
+    WATCHDOG_SLOT_TIMEOUT = ConfigOption(
+        "watchdog.slot-timeout", 600.0,
+        "deadline (s) on the materializer staging-slot wait")
     # -- observability (docs/observability.md) --------------------------
     # step-loop span tracing: bounded ring of phase spans exported as
     # Chrome-trace JSON via /jobs/<jid>/traces (metrics/tracing.py)

@@ -18,6 +18,8 @@ import os
 import threading
 from typing import Dict, List, Tuple
 
+from flink_tpu.testing import faults
+
 
 def split_scheme(path: str) -> Tuple[str, str]:
     if "://" in path:
@@ -56,6 +58,10 @@ class FileSystem:
 
 class LocalFileSystem(FileSystem):
     def open(self, path: str, mode: str = "rb", newline=None):
+        if "w" in mode or "a" in mode:
+            # chaos seam: transient filesystem write failures inject at
+            # the SPI boundary every connector/storage write crosses
+            faults.inject("fs.open", path=path, mode=mode)
         if "b" in mode:
             return open(path, mode)
         return open(path, mode, newline=newline)
@@ -119,6 +125,7 @@ class MemoryFileSystem(FileSystem):
         # module's newline="" requirement is inherently satisfied
         text = "b" not in mode
         if "w" in mode or "a" in mode:
+            faults.inject("fs.open", path=path, mode=mode)
             w = MemoryFileSystem._Writer(self, path, text)
             if "a" in mode:
                 with self._lock:

@@ -44,7 +44,7 @@ import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from flink_tpu.runtime.process_cluster import _die_with_parent
 from flink_tpu.runtime.spawner import AbandonableSpawner

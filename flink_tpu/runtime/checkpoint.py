@@ -40,6 +40,7 @@ from flink_tpu.core.keygroups import assign_to_key_group
 from flink_tpu.ops import hashtable
 from flink_tpu.ops import window_kernels as wk
 from flink_tpu.ops.hashing import route_hash
+from flink_tpu.testing import faults
 
 # v2: numeric key identities are raw 64-bit key bits (hashing.
 # key_identity64), not splitmix64 hashes — v1 snapshots' khi/klo would
@@ -375,7 +376,12 @@ class CheckpointStorage:
         async path serializes it on the BARRIER thread (sink/source state
         may keep mutating once the step loop resumes) and hands the
         frozen bytes to the materializer."""
+        faults.inject("ckpt.entries.write", cid=cid)
         tmp = self.path(cid) + ".tmp"
+        # clean slate: a stale staging dir (an aborted attempt under the
+        # same id, possibly from before a restart) could otherwise leak
+        # foreign files — e.g. its manifest.json — into this publish
+        shutil.rmtree(tmp, ignore_errors=True)
         os.makedirs(tmp, exist_ok=True)
         np.savez(os.path.join(tmp, "entries.npz"), **entries)
         if aux_bytes is None:
@@ -404,6 +410,7 @@ class CheckpointStorage:
                 "entries": int(len(entries["key_hi"])),
                 "bytes": int(nbytes),
             })
+        faults.inject("ckpt.publish", cid=cid)
         final = self.path(cid)
         if os.path.exists(final):
             shutil.rmtree(final)
@@ -415,6 +422,13 @@ class CheckpointStorage:
         from flink_tpu.checkpointing import manifest as mf
 
         return mf.read_manifest(self.path(cid))
+
+    def discard_tmp(self, cid: int) -> None:
+        """GC an aborted checkpoint's staging directory. The atomic
+        publish means an abort can only ever leave ``chk-<cid>.tmp``
+        behind — the published directory set stays exactly the set of
+        durable cuts."""
+        shutil.rmtree(self.path(cid) + ".tmp", ignore_errors=True)
 
     def _gc(self, keep_latest: int):
         from flink_tpu.checkpointing import manifest as mf
@@ -429,6 +443,17 @@ class CheckpointStorage:
         for cid in cids:
             if cid not in live:
                 shutil.rmtree(self.path(cid), ignore_errors=True)
+        # stale staging debris: an ABORTED attempt may have left a
+        # chk-<X>.tmp behind (e.g. the failed cid differs from the
+        # barrier cid that counted the abort). _gc runs on the single
+        # thread that executes checkpoint writes — the just-published
+        # tmp was already renamed away — so any remaining .tmp dir is
+        # an orphan by construction.
+        for name in os.listdir(self.dir):
+            if name.startswith("chk-") and name.endswith(".tmp"):
+                p = os.path.join(self.dir, name)
+                if os.path.isdir(p):
+                    shutil.rmtree(p, ignore_errors=True)
 
     def list_checkpoints(self):
         out = []
@@ -488,7 +513,9 @@ class CheckpointStorage:
                       payload_bytes: bytes = None):
         """payload_bytes: pre-pickled payload — the async path serializes
         on the barrier thread and ships frozen bytes (see write())."""
+        faults.inject("ckpt.generic.write", cid=cid)
         tmp = self.path(cid) + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)   # stale-attempt debris
         os.makedirs(tmp, exist_ok=True)
         with open(os.path.join(tmp, "state.pkl"), "wb") as f:
             if payload_bytes is not None:

@@ -54,7 +54,27 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from flink_tpu.testing import faults
+
 MAX_TICKS = 2**31 - 4
+
+
+class DCNPeerError(RuntimeError):
+    """Attributed data-plane peer failure: the message names WHICH peer
+    and WHAT it was doing, so one sick process surfaces as a clean job
+    failure instead of an anonymous ensemble hang (the failure-
+    containment contract, docs/fault-tolerance.md)."""
+
+
+class DCNPeerStalledError(DCNPeerError):
+    """A live peer stopped sending: the bounded recv deadline expired
+    mid-frame. The connect path always had a deadline; this closes the
+    steady-state hole where one stalled host wedged every reader."""
+
+
+class DCNPeerLostError(DCNPeerError):
+    """A peer connection reset and bounded reconnect-with-backoff could
+    not re-establish the ring — the peer is declared dead."""
 
 
 @dataclass
@@ -119,6 +139,14 @@ class DCNJobSpec:
     # forward ingestion does here. shuffle/global use the same
     # rebalance_addrs side channel.
     ingest_partitioner: str = "forward"
+    # failure containment (docs/fault-tolerance.md): a ring peer that
+    # stops sending mid-frame fails ATTRIBUTED after this deadline
+    # (DCNPeerStalledError names the peer) instead of wedging the
+    # ensemble; a transient peer reset gets this many reconnect
+    # attempts (exponential backoff) before DCNPeerLostError.
+    peer_recv_timeout_s: float = 120.0
+    peer_reconnect_attempts: int = 3
+    peer_reconnect_backoff_s: float = 0.25
 
 
 class GeneratorPartitionSource:
@@ -171,59 +199,211 @@ class _RebalanceRing:
     kernel buffers even when every ring link donates at once (sources
     that trickle below max_records can leave every host with both spare
     lanes AND backlog).
+
+    Failure containment (docs/fault-tolerance.md): steady-state reads
+    run in short socket-timeout slices under a ``recv_timeout_s``
+    deadline, so a stalled peer raises an attributed
+    :class:`DCNPeerStalledError` instead of wedging the reader forever.
+    A transient peer RESET triggers a bounded reconnect: both links are
+    closed and re-established (the same deterministic dial-next /
+    accept-prev dance as startup — a neighbor losing one link resyncs
+    its own links too, so the repair cascades around the ring) and the
+    ROUND retries from the top. Retry is lossless even when the abort
+    is ASYMMETRIC (the donor's round completed while the recipient's
+    recv failed): every request frame carries the requester's round
+    counter, and the serve side caches its last (round, donation) — a
+    re-request for an already-served round re-donates the cached
+    records instead of re-polling, so an aborted round's poll is never
+    lost and never double-consumed, and nothing is applied to device
+    state until the round returns. Reconnect exhaustion raises
+    :class:`DCNPeerLostError` naming the peer.
     """
 
-    _REQ = "<I"      # spare lane count
+    _REQ = "<IQ"     # spare lane count, requester round counter
     _HDR = "<IB"     # donated record count, donor-exhausted flag
     DONATE_CAP = 3200             # 3200 * 20 B = 62.5 KiB per frame
     _SOCKBUF = 1 << 18            # 256 KiB send/recv buffers
+    _SLICE_S = 2.0                # per-I/O socket-timeout slice
 
-    def __init__(self, pid: int, nproc: int, addrs):
+    def __init__(self, pid: int, nproc: int, addrs,
+                 recv_timeout_s: float = 120.0,
+                 reconnect_attempts: int = 3,
+                 reconnect_backoff_s: float = 0.25):
         import socket
         import struct
 
         self.struct = struct
+        self.socket = socket
         self.pid = pid
         self.nproc = nproc
+        self.recv_timeout_s = float(recv_timeout_s)
+        self.reconnect_attempts = max(0, int(reconnect_attempts))
+        self.reconnect_backoff_s = float(reconnect_backoff_s)
         if not addrs or len(addrs) != nproc:
             raise ValueError(
                 "rebalance requires rebalance_addrs with one host:port "
                 "per process"
             )
+        self.addrs = list(addrs)
+        # asymmetric-retry protection (see class docstring): my round
+        # counter stamps every request; the serve side remembers the
+        # last round it donated for so a RE-request re-donates
+        self._round = 0
+        self._served_round = None
+        self._served_cache = None
         host, port = addrs[pid].rsplit(":", 1)
-        srv = socket.create_server((host, int(port)))
-        srv.settimeout(120)
-        # connect to next; accept from prev (with nproc == 2 both links
-        # connect the same pair, one in each role)
-        nhost, nport = addrs[(pid + 1) % nproc].rsplit(":", 1)
-        deadline = time.time() + 120
+        # the listen socket stays open for the ring's lifetime: a reset
+        # link re-ACCEPTS through it (reconnect support), exactly like
+        # the initial handshake
+        self._srv = socket.create_server((host, int(port)))
+        self.next_sock = None
+        self.prev_sock = None
+        self._dial_next(120.0)
+        self._accept_prev(120.0)
+
+    # -- link plumbing --------------------------------------------------
+    def _peer(self, which: str) -> int:
+        return (self.pid + (1 if which == "next" else -1)) % self.nproc
+
+    def _sock_opts(self, s):
+        # short slices so the recv loop can enforce the overall deadline
+        # (and deliver async cancellation) without OS-level blocking
+        s.settimeout(min(self._SLICE_S, max(0.05, self.recv_timeout_s)))
+        s.setsockopt(self.socket.SOL_SOCKET, self.socket.SO_SNDBUF,
+                     self._SOCKBUF)
+        s.setsockopt(self.socket.SOL_SOCKET, self.socket.SO_RCVBUF,
+                     self._SOCKBUF)
+
+    def _dial_next(self, window_s: float):
+        nhost, nport = self.addrs[self._peer("next")].rsplit(":", 1)
+        deadline = time.monotonic() + window_s
         self.next_sock = None
         while self.next_sock is None:
             try:
-                self.next_sock = socket.create_connection(
+                self.next_sock = self.socket.create_connection(
                     (nhost, int(nport)), timeout=5
                 )
             except OSError:
-                if time.time() > deadline:
-                    raise
+                if time.monotonic() > deadline:
+                    raise DCNPeerLostError(
+                        f"process {self.pid}: peer {self._peer('next')} "
+                        f"({nhost}:{nport}) unreachable for "
+                        f"{window_s:.0f}s"
+                    )
                 time.sleep(0.1)
-        self.prev_sock, _ = srv.accept()
-        srv.close()
-        for s in (self.next_sock, self.prev_sock):
-            s.settimeout(120)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
-                         self._SOCKBUF)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF,
-                         self._SOCKBUF)
+        self._sock_opts(self.next_sock)
 
-    def _recv_exact(self, sock, n: int) -> bytes:
+    def _accept_prev(self, window_s: float):
+        self._srv.settimeout(window_s)
+        try:
+            self.prev_sock, _ = self._srv.accept()
+        except self.socket.timeout:
+            raise DCNPeerLostError(
+                f"process {self.pid}: peer {self._peer('prev')} did not "
+                f"redial within {window_s:.0f}s"
+            ) from None
+        self._sock_opts(self.prev_sock)
+
+    def _resync(self):
+        """Close and re-establish BOTH links. A neighbor that lost only
+        one link observes OUR close on the other and resyncs too, so the
+        repair cascades around the ring; fresh sockets also discard any
+        half-frame bytes of the aborted round."""
+        for s in (self.next_sock, self.prev_sock):
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._dial_next(30.0)
+        self._accept_prev(30.0)
+
+    def _run_round(self, fn, attempts: Optional[int] = None):
+        """Run one ring round; on a transient connection failure, resync
+        links (bounded, backed off) and retry the whole round. Lossless
+        by construction: the serve side re-donates its cached records on
+        a round re-request (see exchange) and callers apply nothing
+        until the round returns. Stall deadlines do NOT retry — a
+        stalled-but-connected peer is attributed, not waited out
+        twice."""
+        attempts = self.reconnect_attempts if attempts is None else attempts
+        for attempt in range(attempts + 1):
+            try:
+                return fn()
+            except DCNPeerError:
+                raise
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, self.socket.timeout):
+                    raise      # sends/recvs convert slices to deadlines
+                if attempt >= attempts:
+                    raise DCNPeerLostError(
+                        f"process {self.pid}: ring peer lost and "
+                        f"{attempts} reconnect attempt(s) failed: {e}"
+                    ) from e
+                time.sleep(self.reconnect_backoff_s * (2 ** attempt))
+                self._resync()
+        raise AssertionError("unreachable")
+
+    def _send_all(self, sock, data: bytes, peer: str = "peer") -> None:
+        """sendall in socket-timeout slices under the SAME deadline the
+        reads get: a peer that merely pauses (checkpoint sync, GC) while
+        our frame overruns the kernel buffers is waited out up to
+        ``recv_timeout_s``, then attributed — never killed on one
+        2-second slice."""
+        deadline = time.monotonic() + self.recv_timeout_s
+        view = memoryview(data)
+        sent = 0
+        while sent < len(view):
+            try:
+                sent += sock.send(view[sent:])
+            except self.socket.timeout:
+                if time.monotonic() >= deadline:
+                    raise DCNPeerStalledError(
+                        f"process {self.pid}: peer {peer} stalled — "
+                        f"send stuck at {sent}/{len(view)} frame bytes "
+                        f"after {self.recv_timeout_s:.1f}s"
+                    ) from None
+                continue
+
+    def _recv_exact(self, sock, n: int, peer: str = "peer") -> bytes:
+        # ONE injection hit per FRAME read (outside the slice loop):
+        # occurrence-indexed rules stay deterministic regardless of how
+        # many empty timeout slices the scheduler happens to produce
+        faults.inject("dcn.recv", pid=self.pid, peer=peer, sock=sock)
         buf = b""
+        deadline = time.monotonic() + self.recv_timeout_s
         while len(buf) < n:
-            chunk = sock.recv(n - len(buf))
+            try:
+                chunk = sock.recv(n - len(buf))
+            except self.socket.timeout:
+                if time.monotonic() >= deadline:
+                    raise DCNPeerStalledError(
+                        f"process {self.pid}: peer {peer} stalled — "
+                        f"{len(buf)}/{n} frame bytes after "
+                        f"{self.recv_timeout_s:.1f}s"
+                    ) from None
+                continue
             if not chunk:
-                raise ConnectionError("rebalance peer closed")
+                raise ConnectionResetError(
+                    f"rebalance peer {peer} closed the link"
+                )
             buf += chunk
         return buf
+
+    def _serve_donation(self, want: int, req_round: int, poll_extra):
+        """Serve one request, re-donating from the cache when the peer
+        RE-requests a round we already served (its recv of our donation
+        failed): the polled records went into a dead socket, not into
+        the peer — re-donating them is what makes asymmetric-abort
+        retry lossless; a NEW round always polls fresh."""
+        if req_round == self._served_round and self._served_cache is not None:
+            return self._served_cache
+        donation = poll_extra(want) if want else (
+            np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.float32), False,
+        )
+        self._served_round = req_round
+        self._served_cache = donation
+        return donation
 
     def exchange(self, spare: int, poll_extra):
         """One rebalance round. ``poll_extra(n)`` polls up to n records
@@ -231,37 +411,57 @@ class _RebalanceRing:
         exhausted). Returns (keys, ts_ms, vals, donor_done) received into
         this host's spare lanes."""
         st = self.struct
-        self.next_sock.sendall(st.pack(self._REQ, int(spare)))
-        # serve the prev neighbor
-        (want,) = st.unpack(
-            self._REQ, self._recv_exact(self.prev_sock,
-                                        st.calcsize(self._REQ))
-        )
-        want = min(int(want), self.DONATE_CAP)
-        keys, ts, vals, done = poll_extra(want) if want else (
-            np.zeros(0, np.int64), np.zeros(0, np.int64),
-            np.zeros(0, np.float32), False,
-        )
-        n = len(keys)
-        self.prev_sock.sendall(
-            st.pack(self._HDR, n, 1 if done else 0)
-            + np.asarray(keys, np.int64).tobytes()
-            + np.asarray(ts, np.int64).tobytes()
-            + np.asarray(vals, np.float32).tobytes()
-        )
-        # collect my donation
-        hdr = self._recv_exact(self.next_sock, st.calcsize(self._HDR))
-        m, ddone = st.unpack(self._HDR, hdr)
-        payload = self._recv_exact(self.next_sock, m * (8 + 8 + 4))
-        rk = np.frombuffer(payload[: 8 * m], np.int64)
-        rt = np.frombuffer(payload[8 * m: 16 * m], np.int64)
-        rv = np.frombuffer(payload[16 * m:], np.float32)
-        return rk, rt, rv, bool(ddone)
+
+        def round_once():
+            faults.inject("dcn.send", pid=self.pid, link="next",
+                          sock=self.next_sock)
+            self._send_all(
+                self.next_sock, st.pack(self._REQ, int(spare), self._round),
+                peer=f"next/{self._peer('next')}",
+            )
+            # serve the prev neighbor
+            want, req_round = st.unpack(
+                self._REQ,
+                self._recv_exact(self.prev_sock, st.calcsize(self._REQ),
+                                 peer=f"prev/{self._peer('prev')}"),
+            )
+            want = min(int(want), self.DONATE_CAP)
+            keys, ts, vals, done = self._serve_donation(
+                want, req_round, poll_extra
+            )
+            n = len(keys)
+            self._send_all(
+                self.prev_sock,
+                st.pack(self._HDR, n, 1 if done else 0)
+                + np.asarray(keys, np.int64).tobytes()
+                + np.asarray(ts, np.int64).tobytes()
+                + np.asarray(vals, np.float32).tobytes(),
+                peer=f"prev/{self._peer('prev')}",
+            )
+            # collect my donation
+            hdr = self._recv_exact(
+                self.next_sock, st.calcsize(self._HDR),
+                peer=f"next/{self._peer('next')}",
+            )
+            m, ddone = st.unpack(self._HDR, hdr)
+            payload = self._recv_exact(
+                self.next_sock, m * (8 + 8 + 4),
+                peer=f"next/{self._peer('next')}",
+            )
+            rk = np.frombuffer(payload[: 8 * m], np.int64)
+            rt = np.frombuffer(payload[8 * m: 16 * m], np.int64)
+            rv = np.frombuffer(payload[16 * m:], np.float32)
+            return rk, rt, rv, bool(ddone)
+
+        out = self._run_round(round_once)
+        self._round += 1
+        return out
 
     def close(self):
-        for s in (self.next_sock, self.prev_sock):
+        for s in (self.next_sock, self.prev_sock, self._srv):
             try:
-                s.close()
+                if s is not None:
+                    s.close()
             except OSError:
                 pass
 
@@ -290,51 +490,72 @@ class _TargetRing(_RebalanceRing):
 
     def route(self, keys, ts_ms, vals, targets, exhausted: bool):
         """Returns (keys, ts_ms, vals, all_done) of the records whose
-        destination is this host."""
+        destination is this host. The multi-hop relay is NOT retried on
+        a reset: unlike the pairwise exchange (whose re-donation cache
+        makes retry lossless), a host whose relay round COMPLETED while
+        a neighbor's failed would see the neighbor's re-relayed records
+        as next-round traffic and deliver duplicates — so a targeted-
+        ring reset fails attributed (DCNPeerLostError) and recovery is
+        the job-level restart-from-checkpoint path. Reads and sends
+        still run under the stall deadline."""
         st = self.struct
-        mine_k, mine_t, mine_v = [], [], []
 
-        def split(k, t, v, tgt):
-            here = tgt == self.pid
-            if here.any():
-                mine_k.append(k[here])
-                mine_t.append(t[here])
-                mine_v.append(v[here])
-            away = ~here
-            return k[away], t[away], v[away], tgt[away]
+        def round_once():
+            mine_k, mine_t, mine_v = [], [], []
 
-        pk, pt, pv, ptgt = split(
-            np.asarray(keys, np.int64), np.asarray(ts_ms, np.int64),
-            np.asarray(vals, np.float32), np.asarray(targets, np.uint8),
-        )
-        all_done = bool(exhausted)
-        for _hop in range(self.nproc - 1):
-            n = len(pk)
-            self.prev_sock.sendall(
-                st.pack(self._HDR, n, 1 if all_done else 0)
-                + ptgt.tobytes() + pk.tobytes() + pt.tobytes()
-                + pv.tobytes()
+            def split(k, t, v, tgt):
+                here = tgt == self.pid
+                if here.any():
+                    mine_k.append(k[here])
+                    mine_t.append(t[here])
+                    mine_v.append(v[here])
+                away = ~here
+                return k[away], t[away], v[away], tgt[away]
+
+            pk, pt, pv, ptgt = split(
+                np.asarray(keys, np.int64), np.asarray(ts_ms, np.int64),
+                np.asarray(vals, np.float32), np.asarray(targets, np.uint8),
             )
-            hdr = self._recv_exact(self.next_sock,
-                                   st.calcsize(self._HDR))
-            m, done_flag = st.unpack(self._HDR, hdr)
-            payload = self._recv_exact(self.next_sock, m * (1 + 8 + 8 + 4))
-            rtgt = np.frombuffer(payload[:m], np.uint8)
-            rk = np.frombuffer(payload[m: m + 8 * m], np.int64)
-            rt = np.frombuffer(payload[m + 8 * m: m + 16 * m], np.int64)
-            rv = np.frombuffer(payload[m + 16 * m:], np.float32)
-            all_done = all_done and bool(done_flag)
-            pk, pt, pv, ptgt = split(rk, rt, rv, rtgt)
-        if len(pk):
-            raise RuntimeError(
-                f"{len(pk)} record(s) undeliverable after "
-                f"{self.nproc - 1} ring hops (bad target?)"
-            )
-        if mine_k:
-            return (np.concatenate(mine_k), np.concatenate(mine_t),
-                    np.concatenate(mine_v), all_done)
-        return (np.zeros(0, np.int64), np.zeros(0, np.int64),
-                np.zeros(0, np.float32), all_done)
+            all_done = bool(exhausted)
+            for _hop in range(self.nproc - 1):
+                n = len(pk)
+                faults.inject("dcn.send", pid=self.pid, link="prev",
+                              sock=self.prev_sock)
+                self._send_all(
+                    self.prev_sock,
+                    st.pack(self._HDR, n, 1 if all_done else 0)
+                    + ptgt.tobytes() + pk.tobytes() + pt.tobytes()
+                    + pv.tobytes(),
+                    peer=f"prev/{self._peer('prev')}",
+                )
+                hdr = self._recv_exact(
+                    self.next_sock, st.calcsize(self._HDR),
+                    peer=f"next/{self._peer('next')}",
+                )
+                m, done_flag = st.unpack(self._HDR, hdr)
+                payload = self._recv_exact(
+                    self.next_sock, m * (1 + 8 + 8 + 4),
+                    peer=f"next/{self._peer('next')}",
+                )
+                rtgt = np.frombuffer(payload[:m], np.uint8)
+                rk = np.frombuffer(payload[m: m + 8 * m], np.int64)
+                rt = np.frombuffer(payload[m + 8 * m: m + 16 * m],
+                                   np.int64)
+                rv = np.frombuffer(payload[m + 16 * m:], np.float32)
+                all_done = all_done and bool(done_flag)
+                pk, pt, pv, ptgt = split(rk, rt, rv, rtgt)
+            if len(pk):
+                raise RuntimeError(
+                    f"{len(pk)} record(s) undeliverable after "
+                    f"{self.nproc - 1} ring hops (bad target?)"
+                )
+            if mine_k:
+                return (np.concatenate(mine_k), np.concatenate(mine_t),
+                        np.concatenate(mine_v), all_done)
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.float32), all_done)
+
+        return self._run_round(round_once, attempts=0)
 
 
 class _DCNRunnerBase:
@@ -382,16 +603,21 @@ class _DCNRunnerBase:
         mode = spec.ingest_partitioner
         if spec.rebalance:
             mode = "rebalance"
+        ring_kw = dict(
+            recv_timeout_s=spec.peer_recv_timeout_s,
+            reconnect_attempts=spec.peer_reconnect_attempts,
+            reconnect_backoff_s=spec.peer_reconnect_backoff_s,
+        )
         if mode in ("forward", "rescale") or num_processes == 1:
             self._ring, self._router = None, None
         elif mode == "rebalance":
             self._ring = _RebalanceRing(process_id, num_processes,
-                                        spec.rebalance_addrs)
+                                        spec.rebalance_addrs, **ring_kw)
             self._router = None
         elif mode in ("shuffle", "global"):
             self._ring = None
             self._router = _TargetRing(process_id, num_processes,
-                                       spec.rebalance_addrs)
+                                       spec.rebalance_addrs, **ring_kw)
         else:
             raise ValueError(
                 f"unknown ingest_partitioner {mode!r} (forward | rescale "

@@ -36,7 +36,14 @@ from flink_tpu.ops import window_kernels as wk
 from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.checkpointing import changelog as cklog
 from flink_tpu.checkpointing import manifest as ckmf
-from flink_tpu.checkpointing.materializer import Materializer
+from flink_tpu.checkpointing.materializer import (
+    Materializer,
+    MaterializerError,
+)
+from flink_tpu.checkpointing.policy import (
+    CheckpointFailureBudgetExceeded,
+    policy_from_config,
+)
 from flink_tpu.metrics.tracing import (
     CompileEvents,
     cost_analysis_of,
@@ -58,6 +65,7 @@ from flink_tpu.runtime.step import (
 from flink_tpu.runtime import checkpoint as ckpt
 from flink_tpu.runtime.cluster import JobCancelledException
 from flink_tpu.runtime.union import to_elements
+from flink_tpu.runtime.watchdog import WatchdogError, watchdog_from_config
 from flink_tpu.runtime.watermarks import WatermarkStrategy
 
 WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
@@ -124,9 +132,13 @@ class _GenericCheckpointIO:
     what actually runs. The generic payloads themselves are always full
     snapshots (one small pytree/dict — nothing to delta)."""
 
-    def __init__(self, env, storage, pipe):
+    def __init__(self, env, storage, pipe, policy=None):
         self.storage = storage
         self.pipe = pipe
+        # optional CheckpointFailurePolicy: completions reset its
+        # consecutive-failure count AT PUBLISH TIME (sync inline, async
+        # on the materializer thread — the policy is thread-safe)
+        self.policy = policy
         # serializes source wire interactions against a pipelined-ingest
         # producer (runtime/ingest.py): the windowed runner points this
         # at its pipeline's source_lock — an offset commit may share the
@@ -171,6 +183,8 @@ class _GenericCheckpointIO:
         self.drain()
         if self.materializer is None:
             self.storage.write_generic(cid, payload)
+            if self.policy is not None:
+                self.policy.on_completed(cid)
             with self.source_lock:
                 self.pipe.source.notify_checkpoint_complete(
                     cid, payload["offsets"]
@@ -184,15 +198,26 @@ class _GenericCheckpointIO:
 
         def task():
             self.storage.write_generic(cid, payload_bytes=blob)
+            if self.policy is not None:
+                self.policy.on_completed(cid)
             self._notify_q.append((cid, offsets))
 
         self.materializer.submit(f"chk-{cid}", task)
+
+    def _drain_timeout(self):
+        """Bound on recovery/teardown drains: a WEDGED write must not
+        turn the escalation path into the very hang the containment
+        layer exists to eliminate. checkpoint.timeout when configured;
+        a generous fallback otherwise (0/unset timeout = the operator
+        chose unbounded publishes, but recovery still terminates)."""
+        t = getattr(self.policy, "timeout_s", 0) if self.policy else 0
+        return t if t and t > 0 else 600.0
 
     def recover(self):
         """Restore-time drain: in-flight async writes land (each is a
         valid cut the restore may pick up), stored failures drop."""
         if self.materializer is not None:
-            self.materializer.recover()
+            self.materializer.recover(timeout=self._drain_timeout())
             self.drain()
 
     def flush(self):
@@ -204,13 +229,42 @@ class _GenericCheckpointIO:
 
     def settle(self):
         """Failure-path barrier: let pending cuts become durable before
-        the caller checks whether a restartable checkpoint exists."""
+        the caller checks whether a restartable checkpoint exists —
+        bounded, so a wedged write cannot stall the restart decision."""
         if self.materializer is not None:
-            self.materializer.flush(raise_errors=False)
+            self.materializer.flush(raise_errors=False,
+                                    timeout=self._drain_timeout())
 
     def close(self):
         if self.materializer is not None:
-            self.materializer.close(flush=True)
+            self.materializer.close(flush=True,
+                                    timeout=self._drain_timeout())
+
+
+def _guarded_generic_write(ck_io, policy, storage, metrics, cid,
+                           payload_fn):
+    """Abort-and-count containment for the generic checkpoint paths
+    (docs/fault-tolerance.md): a failed attempt — including an async
+    failure surfacing at this barrier via the materializer check — is
+    GC'd and recorded, and the job keeps running until the consecutive-
+    failure budget is exhausted. ``payload_fn`` builds the payload
+    INSIDE the guard, so a snapshot-time failure is contained too."""
+    t0 = time.perf_counter()
+    trigger_ms = time.time() * 1000
+    try:
+        ck_io.write(cid, payload_fn())
+    except (JobCancelledException, WatchdogError,
+            CheckpointFailureBudgetExceeded):
+        raise
+    except Exception as e:
+        storage.discard_tmp(cid)
+        metrics.checkpoints_aborted += 1
+        metrics.record_checkpoint_abort(
+            cid, trigger_ms, (time.perf_counter() - t0) * 1e3,
+            reason=f"{type(e).__name__}: {e}", kind="generic",
+        )
+        if policy.on_aborted(cid, str(e)):
+            raise policy.exhausted_error(cid, e) from e
 
 
 class _FlatStageCheckpointer:
@@ -260,7 +314,18 @@ class _FlatStageCheckpointer:
         self.next_cid = (
             (self.storage.latest() or 0) + 1 if self.storage else 1
         )
-        self.io = _GenericCheckpointIO(env, self.storage, pipe)
+        # failure budget (checkpointing/policy.py): generic stages get
+        # the same abort-and-count containment as the windowed path
+        self.policy = (
+            policy_from_config(env.config)
+            if self.storage is not None else None
+        )
+        # the live policy object: the web monitor snapshots .state()
+        metrics.failure_budget = self.policy
+        self._pause_declined = False
+        self.io = _GenericCheckpointIO(
+            env, self.storage, pipe, policy=self.policy
+        )
         self.steps_at_ckpt = 0
         self.n_keys_logged = 0
         executor._savepoint_writer = self.write_savepoint
@@ -298,11 +363,23 @@ class _FlatStageCheckpointer:
             and self.metrics.steps - self.steps_at_ckpt
             >= self.env.checkpoint_interval_steps
         ):
+            # min-pause gate (checkpoint.min-pause): a due trigger
+            # defers until the pause elapses; ONE decline is counted per
+            # deferred trigger, not one per polled cycle
+            if self.policy is not None and not self.policy.can_trigger():
+                if not self._pause_declined:
+                    self._pause_declined = True
+                    self.metrics.checkpoints_declined += 1
+                return
+            self._pause_declined = False
             self.write_checkpoint()
 
     def write_checkpoint(self):
         self.emitter.drain()
-        self.io.write(self.next_cid, self._payload(self.storage))
+        _guarded_generic_write(
+            self.io, self.policy, self.storage, self.metrics,
+            self.next_cid, lambda: self._payload(self.storage),
+        )
         self.next_cid += 1
         self.steps_at_ckpt = self.metrics.steps
 
@@ -440,6 +517,15 @@ class JobMetrics:
     dropped_late: int = 0
     dropped_capacity: int = 0
     restarts: int = 0
+    # failure containment (docs/fault-tolerance.md): aborted-and-counted
+    # checkpoints, min-pause trigger declines, watchdog deadline trips
+    checkpoints_aborted: int = 0
+    checkpoints_declined: int = 0
+    watchdog_trips: int = 0
+    # the live CheckpointFailurePolicy (checkpointing/policy.py); the
+    # web monitor serves its .state() snapshot on
+    # /jobs/<jid>/checkpoints. None when checkpointing is off.
+    failure_budget: Any = None
     # DCN path: records THIS host's lanes carried (post ingest
     # partitioning — shows rebalance/shuffle/global routing physically)
     dcn_ingested_local: int = 0
@@ -475,6 +561,7 @@ class JobMetrics:
             self.checkpoint_stats = []
         row = {
             "id": cid,
+            "status": "completed",
             "trigger_ms": round(trigger_ms, 1),
             "duration_ms": round(duration_ms, 2),
             "bytes": nbytes,
@@ -491,6 +578,31 @@ class JobMetrics:
             row["coverage"] = coverage
         self.checkpoint_stats.append(row)
         del self.checkpoint_stats[:-200]      # bounded history
+
+    def record_checkpoint_abort(self, cid: int, trigger_ms: float,
+                                duration_ms: float, reason: str,
+                                kind: str = "full"):
+        """An aborted-and-counted checkpoint (failure-budget path): the
+        attempt rides the same history the web monitor serves, with
+        status "aborted" and the failure reason, so an operator sees the
+        contained fault instead of a silent gap in the ids."""
+        if self.checkpoint_stats is None:
+            self.checkpoint_stats = []
+        self.checkpoint_stats.append({
+            "id": cid,
+            "status": "aborted",
+            "trigger_ms": round(trigger_ms, 1),
+            "duration_ms": round(duration_ms, 2),
+            "bytes": 0,
+            "entries": 0,
+            "kind": kind,
+            "sync_ms": 0.0,
+            "async_ms": 0.0,
+            "staging_wait_ms": 0.0,
+            "staging_occupancy": 0,
+            "failure_reason": reason[:500],
+        })
+        del self.checkpoint_stats[:-200]
 
     def record_fire_latency(self, n_windows: int, ms: float):
         from flink_tpu.metrics.latency import LatencySamples
@@ -510,6 +622,7 @@ class JobMetrics:
     GAUGE_FIELDS = (
         "records_in", "records_out", "fires", "steps", "steps_fast",
         "dropped_late", "dropped_capacity", "restarts",
+        "checkpoints_aborted", "checkpoints_declined", "watchdog_trips",
     )
 
 
@@ -1323,9 +1436,23 @@ class LocalExecutor:
         def setup(origin_ms: int, fresh_state: bool = True):
             nonlocal td, win, spec, fire_step, fire_reduced_step, state
             td = TimeDomain(origin_ms=origin_ms, ms_per_tick=1)
-            ring = env.config.get_int("window.ring-panes", 0) or max(
+            ppw = size_ms // slide_ms
+            ring_cfg = env.config.get_int("window.ring-panes", 0)
+            if ring_cfg and ring_cfg < ppw + 3:
+                # the catch-up slicer's span bound is
+                # ring - max(2, panes_per_window + 1); below 1 its
+                # grouping loop can never advance (each group would be
+                # empty forever) — fail loudly at setup instead of
+                # hanging the job on the first replay burst
+                raise ValueError(
+                    f"window.ring-panes={ring_cfg} leaves no catch-up "
+                    f"headroom for a {ppw}-pane window (need ring >= "
+                    f"panes_per_window + 3 = {ppw + 3}); raise it or "
+                    f"unset it to use the auto-sized ring"
+                )
+            ring = ring_cfg or max(
                 8,
-                2 * (size_ms // slide_ms)
+                2 * ppw
                 + (wm_strategy.out_of_orderness_ms + wagg.allowed_lateness_ms)
                 // slide_ms
                 + 2,
@@ -1593,8 +1720,37 @@ class LocalExecutor:
         # the staged-delta pipeline below writes its own files, but the
         # materializer + notify/failure protocol is the SHARED one — a
         # fourth inline copy would drift from the generic paths'
-        ck_io = _GenericCheckpointIO(env, storage, pipe)
+        # -- failure containment (docs/fault-tolerance.md) -----------------
+        # coordinator-side budget (checkpointing/policy.py, ref
+        # CheckpointFailureManager): a failed or timed-out checkpoint is
+        # ABORTED and counted; only exhausting checkpoint.tolerable-
+        # failures escalates to the restart strategy. The policy's
+        # on_completed runs at publish time — on the materializer thread
+        # in async mode — so the consecutive-failure count tracks what
+        # actually became durable. (The windowed path writes through its
+        # own staged-delta pipeline, so ck_io carries the policy only
+        # for its bounded recover/settle/close drains.)
+        ck_policy = policy_from_config(env.config) if storage is not None \
+            else None
+        ck_io = _GenericCheckpointIO(env, storage, pipe, policy=ck_policy)
         materializer = ck_io.materializer
+        metrics.failure_budget = ck_policy
+        ck_declined = [False]      # one decline counted per deferred trigger
+        # checkpoint.timeout bookkeeping for async in-flight cids:
+        # cid -> monotonic publish deadline. An expired cid's publish is
+        # CANCELLED (the materialize closure checks before writing), so a
+        # wedged write can never publish a stale cut after the budget
+        # already accounted for its failure.
+        ck_pending = {}
+        ck_cancelled = set()
+        ck_lock = threading.Lock()
+        # step-loop watchdog (runtime/watchdog.py): per-phase deadlines
+        # that turn a hang into an attributed failure
+
+        def _wd_trip(trip):
+            metrics.watchdog_trips += 1
+
+        wd = watchdog_from_config(env.config, on_trip=_wd_trip)
         # live manifest chain of the current incremental sequence (base
         # first). Starts EMPTY even when the directory holds checkpoints:
         # a delta may only chain onto a base whose state this job actually
@@ -1681,20 +1837,108 @@ class LocalExecutor:
                 "fresh": fr,
             }
 
+        def _abort_checkpoint(cid, err, t_ck0, trigger_ms):
+            """Abort-and-count one failed checkpoint attempt (the
+            containment half of the failure budget). GCs the attempt's
+            staging dir; in incremental mode cancels every in-flight
+            publish and RESETS the manifest chain — the failed cut's
+            dirty bits are already cleared, so only a fresh full base
+            can cover its changes, and no future delta may chain over
+            the hole. Raises (escalating to the restart strategy) only
+            when the consecutive-failure budget is exhausted."""
+            storage.discard_tmp(cid)
+            if ck_mode == "incremental":
+                with ck_lock:
+                    ck_cancelled.update(ck_pending)
+                    ck_pending.clear()
+                ck_chain[:] = []
+            metrics.checkpoints_aborted += 1
+            metrics.record_checkpoint_abort(
+                cid, trigger_ms, (time.perf_counter() - t_ck0) * 1e3,
+                reason=f"{type(err).__name__}: {err}",
+                kind="incremental" if ck_mode == "incremental" else "full",
+            )
+            if ck_policy.on_aborted(cid, str(err)):
+                raise ck_policy.exhausted_error(cid, err) from err
+
+        def _expire_pending():
+            """checkpoint.timeout for async in-flight checkpoints: a cid
+            still unpublished past its deadline is declared failed — its
+            publish is cancelled and the failure counts against the
+            budget — so a wedged materialization cannot silently stall
+            durability forever. timeout <= 0 disables (nothing is ever
+            registered as pending then)."""
+            if not ck_pending:
+                return
+            now = time.monotonic()
+            with ck_lock:
+                expired = sorted(
+                    c for c, dl in ck_pending.items() if now > dl
+                )
+                for c in expired:
+                    ck_cancelled.add(c)
+                    ck_pending.pop(c, None)
+            for c in expired:
+                _abort_checkpoint(
+                    c,
+                    TimeoutError(
+                        f"checkpoint {c} unpublished after "
+                        f"{ck_policy.timeout_s:.0f}s (checkpoint.timeout)"
+                    ),
+                    time.perf_counter(), time.time() * 1000,
+                )
+
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt, n_keys_logged, state
-            if materializer is not None:
-                # surface an async write failure AT the barrier: it is a
-                # checkpoint failure and takes the restart path like one
-                materializer.check()
-                ck_io.drain()
             t_ck0 = time.perf_counter()
             trigger_ms = time.time() * 1000
             cid = next_cid
-            # ---- SYNC phase (the only step-loop stall) -----------------
-            # drain due fires so fired_through is uniform across shards and
-            # the snapshot is an exact global cut (F-throttle divergence)
+            try:
+                if materializer is not None:
+                    _expire_pending()
+                    # surface an async write failure AT the barrier: it
+                    # is a checkpoint failure — aborted and counted (the
+                    # abort record carries THIS barrier's cid; the
+                    # reason names the failed chk label)
+                    materializer.check()
+                    ck_io.drain()
+            except (JobCancelledException, WatchdogError,
+                    CheckpointFailureBudgetExceeded):
+                raise
+            except Exception as e:
+                # a poisoned materializer DROPPED its queued tasks:
+                # their cids will never pop themselves from pending, and
+                # none of them published — stop tracking (and block any
+                # straggler publish) before counting the abort
+                with ck_lock:
+                    ck_cancelled.update(ck_pending)
+                    ck_pending.clear()
+                _abort_checkpoint(cid, e, t_ck0, trigger_ms)
+                next_cid += 1
+                steps_at_ckpt = metrics.steps
+                return
+            # drain due fires so fired_through is uniform across shards
+            # and the snapshot is an exact global cut (F-throttle
+            # divergence). OUTSIDE the abort scope: a sink failure while
+            # emitting is a job failure, not a checkpoint failure.
             drain_fires(int(wm_strategy.current()))
+            wd_prev = wd.arm("checkpoint_sync") if wd is not None else None
+            try:
+                _write_checkpoint_cut(cid, t_ck0, trigger_ms)
+            except (JobCancelledException, WatchdogError,
+                    CheckpointFailureBudgetExceeded):
+                raise
+            except Exception as e:
+                _abort_checkpoint(cid, e, t_ck0, trigger_ms)
+            finally:
+                if wd is not None:
+                    wd.disarm(wd_prev)
+            next_cid += 1
+            steps_at_ckpt = metrics.steps
+
+        def _write_checkpoint_cut(cid, t_ck0, trigger_ms):
+            nonlocal n_keys_logged, state
+            # ---- SYNC phase (the only step-loop stall) -----------------
             # changelog fetch: which key groups changed since the last cut
             spill_dump = _dump_spill_stores()
             kind, dirty_kgs, rows = "full", None, None
@@ -1762,10 +2006,24 @@ class LocalExecutor:
                         else len(dirty_kgs)
                     )
                     ck_cov_gauge.set(cov_n)
-            staging_wait = (
-                materializer.wait_for_slot() if materializer is not None
-                else 0.0
-            )
+            staging_wait = 0.0
+            if materializer is not None:
+                # bounded: a wedged in-flight write must surface as an
+                # abortable checkpoint failure, not an unbounded stall
+                # (MaterializerStall -> _abort_checkpoint)
+                slot_prev = (
+                    wd.arm("materializer_slot") if wd is not None else None
+                )
+                try:
+                    staging_wait = materializer.wait_for_slot(
+                        timeout=(
+                            ck_policy.timeout_s
+                            if ck_policy.timeout_s > 0 else None
+                        )
+                    )
+                finally:
+                    if wd is not None:
+                        wd.disarm(slot_prev)
             occupancy = materializer.pending() if materializer else 0
             sync_ms = (time.perf_counter() - t_ck0) * 1e3
             if ck_hists:
@@ -1777,56 +2035,82 @@ class LocalExecutor:
 
             # ---- ASYNC phase (materializer thread; inline when sync) ---
             def materialize():
-                t_a0 = time.perf_counter()
-                entries, scalars = ckpt.extract_entries(staged, win)
-                entries = _fold_spill_entries(entries, spill_dump)
-                if kind == "delta":
-                    entries = cklog.filter_entries_to_key_groups(
-                        entries, dirty_kgs, ctx.max_parallelism
+                try:
+                    with ck_lock:
+                        if cid in ck_cancelled:
+                            return        # timed out: abort already counted
+                    t_a0 = time.perf_counter()
+                    entries, scalars = ckpt.extract_entries(staged, win)
+                    entries = _fold_spill_entries(entries, spill_dump)
+                    if kind == "delta":
+                        entries = cklog.filter_entries_to_key_groups(
+                            entries, dirty_kgs, ctx.max_parallelism
+                        )
+                    # last cancellation point before durability: a cut
+                    # declared timed-out must never publish (its failure
+                    # is already in the budget and the chain was reset)
+                    with ck_lock:
+                        if cid in ck_cancelled:
+                            return
+                    path = storage.write(
+                        cid, entries, scalars,
+                        manifest=manifest, aux_bytes=aux_bytes,
                     )
-                path = storage.write(
-                    cid, entries, scalars,
-                    manifest=manifest, aux_bytes=aux_bytes,
-                )
-                # the checkpoint is durable: commit offsets externally +
-                # let sinks finalize (ref notifyCheckpointComplete fan-
-                # out). Async mode queues — the step loop delivers.
-                if materializer is not None:
-                    ck_io.queue_notification(cid, offsets)
-                else:
-                    with ck_io.source_lock:
-                        pipe.source.notify_checkpoint_complete(cid, offsets)
-                    for s in pipe.all_sinks:
-                        s.notify_checkpoint_complete(cid)
-                nbytes = sum(
-                    os.path.getsize(os.path.join(path, f))
-                    for f in os.listdir(path)
-                ) if path and os.path.isdir(path) else 0
-                async_ms = (time.perf_counter() - t_a0) * 1e3
-                if ck_hists:
-                    ck_hists["async"].update(async_ms)
-                metrics.record_checkpoint(
-                    cid, trigger_ms,
-                    (time.perf_counter() - t_ck0) * 1e3,
-                    nbytes, len(entries["key_hi"]),
-                    # sync mode: the WHOLE checkpoint stalls the loop
-                    kind=kind,
-                    sync_ms=sync_ms if materializer is not None else None,
-                    async_ms=async_ms if materializer is not None else 0.0,
-                    coverage=(
-                        None if dirty_kgs is None or kind == "full"
-                        else len(dirty_kgs)
-                    ),
-                    staging_wait_ms=staging_wait * 1e3,
-                    staging_occupancy=occupancy,
-                )
+                    ck_policy.on_completed(cid)
+                    # the checkpoint is durable: commit offsets externally
+                    # + let sinks finalize (ref notifyCheckpointComplete
+                    # fan-out). Async mode queues — the step loop delivers.
+                    if materializer is not None:
+                        ck_io.queue_notification(cid, offsets)
+                    else:
+                        with ck_io.source_lock:
+                            pipe.source.notify_checkpoint_complete(
+                                cid, offsets
+                            )
+                        for s in pipe.all_sinks:
+                            s.notify_checkpoint_complete(cid)
+                    nbytes = sum(
+                        os.path.getsize(os.path.join(path, f))
+                        for f in os.listdir(path)
+                    ) if path and os.path.isdir(path) else 0
+                    async_ms = (time.perf_counter() - t_a0) * 1e3
+                    if ck_hists:
+                        ck_hists["async"].update(async_ms)
+                    metrics.record_checkpoint(
+                        cid, trigger_ms,
+                        (time.perf_counter() - t_ck0) * 1e3,
+                        nbytes, len(entries["key_hi"]),
+                        # sync mode: the WHOLE checkpoint stalls the loop
+                        kind=kind,
+                        sync_ms=sync_ms if materializer is not None
+                        else None,
+                        async_ms=async_ms if materializer is not None
+                        else 0.0,
+                        coverage=(
+                            None if dirty_kgs is None or kind == "full"
+                            else len(dirty_kgs)
+                        ),
+                        staging_wait_ms=staging_wait * 1e3,
+                        staging_occupancy=occupancy,
+                    )
+                finally:
+                    with ck_lock:
+                        ck_pending.pop(cid, None)
 
             if materializer is not None:
-                materializer.submit(f"chk-{cid}", materialize)
+                if ck_policy.timeout_s > 0:     # 0/negative = no timeout
+                    with ck_lock:
+                        ck_pending[cid] = (
+                            time.monotonic() + ck_policy.timeout_s
+                        )
+                try:
+                    materializer.submit(f"chk-{cid}", materialize)
+                except BaseException:
+                    with ck_lock:      # never-queued cid must not "expire"
+                        ck_pending.pop(cid, None)
+                    raise
             else:
                 materialize()
-            next_cid += 1
-            steps_at_ckpt = metrics.steps
 
         def restore_checkpoint(path_or_storage, cid=None):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
@@ -1838,6 +2122,14 @@ class LocalExecutor:
             ingest.pause()
             if materializer is not None:
                 ck_io.recover()           # durable cuts still notify
+            with ck_lock:
+                # restoring IS the recovery from any in-flight attempt:
+                # whatever landed during recover()'s bounded drain is a
+                # valid cut; the rest stop being tracked. ck_cancelled
+                # is KEPT — a cancelled cid whose wedged write outlived
+                # the drain must still never publish (cids are
+                # monotonic, so stale entries can never block new ones).
+                ck_pending.clear()
             host_fired_pane = -(2**62)   # re-arm boundary fire detection
             applied_max_pane = None      # re-armed from the snapshot below
             # restored table contents differ from the running population:
@@ -2720,16 +3012,26 @@ class LocalExecutor:
             traced = tracer is not None and tracer.active
             while True:
                 t_f0 = time.perf_counter()
-                cf = run_fire(wm_ms, reduced=use_reduced)
-                # fire dispatch returns immediately; the device_get below
-                # IS the step-boundary barrier — trace them separately so
-                # a stalled fetch is attributable (tentpole span catalog)
-                t_fd = time.perf_counter() if traced else None
-                # ONE batched fetch of all small per-lane fields
-                counts, lanes, ends, vsums = jax.device_get(
-                    (cf.counts, cf.lane_valid, cf.window_end_ticks,
-                     cf.value_sums)
-                )
+                # watchdog phases: fire dispatch and the barrier fetch
+                # are the step loop's device waits — a wedged ensemble
+                # hangs HERE, so these arms buy the attribution
+                wd_prev = wd.arm("fire") if wd is not None else None
+                try:
+                    cf = run_fire(wm_ms, reduced=use_reduced)
+                    # fire dispatch returns immediately; the device_get
+                    # below IS the step-boundary barrier — trace them
+                    # separately so a stalled fetch is attributable
+                    t_fd = time.perf_counter() if traced else None
+                    if wd is not None:
+                        wd.arm("barrier_fetch")
+                    # ONE batched fetch of all small per-lane fields
+                    counts, lanes, ends, vsums = jax.device_get(
+                        (cf.counts, cf.lane_valid, cf.window_end_ticks,
+                         cf.value_sums)
+                    )
+                finally:
+                    if wd is not None:
+                        wd.disarm(wd_prev)
                 t_f1 = time.perf_counter()
                 fires_before = metrics.fires
                 n_emit = emit_fires(cf, counts, lanes, ends, vsums,
@@ -2976,7 +3278,17 @@ class LocalExecutor:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()
             phase_acc["dispatch"] = phase_acc["emit"] = 0.0
-            pb = ingest.next()
+            if wd is None:
+                pb = ingest.next()
+            else:
+                # watchdog "source" phase (off by default): the wait for
+                # the prep side — covers a dead prefetch thread or a
+                # must-produce source going silent
+                wd_prev = wd.arm("source")
+                try:
+                    pb = ingest.next()
+                finally:
+                    wd.disarm(wd_prev)
             # attribution: with prefetch on, "source" time is only the
             # wait for the prep thread (~0 while it keeps ahead)
             t_src = time.perf_counter()
@@ -3027,7 +3339,15 @@ class LocalExecutor:
                 and metrics.steps - steps_at_ckpt >= env.checkpoint_interval_steps
                 and td is not None
             ):
-                write_checkpoint()
+                # checkpoint.min-pause gate: a due trigger defers until
+                # the pause since the last attempt elapses; ONE decline
+                # is counted per deferred trigger, not per polled cycle
+                if ck_policy.can_trigger():
+                    ck_declined[0] = False
+                    write_checkpoint()
+                elif not ck_declined[0]:
+                    ck_declined[0] = True
+                    metrics.checkpoints_declined += 1
             if self._attribution is not None:
                 t_end = time.perf_counter()
                 src_s = t_src - t_c0
@@ -3064,6 +3384,17 @@ class LocalExecutor:
             span_limit = win.ring - max(
                 2, int(win.size_ticks // win.slide_ticks) + 1
             )
+            if span_limit < 1:
+                # setup() validates configured rings; this guard keeps a
+                # degenerate span from ever entering the grouping loop
+                # below, whose cutoff would never advance (an infinite
+                # empty-group hang instead of an error)
+                raise RuntimeError(
+                    f"window ring {win.ring} leaves catch-up span "
+                    f"{span_limit} < 1 for a "
+                    f"{int(win.size_ticks // win.slide_ticks)}-pane "
+                    f"window; raise window.ring-panes"
+                )
             if int(panes.max()) - int(panes.min()) >= span_limit:
                 order = np.argsort(panes, kind="stable")
                 sorted_panes = panes[order]
@@ -3167,6 +3498,8 @@ class LocalExecutor:
         # go live BEFORE restore: once td/state exist, a direct kv_read off
         # the executor thread would race the first donated step
         job_live.set()
+        if wd is not None:
+            wd.start()
         try:
             if restore_from:
                 restore_checkpoint(restore_from)
@@ -3185,9 +3518,18 @@ class LocalExecutor:
                                     time.perf_counter())
                     if materializer is not None:
                         # an async write still failing here IS a
-                        # checkpoint failure: raise inside the restart
-                        # protection so recovery treats it as one
-                        ck_io.flush()
+                        # checkpoint failure: abort-and-count like any
+                        # other; only budget exhaustion raises (inside
+                        # the restart protection, so recovery treats it
+                        # as one) — a transient final-write failure must
+                        # not fail a job whose stream already completed
+                        try:
+                            ck_io.flush()
+                        except MaterializerError as e:
+                            _abort_checkpoint(
+                                next_cid, e, time.perf_counter(),
+                                time.time() * 1000,
+                            )
                     break
                 except JobCancelledException:
                     raise
@@ -3207,6 +3549,8 @@ class LocalExecutor:
                     self._notify_restart()
                     restore_checkpoint(storage)
         finally:
+            if wd is not None:
+                wd.stop()
             job_live.clear()
             ingest.close()
             drain_kv_mailbox()
@@ -3461,7 +3805,10 @@ class LocalExecutor:
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0
-        ck_io = _GenericCheckpointIO(env, storage, pipe)
+        ck_policy = policy_from_config(env.config) if storage is not None \
+            else None
+        metrics.failure_budget = ck_policy
+        ck_io = _GenericCheckpointIO(env, storage, pipe, policy=ck_policy)
 
         def _payload():
             return {
@@ -3480,7 +3827,9 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt
-            ck_io.write(next_cid, _payload())
+            _guarded_generic_write(
+                ck_io, ck_policy, storage, metrics, next_cid, _payload
+            )
             next_cid += 1
             steps_at_ckpt = metrics.steps
 
@@ -3719,21 +4068,29 @@ class LocalExecutor:
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0
-        ck_io = _GenericCheckpointIO(env, storage, pipe)
+        ck_policy = policy_from_config(env.config) if storage is not None \
+            else None
+        metrics.failure_budget = ck_policy
+        ck_io = _GenericCheckpointIO(env, storage, pipe, policy=ck_policy)
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt
-            ck_io.write(next_cid, {
-                "backend": backend.snapshot(),
-                "timers": timers.snapshot(),
-                "offsets": pipe.source.snapshot_offsets(),
-                "wm_current": wm_strategy.current(),
-                "proc_time": timers.current_processing_time,
-                "max_parallelism": env.max_parallelism,
-                "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
-                "accumulators": accumulators.snapshot(),
-                "operator_state": operator_state.snapshot(),
-            })
+            _guarded_generic_write(
+                ck_io, ck_policy, storage, metrics, next_cid,
+                lambda: {
+                    "backend": backend.snapshot(),
+                    "timers": timers.snapshot(),
+                    "offsets": pipe.source.snapshot_offsets(),
+                    "wm_current": wm_strategy.current(),
+                    "proc_time": timers.current_processing_time,
+                    "max_parallelism": env.max_parallelism,
+                    "sink_states": [
+                        s.snapshot_state() for s in pipe.all_sinks
+                    ],
+                    "accumulators": accumulators.snapshot(),
+                    "operator_state": operator_state.snapshot(),
+                },
+            )
             next_cid += 1
             steps_at_ckpt = metrics.steps
 

@@ -60,6 +60,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from flink_tpu.core.keygroups import assign_to_key_group
 from flink_tpu.ops.hashing import route_hash
 from flink_tpu.parallel.mesh import SHARD_AXIS
+from flink_tpu.testing import faults
 
 
 # ---------------------------------------------------------------- masks
@@ -401,6 +402,11 @@ class IngestPipeline:
                 self._gate.wait(0.1)
                 continue
             self._parked.clear()
+            # chaos seam, OUTSIDE the delivery try: an injected raise
+            # kills the thread WITHOUT handing the consumer an error —
+            # the "prefetch thread died" detection path in next() (and
+            # the ensure-thread respawn) is exactly what it exercises
+            faults.inject("ingest.producer", epoch=self._epoch)
             epoch = self._epoch
             park_after = False
             try:

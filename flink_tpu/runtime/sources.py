@@ -316,6 +316,11 @@ class SocketWordsSource(ColumnarSource):
         self._buf = b""
         self._eof = False
         self._words = {}          # id (int) -> word str
+        # (ts, ids) tail of a single line wider than one poll's cap:
+        # parse_ts_words is line-atomic, so the overflow splits across
+        # SUBSEQUENT polls here — the poll contract (<= max_records)
+        # holds even for pathological lines
+        self._carry = None
 
     def open(self):
         self._sock = socket.create_connection(
@@ -335,6 +340,25 @@ class SocketWordsSource(ColumnarSource):
     def poll(self, max_records: int):
         from flink_tpu.native import parse_ts_words
 
+        # serve a carried oversized-line tail FIRST: its words are
+        # already recorded, and mixing it with fresh lines could exceed
+        # the cap again
+        if self._carry is not None:
+            ts_c, ids_c = self._carry
+            take = min(int(max_records), len(ids_c))
+            ts, ids = ts_c[:take], ids_c[:take]
+            self._carry = (
+                (ts_c[take:], ids_c[take:]) if take < len(ids_c) else None
+            )
+            cols = {
+                "key": ids.view(np.int64),
+                "value": np.ones(len(ids), np.float32),
+                "ts": ts,
+            }
+            done = (
+                self._carry is None and self._eof and not self._buf
+            )
+            return (cols, ts), done
         if not self._eof:
             try:
                 while True:
@@ -359,7 +383,8 @@ class SocketWordsSource(ColumnarSource):
         if self._eof and consumed < len(data) and len(ids) == 0:
             consumed = len(data)     # nothing parseable remains
         self._buf = self._buf[min(consumed, len(self._buf)):]
-        # first-seen tokens: record their strings for word_of()
+        # first-seen tokens: record their strings for word_of() — BEFORE
+        # any cap split, while ``data`` (which offs/lens index) is here
         if len(ids):
             uniq, first = np.unique(ids, return_index=True)
             for u, i in zip(uniq.tolist(), first.tolist()):
@@ -368,10 +393,18 @@ class SocketWordsSource(ColumnarSource):
                     self._words[u] = data[o:o + l].decode(
                         "utf-8", errors="replace"
                     )
+        if len(ids) > max_records:
+            # ONE line wider than the cap came back whole (line-atomic
+            # parse); split it across polls so the contract holds.
+            # Copies: the tail must not pin the parse buffers.
+            self._carry = (
+                ts[max_records:].copy(), ids[max_records:].copy()
+            )
+            ts, ids = ts[:max_records], ids[:max_records]
         cols = {
             "key": ids.view(np.int64),
             "value": np.ones(len(ids), np.float32),
             "ts": ts,    # for assign_timestamps_and_watermarks(c["ts"])
         }
-        done = self._eof and not self._buf
+        done = self._eof and not self._buf and self._carry is None
         return (cols, ts), done

@@ -1,0 +1,239 @@
+"""Watchdog-supervised step loop: per-phase deadlines that convert a
+distributed hang into a clean, attributed job failure.
+
+The reference cancels a stuck Task via TaskCancelerWatchDog
+(Task.java:1528: a watchdog thread that escalates a cancellation that
+does not finish); a jax_graft step loop has the same exposure with
+different phases — a wedged device fetch, a source that stops
+producing, a materializer that never frees a staging slot. The
+:class:`Watchdog` monitor thread checks one ARMED phase per supervised
+thread; when a phase overruns its deadline it records the attribution
+(phase name, elapsed, deadline), notifies ``on_trip`` (metrics), and
+raises :class:`WatchdogError` inside the supervised thread via CPython's
+async-exception hook, so the failure surfaces AT the stalled call with
+the phase name in the message — the restart machinery then treats it
+like any job failure (restore from the last checkpoint or die cleanly).
+
+Delivery caveat (inherent to async exceptions): the error lands when the
+blocked thread next executes Python bytecode. Every supervised wait in
+this codebase is either a short-timeout loop (queue.get, Condition.wait,
+sliced socket recv) or a device fetch; an OS-level block that never
+returns cannot be interrupted from userspace — the watchdog still
+records and reports the trip, which is the attribution half of the
+contract.
+
+Arming is two attribute stores + a monotonic read (< 1 us), so phases
+can wrap every cycle of the hot loop; the monitor thread wakes every
+``interval_s`` and does O(supervised threads) work.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+class WatchdogError(RuntimeError):
+    """A supervised phase overran its deadline. When raised via the
+    async-exception hook CPython instantiates the class with no args —
+    in the TARGET thread — so the monitor parks the attribution in
+    ``pending_by_tid`` first and __init__ picks up its own thread's
+    entry (per-tid: concurrent trips cannot swap messages)."""
+
+    pending_by_tid: dict = {}
+
+    def __init__(self, *args):
+        if not args:
+            msg = type(self).pending_by_tid.pop(
+                threading.get_ident(), ""
+            )
+            if msg:
+                args = (msg,)
+        super().__init__(*args)
+
+
+@dataclass
+class WatchdogTrip:
+    phase: str
+    elapsed_s: float
+    deadline_s: float
+    thread_name: str
+    detail: str = ""
+
+    def message(self) -> str:
+        base = (
+            f"watchdog: phase {self.phase!r} exceeded its "
+            f"{self.deadline_s:.1f}s deadline "
+            f"({self.elapsed_s:.1f}s elapsed) on thread "
+            f"{self.thread_name!r}"
+        )
+        return f"{base}: {self.detail}" if self.detail else base
+
+
+class Watchdog:
+    """deadlines: phase name -> seconds (entries <= 0 disable that
+    phase). Phases nest: ``arm`` returns the previously armed slot and
+    ``disarm(prev)`` restores it, so a checkpoint's slot wait can be
+    attributed separately from the surrounding sync phase."""
+
+    def __init__(self, deadlines: Dict[str, float],
+                 interval_s: float = 1.0, name: str = "flink-tpu-watchdog",
+                 on_trip: Optional[Callable[[WatchdogTrip], None]] = None):
+        self.deadlines = {
+            k: float(v) for k, v in deadlines.items() if v and v > 0
+        }
+        self.interval_s = max(0.05, float(interval_s))
+        self.name = name
+        self.on_trip = on_trip
+        self.trips: List[WatchdogTrip] = []
+        # tid -> (phase, t_armed, deadline_s, detail); plain dict ops are
+        # GIL-atomic, which is all the monitor's snapshot read needs
+        self._armed: Dict[int, tuple] = {}
+        # tids with an injected-but-possibly-undelivered trip: disarm()
+        # CANCELS the pending async exception when the supervised wait
+        # completed in the monitor's observe->inject window, so a trip
+        # can never detonate later in unrelated code. _trip_lock makes
+        # the monitor's verify->pop->inject and disarm's cancel->restore
+        # mutually exclusive — whichever wins, the loser sees a
+        # consistent state (no injection after a completed disarm).
+        self._tripping: set = set()
+        self._trip_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.deadlines)
+
+    # -- supervised-thread side ----------------------------------------
+    def arm(self, phase: str, detail: str = ""):
+        """Arm ``phase`` for the calling thread; returns the previous
+        slot (restore it with ``disarm``). Unknown/disabled phases arm a
+        no-deadline slot so nesting stays balanced."""
+        tid = threading.get_ident()
+        prev = self._armed.get(tid)
+        dl = self.deadlines.get(phase)
+        if dl is None:
+            self._armed[tid] = (phase, 0.0, 0.0, detail)
+        else:
+            self._armed[tid] = (phase, time.monotonic(), dl, detail)
+        return prev
+
+    def disarm(self, prev=None) -> None:
+        tid = threading.get_ident()
+        with self._trip_lock:
+            if tid in self._tripping:
+                # the phase finished between the monitor's overdue check
+                # and the async delivery: cancel the in-flight exception
+                # (a no-op if it was already delivered and is unwinding
+                # through this very disarm — then it surfaces AT the
+                # armed phase, which is the correct attribution)
+                self._tripping.discard(tid)
+                WatchdogError.pending_by_tid.pop(tid, None)
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(tid), None
+                )
+            if prev is None:
+                self._armed.pop(tid, None)
+            else:
+                self._armed[tid] = prev
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._main, daemon=True, name=self.name
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- monitor side ---------------------------------------------------
+    def _main(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            now = time.monotonic()
+            for tid, slot in list(self._armed.items()):
+                phase, t0, dl, detail = slot
+                if dl <= 0 or now - t0 <= dl:
+                    continue
+                with self._trip_lock:
+                    # verify-pop-inject atomically vs disarm: a phase
+                    # that completed (disarm ran) can never be tripped,
+                    # and a trip decided here is cancellable by the
+                    # very next disarm
+                    if self._armed.get(tid) is not slot:
+                        continue
+                    self._armed.pop(tid, None)
+                    self._trip(tid, phase, now - t0, dl, detail)
+
+    def _trip(self, tid: int, phase: str, elapsed: float, deadline: float,
+              detail: str) -> None:
+        """Record + inject one trip. Caller holds _trip_lock."""
+        tname = next(
+            (t.name for t in threading.enumerate() if t.ident == tid),
+            str(tid),
+        )
+        trip = WatchdogTrip(
+            phase=phase, elapsed_s=elapsed, deadline_s=deadline,
+            thread_name=tname, detail=detail,
+        )
+        self.trips.append(trip)
+        del self.trips[:-50]
+        if self.on_trip is not None:
+            try:
+                self.on_trip(trip)
+            except Exception:
+                pass          # observability must never kill the monitor
+        WatchdogError.pending_by_tid[tid] = trip.message()
+        self._tripping.add(tid)
+        _async_raise(tid, WatchdogError)
+
+
+def _async_raise(tid: int, exc_type) -> bool:
+    """Raise ``exc_type`` inside thread ``tid`` at its next bytecode
+    boundary (CPython's PyThreadState_SetAsyncExc). Returns False when
+    the thread no longer exists."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exc_type)
+    )
+    if res > 1:        # shouldn't happen: undo and refuse
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(tid), None
+        )
+        return False
+    return res == 1
+
+
+def watchdog_from_config(config, on_trip=None) -> Optional[Watchdog]:
+    """Build the step-loop watchdog from ``watchdog.*`` config (None when
+    disabled). Phase deadlines in SECONDS; 0 disables one phase.
+    Defaults are deliberately generous — the watchdog is a hang
+    detector, not a latency SLO. Reads go through the declared
+    ConfigOptions so conf-file strings coerce strictly (a misspelled
+    boolean is an error, never a silently-disabled watchdog)."""
+    from flink_tpu.core.config import CoreOptions as CO
+
+    if config is None or not config.get(CO.WATCHDOG_ENABLED):
+        return None
+    deadlines = {
+        # the ingest wait: 0 by default — a legitimate source may idle
+        # indefinitely (sockets); enable for must-produce pipelines
+        "source": config.get(CO.WATCHDOG_SOURCE_TIMEOUT),
+        "fire": config.get(CO.WATCHDOG_FIRE_TIMEOUT),
+        "barrier_fetch": config.get(CO.WATCHDOG_FETCH_TIMEOUT),
+        "checkpoint_sync": config.get(CO.WATCHDOG_CKPT_SYNC_TIMEOUT),
+        "materializer_slot": config.get(CO.WATCHDOG_SLOT_TIMEOUT),
+    }
+    wd = Watchdog(
+        deadlines, interval_s=config.get(CO.WATCHDOG_INTERVAL),
+        on_trip=on_trip,
+    )
+    return wd if wd.enabled else None

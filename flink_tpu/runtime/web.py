@@ -19,6 +19,8 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /metrics                  Prometheus text exposition over every job's
                               registry (text/plain, not JSON — scrape me)
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
+                              + aborted attempts, the live failure-budget
+                              state, and watchdog trips
                               (ref CheckpointStatsTracker + handlers/checkpoints/)
     /jobs/<jid>/plan          logical operator DAG (ref JobPlanHandler)
     /jobs/<jid>/vertices      plan nodes + job throughput (ref JobDetailsHandler)
@@ -778,6 +780,20 @@ class WebMonitor:
                 "compact-every": getattr(
                     cfg, "get_int", lambda *a: 8
                 )("checkpoint.compact-every", 8),
+                # failure containment (docs/fault-tolerance.md)
+                "tolerable-failures": getattr(
+                    cfg, "get_int", lambda *a: 0
+                )("checkpoint.tolerable-failures", 0),
+                "timeout-s": getattr(
+                    cfg, "get_float", lambda *a: 600.0
+                )("checkpoint.timeout", 600.0),
+                "min-pause-s": getattr(
+                    cfg, "get_float", lambda *a: 0.0
+                )("checkpoint.min-pause", 0.0),
+                "watchdog": (
+                    cfg.get_bool("watchdog.enabled", True)
+                    if cfg is not None else True
+                ),
                 "externalization": {"enabled": True,
                                     "delete_on_cancellation": False},
             }
@@ -806,9 +822,9 @@ class WebMonitor:
                         "parallelism": jv.parallelism,
                         "acknowledged": jv.parallelism,
                     }
-            return {
+            out = {
                 "id": cid,
-                "status": "COMPLETED",
+                "status": row.get("status", "completed").upper(),
                 "trigger-timestamp-ms": row["trigger_ms"],
                 "duration-ms": row["duration_ms"],
                 "state-size-bytes": row["bytes"],
@@ -819,6 +835,9 @@ class WebMonitor:
                 },
                 "tasks": tasks,
             }
+            if row.get("failure_reason"):
+                out["failure-cause"] = row["failure_reason"]
+            return out
         m = re.fullmatch(r"/jobs/([^/]+)/accumulators", path)
         if m:
             # ref JobAccumulatorsHandler
@@ -888,8 +907,14 @@ class WebMonitor:
             if rec is None:
                 return None
             stats = self._checkpoint_stats(rec)
-            durs = [s["duration_ms"] for s in stats]
-            sizes = [s["bytes"] for s in stats]
+            # aborted attempts ride the same history (failure-budget
+            # containment) but must not skew the completion summaries
+            done = [
+                s for s in stats if s.get("status", "completed") != "aborted"
+            ]
+            aborted = [s for s in stats if s.get("status") == "aborted"]
+            durs = [s["duration_ms"] for s in done]
+            sizes = [s["bytes"] for s in done]
 
             def _mm(vals):
                 return {
@@ -901,27 +926,37 @@ class WebMonitor:
             # async/incremental split (flink_tpu/checkpointing): sync-ms
             # is the step-loop stall, async-ms the background
             # materialization; bytes split by full base vs delta
-            full = [s for s in stats if s.get("kind", "full") == "full"]
-            delta = [s for s in stats if s.get("kind") == "delta"]
+            full = [s for s in done if s.get("kind", "full") == "full"]
+            delta = [s for s in done if s.get("kind") == "delta"]
+            live = getattr(rec.env, "_live_metrics", None)
+            src = live or (rec.handle.metrics if rec.handle else None)
+            budget = getattr(src, "failure_budget", None)
             return {
                 "counts": {
-                    "completed": len(stats),
+                    "completed": len(done),
+                    "aborted": len(aborted),
+                    "declined": getattr(src, "checkpoints_declined", 0),
                     "full": len(full),
                     "incremental": len(delta),
                 },
+                # live failure-budget state (checkpointing/policy.py)
+                "failure-budget": (
+                    budget.state() if budget is not None else None
+                ),
+                "watchdog-trips": getattr(src, "watchdog_trips", 0),
                 "summary": {
                     "duration-ms": _mm(durs),
                     "state-size-bytes": _mm(sizes),
                     "sync-ms": _mm([
-                        s.get("sync_ms", s["duration_ms"]) for s in stats
+                        s.get("sync_ms", s["duration_ms"]) for s in done
                     ]),
                     "async-ms": _mm([
-                        s.get("async_ms", 0.0) for s in stats
+                        s.get("async_ms", 0.0) for s in done
                     ]),
                     "bytes-full": sum(s["bytes"] for s in full),
                     "bytes-incremental": sum(s["bytes"] for s in delta),
                     "staging-wait-ms": _mm([
-                        s.get("staging_wait_ms", 0.0) for s in stats
+                        s.get("staging_wait_ms", 0.0) for s in done
                     ]),
                 },
                 "history": stats[-50:],

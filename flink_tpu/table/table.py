@@ -791,45 +791,62 @@ class TableEnvironment:
         containing the word UNION never splits). Returns
         ([branch_sql...], [op...]) with ops[i] the combinator between
         branch i and i+1 ("all" | "distinct")."""
-        lits: List[str] = []
-
-        def stash(m):
-            lits.append(m.group(0))
-            return f"\x00{len(lits) - 1}\x00"
-
-        masked = re.sub(r"'(?:[^']|'')*'", stash, query)
+        masked, unstash = TableEnvironment._mask_literals(query)
         parts = re.split(r"\bUNION(\s+ALL)?\b", masked,
                          flags=re.IGNORECASE)
         branches = parts[0::2]
         ops = ["all" if a else "distinct" for a in parts[1::2]]
-
-        def unstash(s):
-            return re.sub(r"\x00(\d+)\x00",
-                          lambda m: lits[int(m.group(1))], s)
-
         return [unstash(b).strip() for b in branches], ops
 
     @staticmethod
-    def _strip_trailing(branch: str):
-        """Pull a trailing ORDER BY / LIMIT off a query. Used where the
-        clause must apply AFTER a set operation (DISTINCT dedupes before
-        ORDER BY/LIMIT; a union's trailing clauses order/bound the WHOLE
-        union, not its last branch). Returns (core, order_spec, limit)."""
+    def _mask_literals(sql: str):
+        """Stash string literals behind \\x00N\\x00 markers so clause
+        regexes can never match keywords INSIDE a quoted value. ONE
+        implementation — the planner's stash_literals — so the quoting
+        rule can never drift between the layers. Returns
+        (masked, unstash)."""
+        from flink_tpu.table.planner import stash_literals
+
+        return stash_literals(sql)
+
+    @staticmethod
+    def _strip_trailing_masked(masked: str):
+        """_strip_trailing's core on ALREADY-masked text (no literal can
+        interfere); order_spec comes back still masked."""
         limit = None
-        m = re.search(r"\s+LIMIT\s+(\d+)\s*;?\s*$", branch, re.IGNORECASE)
+        m = re.search(r"\s+LIMIT\s+(\d+)\s*;?\s*$", masked, re.IGNORECASE)
         if m:
             limit = int(m.group(1))
-            branch = branch[:m.start()]
+            masked = masked[:m.start()]
         order = None
         m = re.search(
             r"\s+ORDER\s+BY\s+"
             r"((?:(?!\b(?:WHERE|GROUP|HAVING|UNION|LIMIT)\b).)+?)\s*;?\s*$",
-            branch, re.IGNORECASE | re.DOTALL,
+            masked, re.IGNORECASE | re.DOTALL,
         )
         if m:
             order = m.group(1).strip()
-            branch = branch[:m.start()]
-        return branch, order, limit
+            masked = masked[:m.start()]
+        return masked, order, limit
+
+    @classmethod
+    def _strip_trailing(cls, branch: str):
+        """Pull a trailing ORDER BY / LIMIT off a query. Used where the
+        clause must apply AFTER a set operation (DISTINCT dedupes before
+        ORDER BY/LIMIT; a union's trailing clauses order/bound the WHOLE
+        union, not its last branch). Returns (core, order_spec, limit).
+
+        Literal-aware like _split_union: the clause regexes run on a
+        MASKED copy, so a trailing string literal containing 'ORDER BY
+        x' or 'LIMIT 5' (WHERE name = 'a ORDER BY b') is never stripped
+        as a clause."""
+        masked, unstash = cls._mask_literals(branch)
+        masked, order, limit = cls._strip_trailing_masked(masked)
+        return (
+            unstash(masked),
+            unstash(order) if order is not None else None,
+            limit,
+        )
 
     @staticmethod
     def _apply_trailing(t: Table, order: Optional[str],
@@ -860,19 +877,27 @@ class TableEnvironment:
         (ast_txt, optimized_txt, rules) when requested."""
         from flink_tpu.table import planner as pl
 
-        branch, n_distinct = re.subn(
-            r"^(\s*SELECT)\s+DISTINCT\b", r"\1", branch, count=1,
+        # ONE literal mask for the whole branch pipeline: the DISTINCT
+        # strip, the trailing-clause strip, AND the grammar regex run on
+        # masked text — a quoted value containing ORDER BY/LIMIT/WHERE
+        # can never be parsed as syntax. Clause texts unstash on access
+        # (_UnstashingMatch), so the planner sees the real SQL.
+        masked, unstash = self._mask_literals(branch)
+        masked, n_distinct = re.subn(
+            r"^(\s*SELECT)\s+DISTINCT\b", r"\1", masked, count=1,
             flags=re.IGNORECASE,
         )
         order = limit = None
         if n_distinct:
             # SQL evaluates DISTINCT before ORDER BY/LIMIT: dedupe the
             # full result, then sort and bound it
-            branch, order, limit = self._strip_trailing(branch)
-        m = self._SQL.match(branch)
+            masked, order, limit = self._strip_trailing_masked(masked)
+            if order is not None:
+                order = unstash(order)
+        m = self._SQL.match(masked)
         if not m:
             raise ValueError(f"unsupported SQL shape: {branch!r}")
-        root = self._build_logical(m)
+        root = self._build_logical(_UnstashingMatch(m, unstash))
         opt, rules = pl.optimize(root) if optimize else (root, [])
         render = (
             (pl.render(root), pl.render(opt), rules) if want_render
@@ -967,6 +992,26 @@ class TableEnvironment:
             self._apply_trailing(prev, g_order, g_limit, tail)
             sections.append("== Union Result ==\n" + "\n".join(tail))
         return "\n\n".join(sections)
+
+
+class _UnstashingMatch:
+    """re.Match proxy whose group() restores stashed string literals:
+    the grammar regex runs on MASKED text (no quoted value can match a
+    clause keyword), while the planner keeps seeing the real SQL."""
+
+    def __init__(self, m, unstash):
+        self._m = m
+        self._unstash = unstash
+
+    def group(self, *args):
+        g = self._m.group(*args)
+        if isinstance(g, str):
+            return self._unstash(g)
+        if isinstance(g, tuple):
+            return tuple(
+                self._unstash(x) if isinstance(x, str) else x for x in g
+            )
+        return g
 
 
 def _split_commas(s: str) -> List[str]:

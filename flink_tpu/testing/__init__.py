@@ -1,0 +1,5 @@
+"""Test-support tooling shipped inside the package (ref
+flink-test-utils' role): the deterministic fault-injection harness
+(`faults`) lives here so production modules can carry always-present,
+no-op-when-disabled injection hooks without importing anything from the
+test tree."""

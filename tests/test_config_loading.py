@@ -81,3 +81,46 @@ def test_controller_main_reads_conf_defaults(conf_dir, monkeypatch):
     with pytest.raises(SystemExit):
         pc.main([])
     assert captured["auth_token"] == "sekrit"
+
+# -- typed coercion (ADVICE r5: coerce from the DECLARED type, name the
+# -- key on parse failure, reject unrecognized boolean strings) ---------
+
+def test_option_with_none_default_still_coerces_by_declared_type():
+    from flink_tpu.core.config import ConfigOption
+
+    opt = ConfigOption("some.count", None, type=int)
+    assert Configuration({"some.count": "42"}).get(opt) == 42
+    assert Configuration().get(opt) is None
+
+
+def test_parse_failure_names_the_config_key():
+    from flink_tpu.core.config import ConfigOption
+
+    opt = ConfigOption("parallelism.default", 1)
+    with pytest.raises(ValueError, match="parallelism.default"):
+        Configuration({"parallelism.default": "zippy"}).get(opt)
+    fopt = ConfigOption("checkpoint.timeout", 600.0)
+    with pytest.raises(ValueError, match="checkpoint.timeout"):
+        Configuration({"checkpoint.timeout": "soon"}).get(fopt)
+
+
+def test_unrecognized_boolean_strings_rejected():
+    from flink_tpu.core.config import ConfigOption
+
+    opt = ConfigOption("checkpoint.async", False)
+    with pytest.raises(ValueError, match="checkpoint.async"):
+        Configuration({"checkpoint.async": "maybe"}).get(opt)
+    assert Configuration({"checkpoint.async": "on"}).get(opt) is True
+    assert Configuration({"checkpoint.async": "OFF"}).get(opt) is False
+    assert Configuration({"checkpoint.async": "1"}).get(opt) is True
+
+
+def test_bool_option_not_coerced_via_int_and_default_kept():
+    from flink_tpu.core.config import ConfigOption
+
+    # a bool-typed option must never fall into int("true") territory,
+    # and non-string values pass through untouched
+    opt = ConfigOption("watchdog.enabled", True)
+    assert Configuration({"watchdog.enabled": "false"}).get(opt) is False
+    assert Configuration({"watchdog.enabled": False}).get(opt) is False
+    assert Configuration().get(opt) is True

@@ -1,0 +1,675 @@
+"""Failure containment (ISSUE 4): checkpoint failure budgets, the
+watchdog-supervised step loop, DCN peer deadlines/reconnect, and the
+deterministic fault-injection harness (flink_tpu/testing/faults.py).
+
+The chaos soak drives one windowed job through a seeded schedule of
+filesystem write failures, slow materializer I/O, torn manifest writes,
+and prefetch-thread death, and asserts the exactly-once oracle plus
+zero hangs; the targeted tests pin each containment mechanism's
+acceptance criterion individually."""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime import dcn
+from flink_tpu.runtime.checkpoint import CheckpointStorage
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+N_KEYS = 200
+WINDOW = 10_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 50) * 1000
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 50) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None, **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = 256
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, source=None, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(source or GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("faults-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+def assert_chains_closed(ckpt_dir):
+    """No published manifest may reference a checkpoint directory that
+    does not exist — aborted checkpoints must never leave a hole a
+    retained chain spans."""
+    st = CheckpointStorage(str(ckpt_dir))
+    present = set(st.list_checkpoints())
+    for cid in present:
+        m = st.read_manifest(cid)
+        if m is not None:
+            missing = [c for c in m["chain"] if c not in present]
+            assert not missing, (
+                f"manifest of chk-{cid} chains over missing {missing}"
+            )
+
+
+# ---------------------------------------------------- injector framework
+
+def test_fault_injector_is_deterministic():
+    r1 = FaultInjector(
+        [FaultRule("p", prob=0.5, times=10**9,
+                   exc=None, action="sleep", delay_s=0.0)],
+        seed=7,
+    )
+    r2 = FaultInjector(
+        [FaultRule("p", prob=0.5, times=10**9,
+                   exc=None, action="sleep", delay_s=0.0)],
+        seed=7,
+    )
+    for _ in range(64):
+        r1.hit("p", {})
+        r2.hit("p", {})
+    assert [f["hit"] for f in r1.fired] == [f["hit"] for f in r2.fired]
+    assert r1.fired  # the coin actually came up at least once in 64
+
+
+def test_fault_injector_occurrence_index_and_times():
+    inj = FaultInjector([
+        FaultRule("w", exc=OSError("boom"), at=2),
+        FaultRule("e", exc=OSError("boom"), every=3, times=2),
+    ])
+    with faults.active(inj):
+        for i in range(6):
+            if i == 2:
+                with pytest.raises(OSError):
+                    faults.inject("w")
+            else:
+                faults.inject("w")
+        fired = 0
+        for i in range(12):
+            try:
+                faults.inject("e")
+            except OSError:
+                fired += 1
+        assert fired == 2          # every=3 capped by times=2
+    faults.inject("w")             # uninstalled: plain no-op
+
+
+# --------------------------------------------- checkpoint failure budget
+
+def test_write_failure_within_budget_aborts_only_that_checkpoint(tmp_path):
+    """THE containment criterion: one transient write failure within
+    checkpoint.tolerable-failures aborts only that checkpoint — the job
+    keeps running without a restart, the next checkpoint succeeds, and
+    recovery from the surviving chain is exactly-once."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=2,
+        **{"checkpoint.tolerable-failures": 2, "checkpoint.async": False},
+    )
+    inj = FaultInjector(
+        [FaultRule("ckpt.entries.write", exc=OSError("injected fs blip"),
+                   at=1)]
+    )
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert inj.fired_at("ckpt.entries.write"), "fault never fired"
+    assert m.restarts == 0
+    assert m.checkpoints_aborted == 1
+    assert got == expected(total)
+    stats = m.checkpoint_stats
+    aborted = [s for s in stats if s["status"] == "aborted"]
+    completed = [s for s in stats if s["status"] == "completed"]
+    assert len(aborted) == 1
+    assert "injected fs blip" in aborted[0]["failure_reason"]
+    # the NEXT checkpoint succeeded (later id than the aborted one)
+    assert any(s["id"] > aborted[0]["id"] for s in completed)
+    # no staging debris from the abort
+    assert not [d for d in os.listdir(tmp_path / "chk")
+                if d.endswith(".tmp")]
+    # budget state is served live
+    assert m.failure_budget.state()["total-failures"] == 1
+    # recovery from the surviving chain: a fresh job restores the latest
+    # cut and replays a longer stream — merged output is the no-failure
+    # truth (exactly-once across the abort)
+    got2 = run_job(build_env(2), total * 2,
+                   restore_from=str(tmp_path / "chk"))
+    assert {**got, **got2} == expected(total * 2)
+
+
+def test_budget_exhaustion_escalates_to_restart_strategy(tmp_path):
+    """Two CONSECUTIVE failures with tolerable-failures=1: the second
+    abort exhausts the budget and takes the configured RestartStrategy
+    path; recovery still converges to exactly-once."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{"checkpoint.tolerable-failures": 1, "checkpoint.async": False},
+    )
+    inj = FaultInjector([
+        FaultRule("ckpt.entries.write", exc=OSError("injected 1"), at=1),
+        FaultRule("ckpt.entries.write", exc=OSError("injected 2"), at=2),
+    ])
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert m.checkpoints_aborted == 2
+    assert m.restarts == 1
+    assert got == expected(total)
+
+
+def test_async_incremental_abort_rebases_chain(tmp_path):
+    """A torn manifest write in incremental mode: the failed delta's
+    dirty bits are gone, so the chain must RESET — the next published
+    checkpoint is a fresh full base and no retained manifest ever spans
+    the hole."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=1,
+        **{"checkpoint.mode": "incremental", "checkpoint.async": True,
+           "checkpoint.compact-every": 100,
+           "checkpoint.tolerable-failures": 3},
+    )
+    inj = FaultInjector(
+        [FaultRule("ckpt.manifest.write", action="torn", at=2)]
+    )
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert got == expected(total)
+    assert m.restarts == 0
+    assert m.checkpoints_aborted >= 1
+    aborted_ids = [s["id"] for s in m.checkpoint_stats
+                   if s["status"] == "aborted"]
+    assert aborted_ids
+    assert_chains_closed(tmp_path / "chk")
+    # the first checkpoint published after the hole re-based the chain
+    st = CheckpointStorage(str(tmp_path / "chk"))
+    after = [c for c in st.list_checkpoints() if c > min(aborted_ids)]
+    if after:       # (retention may have GC'd it, but normally present)
+        m0 = st.read_manifest(min(after))
+        assert m0 is None or m0["kind"] == "full"
+    # and the chain restores exactly-once
+    got2 = run_job(build_env(2), total * 2,
+                   restore_from=str(tmp_path / "chk"))
+    assert {**got, **got2} == expected(total * 2)
+
+
+def test_checkpoint_timeout_cancels_wedged_publish(tmp_path):
+    """A wedged materialization (injected slow I/O far beyond
+    checkpoint.timeout) is declared failed at a later barrier: its
+    publish is cancelled, the failure is counted, and the job finishes
+    exactly-once with closed chains on disk."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=1,
+        **{"checkpoint.mode": "incremental", "checkpoint.async": True,
+           "checkpoint.timeout": 0.4,
+           "checkpoint.tolerable-failures": 50},
+    )
+    inj = FaultInjector(
+        [FaultRule("materializer.task", action="sleep", delay_s=2.5,
+                   at=0)]
+    )
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert got == expected(total)
+    assert m.checkpoints_aborted >= 1
+    reasons = " | ".join(
+        s.get("failure_reason", "") for s in m.checkpoint_stats
+        if s["status"] == "aborted"
+    )
+    assert "checkpoint.timeout" in reasons or "wedged" in reasons
+    assert_chains_closed(tmp_path / "chk")
+
+
+def test_min_pause_declines_triggers(tmp_path):
+    env = build_env(
+        1, tmp_path / "chk", interval=1,
+        **{"checkpoint.min-pause": 120.0, "checkpoint.async": False},
+    )
+    got = run_job(env, 2048)
+    m = env.last_job.metrics
+    completed = [s for s in (m.checkpoint_stats or [])
+                 if s["status"] == "completed"]
+    assert len(completed) == 1          # everything after defers
+    assert m.checkpoints_declined == 1  # one decline per deferred trigger
+    assert got == expected(2048)
+
+
+def test_policy_unit_accounting():
+    from flink_tpu.checkpointing.policy import CheckpointFailurePolicy
+
+    p = CheckpointFailurePolicy(tolerable_failures=2, min_pause_s=0.05)
+    assert not p.on_aborted(1, "a")
+    assert not p.on_aborted(2, "b")
+    assert p.on_aborted(3, "c")            # 3 consecutive > 2
+    p.on_completed(4)
+    assert not p.on_aborted(5, "d")        # completion reset the run
+    s = p.state()
+    assert s["total-failures"] == 4 and s["continuous-failures"] == 1
+    assert not p.can_trigger()             # 50ms pause after the abort
+    time.sleep(0.06)
+    assert p.can_trigger()
+
+
+def test_materializer_slot_wait_timeout():
+    from flink_tpu.checkpointing.materializer import (
+        Materializer,
+        MaterializerStall,
+    )
+
+    mat = Materializer(slots=1)
+    release = threading.Event()
+    mat.submit("wedge", release.wait)
+    with pytest.raises(MaterializerStall, match="wedged"):
+        mat.wait_for_slot(timeout=0.3)
+    release.set()
+    mat.close()
+
+
+# ------------------------------------------------------------- watchdog
+
+def test_watchdog_trips_armed_phase_with_attribution():
+    from flink_tpu.runtime.watchdog import Watchdog, WatchdogError
+
+    trips = []
+    wd = Watchdog({"fire": 0.3}, interval_s=0.05,
+                  on_trip=trips.append).start()
+    try:
+        with pytest.raises(WatchdogError, match="fire"):
+            prev = wd.arm("fire")
+            try:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    time.sleep(0.01)
+                pytest.fail("watchdog never tripped")
+            finally:
+                wd.disarm(prev)
+        assert trips and trips[0].phase == "fire"
+        assert trips[0].elapsed_s >= 0.3
+    finally:
+        wd.stop()
+
+
+def test_watchdog_disarm_restores_nested_phase():
+    from flink_tpu.runtime.watchdog import Watchdog
+
+    wd = Watchdog({"outer": 100.0, "inner": 100.0})
+    prev = wd.arm("outer")
+    t0 = wd._armed[threading.get_ident()][1]
+    inner_prev = wd.arm("inner")
+    assert wd._armed[threading.get_ident()][0] == "inner"
+    wd.disarm(inner_prev)
+    phase, t_restored = wd._armed[threading.get_ident()][:2]
+    assert phase == "outer" and t_restored == t0   # t0 preserved
+    wd.disarm(prev)
+    assert threading.get_ident() not in wd._armed
+
+
+class StalledSource(GeneratorSource):
+    """Goes silent forever (short cooperative sleeps) once ``stall_at``
+    records have been polled — the distributed-hang stand-in."""
+
+    def __init__(self, fn, total, stall_at):
+        super().__init__(fn, total)
+        self.stall_at = stall_at
+
+    def poll(self, max_records):
+        if self.offset >= self.stall_at:
+            while True:
+                time.sleep(0.05)
+        return super().poll(max_records)
+
+
+def test_watchdog_converts_source_stall_into_attributed_failure():
+    """An injected mid-job stall produces a clean, attributed job
+    failure within the watchdog deadline instead of an indefinite
+    hang."""
+    from flink_tpu.runtime.watchdog import WatchdogError
+
+    env = build_env(
+        1,
+        **{"pipeline.prefetch": "on",
+           "watchdog.source-timeout": 1.5,
+           "watchdog.interval": 0.2},
+    )
+    src = StalledSource(gen, 4096, stall_at=1024)
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogError, match="source"):
+        run_job(env, 4096, source=src)
+    assert time.monotonic() - t0 < 30.0
+    assert env._live_metrics.watchdog_trips >= 1
+
+
+# ------------------------------------------------------------- DCN ring
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _ring_pair(**kw):
+    addrs = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    rings = [None, None]
+    errs = [None, None]
+
+    def build(pid):
+        try:
+            rings[pid] = dcn._RebalanceRing(pid, 2, addrs, **kw)
+        except Exception as e:      # surfaced by the caller's assert
+            errs[pid] = e
+
+    ts = [threading.Thread(target=build, args=(p,), daemon=True)
+          for p in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert errs == [None, None], errs
+    return rings
+
+
+def _empty_poll(n):
+    return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+            np.zeros(0, np.float32), False)
+
+
+def test_dcn_peer_stall_is_attributed_within_deadline():
+    """A peer that stops sending mid-job fails ATTRIBUTED (which peer,
+    how long) within the recv deadline — not an indefinite hang."""
+    rings = _ring_pair(recv_timeout_s=1.0, reconnect_attempts=0)
+    try:
+        t0 = time.monotonic()
+        # peer 1 never serves its side of the round
+        with pytest.raises(dcn.DCNPeerStalledError) as ei:
+            rings[0].exchange(4, _empty_poll)
+        assert time.monotonic() - t0 < 10.0
+        assert "peer" in str(ei.value) and "stalled" in str(ei.value)
+    finally:
+        for r in rings:
+            r.close()
+
+
+def test_dcn_transient_reset_recovers_without_loss():
+    """An injected socket reset mid-run: both sides resync their links
+    and retry the round; every donated record arrives exactly once
+    (the donation cache re-donates, never re-polls)."""
+    rings = _ring_pair(recv_timeout_s=5.0, reconnect_attempts=3,
+                       reconnect_backoff_s=0.05)
+    counters = [iter(range(0, 10**6)), iter(range(1000, 10**6))]
+
+    def poll_for(pid):
+        def poll_extra(n):
+            ks = np.asarray([next(counters[pid]) for _ in range(3)],
+                            np.int64)
+            return ks, ks.copy(), ks.astype(np.float32), False
+        return poll_extra
+
+    received = [[], []]
+    errs = [None, None]
+
+    def run(pid):
+        try:
+            for _ in range(5):
+                rk, _rt, _rv, _dd = rings[pid].exchange(
+                    3, poll_for(pid)
+                )
+                received[pid].append(np.asarray(rk))
+        except Exception as e:
+            errs[pid] = e
+
+    rule = FaultRule("dcn.send", action="call",
+                     fn=lambda ctx: ctx["sock"].close(), at=4)
+    try:
+        with faults.active(FaultInjector([rule])):
+            ts = [threading.Thread(target=run, args=(p,), daemon=True)
+                  for p in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in ts), "ring hung"
+        assert errs == [None, None], errs
+        # lossless: each side received its peer's records 0..14 /
+        # 1000..1014 in order, no gaps, no duplicates
+        got0 = np.concatenate(received[0]).tolist()
+        got1 = np.concatenate(received[1]).tolist()
+        assert got0 == list(range(1000, 1015))
+        assert got1 == list(range(0, 15))
+    finally:
+        for r in rings:
+            r.close()
+
+
+def test_dcn_serve_cache_rededonates_same_round_only():
+    """Asymmetric-abort protection: a RE-request for an already-served
+    round re-donates the cached records (the originals went into a dead
+    socket) WITHOUT re-polling; a new round polls fresh."""
+    rings = _ring_pair(recv_timeout_s=2.0)
+    try:
+        polls = []
+
+        def poll_extra(n):
+            polls.append(n)
+            ks = np.arange(len(polls) * 10, len(polls) * 10 + 2,
+                           dtype=np.int64)
+            return ks, ks.copy(), ks.astype(np.float32), False
+
+        r = rings[0]
+        first = r._serve_donation(2, 5, poll_extra)
+        again = r._serve_donation(2, 5, poll_extra)     # retry of round 5
+        assert polls == [2]                             # no second poll
+        assert again[0].tolist() == first[0].tolist() == [10, 11]
+        fresh = r._serve_donation(2, 6, poll_extra)     # next round
+        assert polls == [2, 2]
+        assert fresh[0].tolist() == [20, 21]
+    finally:
+        for ring in rings:
+            ring.close()
+
+
+def test_materializer_recover_bounded_by_timeout():
+    """A WEDGED write must not turn recovery into the hang it recovers
+    from: flush/recover give up after the timeout (the abandoned task
+    keeps running on the daemon thread)."""
+    from flink_tpu.checkpointing.materializer import Materializer
+
+    mat = Materializer(slots=1)
+    release = threading.Event()
+    mat.submit("wedge", release.wait)
+    t0 = time.monotonic()
+    assert mat.flush(raise_errors=False, timeout=0.4) is False
+    mat.recover(timeout=0.4)
+    assert time.monotonic() - t0 < 5.0
+    release.set()
+    mat.close()
+
+
+def test_gc_sweeps_stale_tmp_dirs(tmp_path):
+    """An aborted attempt's chk-<X>.tmp — even under a DIFFERENT id
+    than the barrier that counted the abort — is swept by the next
+    successful publish's GC."""
+    st = CheckpointStorage(str(tmp_path / "chk"), retain=2)
+    os.makedirs(st.path(7) + ".tmp")          # orphaned abort debris
+    ent = {
+        "key_hi": np.zeros(0, np.uint32), "key_lo": np.zeros(0, np.uint32),
+        "pane": np.zeros(0, np.int32), "value": np.zeros(0, np.float32),
+        "fresh": np.zeros(0, bool),
+    }
+    scal = {"watermark": 0, "fired_through": 0, "max_pane": 0,
+            "min_pane": 0, "dropped_late": 0, "dropped_capacity": 0}
+    st.write(9, ent, scal, None, {})
+    names = os.listdir(tmp_path / "chk")
+    assert not any(n.endswith(".tmp") for n in names), names
+    assert st.latest() == 9
+
+
+def test_dcn_peer_loss_after_reconnect_exhaustion():
+    """A peer that dies for good: bounded reconnect gives up with an
+    attributed DCNPeerLostError, not an endless redial loop."""
+    rings = _ring_pair(recv_timeout_s=5.0, reconnect_attempts=1,
+                       reconnect_backoff_s=0.05)
+    rings[1].close()                # peer gone, server socket included
+    try:
+        with pytest.raises(dcn.DCNPeerLostError):
+            rings[0].exchange(4, _empty_poll)
+    finally:
+        rings[0].close()
+
+
+# ----------------------------------------- generic checkpoint paths
+
+def test_generic_stage_write_failure_within_budget(tmp_path):
+    """The generic (pickled-payload) checkpoint paths share the failure
+    budget: a rolling-reduce stage survives one injected snapshot write
+    failure without a restart and keeps the per-record output exact."""
+    rng = np.random.default_rng(7)
+    events = [(int(rng.integers(0, 5)), float(rng.integers(1, 4)))
+              for _ in range(120)]
+    acc, expect = {}, []
+    for k, v in events:
+        acc[k] = acc.get(k, 0.0) + v
+        expect.append((k, acc[k]))
+    env = StreamExecutionEnvironment(Configuration({
+        "checkpoint.tolerable-failures": 2,
+    }))
+    env.set_parallelism(2).set_max_parallelism(8)
+    env.set_state_capacity(256)
+    env.batch_size = 8
+    env.enable_checkpointing(2, str(tmp_path / "chk"))
+    sink = CollectSink()
+    (
+        env.from_collection(events)
+        .key_by(lambda e: e[0])
+        .sum(lambda e: e[1])
+        .add_sink(sink)
+    )
+    inj = FaultInjector(
+        [FaultRule("ckpt.generic.write", exc=OSError("injected"), at=1)]
+    )
+    with faults.active(inj):
+        job = env.execute("rolling-budget")
+    assert inj.fired_at("ckpt.generic.write")
+    assert job.metrics.restarts == 0
+    assert job.metrics.checkpoints_aborted == 1
+    assert sink.results == expect
+
+
+# ----------------------------------------------------- ring-hang satellite
+
+def test_configured_ring_without_headroom_raises(tmp_path):
+    """Regression (ADVICE r5): window.ring-panes == panes_per_window + 1
+    used to enter a never-advancing grouping loop on the first catch-up
+    batch; it must be rejected at setup with a clear error."""
+    env = build_env(1, **{"window.ring-panes": 2})   # ppw=1 -> needs >= 4
+    with pytest.raises(ValueError, match="window.ring-panes"):
+        run_job(env, 512)
+    # the minimum accepted configured ring runs to completion
+    env = build_env(1, **{"window.ring-panes": 4})
+    assert run_job(env, 2048) == expected(2048)
+
+
+# ------------------------------------------------------------ chaos soak
+
+CHAOS_RULES = [
+    # transient filesystem write failures on two non-consecutive
+    # checkpoints (within the budget)
+    FaultRule("ckpt.entries.write", exc=OSError("chaos fs blip"), at=1),
+    FaultRule("ckpt.entries.write", exc=OSError("chaos fs blip"), at=4),
+    # torn manifest: partial bytes then failure
+    FaultRule("ckpt.manifest.write", action="torn", at=6),
+    # slow I/O on the materializer thread
+    FaultRule("materializer.task", action="sleep", delay_s=0.05, every=5,
+              times=4),
+    # prefetch-thread death mid-stream
+    FaultRule("ingest.producer", exc=RuntimeError("chaos thread death"),
+              at=8),
+]
+
+
+def _chaos_run(tmp_path, total):
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{"checkpoint.mode": "incremental", "checkpoint.async": True,
+           "checkpoint.compact-every": 100,
+           "checkpoint.tolerable-failures": 3,
+           "pipeline.prefetch": "on"},
+    )
+    inj = FaultInjector(list(CHAOS_RULES), seed=1234)
+    t0 = time.monotonic()
+    with faults.active(inj):
+        got = run_job(env, total)
+    wall = time.monotonic() - t0
+    m = env.last_job.metrics
+    # exactly-once oracle: the injected faults changed NOTHING about
+    # the results
+    assert got == expected(total)
+    # all three-plus fault classes actually fired
+    for point in ("ckpt.entries.write", "ckpt.manifest.write",
+                  "materializer.task", "ingest.producer"):
+        assert inj.fired_at(point), f"{point} never fired"
+    assert m.checkpoints_aborted >= 1
+    assert_chains_closed(tmp_path / "chk")
+    return m, wall
+
+
+def test_chaos_soak_fast(tmp_path):
+    """Tier-1 variant: a windowed job survives a seeded schedule of
+    fs write failures, a torn manifest, slow I/O, and prefetch-thread
+    death — exactly-once results, zero hangs."""
+    m, wall = _chaos_run(tmp_path, total=6144)
+    assert wall < 300.0             # "zero hangs", with CPU headroom
+
+
+@pytest.mark.slow
+def test_chaos_soak_full(tmp_path):
+    """Full soak: the same fault classes over a longer stream (dozens
+    of checkpoints, repeated slow-I/O windows)."""
+    m, wall = _chaos_run(tmp_path, total=32768)
+    assert wall < 900.0

@@ -542,6 +542,29 @@ def test_distinct_dedupes_before_limit():
     assert t2.to_rows() == [(30,), (20,)]
 
 
+def test_trailing_clause_inside_literal_not_stripped():
+    """ADVICE r5: _strip_trailing is literal-aware — a trailing string
+    literal containing 'ORDER BY x' is a VALUE, not a clause, and must
+    not be stripped (the old behavior cut the branch mid-literal)."""
+    te = _tenv()
+    t = te.sql_query(
+        "SELECT DISTINCT region FROM customers "
+        "WHERE region = 'eu ORDER BY cust'"
+    )
+    assert t.to_rows() == []      # no such region; branch NOT corrupted
+    # a REAL trailing LIMIT still strips with a literal elsewhere
+    t2 = te.sql_query(
+        "SELECT DISTINCT oid, 'x LIMIT 5' AS tag FROM orders LIMIT 2"
+    )
+    rows2 = t2.to_rows()
+    assert len(rows2) == 2 and all(r[1] == "x LIMIT 5" for r in rows2)
+    # CASE/END inside a literal never feeds the CASE rewriter
+    t3 = te.sql_query(
+        "SELECT oid, 'CASE WHEN END' AS c FROM orders WHERE oid = 1"
+    )
+    assert t3.to_rows() == [(1, "CASE WHEN END")]
+
+
 def test_union_dtype_mismatch_rejected():
     import pytest as _pytest
 

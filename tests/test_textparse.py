@@ -199,3 +199,28 @@ def test_socket_words_source_respects_poll_cap():
     src.close()
     srv.close()
     assert total == 2000 * 8
+
+
+def test_socket_words_poll_cap_holds_for_oversized_line():
+    """ADVICE r5: a single line wider than max_records tokens must
+    split across polls (carried offset state) so the poll contract
+    (<= max_records records per poll) actually holds."""
+    from flink_tpu.runtime.sources import SocketWordsSource
+
+    src = SocketWordsSource("unused", 0)
+    words = [f"w{i}" for i in range(50)]
+    src._buf = ("7 " + " ".join(words) + "\n").encode()
+    src._eof = True
+
+    chunks, done, polls = [], False, 0
+    while not done:
+        (cols, ts), done = src.poll(16)
+        polls += 1
+        assert len(cols["key"]) <= 16, "poll cap violated"
+        chunks.append(cols)
+        assert polls < 20
+    ids = np.concatenate([c["key"] for c in chunks])
+    assert len(ids) == 50
+    # order, words, and timestamps all survive the split
+    assert [src.word_of(int(i)) for i in ids] == words
+    assert all((np.asarray(c["ts"]) == 7).all() for c in chunks)
