@@ -679,6 +679,157 @@ def run_fault_overhead(total_events: int, cpu: bool):
     return (detail["watchdog_on"]["eps"], detail["watchdog_off"]["eps"])
 
 
+# ------------------------------------------------ device update ceiling
+DEVICE_CEILING_BATCH = 512   # bench.py --device-ceiling reports this
+
+
+def run_device_update_ceiling(total_events: int, cpu: bool):
+    """Device update-step + fire ceiling (ISSUE 5): a pre-staged
+    synthetic batch ring feeds the compiled update step directly — no
+    source, no prefetch, no emit path, no tunnel-quietness dependence —
+    so the compute ceiling VERDICT r5 could only infer from quiet-window
+    luck is measured per-round as a first-class number.
+
+    Two sweeps:
+      * fusion: K in {1, 4, 8} (pipeline.steps-per-dispatch megasteps)
+        x duplicate-key fraction in {0, 0.5, 0.9}. The geometry
+        (DEVICE_CEILING_BATCH=512, C=4096) sits in the
+        dispatch-overhead regime the fusion lever
+        attacks: per-dispatch fixed cost is a measurable share of the
+        step, as on the tunneled TPU runtime where it is ~100ms.
+      * precombine: wk.update's duplicate-key collapse ON vs OFF at each
+        duplicate fraction (K=1). On accelerators a duplicate-index
+        scatter serializes and the sort pays for itself; on XLA CPU the
+        sort costs more than the scatter it saves — both are reported,
+        per platform, so the default (platform-gated auto) is grounded
+        in this artifact instead of asserted.
+
+    subject = K=4 events/s at dup=0.5, baseline = K=1 (the fusion win);
+    the detail line carries the full grid + a fire-step probe.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec,
+        build_window_fire_step,
+        build_window_megastep,
+        build_window_update_step,
+        init_sharded_state,
+    )
+
+    n_dev = len(jax.devices())
+    ctx = MeshContext.create(n_dev, 128)
+    # dispatch-overhead regime: small enough that the fixed per-dispatch
+    # cost is a measurable share of the step (on the tunneled TPU that
+    # cost is ~100ms and ANY batch size sits in this regime); ring 9
+    # holds the 8 cycling panes without evicting unfired data
+    B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
+    N_SLOTS = 8
+    iters = max(128, min(8192, total_events // B))
+
+    def make_ring(dup, rng):
+        """N_SLOTS pre-staged batches; slot i's records land in pane i,
+        so the slot cycle exercises the pane-ring rotation without ever
+        evicting unfired data (the 9-pane ring holds the 8 cycling
+        panes plus the headroom pane). A
+        `dup` fraction of lanes hits a 64-key hot set (the duplicate-
+        collapse case); the rest are near-unique."""
+        slots = []
+        for i in range(N_SLOTS):
+            n_hot = int(B * dup)
+            lo = np.concatenate([
+                rng.integers(0, C - 1, B - n_hot),
+                rng.integers(0, 64, n_hot),
+            ]).astype(np.uint32)
+            rng.shuffle(lo)
+            ts = np.full(B, i * SLIDE + SLIDE // 2, np.int32)
+            slots.append(tuple(jax.device_put(a) for a in (
+                np.zeros(B, np.uint32), lo, ts,
+                np.ones(B, np.float32), np.ones(B, bool),
+            )))
+        return slots
+
+    WM_MIN = np.int32(-(2**31) + 1)   # sentinel: no fires mid-loop
+
+    def measure(K, dup, precombine):
+        spec = WindowStageSpec(
+            win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
+            red=wk.ReduceSpec("sum", jnp.float32),
+            capacity_per_shard=C, layout="direct", precombine=precombine,
+        )
+        step = (
+            build_window_update_step(ctx, spec) if K == 1
+            else build_window_megastep(ctx, spec, K)
+        )
+        fire = build_window_fire_step(ctx, spec)
+        state = init_sharded_state(ctx, spec)
+        slots = make_ring(dup, np.random.default_rng(7))
+        wm = np.full(n_dev, WM_MIN)
+        wmv = np.tile(WM_MIN, (n_dev, K))
+
+        def disp(state, it):
+            if K == 1:
+                return step(state, *slots[it % N_SLOTS], wm)
+            flat = [a for j in range(K)
+                    for a in slots[(it * K + j) % N_SLOTS]]
+            return step(state, *flat, wmv)
+
+        for w in range(3):                      # compile + settle
+            state, mon = disp(state, w)
+        # compile the fire step too (the sentinel watermark fires
+        # nothing, so the live state is untouched)
+        state, fr = fire(state, wm)
+        jax.block_until_ready(fr.counts)
+        # best-of-3: each cell recompiles its own step variant, and a
+        # single short pass is at the mercy of host scheduling noise —
+        # the ceiling claimed is the best the device actually did
+        n_disp = max(1, iters // K)
+        upd_dt = float("inf")
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            for it in range(n_disp):
+                state, mon = disp(state, it)
+            jax.block_until_ready(mon[1])
+            upd_dt = min(upd_dt, time.perf_counter() - t0)
+        # fire probe: one fire dispatch over the full key population
+        # (every pane due) — the drain half of the hot loop's ceiling
+        t1 = time.perf_counter()
+        state, fr = fire(state, np.full(n_dev, np.int32(2**31 - 5)))
+        jax.block_until_ready(fr.counts)
+        fire_ms = (time.perf_counter() - t1) * 1e3
+        return B * n_disp * K / upd_dt, fire_ms
+
+    platform = jax.default_backend()
+    pre_default = platform != "cpu"   # the executor's auto resolution
+    detail = {"platform": platform, "B": B, "C": C,
+              "iters": iters, "n_devices": n_dev,
+              "fusion": {}, "precombine": {}}
+    for dup in (0.0, 0.5, 0.9):
+        row = {}
+        for K in (1, 4, 8):
+            eps, fire_ms = measure(K, dup, pre_default)
+            row[f"K{K}"] = round(eps)
+            if K == 1:
+                row["fire_ms"] = round(fire_ms, 2)
+        row["K4_vs_K1"] = round(row["K4"] / row["K1"], 2)
+        row["K8_vs_K1"] = round(row["K8"] / row["K1"], 2)
+        detail["fusion"][f"dup_{dup}"] = row
+    for dup in (0.0, 0.5, 0.9):
+        on, _ = measure(1, dup, True)
+        off, _ = measure(1, dup, False)
+        detail["precombine"][f"dup_{dup}"] = {
+            "on": round(on), "off": round(off),
+            "ratio": round(on / off, 2),
+        }
+    print(json.dumps(
+        {"config": "device_update_ceiling", "detail": detail}), flush=True)
+    return (detail["fusion"]["dup_0.5"]["K4"],
+            detail["fusion"]["dup_0.5"]["K1"])
+
+
 CONFIGS = {
     "socket_wc": (run_socket_wc, 2_000_000),
     "count_min": (run_count_min, 4_000_000),
@@ -689,6 +840,7 @@ CONFIGS = {
     "observability_overhead": (run_observability_overhead, 2_000_000),
     "ingest_pipeline": (run_ingest_pipeline, 4_000_000),
     "fault_overhead": (run_fault_overhead, 4_000_000),
+    "device_update_ceiling": (run_device_update_ceiling, 2_000_000),
 }
 
 

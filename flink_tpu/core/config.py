@@ -204,6 +204,22 @@ class CoreOptions:
     PIPELINE_PREFETCH_DEPTH = ConfigOption(
         "pipeline.prefetch-depth", 2,
         "prepped batches the ingest queue holds ahead of the step loop")
+    # -- dispatch fusion + pre-combine (docs/performance.md) ------------
+    PIPELINE_STEPS_PER_DISPATCH = ConfigOption(
+        "pipeline.steps-per-dispatch", 1,
+        "K staged micro-batches fused into ONE jitted lax.scan megastep "
+        "dispatch; divides the fixed per-dispatch cost (Python, tracing, "
+        "and the ~100ms tunnel round trip) by K at the cost of K-batch "
+        "fire/checkpoint granularity. 1 = unfused (bit-identical "
+        "single-step dispatch)")
+    UPDATE_PRECOMBINE = ConfigOption(
+        "pipeline.update-precombine", "auto",
+        "auto | on | off — collapse duplicate (slot, pane) scatter keys "
+        "with one shared sort + segmented scan before the state scatter "
+        "(built-in reducers; duplicate scatter indices serialize on "
+        "TPU). auto enables it on accelerator backends and keeps the "
+        "CPU path unsorted (XLA's CPU sort costs more than the CPU "
+        "scatter it saves — measured in device_update_ceiling)")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)

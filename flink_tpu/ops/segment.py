@@ -51,6 +51,48 @@ def _bshape(flag, val):
     return flag.reshape(flag.shape + (1,) * extra)
 
 
+def segment_sort(seg_ids, valid):
+    """The ONE sort a batched pre-combine pays: order lanes by segment id
+    with invalid lanes pushed to the end (id = INT32_MAX).
+
+    Returns ``(order, ids_s, valid_s, seg_start, rep_mask)`` — the gather
+    permutation, the sorted ids, the sorted validity, the new-segment
+    flags, and the representative mask (last lane of each valid segment).
+    Callers gather any number of per-lane columns through ``order`` and
+    reduce them with ``reduce_sorted`` — the update kernel shares this
+    sort between the accumulator scatter and the changelog dirty bits
+    instead of sweeping the batch once per consumer.
+    """
+    big = jnp.int32(2**31 - 1)
+    ids = jnp.where(valid, seg_ids, big)
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    valid_s = valid[order]
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]]
+    )
+    # last lane of each segment = lane before the next segment start (or last)
+    seg_end = jnp.concatenate([ids_s[1:] != ids_s[:-1], jnp.ones((1,), bool)])
+    rep_mask = seg_end & (ids_s != big)
+    return order, ids_s, valid_s, seg_start, rep_mask
+
+
+def reduce_sorted(order, valid_s, seg_start, values, combine: Callable,
+                  neutral):
+    """Gather a pytree of per-lane columns through a ``segment_sort``
+    permutation and reduce each segment (neutral substituted in invalid
+    lanes). Returns [B, ...] where the representative (last) lane of each
+    segment holds the segment's full reduction."""
+    vals_s = jax.tree_util.tree_map(
+        lambda v, n: jnp.where(
+            _bshape(valid_s, v[order]), v[order], jnp.asarray(n, v.dtype)
+        ),
+        values,
+        neutral,
+    )
+    return segmented_reduce_sorted(vals_s, seg_start, combine)
+
+
 def preaggregate(seg_ids, values, valid, combine: Callable, neutral):
     """Pre-aggregate a batch by segment id with a general associative combine.
 
@@ -64,45 +106,42 @@ def preaggregate(seg_ids, values, valid, combine: Callable, neutral):
     one representative lane per distinct segment carries the full reduction;
     rep_mask selects it. Invalid lanes sort to the end (id = INT32_MAX).
     """
-    big = jnp.int32(2**31 - 1)
-    ids = jnp.where(valid, seg_ids, big)
-    order = jnp.argsort(ids)
-    ids_s = ids[order]
-    valid_s = valid[order]
-    vals_s = jax.tree_util.tree_map(
-        lambda v, n: jnp.where(
-            _bshape(valid_s, v[order]), v[order], jnp.asarray(n, v.dtype)
-        ),
-        values,
-        neutral,
-    )
-    seg_start = jnp.concatenate(
-        [jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]]
-    )
-    reduced = segmented_reduce_sorted(vals_s, seg_start, combine)
-    # last lane of each segment = lane before the next segment start (or last)
-    seg_end = jnp.concatenate([ids_s[1:] != ids_s[:-1], jnp.ones((1,), bool)])
-    rep_mask = seg_end & (ids_s != big)
+    order, ids_s, valid_s, seg_start, rep_mask = segment_sort(seg_ids, valid)
+    reduced = reduce_sorted(order, valid_s, seg_start, values, combine,
+                            neutral)
     return ids_s, rep_mask, reduced
 
 
-def scatter_combine(target, idx, updates, mask, kind: str):
+def scatter_combine(target, idx, updates, mask, kind: str,
+                    unique: bool = False):
     """Scatter a batch into state with a built-in reducer.
 
     kind: 'add' | 'min' | 'max' | 'set'. idx lanes with mask=False must be
     out of range already (or are forced out here); duplicates are fine for
     add/min/max (hardware-combined) and resolved arbitrarily for 'set'.
+
+    ``unique=True`` asserts the masked-in indices are pairwise distinct
+    (e.g. pre-combined segment representatives): XLA then lowers the
+    scatter without the duplicate-collision serialization. Masked-out
+    lanes get DISTINCT out-of-range indices (base + lane) so the promise
+    holds for them too — a shared sentinel would itself be a duplicate.
     """
-    safe_idx = jnp.where(mask, idx, target.shape[0])
+    n = target.shape[0]
+    if unique:
+        safe_idx = jnp.where(
+            mask, idx, n + jnp.arange(idx.shape[0], dtype=idx.dtype)
+        )
+    else:
+        safe_idx = jnp.where(mask, idx, n)
     at = target.at[safe_idx]
     if kind == "add":
-        return at.add(updates, mode="drop")
+        return at.add(updates, mode="drop", unique_indices=unique)
     if kind == "min":
-        return at.min(updates, mode="drop")
+        return at.min(updates, mode="drop", unique_indices=unique)
     if kind == "max":
-        return at.max(updates, mode="drop")
+        return at.max(updates, mode="drop", unique_indices=unique)
     if kind == "set":
-        return at.set(updates, mode="drop")
+        return at.set(updates, mode="drop", unique_indices=unique)
     raise ValueError(f"unknown scatter kind {kind!r}")
 
 

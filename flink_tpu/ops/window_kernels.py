@@ -37,7 +37,12 @@ from flink_tpu.core.keygroups import assign_to_key_group
 from flink_tpu.ops import hashtable
 from flink_tpu.ops.hashing import route_hash
 from flink_tpu.ops.hashtable import SlotTable
-from flink_tpu.ops.segment import preaggregate, scatter_combine
+from flink_tpu.ops.segment import (
+    preaggregate,
+    reduce_sorted,
+    scatter_combine,
+    segment_sort,
+)
 
 # np scalar, not jnp: a module-level jnp call would initialize the JAX
 # backend at import time (hanging any process whose platform override
@@ -415,6 +420,7 @@ def update(
     insert: bool = True,
     direct: bool = False,
     kg=None,
+    precombine: bool = False,
 ):
     """Apply one micro-batch of records to shard state (pure function).
 
@@ -437,6 +443,17 @@ def update(
     ``activity`` through the lagged monitoring channel and flips back to
     the insert step while new keys are arriving, so the fast path only
     ever runs when misses are rare (runtime/executor.py step tiering).
+
+    ``precombine=True`` (built-in reducers only) pre-aggregates the batch
+    per (slot, pane) BEFORE the state scatter: one shared sort by flat
+    accumulator index + a segmented scan, then the accumulator, touched,
+    and changelog-dirty scatters see only one representative lane per
+    distinct segment — duplicate scatter indices serialize on TPU, and a
+    hot-key batch is exactly the duplicate-heavy case. The rep scatters
+    carry ``unique_indices`` so XLA can skip the collision handling
+    entirely. (kg_fill skew telemetry keeps its own scatter: it counts
+    pre-late-check traffic by contract, a superset of the lanes this
+    sort orders.)
     """
     C = state.table.capacity
     R = win.ring
@@ -512,10 +529,16 @@ def update(
     # would silently drop its state from the next incremental checkpoint.
     # `kg`: the caller's precomputed per-lane key groups (the routing
     # bodies in runtime/step.py already have them — skip the re-hash).
+    # With precombine the marking moves AFTER the upsert so it can ride
+    # the shared sort: segment representatives cover every FITTING lane's
+    # group (same slot => same key => same group), and the rare nofit
+    # lanes get their own scatter below — together exactly the live set
+    # this eager scatter covers.
     KG = state.kg_dirty.shape[0]
-    if KG:
-        if kg is None:
-            kg = assign_to_key_group(route_hash(hi, lo, jnp), KG, jnp)
+    pre = precombine and red.kind in ("sum", "min", "max", "count")
+    if KG and kg is None:
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), KG, jnp)
+    if KG and not pre:
         kg_dirty = state.kg_dirty.at[
             jnp.where(live, kg.astype(jnp.int32), jnp.int32(KG))
         ].set(True, mode="drop")
@@ -580,9 +603,36 @@ def update(
         ).reshape((C * R,) + red.value_shape)
     elif red.kind in ("sum", "min", "max", "count"):
         upd = values if red.kind != "count" else jnp.ones_like(values)
-        acc = scatter_combine(acc, flat, upd.astype(red.dtype), live,
-                              {"sum": "add", "count": "add",
-                               "min": "min", "max": "max"}[red.kind])
+        upd = upd.astype(red.dtype)
+        op = {"sum": "add", "count": "add",
+              "min": "min", "max": "max"}[red.kind]
+        if pre:
+            # duplicate-key collapse: ONE sort by flat accumulator index,
+            # segmented-scan reduce, then unique-index rep scatters for
+            # acc + touched + kg_dirty (the shared-sort hoist)
+            order, ids_s, valid_s, seg_start, rep_mask = segment_sort(
+                flat, live
+            )
+            upd_s = reduce_sorted(order, valid_s, seg_start, upd,
+                                  red.combine_fn(), neutral)
+            acc = scatter_combine(acc, ids_s, upd_s, rep_mask, op,
+                                  unique=True)
+            touched = scatter_combine(
+                touched, ids_s, jnp.ones_like(ids_s, bool), rep_mask,
+                "set", unique=True,
+            )
+            if KG:
+                kg32 = kg.astype(jnp.int32)
+                kg_dirty = kg_dirty.at[
+                    jnp.where(rep_mask, kg32[order], jnp.int32(KG))
+                ].set(True, mode="drop")
+                # nofit lanes never reached a slot but still dirtied
+                # their group (they spill host-side); usually all-masked
+                kg_dirty = kg_dirty.at[
+                    jnp.where(nofit, kg32, jnp.int32(KG))
+                ].set(True, mode="drop")
+        else:
+            acc = scatter_combine(acc, flat, upd, live, op)
     else:
         ids, rep_mask, reduced = preaggregate(
             flat, values.astype(red.dtype), live,
@@ -595,7 +645,10 @@ def update(
             _expand(old_touched, old), red.combine_fn()(old, reduced), reduced
         )
         acc = acc.at[safe].set(merged, mode="drop")
-    touched = scatter_combine(touched, flat, jnp.ones_like(flat, bool), live, "set")
+    if not pre:
+        touched = scatter_combine(
+            touched, flat, jnp.ones_like(flat, bool), live, "set"
+        )
 
     # -- allowed lateness: records landing in already-fired windows mark
     # their pane "fresh" so those windows re-fire (ref late-firing panes)

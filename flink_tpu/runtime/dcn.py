@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import time
 from dataclasses import dataclass
@@ -147,6 +148,14 @@ class DCNJobSpec:
     peer_recv_timeout_s: float = 120.0
     peer_reconnect_attempts: int = 3
     peer_reconnect_backoff_s: float = 0.25
+    # pipeline.steps-per-dispatch plumb-through: the lockstep DCN plane
+    # runs ONE poll → route → exchange → update round per collective
+    # cycle (every host must dispatch the same step in the same round,
+    # and the rebalance/shuffle side channels synchronize per cycle), so
+    # K-fused dispatch does not compose with it. Values > 1 take the
+    # EXPLICIT single-step fallback: noted loudly at startup, never
+    # silently absorbed.
+    steps_per_dispatch: int = 1
 
 
 class GeneratorPartitionSource:
@@ -623,6 +632,18 @@ class _DCNRunnerBase:
                 f"unknown ingest_partitioner {mode!r} (forward | rescale "
                 f"| rebalance | shuffle | global)")
         self._mode = mode
+        if spec.steps_per_dispatch > 1:
+            # explicit single-step fallback (never silent): fused
+            # dispatch would hold batches across collective rounds, but
+            # every host must enter the same all_to_all in the same
+            # round — a host with a full slot and a host with a partial
+            # one would deadlock the lockstep
+            print(
+                f"[dcn] pipeline.steps-per-dispatch="
+                f"{spec.steps_per_dispatch} does not apply to the "
+                f"lockstep DCN plane; running single-step dispatch",
+                file=sys.stderr,
+            )
         self.ingested_local = 0   # records this host's lanes carried
         self._build_step()
         self._init_state()

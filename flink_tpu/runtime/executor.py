@@ -56,6 +56,8 @@ from flink_tpu.runtime.step import (
     build_kg_occupancy_step,
     build_window_fire_reduced_step,
     build_window_fire_step,
+    build_window_megastep,
+    build_window_megastep_exchange,
     build_window_update_step,
     build_window_update_step_exchange,
     clear_dirty,
@@ -511,6 +513,9 @@ class JobMetrics:
     steps: int = 0
     steps_fast: int = 0   # steps run on the lookup-only fast tier
     steps_exchanged: int = 0  # steps routed through the ICI all_to_all
+    # K-fused lax.scan dispatches (pipeline.steps-per-dispatch > 1);
+    # each one carries k_steps micro-batches of the `steps` counter
+    fused_dispatches: int = 0
     state_layout: str = ""  # "hash" | "direct" once the stage is set up
     # "mask" | "all_to_all" | "adaptive" once the stage is set up
     exchange_mode: str = ""
@@ -621,6 +626,7 @@ class JobMetrics:
     # MiniCluster's job detail endpoint)
     GAUGE_FIELDS = (
         "records_in", "records_out", "fires", "steps", "steps_fast",
+        "fused_dispatches",
         "dropped_late", "dropped_capacity", "restarts",
         "checkpoints_aborted", "checkpoints_declined", "watchdog_trips",
     )
@@ -1192,6 +1198,9 @@ class LocalExecutor:
                 if pipe.ts_transform is not None else 0
             ),
             origin_ms=env.config.get_int("dcn.origin-ms", 0),
+            steps_per_dispatch=env.config.get_int(
+                "pipeline.steps-per-dispatch", 1
+            ),
         )
         # physical ingest partitioner: the API annotation (.shuffle(),
         # .global_(), .rebalance(), .rescale() before key_by) wins, the
@@ -1384,6 +1393,34 @@ class LocalExecutor:
         # picks a variant per micro-batch at zero switch cost (shared
         # state layout)
         steps_by_route = {}
+        # -- dispatch fusion (pipeline.steps-per-dispatch=K): the fused
+        # slot collects K consecutive same-route planned batches and ONE
+        # lax.scan megastep applies them in a single dispatch, dividing
+        # the fixed dispatch/tracing/watchdog overhead by K. K=1 keeps
+        # the single-step path untouched. megasteps_by_route mirrors
+        # steps_by_route's [route][tier] shape.
+        k_fuse = max(1, env.config.get_int("pipeline.steps-per-dispatch", 1))
+        megasteps_by_route = {}
+        fused = ingest_mod.FusedBatchAccumulator(k_fuse)
+        fuse_gauge = [None]    # settable steps_per_dispatch gauge
+        # -- update-kernel pre-combine (pipeline.update-precombine):
+        # duplicate-key collapse before the state scatter (wk.update);
+        # generic reduces already pre-aggregate, sketches expand per
+        # register. auto is PLATFORM-gated: on accelerators a scatter
+        # with duplicate indices serializes (the win), but XLA's CPU
+        # sort costs ~4.5ms per 16k lanes (measured, device_update_
+        # ceiling bench) — far more than the CPU scatter it would save —
+        # so auto keeps the CPU path bit-identical to the unsorted
+        # scatter
+        pc_cfg = env.config.get_str("pipeline.update-precombine", "auto")
+        if pc_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.update-precombine must be auto|on|off, "
+                f"got {pc_cfg!r}"
+            )
+        use_precombine = pc_cfg == "on" or (
+            pc_cfg == "auto" and jax.default_backend() != "cpu"
+        )
         exchange_cap = [0]        # per-(src,dst) bucket lanes of the exchange
         force_route = [None]      # warmup override
         fire_step = None
@@ -1486,7 +1523,15 @@ class LocalExecutor:
                     "capacity"
                 )
             if spillable:
-                auto = (MON_EVERY * (OVF_LAG + 1) + 4) * B + 8192
+                # + k_fuse: a fused group's misses can only drain at the
+                # megastep boundary, so the detection window stretches by
+                # up to one group of batches. The sample stride is
+                # ceil(MON_EVERY / K) * K batches, not MON_EVERY: the
+                # skip counter advances K at a time and resets on
+                # crossing, so samples land only on dispatch boundaries
+                # (K=7 with MON_EVERY=8 samples every 14 batches)
+                stride = -(-MON_EVERY // k_fuse) * k_fuse
+                auto = (stride * (OVF_LAG + 1) + 4 + k_fuse) * B + 8192
                 ovf = ovf_cfg if ovf_cfg >= 0 else auto
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
@@ -1516,6 +1561,7 @@ class LocalExecutor:
                 capacity_per_shard=env.state_capacity_per_shard,
                 probe_len=env.config.get_int("state.probe-len", 16),
                 layout=layout[0],
+                precombine=use_precombine,
             )
             metrics.state_layout = layout[0]
             if not steps_by_route:
@@ -1575,6 +1621,32 @@ class LocalExecutor:
                         ) if build_fast else None,
                     }
                     exchange_cap[0] = ex_insert.bucket_cap
+                if k_fuse > 1:
+                    # K-fused megasteps mirror the [route][tier] variant
+                    # table for exactly the routes built above; partial
+                    # groups fall back to the single steps (bit-identical
+                    # by construction)
+                    if "mask" in steps_by_route:
+                        megasteps_by_route["mask"] = {
+                            "insert": build_window_megastep(
+                                ctx, spec, k_fuse, kg_fill=kg_stats_on,
+                            ),
+                            "fast": build_window_megastep(
+                                ctx, spec, k_fuse, insert=False,
+                                kg_fill=kg_stats_on,
+                            ) if build_fast else None,
+                        }
+                    if "exchange" in steps_by_route:
+                        megasteps_by_route["exchange"] = {
+                            "insert": build_window_megastep_exchange(
+                                ctx, spec, bpd, k_fuse, capf,
+                                kg_fill=kg_stats_on,
+                            ),
+                            "fast": build_window_megastep_exchange(
+                                ctx, spec, bpd, k_fuse, capf,
+                                insert=False, kg_fill=kg_stats_on,
+                            ) if build_fast else None,
+                        }
                 fire_step = build_window_fire_step(ctx, spec)
                 if sink_device_reduce:
                     # a second compiled fire variant with NO key/value
@@ -1618,6 +1690,7 @@ class LocalExecutor:
                 # fresh state
                 steps0, fast0, ex0 = (metrics.steps, metrics.steps_fast,
                                       metrics.steps_exchanged)
+                fused0 = metrics.fused_dispatches
                 for route in steps_by_route:
                     for tier in ("insert", "fast"):
                         if steps_by_route[route][tier] is None:
@@ -1632,6 +1705,18 @@ class LocalExecutor:
                         ):
                             self._empty_step(run_update, B_step[0], red,
                                              None)
+                for route in megasteps_by_route:
+                    for tier in ("insert", "fast"):
+                        if megasteps_by_route[route][tier] is None:
+                            continue
+                        step_mode[0] = tier
+                        with CompileEvents.stage(
+                            f"window-megastep-{route}-{tier}"
+                        ):
+                            run_update_fused(
+                                route, [_empty_fused_item(route)
+                                        for _ in range(k_fuse)]
+                            )
                 step_mode[0] = "insert"
                 force_route[0] = None
                 tier_quiet[0] = 0
@@ -1640,6 +1725,7 @@ class LocalExecutor:
                 # operator (and the tiering test) reads
                 metrics.steps, metrics.steps_fast = steps0, fast0
                 metrics.steps_exchanged = ex0
+                metrics.fused_dispatches = fused0
                 with CompileEvents.stage("window-fire"):
                     cf = run_fire(None)
                     jax.block_until_ready(cf.counts)
@@ -1890,6 +1976,7 @@ class LocalExecutor:
 
         def write_checkpoint():
             nonlocal next_cid, steps_at_ckpt, n_keys_logged, state
+            flush_fused()   # snapshot cut = megastep boundary (no-op at 1)
             t_ck0 = time.perf_counter()
             trigger_ms = time.time() * 1000
             cid = next_cid
@@ -2120,6 +2207,10 @@ class LocalExecutor:
             # ingest plan); resume() at the end bumps the epoch so every
             # batch prepped before this restore is discarded + replayed
             ingest.pause()
+            # pending fused batches belong to the pre-restore epoch: they
+            # were never applied and never marked, so dropping them here
+            # simply lets the rewound source replay them
+            fused.clear()
             if materializer is not None:
                 ck_io.recover()           # durable cuts still notify
             with ck_lock:
@@ -2248,6 +2339,7 @@ class LocalExecutor:
             if td is None:
                 raise RuntimeError("no state to savepoint yet")
             sp = ckpt.CheckpointStorage(path, retain=10**9)
+            flush_fused()   # savepoint cut = megastep boundary
             drain_fires(int(wm_strategy.current()))
             entries, scalars = ckpt.snapshot_window_state(state, win)
             entries = _fold_spill_entries(entries, _dump_spill_stores())
@@ -2476,6 +2568,9 @@ class LocalExecutor:
         env._kg_report = kg_report
         if self._job_group is not None:
             grp = self._job_group
+            # effective fused depth of the most recent dispatch (K for a
+            # megastep, 1 for single-step / partial-group flushes)
+            fuse_gauge[0] = grp.settable_gauge("steps_per_dispatch", 1)
 
             def _occ_stat(fn, default=0):
                 occ = kg_occ_cache[0]
@@ -2585,13 +2680,12 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
-            plan = ingest.plan
-            if staged is None and plan is not None and plan.staging:
-                # enqueue-only device_put (no wait): the arrays are fresh
-                # per-call, so there is no buffer-recycle hazard here
-                staged = ingest_mod.stage_batch_arrays(
-                    plan, route, hi, lo, ticks, values, valid
+            if staged is None:
+                s_args, did_stage = _stage_planned(
+                    (hi, lo, ticks, values, valid), route
                 )
+                if did_stage:
+                    staged = s_args
             if staged is not None:
                 state, (ovf_handle, act_handle, kgf_handle) = active(
                     state, *staged, wmv,
@@ -2634,8 +2728,141 @@ class LocalExecutor:
                 mon_skip[0] += 1
                 if mon_skip[0] >= MON_EVERY:
                     mon_skip[0] = 0
-                    mon_watch.append((ovf_handle, act_handle, kgf_handle))
+                    mon_watch.append(
+                        (ovf_handle, act_handle, kgf_handle, 1)
+                    )
                     check_overflow_pressure()
+
+        def _pad_planned(pb):
+            """Pad a planned batch's host arrays to step shape: the
+            5-tuple (hi, lo, ticks, values, valid) every update-step
+            variant takes. The ONE copy of the padding recipe."""
+            Bs = B_step[0]
+            return (
+                _pad(pb.hi, Bs, np.uint32),
+                _pad(pb.lo, Bs, np.uint32),
+                _pad(pb.ticks, Bs, np.int32),
+                _pad(pb.values, Bs, pb.values.dtype),
+                ingest_mod.prefix_mask(valid_tmpl[0], pb.n),
+            )
+
+        def _stage_planned(args, route):
+            """Stage a padded 5-tuple with the route's committed
+            shardings when the ingest plan stages (enqueue-only
+            device_put — the arrays are fresh per call, so there is no
+            buffer-recycle hazard). Returns (args, staged_mode)."""
+            plan = ingest.plan
+            if plan is not None and plan.staging:
+                return (
+                    ingest_mod.stage_batch_arrays(plan, route, *args),
+                    True,
+                )
+            return args, False
+
+        def _empty_fused_item(route):
+            """One zero batch in megastep-operand form (compile warmup)."""
+            Bs = B_step[0]
+            vals = (
+                np.zeros(Bs, np.uint32) if red.kind == "sketch"
+                else np.zeros((Bs,) + tuple(red.value_shape), np.float32)
+            )
+            args = (np.zeros(Bs, np.uint32), np.zeros(Bs, np.uint32),
+                    np.zeros(Bs, np.int32), vals, np.zeros(Bs, bool))
+            args, _ = _stage_planned(args, route)
+            return (args, None, None)
+
+        def run_update_fused(route, items):
+            """Dispatch ONE K-fused megastep: `items` is exactly k_fuse
+            (args, wm_ms, pb) tuples of the same route and staging mode
+            (the fused slot's grouping contract). A single jitted
+            lax.scan applies all K batches against donated state, so the
+            fixed per-dispatch cost — this function, tracing, the
+            dispatch round trip — is paid once for K micro-batches. The
+            monitoring handles come back with single-step shapes (the
+            megastep sums/finalizes over K on device), so the lagged
+            monitoring consumer is shared; the skip counter advances by
+            K to keep MON_EVERY's per-MICRO-BATCH sampling cadence (and
+            therefore the overflow-detection lag) unchanged."""
+            nonlocal state
+            t_d0 = time.perf_counter()
+            t_r1 = (
+                time.perf_counter()
+                if tracer is not None and tracer.active else None
+            )
+            tiers = megasteps_by_route[route]
+            tier = (
+                "fast"
+                if step_mode[0] == "fast" and tiers["fast"] is not None
+                else "insert"
+            )
+            active = tiers[tier]
+            flat = []
+            wmv = np.empty((ctx.n_shards, k_fuse), np.int32)
+            for i, (args, wm_ms, _pb) in enumerate(items):
+                flat.extend(args)
+                wmv[:, i] = np.int32(
+                    min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+                    if wm_ms is not None else -(2**31) + 1
+                )
+            state, (ovf_handle, act_handle, kgf_handle) = active(
+                state, *flat, wmv,
+            )
+            inflight.append(act_handle)
+            if len(inflight) > max_inflight:
+                inflight.popleft().block_until_ready()
+            t_d1 = time.perf_counter()
+            phase_acc["dispatch"] += t_d1 - t_d0
+            if t_r1 is not None:
+                tracer.rec("dispatch", t_r1, t_d1, route=route, tier=tier,
+                           step=metrics.steps, k=k_fuse)
+            metrics.steps += k_fuse
+            metrics.fused_dispatches += 1
+            if tier == "fast":
+                metrics.steps_fast += k_fuse
+            if route == "exchange":
+                metrics.steps_exchanged += k_fuse
+            if fuse_gauge[0] is not None:
+                fuse_gauge[0].set(k_fuse)
+            if win.overflow or kg_stats_on:
+                mon_skip[0] += k_fuse
+                if mon_skip[0] >= MON_EVERY:
+                    mon_skip[0] = 0
+                    # a megastep's kg_fill handle sums K batches' counts:
+                    # carry K so the sampled-batch denominator stays per
+                    # micro-batch
+                    mon_watch.append(
+                        (ovf_handle, act_handle, kgf_handle, k_fuse)
+                    )
+                    check_overflow_pressure()
+
+        def flush_fused():
+            """Dispatch whatever the fused slot holds: a full group as
+            one megastep, a partial group as sequential single steps
+            (bit-identical by construction — the scan body IS the single
+            step), then mark the LAST batch's offsets applied. That mark
+            is the megastep-boundary checkpoint cut: a snapshot taken
+            after this flush names offsets whose every prior record the
+            device state has absorbed, so exactly-once is preserved with
+            fusion on."""
+            if not len(fused):
+                return
+            route, staged_mode, items = fused.drain()
+            if len(items) >= k_fuse:
+                run_update_fused(route, items)
+            elif staged_mode:
+                for args, wm_ms, _pb in items:
+                    run_update(None, None, None, None, None, wm_ms,
+                               staged=args, route=route)
+                if fuse_gauge[0] is not None:
+                    fuse_gauge[0].set(1)
+            else:
+                for args, wm_ms, _pb in items:
+                    run_update(*args, wm_ms, route=route)
+                if fuse_gauge[0] is not None:
+                    fuse_gauge[0].set(1)
+            last_pb = items[-1][2]
+            if last_pb is not None:
+                ingest.mark_applied(last_pb)
 
         def run_fire(wm_ms, reduced: bool = False):
             nonlocal state
@@ -2677,16 +2904,18 @@ class LocalExecutor:
         def check_overflow_pressure():
             if len(mon_watch) <= OVF_LAG:
                 return
-            ovf_h, act_h, kgf_h = mon_watch.popleft()
+            ovf_h, act_h, kgf_h, n_batches = mon_watch.popleft()
             fill = int(np.asarray(ovf_h).max(initial=0))
             act = int(np.asarray(act_h).sum())
-            # skew telemetry: the sampled batch's per-key-group record
+            # skew telemetry: the sampled dispatch's per-key-group record
             # counts ([n_shards, maxp] — shards are disjoint, sum them;
-            # [n_shards, 0] when the steps were built without kg_fill)
+            # [n_shards, 0] when the steps were built without kg_fill).
+            # n_batches = micro-batches the handle covers (K for a fused
+            # megastep), so fill-per-sampled-batch stays a per-batch rate
             kgf = np.asarray(kgf_h)
             if kgf.size:
                 kg_fill_total[:] += kgf.sum(axis=0)
-                kg_fill_sampled[0] += 1
+                kg_fill_sampled[0] += n_batches
             # -- adaptive step tiering: while new keys are being PLACED,
             # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
@@ -3231,7 +3460,17 @@ class LocalExecutor:
             already chose the route and (with staging on) moved the
             padded arrays to the device, so this path is watermark
             arithmetic + one dispatch — no hashing, no padding, no
-            per-batch allocation on the step-loop thread."""
+            per-batch allocation on the step-loop thread.
+
+            With dispatch fusion on (pipeline.steps-per-dispatch=K > 1)
+            the batch lands in the fused slot instead; the slot flushes
+            as ONE megastep when full, and EARLY on a route/staging
+            change or a fire boundary (fires must see every pending
+            update, and a group never spans a pane crossing — fire
+            timing matches the sequential path). Returns True when the
+            batch is still pending in the slot: the caller must NOT mark
+            its offsets applied — the flush does, at the megastep
+            boundary (the exactly-once cut)."""
             nonlocal applied_max_pane, host_fired_pane
             wm_ms = (
                 wm_strategy.on_batch(pb.ts_max) if event_time
@@ -3248,28 +3487,38 @@ class LocalExecutor:
             ):
                 g_min_pane = pb.ticks_min // slide
                 fire_wm = min(wm_ms, int(td.to_ms(g_min_pane * slide)) - 1)
+                flush_fused()   # pending updates may feed the panes fired
                 drain_fires(fire_wm, time.perf_counter())
             applied_max_pane = (
                 g_max_pane if applied_max_pane is None
                 else max(applied_max_pane, g_max_pane)
             )
-            if pb.staged is not None:
+            wp = wm_pane_of(wm_ms)
+            fire_now = eager_fire or wp > host_fired_pane
+            deferred = False
+            if k_fuse > 1 and pb.route in megasteps_by_route:
+                if pb.staged is not None:
+                    args, staged_mode = pb.staged, True
+                else:
+                    args, staged_mode = _stage_planned(
+                        _pad_planned(pb), pb.route
+                    )
+                if not fused.compatible(pb.route, staged_mode):
+                    flush_fused()
+                fused.push(args, wm_ms, pb, pb.route, staged_mode)
+                if fused.full() or fire_now:
+                    flush_fused()
+                else:
+                    deferred = True
+            elif pb.staged is not None:
                 run_update(None, None, None, None, None, wm_ms,
                            staged=pb.staged, route=pb.route)
             else:
-                Bs = B_step[0]
-                run_update(
-                    _pad(pb.hi, Bs, np.uint32),
-                    _pad(pb.lo, Bs, np.uint32),
-                    _pad(pb.ticks, Bs, np.int32),
-                    _pad(pb.values, Bs, pb.values.dtype),
-                    ingest_mod.prefix_mask(valid_tmpl[0], pb.n),
-                    wm_ms, route=pb.route,
-                )
-            wp = wm_pane_of(wm_ms)
-            if eager_fire or wp > host_fired_pane:
+                run_update(*_pad_planned(pb), wm_ms, route=pb.route)
+            if fire_now:
                 drain_fires(wm_ms, time.perf_counter())
                 host_fired_pane = wp
+            return deferred
 
         def poll_cycle():
             nonlocal td, host_fired_pane, applied_max_pane
@@ -3299,6 +3548,7 @@ class LocalExecutor:
             end, n, now_ms = pb.end, pb.n, pb.now_ms
 
             metrics.records_in += n
+            deferred = False
             if n:
                 last_ingest_t[0] = pb.t_src
                 if td is None:
@@ -3317,19 +3567,30 @@ class LocalExecutor:
                     )
                     setup((int(np.min(pb.ts_ms)) // size_ms) * size_ms)
                 if pb.route is not None:
-                    _apply_planned(pb)
+                    deferred = _apply_planned(pb)
                 else:
                     _apply_general(pb)
             elif td is not None:
+                # idle poll: the source went quiet — apply any pending
+                # fused group now (latency guard, and this empty poll's
+                # offsets sit PAST the pending batches' polls, so marking
+                # them applied below is only correct once they dispatch)
+                flush_fused()
                 # idle poll: advance processing-time watermark
                 if not event_time:
                     wp = wm_pane_of(now_ms - 1)
                     if wp > host_fired_pane:
                         drain_fires(now_ms - 1, time.perf_counter())
                         host_fired_pane = wp
+            if end:
+                flush_fused()   # the stream is over: nothing may pend
+                deferred = False
             # this batch is now part of the device state: its offsets
-            # name the cut the next checkpoint/savepoint snapshots
-            ingest.mark_applied(pb)
+            # name the cut the next checkpoint/savepoint snapshots. A
+            # batch deferred into the fused slot is NOT part of it yet —
+            # its flush marks the cut instead (megastep boundary).
+            if not deferred:
+                ingest.mark_applied(pb)
             if not kv_mailbox.empty():
                 drain_kv_mailbox()
             ck_io.drain()
@@ -3344,6 +3605,8 @@ class LocalExecutor:
                 # is counted per deferred trigger, not per polled cycle
                 if ck_policy.can_trigger():
                     ck_declined[0] = False
+                    # write_checkpoint owns the megastep-boundary cut:
+                    # its first act flushes any pending fused group
                     write_checkpoint()
                 elif not ck_declined[0]:
                     ck_declined[0] = True
@@ -3365,6 +3628,9 @@ class LocalExecutor:
             re-planned after restore), catch-up replay spans that must be
             time-sliced, and host-chain polls expanded beyond B lanes."""
             nonlocal host_fired_pane, applied_max_pane
+            # dispatch order must match poll order: anything the fused
+            # slot still holds precedes this batch
+            flush_fused()
             hi, lo, values, ts_ms = pb.hi, pb.lo, pb.values, pb.ts_ms
             n, now_ms = pb.n, pb.now_ms
             ticks = td.to_ticks(ts_ms)

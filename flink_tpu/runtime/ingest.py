@@ -288,6 +288,60 @@ class StagingRing:
         return staged
 
 
+# ------------------------------------------------------- fused dispatch
+
+class FusedBatchAccumulator:
+    """Fused-dispatch slot for ``pipeline.steps-per-dispatch=K``: collects
+    up to K consecutive planned micro-batches that share a route and a
+    staging mode, which the executor then hands to ONE compiled lax.scan
+    megastep (runtime/step.py build_window_megastep*). The flush triggers
+    — route change, fire boundary, checkpoint/savepoint cut, idle poll,
+    end of stream, restore — are all step-loop state, so the executor
+    drives; this class owns the slot bookkeeping so the grouping contract
+    is unit-testable.
+
+    Exactly-once contract: a batch sitting in the slot has NOT been
+    dispatched, so its offsets must not become the applied cut until the
+    flush — the executor marks the LAST flushed batch applied, which is
+    the megastep-boundary snapshot cut."""
+
+    def __init__(self, k: int):
+        self.k = max(1, int(k))
+        self.items: list = []      # [(args 5-tuple, wm_ms | None, pb)]
+        self.route: Optional[str] = None
+        self.staged: Optional[bool] = None
+
+    def __len__(self):
+        return len(self.items)
+
+    def compatible(self, route: str, staged: bool) -> bool:
+        """Can a batch of this route/staging mode join the open group?"""
+        return not self.items or (
+            route == self.route and staged == self.staged
+        )
+
+    def push(self, args: Tuple, wm_ms, pb, route: str, staged: bool):
+        if not self.items:
+            self.route, self.staged = route, staged
+        self.items.append((args, wm_ms, pb))
+
+    def full(self) -> bool:
+        return len(self.items) >= self.k
+
+    def drain(self):
+        """Take the group: (route, staged, items). Resets the slot."""
+        items, self.items = self.items, []
+        route, staged = self.route, self.staged
+        self.route = self.staged = None
+        return route, staged, items
+
+    def clear(self):
+        """Restore path: pending batches belong to the pre-restore epoch
+        — they are discarded and replay from the rewound source."""
+        self.items = []
+        self.route = self.staged = None
+
+
 # ------------------------------------------------------------- pipeline
 
 class IngestPipeline:

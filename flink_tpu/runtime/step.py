@@ -45,6 +45,11 @@ class WindowStageSpec:
     # "hash" (open-addressing SlotTable) or "direct" (key == slot for
     # bounded non-negative int keys; see wk.init_state layout="direct")
     layout: str = "hash"
+    # duplicate-key collapse before the state scatter (wk.update
+    # precombine): sort + segmented-scan per (slot, pane), unique-index
+    # rep scatters. Only built-in reducers take it; resolved from
+    # pipeline.update-precombine by the executor.
+    precombine: bool = False
 
 
 def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
@@ -83,7 +88,8 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
             kg <= kg_end.astype(jnp.uint32)
         )
         state, _ = wk.update(state, spec.win, spec.red, hi, lo, ts, values,
-                             mine, direct=spec.layout == "direct", kg=kg)
+                             mine, direct=spec.layout == "direct", kg=kg,
+                             precombine=spec.precombine)
         state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
         state = jax.tree_util.tree_map(lambda x: x[None], state)
         fires = jax.tree_util.tree_map(lambda x: x[None], fires)
@@ -113,6 +119,37 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
     return step
 
 
+def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
+                      hi, lo, ts, values, valid, wm, maxp: int,
+                      insert: bool = True, kg_fill: bool = False):
+    """Shared per-shard body for the mask (replicated-batch) route: hash
+    to key groups, mask to owned groups, apply the window update, and
+    advance the shard watermark. Used by the single step AND the K-fused
+    megastep scan body so the mask semantics cannot diverge (the exchange
+    route shares exchange_update_shard the same way). ``wm`` is this
+    batch's watermark scalar. Returns (state', activity, kg_fill_counts);
+    kg_fill counts are the skew telemetry (observability.kg-stats),
+    statically compiled out to a zero-length array when off."""
+    import dataclasses as _dc
+
+    if spec.pre is not None:
+        values, ts, valid = spec.pre(values, ts, valid)
+    kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
+    mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
+        kg <= kg_end.astype(jnp.uint32)
+    )
+    state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
+                                values, mine, insert=insert,
+                                direct=spec.layout == "direct", kg=kg,
+                                precombine=spec.precombine)
+    state = _dc.replace(state, watermark=jnp.maximum(state.watermark, wm))
+    kgf = (
+        wk.kg_batch_fill(kg, mine, maxp) if kg_fill
+        else jnp.zeros(0, jnp.int32)
+    )
+    return state, activity, kgf
+
+
 def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
                              insert: bool = True,
                              kg_fill: bool = False):
@@ -130,8 +167,6 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
     insert flag): same state layout, so the executor switches between the
     two compiled steps per micro-batch at zero cost, driven by the lagged
     activity signal in the monitoring output."""
-    import dataclasses as _dc
-
     starts, ends = ctx.kg_bounds()
     starts = jnp.asarray(starts)
     ends = jnp.asarray(ends)
@@ -140,26 +175,11 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
 
     def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
-        kg_start, kg_end = kg_start[0], kg_end[0]
-        if spec.pre is not None:
-            values, ts, valid = spec.pre(values, ts, valid)
-        kg = assign_to_key_group(route_hash(hi, lo, jnp), maxp, jnp)
-        mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
-            kg <= kg_end.astype(jnp.uint32)
-        )
-        state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
-                                    values, mine, insert=insert,
-                                    direct=spec.layout == "direct", kg=kg)
-        state = _dc.replace(
-            state, watermark=jnp.maximum(state.watermark, wm[0])
+        state, activity, kgf = mask_update_shard(
+            state, spec, kg_start[0], kg_end[0], hi, lo, ts, values,
+            valid, wm[0], maxp, insert=insert, kg_fill=kg_fill,
         )
         ovf_n = state.ovf_n
-        # skew telemetry (observability.kg-stats): statically compiled
-        # out when off so the default step is identical to before
-        kgf = (
-            wk.kg_batch_fill(kg, mine, maxp) if kg_fill
-            else jnp.zeros(0, jnp.int32)
-        )
         return (
             jax.tree_util.tree_map(lambda x: x[None], state),
             ovf_n[None], activity[None], kgf[None],
@@ -219,7 +239,8 @@ def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
     state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
                                 cols["ts"], cols["values"], mine,
                                 insert=insert,
-                                direct=spec.layout == "direct")
+                                direct=spec.layout == "direct",
+                                precombine=spec.precombine)
     state = _dc.replace(
         state, dropped_capacity=state.dropped_capacity + n_over
     )
@@ -310,6 +331,175 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
     # .lower(), which the plain wrapper doesn't have)
     update_step.jit = _jit_step
     return update_step
+
+
+def _fused_batch_stack(K: int, flat):
+    """Stack the flat per-batch megastep operands back into [K, B] arrays.
+
+    ``flat`` is (hi_0, lo_0, ticks_0, values_0, valid_0, hi_1, ...): K
+    groups of 5. The stack happens INSIDE the jit so the executor can
+    hand over K individually device-staged batches (the ingest ring
+    stages them one poll at a time) without a host-side concat."""
+    return [
+        jnp.stack([flat[5 * i + j] for i in range(K)]) for j in range(5)
+    ]
+
+
+def build_window_megastep(ctx: MeshContext, spec: WindowStageSpec,
+                          k_steps: int, insert: bool = True,
+                          kg_fill: bool = False):
+    """K-step dispatch fusion (pipeline.steps-per-dispatch): ONE jitted
+    ``lax.scan`` applies a stack of K staged micro-batches against
+    donated state in a single dispatch. Every fused group divides the
+    fixed per-dispatch cost — Python ``run_update`` overhead, tracing,
+    watchdog arming, and on a tunneled runtime the ~100ms dispatch round
+    trip — by K, while the per-batch semantics (late checks against the
+    pre-batch watermark, per-batch watermark advance) are byte-for-byte
+    the sequential single steps': the scan body IS the single-step body.
+
+    Signature: ``megastep(state, hi_0, lo_0, ticks_0, values_0, valid_0,
+    ..., wmv)`` with wmv int32 [n_shards, K] (column i = batch i's
+    watermark vector). Returns ``(state', (ovf_n, activity, kg_fill))``
+    with the SAME monitoring shapes as the single step — ovf_n is the
+    post-scan fill (monotone within a dispatch, so final == max),
+    activity and kg_fill are summed over the K sub-steps — so the
+    executor's lagged-monitoring consumer needs no fused-path variant.
+    """
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    K = int(k_steps)
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+
+        def sub(st, xs):
+            s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+            st, act, kgf = mask_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+            )
+            return st, (act, kgf)
+
+        state, (acts, kgfs) = jax.lax.scan(
+            sub, state, (hi, lo, ts, values, valid, wm[0])
+        )
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], state),
+            ovf_n[None], act[None], kgf[None],
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(), P(),   # [K, B] batch stacks, replicated
+            P(SHARD_AXIS),             # wmv [n_shards, K]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def megastep(state, *flat):
+        *batches, wmv = flat
+        stacks = _fused_batch_stack(K, batches)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, wmv)
+        return st, (ovf_n, act, kgf)
+
+    megastep.k_steps = K
+    return megastep
+
+
+def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
+                                   batch_per_device: int, k_steps: int,
+                                   capacity_factor: float = 2.0,
+                                   insert: bool = True,
+                                   kg_fill: bool = False):
+    """Exchange-route megastep: the K-fused analog of
+    build_window_update_step_exchange — each scan sub-step runs the
+    shared ``exchange_update_shard`` body (bucket + all_to_all + masked
+    update), so the fused shuffle semantics cannot diverge from the
+    single-step route. Batch stacks arrive [K, B] SPLIT over devices on
+    the batch (second) axis."""
+    import dataclasses as _dc
+
+    from flink_tpu.parallel.exchange import bucket_capacity
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    n = ctx.n_shards
+    cap = bucket_capacity(batch_per_device, n, capacity_factor)
+    K = int(k_steps)
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+
+        def sub(st, xs):
+            s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+            st, act = exchange_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, n, maxp, cap, insert=insert,
+            )
+            st = _dc.replace(st, watermark=jnp.maximum(st.watermark, s_wm))
+            if kg_fill:
+                kg_local = assign_to_key_group(
+                    route_hash(s_hi, s_lo, jnp), maxp, jnp
+                )
+                kgf = wk.kg_batch_fill(kg_local, s_valid, maxp)
+            else:
+                kgf = jnp.zeros(0, jnp.int32)
+            return st, (act, kgf)
+
+        state, (acts, kgfs) = jax.lax.scan(
+            sub, state, (hi, lo, ts, values, valid, wm[0])
+        )
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], state),
+            ovf_n[None], act[None], kgf[None],
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            # [K, B] stacks SPLIT over devices on the batch axis
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def megastep(state, *flat):
+        *batches, wmv = flat
+        stacks = _fused_batch_stack(K, batches)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, wmv)
+        return st, (ovf_n, act, kgf)
+
+    megastep.k_steps = K
+    megastep.recv_lanes = n * cap
+    megastep.bucket_cap = cap
+    return megastep
 
 
 def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):

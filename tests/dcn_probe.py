@@ -1,0 +1,98 @@
+"""Collection-time capability probe for the DCN multi-process tests.
+
+The two-host DCN tests spawn worker PROCESSES that form one global JAX
+mesh and route records with cross-process collectives. Some containers'
+CPU backend cannot run those at all — every multi-device computation
+dies with ``XlaRuntimeError: INVALID_ARGUMENT: Multiprocess computations
+aren't implemented on the CPU backend`` during state init, so the whole
+ensemble fails identically on every commit. Failing 12 tests forever is
+worse than useless: real regressions hide behind "same 12 failures as
+the parent". This probe detects the limitation ONCE per session (two
+tiny subprocesses doing exactly the operation the runners die on) and
+the test modules ``pytest.skip`` with an explicit reason instead.
+
+Overrides: set ``FLINK_TPU_ASSUME_MULTIPROC=1`` to skip the probe and
+assume support (e.g. on a backend known-good), ``=0`` to assume the
+limitation without paying the probe.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+_PROBE_CODE = """
+import jax
+jax.distributed.initialize("{coord}", 2, {pid})
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()), ("x",))
+local = np.zeros(len(jax.local_devices()), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("x")), local
+)
+out = jax.jit(lambda a: a + 1)(arr)
+jax.block_until_ready(out)
+print("MULTIPROC_OK")
+"""
+
+_cache = None
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def multiprocess_collectives_supported(timeout_s: float = 90.0) -> bool:
+    """True iff this backend can run a 2-process global-mesh computation
+    (the minimal operation every DCN runner performs at state init)."""
+    global _cache
+    if _cache is not None:
+        return _cache
+    override = os.environ.get("FLINK_TPU_ASSUME_MULTIPROC")
+    if override is not None:
+        _cache = override.strip() not in ("0", "false", "no")
+        return _cache
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "").replace(
+            "--xla_force_host_platform_device_count=8", ""
+        ).strip() + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c",
+             _PROBE_CODE.format(coord=coord, pid=p)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for p in range(2)
+    ]
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            # a hung distributed init cannot run the ensemble tests
+            # either — treat as unsupported, loudly
+            for q in procs:
+                q.kill()
+            ok = False
+            break
+        if p.returncode != 0 or b"MULTIPROC_OK" not in out:
+            ok = False
+    _cache = ok
+    return ok
+
+
+SKIP_REASON = (
+    "this container's CPU backend lacks multi-process collectives "
+    "(XLA: \"Multiprocess computations aren't implemented on the CPU "
+    "backend\") — the two-process DCN ensemble cannot initialize its "
+    "global mesh; pre-existing environment limitation, not a regression "
+    "(set FLINK_TPU_ASSUME_MULTIPROC=1 to force-run)"
+)

@@ -19,6 +19,15 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(__file__))
 import dcn_jobs as J  # noqa: E402
+from dcn_probe import (  # noqa: E402
+    SKIP_REASON,
+    multiprocess_collectives_supported,
+)
+
+# collection-time capability gate (see test_dcn.py / dcn_probe.py)
+pytestmark = pytest.mark.skipif(
+    not multiprocess_collectives_supported(), reason=SKIP_REASON
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NPROC = 2

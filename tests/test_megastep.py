@@ -1,0 +1,365 @@
+"""Dispatch fusion (ISSUE 5): K-step lax.scan megasteps + update-kernel
+pre-combine.
+
+* bit-exact equivalence of one K-fused megastep vs K sequential single
+  steps — hash + direct layouts, mask + exchange routes, precombine on
+  and off (the scan body IS the single-step body, so nothing may drift),
+* duplicate-heavy (hot-key) pre-combine parity against the scalar
+  oracle, and precombine-on == precombine-off window sums,
+* the fused executor loop end-to-end: exact window sums with K>1, full
+  groups actually dispatched as megasteps, K=1 default untouched,
+* mid-megastep crash/restore exactly-once with checkpoint.mode:
+  incremental + prefetch + K>1 (the megastep-boundary snapshot cut),
+* FusedBatchAccumulator grouping contract at the unit level.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import hash64_host
+from flink_tpu.parallel.mesh import MeshContext
+from flink_tpu.runtime import ingest as ingest_mod
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.runtime.step import (
+    WindowStageSpec,
+    build_window_megastep,
+    build_window_megastep_exchange,
+    build_window_update_step,
+    build_window_update_step_exchange,
+    init_sharded_state,
+)
+
+K = 4
+B = 256
+
+
+def _split(keys):
+    h = hash64_host(np.asarray(keys, dtype=np.int64))
+    return ((h >> np.uint64(32)).astype(np.uint32),
+            (h & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _spec(layout="hash", precombine=False, red_kind="sum"):
+    return WindowStageSpec(
+        win=wk.WindowSpec(10, 10, ring=8, fires_per_step=4),
+        red=wk.ReduceSpec(red_kind, jnp.float32),
+        capacity_per_shard=512, layout=layout, precombine=precombine,
+    )
+
+
+def _batches(rng, layout, k=K):
+    out = []
+    for i in range(k):
+        if layout == "direct":
+            hi = np.zeros(B, np.uint32)
+            lo = rng.integers(0, 500, B).astype(np.uint32)
+        else:
+            hi, lo = _split(rng.integers(0, 100, B).astype(np.int64))
+        ts = rng.integers(0, 40, B).astype(np.int32)
+        vals = rng.integers(1, 5, B).astype(np.float32)
+        out.append((hi, lo, ts, vals, np.ones(B, bool),
+                    np.full(8, np.int32(i * 3))))
+    return out
+
+
+def _flat(batches):
+    return [a for b in batches for a in b[:5]]
+
+
+def _wmv(batches):
+    return np.stack([b[5] for b in batches], axis=1).astype(np.int32)
+
+
+def _assert_states_bitexact(s1, s2):
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("layout", ["hash", "direct"])
+@pytest.mark.parametrize("precombine", [False, True])
+def test_megastep_bitexact_vs_sequential_mask(rng, layout, precombine):
+    """One K-fused mask-route megastep == K sequential single steps,
+    bit for bit, across every state leaf (acc, table, counters, dirty
+    bits) — for both state layouts and with/without pre-combine."""
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    spec = _spec(layout, precombine)
+    single = build_window_update_step(ctx, spec)
+    mega = build_window_megastep(ctx, spec, K)
+    s1 = init_sharded_state(ctx, spec)
+    s2 = init_sharded_state(ctx, spec)
+    batches = _batches(rng, layout)
+    for (hi, lo, ts, vals, valid, wm) in batches:
+        s1, _ = single(s1, hi, lo, ts, vals, valid, wm)
+    s2, mon = mega(s2, *_flat(batches), _wmv(batches))
+    _assert_states_bitexact(s1, s2)
+    # monitoring shapes match the single step's (shared consumer)
+    ovf_n, act, kgf = mon
+    assert np.asarray(ovf_n).shape == (8,)
+    assert np.asarray(act).shape == (8,)
+
+
+@pytest.mark.parametrize("precombine", [False, True])
+def test_megastep_bitexact_vs_sequential_exchange(rng, precombine):
+    """Exchange-route megastep (all_to_all inside the scan body) == K
+    sequential exchange steps, bit for bit."""
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    spec = _spec("hash", precombine)
+    bpd = B // 8
+    single = build_window_update_step_exchange(ctx, spec, bpd, 2.0)
+    mega = build_window_megastep_exchange(ctx, spec, bpd, K, 2.0)
+    s1 = init_sharded_state(ctx, spec)
+    s2 = init_sharded_state(ctx, spec)
+    batches = _batches(rng, "hash")
+    for (hi, lo, ts, vals, valid, wm) in batches:
+        s1, _ = single(s1, hi, lo, ts, vals, valid, wm)
+    s2, _ = mega(s2, *_flat(batches), _wmv(batches))
+    _assert_states_bitexact(s1, s2)
+
+
+# ---------------------------------------------------------- pre-combine
+
+def test_precombine_hot_key_parity_with_scalar_oracle(rng):
+    """Duplicate-heavy batches (90% of lanes on 8 hot keys): the
+    pre-combined update's fired window sums equal a scalar dict oracle,
+    and equal the non-precombined path (sums of small integers are exact
+    in float32, so the segmented-scan reorder cannot hide behind
+    tolerance)."""
+    from flink_tpu.runtime.step import build_window_fire_step
+
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    oracle = {}
+    results = {}
+    for precombine in (False, True):
+        spec = _spec("hash", precombine)
+        step = build_window_update_step(ctx, spec)
+        fire = build_window_fire_step(ctx, spec)
+        state = init_sharded_state(ctx, spec)
+        r = np.random.default_rng(7)   # same stream for both paths
+        for i in range(6):
+            n_hot = (9 * B) // 10
+            keys = np.concatenate([
+                r.integers(0, 8, n_hot),          # hot set
+                r.integers(100, 400, B - n_hot),  # long tail
+            ]).astype(np.int64)
+            r.shuffle(keys)
+            ts = np.full(B, i * 10 + 5, np.int32)
+            vals = r.integers(1, 4, B).astype(np.float32)
+            if not precombine:   # oracle built once
+                for k, t, v in zip(keys.tolist(), ts.tolist(),
+                                   vals.tolist()):
+                    we = (t // 10 + 1) * 10
+                    oracle[(we, k)] = oracle.get((we, k), 0.0) + v
+            hi, lo = _split(keys)
+            state, _ = step(state, hi, lo, ts, vals, np.ones(B, bool),
+                            np.full(8, np.int32(i * 10 - 1)))
+        got = {}
+        kid_of = {}
+        for k in set(k for (_, k) in oracle):
+            h, l = _split(np.asarray([k]))
+            kid_of[(int(h[0]) << 32) | int(l[0])] = k
+        while True:   # each fire step evaluates up to F window ends
+            state, fr = fire(state, np.full(8, np.int32(10**6)))
+            counts = np.asarray(fr.counts)
+            lanes = np.asarray(fr.lane_valid)
+            ends = np.asarray(fr.window_end_ticks)
+            khi = np.asarray(fr.key_hi)
+            klo = np.asarray(fr.key_lo)
+            values = np.asarray(fr.values)
+            for sh in range(counts.shape[0]):
+                for f in np.nonzero(lanes[sh])[0]:
+                    for j in range(int(counts[sh, f])):
+                        kid = (int(khi[sh, f, j]) << 32) | int(
+                            klo[sh, f, j]
+                        )
+                        got[(int(ends[sh, f]), kid_of[kid])] = float(
+                            values[sh, f, j]
+                        )
+            if not lanes.any():
+                break
+        results[precombine] = got
+        assert got == {k: v for k, v in oracle.items()}, (
+            f"precombine={precombine} diverged from the scalar oracle"
+        )
+    assert results[False] == results[True]
+
+
+def test_precombine_marks_same_dirty_groups(rng):
+    """The rep-scatter changelog marking covers exactly the key groups
+    the eager per-lane scatter marks (incremental checkpoints must not
+    lose coverage to the shared-sort hoist)."""
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    dirt = {}
+    for precombine in (False, True):
+        spec = _spec("hash", precombine)
+        step = build_window_update_step(ctx, spec)
+        state = init_sharded_state(ctx, spec)
+        r = np.random.default_rng(11)
+        hi, lo = _split(r.integers(0, 50, B).astype(np.int64))
+        ts = np.full(B, 5, np.int32)
+        state, _ = step(state, hi, lo, ts, np.ones(B, np.float32),
+                        np.ones(B, bool), np.full(8, np.int32(-1)))
+        dirt[precombine] = np.asarray(state.kg_dirty)
+    assert np.array_equal(dirt[False], dirt[True])
+
+
+# ------------------------------------------------- fused executor loop
+
+N_KEYS = 200
+WINDOW = 10_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    # slow event time: ~8 micro-batches per pane, so fused groups fill
+    return cols, (idx // 2000) * 1000
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 2000) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None, **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = B
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, source=None, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(source or GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("megastep-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+def test_fused_executor_exact_and_actually_fused():
+    total = 16384
+    env = build_env(2, **{"pipeline.steps-per-dispatch": K})
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    # full groups really dispatched as megasteps (not all-partial flush)
+    assert m.fused_dispatches > 0
+    assert m.steps == total // B
+
+
+def test_k1_default_has_no_fused_dispatches():
+    total = 4096
+    env = build_env(2)
+    got = run_job(env, total)
+    assert got == expected(total)
+    assert env.last_job.metrics.fused_dispatches == 0
+
+
+class FailingSource(GeneratorSource):
+    """Raises once when crossing fail_at — mid-stream, while fused
+    groups are pending/forming (the poll runs on the prefetch thread)."""
+
+    def __init__(self, fn, total, fail_at):
+        super().__init__(fn, total)
+        self.fail_at = fail_at
+        self.failed = False
+        self.poll_thread_names = set()
+
+    def poll(self, max_records):
+        self.poll_thread_names.add(threading.current_thread().name)
+        out = super().poll(max_records)
+        if not self.failed and self.offset >= self.fail_at:
+            self.failed = True
+            raise RuntimeError("injected failure")
+        return out
+
+
+def test_fused_crash_restore_exactly_once(tmp_path):
+    """Mid-megastep crash with checkpoint.mode=incremental + prefetch +
+    K>1, restore, exactly-once counts: the snapshot cut is the offsets
+    of the LAST batch of the last flushed group, so batches pending in
+    the fused slot at the crash replay without double-counting."""
+    total = 16384
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
+           "checkpoint.async": True, "pipeline.steps-per-dispatch": K},
+    )
+    src = FailingSource(gen, total, fail_at=total // 2)
+    got = run_job(env, total, source=src)
+    m = env.last_job.metrics
+    assert m.restarts == 1
+    assert m.fused_dispatches > 0          # the scenario really fused
+    assert got == expected(total)          # no skips, no double counts
+
+
+def test_fused_checkpoint_cadence_exact(tmp_path):
+    """Periodic checkpoints at a cadence that lands MID-group (interval
+    3 micro-batches vs K=4): every trigger flushes the fused slot first,
+    checkpoints get written, results stay exact, and fusion still
+    happens between triggers."""
+    total = 16384
+    env = build_env(
+        2, tmp_path / "chk", interval=3,
+        **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
+           "checkpoint.async": True, "pipeline.steps-per-dispatch": K},
+    )
+    got = run_job(env, total)
+    m = env.last_job.metrics
+    assert got == expected(total)
+    assert m.checkpoint_stats, "no checkpoints were written"
+    assert m.fused_dispatches > 0
+
+
+# ------------------------------------------------- accumulator contract
+
+def test_fused_accumulator_grouping():
+    acc = ingest_mod.FusedBatchAccumulator(3)
+    assert len(acc) == 0 and not acc.full()
+    assert acc.compatible("mask", True)
+    acc.push(("a",), 1, "pb1", "mask", True)
+    assert acc.compatible("mask", True)
+    assert not acc.compatible("exchange", True)   # route change -> flush
+    assert not acc.compatible("mask", False)      # staging change -> flush
+    acc.push(("b",), 2, "pb2", "mask", True)
+    assert not acc.full()
+    acc.push(("c",), 3, "pb3", "mask", True)
+    assert acc.full()
+    route, staged, items = acc.drain()
+    assert route == "mask" and staged is True and len(items) == 3
+    assert items[-1][2] == "pb3"                  # last pb = applied cut
+    assert len(acc) == 0 and acc.compatible("exchange", False)
+    acc.push(("d",), 4, "pb4", "exchange", False)
+    acc.clear()                                   # restore path discards
+    assert len(acc) == 0
