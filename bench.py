@@ -336,6 +336,11 @@ def main():
                     help="run ONLY the device_update_ceiling microbench "
                          "(pre-staged batch ring, no source): K-fusion x "
                          "duplicate-fraction grid + precombine on/off")
+    ap.add_argument("--mttr", action="store_true",
+                    help="run ONLY the mttr_recovery drill: detect-to-"
+                         "first-fire of cold-remote vs local vs warm "
+                         "recovery paths, per-phase breakdowns in the "
+                         "detail JSON")
     args = ap.parse_args()
     if args.batch:
         BATCH = args.batch
@@ -416,6 +421,27 @@ def main():
             "unit": "events/s",
             "vs_baseline": round(k4 / k1, 2) if k1 else 0,
             "batch": DEVICE_CEILING_BATCH,
+        }))
+        return
+
+    if args.mttr:
+        # MTTR drill mode (ISSUE 6): the detail JSON line with per-phase
+        # timings prints from inside the config; this summary line is
+        # the acceptance number (cold-remote / warm >= 2)
+        if args.cpu:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from bench_configs import run_mttr_recovery
+
+        cold_ms, warm_ms = run_mttr_recovery(args.events, args.cpu)
+        print(json.dumps({
+            "metric": "MTTR detect-to-first-fire, cold-remote vs warm",
+            "value": warm_ms,
+            "unit": "ms",
+            "vs_baseline": round(cold_ms / warm_ms, 2) if warm_ms else 0,
+            "cold_remote_ms": cold_ms,
         }))
         return
 

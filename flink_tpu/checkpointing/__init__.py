@@ -24,6 +24,12 @@ This package is the micro-batch SPMD redesign of both:
   group, purge-cutoff filtered) back into one logical snapshot, so
   restore — including rescale re-bucketing — reuses the existing
   ``restore_window_state`` path unchanged.
+* ``local``       — the task-local snapshot cache (ref task-local
+  recovery): every published checkpoint mirrors into a checksum-
+  verified host-side cache whose retention follows the primary
+  chain-closure GC; restore prefers local per chain member and falls
+  back to primary on miss/corruption (the MTTR fast path,
+  docs/fault-tolerance.md).
 * ``policy``      — the coordinator-side failure budget (ref
   CheckpointFailureManager): ``checkpoint.tolerable-failures`` /
   ``checkpoint.timeout`` / ``checkpoint.min-pause``, so a transient
@@ -45,6 +51,11 @@ from flink_tpu.checkpointing.changelog import (  # noqa: F401
     dirty_shard_rows,
     entry_key_groups,
     filter_entries_to_key_groups,
+)
+from flink_tpu.checkpointing.local import (  # noqa: F401
+    LocalCacheMiss,
+    LocalSnapshotCache,
+    local_cache_from_config,
 )
 from flink_tpu.checkpointing.manifest import (  # noqa: F401
     MANIFEST_NAME,

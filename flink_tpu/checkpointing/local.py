@@ -1,0 +1,246 @@
+"""Task-local snapshot cache (ref Flink task-local recovery,
+TaskLocalStateStoreImpl): a host-side secondary copy of every published
+checkpoint, so recovery fetches state from the machine it runs on
+instead of re-pulling every blob from primary checkpoint storage.
+
+The reference's insight is that the PRIMARY copy exists for durability
+and the LOCAL copy exists for MTTR: a restore that finds its state on
+local disk skips the remote fetch entirely, and a restore that finds the
+local copy missing or corrupt falls back to primary per chain member —
+the cache can only ever make recovery faster, never wrong. Three
+properties make that safe here:
+
+* **Mirror-at-publish.** ``CheckpointStorage.write`` mirrors the
+  checkpoint directory into the cache only AFTER the primary's atomic
+  rename, so the cache never holds a cut that is not durable. The mirror
+  itself is also staged + renamed, so a crash mid-mirror leaves debris,
+  never a half-entry that verifies.
+* **Per-blob checksums.** Every cached file's CRC is recorded in a
+  ``checksums.json`` manifest at mirror time and verified at read time;
+  a flipped bit or truncated file surfaces as :class:`LocalCacheMiss`
+  (the entry is dropped) and the read falls back to primary — local disk
+  is treated as UNTRUSTED, exactly like the reference discards a local
+  state handle that fails to open.
+* **Retention follows the primary chain-closure GC.** ``prune(live)``
+  receives the same live set (retained checkpoints + their manifest
+  chains) the primary GC keeps, so the two tiers can never disagree
+  about which cut is restorable: anything the primary may restore, the
+  cache either holds verbatim or does not hold at all.
+
+Mirroring is best-effort by contract: a cache failure (disk full,
+permission) increments a counter and the checkpoint remains exactly as
+durable as it was — the job must never fail because its MTTR
+optimization did.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from typing import Iterable, List, Optional
+
+CHECKSUMS_NAME = "checksums.json"
+
+
+class LocalCacheMiss(Exception):
+    """The cache has no verified copy of the requested checkpoint —
+    missing entry, missing/unreadable checksum manifest, or a blob whose
+    CRC does not match. The caller falls back to primary storage."""
+
+
+def file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+class LocalSnapshotCache:
+    """One directory of mirrored checkpoint entries::
+
+        <dir>/chk-<id>/{meta.json, entries.npz, ..., checksums.json}
+
+    Same layout as primary so the storage-format readers work on a
+    cached entry unchanged; ``checksums.json`` is the only addition.
+    ``stats`` is the hit/miss/corruption ledger the recovery
+    instrumentation (metrics/recovery.py) and /jobs/<jid>/recovery
+    serve."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        # identity of the PRIMARY storage this cache mirrors (see
+        # bind_identity): a cache entry is only trusted for the storage
+        # incarnation that wrote it — cids restart when a checkpoint
+        # directory is wiped and re-created, and a stale mirror's CRCs
+        # are self-consistent, so CRC verification alone cannot catch it
+        self.identity: Optional[str] = None
+        self.stats = {
+            "puts": 0, "put_failures": 0,
+            "hits": 0, "misses": 0, "corrupt": 0, "stale": 0,
+        }
+
+    def bind_identity(self, identity: Optional[str]) -> None:
+        """Record the primary storage's identity token (checkpoint.py
+        stamps one per storage-directory incarnation). ``put`` embeds it
+        in ``checksums.json`` and ``verify`` rejects entries recorded
+        under any other identity — or under none, which an unbound
+        writer produces — as stale. A ``None`` identity (token
+        unavailable, e.g. read-only primary) disables the check."""
+        self.identity = identity
+
+    def path(self, cid: int) -> str:
+        return os.path.join(self.dir, f"chk-{cid}")
+
+    # -- write side -----------------------------------------------------
+    def put(self, cid: int, src_dir: str) -> bool:
+        """Mirror a just-published checkpoint directory into the cache.
+        Staged + atomic rename (a crash mid-copy never leaves an entry
+        that verifies); hard-links blobs where the filesystem allows it
+        (primary and cache commonly share a local disk) and copies
+        otherwise. Best-effort: returns False on failure instead of
+        raising."""
+        tmp = self.path(cid) + ".tmp"
+        try:
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            sums = {}
+            for name in os.listdir(src_dir):
+                src = os.path.join(src_dir, name)
+                if not os.path.isfile(src):
+                    continue
+                dst = os.path.join(tmp, name)
+                try:
+                    os.link(src, dst)
+                except OSError:
+                    shutil.copyfile(src, dst)
+                sums[name] = file_crc32(dst)
+            with open(os.path.join(tmp, CHECKSUMS_NAME), "w") as f:
+                json.dump({"identity": self.identity, "blobs": sums}, f)
+            final = self.path(cid)
+            shutil.rmtree(final, ignore_errors=True)
+            os.rename(tmp, final)
+            self.stats["puts"] += 1
+            return True
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            # any pre-existing entry under this cid is outdated by the
+            # primary publish that triggered this put — a failed mirror
+            # must not leave it behind to verify later
+            self.drop(cid)
+            self.stats["put_failures"] += 1
+            return False
+
+    # -- read side ------------------------------------------------------
+    def verify(self, cid: int) -> str:
+        """Return the cached directory path after verifying every
+        recorded blob's CRC. Raises :class:`LocalCacheMiss` on a missing
+        entry; a CORRUPT entry (bad manifest, CRC mismatch, missing
+        blob) is dropped from the cache before the miss is raised, so a
+        rotten copy can never be consulted twice."""
+        p = self.path(cid)
+        if not os.path.isdir(p):
+            self.stats["misses"] += 1
+            raise LocalCacheMiss(f"chk-{cid} not in local cache")
+        try:
+            with open(os.path.join(p, CHECKSUMS_NAME)) as f:
+                manifest = json.load(f)
+            if self.identity is not None and (
+                manifest.get("identity") != self.identity
+            ):
+                # recorded under another primary incarnation (or none):
+                # the blobs may CRC-verify perfectly and still be a
+                # different job's chk-<cid> — drop, count, fall back
+                self.stats["stale"] += 1
+                self.drop(cid)
+                raise LocalCacheMiss(
+                    f"local copy of chk-{cid} belongs to a different "
+                    f"primary storage incarnation; falling back"
+                )
+            for name, crc in manifest["blobs"].items():
+                if file_crc32(os.path.join(p, name)) != int(crc):
+                    raise ValueError(f"{name}: checksum mismatch")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            self.stats["corrupt"] += 1
+            self.drop(cid)
+            raise LocalCacheMiss(
+                f"local copy of chk-{cid} failed verification ({e}); "
+                f"falling back to primary storage"
+            ) from e
+        self.stats["hits"] += 1
+        return p
+
+    def has(self, cid: int) -> bool:
+        return os.path.isdir(self.path(cid))
+
+    def identity_ok(self, cid: int) -> bool:
+        """Cheap staleness check without the full CRC sweep, for readers
+        that bypass :meth:`verify` (the manifest fast path reads one tiny
+        json and must not pay a whole-entry checksum pass). False means
+        the entry was recorded under a different primary incarnation —
+        or the manifest is unreadable — and primary must serve."""
+        if self.identity is None:
+            return True
+        try:
+            with open(os.path.join(self.path(cid), CHECKSUMS_NAME)) as f:
+                return json.load(f).get("identity") == self.identity
+        except (OSError, ValueError, AttributeError):
+            return False
+
+    def drop(self, cid: int) -> None:
+        shutil.rmtree(self.path(cid), ignore_errors=True)
+
+    # -- retention ------------------------------------------------------
+    def prune(self, live: Iterable[int]) -> None:
+        """Drop every cached entry outside the primary's live set (the
+        chain-closure the primary GC retains), plus any staging debris.
+        Called after each primary GC so the tiers stay in lockstep."""
+        keep = {int(c) for c in live}
+        for cid in self.list_entries():
+            if cid not in keep:
+                self.drop(cid)
+        for name in os.listdir(self.dir):
+            if name.startswith("chk-") and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.dir, name),
+                              ignore_errors=True)
+
+    def list_entries(self) -> List[int]:
+        out = []
+        if not os.path.isdir(self.dir):
+            return out
+        for name in os.listdir(self.dir):
+            if name.startswith("chk-") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[4:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    # -- observability --------------------------------------------------
+    def state(self) -> dict:
+        return {
+            "directory": self.dir,
+            "entries": self.list_entries(),
+            **self.stats,
+        }
+
+
+def local_cache_from_config(config, primary_dir: str
+                            ) -> Optional[LocalSnapshotCache]:
+    """Build the cache from ``checkpoint.local.*`` config (None when
+    disabled). The default directory is a ``<primary>-local`` sibling —
+    on a production deployment ``checkpoint.local.dir`` points at node-
+    local disk while the primary lives on shared/remote storage."""
+    from flink_tpu.core.config import CoreOptions as CO
+
+    if config is None or not config.get(CO.CHECKPOINT_LOCAL_ENABLED):
+        return None
+    directory = config.get(CO.CHECKPOINT_LOCAL_DIR)
+    if not directory:
+        directory = primary_dir.rstrip("/\\") + "-local"
+    return LocalSnapshotCache(directory)

@@ -180,6 +180,27 @@ class CoreOptions:
     CHECKPOINT_STAGING_SLOTS = ConfigOption(
         "checkpoint.staging-slots", 2,
         "host staging buffers in flight (double-buffered by default)")
+    # -- task-local snapshot cache (checkpointing/local.py, ref Flink
+    # task-local recovery; docs/fault-tolerance.md) ---------------------
+    CHECKPOINT_LOCAL_ENABLED = ConfigOption(
+        "checkpoint.local.enabled", False,
+        "mirror every published checkpoint into a host-local cache with "
+        "per-blob checksums; restore prefers the verified local copy "
+        "per chain member and falls back to primary on miss/corruption")
+    CHECKPOINT_LOCAL_DIR = ConfigOption(
+        "checkpoint.local.dir", None, type=str,
+        description="task-local cache directory (node-local disk in "
+        "production); default: a '<checkpoint.dir>-local' sibling")
+    # -- recovery fast path (docs/fault-tolerance.md) -------------------
+    RECOVERY_WARM_RESTART = ConfigOption(
+        "recovery.warm-restart", True,
+        "classify failures at the restart boundary and recover "
+        "TRANSIENT host-side ones (watchdog trip, checkpoint budget "
+        "exhaustion, DCN peer stall, ingest-thread death) in-process: "
+        "live jitted kernels are reused (no recompile) and only the "
+        "key groups dirty since the restored cut are re-staged when "
+        "the cut's fire horizon still matches; off = every restart "
+        "takes the full restore path")
     # -- pipelined ingest (runtime/ingest.py; docs/performance.md) ------
     # prep-half prefetch thread: poll + encode of batch k+1 overlaps the
     # device step of batch k. Checkpoint-compatible since the epoch-
@@ -223,6 +244,33 @@ class CoreOptions:
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)
+    RESTART_FAILURE_RATE_MAX = ConfigOption(
+        "restart-strategy.failure-rate.max-failures", 3)
+    RESTART_FAILURE_RATE_INTERVAL = ConfigOption(
+        "restart-strategy.failure-rate.interval", 60.0)
+    RESTART_FAILURE_RATE_DELAY = ConfigOption(
+        "restart-strategy.failure-rate.delay", 0.0)
+    # exponential-backoff restart strategy (ref RestartStrategies.
+    # exponentialDelayRestart): delay doubles per consecutive failure up
+    # to max-delay, a quiet period resets it, jitter decorrelates
+    # restart storms across jobs. Restarts are unbounded like the
+    # reference — the growing delay is the budget.
+    RESTART_EXP_INITIAL_DELAY = ConfigOption(
+        "restart-strategy.exponential-backoff.initial-delay", 1.0,
+        "seconds before the first restart attempt")
+    RESTART_EXP_MAX_DELAY = ConfigOption(
+        "restart-strategy.exponential-backoff.max-delay", 60.0,
+        "ceiling (s) the growing delay never exceeds")
+    RESTART_EXP_MULTIPLIER = ConfigOption(
+        "restart-strategy.exponential-backoff.multiplier", 2.0,
+        "delay growth factor per consecutive failure")
+    RESTART_EXP_JITTER = ConfigOption(
+        "restart-strategy.exponential-backoff.jitter", 0.1,
+        "+- fraction of the delay drawn uniformly at random")
+    RESTART_EXP_RESET_AFTER = ConfigOption(
+        "restart-strategy.exponential-backoff.reset-after", 3600.0,
+        "a failure-free quiet period (s) this long resets the delay "
+        "back to initial-delay")
     # -- failure containment (docs/fault-tolerance.md) ------------------
     # checkpoint failure budget (checkpointing/policy.py, ref
     # CheckpointFailureManager): a failed/timed-out checkpoint is
@@ -266,6 +314,12 @@ class CoreOptions:
     WATCHDOG_SLOT_TIMEOUT = ConfigOption(
         "watchdog.slot-timeout", 600.0,
         "deadline (s) on the materializer staging-slot wait")
+    WATCHDOG_RESTORE_TIMEOUT = ConfigOption(
+        "watchdog.restore-timeout", 900.0,
+        "deadline (s) on a whole checkpoint restore; the step-loop "
+        "phase deadlines are suspended while a restore runs, so a "
+        "legitimately long cold restore cannot trip a steady-state "
+        "deadline mid-recovery. 0 disables")
     # -- observability (docs/observability.md) --------------------------
     # step-loop span tracing: bounded ring of phase spans exported as
     # Chrome-trace JSON via /jobs/<jid>/traces (metrics/tracing.py)

@@ -29,6 +29,7 @@ import os
 import pickle
 import shutil
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Optional
 
@@ -173,21 +174,19 @@ def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
     return extract_entries(stage_window_state(state), win)
 
 
-def restore_window_state(entries, scalars, ctx, spec, leftover=None):
-    """Logical entries -> device state on a (possibly different) mesh.
-
-    Re-buckets every entry by key group onto ctx's shard ranges, re-inserts
-    keys into fresh hash tables, scatters pane values. The ring is
-    re-registered from the global max_pane.
-
-    leftover: optional list — entries whose key does not fit the table
-    (snapshot taken with a spill tier, restored into a smaller/equal
-    capacity) are appended as (key_hi, key_lo, pane, value) arrays for the
-    caller to route back into its spill tier; without the list the
-    overrun raises.
-    """
+def restore_window_rows(entries, scalars, ctx, spec, rows=None,
+                        leftover=None) -> dict:
+    """Host half of a restore: logical entries -> per-shard host arrays
+    for the given shard ``rows`` (None = all shards, the full-restore
+    path). The warm in-process restart passes only the shards whose
+    key-group range went dirty since the restored cut, so the host
+    rebuild and the device re-stage scale with what diverged instead of
+    with what exists. Returns stacked ``[len(rows), ...]`` numpy arrays:
+    ``{"keys", "acc", "touched", "fresh", "pane_ids", "n_fresh"}``."""
     R = spec.win.ring
     C = spec.capacity_per_shard
+    rows = list(range(ctx.n_shards)) if rows is None \
+        else sorted(int(r) for r in rows)
 
     khi = entries["key_hi"]
     klo = entries["key_lo"]
@@ -212,7 +211,7 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
     pane_rows = []
     starts, ends = ctx.kg_bounds()
     direct = getattr(spec, "layout", "hash") == "direct"
-    for s in range(ctx.n_shards):
+    for s in rows:
         sel = (kg >= starts[s]) & (kg <= ends[s])
         e_hi, e_lo = khi[sel], klo[sel]
         e_pane, e_val = pane[sel], value[sel]
@@ -292,19 +291,46 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
             pane_rows.append(p_r.astype(np.int32))
         else:
             pane_rows.append(np.full(R, int(wk.PANE_NONE), np.int32))
+    return {
+        "keys": np.stack(shard_tables),
+        "acc": np.stack(shard_accs),
+        "touched": np.stack(shard_touched),
+        "fresh": np.stack(shard_fresh),
+        "pane_ids": np.stack(pane_rows),
+        "n_fresh": np.asarray(
+            [int(f.sum()) for f in shard_fresh], np.int32
+        ),
+    }
 
-    def stack_put(arrs, dtype=None):
-        a = np.stack(arrs)
+
+def restore_window_state(entries, scalars, ctx, spec, leftover=None):
+    """Logical entries -> device state on a (possibly different) mesh.
+
+    Re-buckets every entry by key group onto ctx's shard ranges, re-inserts
+    keys into fresh hash tables, scatters pane values. The ring is
+    re-registered from the global max_pane.
+
+    leftover: optional list — entries whose key does not fit the table
+    (snapshot taken with a spill tier, restored into a smaller/equal
+    capacity) are appended as (key_hi, key_lo, pane, value) arrays for the
+    caller to route back into its spill tier; without the list the
+    overrun raises.
+    """
+    built = restore_window_rows(entries, scalars, ctx, spec,
+                                leftover=leftover)
+
+    def stack_put(a, dtype=None):
+        a = np.stack(a) if isinstance(a, list) else a
         return jax.device_put(
             a if dtype is None else a.astype(dtype), ctx.state_sharding
         )
 
     S = ctx.n_shards
     new_state = wk.WindowShardState(
-        table=hashtable.SlotTable(stack_put(shard_tables), spec.probe_len),
-        acc=stack_put(shard_accs),
-        touched=stack_put(shard_touched),
-        pane_ids=stack_put(pane_rows),
+        table=hashtable.SlotTable(stack_put(built["keys"]), spec.probe_len),
+        acc=stack_put(built["acc"]),
+        touched=stack_put(built["touched"]),
+        pane_ids=stack_put(built["pane_ids"]),
         max_pane=_scal(S, scalars["max_pane"], ctx),
         min_pane=_scal(S, scalars["min_pane"], ctx),
         watermark=_scal(S, scalars["watermark"], ctx),
@@ -318,11 +344,8 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
         ),
         dropped_late=_scal(S, scalars["dropped_late"], ctx, split=True),
         dropped_capacity=_scal(S, scalars["dropped_capacity"], ctx, split=True),
-        fresh=stack_put(shard_fresh),
-        n_fresh=jax.device_put(
-            np.asarray([int(f.sum()) for f in shard_fresh], np.int32),
-            ctx.state_sharding,
-        ),
+        fresh=stack_put(built["fresh"]),
+        n_fresh=jax.device_put(built["n_fresh"], ctx.state_sharding),
         # overflow ring restores empty: a checkpoint is taken at a fire
         # boundary where the ring was drained into the spill tier, and the
         # spill entries ride the snapshot as regular logical entries
@@ -360,12 +383,37 @@ class CheckpointStorage:
     Incremental checkpoints add a manifest.json (checkpointing/manifest)
     naming the chain of checkpoint ids they depend on; retention GC keeps
     every directory a retained manifest references, so a delta can never
-    outlive its base."""
+    outlive its base.
 
-    def __init__(self, directory: str, retain: int = 2):
+    ``local``: optional task-local snapshot cache (checkpointing/local.py,
+    ref Flink task-local recovery). Every publish mirrors into it and
+    every read prefers the verified local copy per checkpoint directory
+    (i.e. per chain member for delta restores), falling back to primary
+    on miss/corruption; its retention follows this storage's chain-
+    closure GC so the tiers never disagree about the restorable cut."""
+
+    def __init__(self, directory: str, retain: int = 2, local=None):
         self.dir = directory
         self.retain = retain
+        self.local = local
         os.makedirs(directory, exist_ok=True)
+        # per-incarnation identity token: wiping + re-creating the
+        # checkpoint directory restarts cids at 1, so a surviving local
+        # cache could otherwise serve the OLD job's chk-<cid> with
+        # perfectly self-consistent CRCs. Best-effort (a read-only
+        # primary runs without the staleness check, as before).
+        self.storage_id = None
+        id_path = os.path.join(directory, ".storage-id")
+        try:
+            if not os.path.exists(id_path):
+                with open(id_path, "w") as f:
+                    f.write(uuid.uuid4().hex)
+            with open(id_path) as f:
+                self.storage_id = f.read().strip() or None
+        except OSError:
+            pass
+        if self.local is not None:
+            self.local.bind_identity(self.storage_id)
 
     def path(self, cid: int) -> str:
         return os.path.join(self.dir, f"chk-{cid}")
@@ -415,12 +463,31 @@ class CheckpointStorage:
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic publish
+        if self.local is not None:
+            # mirror AFTER the atomic publish: the cache may only ever
+            # hold durable cuts (best-effort — a cache failure must not
+            # fail the checkpoint)
+            self.local.put(cid, final)
         self._gc(keep_latest=cid)
         return final
 
     def read_manifest(self, cid: int):
         from flink_tpu.checkpointing import manifest as mf
 
+        if (
+            self.local is not None
+            and self.local.has(cid)
+            and self.local.identity_ok(cid)
+        ):
+            # the manifest is tiny and json.load fails loudly on a torn
+            # copy, so it is read without the full-entry CRC sweep —
+            # but NOT without the incarnation check: a stale cached
+            # manifest would resolve the wrong chain (and _gc computes
+            # the live set from chains). Any failure falls to primary.
+            try:
+                return mf.read_manifest(self.local.path(cid))
+            except (OSError, ValueError):
+                pass
         return mf.read_manifest(self.path(cid))
 
     def discard_tmp(self, cid: int) -> None:
@@ -454,6 +521,10 @@ class CheckpointStorage:
                 p = os.path.join(self.dir, name)
                 if os.path.isdir(p):
                     shutil.rmtree(p, ignore_errors=True)
+        if self.local is not None:
+            # cache retention follows the SAME chain closure, so the
+            # local tier can never offer a cut the primary gave up on
+            self.local.prune(live)
 
     def list_checkpoints(self):
         out = []
@@ -480,8 +551,22 @@ class CheckpointStorage:
         return self.read_raw(cid)
 
     def read_raw(self, cid: int):
-        """One checkpoint directory's own files, chain-unresolved."""
-        p = self.path(cid)
+        """One checkpoint directory's own files, chain-unresolved.
+        Prefers the checksum-verified local copy when a cache is
+        attached; a miss or a corrupt entry falls back to primary (the
+        ``ckpt.read.primary`` injection point models the remote-fetch
+        cost the cache exists to avoid)."""
+        if self.local is not None:
+            from flink_tpu.checkpointing.local import LocalCacheMiss
+
+            try:
+                return self._read_raw_dir(self.local.verify(cid), cid)
+            except LocalCacheMiss:
+                pass
+        faults.inject("ckpt.read.primary", cid=cid)
+        return self._read_raw_dir(self.path(cid), cid)
+
+    def _read_raw_dir(self, p: str, cid: int):
         try:
             with open(os.path.join(p, "meta.json")) as f:
                 meta = json.load(f)
@@ -534,11 +619,23 @@ class CheckpointStorage:
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
+        if self.local is not None:
+            self.local.put(cid, final)
         self._gc(keep_latest=cid)
         return final
 
     def read_generic(self, cid: int) -> dict:
-        p = self.path(cid)
+        p = None
+        if self.local is not None:
+            from flink_tpu.checkpointing.local import LocalCacheMiss
+
+            try:
+                p = self.local.verify(cid)
+            except LocalCacheMiss:
+                p = None
+        if p is None:
+            faults.inject("ckpt.read.primary", cid=cid)
+            p = self.path(cid)
         with open(os.path.join(p, "meta.json")) as f:
             meta = json.load(f)
         if meta.get("format_version") != FORMAT_VERSION:
@@ -579,15 +676,33 @@ class CheckpointStorage:
 
 @dataclass
 class RestartStrategy:
-    """ref RestartStrategies (fixed-delay / failure-rate / no-restart)."""
+    """ref RestartStrategies (fixed-delay / failure-rate /
+    exponential-delay / no-restart)."""
 
-    kind: str = "none"          # none | fixed-delay | failure-rate
+    kind: str = "none"   # none | fixed-delay | failure-rate | exponential-backoff
     attempts: int = 3
     delay_s: float = 0.0
     failure_rate: int = 3       # max failures...
     failure_interval_s: float = 60.0  # ...per interval
+    # exponential-backoff knobs (ref RestartStrategies.
+    # exponentialDelayRestart): the delay grows by `multiplier` per
+    # consecutive failure up to `max_delay_s`; a failure-free quiet
+    # period of `reset_after_s` resets it to `initial_delay_s`; `jitter`
+    # is a +-fraction drawn uniformly so fleet-wide restart storms
+    # decorrelate. Attempts are UNBOUNDED — the growing delay is the
+    # budget.
+    initial_delay_s: float = 1.0
+    max_delay_s: float = 60.0
+    multiplier: float = 2.0
+    jitter: float = 0.1
+    reset_after_s: float = 3600.0
 
     _failures: list = None
+    _last_failure_t: float = None
+    _consecutive: int = 0
+    # delays actually slept, newest last (bounded) — the chaos soak
+    # asserts bounded backoff from this
+    delays: list = None
 
     @staticmethod
     def none() -> "RestartStrategy":
@@ -605,13 +720,64 @@ class RestartStrategy:
             failure_interval_s=interval_s, delay_s=delay_s,
         )
 
+    @staticmethod
+    def exponential_backoff(initial_delay_s: float = 1.0,
+                            max_delay_s: float = 60.0,
+                            multiplier: float = 2.0,
+                            jitter: float = 0.1,
+                            reset_after_s: float = 3600.0
+                            ) -> "RestartStrategy":
+        return RestartStrategy(
+            "exponential-backoff", initial_delay_s=initial_delay_s,
+            max_delay_s=max_delay_s, multiplier=multiplier, jitter=jitter,
+            reset_after_s=reset_after_s,
+        )
+
+    def next_backoff_delay(self, now: float = None) -> float:
+        """The delay the NEXT exponential-backoff restart would sleep
+        (also advances the consecutive-failure bookkeeping)."""
+        import random
+
+        now = time.time() if now is None else now
+        if (
+            self._last_failure_t is not None
+            and self.reset_after_s > 0
+            and now - self._last_failure_t >= self.reset_after_s
+        ):
+            self._consecutive = 0       # quiet period: back to initial
+        self._last_failure_t = now
+        self._consecutive += 1
+        delay = min(
+            float(self.max_delay_s),
+            float(self.initial_delay_s)
+            * float(self.multiplier) ** (self._consecutive - 1),
+        )
+        if self.jitter > 0:
+            delay *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(0.0, min(delay, float(self.max_delay_s)
+                            * (1.0 + self.jitter)))
+
     def should_restart(self) -> bool:
         now = time.time()
+        if self.kind == "none":
+            return False
+        if self.kind == "exponential-backoff":
+            # no _failures ledger: restarts are deliberately unbounded
+            # here (the growing delay is the budget), and an append-per-
+            # restart list would leak for the lifetime of a crash-
+            # looping job — next_backoff_delay keeps all needed state
+            # (_last_failure_t/_consecutive)
+            delay = self.next_backoff_delay(now)
+            if self.delays is None:
+                self.delays = []
+            self.delays.append(delay)
+            del self.delays[:-50]
+            if delay:
+                time.sleep(delay)
+            return True
         if self._failures is None:
             self._failures = []
         self._failures.append(now)
-        if self.kind == "none":
-            return False
         if self.kind == "fixed-delay":
             ok = len(self._failures) <= self.attempts
         else:

@@ -40,10 +40,12 @@ from flink_tpu.checkpointing.materializer import (
     Materializer,
     MaterializerError,
 )
+from flink_tpu.checkpointing.local import local_cache_from_config
 from flink_tpu.checkpointing.policy import (
     CheckpointFailureBudgetExceeded,
     policy_from_config,
 )
+from flink_tpu.metrics.recovery import RecoveryTracker
 from flink_tpu.metrics.tracing import (
     CompileEvents,
     cost_analysis_of,
@@ -111,6 +113,46 @@ class _LaggedEmitter:
         sink to the checkpoint cut, and replay re-fires everything after
         it; emitting the stale handles would double-count."""
         self._q.clear()
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Failure classification at the restart boundary (ref the
+    coarse-grained recovery split in RestartPipelinedRegionFailover-
+    Strategy — here the regions are "the host-side plumbing" vs "the
+    state itself"). TRANSIENT host-side failures — a watchdog trip, an
+    exhausted checkpoint failure budget, a DCN peer stall/loss, the
+    ingest thread dying, a connection/timeout blip — say nothing about
+    the integrity of the live device state or the compiled kernels, so
+    recovery may restart warm in-process: keep the jitted steps, re-stage
+    only what diverged from the restored cut. Anything else
+    (arithmetic/assertion/XLA errors, unknown exceptions) is treated as
+    STATE-CORRUPTING and takes the full restore path, rebuilding every
+    shard from the checkpoint."""
+    from flink_tpu.runtime import dcn
+
+    transient = (
+        WatchdogError,
+        CheckpointFailureBudgetExceeded,
+        MaterializerError,
+        ingest_mod.IngestThreadDied,
+        dcn.DCNPeerError,
+        ConnectionError,
+        TimeoutError,
+    )
+    return "transient" if isinstance(exc, transient) else "state-corrupting"
+
+
+def _storage_for_restore_path(live_storage, path_or_storage):
+    """Resolve a restore target: an own-directory path rides the live
+    storage object (and its task-local snapshot cache); a foreign path
+    gets a plain reader; a storage object passes through."""
+    if not isinstance(path_or_storage, str):
+        return path_or_storage
+    if live_storage is not None and os.path.abspath(
+        path_or_storage
+    ) == os.path.abspath(live_storage.dir):
+        return live_storage
+    return ckpt.CheckpointStorage(path_or_storage)
 
 
 def _pad(arr, size, dtype):
@@ -312,6 +354,9 @@ class _FlatStageCheckpointer:
             self.storage = ckpt.CheckpointStorage(
                 env.checkpoint_dir,
                 retain=env.config.get_int("checkpoint.retain", 2),
+                local=local_cache_from_config(
+                    env.config, env.checkpoint_dir
+                ),
             )
         self.next_cid = (
             (self.storage.latest() or 0) + 1 if self.storage else 1
@@ -387,10 +432,7 @@ class _FlatStageCheckpointer:
 
     def restore(self, path_or_storage, cid=None):
         self.io.recover()             # durable cuts still notify
-        st = (
-            ckpt.CheckpointStorage(path_or_storage)
-            if isinstance(path_or_storage, str) else path_or_storage
-        )
+        st = _storage_for_restore_path(self.storage, path_or_storage)
         cid = cid if cid is not None else st.latest()
         if cid is None:
             raise FileNotFoundError(f"no checkpoint in {st.dir}")
@@ -1022,18 +1064,35 @@ class LocalExecutor:
                 pass      # observability must never kill the job
 
     def _restart_strategy(self) -> ckpt.RestartStrategy:
+        """Reads go through the declared ConfigOptions so conf-file
+        strings coerce strictly and parse errors name the key."""
+        from flink_tpu.core.config import CoreOptions as CO
+
         cfg = self.env.config
-        kind = cfg.get_str("restart-strategy", "none")
+        kind = cfg.get(CO.RESTART_STRATEGY)
         if kind == "fixed-delay":
             return ckpt.RestartStrategy.fixed_delay(
-                cfg.get_int("restart-strategy.fixed-delay.attempts", 3),
-                cfg.get_float("restart-strategy.fixed-delay.delay", 0.0),
+                cfg.get(CO.RESTART_ATTEMPTS),
+                cfg.get(CO.RESTART_DELAY_S),
             )
         if kind == "failure-rate":
             return ckpt.RestartStrategy.failure_rate(
-                cfg.get_int("restart-strategy.failure-rate.max-failures", 3),
-                cfg.get_float("restart-strategy.failure-rate.interval", 60.0),
-                cfg.get_float("restart-strategy.failure-rate.delay", 0.0),
+                cfg.get(CO.RESTART_FAILURE_RATE_MAX),
+                cfg.get(CO.RESTART_FAILURE_RATE_INTERVAL),
+                cfg.get(CO.RESTART_FAILURE_RATE_DELAY),
+            )
+        if kind == "exponential-backoff":
+            return ckpt.RestartStrategy.exponential_backoff(
+                cfg.get(CO.RESTART_EXP_INITIAL_DELAY),
+                cfg.get(CO.RESTART_EXP_MAX_DELAY),
+                cfg.get(CO.RESTART_EXP_MULTIPLIER),
+                cfg.get(CO.RESTART_EXP_JITTER),
+                cfg.get(CO.RESTART_EXP_RESET_AFTER),
+            )
+        if kind != "none":
+            raise ValueError(
+                f"restart-strategy must be none|fixed-delay|failure-rate|"
+                f"exponential-backoff, got {kind!r}"
             )
         return ckpt.RestartStrategy.none()
 
@@ -1772,9 +1831,14 @@ class LocalExecutor:
         # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
         storage = None
         if env.checkpoint_dir:
+            # task-local snapshot cache (checkpointing/local.py): publish
+            # mirrors in, restore prefers the verified local copy
             storage = ckpt.CheckpointStorage(
                 env.checkpoint_dir,
                 retain=env.config.get_int("checkpoint.retain", 2),
+                local=local_cache_from_config(
+                    env.config, env.checkpoint_dir
+                ),
             )
         # resume numbering after any checkpoints already in the directory
         next_cid = (storage.latest() or 0) + 1 if storage else 1
@@ -1837,6 +1901,25 @@ class LocalExecutor:
             metrics.watchdog_trips += 1
 
         wd = watchdog_from_config(env.config, on_trip=_wd_trip)
+        # MTTR instrumentation (metrics/recovery.py): per-attempt
+        # recovery phase spans + recovery_* gauges + /jobs/<jid>/recovery
+        rec_tracker = RecoveryTracker(self._job_group, self._tracer)
+        if storage is not None and storage.local is not None:
+            rec_tracker.local_cache = storage.local
+        env._recovery_report = rec_tracker.report
+        # warm in-process restart (docs/fault-tolerance.md): transient
+        # host-side failures keep the live jitted kernels and re-stage
+        # only the shards whose key groups diverged from the restored cut
+        from flink_tpu.core.config import CoreOptions as _CO
+
+        warm_enabled = env.config.get(_CO.RECOVERY_WARM_RESTART)
+        # incremental cuts CLEAR the device dirty bits before their write
+        # is durable; if that write later aborts, the cleared bits are
+        # divergence the bits no longer show. The warm splice therefore
+        # unions the live bits with every cut cleared after the cid it
+        # restores (pruned once a newer cut publishes).
+        ck_cleared_dirty = {}
+        ck_published = [0]
         # live manifest chain of the current incremental sequence (base
         # first). Starts EMPTY even when the directory holds checkpoints:
         # a delta may only chain onto a base whose state this job actually
@@ -2051,6 +2134,13 @@ class LocalExecutor:
             staged = ckpt.stage_window_state(state, rows=rows)
             if ck_mode == "incremental":
                 state = clear_dirty(state)
+                # cleared-bits ledger for the warm splice (see above):
+                # this cut's dirty set is unaccounted divergence until
+                # the cut is durable
+                ck_cleared_dirty[cid] = np.asarray(dirty_kgs)
+                for c in [c for c in ck_cleared_dirty
+                          if c <= ck_published[0]]:
+                    del ck_cleared_dirty[c]
             if keep_rev:
                 # atomic against the ingest thread's concurrent encodes
                 # (the map may already hold keys from prefetched batches
@@ -2144,6 +2234,10 @@ class LocalExecutor:
                         manifest=manifest, aux_bytes=aux_bytes,
                     )
                     ck_policy.on_completed(cid)
+                    # durable: bits cleared at or before this cut are
+                    # accounted for by it (int store is GIL-atomic; the
+                    # ledger itself is pruned on the step-loop thread)
+                    ck_published[0] = max(ck_published[0], cid)
                     # the checkpoint is durable: commit offsets externally
                     # + let sinks finalize (ref notifyCheckpointComplete
                     # fan-out). Async mode queues — the step loop delivers.
@@ -2199,9 +2293,97 @@ class LocalExecutor:
             else:
                 materialize()
 
-        def restore_checkpoint(path_or_storage, cid=None):
+        def _try_warm_splice(entries, scalars, restored_cid):
+            """Warm dirty-only re-stage: rebuild ONLY the shards whose
+            key-group range diverged since the restored cut and splice
+            them into the live device state; clean shards never leave
+            the device. Sound only when the cut's fire horizon still
+            matches the live state — fire/purge sweeps mutate shards
+            WITHOUT marking dirty bits (deliberately, see
+            ops/window_kernels.py), so any fire, purge, or ring
+            rotation since the cut sends the caller down the full
+            re-stage path. Returns True when the splice happened. The
+            spill-tier precondition is the CALLER's (the stores are
+            already closed/cleared by the time this runs)."""
+            nonlocal state
+            live = jax.device_get({
+                "fired_through": state.fired_through,
+                "max_pane": state.max_pane,
+                "min_pane": state.min_pane,
+                "kg_dirty": state.kg_dirty,
+                "ovf_n": state.ovf_n,
+            })
+            if (
+                int(np.min(live["fired_through"]))
+                != int(scalars["fired_through"])
+                or int(np.max(live["max_pane"])) != int(scalars["max_pane"])
+                or int(np.min(live["min_pane"])) != int(scalars["min_pane"])
+                or int(np.asarray(live["ovf_n"]).sum()) != 0
+            ):
+                return False
+            dirty = cklog.dirty_key_groups(live["kg_dirty"])
+            # plus every dirty set a post-cut checkpoint cleared without
+            # becoming durable (the bits no longer show that divergence)
+            for c, kgs in list(ck_cleared_dirty.items()):
+                if c > restored_cid:
+                    dirty = np.union1d(dirty, kgs)
+            rows = cklog.dirty_shard_rows(dirty, *ctx.kg_bounds())
+            if len(rows) >= ctx.n_shards:
+                return False     # everything diverged: splice == full
+            S = ctx.n_shards
+            repl = {
+                # global scalars rewind to the cut (fired_through /
+                # max_pane / min_pane are equal by the guard; watermark
+                # and the drop counters are re-driven by replay)
+                "watermark": ckpt._scal(S, scalars["watermark"], ctx),
+                "dropped_late": ckpt._scal(
+                    S, scalars["dropped_late"], ctx, split=True
+                ),
+                "dropped_capacity": ckpt._scal(
+                    S, scalars["dropped_capacity"], ctx, split=True
+                ),
+                # the restored state IS the chain's state
+                "kg_dirty": jax.device_put(
+                    np.zeros((S, ctx.max_parallelism), bool),
+                    ctx.state_sharding,
+                ),
+            }
+            if rows:
+                leftover = []
+                built = ckpt.restore_window_rows(
+                    entries, scalars, ctx, spec, rows=rows,
+                    leftover=leftover,
+                )
+                if leftover:
+                    return False     # rows need the spill tier: full path
+                idx = jnp.asarray(np.asarray(rows, np.int32))
+
+                def spl(live_arr, sub):
+                    return jax.device_put(
+                        live_arr.at[idx].set(jnp.asarray(sub)),
+                        ctx.state_sharding,
+                    )
+
+                repl.update(
+                    table=type(state.table)(
+                        spl(state.table.keys, built["keys"]),
+                        spec.probe_len,
+                    ),
+                    acc=spl(state.acc, built["acc"]),
+                    touched=spl(state.touched, built["touched"]),
+                    fresh=spl(state.fresh, built["fresh"]),
+                    pane_ids=spl(state.pane_ids, built["pane_ids"]),
+                    n_fresh=spl(state.n_fresh, built["n_fresh"]),
+                )
+            # rows == []: nothing diverged since the cut — the live
+            # arrays ARE the checkpoint; only the scalars rewind
+            state = dataclasses.replace(state, **repl)
+            return True
+
+        def restore_checkpoint(path_or_storage, cid=None, warm=False):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane, applied_max_pane
+            t_plan0 = time.perf_counter()
             # park the prefetch producer FIRST: everything below mutates
             # state it reads (source offsets, the codec reverse map, the
             # ingest plan); resume() at the end bumps the epoch so every
@@ -2231,18 +2413,22 @@ class LocalExecutor:
             bounce_miss[0] = 0
             mon_watch.clear()
             # spill contents were folded into the snapshot's entries; the
-            # restored device state supersedes the host tier
+            # restored device state supersedes the host tier. Whether the
+            # tier WAS in play decides warm-splice eligibility below:
+            # spill keys' cut entries live nowhere on device, so only the
+            # full restore (its leftover path) can resurrect them.
+            had_spill = bool(ovf_stores)
             for store in ovf_stores.values():
                 store.close()
             ovf_stores.clear()
-            st = (
-                ckpt.CheckpointStorage(path_or_storage)
-                if isinstance(path_or_storage, str) else path_or_storage
-            )
+            st = _storage_for_restore_path(storage, path_or_storage)
             cid = cid if cid is not None else st.latest()
             if cid is None:
                 raise FileNotFoundError(f"no checkpoint in {st.dir}")
+            rec_tracker.mark_phase("restore_plan", t_plan0)
+            t_fetch0 = time.perf_counter()
             entries, scalars, offsets, aux = st.read(cid)
+            rec_tracker.mark_phase("fetch", t_fetch0)
             if (aux["size_ms"], aux["slide_ms"]) != (size_ms, slide_ms):
                 raise ValueError("checkpoint window spec mismatch")
             # re-arm the between-polls jump guard from the snapshot: the
@@ -2264,11 +2450,39 @@ class LocalExecutor:
                     aux.get("state_layout", "hash")
                     if layout_cfg == "auto" else layout_cfg
                 )
-            setup(aux["origin_ms"], fresh_state=False)
-            leftover = [] if win.overflow else None
-            state = ckpt.restore_window_state(
-                entries, scalars, ctx, spec, leftover=leftover
-            )
+            t_stage0 = time.perf_counter()
+            # warm in-process restart: the transient-failure path keeps
+            # the live jitted kernels and the installed ingest plan (the
+            # time-domain origin is unchanged for a same-job restore)
+            # and, when the cut's fire horizon still matches, re-stages
+            # only the dirty shards
+            mode = "full"
+            if (
+                warm and warm_enabled and state is not None
+                and td is not None and win is not None
+                and aux["origin_ms"] == td.origin_ms
+                and aux.get("state_layout", layout[0]) == layout[0]
+            ):
+                # a live spill tier rules out the splice (its keys' cut
+                # entries exist on no device shard — only the full
+                # rebuild's leftover path resurrects them) but not the
+                # kernel-warm full restore
+                mode = (
+                    "warm-splice"
+                    if not had_spill
+                    and _try_warm_splice(entries, scalars, cid)
+                    else "warm-full"
+                )
+            leftover = None
+            if mode != "warm-splice":
+                if mode == "full":
+                    setup(aux["origin_ms"], fresh_state=False)
+                leftover = [] if win.overflow else None
+                state = ckpt.restore_window_state(
+                    entries, scalars, ctx, spec, leftover=leftover
+                )
+            rec_tracker.mark_phase("stage", t_stage0)
+            rec_tracker.set_mode(mode, cid)
             if leftover:
                 # snapshot rows that no longer fit the table go back to the
                 # host spill tier they came from
@@ -3288,6 +3502,11 @@ class LocalExecutor:
                 if on_time < F and late < F:
                     prune_stores(wm_ms)
                     phase_acc["emit"] += time.perf_counter() - t_e0
+                    if total:
+                        # the first emission after a restore stamps the
+                        # detect-to-first-fire MTTR number (no-op in
+                        # steady state)
+                        rec_tracker.note_fire()
                     if total and self._latency_hist is not None and \
                             last_ingest_t[0] is not None:
                         # LatencyMarker analog: ingest -> sink for the
@@ -3766,9 +3985,77 @@ class LocalExecutor:
         job_live.set()
         if wd is not None:
             wd.start()
+
+        @contextlib.contextmanager
+        def _restore_guard():
+            """Watchdog bracket for a whole restore: the dedicated
+            ``restore`` deadline (watchdog.restore-timeout) arms and the
+            steady-state phase deadlines are suspended, so a
+            legitimately long cold restore cannot trip a false
+            WatchdogError mid-recovery."""
+            if wd is None:
+                yield
+                return
+            prev = wd.arm("restore")
+            wd.suspend()
+            try:
+                yield
+            finally:
+                wd.unsuspend()
+                wd.disarm(prev)
+
+        def _recover(first_exc):
+            """One failure -> a restored, runnable job, or raise.
+            Classifies the failure (transient host-side -> warm
+            in-process restart; anything else -> full restore), and
+            keeps a failure DURING restore inside the restart budget:
+            a double fault consumes another should_restart() slot and
+            retries with the warm path disabled (the half-restored
+            state is no longer trusted), instead of escaping as an
+            unhandled error or wedging the job."""
+            exc = first_exc
+            warm = classify_failure(first_exc) == "transient"
+            while True:
+                rec_tracker.begin(
+                    cause=f"{type(exc).__name__}: {exc}",
+                    classification=(
+                        "transient" if warm else "state-corrupting"
+                    ),
+                )
+                with rec_tracker.phase("settle"):
+                    if materializer is not None:
+                        # let pending async cuts become durable before
+                        # deciding whether a restartable checkpoint
+                        # exists
+                        ck_io.settle()
+                can = (
+                    storage is not None
+                    and storage.latest() is not None
+                )
+                if can:
+                    with rec_tracker.phase("backoff"):
+                        can = restart.should_restart()
+                if not can:
+                    raise exc
+                metrics.restarts += 1
+                self._notify_restart()
+                try:
+                    with _restore_guard():
+                        restore_checkpoint(storage, warm=warm)
+                    rec_tracker.end()
+                    return
+                except JobCancelledException:
+                    raise
+                except Exception as e2:
+                    exc, warm = e2, False
+
         try:
             if restore_from:
-                restore_checkpoint(restore_from)
+                rec_tracker.begin(cause="explicit restore_from",
+                                  classification="initial")
+                with _restore_guard():
+                    restore_checkpoint(restore_from)
+                rec_tracker.end()
             restart = self._restart_strategy()
             while True:
                 try:
@@ -3799,21 +4086,8 @@ class LocalExecutor:
                     break
                 except JobCancelledException:
                     raise
-                except Exception:
-                    if materializer is not None:
-                        # let pending async cuts become durable before
-                        # deciding whether a restartable checkpoint exists
-                        ck_io.settle()
-                    can = (
-                        storage is not None
-                        and storage.latest() is not None
-                        and restart.should_restart()
-                    )
-                    if not can:
-                        raise
-                    metrics.restarts += 1
-                    self._notify_restart()
-                    restore_checkpoint(storage)
+                except Exception as e:
+                    _recover(e)
         finally:
             if wd is not None:
                 wd.stop()
@@ -4065,9 +4339,14 @@ class LocalExecutor:
 
         storage = None
         if env.checkpoint_dir:
+            # task-local snapshot cache (checkpointing/local.py): publish
+            # mirrors in, restore prefers the verified local copy
             storage = ckpt.CheckpointStorage(
                 env.checkpoint_dir,
                 retain=env.config.get_int("checkpoint.retain", 2),
+                local=local_cache_from_config(
+                    env.config, env.checkpoint_dir
+                ),
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0
@@ -4328,9 +4607,14 @@ class LocalExecutor:
 
         storage = None
         if env.checkpoint_dir:
+            # task-local snapshot cache (checkpointing/local.py): publish
+            # mirrors in, restore prefers the verified local copy
             storage = ckpt.CheckpointStorage(
                 env.checkpoint_dir,
                 retain=env.config.get_int("checkpoint.retain", 2),
+                local=local_cache_from_config(
+                    env.config, env.checkpoint_dir
+                ),
             )
         next_cid = (storage.latest() or 0) + 1 if storage else 1
         steps_at_ckpt = 0

@@ -63,6 +63,14 @@ from flink_tpu.parallel.mesh import SHARD_AXIS
 from flink_tpu.testing import faults
 
 
+class IngestThreadDied(RuntimeError):
+    """The prefetch producer thread died without delivering a batch or
+    an error (hard death — e.g. an injected ``kill`` rule or a native
+    crash in the prep path). Classified TRANSIENT at the restart
+    boundary: the thread is respawned by the next ``next()`` after a
+    restore, so a warm in-process restart fully recovers it."""
+
+
 # ---------------------------------------------------------------- masks
 
 def make_prefix_mask_template(size: int) -> np.ndarray:
@@ -390,6 +398,9 @@ class IngestPipeline:
         self._parked = threading.Event()
         self._gate.set()
         self._thread: Optional[threading.Thread] = None
+        # epoch the live thread was spawned under: a DEAD thread is only
+        # respawned after a restore bumped the epoch (see _ensure_thread)
+        self._thread_epoch = -1
 
     # -- plan ------------------------------------------------------------
     @property
@@ -470,7 +481,10 @@ class IngestPipeline:
                 self._finish(pb)
                 item = ("ok", epoch, pb)
                 park_after = pb.end
-            except BaseException as e:   # deliver to the consumer
+            except Exception as e:   # deliver to the consumer
+                # BaseException (ThreadKilled, interpreter teardown) is
+                # NOT delivered: it kills the producer hard, which is
+                # the dead-thread detection path next() covers
                 item = ("err", epoch, e)
                 park_after = True
             if park_after:
@@ -495,12 +509,26 @@ class IngestPipeline:
                 continue
 
     def _ensure_thread(self):
-        if self._thread is None or not self._thread.is_alive():
+        if self._thread is not None and not self._thread.is_alive():
+            if self._thread_epoch == self._epoch:
+                # hard death (not a restore respawn): the thread may have
+                # died MID-POLL, advancing the source past records it
+                # never delivered — silently respawning would turn that
+                # into data loss. Surface it; the restart machinery
+                # restores to the applied-offset cut and the epoch bump
+                # below then legitimizes a fresh producer.
+                raise IngestThreadDied(
+                    "ingest prefetch thread died without delivering a "
+                    "batch or an error"
+                )
+            self._thread = None
+        if self._thread is None:
             t = threading.Thread(
                 target=self._producer, daemon=True,
                 name="flink-tpu-ingest",
             )
             self._thread = t
+            self._thread_epoch = self._epoch
             t.start()
 
     # -- consumer --------------------------------------------------------
@@ -516,7 +544,7 @@ class IngestPipeline:
                 kind, epoch, item = self._q.get(timeout=1.0)
             except queue.Empty:
                 if not self._thread.is_alive() and self._q.empty():
-                    raise RuntimeError(
+                    raise IngestThreadDied(
                         "ingest prefetch thread died without delivering "
                         "a batch or an error"
                     )
@@ -559,6 +587,14 @@ class IngestPipeline:
             except queue.Empty:
                 break
         self._applied = applied_offsets
+        if self._thread is not None and self._thread.is_alive():
+            # the surviving (parked) producer serves the new epoch from
+            # here on — re-stamp it so a LATER hard death is surfaced as
+            # IngestThreadDied rather than mistaken for a restore respawn
+            # (a stale stamp would silently respawn past lost records);
+            # a thread already dead here keeps its old stamp so
+            # _ensure_thread treats the post-restore spawn as legitimate
+            self._thread_epoch = self._epoch
         self._pause_req.clear()
         self._gate.set()
 

@@ -103,6 +103,11 @@ class Watchdog:
         self._trip_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # restore suspension (see suspend()): while > 0, newly armed
+        # phases other than "restore" get a no-deadline slot — a
+        # legitimately long cold restore must not trip the step-loop
+        # deadlines tuned for steady-state phases
+        self._suspended = 0
 
     @property
     def enabled(self) -> bool:
@@ -116,11 +121,26 @@ class Watchdog:
         tid = threading.get_ident()
         prev = self._armed.get(tid)
         dl = self.deadlines.get(phase)
+        if self._suspended and phase != "restore":
+            dl = None   # restore in progress: only its own deadline runs
         if dl is None:
             self._armed[tid] = (phase, 0.0, 0.0, detail)
         else:
             self._armed[tid] = (phase, time.monotonic(), dl, detail)
         return prev
+
+    def suspend(self) -> None:
+        """Disarm step-loop phase deadlines for the duration of a
+        restore: nested arms (checkpoint drains, device fetches inside
+        the restore) get no-deadline slots, so a long cold restore can
+        only trip the dedicated ``restore`` phase
+        (``watchdog.restore-timeout``), never a steady-state deadline
+        misattributed mid-recovery. Counted, so nested restores (a
+        restore retried inside the restart loop) balance."""
+        self._suspended += 1
+
+    def unsuspend(self) -> None:
+        self._suspended = max(0, self._suspended - 1)
 
     def disarm(self, prev=None) -> None:
         tid = threading.get_ident()
@@ -231,6 +251,9 @@ def watchdog_from_config(config, on_trip=None) -> Optional[Watchdog]:
         "barrier_fetch": config.get(CO.WATCHDOG_FETCH_TIMEOUT),
         "checkpoint_sync": config.get(CO.WATCHDOG_CKPT_SYNC_TIMEOUT),
         "materializer_slot": config.get(CO.WATCHDOG_SLOT_TIMEOUT),
+        # recovery gets its OWN deadline; the step-loop phases above are
+        # suspended while a restore runs (Watchdog.suspend)
+        "restore": config.get(CO.WATCHDOG_RESTORE_TIMEOUT),
     }
     wd = Watchdog(
         deadlines, interval_s=config.get(CO.WATCHDOG_INTERVAL),

@@ -14,6 +14,9 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>/backpressure  cycle-time percentiles
     /jobs/<jid>/traces        step-loop span traces as Chrome-trace JSON
                               (observability.tracing; docs/observability.md)
+    /jobs/<jid>/recovery      per-attempt recovery phase breakdowns
+                              (detect -> first-fire MTTR, warm vs full,
+                              task-local cache hits/misses)
     /jobs/<jid>/keygroups     hot key-group top-k + occupancy/fill skew
                               (device-resident telemetry; ?k= bounds)
     /metrics                  Prometheus text exposition over every job's
@@ -999,6 +1002,24 @@ class WebMonitor:
             except ValueError:
                 k = 10
             return {"available": True, **report_fn(k)}
+        m = re.fullmatch(r"/jobs/([^/]+)/recovery", path)
+        if m:
+            # per-attempt recovery phase breakdowns (metrics/recovery.py):
+            # detect/settle/backoff/restore_plan/fetch/stage/compile ->
+            # first-fire, plus warm-vs-full counts and the task-local
+            # cache hit/miss ledger — the MTTR story of this job
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            report_fn = getattr(rec.env, "_recovery_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "recovery instrumentation is recorded by "
+                            "windowed keyed stages; this job has none "
+                            "(yet)",
+                }
+            return {"available": True, **report_fn()}
         m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)
         if m:
             rec = self.cluster.jobs.get(m.group(1))

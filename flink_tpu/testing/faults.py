@@ -34,6 +34,11 @@ Injection-point catalog (the sites wired in this repo):
     ingest.producer         top of the prefetch-thread loop, OUTSIDE its
                             error-delivery try: a raising rule kills the
                             thread without delivering (thread death)
+    ckpt.read.primary       runtime/checkpoint CheckpointStorage, before
+                            a PRIMARY-storage read of one checkpoint
+                            directory (local-cache hits skip it) — a
+                            ``sleep`` rule here models remote-storage
+                            fetch latency in the MTTR drill
 
 Actions:
 
@@ -42,6 +47,11 @@ Actions:
     torn    raise :class:`TornWrite`; the site writes a truncated
             payload first, then fails the operation
     call    invoke ``fn(ctx)`` — e.g. close a socket handed in ctx
+    kill    raise :class:`ThreadKilled` (a BaseException): unlike
+            ``raise`` it sails through every ``except Exception``
+            containment layer between the point and the thread's top
+            frame — HARD thread/producer death, the "process segment
+            just vanished" failure mode
 """
 
 from __future__ import annotations
@@ -60,6 +70,15 @@ class TornWrite(Exception):
     PARTIAL bytes on disk, unlike a clean error)."""
 
 
+class ThreadKilled(BaseException):
+    """Raised by ``inject`` for ``action="kill"``. Deliberately a
+    BaseException: the containment layers under test catch ``Exception``,
+    so a kill rule dies HARD through all of them — the closest userspace
+    analog of a thread that simply ceases to run. The survivors (the
+    consumer detecting a dead producer, the watchdog detecting the
+    resulting stall) are what the rule exercises."""
+
+
 @dataclass
 class FaultRule:
     """One scheduled fault. Trigger precedence: ``at`` (0-based hit
@@ -67,7 +86,7 @@ class FaultRule:
     the injector's seeded RNG). ``times`` bounds total firings."""
 
     point: str
-    action: str = "raise"            # raise | sleep | torn | call
+    action: str = "raise"            # raise | sleep | torn | call | kill
     exc: Optional[BaseException] = None
     delay_s: float = 0.0
     fn: Optional[Callable[[dict], Any]] = None
@@ -132,6 +151,8 @@ class FaultInjector:
                     rule.fn(ctx)
             elif rule.action == "torn":
                 raise TornWrite(f"injected torn write at {point}")
+            elif rule.action == "kill":
+                raise ThreadKilled(f"injected thread kill at {point}")
             else:
                 raise rule.exc if rule.exc is not None else RuntimeError(
                     f"injected fault at {point}"

@@ -265,6 +265,44 @@ def test_pipeline_epoch_reset_discards_stale_batches():
         p.close()
 
 
+def test_hard_death_after_resume_still_surfaces():
+    """A producer that SURVIVES a pause/resume (restore) serves the new
+    epoch — a later hard death (BaseException out of prep, past the
+    error-delivery except) must surface as IngestThreadDied, not be
+    mistaken for a restore respawn and silently restarted past records
+    the dead thread consumed but never delivered."""
+    from flink_tpu.testing.faults import ThreadKilled
+
+    state = {"kill": False, "i": 0}
+
+    def prep():
+        if state["kill"]:
+            state["kill"] = False       # one-shot: a silent respawn
+            raise ThreadKilled("boom")  # would poll through unnoticed
+        state["i"] += 1
+        return ingest_mod.PreppedBatch(
+            end=False, n=1, now_ms=0, t_src=0.0, offsets=state["i"],
+        )
+
+    p = ingest_mod.IngestPipeline(prep, prefetch=True, initial_offsets=0,
+                                  depth=2)
+    try:
+        p.next()
+        p.pause()                  # thread survives, parked
+        assert p._thread.is_alive()
+        state["kill"] = True       # armed while parked: the FIRST
+        p.resume(applied_offsets=0)   # post-resume poll dies
+        deadline = time.monotonic() + 5
+        while p._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not p._thread.is_alive()
+        with pytest.raises(ingest_mod.IngestThreadDied):
+            for _ in range(20):    # a silent respawn would keep
+                p.next()           # returning batches — bounded
+    finally:
+        p.close()
+
+
 def test_pipeline_error_then_resume_continues():
     """After delivering an error the producer parks (it does not exit);
     resume() restarts production on the same thread — the restart path

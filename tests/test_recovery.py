@@ -1,0 +1,534 @@
+"""Fast bounded recovery (ISSUE 6): the task-local snapshot cache,
+failure classification + warm in-process restarts, the exponential-
+backoff restart strategy, the watchdog restore deadline, and the
+crash/restart chaos-cycle soak.
+
+The soak drives one windowed job through repeated injected crashes
+(hard ingest-thread kills — the faults.py ``kill`` action) and asserts
+the exactly-once oracle, closed manifest chains, and bounded restart
+backoff on EVERY cycle; the targeted tests pin each recovery mechanism
+individually."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.checkpointing.local import (
+    LocalCacheMiss,
+    LocalSnapshotCache,
+)
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.checkpoint import CheckpointStorage, RestartStrategy
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.runtime.watchdog import Watchdog, WatchdogError
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule, ThreadKilled
+
+N_KEYS = 200
+WINDOW = 10_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 50) * 1000
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 50) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, **cfg):
+    conf = Configuration(cfg)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = 256
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("recovery-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+def assert_chains_closed(ckpt_dir):
+    st = CheckpointStorage(str(ckpt_dir))
+    present = set(st.list_checkpoints())
+    for cid in present:
+        m = st.read_manifest(cid)
+        if m is not None:
+            missing = [c for c in m["chain"] if c not in present]
+            assert not missing, (
+                f"manifest of chk-{cid} chains over missing {missing}"
+            )
+
+
+WARM_CFG = {
+    "checkpoint.mode": "incremental",
+    "checkpoint.async": True,
+    "checkpoint.local.enabled": True,
+    "pipeline.prefetch": "on",
+    "restart-strategy": "exponential-backoff",
+    "restart-strategy.exponential-backoff.initial-delay": 0.01,
+    "restart-strategy.exponential-backoff.max-delay": 0.05,
+    "restart-strategy.exponential-backoff.jitter": 0.1,
+}
+
+
+# -------------------------------------------------- local cache unit
+
+def _write_chk(st, cid):
+    entries = {
+        "key_hi": np.arange(4, dtype=np.uint32),
+        "key_lo": np.arange(4, dtype=np.uint32),
+        "pane": np.zeros(4, np.int32),
+        "value": np.full(4, float(cid), np.float32),
+        "fresh": np.zeros(4, bool),
+    }
+    scal = {"watermark": cid, "fired_through": 0, "max_pane": 1,
+            "min_pane": 0, "dropped_late": 0, "dropped_capacity": 0}
+    st.write(cid, entries, scal, source_offsets={"o": cid}, aux={})
+
+
+def test_local_cache_mirror_verify_and_prune(tmp_path):
+    """Every publish mirrors into the cache; retention follows the
+    primary chain-closure GC so the tiers agree about the restorable
+    set; a corrupted blob fails verification and drops the entry."""
+    cache = LocalSnapshotCache(str(tmp_path / "local"))
+    st = CheckpointStorage(str(tmp_path / "chk"), retain=2, local=cache)
+    for cid in (1, 2, 3, 4, 5):
+        _write_chk(st, cid)
+    assert st.list_checkpoints() == cache.list_entries() == [4, 5]
+    assert cache.stats["puts"] == 5
+    # verified read
+    p = cache.verify(5)
+    assert os.path.isdir(p) and cache.stats["hits"] == 1
+    # corruption -> LocalCacheMiss + entry dropped
+    with open(os.path.join(cache.path(4), "entries.npz"), "ab") as f:
+        f.write(b"bitrot")
+    with pytest.raises(LocalCacheMiss):
+        cache.verify(4)
+    assert cache.stats["corrupt"] == 1 and not cache.has(4)
+
+
+def test_local_cache_rejects_stale_incarnation(tmp_path):
+    """Wiping + re-creating the primary directory restarts cids at 1;
+    a surviving cache entry from the OLD incarnation CRC-verifies
+    perfectly, so the storage-identity binding (not the checksums) must
+    reject it — restoring another incarnation's chk-1 would be silent
+    wrong-state recovery."""
+    import shutil
+
+    chk = str(tmp_path / "chk")
+    cache = LocalSnapshotCache(str(tmp_path / "local"))
+    st = CheckpointStorage(chk, retain=2, local=cache)
+    _write_chk(st, 1)
+    assert cache.verify(1)          # bound + fresh: verifies
+    hits = cache.stats["hits"]
+    # operator wipes the primary (token included) and starts over
+    shutil.rmtree(chk)
+    st2 = CheckpointStorage(chk, retain=2, local=cache)
+    assert st2.storage_id != st.storage_id
+    # the manifest fast path (read_manifest skips the CRC sweep) must
+    # reject the stale entry through the same identity binding
+    assert not cache.identity_ok(1)
+    with pytest.raises(LocalCacheMiss):
+        cache.verify(1)
+    assert cache.stats["stale"] == 1 and not cache.has(1)
+    assert cache.stats["hits"] == hits
+    # the new incarnation's own publishes verify again
+    _write_chk(st2, 1)
+    assert cache.verify(1)
+
+
+def test_storage_read_prefers_local_and_falls_back(tmp_path):
+    """read() serves from the verified local copy; a corrupt cache
+    entry transparently falls back to primary; a GC'd primary directory
+    can still restore from the cache (the availability win)."""
+    cache = LocalSnapshotCache(str(tmp_path / "local"))
+    st = CheckpointStorage(str(tmp_path / "chk"), retain=3, local=cache)
+    for cid in (1, 2, 3):
+        _write_chk(st, cid)
+    _e, _s, offsets, _a = st.read(3)
+    assert offsets == {"o": 3} and cache.stats["hits"] >= 1
+    # corrupt the cached copy: read falls back to primary and still works
+    with open(os.path.join(cache.path(3), "entries.npz"), "ab") as f:
+        f.write(b"junk")
+    _e, _s, offsets, _a = st.read(3)
+    assert offsets == {"o": 3} and cache.stats["corrupt"] == 1
+    # primary directory lost, cache intact -> read served locally
+    import shutil
+
+    shutil.rmtree(st.path(2))
+    _e, _s, offsets, _a = st.read_raw(2)
+    assert offsets == {"o": 2}
+
+
+# ------------------------------------------- warm in-process restart
+
+def test_warm_restart_after_ingest_thread_kill(tmp_path):
+    """A hard prefetch-thread death (the faults.py ``kill`` action) is
+    classified TRANSIENT and recovered by a warm in-process restart:
+    exactly-once results, a warm-mode attempt in the recovery report,
+    and the first-fire MTTR stamped."""
+    env = build_env(1, tmp_path / "chk", interval=2, **WARM_CFG)
+    inj = FaultInjector([FaultRule("ingest.producer", action="kill",
+                                   at=8)])
+    with faults.active(inj):
+        got = run_job(env, 6144)
+    assert got == expected(6144)
+    m = env.last_job.metrics
+    assert m.restarts == 1
+    rep = env._recovery_report()
+    ok = [a for a in rep["attempts"] if a["ok"]]
+    assert ok and ok[-1]["classification"] == "transient"
+    assert ok[-1]["mode"].startswith("warm")
+    assert ok[-1]["first_fire_ms"] and ok[-1]["first_fire_ms"] > 0
+    # warm = no recompile: the kernels compiled at setup are reused
+    assert ok[-1]["phases_ms"].get("compile", 0.0) == 0.0
+    assert rep["local-cache"]["puts"] >= 1
+
+
+def test_warm_restart_multi_shard_parity(tmp_path):
+    """The dirty-shard splice on a 2-shard mesh produces the same
+    results as the no-failure run (clean shards keep their live device
+    arrays; only diverged shards re-stage)."""
+    env = build_env(2, tmp_path / "chk", interval=2, **WARM_CFG)
+    inj = FaultInjector([FaultRule("ingest.producer", action="kill",
+                                   at=10)])
+    with faults.active(inj):
+        got = run_job(env, 6144)
+    assert got == expected(6144)
+    assert env.last_job.metrics.restarts == 1
+
+
+def test_warm_restart_opt_out_takes_full_path(tmp_path):
+    """recovery.warm-restart: false sends even transient failures down
+    the full restore path."""
+    env = build_env(1, tmp_path / "chk", interval=2,
+                    **{**WARM_CFG, "recovery.warm-restart": False})
+    inj = FaultInjector([FaultRule("ingest.producer", action="kill",
+                                   at=8)])
+    with faults.active(inj):
+        got = run_job(env, 6144)
+    assert got == expected(6144)
+    rep = env._recovery_report()
+    ok = [a for a in rep["attempts"] if a["ok"]]
+    assert ok and ok[-1]["mode"] == "full"
+
+
+def test_state_corrupting_failure_takes_full_path(tmp_path):
+    """An unclassified exception (a plain RuntimeError out of a sink)
+    is state-corrupting: the restore rebuilds every shard from the
+    checkpoint instead of trusting the live device state."""
+    env = build_env(1, tmp_path / "chk", interval=2, **WARM_CFG)
+    blew = []
+
+    class BlowOnceSink(CollectSink):
+        def invoke_batch(self, elements):
+            if not blew and self.results:
+                blew.append(1)
+                raise RuntimeError("sink blew a fuse")
+            super().invoke_batch(elements)
+
+    sink = BlowOnceSink()
+    (
+        env.add_source(GeneratorSource(gen, total=6144))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("recovery-job")
+    got = {(r.key, r.window_end_ms): r.value for r in sink.results}
+    assert got == expected(6144)
+    rep = env._recovery_report()
+    ok = [a for a in rep["attempts"] if a["ok"]]
+    assert ok and ok[-1]["classification"] == "state-corrupting"
+    assert ok[-1]["mode"] == "full"
+
+
+# ------------------------------------------------ double-fault path
+
+def test_double_fault_during_restore_lands_in_budget(tmp_path):
+    """A second injected failure DURING the restore (primary read
+    failure on the first fetch) consumes another restart-budget slot
+    and retries — the job neither hangs nor escapes with the raw
+    restore error."""
+    env = build_env(1, tmp_path / "chk", interval=2, **{
+        **WARM_CFG, "checkpoint.local.enabled": False,
+    })
+    inj = FaultInjector([
+        FaultRule("ingest.producer", action="kill", at=8),
+        FaultRule("ckpt.read.primary", exc=OSError("remote blip"), at=0),
+    ])
+    t0 = time.monotonic()
+    with faults.active(inj):
+        got = run_job(env, 6144)
+    assert time.monotonic() - t0 < 300.0        # no hang
+    assert got == expected(6144)
+    m = env.last_job.metrics
+    assert m.restarts == 2          # original failure + restore retry
+    rep = env._recovery_report()
+    assert len(rep["attempts"]) == 2
+    assert rep["attempts"][0]["ok"] is False
+    assert rep["attempts"][1]["ok"] is True
+    assert inj.fired_at("ckpt.read.primary")
+
+
+def test_double_fault_does_not_corrupt_local_cache(tmp_path):
+    """With the cache on, a corrupted cache entry + a primary-read
+    failure during restore still recovers within the budget, and every
+    surviving cache entry verifies afterwards."""
+    chk = tmp_path / "chk"
+    cache_dir = str(chk) + "-local"
+
+    def corrupt_newest(_ctx):
+        entries = sorted(
+            int(n[4:]) for n in os.listdir(cache_dir)
+            if n.startswith("chk-") and not n.endswith(".tmp")
+        )
+        if entries:
+            p = os.path.join(cache_dir, f"chk-{entries[-1]}",
+                             "entries.npz")
+            with open(p, "ab") as f:
+                f.write(b"bitrot")
+
+    env = build_env(1, chk, interval=2, **WARM_CFG)
+    inj = FaultInjector([
+        FaultRule("ingest.producer", action="call", fn=corrupt_newest,
+                  at=7),
+        FaultRule("ingest.producer", action="kill", at=8),
+    ])
+    with faults.active(inj):
+        got = run_job(env, 6144)
+    assert got == expected(6144)
+    # every surviving cache entry verifies (the corrupted one was
+    # dropped at restore time, not served)
+    cache = LocalSnapshotCache(cache_dir)
+    for cid in cache.list_entries():
+        cache.verify(cid)
+
+
+# ------------------------------------------------ restart strategies
+
+def test_exponential_backoff_grows_caps_and_resets():
+    rs = RestartStrategy.exponential_backoff(
+        initial_delay_s=0.01, max_delay_s=0.04, multiplier=2.0,
+        jitter=0.0, reset_after_s=0.2,
+    )
+    now = time.time()
+    delays = [rs.next_backoff_delay(now + i * 0.001) for i in range(4)]
+    assert delays == [0.01, 0.02, 0.04, 0.04]       # grows, then capped
+    # a quiet period >= reset-after resets back to the initial delay
+    assert rs.next_backoff_delay(now + 1.0) == 0.01
+
+
+def test_exponential_backoff_jitter_bounded():
+    rs = RestartStrategy.exponential_backoff(
+        initial_delay_s=0.04, max_delay_s=0.04, multiplier=2.0,
+        jitter=0.25, reset_after_s=10.0,
+    )
+    for _ in range(50):
+        d = rs.next_backoff_delay()
+        assert 0.04 * 0.75 - 1e-9 <= d <= 0.04 * 1.25 + 1e-9
+
+
+def test_exponential_backoff_config_plumbing(tmp_path):
+    """The executor builds the strategy from the declared ConfigOptions
+    (strict coercion: conf-file strings parse, typos raise)."""
+    from flink_tpu.runtime.executor import LocalExecutor
+
+    env = build_env(1, **{
+        "restart-strategy": "exponential-backoff",
+        "restart-strategy.exponential-backoff.initial-delay": "0.5",
+        "restart-strategy.exponential-backoff.max-delay": "2.0",
+        "restart-strategy.exponential-backoff.multiplier": "3.0",
+        "restart-strategy.exponential-backoff.jitter": "0",
+        "restart-strategy.exponential-backoff.reset-after": "60",
+    })
+    rs = LocalExecutor(env)._restart_strategy()
+    assert rs.kind == "exponential-backoff"
+    assert (rs.initial_delay_s, rs.max_delay_s, rs.multiplier) == \
+        (0.5, 2.0, 3.0)
+    env = build_env(1, **{"restart-strategy": "sometimes"})
+    with pytest.raises(ValueError, match="restart-strategy"):
+        LocalExecutor(env)._restart_strategy()
+
+
+# ---------------------------------------------------- watchdog restore
+
+def test_watchdog_suspend_disarms_step_phases():
+    """While a restore is in progress the steady-state phase deadlines
+    must not trip; the dedicated restore deadline still does."""
+    wd = Watchdog({"fire": 0.1, "restore": 10.0}, interval_s=0.05)
+    wd.start()
+    try:
+        prev = wd.arm("restore")
+        wd.suspend()
+        # a nested steady-state phase armed during restore gets NO
+        # deadline: sleeping past fire's 0.1s must not trip
+        p2 = wd.arm("fire")
+        time.sleep(0.4)
+        wd.disarm(p2)
+        wd.unsuspend()
+        wd.disarm(prev)
+        assert wd.trips == []
+    finally:
+        wd.stop()
+
+
+def test_watchdog_restore_deadline_trips():
+    wd = Watchdog({"restore": 0.1}, interval_s=0.05)
+    wd.start()
+    try:
+        prev = wd.arm("restore")
+        wd.suspend()
+        with pytest.raises(WatchdogError, match="restore"):
+            time.sleep(5.0)
+        wd.unsuspend()
+        wd.disarm(prev)
+        assert wd.trips and wd.trips[0].phase == "restore"
+    finally:
+        wd.stop()
+
+
+# --------------------------------------------------------- kill action
+
+def test_kill_action_escapes_exception_containment():
+    """ThreadKilled is a BaseException: an ``except Exception``
+    containment layer between the injection point and the thread top
+    must NOT swallow it."""
+    inj = FaultInjector([FaultRule("p.kill", action="kill", at=0)])
+    with faults.active(inj):
+        with pytest.raises(ThreadKilled):
+            try:
+                faults.inject("p.kill")
+            except Exception:       # the containment a kill must escape
+                pytest.fail("kill was contained by `except Exception`")
+
+
+# ------------------------------------------------- web + metrics surface
+
+def test_recovery_route_and_gauges(tmp_path):
+    """/jobs/<jid>/recovery serves the attempt history for a windowed
+    job (and available:false for stages without the tracker); the
+    recovery_* gauges ride the Prometheus text exposition."""
+    import urllib.request
+
+    from flink_tpu.runtime.cluster import MiniCluster
+    from flink_tpu.runtime.web import WebMonitor
+
+    env = build_env(1, tmp_path / "chk", interval=2, **WARM_CFG)
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=4096))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    inj = FaultInjector([FaultRule("ingest.producer", action="kill",
+                                   at=6)])
+    try:
+        with faults.active(inj):
+            jid = cluster.submit(env, "recovery-web-job")
+            assert cluster.wait(jid, 240) == "FINISHED"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/jobs/{jid}/recovery", timeout=10
+        ) as r:
+            body = json.loads(r.read())
+        assert body["available"] is True
+        assert body["counts"]["total"] >= 1
+        assert body["attempts"][-1]["phases_ms"]
+        assert body["local-cache"]["puts"] >= 1
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        for gauge in ("recovery_attempts", "recovery_warm_restarts",
+                      "recovery_last_first_fire_ms",
+                      "recovery_local_hits"):
+            assert f"flink_tpu_{gauge}" in text, gauge
+        assert 'flink_tpu_recovery_attempts{job="recovery-web-job"} 1' \
+            in text
+    finally:
+        web.stop()
+
+
+# ------------------------------------------------- chaos-cycle soak
+
+def _cycle_soak(tmp_path, total, kill_hits):
+    env = build_env(1, tmp_path / "chk", interval=2, **WARM_CFG)
+    rules = [FaultRule("ingest.producer", action="kill", at=h)
+             for h in kill_hits]
+    inj = FaultInjector(rules, seed=99)
+    t0 = time.monotonic()
+    with faults.active(inj):
+        got = run_job(env, total)
+    wall = time.monotonic() - t0
+    m = env.last_job.metrics
+    # exactly-once oracle across EVERY crash/restart cycle
+    assert got == expected(total)
+    assert m.restarts >= len(kill_hits)
+    assert_chains_closed(tmp_path / "chk")
+    # bounded backoff every cycle: the exponential-backoff strategy
+    # caps at max-delay * (1 + jitter) (+ scheduling slack)
+    rep = env._recovery_report()
+    cap_ms = 0.05 * 1.1 * 1000 + 250.0
+    backoffs = [a["phases_ms"].get("backoff", 0.0)
+                for a in rep["attempts"]]
+    assert backoffs and all(b <= cap_ms for b in backoffs), backoffs
+    # the cycles actually recovered warm (the fast path is the product)
+    assert any((a["mode"] or "").startswith("warm")
+               for a in rep["attempts"])
+    return m, rep, wall
+
+
+def test_crash_restart_cycle_soak_fast(tmp_path):
+    """Tier-1 variant: 3 injected crash/restart cycles."""
+    m, rep, wall = _cycle_soak(tmp_path, total=8192,
+                               kill_hits=(8, 16, 24))
+    assert wall < 300.0
+
+
+@pytest.mark.slow
+def test_crash_restart_cycle_soak_full(tmp_path):
+    """Full soak (the ISSUE 6 acceptance): >= 5 crash/restart cycles
+    with exactly-once, closed chains, and bounded backoff per cycle."""
+    m, rep, wall = _cycle_soak(
+        tmp_path, total=32768, kill_hits=(10, 25, 40, 55, 70, 85),
+    )
+    assert m.restarts >= 5
+    assert wall < 900.0
