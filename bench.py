@@ -414,12 +414,18 @@ def main():
             run_device_update_ceiling,
         )
 
-        k4, k1 = run_device_update_ceiling(args.events, args.cpu)
+        fused_best, split_best = run_device_update_ceiling(
+            args.events, args.cpu
+        )
         print(json.dumps({
-            "metric": "device update ceiling, K=4 fused vs K=1 (dup 0.5)",
-            "value": k4,
+            "metric": "device ceiling, best fused-fire cell vs best "
+                      "split-dispatch (PR-5 path) cell, firing stream",
+            "value": round(fused_best),
             "unit": "events/s",
-            "vs_baseline": round(k4 / k1, 2) if k1 else 0,
+            "vs_baseline": (
+                round(fused_best / split_best, 2) if split_best else 0
+            ),
+            "criterion": ">= 1.15",
             "batch": DEVICE_CEILING_BATCH,
         }))
         return

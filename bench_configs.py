@@ -830,28 +830,39 @@ DEVICE_CEILING_BATCH = 512   # bench.py --device-ceiling reports this
 
 
 def run_device_update_ceiling(total_events: int, cpu: bool):
-    """Device update-step + fire ceiling (ISSUE 5): a pre-staged
-    synthetic batch ring feeds the compiled update step directly — no
-    source, no prefetch, no emit path, no tunnel-quietness dependence —
-    so the compute ceiling VERDICT r5 could only infer from quiet-window
-    luck is measured per-round as a first-class number.
+    """Device update + fire ceiling (ISSUE 5, extended by ISSUE 7): a
+    pre-staged synthetic batch stream feeds the compiled steps directly
+    — no source, no prefetch, no emit path, no tunnel-quietness
+    dependence — so the compute ceiling is measured per-round as a
+    first-class number.
 
-    Two sweeps:
-      * fusion: K in {1, 4, 8} (pipeline.steps-per-dispatch megasteps)
-        x duplicate-key fraction in {0, 0.5, 0.9}. The geometry
-        (DEVICE_CEILING_BATCH=512, C=4096) sits in the
-        dispatch-overhead regime the fusion lever
-        attacks: per-dispatch fixed cost is a measurable share of the
-        step, as on the tunneled TPU runtime where it is ~100ms.
-      * precombine: wk.update's duplicate-key collapse ON vs OFF at each
-        duplicate fraction (K=1). On accelerators a duplicate-index
-        scatter serializes and the sort pays for itself; on XLA CPU the
-        sort costs more than the scatter it saves — both are reported,
-        per platform, so the default (platform-gated auto) is grounded
-        in this artifact instead of asserted.
+    Three blocks:
 
-    subject = K=4 events/s at dup=0.5, baseline = K=1 (the fusion win);
-    the detail line carries the full grid + a fire-step probe.
+    * ``fusion`` / ``precombine`` — the PR-5 QUIET grid, unchanged for
+      trajectory continuity: K in {1,4,8} megasteps x duplicate-key
+      fraction, sentinel watermark (no fires mid-loop), plus the
+      precombine on/off pair per duplicate fraction.
+    * ``fire_grid`` — the ISSUE-7 acceptance grid: a FIRING workload
+      (event time advances ~1 pane per ``BPP`` batches, watermark
+      trailing, so windows really close mid-stream) run through BOTH
+      dispatch disciplines on the same K/dup grid:
+        - ``split``: the PR-5 runtime's pattern — the fused group breaks
+          at every pane-boundary crossing (partial groups dispatch as
+          sequential single steps), then a separate fire dispatch plus
+          the blocking small-field fetch the split drain pays;
+        - ``fused``: resident-pipeline megasteps (fire folded into the
+          scan, build_window_megastep_fired), fire payload handles
+          consumed LAGGED like the executor's consume_fires.
+      ``acceptance`` stamps best(fused) / best(split) — the "PR 5 best
+      cell" is the best the split discipline achieves on this container,
+      same K/dup grid, best-of-3 — criterion >= 1.15.
+    * ``state_planes`` — the kernel-variant sweep at the base firing
+      cell (K=8, dup=0.5, direct, f32-sum, pane-major, split planes),
+      varying one axis at a time: packed planes, i32-count accumulators
+      (plain + packed), the hash table layout, and slot-major
+      accumulator order — so the platform-gated auto defaults (packed /
+      precombine off on CPU, on for accelerators) stay grounded in this
+      artifact instead of asserted.
     """
     import jax
     import jax.numpy as jnp
@@ -860,8 +871,10 @@ def run_device_update_ceiling(total_events: int, cpu: bool):
     from flink_tpu.parallel.mesh import MeshContext
     from flink_tpu.runtime.step import (
         WindowStageSpec,
+        build_window_fire_reduced_step,
         build_window_fire_step,
         build_window_megastep,
+        build_window_megastep_fired,
         build_window_update_step,
         init_sharded_state,
     )
@@ -874,38 +887,55 @@ def run_device_update_ceiling(total_events: int, cpu: bool):
     # holds the 8 cycling panes without evicting unfired data
     B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
     N_SLOTS = 8
+    BPP = 4            # firing stream: batches per pane (crossing cadence)
     iters = max(128, min(8192, total_events // B))
+    # firing cells pre-stage every batch (panes advance monotonically,
+    # so batches cannot be reused across iterations like the quiet ring)
+    iters_f = max(96, iters // 8)
 
+    def _spec(K=1, dup=0.0, precombine=False, layout="direct",
+              red=None, packed=False, acc_layout="pane"):
+        return WindowStageSpec(
+            win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4,
+                              acc_layout=acc_layout),
+            red=red or wk.ReduceSpec("sum", jnp.float32),
+            capacity_per_shard=C, layout=layout, precombine=precombine,
+            packed=packed,
+        )
+
+    def _keys(dup, rng, layout):
+        n_hot = int(B * dup)
+        lo = np.concatenate([
+            rng.integers(0, C - 1, B - n_hot),
+            rng.integers(0, 64, n_hot),
+        ]).astype(np.uint32)
+        rng.shuffle(lo)
+        if layout == "direct":
+            return np.zeros(B, np.uint32), lo
+        from flink_tpu.ops.hashing import hash64_host
+
+        h = hash64_host(lo.astype(np.int64))
+        return ((h >> np.uint64(32)).astype(np.uint32),
+                (h & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+    # ---------------------------------------------------- quiet grid (PR 5)
     def make_ring(dup, rng):
         """N_SLOTS pre-staged batches; slot i's records land in pane i,
         so the slot cycle exercises the pane-ring rotation without ever
-        evicting unfired data (the 9-pane ring holds the 8 cycling
-        panes plus the headroom pane). A
-        `dup` fraction of lanes hits a 64-key hot set (the duplicate-
-        collapse case); the rest are near-unique."""
+        evicting unfired data."""
         slots = []
         for i in range(N_SLOTS):
-            n_hot = int(B * dup)
-            lo = np.concatenate([
-                rng.integers(0, C - 1, B - n_hot),
-                rng.integers(0, 64, n_hot),
-            ]).astype(np.uint32)
-            rng.shuffle(lo)
+            hi, lo = _keys(dup, rng, "direct")
             ts = np.full(B, i * SLIDE + SLIDE // 2, np.int32)
             slots.append(tuple(jax.device_put(a) for a in (
-                np.zeros(B, np.uint32), lo, ts,
-                np.ones(B, np.float32), np.ones(B, bool),
+                hi, lo, ts, np.ones(B, np.float32), np.ones(B, bool),
             )))
         return slots
 
     WM_MIN = np.int32(-(2**31) + 1)   # sentinel: no fires mid-loop
 
-    def measure(K, dup, precombine):
-        spec = WindowStageSpec(
-            win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
-            red=wk.ReduceSpec("sum", jnp.float32),
-            capacity_per_shard=C, layout="direct", precombine=precombine,
-        )
+    def measure_quiet(K, dup, precombine):
+        spec = _spec(K, dup, precombine)
         step = (
             build_window_update_step(ctx, spec) if K == 1
             else build_window_megastep(ctx, spec, K)
@@ -941,22 +971,150 @@ def run_device_update_ceiling(total_events: int, cpu: bool):
             jax.block_until_ready(mon[1])
             upd_dt = min(upd_dt, time.perf_counter() - t0)
         # fire probe: one fire dispatch over the full key population
-        # (every pane due) — the drain half of the hot loop's ceiling
         t1 = time.perf_counter()
         state, fr = fire(state, np.full(n_dev, np.int32(2**31 - 5)))
         jax.block_until_ready(fr.counts)
         fire_ms = (time.perf_counter() - t1) * 1e3
         return B * n_disp * K / upd_dt, fire_ms
 
+    # ------------------------------------------------- firing-stream cells
+    def make_stream(dup, rng, n_batches, layout):
+        """Pre-staged batches whose panes ADVANCE (pane j//BPP) with the
+        watermark trailing one pane, so windows fire mid-stream — the
+        workload the resident pipeline exists for."""
+        batches, wms = [], []
+        for j in range(n_batches):
+            p = j // BPP
+            hi, lo = _keys(dup, rng, layout)
+            ts = np.full(B, p * SLIDE + SLIDE // 2, np.int32)
+            batches.append(tuple(jax.device_put(a) for a in (
+                hi, lo, ts, np.ones(B, np.float32), np.ones(B, bool),
+            )))
+            wms.append(np.int32(p * SLIDE - 1))
+        return batches, wms
+
+    def measure_split_fire(K, dup, layout="direct", red=None,
+                           packed=False, acc_layout="pane",
+                           reduced=False):
+        """The PR-5 dispatch discipline on the firing stream: groups
+        break at every crossing (partials dispatch as singles), each
+        crossing pays a separate fire dispatch + the blocking
+        small-field fetch of the split drain. ``reduced`` uses the
+        on-chip-reduced fire variant (device_reduce sink topology) —
+        the split path's best case, so the acceptance comparison never
+        flatters the resident pipeline."""
+        spec = _spec(K, dup, layout=layout, red=red, packed=packed,
+                     acc_layout=acc_layout)
+        step1 = build_window_update_step(ctx, spec)
+        mega = build_window_megastep(ctx, spec, K) if K > 1 else None
+        fire = (
+            build_window_fire_reduced_step(ctx, spec) if reduced
+            else build_window_fire_step(ctx, spec)
+        )
+        n_batches = iters_f * max(1, K)
+        batches, wms = make_stream(dup, np.random.default_rng(11),
+                                   n_batches, layout)
+
+        def run_once():
+            state = init_sharded_state(ctx, spec)
+            t0 = time.perf_counter()
+            pend = []
+            last_wm = WM_MIN
+            mon = None
+            for j in range(n_batches):
+                pend.append(j)
+                crossing = wms[j] > last_wm
+                if crossing or len(pend) == K:
+                    if len(pend) == K and mega is not None:
+                        flat = [a for i in pend for a in batches[i]]
+                        wmv = np.tile(
+                            np.asarray([wms[i] for i in pend], np.int32),
+                            (n_dev, 1),
+                        )
+                        state, mon = mega(state, *flat, wmv)
+                    else:
+                        for i in pend:
+                            state, mon = step1(
+                                state, *batches[i],
+                                np.full(n_dev, wms[i]),
+                            )
+                    pend = []
+                    if crossing:
+                        state, cf = fire(state, np.full(n_dev, wms[j]))
+                        # the split drain's blocking small-field fetch
+                        jax.device_get((cf.counts, cf.lane_valid,
+                                        cf.window_end_ticks,
+                                        cf.value_sums))
+                        last_wm = wms[j]
+            jax.block_until_ready(mon[1])
+            return time.perf_counter() - t0
+
+        run_once()                               # compile + settle
+        dt = min(run_once() for _ in range(3))
+        return B * n_batches / dt
+
+    def measure_fused_fire(K, dup, layout="direct", red=None,
+                           packed=False, acc_layout="pane",
+                           reduced=False):
+        """The resident pipeline on the same firing stream: full fired
+        megasteps throughout (crossings fire IN the scan), payload
+        handles consumed lagged like executor.consume_fires.
+        ``reduced`` surfaces ReducedFires — no payload stacking, the
+        device_reduce topology's path."""
+        from collections import deque as _dq
+
+        spec = _spec(K, dup, layout=layout, red=red, packed=packed,
+                     acc_layout=acc_layout)
+        mega = build_window_megastep_fired(ctx, spec, K, reduced=reduced)
+        n_disp = iters_f
+        n_batches = n_disp * K
+        batches, wms = make_stream(dup, np.random.default_rng(11),
+                                   n_batches, layout)
+
+        def consume(cf):
+            jax.device_get((cf.counts, cf.lane_valid,
+                            cf.window_end_ticks, cf.value_sums))
+
+        def run_once():
+            state = init_sharded_state(ctx, spec)
+            t0 = time.perf_counter()
+            handles = _dq()
+            mon = None
+            for g in range(n_disp):
+                sel = range(g * K, (g + 1) * K)
+                flat = [a for i in sel for a in batches[i]]
+                wmv = np.tile(
+                    np.asarray([wms[i] for i in sel], np.int32),
+                    (n_dev, 1),
+                )
+                state, mon, fires = mega(state, *flat, wmv)
+                handles.append(fires)
+                if len(handles) > 1:
+                    consume(handles.popleft())
+            while handles:
+                consume(handles.popleft())
+            jax.block_until_ready(mon[1])
+            return time.perf_counter() - t0
+
+        run_once()                               # compile + settle
+        dt = min(run_once() for _ in range(3))
+        return B * n_batches / dt
+
     platform = jax.default_backend()
-    pre_default = platform != "cpu"   # the executor's auto resolution
+    pre_default = platform != "cpu"    # the executor's auto resolutions
+    packed_default = platform != "cpu"
     detail = {"platform": platform, "B": B, "C": C,
-              "iters": iters, "n_devices": n_dev,
-              "fusion": {}, "precombine": {}}
+              "iters": iters, "iters_firing": iters_f, "bpp": BPP,
+              "n_devices": n_dev,
+              "precombine_auto": pre_default,
+              "packed_planes_auto": packed_default,
+              "fusion": {}, "precombine": {},
+              "fire_grid": {"split": {}, "fused": {}},
+              "state_planes": {}}
     for dup in (0.0, 0.5, 0.9):
         row = {}
         for K in (1, 4, 8):
-            eps, fire_ms = measure(K, dup, pre_default)
+            eps, fire_ms = measure_quiet(K, dup, pre_default)
             row[f"K{K}"] = round(eps)
             if K == 1:
                 row["fire_ms"] = round(fire_ms, 2)
@@ -964,16 +1122,79 @@ def run_device_update_ceiling(total_events: int, cpu: bool):
         row["K8_vs_K1"] = round(row["K8"] / row["K1"], 2)
         detail["fusion"][f"dup_{dup}"] = row
     for dup in (0.0, 0.5, 0.9):
-        on, _ = measure(1, dup, True)
-        off, _ = measure(1, dup, False)
+        on, _ = measure_quiet(1, dup, True)
+        off, _ = measure_quiet(1, dup, False)
         detail["precombine"][f"dup_{dup}"] = {
             "on": round(on), "off": round(off),
             "ratio": round(on / off, 2),
         }
+
+    # the ISSUE-7 acceptance grid: both dispatch disciplines, both fire
+    # payload modes, same K/dup cells. The headline acceptance compares
+    # the device_reduce (on-chip-reduced) topology — the reference
+    # northstar bench's path and BOTH disciplines' best case; the
+    # compact-payload pair is stamped alongside for the general
+    # (key-emitting) topology.
+    detail["fire_grid"]["split_reduced"] = {}
+    detail["fire_grid"]["fused_reduced"] = {}
+    bests = {k: (None, 0.0) for k in
+             ("split", "fused", "split_reduced", "fused_reduced")}
+    for dup in (0.0, 0.5, 0.9):
+        for K in (4, 8):
+            cell = f"K{K}_dup_{dup}"
+            for mode, eps in (
+                ("split", measure_split_fire(K, dup)),
+                ("fused", measure_fused_fire(K, dup)),
+                ("split_reduced", measure_split_fire(K, dup,
+                                                     reduced=True)),
+                ("fused_reduced", measure_fused_fire(K, dup,
+                                                     reduced=True)),
+            ):
+                detail["fire_grid"][mode][cell] = round(eps)
+                if eps > bests[mode][1]:
+                    bests[mode] = (cell, eps)
+    best_split = bests["split_reduced"]
+    best_fused = bests["fused_reduced"]
+    detail["acceptance"] = {
+        "topology": "device_reduce (on-chip-reduced fires)",
+        "pr5_best_cell": {"cell": best_split[0],
+                          "eps": round(best_split[1])},
+        "fused_fire_best_cell": {"cell": best_fused[0],
+                                 "eps": round(best_fused[1])},
+        "ratio": round(best_fused[1] / max(best_split[1], 1.0), 2),
+        "criterion": ">= 1.15",
+    }
+    detail["acceptance_compact"] = {
+        "topology": "compact payloads (key-emitting sinks)",
+        "pr5_best_cell": {"cell": bests["split"][0],
+                          "eps": round(bests["split"][1])},
+        "fused_fire_best_cell": {"cell": bests["fused"][0],
+                                 "eps": round(bests["fused"][1])},
+        "ratio": round(
+            bests["fused"][1] / max(bests["split"][1], 1.0), 2
+        ),
+    }
+
+    # state-plane sweep: one axis at a time off the base firing cell
+    KB, DB = 8, 0.5
+    i32 = wk.ReduceSpec("count", jnp.int32)
+    plane_cells = {
+        "base_f32_split": dict(),
+        "packed": dict(packed=True),
+        "i32_count": dict(red=i32),
+        "packed_i32": dict(red=i32, packed=True),
+        "hash_table": dict(layout="hash"),
+        "slot_major": dict(acc_layout="slot"),
+    }
+    for name, kw in plane_cells.items():
+        detail["state_planes"][name] = {
+            "split_fire": round(measure_split_fire(KB, DB, **kw)),
+            "fused_fire": round(measure_fused_fire(KB, DB, **kw)),
+        }
+
     print(json.dumps(
         {"config": "device_update_ceiling", "detail": detail}), flush=True)
-    return (detail["fusion"]["dup_0.5"]["K4"],
-            detail["fusion"]["dup_0.5"]["K1"])
+    return (best_fused[1], best_split[1])
 
 
 CONFIGS = {

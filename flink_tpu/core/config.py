@@ -241,6 +241,26 @@ class CoreOptions:
         "TPU). auto enables it on accelerator backends and keeps the "
         "CPU path unsorted (XLA's CPU sort costs more than the CPU "
         "scatter it saves — measured in device_update_ceiling)")
+    PIPELINE_FUSED_FIRE = ConfigOption(
+        "pipeline.fused-fire", "auto",
+        "auto | on | off — fold the fire sweep into the K-fused megastep "
+        "scan (the resident pipeline, ISSUE 7): a pane-boundary crossing "
+        "inside a K-group fires WITHIN the scan instead of breaking the "
+        "group and paying a separate fire dispatch; fire payloads "
+        "surface as lagged megastep outputs. auto = on whenever "
+        "steps-per-dispatch > 1; off keeps the split-dispatch path "
+        "(which always remains the fallback for partial groups and the "
+        "DCN lockstep plane)")
+    STATE_PACKED_PLANES = ConfigOption(
+        "state.packed-planes", "auto",
+        "auto | on | off — store the touched (fire-eligibility) bits as "
+        "a trailing column of the pane accumulator so the update issues "
+        "ONE scatter over wider lanes and ring-reset/purge sweeps clear "
+        "one plane instead of two (built-in reducers with default "
+        "neutrals only). auto enables it on accelerator backends where "
+        "scatter passes dominate; CPU keeps split planes (the wider "
+        "sweep costs more than the scatter it saves — measured in "
+        "device_update_ceiling)")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)

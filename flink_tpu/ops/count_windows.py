@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from flink_tpu.ops import hashtable
 from flink_tpu.ops.hashtable import SlotTable
+from flink_tpu.ops import segment
 from flink_tpu.ops.segment import _bshape, segmented_reduce_sorted
 from flink_tpu.ops.window_kernels import ReduceSpec
 
@@ -73,7 +74,7 @@ def update(
 
     big = jnp.int32(2**31 - 1)
     ids = jnp.where(live, slot, big)
-    order = jnp.argsort(ids)
+    order = segment.argsort_ids(ids)
     ids_s = ids[order]
     khi_s, klo_s = hi[order], lo[order]
     vals = values.astype(red.dtype)[order]

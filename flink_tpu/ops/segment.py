@@ -51,6 +51,41 @@ def _bshape(flag, val):
     return flag.reshape(flag.shape + (1,) * extra)
 
 
+# -- the ONE place device sorts live -----------------------------------
+# Every jnp.sort/argsort in flink_tpu/ops goes through these wrappers:
+# a sort is the single most expensive reordering primitive the kernels
+# use, and the whole pre-combine design is "pay ONE sort, feed every
+# consumer from it" (acc scatter, fire eligibility via touched, the
+# kg_dirty changelog bits, kg_fill skew telemetry — see
+# window_kernels.update). Centralizing the call sites makes that seam
+# auditable: tools/check_segment_sort_seam.py (tier-1) fails the build
+# when a sort appears anywhere else under ops/, so a future edit cannot
+# quietly reintroduce a per-plane sort pass.
+
+def sort_values(x):
+    """Ascending sort of a 1-D array (the do_late window-id dedup in
+    window_kernels and any future value sort)."""
+    return jnp.sort(x)
+
+
+def argsort_ids(ids, stable: bool = False):
+    """Permutation ordering ``ids`` ascending. ``stable=True`` keeps
+    equal ids in input order (the session-window chain relies on it)."""
+    return jnp.argsort(ids, stable=stable) if stable else jnp.argsort(ids)
+
+
+def invert_permutation(order):
+    """Inverse of a permutation: out[order[i]] = i. One scatter instead
+    of the argsort-of-argsort idiom (an O(B log B) sort to invert what a
+    single O(B) scatter inverts exactly)."""
+    B = order.shape[0]
+    return (
+        jnp.zeros(B, order.dtype)
+        .at[order]
+        .set(jnp.arange(B, dtype=order.dtype))
+    )
+
+
 def segment_sort(seg_ids, valid):
     """The ONE sort a batched pre-combine pays: order lanes by segment id
     with invalid lanes pushed to the end (id = INT32_MAX).
@@ -65,7 +100,7 @@ def segment_sort(seg_ids, valid):
     """
     big = jnp.int32(2**31 - 1)
     ids = jnp.where(valid, seg_ids, big)
-    order = jnp.argsort(ids)
+    order = argsort_ids(ids)
     ids_s = ids[order]
     valid_s = valid[order]
     seg_start = jnp.concatenate(

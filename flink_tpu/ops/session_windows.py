@@ -34,6 +34,7 @@ import numpy as np
 
 from flink_tpu.ops import hashtable
 from flink_tpu.ops.hashtable import SlotTable
+from flink_tpu.ops import segment
 from flink_tpu.ops.segment import _bshape, segmented_reduce_sorted
 from flink_tpu.ops.window_kernels import ReduceSpec
 
@@ -76,8 +77,8 @@ def init_state(capacity: int, probe_len: int, red: ReduceSpec) -> SessionShardSt
 
 def _lexsort_slot_ts(ids, ts):
     """Stable sort by (ids, ts): sort by ts first, then stable by ids."""
-    o1 = jnp.argsort(ts, stable=True)
-    o2 = jnp.argsort(ids[o1], stable=True)
+    o1 = segment.argsort_ids(ts, stable=True)
+    o2 = segment.argsort_ids(ids[o1], stable=True)
     return o1[o2]
 
 

@@ -26,7 +26,7 @@ out-of-orderness budget.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -42,6 +42,7 @@ from flink_tpu.ops.segment import (
     reduce_sorted,
     scatter_combine,
     segment_sort,
+    sort_values,
 )
 
 # np scalar, not jnp: a module-level jnp call would initialize the JAX
@@ -134,6 +135,14 @@ class WindowSpec:
     # host drains the ring into the spill-store tier at fire boundaries
     # (the RocksDB-analog seam, RocksDBKeyedStateBackend.java:82)
     overflow: int = 0
+    # accumulator memory order: "pane" (ring-major, pane columns
+    # contiguous — sweeps/fires/purges are sequential-bandwidth passes)
+    # or "slot" (slot-major, each key's pane vector contiguous — the
+    # scatter writes one cache line per key). The runtime always runs
+    # pane-major (measured best for the sweep-dominated step); the
+    # device_update_ceiling bench sweeps both so the choice stays
+    # grounded per platform instead of asserted.
+    acc_layout: str = "pane"
 
     def __post_init__(self):
         if self.size_ticks % self.slide_ticks:
@@ -141,6 +150,10 @@ class WindowSpec:
         if self.panes_per_window + 1 > self.ring:
             raise ValueError(
                 f"ring={self.ring} too small for {self.panes_per_window} panes/window"
+            )
+        if self.acc_layout not in ("pane", "slot"):
+            raise ValueError(
+                f"acc_layout must be pane|slot, got {self.acc_layout!r}"
             )
 
     @property
@@ -180,6 +193,16 @@ class WindowShardState:
     # the scalars at the step-boundary barrier, it tells the snapshot
     # which key groups' entries must ride the next delta
     kg_dirty: jax.Array         # bool [n_key_groups]
+    # STATIC plane descriptor (pytree aux data, not a leaf): -1 = split
+    # planes (acc + touched are separate arrays, the layout above);
+    # >= 0 = PACKED planes — ``acc`` carries a trailing touch column
+    # ([C*R, W+1] for a W-wide value, [C*R, 2] for scalars) updated by
+    # the SAME scatter/sweep as the values, and ``touched`` is a
+    # zero-length placeholder. The int is the logical value ndim (0 for
+    # scalar reduces), which disambiguates [*, 2] scalar-packed from a
+    # width-1 vector. Self-describing so snapshot/restore/queryable
+    # consumers unpack without threading a spec (wk.split_packed).
+    packed: int = -1
 
     def tree_flatten(self):
         return (
@@ -188,12 +211,12 @@ class WindowShardState:
              self.purged_through, self.dropped_late, self.dropped_capacity,
              self.fresh, self.n_fresh, self.ovf_hi, self.ovf_lo,
              self.ovf_pane, self.ovf_val, self.ovf_n, self.kg_dirty),
-            None,
+            self.packed,
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(*children, packed=aux)
 
 
 def ring_append(ovf, mask, hi, lo, pane, vals, O: int):
@@ -226,9 +249,112 @@ def overflow_supported(red: ReduceSpec) -> bool:
     return red.kind in ("sum", "count", "min", "max") and red.finalize is None
 
 
+# ------------------------------------------------- packed state planes
+# ISSUE 7: the pane-ring accumulator and the touched (fire-eligibility)
+# plane can live in ONE wider array — acc[..., :W] holds the values and
+# acc[..., -1] a touch column combined under the SAME reducer op — so
+# every update issues one scatter over W+1 lanes instead of a value
+# scatter plus a bool scatter, and every ring-reset/purge sweep clears
+# one plane instead of two. The touch column's neutral IS the untouched
+# marker (sweeps that write the packed neutral reset both planes at
+# once); any update drives it away from neutral (add: +1 per lane,
+# min/max: 0 against the +/-extreme default neutral), so
+# ``column != neutral`` recovers the bool plane exactly.
+
+def packed_eligible(red: ReduceSpec) -> bool:
+    """Packing needs a builtin combine whose DEFAULT neutral the touch
+    marker provably escapes (an explicit user neutral could collide with
+    the marker), and an at-most-1-D value (the column rides axis -1)."""
+    return (
+        red.kind in ("sum", "count", "min", "max")
+        and red.neutral is None
+        and red.sketch is None
+        and len(red.value_shape) <= 1
+    )
+
+
+def _touch_marker(red: ReduceSpec):
+    """Per-lane touch-column update: combines to something != neutral."""
+    if red.kind in ("sum", "count"):
+        return jnp.ones((), red.dtype)     # neutral 0 -> count of touches
+    return jnp.zeros((), red.dtype)        # min/max: 0 vs the +/-extreme
+
+
+def make_packed(acc, touched, red: ReduceSpec):
+    """Pack split (acc, touched) planes into the [..., W+1] packed array.
+    Works on host numpy and device arrays alike (restore/splice pack on
+    the host; the jnp scalars below are compile-time constants)."""
+    xp = np if isinstance(acc, np.ndarray) else jnp
+    neutral = red.neutral_value().astype(red.dtype)
+    marker = _touch_marker(red)
+    col = xp.where(touched, marker, neutral).astype(acc.dtype)
+    if len(red.value_shape) == 0:
+        return xp.stack([acc, col], axis=-1)
+    return xp.concatenate([acc, col[..., None]], axis=-1)
+
+
+def split_packed(acc_packed, vdims: int, red: ReduceSpec):
+    """Unpack a packed plane into logical (acc, touched). ``vdims`` is
+    the state's ``packed`` descriptor (logical value ndim)."""
+    neutral = red.neutral_value().astype(red.dtype)
+    if isinstance(acc_packed, np.ndarray):
+        # host staging path (checkpoint SYNC phase): keep the compare in
+        # numpy — a jnp scalar operand would bounce the whole plane
+        # through the device. The scalar constant fetch is the only
+        # device touch.
+        neutral = np.asarray(neutral)  # host-sync-ok: compile-time scalar constant, snapshot staging runs host-side by contract
+    touched = acc_packed[..., -1] != neutral
+    acc = acc_packed[..., 0] if vdims == 0 else acc_packed[..., :-1]
+    return acc, touched
+
+
+def acc_view(state: "WindowShardState", red: ReduceSpec):
+    """Logical value accumulator regardless of plane packing."""
+    if state.packed < 0:
+        return state.acc
+    return split_packed(state.acc, state.packed, red)[0]
+
+
+def touched_view(state: "WindowShardState", red: ReduceSpec):
+    """Logical bool touched plane regardless of plane packing."""
+    if state.packed < 0:
+        return state.touched
+    return split_packed(state.acc, state.packed, red)[1]
+
+
+# ------------------------------------------------ accumulator layouts
+# Logical shape is always [R, C, ...] (ring rows x key slots); the
+# flat storage order is the WindowSpec.acc_layout choice. Every kernel
+# goes through these three helpers so pane-major and slot-major cannot
+# drift semantically — only the memory walk differs.
+
+def _acc2d(flat_arr, C: int, R: int, slot_major: bool):
+    """[C*R, ...] flat storage -> logical [R, C, ...] view."""
+    tail = flat_arr.shape[1:]
+    if slot_major:
+        return flat_arr.reshape((C, R) + tail).swapaxes(0, 1)
+    return flat_arr.reshape((R, C) + tail)
+
+
+def _acc_flat(arr2d, C: int, R: int, slot_major: bool):
+    """Logical [R, C, ...] -> [C*R, ...] flat storage order."""
+    tail = arr2d.shape[2:]
+    if slot_major:
+        return arr2d.swapaxes(0, 1).reshape((C * R,) + tail)
+    return arr2d.reshape((C * R,) + tail)
+
+
+def _flat_index(ring, slot, C: int, R: int, slot_major: bool):
+    """Per-lane flat scatter index for (ring row, slot)."""
+    if slot_major:
+        return slot.astype(jnp.int32) * jnp.int32(R) + ring
+    return ring * jnp.int32(C) + slot.astype(jnp.int32)
+
+
 def init_state(capacity: int, probe_len: int, win: WindowSpec,
                red: ReduceSpec, layout: str = "hash",
-               n_key_groups: int = 0) -> WindowShardState:
+               n_key_groups: int = 0,
+               packed: bool = False) -> WindowShardState:
     """layout="direct": the DIRECT-INDEX state backend. For keys that are
     bounded non-negative ints (identity hi==0, lo < capacity — see
     hashing.key_identity64), the key IS its slot: no probe gathers, no
@@ -251,8 +377,24 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
             f"overflow ring requires a builtin scalar reduce without "
             f"finalize, got kind={red.kind!r}"
         )
+    if packed and not packed_eligible(red):
+        raise ValueError(
+            f"packed state planes require a builtin reduce with the "
+            f"default neutral and an at-most-1-D value, got "
+            f"kind={red.kind!r}"
+        )
     neutral = red.neutral_value()
-    acc = jnp.broadcast_to(neutral, (capacity * R,) + red.value_shape).astype(red.dtype)
+    if packed:
+        # acc + touched in one plane: W value lanes + 1 touch column,
+        # all initialized to the neutral (== untouched marker)
+        W = int(np.prod(red.value_shape, dtype=np.int64)) or 1
+        acc = jnp.broadcast_to(
+            neutral, (capacity * R, W + 1)
+        ).astype(red.dtype)
+    else:
+        acc = jnp.broadcast_to(
+            neutral, (capacity * R,) + red.value_shape
+        ).astype(red.dtype)
     O = win.overflow
     if layout == "direct":
         iota = jnp.arange(capacity, dtype=jnp.uint32)
@@ -266,7 +408,7 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
     return WindowShardState(
         table=table,
         acc=acc + jnp.zeros_like(acc),  # materialize (broadcast_to is a view)
-        touched=jnp.zeros(capacity * R, bool),
+        touched=jnp.zeros(0 if packed else capacity * R, bool),
         pane_ids=jnp.full((R,), PANE_NONE, jnp.int32),
         max_pane=jnp.asarray(PANE_NONE),
         min_pane=jnp.asarray(2**31 - 1, jnp.int32),
@@ -283,10 +425,13 @@ def init_state(capacity: int, probe_len: int, win: WindowSpec,
         ovf_val=jnp.zeros((O,) + red.value_shape, red.dtype),
         ovf_n=jnp.zeros((), jnp.int32),
         kg_dirty=jnp.zeros(n_key_groups, bool),
+        packed=len(red.value_shape) if packed else -1,
     )
 
 
-def kg_occupancy(state: WindowShardState, n_key_groups: int):
+def kg_occupancy(state: WindowShardState, n_key_groups: int,
+                 red: Optional[ReduceSpec] = None,
+                 win: Optional[WindowSpec] = None):
     """Per-key-group live-key occupancy of one shard: how many table keys
     with at least one touched pane hash into each key group. int32
     [n_key_groups].
@@ -298,10 +443,17 @@ def kg_occupancy(state: WindowShardState, n_key_groups: int):
     is one route-hash over the table keys and one scatter-add, and only
     the [n_key_groups] counts cross the link at the existing step-
     boundary barrier (same pattern as the kg_dirty changelog bits).
+
+    ``red`` is required for packed-plane state (the touch column derives
+    through the neutral); ``win`` only for a non-default acc layout.
     """
     C = state.table.capacity
-    touched2 = state.touched.reshape(-1, C)              # [R, C]
-    alive = touched2.any(axis=0) | state.fresh.reshape(-1, C).any(axis=0)
+    slot_major = win is not None and win.acc_layout == "slot"
+    t_flat = touched_view(state, red) if state.packed >= 0 else state.touched
+    R = t_flat.shape[0] // C
+    touched2 = _acc2d(t_flat, C, R, slot_major)          # [R, C]
+    fresh2 = _acc2d(state.fresh, C, R, slot_major)
+    alive = touched2.any(axis=0) | fresh2.any(axis=0)
     keys = state.table.keys                              # [C, 2]
     kg = assign_to_key_group(
         route_hash(keys[:, 0], keys[:, 1], jnp), n_key_groups, jnp
@@ -338,8 +490,14 @@ def compact_table(state: WindowShardState, win: WindowSpec,
     """
     C = state.table.capacity
     R = win.ring
-    touched2 = state.touched.reshape(R, C)
-    fresh2 = state.fresh.reshape(R, C)
+    slot_major = win.acc_layout == "slot"
+    packed = state.packed >= 0
+    acc3 = _acc2d(state.acc, C, R, slot_major)           # [R, C, ...]
+    if packed:
+        touched2 = acc3[..., -1] != red.neutral_value().astype(red.dtype)
+    else:
+        touched2 = _acc2d(state.touched, C, R, slot_major)
+    fresh2 = _acc2d(state.fresh, C, R, slot_major)
     alive = touched2.any(axis=0) | fresh2.any(axis=0)   # [C]
 
     keys = state.table.keys                              # [C, 2]
@@ -360,8 +518,13 @@ def compact_table(state: WindowShardState, win: WindowSpec,
     failed = alive & ~ok                                 # [C]
     idx = jnp.where(alive & ok, slot, C)                 # old slot -> new
 
-    acc3 = state.acc.reshape((R, C) + red.value_shape)
     neutral = red.neutral_value().astype(red.dtype)
+    # overflow export needs LOGICAL values; the remap moves the physical
+    # plane (packed: values + touch column together, one vmap scatter)
+    acc3_logical = acc3[..., :-1] if packed else acc3
+    if packed and state.packed == 0:
+        acc3_logical = acc3[..., 0]
+    tail = acc3.shape[2:]
 
     ovf = (state.ovf_hi, state.ovf_lo, state.ovf_pane, state.ovf_val,
            state.ovf_n)
@@ -373,7 +536,7 @@ def compact_table(state: WindowShardState, win: WindowSpec,
         ).reshape(-1)
         ovf, lost = ring_append(
             ovf, ent, key_rc[:, 0], key_rc[:, 1], pane_rc,
-            acc3.reshape((R * C,) + red.value_shape), win.overflow,
+            acc3_logical.reshape((R * C,) + red.value_shape), win.overflow,
         )
     else:
         lost = jnp.sum(
@@ -382,27 +545,31 @@ def compact_table(state: WindowShardState, win: WindowSpec,
     ovf_hi, ovf_lo, ovf_pane, ovf_val, ovf_n = ovf
 
     def remap_row(row):
-        base = jnp.broadcast_to(neutral, (C,) + red.value_shape).astype(
+        base = jnp.broadcast_to(neutral, (C,) + tail).astype(
             red.dtype
         ) + jnp.zeros((), red.dtype)
         return base.at[idx].set(row, mode="drop")
 
     new_acc3 = jax.vmap(remap_row)(acc3)
-    new_touched2 = jax.vmap(
-        lambda row: jnp.zeros(C, bool).at[idx].set(row, mode="drop")
-    )(touched2)
     new_fresh2 = jax.vmap(
         lambda row: jnp.zeros(C, bool).at[idx].set(row, mode="drop")
     )(fresh2)
+    if packed:
+        new_touched_flat = state.touched       # [0] placeholder
+    else:
+        new_touched2 = jax.vmap(
+            lambda row: jnp.zeros(C, bool).at[idx].set(row, mode="drop")
+        )(touched2)
+        new_touched_flat = _acc_flat(new_touched2, C, R, slot_major)
 
     import dataclasses as _dc
 
     return _dc.replace(
         state,
         table=hashtable.SlotTable(new_keys, state.table.probe_len),
-        acc=new_acc3.reshape((C * R,) + red.value_shape),
-        touched=new_touched2.reshape(C * R),
-        fresh=new_fresh2.reshape(C * R),
+        acc=_acc_flat(new_acc3, C, R, slot_major),
+        touched=new_touched_flat,
+        fresh=_acc_flat(new_fresh2, C, R, slot_major),
         dropped_capacity=state.dropped_capacity + lost,
         ovf_hi=ovf_hi,
         ovf_lo=ovf_lo,
@@ -421,6 +588,8 @@ def update(
     direct: bool = False,
     kg=None,
     precombine: bool = False,
+    kg_fill: int = 0,
+    clear_rows=None,
 ):
     """Apply one micro-batch of records to shard state (pure function).
 
@@ -428,10 +597,13 @@ def update(
     owned by this shard. Replaces WindowOperator.processElement +
     HeapReducingState.add for the whole batch at once.
 
-    Returns ``(new_state, activity)`` where activity (int32 scalar) counts
-    lanes whose key was NOT already resident in the table: newly inserted
-    keys plus overflowed lanes. ``activity == 0`` certifies the batch was a
-    pure in-place update.
+    Returns ``(new_state, activity, kgf)``. ``activity`` (int32 scalar)
+    counts lanes whose key was NOT already resident in the table: newly
+    inserted keys plus overflowed lanes — ``activity == 0`` certifies the
+    batch was a pure in-place update. ``kgf`` is the per-key-group record
+    count of this batch (int32 ``[kg_fill]``; ``[0]`` when ``kg_fill=0``)
+    counting the PRE-late-check ``valid`` lanes — the traffic half of the
+    skew telemetry, computed here so it can ride the shared sort below.
 
     ``insert=False`` compiles the steady-state FAST path: the key table is
     never mutated — one probe gather instead of upsert's five, and no claim
@@ -445,19 +617,37 @@ def update(
     ever runs when misses are rare (runtime/executor.py step tiering).
 
     ``precombine=True`` (built-in reducers only) pre-aggregates the batch
-    per (slot, pane) BEFORE the state scatter: one shared sort by flat
-    accumulator index + a segmented scan, then the accumulator, touched,
-    and changelog-dirty scatters see only one representative lane per
-    distinct segment — duplicate scatter indices serialize on TPU, and a
-    hot-key batch is exactly the duplicate-heavy case. The rep scatters
-    carry ``unique_indices`` so XLA can skip the collision handling
-    entirely. (kg_fill skew telemetry keeps its own scatter: it counts
-    pre-late-check traffic by contract, a superset of the lanes this
-    sort orders.)
+    per (slot, pane) BEFORE the state scatter: ONE shared sort by flat
+    accumulator index + a segmented scan, and every consumer rides the
+    same permutation — the accumulator scatter, the fire-eligibility
+    (touched) plane, the changelog kg_dirty bits, and the kg_fill skew
+    counts (segment lane-counts scattered at the representatives, plus a
+    residual scatter for the rare late/too-old/nofit lanes the sort
+    excludes). Duplicate scatter indices serialize on TPU, and a hot-key
+    batch is exactly the duplicate-heavy case; the rep scatters carry
+    ``unique_indices`` so XLA skips the collision handling entirely.
+    tools/check_segment_sort_seam.py keeps this the only sort a batch
+    pays.
+
+    ``clear_rows`` (bool ``[R]`` in logical ring-row space) folds a
+    DEFERRED purge from the fused-fire scan into this batch's ring-reset
+    sweep: rows flagged by the previous sub-step's
+    ``advance_and_fire_resident`` clear here for free instead of paying
+    their own sweep (every containing window already fired, so nothing
+    reads them in between — see the resident-pipeline invariant there).
+    Only valid with ``win.lateness_ticks == 0``.
+
+    With PACKED planes (``state.packed >= 0``) the touched bits live in
+    the accumulator's trailing column, so the value scatter and the
+    ring-reset/purge sweeps maintain both planes in one pass and the
+    separate touched scatter disappears.
     """
     C = state.table.capacity
     R = win.ring
     k = win.panes_per_window
+    slot_major = win.acc_layout == "slot"
+    packed = state.packed >= 0
+    mine = valid            # pre-late-check routing mask (kg_fill contract)
 
     pane = _floor_div_pane(ts, win.slide_ticks)
     L = win.lateness_ticks
@@ -492,28 +682,39 @@ def update(
     evicted = stale & (state.pane_ids != PANE_NONE) & (
         state.pane_ids + jnp.int32(k - 1) > state.fired_through
     )
-    # ring-major layout [R, C]: pane columns are CONTIGUOUS, so ring
-    # resets, fires, and purges are sequential-bandwidth sweeps instead of
-    # R-strided accesses (the difference between ~0.2ms and ~20ms per step
-    # on TPU for a 4M-slot shard)
-    touched2d = state.touched.reshape(R, C)
+    neutral = red.neutral_value()
+    # logical [R, C, ...] views of the flat planes (pane-major keeps pane
+    # columns CONTIGUOUS so ring resets/fires/purges are sequential-
+    # bandwidth sweeps — the difference between ~0.2ms and ~20ms per step
+    # on TPU for a 4M-slot shard; slot-major is the bench-swept variant)
+    acc2d = _acc2d(state.acc, C, R, slot_major)
+    if packed:
+        touched2d = acc2d[..., -1] != neutral.astype(red.dtype)
+    else:
+        touched2d = _acc2d(state.touched, C, R, slot_major)
     n_evicted = jnp.sum(
         jnp.where(evicted[:, None], touched2d, False), dtype=jnp.int32
     )
-    neutral = red.neutral_value()
-    acc2d = state.acc.reshape((R, C) + red.value_shape)
-
-    fresh2d = state.fresh.reshape(R, C)
 
     # unconditional sweep: a fused full pass costs far less than the
-    # operand copies a lax.cond forces on 100MB+ carried buffers
-    acc2d = jnp.where(_expand(stale[:, None], acc2d),
+    # operand copies a lax.cond forces on 100MB+ carried buffers.
+    # clear_rows (the fused-fire deferred purge) rides the same pass.
+    clear = stale if clear_rows is None else (stale | clear_rows)
+    acc2d = jnp.where(_expand(clear[:, None], acc2d),
                       neutral.astype(red.dtype), acc2d)
-    touched2d = jnp.where(stale[:, None], False, touched2d)
-    fresh2d = jnp.where(stale[:, None], False, fresh2d)
+    if not packed:
+        touched2d = jnp.where(clear[:, None], False, touched2d)
+    if L > 0:
+        # with no allowed lateness the fresh plane is never set, so its
+        # sweep (and reshape) is statically elided — one fewer full pass
+        # per batch
+        fresh2d = _acc2d(state.fresh, C, R, slot_major)
+        fresh2d = jnp.where(clear[:, None], False, fresh2d)
     pane_ids = jnp.where(stale, p_r, state.pane_ids)
-    acc = acc2d.reshape((C * R,) + red.value_shape)
-    touched = touched2d.reshape(C * R)
+    acc = _acc_flat(acc2d, C, R, slot_major)
+    touched = (
+        state.touched if packed else _acc_flat(touched2d, C, R, slot_major)
+    )
 
     # -- drop records older than the ring horizon --------------------------
     oldest = new_max - jnp.int32(R - 1)
@@ -535,9 +736,13 @@ def update(
     # lanes get their own scatter below — together exactly the live set
     # this eager scatter covers.
     KG = state.kg_dirty.shape[0]
+    if KG and kg_fill and kg_fill != KG:
+        raise ValueError(
+            f"kg_fill group count {kg_fill} != changelog group count {KG}"
+        )
     pre = precombine and red.kind in ("sum", "min", "max", "count")
-    if KG and kg is None:
-        kg = assign_to_key_group(route_hash(hi, lo, jnp), KG, jnp)
+    if (KG or kg_fill) and kg is None:
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), KG or kg_fill, jnp)
     if KG and not pre:
         kg_dirty = state.kg_dirty.at[
             jnp.where(live, kg.astype(jnp.int32), jnp.int32(KG))
@@ -590,9 +795,11 @@ def update(
 
     # -- scatter-combine into (slot, pane-ring) accumulators ----------------
     ring = jnp.mod(pane, jnp.int32(R))
-    # ring-major flat index; slot==C when !ok lands in [0, C*R) only via
-    # the scatter mask, which drops those lanes
-    flat = ring * jnp.int32(C) + slot
+    # flat storage index (layout-aware); slot==C when !ok lands in
+    # [0, C*R) only via the scatter mask, which drops those lanes
+    flat = _flat_index(ring, slot, C, R, slot_major)
+    kgf = jnp.zeros(0, jnp.int32)
+    kgf_pending = bool(kg_fill)
     if red.kind == "sketch":
         # records expand to per-register updates in the flattened
         # [C*R * prod(value_shape)] register space; one hardware scatter
@@ -604,12 +811,23 @@ def update(
     elif red.kind in ("sum", "min", "max", "count"):
         upd = values if red.kind != "count" else jnp.ones_like(values)
         upd = upd.astype(red.dtype)
+        if packed:
+            # the touch column rides the SAME scatter: marker lanes
+            # combine to != neutral under the reducer op
+            marker = jnp.broadcast_to(
+                _touch_marker(red), upd.shape[: upd.ndim - state.packed]
+            ).astype(red.dtype)
+            if state.packed == 0:
+                upd = jnp.stack([upd, marker], axis=-1)
+            else:
+                upd = jnp.concatenate([upd, marker[..., None]], axis=-1)
         op = {"sum": "add", "count": "add",
               "min": "min", "max": "max"}[red.kind]
         if pre:
             # duplicate-key collapse: ONE sort by flat accumulator index,
-            # segmented-scan reduce, then unique-index rep scatters for
-            # acc + touched + kg_dirty (the shared-sort hoist)
+            # a segmented-scan reduce, then unique-index rep scatters —
+            # acc (+ its packed touch column), touched, kg_dirty, and the
+            # kg_fill counts all consume this single permutation
             order, ids_s, valid_s, seg_start, rep_mask = segment_sort(
                 flat, live
             )
@@ -617,12 +835,13 @@ def update(
                                   red.combine_fn(), neutral)
             acc = scatter_combine(acc, ids_s, upd_s, rep_mask, op,
                                   unique=True)
-            touched = scatter_combine(
-                touched, ids_s, jnp.ones_like(ids_s, bool), rep_mask,
-                "set", unique=True,
-            )
+            if not packed:
+                touched = scatter_combine(
+                    touched, ids_s, jnp.ones_like(ids_s, bool), rep_mask,
+                    "set", unique=True,
+                )
+            kg32 = kg.astype(jnp.int32) if (KG or kg_fill) else None
             if KG:
-                kg32 = kg.astype(jnp.int32)
                 kg_dirty = kg_dirty.at[
                     jnp.where(rep_mask, kg32[order], jnp.int32(KG))
                 ].set(True, mode="drop")
@@ -631,6 +850,25 @@ def update(
                 kg_dirty = kg_dirty.at[
                     jnp.where(nofit, kg32, jnp.int32(KG))
                 ].set(True, mode="drop")
+            if kg_fill:
+                # 4th consumer of the shared sort: per-segment lane
+                # counts land at the representatives (same slot => same
+                # key => same group), residual pre-late-check traffic
+                # (late / too-old / nofit lanes, outside the sort's
+                # validity) adds its own mostly-masked scatter
+                seg_n = reduce_sorted(
+                    order, valid_s, seg_start,
+                    jnp.ones_like(ids_s), lambda a, b: a + b,
+                    jnp.zeros((), ids_s.dtype),
+                )
+                kgf = jnp.zeros(kg_fill, jnp.int32).at[
+                    jnp.where(rep_mask, kg32[order], jnp.int32(kg_fill))
+                ].add(seg_n.astype(jnp.int32), mode="drop")
+                resid = mine & ~live
+                kgf = kgf.at[
+                    jnp.where(resid, kg32, jnp.int32(kg_fill))
+                ].add(1, mode="drop")
+                kgf_pending = False
         else:
             acc = scatter_combine(acc, flat, upd, live, op)
     else:
@@ -645,32 +883,37 @@ def update(
             _expand(old_touched, old), red.combine_fn()(old, reduced), reduced
         )
         acc = acc.at[safe].set(merged, mode="drop")
-    if not pre:
+    if not pre and not packed:
         touched = scatter_combine(
             touched, flat, jnp.ones_like(flat, bool), live, "set"
         )
+    if kgf_pending:
+        # non-precombined paths: the plain one-scatter bincount
+        kgf = kg_batch_fill(kg, mine, kg_fill)
 
     # -- allowed lateness: records landing in already-fired windows mark
     # their pane "fresh" so those windows re-fire (ref late-firing panes)
-    fresh = fresh2d.reshape(C * R)
     n_fresh = state.n_fresh
     if L > 0:
+        fresh = _acc_flat(fresh2d, C, R, slot_major)
         late_upd = live & (pane <= state.fired_through)
         fresh = scatter_combine(
             fresh, flat, jnp.ones_like(flat, bool), late_upd, "set"
         )
         n_fresh = n_fresh + jnp.sum(late_upd, dtype=jnp.int32)
+    else:
+        fresh = state.fresh
 
-    return WindowShardState(
+    import dataclasses as _dc
+
+    return _dc.replace(
+        state,
         table=table,
         acc=acc,
         touched=touched,
         pane_ids=pane_ids,
         max_pane=new_max,
         min_pane=new_min,
-        watermark=state.watermark,
-        fired_through=state.fired_through,
-        purged_through=state.purged_through,
         dropped_late=state.dropped_late + n_late,
         dropped_capacity=state.dropped_capacity + n_too_old + n_nofit + n_evicted,
         fresh=fresh,
@@ -681,7 +924,7 @@ def update(
         ovf_val=ovf_val,
         ovf_n=ovf_n,
         kg_dirty=kg_dirty,
-    ), activity
+    ), activity, kgf
 
 
 def _expand(flag, val):
@@ -791,47 +1034,62 @@ def reduce_fires(fr: FireResult) -> ReducedFires:
                         fr.lane_valid, vsums)
 
 
-def compact_fires(table: SlotTable, fr: FireResult) -> CompactFires:
-    """Pack a dense FireResult into per-lane prefix buffers on device.
+def _pack_fire_lanes(table: SlotTable, mask, values):
+    """The pack math of compact_fires: per fire lane, compact the dense
+    (mask, values) planes into prefix buffers of (key_hi, key_lo, value)
+    plus (count, value_sum) scalars. Shared by compact_fires and the
+    fused-fire resident advance (the gated in-scan pack) so the payload
+    bytes cannot diverge between the split and resident drains.
 
-    One cumsum + three row scatters per lane; the scatter target index of
-    a non-emitting slot is C (out of range) so mode='drop' discards it.
-    Replaces the host-side np.nonzero sweep over [Ft, C] masks and the
-    full table.keys transfer the round-1 emit path paid every step.
-    """
+    Round 7: the stream compaction is GATHER-formulated — cumsum the
+    mask, then ``searchsorted`` finds output position i's source lane
+    (the first lane whose running count reaches i+1; a vectorized
+    binary search, NOT a sort) and three gathers move the payload.
+    The previous three row SCATTERS per lane serialized on XLA CPU
+    (~60ns/element — the single biggest term of the firing-stream
+    ceiling); the gather form is ~8x cheaper there and collision-free
+    everywhere, with bit-identical output."""
     C = table.capacity
     tk = table.keys
+    ar = jnp.arange(C, dtype=jnp.int32)
 
     def pack(mask_f, vals_f):
-        pos = jnp.cumsum(mask_f.astype(jnp.int32)) - 1
-        idx = jnp.where(mask_f, pos, jnp.int32(C))
-        khi = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 0], mode="drop")
-        klo = jnp.zeros(C, jnp.uint32).at[idx].set(tk[:, 1], mode="drop")
-        v = jnp.zeros_like(vals_f).at[idx].set(vals_f, mode="drop")
+        cs = jnp.cumsum(mask_f.astype(jnp.int32))
+        count = cs[-1]
+        sel = jnp.searchsorted(cs, ar + 1, side="left")
+        ok = ar < count
+        selc = jnp.minimum(sel, jnp.int32(C - 1))
+        khi = jnp.where(ok, tk[selc, 0], jnp.uint32(0))
+        klo = jnp.where(ok, tk[selc, 1], jnp.uint32(0))
+        v = jnp.where(_expand(ok, vals_f), vals_f[selc],
+                      jnp.zeros((), vals_f.dtype))
         vsum = jnp.sum(
             jnp.where(_expand(mask_f, vals_f), vals_f, 0.0)
         ).astype(jnp.float32)
-        return khi, klo, v, jnp.sum(mask_f, dtype=jnp.int32), vsum
+        return khi, klo, v, count, vsum
 
-    khi, klo, v, counts, vsums = jax.vmap(pack)(fr.mask, fr.values)
+    return jax.vmap(pack)(mask, values)
+
+
+def compact_fires(table: SlotTable, fr: FireResult) -> CompactFires:
+    """Pack a dense FireResult into per-lane prefix buffers on device.
+
+    Delegates the compaction to ``_pack_fire_lanes`` (cumsum +
+    searchsorted + gathers — see there). Replaces the host-side
+    np.nonzero sweep over [Ft, C] masks and the full table.keys transfer
+    the round-1 emit path paid every step.
+    """
+    khi, klo, v, counts, vsums = _pack_fire_lanes(table, fr.mask, fr.values)
     return CompactFires(khi, klo, v, counts, fr.window_end_ticks,
                         fr.n_fires, fr.lane_valid, vsums)
 
 
-def advance_and_fire(
-    state: WindowShardState,
-    win: WindowSpec,
-    red: ReduceSpec,
-    new_watermark,
-) -> Tuple[WindowShardState, FireResult]:
-    """Advance the shard watermark and emit due window fires.
+def _fire_plan(state: WindowShardState, win: WindowSpec, new_watermark):
+    """Scalar half of a watermark advance: which window-ends are due.
 
-    Vectorized analog of HeapInternalTimerService.advanceWatermark +
-    WindowOperator.onEventTime per key (ref §3.3): instead of per-key timer
-    callbacks, each due window-end is evaluated for ALL keys at once; a
-    sliding window combines its panes_per_window ring columns.
-    """
-    C = state.table.capacity
+    Shared by the split-dispatch fire step (advance_and_fire) and the
+    fused-fire resident advance so the two drains cannot disagree about
+    lane scheduling. Pure scalar/[F] math — nothing O(C)."""
     R = win.ring
     k = win.panes_per_window
     F = win.fires_per_step
@@ -853,7 +1111,8 @@ def advance_and_fire(
     # Sliding windows ending up to k-1 panes past max_pane still contain
     # registered panes; only ends beyond max_pane+k-1 are certainly empty.
     end = jnp.where(
-        have, jnp.minimum(wm_pane, state.max_pane + jnp.int32(k - 1)), start - 1
+        have, jnp.minimum(wm_pane, state.max_pane + jnp.int32(k - 1)),
+        start - 1,
     )
     n_due = jnp.maximum(end - start + 1, 0)
     n_now = jnp.minimum(n_due, F)
@@ -861,44 +1120,6 @@ def advance_and_fire(
     f_idx = jnp.arange(F, dtype=jnp.int32)
     p_f = start + f_idx                      # window-end pane per fire lane
     lane_ok = f_idx < n_now
-
-    acc3 = state.acc.reshape((R, C) + red.value_shape)
-    touched2 = state.touched.reshape(R, C)
-    fresh2 = state.fresh.reshape(R, C)
-    big = jnp.int32(2**31 - 1)
-
-    def fire_one(p, ok, mask2):
-        """Evaluate window ending at pane p for all keys; emission mask
-        comes from mask2 (touched for on-time fires, fresh for re-fires),
-        values always combine every touched pane of the window.
-
-        Statically unrolled over the R ring rows (contiguous [C] columns in
-        the ring-major layout): each row joins the window iff its pane id
-        lies in [p-k+1, p] — equivalent to probing ring slot q%%R per window
-        offset, but with sequential instead of strided access."""
-        combine = red.combine_fn()
-        neutral = red.neutral_value()
-        vals = jnp.broadcast_to(
-            neutral, (C,) + red.value_shape
-        ).astype(red.dtype)
-        emit = jnp.zeros(C, bool)
-        for j in range(R):
-            q = state.pane_ids[j]
-            present = (
-                ok & (q != PANE_NONE) & (q <= p) & (q >= p - jnp.int32(k - 1))
-            )
-            col = acc3[j]
-            col_t = touched2[j] & present
-            vals = jnp.where(_expand(col_t, vals), combine(vals, col), vals)
-            # combine(neutral, col) == col for first touch
-            emit = emit | (mask2[j] & present)
-        if red.finalize is not None:
-            vals = red.finalize(vals)
-        return emit, vals
-
-    mask, values = jax.vmap(lambda p, ok: fire_one(p, ok, touched2))(
-        p_f, lane_ok
-    )
     window_end = jnp.where(
         lane_ok, (p_f + 1) * jnp.int32(win.slide_ticks), PANE_NONE
     )
@@ -912,10 +1133,178 @@ def advance_and_fire(
         have, new_fired_through,
         jnp.maximum(state.fired_through, wm_pane),
     )
+    return {
+        "wm": wm, "wm_pane": wm_pane, "have": have, "start": start,
+        "n_due": n_due, "n_now": n_now, "p_f": p_f, "lane_ok": lane_ok,
+        "window_end": window_end, "new_fired_through": new_fired_through,
+    }
+
+
+def _state_fire_views(state: WindowShardState, win: WindowSpec,
+                      red: ReduceSpec):
+    """(acc3 logical, touched2) read views [R, C(, ...)] of the pane
+    planes, regardless of plane packing and accumulator layout."""
+    C = state.table.capacity
+    R = win.ring
+    slot_major = win.acc_layout == "slot"
+    accp3 = _acc2d(state.acc, C, R, slot_major)
+    if state.packed >= 0:
+        neutral = red.neutral_value().astype(red.dtype)
+        touched2 = accp3[..., -1] != neutral
+        acc3 = accp3[..., 0] if state.packed == 0 else accp3[..., :-1]
+    else:
+        touched2 = _acc2d(state.touched, C, R, slot_major)
+        acc3 = accp3
+    return acc3, touched2
+
+
+def _eval_fire_lanes(acc3, touched2, pane_ids, win: WindowSpec,
+                     red: ReduceSpec, p_f, lane_ok, mask2):
+    """Evaluate the windows ending at panes ``p_f`` for ALL keys.
+
+    The emission mask comes from ``mask2`` (touched for on-time fires,
+    fresh for late re-fires); values always combine every touched pane
+    of the window. PANE-INDEXED (round 7): the window ending at pane p
+    is the combine of panes p-k+1..p, and pane q can only live in ring
+    row q % R — so each lane reads its k rows by direct (dynamic) row
+    index, O(k*C) instead of the old O(R*C) sweep over every ring row.
+    For a tumbling window (k=1, the throughput topology) that is a
+    1/R-th of the old fire-evaluation cost — the single biggest term of
+    the firing-stream ceiling (device_update_ceiling fire_grid). A row
+    only contributes when its registered id equals q (an unrotated ring
+    row still holding an older pane stays masked out)."""
+    C = acc3.shape[1]
+    R = win.ring
+    k = win.panes_per_window
+    combine = red.combine_fn()
+    neutral = red.neutral_value()
+
+    def fire_one(p, ok):
+        vals = jnp.broadcast_to(
+            neutral, (C,) + red.value_shape
+        ).astype(red.dtype)
+        emit = jnp.zeros(C, bool)
+        for j in range(k):
+            q = p - jnp.int32(k - 1) + jnp.int32(j)
+            row = jnp.mod(q, jnp.int32(R))
+            present = ok & (pane_ids[row] == q)
+            col = acc3[row]
+            col_t = touched2[row] & present
+            vals = jnp.where(_expand(col_t, vals), combine(vals, col), vals)
+            # combine(neutral, col) == col for first touch
+            emit = emit | (mask2[row] & present)
+        if red.finalize is not None:
+            vals = red.finalize(vals)
+        return emit, vals
+
+    return jax.vmap(fire_one)(p_f, lane_ok)
+
+
+def _purge_plan(state: WindowShardState, win: WindowSpec, wm,
+                new_fired_through, fresh2=None):
+    """Which ring rows purge at this advance, and the purged_through
+    scalar. A pane leaves state only once BOTH every containing window
+    has fired AND the lateness horizon has passed (and no re-fire is
+    pending on it). Clamps before subtracting so the MIN sentinel cannot
+    wrap int32."""
+    k = win.panes_per_window
+    base_l = jnp.maximum(
+        wm,
+        jnp.int32(-(2**31) + 1 + win.slide_ticks)
+        + jnp.int32(win.lateness_ticks),
+    ) - jnp.int32(win.lateness_ticks)
+    wm_pane_l = _floor_div_pane(base_l + 1 - win.slide_ticks, win.slide_ticks)
+    cutoff = jnp.minimum(new_fired_through, wm_pane_l)
+    purgeable = (
+        (state.pane_ids != PANE_NONE)
+        & (state.pane_ids + jnp.int32(k - 1) <= cutoff)
+        & (state.pane_ids > state.purged_through)
+    )
+    if fresh2 is not None:
+        purgeable = purgeable & ~jnp.any(fresh2, axis=1)
+    new_purged = jnp.where(
+        cutoff == PANE_NONE,
+        state.purged_through,
+        jnp.maximum(
+            state.purged_through,
+            jnp.maximum(cutoff, PANE_NONE + jnp.int32(k)) - jnp.int32(k - 1),
+        ),
+    )
+    return cutoff, purgeable, new_purged
+
+
+def _clear_rows_planes(state: WindowShardState, win: WindowSpec,
+                       red: ReduceSpec, rows):
+    """Clear the flagged ring rows in the acc/touched planes (one sweep
+    when packed). Returns (acc_flat, touched_flat)."""
+    C = state.table.capacity
+    R = win.ring
+    slot_major = win.acc_layout == "slot"
+    neutral = red.neutral_value().astype(red.dtype)
+    accp = _acc2d(state.acc, C, R, slot_major)
+    accp = jnp.where(_expand(rows[:, None], accp), neutral, accp)
+    if state.packed >= 0:
+        return _acc_flat(accp, C, R, slot_major), state.touched
+    t2 = _acc2d(state.touched, C, R, slot_major)
+    t2 = jnp.where(rows[:, None], False, t2)
+    return (_acc_flat(accp, C, R, slot_major),
+            _acc_flat(t2, C, R, slot_major))
+
+
+def apply_pending_purge(state: WindowShardState, win: WindowSpec,
+                        red: ReduceSpec, rows) -> WindowShardState:
+    """Post-scan fixup of the fused-fire resident pipeline: clear ring
+    rows whose purge was deferred into "the next update's ring-reset
+    sweep" but whose megastep ended first. After this the state is
+    bit-identical to the sequential update/advance_and_fire interleaving
+    (the purged_through scalar already advanced at defer time)."""
+    import dataclasses as _dc
+
+    acc, touched = _clear_rows_planes(state, win, red, rows)
+    return _dc.replace(state, acc=acc, touched=touched)
+
+
+def advance_and_fire(
+    state: WindowShardState,
+    win: WindowSpec,
+    red: ReduceSpec,
+    new_watermark,
+) -> Tuple[WindowShardState, FireResult]:
+    """Advance the shard watermark and emit due window fires.
+
+    Vectorized analog of HeapInternalTimerService.advanceWatermark +
+    WindowOperator.onEventTime per key (ref §3.3): instead of per-key timer
+    callbacks, each due window-end is evaluated for ALL keys at once; a
+    sliding window combines its panes_per_window ring columns.
+    """
+    import dataclasses as _dc
+
+    C = state.table.capacity
+    R = win.ring
+    k = win.panes_per_window
+    F = win.fires_per_step
+    slot_major = win.acc_layout == "slot"
+
+    plan = _fire_plan(state, win, new_watermark)
+    wm = plan["wm"]
+    lane_ok = plan["lane_ok"]
+    window_end = plan["window_end"]
+    new_fired_through = plan["new_fired_through"]
+    n_now = plan["n_now"]
+
+    acc3, touched2 = _state_fire_views(state, win, red)
+    big = jnp.int32(2**31 - 1)
+
+    mask, values = _eval_fire_lanes(
+        acc3, touched2, state.pane_ids, win, red, plan["p_f"], lane_ok,
+        touched2,
+    )
 
     # -- late re-fires (allowedLateness): windows <= fired_through whose
     # panes got late updates re-fire with their corrected full value.
     if win.lateness_ticks > 0:
+        fresh2 = _acc2d(state.fresh, C, R, slot_major)
+
         def do_late(fresh2):
             fresh_any = jnp.any(fresh2, axis=1)  # [R]
             j_idx = jnp.arange(k, dtype=jnp.int32)
@@ -926,7 +1315,7 @@ def advance_and_fire(
                 & (wc <= new_fired_through)
             )
             wflat = jnp.where(need.reshape(-1), wc.reshape(-1), big)
-            wsort = jnp.sort(wflat)
+            wsort = sort_values(wflat)
             first = jnp.concatenate(
                 [jnp.ones((1,), bool), wsort[1:] != wsort[:-1]]
             ) & (wsort < big)
@@ -934,9 +1323,10 @@ def advance_and_fire(
             sel = jnp.full((F,), big)
             sel = sel.at[jnp.where(first, rank, F)].set(wsort, mode="drop")
             sel_ok = sel < big
-            lmask, lvals = jax.vmap(
-                lambda p, ok: fire_one(p, ok, fresh2)
-            )(sel, sel_ok)
+            lmask, lvals = _eval_fire_lanes(
+                acc3, touched2, state.pane_ids, win, red, sel, sel_ok,
+                fresh2,
+            )
             # clear fresh panes whose due windows were all covered this pass
             covered_c = (~need) | (wc[:, :, None] == sel[None, None, :]).any(-1)
             pane_done = covered_c.all(axis=1) & fresh_any
@@ -959,65 +1349,152 @@ def advance_and_fire(
         lane_valid = jnp.concatenate([lane_ok, lsel_ok])
         n_fires = n_now + jnp.sum(lsel_ok, dtype=jnp.int32)
     else:
+        fresh2 = None
         lane_valid = lane_ok
         n_fires = n_now
         n_fresh = state.n_fresh
 
-    # -- purge: a pane leaves state only once BOTH every containing window
-    # has fired AND the lateness horizon has passed (and no pending re-fire)
-    # clamp before subtracting lateness so the MIN sentinel cannot wrap
-    base_l = jnp.maximum(
-        wm,
-        jnp.int32(-(2**31) + 1 + win.slide_ticks) + jnp.int32(win.lateness_ticks),
-    ) - jnp.int32(win.lateness_ticks)
-    wm_pane_l = _floor_div_pane(base_l + 1 - win.slide_ticks, win.slide_ticks)
-    cutoff = jnp.minimum(new_fired_through, wm_pane_l)
-    purgeable = (
-        (state.pane_ids != PANE_NONE)
-        & (state.pane_ids + jnp.int32(k - 1) <= cutoff)
-        & (state.pane_ids > state.purged_through)
+    # -- purge (unconditional sweep — see update(): conds copy the big
+    # carried buffers)
+    _cutoff, purgeable, new_purged = _purge_plan(
+        state, win, wm, new_fired_through, fresh2=fresh2
     )
-    if win.lateness_ticks > 0:
-        purgeable = purgeable & ~jnp.any(fresh2, axis=1)
-    neutral = red.neutral_value()
+    acc, touched = _clear_rows_planes(state, win, red, purgeable)
 
-    # unconditional sweep (see update(): conds copy the big carried buffers)
-    acc3 = jnp.where(_expand(purgeable[:, None], acc3), neutral, acc3)
-    touched2 = jnp.where(purgeable[:, None], False, touched2)
-
-    new_state = WindowShardState(
-        table=state.table,
-        acc=acc3.reshape((C * R,) + red.value_shape),
-        touched=touched2.reshape(C * R),
-        pane_ids=state.pane_ids,
-        max_pane=state.max_pane,
-        min_pane=state.min_pane,
+    new_state = _dc.replace(
+        state,
+        acc=acc,
+        touched=touched,
         watermark=wm,
         fired_through=new_fired_through,
-        # clamp before subtracting so near-INT32_MIN values cannot wrap;
-        # with lateness, purged_through may only advance to the purge cutoff
-        purged_through=jnp.where(
-            cutoff == PANE_NONE,
-            state.purged_through,
-            jnp.maximum(
-                state.purged_through,
-                jnp.maximum(cutoff, PANE_NONE + jnp.int32(k))
-                - jnp.int32(k - 1),
-            ),
+        purged_through=new_purged,
+        fresh=(
+            _acc_flat(fresh2, C, R, slot_major)
+            if win.lateness_ticks > 0 else state.fresh
         ),
-        dropped_late=state.dropped_late,
-        dropped_capacity=state.dropped_capacity,
-        fresh=fresh2.reshape(C * R),
         n_fresh=n_fresh,
-        ovf_hi=state.ovf_hi,
-        ovf_lo=state.ovf_lo,
-        ovf_pane=state.ovf_pane,
-        ovf_val=state.ovf_val,
-        ovf_n=state.ovf_n,
         # fires/purges are NOT marked dirty: they are global sweeps fully
         # determined by the scalars (fired_through/watermark), and chain
         # recovery re-applies the same purge cutoff to merged entries
         # (checkpointing/recovery.py), so per-group bits stay precise
-        kg_dirty=state.kg_dirty,
     )
     return new_state, FireResult(mask, values, window_end, n_fires, lane_valid)
+
+
+def advance_and_fire_resident(
+    state: WindowShardState,
+    win: WindowSpec,
+    red: ReduceSpec,
+    new_watermark,
+    reduced: bool = False,
+) -> Tuple[WindowShardState, jax.Array, "CompactFires | ReducedFires"]:
+    """Fused-fire advance for the RESIDENT megastep scan (ISSUE 7).
+
+    The split path dispatches fire as its own device step and breaks
+    every K-group at a pane boundary; here the whole advance runs inside
+    the scan body after each sub-batch's update, with two cost moves
+    that make a per-sub-step advance affordable:
+
+    * the O(F*R*C) fire evaluation + payload pack runs under ``lax.cond``
+      on ``n_now > 0`` — sub-steps that cross no pane boundary (the
+      overwhelming steady-state majority) pay only the scalar plan. The
+      cond is READ-ONLY over the big state (its outputs are just the
+      packed fire buffers), so no identity-branch state copies arise,
+      and the skip branch's all-zero payload is bit-identical to packing
+      an empty fire.
+    * the purge plane-clears are DEFERRED: this call advances the
+      ``purged_through`` scalar immediately but returns the purgeable
+      row mask for the NEXT sub-step's update to fold into its ring-
+      reset sweep (wk.update ``clear_rows``) — or for
+      ``apply_pending_purge`` after the scan. Safe because a deferred
+      row's every window already fired: no in-scan reader revisits it
+      (fire lanes start past it, late-dropped records cannot scatter
+      into it) until a sweep clears it.
+
+    Returns ``(state', purge_rows, fires)`` with ``fires`` a
+    CompactFires for THIS sub-step — or, with ``reduced=True``, a
+    ReducedFires: per-lane (count, value_sum) scalars only, NO payload
+    planes at all. The reduced mode exists because the scan must stack
+    a payload slot for EVERY sub-step (crossing or not), and those
+    [F, C] zero-writes are the resident pipeline's whole overhead on a
+    quiet stream; device_reduce sink topologies (runtime/sinks.py)
+    never read the payload, so they skip it — the in-scan analog of
+    build_window_fire_reduced_step. With allowed lateness the fresh/
+    re-fire machinery is needed every sub-step anyway, so that cold
+    path delegates to the classic advance (no gate, no deferral).
+    """
+    import dataclasses as _dc
+
+    R = win.ring
+    if win.lateness_ticks > 0:
+        st, fr = advance_and_fire(state, win, red, new_watermark)
+        packed_fr = (
+            reduce_fires(fr) if reduced else compact_fires(st.table, fr)
+        )
+        return st, jnp.zeros(R, bool), packed_fr
+
+    C = state.table.capacity
+    F = win.fires_per_step
+
+    plan = _fire_plan(state, win, new_watermark)
+    wm = plan["wm"]
+    n_now = plan["n_now"]
+    lane_ok = plan["lane_ok"]
+
+    _cutoff, purgeable, new_purged = _purge_plan(
+        state, win, wm, plan["new_fired_through"]
+    )
+
+    def _eval_compact():
+        acc3, touched2 = _state_fire_views(state, win, red)
+        mask, values = _eval_fire_lanes(
+            acc3, touched2, state.pane_ids, win, red, plan["p_f"],
+            lane_ok, touched2,
+        )
+        return _pack_fire_lanes(state.table, mask, values)
+
+    def _skip_compact():
+        return (
+            jnp.zeros((F, C), jnp.uint32),
+            jnp.zeros((F, C), jnp.uint32),
+            jnp.zeros((F, C) + red.out_shape, red.out_dtype),
+            jnp.zeros(F, jnp.int32),
+            jnp.zeros(F, jnp.float32),
+        )
+
+    def _eval_reduced():
+        acc3, touched2 = _state_fire_views(state, win, red)
+        mask, values = _eval_fire_lanes(
+            acc3, touched2, state.pane_ids, win, red, plan["p_f"],
+            lane_ok, touched2,
+        )
+        # == reduce_fires over this lane set (bit-parity with the
+        # split drain's on-chip reduction)
+        counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
+        masked = jnp.where(_expand(mask, values), values, 0)
+        vsums = jnp.sum(
+            masked.reshape(masked.shape[0], -1), axis=1
+        ).astype(jnp.float32)
+        return counts, vsums
+
+    def _skip_reduced():
+        return jnp.zeros(F, jnp.int32), jnp.zeros(F, jnp.float32)
+
+    if reduced:
+        counts, vsums = jax.lax.cond(n_now > 0, _eval_reduced,
+                                     _skip_reduced)
+        fires = ReducedFires(counts, plan["window_end"], n_now, lane_ok,
+                             vsums)
+    else:
+        khi, klo, v, counts, vsums = jax.lax.cond(
+            n_now > 0, _eval_compact, _skip_compact
+        )
+        fires = CompactFires(khi, klo, v, counts, plan["window_end"],
+                             n_now, lane_ok, vsums)
+    new_state = _dc.replace(
+        state,
+        watermark=wm,
+        fired_through=plan["new_fired_through"],
+        purged_through=new_purged,
+    )
+    return new_state, purgeable, fires

@@ -64,7 +64,8 @@ class SnapshotMeta:
     aux: dict
 
 
-def stage_window_state(state: wk.WindowShardState, rows=None) -> dict:
+def stage_window_state(state: wk.WindowShardState, rows=None,
+                       red: wk.ReduceSpec = None) -> dict:
     """SYNC phase of a snapshot: device -> host staging buffer.
 
     Fetches the bulk per-shard arrays of the selected shard ``rows``
@@ -73,25 +74,37 @@ def stage_window_state(state: wk.WindowShardState, rows=None) -> dict:
     device_get. Everything returned is a host numpy COPY, so the caller
     can hand the staging buffer to the background materializer and keep
     donating the live device buffers to subsequent steps.
+
+    PACKED-plane state (``state.packed >= 0``) unpacks into the split
+    (acc, touched) staging form here, so the logical snapshot format —
+    and therefore restore compatibility across plane layouts — is
+    independent of how the live device planes were stored. ``red`` is
+    required then (the touch column derives through its neutral).
     """
     S = int(state.acc.shape[0])
+    packed = state.packed >= 0
+    if packed and red is None:
+        raise ValueError("staging packed-plane state requires the "
+                         "stage's ReduceSpec")
     all_rows = rows is None or len(rows) == S
     rows = list(range(S)) if rows is None else sorted(int(r) for r in rows)
     if all_rows:
         bulk = {
             "keys": state.table.keys, "acc": state.acc,
-            "touched": state.touched, "pane_ids": state.pane_ids,
-            "fresh": state.fresh,
+            "pane_ids": state.pane_ids, "fresh": state.fresh,
         }
+        if not packed:
+            bulk["touched"] = state.touched
     else:
         # lazy row slices: only the dirty shards' bytes cross the link
         bulk = {
             "keys": [state.table.keys[s] for s in rows],
             "acc": [state.acc[s] for s in rows],
-            "touched": [state.touched[s] for s in rows],
             "pane_ids": [state.pane_ids[s] for s in rows],
             "fresh": [state.fresh[s] for s in rows],
         }
+        if not packed:
+            bulk["touched"] = [state.touched[s] for s in rows]
     small = {
         "watermark": state.watermark, "fired_through": state.fired_through,
         "max_pane": state.max_pane, "min_pane": state.min_pane,
@@ -101,14 +114,24 @@ def stage_window_state(state: wk.WindowShardState, rows=None) -> dict:
     bulk_h, small_h = jax.device_get((bulk, small))
     shards = {}
     for i, s in enumerate(rows):
-        shards[s] = {
+        sh = {
             k: np.asarray(bulk_h[k][s if all_rows else i])
-            for k in ("keys", "acc", "touched", "pane_ids", "fresh")
+            for k in bulk_h
         }
+        if packed:
+            sh["acc"], sh["touched"] = wk.split_packed(
+                sh["acc"], state.packed, red
+            )
+            sh["acc"] = np.ascontiguousarray(sh["acc"])
+            sh["touched"] = np.asarray(sh["touched"])
+        shards[s] = sh
     # value tail shape/dtype from the LIVE acc ([S, C*R, *tail]): an
     # empty staging (zero dirty shards) must still write correctly-
     # shaped empty entry arrays for vector / non-f32 reductions
-    value_tail = tuple(state.acc.shape[2:])
+    if packed:
+        value_tail = () if state.packed == 0 else (state.acc.shape[-1] - 1,)
+    else:
+        value_tail = tuple(state.acc.shape[2:])
     value_dtype = np.dtype(state.acc.dtype)
     scalars = {
         "watermark": int(np.asarray(small_h["watermark"]).min()),
@@ -167,11 +190,13 @@ def extract_entries(staged: dict, win: wk.WindowSpec):
     return entries, dict(staged["scalars"])
 
 
-def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec):
+def snapshot_window_state(state: wk.WindowShardState, win: wk.WindowSpec,
+                          red: wk.ReduceSpec = None):
     """Device -> logical entries. state is the stacked [n_shards, ...]
     tree. The synchronous composition of stage + extract — the sync-full
-    path and savepoints use it directly."""
-    return extract_entries(stage_window_state(state), win)
+    path and savepoints use it directly. ``red`` is required for
+    packed-plane state (see stage_window_state)."""
+    return extract_entries(stage_window_state(state, red=red), win)
 
 
 def restore_window_rows(entries, scalars, ctx, spec, rows=None,
@@ -326,10 +351,21 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
         )
 
     S = ctx.n_shards
+    # snapshot entries are logical, so a checkpoint restores into EITHER
+    # plane layout: packed stages re-pack the split host arrays here
+    packed = bool(getattr(spec, "packed", False))
+    if packed:
+        acc_dev = stack_put(
+            wk.make_packed(built["acc"], built["touched"], spec.red)
+        )
+        touched_dev = stack_put(np.zeros((S, 0), bool))
+    else:
+        acc_dev = stack_put(built["acc"])
+        touched_dev = stack_put(built["touched"])
     new_state = wk.WindowShardState(
         table=hashtable.SlotTable(stack_put(built["keys"]), spec.probe_len),
-        acc=stack_put(built["acc"]),
-        touched=stack_put(built["touched"]),
+        acc=acc_dev,
+        touched=touched_dev,
         pane_ids=stack_put(built["pane_ids"]),
         max_pane=_scal(S, scalars["max_pane"], ctx),
         min_pane=_scal(S, scalars["min_pane"], ctx),
@@ -362,6 +398,7 @@ def restore_window_state(entries, scalars, ctx, spec, leftover=None):
         # changelog restarts clean: the restored state IS the chain's
         # state, so the next incremental checkpoint extends that chain
         kg_dirty=stack_put([np.zeros(ctx.max_parallelism, bool)] * S),
+        packed=len(spec.red.value_shape) if packed else -1,
     )
     return new_state
 

@@ -23,6 +23,7 @@ import sys
 import threading
 import time
 from collections import deque, namedtuple
+from functools import partial
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -60,6 +61,8 @@ from flink_tpu.runtime.step import (
     build_window_fire_step,
     build_window_megastep,
     build_window_megastep_exchange,
+    build_window_megastep_fired,
+    build_window_megastep_fired_exchange,
     build_window_update_step,
     build_window_update_step_exchange,
     clear_dirty,
@@ -558,7 +561,12 @@ class JobMetrics:
     # K-fused lax.scan dispatches (pipeline.steps-per-dispatch > 1);
     # each one carries k_steps micro-batches of the `steps` counter
     fused_dispatches: int = 0
+    # ...of which resident-pipeline dispatches (pipeline.fused-fire):
+    # the fire sweep ran inside the scan and payloads surfaced lagged
+    fused_fire_dispatches: int = 0
     state_layout: str = ""  # "hash" | "direct" once the stage is set up
+    # packed acc+touched planes in effect (state.packed-planes)
+    state_packed_planes: bool = False
     # "mask" | "all_to_all" | "adaptive" once the stage is set up
     exchange_mode: str = ""
     dropped_late: int = 0
@@ -668,7 +676,7 @@ class JobMetrics:
     # MiniCluster's job detail endpoint)
     GAUGE_FIELDS = (
         "records_in", "records_out", "fires", "steps", "steps_fast",
-        "fused_dispatches",
+        "fused_dispatches", "fused_fire_dispatches",
         "dropped_late", "dropped_capacity", "restarts",
         "checkpoints_aborted", "checkpoints_declined", "watchdog_trips",
     )
@@ -1460,7 +1468,27 @@ class LocalExecutor:
         # steps_by_route's [route][tier] shape.
         k_fuse = max(1, env.config.get_int("pipeline.steps-per-dispatch", 1))
         megasteps_by_route = {}
-        fused = ingest_mod.FusedBatchAccumulator(k_fuse)
+        # -- resident pipeline (pipeline.fused-fire): fold the fire sweep
+        # into the megastep scan so a pane-boundary crossing inside a
+        # K-group fires WITHIN the scan — the fused slot no longer breaks
+        # groups at fire boundaries, and fire payloads surface as LAGGED
+        # megastep outputs (fire_watch) instead of a separate serialized
+        # fire dispatch. off = the PR-5 split-dispatch path, which always
+        # remains the fallback for partial groups and the DCN lockstep
+        # plane. Read through the declared ConfigOption (strict coercion).
+        from flink_tpu.core.config import CoreOptions as _CoreOpts
+
+        ff_cfg = str(env.config.get(_CoreOpts.PIPELINE_FUSED_FIRE))
+        if ff_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.fused-fire must be auto|on|off, got {ff_cfg!r}"
+            )
+        use_fused_fire = k_fuse > 1 and ff_cfg != "off"
+        fire_watch = deque()   # lagged fused-fire payload handles
+        FIRE_LAG = 1           # dispatches a payload may stay unread
+        fused = ingest_mod.FusedBatchAccumulator(
+            k_fuse, hold_fires=use_fused_fire
+        )
         fuse_gauge = [None]    # settable steps_per_dispatch gauge
         # -- update-kernel pre-combine (pipeline.update-precombine):
         # duplicate-key collapse before the state scatter (wk.update);
@@ -1479,6 +1507,29 @@ class LocalExecutor:
             )
         use_precombine = pc_cfg == "on" or (
             pc_cfg == "auto" and jax.default_backend() != "cpu"
+        )
+        # -- packed state planes (state.packed-planes): touched bits ride
+        # a trailing accumulator column — one scatter/sweep maintains
+        # both planes (wk.init_state packed). auto is PLATFORM-gated
+        # like precombine: on accelerators the saved scatter pass wins;
+        # on CPU the wider sweep bytes cost more than the serial scatter
+        # they replace (measured, device_update_ceiling state-plane
+        # sweep). Snapshots stay logical, so checkpoints move freely
+        # between plane layouts.
+        pp_cfg = str(env.config.get(_CoreOpts.STATE_PACKED_PLANES))
+        if pp_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"state.packed-planes must be auto|on|off, got {pp_cfg!r}"
+            )
+        if pp_cfg == "on" and not wk.packed_eligible(red):
+            raise ValueError(
+                "state.packed-planes=on requires a builtin sum/count/"
+                "min/max reduce with the default neutral and an "
+                "at-most-1-D value; unset it for this stage"
+            )
+        use_packed = pp_cfg == "on" or (
+            pp_cfg == "auto" and jax.default_backend() != "cpu"
+            and wk.packed_eligible(red)
         )
         exchange_cap = [0]        # per-(src,dst) bucket lanes of the exchange
         force_route = [None]      # warmup override
@@ -1621,8 +1672,10 @@ class LocalExecutor:
                 probe_len=env.config.get_int("state.probe-len", 16),
                 layout=layout[0],
                 precombine=use_precombine,
+                packed=use_packed,
             )
             metrics.state_layout = layout[0]
+            metrics.state_packed_planes = use_packed
             if not steps_by_route:
                 # exchange.mode — how records reach their owning shard on
                 # a multi-device mesh (the reference's keyed shuffle,
@@ -1684,24 +1737,50 @@ class LocalExecutor:
                     # K-fused megasteps mirror the [route][tier] variant
                     # table for exactly the routes built above; partial
                     # groups fall back to the single steps (bit-identical
-                    # by construction)
+                    # by construction). With the resident pipeline on
+                    # (pipeline.fused-fire) the FIRED variants replace
+                    # the plain ones outright — full groups always take
+                    # the in-scan fire path, so compiling both would
+                    # only double the warmup burst.
+                    if use_fused_fire:
+                        # device_reduce sink topologies never read fire
+                        # payloads, so their fired megasteps surface
+                        # ReducedFires and skip the [K, F, C] payload
+                        # stacking entirely (the in-scan analog of
+                        # fire_reduced_step). Only safe when the spill
+                        # tier can NEVER activate (no overflow ring):
+                        # spill merges need per-key payloads.
+                        ff_reduced = bool(
+                            sink_device_reduce and not win.overflow
+                        )
+                        mk_mask = partial(
+                            build_window_megastep_fired,
+                            reduced=ff_reduced,
+                        )
+                        mk_ex = partial(
+                            build_window_megastep_fired_exchange,
+                            reduced=ff_reduced,
+                        )
+                    else:
+                        mk_mask = build_window_megastep
+                        mk_ex = build_window_megastep_exchange
                     if "mask" in steps_by_route:
                         megasteps_by_route["mask"] = {
-                            "insert": build_window_megastep(
+                            "insert": mk_mask(
                                 ctx, spec, k_fuse, kg_fill=kg_stats_on,
                             ),
-                            "fast": build_window_megastep(
+                            "fast": mk_mask(
                                 ctx, spec, k_fuse, insert=False,
                                 kg_fill=kg_stats_on,
                             ) if build_fast else None,
                         }
                     if "exchange" in steps_by_route:
                         megasteps_by_route["exchange"] = {
-                            "insert": build_window_megastep_exchange(
+                            "insert": mk_ex(
                                 ctx, spec, bpd, k_fuse, capf,
                                 kg_fill=kg_stats_on,
                             ),
-                            "fast": build_window_megastep_exchange(
+                            "fast": mk_ex(
                                 ctx, spec, bpd, k_fuse, capf,
                                 insert=False, kg_fill=kg_stats_on,
                             ) if build_fast else None,
@@ -1750,6 +1829,7 @@ class LocalExecutor:
                 steps0, fast0, ex0 = (metrics.steps, metrics.steps_fast,
                                       metrics.steps_exchanged)
                 fused0 = metrics.fused_dispatches
+                ff0 = metrics.fused_fire_dispatches
                 for route in steps_by_route:
                     for tier in ("insert", "fast"):
                         if steps_by_route[route][tier] is None:
@@ -1785,6 +1865,10 @@ class LocalExecutor:
                 metrics.steps, metrics.steps_fast = steps0, fast0
                 metrics.steps_exchanged = ex0
                 metrics.fused_dispatches = fused0
+                metrics.fused_fire_dispatches = ff0
+                # warmup fired-megastep payloads: sentinel watermarks
+                # fire nothing, and warmup must not leave handles behind
+                fire_watch.clear()
                 with CompileEvents.stage("window-fire"):
                     cf = run_fire(None)
                     jax.block_until_ready(cf.counts)
@@ -2131,7 +2215,7 @@ class LocalExecutor:
                     )
                 # else: first checkpoint in the directory, or compaction
                 # due -> write a fresh full base
-            staged = ckpt.stage_window_state(state, rows=rows)
+            staged = ckpt.stage_window_state(state, rows=rows, red=red)
             if ck_mode == "incremental":
                 state = clear_dirty(state)
                 # cleared-bits ledger for the warm splice (see above):
@@ -2369,12 +2453,21 @@ class LocalExecutor:
                         spl(state.table.keys, built["keys"]),
                         spec.probe_len,
                     ),
-                    acc=spl(state.acc, built["acc"]),
-                    touched=spl(state.touched, built["touched"]),
                     fresh=spl(state.fresh, built["fresh"]),
                     pane_ids=spl(state.pane_ids, built["pane_ids"]),
                     n_fresh=spl(state.n_fresh, built["n_fresh"]),
                 )
+                if use_packed:
+                    # restore rows are logical; re-pack before splicing
+                    # onto the live packed plane (touched rides inside)
+                    repl.update(acc=spl(state.acc, wk.make_packed(
+                        built["acc"], built["touched"], red
+                    )))
+                else:
+                    repl.update(
+                        acc=spl(state.acc, built["acc"]),
+                        touched=spl(state.touched, built["touched"]),
+                    )
             # rows == []: nothing diverged since the cut — the live
             # arrays ARE the checkpoint; only the scalars rewind
             state = dataclasses.replace(state, **repl)
@@ -2393,6 +2486,10 @@ class LocalExecutor:
             # were never applied and never marked, so dropping them here
             # simply lets the rewound source replay them
             fused.clear()
+            # unread resident-pipeline fire payloads die with the failed
+            # state: the restored cut re-fires them on replay (the same
+            # at-least-once sink contract as fires emitted-then-replayed)
+            fire_watch.clear()
             if materializer is not None:
                 ck_io.recover()           # durable cuts still notify
             with ck_lock:
@@ -2555,7 +2652,8 @@ class LocalExecutor:
             sp = ckpt.CheckpointStorage(path, retain=10**9)
             flush_fused()   # savepoint cut = megastep boundary
             drain_fires(int(wm_strategy.current()))
-            entries, scalars = ckpt.snapshot_window_state(state, win)
+            entries, scalars = ckpt.snapshot_window_state(state, win,
+                                                          red=red)
             entries = _fold_spill_entries(entries, _dump_spill_stores())
             n_rev = 0
             if keep_rev:
@@ -2614,8 +2712,16 @@ class LocalExecutor:
                 R = win.ring
                 C_cap = tkeys.shape[0]
                 acc_s = np.asarray(state.acc[shard])
+                if state.packed >= 0:
+                    acc_s, touched_f = wk.split_packed(
+                        acc_s, state.packed, red
+                    )
+                    touched = np.asarray(touched_f).reshape(R, C_cap)
+                else:
+                    touched = np.asarray(
+                        state.touched[shard]
+                    ).reshape(R, C_cap)
                 acc2 = acc_s.reshape((R, C_cap) + acc_s.shape[1:])
-                touched = np.asarray(state.touched[shard]).reshape(R, C_cap)
                 pane_ids = np.asarray(state.pane_ids[shard])
                 for r in range(R):
                     if touched[r, slot] and pane_ids[r] != wk.PANE_NONE:
@@ -3018,9 +3124,24 @@ class LocalExecutor:
                     min(int(td.to_ticks(wm_ms)), 2**31 - 4)
                     if wm_ms is not None else -(2**31) + 1
                 )
-            state, (ovf_handle, act_handle, kgf_handle) = active(
-                state, *flat, wmv,
-            )
+            if getattr(active, "fused_fire", False):
+                # resident pipeline: the scan fired each sub-batch under
+                # its own watermark; queue the payload handles for LAGGED
+                # consumption (consume_fires) — no step-loop sync here.
+                # The post-scan ovf_n handle rides along: emitting a
+                # window whose spill contributions still sit in the
+                # DEVICE ring would lose them, so the consumer drains
+                # the ring first whenever that fill is nonzero (ovf_n is
+                # monotone until a host drain, so the post-scan value
+                # can never under-report the fill at fire time).
+                state, (ovf_handle, act_handle, kgf_handle), fires = \
+                    active(state, *flat, wmv)
+                fire_watch.append((fires, ovf_handle, time.perf_counter()))
+                metrics.fused_fire_dispatches += 1
+            else:
+                state, (ovf_handle, act_handle, kgf_handle) = active(
+                    state, *flat, wmv,
+                )
             inflight.append(act_handle)
             if len(inflight) > max_inflight:
                 inflight.popleft().block_until_ready()
@@ -3057,11 +3178,20 @@ class LocalExecutor:
             is the megastep-boundary checkpoint cut: a snapshot taken
             after this flush names offsets whose every prior record the
             device state has absorbed, so exactly-once is preserved with
-            fusion on."""
+            fusion on.
+
+            Resident-pipeline mode (fused.hold_fires): groups are no
+            longer broken at fire boundaries, so this flush also OWNS
+            the crossing bookkeeping — a full group's crossings fired
+            in-scan (host_fired_pane catches up here, and a modeled
+            lane-backlog overrun falls back to the split drain), while a
+            partial group dispatched as singles still needs the split
+            drain for any crossing it carried."""
             if not len(fused):
                 return
             route, staged_mode, items = fused.drain()
-            if len(items) >= k_fuse:
+            full = len(items) >= k_fuse
+            if full:
                 run_update_fused(route, items)
             elif staged_mode:
                 for args, wm_ms, _pb in items:
@@ -3077,6 +3207,59 @@ class LocalExecutor:
             last_pb = items[-1][2]
             if last_pb is not None:
                 ingest.mark_applied(last_pb)
+            if fused.hold_fires:
+                fired_in_scan = full and getattr(
+                    megasteps_by_route.get(route, {}).get("insert"),
+                    "fused_fire", False,
+                )
+                _fused_fire_bookkeep(items, fired_in_scan)
+                # lagged payload consumption: by now the PREVIOUS
+                # group's fires have long materialized on device
+                consume_fires()
+
+        def _fused_fire_bookkeep(items, fired_in_scan):
+            """Track pane crossings through a resident-pipeline flush.
+
+            A full fired-megastep group emitted every due window IN the
+            scan (up to F lanes per sub-step, leftovers rolling to the
+            next sub-step); the host models that lane budget and only
+            falls back to the split drain when the model says dues could
+            have outrun the lanes (or the group was dispatched split —
+            partial flush — with a crossing pending). Also catches
+            host_fired_pane up to the group's last watermark, and drains
+            eagerly with allowed lateness (re-fire backlogs are data-
+            dependent, which the host cannot see)."""
+            nonlocal host_fired_pane
+            F_on = win.fires_per_step
+            # device dues per advance are bounded by the ring span plus
+            # the window's pane count (fire-lane plan), so a fresh job's
+            # sentinel host_fired_pane cannot fake an unbounded backlog
+            cap = win.ring + win.size_ticks // win.slide_ticks
+            backlog = 0
+            prev = host_fired_pane
+            last_wm = None
+            crossed = False
+            for _args, wm_ms, _pb in items:
+                if wm_ms is None:
+                    continue
+                last_wm = wm_ms
+                wp = wm_pane_of(wm_ms)
+                if wp > prev:
+                    crossed = True
+                    backlog += min(wp - prev, cap)
+                    prev = wp
+                if fired_in_scan:
+                    backlog = max(0, backlog - F_on)
+            if last_wm is None:
+                return
+            host_fired_pane = max(host_fired_pane, prev)
+            need_split_drain = (
+                backlog > 0
+                or (not fired_in_scan and (crossed or eager_fire))
+                or (eager_fire and fired_in_scan)
+            )
+            if need_split_drain:
+                drain_fires(last_wm, time.perf_counter())
 
         def run_fire(wm_ms, reduced: bool = False):
             nonlocal state
@@ -3425,6 +3608,78 @@ class LocalExecutor:
             ]
             return _emit_batch(pipe, out, metrics)
 
+        class _SubstepFires:
+            """Per-sub-step view of a fired megastep's stacked
+            CompactFires ([n_shards, K, ...] leaves): lazy [:, k] payload
+            slices that materialize only through emit_fires' [:count]
+            fetches — a no-fire sub-step transfers nothing."""
+
+            __slots__ = ("key_hi", "key_lo", "values")
+
+            def __init__(self, cf, kk):
+                self.key_hi = cf.key_hi[:, kk]
+                self.key_lo = cf.key_lo[:, kk]
+                self.values = cf.values[:, kk]
+
+        def consume_fires(force: bool = False):
+            """Drain lagged resident-pipeline fire payloads, oldest
+            first (emission order == fire order). In steady state a
+            handle sits FIRE_LAG dispatches before being read, so the
+            device long since materialized it and the fetch is one
+            settled round trip — the resident pipeline's analog of the
+            lagged monitoring channel. ``force`` empties the queue at
+            ordering boundaries: any split drain, checkpoint/savepoint
+            cuts (emissions must precede the snapshot so a crash cannot
+            strand a fire the restored fired_through already counts),
+            idle polls and end of stream (latency guard)."""
+            total = 0
+            while fire_watch and (force or len(fire_watch) > FIRE_LAG):
+                cf, ovf_h, t_disp = fire_watch.popleft()
+                # ReducedFires payloads (device_reduce topologies) have
+                # no key planes: the small fields below ARE the drain
+                reduced = not hasattr(cf, "key_hi")
+                t_f0 = time.perf_counter()
+                counts, lanes, ends, vsums, ovf_fill = jax.device_get(
+                    (cf.counts, cf.lane_valid, cf.window_end_ticks,
+                     cf.value_sums, ovf_h)
+                )                              # [n_shards, K, Ft]
+                if win.overflow and int(ovf_fill.max(initial=0)) > 0:
+                    # spill contributions for the fired panes may still
+                    # sit in the device overflow ring — move them into
+                    # the host pane stores BEFORE the emission merge
+                    # (the split drain orders drain_overflow the same
+                    # way; entries landing after a window fired are
+                    # late-dropped on device, so over-draining is safe)
+                    drain_overflow()
+                t_f1 = time.perf_counter()
+                fires_before = metrics.fires
+                n = 0
+                for kk in range(counts.shape[1]):
+                    if not lanes[:, kk].any():
+                        continue
+                    n += emit_fires(
+                        None if reduced else _SubstepFires(cf, kk),
+                        counts[:, kk], lanes[:, kk], ends[:, kk],
+                        vsums[:, kk], reduced,
+                    )
+                if tracer is not None and tracer.active:
+                    tracer.rec("fire", t_f0, t_f1, fused=True)
+                    tracer.rec("emit", t_f1, fired=n)
+                if n:
+                    metrics.record_fire_latency(
+                        metrics.fires - fires_before,
+                        (time.perf_counter() - t_disp) * 1e3,
+                    )
+                    rec_tracker.note_fire()
+                    if self._latency_hist is not None and \
+                            last_ingest_t[0] is not None:
+                        self._latency_hist.update(
+                            (time.perf_counter() - last_ingest_t[0]) * 1e3
+                        )
+                total += n
+                phase_acc["emit"] += time.perf_counter() - t_f0
+            return total
+
         def drain_fires(wm_ms, t_cross=None):
             """Fire every due window end at watermark wm_ms. One fire step
             evaluates up to F window ends (+ up to F late re-fires); loop
@@ -3436,6 +3691,9 @@ class LocalExecutor:
             north-star metric; ref WindowOperator.onEventTime drain)."""
             dbg = os.environ.get("FLINK_TPU_DRAIN_DEBUG")
             t_e0 = time.perf_counter()
+            # pending resident-pipeline payloads predate this drain's
+            # fires (and prune_stores below must not outrun them)
+            consume_fires(force=True)
             drain_overflow()     # ring -> pane stores before any emission
             # skew telemetry: refresh the per-key-group occupancy view ON
             # ENTRY (interval-limited inside) — the fires below purge due
@@ -3715,6 +3973,13 @@ class LocalExecutor:
             wp = wm_pane_of(wm_ms)
             fire_now = eager_fire or wp > host_fired_pane
             deferred = False
+            # resident pipeline: a crossing no longer breaks the group —
+            # the fused-fire megastep fires it INSIDE the scan, and
+            # flush_fused owns the crossing bookkeeping for this batch
+            in_scan = (
+                fused.hold_fires and k_fuse > 1
+                and pb.route in megasteps_by_route
+            )
             if k_fuse > 1 and pb.route in megasteps_by_route:
                 if pb.staged is not None:
                     args, staged_mode = pb.staged, True
@@ -3725,7 +3990,7 @@ class LocalExecutor:
                 if not fused.compatible(pb.route, staged_mode):
                     flush_fused()
                 fused.push(args, wm_ms, pb, pb.route, staged_mode)
-                if fused.full() or fire_now:
+                if fused.full() or (fire_now and not in_scan):
                     flush_fused()
                 else:
                     deferred = True
@@ -3734,7 +3999,7 @@ class LocalExecutor:
                            staged=pb.staged, route=pb.route)
             else:
                 run_update(*_pad_planned(pb), wm_ms, route=pb.route)
-            if fire_now:
+            if fire_now and not in_scan:
                 drain_fires(wm_ms, time.perf_counter())
                 host_fired_pane = wp
             return deferred
@@ -3793,8 +4058,10 @@ class LocalExecutor:
                 # idle poll: the source went quiet — apply any pending
                 # fused group now (latency guard, and this empty poll's
                 # offsets sit PAST the pending batches' polls, so marking
-                # them applied below is only correct once they dispatch)
+                # them applied below is only correct once they dispatch),
+                # and surface any lagged resident-pipeline fires
                 flush_fused()
+                consume_fires(force=True)
                 # idle poll: advance processing-time watermark
                 if not event_time:
                     wp = wm_pane_of(now_ms - 1)
@@ -3803,6 +4070,7 @@ class LocalExecutor:
                         host_fired_pane = wp
             if end:
                 flush_fused()   # the stream is over: nothing may pend
+                consume_fires(force=True)
                 deferred = False
             # this batch is now part of the device state: its offsets
             # name the cut the next checkpoint/savepoint snapshots. A

@@ -303,18 +303,28 @@ class FusedBatchAccumulator:
     up to K consecutive planned micro-batches that share a route and a
     staging mode, which the executor then hands to ONE compiled lax.scan
     megastep (runtime/step.py build_window_megastep*). The flush triggers
-    — route change, fire boundary, checkpoint/savepoint cut, idle poll,
-    end of stream, restore — are all step-loop state, so the executor
-    drives; this class owns the slot bookkeeping so the grouping contract
-    is unit-testable.
+    — route change, checkpoint/savepoint cut, idle poll, end of stream,
+    restore, and (split-dispatch mode only) fire boundary — are all
+    step-loop state, so the executor drives; this class owns the slot
+    bookkeeping so the grouping contract is unit-testable.
+
+    ``hold_fires`` records the resident-pipeline mode
+    (pipeline.fused-fire): the fire sweep is folded into the megastep
+    scan, so a pane-boundary crossing inside the group no longer breaks
+    it — groups stay K-full across fire boundaries and the in-scan
+    advance fires each sub-batch under its own watermark. With it off
+    (the PR-5 split-dispatch behavior, still the partial-group and DCN
+    fallback) the executor flushes early at every fire boundary so the
+    separate fire dispatch sees every pending update.
 
     Exactly-once contract: a batch sitting in the slot has NOT been
     dispatched, so its offsets must not become the applied cut until the
     flush — the executor marks the LAST flushed batch applied, which is
     the megastep-boundary snapshot cut."""
 
-    def __init__(self, k: int):
+    def __init__(self, k: int, hold_fires: bool = False):
         self.k = max(1, int(k))
+        self.hold_fires = bool(hold_fires)
         self.items: list = []      # [(args 5-tuple, wm_ms | None, pb)]
         self.route: Optional[str] = None
         self.staged: Optional[bool] = None

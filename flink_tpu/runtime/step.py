@@ -50,6 +50,11 @@ class WindowStageSpec:
     # rep scatters. Only built-in reducers take it; resolved from
     # pipeline.update-precombine by the executor.
     precombine: bool = False
+    # packed state planes (wk.init_state packed): touched bits ride a
+    # trailing accumulator column — one scatter/sweep maintains both.
+    # Resolved from state.packed-planes by the executor (platform-gated
+    # auto); only wk.packed_eligible reduce specs take it.
+    packed: bool = False
 
 
 def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
@@ -62,7 +67,8 @@ def init_sharded_state(ctx: MeshContext, spec: WindowStageSpec):
     def one(_):
         return wk.init_state(spec.capacity_per_shard, spec.probe_len,
                              spec.win, spec.red, layout=spec.layout,
-                             n_key_groups=ctx.max_parallelism)
+                             n_key_groups=ctx.max_parallelism,
+                             packed=spec.packed)
 
     states = [one(i) for i in range(ctx.n_shards)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
@@ -87,9 +93,10 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
         mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
             kg <= kg_end.astype(jnp.uint32)
         )
-        state, _ = wk.update(state, spec.win, spec.red, hi, lo, ts, values,
-                             mine, direct=spec.layout == "direct", kg=kg,
-                             precombine=spec.precombine)
+        state, _, _ = wk.update(state, spec.win, spec.red, hi, lo, ts,
+                                values, mine,
+                                direct=spec.layout == "direct", kg=kg,
+                                precombine=spec.precombine)
         state, fires = wk.advance_and_fire(state, spec.win, spec.red, wm[0])
         state = jax.tree_util.tree_map(lambda x: x[None], state)
         fires = jax.tree_util.tree_map(lambda x: x[None], fires)
@@ -121,15 +128,20 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
 
 def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
                       hi, lo, ts, values, valid, wm, maxp: int,
-                      insert: bool = True, kg_fill: bool = False):
+                      insert: bool = True, kg_fill: bool = False,
+                      clear_rows=None):
     """Shared per-shard body for the mask (replicated-batch) route: hash
     to key groups, mask to owned groups, apply the window update, and
     advance the shard watermark. Used by the single step AND the K-fused
-    megastep scan body so the mask semantics cannot diverge (the exchange
-    route shares exchange_update_shard the same way). ``wm`` is this
-    batch's watermark scalar. Returns (state', activity, kg_fill_counts);
-    kg_fill counts are the skew telemetry (observability.kg-stats),
-    statically compiled out to a zero-length array when off."""
+    megastep scan bodies so the mask semantics cannot diverge (the
+    exchange route shares exchange_update_shard the same way). ``wm`` is
+    this batch's watermark scalar. Returns (state', activity,
+    kg_fill_counts); the kg_fill counts (observability.kg-stats skew
+    telemetry) are computed INSIDE wk.update so they ride the shared
+    pre-combine sort with the other scatter consumers, statically
+    compiled out to a zero-length array when off. ``clear_rows`` folds
+    the fused-fire scan's deferred purge into the update's ring-reset
+    sweep (wk.update)."""
     import dataclasses as _dc
 
     if spec.pre is not None:
@@ -138,15 +150,13 @@ def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
     mine = valid & (kg >= kg_start.astype(jnp.uint32)) & (
         kg <= kg_end.astype(jnp.uint32)
     )
-    state, activity = wk.update(state, spec.win, spec.red, hi, lo, ts,
-                                values, mine, insert=insert,
-                                direct=spec.layout == "direct", kg=kg,
-                                precombine=spec.precombine)
-    state = _dc.replace(state, watermark=jnp.maximum(state.watermark, wm))
-    kgf = (
-        wk.kg_batch_fill(kg, mine, maxp) if kg_fill
-        else jnp.zeros(0, jnp.int32)
+    state, activity, kgf = wk.update(
+        state, spec.win, spec.red, hi, lo, ts, values, mine,
+        insert=insert, direct=spec.layout == "direct", kg=kg,
+        precombine=spec.precombine, kg_fill=maxp if kg_fill else 0,
+        clear_rows=clear_rows,
     )
+    state = _dc.replace(state, watermark=jnp.maximum(state.watermark, wm))
     return state, activity, kgf
 
 
@@ -219,13 +229,15 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
 
 def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
                           hi, lo, ts, values, valid, n: int, maxp: int,
-                          cap: int, insert: bool = True):
+                          cap: int, insert: bool = True, clear_rows=None):
     """Shared per-shard body: route this device's lane slice to owning
     shards over the mesh all_to_all, mask to owned key groups, and apply
     the window update. Used by the single-host exchange step and the
     cross-host DCN runner (runtime/dcn.py) so the shuffle semantics
     cannot diverge. Returns (state', activity) with bucket overflow
-    already counted into dropped_capacity."""
+    already counted into dropped_capacity. (kg_fill telemetry stays a
+    route-level concern here: the contract counts each record at its
+    PRE-exchange source device, which update cannot see.)"""
     import dataclasses as _dc
 
     from flink_tpu.parallel.exchange import exchange_owned
@@ -236,11 +248,12 @@ def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
         {"ts": ts, "values": values}, hi, lo, valid, n, maxp, cap,
         kg_start, kg_end,
     )
-    state, activity = wk.update(state, spec.win, spec.red, r_hi, r_lo,
-                                cols["ts"], cols["values"], mine,
-                                insert=insert,
-                                direct=spec.layout == "direct",
-                                precombine=spec.precombine)
+    state, activity, _ = wk.update(state, spec.win, spec.red, r_hi, r_lo,
+                                   cols["ts"], cols["values"], mine,
+                                   insert=insert,
+                                   direct=spec.layout == "direct",
+                                   precombine=spec.precombine,
+                                   clear_rows=clear_rows)
     state = _dc.replace(
         state, dropped_capacity=state.dropped_capacity + n_over
     )
@@ -502,6 +515,199 @@ def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
     return megastep
 
 
+def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
+                                k_steps: int, insert: bool = True,
+                                kg_fill: bool = False,
+                                reduced: bool = False):
+    """Resident-pipeline megastep (pipeline.fused-fire, ISSUE 7): the
+    K-fused ``lax.scan`` with the FIRE SWEEP folded into the scan body.
+    Each sub-step applies its micro-batch (the shared mask_update_shard
+    body, so the routing semantics cannot diverge from the single step)
+    and then runs ``wk.advance_and_fire_resident`` against its own
+    watermark: a pane-boundary crossing inside the K-group fires WITHIN
+    the scan instead of breaking the group into single dispatches plus a
+    separate fire dispatch (the split path this replaces serialized
+    update and fire at every boundary).
+
+    The per-sub-step advance is affordable because the fire evaluation
+    is lax.cond-gated on "anything due" and the purge plane-clears
+    DEFER into the next sub-step's ring-reset sweep (carried ``pending``
+    rows; ``apply_pending_purge`` reconciles after the scan so the
+    returned state is bit-identical to the sequential interleaving).
+
+    Returns ``(state', (ovf_n, activity, kg_fill), fires)`` where
+    ``fires`` is a CompactFires pytree with a leading [n_shards, K] axis
+    — sub-step i's payload under sub-step i's watermark. The executor
+    consumes the handles LAGGED (runtime/executor.py consume_fires), so
+    surfacing fires costs no step-loop sync.
+
+    ``reduced=True`` surfaces ReducedFires instead — per-lane scalars,
+    no payload planes. The scan stacks a payload slot for every
+    sub-step whether it fired or not, so device_reduce sink topologies
+    (which never read payloads) skip the [K, F, C] zero traffic that
+    otherwise dominates the resident overhead on quiet streams."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    K = int(k_steps)
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        pend0 = jnp.zeros(spec.win.ring, bool)
+
+        def sub(carry, xs):
+            st, pend = carry
+            s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+            st, act, kgf = mask_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+                clear_rows=pend,
+            )
+            st, pend, cf = wk.advance_and_fire_resident(
+                st, spec.win, spec.red, s_wm, reduced=reduced
+            )
+            return (st, pend), (act, kgf, cf)
+
+        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+            sub, (state, pend0), (hi, lo, ts, values, valid, wm[0])
+        )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(), P(), P(),   # [K, B] batch stacks, replicated
+            P(SHARD_AXIS),             # wmv [n_shards, K]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def megastep(state, *flat):
+        *batches, wmv = flat
+        stacks = _fused_batch_stack(K, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            state, starts, ends, *stacks, wmv
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    megastep.k_steps = K
+    megastep.fused_fire = True
+    megastep.fused_fire_reduced = reduced
+    return megastep
+
+
+def build_window_megastep_fired_exchange(ctx: MeshContext,
+                                         spec: WindowStageSpec,
+                                         batch_per_device: int,
+                                         k_steps: int,
+                                         capacity_factor: float = 2.0,
+                                         insert: bool = True,
+                                         kg_fill: bool = False,
+                                         reduced: bool = False):
+    """Exchange-route resident megastep: the fused-fire analog of
+    build_window_megastep_exchange — each scan sub-step runs the shared
+    ``exchange_update_shard`` body (bucket + all_to_all + masked update)
+    followed by the gated resident advance, so neither the shuffle nor
+    the fire semantics can diverge from the split-dispatch route. Batch
+    stacks arrive [K, B] SPLIT over devices on the batch (second) axis;
+    fires come back per shard like the mask variant."""
+    import dataclasses as _dc
+
+    from flink_tpu.parallel.exchange import bucket_capacity
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    n = ctx.n_shards
+    cap = bucket_capacity(batch_per_device, n, capacity_factor)
+    K = int(k_steps)
+
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        pend0 = jnp.zeros(spec.win.ring, bool)
+
+        def sub(carry, xs):
+            st, pend = carry
+            s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+            st, act = exchange_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, n, maxp, cap, insert=insert, clear_rows=pend,
+            )
+            st = _dc.replace(st, watermark=jnp.maximum(st.watermark, s_wm))
+            if kg_fill:
+                kg_local = assign_to_key_group(
+                    route_hash(s_hi, s_lo, jnp), maxp, jnp
+                )
+                kgf = wk.kg_batch_fill(kg_local, s_valid, maxp)
+            else:
+                kgf = jnp.zeros(0, jnp.int32)
+            st, pend, cf = wk.advance_and_fire_resident(
+                st, spec.win, spec.red, s_wm, reduced=reduced
+            )
+            return (st, pend), (act, kgf, cf)
+
+        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+            sub, (state, pend0), (hi, lo, ts, values, valid, wm[0])
+        )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            # [K, B] stacks SPLIT over devices on the batch axis
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def megastep(state, *flat):
+        *batches, wmv = flat
+        stacks = _fused_batch_stack(K, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            state, starts, ends, *stacks, wmv
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    megastep.k_steps = K
+    megastep.fused_fire = True
+    megastep.fused_fire_reduced = reduced
+    megastep.recv_lanes = n * cap
+    megastep.bucket_cap = cap
+    return megastep
+
+
 def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
     """Fire-only half: advance the watermark, evaluate due window ends for
     the whole key population, and return device-compacted fires
@@ -575,7 +781,8 @@ def build_kg_occupancy_step(ctx: MeshContext, spec: WindowStageSpec):
 
     def shard_body(state):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
-        return wk.kg_occupancy(state, maxp)[None]
+        return wk.kg_occupancy(state, maxp, red=spec.red,
+                               win=spec.win)[None]
 
     sharded = shard_map(
         shard_body, mesh=mesh, in_specs=(P(SHARD_AXIS),),

@@ -31,8 +31,11 @@ from flink_tpu.runtime.sinks import CollectSink
 from flink_tpu.runtime.sources import GeneratorSource
 from flink_tpu.runtime.step import (
     WindowStageSpec,
+    build_window_fire_step,
     build_window_megastep,
     build_window_megastep_exchange,
+    build_window_megastep_fired,
+    build_window_megastep_fired_exchange,
     build_window_update_step,
     build_window_update_step_exchange,
     init_sharded_state,
@@ -212,6 +215,170 @@ def test_precombine_marks_same_dirty_groups(rng):
     assert np.array_equal(dirt[False], dirt[True])
 
 
+# ---------------------------------------------- resident pipeline (fused fire)
+
+_FIRE_FIELDS = ("key_hi", "key_lo", "values", "counts",
+                "window_end_ticks", "n_fires", "lane_valid", "value_sums")
+
+
+def _fire_crossing_batches(rng, layout, k=K):
+    """Batches whose watermarks cross pane boundaries MID-group, so the
+    in-scan fire path actually fires (slide=10; wm advances ~1.2 panes
+    per batch). For k > 1 the first sub-step crosses nothing — the
+    gated-eval SKIP branch gets exercised alongside the fire branch;
+    a k=1 group starts past the first boundary so it always fires."""
+    out = []
+    wm0 = 15 if k == 1 else 5
+    for i in range(k):
+        if layout == "direct":
+            hi = np.zeros(B, np.uint32)
+            lo = rng.integers(0, 500, B).astype(np.uint32)
+        else:
+            hi, lo = _split(rng.integers(0, 100, B).astype(np.int64))
+        ts = rng.integers(0, 40, B).astype(np.int32)
+        vals = rng.integers(1, 5, B).astype(np.float32)
+        out.append((hi, lo, ts, vals, np.ones(B, bool),
+                    np.full(8, np.int32(i * 12 + wm0))))
+    return out
+
+
+@pytest.mark.parametrize("layout", ["hash", "direct"])
+@pytest.mark.parametrize("k", [1, K])
+def test_fired_megastep_bitexact_vs_sequential_oracle_mask(rng, layout, k):
+    """The resident-pipeline megastep (fire folded into the scan) vs the
+    sequential update-then-advance_and_fire oracle: every state leaf bit-
+    equal AND every sub-step's compacted fire payload byte-equal — the
+    gated eval, the deferred purge, and the post-scan fixup may not
+    perturb anything observable."""
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    spec = _spec(layout)
+    single = build_window_update_step(ctx, spec)
+    fire = build_window_fire_step(ctx, spec)
+    mega = build_window_megastep_fired(ctx, spec, k)
+    s1 = init_sharded_state(ctx, spec)
+    s2 = init_sharded_state(ctx, spec)
+    batches = _fire_crossing_batches(rng, layout, k)
+    oracle = []
+    for (hi, lo, ts, vals, valid, wm) in batches:
+        s1, _ = single(s1, hi, lo, ts, vals, valid, wm)
+        s1, fr = fire(s1, wm)
+        oracle.append(fr)
+    s2, mon, fires = mega(s2, *_flat(batches), _wmv(batches))
+    _assert_states_bitexact(s1, s2)
+    total = 0
+    for i, fr in enumerate(oracle):
+        for name in _FIRE_FIELDS:
+            a = np.asarray(getattr(fr, name))
+            b = np.asarray(getattr(fires, name))[:, i]
+            assert np.array_equal(a, b), (name, i)
+        total += int(np.asarray(fr.counts).sum())
+    assert total > 0, "scenario never fired — the test proves nothing"
+    # reduce_fires payload parity: the on-chip reduced quantities the
+    # device_reduce sinks consume derive from the same packed fields
+    for i, fr in enumerate(oracle):
+        assert np.array_equal(np.asarray(fr.value_sums),
+                              np.asarray(fires.value_sums)[:, i])
+        assert np.array_equal(np.asarray(fr.counts),
+                              np.asarray(fires.counts)[:, i])
+
+
+def test_fired_megastep_bitexact_vs_sequential_oracle_exchange(rng):
+    """Exchange-route resident megastep (all_to_all + in-scan fire) ==
+    K sequential exchange steps + fire steps, bit for bit, payloads
+    included."""
+    from flink_tpu.runtime.step import build_window_fire_step
+
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    spec = _spec("hash")
+    bpd = B // 8
+    single = build_window_update_step_exchange(ctx, spec, bpd, 2.0)
+    fire = build_window_fire_step(ctx, spec)
+    mega = build_window_megastep_fired_exchange(ctx, spec, bpd, K, 2.0)
+    s1 = init_sharded_state(ctx, spec)
+    s2 = init_sharded_state(ctx, spec)
+    batches = _fire_crossing_batches(rng, "hash")
+    oracle = []
+    for (hi, lo, ts, vals, valid, wm) in batches:
+        s1, _ = single(s1, hi, lo, ts, vals, valid, wm)
+        s1, fr = fire(s1, wm)
+        oracle.append(fr)
+    s2, _mon, fires = mega(s2, *_flat(batches), _wmv(batches))
+    _assert_states_bitexact(s1, s2)
+    total = 0
+    for i, fr in enumerate(oracle):
+        for name in _FIRE_FIELDS:
+            assert np.array_equal(
+                np.asarray(getattr(fr, name)),
+                np.asarray(getattr(fires, name))[:, i],
+            ), (name, i)
+        total += int(np.asarray(fr.counts).sum())
+    assert total > 0
+
+
+def test_fired_megastep_kg_dirty_and_kg_fill_equality(rng):
+    """The resident megastep's changelog bits and skew counts (the
+    4th shared-sort consumer) match the sequential oracle's: kg_dirty
+    rides the state compare; the summed kg_fill handle must equal the
+    per-batch kg_batch_fill sums."""
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    for precombine in (False, True):
+        spec = _spec("hash", precombine)
+        single = build_window_update_step(ctx, spec, kg_fill=True)
+        fire = build_window_fire_step(ctx, spec)
+        mega = build_window_megastep_fired(ctx, spec, K, kg_fill=True)
+        s1 = init_sharded_state(ctx, spec)
+        s2 = init_sharded_state(ctx, spec)
+        batches = _fire_crossing_batches(rng, "hash")
+        kgf_sum = None
+        for (hi, lo, ts, vals, valid, wm) in batches:
+            s1, (_o, _a, kgf) = single(s1, hi, lo, ts, vals, valid, wm)
+            kgf = np.asarray(kgf)
+            kgf_sum = kgf if kgf_sum is None else kgf_sum + kgf
+            s1, _ = fire(s1, wm)
+        s2, (_o, _a, kgf2), _fires = mega(s2, *_flat(batches),
+                                          _wmv(batches))
+        _assert_states_bitexact(s1, s2)   # includes kg_dirty
+        assert np.array_equal(kgf_sum, np.asarray(kgf2)), (
+            f"kg_fill diverged (precombine={precombine})"
+        )
+        assert int(np.asarray(s1.kg_dirty).sum()) > 0
+
+
+def test_update_kg_fill_precombine_equals_plain(rng):
+    """One-sort-feeds-four seam: the kg_fill counts computed from the
+    shared sort (precombine on: segment lane-counts at representatives
+    + residual late lanes) equal the plain bincount scatter — including
+    LATE lanes, which sit outside the sort's validity."""
+    import jax
+
+    win = wk.WindowSpec(10, 10, ring=8, fires_per_step=4)
+    red = wk.ReduceSpec("sum", jnp.float32)
+    results = {}
+    for pre in (False, True):
+        st = wk.init_state(256, 8, win, red, n_key_groups=64)
+        r = np.random.default_rng(23)
+        kgfs = []
+        for i in range(3):
+            hi, lo = _split(r.integers(0, 40, B).astype(np.int64))
+            # advance the watermark so later batches carry LATE lanes
+            st = __import__("dataclasses").replace(
+                st, watermark=jnp.asarray(np.int32(i * 15))
+            )
+            ts = r.integers(0, 60, B).astype(np.int32)
+            st, _act, kgf = wk.update(
+                st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+                jnp.asarray(ts), jnp.asarray(np.ones(B, np.float32)),
+                jnp.asarray(np.ones(B, bool)),
+                precombine=pre, kg_fill=64,
+            )
+            kgfs.append(np.asarray(kgf))
+            st, _ = wk.advance_and_fire(st, win, red, np.int32(i * 15))
+        results[pre] = np.stack(kgfs)
+        assert int(np.asarray(st.dropped_late)) > 0, \
+            "no late lanes — residual path untested"
+    assert np.array_equal(results[False], results[True])
+
+
 # ------------------------------------------------- fused executor loop
 
 N_KEYS = 200
@@ -340,6 +507,178 @@ def test_fused_checkpoint_cadence_exact(tmp_path):
     assert got == expected(total)
     assert m.checkpoint_stats, "no checkpoints were written"
     assert m.fused_dispatches > 0
+
+
+def gen_fast(offset, n):
+    """Event time advancing ~1 pane every 2.5 micro-batches (B=256), so
+    every K=4 fused group contains at least one pane-boundary crossing
+    — the resident pipeline's in-scan fire path, not the split drain,
+    carries the job."""
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 640) * 1000
+
+
+def expected_fast(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 640) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def test_fused_fire_executor_exact_with_in_group_crossings():
+    """End-to-end resident pipeline: pane boundaries land INSIDE fused
+    groups, fires surface from megastep payloads (lagged), results stay
+    exact, and the groups really stay fused across the crossings (the
+    split path would have broken every one)."""
+    total = 16384
+    env = build_env(2, **{"pipeline.steps-per-dispatch": K})
+    got = run_job(env, total, source=GeneratorSource(gen_fast, total=total))
+    assert got == expected_fast(total)
+    m = env.last_job.metrics
+    assert m.fused_fire_dispatches > 0
+    assert m.fused_dispatches == m.fused_fire_dispatches
+    assert m.fires == len(expected_fast(total))
+
+
+def test_fused_fire_off_is_split_path():
+    total = 8192
+    env = build_env(
+        2, **{"pipeline.steps-per-dispatch": K, "pipeline.fused-fire": "off"},
+    )
+    got = run_job(env, total, source=GeneratorSource(gen_fast, total=total))
+    assert got == expected_fast(total)
+    m = env.last_job.metrics
+    assert m.fused_fire_dispatches == 0
+
+
+def test_fused_fire_crash_restore_exactly_once_with_in_group_fire(tmp_path):
+    """Mid-stream crash while the resident pipeline is firing INSIDE
+    fused groups (incremental + async + prefetch + K>1): restore rewinds
+    to the megastep-boundary cut, unread in-flight fire payloads are
+    discarded and re-fired from the replayed state, and the window
+    counts come out exactly once."""
+    total = 16384
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
+           "checkpoint.async": True, "pipeline.steps-per-dispatch": K},
+    )
+    src = FailingSource(gen_fast, total, fail_at=total // 2)
+    got = run_job(env, total, source=src)
+    m = env.last_job.metrics
+    assert m.restarts == 1
+    assert m.fused_fire_dispatches > 0     # the scenario really fused-fired
+    assert got == expected_fast(total)     # no skips, no double counts
+
+
+def test_fired_megastep_reduced_parity_vs_oracle(rng):
+    """The ReducedFires resident variant (device_reduce topologies skip
+    the payload stacking) must match the sequential oracle's
+    reduce_fires lane-for-lane, and leave state bit-identical to the
+    compact variant."""
+    from flink_tpu.runtime.step import build_window_fire_step
+
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    spec = _spec("hash")
+    single = build_window_update_step(ctx, spec)
+    fire = build_window_fire_step(ctx, spec)
+    mega_r = build_window_megastep_fired(ctx, spec, K, reduced=True)
+    s1 = init_sharded_state(ctx, spec)
+    s2 = init_sharded_state(ctx, spec)
+    batches = _fire_crossing_batches(rng, "hash")
+    oracle = []
+    for (hi, lo, ts, vals, valid, wm) in batches:
+        s1, _ = single(s1, hi, lo, ts, vals, valid, wm)
+        # the split fire step's CompactFires carries the same small
+        # fields the reduced variant surfaces — compare those directly
+        s1, fr = fire(s1, wm)
+        oracle.append(fr)
+    s2, _mon, fires = mega_r(s2, *_flat(batches), _wmv(batches))
+    _assert_states_bitexact(s1, s2)
+    assert not hasattr(fires, "key_hi")        # really reduced
+    total = 0
+    for i, fr in enumerate(oracle):
+        for name in ("counts", "window_end_ticks", "n_fires",
+                     "lane_valid", "value_sums"):
+            assert np.array_equal(
+                np.asarray(getattr(fr, name)),
+                np.asarray(getattr(fires, name))[:, i],
+            ), (name, i)
+        total += int(np.asarray(fr.counts).sum())
+    assert total > 0
+
+
+def test_fused_fire_device_reduce_sink_exact():
+    """End-to-end resident pipeline with a device_reduce sink
+    (CountingSink): the executor auto-selects the ReducedFires fired
+    megasteps (no payload planes) and the on-chip-reduced counts/sums
+    come out exact."""
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    total = 16384
+    env = build_env(2, **{"pipeline.steps-per-dispatch": K})
+    sink = CountingSink()
+    (
+        env.add_source(GeneratorSource(gen_fast, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("megastep-reduced-job")
+    m = env.last_job.metrics
+    exp = expected_fast(total)
+    assert m.fused_fire_dispatches > 0
+    assert sink.count == len(exp)
+    assert abs(sink.value_sum - sum(exp.values())) < 1e-3
+
+
+def test_fused_fire_spill_tier_exact():
+    """Resident pipeline under STATE CAPACITY pressure: keys overflow the
+    table into the device ring -> host spill stores, and windows fire
+    INSIDE fused groups. The consumer must see the ring drained before
+    merging spill contributions into an emission (the post-scan ovf_n
+    handle rides the fire payload for exactly this), or fired values
+    silently lose their spilled shares."""
+    N = 1500                      # ~3x the 2x256-slot table capacity
+    total = 16384
+
+    def gen_spill(offset, n):
+        idx = np.arange(offset, offset + n)
+        return ({"key": (idx * 48271) % N,
+                 "value": np.ones(n, np.float32)}, (idx // 640) * 1000)
+
+    exp = {}
+    idx = np.arange(total)
+    for k, t in zip(((idx * 48271) % N).tolist(),
+                    ((idx // 640) * 1000).tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        exp[(k, we)] = exp.get((k, we), 0) + 1.0
+
+    env = build_env(2, **{"pipeline.steps-per-dispatch": K})
+    env.set_state_capacity(256)
+    got = run_job(env, total,
+                  source=GeneratorSource(gen_spill, total=total))
+    m = env.last_job.metrics
+    assert m.fused_fire_dispatches > 0
+    assert m.dropped_capacity == 0       # spill tier absorbed everything
+    assert got == exp
+
+
+def test_fused_fire_invalid_config_rejected():
+    env = build_env(2, **{"pipeline.steps-per-dispatch": K,
+                          "pipeline.fused-fire": "sometimes"})
+    with pytest.raises(ValueError, match="fused-fire"):
+        run_job(env, 1024)
 
 
 # ------------------------------------------------- accumulator contract

@@ -1,0 +1,411 @@
+"""Packed state planes (ISSUE 7): acc + touched in one wider array.
+
+The packed layout must be observationally identical to split planes —
+same logical accumulator bits, same touched set, same fires, same
+snapshot format (checkpoints move freely between layouts) — while the
+kernels issue one scatter/sweep where split issues two. CPU tier-1
+forces packing explicitly (the runtime's auto gate keeps CPU on split
+planes), so the layout is covered wherever it can run.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import hash64_host
+
+B = 256
+
+
+def _split_keys(keys):
+    h = hash64_host(np.asarray(keys, dtype=np.int64))
+    return ((h >> np.uint64(32)).astype(np.uint32),
+            (h & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _batches(rng, n=5):
+    out = []
+    for i in range(n):
+        hi, lo = _split_keys(rng.integers(0, 80, B).astype(np.int64))
+        ts = rng.integers(0, 50, B).astype(np.int32)
+        vals = rng.integers(1, 6, B).astype(np.float32)
+        out.append((hi, lo, ts, vals, np.int32(i * 13 - 4)))
+    return out
+
+
+def _run_seq(win, red, packed, batches, kind_vals=True):
+    st = wk.init_state(256, 8, win, red, n_key_groups=64, packed=packed)
+    for (hi, lo, ts, vals, wm) in batches:
+        st, _act, _kgf = wk.update(
+            st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+            jnp.asarray(ts), jnp.asarray(vals),
+            jnp.asarray(np.ones(B, bool)), kg_fill=64,
+        )
+        st, fr = wk.advance_and_fire(st, win, red, wm)
+    return st, fr
+
+
+@pytest.mark.parametrize("kind", ["sum", "count", "min", "max"])
+def test_packed_logical_parity_with_split(rng, kind):
+    """Same update/fire sequence on packed vs split planes: logical acc
+    view, touched view, fires, counters — all identical."""
+    win = wk.WindowSpec(10, 10, ring=8, fires_per_step=4)
+    red = wk.ReduceSpec(kind, jnp.float32)
+    batches = _batches(rng)
+    s_split, fr_split = _run_seq(win, red, False, batches)
+    s_pack, fr_pack = _run_seq(win, red, True, batches)
+
+    assert s_pack.packed == 0 and s_split.packed == -1
+    assert s_pack.touched.shape == (0,)
+    np.testing.assert_array_equal(
+        np.asarray(wk.acc_view(s_split, red)),
+        np.asarray(wk.acc_view(s_pack, red)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(wk.touched_view(s_split, red)),
+        np.asarray(wk.touched_view(s_pack, red)),
+    )
+    for name in ("mask", "values", "window_end_ticks", "n_fires",
+                 "lane_valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fr_split, name)),
+            np.asarray(getattr(fr_pack, name)), err_msg=name,
+        )
+    for name in ("pane_ids", "max_pane", "fired_through", "purged_through",
+                 "dropped_late", "dropped_capacity", "kg_dirty"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_split, name)),
+            np.asarray(getattr(s_pack, name)), err_msg=name,
+        )
+
+
+def test_packed_precombine_parity(rng):
+    """Packed + precombine: the rep scatter carries the touch column
+    through the shared sort; results equal the split/plain path."""
+    win = wk.WindowSpec(20, 10, ring=8, fires_per_step=4)
+    red = wk.ReduceSpec("sum", jnp.float32)
+    # duplicate-heavy: 90% of lanes on 8 hot keys
+    batches = []
+    for i in range(4):
+        keys = np.concatenate([
+            rng.integers(0, 8, (9 * B) // 10),
+            rng.integers(100, 200, B - (9 * B) // 10),
+        ]).astype(np.int64)
+        rng.shuffle(keys)
+        hi, lo = _split_keys(keys)
+        ts = np.full(B, i * 10 + 5, np.int32)
+        vals = rng.integers(1, 4, B).astype(np.float32)
+        batches.append((hi, lo, ts, vals, np.int32(i * 10 - 1)))
+
+    def run(packed, pre):
+        st = wk.init_state(256, 8, win, red, n_key_groups=64,
+                           packed=packed)
+        kgfs = []
+        for (hi, lo, ts, vals, wm) in batches:
+            st, _a, kgf = wk.update(
+                st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+                jnp.asarray(ts), jnp.asarray(vals),
+                jnp.asarray(np.ones(B, bool)), precombine=pre, kg_fill=64,
+            )
+            kgfs.append(np.asarray(kgf))
+            st, _ = wk.advance_and_fire(st, win, red, wm)
+        return st, np.stack(kgfs)
+
+    base, kgf0 = run(False, False)
+    for packed, pre in ((True, False), (True, True), (False, True)):
+        st, kgf = run(packed, pre)
+        np.testing.assert_array_equal(
+            np.asarray(wk.acc_view(base, red)),
+            np.asarray(wk.acc_view(st, red)),
+            err_msg=f"packed={packed} pre={pre}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(wk.touched_view(base, red)),
+            np.asarray(wk.touched_view(st, red)),
+        )
+        np.testing.assert_array_equal(np.asarray(base.kg_dirty),
+                                      np.asarray(st.kg_dirty))
+        np.testing.assert_array_equal(kgf0, kgf)
+
+
+def test_packed_snapshot_roundtrips_across_layouts(rng):
+    """Checkpoint format is LOGICAL: a snapshot of packed state restores
+    into a split stage (and back) with identical logical contents."""
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime import checkpoint as ckpt
+    from flink_tpu.runtime.step import (
+        WindowStageSpec, build_window_update_step, init_sharded_state,
+    )
+
+    ctx = MeshContext.create(n_shards=8, max_parallelism=128)
+    win = wk.WindowSpec(10, 10, ring=8, fires_per_step=4)
+    red = wk.ReduceSpec("sum", jnp.float32)
+    spec_p = WindowStageSpec(win=win, red=red, capacity_per_shard=256,
+                             probe_len=8, packed=True)
+    spec_s = dataclasses.replace(spec_p, packed=False)
+
+    step = build_window_update_step(ctx, spec_p)
+    state = init_sharded_state(ctx, spec_p)
+    hi, lo = _split_keys(rng.integers(0, 300, B).astype(np.int64))
+    ts = rng.integers(0, 30, B).astype(np.int32)
+    state, _ = step(state, hi, lo, ts, np.ones(B, np.float32),
+                    np.ones(B, bool), np.full(8, np.int32(-1)))
+
+    entries, scalars = ckpt.snapshot_window_state(state, win, red=red)
+    assert len(entries["key_hi"]) > 0
+    # packed -> split
+    restored_s = ckpt.restore_window_state(entries, scalars, ctx, spec_s)
+    # packed -> packed
+    restored_p = ckpt.restore_window_state(entries, scalars, ctx, spec_p)
+    assert restored_s.packed == -1 and restored_p.packed == 0
+
+    # both restores rebuild from the same logical entries, so their
+    # planes must agree position-for-position across the layouts
+    np.testing.assert_array_equal(np.asarray(restored_s.table.keys),
+                                  np.asarray(restored_p.table.keys))
+    np.testing.assert_array_equal(
+        np.asarray(wk.acc_view(restored_s, red)),
+        np.asarray(wk.acc_view(restored_p, red)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(wk.touched_view(restored_s, red)),
+        np.asarray(wk.touched_view(restored_p, red)),
+    )
+
+    # ...and re-snapshotting the PACKED restore reproduces the original
+    # logical entry set exactly (a restore reshuffles slots, so the
+    # entry multiset — not the plane layout — is the format contract)
+    def entry_set(e):
+        return {
+            (int(h), int(l), int(p), float(v))
+            for h, l, p, v in zip(e["key_hi"], e["key_lo"], e["pane"],
+                                  e["value"])
+        }
+
+    entries2, _ = ckpt.snapshot_window_state(restored_p, win, red=red)
+    assert entry_set(entries2) == entry_set(entries)
+
+    # staging packed state without the reduce spec must fail loudly
+    with pytest.raises(ValueError, match="ReduceSpec"):
+        ckpt.stage_window_state(state)
+
+
+def test_packed_compact_table_and_occupancy_parity(rng):
+    """compact_table remaps the packed plane in one pass; kg_occupancy
+    derives the touched view — both must match split planes."""
+    win = wk.WindowSpec(10, 10, ring=8, fires_per_step=4)
+    red = wk.ReduceSpec("sum", jnp.float32)
+    batches = _batches(rng, n=3)
+    s_split, _ = _run_seq(win, red, False, batches)
+    s_pack, _ = _run_seq(win, red, True, batches)
+    occ_s = np.asarray(wk.kg_occupancy(s_split, 64, red=red, win=win))
+    occ_p = np.asarray(wk.kg_occupancy(s_pack, 64, red=red, win=win))
+    np.testing.assert_array_equal(occ_s, occ_p)
+
+    c_split = wk.compact_table(s_split, win, red)
+    c_pack = wk.compact_table(s_pack, win, red)
+    # same live population lands in the (deterministically rebuilt) table
+    np.testing.assert_array_equal(
+        np.asarray(c_split.table.keys), np.asarray(c_pack.table.keys)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(wk.acc_view(c_split, red)),
+        np.asarray(wk.acc_view(c_pack, red)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(wk.touched_view(c_split, red)),
+        np.asarray(wk.touched_view(c_pack, red)),
+    )
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_slot_major_layout_parity(rng, packed):
+    """acc_layout="slot" (slot-major storage, the bench-swept variant)
+    must be observationally identical to the default pane-major order:
+    same logical views, same fires, same counters — only the memory walk
+    differs."""
+    win_p = wk.WindowSpec(20, 10, ring=8, fires_per_step=4)
+    win_s = dataclasses.replace(win_p, acc_layout="slot")
+    red = wk.ReduceSpec("sum", jnp.float32)
+    batches = _batches(rng, n=4)
+
+    def run(win):
+        st = wk.init_state(256, 8, win, red, n_key_groups=64,
+                           packed=packed)
+        for (hi, lo, ts, vals, wm) in batches:
+            st, _a, _k = wk.update(
+                st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+                jnp.asarray(ts), jnp.asarray(vals),
+                jnp.asarray(np.ones(B, bool)), kg_fill=64,
+            )
+            st, fr = wk.advance_and_fire(st, win, red, wm)
+        return st, fr
+
+    s_p, fr_p = run(win_p)
+    s_s, fr_s = run(win_s)
+    # fires are layout-independent (per-lane dense planes)
+    for name in ("mask", "values", "window_end_ticks", "n_fires",
+                 "lane_valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(fr_p, name)),
+            np.asarray(getattr(fr_s, name)), err_msg=name,
+        )
+    # logical plane content matches after undoing the storage order
+    C, R = 256, win_p.ring
+    a_p = np.asarray(wk.acc_view(s_p, red)).reshape(R, C)
+    a_s = np.asarray(wk.acc_view(s_s, red)).reshape(C, R).T
+    np.testing.assert_array_equal(a_p, a_s)
+    t_p = np.asarray(wk.touched_view(s_p, red)).reshape(R, C)
+    t_s = np.asarray(wk.touched_view(s_s, red)).reshape(C, R).T
+    np.testing.assert_array_equal(t_p, t_s)
+    for name in ("pane_ids", "fired_through", "purged_through",
+                 "dropped_late", "dropped_capacity", "kg_dirty"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_p, name)), np.asarray(getattr(s_s, name)),
+            err_msg=name,
+        )
+
+
+def test_packed_eligibility_gates():
+    win = wk.WindowSpec(10, 10, ring=8)
+    generic = wk.ReduceSpec("generic", jnp.float32,
+                            combine=lambda a, b: a + b, neutral=0.0)
+    assert not wk.packed_eligible(generic)
+    with pytest.raises(ValueError, match="packed"):
+        wk.init_state(64, 8, win, generic, packed=True)
+    # explicit user neutral could collide with the touch marker
+    assert not wk.packed_eligible(
+        wk.ReduceSpec("min", jnp.float32, neutral=0.0)
+    )
+    assert wk.packed_eligible(wk.ReduceSpec("min", jnp.float32))
+    assert wk.packed_eligible(wk.ReduceSpec("count", jnp.int32))
+
+
+# ------------------------------------------------------------- end to end
+
+N_KEYS = 150
+WINDOW = 10_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    return ({"key": (idx * 48271) % N_KEYS,
+             "value": np.ones(n, np.float32)}, (idx // 1000) * 1000)
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 1000) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def _env(tmp=None, interval=0, restart=None, **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(2).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = B
+    if tmp:
+        env.enable_checkpointing(interval, str(tmp))
+    return env
+
+
+def _run(env, total, source=None):
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    sink = CollectSink()
+    (
+        env.add_source(source or GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("packed-job")
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+def test_packed_job_end_to_end_exact():
+    total = 8192
+    env = _env(**{"state.packed-planes": "on"})
+    got = _run(env, total)
+    assert got == expected(total)
+    assert env.last_job.metrics.state_packed_planes is True
+
+
+def test_packed_job_with_fused_fire_and_crash_restore(tmp_path):
+    """The whole round in one scenario: packed planes + K-fused resident
+    pipeline + incremental async checkpoints + prefetch, with a
+    mid-stream crash — exactly-once across a packed-state restore."""
+    import threading
+
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    class FailingSource(GeneratorSource):
+        def __init__(self, fn, total, fail_at):
+            super().__init__(fn, total)
+            self.fail_at = fail_at
+            self.failed = False
+
+        def poll(self, max_records):
+            out = super().poll(max_records)
+            if not self.failed and self.offset >= self.fail_at:
+                self.failed = True
+                raise RuntimeError("injected failure")
+            return out
+
+    total = 12288
+    env = _env(
+        tmp_path / "chk", interval=2, restart=3,
+        **{"state.packed-planes": "on", "pipeline.steps-per-dispatch": 4,
+           "pipeline.prefetch": "on", "checkpoint.mode": "incremental",
+           "checkpoint.async": True},
+    )
+    got = _run(env, total, source=FailingSource(gen, total, total // 2))
+    m = env.last_job.metrics
+    assert m.restarts == 1
+    assert m.state_packed_planes is True
+    assert m.fused_fire_dispatches > 0
+    assert got == expected(total)
+
+
+def test_packed_on_rejected_for_ineligible_reduce():
+    env = _env(**{"state.packed-planes": "on"})
+    total = 1024
+
+    def gen2(offset, n):
+        idx = np.arange(offset, offset + n)
+        return ({"key": idx % 10, "value": np.ones(n, np.float32)},
+                (idx // 100) * 1000)
+
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen2, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .reduce(lambda a, b: a + b, extractor=lambda c: c["value"],
+                neutral=0.0)
+        .add_sink(sink)
+    )
+    with pytest.raises(ValueError, match="packed-planes"):
+        env.execute("packed-generic")

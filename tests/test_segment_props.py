@@ -1,0 +1,174 @@
+"""Property tests for the shared-sort seam (ISSUE 7 satellite).
+
+``segment_sort`` / ``reduce_sorted`` / ``segmented_reduce_sorted`` now
+feed FOUR consumers in the update kernel (the accumulator scatter, fire
+eligibility, kg_dirty, kg_fill — window_kernels.update), so their
+contract gets direct coverage against a NumPy oracle: dtypes (f32/i32),
+all-invalid batches, segments with no valid lanes, and single-segment
+batches — the shapes a streaming batch actually takes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.ops.segment import (
+    argsort_ids,
+    invert_permutation,
+    reduce_sorted,
+    segment_sort,
+    segmented_reduce_sorted,
+    sort_values,
+)
+
+BIG = 2**31 - 1
+
+
+def _oracle(ids, vals, valid, combine, neutral):
+    """Per-segment reduction the slow way."""
+    out = {}
+    for i, v, ok in zip(ids.tolist(), vals.tolist(), valid.tolist()):
+        if not ok:
+            continue
+        out[i] = combine(out[i], v) if i in out else v
+    return out
+
+
+def _reduced_by_segment(ids, valid, values, combine, neutral):
+    """Run the shared sort + segmented reduce; return {seg_id: value}
+    from the representative lanes."""
+    order, ids_s, valid_s, seg_start, rep_mask = segment_sort(
+        jnp.asarray(ids), jnp.asarray(valid)
+    )
+    red = reduce_sorted(order, valid_s, seg_start, jnp.asarray(values),
+                        combine, neutral)
+    ids_s, rep_mask, red = map(np.asarray, (ids_s, rep_mask, red))
+    return {
+        int(i): r for i, r in zip(ids_s[rep_mask], red[rep_mask])
+    }
+
+
+CASES = [
+    # (dtype, combine, neutral, value sampler)
+    (np.float32, lambda a, b: a + b, np.float32(0),
+     lambda rng, n: rng.normal(size=n).astype(np.float32)),
+    (np.int32, lambda a, b: a + b, np.int32(0),
+     lambda rng, n: rng.integers(-50, 50, n).astype(np.int32)),
+    (np.float32, np.minimum, np.float32(np.finfo(np.float32).max),
+     lambda rng, n: rng.normal(size=n).astype(np.float32)),
+    (np.int32, np.maximum, np.int32(np.iinfo(np.int32).min),
+     lambda rng, n: rng.integers(-1000, 1000, n).astype(np.int32)),
+]
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_segment_sort_reduce_matches_numpy(rng, case):
+    dtype, combine, neutral, sample = CASES[case]
+    B = 384
+    ids = rng.integers(0, 37, B).astype(np.int32)
+    vals = sample(rng, B)
+    valid = rng.random(B) < 0.8
+
+    got = _reduced_by_segment(ids, valid, vals, combine, neutral)
+    expect = _oracle(ids, vals, valid, combine, neutral)
+    assert set(got) == set(int(k) for k in expect)
+    for k, v in expect.items():
+        if dtype == np.float32:
+            assert abs(got[int(k)] - float(v)) < 1e-3 * max(1, abs(v))
+        else:
+            assert got[int(k)] == v   # integer adds/extremes are exact
+
+
+def test_segment_sort_invariants(rng):
+    """order is a permutation; invalid lanes sort to the end with the
+    INT32_MAX sentinel; exactly one representative per valid segment."""
+    B = 256
+    ids = rng.integers(0, 20, B).astype(np.int32)
+    valid = rng.random(B) < 0.7
+    order, ids_s, valid_s, seg_start, rep_mask = map(
+        np.asarray,
+        segment_sort(jnp.asarray(ids), jnp.asarray(valid)),
+    )
+    assert sorted(order.tolist()) == list(range(B))
+    assert (np.diff(ids_s) >= 0).all()           # sorted ascending
+    n_valid = int(valid.sum())
+    assert (ids_s[:n_valid] != BIG).all() or n_valid == 0
+    assert (ids_s[n_valid:] == BIG).all()
+    assert not rep_mask[ids_s == BIG].any()      # sentinels never represent
+    assert int(rep_mask.sum()) == len(set(ids[valid].tolist()))
+
+
+def test_all_invalid_batch_has_no_representatives(rng):
+    B = 64
+    ids = rng.integers(0, 8, B).astype(np.int32)
+    valid = np.zeros(B, bool)
+    _o, ids_s, valid_s, _s, rep_mask = map(
+        np.asarray, segment_sort(jnp.asarray(ids), jnp.asarray(valid))
+    )
+    assert (ids_s == BIG).all()
+    assert not valid_s.any()
+    assert not rep_mask.any()
+    got = _reduced_by_segment(ids, valid, np.ones(B, np.float32),
+                              lambda a, b: a + b, np.float32(0))
+    assert got == {}
+
+
+def test_single_segment_batch_reduces_to_one_rep(rng):
+    B = 128
+    ids = np.full(B, 7, np.int32)
+    vals = rng.integers(1, 5, B).astype(np.int32)
+    valid = np.ones(B, bool)
+    got = _reduced_by_segment(ids, valid, vals, lambda a, b: a + b,
+                              np.int32(0))
+    assert got == {7: int(vals.sum())}
+
+
+def test_segment_with_no_valid_lanes_is_absent(rng):
+    """A segment id present only on invalid lanes must not produce a
+    representative (its neutral-substituted lanes sort to the end)."""
+    ids = np.array([1, 1, 2, 2, 3], np.int32)
+    valid = np.array([True, True, False, False, True])
+    vals = np.array([10, 20, 99, 99, 5], np.float32)
+    got = _reduced_by_segment(ids, valid, vals, lambda a, b: a + b,
+                              np.float32(0))
+    assert got == {1: 30.0, 3: 5.0}
+
+
+def test_segmented_reduce_sorted_prefix_semantics():
+    """The last lane of each run holds the full reduction; earlier lanes
+    hold prefixes (the flagged-scan contract reduce_sorted builds on)."""
+    vals = jnp.asarray(np.array([1, 2, 3, 10, 20], np.float32))
+    seg_start = jnp.asarray(np.array([True, False, False, True, False]))
+    out = np.asarray(
+        segmented_reduce_sorted(vals, seg_start, lambda a, b: a + b)
+    )
+    assert out.tolist() == [1.0, 3.0, 6.0, 10.0, 30.0]
+
+
+def test_reduce_sorted_int32_counts_are_exact(rng):
+    """The kg_fill consumer reduces int32 ones — per-segment lane counts
+    must be exact for any batch."""
+    B = 300
+    ids = rng.integers(0, 11, B).astype(np.int32)
+    valid = rng.random(B) < 0.6
+    got = _reduced_by_segment(ids, valid, np.ones(B, np.int32),
+                              lambda a, b: a + b, np.int32(0))
+    expect = {}
+    for i, ok in zip(ids.tolist(), valid.tolist()):
+        if ok:
+            expect[i] = expect.get(i, 0) + 1
+    assert got == expect
+
+
+def test_sort_wrappers(rng):
+    """The segment.py sort wrappers every other ops/ kernel must use
+    (tools/check_segment_sort_seam.py)."""
+    x = rng.integers(0, 100, 64).astype(np.int32)
+    assert np.asarray(sort_values(jnp.asarray(x))).tolist() == \
+        sorted(x.tolist())
+    order = np.asarray(argsort_ids(jnp.asarray(x)))
+    assert (x[order] == np.sort(x)).all()
+    inv = np.asarray(invert_permutation(jnp.asarray(order)))
+    assert (inv[order] == np.arange(64)).all()
+    assert (np.asarray(argsort_ids(jnp.asarray(x), stable=True))
+            == np.argsort(x, kind="stable")).all()

@@ -1,0 +1,97 @@
+"""tools/check_segment_sort_seam.py wired as a tier-1 test (ISSUE 7
+satellite): a ``jnp.sort``/``argsort``/``lax.sort`` call site added to
+``flink_tpu/ops`` outside ``segment.py`` fails the suite — the one-sort
+pre-combine seam (segment_sort feeding the acc scatter, fire
+eligibility, kg_dirty, and kg_fill) must stay auditable in one file."""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+from check_segment_sort_seam import (  # noqa: E402
+    check_source,
+    check_tree,
+    main,
+    ops_files,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_repo_ops_tree_is_clean():
+    violations = check_tree(ROOT)
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_checker_scans_the_real_ops_tree():
+    rels = {rel.replace(os.sep, "/") for _p, rel in ops_files(ROOT)}
+    assert "flink_tpu/ops/window_kernels.py" in rels
+    assert "flink_tpu/ops/segment.py" in rels
+    assert "flink_tpu/ops/rolling.py" in rels
+    assert len(rels) > 5
+
+
+def test_checker_flags_every_sort_spelling():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def kernel(x, k, v):\n"
+        "    a = jnp.sort(x)\n"
+        "    b = jnp.argsort(x)\n"
+        "    c = jax.lax.sort(x)\n"
+        "    d = lax.sort_key_val(k, v)\n"
+        "    e = jnp.lexsort((x,))\n"
+        "    return a, b, c, d, e\n"
+    )
+    vs = check_source(src, "flink_tpu/ops/fake.py")
+    assert [v.line for v in vs] == [5, 6, 7, 8, 9]
+    assert {v.what for v in vs} == {
+        "jnp.sort", "jnp.argsort", "jax.lax.sort",
+        "lax.sort_key_val", "jnp.lexsort",
+    }
+
+
+def test_checker_allows_segment_py_itself():
+    src = "import jax.numpy as jnp\ndef s(x):\n    return jnp.argsort(x)\n"
+    assert check_source(src, "flink_tpu/ops/segment.py") == []
+    # ...but the same code anywhere else in ops/ is a violation
+    assert len(check_source(src, "flink_tpu/ops/other.py")) == 1
+
+
+def test_checker_ignores_non_sort_calls_and_prose():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def kernel(x, xs):\n"
+        "    '''prose about jnp.sort( and argsort'''\n"
+        "    # jnp.argsort(x) in a comment\n"
+        "    xs.sort()\n"            # list.sort: bare receiver, not a module
+        "    return jnp.where(x > 0, x, 0)\n"
+    )
+    assert check_source(src, "flink_tpu/ops/fake.py") == []
+
+
+def test_reintroduced_per_plane_sort_is_caught():
+    """The regression this tool exists for: someone re-deriving a
+    per-plane order inside window_kernels instead of reusing the shared
+    segment_sort permutation."""
+    path = os.path.join(ROOT, "flink_tpu", "ops", "window_kernels.py")
+    with open(path) as f:
+        src = f.read()
+    assert check_source(src, "flink_tpu/ops/window_kernels.py") == []
+    patched = src + "\n\ndef rogue(x):\n    import jax.numpy as jnp\n" \
+        "    return jnp.argsort(x)\n"
+    vs = check_source(patched, "flink_tpu/ops/window_kernels.py")
+    assert len(vs) == 1 and vs[0].func == "rogue"
+
+
+def test_cli_entrypoint():
+    assert main(["--root", ROOT]) == 0
+    rc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "check_segment_sort_seam.py")],
+        capture_output=True,
+    )
+    assert rc.returncode == 0, rc.stderr.decode()

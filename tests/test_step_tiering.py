@@ -38,15 +38,15 @@ def test_fast_path_matches_insert_path():
     v1 = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
 
     st_a = wk.init_state(64, 8, win, red)
-    st_a, act0 = wk.update(st_a, win, red, *_mk(keys, ts, v1))
+    st_a, act0, _ = wk.update(st_a, win, red, *_mk(keys, ts, v1))
     assert int(act0) == 6          # every lane's key was new pre-batch
     st_b = wk.init_state(64, 8, win, red)
-    st_b, _ = wk.update(st_b, win, red, *_mk(keys, ts, v1))
+    st_b, _, _ = wk.update(st_b, win, red, *_mk(keys, ts, v1))
 
     # second batch, all-resident keys: fast path == insert path, activity 0
     v2 = [10.0, 20.0, 30.0, 40.0, 50.0, 60.0]
-    st_a, act_a = wk.update(st_a, win, red, *_mk(keys, ts, v2), insert=True)
-    st_b, act_b = wk.update(st_b, win, red, *_mk(keys, ts, v2), insert=False)
+    st_a, act_a, _ = wk.update(st_a, win, red, *_mk(keys, ts, v2), insert=True)
+    st_b, act_b, _ = wk.update(st_b, win, red, *_mk(keys, ts, v2), insert=False)
     assert int(act_a) == 0 and int(act_b) == 0
     np.testing.assert_array_equal(np.asarray(st_a.acc), np.asarray(st_b.acc))
     np.testing.assert_array_equal(
@@ -67,10 +67,10 @@ def test_fast_path_misses_take_overflow_ring():
                         fires_per_step=2, overflow=16)
     red = wk.ReduceSpec(kind="sum")
     st = wk.init_state(64, 8, win, red)
-    st, _ = wk.update(st, win, red, *_mk([1, 2], [0, 1], [1.0, 2.0]))
+    st, _, _ = wk.update(st, win, red, *_mk([1, 2], [0, 1], [1.0, 2.0]))
 
     # keys 3, 4 are absent: fast path must not insert them
-    st, act = wk.update(
+    st, act, _ = wk.update(
         st, win, red, *_mk([1, 3, 4, 3], [2, 3, 4, 5], [10.0, 5.0, 7.0, 6.0]),
         insert=False,
     )

@@ -94,7 +94,7 @@ def run_device(events, batches, size, slide, ring=16, fires_per_step=4,
             for key, h, l in zip(keys, hi, lo):
                 keymap[(int(h) << 32) | int(l)] = key
             valid = np.ones(len(batch), bool)
-            st, _ = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+            st, _, _ = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
                               jnp.asarray(ts), jnp.asarray(vals),
                               jnp.asarray(valid))
         while True:
@@ -200,7 +200,7 @@ def test_generic_combine_max():
     ts = np.asarray([0, 3, 5, 7, 9], np.int32)
     vals = np.asarray([5.0, 2.0, 9.0, 1.0, 4.0], np.float32)
     hi, lo = _split(keys)
-    st, _ = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
+    st, _, _ = wk.update(st, win, red, jnp.asarray(hi), jnp.asarray(lo),
                       jnp.asarray(ts), jnp.asarray(vals),
                       jnp.ones(5, dtype=bool))
     st, fr = wk.advance_and_fire(st, win, red, jnp.int32(9))
