@@ -825,6 +825,225 @@ def run_mttr_recovery(total_events: int, cpu: bool):
             detail["warm"]["detect_to_first_fire_ms"])
 
 
+# ------------------------------------------------------ elasticity drill
+def run_elastic_recovery(total_events: int, cpu: bool):
+    """Elasticity drill (ISSUE 8, ``bench.py --elastic``): kill one
+    shard of an 8-device mesh mid-stream and measure the lose-one ->
+    degraded run -> scale-back cycle end to end.
+
+    Phases (one job, one stream):
+
+      pre       8-shard steady state (throughput sampled)
+      kill      the ``device_loss`` fault class fires at a step
+                dispatch — shard 5's device is declared dead
+      degraded  elastic recovery re-sliced the key-group ranges over
+                the 7 survivors, rebuilt the compiled step family, and
+                rescaled-restored the last durable cut (preferring the
+                PR 6 task-local cache); the job keeps serving
+      scale-back once degraded throughput is established, the drill
+                requests scale-up and the job performs a savepoint-cut
+                live rescale back to 8 shards
+
+    Stamps: degraded-throughput fraction (criterion >= 0.6 x 7/8 =
+    0.525 of pre-fault), the rescaled-recovery detect-to-first-fire
+    alongside PR 6's MTTR tiers, and the exactly-once oracle — the
+    emission set across the whole cycle equals the unfaulted analytic
+    oracle. Returns (degraded_fraction, rescale_first_fire_ms)."""
+    import tempfile
+
+    import jax
+
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CollectSink
+    from flink_tpu.runtime.sources import GeneratorSource
+    from flink_tpu.testing import faults
+    from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+    N_DEV = 8
+    if len(jax.devices()) < N_DEV:
+        raise RuntimeError(
+            f"elastic_recovery needs an {N_DEV}-device mesh; found "
+            f"{len(jax.devices())} (bench.py --elastic forces the "
+            f"virtual CPU mesh via XLA_FLAGS before JAX initializes)"
+        )
+    n_keys = 1 << 14
+    B = 16384
+    WINDOW = 10_000
+    events = min(total_events, 2_000_000)
+    KILL_SHARD, KILL_AT = 5, 30
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            "key": (idx * 48271) % n_keys,
+            "value": np.ones(n, np.float32),
+        }
+        return cols, (idx // 8192) * 1000
+
+    def expected(total):
+        idx = np.arange(total)
+        keys = (idx * 48271) % n_keys
+        we = ((idx // 8192) * 1000 // WINDOW + 1) * WINDOW
+        pair = keys.astype(np.int64) * (1 << 34) + we
+        uniq, counts = np.unique(pair, return_counts=True)
+        return {
+            (int(p >> 34), int(p & ((1 << 34) - 1))): float(c)
+            for p, c in zip(uniq.tolist(), counts.tolist())
+        }
+
+    ckpt_dir = tempfile.mkdtemp(prefix="elastic-")
+    cfg = Configuration({
+        "checkpoint.mode": "incremental",
+        "checkpoint.async": True,
+        "checkpoint.local.enabled": True,
+        "pipeline.prefetch": "on",
+        "keys.reverse-map": False,
+        "restart-strategy": "exponential-backoff",
+        "restart-strategy.exponential-backoff.initial-delay": 0.01,
+        "restart-strategy.exponential-backoff.max-delay": 0.05,
+    })
+    env = StreamExecutionEnvironment(cfg)
+    env.set_parallelism(N_DEV)
+    env.set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    # capacity == keyspace: the direct-index layout (key == slot), the
+    # bench.py configuration — no insert phase, no adaptive tier flip
+    # to pollute the phase throughput windows
+    env.set_state_capacity(n_keys)
+    env.batch_size = B
+    env.enable_checkpointing(2, ckpt_dir)
+
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=events))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+
+    marks = {"t_kill": None, "t_deg0": None, "t_scale_req": None}
+    samples = []                  # (t_perf, records_in)
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            m = getattr(env, "_live_metrics", None)
+            if m is not None:
+                samples.append((time.perf_counter(), m.records_in))
+            time.sleep(0.025)
+
+    def scale_up_trigger():
+        """Request scale-back once degraded throughput is established:
+        the measurement window opens only after real post-replan
+        progress (past the re-plan's compile burst + replay catch-up),
+        so the degraded slope measures steady degraded serving."""
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline and not stop.is_set():
+            ctl = getattr(env, "_elastic_controller", None)
+            m = getattr(env, "_live_metrics", None)
+            if ctl is not None and ctl.degraded and m is not None:
+                r0 = m.records_in
+                while time.monotonic() < deadline and not stop.is_set():
+                    if marks["t_deg0"] is None and \
+                            m.records_in >= r0 + 4 * B:
+                        marks["t_deg0"] = time.perf_counter()
+                        r0 = m.records_in
+                    if marks["t_deg0"] is not None and \
+                            m.records_in >= r0 + 16 * B and \
+                            time.perf_counter() - marks["t_deg0"] >= 1.0:
+                        marks["t_scale_req"] = time.perf_counter()
+                        ctl.request_scale_up()
+                        return
+                    time.sleep(0.025)
+                return
+            time.sleep(0.025)
+
+    rules = [
+        FaultRule("step.dispatch", action="call",
+                  fn=lambda _ctx: marks.__setitem__(
+                      "t_kill", time.perf_counter()),
+                  at=KILL_AT),
+        faults.device_loss_rule(shard=KILL_SHARD, at=KILL_AT),
+    ]
+    threads = [threading.Thread(target=sampler, daemon=True),
+               threading.Thread(target=scale_up_trigger, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        with faults.active(FaultInjector(rules)):
+            env.execute("elastic-drill")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    got = {(r.key, r.window_end_ms): r.value for r in sink.results}
+    exp = expected(events)
+    missing = sum(1 for k, v in exp.items() if got.get(k) != v)
+    extra = sum(1 for k in got if k not in exp)
+    oracle_ok = not missing and not extra
+
+    def slope_eps(t_start, t_end):
+        """records/s over the sample window [t_start, t_end)."""
+        if t_start is None or t_end is None:
+            return None
+        win = [(t, r) for t, r in samples if t_start <= t < t_end]
+        if len(win) < 4 or win[-1][0] - win[0][0] < 0.2:
+            return None
+        return (win[-1][1] - win[0][1]) / (win[-1][0] - win[0][0])
+
+    # pre window: the last 3s of the 8-shard steady state, clamped to
+    # after the first real progress (the initial compile burst is flat)
+    t_first = next((t for t, r in samples if r >= 2 * B), None)
+    pre_eps = (
+        slope_eps(max(t_first, marks["t_kill"] - 3.0), marks["t_kill"])
+        if t_first is not None and marks["t_kill"] is not None else None
+    )
+    degraded_eps = slope_eps(marks["t_deg0"], marks["t_scale_req"])
+    frac = (
+        degraded_eps / pre_eps if pre_eps and degraded_eps else 0.0
+    )
+
+    rep = env._recovery_report()
+    rescaled = [a for a in rep["attempts"]
+                if (a["mode"] or "").startswith("rescale")]
+    first_fire_ms = (
+        rescaled[-1]["first_fire_ms"] if rescaled
+        and rescaled[-1]["first_fire_ms"] else 0.0
+    )
+    el = env._elasticity_report()
+    detail = {
+        "events": events,
+        "devices": N_DEV,
+        "killed_shard": KILL_SHARD,
+        "pre_fault_eps": round(pre_eps) if pre_eps else None,
+        "degraded_eps": round(degraded_eps) if degraded_eps else None,
+        "degraded_fraction": round(frac, 3),
+        "criterion": ">= 0.6 * (7/8) = 0.525",
+        "rescale_detect_to_first_fire_ms": first_fire_ms,
+        "rescale_phases_ms": (
+            rescaled[-1]["phases_ms"] if rescaled else None
+        ),
+        "exactly_once": bool(oracle_ok),
+        # diagnosable on failure: which side diverged and by how much
+        "oracle_missing_or_wrong": int(missing),
+        "oracle_extra": int(extra),
+        "finished_at_shards": el["current-shards"],
+        "rescales": el["rescales"],
+        "local_cache": rep["local-cache"],
+    }
+    print(json.dumps(
+        {"config": "elastic_recovery", "detail": detail}), flush=True)
+    assert oracle_ok, (
+        "exactly-once oracle FAILED across kill -> degraded -> "
+        "scale-back"
+    )
+    return frac, first_fire_ms
+
+
 # ------------------------------------------------ device update ceiling
 DEVICE_CEILING_BATCH = 512   # bench.py --device-ceiling reports this
 
@@ -1209,6 +1428,7 @@ CONFIGS = {
     "fault_overhead": (run_fault_overhead, 4_000_000),
     "device_update_ceiling": (run_device_update_ceiling, 2_000_000),
     "mttr_recovery": (run_mttr_recovery, 2_000_000),
+    "elastic_recovery": (run_elastic_recovery, 2_000_000),
 }
 
 

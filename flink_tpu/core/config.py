@@ -201,6 +201,23 @@ class CoreOptions:
         "key groups dirty since the restored cut are re-staged when "
         "the cut's fire horizon still matches; off = every restart "
         "takes the full restore path")
+    # -- elastic recovery (runtime/elastic.py; docs/fault-tolerance.md) -
+    RECOVERY_ELASTIC = ConfigOption(
+        "recovery.elastic", True,
+        "re-plan the job at reduced parallelism when a mesh shard's "
+        "device is lost (DeviceLostError / detected device loss): "
+        "re-slice key-group ranges over the survivors, rebuild the "
+        "compiled step family, rescaled-restore the last durable cut, "
+        "and resume exactly-once in degraded mode; off = device loss "
+        "takes the ordinary full-restore path at the original "
+        "parallelism (which on real hardware fails until the device "
+        "returns)")
+    RECOVERY_MIN_SHARDS = ConfigOption(
+        "recovery.min-shards", 1,
+        "fewest surviving shards the elastic re-plan may degrade to; "
+        "losing capacity below this floor FAILS the job instead of "
+        "re-planning (capacity-critical jobs set it near the planned "
+        "parallelism)")
     # -- pipelined ingest (runtime/ingest.py; docs/performance.md) ------
     # prep-half prefetch thread: poll + encode of batch k+1 overlaps the
     # device step of batch k. Checkpoint-compatible since the epoch-

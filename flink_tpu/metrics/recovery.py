@@ -20,6 +20,10 @@ records one row:
     compile     XLA compile wall-time between recovery entry and the
                 first post-restore fire (0 on the warm path — reusing
                 the live jitted kernels is the point)
+    reslice     elastic re-plan only: survivor planning + mesh/compiled-
+                step-family rebuild at the reduced shard count
+    rescale_restore  elastic re-plan only: the full rescaled restore
+                (re-bucketing the cut over the re-sliced ranges)
     first_fire  recovery entry -> first post-restore window emission,
                 the end-to-end MTTR number
 
@@ -53,6 +57,11 @@ class RecoveryTracker:
         self.total_attempts = 0
         self.total_warm = 0
         self.total_full = 0
+        # elastic re-plans (runtime/elastic.py): completed rescales —
+        # degrade AND scale-back — plus the live degraded-shard count
+        # (full capacity minus current parallelism; 0 = not degraded)
+        self.total_rescales = 0
+        self.degraded_shards = 0
         self.local_cache: Any = None    # LocalSnapshotCache, set by owner
         self._open: Optional[dict] = None
         self._t0: float = 0.0
@@ -60,7 +69,8 @@ class RecoveryTracker:
         self._g = {}
         if group is not None:
             for name in ("recovery_attempts", "recovery_warm_restarts",
-                         "recovery_full_restores"):
+                         "recovery_full_restores", "recovery_rescales",
+                         "degraded_shards"):
                 self._g[name] = group.settable_gauge(name, 0)
             for name in ("recovery_last_total_ms",
                          "recovery_last_first_fire_ms"):
@@ -162,6 +172,23 @@ class RecoveryTracker:
         self._set("recovery_warm_restarts", self.total_warm)
         self._set("recovery_full_restores", self.total_full)
 
+    def note_rescale(self, from_shards: int, to_shards: int,
+                     degraded_shards: int):
+        """One completed elastic re-plan (degrade or scale-back): bump
+        the rescale total, publish the live degraded-shard count, and
+        stamp the transition onto the open attempt's row (a scale-back
+        runs outside any attempt — gauges still move)."""
+        with self._lock:
+            self.total_rescales += 1
+            self.degraded_shards = max(0, int(degraded_shards))
+            if self._open is not None:
+                self._open["rescale"] = {
+                    "from_shards": int(from_shards),
+                    "to_shards": int(to_shards),
+                }
+        self._set("recovery_rescales", self.total_rescales)
+        self._set("degraded_shards", self.degraded_shards)
+
     def note_fire(self):
         """Called by the fire drain on every emission: the FIRST one
         after a restore stamps detect-to-first-fire and the compile
@@ -200,6 +227,8 @@ class RecoveryTracker:
                 "total": self.total_attempts,
                 "warm": self.total_warm,
                 "full": self.total_full,
+                "rescales": self.total_rescales,
+                "degraded_shards": self.degraded_shards,
             },
             "local-cache": (
                 self.local_cache.state()

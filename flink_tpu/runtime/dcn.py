@@ -55,6 +55,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from flink_tpu.runtime import elastic
 from flink_tpu.testing import faults
 
 MAX_TICKS = 2**31 - 4
@@ -73,9 +74,24 @@ class DCNPeerStalledError(DCNPeerError):
     steady-state hole where one stalled host wedged every reader."""
 
 
-class DCNPeerLostError(DCNPeerError):
+class DCNPeerLostError(DCNPeerError, elastic.DeviceLostError):
     """A peer connection reset and bounded reconnect-with-backoff could
-    not re-establish the ring — the peer is declared dead."""
+    not re-establish the ring — the peer is declared dead.
+
+    Also a :class:`~flink_tpu.runtime.elastic.DeviceLostError`: the
+    dead peer's mesh segment (its device) is gone with it, so the
+    failure classifies as DEVICE LOSS at the restart boundary. The DCN
+    lockstep plane itself cannot re-plan in place (every process bakes
+    the global mesh into its collectives), so recovery there is the
+    ordinary job-level restart at full parallelism — but the
+    classification, metrics, and any supervising controller see the
+    loss for what it is."""
+
+    def __init__(self, message: str, lost_shards=(), lost_devices=()):
+        elastic.DeviceLostError.__init__(
+            self, message, lost_shards=lost_shards,
+            lost_devices=lost_devices,
+        )
 
 
 @dataclass

@@ -1,0 +1,269 @@
+"""Elastic survival: device/shard-loss classification and survivor
+planning (ISSUE 8; the availability half of the multi-chip story).
+
+At fleet scale a chip dying is background noise, and the job's duty is
+to keep serving from the survivors rather than crash-loop at a
+parallelism its mesh no longer has (the Hazelcast Jet argument:
+availability at the tail is the product). The key-group scheme was
+designed for exactly this — rescale re-slices contiguous key-group
+ranges, never re-hashes keys (core/keygroups.py), and the logical
+snapshot format restores at ANY parallelism (runtime/checkpoint.py) —
+so shard loss is a *re-plan*, not a death:
+
+    classify the failure as device loss  (this module)
+      -> re-slice key-group ranges over the M surviving shards
+      -> rebuild MeshContext + the jitted step family at n_shards=M
+      -> rescaled restore from the last durable cut
+      -> resume exactly-once in DEGRADED mode
+
+and the reverse edge — a triggered scale-back-up once replacement
+capacity exists — bounds the degradation. The executor owns the
+re-plan (runtime/executor.py `_recover`); this module owns what can be
+decided *without* the executor's closures: what counts as device loss,
+which devices survive, and the thread-safe degraded-state ledger the
+web route and the elasticity drill read.
+
+Failure classification (docs/fault-tolerance.md):
+
+* :class:`DeviceLostError` — raised directly (the ``device_loss``
+  fault class in testing/faults.py injects it at the ``step.dispatch``
+  point), or detected by :func:`as_device_loss` from the runtime
+  errors a dying chip actually produces: an ``XlaRuntimeError`` whose
+  message carries a device-loss marker, a watchdog trip in a
+  device-wait phase whose health probe finds a dead device, or DCN
+  peer loss after reconnect exhaustion (that host's mesh segment is
+  gone — runtime/dcn.py raises a :class:`DeviceLostError` subclass).
+* Device loss is NEVER "transient" (no warm restart: the live state
+  straddles a dead device) and never "state-corrupting" in the usual
+  sense (the checkpoint is fine; the *mesh* is wrong) — it is its own
+  recovery kind.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class DeviceLostError(RuntimeError):
+    """A mesh shard's device is gone (chip failure, host segment loss).
+
+    ``lost_shards``: indices into the CURRENT mesh's shard axis;
+    ``lost_devices``: jax device objects, for callers that identify the
+    casualty directly (the health probe). Either may be empty — a loss
+    without an attributable shard still classifies as device loss, and
+    recovery falls back to a full restore at the current parallelism.
+    """
+
+    def __init__(self, message: str, lost_shards: Sequence[int] = (),
+                 lost_devices: Sequence = ()):
+        super().__init__(message)
+        self.lost_shards = tuple(int(s) for s in lost_shards)
+        self.lost_devices = tuple(lost_devices)
+
+
+class ElasticCapacityError(RuntimeError):
+    """Survivors fell below ``recovery.min-shards``: degraded operation
+    is no longer acceptable, so the job FAILS instead of re-planning.
+    Deliberately not retried by the recovery loop — retrying cannot
+    grow the surviving device set."""
+
+
+# lowercase substrings of the runtime errors a lost accelerator
+# actually surfaces (XLA/PJRT wording varies by backend + version, so
+# this is a marker list, not a parse; the health probe is the
+# confirming signal where one can run)
+DEVICE_LOSS_MARKERS = (
+    "device_lost",
+    "device lost",
+    "device is lost",
+    "device failure",
+    "device unavailable",
+    "device or resource busy",
+    "chip is unhealthy",
+    "failed to enqueue",
+    "halted",
+)
+
+# watchdog phases that wait ON the device: a deadline trip there with a
+# failing health probe is a dead chip, not a slow one. (These are the
+# armed phase names from runtime/executor.py — the dispatch itself is
+# not watchdog-armed; a chip dying mid-dispatch surfaces as a runtime
+# error out of the dispatch call, the marker path above.)
+_DEVICE_WAIT_PHASES = ("fire", "barrier_fetch", "restore")
+
+
+def probe_devices(devices) -> List:
+    """Health-probe each device with a trivial round-trip computation;
+    returns the sublist that FAILED (dead/unreachable devices). Runs
+    only on the recovery path — steady state never calls it."""
+    dead = []
+    for d in devices:
+        try:
+            x = jax.device_put(np.zeros((), np.int32), d)
+            jax.block_until_ready(x + 1)  # host-sync-ok: recovery-path device health probe, never on the step loop
+        except Exception:
+            dead.append(d)
+    return dead
+
+
+def as_device_loss(exc: BaseException,
+                   devices=None) -> Optional[DeviceLostError]:
+    """Classify ``exc`` as device loss, or return None.
+
+    The three production surfaces, in order of confidence:
+
+    1. A :class:`DeviceLostError` (or subclass — DCN peer loss after
+       reconnect exhaustion) passes through as-is.
+    2. An XLA/PJRT runtime error whose message matches a device-loss
+       marker; the health probe over ``devices`` attributes the
+       casualty (an unattributable marker match still classifies, and
+       recovery falls back to a same-parallelism full restore).
+    3. A watchdog trip in a device-wait phase whose health probe finds
+       a dead device — a hang and a death look identical from the host
+       until the probe separates them, so the probe is REQUIRED here
+       (a trip with every device healthy stays a plain watchdog trip).
+    """
+    if isinstance(exc, DeviceLostError):
+        return exc
+    mod = type(exc).__module__ or ""
+    txt = f"{type(exc).__name__}: {exc}".lower()
+    if ("jaxlib" in mod or "jax" in mod or
+            type(exc).__name__ == "XlaRuntimeError"):
+        if any(m in txt for m in DEVICE_LOSS_MARKERS):
+            dead = probe_devices(devices) if devices else []
+            return DeviceLostError(
+                f"device loss detected from runtime error: {exc}",
+                lost_devices=dead,
+            )
+    from flink_tpu.runtime.watchdog import WatchdogError
+
+    if isinstance(exc, WatchdogError) and devices and \
+            getattr(exc, "phase", "") in _DEVICE_WAIT_PHASES:
+        dead = probe_devices(devices)
+        if dead:
+            return DeviceLostError(
+                f"device loss detected behind watchdog trip "
+                f"({exc.phase}): {len(dead)} device(s) failed the "
+                f"health probe",
+                lost_devices=dead,
+            )
+    return None
+
+
+def plan_survivors(current_devices, loss: DeviceLostError):
+    """(survivors, newly_lost) given the current mesh's device order and
+    a classified loss. Shard indices resolve against ``current_devices``
+    (the mesh axis order); device objects match by identity. Both lists
+    preserve mesh order so the re-sliced key-group ranges stay
+    contiguous over the survivors."""
+    newly = []
+    for s in loss.lost_shards:
+        if 0 <= s < len(current_devices):
+            d = current_devices[s]
+            if d not in newly:
+                newly.append(d)
+    for d in loss.lost_devices:
+        if d in current_devices and d not in newly:
+            newly.append(d)
+    survivors = [d for d in current_devices if d not in newly]
+    return survivors, newly
+
+
+class ElasticityController:
+    """Thread-safe degraded-state ledger + scale-back request box for
+    one windowed job.
+
+    The executor records every re-plan (``record``); web threads read
+    ``report`` (served at ``/jobs/<jid>/elasticity``); the operator —
+    or the elasticity drill — calls :meth:`request_scale_up` once
+    replacement capacity exists, and the step loop performs the
+    savepoint-cut rescale at the next cycle boundary. Requests are a
+    single latched flag: re-requesting before the loop serviced the
+    first is idempotent."""
+
+    def __init__(self, devices):
+        self._lock = threading.Lock()
+        # the job's FULL capacity: the mesh it was planned at. Scale-up
+        # targets this set (in simulation the "lost" device is reusable;
+        # on real hardware the operator requests scale-up only once the
+        # replacement is registered under the same device ids).
+        self.full_devices = list(devices)
+        self.current_shards = len(self.full_devices)
+        self.lost: List[str] = []       # device str()s, newest last
+        self.rescales: List[dict] = []  # bounded history, newest last
+        self.total_rescales = 0
+        self._scale_up = threading.Event()
+
+    @property
+    def full_shards(self) -> int:
+        return len(self.full_devices)
+
+    @property
+    def degraded_shards(self) -> int:
+        return max(0, self.full_shards - self.current_shards)
+
+    @property
+    def degraded(self) -> bool:
+        return self.degraded_shards > 0
+
+    # -- executor side ---------------------------------------------------
+    def record(self, kind: str, from_shards: int, to_shards: int,
+               cause: str = "", lost=(), mttr_ms: Optional[float] = None):
+        """One completed re-plan: kind 'degrade' (shard loss) or
+        'scale_up' (capacity restored)."""
+        with self._lock:
+            self.current_shards = int(to_shards)
+            if kind == "scale_up":
+                self.lost = []
+            else:
+                self.lost.extend(str(d) for d in lost)
+            self.total_rescales += 1
+            self.rescales.append({
+                "kind": kind,
+                "from_shards": int(from_shards),
+                "to_shards": int(to_shards),
+                "cause": cause[:300],
+                "lost": [str(d) for d in lost],
+                "mttr_ms": (
+                    round(mttr_ms, 2) if mttr_ms is not None else None
+                ),
+                "t_wall": round(time.time(), 3),
+                "t_perf": time.perf_counter(),
+            })
+            del self.rescales[:-50]
+
+    # -- operator side ---------------------------------------------------
+    def request_scale_up(self):
+        """Ask the job to rescale back to full capacity at the next
+        cycle boundary (a savepoint-cut live rescale — exactly-once,
+        no restart)."""
+        self._scale_up.set()
+
+    def take_scale_up_request(self) -> bool:
+        """Step-loop poll: True exactly once per latched request."""
+        if self._scale_up.is_set():
+            self._scale_up.clear()
+            return True
+        return False
+
+    # -- observability ---------------------------------------------------
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "full-shards": self.full_shards,
+                "current-shards": self.current_shards,
+                "degraded": self.degraded,
+                "degraded-shards": self.degraded_shards,
+                "lost-devices": list(self.lost),
+                "rescales": [
+                    {k: v for k, v in r.items() if k != "t_perf"}
+                    for r in self.rescales
+                ],
+                "total-rescales": self.total_rescales,
+                "scale-up-pending": self._scale_up.is_set(),
+            }

@@ -52,6 +52,7 @@ from flink_tpu.metrics.tracing import (
     cost_analysis_of,
     tracer_from_config,
 )
+from flink_tpu.runtime import elastic
 from flink_tpu.runtime import ingest as ingest_mod
 from flink_tpu.runtime.step import (
     WindowStageSpec,
@@ -74,6 +75,7 @@ from flink_tpu.runtime.cluster import JobCancelledException
 from flink_tpu.runtime.union import to_elements
 from flink_tpu.runtime.watchdog import WatchdogError, watchdog_from_config
 from flink_tpu.runtime.watermarks import WatermarkStrategy
+from flink_tpu.testing import faults
 
 WindowResult = namedtuple("WindowResult", ["key", "window_end_ms", "value"])
 SessionResult = namedtuple(
@@ -127,12 +129,20 @@ def classify_failure(exc: BaseException) -> str:
     ingest thread dying, a connection/timeout blip — say nothing about
     the integrity of the live device state or the compiled kernels, so
     recovery may restart warm in-process: keep the jitted steps, re-stage
-    only what diverged from the restored cut. Anything else
-    (arithmetic/assertion/XLA errors, unknown exceptions) is treated as
-    STATE-CORRUPTING and takes the full restore path, rebuilding every
-    shard from the checkpoint."""
+    only what diverged from the restored cut. DEVICE LOSS (a mesh
+    shard's chip gone — runtime/elastic.py) is its own kind: the
+    checkpoint is fine but the mesh is wrong, so recovery re-plans the
+    job over the survivors instead of restoring onto a dead device.
+    Anything else (arithmetic/assertion/XLA errors, unknown exceptions)
+    is treated as STATE-CORRUPTING and takes the full restore path,
+    rebuilding every shard from the checkpoint."""
     from flink_tpu.runtime import dcn
 
+    if isinstance(exc, elastic.DeviceLostError):
+        # checked FIRST: DCNPeerLostError is both a DCNPeerError (in
+        # the transient tuple) and a DeviceLostError — the dead peer's
+        # mesh segment is gone, which no warm restart survives
+        return "device-loss"
     transient = (
         WatchdogError,
         CheckpointFailureBudgetExceeded,
@@ -1446,6 +1456,20 @@ class LocalExecutor:
         n_dev = len(jax.devices())
         n_shards = max(1, min(env.parallelism, n_dev))
         ctx = MeshContext.create(n_shards, env.max_parallelism)
+        # -- elastic survival (runtime/elastic.py; ISSUE 8): device loss
+        # re-plans the job over the surviving shards instead of crash-
+        # looping at a parallelism the mesh no longer has. The
+        # controller is the operator/web surface: degraded-state ledger
+        # + the scale-back-up request box the step loop polls.
+        from flink_tpu.core.config import CoreOptions as _ECO
+
+        elastic_enabled = env.config.get(_ECO.RECOVERY_ELASTIC)
+        elastic_min_shards = max(1, env.config.get(_ECO.RECOVERY_MIN_SHARDS))
+        elastic_ctl = elastic.ElasticityController(
+            list(np.asarray(ctx.mesh.devices).flat)
+        )
+        env._elasticity_report = elastic_ctl.report
+        env._elastic_controller = elastic_ctl
 
         red = wagg.reduce_spec_factory()
         # time domain: 1 tick = 1 ms until first batch fixes the origin
@@ -2473,6 +2497,116 @@ class LocalExecutor:
             state = dataclasses.replace(state, **repl)
             return True
 
+        def _seed_spill_leftover(leftover):
+            """Snapshot rows that no longer fit the device table go back
+            to the host spill tier they came from (shared by the full
+            restore and the live savepoint-cut rescale — a rescale to
+            FEWER shards shrinks total device capacity, so rows that fit
+            at N shards may spill at M)."""
+            if not leftover:
+                return
+            from flink_tpu.native import SpillStore
+
+            for l_hi, l_lo, l_pane, l_val in leftover:
+                k64 = (
+                    l_hi.astype(np.uint64) << np.uint64(32)
+                ) | l_lo.astype(np.uint64)
+                for p in np.unique(l_pane):
+                    m = l_pane == p
+                    store = ovf_stores.get(int(p))
+                    if store is None:
+                        store = ovf_stores[int(p)] = SpillStore(
+                            width=ovf_w, initial_capacity=1024
+                        )
+                    store.put(
+                        k64[m],
+                        l_val[m].reshape(-1, ovf_w).astype(np.float32),
+                    )
+
+        def _replan_mesh(devices):
+            """Re-slice + rebuild for a NEW shard count (elastic
+            degrade onto survivors, or the scale-back-up): a fresh
+            MeshContext over ``devices`` (key-group ranges re-slice
+            through the unchanged compute_key_group_range math — keys
+            never change key group), and every mesh-derived compiled/
+            cached artifact is dropped so the next setup() rebuilds the
+            whole jitted step family, the exchange geometry, and the
+            ingest plan at the new ``n_shards``. The caller completes
+            the re-plan with a restore (rescaled cut) — state is NOT
+            touched here."""
+            nonlocal ctx, _kg_ends, compact_step_fn
+            ctx = MeshContext.create(
+                len(devices), env.max_parallelism, devices=devices
+            )
+            _kg_ends = np.asarray(ctx.kg_bounds()[1])
+            steps_by_route.clear()
+            megasteps_by_route.clear()
+            compact_step_fn = None
+            kg_occ_step_fn[0] = None
+            kg_occ_cache[0] = None
+            exchange_cap[0] = 0
+            force_route[0] = None
+            # in-flight monitoring handles reference the OLD mesh (a
+            # dead device on real hardware): drop without blocking
+            inflight.clear()
+
+        def _rescale_live(targets, kind: str, cause: str):
+            """Planned savepoint-cut rescale at a cycle boundary — the
+            scale-back-up edge that bounds degraded mode (and, by
+            symmetry, any operator-triggered live re-plan). Semantics
+            match write_savepoint: pending fused groups dispatch, due
+            windows fire BEFORE the cut, then the logical snapshot
+            (device + spill tier) re-buckets onto the new mesh and the
+            source rewinds to the applied-offset cut so prefetched
+            batches replay — exactly-once, no restart, no durable-
+            storage round trip."""
+            nonlocal state, host_fired_pane, applied_max_pane
+            t0 = time.perf_counter()
+            n_before = ctx.n_shards
+            flush_fused()
+            consume_fires(force=True)
+            drain_fires(int(wm_strategy.current()), time.perf_counter())
+            ingest.pause()
+            fused.clear()
+            fire_watch.clear()
+            entries, scalars = ckpt.snapshot_window_state(state, win,
+                                                          red=red)
+            entries = _fold_spill_entries(entries, _dump_spill_stores())
+            for store in ovf_stores.values():
+                store.close()
+            ovf_stores.clear()
+            offsets = ingest.applied_offsets()
+            _replan_mesh(targets)
+            setup(td.origin_ms, fresh_state=False)
+            leftover = [] if win.overflow else None
+            state = ckpt.restore_window_state(
+                entries, scalars, ctx, spec, leftover=leftover
+            )
+            _seed_spill_leftover(leftover)
+            # live-state divergence since the last durable cut has no
+            # dirty bits anymore (the re-bucketed state restores with
+            # clean bits): the next incremental checkpoint must re-base
+            # full instead of chaining a delta over the hole
+            ck_chain[:] = []
+            host_fired_pane = -(2**62)
+            applied_max_pane = (
+                int(entries["pane"].max()) if len(entries["pane"])
+                else None
+            )
+            step_mode[0] = "insert"
+            tier_quiet[0] = 0
+            miss_tolerance[0] = 0
+            bounce_miss[0] = 0
+            mon_watch.clear()
+            pipe.source.restore_offsets(offsets)
+            ingest.resume(offsets)
+            mttr_ms = (time.perf_counter() - t0) * 1e3
+            elastic_ctl.record(kind, n_before, ctx.n_shards, cause=cause,
+                               mttr_ms=mttr_ms)
+            rec_tracker.note_rescale(
+                n_before, ctx.n_shards, elastic_ctl.degraded_shards
+            )
+
         def restore_checkpoint(path_or_storage, cid=None, warm=False):
             nonlocal state, next_cid, steps_at_ckpt, n_keys_logged
             nonlocal host_fired_pane, applied_max_pane
@@ -2580,26 +2714,7 @@ class LocalExecutor:
                 )
             rec_tracker.mark_phase("stage", t_stage0)
             rec_tracker.set_mode(mode, cid)
-            if leftover:
-                # snapshot rows that no longer fit the table go back to the
-                # host spill tier they came from
-                from flink_tpu.native import SpillStore
-
-                for l_hi, l_lo, l_pane, l_val in leftover:
-                    k64 = (
-                        l_hi.astype(np.uint64) << np.uint64(32)
-                    ) | l_lo.astype(np.uint64)
-                    for p in np.unique(l_pane):
-                        m = l_pane == p
-                        store = ovf_stores.get(int(p))
-                        if store is None:
-                            store = ovf_stores[int(p)] = SpillStore(
-                                width=ovf_w, initial_capacity=1024
-                            )
-                        store.put(
-                            k64[m],
-                            l_val[m].reshape(-1, ovf_w).astype(np.float32),
-                        )
+            _seed_spill_leftover(leftover)
             pipe.source.restore_offsets(offsets)
             sink_states = aux.get("sink_states")
             if sink_states:
@@ -3000,6 +3115,11 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
+            # chaos seam: a dying chip surfaces as a runtime error out
+            # of the dispatch — the device_loss fault class injects
+            # exactly there (no-op module-global check in production)
+            faults.inject("step.dispatch", step=metrics.steps,
+                          route=route)
             if staged is None:
                 s_args, did_stage = _stage_planned(
                     (hi, lo, ticks, values, valid), route
@@ -3116,6 +3236,10 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
+            # chaos seam (see run_update): device loss out of a fused
+            # dispatch takes the same elastic recovery branch
+            faults.inject("step.dispatch", step=metrics.steps,
+                          route=route, k=k_fuse)
             flat = []
             wmv = np.empty((ctx.n_shards, k_fuse), np.int32)
             for i, (args, wm_ms, _pb) in enumerate(items):
@@ -4007,6 +4131,18 @@ class LocalExecutor:
         def poll_cycle():
             nonlocal td, host_fired_pane, applied_max_pane
             self._poll_control()
+            # scale-back-up (runtime/elastic.py): a latched operator
+            # request is serviced at the cycle boundary — a savepoint-
+            # cut live rescale back to full capacity. The latch is
+            # consumed only when the rescale can actually run (job has
+            # state AND is degraded): a request filed early — or before
+            # a loss even lands — stays pending until it applies.
+            if td is not None and elastic_ctl.degraded and \
+                    elastic_ctl.take_scale_up_request():
+                _rescale_live(
+                    list(elastic_ctl.full_devices), "scale_up",
+                    "operator scale-up request",
+                )
             if tracer is not None:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()
@@ -4272,22 +4408,83 @@ class LocalExecutor:
                 wd.unsuspend()
                 wd.disarm(prev)
 
+        def _elastic_replan(loss):
+            """Degraded-mode recovery for a classified device loss:
+            re-slice key-group ranges over the M surviving shards,
+            rebuild the mesh + compiled step family, and perform a
+            RESCALED restore of the last durable cut (the logical
+            snapshot format re-buckets entries by key group, so the
+            restore is parallelism-agnostic by construction). A loss
+            without an attributable casualty (marker-matched runtime
+            error, healthy probe) falls back to a same-parallelism full
+            restore; survivors below recovery.min-shards FAIL the job
+            (ElasticCapacityError — retrying cannot grow the mesh)."""
+            t_replan0 = time.perf_counter()
+            with rec_tracker.phase("reslice"):
+                cur = list(np.asarray(ctx.mesh.devices).flat)
+                survivors, newly = elastic.plan_survivors(cur, loss)
+                if not newly:
+                    survivors = None   # unattributable: same-mesh restore
+                elif len(survivors) < elastic_min_shards:
+                    raise elastic.ElasticCapacityError(
+                        f"device loss leaves {len(survivors)} surviving "
+                        f"shard(s), below recovery.min-shards="
+                        f"{elastic_min_shards}; failing the job instead "
+                        f"of degrading further"
+                    ) from loss
+                else:
+                    n_before = ctx.n_shards
+                    _replan_mesh(survivors)
+            if survivors is None:
+                with _restore_guard():
+                    restore_checkpoint(storage, warm=False)
+                return
+            t0 = time.perf_counter()
+            try:
+                with _restore_guard():
+                    restore_checkpoint(storage, warm=False)
+            finally:
+                rec_tracker.mark_phase("rescale_restore", t0)
+            # restore_checkpoint stamped mode "full"; the re-plan is the
+            # headline — restate it with the shard transition. The
+            # controller records first so the tracker's degraded gauge
+            # derives from it (one source of truth for the count).
+            rec_tracker.set_mode(
+                f"rescale-{ctx.n_shards}of{elastic_ctl.full_shards}"
+            )
+            elastic_ctl.record(
+                "degrade", n_before, ctx.n_shards,
+                cause=f"{type(loss).__name__}: {loss}", lost=newly,
+                mttr_ms=(time.perf_counter() - t_replan0) * 1e3,
+            )
+            rec_tracker.note_rescale(
+                n_before, ctx.n_shards, elastic_ctl.degraded_shards
+            )
+
         def _recover(first_exc):
             """One failure -> a restored, runnable job, or raise.
             Classifies the failure (transient host-side -> warm
-            in-process restart; anything else -> full restore), and
-            keeps a failure DURING restore inside the restart budget:
-            a double fault consumes another should_restart() slot and
-            retries with the warm path disabled (the half-restored
-            state is no longer trusted), instead of escaping as an
-            unhandled error or wedging the job."""
+            in-process restart; device loss -> elastic re-plan over the
+            survivors; anything else -> full restore), and keeps a
+            failure DURING restore inside the restart budget: a double
+            fault consumes another should_restart() slot and retries
+            with the warm path disabled (the half-restored state is no
+            longer trusted), instead of escaping as an unhandled error
+            or wedging the job."""
             exc = first_exc
             warm = classify_failure(first_exc) == "transient"
             while True:
+                loss = (
+                    elastic.as_device_loss(
+                        exc, devices=list(np.asarray(ctx.mesh.devices).flat)
+                    )
+                    if elastic_enabled else None
+                )
                 rec_tracker.begin(
                     cause=f"{type(exc).__name__}: {exc}",
                     classification=(
-                        "transient" if warm else "state-corrupting"
+                        "device-loss" if loss is not None
+                        else "transient" if warm else "state-corrupting"
                     ),
                 )
                 with rec_tracker.phase("settle"):
@@ -4308,11 +4505,18 @@ class LocalExecutor:
                 metrics.restarts += 1
                 self._notify_restart()
                 try:
-                    with _restore_guard():
-                        restore_checkpoint(storage, warm=warm)
+                    if loss is not None:
+                        _elastic_replan(loss)
+                    else:
+                        with _restore_guard():
+                            restore_checkpoint(storage, warm=warm)
                     rec_tracker.end()
                     return
                 except JobCancelledException:
+                    raise
+                except elastic.ElasticCapacityError:
+                    # deliberately NOT retried: the surviving device
+                    # set cannot grow by restoring again
                     raise
                 except Exception as e2:
                     exc, warm = e2, False

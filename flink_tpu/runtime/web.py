@@ -17,6 +17,8 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
     /jobs/<jid>/recovery      per-attempt recovery phase breakdowns
                               (detect -> first-fire MTTR, warm vs full,
                               task-local cache hits/misses)
+    /jobs/<jid>/elasticity    shard-loss degraded-mode state + rescale
+                              history (runtime/elastic.py)
     /jobs/<jid>/keygroups     hot key-group top-k + occupancy/fill skew
                               (device-resident telemetry; ?k= bounds)
     /metrics                  Prometheus text exposition over every job's
@@ -1018,6 +1020,23 @@ class WebMonitor:
                     "hint": "recovery instrumentation is recorded by "
                             "windowed keyed stages; this job has none "
                             "(yet)",
+                }
+            return {"available": True, **report_fn()}
+        m = re.fullmatch(r"/jobs/([^/]+)/elasticity", path)
+        if m:
+            # elastic degraded-mode state (runtime/elastic.py): full vs
+            # current shard count, lost devices, and the rescale history
+            # (degrade + scale-back rows with per-transition MTTR) — the
+            # shard-loss survival story of this job
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            report_fn = getattr(rec.env, "_elasticity_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "elasticity state is recorded by windowed "
+                            "keyed stages; this job has none (yet)",
                 }
             return {"available": True, **report_fn()}
         m = re.fullmatch(r"/jobs/([^/]+)/backpressure", path)

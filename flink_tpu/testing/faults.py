@@ -39,6 +39,12 @@ Injection-point catalog (the sites wired in this repo):
                             directory (local-cache hits skip it) — a
                             ``sleep`` rule here models remote-storage
                             fetch latency in the MTTR drill
+    step.dispatch           runtime/executor windowed step loop, at the
+                            top of every update dispatch (single step
+                            and K-fused megastep) — the seam the
+                            ``device_loss`` fault class (below) rides:
+                            a dying chip surfaces exactly here, as a
+                            runtime error out of the dispatch
 
 Actions:
 
@@ -52,6 +58,13 @@ Actions:
             containment layer between the point and the thread's top
             frame — HARD thread/producer death, the "process segment
             just vanished" failure mode
+
+Fault classes beyond the raw actions: :func:`device_loss_rule` builds
+the ``device_loss`` class — a ``raise`` rule at ``step.dispatch``
+carrying a :class:`runtime.elastic.DeviceLostError` that names the
+lost mesh shard, which the elastic recovery path (docs/fault-
+tolerance.md) answers with a re-plan onto the survivors instead of a
+crash loop.
 """
 
 from __future__ import annotations
@@ -157,6 +170,28 @@ class FaultInjector:
                 raise rule.exc if rule.exc is not None else RuntimeError(
                     f"injected fault at {point}"
                 )
+
+
+def device_loss_rule(shard: int = 0, **trigger) -> FaultRule:
+    """The ``device_loss`` fault class: one mesh shard's device dies at
+    the chosen occurrence of the ``step.dispatch`` point. The injected
+    exception is a real :class:`~flink_tpu.runtime.elastic.
+    DeviceLostError` naming the lost shard, so the containment under
+    test — the elastic re-plan in the executor's recovery path — takes
+    exactly the branch a production chip loss would. ``trigger`` passes
+    through to :class:`FaultRule` (``at=``/``every=``/``prob=``/
+    ``times=``)."""
+    # lazy import: runtime modules import this module at load time
+    from flink_tpu.runtime.elastic import DeviceLostError
+
+    return FaultRule(
+        "step.dispatch",
+        exc=DeviceLostError(
+            f"injected device loss: mesh shard {int(shard)}",
+            lost_shards=(int(shard),),
+        ),
+        **trigger,
+    )
 
 
 # -- installation ------------------------------------------------------

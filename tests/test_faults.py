@@ -8,6 +8,7 @@ and prefetch-thread death, and asserts the exactly-once oracle plus
 zero hangs; the targeted tests pin each containment mechanism's
 acceptance criterion individually."""
 
+import copy
 import os
 import socket
 import threading
@@ -630,6 +631,11 @@ CHAOS_RULES = [
     # prefetch-thread death mid-stream
     FaultRule("ingest.producer", exc=RuntimeError("chaos thread death"),
               at=8),
+    # device loss mid-stream (ISSUE 8): one of the 2 mesh shards dies
+    # at a step dispatch; the elastic recovery path re-plans the job at
+    # parallelism 1 and the stream finishes DEGRADED — exactly-once
+    # must hold across the re-slice like every other fault class
+    faults.device_loss_rule(shard=1, at=16),
 ]
 
 
@@ -641,7 +647,11 @@ def _chaos_run(tmp_path, total):
            "checkpoint.tolerable-failures": 3,
            "pipeline.prefetch": "on"},
     )
-    inj = FaultInjector(list(CHAOS_RULES), seed=1234)
+    # deep copy: FaultRule carries a mutable per-run `fired` counter,
+    # and the fast + slow soaks share this module-level schedule — a
+    # shallow copy would leave the second soak with spent rules that
+    # never inject (and failing fired_at assertions)
+    inj = FaultInjector(copy.deepcopy(CHAOS_RULES), seed=1234)
     t0 = time.monotonic()
     with faults.active(inj):
         got = run_job(env, total)
@@ -650,11 +660,16 @@ def _chaos_run(tmp_path, total):
     # exactly-once oracle: the injected faults changed NOTHING about
     # the results
     assert got == expected(total)
-    # all three-plus fault classes actually fired
+    # all fault classes actually fired (device loss rides step.dispatch)
     for point in ("ckpt.entries.write", "ckpt.manifest.write",
-                  "materializer.task", "ingest.producer"):
+                  "materializer.task", "ingest.producer",
+                  "step.dispatch"):
         assert inj.fired_at(point), f"{point} never fired"
     assert m.checkpoints_aborted >= 1
+    # the device-loss class degraded the job onto the surviving shard
+    # and it FINISHED there, exactly-once (asserted above)
+    assert env._elasticity_report()["degraded"] is True
+    assert env.last_job.ctx.n_shards == 1
     assert_chains_closed(tmp_path / "chk")
     return m, wall
 

@@ -251,7 +251,7 @@ def test_web_job_scoped_endpoints_404_unknown_job():
             "/jobs/nope/backpressure", "/jobs/nope/checkpoints",
             "/jobs/nope/metrics", "/jobs/nope/checkpoints/config",
             "/jobs/nope/plan", "/jobs/nope/exceptions",
-            "/jobs/nope/recovery",
+            "/jobs/nope/recovery", "/jobs/nope/elasticity",
         ):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get_json(port, path)
