@@ -41,6 +41,8 @@ import shutil
 import zlib
 from typing import Iterable, List, Optional
 
+from flink_tpu.testing import faults
+
 CHECKSUMS_NAME = "checksums.json"
 
 
@@ -107,6 +109,10 @@ class LocalSnapshotCache:
         raising."""
         tmp = self.path(cid) + ".tmp"
         try:
+            # fault seam: an injected OSError here (disk full, yanked
+            # mount) exercises the best-effort contract — the mirror
+            # fails, the checkpoint stays durable, the job lives
+            faults.inject("ckpt.local.put", cid=cid)
             shutil.rmtree(tmp, ignore_errors=True)
             os.makedirs(tmp)
             sums = {}
@@ -148,6 +154,9 @@ class LocalSnapshotCache:
             self.stats["misses"] += 1
             raise LocalCacheMiss(f"chk-{cid} not in local cache")
         try:
+            # fault seam: an injected OSError/ValueError takes the
+            # corrupt-entry branch — drop, count, fall back to primary
+            faults.inject("ckpt.local.verify", cid=cid)
             with open(os.path.join(p, CHECKSUMS_NAME)) as f:
                 manifest = json.load(f)
             if self.identity is not None and (
@@ -187,6 +196,8 @@ class LocalSnapshotCache:
         if self.identity is None:
             return True
         try:
+            # fault seam: an unreadable manifest means primary serves
+            faults.inject("ckpt.local.verify", cid=cid)
             with open(os.path.join(self.path(cid), CHECKSUMS_NAME)) as f:
                 return json.load(f).get("identity") == self.identity
         except (OSError, ValueError, AttributeError):

@@ -85,6 +85,9 @@ def read_manifest(directory: str) -> Optional[dict]:
     path = os.path.join(directory, MANIFEST_NAME)
     if not os.path.exists(path):
         return None
+    # fault seam: a failing/slow manifest read is the restore-time half
+    # of the torn-write story (recovery walks the chain through here)
+    faults.inject("ckpt.manifest.read", path=path)
     with open(path) as f:
         m = json.load(f)
     if m.get("manifest_version") != MANIFEST_VERSION:

@@ -371,8 +371,9 @@ class CoreOptions:
         "observability.trace-buffer-spans", 65536,
         "span ring-buffer capacity (old spans fall off)")
     TRACE_DUMP = ConfigOption(
-        "observability.trace-dump", None,
-        "write the Chrome-trace JSON to this file when the job ends")
+        "observability.trace-dump", "",
+        "write the Chrome-trace JSON to this file when the job ends "
+        "(empty = don't)")
     KG_STATS = ConfigOption(
         "observability.kg-stats", None,
         "enable key-group skew telemetry (per-batch fill scatter in the "
@@ -387,3 +388,100 @@ class CoreOptions:
         "observability.compile-cost", False,
         "record XLA cost_analysis (FLOPs/bytes) of the update step at "
         "warmup — costs one extra trace+compile")
+    # -- state backend / keying (docs/performance.md) -------------------
+    # The keys below predate the config-hygiene lint (ISSUE 9): they
+    # were read as bare literals across the executor; declaring them
+    # here is what gives them strict coercion, a single default, and a
+    # docs anchor.
+    STATE_LAYOUT = ConfigOption(
+        "state.backend.layout", "auto",
+        "auto | hash | direct — slot layout of the device state table; "
+        "direct (slot == key) skips probing for bounded non-negative "
+        "int keys, auto picks per job")
+    STATE_OVERFLOW_RING = ConfigOption(
+        "state.backend.overflow-ring", -1,
+        "overflow-ring rows per shard for spillable reduces; -1 = "
+        "auto-size from the monitoring lag, 0 disables the ring")
+    STATE_STAGE_PROBE_LEN = ConfigOption(
+        "state.probe-len", 16,
+        "open-addressing probe length of a keyed stage's slot table "
+        "(the per-stage override of "
+        "state.backend.device.probe-length)")
+    STATE_STRICT_CAPACITY = ConfigOption(
+        "state.backend.strict-capacity", True,
+        "fail the job when records would be dropped (capacity "
+        "overflow) rather than tolerate loss")
+    KEYS_REVERSE_MAP = ConfigOption(
+        "keys.reverse-map", True,
+        "keep the host-side hash->original-key reverse map so fired "
+        "windows surface user keys; off saves host memory when sinks "
+        "only need hashes")
+    # -- mesh exchange route (docs/performance.md) ----------------------
+    EXCHANGE_MODE = ConfigOption(
+        "exchange.mode", "auto",
+        "auto | all_to_all | mask — how records reach their owning "
+        "shard: per-batch adaptive all_to_all (auto), always exchange, "
+        "or always replicate-and-mask")
+    EXCHANGE_CAPACITY_FACTOR = ConfigOption(
+        "exchange.capacity-factor", 2.0,
+        "per-shard exchange bucket headroom over the balanced share "
+        "(hash skew beyond it falls back / counts dropped_capacity)")
+    # -- windowing ------------------------------------------------------
+    WINDOW_RING_PANES = ConfigOption(
+        "window.ring-panes", 0,
+        "pane ring size override; 0 = auto from window spec + "
+        "out-of-orderness")
+    WINDOW_FIRES_PER_STEP = ConfigOption(
+        "window.fires-per-step", 4,
+        "window ends evaluated per fire step")
+    # -- cross-host DCN plane (docs/DCN_INGESTION.md) -------------------
+    DCN_COORDINATOR = ConfigOption(
+        "dcn.coordinator", "",
+        "host:port of the jax.distributed coordinator; non-empty "
+        "switches the executor to the multi-process DCN plane")
+    DCN_NUM_PROCESSES = ConfigOption(
+        "dcn.num-processes", 1, "process count of the DCN job")
+    DCN_PROCESS_ID = ConfigOption(
+        "dcn.process-id", 0, "this process's index in the DCN job")
+    DCN_ORIGIN_MS = ConfigOption(
+        "dcn.origin-ms", 0,
+        "shared time-domain origin (epoch ms) so every process buckets "
+        "event time identically")
+    DCN_REBALANCE_ADDRS = ConfigOption(
+        "dcn.rebalance-addrs", "",
+        "comma-separated host:port per process for the work-stealing "
+        "rebalance ring side channel")
+    DCN_INGEST_PARTITIONER = ConfigOption(
+        "dcn.ingest-partitioner", "forward",
+        "forward | rebalance — whether each process keeps its source "
+        "partition or steals from neighbors over the rebalance ring")
+    # -- CEP acceleration -----------------------------------------------
+    CEP_DEVICE_ENABLED = ConfigOption(
+        "cep.device.enabled", True,
+        "compile eligible CEP patterns to the device NFA kernel; off "
+        "forces the host interpreter")
+    CEP_DEVICE_WITHIN_BUCKETS = ConfigOption(
+        "cep.device.within-buckets", 8,
+        "time-bucket count for the device NFA's within-window pruning")
+    # -- control plane / cluster (docs/DEPLOYMENT.md) -------------------
+    CONTROLLER_RPC_PORT = ConfigOption(
+        "controller.rpc.port", 6123,
+        "control-plane RPC port (the jobmanager.rpc.port analog); "
+        "0 = ephemeral")
+    CONTROLLER_BIND_HOST = ConfigOption(
+        "controller.bind-host", "127.0.0.1", "control-plane bind host")
+    HA_DIR = ConfigOption(
+        "high-availability.dir", None,
+        "file-lock leader-election directory (the ZooKeeper-quorum "
+        "analog); unset = standalone", type=str)
+    SECURITY_AUTH_TOKEN = ConfigOption(
+        "security.auth.token", "",
+        "shared-secret token for the control plane + HTTP monitor; "
+        "empty = open cluster")
+    SECURITY_AUTH_TOKEN_FILE = ConfigOption(
+        "security.auth.token-file", "",
+        "file to read the shared-secret token from (wins over env)")
+    METRICS_REPORTERS = ConfigOption(
+        "metrics.reporters", "",
+        "comma-separated reporter names; each configures via "
+        "metrics.reporter.<name>.* keys")

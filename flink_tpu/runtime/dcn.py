@@ -862,6 +862,10 @@ class _DCNRunnerBase:
         import jax
 
         cid = self._next_cid
+        # fault seam: a raising rule models a process crashing mid-cut;
+        # the lockstep cadence means the ensemble's OTHER procs publish
+        # their halves and restore skips the globally-incomplete cid
+        faults.inject("dcn.ckpt.write", pid=self.pid, cid=cid)
         d = os.path.join(self.ckpt_dir, f"chk-{cid:06d}")
         os.makedirs(d, exist_ok=True)
         leaves, _ = jax.tree_util.tree_flatten(self.state)
@@ -927,6 +931,8 @@ class _DCNRunnerBase:
         d = self._latest_complete()
         if d is None:
             return
+        # fault seam: restore-time read of this process's half of the cut
+        faults.inject("dcn.ckpt.read", pid=self.pid)
         with open(os.path.join(d, f"proc-{self.pid}.meta.json")) as f:
             meta = json.load(f)
         data = np.load(os.path.join(d, f"proc-{self.pid}.npz"))
@@ -1582,11 +1588,13 @@ def main(argv=None) -> int:
     )
     out = runner.run()
     tmp = a.out + ".tmp"
+    # lint: allow(fault-seam): one-shot CLI result dump after the job ended — not a recovery seam; a failure here is an ordinary process error
     with open(tmp, "wb") as f:    # file object: savez appends no suffix
         np.savez(f, key_id=out["key_id"],
                  window_start_ms=out["window_start_ms"],
                  window_end_ms=out["window_end_ms"], value=out["value"],
                  dropped_capacity=out["dropped_capacity"])
+    # lint: allow(fault-seam): same one-shot result publish as the open above
     os.replace(tmp, a.out)
     print(json.dumps({"rows": int(len(out["key_id"])),
                       "cycles": out["cycles"], "pid": a.process_id,

@@ -3096,6 +3096,7 @@ class LocalExecutor:
             # numpy, NOT jnp.full: an eager device op for this tiny vector
             # costs a full ~100ms tunnel round trip per call; as a jit
             # argument it rides the step's (queued, cheap) input transfer
+            # lint: allow(retrace): deliberate tiny [n_shards] per-dispatch vector — see the comment above; hoisting would share a buffer across queued async dispatches
             wmv = np.full((ctx.n_shards,), np.int32(
                 wm_ticks if wm_ticks is not None else -(2**31) + 1
             ))
@@ -3241,6 +3242,7 @@ class LocalExecutor:
             faults.inject("step.dispatch", step=metrics.steps,
                           route=route, k=k_fuse)
             flat = []
+            # lint: allow(retrace): tiny [n_shards, K] watermark matrix, fresh per fused dispatch for the same reason as run_update's wmv (queued async dispatches must not share the buffer)
             wmv = np.empty((ctx.n_shards, k_fuse), np.int32)
             for i, (args, wm_ms, _pb) in enumerate(items):
                 flat.extend(args)

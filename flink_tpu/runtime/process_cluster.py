@@ -560,9 +560,11 @@ def main(argv=None) -> int:
                                           "127.0.0.1"),
                     help="bind address (0.0.0.0 for multi-host)")
     ap.add_argument("--port", type=int,
+                    # lint: allow(config): ephemeral port (0), not 6123 — spawned test ensembles on one host must not collide
                     default=gconf.get_int("controller.rpc.port", 0))
     ap.add_argument("--advertise-host", default="127.0.0.1")
     ap.add_argument("--ha-dir",
+                    # lint: allow(config): argparse wants a string; '' is the same standalone mode as the declared None default
                     default=gconf.get_str("high-availability.dir", "")
                     or None)
     ap.add_argument("--contender-id", default=None)

@@ -1,0 +1,35 @@
+"""Cross-thread shared-state registry (the ``thread-state`` lint's
+annotation side; tools/lint/rules/thread_state.py).
+
+The runtime runs four long-lived background threads next to the step
+loop — ingest producer, checkpoint materializer, watchdog monitor, web
+monitor handlers. Every attribute those threads MUTATE must either sit
+lexically inside ``with self.<lock>:`` (auto-detected by the lint — no
+entry needed here) or be registered below with a policy and a reason.
+The registry is data, not code: the linter parses it as a literal and
+never imports the runtime, and a reviewer reads it as the single
+catalog of deliberately-unlocked cross-thread state.
+
+Policies:
+
+  ``single-writer:<thread>`` — only the named thread ever writes the
+      attribute; readers tolerate staleness (GIL-atomic publication).
+  ``locked-by-caller:<lock>`` — every call path into the mutating
+      method holds the named lock; the lexical ``with`` lives in the
+      caller, which the purely-lexical lint cannot see.
+
+Adding an entry is a REVIEWED claim about the runtime's threading
+contract — include the why, not just the policy.
+"""
+
+SHARED_STATE = {
+    # Watchdog._trip runs on the monitor thread with _trip_lock HELD BY
+    # ITS ONLY CALLER (_main's verify-pop-inject critical section); the
+    # lexical `with` is one frame up, invisible to the lint.
+    "Watchdog.trips":
+        "locked-by-caller:_trip_lock — _main holds _trip_lock across "
+        "the verify-pop-inject sequence that calls _trip",
+    "Watchdog._tripping":
+        "locked-by-caller:_trip_lock — same critical section as "
+        "Watchdog.trips; disarm()'s cancel path takes the same lock",
+}

@@ -39,6 +39,21 @@ Injection-point catalog (the sites wired in this repo):
                             directory (local-cache hits skip it) — a
                             ``sleep`` rule here models remote-storage
                             fetch latency in the MTTR drill
+    ckpt.manifest.read      checkpointing/manifest.read_manifest: the
+                            restore-time chain walk (the read half of
+                            the torn-write story)
+    ckpt.local.put          checkpointing/local LocalSnapshotCache.put,
+                            inside the best-effort try: an injected
+                            OSError exercises "mirror fails, checkpoint
+                            stays durable, job lives"
+    ckpt.local.verify       LocalSnapshotCache.verify/identity_ok read
+                            path: an injected error takes the corrupt-
+                            entry branch (drop + fall back to primary)
+    dcn.ckpt.write          runtime/dcn per-process checkpoint write: a
+                            raising rule models a process crashing mid-
+                            cut (restore skips the incomplete cid)
+    dcn.ckpt.read           runtime/dcn restore-time read of this
+                            process's half of the cut
     step.dispatch           runtime/executor windowed step loop, at the
                             top of every update dispatch (single step
                             and K-fused megastep) — the seam the

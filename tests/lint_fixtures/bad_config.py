@@ -1,0 +1,10 @@
+# virtual-path: flink_tpu/runtime/demo_reader.py
+# Red-team fixture: a typed-getter read of a key NO ConfigOption
+# declares — it bypasses strict coercion and can typo silently —
+# plus a fallback contradicting the declared default.
+
+
+def setup(config):
+    a = config.get_int("demo.bogus", 1)       # undeclared key
+    b = config.get_int("demo.knob", 99)       # drifted fallback (4 declared)
+    return a, b

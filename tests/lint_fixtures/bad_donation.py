@@ -1,0 +1,17 @@
+# virtual-path: flink_tpu/runtime/executor.py
+# Red-team fixture: state is read AFTER being passed in the donated
+# position — the buffer was invalidated by donate_argnums.
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def loop(state, batches):
+    out = step(state, batches[0])
+    total = state.sum()            # use-after-donate: stale buffer read
+    return out, total

@@ -1,0 +1,11 @@
+# virtual-path: flink_tpu/checkpointing/fake_store.py
+# Red-team fixture: raw checkpoint IO with NO faults.inject seam — the
+# chaos soak cannot schedule this failure mode.
+import os
+
+
+def publish(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)

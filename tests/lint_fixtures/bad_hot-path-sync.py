@@ -1,0 +1,11 @@
+# virtual-path: flink_tpu/ops/fake_kernel.py
+# Red-team fixture: host syncs in a kernel module — every construct the
+# hot-path-sync rule exists to catch.
+import numpy as np
+
+
+def kernel(x):
+    x.block_until_ready()          # serializes the dispatch pipeline
+    n = x.ovf_n.item()             # device->host scalar fetch
+    a = np.asarray(x.acc)          # device->host array fetch
+    return n, a

@@ -1,0 +1,20 @@
+# virtual-path: flink_tpu/runtime/executor.py
+# Red-team fixture: the PR 3 bug class — a fresh np.ones mask allocated
+# per dispatch inside the hot section, plus a compile inside a loop.
+import jax
+import numpy as np
+
+update_step = jax.jit(lambda s, m: s)
+
+
+def run_update(state, n):
+    mask = np.ones(8192, bool)         # fresh per-dispatch allocation
+    mask[n:] = False
+    return update_step(state, mask)
+
+
+def warm_all(bodies):
+    compiled = []
+    for body in bodies:
+        compiled.append(jax.jit(body))  # retrace storm: compile per item
+    return compiled

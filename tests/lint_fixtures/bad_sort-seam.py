@@ -1,0 +1,13 @@
+# virtual-path: flink_tpu/ops/rogue_kernel.py
+# Red-team fixture: a second sort added to a kernel outside segment.py —
+# exactly the per-plane re-sort the shared-sort seam exists to prevent.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def rogue(x, k, v):
+    a = jnp.argsort(x)
+    b = jax.lax.sort(x)
+    c = lax.sort_key_val(k, v)
+    return a, b, c

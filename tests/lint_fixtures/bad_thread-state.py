@@ -1,0 +1,17 @@
+# virtual-path: flink_tpu/runtime/ingest.py
+# Red-team fixture: the producer thread mutates shared attributes with
+# no covering lock and no registry entry — the PR 3 bug shape.
+import threading
+
+
+class Producer:
+    def __init__(self):
+        self.count = 0
+        self.batches = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._main, daemon=True)
+
+    def _main(self):
+        while True:
+            self.count += 1              # unlocked cross-thread write
+            self.batches.append(object())   # unlocked mutator call

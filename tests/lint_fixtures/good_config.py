@@ -1,0 +1,7 @@
+# virtual-path: flink_tpu/runtime/demo_reader.py
+# Good twin: the read resolves to a declared option and the fallback
+# agrees with the declared default.
+
+
+def setup(config):
+    return config.get_int("demo.knob", 4)

@@ -1,0 +1,16 @@
+# virtual-path: flink_tpu/runtime/executor.py
+# Good twin: the sanctioned idiom — the donating call REBINDS the name,
+# so every later read sees the new buffer.
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def loop(state, batches):
+    state = step(state, batches[0])
+    return state, state.sum()

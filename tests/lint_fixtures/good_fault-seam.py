@@ -1,0 +1,20 @@
+# virtual-path: flink_tpu/checkpointing/fake_store.py
+# Good twin: the same IO behind a named injection point (covering the
+# whole function), plus a helper whose every caller carries the seam.
+import os
+
+from flink_tpu.testing import faults
+
+
+def publish(path, payload):
+    faults.inject("ckpt.fake.publish", path=path)
+    tmp = path + ".tmp"
+    _write(tmp, payload)
+    os.replace(tmp, path)
+
+
+def _write(tmp, payload):
+    # no inject here — covered because every intra-module caller
+    # (publish) carries one
+    with open(tmp, "w") as f:
+        f.write(payload)

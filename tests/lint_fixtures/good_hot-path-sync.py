@@ -1,0 +1,16 @@
+# virtual-path: flink_tpu/ops/fake_kernel.py
+# Good twin: host-side work lives in host-named helpers (naming
+# convention) or behind a reasoned inline marker.
+import numpy as np
+
+
+def decode_host(x):
+    return np.asarray(x)           # host helper by naming contract
+
+
+def kernel(x):
+    return x + 1                   # stays on device
+
+
+def barrier(x):
+    return np.asarray(x)  # host-sync-ok: documented step-boundary barrier

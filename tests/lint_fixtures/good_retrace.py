@@ -1,0 +1,13 @@
+# virtual-path: flink_tpu/runtime/executor.py
+# Good twin: the template is hoisted to setup (frozen mask template) and
+# the hot section only slices it; compiles happen once, outside loops.
+import jax
+import numpy as np
+
+update_step = jax.jit(lambda s, m: s)
+_MASK_TMPL = np.ones(8192, bool)       # allocated once at import/setup
+
+
+def run_update(state, n):
+    mask = _MASK_TMPL[:n]              # view slice, no allocation
+    return update_step(state, mask)

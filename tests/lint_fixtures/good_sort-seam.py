@@ -1,0 +1,8 @@
+# virtual-path: flink_tpu/ops/segment.py
+# Good twin: the identical sorts are LEGAL in segment.py — the one file
+# the seam designates as the sort home.
+import jax.numpy as jnp
+
+
+def segment_sort(x):
+    return jnp.argsort(x)

@@ -1,0 +1,21 @@
+# virtual-path: flink_tpu/runtime/ingest.py
+# Good twin: every producer-thread mutation sits inside `with
+# self._lock:` (auto-detected — the lock attr is assigned
+# threading.Lock in this module), and the queue is a sanctioned
+# sync primitive.
+import queue
+import threading
+
+
+class Producer:
+    def __init__(self):
+        self.count = 0
+        self._q = queue.Queue()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._main, daemon=True)
+
+    def _main(self):
+        while True:
+            with self._lock:
+                self.count += 1
+            self._q.put_nowait(object())

@@ -1,0 +1,257 @@
+"""Unified hot-path invariant linter wired as tier-1 (ISSUE 9).
+
+One parametrized module runs every rule of tools/lint:
+
+* against the REPO — all 7 rules must come back clean (a regression in
+  any guarded invariant fails the suite, exactly like the two
+  pre-framework checkers did for their two invariants);
+* against a red-team FIXTURE PAIR per rule (tests/lint_fixtures/) —
+  the bad snippet must be flagged, the good twin must pass, so a rule
+  that silently stops detecting its bug class fails loudly;
+* suppression syntax: ``# lint: allow(<rule>): <reason>`` silences one
+  finding, a reasonless allow is itself reported, and the sort-seam
+  rule accepts no suppression at all;
+* the shared parse cache keeps the whole run under the ~5s tier-1
+  budget, and the CLI's exit codes distinguish clean/findings/broken.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.lint import RepoTree, all_rules, rule_by_name, run_rules  # noqa: E402
+from tools.lint.core import (  # noqa: E402
+    SUPPRESS_RE, LintInternalError, Finding,
+)
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+RULE_NAMES = [r.name for r in all_rules()]
+
+# auxiliary virtual files some rules need to judge a fixture (the
+# config rule resolves reads against declarations + conf + docs)
+AUX = {
+    "config": {
+        "flink_tpu/core/config.py": (
+            "from dataclasses import dataclass\n"
+            "@dataclass\n"
+            "class ConfigOption:\n"
+            "    key: str\n"
+            "    default: object = None\n"
+            "OPT = ConfigOption('demo.knob', 4)\n"
+        ),
+        "conf/flink-tpu-conf.yaml": "# demo.knob: 4\n",
+        "docs/demo.md": "`demo.knob` — the demo knob.\n",
+    },
+}
+
+
+def load_fixture(kind: str, rule: str):
+    path = os.path.join(FIXDIR, f"{kind}_{rule}.py")
+    with open(path) as f:
+        src = f.read()
+    m = re.search(r"# virtual-path:\s*(\S+)", src)
+    assert m, f"{path} must declare its '# virtual-path:' header"
+    return m.group(1), src
+
+
+def fixture_tree(kind: str, rule: str) -> RepoTree:
+    vpath, src = load_fixture(kind, rule)
+    files = dict(AUX.get(rule, {}))
+    files[vpath] = src
+    return RepoTree(files=files)
+
+
+# -- every rule: repo clean, bad flagged, good passes -------------------
+
+@pytest.mark.parametrize("rule", RULE_NAMES)
+def test_repo_is_clean(rule):
+    findings = run_rules(RepoTree(ROOT), [rule_by_name(rule)])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+@pytest.mark.parametrize("rule", RULE_NAMES)
+def test_rule_flags_its_bad_fixture(rule):
+    findings = run_rules(fixture_tree("bad", rule), [rule_by_name(rule)])
+    assert any(f.rule == rule for f in findings), (
+        f"rule {rule!r} no longer detects its seeded violation"
+    )
+
+
+@pytest.mark.parametrize("rule", RULE_NAMES)
+def test_rule_passes_its_good_fixture(rule):
+    findings = run_rules(fixture_tree("good", rule), [rule_by_name(rule)])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# -- suppression syntax -------------------------------------------------
+
+def _retrace_tree(extra: str) -> RepoTree:
+    src = (
+        "import numpy as np\n"
+        "def run_update(state):\n"
+        f"    m = np.ones(8, bool){extra}\n"
+        "    return state\n"
+    )
+    return RepoTree(files={"flink_tpu/runtime/executor.py": src})
+
+
+def test_reasoned_allow_suppresses_one_finding():
+    tree = _retrace_tree(
+        "  # lint: allow(retrace): fixture — deliberate tiny buffer"
+    )
+    assert run_rules(tree, [rule_by_name("retrace")]) == []
+
+
+def test_reasonless_allow_is_itself_a_finding():
+    tree = _retrace_tree("  # lint: allow(retrace)")
+    findings = run_rules(tree, [rule_by_name("retrace")])
+    assert [f.rule for f in findings] == ["suppression"]
+    assert "reason is mandatory" in findings[0].message
+
+
+def test_allow_for_a_different_rule_does_not_cover():
+    tree = _retrace_tree("  # lint: allow(donation): wrong rule entirely")
+    findings = run_rules(tree, [rule_by_name("retrace")])
+    assert [f.rule for f in findings] == ["retrace"]
+
+
+def test_sort_seam_accepts_no_suppression():
+    src = (
+        "import jax.numpy as jnp\n"
+        "def rogue(x):\n"
+        "    return jnp.argsort(x)"
+        "  # lint: allow(sort-seam): should not work\n"
+    )
+    tree = RepoTree(files={"flink_tpu/ops/rogue.py": src})
+    findings = run_rules(tree, [rule_by_name("sort-seam")])
+    assert [f.rule for f in findings] == ["sort-seam"]
+
+
+def test_every_repo_suppression_carries_a_reason():
+    """Acceptance criterion: every `# lint: allow(<rule>)` comment in
+    the production tree carries a reason. (tests/ is excluded: the
+    suppression tests above deliberately exercise reasonless allows.)"""
+    bad = []
+    for sub in ("flink_tpu", "tools"):
+        for dirpath, _dirs, files in os.walk(os.path.join(ROOT, sub)):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                with open(p) as f:
+                    for i, line in enumerate(f, 1):
+                        m = SUPPRESS_RE.search(line)
+                        if m is not None and not (
+                            m.group("reason") or ""
+                        ).strip():
+                            bad.append(f"{p}:{i}: {line.strip()}")
+    assert bad == [], "\n".join(bad)
+
+
+def test_config_mentions_are_token_bounded():
+    """A declared key that PREFIXES another key must not ride its
+    sibling's conf/docs mention (the security.auth.token /
+    security.auth.token-file shape, and dotted children)."""
+    from tools.lint.rules.config_hygiene import _mentions
+
+    assert not _mentions("# security.auth.token-file: /x",
+                         "security.auth.token")
+    assert _mentions("# security.auth.token: change-me",
+                     "security.auth.token")
+    assert not _mentions("restart-strategy.fixed-delay.attempts: 3",
+                         "restart-strategy")
+    assert _mentions("restart-strategy: none", "restart-strategy")
+    # a sentence-ending period is still a boundary
+    assert _mentions("set checkpoint.local.dir.", "checkpoint.local.dir")
+
+
+# -- framework mechanics ------------------------------------------------
+
+def test_parse_cache_is_shared():
+    tree = RepoTree(ROOT)
+    a = tree.module("flink_tpu/runtime/step.py")
+    b = tree.module("flink_tpu/runtime/step.py")
+    assert a is b and a is not None
+
+
+def test_donation_rule_resolves_real_builders():
+    """Pass 1 of the donation rule must keep resolving runtime/step.py's
+    donated factories — including the thin-wrapper exchange variant."""
+    from tools.lint.rules.donation import donated_builders
+
+    b = donated_builders(RepoTree(ROOT))
+    assert b.get("build_window_update_step") == (0,)
+    assert b.get("build_window_megastep") == (0,)
+    assert b.get("build_window_fire_step") == (0,)
+    assert b.get("build_window_update_step_exchange") == (0,)
+    assert len(b) >= 8
+
+
+def test_unknown_rule_is_internal_error():
+    with pytest.raises(LintInternalError):
+        rule_by_name("no-such-rule")
+
+
+def test_rule_catalog_metadata():
+    for r in all_rules():
+        assert r.name and r.title and r.established, r
+    assert len({r.name for r in all_rules()}) == 7
+
+
+def test_wall_time_budget():
+    """Whole-suite lint stays under ~5s on this container: every rule
+    rides ONE RepoTree parse of each module."""
+    t0 = time.perf_counter()
+    run_rules(RepoTree(ROOT), all_rules())
+    dt = time.perf_counter() - t0
+    assert dt < 5.0, f"lint took {dt:.2f}s (budget 5s)"
+
+
+# -- CLI ----------------------------------------------------------------
+
+def _cli(*args, cwd=ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.lint", *args],
+        capture_output=True, text=True, cwd=cwd,
+    )
+
+
+def test_cli_clean_tree_exits_zero():
+    rc = _cli()
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+
+
+def test_cli_findings_exit_one_and_json(tmp_path):
+    bad = tmp_path / "flink_tpu" / "ops"
+    bad.mkdir(parents=True)
+    (bad / "fake.py").write_text(
+        "def kernel(x):\n    return x.block_until_ready()\n"
+    )
+    rc = _cli("--root", str(tmp_path), "--json")
+    assert rc.returncode == 1, rc.stdout + rc.stderr
+    payload = json.loads(rc.stdout)
+    assert payload and payload[0]["rule"] == "hot-path-sync"
+
+
+def test_cli_internal_error_exits_two():
+    rc = _cli("--rule", "no-such-rule")
+    assert rc.returncode == 2
+    assert "internal error" in rc.stderr
+
+
+def test_cli_single_rule_and_listing():
+    rc = _cli("--rule", "sort-seam")
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    rc = _cli("--list-rules")
+    assert rc.returncode == 0
+    for name in RULE_NAMES:
+        assert name in rc.stdout

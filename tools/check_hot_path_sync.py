@@ -1,207 +1,36 @@
 #!/usr/bin/env python
 """Static check: no host synchronization in hot-path modules.
 
-The step loop's whole performance story is that update steps dispatch
-asynchronously and nothing reads device memory between barriers — a
-single stray `block_until_ready()`, `.item()`, or `np.asarray(<device
-array>)` in a kernel or step builder serializes the pipeline and costs a
-fixed ~70ms tunnel round trip per call on the TPU runtime (ISSUE 2;
-tools/microbench_d2h.py measured it). This checker fails the build when
-one of those host-sync constructs appears in the hot-path modules:
-
-    flink_tpu/ops/**.py          (device kernels)
-    flink_tpu/runtime/step.py    (compiled step builders)
-    flink_tpu/runtime/ingest.py  (pipelined ingest / device staging)
-    flink_tpu/runtime/elastic.py (elastic re-plan helpers)
-
-outside an allowlisted barrier section. The ingest module's one
-legitimate wait — the staging ring's transfer-completion block, which
-runs on the ingest thread and exists precisely so the STEP LOOP never
-waits — carries an inline marker; anything else that blocks there would
-silently serialize the overlap the module exists to provide. Allowlisting, in order of
-preference:
-
-  1. Naming convention — functions whose name contains ``host`` or ends
-     with ``_np`` are host-side by contract (hash64_host, estimate_np,
-     ...); the hot path never calls them per step.
-  2. The explicit ALLOWLIST below — (relative path, function qualname)
-     pairs for documented host-facing APIs that don't fit the naming
-     convention (e.g. segment.grouped_reduce, the batch DataSet seam).
-  3. An inline ``# host-sync-ok: <reason>`` comment on the flagged line
-     for true one-offs; the reason is mandatory by convention.
-
-Detection is AST-based (not grep) so strings/comments can't false-
-positive and aliasing `numpy as np` is resolved per call site.
-
-Wired into the tier-1 suite via tests/test_hot_path_sync.py, so an
-unintended host sync cannot regress silently.
-
-Usage:
-    python tools/check_hot_path_sync.py [--root REPO_ROOT]
-Exit status 0 = clean, 1 = violations (printed one per line).
+THIN SHIM (ISSUE 9): the checker migrated into the unified invariant
+linter as the ``hot-path-sync`` rule — run ``python -m tools.lint``
+for all 7 rules, or this script for the one check. Public API
+(ALLOWLIST, check_source, check_tree, hot_path_files, main) is
+re-exported unchanged for tests/test_hot_path_sync.py and any other
+caller. Rule implementation: tools/lint/rules/hot_path_sync.py;
+catalog: docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import argparse
-import ast
 import os
 import sys
-from typing import List, NamedTuple, Tuple
 
-# hot-path locations, relative to the repo root
-HOT_PATHS = (
-    "flink_tpu/ops",
-    "flink_tpu/runtime/step.py",
-    "flink_tpu/runtime/ingest.py",
-    # elastic re-plan helpers (ISSUE 8): imported by the executor's
-    # recovery path; the one legitimate wait — the recovery-path device
-    # health probe — carries the inline marker
-    "flink_tpu/runtime/elastic.py",
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.lint.rules.hot_path_sync import (  # noqa: E402,F401
+    ALLOWLIST,
+    HOT_PATHS,
+    INLINE_MARKER,
+    SYNC_ATTRS,
+    HotPathSyncRule,
+    Violation,
+    check_source,
+    check_tree,
+    hot_path_files,
+    main,
 )
-
-# documented host-facing seams that live in hot-path modules but are
-# never called from inside the step loop
-ALLOWLIST: set = {
-    # host-side key encode: runs in prep_batch on numpy inputs
-    ("flink_tpu/ops/hashing.py", "splitmix64"),
-    ("flink_tpu/ops/hashing.py", "key_identity64"),
-    # batch DataSet/Table aggregation API: documented to return numpy
-    ("flink_tpu/ops/segment.py", "grouped_reduce"),
-    # sketch host mirrors: query-path estimates over fetched registers
-    ("flink_tpu/ops/sketches.py", "CountMinSketch.__init__"),
-    ("flink_tpu/ops/sketches.py", "_numeric"),
-}
-
-SYNC_ATTRS = ("block_until_ready", "item")
-INLINE_MARKER = "host-sync-ok"
-
-
-class Violation(NamedTuple):
-    path: str
-    line: int
-    func: str
-    what: str
-
-    def __str__(self):
-        return (f"{self.path}:{self.line}: {self.what} in {self.func!r} "
-                f"— host sync on the hot path (allowlist it only if this "
-                f"is a documented barrier section; see "
-                f"tools/check_hot_path_sync.py)")
-
-
-def _is_np_asarray(call: ast.Call) -> bool:
-    f = call.func
-    return (
-        isinstance(f, ast.Attribute)
-        and f.attr == "asarray"
-        and isinstance(f.value, ast.Name)
-        and f.value.id in ("np", "numpy")
-    )
-
-
-def _is_sync_attr(call: ast.Call) -> bool:
-    f = call.func
-    return isinstance(f, ast.Attribute) and f.attr in SYNC_ATTRS
-
-
-class _Scanner(ast.NodeVisitor):
-    def __init__(self, relpath: str, lines: List[str]):
-        self.relpath = relpath
-        self.lines = lines
-        self.stack: List[str] = []
-        self.out: List[Violation] = []
-
-    def _qualname(self) -> str:
-        return ".".join(self.stack) if self.stack else "<module>"
-
-    def _allowed(self, node: ast.Call) -> bool:
-        qn = self._qualname()
-        # naming convention: host-side helpers
-        for part in self.stack:
-            if "host" in part or part.endswith("_np"):
-                return True
-        if (self.relpath, qn) in ALLOWLIST:
-            return True
-        line = (
-            self.lines[node.lineno - 1]
-            if 0 < node.lineno <= len(self.lines) else ""
-        )
-        return INLINE_MARKER in line
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    def visit_FunctionDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node: ast.Call):
-        what = None
-        if _is_sync_attr(node):
-            what = f".{node.func.attr}()"
-        elif _is_np_asarray(node):
-            what = "np.asarray(...)"
-        if what is not None and not self._allowed(node):
-            self.out.append(Violation(
-                self.relpath, node.lineno, self._qualname(), what
-            ))
-        self.generic_visit(node)
-
-
-def check_source(src: str, relpath: str) -> List[Violation]:
-    tree = ast.parse(src, filename=relpath)
-    sc = _Scanner(relpath, src.splitlines())
-    sc.visit(tree)
-    return sc.out
-
-
-def hot_path_files(root: str) -> List[Tuple[str, str]]:
-    """[(abs_path, rel_path)] of every hot-path module under `root`."""
-    out = []
-    for hp in HOT_PATHS:
-        full = os.path.join(root, hp)
-        if os.path.isfile(full):
-            out.append((full, hp))
-        elif os.path.isdir(full):
-            for dirpath, _dirs, files in os.walk(full):
-                for f in sorted(files):
-                    if not f.endswith(".py"):
-                        continue
-                    p = os.path.join(dirpath, f)
-                    out.append((p, os.path.relpath(p, root)))
-    return out
-
-
-def check_tree(root: str) -> List[Violation]:
-    violations: List[Violation] = []
-    for path, rel in hot_path_files(root):
-        with open(path) as f:
-            violations.extend(check_source(f.read(), rel.replace(os.sep, "/")))
-    return violations
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "--root",
-        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
-    args = ap.parse_args(argv)
-    violations = check_tree(args.root)
-    for v in violations:
-        print(v, file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} hot-path host-sync violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

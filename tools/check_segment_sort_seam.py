@@ -1,164 +1,35 @@
 #!/usr/bin/env python
 """Static check: device sorts in ops/ live ONLY in segment.py.
 
-The update kernel's pre-combine design is "pay ONE sort per micro-batch
-and feed every consumer from it": the accumulator scatter, the fire-
-eligibility (touched) plane, the kg_dirty changelog bits, and the
-kg_fill skew telemetry all ride the single ``segment.segment_sort``
-permutation (window_kernels.update; ISSUE 7). A sort is the most
-expensive reordering primitive the kernels use — XLA's CPU sort costs
-~4.5ms per 16k lanes, and on TPU it is the whole pre-combine budget —
-so a second sort quietly added to a kernel doubles exactly the cost the
-shared-sort seam exists to pay once.
-
-This checker fails the build when a sort primitive
-(``jnp.sort`` / ``jnp.argsort`` / ``jnp.lexsort`` / ``jax.lax.sort`` /
-``jax.lax.sort_key_val``, under any of the conventional module aliases)
-appears in ``flink_tpu/ops`` outside ``segment.py``. Kernels order
-lanes through the segment.py wrappers instead (``segment_sort``,
-``sort_values``, ``argsort_ids``, ``invert_permutation``), which keeps
-every sort call site greppable in one file and the one-sort-per-batch
-contract reviewable at the seam.
-
-Detection is AST-based (not grep) so strings/comments can't false-
-positive. There is deliberately NO inline-marker escape hatch: a new
-sort in a kernel is a design decision that belongs in segment.py, not
-an annotation.
-
-Wired into the tier-1 suite via tests/test_sort_seam.py.
-
-Usage:
-    python tools/check_segment_sort_seam.py [--root REPO_ROOT]
-Exit status 0 = clean, 1 = violations (printed one per line).
+THIN SHIM (ISSUE 9): the checker migrated into the unified invariant
+linter as the ``sort-seam`` rule — run ``python -m tools.lint`` for
+all 7 rules, or this script for the one check. Public API
+(check_source, check_tree, ops_files, main) is re-exported unchanged
+for tests/test_sort_seam.py and any other caller. Rule implementation:
+tools/lint/rules/sort_seam.py; catalog: docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import argparse
-import ast
 import os
 import sys
-from typing import List, NamedTuple, Tuple
 
-# the scanned tree and the one file sorts may live in
-OPS_PATH = "flink_tpu/ops"
-SORT_HOME = "flink_tpu/ops/segment.py"
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
-# sort primitives by attribute name; the owning module alias is checked
-# against the conventional jax/jnp/lax spellings so dict.sort() false
-# positives (list.sort is a bare Name call anyway) cannot fire
-SORT_ATTRS = ("sort", "argsort", "lexsort", "sort_key_val", "top_k")
-SORT_MODULES = ("jnp", "jax", "lax", "numpy", "np")
-
-
-class Violation(NamedTuple):
-    path: str
-    line: int
-    func: str
-    what: str
-
-    def __str__(self):
-        return (
-            f"{self.path}:{self.line}: {self.what} in {self.func!r} — "
-            f"device sorts in ops/ belong in segment.py (the one-sort "
-            f"pre-combine seam; see tools/check_segment_sort_seam.py)"
-        )
-
-
-def _sort_call(call: ast.Call):
-    """Return 'mod.attr' when this call is a sort primitive, else None."""
-    f = call.func
-    if not isinstance(f, ast.Attribute) or f.attr not in SORT_ATTRS:
-        return None
-    v = f.value
-    # jnp.sort / np.argsort
-    if isinstance(v, ast.Name) and v.id in SORT_MODULES:
-        return f"{v.id}.{f.attr}"
-    # jax.lax.sort / jax.numpy.argsort
-    if (
-        isinstance(v, ast.Attribute)
-        and isinstance(v.value, ast.Name)
-        and v.value.id in SORT_MODULES
-    ):
-        return f"{v.value.id}.{v.attr}.{f.attr}"
-    return None
-
-
-class _Scanner(ast.NodeVisitor):
-    def __init__(self, relpath: str):
-        self.relpath = relpath
-        self.stack: List[str] = []
-        self.out: List[Violation] = []
-
-    def _qualname(self) -> str:
-        return ".".join(self.stack) if self.stack else "<module>"
-
-    def visit_ClassDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    def visit_FunctionDef(self, node):
-        self.stack.append(node.name)
-        self.generic_visit(node)
-        self.stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node: ast.Call):
-        what = _sort_call(node)
-        if what is not None:
-            self.out.append(
-                Violation(self.relpath, node.lineno, self._qualname(), what)
-            )
-        self.generic_visit(node)
-
-
-def check_source(src: str, relpath: str) -> List[Violation]:
-    if relpath.replace(os.sep, "/") == SORT_HOME:
-        return []
-    tree = ast.parse(src, filename=relpath)
-    sc = _Scanner(relpath.replace(os.sep, "/"))
-    sc.visit(tree)
-    return sc.out
-
-
-def ops_files(root: str) -> List[Tuple[str, str]]:
-    """[(abs_path, rel_path)] of every module under flink_tpu/ops."""
-    out = []
-    full = os.path.join(root, OPS_PATH)
-    for dirpath, _dirs, files in os.walk(full):
-        for f in sorted(files):
-            if f.endswith(".py"):
-                p = os.path.join(dirpath, f)
-                out.append((p, os.path.relpath(p, root)))
-    return out
-
-
-def check_tree(root: str) -> List[Violation]:
-    violations: List[Violation] = []
-    for path, rel in ops_files(root):
-        with open(path) as f:
-            violations.extend(check_source(f.read(), rel))
-    return violations
-
-
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument(
-        "--root",
-        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    )
-    args = ap.parse_args(argv)
-    violations = check_tree(args.root)
-    for v in violations:
-        print(v, file=sys.stderr)
-    if violations:
-        print(f"{len(violations)} ops/ sort-seam violation(s)",
-              file=sys.stderr)
-        return 1
-    return 0
-
+from tools.lint.rules.sort_seam import (  # noqa: E402,F401
+    OPS_PATH,
+    SORT_ATTRS,
+    SORT_HOME,
+    SORT_MODULES,
+    SortSeamRule,
+    Violation,
+    check_source,
+    check_tree,
+    main,
+    ops_files,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,0 +1,34 @@
+"""Unified hot-path invariant linter (ISSUE 9).
+
+``python -m tools.lint`` runs all 7 rules (2 migrated one-off checkers
++ 5 new) over the repo with one shared parsed-module cache. See
+tools/lint/core.py for the framework and docs/static-analysis.md for
+the rule catalog.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# the shims under tools/ are imported as TOP-LEVEL modules by the
+# legacy tests (sys.path points at tools/); make the package importable
+# from there too
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from tools.lint.core import (  # noqa: E402,F401
+    Finding, LintInternalError, ParsedModule, RepoTree, Rule, run_rules,
+)
+from tools.lint.rules import all_rules, rule_by_name  # noqa: E402,F401
+
+DEFAULT_ROOT = _ROOT
+
+
+def run_lint(root: str = None, rule: str = None):
+    """All (or one) rule(s) over the repo; returns the finding list."""
+    tree = RepoTree(root or DEFAULT_ROOT)
+    rules = [rule_by_name(rule)] if rule else all_rules()
+    return run_rules(tree, rules)

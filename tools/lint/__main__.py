@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.lint [--rule X] [--json] [--root R]``.
+
+Exit codes are DISTINCT so CI can tell a dirty tree from a broken
+linter:
+
+    0  clean (no unsuppressed findings)
+    1  findings (printed one per line, or as JSON with --json)
+    2  internal error (unknown rule, unparseable module, bad root)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from tools.lint import (
+    DEFAULT_ROOT, LintInternalError, RepoTree, all_rules, rule_by_name,
+    run_rules,
+)
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_INTERNAL = 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="Unified hot-path invariant linter "
+                    "(docs/static-analysis.md)",
+    )
+    ap.add_argument("--rule", help="run only this rule (by name)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--root", default=DEFAULT_ROOT,
+                    help="repo root to scan")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.name:15s} [{r.established}] {r.title}")
+        return EXIT_CLEAN
+
+    try:
+        rules = [rule_by_name(args.rule)] if args.rule else all_rules()
+        t0 = time.perf_counter()
+        findings = run_rules(RepoTree(args.root), rules)
+        dt = time.perf_counter() - t0
+    except LintInternalError as e:
+        print(f"lint: internal error: {e}", file=sys.stderr)
+        return EXIT_INTERNAL
+    except Exception as e:  # noqa: BLE001 — any crash is exit 2, not 1
+        print(f"lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return EXIT_INTERNAL
+
+    if args.json:
+        print(json.dumps([
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "func": f.func, "message": f.message}
+            for f in findings
+        ], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(
+            f"lint: {len(findings)} finding(s), {len(rules)} rule(s), "
+            f"{dt:.2f}s", file=sys.stderr,
+        )
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

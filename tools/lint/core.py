@@ -1,0 +1,305 @@
+"""Shared AST-analysis framework for the hot-path invariant linter.
+
+PRs 1-8 established a set of invariants that keep the step loop
+saturated and the fault story honest — no host syncs on the hot path,
+one sort per micro-batch, no use-after-donate, declared-and-documented
+config keys, lock-or-single-writer discipline on cross-thread state,
+and a ``faults.inject`` seam on every raw IO call the chaos soak is
+supposed to reach. Two of those used to be guarded by copy-pasted
+one-off AST scripts; the rest were convention. This package makes them
+ONE framework:
+
+  * :class:`RepoTree` — parses each module ONCE and shares the cache
+    across every rule (the <5s tier-1 wall-time budget is a test).
+  * :class:`Rule` — the plugin interface: a rule declares its name, the
+    invariant it protects, which PR established it, and a ``check``
+    over the shared tree returning :class:`Finding`\\ s.
+  * Suppressions — ``# lint: allow(<rule>): <reason>`` on the flagged
+    line (or the line directly above) silences one finding; the reason
+    is MANDATORY — a reasonless allow is itself reported (as the
+    pseudo-rule ``suppression``), and rules may opt out of being
+    suppressible at all (the sort-seam rule does: a new sort in a
+    kernel is a design decision, not an annotation).
+  * CLI — ``python -m tools.lint [--rule X] [--json]``; exit 0 clean,
+    1 findings, 2 internal error (distinct so CI can tell "the tree is
+    dirty" from "the linter is broken").
+
+Wired into tier-1 via tests/test_lint.py: one parametrized module runs
+every rule against the repo (must be clean) and against a red-team
+fixture pair (must flag the bad snippet, pass the good one). Rule
+catalog: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*(?P<rule>[A-Za-z0-9_-]+)\s*\)"
+    r"(?::\s*(?P<reason>\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a repo-relative path + line."""
+
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    message: str
+    func: str = "<module>"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class ParsedModule:
+    """A parsed python module plus the raw text every rule shares."""
+
+    relpath: str       # '/'-separated, relative to the tree root
+    source: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+    def line_at(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class LintInternalError(Exception):
+    """The linter itself failed (unparseable module, unknown rule, bad
+    root). Distinct from findings so the CLI can exit 2, not 1."""
+
+
+class RepoTree:
+    """Parse-once module cache over the repo (or a virtual overlay).
+
+    Disk mode: ``RepoTree(root)``. Virtual mode (fixtures/tests):
+    ``RepoTree(files={relpath: source})`` — rules see exactly the given
+    files and nothing else, so a red-team snippet can impersonate
+    ``flink_tpu/runtime/step.py`` without touching the real tree.
+    """
+
+    def __init__(self, root: Optional[str] = None,
+                 files: Optional[Dict[str, str]] = None):
+        if (root is None) == (files is None):
+            raise LintInternalError("RepoTree needs exactly one of "
+                                    "root= or files=")
+        self.root = root
+        self._virtual = dict(files) if files is not None else None
+        self._cache: Dict[str, Optional[ParsedModule]] = {}
+
+    # -- raw text (conf files, docs) -----------------------------------
+    def exists(self, relpath: str) -> bool:
+        if self._virtual is not None:
+            return relpath in self._virtual
+        return os.path.exists(os.path.join(self.root, relpath))
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        if self._virtual is not None:
+            return self._virtual.get(relpath)
+        p = os.path.join(self.root, relpath)
+        if not os.path.isfile(p):
+            return None
+        try:
+            with open(p, encoding="utf-8", errors="replace") as f:
+                return f.read()
+        except OSError as e:
+            raise LintInternalError(f"cannot read {relpath}: {e}") from e
+
+    # -- parsed modules -------------------------------------------------
+    def module(self, relpath: str) -> Optional[ParsedModule]:
+        """Parse one module (cached; every rule shares the one parse).
+        Returns None when the file does not exist; raises
+        LintInternalError on a syntax error — an unparseable production
+        module is a broken build, not a finding."""
+        relpath = relpath.replace(os.sep, "/")
+        if relpath in self._cache:
+            return self._cache[relpath]
+        src = self.read_text(relpath)
+        if src is None:
+            self._cache[relpath] = None
+            return None
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except SyntaxError as e:
+            raise LintInternalError(
+                f"cannot parse {relpath}: {e}"
+            ) from e
+        pm = ParsedModule(relpath=relpath, source=src, tree=tree)
+        self._cache[relpath] = pm
+        return pm
+
+    def walk(self, *prefixes: str) -> List[ParsedModule]:
+        """Every .py module under the given relative files/directories,
+        parsed via the shared cache, sorted by relpath."""
+        rels: List[str] = []
+        for prefix in prefixes:
+            prefix = prefix.replace(os.sep, "/")
+            if self._virtual is not None:
+                for rp in self._virtual:
+                    if rp == prefix or (
+                        rp.startswith(prefix.rstrip("/") + "/")
+                        and rp.endswith(".py")
+                    ):
+                        rels.append(rp)
+                continue
+            full = os.path.join(self.root, prefix)
+            if os.path.isfile(full):
+                rels.append(prefix)
+            elif os.path.isdir(full):
+                for dirpath, _dirs, files in os.walk(full):
+                    for f in sorted(files):
+                        if f.endswith(".py"):
+                            rels.append(os.path.relpath(
+                                os.path.join(dirpath, f), self.root
+                            ).replace(os.sep, "/"))
+        out = []
+        for rp in sorted(set(rels)):
+            pm = self.module(rp)
+            if pm is not None:
+                out.append(pm)
+        return out
+
+
+class Rule:
+    """Plugin interface. Subclasses set the class attributes and
+    implement ``check``; the framework owns suppression filtering."""
+
+    name: str = ""
+    title: str = ""            # one-line invariant statement
+    established: str = ""      # which PR established the invariant
+    suppressible: bool = True  # sort-seam opts out: no escape hatch
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        raise NotImplementedError
+
+
+def _suppression_for(tree: RepoTree, path: str, line: int):
+    """The ``# lint: allow(rule): reason`` match covering ``line`` (the
+    line itself or the one directly above), else None. Works on any
+    text file with '#' comments — .py and the flat conf yaml alike."""
+    text = tree.read_text(path)
+    if text is None:
+        return None
+    lines = text.splitlines()
+    for ln in (line, line - 1):
+        if 0 < ln <= len(lines):
+            m = SUPPRESS_RE.search(lines[ln - 1])
+            if m:
+                return m
+    return None
+
+
+def apply_suppressions(tree: RepoTree, rules: Sequence[Rule],
+                       findings: Iterable[Finding]) -> List[Finding]:
+    """Drop findings covered by a reasoned allow-comment; emit a
+    ``suppression`` pseudo-finding for every reasonless allow."""
+    suppressible = {r.name for r in rules if r.suppressible}
+    out: List[Finding] = []
+    seen_bad_allows: set = set()
+    for f in findings:
+        m = _suppression_for(tree, f.path, f.line)
+        if m is None or f.rule not in suppressible:
+            out.append(f)
+            continue
+        if m.group("rule") != f.rule:
+            out.append(f)      # allow for a different rule: no cover
+            continue
+        if not (m.group("reason") or "").strip():
+            key = (f.path, f.line)
+            if key not in seen_bad_allows:
+                seen_bad_allows.add(key)
+                out.append(Finding(
+                    "suppression", f.path, f.line,
+                    f"allow({f.rule}) without a reason — the reason is "
+                    f"mandatory: '# lint: allow({f.rule}): <why>'",
+                    f.func,
+                ))
+            # the underlying finding stays suppressed: the author
+            # clearly intended it; the missing reason is the violation
+    return out
+
+
+def run_rules(tree: RepoTree, rules: Sequence[Rule]) -> List[Finding]:
+    """Run ``rules`` over ``tree`` and return post-suppression findings
+    sorted by (path, line). The shared RepoTree cache means each module
+    is parsed exactly once no matter how many rules scan it."""
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(tree))
+    out = apply_suppressions(tree, rules, raw)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# -- small AST helpers shared by rules ---------------------------------
+
+class QualnameVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains a class/function qualname stack —
+    the walking boilerplate the two pre-framework checkers each
+    copy-pasted."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+
+    def qualname(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def functions_in(tree: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """[(qualname, FunctionDef)] for every function in the module."""
+    out: List[Tuple[str, ast.AST]] = []
+
+    class V(QualnameVisitor):
+        def visit_FunctionDef(self, node):
+            self.stack.append(node.name)
+            out.append((".".join(self.stack), node))
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    V().visit(tree)
+    return out

@@ -1,0 +1,45 @@
+"""Rule plugins for the hot-path invariant linter (tools/lint).
+
+One module per rule; ALL_RULES is the registry the CLI and the tier-1
+test parametrize over. Catalog with the invariant each rule protects:
+docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.lint.core import Rule
+
+from tools.lint.rules.hot_path_sync import HotPathSyncRule
+from tools.lint.rules.sort_seam import SortSeamRule
+from tools.lint.rules.retrace import RetraceRule
+from tools.lint.rules.donation import DonationRule
+from tools.lint.rules.config_hygiene import ConfigHygieneRule
+from tools.lint.rules.thread_state import ThreadStateRule
+from tools.lint.rules.fault_seams import FaultSeamRule
+
+
+def all_rules() -> List[Rule]:
+    """Fresh instances, migration order first then ISSUE 9's five."""
+    return [
+        HotPathSyncRule(),
+        SortSeamRule(),
+        RetraceRule(),
+        DonationRule(),
+        ConfigHygieneRule(),
+        ThreadStateRule(),
+        FaultSeamRule(),
+    ]
+
+
+def rule_by_name(name: str) -> Rule:
+    for r in all_rules():
+        if r.name == name:
+            return r
+    from tools.lint.core import LintInternalError
+
+    raise LintInternalError(
+        f"unknown rule {name!r}; known: "
+        f"{', '.join(r.name for r in all_rules())}"
+    )

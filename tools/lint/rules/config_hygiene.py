@@ -1,0 +1,297 @@
+"""Rule ``config``: every config key is declared, documented, shipped,
+and type-consistent.
+
+PR 4 made conf-file strings coerce STRICTLY through the declared
+``ConfigOption`` type (a misspelled boolean is an error, never a
+silently-disabled watchdog). That guarantee only holds for keys that
+HAVE a declaration — a typed-getter read of an undeclared literal key
+(``config.get_int("stat.probe-len", 16)``) bypasses the whole scheme:
+no declared type, no default registry entry, no docs anchor, and a typo
+silently reads the fallback forever. Through rounds 6-8 the option
+space grew to ~40 keys (``recovery.elastic``, ``pipeline.fused-fire``,
+``state.packed-planes``, ...) and the drift is exactly what this rule
+pins down:
+
+  * read-undeclared — every literal key passed to
+    ``get_str/get_int/get_bool/get_float`` under ``flink_tpu/`` must
+    resolve to a declared ``ConfigOption``.
+  * conf-missing — every declared key must appear in
+    ``conf/flink-tpu-conf.yaml`` (a commented default line counts: the
+    file is the operator-facing key catalog).
+  * docs-missing — every declared key must be mentioned somewhere in
+    ``docs/*.md``.
+  * default-type-mismatch — a declared literal default must match the
+    option's declared/inferred type (bool-before-int, as
+    core/config.py coerces).
+  * default-drift — a literal fallback at a read site that contradicts
+    the declared default (two sources of truth disagreeing is how the
+    web handlers and the executor drift apart).
+  * perf-doc — performance knobs (``pipeline.*``, ``exchange.*``,
+    ``state.packed-planes``, ``execution.micro-batch-size``) must be
+    mentioned in docs/performance.md, and the keys served by the web
+    monitor's ``/checkpoints/config``-style routes (any literal read
+    in runtime/web.py) must be mentioned in docs/ — the route exists
+    so operators can see the knobs; the docs must name them.
+
+Established by PR 4 (strict coercion); unified + extended here
+(ISSUE 9).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from tools.lint.core import (
+    Finding, QualnameVisitor, RepoTree, Rule, const_str,
+)
+
+SCAN_ROOT = "flink_tpu"
+CONF_FILE = "conf/flink-tpu-conf.yaml"
+DOCS_DIR = "docs"
+PERF_DOC = "docs/performance.md"
+WEB_MODULE = "flink_tpu/runtime/web.py"
+
+TYPED_GETTERS = {
+    "get_str": str, "get_int": int, "get_bool": bool, "get_float": float,
+}
+
+PERF_PREFIXES = ("pipeline.", "exchange.")
+PERF_KEYS = ("state.packed-planes", "execution.micro-batch-size")
+
+
+def _mentions(text: str, key: str) -> bool:
+    """Token-bounded mention of ``key`` in ``text``: plain substring
+    would let a key that PREFIXES another declared key ride its
+    sibling's mention (delete the 'security.auth.token' conf line and
+    'security.auth.token-file' still contains it; same for
+    'restart-strategy' inside 'restart-strategy.fixed-delay.*'). A
+    trailing sentence period (dot NOT followed by a word char) still
+    counts as a boundary."""
+    return re.search(
+        r"(?<![\w.-])" + re.escape(key) + r"(?![\w-])(?!\.[\w-])", text
+    ) is not None
+
+
+def _py_type_of_literal(node: ast.AST) -> Optional[type]:
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if v is None:
+            return None
+        return bool if isinstance(v, bool) else type(v)
+    if isinstance(node, ast.BinOp):   # 1 << 16 style defaults
+        try:
+            return type(ast.literal_eval(node))
+        except (ValueError, TypeError, SyntaxError):
+            return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _py_type_of_literal(node.operand)
+    return None
+
+
+def _literal_value(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return _NOT_LITERAL
+
+
+_NOT_LITERAL = object()
+
+
+class Declaration:
+    def __init__(self, key: str, path: str, line: int,
+                 default_node: Optional[ast.AST],
+                 type_name: Optional[str]):
+        self.key = key
+        self.path = path
+        self.line = line
+        self.default_node = default_node
+        self.type_name = type_name
+
+
+def collect_declarations(tree: RepoTree) -> Dict[str, Declaration]:
+    decls: Dict[str, Declaration] = {}
+    for pm in tree.walk(SCAN_ROOT):
+        if "ConfigOption" not in pm.source:
+            continue
+        for node in ast.walk(pm.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func
+            name = (
+                fname.id if isinstance(fname, ast.Name)
+                else fname.attr if isinstance(fname, ast.Attribute)
+                else None
+            )
+            if name != "ConfigOption" or not node.args:
+                continue
+            key = const_str(node.args[0])
+            if key is None:
+                continue
+            default_node = node.args[1] if len(node.args) > 1 else None
+            type_name = None
+            for kw in node.keywords:
+                if kw.arg == "default" and default_node is None:
+                    default_node = kw.value
+                if kw.arg == "type" and isinstance(kw.value, ast.Name):
+                    type_name = kw.value.id
+            decls.setdefault(key, Declaration(
+                key, pm.relpath, node.lineno, default_node, type_name,
+            ))
+    return decls
+
+
+class _ReadScanner(QualnameVisitor):
+    """Literal-key typed-getter reads: (key, getter, default_node)."""
+
+    def __init__(self, relpath: str):
+        super().__init__()
+        self.relpath = relpath
+        self.reads: List[Tuple[str, str, Optional[ast.AST], int, str]] = []
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in TYPED_GETTERS
+            and node.args
+        ):
+            key = const_str(node.args[0])
+            if key is not None and "." in key:
+                default = node.args[1] if len(node.args) > 1 else None
+                self.reads.append(
+                    (key, f.attr, default, node.lineno, self.qualname())
+                )
+        self.generic_visit(node)
+
+
+class ConfigHygieneRule(Rule):
+    name = "config"
+    title = ("literal config reads resolve to declared ConfigOptions; "
+             "declared keys are shipped in conf/, documented in docs/, "
+             "and type/default-consistent")
+    established = "PR 4"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        out: List[Finding] = []
+        decls = collect_declarations(tree)
+        conf_text = tree.read_text(CONF_FILE) or ""
+        docs_text = self._docs_text(tree)
+        perf_text = tree.read_text(PERF_DOC) or ""
+
+        # -- read sites --------------------------------------------------
+        for pm in tree.walk(SCAN_ROOT):
+            sc = _ReadScanner(pm.relpath)
+            sc.visit(pm.tree)
+            for key, getter, default, line, qn in sc.reads:
+                decl = decls.get(key)
+                if decl is None:
+                    out.append(Finding(
+                        self.name, pm.relpath, line,
+                        f"config key {key!r} read via .{getter}() has no "
+                        f"declared ConfigOption — declare it (strict "
+                        f"coercion, defaults registry, docs anchor all "
+                        f"hang off the declaration)",
+                        qn,
+                    ))
+                    continue
+                if default is not None and decl.default_node is not None:
+                    rv = _literal_value(default)
+                    dv = _literal_value(decl.default_node)
+                    if (
+                        rv is not _NOT_LITERAL
+                        and dv is not _NOT_LITERAL
+                        and rv != dv
+                    ):
+                        out.append(Finding(
+                            self.name, pm.relpath, line,
+                            f"fallback {rv!r} for {key!r} contradicts "
+                            f"the declared default {dv!r} "
+                            f"({decl.path}:{decl.line}) — two sources "
+                            f"of truth; align them",
+                            qn,
+                        ))
+
+        # -- declarations ------------------------------------------------
+        for key, decl in sorted(decls.items()):
+            if not _mentions(conf_text, key):
+                out.append(Finding(
+                    self.name, decl.path, decl.line,
+                    f"declared option {key!r} is missing from "
+                    f"{CONF_FILE} — ship every key in the operator-"
+                    f"facing catalog (a commented default line counts)",
+                ))
+            if not _mentions(docs_text, key):
+                out.append(Finding(
+                    self.name, decl.path, decl.line,
+                    f"declared option {key!r} is not mentioned anywhere "
+                    f"in docs/ — document the knob",
+                ))
+            self._check_default_type(decl, out)
+            if (
+                key.startswith(PERF_PREFIXES) or key in PERF_KEYS
+            ) and not _mentions(perf_text, key):
+                out.append(Finding(
+                    self.name, decl.path, decl.line,
+                    f"performance knob {key!r} is not mentioned in "
+                    f"{PERF_DOC} — the perf doc's knob tables must "
+                    f"cover it",
+                ))
+
+        # -- web-route-served keys must be documented --------------------
+        web = tree.module(WEB_MODULE)
+        if web is not None:
+            sc = _ReadScanner(web.relpath)
+            sc.visit(web.tree)
+            for key, _getter, _default, line, qn in sc.reads:
+                if key in decls and not _mentions(docs_text, key):
+                    # already reported at the declaration; route-serving
+                    # makes it worth anchoring at the handler too
+                    out.append(Finding(
+                        self.name, web.relpath, line,
+                        f"web route serves config key {key!r} that docs/ "
+                        f"never mentions — operators can see the knob "
+                        f"but cannot look it up",
+                        qn,
+                    ))
+        return out
+
+    def _docs_text(self, tree: RepoTree) -> str:
+        chunks = []
+        if tree._virtual is not None:
+            for rp in tree._virtual:
+                if rp.startswith(DOCS_DIR + "/"):
+                    chunks.append(tree.read_text(rp) or "")
+        else:
+            import os
+            d = os.path.join(tree.root, DOCS_DIR)
+            if os.path.isdir(d):
+                for fn in sorted(os.listdir(d)):
+                    if fn.endswith(".md"):
+                        chunks.append(
+                            tree.read_text(f"{DOCS_DIR}/{fn}") or ""
+                        )
+        return "\n".join(chunks)
+
+    def _check_default_type(self, decl: Declaration, out: List[Finding]):
+        if decl.default_node is None:
+            return
+        lit_t = _py_type_of_literal(decl.default_node)
+        if lit_t is None:
+            return
+        declared_t = {
+            "str": str, "int": int, "bool": bool, "float": float,
+        }.get(decl.type_name or "")
+        if declared_t is None:
+            return
+        ok = lit_t is declared_t or (declared_t is float and lit_t is int)
+        if not ok:
+            out.append(Finding(
+                self.name, decl.path, decl.line,
+                f"option {decl.key!r} declares type="
+                f"{decl.type_name} but its default is a "
+                f"{lit_t.__name__} — strict coercion will fight the "
+                f"default; align them",
+            ))

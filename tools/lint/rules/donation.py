@@ -1,0 +1,269 @@
+"""Rule ``donation``: no read of a variable after it was passed in a
+donated position to a ``donate_argnums``-compiled function.
+
+Every windowed step in runtime/step.py donates its state argument
+(``@partial(jax.jit, donate_argnums=(0,))``): XLA updates the 100MB+
+shard arrays in place instead of copy-on-write. The contract is that
+the caller must NOT touch the old reference afterwards — a read
+dereferences a deleted buffer and raises (or worse, on some backends,
+silently reads garbage). The executor's recovery and snapshot paths
+each tripped over this by hand before the rule existed (PR 5's
+megastep-boundary work documents the discipline at length).
+
+Detection is two-pass over the shared module cache:
+
+  * Pass 1 collects "donated callables" across the scoped modules:
+    functions compiled with ``donate_argnums`` (decorator or
+    ``jax.jit(f, donate_argnums=...)`` call) and — the cross-module
+    half — ``build_*`` factories in runtime/step.py whose returned
+    inner function is donated (including the thin-wrapper case, e.g.
+    build_window_update_step_exchange returning a plain wrapper around
+    its donated ``_jit_step``).
+  * Pass 2 walks each function in the scoped modules: a call through a
+    resolvable donated callable (a local name or ``self.attr`` bound
+    from a donated builder, or a directly-donated def) marks the plain
+    ``Name`` passed at each donated position as DEAD; any later load of
+    that name in the same function — by line order, with no intervening
+    rebind — is a finding. ``state, aux = step(state, ...)`` is the
+    sanctioned idiom: the assignment rebinds the name at the call line.
+
+The analysis is deliberately straight-line (line-ordered within one
+function body); it resolves the idioms this codebase actually uses and
+is documented not to chase attribute aliasing. Established by PR 5;
+unified here (ISSUE 9).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.core import (
+    Finding, RepoTree, Rule, dotted_name, functions_in,
+)
+
+SCOPE = (
+    "flink_tpu/runtime/step.py",
+    "flink_tpu/runtime/executor.py",
+    "flink_tpu/runtime/dcn.py",
+    "flink_tpu/cep/accel.py",
+)
+
+# module that owns the donated step factories (pass 1 cross-module map)
+BUILDER_HOME = "flink_tpu/runtime/step.py"
+
+
+def _donate_argnums_of(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """(argnums,) when ``call`` is jax.jit/partial(jax.jit, ...) with a
+    donate_argnums constant, else None."""
+    dn = dotted_name(call.func)
+    is_jit = dn in ("jax.jit", "jit")
+    if dn == "partial" and call.args and dotted_name(
+            call.args[0]) in ("jax.jit", "jit"):
+        is_jit = True
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                nums = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                            el.value, int):
+                        nums.append(el.value)
+                return tuple(nums)
+            return ()   # non-constant: donation exists, positions unknown
+    return None
+
+
+def _donated_defs(scope_tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """{function name: donated argnums} for defs under ``scope_tree``
+    whose decorators carry donate_argnums."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(scope_tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                nums = _donate_argnums_of(dec)
+                if nums:
+                    out[node.name] = nums
+    return out
+
+
+def donated_builders(tree: RepoTree) -> Dict[str, Tuple[int, ...]]:
+    """{builder name: donated argnums} for ``build_*`` factories in
+    runtime/step.py that return a donated inner function (directly, or
+    through a one-hop wrapper that forwards its first argument)."""
+    pm = tree.module(BUILDER_HOME)
+    if pm is None:
+        return {}
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in pm.tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        inner = _donated_defs(node)
+        if not inner:
+            continue
+        # inner defs by name, for the wrapper hop
+        defs = {
+            n.name: n for n in ast.walk(node)
+            if isinstance(n, ast.FunctionDef) and n is not node
+        }
+        returned: Optional[str] = None
+        for stmt in node.body:
+            if isinstance(stmt, ast.Return) and isinstance(
+                    stmt.value, ast.Name):
+                returned = stmt.value.id
+        if returned is None:
+            continue
+        if returned in inner:
+            out[node.name] = inner[returned]
+            continue
+        wrapper = defs.get(returned)
+        if wrapper is None or not wrapper.args.args:
+            continue
+        first_param = wrapper.args.args[0].arg
+        for call in ast.walk(wrapper):
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id in inner
+                and call.args
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id == first_param
+            ):
+                out[node.name] = inner[call.func.id]
+                break
+    return out
+
+
+def _local_donated_callables(mod_tree: ast.AST,
+                             builders: Dict[str, Tuple[int, ...]],
+                             ) -> Dict[str, Tuple[int, ...]]:
+    """Names (and 'self.attr' paths) bound to donated callables in this
+    module: donated defs, jax.jit(f, donate_argnums=...) assignments,
+    and assignments from donated builders."""
+    out: Dict[str, Tuple[int, ...]] = dict(_donated_defs(mod_tree))
+    for node in ast.walk(mod_tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        nums = _donate_argnums_of(call)
+        if nums is None:
+            callee = dotted_name(call.func)
+            if callee is not None:
+                nums = builders.get(callee.rsplit(".", 1)[-1])
+        if not nums:
+            continue
+        for t in node.targets:
+            dn = dotted_name(t)
+            if dn:
+                out[dn] = nums
+    return out
+
+
+def _walk_shallow(fn: ast.AST):
+    """ast.walk limited to ONE function scope: does not descend into
+    nested defs/lambdas (their reads/kills are analysed separately —
+    a nonlocal donated name crossing scopes is beyond the straight-line
+    contract and stays the author's responsibility)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _NameEvents(ast.NodeVisitor):
+    """All Name loads/stores in one function body (not nested defs)."""
+
+    def __init__(self):
+        self.loads: List[Tuple[str, int]] = []
+        self.stores: List[Tuple[str, int]] = []
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            self.loads.append((node.id, node.lineno))
+        else:
+            self.stores.append((node.id, node.lineno))
+
+    def visit_FunctionDef(self, node):
+        pass          # nested defs are separate scopes
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class DonationRule(Rule):
+    name = "donation"
+    title = ("no read of a variable after it was passed in a donated "
+             "position to a donate_argnums-compiled function")
+    established = "PR 5"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        builders = donated_builders(tree)
+        out: List[Finding] = []
+        for pm in tree.walk(*SCOPE):
+            donated = _local_donated_callables(pm.tree, builders)
+            if not donated:
+                continue
+            for qn, fn in functions_in(pm.tree):
+                out.extend(self._check_function(pm, qn, fn, donated))
+        return out
+
+    def _check_function(self, pm, qn, fn, donated) -> List[Finding]:
+        # donating calls directly in this function body;
+        # (name, call_start, call_end, callee) — a rebind anywhere from
+        # the call statement's first line on revives the name (the
+        # `state, aux = step(state, ...)` idiom may span lines)
+        kills: List[Tuple[str, int, int, str]] = []
+        for node in _walk_shallow(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            nums = donated.get(callee)
+            if not nums:
+                continue
+            for pos in nums:
+                if pos < len(node.args) and isinstance(
+                        node.args[pos], ast.Name):
+                    kills.append((
+                        node.args[pos].id,
+                        node.lineno,
+                        getattr(node, "end_lineno", node.lineno),
+                        callee,
+                    ))
+        if not kills:
+            return []
+        ev = _NameEvents()
+        for stmt in fn.body:
+            ev.visit(stmt)
+        out: List[Finding] = []
+        for name, kstart, kend, callee in kills:
+            revive = [ln for n, ln in ev.stores
+                      if n == name and ln >= kstart]
+            for n, ln in ev.loads:
+                if n != name or ln <= kend:
+                    continue
+                if any(r <= ln for r in revive):
+                    continue
+                out.append(Finding(
+                    self.name, pm.relpath, ln,
+                    f"{name!r} read after being DONATED to {callee!r} "
+                    f"(line {kstart}) — the buffer is invalidated by "
+                    f"donate_argnums; rebind the result or snapshot "
+                    f"before the call",
+                    qn,
+                ))
+                break   # one finding per (kill, name) is enough
+        return out

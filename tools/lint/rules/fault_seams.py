@@ -1,0 +1,140 @@
+"""Rule ``fault-seam``: raw IO in the durability layers sits within or
+adjacent to a ``faults.inject`` point.
+
+The chaos soak (PR 4) and the MTTR drill (PR 6) assert exactly-once
+UNDER injected faults — but they can only reach the failure modes that
+have a ``faults.inject("<point>")`` seam in front of them. A raw
+``open``/``os.replace``/socket call added to the checkpoint or DCN
+path without a seam silently shrinks the soak's reach: the new IO can
+fail in production in a way no test can schedule. This rule pins the
+seam coverage:
+
+Every raw IO call (builtin ``open``, ``os.replace``/``os.rename``,
+socket ``send``/``sendall``/``recv``/``recv_into``/``sendto``/
+``recvfrom``) inside ``flink_tpu/checkpointing/`` or
+``flink_tpu/runtime/dcn.py`` must be
+
+  * in a function that contains a ``faults.inject(...)`` call (the
+    seam guards the whole operation), or
+  * in a helper whose intra-module callers ALL contain one (the
+    ``_send_all`` pattern: the seam fires once per frame at the call
+    site, outside the retry-slice loop), or
+  * suppressed with a reasoned ``# lint: allow(fault-seam): ...`` for
+    IO that is genuinely outside the fault story (e.g. a CLI's final
+    result dump).
+
+Established by PR 4 (failure containment); unified here (ISSUE 9).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.core import (
+    Finding, RepoTree, Rule, dotted_name, functions_in,
+)
+
+SCOPE = (
+    "flink_tpu/checkpointing",
+    "flink_tpu/runtime/dcn.py",
+)
+
+SOCKET_ATTRS = {
+    "send", "sendall", "sendto", "recv", "recv_into", "recvfrom",
+    "recvmsg", "sendmsg",
+}
+OS_IO = {"os.replace", "os.rename"}
+
+
+def _io_call(node: ast.Call) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return "open(...)"
+    dn = dotted_name(f)
+    if dn in OS_IO:
+        return f"{dn}(...)"
+    if isinstance(f, ast.Attribute) and f.attr in SOCKET_ATTRS:
+        return f".{f.attr}(...)"
+    return None
+
+
+def _has_inject(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn is not None and (
+                dn == "inject" or dn.endswith(".inject")
+            ):
+                return True
+    return False
+
+
+class FaultSeamRule(Rule):
+    name = "fault-seam"
+    title = ("raw IO in checkpointing/ and runtime/dcn.py is covered by "
+             "a faults.inject seam (directly or at every call site)")
+    established = "PR 4"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        out: List[Finding] = []
+        for pm in tree.walk(*SCOPE):
+            funcs = functions_in(pm.tree)
+            inject_by_name: Dict[str, bool] = {}
+            callers: Dict[str, List[str]] = {}
+            for qn, fn in funcs:
+                short = qn.rsplit(".", 1)[-1]
+                inject_by_name[short] = (
+                    inject_by_name.get(short, False) or _has_inject(fn)
+                )
+            for qn, fn in funcs:
+                caller = qn.rsplit(".", 1)[-1]
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        dn = dotted_name(node.func)
+                        if dn is None:
+                            continue
+                        callee = dn.rsplit(".", 1)[-1]
+                        if callee in inject_by_name and callee != caller:
+                            callers.setdefault(callee, []).append(caller)
+
+            # innermost enclosing function per IO call
+            spans: List[Tuple[str, ast.AST]] = funcs
+            for node in ast.walk(pm.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = _io_call(node)
+                if what is None:
+                    continue
+                qn, fn = self._innermost(spans, node)
+                if fn is not None and _has_inject(fn):
+                    continue
+                short = qn.rsplit(".", 1)[-1] if fn is not None else None
+                if short is not None:
+                    cs = callers.get(short, [])
+                    if cs and all(inject_by_name.get(c, False)
+                                  for c in cs):
+                        continue
+                out.append(Finding(
+                    self.name, pm.relpath, node.lineno,
+                    f"raw IO {what} in {qn if fn is not None else '<module>'!r} "
+                    f"has no faults.inject seam within or adjacent — the "
+                    f"chaos soak cannot schedule this failure; add a "
+                    f"named injection point (see "
+                    f"flink_tpu/testing/faults.py catalog) or suppress "
+                    f"with a reason",
+                    qn if fn is not None else "<module>",
+                ))
+        return out
+
+    @staticmethod
+    def _innermost(spans, node) -> Tuple[str, Optional[ast.AST]]:
+        best_qn, best_fn, best_size = "<module>", None, None
+        for qn, fn in spans:
+            start = fn.lineno
+            end = getattr(fn, "end_lineno", start)
+            if start <= node.lineno <= end:
+                size = end - start
+                if best_size is None or size < best_size:
+                    best_qn, best_fn, best_size = qn, fn, size
+        return best_qn, best_fn

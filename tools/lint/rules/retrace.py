@@ -1,0 +1,211 @@
+"""Rule ``retrace``: jit-boundary retrace/realloc hazards in the
+dispatch hot sections.
+
+The PR 3 frozen-mask-template bug class: the per-batch prep path
+allocated a fresh ``np.ones`` valid-mask per call and padded it, so
+every dispatch paid a host allocation + copy that the hoisted template
+(``valid_tmpl`` + a view slice) provides for free. More generally,
+anything FRESH the host builds per call and feeds into a jitted
+callable — a new numpy array, a compile inside the loop, a
+Python-varying scalar — either costs a per-step allocation/transfer or,
+for a compile, a full retrace (~seconds) per iteration.
+
+Three checks, scoped to the dispatch path:
+
+  1. fresh host allocations (``np.ones/zeros/empty/full/arange``)
+     anywhere inside the declared HOT_SECTIONS functions (the step
+     loop's per-dispatch bodies). A deliberate tiny-vector exception —
+     run_update's ``wmv``, which rides the step's queued input transfer
+     precisely so it does NOT cost an eager device op — carries a
+     reasoned ``# lint: allow(retrace): ...``.
+  2. compiling in a loop: ``jax.jit(...)`` or a ``build_*`` step
+     factory invoked inside a ``for``/``while`` body anywhere in the
+     scoped modules (each iteration traces + compiles afresh), or
+     invoked at all inside a HOT_SECTIONS function.
+  3. Python-varying scalars (``time.*()``, ``random.*()``) or fresh
+     numpy allocations passed DIRECTLY as arguments to a callable the
+     module resolvably compiled with ``jax.jit`` or obtained from a
+     ``build_*`` factory.
+
+Scope: flink_tpu/runtime/step.py and flink_tpu/runtime/executor.py —
+the modules that own the compiled-step boundary. Established by PR 3
+(pipelined ingest); unified here (ISSUE 9).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.lint.core import (
+    Finding, QualnameVisitor, RepoTree, Rule, dotted_name,
+)
+
+SCOPE = (
+    "flink_tpu/runtime/step.py",
+    "flink_tpu/runtime/executor.py",
+)
+
+# per-dispatch bodies of the step loop, by (module, innermost function
+# name). Everything these run is paid once per micro-batch (or per
+# fused megastep) — the budget the whole round-5/7 effort bought back.
+HOT_SECTIONS: Dict[str, Set[str]] = {
+    "flink_tpu/runtime/executor.py": {"run_update", "run_update_fused"},
+}
+
+ALLOC_ATTRS = ("ones", "zeros", "empty", "full", "arange")
+NP_NAMES = ("np", "numpy")
+VARYING_MODULES = ("time", "random")
+
+
+def _is_np_alloc(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr in ALLOC_ATTRS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in NP_NAMES
+    ):
+        return f"{f.value.id}.{f.attr}"
+    return None
+
+
+def _is_varying_scalar(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Name)
+        and f.value.id in VARYING_MODULES
+    ):
+        return f"{f.value.id}.{f.attr}()"
+    return None
+
+
+def _is_jit_constructor(call: ast.Call) -> Optional[str]:
+    """'jax.jit(...)', 'jit(...)', 'partial(jax.jit, ...)' or a
+    'build_*' step-factory call — anything that traces + compiles."""
+    dn = dotted_name(call.func)
+    if dn in ("jax.jit", "jit"):
+        return dn
+    if dn == "partial" and call.args:
+        inner = dotted_name(call.args[0])
+        if inner in ("jax.jit", "jit"):
+            return "partial(jax.jit, ...)"
+    if dn is not None:
+        last = dn.rsplit(".", 1)[-1]
+        if last.startswith("build_"):
+            return dn
+    return None
+
+
+def collect_jitted_names(tree: ast.AST) -> Set[str]:
+    """Names resolvably bound to a compiled callable in this module:
+    ``f = jax.jit(...)``, ``f = build_*(...)``, ``self.x = build_*(...)``
+    (as 'self.x'), and defs decorated with jax.jit/partial(jax.jit)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_constructor(node.value):
+                for t in node.targets:
+                    dn = dotted_name(t)
+                    if dn:
+                        out.add(dn)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dn = dotted_name(dec)
+                if dn in ("jax.jit", "jit"):
+                    out.add(node.name)
+                elif isinstance(dec, ast.Call) and _is_jit_constructor(dec):
+                    out.add(node.name)
+    return out
+
+
+class _Scanner(QualnameVisitor):
+    def __init__(self, rule: "RetraceRule", relpath: str,
+                 jitted: Set[str], hot_funcs: Set[str]):
+        super().__init__()
+        self.rule = rule
+        self.relpath = relpath
+        self.jitted = jitted
+        self.hot_funcs = hot_funcs
+        self.loop_depth = 0
+        self.out: List[Finding] = []
+
+    def _in_hot_section(self) -> bool:
+        return any(part in self.hot_funcs for part in self.stack)
+
+    def _emit(self, node, msg):
+        self.out.append(Finding(
+            self.rule.name, self.relpath, node.lineno, msg,
+            self.qualname(),
+        ))
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+    visit_AsyncFor = visit_For
+
+    def visit_FunctionDef(self, node):
+        # a nested def inside a loop body is deferred work, not per-
+        # iteration work: reset the loop depth inside it
+        saved, self.loop_depth = self.loop_depth, 0
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+        self.loop_depth = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        hot = self._in_hot_section()
+        alloc = _is_np_alloc(node)
+        if alloc and hot:
+            self._emit(node, (
+                f"{alloc}(...) allocated per dispatch in hot section "
+                f"{self.qualname()!r} — hoist it to setup (the PR 3 "
+                f"frozen-template fix) or justify it with an allow "
+                f"reason"
+            ))
+        jc = _is_jit_constructor(node)
+        if jc is not None and (self.loop_depth > 0 or hot):
+            where = ("inside a loop" if self.loop_depth > 0
+                     else f"in hot section {self.qualname()!r}")
+            self._emit(node, (
+                f"{jc}(...) invoked {where} — each call traces and "
+                f"compiles afresh (a retrace storm); compile once at "
+                f"setup and reuse the callable"
+            ))
+        # fresh/varying values flowing directly into a compiled callable
+        callee = dotted_name(node.func)
+        if callee in self.jitted:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Call):
+                    what = _is_np_alloc(arg) or _is_varying_scalar(arg)
+                    if what:
+                        self._emit(arg, (
+                            f"{what} built fresh in the argument list of "
+                            f"compiled callable {callee!r} — per-call "
+                            f"host work on the jit boundary; hoist or "
+                            f"stage it"
+                        ))
+        self.generic_visit(node)
+
+
+class RetraceRule(Rule):
+    name = "retrace"
+    title = ("no fresh host allocations, in-loop compiles, or varying "
+             "scalars on the jitted dispatch boundary")
+    established = "PR 3"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        out: List[Finding] = []
+        for pm in tree.walk(*SCOPE):
+            jitted = collect_jitted_names(pm.tree)
+            sc = _Scanner(self, pm.relpath, jitted,
+                          HOT_SECTIONS.get(pm.relpath, set()))
+            sc.visit(pm.tree)
+            out.extend(sc.out)
+        return out

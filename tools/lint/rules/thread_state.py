@@ -1,0 +1,265 @@
+"""Rule ``thread-state``: state mutated from the named background
+threads is lock-covered or registered single-writer.
+
+The runtime keeps four long-lived background threads next to the step
+loop — the ingest producer (runtime/ingest.py), the checkpoint
+materializer (checkpointing/materializer.py), the watchdog monitor
+(runtime/watchdog.py), and the web monitor's handler threads
+(runtime/web.py). PR 3's KeyCodec-lock and mon_watch-deque bugs were
+both the same shape: an attribute the step loop reads, quietly mutated
+from one of those threads with nothing declaring the discipline. This
+rule makes the discipline structural:
+
+Every ``self.<attr>`` mutation (assign, augmented assign, subscript
+store/delete, or a known mutator-method call like ``.append``/
+``.pop``) reachable from a background-thread entry point must be
+
+  * lexically inside ``with self.<lock>:`` where ``<lock>`` is an
+    attribute the module assigns from ``threading.Lock/RLock/
+    Condition/Semaphore`` — auto-detected, no annotation needed; or
+  * a call on an attribute that IS a synchronization/queue primitive
+    (``threading.Event``, ``queue.Queue`` — their methods are the
+    sanctioned cross-thread mechanism); or
+  * registered in :data:`flink_tpu.runtime.thread_state.SHARED_STATE`
+    (parsed as a literal — the linter never imports runtime code) as
+    ``single-writer:<thread>`` or ``locked-by-caller:<lock>`` with a
+    reason.
+
+Thread entry points are found structurally: ``threading.Thread(
+target=self.X)`` in a scoped module makes method ``X`` (plus every
+same-class method it transitively calls through ``self``) background-
+thread code; ``do_GET``-style handler methods are web-thread entries.
+The analysis is self-attribute-scoped by design — mutations through
+local aliases or foreign objects are out of reach and the registry
+documents the contract for those.
+
+Established by PR 3 (ingest pipelining); unified here (ISSUE 9).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.lint.core import Finding, RepoTree, Rule, dotted_name
+
+SCOPE = (
+    "flink_tpu/runtime/ingest.py",
+    "flink_tpu/runtime/watchdog.py",
+    "flink_tpu/runtime/web.py",
+    "flink_tpu/checkpointing/materializer.py",
+)
+
+REGISTRY_MODULE = "flink_tpu/runtime/thread_state.py"
+REGISTRY_NAME = "SHARED_STATE"
+
+HANDLER_ENTRIES = {
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_PATCH", "do_HEAD",
+    "log_message", "handle_one_request",
+}
+
+MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "discard", "remove", "pop", "popleft", "clear", "update",
+    "setdefault", "sort", "reverse", "put_nowait",
+}
+
+SYNC_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Event", "queue.Queue", "queue.SimpleQueue",
+    "queue.LifoQueue", "queue.PriorityQueue",
+}
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+}
+
+
+def load_registry(tree: RepoTree) -> Dict[str, str]:
+    """SHARED_STATE from the annotation registry module, parsed as an
+    AST literal — {'Class.attr': 'policy — reason'}."""
+    pm = tree.module(REGISTRY_MODULE)
+    if pm is None:
+        return {}
+    for node in pm.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == REGISTRY_NAME:
+                    try:
+                        v = ast.literal_eval(node.value)
+                    except (ValueError, TypeError, SyntaxError):
+                        return {}
+                    if isinstance(v, dict):
+                        return {str(k): str(val) for k, val in v.items()}
+    return {}
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.sync_attrs: Set[str] = set()    # Event/Queue/Lock/... attrs
+        self.lock_attrs: Set[str] = set()    # with-able lock attrs
+        self.thread_entries: Set[str] = set()
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' when node is ``self.attr``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _collect_classes(mod_tree: ast.AST) -> List[_ClassInfo]:
+    out: List[_ClassInfo] = []
+    for node in ast.walk(mod_tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = _ClassInfo(node.name)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.FunctionDef):
+                ci.methods.setdefault(sub.name, sub)
+            # self.X = threading.Lock() / Event() / queue.Queue()
+            if isinstance(sub, ast.Assign) and isinstance(
+                    sub.value, ast.Call):
+                factory = dotted_name(sub.value.func)
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if attr is None or factory is None:
+                        continue
+                    if factory in SYNC_FACTORIES:
+                        ci.sync_attrs.add(attr)
+                    if factory in LOCK_FACTORIES:
+                        ci.lock_attrs.add(attr)
+            # threading.Thread(target=self.X)
+            if isinstance(sub, ast.Call) and dotted_name(
+                    sub.func) == "threading.Thread":
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        attr = _self_attr(kw.value)
+                        if attr is not None:
+                            ci.thread_entries.add(attr)
+        for mname in ci.methods:
+            if mname in HANDLER_ENTRIES:
+                ci.thread_entries.add(mname)
+        out.append(ci)
+    return out
+
+
+def _thread_reachable(ci: _ClassInfo) -> Dict[str, str]:
+    """{method name: entry it is reachable from} via self.X() calls."""
+    reach: Dict[str, str] = {}
+    work = [(e, e) for e in ci.thread_entries if e in ci.methods]
+    while work:
+        mname, entry = work.pop()
+        if mname in reach:
+            continue
+        reach[mname] = entry
+        body = ci.methods[mname]
+        for node in ast.walk(body):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr is not None and attr in ci.methods:
+                    work.append((attr, entry))
+    return reach
+
+
+class _MutationScanner(ast.NodeVisitor):
+    """Mutations of self attributes in one method, with lock coverage."""
+
+    def __init__(self, ci: _ClassInfo):
+        self.ci = ci
+        self.lock_depth = 0
+        # (attr, lineno, kind, covered_by_with_lock)
+        self.out: List[Tuple[str, int, str, bool]] = []
+
+    def visit_With(self, node):
+        covers = any(
+            _self_attr(item.context_expr) in self.ci.lock_attrs
+            for item in node.items
+        )
+        if covers:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if covers:
+            self.lock_depth -= 1
+
+    def _rec(self, attr: str, lineno: int, kind: str):
+        self.out.append((attr, lineno, kind, self.lock_depth > 0))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target, node.lineno, kind="augmented assign")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            self._target(t, node.lineno, kind="delete")
+        self.generic_visit(node)
+
+    def _target(self, t: ast.AST, lineno: int, kind: str = "assign"):
+        attr = _self_attr(t)
+        if attr is not None:
+            self._rec(attr, lineno, kind)
+            return
+        # self.attr[i] = ... / del self.attr[i]
+        if isinstance(t, ast.Subscript):
+            attr = _self_attr(t.value)
+            if attr is not None:
+                self._rec(attr, lineno, f"subscript {kind}")
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS:
+            attr = _self_attr(f.value)
+            if attr is not None and attr not in self.ci.sync_attrs:
+                self._rec(attr, node.lineno, f".{f.attr}() call")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass          # nested defs: separate analysis scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class ThreadStateRule(Rule):
+    name = "thread-state"
+    title = ("attributes mutated from the ingest/materializer/watchdog/"
+             "web threads are lock-covered or registered single-writer")
+    established = "PR 3"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        registry = load_registry(tree)
+        out: List[Finding] = []
+        for pm in tree.walk(*SCOPE):
+            for ci in _collect_classes(pm.tree):
+                reach = _thread_reachable(ci)
+                for mname, entry in reach.items():
+                    sc = _MutationScanner(ci)
+                    for stmt in ci.methods[mname].body:
+                        sc.visit(stmt)
+                    for attr, lineno, kind, covered in sc.out:
+                        if covered:
+                            continue
+                        key = f"{ci.name}.{attr}"
+                        if key in registry:
+                            continue
+                        out.append(Finding(
+                            self.name, pm.relpath, lineno,
+                            f"{key} mutated ({kind}) on the background "
+                            f"thread entered via {ci.name}.{entry} "
+                            f"without a covering lock — wrap it in "
+                            f"`with self.<lock>:` or register it in "
+                            f"{REGISTRY_MODULE} with a policy + reason",
+                            f"{ci.name}.{mname}",
+                        ))
+        return out
