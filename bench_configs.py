@@ -1411,6 +1411,38 @@ def run_device_update_ceiling(total_events: int, cpu: bool):
             "fused_fire": round(measure_fused_fire(KB, DB, **kw)),
         }
 
+    # structural stamp (ISSUE 11): grouped op counts, signature digest
+    # and compiled memory_analysis bytes for three representative
+    # ceiling kernels AT THE BENCH DIMS — so the perf artifact carries
+    # a structural trajectory (did a sort appear? did the temp
+    # footprint move?) next to events/s. Telemetry only: a stamp
+    # failure never changes the bench verdict.
+    try:
+        from tools.lint.kernel_audit import kernel_structural_stamp
+
+        sds = jax.ShapeDtypeStruct
+        batch_sig = (sds((B,), jnp.uint32), sds((B,), jnp.uint32),
+                     sds((B,), jnp.int32), sds((B,), jnp.float32),
+                     sds((B,), jnp.bool_))
+        wm_sig = sds((n_dev,), jnp.int32)
+        wmv_sig = sds((n_dev, KB), jnp.int32)
+        spec_a = _spec(KB, DB, pre_default)
+        st = init_sharded_state(ctx, spec_a)
+        detail["audit"] = {
+            "update_K1": kernel_structural_stamp(
+                build_window_update_step(ctx, spec_a),
+                (st,) + batch_sig + (wm_sig,)),
+            f"megastep_fired_K{KB}_reduced": kernel_structural_stamp(
+                build_window_megastep_fired(ctx, spec_a, KB,
+                                            reduced=True),
+                (st,) + batch_sig * KB + (wmv_sig,)),
+            "fire_reduced": kernel_structural_stamp(
+                build_window_fire_reduced_step(ctx, spec_a),
+                (st, wm_sig)),
+        }
+    except Exception as ex:  # noqa: BLE001 — never the bench verdict
+        detail["audit"] = {"error": f"{type(ex).__name__}: {ex}"}
+
     print(json.dumps(
         {"config": "device_update_ceiling", "detail": detail}), flush=True)
     return (best_fused[1], best_split[1])

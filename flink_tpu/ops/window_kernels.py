@@ -1498,3 +1498,78 @@ def advance_and_fire_resident(
         purged_through=new_purged,
     )
     return new_state, purgeable, fires
+
+
+# --------------------------------------------- canonical kernel families
+
+def kernel_family_grid(capacity: int = 64, probe_len: int = 4,
+                       batch: int = 8):
+    """Raw-kernel half of the canonical audit grid (the step-builder
+    half lives in runtime/step.py kernel_family_grid, next to the
+    builders): ``[(name, fn, example_args)]`` for every public kernel in
+    this module, one entry per layout/plane variant the runtime
+    dispatches. The compiled-graph auditor (tools/lint trace tier)
+    make_jaxprs each entry and holds its primitive counts against the
+    checked-in op-budget ledger — the one-sort precombine seam and the
+    packed single-scatter plane are contracts here, not prose. None of
+    these are jitted or donated: the jit/donation story is the step
+    builders'; this grid pins the kernel bodies themselves."""
+    win = WindowSpec(4, 2, ring=4, fires_per_step=2, overflow=4)
+    red = ReduceSpec("sum", jnp.float32)
+    B = batch
+    hi = jnp.arange(B, dtype=jnp.uint32) * jnp.uint32(2654435761)
+    lo = jnp.arange(B, dtype=jnp.uint32)
+    hi_d = jnp.zeros(B, jnp.uint32)
+    lo_d = jnp.arange(B, dtype=jnp.uint32) % jnp.uint32(capacity)
+    ts = jnp.zeros(B, jnp.int32)
+    values = jnp.ones(B, jnp.float32)
+    valid = jnp.ones(B, bool)
+    wm = jnp.zeros((), jnp.int32)
+    st = init_state(capacity, probe_len, win, red)
+    st_d = init_state(capacity, probe_len, win, red, layout="direct")
+    st_p = init_state(capacity, probe_len, win, red, packed=True)
+
+    def mk_update(direct=False, insert=True, precombine=False):
+        def kernel(state, k_hi, k_lo, k_ts, k_values, k_valid):
+            return update(state, win, red, k_hi, k_lo, k_ts, k_values,
+                          k_valid, insert=insert, direct=direct,
+                          precombine=precombine)
+        return kernel
+
+    def fire_compact(state, k_wm):
+        state, fr = advance_and_fire(state, win, red, k_wm)
+        return state, compact_fires(state.table, fr)
+
+    def fire_reduced(state, k_wm):
+        state, fr = advance_and_fire(state, win, red, k_wm)
+        return state, reduce_fires(fr)
+
+    def fire_resident(state, k_wm):
+        return advance_and_fire_resident(state, win, red, k_wm)
+
+    def fire_resident_reduced(state, k_wm):
+        return advance_and_fire_resident(state, win, red, k_wm,
+                                         reduced=True)
+
+    def compact(state):
+        return compact_table(state, win, red)
+
+    def occupancy(state):
+        return kg_occupancy(state, 8, red=red, win=win)
+
+    upd = (hi, lo, ts, values, valid)
+    upd_d = (hi_d, lo_d, ts, values, valid)
+    return [
+        ("wk.update.hash", mk_update(), (st,) + upd),
+        ("wk.update.direct", mk_update(direct=True), (st_d,) + upd_d),
+        ("wk.update.hash.precombine", mk_update(precombine=True),
+         (st,) + upd),
+        ("wk.update.hash.packed", mk_update(), (st_p,) + upd),
+        ("wk.update_fast.hash", mk_update(insert=False), (st,) + upd),
+        ("wk.fire.compact", fire_compact, (st, wm)),
+        ("wk.fire.reduced", fire_reduced, (st, wm)),
+        ("wk.fire.resident", fire_resident, (st, wm)),
+        ("wk.fire.resident_reduced", fire_resident_reduced, (st, wm)),
+        ("wk.compact_table", compact, (st,)),
+        ("wk.occupancy", occupancy, (st,)),
+    ]

@@ -1042,3 +1042,193 @@ def build_rolling_step(ctx: MeshContext, spec: RollingStageSpec):
         return sharded(state, starts, ends, hi, lo, values, valid)
 
     return step
+
+
+# --------------------------------------------- canonical kernel families
+
+# Canonical "tiny but structurally real" dims for auditing: big enough
+# that every code path (probe rounds, ring panes, overflow lanes, kg
+# telemetry) is live in the traced program, small enough that tracing
+# the whole grid stays inside the lint tier's wall-time budget.
+AUDIT_CAPACITY = 64
+AUDIT_PROBE_LEN = 4
+AUDIT_BATCH = 8
+AUDIT_K_STEPS = 2
+
+
+@dataclass(frozen=True)
+class KernelFamily:
+    """One canonical hot-path kernel family.
+
+    The compiled-graph auditor (tools/lint trace tier, ISSUE 11) and the
+    bench harness both need the same enumeration of "which step builders
+    exist, along which spec axes" — this descriptor and
+    :func:`kernel_family_grid` ARE that enumeration, kept next to the
+    builders so the audited grid and the executor's dispatch surface
+    cannot drift. ``donated`` mirrors the builder's donate_argnums
+    contract (argnum 0 = state); the donation-effective rule verifies it
+    against the lowered/compiled alias tables. ``deep`` marks the
+    families the auditor fully compiles (executable alias table + memory
+    stats) rather than just lowers — one representative per kind keeps
+    the audit under its wall-time budget.
+    """
+
+    name: str
+    builder: Callable
+    kind: str            # update | megastep | megastep_fired | fire |
+    #                      fire_reduced | compact | occupancy |
+    #                      session | count | rolling
+    route: str = "mask"      # mask | exchange
+    layout: str = "hash"     # hash | direct
+    donated: bool = True
+    insert: bool = True
+    precombine: bool = False
+    packed: bool = False
+    reduced: bool = False
+    k_steps: int = 0
+    deep: bool = False
+
+
+def kernel_family_grid():
+    """THE canonical kernel-family grid: every window step builder, along
+    the spec axes the executor actually dispatches (routes x layouts x
+    packed/precombine planes x fused depths), plus the auxiliary
+    session/count/rolling window steps. tests/test_lint_trace.py asserts
+    every ``build_*`` step factory in this module is represented, so
+    adding a builder without extending the grid fails tier-1."""
+    F = KernelFamily
+    K = AUDIT_K_STEPS
+    return [
+        F("step.combined.mask.hash", build_window_step, "combined"),
+        F("step.update.mask.hash", build_window_update_step,
+          "update", deep=True),
+        F("step.update.mask.direct", build_window_update_step,
+          "update", layout="direct"),
+        F("step.update.mask.hash.precombine", build_window_update_step,
+          "update", precombine=True),
+        F("step.update.mask.hash.packed", build_window_update_step,
+          "update", packed=True),
+        F("step.update_fast.mask.hash", build_window_update_step,
+          "update", insert=False),
+        F("step.update.exchange.hash", build_window_update_step_exchange,
+          "update", route="exchange"),
+        F("step.megastep.mask.hash.k2", build_window_megastep,
+          "megastep", k_steps=K),
+        F("step.megastep.exchange.hash.k2", build_window_megastep_exchange,
+          "megastep", route="exchange", k_steps=K),
+        F("step.megastep_fired.mask.hash.k2", build_window_megastep_fired,
+          "megastep_fired", k_steps=K, deep=True),
+        F("step.megastep_fired.mask.direct.k2", build_window_megastep_fired,
+          "megastep_fired", layout="direct", k_steps=K),
+        F("step.megastep_fired.mask.hash.k2.packed",
+          build_window_megastep_fired,
+          "megastep_fired", packed=True, k_steps=K),
+        F("step.megastep_fired.mask.hash.k2.reduced",
+          build_window_megastep_fired,
+          "megastep_fired", reduced=True, k_steps=K),
+        F("step.megastep_fired.exchange.hash.k2",
+          build_window_megastep_fired_exchange,
+          "megastep_fired", route="exchange", k_steps=K),
+        F("step.fire.hash", build_window_fire_step, "fire", deep=True),
+        F("step.fire_reduced.hash", build_window_fire_reduced_step,
+          "fire_reduced"),
+        F("step.compact.hash", build_compact_step, "compact", deep=True),
+        F("step.occupancy.hash", build_kg_occupancy_step,
+          "occupancy", donated=False),
+        # auxiliary window kinds: their steps do not donate today (the
+        # audit mirrors the builders' real contracts, it does not wish)
+        F("step.session.mask.hash", build_session_step,
+          "session", donated=False),
+        F("step.count.mask.hash", build_count_step,
+          "count", donated=False),
+        F("step.rolling.mask.hash", build_rolling_step,
+          "rolling", donated=False),
+    ]
+
+
+def audit_stage_spec(fam: KernelFamily):
+    """The canonical stage spec for one family: fixed tiny dims,
+    family-specific layout/precombine/packed axes (spec class chosen by
+    the family's window kind)."""
+    red = wk.ReduceSpec("sum", jnp.float32)
+    if fam.kind == "session":
+        return SessionStageSpec(
+            red=red, gap_ticks=16,
+            capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+        )
+    if fam.kind == "count":
+        return CountStageSpec(
+            red=red, n_per_window=4,
+            capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+        )
+    if fam.kind == "rolling":
+        return RollingStageSpec(
+            red=red,
+            capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+        )
+    win = wk.WindowSpec(4, 2, ring=4, fires_per_step=2, overflow=4)
+    return WindowStageSpec(
+        win=win, red=red,
+        capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+        layout=fam.layout, precombine=fam.precombine, packed=fam.packed,
+    )
+
+
+def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
+                         batch: int):
+    """A canonical concrete call for ``fam``: batch operands with the
+    exact dtypes the executor stages (uint32 keys, int32 ticks, f32
+    values, bool valid, int32 watermark vectors). Direct layout keeps
+    the identity-key contract (hi == 0, lo < capacity)."""
+    B = batch
+    if fam.layout == "direct":
+        hi = jnp.zeros(B, jnp.uint32)
+        lo = jnp.arange(B, dtype=jnp.uint32) % jnp.uint32(AUDIT_CAPACITY)
+    else:
+        hi = jnp.arange(B, dtype=jnp.uint32) * jnp.uint32(2654435761)
+        lo = jnp.arange(B, dtype=jnp.uint32)
+    per = (hi, lo, jnp.zeros(B, jnp.int32), jnp.ones(B, jnp.float32),
+           jnp.ones(B, bool))
+    if fam.kind in ("update", "combined"):
+        return (state,) + per + (watermark_vector(ctx, 0),)
+    if fam.kind in ("megastep", "megastep_fired"):
+        wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
+        return (state,) + per * fam.k_steps + (wmv,)
+    if fam.kind in ("fire", "fire_reduced"):
+        return (state, watermark_vector(ctx, 0))
+    if fam.kind == "session":
+        # (state, hi, lo, ts, values, valid, per-shard watermark)
+        return (state,) + per + (jnp.zeros(ctx.n_shards, jnp.int32),)
+    if fam.kind in ("count", "rolling"):
+        # (state, hi, lo, values, valid) — no event-time operands
+        return (state, per[0], per[1], per[3], per[4])
+    return (state,)
+
+
+def build_family(fam: KernelFamily, ctx: MeshContext,
+                 batch: int = AUDIT_BATCH):
+    """Instantiate one canonical family: ``(fn, example_args,
+    donate_argnums)``. ``fn`` is exactly what the executor would hold
+    (the exchange route's plain wrapper keeps its jitted inner on
+    ``.jit`` for AOT consumers); ``example_args`` is a concrete call the
+    auditor can make_jaxpr / lower / compile against."""
+    spec = audit_stage_spec(fam)
+    kw = {}
+    if fam.kind in ("update", "megastep", "megastep_fired"):
+        kw["insert"] = fam.insert
+        kw["kg_fill"] = True
+    if fam.route == "exchange":
+        kw["batch_per_device"] = batch
+    if fam.kind in ("megastep", "megastep_fired"):
+        kw["k_steps"] = fam.k_steps
+    if fam.kind == "megastep_fired":
+        kw["reduced"] = fam.reduced
+    fn = fam.builder(ctx, spec, **kw)
+    init = {
+        "session": init_session_state,
+        "count": init_count_state,
+        "rolling": init_rolling_state,
+    }.get(fam.kind, init_sharded_state)
+    state = init(ctx, spec)
+    args = _family_example_args(fam, ctx, state, batch)
+    return fn, args, ((0,) if fam.donated else ())

@@ -1,0 +1,21 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# BAD: the family's abstract input signature no longer matches the
+# recorded one (f32[8] in the fixture ledger, f32[16] here) — the
+# recompile-storm shape: some call path resized/re-dtyped an operand,
+# and the "same" step now compiles twice and flips between executables.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        return x * 2.0
+
+    return [{
+        "name": "fixture.sig",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((16,), jnp.float32),),
+    }]

@@ -1,0 +1,28 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# BAD: the kernel requests donation of its state arg but returns a
+# SLICED view — the output shape no longer matches the donated input,
+# so XLA cannot alias and silently copies. The donated-but-copied bug
+# class the donation-effective rule exists to catch: the program stays
+# correct, the step just pays a full extra state write every dispatch.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(state, x):
+        # shrinks the state: unusable donation, XLA copies
+        return state[:4] + x[:4]
+
+    args = (
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    return [{
+        "name": "fixture.donated_but_copied",
+        "fn": kernel,
+        "args": args,
+        "donate": (0,),
+    }]

@@ -1,0 +1,24 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# BAD: an explicit float64 cast in the kernel body. Under x64 (which
+# this fixture enables for its trace, mimicking a host environment
+# where some dependency flipped the flag) the whole downstream plane
+# widens — double the HBM traffic, >10x ALU cost on TPU. With x64 off,
+# JAX silently demotes and unit tests never see it; the trace tier does.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        acc = x.astype(jnp.float64) * 2.0
+        return acc.sum()
+
+    return [{
+        "name": "fixture.f64_leak",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+        "x64": True,
+    }]

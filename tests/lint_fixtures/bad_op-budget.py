@@ -1,0 +1,24 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# BAD: the kernel pays a SECOND sort the ledger doesn't budget for —
+# the exact regression shape of losing the one-sort precombine seam
+# (PR 7): numerically identical output, structurally twice the cost.
+# The fixture ledger (AUX in tests/test_lint.py) budgets sort: 1 for
+# this family.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        y = jnp.sort(x)
+        # ledger-busting extra sort: already sorted, sorted again
+        return jnp.sort(y * 2.0)
+
+    return [{
+        "name": "fixture.sortk",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+    }]

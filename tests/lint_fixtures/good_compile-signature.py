@@ -1,0 +1,19 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# GOOD twin: the family traces at exactly the signature the fixture
+# ledger records (f32[8]) — one signature, one compile, no storm.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        return x * 2.0
+
+    return [{
+        "name": "fixture.sig",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+    }]

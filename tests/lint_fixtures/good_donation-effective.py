@@ -1,0 +1,26 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# GOOD twin: the updated state keeps the donated input's shape and
+# dtype, so the lowering aliases the buffer (tf.aliasing_output on the
+# param) and the compiled executable keeps the alias — an in-place
+# update, the contract every step builder relies on.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(state, x):
+        return state + x
+
+    args = (
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+    )
+    return [{
+        "name": "fixture.donated_inplace",
+        "fn": kernel,
+        "args": args,
+        "donate": (0,),
+    }]

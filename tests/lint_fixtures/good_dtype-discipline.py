@@ -1,0 +1,23 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# GOOD twin: same computation with the boundary cast done RIGHT —
+# everything stays f32 even when the fixture traces under x64, because
+# every scalar enters the graph already narrowed. This is the
+# discipline the state planes rely on.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        acc = x * jnp.float32(2.0)
+        return acc.sum()
+
+    return [{
+        "name": "fixture.f32_clean",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+        "x64": True,
+    }]

@@ -1,0 +1,24 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# GOOD twin: the same scan with the debug print removed — the kernel
+# stays entirely on device; anything worth observing rides the lagged
+# monitoring outputs instead of a callback.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        def body(carry, _):
+            return carry + 1.0, None
+
+        y, _ = jax.lax.scan(body, x, None, length=4)
+        return y
+
+    return [{
+        "name": "fixture.scan_clean",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+    }]

@@ -1,0 +1,19 @@
+# virtual-path: flink_tpu/audit_fixture.py
+# lint-kernel-fixture
+#
+# GOOD twin: one sort, matching the fixture ledger's budget for this
+# family (sort: 1) — the shared-sort discipline holding.
+
+
+def lint_kernel_families():
+    import jax
+    import jax.numpy as jnp
+
+    def kernel(x):
+        return jnp.sort(x) * 2.0
+
+    return [{
+        "name": "fixture.sortk",
+        "fn": kernel,
+        "args": (jax.ShapeDtypeStruct((8,), jnp.float32),),
+    }]

@@ -1,9 +1,10 @@
-"""Unified hot-path invariant linter (ISSUE 9).
+"""Unified hot-path invariant linter (ISSUE 9; trace tier ISSUE 11).
 
-``python -m tools.lint`` runs all 7 rules (2 migrated one-off checkers
-+ 5 new) over the repo with one shared parsed-module cache. See
-tools/lint/core.py for the framework and docs/static-analysis.md for
-the rule catalog.
+``python -m tools.lint`` runs all 12 rules — 7 AST-tier (source-level
+invariants, one shared parsed-module cache) and 5 trace-tier (compiled-
+graph invariants over the canonical kernel families, one shared trace
+cache; see tools/lint/kernel_audit.py). See tools/lint/core.py for the
+framework and docs/static-analysis.md for the rule catalog.
 """
 
 from __future__ import annotations
@@ -27,8 +28,9 @@ from tools.lint.rules import all_rules, rule_by_name  # noqa: E402,F401
 DEFAULT_ROOT = _ROOT
 
 
-def run_lint(root: str = None, rule: str = None):
-    """All (or one) rule(s) over the repo; returns the finding list."""
+def run_lint(root: str = None, rule: str = None, tier: str = None):
+    """All (or one) rule(s) over the repo; returns the finding list.
+    ``tier`` ("ast"/"trace") filters like the CLI's --tier."""
     tree = RepoTree(root or DEFAULT_ROOT)
-    rules = [rule_by_name(rule)] if rule else all_rules()
+    rules = [rule_by_name(rule)] if rule else all_rules(tier)
     return run_rules(tree, rules)

@@ -1,4 +1,16 @@
-"""CLI: ``python -m tools.lint [--rule X] [--json] [--root R]``.
+"""CLI: ``python -m tools.lint [--tier T] [--rule X] [--json]
+[--update-ledger] [--root R]`` (also installed as ``flink-tpu-lint``).
+
+Runs BOTH tiers by default: the AST rules (source-level invariants) and
+the trace rules (compiled-graph invariants, ISSUE 11 — these build the
+canonical kernel families on the CPU backend, so a full run costs a few
+seconds of tracing). ``--tier ast`` keeps the sub-second source-only
+pass; ``--tier trace`` audits just the compiled contracts.
+
+``--update-ledger`` rewrites the golden ledgers (op budgets, compile
+signatures) from a fresh trace instead of diffing against them — the
+sanctioned way to record a DELIBERATE structural change; commit the
+ledger diff with the kernel change that caused it.
 
 Exit codes are DISTINCT so CI can tell a dirty tree from a broken
 linter:
@@ -6,6 +18,10 @@ linter:
     0  clean (no unsuppressed findings)
     1  findings (printed one per line, or as JSON with --json)
     2  internal error (unknown rule, unparseable module, bad root)
+
+``--json`` emits a versioned envelope (``schema``, the rule names run,
+and the findings sorted by path/line/rule/message) so ledger and CI
+diffs are deterministic.
 """
 
 from __future__ import annotations
@@ -24,6 +40,9 @@ EXIT_CLEAN = 0
 EXIT_FINDINGS = 1
 EXIT_INTERNAL = 2
 
+# bump when the --json envelope shape changes
+JSON_SCHEMA_VERSION = 2
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -32,8 +51,14 @@ def main(argv=None) -> int:
                     "(docs/static-analysis.md)",
     )
     ap.add_argument("--rule", help="run only this rule (by name)")
+    ap.add_argument("--tier", choices=("ast", "trace"),
+                    help="run only one analysis tier (default: both)")
     ap.add_argument("--json", action="store_true",
-                    help="emit findings as a JSON array")
+                    help="emit a versioned JSON findings envelope")
+    ap.add_argument("--update-ledger", action="store_true",
+                    help="rewrite the golden ledgers (op budgets, "
+                         "compile signatures) from a fresh trace "
+                         "instead of diffing against them")
     ap.add_argument("--root", default=DEFAULT_ROOT,
                     help="repo root to scan")
     ap.add_argument("--list-rules", action="store_true",
@@ -41,12 +66,25 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for r in all_rules():
-            print(f"{r.name:15s} [{r.established}] {r.title}")
+        for r in all_rules(args.tier):
+            print(f"{r.name:20s} [{r.tier:5s}] [{r.established}] "
+                  f"{r.title}")
         return EXIT_CLEAN
 
     try:
-        rules = [rule_by_name(args.rule)] if args.rule else all_rules()
+        if args.rule:
+            rules = [rule_by_name(args.rule)]
+            if args.tier and rules[0].tier != args.tier:
+                raise LintInternalError(
+                    f"rule {args.rule!r} is tier "
+                    f"{rules[0].tier!r}, not {args.tier!r}"
+                )
+        else:
+            rules = all_rules(args.tier)
+        if args.update_ledger:
+            for r in rules:
+                if hasattr(r, "update_ledger"):
+                    r.update_ledger = True
         t0 = time.perf_counter()
         findings = run_rules(RepoTree(args.root), rules)
         dt = time.perf_counter() - t0
@@ -59,11 +97,16 @@ def main(argv=None) -> int:
         return EXIT_INTERNAL
 
     if args.json:
-        print(json.dumps([
-            {"rule": f.rule, "path": f.path, "line": f.line,
-             "func": f.func, "message": f.message}
-            for f in findings
-        ], indent=2))
+        print(json.dumps({
+            "schema": JSON_SCHEMA_VERSION,
+            "tier": args.tier or "all",
+            "rules": [r.name for r in rules],
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "func": f.func, "message": f.message, "note": f.note}
+                for f in findings
+            ],
+        }, indent=2))
     else:
         for f in findings:
             print(f)

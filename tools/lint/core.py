@@ -46,16 +46,25 @@ SUPPRESS_RE = re.compile(
 
 @dataclass(frozen=True)
 class Finding:
-    """One rule violation, anchored to a repo-relative path + line."""
+    """One rule violation, anchored to a repo-relative path + line.
+
+    ``note`` carries cross-tier evidence: when the trace tier
+    (tools/lint/kernel_audit.py) flags a compiled-program property, the
+    note names the source construct the AST tier attributed it to (e.g.
+    a donation-effective finding names the donate_argnums line the AST
+    donation rule found) — one finding, both tiers' evidence.
+    """
 
     rule: str
     path: str          # repo-relative, '/'-separated
     line: int
     message: str
     func: str = "<module>"
+    note: str = ""
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        base = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        return f"{base}\n    note: {self.note}" if self.note else base
 
 
 @dataclass
@@ -181,6 +190,10 @@ class Rule:
     title: str = ""            # one-line invariant statement
     established: str = ""      # which PR established the invariant
     suppressible: bool = True  # sort-seam opts out: no escape hatch
+    # "ast" rules read source; "trace" rules (ISSUE 11) build the real
+    # kernel families and read the jaxpr / lowered / compiled program.
+    # The CLI's --tier flag filters on this.
+    tier: str = "ast"
 
     def check(self, tree: RepoTree) -> List[Finding]:
         raise NotImplementedError
@@ -240,7 +253,9 @@ def run_rules(tree: RepoTree, rules: Sequence[Rule]) -> List[Finding]:
     for rule in rules:
         raw.extend(rule.check(tree))
     out = apply_suppressions(tree, rules, raw)
-    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    # message joins the key so --json diffs are byte-deterministic even
+    # when one line carries several findings of one rule
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return out
 
 

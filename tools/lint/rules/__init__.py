@@ -1,13 +1,16 @@
 """Rule plugins for the hot-path invariant linter (tools/lint).
 
 One module per rule; ALL_RULES is the registry the CLI and the tier-1
-test parametrize over. Catalog with the invariant each rule protects:
-docs/static-analysis.md.
+test parametrize over. Two tiers: "ast" rules read source through the
+shared RepoTree parse cache; "trace" rules (ISSUE 11) build the real
+kernel families through the shared KernelAudit trace cache and read the
+jaxpr / lowered / compiled program. Catalog with the invariant each
+rule protects: docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from tools.lint.core import Rule
 
@@ -18,11 +21,18 @@ from tools.lint.rules.donation import DonationRule
 from tools.lint.rules.config_hygiene import ConfigHygieneRule
 from tools.lint.rules.thread_state import ThreadStateRule
 from tools.lint.rules.fault_seams import FaultSeamRule
+from tools.lint.rules.donation_effective import DonationEffectiveRule
+from tools.lint.rules.host_crossing import HostCrossingRule
+from tools.lint.rules.dtype_discipline import DtypeDisciplineRule
+from tools.lint.rules.op_budget import OpBudgetRule
+from tools.lint.rules.compile_signature import CompileSignatureRule
 
 
-def all_rules() -> List[Rule]:
-    """Fresh instances, migration order first then ISSUE 9's five."""
-    return [
+def all_rules(tier: Optional[str] = None) -> List[Rule]:
+    """Fresh instances: migration order, then ISSUE 9's five AST rules,
+    then ISSUE 11's five trace rules. ``tier`` filters ("ast"/"trace");
+    None returns both tiers — the CLI default."""
+    rules: List[Rule] = [
         HotPathSyncRule(),
         SortSeamRule(),
         RetraceRule(),
@@ -30,7 +40,15 @@ def all_rules() -> List[Rule]:
         ConfigHygieneRule(),
         ThreadStateRule(),
         FaultSeamRule(),
+        DonationEffectiveRule(),
+        HostCrossingRule(),
+        DtypeDisciplineRule(),
+        OpBudgetRule(),
+        CompileSignatureRule(),
     ]
+    if tier is not None:
+        rules = [r for r in rules if r.tier == tier]
+    return rules
 
 
 def rule_by_name(name: str) -> Rule:

@@ -1,0 +1,113 @@
+"""Rule ``compile-signature``: each kernel family's abstract input
+signature matches the checked-in signature ledger.
+
+A jitted step compiles ONCE per abstract signature. The recompile-storm
+bug class (the retrace rule's runtime sibling): a refactor changes a
+batch operand's dtype on one call path, or a megastep's flat-args
+packing, and the "same" step silently becomes two executables — every
+flip between them is a multi-second trace+compile in the dispatch loop.
+The AST retrace rule catches compiles written inside loops; this rule
+pins WHAT each canonical family compiles against:
+``tools/lint/ledgers/signatures.json`` records the comma-joined
+``aval.str_short()`` of every flattened input leaf (human-readable, so
+the ledger diff in review shows exactly which leaf moved — f32[8] ->
+f32[16] — not just a hash; the sha256 digest rides along for compact
+comparison in CI output).
+
+A signature change is sometimes right (you resized the canonical grid,
+added a state plane) — record it with ``--update-ledger`` so the diff
+is reviewed next to the code. Not suppressible, same reasoning as
+op-budget: the ledger is the escape hatch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.lint.core import Finding, LintInternalError, RepoTree, Rule
+from tools.lint.kernel_audit import get_audit, load_ledger, write_ledger
+
+LEDGER_PATH = "tools/lint/ledgers/signatures.json"
+
+
+def _first_diff(a: str, b: str) -> str:
+    """Human pointer at the first differing leaf of two signatures."""
+    la, lb = a.split(","), b.split(",")
+    for i, (x, y) in enumerate(zip(la, lb)):
+        if x != y:
+            return f"leaf {i}: {x} -> {y}"
+    if len(la) != len(lb):
+        return (f"leaf count {len(la)} -> {len(lb)} (extra: "
+                f"{(la + lb)[min(len(la), len(lb))]})")
+    return "identical leaves in different order"
+
+
+class CompileSignatureRule(Rule):
+    name = "compile-signature"
+    title = ("each kernel family's abstract input signature matches the "
+             "signature ledger (no accidental recompile-storm splits)")
+    established = "PR 10"
+    tier = "trace"
+    suppressible = False
+    update_ledger = False     # set by the CLI's --update-ledger flag
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        audit = get_audit(tree)
+        if audit is None:
+            return []
+        actual: Dict[str, Dict[str, str]] = {
+            name: {"digest": tr.digest, "signature": tr.signature}
+            for name, tr in audit.traces.items()
+        }
+        if self.update_ledger:
+            if tree.root is None:
+                raise LintInternalError(
+                    "--update-ledger needs a disk tree to write to")
+            write_ledger(tree.root, LEDGER_PATH, {"families": actual})
+            return []
+        out: List[Finding] = []
+        data = load_ledger(tree, LEDGER_PATH)
+        if data is None:
+            out.append(Finding(
+                self.name, LEDGER_PATH, 1,
+                f"signature ledger missing — generate it with "
+                f"'python -m tools.lint --rule {self.name} "
+                f"--update-ledger' and commit it",
+            ))
+            return out
+        ledger: Dict[str, Dict[str, str]] = data.get("families", {})
+        for name in sorted(set(actual) | set(ledger)):
+            if name not in ledger:
+                tr = audit.traces[name]
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r} has no recorded compile "
+                    f"signature — record it (--update-ledger) so an "
+                    f"accidental signature split is caught",
+                    tr.builder or "<family>",
+                ))
+                continue
+            if name not in actual:
+                out.append(Finding(
+                    self.name, LEDGER_PATH, 1,
+                    f"signature ledger lists unknown kernel family "
+                    f"{name!r} — stale entry (or a hand edit without "
+                    f"--update-ledger); regenerate the ledger",
+                ))
+                continue
+            want = ledger[name].get("signature", "")
+            got = actual[name]["signature"]
+            if want != got:
+                tr = audit.traces[name]
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r} abstract signature changed "
+                    f"({ledger[name].get('digest', '?')} -> "
+                    f"{actual[name]['digest']}; {_first_diff(want, got)})"
+                    f" — a call-path disagreeing with the recorded "
+                    f"signature means a second compile of the same step "
+                    f"(recompile storm); if the new signature is the "
+                    f"design, rerun with --update-ledger",
+                    tr.builder or "<family>",
+                ))
+        return out

@@ -141,6 +141,31 @@ def donated_builders(tree: RepoTree) -> Dict[str, Tuple[int, ...]]:
     return out
 
 
+def donate_sites(tree: RepoTree) -> Dict[str, Tuple[str, int]]:
+    """{builder name: (path, line)} — the ``donate_argnums`` source
+    line (the decorated inner def's jit decorator) this rule attributes
+    each donated builder's donation to. The trace tier's
+    donation-effective rule stitches this into its findings' note field
+    so one finding carries both tiers' evidence: the compiled alias
+    table that is missing the leaf AND the source line that requested
+    the donation."""
+    pm = tree.module(BUILDER_HOME)
+    if pm is None:
+        return {}
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in pm.tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for inner in ast.walk(node):
+            if not isinstance(inner, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            for dec in inner.decorator_list:
+                if isinstance(dec, ast.Call) and _donate_argnums_of(dec):
+                    out.setdefault(node.name, (pm.relpath, dec.lineno))
+    return out
+
+
 def _local_donated_callables(mod_tree: ast.AST,
                              builders: Dict[str, Tuple[int, ...]],
                              ) -> Dict[str, Tuple[int, ...]]:

@@ -1,0 +1,75 @@
+"""Rule ``donation-effective``: every donated state arg must actually
+alias in the compiled program.
+
+The AST ``donation`` rule polices the CALLER side of the contract (no
+read-after-donate); this rule closes the loop on the CALLEE side: a
+``donate_argnums`` annotation is a *request*, and XLA silently falls
+back to a copy whenever it cannot alias the buffer (output
+shape/dtype/layout mismatch, an output that still reads the input,
+backend refusal). A donated-but-copied 100MB+ state shard doubles the
+step's HBM traffic and nobody notices — the program is still correct,
+just slow, which is exactly the regression class this trace tier exists
+to catch.
+
+Evidence (tools/lint/kernel_audit.py): every donated leaf of every
+canonical kernel family must appear in the LOWERED StableHLO
+input/output alias table (``tf.aliasing_output`` on the ``@main``
+params — an unusable donation drops out of this table at lower time);
+the ``deep`` representative families are additionally COMPILED and
+checked against the executable's ``input_output_alias`` table (what XLA
+actually kept). Each finding carries a cross-tier note naming the
+``donate_argnums`` source line the AST donation rule attributes the
+builder's donation to — one finding, both tiers' evidence.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.lint.core import Finding, RepoTree, Rule
+from tools.lint.kernel_audit import get_audit
+from tools.lint.rules.donation import donate_sites
+
+
+class DonationEffectiveRule(Rule):
+    name = "donation-effective"
+    title = ("every donated kernel state arg aliases in the lowered "
+             "(and, for deep families, compiled) program")
+    established = "PR 10"
+    tier = "trace"
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        audit = get_audit(tree)
+        if audit is None:
+            return []
+        sites = donate_sites(tree)
+        out: List[Finding] = []
+        for name in sorted(audit.traces):
+            tr = audit.traces[name]
+            if not tr.donated:
+                continue
+            rep = audit.donation_report(name)
+            site = sites.get(tr.builder)
+            note = (f"AST donation rule attributes this donate to "
+                    f"{site[0]}:{site[1]} ({tr.builder})" if site else "")
+            for leaf in rep["missing_lowered"]:
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r}: donated leaf {leaf} is "
+                    f"absent from the lowered input/output alias table "
+                    f"— XLA will COPY this buffer every step "
+                    f"(donate_argnums was requested but is not usable; "
+                    f"check output shapes/dtypes against the donated "
+                    f"input)",
+                    tr.builder or "<family>", note,
+                ))
+            for leaf in rep["dropped_by_executable"]:
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r}: donated leaf {leaf} "
+                    f"aliased at lower time but the compiled "
+                    f"executable's input_output_alias table dropped it "
+                    f"— the compiler decided it must copy",
+                    tr.builder or "<family>", note,
+                ))
+        return out

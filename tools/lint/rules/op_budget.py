@@ -1,0 +1,120 @@
+"""Rule ``op-budget``: per-family sort/scatter/gather/scan counts match
+the checked-in golden ledger.
+
+PR 7's headline wins are STRUCTURAL: the precombine path pays ONE
+shared sort that feeds four scatter consumers, the packed planes
+collapse the touched-bit scatter into the accumulator scatter, the
+resident megastep keeps fire evaluation inside one scan. None of that
+is visible to a unit test (the numbers stay right) and a benchmark only
+catches it as noise two PRs later. This rule counts the budget-relevant
+primitive groups (sort, scatter, gather, while/scan, cond) in every
+canonical kernel family's jaxpr and diffs them against
+``tools/lint/ledgers/op_budget.json``:
+
+  * a drifted count is a finding — "your change added a second sort to
+    the update kernel" fails the build at lint time;
+  * a DELIBERATE change (you redesigned the kernel) is recorded with
+    ``python -m tools.lint --rule op-budget --update-ledger``, which
+    rewrites the ledger from a fresh trace — the diff then shows up in
+    review next to the code that caused it;
+  * on top of the ledger, one hard invariant that must never drift even
+    WITH an update: a ``.precombine`` family pays at most one sort (the
+    whole point of the shared-sort seam).
+
+Not suppressible: like sort-seam, an op-budget change is a design
+decision; the ledger (reviewed in the PR diff) is the escape hatch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from tools.lint.core import Finding, LintInternalError, RepoTree, Rule
+from tools.lint.kernel_audit import (
+    OP_GROUPS, get_audit, load_ledger, write_ledger,
+)
+
+LEDGER_PATH = "tools/lint/ledgers/op_budget.json"
+
+
+class OpBudgetRule(Rule):
+    name = "op-budget"
+    title = ("per-kernel-family sort/scatter/gather/scan counts match "
+             "the checked-in golden ledger")
+    established = "PR 10"
+    tier = "trace"
+    suppressible = False
+    update_ledger = False     # set by the CLI's --update-ledger flag
+
+    def check(self, tree: RepoTree) -> List[Finding]:
+        audit = get_audit(tree)
+        if audit is None:
+            return []
+        actual: Dict[str, Dict[str, int]] = {
+            name: dict(tr.op_counts)
+            for name, tr in audit.traces.items()
+        }
+        out: List[Finding] = []
+        # the hard seam invariant survives even a ledger update
+        for name in sorted(actual):
+            tr = audit.traces[name]
+            if ".precombine" in name and actual[name]["sort"] > 1:
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r} pays {actual[name]['sort']} "
+                    f"sorts — the precombine contract is ONE shared sort "
+                    f"feeding every scatter consumer (PR 7); this cannot "
+                    f"be ledgered away",
+                    tr.builder or "<family>",
+                ))
+        if self.update_ledger:
+            if tree.root is None:
+                raise LintInternalError(
+                    "--update-ledger needs a disk tree to write to")
+            write_ledger(tree.root, LEDGER_PATH, {"families": actual})
+            return out
+        data = load_ledger(tree, LEDGER_PATH)
+        if data is None:
+            out.append(Finding(
+                self.name, LEDGER_PATH, 1,
+                f"op-budget ledger missing — generate it with "
+                f"'python -m tools.lint --rule {self.name} "
+                f"--update-ledger' and commit it",
+            ))
+            return out
+        ledger: Dict[str, Dict[str, int]] = data.get("families", {})
+        for name in sorted(set(actual) | set(ledger)):
+            if name not in ledger:
+                tr = audit.traces[name]
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r} is not in the op-budget "
+                    f"ledger — a new family needs its budget recorded "
+                    f"(--update-ledger) so future drift is caught",
+                    tr.builder or "<family>",
+                ))
+                continue
+            if name not in actual:
+                out.append(Finding(
+                    self.name, LEDGER_PATH, 1,
+                    f"op-budget ledger lists unknown kernel family "
+                    f"{name!r} — stale entry (or a hand edit without "
+                    f"--update-ledger); regenerate the ledger",
+                ))
+                continue
+            diffs = [
+                f"{g}: {ledger[name].get(g, 0)} -> {actual[name][g]}"
+                for g in OP_GROUPS
+                if actual[name][g] != ledger[name].get(g, 0)
+            ]
+            if diffs:
+                tr = audit.traces[name]
+                out.append(Finding(
+                    self.name, tr.path, tr.line,
+                    f"kernel family {name!r} op budget drifted from the "
+                    f"ledger: {'; '.join(diffs)} — if this structural "
+                    f"change is deliberate, rerun with --update-ledger "
+                    f"and commit the ledger diff",
+                    tr.builder or "<family>",
+                ))
+        return out
