@@ -336,6 +336,11 @@ def main():
                     help="run ONLY the device_update_ceiling microbench "
                          "(pre-staged batch ring, no source): K-fusion x "
                          "duplicate-fraction grid + precombine on/off")
+    ap.add_argument("--resident", action="store_true",
+                    help="run ONLY the resident_loop microbench: ring-"
+                         "drain dispatches (one per 32 staged slots) vs "
+                         "K=8 fused megasteps at matched dims, stamping "
+                         "host dispatches per 1k events + events/s")
     ap.add_argument("--mttr", action="store_true",
                     help="run ONLY the mttr_recovery drill: detect-to-"
                          "first-fire of cold-remote vs local vs warm "
@@ -432,6 +437,33 @@ def main():
                 round(fused_best / split_best, 2) if split_best else 0
             ),
             "criterion": ">= 1.15",
+            "batch": DEVICE_CEILING_BATCH,
+        }))
+        return
+
+    if args.resident:
+        # resident-loop mode (ISSUE 12): ring-drain vs K=8 megastep
+        # dispatch disciplines on the firing stream; the detail JSON with
+        # the per-cell grid and the per-1k-events dispatch counts prints
+        # from inside the config
+        if args.cpu:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from bench_configs import DEVICE_CEILING_BATCH, run_resident_loop
+
+        res_best, fused_best = run_resident_loop(args.events, args.cpu)
+        print(json.dumps({
+            "metric": "resident ring-drain best cell vs best K=8 "
+                      "fused-megastep (PR-7 path) cell, firing stream",
+            "value": round(res_best),
+            "unit": "events/s",
+            "vs_baseline": (
+                round(res_best / fused_best, 2) if fused_best else 0
+            ),
+            "criterion": ">= 1.15",
+            "dispatch_drop": 4.0,
             "batch": DEVICE_CEILING_BATCH,
         }))
         return

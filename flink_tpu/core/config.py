@@ -268,6 +268,24 @@ class CoreOptions:
         "steps-per-dispatch > 1; off keeps the split-dispatch path "
         "(which always remains the fallback for partial groups and the "
         "DCN lockstep plane)")
+    PIPELINE_RESIDENT_LOOP = ConfigOption(
+        "pipeline.resident-loop", "auto",
+        "auto | on | off — the device-resident steady-state loop (ISSUE "
+        "12): the prefetch thread publishes staged batches into an HBM "
+        "batch ring and the step loop dispatches ONE jitted drain over "
+        "every ready slot (fused update+fire per slot, count-gated), so "
+        "steady state costs one host round trip per ring drain instead "
+        "of one per megastep. Requires prefetch + device staging + "
+        "fused fire; exactly-once cuts move to ring-drain boundaries. "
+        "auto = on whenever the fused-fire resident pipeline is active "
+        "on a single-controller topology; DCN lockstep planes keep the "
+        "loud single-step fallback")
+    PIPELINE_RING_DEPTH = ConfigOption(
+        "pipeline.ring-depth", 16,
+        "HBM slots in the device batch ring (pipeline.resident-loop): "
+        "bounds device-resident batches AND the max slots one drain "
+        "dispatch consumes — deeper rings amortize the host round trip "
+        "further but coarsen fire/checkpoint latency and HBM residency")
     STATE_PACKED_PLANES = ConfigOption(
         "state.packed-planes", "auto",
         "auto | on | off — store the touched (fire-eligibility) bits as "
@@ -351,6 +369,12 @@ class CoreOptions:
     WATCHDOG_SLOT_TIMEOUT = ConfigOption(
         "watchdog.slot-timeout", 600.0,
         "deadline (s) on the materializer staging-slot wait")
+    WATCHDOG_DRAIN_TIMEOUT = ConfigOption(
+        "watchdog.drain-timeout", 120.0,
+        "PER-SLOT deadline (s) on one resident ring-drain dispatch "
+        "(pipeline.resident-loop); armed scaled by the slot count the "
+        "drain consumes, so deep drains get proportionally more time. "
+        "0 disables")
     WATCHDOG_RESTORE_TIMEOUT = ConfigOption(
         "watchdog.restore-timeout", 900.0,
         "deadline (s) on a whole checkpoint restore; the step-loop "

@@ -50,6 +50,8 @@ STEP_PHASES = (
     "stage",            # ingest-thread pad into the staging ring
     "transfer",         # ingest-thread H2D device_put + completion wait
     "dispatch",         # device step dispatch (+ inflight-depth wait)
+    "drain",            # resident ring-drain dispatch (pipeline.
+                        #   resident-loop); attrs carry the slot count
     "fire",             # fire-step dispatch at a pane boundary
     "barrier_fetch",    # step-boundary scalar/lane fetch (the d2h barrier)
     "emit",             # fire extraction + sink invocation

@@ -253,7 +253,8 @@ class _RebalanceRing:
     def __init__(self, pid: int, nproc: int, addrs,
                  recv_timeout_s: float = 120.0,
                  reconnect_attempts: int = 3,
-                 reconnect_backoff_s: float = 0.25):
+                 reconnect_backoff_s: float = 0.25,
+                 resync_window_s: float = 30.0):
         import socket
         import struct
 
@@ -264,6 +265,10 @@ class _RebalanceRing:
         self.recv_timeout_s = float(recv_timeout_s)
         self.reconnect_attempts = max(0, int(reconnect_attempts))
         self.reconnect_backoff_s = float(reconnect_backoff_s)
+        # how long a resync waits for the lost peer to come back up
+        # (redial + re-accept window); bounded so a peer that is gone
+        # for good attributes instead of redialing forever
+        self.resync_window_s = float(resync_window_s)
         if not addrs or len(addrs) != nproc:
             raise ValueError(
                 "rebalance requires rebalance_addrs with one host:port "
@@ -339,8 +344,8 @@ class _RebalanceRing:
                 s.close()
             except OSError:
                 pass
-        self._dial_next(30.0)
-        self._accept_prev(30.0)
+        self._dial_next(self.resync_window_s)
+        self._accept_prev(self.resync_window_s)
 
     def _run_round(self, fn, attempts: Optional[int] = None):
         """Run one ring round; on a transient connection failure, resync

@@ -64,6 +64,8 @@ from flink_tpu.runtime.step import (
     build_window_megastep_exchange,
     build_window_megastep_fired,
     build_window_megastep_fired_exchange,
+    build_window_resident_drain,
+    build_window_resident_drain_exchange,
     build_window_update_step,
     build_window_update_step_exchange,
     clear_dirty,
@@ -574,6 +576,10 @@ class JobMetrics:
     # ...of which resident-pipeline dispatches (pipeline.fused-fire):
     # the fire sweep ran inside the scan and payloads surfaced lagged
     fused_fire_dispatches: int = 0
+    # device-resident ring-drain dispatches (pipeline.resident-loop);
+    # each carries up to ring-depth micro-batches of `steps` in ONE
+    # count-gated scan — THE steady-state host-round-trip divisor
+    resident_drains: int = 0
     state_layout: str = ""  # "hash" | "direct" once the stage is set up
     # packed acc+touched planes in effect (state.packed-planes)
     state_packed_planes: bool = False
@@ -686,7 +692,7 @@ class JobMetrics:
     # MiniCluster's job detail endpoint)
     GAUGE_FIELDS = (
         "records_in", "records_out", "fires", "steps", "steps_fast",
-        "fused_dispatches", "fused_fire_dispatches",
+        "fused_dispatches", "fused_fire_dispatches", "resident_drains",
         "dropped_late", "dropped_capacity", "restarts",
         "checkpoints_aborted", "checkpoints_declined", "watchdog_trips",
     )
@@ -1218,6 +1224,17 @@ class LocalExecutor:
         coord = env.config.get_str("dcn.coordinator")
         nproc = env.config.get_int("dcn.num-processes", 1)
         pid = env.config.get_int("dcn.process-id", 0)
+        if env.config.get_str("pipeline.resident-loop", "auto") == "on":
+            # LOUD fallback, not an error: the lockstep plane's global
+            # collectives require every process to dispatch the same
+            # step sequence, which a locally-count-gated ring drain
+            # cannot guarantee — multi-host keeps single-step dispatch
+            print(
+                "flink-tpu: pipeline.resident-loop=on is ignored on the "
+                "DCN lockstep plane; multi-host execution keeps the "
+                "single-step dispatch fallback",
+                file=sys.stderr,
+            )
         wagg = pipe.window_agg
         if wagg is None or pipe.key_by is None:
             raise NotImplementedError(
@@ -1514,6 +1531,29 @@ class LocalExecutor:
             k_fuse, hold_fires=use_fused_fire
         )
         fuse_gauge = [None]    # settable steps_per_dispatch gauge
+        # -- device-resident steady-state loop (pipeline.resident-loop,
+        # round 12): the prefetch thread publishes staged batches into a
+        # DeviceBatchRing (runtime/ingest.py) and the accumulated drain
+        # group — capacity = ring depth — dispatches as ONE count-gated
+        # resident-drain scan (runtime/step.py), so steady state costs
+        # one host round trip per ring drain instead of one per
+        # megastep. Config validated here; `use_resident` is FINALIZED
+        # where prefetch/staging resolve (just before the ingest
+        # pipeline is built) because the drain consumes ring-published
+        # staged batches. The DCN lockstep plane runs a separate
+        # executor entirely (_run_dcn) and keeps its loud single-step
+        # fallback there.
+        res_cfg = str(env.config.get(_CoreOpts.PIPELINE_RESIDENT_LOOP))
+        if res_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.resident-loop must be auto|on|off, "
+                f"got {res_cfg!r}"
+            )
+        ring_depth = max(2, env.config.get_int("pipeline.ring-depth", 16))
+        use_resident = False       # finalized at ingest construction
+        residents_by_route = {}    # [route][tier] resident-drain kernels
+        pending_batch = [None]     # greedy ring fill's non-drain leftover
+        drain_warmup = [False]     # warmup drains skip the chaos seam
         # -- update-kernel pre-combine (pipeline.update-precombine):
         # duplicate-key collapse before the state scatter (wk.update);
         # generic reduces already pre-aggregate, sketches expand per
@@ -1664,8 +1704,12 @@ class LocalExecutor:
                 # skip counter advances K at a time and resets on
                 # crossing, so samples land only on dispatch boundaries
                 # (K=7 with MON_EVERY=8 samples every 14 batches)
-                stride = -(-MON_EVERY // k_fuse) * k_fuse
-                auto = (stride * (OVF_LAG + 1) + 4 + k_fuse) * B + 8192
+                # with the resident loop on the dispatch group is the
+                # RING, so the detection window stretches by up to one
+                # ring of batches, not one K-group
+                grp_k = ring_depth if use_resident else k_fuse
+                stride = -(-MON_EVERY // grp_k) * grp_k
+                auto = (stride * (OVF_LAG + 1) + 4 + grp_k) * B + 8192
                 ovf = ovf_cfg if ovf_cfg >= 0 else auto
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
@@ -1809,6 +1853,41 @@ class LocalExecutor:
                                 insert=False, kg_fill=kg_stats_on,
                             ) if build_fast else None,
                         }
+                if use_resident:
+                    # resident ring-drain kernels (pipeline.resident-
+                    # loop): ONE count-gated scan per route x tier
+                    # serves EVERY fill level 1..ring_depth — the host
+                    # passes the live slot count as a traced operand,
+                    # so partial drains never recompile. Fired variants
+                    # only: the drain is the fused-fire pipeline taken
+                    # to its limit (every slot fires under its own
+                    # watermark inside the scan).
+                    rd_reduced = bool(
+                        sink_device_reduce and not win.overflow
+                    )
+                    if "mask" in steps_by_route:
+                        residents_by_route["mask"] = {
+                            "insert": build_window_resident_drain(
+                                ctx, spec, ring_depth,
+                                kg_fill=kg_stats_on, reduced=rd_reduced,
+                            ),
+                            "fast": build_window_resident_drain(
+                                ctx, spec, ring_depth, insert=False,
+                                kg_fill=kg_stats_on, reduced=rd_reduced,
+                            ) if build_fast else None,
+                        }
+                    if "exchange" in steps_by_route:
+                        residents_by_route["exchange"] = {
+                            "insert": build_window_resident_drain_exchange(
+                                ctx, spec, bpd, ring_depth, capf,
+                                kg_fill=kg_stats_on, reduced=rd_reduced,
+                            ),
+                            "fast": build_window_resident_drain_exchange(
+                                ctx, spec, bpd, ring_depth, capf,
+                                insert=False, kg_fill=kg_stats_on,
+                                reduced=rd_reduced,
+                            ) if build_fast else None,
+                        }
                 fire_step = build_window_fire_step(ctx, spec)
                 if sink_device_reduce:
                     # a second compiled fire variant with NO key/value
@@ -1841,6 +1920,7 @@ class LocalExecutor:
                 value_dtype=(
                     np.uint32 if red.kind == "sketch" else np.float32
                 ),
+                ring_depth=ring_depth if use_resident else 0,
             ))
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
@@ -1854,6 +1934,7 @@ class LocalExecutor:
                                       metrics.steps_exchanged)
                 fused0 = metrics.fused_dispatches
                 ff0 = metrics.fused_fire_dispatches
+                rd0 = metrics.resident_drains
                 for route in steps_by_route:
                     for tier in ("insert", "fast"):
                         if steps_by_route[route][tier] is None:
@@ -1880,6 +1961,26 @@ class LocalExecutor:
                                 route, [_empty_fused_item(route)
                                         for _ in range(k_fuse)]
                             )
+                drain_warmup[0] = True
+                try:
+                    for route in residents_by_route:
+                        # one compile serves every fill level (count is
+                        # a traced operand); warm up at a PARTIAL fill
+                        # so both cond branches execute at least once
+                        # before measurement
+                        for tier in ("insert", "fast"):
+                            if residents_by_route[route][tier] is None:
+                                continue
+                            step_mode[0] = tier
+                            with CompileEvents.stage(
+                                f"window-drain-{route}-{tier}"
+                            ):
+                                run_update_resident(
+                                    route, [_empty_fused_item(route)
+                                            for _ in range(ring_depth - 1)]
+                                )
+                finally:
+                    drain_warmup[0] = False
                 step_mode[0] = "insert"
                 force_route[0] = None
                 tier_quiet[0] = 0
@@ -1890,6 +1991,7 @@ class LocalExecutor:
                 metrics.steps_exchanged = ex0
                 metrics.fused_dispatches = fused0
                 metrics.fused_fire_dispatches = ff0
+                metrics.resident_drains = rd0
                 # warmup fired-megastep payloads: sentinel watermarks
                 # fire nothing, and warmup must not leave handles behind
                 fire_watch.clear()
@@ -2541,6 +2643,7 @@ class LocalExecutor:
             _kg_ends = np.asarray(ctx.kg_bounds()[1])
             steps_by_route.clear()
             megasteps_by_route.clear()
+            residents_by_route.clear()
             compact_step_fn = None
             kg_occ_step_fn[0] = None
             kg_occ_cache[0] = None
@@ -3006,6 +3109,11 @@ class LocalExecutor:
             # effective fused depth of the most recent dispatch (K for a
             # megastep, 1 for single-step / partial-group flushes)
             fuse_gauge[0] = grp.settable_gauge("steps_per_dispatch", 1)
+            # configured HBM batch-ring depth, 0 while the resident
+            # loop is off (the resident_drains counter rides
+            # JobMetrics.GAUGE_FIELDS)
+            grp.gauge("ring_depth",
+                      lambda: ring_depth if use_resident else 0)
 
             def _occ_stat(fn, default=0):
                 occ = kg_occ_cache[0]
@@ -3296,6 +3404,104 @@ class LocalExecutor:
                     )
                     check_overflow_pressure()
 
+        def run_update_resident(route, items):
+            """Dispatch ONE resident ring drain: `items` is 1..ring_depth
+            (args, wm_ms, pb) tuples of the same route, all device-staged
+            (the drain group's contract). A single count-gated jitted
+            scan applies + fires every live slot against donated state —
+            slots past the count cost only the scalar predicate — so the
+            fixed per-dispatch cost is paid once per ring drain at ANY
+            fill level, with no per-fill recompile. Exit policy (ring
+            empty, fire high-water, monitoring cadence, checkpoint cut)
+            is host-side COUNT policy: whatever bounded this group's
+            accumulation decides what the device consumes; slots past a
+            cut simply stay in the ring for the next drain."""
+            nonlocal state
+            count = len(items)
+            t_d0 = time.perf_counter()
+            t_r1 = (
+                time.perf_counter()
+                if tracer is not None and tracer.active else None
+            )
+            tiers = residents_by_route[route]
+            tier = (
+                "fast"
+                if step_mode[0] == "fast" and tiers["fast"] is not None
+                else "insert"
+            )
+            active = tiers[tier]
+            # chaos seam (see run_update): device loss / crash out of a
+            # drain dispatch — the mid-drain exactly-once test injects
+            # exactly here. Warmup drains are exempt: they dispatch
+            # synthetic empty batches (already excluded from the step
+            # counters), and counting them would make a rule's
+            # occurrence index depend on which kernel tiers got built
+            if not drain_warmup[0]:
+                faults.inject("step.drain", step=metrics.steps,
+                              route=route, slots=count)
+            flat = []
+            # lint: allow(retrace): tiny [n_shards, D] watermark matrix, fresh per drain dispatch for the same reason as run_update's wmv (queued async dispatches must not share the buffer)
+            wmv = np.empty((ctx.n_shards, ring_depth), np.int32)
+            for i, (args, wm_ms, _pb) in enumerate(items):
+                flat.extend(args)
+                wmv[:, i] = np.int32(
+                    min(int(td.to_ticks(wm_ms)), 2**31 - 4)
+                    if wm_ms is not None else -(2**31) + 1
+                )
+            # pad the operand list to ring depth by repeating the last
+            # slot: the skip branch never applies them, and the MIN-
+            # sentinel watermark fires nothing even if it did — the pad
+            # exists only so the scan's stacked xs keep one static shape
+            for i in range(count, ring_depth):
+                flat.extend(items[-1][0])
+                wmv[:, i] = np.int32(-(2**31) + 1)
+            wd_prev = None
+            if wd is not None:
+                # deadline scales with the work actually handed to the
+                # device: per-slot seconds x slots consumed
+                wd_prev = wd.arm("device-drain",
+                                 detail=f"slots={count}", scale=count)
+            try:
+                # resident drains always fire in-scan: queue the payload
+                # handles for LAGGED consumption (consume_fires); the
+                # post-scan ovf_n handle rides along as in
+                # run_update_fused
+                state, (ovf_handle, act_handle, kgf_handle), fires = \
+                    active(state, *flat, wmv, np.int32(count))
+                fire_watch.append((fires, ovf_handle, time.perf_counter()))
+                inflight.append(act_handle)
+                if len(inflight) > max_inflight:
+                    inflight.popleft().block_until_ready()
+            finally:
+                if wd is not None:
+                    wd.disarm(wd_prev)
+            t_d1 = time.perf_counter()
+            phase_acc["dispatch"] += t_d1 - t_d0
+            if t_r1 is not None:
+                tracer.rec("drain", t_r1, t_d1, route=route, tier=tier,
+                           step=metrics.steps, slots=count,
+                           ring_depth=ring_depth)
+            metrics.steps += count
+            metrics.resident_drains += 1
+            metrics.fused_fire_dispatches += 1
+            if tier == "fast":
+                metrics.steps_fast += count
+            if route == "exchange":
+                metrics.steps_exchanged += count
+            if fuse_gauge[0] is not None:
+                fuse_gauge[0].set(count)
+            if win.overflow or kg_stats_on:
+                mon_skip[0] += count
+                if mon_skip[0] >= MON_EVERY:
+                    mon_skip[0] = 0
+                    # the drain's kg_fill handle sums `count` batches'
+                    # counts — carry the batch count so the sampled
+                    # denominator stays per micro-batch
+                    mon_watch.append(
+                        (ovf_handle, act_handle, kgf_handle, count)
+                    )
+                    check_overflow_pressure()
+
         def flush_fused():
             """Dispatch whatever the fused slot holds: a full group as
             one megastep, a partial group as sequential single steps
@@ -3316,8 +3522,17 @@ class LocalExecutor:
             if not len(fused):
                 return
             route, staged_mode, items = fused.drain()
-            full = len(items) >= k_fuse
-            if full:
+            # resident loop: a STAGED group of any fill 1..ring_depth is
+            # one count-gated drain dispatch — partial groups no longer
+            # fall back to sequential singles
+            resident_ok = (
+                use_resident and staged_mode
+                and route in residents_by_route
+            )
+            full = len(items) == k_fuse
+            if resident_ok:
+                run_update_resident(route, items)
+            elif full and route in megasteps_by_route:
                 run_update_fused(route, items)
             elif staged_mode:
                 for args, wm_ms, _pb in items:
@@ -3333,11 +3548,24 @@ class LocalExecutor:
             last_pb = items[-1][2]
             if last_pb is not None:
                 ingest.mark_applied(last_pb)
+            if resident_ok:
+                # ring-drain exactly-once boundary: the drain has been
+                # dispatched for every slot in this group, and the
+                # offsets cut above names it — retire the HBM ring
+                # slots so the prefetch thread can recycle them (the
+                # async runtime keeps the buffers alive until the
+                # queued drain has consumed them)
+                seqs = [
+                    it[2].ring_seq for it in items
+                    if it[2] is not None and it[2].ring_seq is not None
+                ]
+                if seqs and ingest.device_ring is not None:
+                    ingest.device_ring.release_through(max(seqs))
             if fused.hold_fires:
-                fired_in_scan = full and getattr(
+                fired_in_scan = resident_ok or (full and getattr(
                     megasteps_by_route.get(route, {}).get("insert"),
                     "fused_fire", False,
-                )
+                ))
                 _fused_fire_bookkeep(items, fired_in_scan)
                 # lagged payload consumption: by now the PREVIOUS
                 # group's fires have long materialized on device
@@ -4047,6 +4275,37 @@ class LocalExecutor:
                 "thread and would otherwise block the step loop"
             )
         use_staging = use_prefetch and staging_cfg != "off"
+        # -- finalize the resident loop (validated where res_cfg was
+        # read): the drain consumes ring-published STAGED batches, so
+        # "on" without the prefetch+staging substrate is a config error,
+        # and "auto" lights up exactly when the fused-fire resident
+        # pipeline is active with staging available
+        if res_cfg == "on":
+            if not use_staging:
+                raise ValueError(
+                    "pipeline.resident-loop=on requires pipeline."
+                    "prefetch + pipeline.device-staging: the drain "
+                    "consumes device-staged batches published into the "
+                    "HBM ring by the ingest thread"
+                )
+            use_resident = True
+        else:
+            # auto is PLATFORM-gated like precombine/packed-planes: the
+            # drain retires a ~100ms tunneled host round trip per
+            # megastep on accelerators, but on CPU dispatch costs
+            # microseconds and the extra drain-kernel compiles would be
+            # pure warmup overhead
+            use_resident = (
+                res_cfg == "auto" and use_fused_fire and use_staging
+                and jax.default_backend() != "cpu"
+            )
+        if use_resident:
+            # the drain group IS the ring: accumulator capacity tracks
+            # ring depth, and groups always hold fires (the drain fires
+            # in-scan per slot)
+            fused = ingest_mod.FusedBatchAccumulator(
+                ring_depth, hold_fires=True
+            )
         ingest = ingest_mod.IngestPipeline(
             prep_batch, prefetch=use_prefetch,
             initial_offsets=pipe.source.snapshot_offsets(),
@@ -4102,11 +4361,15 @@ class LocalExecutor:
             # resident pipeline: a crossing no longer breaks the group —
             # the fused-fire megastep fires it INSIDE the scan, and
             # flush_fused owns the crossing bookkeeping for this batch
-            in_scan = (
-                fused.hold_fires and k_fuse > 1
-                and pb.route in megasteps_by_route
+            in_slot = (
+                (k_fuse > 1 and pb.route in megasteps_by_route)
+                # resident loop: the drain group accumulates regardless
+                # of steps-per-dispatch — the count-gated drain
+                # dispatches ANY fill level as one scan
+                or (use_resident and pb.route in residents_by_route)
             )
-            if k_fuse > 1 and pb.route in megasteps_by_route:
+            in_scan = fused.hold_fires and in_slot
+            if in_slot:
                 if pb.staged is not None:
                     args, staged_mode = pb.staged, True
                 else:
@@ -4149,7 +4412,13 @@ class LocalExecutor:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()
             phase_acc["dispatch"] = phase_acc["emit"] = 0.0
-            if wd is None:
+            if pending_batch[0] is not None:
+                # leftover from the resident greedy ring fill: a batch
+                # the drain group could not absorb (idle, end, or
+                # unplanned) — it gets this cycle's FULL handling, in
+                # the order it was polled
+                pb, pending_batch[0] = pending_batch[0], None
+            elif wd is None:
                 pb = ingest.next()
             else:
                 # watchdog "source" phase (off by default): the wait for
@@ -4190,6 +4459,30 @@ class LocalExecutor:
                     setup((int(np.min(pb.ts_ms)) // size_ms) * size_ms)
                 if pb.route is not None:
                     deferred = _apply_planned(pb)
+                    # resident loop: greedily absorb every batch the
+                    # prefetch queue ALREADY holds into the drain group,
+                    # so one cycle consumes ring slots up to the write
+                    # cursor instead of one batch per cycle. Each pull
+                    # rides _apply_planned (time-jump guard, route
+                    # compatibility, flush-on-full all apply); the loop
+                    # stops at ring empty (try_next None), a flushed
+                    # group (the cycle dispatched its drain), or a
+                    # batch the group cannot hold (handled next cycle
+                    # via pending_batch, order preserved).
+                    while use_resident and deferred:
+                        nxt = ingest.try_next()
+                        if nxt is None:
+                            break
+                        if nxt.n and nxt.route is not None \
+                                and not nxt.end:
+                            metrics.records_in += nxt.n
+                            last_ingest_t[0] = nxt.t_src
+                            if not _apply_planned(nxt):
+                                ingest.mark_applied(nxt)
+                                break
+                        else:
+                            pending_batch[0] = nxt
+                            break
                 else:
                     _apply_general(pb)
             elif td is not None:

@@ -120,6 +120,11 @@ class PreppedBatch:
     route: Optional[str] = None  # "mask" | "exchange" | None (unplanned)
     # device-staged (hi, lo, ticks, values, valid) committed arrays
     staged: Optional[Tuple] = None
+    # device batch ring slot sequence (pipeline.resident-loop): set when
+    # ``staged`` lives in a DeviceBatchRing slot; the consumer releases
+    # the slot once the batch's ring drain retired it. None = staged
+    # outside the ring (ring full, or resident loop off).
+    ring_seq: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -147,6 +152,10 @@ class IngestPlan:
     split_sharding: Any = None   # batch-axis split (exchange route)
     value_shape: Tuple = ()
     value_dtype: Any = np.float32
+    # device batch ring depth (pipeline.resident-loop / ring-depth):
+    # > 0 promotes the staging ring to a DeviceBatchRing of this many
+    # committed HBM slots; 0 keeps the plain PR 3 staging ring
+    ring_depth: int = 0
 
     @staticmethod
     def shardings_for(mesh):
@@ -219,6 +228,37 @@ def _host_probe_put_aliases(buf: np.ndarray, sharding) -> bool:
     return aliased
 
 
+# per-PROCESS zero-copy aliasing verdicts (ISSUE 12 small fix): the
+# probe used to run per ring init — per JOB — so bench sweeps and test
+# suites that build dozens of pipelines in one process paid the device
+# round trips over and over. The verdict is a property of the backend's
+# device_put path, not of the job, so it is cached process-wide:
+#
+#   * non-CPU platforms skip the probe entirely — an accelerator
+#     device_put is architecturally an H2D copy into HBM; host memory
+#     can never alias it.
+#   * on CPU only the ALIASED verdict is sticky: one observed zero-copy
+#     proves the client takes that path, and disabling slot reuse (the
+#     consequence) is the safe direction for every later ring. An
+#     all-False probe is NOT cached — aliasing is decided per
+#     allocation (alignment), so a later ring's differently-aligned
+#     buffers could still alias, and caching False there is exactly the
+#     silent-corruption direction the probe exists to prevent.
+_put_alias_sticky: dict = {}
+
+
+def _host_put_aliases_cached(bufs, sharding) -> bool:
+    platform = jax.default_backend()
+    if platform != "cpu":
+        return False
+    if _put_alias_sticky.get(platform):
+        return True
+    aliased = any(_host_probe_put_aliases(b, sharding) for b in bufs)
+    if aliased:
+        _put_alias_sticky[platform] = True
+    return aliased
+
+
 class StagingRing:
     """Preallocated host padding buffers for the prefetch thread's
     device staging — the per-batch ``np.zeros`` padding in ``_pad``
@@ -251,9 +291,9 @@ class StagingRing:
         self._slots = [one_slot() for _ in range(max(2, int(depth)))]
         self._i = 0
         self._mask_tmpl = make_prefix_mask_template(Bs)
-        self._reuse = not any(
-            _host_probe_put_aliases(buf, plan.mask_sharding)
-            for slot in self._slots for buf in slot.values()
+        self._reuse = not _host_put_aliases_cached(
+            [buf for slot in self._slots for buf in slot.values()],
+            plan.mask_sharding,
         )
 
     @staticmethod
@@ -294,6 +334,100 @@ class StagingRing:
             tracer.rec("stage", t0, t_pad, n=n)
             tracer.rec("transfer", t_pad, route=route)
         return staged
+
+
+class DeviceBatchRing:
+    """Device-resident batch ring (pipeline.resident-loop, ISSUE 12):
+    PR 3's staging ring promoted to a bounded ring of COMMITTED device
+    batch slots with a host-side write cursor, so the step loop can see
+    "how many staged batches are ready right now" and retire them all
+    with one resident-drain dispatch (runtime/step.py
+    build_window_resident_drain) instead of one megastep each.
+
+    Layout: ``depth`` slots, each pairing one preallocated host padding
+    buffer set (the embedded StagingRing, sized to the ring so every
+    in-flight slot has its own pad buffers) with the committed device
+    arrays staged through it. A slot is (seq, epoch, staged 5-tuple);
+    the staged arrays are the slot's HBM residency — publishing bounds
+    the device footprint to ``depth`` batches, and releasing a slot
+    drops the last reference so the arrays free as soon as the drain
+    that consumed them retires. (JAX owns physical allocation; the ring
+    owns the lifetime, which is the half a host-side cursor can pin.)
+
+    Threading contract (SPSC, same as the pipeline): ONE producer — the
+    prefetch thread — publishes; ONE consumer — the step loop — reads
+    occupancy and releases. ``try_publish`` is the producer's whole
+    surface: it stages into the next slot and advances the write cursor,
+    or returns None when the ring is full (the caller falls back to
+    plain staging, so a slow drain never blocks the source poll). The
+    write cursor is advanced AFTER the slot contents are in place, so
+    the consumer can never observe a half-published slot; cursors are
+    plain ints mutated under one lock (the critical sections are
+    pointer-sized — the cursor-race property test drives this seam).
+
+    Epoch discard: every slot carries the pipeline epoch it was staged
+    under. ``clear()`` (called from the pipeline's restore ``resume``,
+    after ``pause`` parked the producer) retires every in-flight slot —
+    the epoch bump already invalidates the queued PreppedBatches that
+    reference them, and the rewound source replays those records."""
+
+    def __init__(self, plan: IngestPlan, depth: int):
+        self.depth = max(2, int(depth))
+        self._staging = StagingRing(plan, self.depth)
+        self._slots: list = [None] * self.depth
+        self._write = 0          # seq of the next slot to publish
+        self._read = 0           # seq of the oldest unreleased slot
+        self._lock = threading.Lock()
+
+    # -- producer (prefetch thread) --------------------------------------
+    def try_publish(self, plan: IngestPlan, hi, lo, ticks, values,
+                    n: int, route: str, epoch: int,
+                    tracer=None) -> Optional[Tuple[int, Tuple]]:
+        """Stage one batch into the next ring slot; returns (seq,
+        staged) or None when the ring is full. The stage itself blocks
+        for transfer completion on THIS thread (StagingRing.stage), so a
+        published slot's arrays are always dispatch-ready."""
+        with self._lock:
+            if self._write - self._read >= self.depth:
+                return None
+            seq = self._write
+        staged = self._staging.stage(plan, hi, lo, ticks, values, n,
+                                     route, tracer=tracer)
+        with self._lock:
+            self._slots[seq % self.depth] = (seq, epoch, staged)
+            self._write = seq + 1
+        return seq, staged
+
+    # -- consumer (step loop) --------------------------------------------
+    def occupancy(self) -> int:
+        """Committed-but-unreleased slots: write cursor - read cursor."""
+        with self._lock:
+            return self._write - self._read
+
+    def release_through(self, seq: int) -> int:
+        """Retire every slot up to and including ``seq`` (a drain
+        returned for them — the ring-drain exactly-once boundary).
+        Returns the number of slots released. Out-of-window seqs are a
+        no-op: a restore's ``clear`` may already have retired them."""
+        with self._lock:
+            if seq < self._read:
+                return 0
+            upto = min(seq, self._write - 1)
+            n = upto - self._read + 1
+            for s in range(self._read, upto + 1):
+                self._slots[s % self.depth] = None
+            self._read = upto + 1
+            return n
+
+    def clear(self) -> int:
+        """Restore path: discard every in-flight slot (their epoch is
+        pre-bump; the queued batches referencing them are dropped by the
+        consumer's epoch check and replay from the rewound source)."""
+        with self._lock:
+            n = self._write - self._read
+            self._slots = [None] * self.depth
+            self._read = self._write
+            return n
 
 
 # ------------------------------------------------------- fused dispatch
@@ -398,6 +532,7 @@ class IngestPipeline:
         self.source_lock = threading.RLock()
         self._plan: Optional[IngestPlan] = None
         self._ring: Optional[StagingRing] = None
+        self._device_ring: Optional[DeviceBatchRing] = None
         self._ring_depth = max(2, int(ring_depth))
         self._applied = initial_offsets
         self._epoch = 0
@@ -420,12 +555,23 @@ class IngestPipeline:
     def set_plan(self, plan: IngestPlan):
         """Install/replace the prep plan (attribute publish is atomic;
         batches mid-prep finish under whichever plan they started —
-        the consumer handles both planned and unplanned batches)."""
+        the consumer handles both planned and unplanned batches). With
+        ``plan.ring_depth > 0`` the plan also stands up the device batch
+        ring; the plain staging ring stays as the ring-full fallback."""
         if plan.staging:
             self._ring = StagingRing(plan, self._ring_depth)
+            self._device_ring = (
+                DeviceBatchRing(plan, plan.ring_depth)
+                if plan.ring_depth > 0 else None
+            )
         else:
             self._ring = None
+            self._device_ring = None
         self._plan = plan
+
+    @property
+    def device_ring(self) -> Optional[DeviceBatchRing]:
+        return self._device_ring
 
     def _finish(self, pb: PreppedBatch) -> PreppedBatch:
         """Apply the plan to a freshly prepped batch: time-domain ticks,
@@ -457,10 +603,24 @@ class IngestPipeline:
         if tracer is not None and tracer.active:
             tracer.rec("route", t_r0, route=pb.route, planned=True)
         if self._ring is not None:
-            pb.staged = self._ring.stage(
-                plan, pb.hi, pb.lo, ticks, values, pb.n, pb.route,
-                tracer=tracer,
-            )
+            pub = None
+            if self._device_ring is not None:
+                pub = self._device_ring.try_publish(
+                    plan, pb.hi, pb.lo, ticks, values, pb.n, pb.route,
+                    pb.epoch, tracer=tracer,
+                )
+            if pub is not None:
+                pb.ring_seq, pb.staged = pub
+            else:
+                # device ring full (or resident loop off): plain staging
+                # — the batch still flows in order through the queue,
+                # and the drain dispatcher applies it as an unringed
+                # staged batch, so a slow drain backpressures HBM
+                # residency without ever blocking the source poll
+                pb.staged = self._ring.stage(
+                    plan, pb.hi, pb.lo, ticks, values, pb.n, pb.route,
+                    tracer=tracer,
+                )
             # the ring slot owns the padded copies; drop the host arrays
             # so nothing can alias a recycled slot
             pb.hi = pb.lo = pb.values = None
@@ -565,6 +725,29 @@ class IngestPipeline:
                 raise item
             return item
 
+    def try_next(self) -> Optional[PreppedBatch]:
+        """Non-blocking ``next()`` for the resident drain's greedy ring
+        fill: a ready batch, or None when the queue is empty RIGHT NOW
+        (the caller dispatches what it already holds instead of
+        waiting). Inline (prefetch-off) pipelines always return None —
+        there is no queue to be ahead in, and polling the source here
+        would turn the greedy accumulate into an unbounded synchronous
+        poll loop. A dead producer also returns None: the next blocking
+        ``next()`` surfaces IngestThreadDied with its full context."""
+        if not self.prefetch:
+            return None
+        self._ensure_thread()
+        while True:
+            try:
+                kind, epoch, item = self._q.get_nowait()
+            except queue.Empty:
+                return None
+            if epoch != self._epoch:
+                continue     # pre-restore batch: dropped, source rewound
+            if kind == "err":
+                raise item
+            return item
+
     def mark_applied(self, pb: PreppedBatch):
         """Record pb's offsets as the applied cut — everything up to and
         including this batch has been dispatched to device state, so a
@@ -596,6 +779,11 @@ class IngestPipeline:
                 self._q.get_nowait()
             except queue.Empty:
                 break
+        if self._device_ring is not None:
+            # the epoch bump above already invalidates every queued
+            # batch referencing these slots; retiring them re-opens the
+            # full ring to the post-restore epoch's producer
+            self._device_ring.clear()
         self._applied = applied_offsets
         if self._thread is not None and self._thread.is_alive():
             # the surviving (parked) producer serves the new epoch from

@@ -708,6 +708,269 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
     return megastep
 
 
+def _zero_slot_fires(spec: WindowStageSpec, reduced: bool):
+    """Zero-shaped per-sub-step fire payload for the resident drain's
+    skip branch: field-for-field the shapes/dtypes a live sub-step's
+    ``wk.advance_and_fire_resident`` emits (its own internal skip branch
+    packs the same zeros), so both ``lax.cond`` branches of the drain
+    body stack identically and an unconsumed ring slot is bit-identical
+    to packing an empty fire — the executor's lagged consume_fires sees
+    counts == 0 and emits nothing."""
+    F = spec.win.fires_per_step
+    C = spec.capacity_per_shard
+    zi = jnp.zeros(F, jnp.int32)
+    zf = jnp.zeros(F, jnp.float32)
+    zb = jnp.zeros(F, bool)
+    n0 = jnp.zeros((), jnp.int32)
+    if reduced:
+        return wk.ReducedFires(zi, zi, n0, zb, zf)
+    return wk.CompactFires(
+        jnp.zeros((F, C), jnp.uint32),
+        jnp.zeros((F, C), jnp.uint32),
+        jnp.zeros((F, C) + spec.red.out_shape, spec.red.out_dtype),
+        zi, zi, n0, zb, zf,
+    )
+
+
+def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
+                                depth: int, insert: bool = True,
+                                kg_fill: bool = False,
+                                reduced: bool = False):
+    """Device-resident ring-drain loop (pipeline.resident-loop, ISSUE
+    12): ONE jitted dispatch consumes up to ``depth`` staged ring slots
+    against donated state, running the PR 7 fused update+fire body per
+    slot — steady state costs one host round trip per ring DRAIN instead
+    of one per megastep.
+
+    Lowering choice (the ISSUE allows ``lax.while_loop`` or a long-K
+    scan with a read-only early-exit cond): a fixed-depth ``lax.scan``
+    whose body is gated by ``lax.cond(i < count, live, skip)``. The scan
+    stacks the per-slot fire payloads for free (the while_loop form
+    needs a dynamic_update_slice per payload field per iteration — more
+    ops under the PR 10 op-budget ledger and a worse scatter count), the
+    carry threading is identical to the proven megastep_fired scan, and
+    XLA's conditional executes only the taken branch, so slots past the
+    write cursor cost the scalar predicate, not an update pass. ``count``
+    is a TRACED int32 operand — one compile per (route, tier) serves
+    every fill level, so the loop never recompiles as ring occupancy
+    varies (the compile-signature ledger pins this).
+
+    The host-side exit conditions (ring-empty, fire-buffer high water,
+    monitoring cadence, checkpoint-cut request) all resolve to the
+    ``count`` the executor passes: it caps the drain at whichever
+    boundary comes first, and slots past the cut stay in the ring for
+    the next drain — the exactly-once cut is the ring-drain boundary.
+
+    Signature: ``drain(state, hi_0, lo_0, ticks_0, values_0, valid_0,
+    ..., wmv, count)`` — ``depth`` staged batch 5-tuples (slots past
+    ``count`` repeat an already-staged slot; the skip branch never reads
+    them), wmv int32 [n_shards, depth] (sentinel past count), count
+    int32 scalar. Returns ``(state', (ovf_n, activity, kg_fill),
+    fires)`` with fires stacked [n_shards, depth] exactly like
+    ``build_window_megastep_fired`` at K=depth, so the executor's lagged
+    fire consumption and monitoring paths need no drain-specific
+    variant."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(depth)
+
+    def shard_body(state, kg_start, kg_end, count, hi, lo, ts, values,
+                   valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        pend0 = jnp.zeros(spec.win.ring, bool)
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(op):
+                st, pend = op
+                st, act, kgf = mask_update_shard(
+                    st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
+                    s_vals, s_valid, s_wm, maxp, insert=insert,
+                    kg_fill=kg_fill, clear_rows=pend,
+                )
+                st, pend, cf = wk.advance_and_fire_resident(
+                    st, spec.win, spec.red, s_wm, reduced=reduced
+                )
+                return (st, pend), (act, kgf, cf)
+
+            def skip(op):
+                kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+                return op, (jnp.zeros((), jnp.int32), kgf,
+                            _zero_slot_fires(spec, reduced))
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+            sub, (state, pend0),
+            (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
+             wm[0]),
+        )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(),                       # count: replicated scalar cursor
+            P(), P(), P(), P(), P(),   # [D, B] batch stacks, replicated
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(state, *flat):
+        *batches, wmv, count = flat
+        stacks = _fused_batch_stack(D, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            state, starts, ends, jnp.asarray(count, jnp.int32),
+            *stacks, wmv,
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.fused_fire = True
+    drain.fused_fire_reduced = reduced
+    return drain
+
+
+def build_window_resident_drain_exchange(ctx: MeshContext,
+                                         spec: WindowStageSpec,
+                                         batch_per_device: int,
+                                         depth: int,
+                                         capacity_factor: float = 2.0,
+                                         insert: bool = True,
+                                         kg_fill: bool = False,
+                                         reduced: bool = False):
+    """Exchange-route resident drain: the ring-drain analog of
+    build_window_megastep_fired_exchange — each live slot runs the
+    shared ``exchange_update_shard`` body (bucket + all_to_all + masked
+    update) followed by the gated resident advance, under the same
+    ``lax.cond(i < count)`` gate as the mask-route drain, so neither the
+    shuffle nor the fire semantics can diverge between routes or fill
+    levels. Batch stacks arrive [D, B] SPLIT over devices on the batch
+    (second) axis; ``count`` is replicated. Note the all_to_all runs
+    only in the live branch: every device takes the same branch because
+    ``count`` is replicated, so the collective stays globally
+    consistent."""
+    import dataclasses as _dc
+
+    from flink_tpu.parallel.exchange import bucket_capacity
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    n = ctx.n_shards
+    cap = bucket_capacity(batch_per_device, n, capacity_factor)
+    D = int(depth)
+
+    def shard_body(state, kg_start, kg_end, count, hi, lo, ts, values,
+                   valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        pend0 = jnp.zeros(spec.win.ring, bool)
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(op):
+                st, pend = op
+                st, act = exchange_update_shard(
+                    st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
+                    s_vals, s_valid, n, maxp, cap, insert=insert,
+                    clear_rows=pend,
+                )
+                st = _dc.replace(
+                    st, watermark=jnp.maximum(st.watermark, s_wm)
+                )
+                if kg_fill:
+                    kg_local = assign_to_key_group(
+                        route_hash(s_hi, s_lo, jnp), maxp, jnp
+                    )
+                    kgf = wk.kg_batch_fill(kg_local, s_valid, maxp)
+                else:
+                    kgf = jnp.zeros(0, jnp.int32)
+                st, pend, cf = wk.advance_and_fire_resident(
+                    st, spec.win, spec.red, s_wm, reduced=reduced
+                )
+                return (st, pend), (act, kgf, cf)
+
+            def skip(op):
+                kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+                return op, (jnp.zeros((), jnp.int32), kgf,
+                            _zero_slot_fires(spec, reduced))
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+            sub, (state, pend0),
+            (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
+             wm[0]),
+        )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(),                       # count: replicated scalar cursor
+            # [D, B] stacks SPLIT over devices on the batch axis
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(state, *flat):
+        *batches, wmv, count = flat
+        stacks = _fused_batch_stack(D, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            state, starts, ends, jnp.asarray(count, jnp.int32),
+            *stacks, wmv,
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.fused_fire = True
+    drain.fused_fire_reduced = reduced
+    drain.recv_lanes = n * cap
+    drain.bucket_cap = cap
+    return drain
+
+
 def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
     """Fire-only half: advance the watermark, evaluate due window ends for
     the whole key population, and return device-compacted fires
@@ -1054,6 +1317,11 @@ AUDIT_CAPACITY = 64
 AUDIT_PROBE_LEN = 4
 AUDIT_BATCH = 8
 AUDIT_K_STEPS = 2
+# resident-drain ring depth for the audit grid: deep enough that the
+# cond gate is structurally live (the canonical count operand is
+# depth - 1, so BOTH branches appear in the traced program), small
+# enough to stay inside the lint tier's wall-time budget
+AUDIT_RING_DEPTH = 4
 
 
 @dataclass(frozen=True)
@@ -1075,9 +1343,13 @@ class KernelFamily:
 
     name: str
     builder: Callable
-    kind: str            # update | megastep | megastep_fired | fire |
-    #                      fire_reduced | compact | occupancy |
-    #                      session | count | rolling
+    kind: str            # update | megastep | megastep_fired |
+    #                      resident_drain | fire | fire_reduced |
+    #                      compact | occupancy | session | count |
+    #                      rolling
+    #                      (resident_drain reuses ``k_steps`` for its
+    #                      ring depth — the scan length axis is the same
+    #                      ledger currency either way)
     route: str = "mask"      # mask | exchange
     layout: str = "hash"     # hash | direct
     donated: bool = True
@@ -1129,6 +1401,23 @@ def kernel_family_grid():
         F("step.megastep_fired.exchange.hash.k2",
           build_window_megastep_fired_exchange,
           "megastep_fired", route="exchange", k_steps=K),
+        # the device-resident ring drain (ISSUE 12): the executor
+        # dispatches it along the same layout/plane/route axes as the
+        # fired megastep it supersedes in steady state
+        F("step.resident_drain.mask.hash.d4", build_window_resident_drain,
+          "resident_drain", k_steps=AUDIT_RING_DEPTH, deep=True),
+        F("step.resident_drain.mask.direct.d4",
+          build_window_resident_drain,
+          "resident_drain", layout="direct", k_steps=AUDIT_RING_DEPTH),
+        F("step.resident_drain.mask.hash.d4.packed",
+          build_window_resident_drain,
+          "resident_drain", packed=True, k_steps=AUDIT_RING_DEPTH),
+        F("step.resident_drain.mask.hash.d4.reduced",
+          build_window_resident_drain,
+          "resident_drain", reduced=True, k_steps=AUDIT_RING_DEPTH),
+        F("step.resident_drain.exchange.hash.d4",
+          build_window_resident_drain_exchange,
+          "resident_drain", route="exchange", k_steps=AUDIT_RING_DEPTH),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -1194,6 +1483,12 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
     if fam.kind in ("megastep", "megastep_fired"):
         wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
         return (state,) + per * fam.k_steps + (wmv,)
+    if fam.kind == "resident_drain":
+        # partially-filled ring (count = depth - 1): both cond branches
+        # are live in the traced program, so the audit sees the gate
+        wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
+        count = jnp.asarray(fam.k_steps - 1, jnp.int32)
+        return (state,) + per * fam.k_steps + (wmv, count)
     if fam.kind in ("fire", "fire_reduced"):
         return (state, watermark_vector(ctx, 0))
     if fam.kind == "session":
@@ -1214,15 +1509,18 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     auditor can make_jaxpr / lower / compile against."""
     spec = audit_stage_spec(fam)
     kw = {}
-    if fam.kind in ("update", "megastep", "megastep_fired"):
+    if fam.kind in ("update", "megastep", "megastep_fired",
+                    "resident_drain"):
         kw["insert"] = fam.insert
         kw["kg_fill"] = True
     if fam.route == "exchange":
         kw["batch_per_device"] = batch
     if fam.kind in ("megastep", "megastep_fired"):
         kw["k_steps"] = fam.k_steps
-    if fam.kind == "megastep_fired":
+    if fam.kind in ("megastep_fired", "resident_drain"):
         kw["reduced"] = fam.reduced
+    if fam.kind == "resident_drain":
+        kw["depth"] = fam.k_steps
     fn = fam.builder(ctx, spec, **kw)
     init = {
         "session": init_session_state,

@@ -114,10 +114,15 @@ class Watchdog:
         return bool(self.deadlines)
 
     # -- supervised-thread side ----------------------------------------
-    def arm(self, phase: str, detail: str = ""):
+    def arm(self, phase: str, detail: str = "", scale: float = 1.0):
         """Arm ``phase`` for the calling thread; returns the previous
         slot (restore it with ``disarm``). Unknown/disabled phases arm a
-        no-deadline slot so nesting stays balanced."""
+        no-deadline slot so nesting stays balanced. ``scale`` multiplies
+        the configured deadline for phases whose legitimate duration is
+        work-proportional — the resident drain arms ``device-drain``
+        with scale = slots consumed, so one per-slot deadline covers
+        every drain size without a deep drain tripping a shallow
+        deadline."""
         tid = threading.get_ident()
         prev = self._armed.get(tid)
         dl = self.deadlines.get(phase)
@@ -126,6 +131,7 @@ class Watchdog:
         if dl is None:
             self._armed[tid] = (phase, 0.0, 0.0, detail)
         else:
+            dl = dl * max(1.0, float(scale))
             self._armed[tid] = (phase, time.monotonic(), dl, detail)
         return prev
 
@@ -251,6 +257,10 @@ def watchdog_from_config(config, on_trip=None) -> Optional[Watchdog]:
         "barrier_fetch": config.get(CO.WATCHDOG_FETCH_TIMEOUT),
         "checkpoint_sync": config.get(CO.WATCHDOG_CKPT_SYNC_TIMEOUT),
         "materializer_slot": config.get(CO.WATCHDOG_SLOT_TIMEOUT),
+        # PER-SLOT seconds: the resident drain arms this scaled by the
+        # slot count it dispatched (Watchdog.arm scale=), so the
+        # deadline tracks the work actually handed to the device
+        "device-drain": config.get(CO.WATCHDOG_DRAIN_TIMEOUT),
         # recovery gets its OWN deadline; the step-loop phases above are
         # suspended while a restore runs (Watchdog.suspend)
         "restore": config.get(CO.WATCHDOG_RESTORE_TIMEOUT),

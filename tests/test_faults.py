@@ -554,8 +554,8 @@ def test_gc_sweeps_stale_tmp_dirs(tmp_path):
 def test_dcn_peer_loss_after_reconnect_exhaustion():
     """A peer that dies for good: bounded reconnect gives up with an
     attributed DCNPeerLostError, not an endless redial loop."""
-    rings = _ring_pair(recv_timeout_s=5.0, reconnect_attempts=1,
-                       reconnect_backoff_s=0.05)
+    rings = _ring_pair(recv_timeout_s=1.0, reconnect_attempts=1,
+                       reconnect_backoff_s=0.05, resync_window_s=1.0)
     rings[1].close()                # peer gone, server socket included
     try:
         with pytest.raises(dcn.DCNPeerLostError):

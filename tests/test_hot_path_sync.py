@@ -1,7 +1,9 @@
 """tools/check_hot_path_sync.py wired as a tier-1 test (ISSUE 2
 satellite): an unintended host sync (`block_until_ready`, `.item()`,
-`np.asarray` on device arrays) in the hot-path modules fails the suite
-instead of silently costing a ~70ms round trip per step."""
+`np.asarray`/`np.array` on device arrays, `jax.device_get` — the last
+two added with the round-12 resident drain loop, whose host sections
+must stay sync-free) in the hot-path modules fails the suite instead of
+silently costing a ~70ms round trip per step."""
 
 import os
 import subprocess
@@ -49,17 +51,21 @@ def test_ingest_staging_path_has_no_unmarked_sync():
 def test_checker_flags_sync_constructs():
     src = (
         "import numpy as np\n"
+        "import jax\n"
         "def kernel(x):\n"
         "    x.block_until_ready()\n"
         "    n = x.ovf_n.item()\n"
         "    a = np.asarray(x.acc)\n"
         "    b = numpy.asarray(x.acc)\n"
-        "    return n, a, b\n"
+        "    c = np.array(x.acc)\n"
+        "    d = jax.device_get(x.acc)\n"
+        "    return n, a, b, c, d\n"
     )
     vs = check_source(src, "flink_tpu/ops/fake.py")
-    assert [v.line for v in vs] == [3, 4, 5, 6]
+    assert [v.line for v in vs] == [4, 5, 6, 7, 8, 9]
     assert {v.what for v in vs} == {
-        ".block_until_ready()", ".item()", "np.asarray(...)"
+        ".block_until_ready()", ".item()", "np.asarray(...)",
+        "np.array(...)", "jax.device_get(...)",
     }
 
 

@@ -1,0 +1,352 @@
+"""Device-resident steady-state loop (ISSUE 12, runtime/ingest.py
+DeviceBatchRing + runtime/executor.py resident drain):
+
+* steady-state correctness with ``pipeline.resident-loop=on`` — exact
+  windows, drains actually dispatched (one host round trip per ring
+  drain, not per megastep),
+* exactly-once across a MID-DRAIN crash (the ``step.drain`` fault seam
+  fires inside the drain dispatch path) with prefetch + incremental
+  checkpoints + packed state planes — the ring-drain boundary is the
+  cut, so the un-retired group replays without loss or double count,
+* the device-drain watchdog phase: per-slot deadline scaled by the slot
+  count the drain consumes (``Watchdog.arm(scale=)``),
+* DeviceBatchRing units: wraparound reuse of slots across many cursor
+  laps, restore ``clear()`` discard, and a threaded producer/consumer
+  cursor-race property test over the SPSC publish/release seam.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime import ingest as ingest_mod
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+N_KEYS = 200
+WINDOW = 10_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 50) * 1000
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 50) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None, **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = 256
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, source=None, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(source or GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("resident-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+RESIDENT_CFG = {
+    "pipeline.prefetch": "on",
+    "pipeline.device-staging": "on",
+    "pipeline.resident-loop": "on",
+    "pipeline.ring-depth": 4,
+}
+
+
+# ----------------------------------------------------- steady state
+
+def test_resident_loop_exact_and_drains_dispatched():
+    """Windows are exact with the resident loop on, and the steady
+    state really ran through ring drains: every step retired by a drain
+    dispatch, strictly fewer dispatches than steps."""
+    total = 4096
+    env = build_env(1, **RESIDENT_CFG)
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    assert m.resident_drains > 0
+    assert m.resident_drains < m.steps
+
+
+def test_resident_loop_on_requires_staging_substrate():
+    """``on`` without the prefetch+staging substrate is a config error,
+    never a silent downgrade to the per-megastep dispatch path."""
+    env = build_env(1, **{"pipeline.prefetch": "off",
+                          "pipeline.resident-loop": "on"})
+    with pytest.raises(ValueError, match="resident-loop"):
+        run_job(env, 512)
+
+
+# ------------------------------------------ mid-drain crash, exactly-once
+
+def test_resident_mid_drain_crash_restore_exactly_once(tmp_path):
+    """THE round-12 exactly-once criterion: crash at a drain dispatch
+    (the ``step.drain`` seam fires with staged slots accumulated but the
+    drain not yet retired) under prefetch + incremental checkpoints +
+    packed state planes; restore replays the un-retired group from the
+    applied-offset cut — no skipped and no double-counted records."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{**RESIDENT_CFG,
+           "checkpoint.mode": "incremental", "checkpoint.async": True,
+           "state.packed-planes": "on"},
+    )
+    inj = FaultInjector([
+        FaultRule("step.drain",
+                  exc=RuntimeError("injected mid-drain crash"), at=1),
+    ])
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert inj.fired_at("step.drain"), "drain seam never fired"
+    assert m.restarts == 1
+    assert m.resident_drains > 0
+    assert got == expected(total)
+
+
+def test_resident_checkpoint_cut_across_processes(tmp_path):
+    """Ring-drain cut portability: phase 1 checkpoints at drain
+    boundaries and stops mid-stream; a FRESH env restores the latest cut
+    and finishes. Merged output equals the single-run truth — a cut
+    inside a drain group (or at the live source position) would lose or
+    duplicate the ring-resident batches."""
+    total, half = 8192, 4096
+    env1 = build_env(1, tmp_path / "chk", interval=1, **RESIDENT_CFG)
+    got1 = run_job(env1, half)
+    assert env1.last_job.metrics.resident_drains > 0
+    env2 = build_env(1, **RESIDENT_CFG)
+    got2 = run_job(env2, total, restore_from=str(tmp_path / "chk"))
+    assert {**got1, **got2} == expected(total)
+
+
+# --------------------------------------------------- watchdog drain phase
+
+def test_watchdog_arm_scale_multiplies_deadline():
+    """The drain arms ``device-drain`` scaled by the slot count it
+    dispatched: deadline = per-slot config x slots; scale below 1 clamps
+    so a tiny drain never shrinks the configured floor."""
+    from flink_tpu.runtime.watchdog import Watchdog
+
+    wd = Watchdog({"device-drain": 0.2}, interval_s=0.05)
+    tid = threading.get_ident()
+    prev = wd.arm("device-drain", scale=16)
+    assert wd._armed[tid][2] == pytest.approx(3.2)
+    wd.disarm(prev)
+    prev = wd.arm("device-drain", scale=0.25)
+    assert wd._armed[tid][2] == pytest.approx(0.2)
+    wd.disarm(prev)
+
+
+def test_watchdog_device_drain_trip_attributed():
+    """A wedged drain trips the SCALED deadline with the phase name and
+    the slot-count detail in the attribution."""
+    from flink_tpu.runtime.watchdog import Watchdog, WatchdogError
+
+    trips = []
+    wd = Watchdog({"device-drain": 0.15}, interval_s=0.05,
+                  on_trip=trips.append).start()
+    try:
+        with pytest.raises(WatchdogError, match="device-drain"):
+            prev = wd.arm("device-drain", detail="slots=3", scale=2)
+            try:
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    time.sleep(0.01)
+                pytest.fail("watchdog never tripped")
+            finally:
+                wd.disarm(prev)
+        assert trips and trips[0].phase == "device-drain"
+        assert trips[0].elapsed_s >= 0.3       # the SCALED deadline held
+        assert trips[0].detail == "slots=3"
+    finally:
+        wd.stop()
+
+
+def test_watchdog_from_config_carries_drain_deadline():
+    from flink_tpu.runtime.watchdog import watchdog_from_config
+
+    wd = watchdog_from_config(
+        Configuration({"watchdog.drain-timeout": 7.5})
+    )
+    assert wd.deadlines["device-drain"] == 7.5
+
+
+# ------------------------------------------------- DeviceBatchRing units
+
+def _mk_plan(B=8, depth=4):
+    from flink_tpu.parallel.mesh import MeshContext
+
+    ctx = MeshContext.create(1, 128)
+    mask_sh, split_sh = ingest_mod.IngestPlan.shardings_for(ctx.mesh)
+    return ingest_mod.IngestPlan(
+        td=None, slide_ticks=1000, span_limit=8, B=B, B_step=B,
+        n_shards=1, max_parallelism=128,
+        kg_ends=np.array([128], np.int32), exchange_cap=0,
+        routes=("mask",), staging=True,
+        mask_sharding=mask_sh, split_sharding=split_sh,
+        ring_depth=depth,
+    )
+
+
+def _batch(j, n, B):
+    assert n <= B
+    return (np.full(n, j, np.uint32), np.arange(n, dtype=np.uint32),
+            np.zeros(n, np.int32), np.ones(n, np.float32))
+
+
+def test_device_ring_wraparound_and_release():
+    """Slots recycle across cursor laps: publish/release several times
+    the ring depth, verifying full-ring refusal, monotone seqs, payload
+    integrity after wraparound, and release accounting."""
+    depth, B = 3, 8
+    plan = _mk_plan(B=B, depth=depth)
+    ring = ingest_mod.DeviceBatchRing(plan, depth)
+    assert ring.occupancy() == 0
+
+    seq_next = 0
+    for lap in range(4):                       # 4 laps = 12 slots through
+        pubs = []
+        for j in range(depth):                 # fill to the brim
+            hi, lo, ticks, vals = _batch(seq_next, 5, B)
+            pub = ring.try_publish(plan, hi, lo, ticks, vals, 5,
+                                   "mask", epoch=0)
+            assert pub is not None
+            seq, staged = pub
+            assert seq == seq_next
+            seq_next += 1
+            pubs.append((seq, staged))
+        assert ring.occupancy() == depth
+        # full ring refuses deterministically (fallback-to-plain seam)
+        hi, lo, ticks, vals = _batch(999, 2, B)
+        assert ring.try_publish(plan, hi, lo, ticks, vals, 2,
+                                "mask", epoch=0) is None
+        # payload integrity after the slot was recycled from prior laps
+        for seq, staged in pubs:
+            got_hi = np.asarray(staged[0])
+            assert (got_hi[:5] == seq).all()
+            valid = np.asarray(staged[4])
+            assert valid[:5].all() and not valid[5:].any()
+        # one release covering the whole drain group
+        assert ring.release_through(pubs[-1][0]) == depth
+        assert ring.occupancy() == 0
+    # already-released / out-of-window seqs are a no-op
+    assert ring.release_through(0) == 0
+    assert ring.release_through(seq_next + 100) == 0
+
+
+def test_device_ring_clear_discards_inflight():
+    """Restore path: ``clear()`` retires every in-flight slot; later
+    publishes keep the monotone seq space (no slot aliasing with the
+    discarded epoch's batches)."""
+    depth, B = 4, 8
+    plan = _mk_plan(B=B, depth=depth)
+    ring = ingest_mod.DeviceBatchRing(plan, depth)
+    for j in range(3):
+        hi, lo, ticks, vals = _batch(j, 4, B)
+        assert ring.try_publish(plan, hi, lo, ticks, vals, 4,
+                                "mask", epoch=0) is not None
+    assert ring.clear() == 3
+    assert ring.occupancy() == 0
+    hi, lo, ticks, vals = _batch(7, 4, B)
+    seq, _staged = ring.try_publish(plan, hi, lo, ticks, vals, 4,
+                                    "mask", epoch=1)
+    assert seq == 3                    # seq space continues past clear
+    assert ring.release_through(seq) == 1
+
+
+def test_device_ring_cursor_race_property():
+    """SPSC cursor race: a producer thread publishes (spinning on full)
+    while the consumer releases concurrently. Every batch arrives
+    exactly once, in order, with its staged payload intact — the write
+    cursor can never expose a half-published slot, and release can never
+    free a slot the producer still owns."""
+    depth, B, M = 3, 8, 150
+    plan = _mk_plan(B=B, depth=depth)
+    ring = ingest_mod.DeviceBatchRing(plan, depth)
+    out_q: "queue.Queue" = queue.Queue()
+    errs = []
+
+    def producer():
+        try:
+            rng = np.random.default_rng(3)
+            for j in range(M):
+                n = int(rng.integers(1, B + 1))
+                hi, lo, ticks, vals = _batch(j, n, B)
+                while True:
+                    pub = ring.try_publish(plan, hi, lo, ticks, vals,
+                                           n, "mask", epoch=0)
+                    if pub is not None:
+                        break
+                    time.sleep(0.0002)     # ring full: drain is behind
+                out_q.put((j, n, pub[0], pub[1]))
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errs.append(e)
+        finally:
+            out_q.put(None)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    rng = np.random.default_rng(11)
+    seen, held = 0, []
+    while True:
+        item = out_q.get(timeout=60)
+        if item is None:
+            break
+        j, n, seq, staged = item
+        assert seq == seen                 # in order, exactly once
+        seen += 1
+        assert 0 < ring.occupancy() <= depth
+        got_hi = np.asarray(staged[0])
+        assert (got_hi[:n] == j).all()
+        valid = np.asarray(staged[4])
+        assert valid[:n].all() and not valid[n:].any()
+        # release in variable-size groups like the executor's drains
+        held.append(seq)
+        if len(held) >= int(rng.integers(1, depth + 1)):
+            ring.release_through(held[-1])
+            held = []
+    if held:
+        ring.release_through(held[-1])
+    t.join(timeout=10)
+    assert not errs, errs
+    assert seen == M
+    assert ring.occupancy() == 0

@@ -3,7 +3,10 @@
 
 THIN SHIM (ISSUE 9): the checker migrated into the unified invariant
 linter as the ``hot-path-sync`` rule — run ``python -m tools.lint``
-for all 7 rules, or this script for the one check. Public API
+for the full rule catalog, or this script for the one check (since
+round 12 it also flags ``np.array(<device array>)`` and
+``jax.device_get`` — the resident drain loop's host sections must stay
+sync-free). Public API
 (ALLOWLIST, check_source, check_tree, hot_path_files, main) is
 re-exported unchanged for tests/test_hot_path_sync.py and any other
 caller. Rule implementation: tools/lint/rules/hot_path_sync.py;

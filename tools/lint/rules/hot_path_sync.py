@@ -14,8 +14,19 @@ hot-path modules:
     flink_tpu/runtime/ingest.py  (pipelined ingest / device staging)
     flink_tpu/runtime/elastic.py (elastic re-plan helpers)
 
-outside an allowlisted barrier section. Allowlisting, in order of
-preference:
+outside an allowlisted barrier section.
+
+Round 12 (resident drain loop) extends the detected constructs: the
+drain's host sections — ring publish/release in ingest.py and the
+drain-group assembly feeding ``build_window_resident_drain`` — must
+stay sync-free for the one-dispatch-per-ring-drain story to hold, so
+``jax.device_get(...)`` (the D2H fetch a stray eager fire consumption
+would spell) and ``np.array(<device array>)`` (materializes like
+``np.asarray``) now flag alongside the original three. The staging
+ring's transfer-completion wait keeps its inline marker: it blocks on
+the INGEST thread by design, never the step loop.
+
+Allowlisting, in order of preference:
 
   1. Naming convention — functions whose name contains ``host`` or ends
      with ``_np`` are host-side by contract (hash64_host, estimate_np,
@@ -90,9 +101,19 @@ def _is_np_asarray(call: ast.Call) -> bool:
     f = call.func
     return (
         isinstance(f, ast.Attribute)
-        and f.attr == "asarray"
+        and f.attr in ("asarray", "array")
         and isinstance(f.value, ast.Name)
         and f.value.id in ("np", "numpy")
+    )
+
+
+def _is_device_get(call: ast.Call) -> bool:
+    f = call.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "device_get"
+        and isinstance(f.value, ast.Name)
+        and f.value.id == "jax"
     )
 
 
@@ -127,7 +148,9 @@ class _Scanner(QualnameVisitor):
         if _is_sync_attr(node):
             what = f".{node.func.attr}()"
         elif _is_np_asarray(node):
-            what = "np.asarray(...)"
+            what = f"np.{node.func.attr}(...)"
+        elif _is_device_get(node):
+            what = "jax.device_get(...)"
         if what is not None and not self._allowed(node):
             self.out.append(Violation(
                 self.relpath, node.lineno, self.qualname(), what
@@ -171,8 +194,9 @@ def check_tree(root: str) -> List[Violation]:
 
 class HotPathSyncRule(Rule):
     name = "hot-path-sync"
-    title = ("no block_until_ready/.item()/np.asarray host sync in "
-             "hot-path modules outside allowlisted barrier sections")
+    title = ("no block_until_ready/.item()/np.asarray/np.array/"
+             "jax.device_get host sync in hot-path modules outside "
+             "allowlisted barrier sections")
     established = "PR 2"
 
     def check(self, tree: RepoTree) -> List[Finding]:
