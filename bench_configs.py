@@ -1631,6 +1631,101 @@ def run_resident_loop(total_events: int, cpu: bool):
     return (bests["resident"][1], bests["fused"][1])
 
 
+def run_scaling_cell(total_events: int):
+    """ONE cell of the chips-vs-events/s curve (ISSUE 13): the sharded
+    resident drain (``build_window_sharded_drain``) at THIS process's
+    device count, matched dims with ``run_resident_loop`` (same B per
+    shard / C / ring / slide, ring depth D=32), pre-routed per-shard
+    batches so every staged row lands on its owning shard — weak
+    scaling, each chip drains its own full ring slice. The caller
+    (``bench.py --scaling``) forces the device count per child process;
+    this function just measures where it lands and returns
+    (n_devices, events/s)."""
+    from collections import deque as _dq
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.core.keygroups import assign_to_key_group
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.ops.hashing import route_hash
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec,
+        build_window_sharded_drain,
+        init_sharded_state,
+    )
+
+    n = len(jax.devices())
+    MAXP = 128
+    ctx = MeshContext.create(n, MAXP)
+    B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
+    D = 32
+    spec = WindowStageSpec(
+        win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=C, layout="direct", precombine=False,
+    )
+    drain = build_window_sharded_drain(ctx, spec, D, reduced=True)
+
+    # per-shard key pools: draw a lo pool, route it with the SAME
+    # host-side key-group math the ingest planner uses, and bucket by
+    # owning shard — staged rows are then sampled per shard from its own
+    # bucket, so the drain's ownership mask never drops a row and the
+    # events/s denominator is exact
+    rng = np.random.default_rng(11)
+    pool = rng.integers(0, C, 1 << 16).astype(np.uint32)
+    kg = assign_to_key_group(
+        route_hash(np.zeros_like(pool), pool, np), MAXP, np)
+    shard_of = ctx.shard_of_key_groups(kg)
+    buckets = [pool[shard_of == s] for s in range(n)]
+    assert all(len(b) for b in buckets), "key pool missed a shard"
+
+    iters = max(2 * D, min(4096, total_events // (B * n)))
+    n_batches = (iters // D) * D
+    batches, wmvs = [], []
+    for j in range(n_batches):
+        p = j // 4                      # BPP=4 batches per pane
+        lo = np.stack([
+            rng.choice(buckets[s], B) for s in range(n)
+        ])
+        batches.append(tuple(jax.device_put(a) for a in (
+            np.zeros((n, B), np.uint32), lo,
+            np.full((n, B), p * SLIDE + SLIDE // 2, np.int32),
+            np.ones((n, B), np.float32), np.ones((n, B), bool),
+        )))
+        wmvs.append(np.int32(p * SLIDE - 1))
+
+    def consume(cf):
+        jax.device_get((cf.counts, cf.lane_valid,
+                        cf.window_end_ticks, cf.value_sums))
+
+    counts = np.full(n, D, np.int32)    # full ring, every shard live
+
+    def run_once():
+        state = init_sharded_state(ctx, spec)
+        t0 = time.perf_counter()
+        handles = _dq()
+        mon = None
+        for g in range(n_batches // D):
+            sel = range(g * D, (g + 1) * D)
+            flat = [a for i in sel for a in batches[i]]
+            wmv = np.tile(
+                np.asarray([wmvs[i] for i in sel], np.int32), (n, 1))
+            state, mon, fires = drain(state, *flat, wmv, counts)
+            handles.append(fires)
+            if len(handles) > 1:
+                consume(handles.popleft())
+        while handles:
+            consume(handles.popleft())
+        jax.block_until_ready(mon[1])
+        return time.perf_counter() - t0
+
+    run_once()                           # compile + settle
+    dt = min(run_once() for _ in range(3))
+    return n, n * B * n_batches / dt
+
+
 CONFIGS = {
     "socket_wc": (run_socket_wc, 2_000_000),
     "count_min": (run_count_min, 4_000_000),

@@ -286,6 +286,26 @@ class CoreOptions:
         "bounds device-resident batches AND the max slots one drain "
         "dispatch consumes — deeper rings amortize the host round trip "
         "further but coarsen fire/checkpoint latency and HBM residency")
+    PIPELINE_DATA_PARALLEL = ConfigOption(
+        "pipeline.data-parallel", "auto",
+        "auto | on | off — mesh-resident data parallelism (ISSUE 13): "
+        "each chip owns a contiguous key-group slice, the prefetch "
+        "thread routes records to the owning shard off-loop and "
+        "publishes into that shard's slice of a sharded device batch "
+        "ring, and ONE shard_map'd drain dispatch advances every "
+        "shard's ring concurrently with zero cross-chip collectives on "
+        "the keyed hot path (fires pack per-shard and merge host-side "
+        "on the lagged consume path). Requires the resident loop; "
+        "batches whose per-shard skew overflows the ring slice fall "
+        "back to the replicated mask route for that batch only. auto = "
+        "on whenever the resident loop is active on a multi-chip mesh")
+    PIPELINE_SHARD_CAPACITY_FACTOR = ConfigOption(
+        "pipeline.shard-capacity-factor", 2.0,
+        "per-shard ring-slice rows as a multiple of the uniform share "
+        "B/n_shards (pipeline.data-parallel): headroom for key-group "
+        "skew before a batch falls back to the replicated route — "
+        "larger tolerates hotter shards at the cost of HBM and padded "
+        "drain work")
     STATE_PACKED_PLANES = ConfigOption(
         "state.packed-planes", "auto",
         "auto | on | off — store the touched (fire-eligibility) bits as "

@@ -86,3 +86,12 @@ class MeshContext:
         starts = np.asarray([r.start for r in self.key_group_ranges], np.int32)
         ends = np.asarray([r.end for r in self.key_group_ranges], np.int32)
         return starts, ends
+
+    def shard_of_key_groups(self, kg: np.ndarray) -> np.ndarray:
+        """Owning shard index per key group: searchsorted over the
+        INCLUSIVE range ends (Flink key-group semantics — default
+        side='left' is load-bearing; 'right' would shift every range
+        boundary one shard over). This is the one ownership mapping the
+        ingest route planner, the sharded batch ring, and the restore
+        re-bucketer must all agree on."""
+        return np.searchsorted(self.kg_bounds()[1], kg)

@@ -34,6 +34,7 @@ from flink_tpu.core.time import TimeDomain
 from flink_tpu.core.types import KeyCodec
 from flink_tpu.graph import stream_graph as sg
 from flink_tpu.ops import window_kernels as wk
+from flink_tpu.parallel.exchange import bucket_capacity
 from flink_tpu.parallel.mesh import MeshContext
 from flink_tpu.checkpointing import changelog as cklog
 from flink_tpu.checkpointing import manifest as ckmf
@@ -66,6 +67,7 @@ from flink_tpu.runtime.step import (
     build_window_megastep_fired_exchange,
     build_window_resident_drain,
     build_window_resident_drain_exchange,
+    build_window_sharded_drain,
     build_window_update_step,
     build_window_update_step_exchange,
     clear_dirty,
@@ -570,6 +572,10 @@ class JobMetrics:
     steps: int = 0
     steps_fast: int = 0   # steps run on the lookup-only fast tier
     steps_exchanged: int = 0  # steps routed through the ICI all_to_all
+    # steps drained through the shard_map'd data-parallel ring
+    # (pipeline.data-parallel): records pre-routed to the owning
+    # shard's slice, zero collectives in the keyed body
+    steps_sharded: int = 0
     # K-fused lax.scan dispatches (pipeline.steps-per-dispatch > 1);
     # each one carries k_steps micro-batches of the `steps` counter
     fused_dispatches: int = 0
@@ -692,6 +698,7 @@ class JobMetrics:
     # MiniCluster's job detail endpoint)
     GAUGE_FIELDS = (
         "records_in", "records_out", "fires", "steps", "steps_fast",
+        "steps_sharded",
         "fused_dispatches", "fused_fire_dispatches", "resident_drains",
         "dropped_late", "dropped_capacity", "restarts",
         "checkpoints_aborted", "checkpoints_declined", "watchdog_trips",
@@ -1224,16 +1231,33 @@ class LocalExecutor:
         coord = env.config.get_str("dcn.coordinator")
         nproc = env.config.get_int("dcn.num-processes", 1)
         pid = env.config.get_int("dcn.process-id", 0)
-        if env.config.get_str("pipeline.resident-loop", "auto") == "on":
-            # LOUD fallback, not an error: the lockstep plane's global
-            # collectives require every process to dispatch the same
-            # step sequence, which a locally-count-gated ring drain
-            # cannot guarantee — multi-host keeps single-step dispatch
+        res_dcn = env.config.get_str("pipeline.resident-loop", "auto")
+        if res_dcn == "on":
+            # a config ERROR, not a silent degrade (round 13, mirroring
+            # the steps-per-dispatch loud single-step fallback): the
+            # lockstep plane's global collectives require every process
+            # to dispatch the same step sequence, which a locally-
+            # count-gated ring drain cannot guarantee
+            raise ValueError(
+                "pipeline.resident-loop=on is incompatible with the DCN "
+                "lockstep plane (dcn.coordinator set): every process "
+                "must dispatch the same step sequence, which a locally "
+                "count-gated ring drain cannot guarantee; unset it or "
+                "use pipeline.resident-loop=auto (resolves to off here)"
+            )
+        if res_dcn == "auto":
             print(
-                "flink-tpu: pipeline.resident-loop=on is ignored on the "
-                "DCN lockstep plane; multi-host execution keeps the "
-                "single-step dispatch fallback",
+                "flink-tpu: pipeline.resident-loop auto resolves to OFF "
+                "on the DCN lockstep plane; multi-host execution keeps "
+                "the single-step dispatch fallback",
                 file=sys.stderr,
+            )
+        if env.config.get_str("pipeline.data-parallel", "auto") == "on":
+            raise ValueError(
+                "pipeline.data-parallel=on is incompatible with the DCN "
+                "lockstep plane: the sharded ring drain rides the "
+                "resident loop, which the lockstep plane cannot run; "
+                "unset it or use pipeline.data-parallel=auto"
             )
         wagg = pipe.window_agg
         if wagg is None or pipe.key_by is None:
@@ -1554,6 +1578,28 @@ class LocalExecutor:
         residents_by_route = {}    # [route][tier] resident-drain kernels
         pending_batch = [None]     # greedy ring fill's non-drain leftover
         drain_warmup = [False]     # warmup drains skip the chaos seam
+        # -- mesh-resident data parallelism (pipeline.data-parallel,
+        # round 13): each chip owns a contiguous key-group slice, the
+        # prefetch thread routes records to the owning shard and
+        # publishes into that shard's slice of a ShardedDeviceBatchRing,
+        # and one shard_map'd drain advances every shard's ring with
+        # zero cross-chip collectives in the keyed body (fires pack
+        # per-shard and merge host-side on the lagged consume path).
+        # Validated here; `use_dp` is FINALIZED with use_resident.
+        dp_cfg = str(env.config.get(_CoreOpts.PIPELINE_DATA_PARALLEL))
+        if dp_cfg not in ("auto", "on", "off"):
+            raise ValueError(
+                f"pipeline.data-parallel must be auto|on|off, "
+                f"got {dp_cfg!r}"
+            )
+        dp_capf = env.config.get_float("pipeline.shard-capacity-factor", 2.0)
+        if dp_capf < 1.0:
+            raise ValueError(
+                f"pipeline.shard-capacity-factor must be >= 1.0, "
+                f"got {dp_capf}"
+            )
+        use_dp = False             # finalized at ingest construction
+        shard_cap = [0]            # per-shard ring-slice rows (dp only)
         # -- update-kernel pre-combine (pipeline.update-precombine):
         # duplicate-key collapse before the state scatter (wk.update);
         # generic reduces already pre-aggregate, sketches expand per
@@ -1888,6 +1934,39 @@ class LocalExecutor:
                                 reduced=rd_reduced,
                             ) if build_fast else None,
                         }
+                    if use_dp:
+                        # shard_map'd drain (pipeline.data-parallel):
+                        # records arrive PRE-ROUTED to the owning
+                        # shard's ring slice, so the drained body runs
+                        # shard-local with ZERO collectives (the
+                        # ownership mask is a safety net, not a
+                        # router) and each shard gates on its OWN
+                        # count — one slow shard never pads the
+                        # others' drains.
+                        shard_cap[0] = bucket_capacity(
+                            B_step[0], ctx.n_shards, dp_capf
+                        )
+                        residents_by_route["sharded"] = {
+                            "insert": build_window_sharded_drain(
+                                ctx, spec, ring_depth,
+                                kg_fill=kg_stats_on, reduced=rd_reduced,
+                            ),
+                            "fast": build_window_sharded_drain(
+                                ctx, spec, ring_depth, insert=False,
+                                kg_fill=kg_stats_on, reduced=rd_reduced,
+                            ) if build_fast else None,
+                        }
+                        if self._job_group is not None:
+                            # per-shard refusal gauges live here (not
+                            # the main gauges block) so they track the
+                            # mesh size across elastic re-plans;
+                            # registry.register overwrites, so the
+                            # repeat registration is idempotent.
+                            for _s in range(ctx.n_shards):
+                                self._job_group.gauge(
+                                    f"ring_publish_refusals_shard_{_s}",
+                                    partial(_ring_refusals, _s),
+                                )
                 fire_step = build_window_fire_step(ctx, spec)
                 if sink_device_reduce:
                     # a second compiled fire variant with NO key/value
@@ -1912,7 +1991,10 @@ class LocalExecutor:
                 B=B, B_step=B_step[0], n_shards=ctx.n_shards,
                 max_parallelism=ctx.max_parallelism, kg_ends=_kg_ends,
                 exchange_cap=exchange_cap[0],
-                routes=tuple(steps_by_route), staging=use_staging,
+                routes=tuple(steps_by_route) + (
+                    ("sharded",) if use_dp else ()
+                ),
+                staging=use_staging,
                 mask_sharding=mask_sh, split_sharding=split_sh,
                 value_shape=(
                     () if red.kind == "sketch" else tuple(red.value_shape)
@@ -1921,6 +2003,7 @@ class LocalExecutor:
                     np.uint32 if red.kind == "sketch" else np.float32
                 ),
                 ring_depth=ring_depth if use_resident else 0,
+                shard_cap=shard_cap[0] if use_dp else 0,
             ))
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
@@ -1935,6 +2018,7 @@ class LocalExecutor:
                 fused0 = metrics.fused_dispatches
                 ff0 = metrics.fused_fire_dispatches
                 rd0 = metrics.resident_drains
+                ss0 = metrics.steps_sharded
                 for route in steps_by_route:
                     for tier in ("insert", "fast"):
                         if steps_by_route[route][tier] is None:
@@ -1992,6 +2076,7 @@ class LocalExecutor:
                 metrics.fused_dispatches = fused0
                 metrics.fused_fire_dispatches = ff0
                 metrics.resident_drains = rd0
+                metrics.steps_sharded = ss0
                 # warmup fired-megastep payloads: sentinel watermarks
                 # fire nothing, and warmup must not leave handles behind
                 fire_watch.clear()
@@ -2648,6 +2733,7 @@ class LocalExecutor:
             kg_occ_step_fn[0] = None
             kg_occ_cache[0] = None
             exchange_cap[0] = 0
+            shard_cap[0] = 0    # re-sliced by setup() at the new n_shards
             force_route[0] = None
             # in-flight monitoring handles reference the OLD mesh (a
             # dead device on real hardware): drop without blocking
@@ -2918,8 +3004,7 @@ class LocalExecutor:
             kg = int(assign_to_key_group(
                 route_hash(hi, lo, np), ctx.max_parallelism, np
             )[0])
-            starts, ends = ctx.kg_bounds()
-            shard = int(np.searchsorted(np.asarray(ends), kg))
+            shard = int(ctx.shard_of_key_groups(np.asarray([kg]))[0])
             tkeys = np.asarray(state.table.keys[shard])
             match = np.nonzero(
                 (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])
@@ -3114,6 +3199,28 @@ class LocalExecutor:
             # JobMetrics.GAUGE_FIELDS)
             grp.gauge("ring_depth",
                       lambda: ring_depth if use_resident else 0)
+            # publish-refusal backpressure (round 13): total refusals
+            # across shards, plus a per-shard labelled series once the
+            # ring is sharded — a stalled shard shows up here instead
+            # of being inferred from throughput dips. `ingest` binds
+            # later in this scope; the lambda resolves at scrape time.
+
+            def _ring_refusals(shard=None):
+                try:
+                    dr = ingest.device_ring
+                except NameError:
+                    return 0   # scraped before the pipeline is built
+                if dr is None:
+                    return 0
+                r = dr.refusals()
+                if shard is None:
+                    return int(sum(r))
+                return int(r[shard]) if shard < len(r) else 0
+
+            grp.gauge("ring_publish_refusals", _ring_refusals)
+            # the per-shard labelled series registers from setup():
+            # use_dp is only finalized after the resident-loop config
+            # resolves, well past this point in the linear body.
 
             def _occ_stat(fn, default=0):
                 occ = kg_occ_cache[0]
@@ -3310,13 +3417,18 @@ class LocalExecutor:
 
         def _empty_fused_item(route):
             """One zero batch in megastep-operand form (compile warmup)."""
-            Bs = B_step[0]
+            if route == "sharded":
+                # sharded drains consume [n_shards, cap] ring slices
+                # (leading axis split across the mesh)
+                shape = (ctx.n_shards, shard_cap[0])
+            else:
+                shape = (B_step[0],)
             vals = (
-                np.zeros(Bs, np.uint32) if red.kind == "sketch"
-                else np.zeros((Bs,) + tuple(red.value_shape), np.float32)
+                np.zeros(shape, np.uint32) if red.kind == "sketch"
+                else np.zeros(shape + tuple(red.value_shape), np.float32)
             )
-            args = (np.zeros(Bs, np.uint32), np.zeros(Bs, np.uint32),
-                    np.zeros(Bs, np.int32), vals, np.zeros(Bs, bool))
+            args = (np.zeros(shape, np.uint32), np.zeros(shape, np.uint32),
+                    np.zeros(shape, np.int32), vals, np.zeros(shape, bool))
             args, _ = _stage_planned(args, route)
             return (args, None, None)
 
@@ -3439,6 +3551,11 @@ class LocalExecutor:
             if not drain_warmup[0]:
                 faults.inject("step.drain", step=metrics.steps,
                               route=route, slots=count)
+                # the drain IS the steady-state dispatch: a dying chip
+                # surfaces here, so the device_loss fault class
+                # (step.dispatch) must be able to target resident jobs
+                faults.inject("step.dispatch", step=metrics.steps,
+                              route=route, slots=count)
             flat = []
             # lint: allow(retrace): tiny [n_shards, D] watermark matrix, fresh per drain dispatch for the same reason as run_update's wmv (queued async dispatches must not share the buffer)
             wmv = np.empty((ctx.n_shards, ring_depth), np.int32)
@@ -3458,16 +3575,36 @@ class LocalExecutor:
             wd_prev = None
             if wd is not None:
                 # deadline scales with the work actually handed to the
-                # device: per-slot seconds x slots consumed
+                # device: per-slot seconds x slots consumed. A sharded
+                # drain retires every shard's slots concurrently — free
+                # on real chips, but on the CPU backend the virtual
+                # shards contend for the same host cores, so the
+                # legitimate wall time grows ~n_shards x and the arm
+                # must too (a deep 8-shard drain would otherwise trip a
+                # deadline tuned for one chip's slots)
+                wd_scale = count
+                if (getattr(active, "sharded_drain", False)
+                        and jax.default_backend() == "cpu"):
+                    wd_scale = count * ctx.n_shards
                 wd_prev = wd.arm("device-drain",
-                                 detail=f"slots={count}", scale=count)
+                                 detail=f"slots={count}", scale=wd_scale)
             try:
                 # resident drains always fire in-scan: queue the payload
                 # handles for LAGGED consumption (consume_fires); the
                 # post-scan ovf_n handle rides along as in
                 # run_update_fused
+                # sharded drain kernels gate per shard: a uniform count
+                # vector here (every publish fills one slot per shard,
+                # possibly with an empty valid mask), but the kernel
+                # contract keeps the vector so a future skew-aware ring
+                # can under-fill individual shards without recompiling
+                cnt = (
+                    np.full(ctx.n_shards, count, np.int32)
+                    if getattr(active, "sharded_drain", False)
+                    else np.int32(count)
+                )
                 state, (ovf_handle, act_handle, kgf_handle), fires = \
-                    active(state, *flat, wmv, np.int32(count))
+                    active(state, *flat, wmv, cnt)
                 fire_watch.append((fires, ovf_handle, time.perf_counter()))
                 inflight.append(act_handle)
                 if len(inflight) > max_inflight:
@@ -3488,6 +3625,8 @@ class LocalExecutor:
                 metrics.steps_fast += count
             if route == "exchange":
                 metrics.steps_exchanged += count
+            elif route == "sharded":
+                metrics.steps_sharded += count
             if fuse_gauge[0] is not None:
                 fuse_gauge[0].set(count)
             if win.overflow or kg_stats_on:
@@ -3555,12 +3694,32 @@ class LocalExecutor:
                 # slots so the prefetch thread can recycle them (the
                 # async runtime keeps the buffers alive until the
                 # queued drain has consumed them)
-                seqs = [
-                    it[2].ring_seq for it in items
-                    if it[2] is not None and it[2].ring_seq is not None
-                ]
-                if seqs and ingest.device_ring is not None:
-                    ingest.device_ring.release_through(max(seqs))
+                dr = ingest.device_ring
+                if dr is not None and dr.sharded:
+                    # per-shard applied cut: each shard retires through
+                    # ITS highest released sequence (a refused lane's
+                    # None simply leaves that shard's cursor alone), so
+                    # one slow shard never pins the others' slots
+                    nsh = len(dr.refusals())
+                    cut = [None] * nsh
+                    for it in items:
+                        pb = it[2]
+                        if pb is None or pb.ring_seqs is None:
+                            continue
+                        for s, sq in enumerate(pb.ring_seqs):
+                            if sq is not None and (
+                                cut[s] is None or sq > cut[s]
+                            ):
+                                cut[s] = sq
+                    if any(sq is not None for sq in cut):
+                        dr.release_shards(cut)
+                else:
+                    seqs = [
+                        it[2].ring_seq for it in items
+                        if it[2] is not None and it[2].ring_seq is not None
+                    ]
+                    if seqs and dr is not None:
+                        dr.release_through(max(seqs))
             if fused.hold_fires:
                 fired_in_scan = resident_ok or (full and getattr(
                     megasteps_by_route.get(route, {}).get("insert"),
@@ -4305,6 +4464,23 @@ class LocalExecutor:
             # in-scan per slot)
             fused = ingest_mod.FusedBatchAccumulator(
                 ring_depth, hold_fires=True
+            )
+        # -- finalize data parallelism (validated where dp_cfg was
+        # read): the sharded drain is a shard_map'd variant of the
+        # resident drain, so it needs the ring substrate AND a mesh
+        # with more than one shard to be worth the extra compiles
+        if dp_cfg == "on":
+            if not use_resident:
+                raise ValueError(
+                    "pipeline.data-parallel=on requires the resident "
+                    "loop (pipeline.resident-loop + prefetch + device "
+                    "staging): the sharded drain consumes per-shard "
+                    "ring slices published by the ingest thread"
+                )
+            use_dp = True
+        else:
+            use_dp = (
+                dp_cfg == "auto" and use_resident and ctx.n_shards > 1
             )
         ingest = ingest_mod.IngestPipeline(
             prep_batch, prefetch=use_prefetch,
@@ -5610,8 +5786,7 @@ class LocalExecutor:
             kg = int(assign_to_key_group(
                 route_hash(hi, lo, np), ctx.max_parallelism, np
             )[0])
-            starts, ends = ctx.kg_bounds()
-            shard = int(np.searchsorted(np.asarray(ends), kg))
+            shard = int(ctx.shard_of_key_groups(np.asarray([kg]))[0])
             tkeys = np.asarray(st.table.keys[shard])
             match = np.nonzero(
                 (tkeys[:, 0] == hi[0]) & (tkeys[:, 1] == lo[0])

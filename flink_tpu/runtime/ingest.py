@@ -117,7 +117,8 @@ class PreppedBatch:
     ticks_min: Optional[int] = None
     ticks_max: Optional[int] = None
     ts_max: Optional[int] = None
-    route: Optional[str] = None  # "mask" | "exchange" | None (unplanned)
+    # "mask" | "exchange" | "sharded" | None (unplanned)
+    route: Optional[str] = None
     # device-staged (hi, lo, ticks, values, valid) committed arrays
     staged: Optional[Tuple] = None
     # device batch ring slot sequence (pipeline.resident-loop): set when
@@ -125,6 +126,12 @@ class PreppedBatch:
     # the slot once the batch's ring drain retired it. None = staged
     # outside the ring (ring full, or resident loop off).
     ring_seq: Optional[int] = None
+    # per-shard slot sequences (pipeline.data-parallel): one entry per
+    # shard when ``staged`` lives in a ShardedDeviceBatchRing — a None
+    # entry means THAT shard's lane ring was full and its slice was
+    # staged fresh (the shard-local backpressure seam); the consumer
+    # releases per shard at the drain boundary (release_shards)
+    ring_seqs: Optional[list] = None
 
 
 @dataclasses.dataclass
@@ -156,13 +163,20 @@ class IngestPlan:
     # > 0 promotes the staging ring to a DeviceBatchRing of this many
     # committed HBM slots; 0 keeps the plain PR 3 staging ring
     ring_depth: int = 0
+    # per-shard lane capacity of the data-parallel route (pipeline.
+    # data-parallel): > 0 (with "sharded" in ``routes``) promotes the
+    # device ring to a ShardedDeviceBatchRing — each batch is host-
+    # partitioned by owning key-group slice and published as [n_shards,
+    # shard_cap] per-chip lane slices; 0 keeps the global-slot ring
+    shard_cap: int = 0
 
     @staticmethod
     def shardings_for(mesh):
         return NamedSharding(mesh, P()), NamedSharding(mesh, P(SHARD_AXIS))
 
 
-def plan_route(plan: IngestPlan, hi: np.ndarray, lo: np.ndarray) -> str:
+def plan_route(plan: IngestPlan, hi: np.ndarray, lo: np.ndarray,
+               kg: Optional[np.ndarray] = None) -> str:
     """Exact per-batch feasibility of the ICI exchange, at prep time.
 
     Computes every lane's owning shard (the same murmur key-group math
@@ -171,14 +185,17 @@ def plan_route(plan: IngestPlan, hi: np.ndarray, lo: np.ndarray) -> str:
     static capacity — skew falls back to replicate-and-mask, so the
     adaptive route is never lossy. Runs on the UNPADDED arrays: padding
     lanes are invalid on device and lane i's source device is i//bpd
-    either way, so the counts match the padded check exactly."""
+    either way, so the counts match the padded check exactly. ``kg``
+    lets a caller that already computed the key groups (the sharded-
+    route planner) skip the second murmur pass."""
     if "exchange" not in plan.routes:
         return "mask"
     if "mask" not in plan.routes:
         return "exchange"        # exchange.mode=all_to_all forced
     n = plan.n_shards
-    kg = assign_to_key_group(route_hash(hi, lo, np), plan.max_parallelism,
-                             np)
+    if kg is None:
+        kg = assign_to_key_group(route_hash(hi, lo, np),
+                                 plan.max_parallelism, np)
     shard = np.searchsorted(plan.kg_ends, kg)
     bpd = plan.B_step // n
     src = np.arange(len(hi)) // bpd
@@ -189,9 +206,35 @@ def plan_route(plan: IngestPlan, hi: np.ndarray, lo: np.ndarray) -> str:
     )
 
 
+def plan_route_and_shards(
+    plan: IngestPlan, hi: np.ndarray, lo: np.ndarray
+) -> Tuple[str, Optional[np.ndarray]]:
+    """Data-parallel route plan (pipeline.data-parallel): ONE key-group
+    pass decides the route AND hands back every lane's owning shard.
+
+    The sharded route is feasible when each shard's slice of the batch
+    fits its static per-shard lane capacity (``plan.shard_cap``) — the
+    host then partitions the batch and each chip receives only its own
+    O(cap) lanes. A batch too skewed to fit falls back to the ordinary
+    ``plan_route`` choice (reusing the computed key groups), so the
+    adaptive ladder is sharded -> exchange -> mask and never lossy."""
+    kg = assign_to_key_group(route_hash(hi, lo, np), plan.max_parallelism,
+                             np)
+    if "sharded" in plan.routes and plan.shard_cap > 0:
+        shard = np.searchsorted(plan.kg_ends, kg)
+        counts = np.bincount(shard, minlength=plan.n_shards)
+        if counts.max(initial=0) <= plan.shard_cap:
+            return "sharded", shard
+    return plan_route(plan, hi, lo, kg=kg), None
+
+
 def _route_sharding(plan: IngestPlan, route: str):
+    # sharded batches are [n_shards, cap] arrays split on the leading
+    # (shard) axis — the same P(SHARD_AXIS) sharding the exchange route
+    # uses on its batch axis
     return (
-        plan.split_sharding if route == "exchange" else plan.mask_sharding
+        plan.split_sharding if route in ("exchange", "sharded")
+        else plan.mask_sharding
     )
 
 
@@ -371,12 +414,15 @@ class DeviceBatchRing:
     the epoch bump already invalidates the queued PreppedBatches that
     reference them, and the rewound source replays those records."""
 
+    sharded = False    # ShardedDeviceBatchRing overrides
+
     def __init__(self, plan: IngestPlan, depth: int):
         self.depth = max(2, int(depth))
         self._staging = StagingRing(plan, self.depth)
         self._slots: list = [None] * self.depth
         self._write = 0          # seq of the next slot to publish
         self._read = 0           # seq of the oldest unreleased slot
+        self._refusals = 0       # full-ring publish refusals (backpressure)
         self._lock = threading.Lock()
 
     # -- producer (prefetch thread) --------------------------------------
@@ -389,6 +435,10 @@ class DeviceBatchRing:
         published slot's arrays are always dispatch-ready."""
         with self._lock:
             if self._write - self._read >= self.depth:
+                # counted, not silent: the ring_publish_refusals gauge
+                # makes a stalled drain observable as backpressure
+                # instead of an unexplained throughput dip
+                self._refusals += 1
                 return None
             seq = self._write
         staged = self._staging.stage(plan, hi, lo, ticks, values, n,
@@ -428,6 +478,200 @@ class DeviceBatchRing:
             self._slots = [None] * self.depth
             self._read = self._write
             return n
+
+    def refusals(self) -> list:
+        """Per-shard full-ring publish refusal counts (one entry here —
+        the global-slot ring has a single lane); the executor surfaces
+        the sum and the per-shard breakdown as gauges."""
+        with self._lock:
+            return [self._refusals]
+
+
+class ShardedDeviceBatchRing:
+    """Per-shard device batch ring (pipeline.data-parallel, ISSUE 13):
+    the DeviceBatchRing split into ``n_shards`` independent lanes. The
+    prefetch thread partitions each planned batch by owning key-group
+    slice (one stable-sort pass — stable so a key's records keep their
+    arrival order and float accumulation is bit-exact vs the single-chip
+    oracle), pads each shard's slice into that shard's ring slot, and
+    device_puts the (1, cap) row DIRECTLY onto the owning chip. The
+    per-slot global [n_shards, cap] arrays are then assembled ZERO-COPY
+    from the committed rows (jax.make_array_from_single_device_arrays)
+    under the split sharding the sharded drain kernel expects — no chip
+    ever receives another chip's lanes, on the wire or in HBM.
+
+    Per-shard write/read cursors are the "one slow shard never blocks
+    the others" seam: a full lane refuses ONLY its own shard's row
+    (counted in that shard's refusal counter; the row is staged fresh,
+    unringed, and its ``ring_seqs`` entry is None), while every other
+    shard's row still publishes into its recycled slot. The consumer
+    releases per shard at ring-drain boundaries (``release_shards``
+    with the drained per-shard sequence vector).
+
+    Threading contract is the DeviceBatchRing's: one producer (prefetch
+    thread) publishes, one consumer (step loop) releases; cursors are
+    plain ints under one lock."""
+
+    sharded = True
+
+    def __init__(self, plan: IngestPlan, depth: int):
+        self.depth = max(2, int(depth))
+        self.n_shards = plan.n_shards
+        self.cap = int(plan.shard_cap)
+        vshape = (self.cap,) + tuple(plan.value_shape)
+        mesh = plan.split_sharding.mesh
+        self._devices = list(mesh.devices.flat)
+        self._split = plan.split_sharding
+        self._vdtype = plan.value_dtype
+
+        def one_slot():
+            return {
+                "hi": np.zeros(self.cap, np.uint32),
+                "lo": np.zeros(self.cap, np.uint32),
+                "ticks": np.zeros(self.cap, np.int32),
+                "values": np.zeros(vshape, plan.value_dtype),
+            }
+
+        self._make_slot = one_slot
+        # per-shard slot buffer pools + cursors; a slot pins its rows'
+        # lifetime (the committed global array holds the same buffers)
+        self._bufs = [
+            [one_slot() for _ in range(self.depth)]
+            for _ in range(self.n_shards)
+        ]
+        self._slots = [[None] * self.depth for _ in range(self.n_shards)]
+        self._write = [0] * self.n_shards
+        self._read = [0] * self.n_shards
+        self._refusals = [0] * self.n_shards
+        self._lock = threading.Lock()
+        self._mask_tmpl = make_prefix_mask_template(self.cap)
+        self._reuse = not _host_put_aliases_cached(
+            [b for pool in self._bufs for slot in pool
+             for b in slot.values()],
+            plan.mask_sharding,
+        )
+
+    @staticmethod
+    def _fill(buf: np.ndarray, arr: np.ndarray, c: int) -> np.ndarray:
+        buf[:c] = arr
+        buf[c:] = 0
+        return buf
+
+    # -- producer (prefetch thread) --------------------------------------
+    def publish_batch(self, plan: IngestPlan, hi, lo, ticks, values,
+                      shard: np.ndarray, n: int, epoch: int,
+                      tracer=None) -> Tuple[list, Tuple]:
+        """Partition one planned batch by owning shard and publish each
+        slice into that shard's ring lane. Returns ``(ring_seqs,
+        staged)``: per-shard slot sequences (None where that lane was
+        full and the row went out fresh) and the committed global
+        [n_shards, cap] 5-tuple the sharded drain consumes. Never
+        refuses the whole batch — the global-array contract needs every
+        shard's row either way, so a full lane costs one fresh
+        allocation, not a stall."""
+        t0 = time.perf_counter()
+        order = np.argsort(shard[:n], kind="stable")
+        counts = np.bincount(shard[:n], minlength=self.n_shards)
+        srcs = (hi[order], lo[order], ticks[order], values[order])
+        seqs: list = [None] * self.n_shards
+        rows = ([], [], [], [], [])
+        pos = 0
+        for s in range(self.n_shards):
+            c = int(counts[s])
+            with self._lock:
+                if self._write[s] - self._read[s] < self.depth:
+                    seqs[s] = self._write[s]
+                else:
+                    self._refusals[s] += 1
+            if self._reuse and seqs[s] is not None:
+                bufs = self._bufs[s][seqs[s] % self.depth]
+            else:
+                # zero-copy backend or full lane: single-use buffers
+                bufs = self._make_slot()
+            filled = (
+                self._fill(bufs["hi"], srcs[0][pos:pos + c], c),
+                self._fill(bufs["lo"], srcs[1][pos:pos + c], c),
+                self._fill(bufs["ticks"], srcs[2][pos:pos + c], c),
+                self._fill(bufs["values"], srcs[3][pos:pos + c], c),
+                prefix_mask(self._mask_tmpl, c),
+            )
+            pos += c
+            dev = self._devices[s]
+            for j, x in enumerate(filled):
+                # (1, cap) row committed onto the OWNING chip only
+                rows[j].append(jax.device_put(x[None], dev))
+        t_pad = time.perf_counter()
+        staged = tuple(
+            jax.make_array_from_single_device_arrays(
+                (self.n_shards,) + r[0].shape[1:], self._split, r,
+            )
+            for r in rows
+        )
+        # transfer completion ON THE INGEST THREAD (StagingRing.stage
+        # contract): a published slot's rows are dispatch-ready
+        jax.block_until_ready(staged)  # host-sync-ok: ingest-thread transfer completion, off the step loop
+        with self._lock:
+            for s in range(self.n_shards):
+                if seqs[s] is not None:
+                    self._slots[s][seqs[s] % self.depth] = (
+                        seqs[s], epoch, tuple(r[s] for r in rows),
+                    )
+                    self._write[s] = seqs[s] + 1
+        if tracer is not None and tracer.active:
+            tracer.rec("stage", t0, t_pad, n=n)
+            tracer.rec("transfer", t_pad, route="sharded")
+        return seqs, staged
+
+    # -- consumer (step loop) --------------------------------------------
+    def occupancy(self) -> int:
+        """Deepest lane's committed-but-unreleased slot count."""
+        with self._lock:
+            return max(
+                self._write[s] - self._read[s]
+                for s in range(self.n_shards)
+            )
+
+    def release_shards(self, seqs) -> int:
+        """Retire each shard's slots up to and including ``seqs[s]`` (a
+        drain returned for them — the per-shard exactly-once boundary).
+        None entries (that shard published nothing ringed in the
+        drained group) and out-of-window seqs are no-ops. Returns total
+        slots released."""
+        total = 0
+        with self._lock:
+            for s, seq in enumerate(seqs):
+                if seq is None or seq < self._read[s]:
+                    continue
+                upto = min(int(seq), self._write[s] - 1)
+                for q in range(self._read[s], upto + 1):
+                    self._slots[s][q % self.depth] = None
+                total += upto - self._read[s] + 1
+                self._read[s] = upto + 1
+        return total
+
+    def release_through(self, seq: int) -> int:
+        """Uniform release — every shard through ``seq`` (fallback call
+        sites that only track a scalar frontier)."""
+        return self.release_shards([seq] * self.n_shards)
+
+    def clear(self) -> int:
+        """Restore path: discard every lane's in-flight slots (epoch
+        bump invalidated the batches referencing them)."""
+        with self._lock:
+            n = sum(
+                self._write[s] - self._read[s]
+                for s in range(self.n_shards)
+            )
+            self._slots = [
+                [None] * self.depth for _ in range(self.n_shards)
+            ]
+            self._read = list(self._write)
+            return n
+
+    def refusals(self) -> list:
+        """Per-shard full-lane publish refusal counts."""
+        with self._lock:
+            return list(self._refusals)
 
 
 # ------------------------------------------------------- fused dispatch
@@ -560,10 +804,18 @@ class IngestPipeline:
         ring; the plain staging ring stays as the ring-full fallback."""
         if plan.staging:
             self._ring = StagingRing(plan, self._ring_depth)
-            self._device_ring = (
-                DeviceBatchRing(plan, plan.ring_depth)
-                if plan.ring_depth > 0 else None
-            )
+            if plan.ring_depth > 0:
+                # data-parallel mode: per-shard lane rings (re-sliced on
+                # every set_plan — the elastic re-plan installs a plan
+                # at the new n_shards and gets fresh lanes for free)
+                ring_cls = (
+                    ShardedDeviceBatchRing
+                    if plan.shard_cap > 0 and "sharded" in plan.routes
+                    else DeviceBatchRing
+                )
+                self._device_ring = ring_cls(plan, plan.ring_depth)
+            else:
+                self._device_ring = None
         else:
             self._ring = None
             self._device_ring = None
@@ -598,20 +850,35 @@ class IngestPipeline:
             return pb
         pb.ticks_min, pb.ticks_max = t_min, t_max
         t_r0 = time.perf_counter()
-        pb.route = plan_route(plan, pb.hi, pb.lo)
+        dr = self._device_ring
+        shard_of = None
+        if dr is not None and dr.sharded:
+            # ONE key-group pass plans the route and the partition
+            pb.route, shard_of = plan_route_and_shards(plan, pb.hi, pb.lo)
+        else:
+            pb.route = plan_route(plan, pb.hi, pb.lo)
         tracer = self.tracer
         if tracer is not None and tracer.active:
             tracer.rec("route", t_r0, route=pb.route, planned=True)
         if self._ring is not None:
             pub = None
-            if self._device_ring is not None:
-                pub = self._device_ring.try_publish(
+            if shard_of is not None:
+                # data-parallel publish: per-shard slices into per-shard
+                # lanes (never refuses the batch — a full lane only
+                # costs its own shard a fresh row)
+                pb.ring_seqs, pb.staged = dr.publish_batch(
+                    plan, pb.hi, pb.lo, ticks, values, shard_of, pb.n,
+                    pb.epoch, tracer=tracer,
+                )
+                pub = (None, pb.staged)
+            elif dr is not None and not dr.sharded:
+                pub = dr.try_publish(
                     plan, pb.hi, pb.lo, ticks, values, pb.n, pb.route,
                     pb.epoch, tracer=tracer,
                 )
-            if pub is not None:
-                pb.ring_seq, pb.staged = pub
-            else:
+                if pub is not None:
+                    pb.ring_seq, pb.staged = pub
+            if pub is None:
                 # device ring full (or resident loop off): plain staging
                 # — the batch still flows in order through the queue,
                 # and the drain dispatcher applies it as an unringed

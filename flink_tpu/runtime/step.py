@@ -971,6 +971,124 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
     return drain
 
 
+def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
+                               depth: int, insert: bool = True,
+                               kg_fill: bool = False,
+                               reduced: bool = False):
+    """Data-parallel resident drain (pipeline.data-parallel, ISSUE 13):
+    the ring-drain scan lowered shard-LOCALLY — the ingest side already
+    partitioned each batch by owning key-group slice and published the
+    per-shard lane slices into the owning shard's ring slot, so the
+    keyed body here is mask_update_shard over lanes that are ALL local
+    by construction. Zero cross-chip collectives on the hot path: no
+    all_to_all (records arrived pre-routed), no replicated full-batch
+    broadcast (each chip touches only its own cap lanes, O(cap) work
+    per chip instead of the mask route's O(B)).
+
+    The per-shard independence is what buys the third delta: ``counts``
+    is an int32 [n_shards] VECTOR under P(SHARD_AXIS), so each shard
+    gates its scan on its OWN fill level. The exchange drain must keep
+    ``count`` replicated (its all_to_all would deadlock if shards took
+    different branches); with no collective in this body, divergent
+    counts are safe — one slow shard's shallow ring never forces the
+    others to under-drain.
+
+    Signature: ``drain(state, hi_0, lo_0, ticks_0, values_0, valid_0,
+    ..., wmv, counts)`` — ``depth`` staged 5-tuples of [n_shards, cap]
+    arrays split over devices on the LEADING axis (slots past a shard's
+    count repeat stale lanes; the skip branch never reads them), wmv
+    int32 [n_shards, depth], counts int32 [n_shards]. Returns the same
+    ``(state', (ovf_n, activity, kg_fill), fires)`` contract as
+    build_window_resident_drain, fires stacked [n_shards, depth] — the
+    executor's lagged consume_fires merges the per-shard packs host-
+    side unchanged."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(depth)
+
+    def shard_body(state, kg_start, kg_end, counts, hi, lo, ts, values,
+                   valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        count = counts[0]          # this shard's OWN fill level
+        pend0 = jnp.zeros(spec.win.ring, bool)
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(op):
+                st, pend = op
+                st, act, kgf = mask_update_shard(
+                    st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
+                    s_vals, s_valid, s_wm, maxp, insert=insert,
+                    kg_fill=kg_fill, clear_rows=pend,
+                )
+                st, pend, cf = wk.advance_and_fire_resident(
+                    st, spec.win, spec.red, s_wm, reduced=reduced
+                )
+                return (st, pend), (act, kgf, cf)
+
+            def skip(op):
+                kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+                return op, (jnp.zeros((), jnp.int32), kgf,
+                            _zero_slot_fires(spec, reduced))
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+            sub, (state, pend0),
+            # [D, 1, cap] per-shard batch stacks squeeze the split axis
+            (jnp.arange(D, dtype=jnp.int32), hi[:, 0], lo[:, 0],
+             ts[:, 0], values[:, 0], valid[:, 0], wm[0]),
+        )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS),             # counts: per-shard fill levels
+            # [D, n_shards, cap] stacks SPLIT on the shard axis: each
+            # chip receives only its own pre-routed lane slices
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(state, *flat):
+        *batches, wmv, counts = flat
+        stacks = _fused_batch_stack(D, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            state, starts, ends, jnp.asarray(counts, jnp.int32),
+            *stacks, wmv,
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.sharded_drain = True
+    drain.fused_fire = True
+    drain.fused_fire_reduced = reduced
+    return drain
+
+
 def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
     """Fire-only half: advance the watermark, evaluate due window ends for
     the whole key population, and return device-compacted fires
@@ -1350,7 +1468,7 @@ class KernelFamily:
     #                      (resident_drain reuses ``k_steps`` for its
     #                      ring depth — the scan length axis is the same
     #                      ledger currency either way)
-    route: str = "mask"      # mask | exchange
+    route: str = "mask"      # mask | exchange | sharded
     layout: str = "hash"     # hash | direct
     donated: bool = True
     insert: bool = True
@@ -1418,6 +1536,20 @@ def kernel_family_grid():
         F("step.resident_drain.exchange.hash.d4",
           build_window_resident_drain_exchange,
           "resident_drain", route="exchange", k_steps=AUDIT_RING_DEPTH),
+        # the data-parallel shard-local drain (ISSUE 13): per-shard
+        # pre-routed lane slices, per-shard count gating, ZERO
+        # collectives in the keyed body (the no-host-crossing rule and
+        # the op-budget ledger pin that — an all_to_all sneaking in
+        # here would break divergent-count safety)
+        F("step.sharded_drain.hash.d4", build_window_sharded_drain,
+          "sharded_drain", route="sharded", k_steps=AUDIT_RING_DEPTH,
+          deep=True),
+        F("step.sharded_drain.direct.d4", build_window_sharded_drain,
+          "sharded_drain", route="sharded", layout="direct",
+          k_steps=AUDIT_RING_DEPTH),
+        F("step.sharded_drain.hash.d4.packed", build_window_sharded_drain,
+          "sharded_drain", route="sharded", packed=True,
+          k_steps=AUDIT_RING_DEPTH),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -1489,6 +1621,15 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
         wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
         count = jnp.asarray(fam.k_steps - 1, jnp.int32)
         return (state,) + per * fam.k_steps + (wmv, count)
+    if fam.kind == "sharded_drain":
+        # per-shard [n_shards, cap] lane slices (cap = the audit batch)
+        # and a per-shard count VECTOR at depth - 1 — both cond
+        # branches live, per-shard gating in the traced signature
+        n = ctx.n_shards
+        per2 = tuple(jnp.broadcast_to(a, (n,) + a.shape) for a in per)
+        wmv = jnp.zeros((n, fam.k_steps), jnp.int32)
+        counts = jnp.full((n,), fam.k_steps - 1, jnp.int32)
+        return (state,) + per2 * fam.k_steps + (wmv, counts)
     if fam.kind in ("fire", "fire_reduced"):
         return (state, watermark_vector(ctx, 0))
     if fam.kind == "session":
@@ -1510,16 +1651,16 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     spec = audit_stage_spec(fam)
     kw = {}
     if fam.kind in ("update", "megastep", "megastep_fired",
-                    "resident_drain"):
+                    "resident_drain", "sharded_drain"):
         kw["insert"] = fam.insert
         kw["kg_fill"] = True
     if fam.route == "exchange":
         kw["batch_per_device"] = batch
     if fam.kind in ("megastep", "megastep_fired"):
         kw["k_steps"] = fam.k_steps
-    if fam.kind in ("megastep_fired", "resident_drain"):
+    if fam.kind in ("megastep_fired", "resident_drain", "sharded_drain"):
         kw["reduced"] = fam.reduced
-    if fam.kind == "resident_drain":
+    if fam.kind in ("resident_drain", "sharded_drain"):
         kw["depth"] = fam.k_steps
     fn = fam.builder(ctx, spec, **kw)
     init = {

@@ -122,7 +122,11 @@ class Watchdog:
         work-proportional — the resident drain arms ``device-drain``
         with scale = slots consumed, so one per-slot deadline covers
         every drain size without a deep drain tripping a shallow
-        deadline."""
+        deadline. The sharded drain (pipeline.data-parallel) keeps that
+        contract per shard: shards retire their slots concurrently, so
+        the caller scales by slots alone on accelerator meshes and
+        folds in n_shards only where the "chips" share host cores (the
+        virtual CPU mesh), where concurrency is a fiction."""
         tid = threading.get_ident()
         prev = self._armed.get(tid)
         dl = self.deadlines.get(phase)

@@ -1,0 +1,615 @@
+"""Mesh-resident data parallelism (ISSUE 13, pipeline.data-parallel):
+per-chip key-group slices feeding shard-local device rings and a
+shard_map'd drain loop.
+
+* kernel-level property test: per-shard routed batches reconstruct the
+  single-chip oracle BIT-EXACTLY (logical state snapshot AND in-scan
+  fire payloads) across {hash, direct} x {packed planes on/off} x
+  n_shards in {1, 2, 4}, with zero overflow pinned on both sides (the
+  per-shard tables spread load, so an overflowing oracle would diverge
+  for capacity reasons, not routing bugs),
+* per-shard count gating: shards drain INDEPENDENT fill levels in one
+  dispatch (zero collectives in the keyed body is what makes divergent
+  counts safe),
+* the executor end to end: exact windows with ``pipeline.data-parallel
+  =on`` on a 4-shard mesh, steps actually retired through the sharded
+  drain, and the config ladder (dp without the resident loop is a
+  config error; skewed batches fall back without loss),
+* exactly-once across a mid-drain crash (``step.drain`` seam) on a
+  4-shard mesh with the per-shard applied cut, and across a PR 8
+  elastic lose-one -> degraded -> scale-back cycle with sharded rings,
+* the PR 12 loose end: ``pipeline.resident-loop=on`` under the DCN
+  lockstep plane is an explicit config error; ``auto`` resolves to off
+  with a startup log line,
+* ``ring_publish_refusals`` backpressure observability in the
+  Prometheus exposition (total + per-shard series), and
+  ShardedDeviceBatchRing unit behavior (per-shard cursors, refusal
+  accounting, independent release).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.ops import window_kernels as wk
+from flink_tpu.ops.hashing import hash64_host, route_hash
+from flink_tpu.parallel.mesh import MeshContext
+from flink_tpu.runtime import checkpoint as ckpt
+from flink_tpu.runtime import ingest as ingest_mod
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.runtime.step import (
+    WindowStageSpec,
+    build_window_resident_drain,
+    build_window_sharded_drain,
+    init_sharded_state,
+)
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule, \
+    device_loss_rule
+
+MAXP = 8
+D = 3          # ring depth of the kernel-level drains
+B = 48         # records per slot
+N_KEYS = 200
+WINDOW = 10_000
+
+
+# ------------------------------------------------ kernel-level property
+
+def _split(keys):
+    h = hash64_host(np.asarray(keys, dtype=np.int64))
+    return ((h >> np.uint64(32)).astype(np.uint32),
+            (h & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _spec(layout, packed):
+    return WindowStageSpec(
+        win=wk.WindowSpec(10, 10, ring=8, fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=256, probe_len=8, layout=layout,
+        packed=packed,
+    )
+
+
+def _batches(rng, layout):
+    """D slot batches; slot i's timestamps sit in pane i and its
+    watermark crosses pane boundaries so fires happen IN-SCAN (the
+    last slot's watermark flushes everything that remains)."""
+    out = []
+    wms = [5, 15, 10**6]
+    for i in range(D):
+        if layout == "direct":
+            hi = np.zeros(B, np.uint32)
+            lo = rng.integers(0, 64, B).astype(np.uint32)
+        else:
+            hi, lo = _split(rng.integers(0, 32, B).astype(np.int64))
+        ts = rng.integers(10 * i, 10 * i + 10, B).astype(np.int32)
+        vals = rng.integers(1, 9, B).astype(np.float32)
+        out.append((hi, lo, ts, vals, np.ones(B, bool),
+                    np.int32(wms[i])))
+    return out
+
+
+def _partition(ctx, batch, cap):
+    """Route one slot batch to owning shards: the SAME searchsorted-
+    over-inclusive-ends math the ingest planner uses."""
+    hi, lo, ts, vals, valid, wm = batch
+    kg = assign_to_key_group(route_hash(hi, lo, np), MAXP, np)
+    shard = np.searchsorted(np.asarray(ctx.kg_bounds()[1]), kg)
+    n = ctx.n_shards
+    p_hi = np.zeros((n, cap), np.uint32)
+    p_lo = np.zeros((n, cap), np.uint32)
+    p_ts = np.zeros((n, cap), np.int32)
+    p_vl = np.zeros((n, cap), np.float32)
+    p_ok = np.zeros((n, cap), bool)
+    for s in range(n):
+        m = shard == s
+        c = int(m.sum())
+        assert c <= cap, "test geometry must never skew past cap"
+        p_hi[s, :c] = hi[m]
+        p_lo[s, :c] = lo[m]
+        p_ts[s, :c] = ts[m]
+        p_vl[s, :c] = vals[m]
+        p_ok[s, :c] = True
+    return p_hi, p_lo, p_ts, p_vl, p_ok
+
+
+def _decode_fires(fires):
+    """Stacked [n_shards, D, ...] CompactFires -> {(end, key64): value},
+    asserting each (window, key) fired exactly once."""
+    counts = np.asarray(fires.counts)
+    lanes = np.asarray(fires.lane_valid)
+    ends = np.asarray(fires.window_end_ticks)
+    khi = np.asarray(fires.key_hi)
+    klo = np.asarray(fires.key_lo)
+    vals = np.asarray(fires.values)
+    out = {}
+    for sh in range(counts.shape[0]):
+        for d in range(counts.shape[1]):
+            for f in np.nonzero(lanes[sh, d])[0]:
+                for j in range(int(counts[sh, d, f])):
+                    kid = (int(khi[sh, d, f, j]) << 32) | int(
+                        klo[sh, d, f, j]
+                    )
+                    key = (int(ends[sh, d, f]), kid)
+                    assert key not in out, f"duplicate fire {key}"
+                    out[key] = float(vals[sh, d, f, j])
+    return out
+
+
+def _canon(entries):
+    comp = (
+        entries["key_hi"].astype(np.uint64) << np.uint64(32)
+    ) | entries["key_lo"]
+    order = np.lexsort((entries["pane"], comp))
+    return {k: np.asarray(v)[order] for k, v in entries.items()}
+
+
+def _entries_equal(a, b):
+    a, b = _canon(a), _canon(b)
+    return a.keys() == b.keys() and all(
+        np.array_equal(a[k], b[k]) for k in a
+    )
+
+
+# compiled-kernel memo: the per-shard-counts test reuses the property
+# test's (hash, unpacked) builds — count/counts are TRACED operands, so
+# one compile per (layout, packed, n_shards) serves every fill level
+_KERNELS = {}
+
+
+def _oracle_drain(spec, key):
+    k = ("oracle",) + key
+    if k not in _KERNELS:
+        ctx1 = MeshContext.create(1, MAXP, devices=jax.devices()[:1])
+        _KERNELS[k] = (ctx1, build_window_resident_drain(ctx1, spec, D))
+    return _KERNELS[k]
+
+
+def _sharded_drain(spec, key, n):
+    k = ("sharded", n) + key
+    if k not in _KERNELS:
+        ctx = MeshContext.create(n, MAXP, devices=jax.devices()[:n])
+        _KERNELS[k] = (ctx, build_window_sharded_drain(ctx, spec, D))
+    return _KERNELS[k]
+
+
+@pytest.mark.parametrize("layout", ["hash", "direct"])
+@pytest.mark.parametrize("packed", [False, True])
+def test_sharded_drain_bitexact_vs_single_chip_oracle(
+    rng, layout, packed
+):
+    """THE round-13 property: per-shard routed batches reconstruct the
+    single-chip oracle bit-exactly — in-scan fire payloads AND the
+    logical state snapshot — at n_shards 1, 2 and 4, with overflow
+    pinned to zero on both sides (per-shard tables spread hash load, so
+    an overflowing oracle diverges for capacity reasons; the pin keeps
+    the property self-checking)."""
+    spec = _spec(layout, packed)
+    batches = _batches(rng, layout)
+    key = (layout, packed)
+
+    ctx1, oracle = _oracle_drain(spec, key)
+    s1 = init_sharded_state(ctx1, spec)
+    flat1 = [a for b in batches for a in b[:5]]
+    wmv1 = np.stack([np.full(1, b[5], np.int32) for b in batches], 1)
+    s1, (ovf1, _, _), fires1 = oracle(s1, *flat1, wmv1, np.int32(D))
+    assert np.asarray(ovf1).sum() == 0, "oracle overflowed: re-dim test"
+    want_fires = _decode_fires(
+        jax.tree_util.tree_map(lambda x: np.asarray(x), fires1)
+    )
+    assert want_fires, "test must actually fire in-scan"
+    want_entries, want_scalars = ckpt.snapshot_window_state(
+        s1, spec.win, red=spec.red
+    )
+
+    for n in (1, 2, 4):
+        ctx, drain = _sharded_drain(spec, key, n)
+        cap = B                       # worst case: every record one shard
+        sn = init_sharded_state(ctx, spec)
+        flat = [a for b in batches for a in _partition(ctx, b, cap)]
+        wmv = np.stack(
+            [np.full(n, b[5], np.int32) for b in batches], 1
+        )
+        counts = np.full(n, D, np.int32)
+        sn, (ovfn, _, _), firesn = drain(sn, *flat, wmv, counts)
+        assert np.asarray(ovfn).sum() == 0, f"n={n} overflowed"
+        got_fires = _decode_fires(
+            jax.tree_util.tree_map(lambda x: np.asarray(x), firesn)
+        )
+        assert got_fires == want_fires, f"fires diverged at n={n}"
+        got_entries, got_scalars = ckpt.snapshot_window_state(
+            sn, spec.win, red=spec.red
+        )
+        assert _entries_equal(got_entries, want_entries), (
+            f"logical state diverged at n={n}"
+        )
+        assert got_scalars == want_scalars
+
+
+def test_sharded_drain_per_shard_counts_gate_independently(rng):
+    """Divergent per-shard fill levels drain in ONE dispatch: shard s
+    consumes exactly its own ``counts[s]`` slots. Oracle: the single-
+    chip drain fed only the records whose owning shard's cursor covers
+    their slot. (Zero collectives in the keyed body is the invariant
+    that makes divergent counts deadlock-free; the lint grid pins it.)"""
+    spec = _spec("hash", False)
+    batches = _batches(rng, "hash")
+    # no fires: count-gating is a pure state property here
+    batches = [b[:5] + (np.int32(-(2**31) + 1),) for b in batches]
+    n = 4
+    ctx, drain = _sharded_drain(spec, ("hash", False), n)
+    counts = np.array([3, 1, 2, 0], np.int32)
+    cap = B
+    sn = init_sharded_state(ctx, spec)
+    flat = [a for b in batches for a in _partition(ctx, b, cap)]
+    wmv = np.stack([np.full(n, b[5], np.int32) for b in batches], 1)
+    sn, (ovfn, _, _), _ = drain(sn, *flat, wmv, counts)
+    assert np.asarray(ovfn).sum() == 0
+
+    # oracle keeps record (slot d, lane) iff counts[owning shard] > d
+    kg_ends = np.asarray(ctx.kg_bounds()[1])
+    ctx1, oracle = _oracle_drain(spec, ("hash", False))
+    s1 = init_sharded_state(ctx1, spec)
+    flat1 = []
+    for d, (hi, lo, ts, vals, valid, _) in enumerate(batches):
+        kg = assign_to_key_group(route_hash(hi, lo, np), MAXP, np)
+        shard = np.searchsorted(kg_ends, kg)
+        keep = counts[shard] > d
+        flat1.extend((hi, lo, ts, vals, valid & keep))
+    wmv1 = np.stack([np.full(1, b[5], np.int32) for b in batches], 1)
+    s1, (ovf1, _, _), _ = oracle(s1, *flat1, wmv1, np.int32(D))
+    assert np.asarray(ovf1).sum() == 0
+    e_got, _ = ckpt.snapshot_window_state(sn, spec.win, red=spec.red)
+    e_want, _ = ckpt.snapshot_window_state(s1, spec.win, red=spec.red)
+    assert _entries_equal(e_got, e_want)
+    assert len(e_got["key_hi"]) > 0    # the gated drain did real work
+
+
+# ------------------------------------------------------ executor e2e
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 50) * 1000
+
+
+def expected(total):
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 50) * 1000
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW + 1) * WINDOW
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None,
+              **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = 256
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("dp-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+DP_CFG = {
+    "pipeline.prefetch": "on",
+    "pipeline.device-staging": "on",
+    "pipeline.resident-loop": "on",
+    "pipeline.ring-depth": 4,
+    "pipeline.data-parallel": "on",
+}
+
+
+def test_dp_job_exact_and_sharded_drains_dispatched():
+    """Exact windows on a 4-shard mesh with dp on, and the steady state
+    really ran shard-locally: steps retired through the sharded drain,
+    strictly fewer drain dispatches than steps."""
+    total = 4096
+    env = build_env(4, **DP_CFG)
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    assert m.steps_sharded > 0
+    assert m.resident_drains > 0
+    assert m.resident_drains < m.steps
+
+
+def test_dp_on_requires_resident_loop():
+    """dp=on without the resident-loop substrate is a config error,
+    never a silent downgrade."""
+    env = build_env(4, **{"pipeline.data-parallel": "on"})
+    with pytest.raises(ValueError, match="data-parallel"):
+        run_job(env, 512)
+
+
+def test_skewed_batch_falls_back_without_loss():
+    """A batch whose per-shard slice overflows ``shard_cap`` takes the
+    replicated route for that batch only — the adaptive ladder is never
+    lossy. Planner unit: all-one-key skew refuses the sharded route."""
+    ctx = MeshContext.create(4, MAXP, devices=jax.devices()[:4])
+    mask_sh, split_sh = ingest_mod.IngestPlan.shardings_for(ctx.mesh)
+    plan = ingest_mod.IngestPlan(
+        td=None, slide_ticks=1000, span_limit=8, B=64, B_step=64,
+        n_shards=4, max_parallelism=MAXP,
+        kg_ends=np.asarray(ctx.kg_bounds()[1]), exchange_cap=0,
+        routes=("mask", "sharded"), staging=True,
+        mask_sharding=mask_sh, split_sharding=split_sh,
+        ring_depth=4, shard_cap=32,
+    )
+    rng = np.random.default_rng(7)
+    hi = rng.integers(0, 2**32, 64, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, 64, dtype=np.uint32)
+    route, shard = ingest_mod.plan_route_and_shards(plan, hi, lo)
+    assert route == "sharded" and shard is not None
+    # the planner's shard assignment matches the mesh ownership ranges
+    kg = assign_to_key_group(route_hash(hi, lo, np), MAXP, np)
+    starts, ends = (np.asarray(a) for a in ctx.kg_bounds())
+    assert ((kg >= starts[shard]) & (kg <= ends[shard])).all()
+    skew_hi = np.zeros(64, np.uint32)
+    skew_lo = np.zeros(64, np.uint32)
+    route, shard = ingest_mod.plan_route_and_shards(plan, skew_hi,
+                                                    skew_lo)
+    assert route == "mask" and shard is None
+
+
+# ------------------------------------- exactly-once: crash + elastic
+
+def test_dp_mid_drain_crash_restore_exactly_once(tmp_path):
+    """THE round-13 exactly-once criterion: crash at a sharded drain
+    dispatch (``step.drain`` seam, staged slots in per-shard rings, the
+    drain not yet retired) on a 4-shard mesh; restore replays the
+    un-retired group from the per-shard applied cut — nothing skipped,
+    nothing double-counted."""
+    total = 4096
+    env = build_env(
+        4, tmp_path / "chk", interval=2, restart=3,
+        **{**DP_CFG, "checkpoint.mode": "incremental",
+           "checkpoint.async": True},
+    )
+    inj = FaultInjector([
+        FaultRule("step.drain",
+                  exc=RuntimeError("injected mid-drain crash"), at=1),
+    ])
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert inj.fired_at("step.drain"), "drain seam never fired"
+    assert m.restarts == 1
+    assert m.steps_sharded > 0
+    assert got == expected(total)
+
+
+def test_dp_elastic_lose_one_then_scale_back(tmp_path):
+    """PR 8 elastic cycle with sharded rings in play: lose 1 of 4
+    shards (device loss) -> degraded 3-shard re-plan re-slices the
+    key-group ranges AND the per-shard rings and drops the sharded
+    drain caches -> operator scale-up back to 4 — exactly-once across
+    the whole cycle."""
+    env = build_env(4, tmp_path / "chk", interval=2, **{
+        **DP_CFG,
+        "checkpoint.mode": "incremental",
+        "checkpoint.async": True,
+        "checkpoint.local.enabled": True,
+        "restart-strategy": "exponential-backoff",
+        "restart-strategy.exponential-backoff.initial-delay": 0.01,
+        "restart-strategy.exponential-backoff.max-delay": 0.05,
+    })
+    total = 8192
+
+    def scale_up_when_degraded():
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            ctl = getattr(env, "_elastic_controller", None)
+            if ctl is not None and ctl.degraded:
+                time.sleep(0.3)
+                ctl.request_scale_up()
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=scale_up_when_degraded, daemon=True)
+    t.start()
+    inj = FaultInjector([device_loss_rule(shard=1, at=8)])
+    with faults.active(inj):
+        got = run_job(env, total)
+    t.join(timeout=5)
+    assert got == expected(total)
+    assert env.last_job.metrics.steps_sharded > 0
+    el = env._elasticity_report()
+    kinds = [r["kind"] for r in el["rescales"]]
+    assert kinds == ["degrade", "scale_up"]
+    assert el["degraded"] is False and el["current-shards"] == 4
+
+
+# --------------------------------------------- DCN lockstep loose end
+
+def test_dcn_resident_loop_on_is_config_error():
+    """``pipeline.resident-loop=on`` under the DCN lockstep plane is an
+    EXPLICIT config error (round-13 satellite) — the lockstep plane's
+    global collectives cannot tolerate locally-count-gated drains, and
+    silently degrading hid that in round 12."""
+    env = build_env(1, **{
+        "dcn.coordinator": "127.0.0.1:1",   # never dialed: raises first
+        "pipeline.resident-loop": "on",
+    })
+    with pytest.raises(ValueError, match="resident-loop.*lockstep"):
+        run_job(env, 256)
+
+
+def test_dcn_data_parallel_on_is_config_error():
+    env = build_env(1, **{
+        "dcn.coordinator": "127.0.0.1:1",
+        "pipeline.data-parallel": "on",
+    })
+    with pytest.raises(ValueError, match="data-parallel.*lockstep"):
+        run_job(env, 256)
+
+
+def test_dcn_resident_loop_auto_resolves_off_with_log(capsys):
+    """``auto`` resolves to off on the lockstep plane, loudly: a
+    startup stderr line says so before anything executes. (The probe
+    pipeline is stateless, so the plane raises NotImplementedError
+    right after the resolution — the log must already be out.)"""
+    env = build_env(1, **{
+        "dcn.coordinator": "127.0.0.1:1",
+        "pipeline.resident-loop": "auto",
+    })
+    sink = CollectSink()
+    env.add_source(GeneratorSource(gen, total=256)).add_sink(sink)
+    with pytest.raises(NotImplementedError):
+        env.execute("dcn-auto-probe")
+    err = capsys.readouterr().err
+    assert "resident-loop auto resolves to OFF" in err
+
+
+# ------------------------------------------- refusal observability
+
+def test_ring_publish_refusals_in_prometheus_exposition(tmp_path):
+    """Backpressure from a stalled shard is OBSERVABLE: the total
+    ``ring_publish_refusals`` gauge and the per-shard series ride the
+    Prometheus text exposition for a dp job."""
+    import urllib.request
+
+    from flink_tpu.runtime.cluster import MiniCluster
+    from flink_tpu.runtime.web import WebMonitor
+
+    env = build_env(4, **DP_CFG)
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=2048))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    try:
+        jid = cluster.submit(env, "dp-web-job")
+        assert cluster.wait(jid, 240) == "FINISHED"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert 'flink_tpu_ring_publish_refusals{job="dp-web-job"}' \
+            in text
+        for s in range(4):
+            assert (
+                f'flink_tpu_ring_publish_refusals_shard_{s}'
+                f'{{job="dp-web-job"}}'
+            ) in text
+        assert 'flink_tpu_steps_sharded{job="dp-web-job"}' in text
+    finally:
+        web.stop()
+
+
+# --------------------------------------- ShardedDeviceBatchRing units
+
+def _dp_plan(n=4, B_=32, cap=16, depth=4):
+    ctx = MeshContext.create(n, MAXP, devices=jax.devices()[:n])
+    mask_sh, split_sh = ingest_mod.IngestPlan.shardings_for(ctx.mesh)
+    return ctx, ingest_mod.IngestPlan(
+        td=None, slide_ticks=1000, span_limit=8, B=B_, B_step=B_,
+        n_shards=n, max_parallelism=MAXP,
+        kg_ends=np.asarray(ctx.kg_bounds()[1]), exchange_cap=0,
+        routes=("mask", "sharded"), staging=True,
+        mask_sharding=mask_sh, split_sharding=split_sh,
+        ring_depth=depth, shard_cap=cap,
+    )
+
+
+def test_sharded_ring_per_shard_cursors_and_release():
+    """Per-shard write cursors and per-shard release: one shard's
+    retirement never frees (or blocks) another's lanes, refusals count
+    PER SHARD, and a refused lane still publishes (fresh buffer) so the
+    global staged array always carries every shard's row."""
+    ctx, plan = _dp_plan()
+    ring = ingest_mod.ShardedDeviceBatchRing(plan, 2)
+    rng = np.random.default_rng(3)
+    hi = rng.integers(0, 2**32, 32, dtype=np.uint32)
+    lo = rng.integers(0, 2**32, 32, dtype=np.uint32)
+    kg = assign_to_key_group(route_hash(hi, lo, np), MAXP, np)
+    shard = np.searchsorted(np.asarray(ctx.kg_bounds()[1]), kg)
+    ticks = np.zeros(32, np.int32)
+    vals = np.ones(32, np.float32)
+
+    seqs0, staged = ring.publish_batch(plan, hi, lo, ticks, vals,
+                                       shard, 32, 0)
+    assert seqs0 == [0, 0, 0, 0]
+    assert all(a.shape == (4, 16) for a in staged)
+    # staged rows reconstruct the partition exactly
+    shi = np.asarray(staged[0])
+    sok = np.asarray(staged[4])
+    for s in range(4):
+        assert sorted(hi[shard == s].tolist()) == \
+            sorted(shi[s][sok[s]].tolist())
+    seqs1, _ = ring.publish_batch(plan, hi, lo, ticks, vals, shard,
+                                  32, 0)
+    assert seqs1 == [1, 1, 1, 1] and ring.occupancy() == 2
+    # full ring: every shard refuses its lane but the publish still
+    # returns a complete staged array (fresh buffers, seq None)
+    seqs2, staged2 = ring.publish_batch(plan, hi, lo, ticks, vals,
+                                        shard, 32, 0)
+    assert seqs2 == [None] * 4
+    assert all(a.shape == (4, 16) for a in staged2)
+    assert ring.refusals() == [1, 1, 1, 1]
+    # release shard 2 only: ITS lane frees, others stay occupied
+    assert ring.release_shards([None, None, 0, None]) == 1
+    seqs3, _ = ring.publish_batch(plan, hi, lo, ticks, vals, shard,
+                                  32, 0)
+    assert seqs3 == [None, None, 2, None]
+    assert ring.refusals() == [2, 2, 1, 2]
+    assert ring.clear() > 0 and ring.occupancy() == 0
+
+
+def test_sharded_ring_epoch_and_clear_discard():
+    """A restore-path ``clear()`` empties every shard's lane ring so
+    the replay epoch starts from empty cursors."""
+    ctx, plan = _dp_plan(n=2)
+    ring = ingest_mod.ShardedDeviceBatchRing(plan, 3)
+    hi = np.arange(8, dtype=np.uint32)
+    shard = np.array([0, 0, 0, 0, 1, 1, 1, 1])
+    for _ in range(2):
+        ring.publish_batch(plan, hi, hi, np.zeros(8, np.int32),
+                           np.ones(8, np.float32), shard, 8, 0)
+    assert ring.occupancy() == 2
+    assert ring.clear() == 4          # 2 slots x 2 shards
+    assert ring.occupancy() == 0
+    seqs, _ = ring.publish_batch(plan, hi, hi, np.zeros(8, np.int32),
+                                 np.ones(8, np.float32), shard, 8, 1)
+    assert seqs == [2, 2]             # cursors continue monotonically

@@ -36,16 +36,21 @@ def test_checker_scans_the_real_hot_paths():
 
 
 def test_ingest_staging_path_has_no_unmarked_sync():
-    """The staging ring's transfer-completion wait is the ONLY allowed
-    block in runtime/ingest.py, and it must carry the inline marker —
-    stripping the marker must make the checker flag it."""
+    """The two transfer-completion waits — the staging ring's and the
+    sharded batch ring's publish commit, both on the INGEST thread —
+    are the ONLY allowed blocks in runtime/ingest.py, and each must
+    carry the inline marker: stripping the markers must make the
+    checker flag exactly those two."""
     path = os.path.join(ROOT, "flink_tpu", "runtime", "ingest.py")
     with open(path) as f:
         src = f.read()
     assert check_source(src, "flink_tpu/runtime/ingest.py") == []
     stripped = src.replace("# host-sync-ok:", "# stripped:")
     vs = check_source(stripped, "flink_tpu/runtime/ingest.py")
-    assert len(vs) == 1 and vs[0].what == ".block_until_ready()"
+    assert {(v.func, v.what) for v in vs} == {
+        ("StagingRing.stage", ".block_until_ready()"),
+        ("ShardedDeviceBatchRing.publish_batch", ".block_until_ready()"),
+    }
 
 
 def test_checker_flags_sync_constructs():
