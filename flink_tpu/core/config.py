@@ -428,6 +428,20 @@ class CoreOptions:
         "observability.kg-stats-interval-ms", 1000,
         "min interval between per-key-group occupancy kernel runs "
         "(refreshed at fire boundaries)")
+    DRAIN_STATS = ConfigOption(
+        "observability.drain-stats", None,
+        "enable the drain-interior flight recorder (per-slot x per-shard "
+        "counters stacked inside the resident/sharded ring-drain scan, "
+        "unpacked lagged into occupancy/duty-cycle/latency telemetry); "
+        "defaults to whatever observability.tracing is — off means the "
+        "drain kernels compile without any telemetry work (ledger-"
+        "verified byte-identical)")
+    DRAIN_STATS_EVERY = ConfigOption(
+        "observability.drain-stats-every", 8,
+        "fetch the drain-stats payload to the host every N-th drain "
+        "dispatch only (the device computes it every drain when the "
+        "recorder is compiled in; duty-cycle/occupancy EWMAs update on "
+        "every drain regardless). 1 = every drain")
     COMPILE_COST = ConfigOption(
         "observability.compile-cost", False,
         "record XLA cost_analysis (FLOPs/bytes) of the update step at "

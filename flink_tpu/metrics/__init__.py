@@ -12,10 +12,12 @@ from flink_tpu.metrics.core import (
     Reporter,
     ScheduledReporter,
 )
+from flink_tpu.metrics.drain_stats import DRAIN_STAT_FIELDS, DrainTelemetry
 from flink_tpu.metrics.tracing import CompileEvents, SpanTracer
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Meter", "MetricGroup",
     "MetricRegistry", "Reporter", "JsonFileReporter", "LoggingReporter",
     "ScheduledReporter", "SpanTracer", "CompileEvents",
+    "DrainTelemetry", "DRAIN_STAT_FIELDS",
 ]

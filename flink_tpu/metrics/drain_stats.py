@@ -1,0 +1,296 @@
+"""Drain-interior flight recorder, host side (ISSUE 14).
+
+Rounds 12-13 made steady state a count-gated ring drain: one dispatch
+retires up to ``ring-depth`` staged batches per shard, so the span
+tracer's ``drain`` span and the cycle attribution both see D x n_shards
+slots of real work as a single opaque interval. The device half of this
+round (runtime/step.py ``DRAIN_STAT_FIELDS`` payload, statically gated
+by ``observability.drain-stats``) stacks per-slot x per-shard counters
+inside the drain scan; this module is the host half that turns the
+lagged payload plus the rings' publish-time stamps into:
+
+  * per-shard ring occupancy / backpressure time series (fill sampled
+    at publish and at drain, joined with the publish-refusal counters);
+  * a drain duty-cycle estimator — device-busy vs ring-starved EWMA per
+    shard, feeding the resident-aware ``CycleAttribution`` regimes;
+  * event-time-to-fire and publish-seq-to-consume latency flowing into
+    ``LatencySamples`` weighted percentiles;
+  * Perfetto counter tracks (``SpanTracer.rec_counter``) so the series
+    render as stacked lanes above the phase spans.
+
+Threading: the executor's step loop calls the ``ingest_publish`` /
+``on_drain`` / ``note_fires`` mutators; web and reporter threads read
+``report()`` and the gauge accessors. One lock guards the tiny mutable
+core (deque appends and EWMA floats — nanosecond critical sections).
+
+This module is on the hot-path-sync lint list (tools/lint/rules/
+hot_path_sync.py): everything here must stay pure host arithmetic over
+ALREADY-FETCHED numpy payloads — the lagged consume path stays sync-
+free, and any ``jax.device_get``/``np.asarray`` creeping in fails lint.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from flink_tpu.metrics.latency import LatencySamples
+
+# Per-slot counter layout emitted by the drain scan body — the single
+# source of truth; runtime/step.py imports it so the kernel packer and
+# this unpacker cannot drift.
+DRAIN_STAT_FIELDS = (
+    "events",          # records retired from the slot (valid lanes)
+    "activity",        # table placements (insert) / probe misses (fast)
+    "fire_lanes",      # fire lanes packed for the slot's pane crossings
+    "fired_keys",      # sum of per-lane fired key counts
+    "late_dropped",    # lanes dropped late (allowed-lateness breach)
+    "nofit_dropped",   # lanes dropped for capacity (no fit after probe)
+    "ovf_fill",        # overflow-ring fill after the slot retired
+    "kg_fill_max",     # max per-key-group fill (skew summary)
+    "panes_advanced",  # panes the slot's watermark advance crossed
+)
+
+# monotonically accumulating fields vs instantaneous levels: totals are
+# summed for the former, the latest fetch's max-over-slots is reported
+# for the latter (summing a fill level across slots is meaningless)
+COUNTER_FIELDS = ("events", "activity", "fire_lanes", "fired_keys",
+                  "late_dropped", "nofit_dropped", "panes_advanced")
+LEVEL_FIELDS = ("ovf_fill", "kg_fill_max")
+
+
+class DrainTelemetry:
+    """Aggregates the drain flight-recorder payload into per-shard
+    series, duty-cycle EWMAs, and latency percentiles."""
+
+    def __init__(self, n_shards: int, ring_depth: int,
+                 alpha: float = 0.1, max_series: int = 512,
+                 tracer=None):
+        self.n_shards = max(1, int(n_shards))
+        self.ring_depth = max(1, int(ring_depth))
+        self.alpha = float(alpha)
+        self.tracer = tracer
+        self.t0 = time.perf_counter()
+        n = self.n_shards
+        nf = len(DRAIN_STAT_FIELDS)
+        self._totals = np.zeros((n, nf), np.int64)
+        self._last = np.zeros((n, nf), np.int64)
+        self._duty = [0.0] * n          # device-busy EWMA (count/depth)
+        self._starved = [0.0] * n       # empty-ring drain EWMA
+        self._fill = [0] * n            # last observed ring fill
+        self._drains = 0                # drain dispatches seen
+        self._fetches = 0               # payload fetches unpacked
+        # per-shard occupancy series: (t_rel_s, fill, source)
+        self._occ: List[deque] = [
+            deque(maxlen=max(16, int(max_series))) for _ in range(n)
+        ]
+        # per-shard outstanding publishes awaiting release: (seq, t)
+        self._pending: List[deque] = [
+            deque(maxlen=4096) for _ in range(n)
+        ]
+        # event-tick -> publish-wall lookup for fire latency; ticks and
+        # times both ascend so bisect over a parallel pair of lists
+        self._tick: List[int] = []
+        self._tick_t: List[float] = []
+        self._fire_lat = LatencySamples()
+        self._consume_lat = LatencySamples()
+        self._lock = threading.Lock()
+
+    # -- mutators (step loop) --------------------------------------------
+
+    def ingest_publish(self, samples: Sequence[Tuple]):
+        """Absorb publish-time stamps drained from a batch ring:
+        ``(shard, seq_or_None, fill_after, max_tick_or_None, t_wall)``
+        tuples appended inside the ring's locked commit section."""
+        with self._lock:
+            for shard, seq, fill, max_tick, t in samples:
+                s = int(shard)
+                if not 0 <= s < self.n_shards:
+                    continue
+                self._fill[s] = int(fill)
+                self._occ[s].append((t - self.t0, int(fill), "publish"))
+                if seq is not None:
+                    self._pending[s].append((int(seq), t))
+                if max_tick is not None and (
+                        not self._tick or int(max_tick) > self._tick[-1]):
+                    self._tick.append(int(max_tick))
+                    self._tick_t.append(t)
+                    if len(self._tick) > 8192:
+                        del self._tick[:4096]
+                        del self._tick_t[:4096]
+
+    def on_drain(self, counts: Sequence[int],
+                 fills: Sequence[int],
+                 released: Sequence[Optional[int]],
+                 t_wall: Optional[float] = None):
+        """One drain dispatch retired: ``counts[s]`` slots drained from
+        shard ``s``'s ring, ``fills[s]`` the lane fill after release,
+        ``released[s]`` the released-through seq (None: nothing ringed).
+        Updates the duty/starved EWMAs, occupancy series and publish-to-
+        consume latency — called every drain regardless of the payload
+        fetch cadence (``absorb_payload`` handles the sampled half)."""
+        if t_wall is None:
+            t_wall = time.perf_counter()
+        a = self.alpha
+        with self._lock:
+            self._drains += 1
+            tracks = []
+            for s in range(self.n_shards):
+                cnt = int(counts[s]) if s < len(counts) else 0
+                fill = int(fills[s]) if s < len(fills) else 0
+                duty = min(1.0, cnt / self.ring_depth)
+                # a shallow drain that leaves the lane EMPTY means the
+                # publish side cannot keep the ring fed (ring-starved);
+                # full-depth drains are the device-saturated signature
+                starved = (
+                    1.0 if (fill == 0 and cnt < self.ring_depth) else 0.0
+                )
+                self._duty[s] += a * (duty - self._duty[s])
+                self._starved[s] += a * (starved - self._starved[s])
+                self._fill[s] = fill
+                self._occ[s].append((t_wall - self.t0, fill, "drain"))
+                rel = released[s] if s < len(released) else None
+                if rel is not None:
+                    q = self._pending[s]
+                    while q and q[0][0] <= int(rel):
+                        _seq, t_pub = q.popleft()
+                        self._consume_lat.record(
+                            1, (t_wall - t_pub) * 1e3
+                        )
+                tracks.append((f"drain/shard{s}", {
+                    "fill": fill,
+                    "duty_pct": round(self._duty[s] * 100.0, 1),
+                }))
+            tr = self.tracer
+        if tr is not None and tr.active:
+            for track, values in tracks:
+                tr.rec_counter(track, t_wall, **values)
+
+    def absorb_payload(self, ds: np.ndarray,
+                       t_wall: Optional[float] = None):
+        """Fold one fetched ``[n_shards, D, len(FIELDS)]`` flight-
+        recorder payload (already host-resident — the lagged consume
+        path fetched it batched with the fire payload) into the totals
+        and level views, and emit per-shard counter-track samples."""
+        if t_wall is None:
+            t_wall = time.perf_counter()
+        per_shard = ds.sum(axis=1, dtype=np.int64)
+        last = ds.max(axis=1).astype(np.int64)
+        if per_shard.shape[0] != self.n_shards:
+            # global-ring resident mode on a multi-shard mesh: the
+            # payload still carries one row per mesh shard, but the
+            # ring (and so this aggregator) has a single lane — fold
+            per_shard = per_shard.sum(axis=0, keepdims=True)
+            last = last.max(axis=0, keepdims=True)
+        with self._lock:
+            self._fetches += 1
+            self._totals += per_shard
+            self._last = last
+            tr = self.tracer
+        if tr is not None and tr.active:
+            for s in range(per_shard.shape[0]):
+                tr.rec_counter(
+                    f"drain_retired/shard{s}", t_wall,
+                    events=int(per_shard[s][0]),
+                    fire_lanes=int(per_shard[s][2]),
+                )
+
+    def note_fires(self, pairs: Sequence[Tuple[int, int]],
+                   t_wall: Optional[float] = None):
+        """Record event-time-to-fire latency for an emission:
+        ``(window_end_tick, n_windows)`` pairs. The latency of a window
+        is measured from the first publish whose max event tick crossed
+        its end (the moment the fire became due on the device) to now —
+        pure wall time, no tick-to-ms conversion needed."""
+        if t_wall is None:
+            t_wall = time.perf_counter()
+        with self._lock:
+            for wend, n in pairs:
+                i = bisect_left(self._tick, int(wend))
+                if i < len(self._tick_t) and n > 0:
+                    self._fire_lat.record(
+                        int(n), (t_wall - self._tick_t[i]) * 1e3
+                    )
+
+    # -- readers (web / reporter threads) --------------------------------
+
+    def duty_cycle(self, s: int) -> float:
+        with self._lock:
+            return self._duty[s] if 0 <= s < self.n_shards else 0.0
+
+    def slot_fill(self, s: int) -> int:
+        with self._lock:
+            return self._fill[s] if 0 <= s < self.n_shards else 0
+
+    def fire_latency_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            return self._fire_lat.percentile(q)
+
+    def consume_latency_ms(self, q: float) -> Optional[float]:
+        with self._lock:
+            return self._consume_lat.percentile(q)
+
+    def regime(self) -> Tuple[float, float]:
+        """(mean duty-cycle, mean ring-starved fraction) across shards —
+        the resident-loop signal ``CycleAttribution`` classifies on."""
+        with self._lock:
+            n = self.n_shards
+            return (sum(self._duty) / n, sum(self._starved) / n)
+
+    def report(self, refusals: Optional[Sequence[int]] = None,
+               occupancy_points: int = 64) -> Dict[str, Any]:
+        """The /jobs/<jid>/pipeline payload body."""
+        with self._lock:
+            shards = []
+            for s in range(self.n_shards):
+                occ = list(self._occ[s])[-occupancy_points:]
+                row: Dict[str, Any] = {
+                    "shard": s,
+                    "duty_cycle": round(self._duty[s], 4),
+                    "ring_starved": round(self._starved[s], 4),
+                    "slot_fill": self._fill[s],
+                    "occupancy": [
+                        [round(t, 4), fill, src] for t, fill, src in occ
+                    ],
+                    "totals": {
+                        f: int(self._totals[s][i])
+                        for i, f in enumerate(DRAIN_STAT_FIELDS)
+                        if f in COUNTER_FIELDS
+                    },
+                    "levels": {
+                        f: int(self._last[s][i])
+                        for i, f in enumerate(DRAIN_STAT_FIELDS)
+                        if f in LEVEL_FIELDS
+                    },
+                }
+                if refusals is not None and s < len(refusals):
+                    row["publish_refusals"] = int(refusals[s])
+                shards.append(row)
+
+            def pct(lat: LatencySamples) -> Dict[str, Any]:
+                out: Dict[str, Any] = {"samples": len(lat)}
+                for q in (50.0, 95.0, 99.0):
+                    v = lat.percentile(q)
+                    out[f"p{int(q)}"] = (
+                        round(v, 3) if v is not None else None
+                    )
+                return out
+
+            return {
+                "available": True,
+                "n_shards": self.n_shards,
+                "ring_depth": self.ring_depth,
+                "drains": self._drains,
+                "payload_fetches": self._fetches,
+                "fields": list(DRAIN_STAT_FIELDS),
+                "shards": shards,
+                "latency_ms": {
+                    "event_to_fire": pct(self._fire_lat),
+                    "publish_to_consume": pct(self._consume_lat),
+                },
+            }

@@ -73,6 +73,9 @@ class SpanTracer:
         self.stage = stage
         self.sample_every = max(1, int(sample_every))
         self._spans: deque = deque(maxlen=max(16, int(max_spans)))
+        # counter-track samples ride their own ring so a chatty counter
+        # cannot evict spans: (track, t_sample_s, {series: value})
+        self._counters: deque = deque(maxlen=max(16, int(max_spans)))
         self._lock = threading.Lock()
         # perf_counter origin for relative span timestamps + the wall
         # clock at that origin so exported ts can be absolute-ish
@@ -104,6 +107,22 @@ class SpanTracer:
                 attrs or None,
             ))
 
+    def rec_counter(self, track: str, t_sample: Optional[float] = None,
+                    **values):
+        """Record one sample on a Perfetto counter track ("ph": "C"):
+        the drain flight recorder emits ring fill / duty cycle / events
+        retired this way so they render as stacked counter lanes above
+        the phase spans. Same guard discipline as `rec`."""
+        if not values:
+            return
+        if t_sample is None:
+            t_sample = time.perf_counter()
+        with self._lock:
+            self._counters.append((
+                track, t_sample,
+                {k: float(v) for k, v in values.items()},
+            ))
+
     def span(self, name: str, **attrs):
         """Context-manager form for code paths without an existing
         timestamp pair (the executor's occupancy refresh uses it). The
@@ -115,6 +134,10 @@ class SpanTracer:
     def snapshot(self) -> List[_Span]:
         with self._lock:
             return list(self._spans)
+
+    def counter_snapshot(self) -> List[Tuple[str, float, Dict[str, float]]]:
+        with self._lock:
+            return list(self._counters)
 
     def __len__(self):
         with self._lock:
@@ -138,6 +161,17 @@ class SpanTracer:
             if attrs:
                 ev["args"] = attrs
             events.append(ev)
+        for track, t_sample, values in self.counter_snapshot():
+            # Perfetto draws one stacked counter lane per (pid, name)
+            # with the series keys of "args" as the stack components
+            events.append({
+                "name": track,
+                "cat": "counter",
+                "ph": "C",
+                "ts": round((t_sample - self.t0) * 1e6, 3),
+                "pid": 1,
+                "args": values,
+            })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

@@ -47,6 +47,7 @@ from flink_tpu.checkpointing.policy import (
     CheckpointFailureBudgetExceeded,
     policy_from_config,
 )
+from flink_tpu.metrics.drain_stats import DrainTelemetry
 from flink_tpu.metrics.recovery import RecoveryTracker
 from flink_tpu.metrics.tracing import (
     CompileEvents,
@@ -942,9 +943,20 @@ class CycleAttribution:
 
     Cycles with no records are source-starved. EWMAs + per-phase
     histograms feed /jobs/<jid>/backpressure.
+
+    Resident-loop regimes (ISSUE 14): host-dispatch phases cannot see
+    inside the ring drain, so when the drain flight recorder is live the
+    executor plugs its duty-cycle estimator in as ``resident_fn`` and
+    classification consults it FIRST — ``ring-starved`` (drains keep
+    finding empty rings: publish side can't feed the device) and
+    ``device-saturated`` (drains keep retiring full-depth ring groups:
+    the device is the bottleneck) are more specific verdicts than the
+    phase dominance rules below them.
     """
 
     PHASES = ("source", "host", "dispatch", "emit")
+    RING_STARVED_ABOVE = 0.5      # mean empty-ring drain fraction
+    DEVICE_SATURATED_ABOVE = 0.85  # mean drain duty cycle (count/depth)
 
     def __init__(self, group=None, alpha: float = 0.05):
         self.alpha = alpha
@@ -955,6 +967,9 @@ class CycleAttribution:
         # regime, not the job's lifetime (a job idle overnight then
         # saturated must flip to device-bound, not stay source-starved)
         self.idle_ewma = 0.0
+        # () -> (duty, starved) from metrics.drain_stats.DrainTelemetry
+        # .regime(); None outside the resident loop
+        self.resident_fn = None
         self.hists = (
             {p: group.histogram(f"phase_{p}_ms") for p in self.PHASES}
             if group is not None else None
@@ -976,6 +991,12 @@ class CycleAttribution:
         total = self.idle + self.busy
         if total == 0:
             return "ok"
+        if self.resident_fn is not None:
+            duty, starved = self.resident_fn()
+            if starved > self.RING_STARVED_ABOVE:
+                return "ring-starved"
+            if duty > self.DEVICE_SATURATED_ABOVE:
+                return "device-saturated"
         if self.idle_ewma > 0.5:
             return "source-starved"
         dominant = max(self.ewma, key=self.ewma.get)
@@ -990,12 +1011,17 @@ class CycleAttribution:
         }[dominant]
 
     def report(self) -> dict:
-        return {
+        out = {
             "classification": self.classify(),
             "phase-ewma-ms": {p: round(v, 3) for p, v in self.ewma.items()},
             "idle-cycles": self.idle,
             "busy-cycles": self.busy,
         }
+        if self.resident_fn is not None:
+            duty, starved = self.resident_fn()
+            out["drain-duty-cycle"] = round(duty, 4)
+            out["ring-starved-fraction"] = round(starved, 4)
+        return out
 
 
 class LocalExecutor:
@@ -1916,10 +1942,12 @@ class LocalExecutor:
                             "insert": build_window_resident_drain(
                                 ctx, spec, ring_depth,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ),
                             "fast": build_window_resident_drain(
                                 ctx, spec, ring_depth, insert=False,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ) if build_fast else None,
                         }
                     if "exchange" in steps_by_route:
@@ -1927,11 +1955,13 @@ class LocalExecutor:
                             "insert": build_window_resident_drain_exchange(
                                 ctx, spec, bpd, ring_depth, capf,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ),
                             "fast": build_window_resident_drain_exchange(
                                 ctx, spec, bpd, ring_depth, capf,
                                 insert=False, kg_fill=kg_stats_on,
                                 reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ) if build_fast else None,
                         }
                     if use_dp:
@@ -1950,10 +1980,12 @@ class LocalExecutor:
                             "insert": build_window_sharded_drain(
                                 ctx, spec, ring_depth,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ),
                             "fast": build_window_sharded_drain(
                                 ctx, spec, ring_depth, insert=False,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
+                                drain_stats=drain_stats_on,
                             ) if build_fast else None,
                         }
                         if self._job_group is not None:
@@ -1966,6 +1998,70 @@ class LocalExecutor:
                                 self._job_group.gauge(
                                     f"ring_publish_refusals_shard_{_s}",
                                     partial(_ring_refusals, _s),
+                                )
+                    if drain_stats_on:
+                        # drain flight recorder, host half: the
+                        # aggregator the lagged consume path feeds,
+                        # plugged into the attribution as its resident-
+                        # loop regime signal. Rebuilt per setup() so an
+                        # elastic re-plan resizes the per-shard series
+                        # with the mesh. Lane count follows the RING:
+                        # per-shard with the sharded ring (use_dp), one
+                        # global lane otherwise (absorb_payload folds
+                        # the payload's shard rows to match).
+                        n_lanes = ctx.n_shards if use_dp else 1
+                        drain_telem[0] = DrainTelemetry(
+                            n_lanes, ring_depth, tracer=tracer,
+                        )
+                        ds_skip[0] = 0
+                        if self._attribution is not None:
+                            self._attribution.resident_fn = (
+                                drain_telem[0].regime
+                            )
+                        if self._job_group is not None:
+                            grp_d = self._job_group
+
+                            def _dt_fill(s):
+                                dt = drain_telem[0]
+                                return dt.slot_fill(s) if dt else 0
+
+                            def _dt_duty(s):
+                                dt = drain_telem[0]
+                                return (
+                                    round(dt.duty_cycle(s), 4)
+                                    if dt else 0.0
+                                )
+
+                            def _dt_lat(which, q):
+                                dt = drain_telem[0]
+                                if dt is None:
+                                    return 0.0
+                                v = (
+                                    dt.fire_latency_ms(q)
+                                    if which == "fire"
+                                    else dt.consume_latency_ms(q)
+                                )
+                                return round(v, 3) if v is not None else 0.0
+
+                            # same idempotency story as the refusal
+                            # series above (registry.register overwrites)
+                            for _s in range(n_lanes):
+                                grp_d.gauge(
+                                    f"drain_slot_fill_shard_{_s}",
+                                    partial(_dt_fill, _s),
+                                )
+                                grp_d.gauge(
+                                    f"drain_duty_cycle_shard_{_s}",
+                                    partial(_dt_duty, _s),
+                                )
+                            for _q in (50, 95, 99):
+                                grp_d.gauge(
+                                    f"drain_fire_latency_p{_q}_ms",
+                                    partial(_dt_lat, "fire", float(_q)),
+                                )
+                                grp_d.gauge(
+                                    f"drain_consume_latency_p{_q}_ms",
+                                    partial(_dt_lat, "consume", float(_q)),
                                 )
                 fire_step = build_window_fire_step(ctx, spec)
                 if sink_device_reduce:
@@ -3142,6 +3238,20 @@ class LocalExecutor:
         kg_stats_on = env.config.get_bool(
             "observability.kg-stats", tracer is not None
         )
+        # observability.drain-stats gates the drain-interior flight
+        # recorder (ISSUE 14): with it on, the resident/sharded drain
+        # kernels stack per-slot DRAIN_STAT_FIELDS counters the consume
+        # path unpacks LAGGED; with it off (the shipping default unless
+        # tracing is on) the drains compile without any telemetry work —
+        # the op-budget ledger pins the OFF variants byte-identical.
+        drain_stats_on = env.config.get_bool(
+            "observability.drain-stats", tracer is not None
+        )
+        drain_stats_every = max(1, env.config.get_int(
+            "observability.drain-stats-every", 8
+        ))
+        drain_telem = [None]   # DrainTelemetry; built in setup() when
+        ds_skip = [0]          # the resident loop is live (payload cadence)
 
         def refresh_kg_occupancy(force: bool = False):
             """Run the per-key-group occupancy kernel and cache the host
@@ -3189,6 +3299,31 @@ class LocalExecutor:
             }
 
         env._kg_report = kg_report
+
+        def pipeline_report() -> dict:
+            """/jobs/<jid>/pipeline body: the consolidated resident-
+            pipeline health view (drain telemetry + refusals + the
+            attribution verdict)."""
+            dt = drain_telem[0]
+            if dt is None:
+                return {
+                    "available": False,
+                    "reason": "observability.drain-stats off or the "
+                              "resident loop is not active",
+                }
+            try:
+                dr = ingest.device_ring
+            except NameError:
+                dr = None      # scraped before the pipeline is built
+            rep = dt.report(
+                refusals=dr.refusals() if dr is not None else None
+            )
+            rep["drain_stats_every"] = drain_stats_every
+            if self._attribution is not None:
+                rep["classification"] = self._attribution.classify()
+            return rep
+
+        env._pipeline_report = pipeline_report
         if self._job_group is not None:
             grp = self._job_group
             # effective fused depth of the most recent dispatch (K for a
@@ -3482,7 +3617,10 @@ class LocalExecutor:
                 # can never under-report the fill at fire time).
                 state, (ovf_handle, act_handle, kgf_handle), fires = \
                     active(state, *flat, wmv)
-                fire_watch.append((fires, ovf_handle, time.perf_counter()))
+                # no drain-stats lane on megasteps (resident drains only)
+                fire_watch.append(
+                    (fires, ovf_handle, time.perf_counter(), None)
+                )
                 metrics.fused_fire_dispatches += 1
             else:
                 state, (ovf_handle, act_handle, kgf_handle) = active(
@@ -3603,9 +3741,23 @@ class LocalExecutor:
                     if getattr(active, "sharded_drain", False)
                     else np.int32(count)
                 )
-                state, (ovf_handle, act_handle, kgf_handle), fires = \
-                    active(state, *flat, wmv, cnt)
-                fire_watch.append((fires, ovf_handle, time.perf_counter()))
+                res = active(state, *flat, wmv, cnt)
+                # telemetry-ON drains return a 4th element: the
+                # [n_shards, D, len(DRAIN_STAT_FIELDS)] flight-recorder
+                # payload. Its handle is kept every drain-stats-every-th
+                # drain only (the device computes it every drain; the
+                # host fetch cadence is the knob) and rides the lagged
+                # fire_watch channel — never a fresh sync
+                state, (ovf_handle, act_handle, kgf_handle), fires = res[:3]
+                ds_h = None
+                if drain_stats_on:
+                    ds_skip[0] += 1
+                    if ds_skip[0] >= drain_stats_every:
+                        ds_skip[0] = 0
+                        ds_h = res[3]
+                fire_watch.append(
+                    (fires, ovf_handle, time.perf_counter(), ds_h)
+                )
                 inflight.append(act_handle)
                 if len(inflight) > max_inflight:
                     inflight.popleft().block_until_ready()
@@ -3695,6 +3847,7 @@ class LocalExecutor:
                 # async runtime keeps the buffers alive until the
                 # queued drain has consumed them)
                 dr = ingest.device_ring
+                released = None
                 if dr is not None and dr.sharded:
                     # per-shard applied cut: each shard retires through
                     # ITS highest released sequence (a refused lane's
@@ -3713,13 +3866,30 @@ class LocalExecutor:
                                 cut[s] = sq
                     if any(sq is not None for sq in cut):
                         dr.release_shards(cut)
-                else:
+                    released = cut
+                elif dr is not None:
                     seqs = [
                         it[2].ring_seq for it in items
                         if it[2] is not None and it[2].ring_seq is not None
                     ]
-                    if seqs and dr is not None:
+                    if seqs:
                         dr.release_through(max(seqs))
+                    released = [max(seqs) if seqs else None]
+                dt = drain_telem[0]
+                if dt is not None and dr is not None:
+                    # flight-recorder tick: absorb the ring's publish-
+                    # time stamps (bounded deque swaps — no device
+                    # traffic) and record this drain's duty-cycle /
+                    # occupancy / publish-to-consume samples
+                    if not dr.stats_enabled:
+                        dr.stats_enabled = True
+                    dt.ingest_publish(dr.publish_samples())
+                    fills = dr.occupancy_shards()
+                    dt.on_drain(
+                        [len(items)] * len(fills), fills,
+                        released if released is not None
+                        else [None] * len(fills),
+                    )
             if fused.hold_fires:
                 fired_in_scan = resident_ok or (full and getattr(
                     megasteps_by_route.get(route, {}).get("insert"),
@@ -4147,15 +4317,27 @@ class LocalExecutor:
             idle polls and end of stream (latency guard)."""
             total = 0
             while fire_watch and (force or len(fire_watch) > FIRE_LAG):
-                cf, ovf_h, t_disp = fire_watch.popleft()
+                cf, ovf_h, t_disp, ds_h = fire_watch.popleft()
                 # ReducedFires payloads (device_reduce topologies) have
                 # no key planes: the small fields below ARE the drain
                 reduced = not hasattr(cf, "key_hi")
                 t_f0 = time.perf_counter()
-                counts, lanes, ends, vsums, ovf_fill = jax.device_get(
-                    (cf.counts, cf.lane_valid, cf.window_end_ticks,
-                     cf.value_sums, ovf_h)
-                )                              # [n_shards, K, Ft]
+                if ds_h is not None:
+                    # the sampled flight-recorder payload rides the SAME
+                    # batched lagged fetch — one settled round trip
+                    # either way, never a fresh sync
+                    counts, lanes, ends, vsums, ovf_fill, ds_np = \
+                        jax.device_get(
+                            (cf.counts, cf.lane_valid,
+                             cf.window_end_ticks, cf.value_sums,
+                             ovf_h, ds_h)
+                        )
+                else:
+                    ds_np = None
+                    counts, lanes, ends, vsums, ovf_fill = jax.device_get(
+                        (cf.counts, cf.lane_valid, cf.window_end_ticks,
+                         cf.value_sums, ovf_h)
+                    )                          # [n_shards, K, Ft]
                 if win.overflow and int(ovf_fill.max(initial=0)) > 0:
                     # spill contributions for the fired panes may still
                     # sit in the device overflow ring — move them into
@@ -4175,6 +4357,17 @@ class LocalExecutor:
                         counts[:, kk], lanes[:, kk], ends[:, kk],
                         vsums[:, kk], reduced,
                     )
+                dt = drain_telem[0]
+                if dt is not None:
+                    if ds_np is not None:
+                        dt.absorb_payload(ds_np)
+                    live = lanes.astype(bool)
+                    if live.any():
+                        # event-time-to-fire: every live lane is one
+                        # fired window end weighted by its key count
+                        dt.note_fires(list(zip(
+                            ends[live].tolist(), counts[live].tolist()
+                        )))
                 if tracer is not None and tracer.active:
                     tracer.rec("fire", t_f0, t_f1, fused=True)
                     tracer.rec("emit", t_f1, fired=n)

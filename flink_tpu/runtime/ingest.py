@@ -51,6 +51,7 @@ import dataclasses
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -423,6 +424,12 @@ class DeviceBatchRing:
         self._write = 0          # seq of the next slot to publish
         self._read = 0           # seq of the oldest unreleased slot
         self._refusals = 0       # full-ring publish refusals (backpressure)
+        # drain flight recorder (observability.drain-stats): publish-time
+        # stamps (shard, seq, fill, max_tick, t) appended in the locked
+        # commit below and drained by the executor's consume path; the
+        # executor flips stats_enabled so the default path appends nothing
+        self.stats_enabled = False
+        self._pub_samples: deque = deque(maxlen=4096)
         self._lock = threading.Lock()
 
     # -- producer (prefetch thread) --------------------------------------
@@ -443,9 +450,15 @@ class DeviceBatchRing:
             seq = self._write
         staged = self._staging.stage(plan, hi, lo, ticks, values, n,
                                      route, tracer=tracer)
+        max_tick = int(ticks[:n].max()) if n else None
         with self._lock:
             self._slots[seq % self.depth] = (seq, epoch, staged)
             self._write = seq + 1
+            if self.stats_enabled:
+                self._pub_samples.append((
+                    0, seq, self._write - self._read, max_tick,
+                    time.perf_counter(),
+                ))
         return seq, staged
 
     # -- consumer (step loop) --------------------------------------------
@@ -485,6 +498,19 @@ class DeviceBatchRing:
         the sum and the per-shard breakdown as gauges."""
         with self._lock:
             return [self._refusals]
+
+    def occupancy_shards(self) -> list:
+        """Per-lane committed-but-unreleased counts (one lane here)."""
+        with self._lock:
+            return [self._write - self._read]
+
+    def publish_samples(self) -> list:
+        """Drain the publish-time stamp buffer (drain flight recorder);
+        empty unless the executor enabled ``stats_enabled``."""
+        with self._lock:
+            out = list(self._pub_samples)
+            self._pub_samples.clear()
+        return out
 
 
 class ShardedDeviceBatchRing:
@@ -543,6 +569,9 @@ class ShardedDeviceBatchRing:
         self._write = [0] * self.n_shards
         self._read = [0] * self.n_shards
         self._refusals = [0] * self.n_shards
+        # drain flight recorder stamps — see DeviceBatchRing
+        self.stats_enabled = False
+        self._pub_samples: deque = deque(maxlen=4096)
         self._lock = threading.Lock()
         self._mask_tmpl = make_prefix_mask_template(self.cap)
         self._reuse = not _host_put_aliases_cached(
@@ -610,6 +639,8 @@ class ShardedDeviceBatchRing:
         # transfer completion ON THE INGEST THREAD (StagingRing.stage
         # contract): a published slot's rows are dispatch-ready
         jax.block_until_ready(staged)  # host-sync-ok: ingest-thread transfer completion, off the step loop
+        max_tick = int(ticks[:n].max()) if n else None
+        t_pub = time.perf_counter()
         with self._lock:
             for s in range(self.n_shards):
                 if seqs[s] is not None:
@@ -617,6 +648,11 @@ class ShardedDeviceBatchRing:
                         seqs[s], epoch, tuple(r[s] for r in rows),
                     )
                     self._write[s] = seqs[s] + 1
+                if self.stats_enabled:
+                    self._pub_samples.append((
+                        s, seqs[s], self._write[s] - self._read[s],
+                        max_tick, t_pub,
+                    ))
         if tracer is not None and tracer.active:
             tracer.rec("stage", t0, t_pad, n=n)
             tracer.rec("transfer", t_pad, route="sharded")
@@ -672,6 +708,22 @@ class ShardedDeviceBatchRing:
         """Per-shard full-lane publish refusal counts."""
         with self._lock:
             return list(self._refusals)
+
+    def occupancy_shards(self) -> list:
+        """Per-shard committed-but-unreleased slot counts."""
+        with self._lock:
+            return [
+                self._write[s] - self._read[s]
+                for s in range(self.n_shards)
+            ]
+
+    def publish_samples(self) -> list:
+        """Drain the publish-time stamp buffer (drain flight recorder);
+        empty unless the executor enabled ``stats_enabled``."""
+        with self._lock:
+            out = list(self._pub_samples)
+            self._pub_samples.clear()
+        return out
 
 
 # ------------------------------------------------------- fused dispatch

@@ -732,10 +732,53 @@ def _zero_slot_fires(spec: WindowStageSpec, reduced: bool):
     )
 
 
+# per-slot drain-interior counters (observability.drain-stats, ISSUE 14):
+# index order of the int32 stats vector each live drain slot emits. The
+# scan stacks them [D, N]; shard_map packs [n_shards, D, N] — the
+# "flight recorder" payload the executor unpacks LAGGED alongside fires.
+# The tuple lives with the host-side unpacker so packer and unpacker
+# cannot drift (flink_tpu/metrics/drain_stats.py documents each field).
+from flink_tpu.metrics.drain_stats import DRAIN_STAT_FIELDS  # noqa: E402
+
+
+def _slot_drain_stats(st, spec: WindowStageSpec, s_valid, act, kgf, cf,
+                      wm_before, late0, cap0):
+    """One live slot's DRAIN_STAT_FIELDS vector — element ops and tiny
+    reductions over fields the fused body already materialized, so the
+    telemetry-ON kernels add zero sort/scatter/gather passes (the
+    op-budget ledger pins the OFF variants byte-identical)."""
+    slide = jnp.int32(spec.win.slide_ticks)
+    # clamp the pre-advance watermark so a fresh job's MIN sentinel
+    # cannot overflow the int32 pane subtraction, and report the very
+    # first advance (no meaningful baseline) as zero panes crossed
+    wb = jnp.maximum(wm_before, st.watermark - jnp.int32(1 << 20))
+    panes = jnp.maximum(
+        jnp.int32(0), st.watermark // slide - wb // slide
+    )
+    panes = jnp.where(
+        wm_before < jnp.int32(-(2 ** 30)), jnp.int32(0), panes
+    )
+    kg_max = (
+        jnp.max(kgf) if kgf.shape[0] else jnp.zeros((), jnp.int32)
+    )
+    return jnp.stack([
+        jnp.sum(s_valid, dtype=jnp.int32),
+        act,
+        jnp.sum(cf.lane_valid, dtype=jnp.int32),
+        jnp.sum(cf.counts, dtype=jnp.int32),
+        st.dropped_late - late0,
+        st.dropped_capacity - cap0,
+        st.ovf_n,
+        kg_max,
+        panes,
+    ])
+
+
 def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
                                 depth: int, insert: bool = True,
                                 kg_fill: bool = False,
-                                reduced: bool = False):
+                                reduced: bool = False,
+                                drain_stats: bool = False):
     """Device-resident ring-drain loop (pipeline.resident-loop, ISSUE
     12): ONE jitted dispatch consumes up to ``depth`` staged ring slots
     against donated state, running the PR 7 fused update+fire body per
@@ -769,7 +812,11 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
     fires)`` with fires stacked [n_shards, depth] exactly like
     ``build_window_megastep_fired`` at K=depth, so the executor's lagged
     fire consumption and monitoring paths need no drain-specific
-    variant."""
+    variant. With ``drain_stats`` (observability.drain-stats) a fourth
+    return element rides along: an int32 [n_shards, depth,
+    len(DRAIN_STAT_FIELDS)] per-slot flight-recorder stack, consumed
+    lagged with the fires; off, the kernel and its return contract are
+    byte-identical to pre-telemetry (the op-budget ledger asserts it)."""
     starts, ends = ctx.kg_bounds()
     starts = jnp.asarray(starts)
     ends = jnp.asarray(ends)
@@ -788,6 +835,8 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
 
             def live(op):
                 st, pend = op
+                wm_b = st.watermark
+                late0, cap0 = st.dropped_late, st.dropped_capacity
                 st, act, kgf = mask_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, s_wm, maxp, insert=insert,
@@ -796,28 +845,39 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
                 st, pend, cf = wk.advance_and_fire_resident(
                     st, spec.win, spec.red, s_wm, reduced=reduced
                 )
+                if drain_stats:
+                    ds = _slot_drain_stats(st, spec, s_valid, act, kgf,
+                                           cf, wm_b, late0, cap0)
+                    return (st, pend), (act, kgf, cf, ds)
                 return (st, pend), (act, kgf, cf)
 
             def skip(op):
                 kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
-                return op, (jnp.zeros((), jnp.int32), kgf,
-                            _zero_slot_fires(spec, reduced))
+                ys = (jnp.zeros((), jnp.int32), kgf,
+                      _zero_slot_fires(spec, reduced))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return op, ys
 
             return jax.lax.cond(i < count, live, skip, carry)
 
-        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+        (state, pend), ys = jax.lax.scan(
             sub, (state, pend0),
             (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
              wm[0]),
         )
+        acts, kgfs, fires = ys[:3]
         state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
         ovf_n = state.ovf_n
         act = jnp.sum(acts)
         kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
         pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return (
+        out = (
             pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
         )
+        if drain_stats:
+            out += (ys[3][None],)      # [1, D, N] flight-recorder stack
+        return out
 
     sharded = shard_map(
         shard_body,
@@ -829,7 +889,8 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS),             # wmv [n_shards, D]
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                   P(SHARD_AXIS), P(SHARD_AXIS)),
+                   P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
         check_vma=False,
     )
 
@@ -837,10 +898,13 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
     def drain(state, *flat):
         *batches, wmv, count = flat
         stacks = _fused_batch_stack(D, batches)
-        st, ovf_n, act, kgf, fires = sharded(
+        res = sharded(
             state, starts, ends, jnp.asarray(count, jnp.int32),
             *stacks, wmv,
         )
+        st, ovf_n, act, kgf, fires = res[:5]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, res[5]
         return st, (ovf_n, act, kgf), fires
 
     drain.k_steps = D
@@ -848,6 +912,7 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
     drain.resident_drain = True
     drain.fused_fire = True
     drain.fused_fire_reduced = reduced
+    drain.drain_stats = drain_stats
     return drain
 
 
@@ -858,7 +923,8 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
                                          capacity_factor: float = 2.0,
                                          insert: bool = True,
                                          kg_fill: bool = False,
-                                         reduced: bool = False):
+                                         reduced: bool = False,
+                                         drain_stats: bool = False):
     """Exchange-route resident drain: the ring-drain analog of
     build_window_megastep_fired_exchange — each live slot runs the
     shared ``exchange_update_shard`` body (bucket + all_to_all + masked
@@ -894,6 +960,8 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
 
             def live(op):
                 st, pend = op
+                wm_b = st.watermark
+                late0, cap0 = st.dropped_late, st.dropped_capacity
                 st, act = exchange_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, n, maxp, cap, insert=insert,
@@ -912,28 +980,39 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
                 st, pend, cf = wk.advance_and_fire_resident(
                     st, spec.win, spec.red, s_wm, reduced=reduced
                 )
+                if drain_stats:
+                    ds = _slot_drain_stats(st, spec, s_valid, act, kgf,
+                                           cf, wm_b, late0, cap0)
+                    return (st, pend), (act, kgf, cf, ds)
                 return (st, pend), (act, kgf, cf)
 
             def skip(op):
                 kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
-                return op, (jnp.zeros((), jnp.int32), kgf,
-                            _zero_slot_fires(spec, reduced))
+                ys = (jnp.zeros((), jnp.int32), kgf,
+                      _zero_slot_fires(spec, reduced))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return op, ys
 
             return jax.lax.cond(i < count, live, skip, carry)
 
-        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+        (state, pend), ys = jax.lax.scan(
             sub, (state, pend0),
             (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
              wm[0]),
         )
+        acts, kgfs, fires = ys[:3]
         state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
         ovf_n = state.ovf_n
         act = jnp.sum(acts)
         kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
         pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return (
+        out = (
             pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
         )
+        if drain_stats:
+            out += (ys[3][None],)
+        return out
 
     sharded = shard_map(
         shard_body,
@@ -947,7 +1026,8 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
             P(SHARD_AXIS),
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                   P(SHARD_AXIS), P(SHARD_AXIS)),
+                   P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
         check_vma=False,
     )
 
@@ -955,10 +1035,13 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
     def drain(state, *flat):
         *batches, wmv, count = flat
         stacks = _fused_batch_stack(D, batches)
-        st, ovf_n, act, kgf, fires = sharded(
+        res = sharded(
             state, starts, ends, jnp.asarray(count, jnp.int32),
             *stacks, wmv,
         )
+        st, ovf_n, act, kgf, fires = res[:5]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, res[5]
         return st, (ovf_n, act, kgf), fires
 
     drain.k_steps = D
@@ -968,13 +1051,15 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
     drain.fused_fire_reduced = reduced
     drain.recv_lanes = n * cap
     drain.bucket_cap = cap
+    drain.drain_stats = drain_stats
     return drain
 
 
 def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
                                depth: int, insert: bool = True,
                                kg_fill: bool = False,
-                               reduced: bool = False):
+                               reduced: bool = False,
+                               drain_stats: bool = False):
     """Data-parallel resident drain (pipeline.data-parallel, ISSUE 13):
     the ring-drain scan lowered shard-LOCALLY — the ingest side already
     partitioned each batch by owning key-group slice and published the
@@ -1021,6 +1106,8 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
 
             def live(op):
                 st, pend = op
+                wm_b = st.watermark
+                late0, cap0 = st.dropped_late, st.dropped_capacity
                 st, act, kgf = mask_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, s_wm, maxp, insert=insert,
@@ -1029,29 +1116,40 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
                 st, pend, cf = wk.advance_and_fire_resident(
                     st, spec.win, spec.red, s_wm, reduced=reduced
                 )
+                if drain_stats:
+                    ds = _slot_drain_stats(st, spec, s_valid, act, kgf,
+                                           cf, wm_b, late0, cap0)
+                    return (st, pend), (act, kgf, cf, ds)
                 return (st, pend), (act, kgf, cf)
 
             def skip(op):
                 kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
-                return op, (jnp.zeros((), jnp.int32), kgf,
-                            _zero_slot_fires(spec, reduced))
+                ys = (jnp.zeros((), jnp.int32), kgf,
+                      _zero_slot_fires(spec, reduced))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return op, ys
 
             return jax.lax.cond(i < count, live, skip, carry)
 
-        (state, pend), (acts, kgfs, fires) = jax.lax.scan(
+        (state, pend), ys = jax.lax.scan(
             sub, (state, pend0),
             # [D, 1, cap] per-shard batch stacks squeeze the split axis
             (jnp.arange(D, dtype=jnp.int32), hi[:, 0], lo[:, 0],
              ts[:, 0], values[:, 0], valid[:, 0], wm[0]),
         )
+        acts, kgfs, fires = ys[:3]
         state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
         ovf_n = state.ovf_n
         act = jnp.sum(acts)
         kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
         pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return (
+        out = (
             pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
         )
+        if drain_stats:
+            out += (ys[3][None],)
+        return out
 
     sharded = shard_map(
         shard_body,
@@ -1066,7 +1164,8 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS),             # wmv [n_shards, D]
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                   P(SHARD_AXIS), P(SHARD_AXIS)),
+                   P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
         check_vma=False,
     )
 
@@ -1074,10 +1173,13 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     def drain(state, *flat):
         *batches, wmv, counts = flat
         stacks = _fused_batch_stack(D, batches)
-        st, ovf_n, act, kgf, fires = sharded(
+        res = sharded(
             state, starts, ends, jnp.asarray(counts, jnp.int32),
             *stacks, wmv,
         )
+        st, ovf_n, act, kgf, fires = res[:5]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, res[5]
         return st, (ovf_n, act, kgf), fires
 
     drain.k_steps = D
@@ -1086,6 +1188,7 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     drain.sharded_drain = True
     drain.fused_fire = True
     drain.fused_fire_reduced = reduced
+    drain.drain_stats = drain_stats
     return drain
 
 
@@ -1477,6 +1580,11 @@ class KernelFamily:
     reduced: bool = False
     k_steps: int = 0
     deep: bool = False
+    # observability.drain-stats telemetry-ON variant (ISSUE 14): the
+    # drain emits the per-slot DRAIN_STAT_FIELDS stack. OFF families
+    # keep their pre-telemetry names AND ledger entries — the byte-
+    # identity test proves the payload compiles out.
+    drain_stats: bool = False
 
 
 def kernel_family_grid():
@@ -1550,6 +1658,21 @@ def kernel_family_grid():
         F("step.sharded_drain.hash.d4.packed", build_window_sharded_drain,
           "sharded_drain", route="sharded", packed=True,
           k_steps=AUDIT_RING_DEPTH),
+        # telemetry-ON drain variants (observability.drain-stats, ISSUE
+        # 14): one per drain builder. Ledgered like any family — the
+        # flight recorder must stay element-ops-only, so an ON variant
+        # whose sort/scatter/gather counts drift from its OFF twin is a
+        # telemetry regression the op-budget rule catches
+        F("step.resident_drain.mask.hash.d4.dstats",
+          build_window_resident_drain,
+          "resident_drain", k_steps=AUDIT_RING_DEPTH, drain_stats=True),
+        F("step.resident_drain.exchange.hash.d4.dstats",
+          build_window_resident_drain_exchange,
+          "resident_drain", route="exchange", k_steps=AUDIT_RING_DEPTH,
+          drain_stats=True),
+        F("step.sharded_drain.hash.d4.dstats", build_window_sharded_drain,
+          "sharded_drain", route="sharded", k_steps=AUDIT_RING_DEPTH,
+          drain_stats=True),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -1662,6 +1785,7 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
         kw["reduced"] = fam.reduced
     if fam.kind in ("resident_drain", "sharded_drain"):
         kw["depth"] = fam.k_steps
+        kw["drain_stats"] = fam.drain_stats
     fn = fam.builder(ctx, spec, **kw)
     init = {
         "session": init_session_state,

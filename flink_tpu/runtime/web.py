@@ -21,6 +21,10 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
                               history (runtime/elastic.py)
     /jobs/<jid>/keygroups     hot key-group top-k + occupancy/fill skew
                               (device-resident telemetry; ?k= bounds)
+    /jobs/<jid>/pipeline      resident-pipeline health: per-shard ring
+                              occupancy/duty-cycle/refusal series +
+                              fire/consume latency percentiles
+                              (observability.drain-stats, ISSUE 14)
     /metrics                  Prometheus text exposition over every job's
                               registry (text/plain, not JSON — scrape me)
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
@@ -1022,6 +1026,25 @@ class WebMonitor:
                             "(yet)",
                 }
             return {"available": True, **report_fn()}
+        m = re.fullmatch(r"/jobs/([^/]+)/pipeline", path)
+        if m:
+            # resident-pipeline health (ISSUE 14): the drain flight
+            # recorder's consolidated view — per-shard ring occupancy /
+            # duty-cycle / publish-refusal series, drain-interior counter
+            # totals, event-to-fire and publish-to-consume percentiles,
+            # and the resident-aware attribution verdict
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            report_fn = getattr(rec.env, "_pipeline_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "pipeline telemetry is recorded by resident-"
+                            "loop windowed stages with observability."
+                            "drain-stats on; this job has none (yet)",
+                }
+            return report_fn()
         m = re.fullmatch(r"/jobs/([^/]+)/elasticity", path)
         if m:
             # elastic degraded-mode state (runtime/elastic.py): full vs

@@ -44,6 +44,43 @@ def test_classification_rules():
     assert c.classify() == "ok"
 
 
+def test_resident_regimes_flip_with_drain_telemetry():
+    """Round 14: when a resident drain loop is live, the duty-cycle /
+    ring-starved signals from the drain telemetry OUTRANK the phase-EWMA
+    rules — a saturated device ring and a starved ring are distinct
+    regimes the phase decomposition cannot see (the drain span is one
+    opaque interval either way), and the classification must flip as the
+    live signal crosses the thresholds."""
+    a = CycleAttribution(alpha=1.0)
+    # phase rule alone says device-bound
+    a.record(idle=False, source=1, host=1, dispatch=30, emit=1)
+    assert a.classify() == "device-bound"
+
+    signal = {"duty": 0.95, "starved": 0.0}
+    a.resident_fn = lambda: (signal["duty"], signal["starved"])
+    assert a.classify() == "device-saturated"
+    # regime flip: rings now drain shallow and come up empty — the
+    # publish side can't keep the device fed
+    signal["duty"] = 0.2
+    signal["starved"] = 0.8
+    assert a.classify() == "ring-starved"
+    # both signals below threshold: fall back to the phase rules
+    signal["starved"] = 0.1
+    assert a.classify() == "device-bound"
+    # starvation wins over saturation (starved checked first: an empty
+    # ring explains a high duty EWMA still decaying)
+    signal["duty"] = 0.99
+    signal["starved"] = 0.9
+    assert a.classify() == "ring-starved"
+
+    # the hooked report carries both live signals
+    r = a.report()
+    assert r["drain-duty-cycle"] == 0.99
+    assert r["ring-starved-fraction"] == 0.9
+    # unhooked instances never grow the keys (back-compat)
+    assert "drain-duty-cycle" not in CycleAttribution().report()
+
+
 def test_report_shape():
     a = CycleAttribution(alpha=1.0)
     a.record(idle=False, source=5, host=1, dispatch=2, emit=1)

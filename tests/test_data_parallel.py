@@ -27,6 +27,7 @@ shard_map'd drain loop.
   accounting, independent release).
 """
 
+import json
 import threading
 import time
 
@@ -534,6 +535,99 @@ def test_ring_publish_refusals_in_prometheus_exposition(tmp_path):
                 f'{{job="dp-web-job"}}'
             ) in text
         assert 'flink_tpu_steps_sharded{job="dp-web-job"}' in text
+    finally:
+        web.stop()
+
+
+def test_drain_flight_recorder_pipeline_endpoint_and_gauges(tmp_path):
+    """Round 14 acceptance: a sharded resident job with
+    ``observability.drain-stats`` on serves per-shard ring occupancy,
+    drain duty-cycle, and fire-latency percentiles at
+    /jobs/<jid>/pipeline; the per-shard gauge families ride the
+    Prometheus exposition; and the Perfetto export carries the drain
+    counter tracks next to the phase spans."""
+    import urllib.request
+
+    from flink_tpu.runtime.cluster import MiniCluster
+    from flink_tpu.runtime.web import WebMonitor
+
+    def get_json(port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return json.loads(r.read())
+
+    env = build_env(4, **{
+        **DP_CFG,
+        "observability.tracing": True,
+        "observability.drain-stats-every": 1,
+    })
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=4096))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    try:
+        jid = cluster.submit(env, "dp-pipe-job")
+        assert cluster.wait(jid, 240) == "FINISHED"
+        got = {(r.key, r.window_end_ms): r.value for r in sink.results}
+        assert got == expected(4096)
+
+        # -- /jobs/<jid>/pipeline: the consolidated drain view
+        rep = get_json(port, f"/jobs/{jid}/pipeline")
+        assert rep["available"] is True
+        assert rep["n_shards"] == 4
+        assert rep["drains"] > 0 and rep["payload_fetches"] > 0
+        assert rep["fields"][0] == "events"
+        assert len(rep["shards"]) == 4
+        for row in rep["shards"]:
+            assert 0.0 <= row["duty_cycle"] <= 1.0
+            assert 0.0 <= row["ring_starved"] <= 1.0
+            assert "publish_refusals" in row
+            # occupancy points carry (t, fill, publish|drain) triples
+            assert all(src in ("publish", "drain")
+                       for _t, _f, src in row["occupancy"])
+        assert sum(r["totals"]["events"] for r in rep["shards"]) > 0
+        assert any(r["occupancy"] for r in rep["shards"])
+        lat = rep["latency_ms"]
+        assert lat["publish_to_consume"]["samples"] > 0
+        assert lat["publish_to_consume"]["p99"] is not None
+        assert lat["event_to_fire"]["samples"] > 0
+        assert rep["drain_stats_every"] == 1
+        assert rep["classification"] in (
+            "ok", "source-starved", "host-bound", "device-bound",
+            "sink-bound", "device-saturated", "ring-starved",
+        )
+
+        # -- Prometheus: per-shard gauge families + latency summaries
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        for s in range(4):
+            assert (f'flink_tpu_drain_slot_fill_shard_{s}'
+                    f'{{job="dp-pipe-job"}}') in text
+            assert (f'flink_tpu_drain_duty_cycle_shard_{s}'
+                    f'{{job="dp-pipe-job"}}') in text
+        for q in (50, 95, 99):
+            assert f'flink_tpu_drain_fire_latency_p{q}_ms' in text
+            assert f'flink_tpu_drain_consume_latency_p{q}_ms' in text
+
+        # -- Perfetto: counter tracks ("ph": "C") next to the spans
+        tr = get_json(port, f"/jobs/{jid}/traces")
+        counters = [ev for ev in tr["traceEvents"] if ev["ph"] == "C"]
+        tracks = {ev["name"] for ev in counters}
+        assert any(t.startswith("drain/shard") for t in tracks)
+        assert any(t.startswith("drain_retired/shard") for t in tracks)
+        fill_ev = next(ev for ev in counters
+                       if ev["name"].startswith("drain/shard"))
+        assert set(fill_ev["args"]) == {"fill", "duty_pct"}
     finally:
         web.stop()
 

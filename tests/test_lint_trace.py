@@ -151,6 +151,38 @@ def test_megastep_families_keep_the_scan():
         )
 
 
+def test_drain_stats_compiles_out_byte_identical_to_pre_pr_ledger():
+    """ISSUE 14 acceptance: with ``observability.drain-stats`` off the
+    drain kernels are the SAME programs as before the flight recorder
+    existed.  The telemetry-OFF drain families' op budgets must stay
+    byte-identical to the frozen pre-PR golden, and every builder must
+    also appear as a ledgered telemetry-ON ``.dstats`` variant."""
+    golden_rel = "tools/lint/ledgers/op_budget_pre_drain_stats.json"
+    with open(os.path.join(ROOT, golden_rel)) as f:
+        golden = json.load(f)["families"]
+    with open(os.path.join(ROOT, LEDGERS[0])) as f:
+        live = json.load(f)["families"]
+    assert len(golden) == 8
+    for name, budget in sorted(golden.items()):
+        assert "dstats" not in name, name
+        assert live.get(name) == budget, (
+            f"{name}: telemetry-OFF drain family drifted from the "
+            f"pre-drain-stats golden ({live.get(name)} != {budget}) — "
+            f"the payload no longer compiles out"
+        )
+    on = {n for n in live if n.endswith(".dstats")}
+    assert on == {
+        "step.resident_drain.mask.hash.d4.dstats",
+        "step.resident_drain.exchange.hash.d4.dstats",
+        "step.sharded_drain.hash.d4.dstats",
+    }, on
+    # the recorder is element-ops-only: an ON variant may not add a
+    # single sort/scatter/gather pass over its OFF twin
+    for name in sorted(on):
+        off = live[name[: -len(".dstats")]]
+        assert live[name] == off, (name, live[name], off)
+
+
 def test_no_family_crosses_the_host_or_widens():
     audit = _audit()
     for name, tr in audit.traces.items():

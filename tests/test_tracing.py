@@ -67,6 +67,32 @@ def test_span_tracer_chrome_trace_shape(tmp_path):
     assert on_disk["traceEvents"] == parsed["traceEvents"]
 
 
+def test_span_tracer_counter_tracks():
+    """Round 14: `rec_counter` samples export as Perfetto counter events
+    ("ph": "C") alongside the phase spans — one stacked lane per track,
+    the kwargs as the stack components. Counters live in their own ring
+    so a chatty fill series can never evict spans."""
+    tr = SpanTracer(stage="job-c", max_spans=16)
+    tr.begin_cycle()
+    tr.rec("dispatch", 10.0, 10.5)
+    tr.rec_counter("drain/shard0", 10.1, fill=3, duty_pct=75.0)
+    tr.rec_counter("drain/shard1", 10.2, fill=0, duty_pct=12.5)
+    tr.rec_counter("empty_is_dropped", 10.3)   # no values: no event
+    ct = json.loads(json.dumps(tr.to_chrome_trace()))
+    cs = [ev for ev in ct["traceEvents"] if ev["ph"] == "C"]
+    assert len(cs) == 2
+    assert cs[0]["name"] == "drain/shard0"
+    assert cs[0]["cat"] == "counter"
+    assert cs[0]["args"] == {"fill": 3.0, "duty_pct": 75.0}
+    assert cs[1]["args"]["fill"] == 0.0
+    # counters ride the same pid so they stack above the span lanes
+    assert all(ev["pid"] == 1 for ev in ct["traceEvents"])
+    # spans survive a counter flood (independent rings)
+    for i in range(100):
+        tr.rec_counter("noisy", 11.0 + i, fill=i)
+    assert [s[0] for s in tr.snapshot()] == ["dispatch"]
+
+
 def test_span_context_manager_respects_active():
     tr = SpanTracer(sample_every=2)
     tr.begin_cycle()            # active
@@ -172,6 +198,43 @@ def test_kg_stats_gating():
     assert snap2["jobs.default-job.kg_occupied_groups"] == 0
 
 
+def test_drain_stats_gating():
+    """The drain flight recorder is gated by observability.drain-stats
+    (defaulting to the tracing flag, same discipline as kg-stats): off
+    means the drain kernels compile WITHOUT the telemetry payload (the
+    trace-tier ledger test pins byte-identity) and the /pipeline report
+    stays unavailable; on lights up the per-shard aggregation without
+    span tracing."""
+    resident = {
+        "observability.tracing": False,
+        "pipeline.prefetch": "on",
+        "pipeline.device-staging": "on",
+        "pipeline.resident-loop": "on",
+        "pipeline.ring-depth": 4,
+    }
+    env, _ = _windowed_env({
+        **resident,
+        "observability.drain-stats": True,
+        # fetch the payload on every drain: short jobs drain only a
+        # handful of times, far fewer than the default sampling stride
+        "observability.drain-stats-every": 1,
+    }, total=16384)
+    env.execute("drain-only")
+    assert env._span_tracer is None
+    rep = env._pipeline_report()
+    assert rep["available"] is True
+    assert rep["n_shards"] == 1 and rep["ring_depth"] == 4
+    assert rep["drains"] > 0 and rep["payload_fetches"] > 0
+    assert rep["shards"][0]["totals"]["events"] > 0
+    assert rep["shards"][0]["occupancy"]
+
+    # default (tracing off): the recorder never instantiates
+    env2, _ = _windowed_env(resident, total=16384)
+    env2.execute("drain-default")
+    rep2 = env2._pipeline_report()
+    assert rep2["available"] is False and "reason" in rep2
+
+
 def test_checkpoint_sync_span_and_trace_dump(tmp_path):
     dump = tmp_path / "trace.json"
     env, _ = _windowed_env({
@@ -252,6 +315,7 @@ def test_web_job_scoped_endpoints_404_unknown_job():
             "/jobs/nope/metrics", "/jobs/nope/checkpoints/config",
             "/jobs/nope/plan", "/jobs/nope/exceptions",
             "/jobs/nope/recovery", "/jobs/nope/elasticity",
+            "/jobs/nope/pipeline",
         ):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get_json(port, path)

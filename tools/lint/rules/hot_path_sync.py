@@ -65,6 +65,13 @@ HOT_PATHS = (
     # recovery path; the one legitimate wait — the recovery-path device
     # health probe — carries the inline marker
     "flink_tpu/runtime/elastic.py",
+    # drain flight-recorder host half (ISSUE 14): the consume-path
+    # unpack target. Its whole contract is pure host arithmetic over
+    # ALREADY-FETCHED numpy payloads — the lagged telemetry channel must
+    # never introduce a fresh device sync, so the module is held to the
+    # same standard as the kernels it observes. (The publish-time stamps
+    # stay inside ingest.py's two allowlisted ingest-thread blocks.)
+    "flink_tpu/metrics/drain_stats.py",
 )
 
 # documented host-facing seams that live in hot-path modules but are
