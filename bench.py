@@ -336,6 +336,11 @@ def main():
                     help="run ONLY the device_update_ceiling microbench "
                          "(pre-staged batch ring, no source): K-fusion x "
                          "duplicate-fraction grid + precombine on/off")
+    ap.add_argument("--stages", action="store_true",
+                    help="run ONLY the chained 2-stage drain vs "
+                         "single-stage comparison at matched dims "
+                         "(ISSUE 16): events/s + p99_fire_ms per "
+                         "discipline")
     ap.add_argument("--resident", action="store_true",
                     help="run ONLY the resident_loop microbench: ring-"
                          "drain dispatches (one per 32 staged slots) vs "
@@ -459,17 +464,51 @@ def main():
             jax.config.update("jax_platforms", "cpu")
         from bench_configs import DEVICE_CEILING_BATCH, run_resident_loop
 
-        res_best, fused_best = run_resident_loop(args.events, args.cpu)
+        res_best, fused_best, res_p99, fused_p99 = run_resident_loop(
+            args.events, args.cpu
+        )
         print(json.dumps({
             "metric": "resident ring-drain best cell vs best K=8 "
                       "fused-megastep (PR-7 path) cell, firing stream",
             "value": round(res_best),
             "unit": "events/s",
+            "p99_fire_ms": res_p99,
             "vs_baseline": (
                 round(res_best / fused_best, 2) if fused_best else 0
             ),
             "criterion": ">= 1.15",
             "dispatch_drop": 4.0,
+            "fused_p99_fire_ms": fused_p99,
+            "batch": DEVICE_CEILING_BATCH,
+        }))
+        return
+
+    if args.stages:
+        # chained-stages mode (ISSUE 16): 2-stage chained drain vs the
+        # single-stage resident drain at matched dims; the acceptance
+        # criterion is <15% throughput cost for the extra stage, with
+        # fire-visibility p99 stamped beside events/s for both
+        if args.cpu:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        from bench_configs import DEVICE_CEILING_BATCH, run_chained_stages
+
+        s_eps, c_eps, s_p99, c_p99 = run_chained_stages(
+            args.events, args.cpu
+        )
+        print(json.dumps({
+            "metric": "chained 2-stage keyed drain vs single-stage "
+                      "resident drain, matched dims, firing stream",
+            "value": round(c_eps),
+            "unit": "events/s",
+            "p99_fire_ms": c_p99,
+            "vs_baseline": round(c_eps / s_eps, 2) if s_eps else 0,
+            "criterion": ">= 0.85 (<15% throughput cost vs "
+                         "single-stage)",
+            "single_stage_events_per_s": round(s_eps),
+            "single_stage_p99_fire_ms": s_p99,
             "batch": DEVICE_CEILING_BATCH,
         }))
         return

@@ -1642,17 +1642,23 @@ def run_resident_loop(total_events: int, cpu: bool):
         return batches, wms
 
     def consume(cf):
-        jax.device_get((cf.counts, cf.lane_valid,
-                        cf.window_end_ticks, cf.value_sums))
+        got = jax.device_get((cf.counts, cf.lane_valid,
+                              cf.window_end_ticks, cf.value_sums))
+        return max(int(np.asarray(got[1]).sum()), 1)
 
     def measure(group, build, dup, reduced):
         """One discipline at group size ``group``: n_batches/group
         dispatches over the shared stream, lagged fire consumption,
-        best-of-3."""
+        best-of-3. Also samples fire-VISIBILITY latency — dispatch of
+        the producing group to its fires host-fetched, the lag the
+        discipline actually imposes on the emit path — weighted by
+        live fire lanes, so p99 stamps beside events/s (ISSUE 16
+        satellite: latency as a first-class acceptance axis)."""
         spec = _spec()
         step = build(spec, reduced)
         batches, wms = make_stream(dup, np.random.default_rng(11))
         n_disp = n_batches // group
+        lat = []
 
         def run_once():
             state = init_sharded_state(ctx, spec)
@@ -1673,17 +1679,22 @@ def run_resident_loop(total_events: int, cpu: bool):
                     )
                 else:
                     state, mon, fires = step(state, *flat, wmv)
-                handles.append(fires)
+                handles.append((time.perf_counter(), fires))
                 if len(handles) > 1:
-                    consume(handles.popleft())
+                    t_d, cf = handles.popleft()
+                    lat.append((consume(cf),
+                                (time.perf_counter() - t_d) * 1e3))
             while handles:
-                consume(handles.popleft())
+                t_d, cf = handles.popleft()
+                lat.append((consume(cf),
+                            (time.perf_counter() - t_d) * 1e3))
             jax.block_until_ready(mon[1])
             return time.perf_counter() - t0
 
         run_once()                               # compile + settle
+        lat.clear()                              # drop compile-run samples
         dt = min(run_once() for _ in range(3))
-        return B * n_batches / dt
+        return B * n_batches / dt, lat
 
     def m_fused(dup, reduced=True):
         return measure(
@@ -1714,29 +1725,41 @@ def run_resident_loop(total_events: int, cpu: bool):
             "criterion": ">= 4x",
         },
     }
-    bests = {"fused": (None, 0.0), "resident": (None, 0.0)}
+    from flink_tpu.metrics.latency import weighted_percentile
+
+    def _p99(lat):
+        p = weighted_percentile(lat, 99)
+        return round(p, 2) if p is not None else None
+
+    bests = {"fused": (None, 0.0, []), "resident": (None, 0.0, [])}
     for dup in (0.0, 0.5, 0.9):
         cell = f"dup_{dup}"
-        ef = m_fused(dup)
-        er = m_resident(dup)
-        detail["fused_k8"][cell] = round(ef)
-        detail["resident_d32"][cell] = round(er)
+        ef, lf = m_fused(dup)
+        er, lr = m_resident(dup)
+        detail["fused_k8"][cell] = {"eps": round(ef),
+                                    "p99_fire_ms": _p99(lf)}
+        detail["resident_d32"][cell] = {"eps": round(er),
+                                        "p99_fire_ms": _p99(lr)}
         if ef > bests["fused"][1]:
-            bests["fused"] = (cell, ef)
+            bests["fused"] = (cell, ef, lf)
         if er > bests["resident"][1]:
-            bests["resident"] = (cell, er)
+            bests["resident"] = (cell, er, lr)
     # compact-payload (key-emitting sink) pair at the base cell, stamped
     # for the general topology next to the reduced headline
     detail["compact_dup_0.5"] = {
-        "fused_k8": round(m_fused(0.5, reduced=False)),
-        "resident_d32": round(m_resident(0.5, reduced=False)),
+        "fused_k8": round(m_fused(0.5, reduced=False)[0]),
+        "resident_d32": round(m_resident(0.5, reduced=False)[0]),
     }
+    res_p99 = _p99(bests["resident"][2])
+    fused_p99 = _p99(bests["fused"][2])
     detail["acceptance"] = {
         "topology": "device_reduce (on-chip-reduced fires)",
         "pr7_fused_best_cell": {"cell": bests["fused"][0],
-                                "eps": round(bests["fused"][1])},
+                                "eps": round(bests["fused"][1]),
+                                "p99_fire_ms": fused_p99},
         "resident_best_cell": {"cell": bests["resident"][0],
-                               "eps": round(bests["resident"][1])},
+                               "eps": round(bests["resident"][1]),
+                               "p99_fire_ms": res_p99},
         "ratio": round(
             bests["resident"][1] / max(bests["fused"][1], 1.0), 2
         ),
@@ -1746,7 +1769,186 @@ def run_resident_loop(total_events: int, cpu: bool):
     }
     print(json.dumps(
         {"config": "resident_loop", "detail": detail}), flush=True)
-    return (bests["resident"][1], bests["fused"][1])
+    return (bests["resident"][1], bests["fused"][1], res_p99, fused_p99)
+
+
+def run_chained_stages(total_events: int, cpu: bool):
+    """Chained 2-stage drain vs the single-stage resident drain at
+    matched dims (ISSUE 16): B=512 / C=4096 / ring depth D=32, the same
+    firing stream, compact fire payload on BOTH sides (the chained
+    drain's final stage emits compact fires, so the single-stage
+    comparator runs ``reduced=False`` for a like-for-like topology).
+
+    The chained discipline is ``build_window_chained_drain`` over
+    (1s tumbling sum) -> device edge -> (4s tumbling rollup): the
+    drain's stacked stage-1 fires pack once per drain into the edge
+    lanes and feed one stage-2 update + advance (the per-drain stage
+    tail). The stream is the multi-level-rollup shape the chain
+    exists for: a bounded key population at the aggregation level
+    (256 distinct keys + a 64-key hot set, dup ~0.5) — both
+    disciplines consume the SAME stream, so the ratio isolates the
+    cost of carrying the second stage. The acceptance criterion is
+    <15% throughput cost, and fire-VISIBILITY p50/p99 (dispatch of
+    the producing drain to fires host-fetched, lagged one dispatch —
+    the emit-path lag the discipline imposes) stamps beside
+    events/s."""
+    from collections import deque as _dq
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.metrics.latency import weighted_percentile
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec,
+        build_window_chained_drain,
+        build_window_resident_drain,
+        init_sharded_state,
+    )
+
+    n_dev = len(jax.devices())
+    ctx = MeshContext.create(n_dev, 128)
+    B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
+    BPP, D = 4, 32
+    ROLLUP = 4               # stage-2 tumbling size, in stage-1 panes
+    KEYSPACE = 256           # distinct keys at the rollup level
+    # per-DRAIN edge budget: one drain closes D/BPP = 8 stage-1 panes,
+    # each firing <= KEYSPACE distinct keys -> <= 2048 edge records per
+    # drain (verified drop-free: edge overflow counts into the stage-2
+    # dropped_capacity counter, which stays 0 on this stream)
+    EX_LANES = 2048
+    iters = max(128, min(8192, total_events // B))
+    n_groups = max(3, max(96, iters // 8) // D)
+    n_batches = n_groups * D
+
+    spec1 = WindowStageSpec(
+        win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=C, layout="direct", precombine=False,
+    )
+    # stage-2 ring sized by the StageGraph.plan_specs rule: the stage
+    # tail advances once per drain, so the ring absorbs a whole drain's
+    # worth of upstream fires (D slots x F pane-ends, the catch-up
+    # worst case) on top of the live window span
+    s2 = ROLLUP * SLIDE
+    ppw = 1
+    slack = (D * spec1.win.fires_per_step * SLIDE) // s2 + 2
+    spec2 = WindowStageSpec(
+        win=wk.WindowSpec(s2, s2, ring=max(8, 2 * ppw + slack, ppw + 3),
+                          fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=C, layout="direct", precombine=False,
+    )
+
+    def _keys(rng):
+        n_hot = B // 2
+        lo = np.concatenate([
+            rng.integers(0, KEYSPACE, B - n_hot),
+            rng.integers(0, 64, n_hot),
+        ]).astype(np.uint32)
+        rng.shuffle(lo)
+        return np.zeros(B, np.uint32), lo
+
+    def make_stream(rng):
+        batches, wms = [], []
+        for j in range(n_batches):
+            p = j // BPP
+            hi, lo = _keys(rng)
+            ts = np.full(B, p * SLIDE + SLIDE // 2, np.int32)
+            batches.append(tuple(jax.device_put(a) for a in (
+                hi, lo, ts, np.ones(B, np.float32), np.ones(B, bool),
+            )))
+            wms.append(np.int32(p * SLIDE - 1))
+        return batches, wms
+
+    def consume(cf):
+        got = jax.device_get((cf.counts, cf.lane_valid,
+                              cf.window_end_ticks, cf.value_sums))
+        return max(int(np.asarray(got[1]).sum()), 1)
+
+    def prep(step, init_state):
+        """Compile + settle one discipline; returns (run_once, lat) so
+        the timed reps of BOTH disciplines can interleave — host load
+        drift then hits single and chained alike instead of biasing
+        whichever ran second."""
+        batches, wms = make_stream(np.random.default_rng(11))
+        n_disp = n_batches // D
+        lat = []
+
+        def run_once():
+            state = init_state()
+            t0 = time.perf_counter()
+            handles = _dq()
+            mon = None
+            for g in range(n_disp):
+                sel = range(g * D, (g + 1) * D)
+                flat = [a for i in sel for a in batches[i]]
+                wmv = np.tile(
+                    np.asarray([wms[i] for i in sel], np.int32),
+                    (n_dev, 1),
+                )
+                state, mon, fires = step(state, *flat, wmv, np.int32(D))
+                handles.append((time.perf_counter(), fires))
+                if len(handles) > 1:
+                    t_d, cf = handles.popleft()
+                    lat.append((consume(cf),
+                                (time.perf_counter() - t_d) * 1e3))
+            while handles:
+                t_d, cf = handles.popleft()
+                lat.append((consume(cf),
+                            (time.perf_counter() - t_d) * 1e3))
+            jax.block_until_ready(mon[1])
+            return time.perf_counter() - t0
+
+        run_once()                               # compile + settle
+        lat.clear()                              # drop compile-run samples
+        return run_once, lat
+
+    def _pct(lat, q):
+        p = weighted_percentile(lat, q)
+        return round(p, 2) if p is not None else None
+
+    single_step = build_window_resident_drain(ctx, spec1, D,
+                                              reduced=False)
+    run_s, s_lat = prep(
+        single_step, lambda: init_sharded_state(ctx, spec1)
+    )
+    chained_step = build_window_chained_drain(
+        ctx, (spec1, spec2), D, exchange_lanes=EX_LANES
+    )
+    run_c, c_lat = prep(
+        chained_step,
+        lambda: (init_sharded_state(ctx, spec1),
+                 init_sharded_state(ctx, spec2)),
+    )
+    t_s, t_c = [], []
+    for _ in range(4):
+        t_s.append(run_s())
+        t_c.append(run_c())
+    s_eps = B * n_batches / min(t_s)
+    c_eps = B * n_batches / min(t_c)
+
+    detail = {
+        "platform": jax.default_backend(), "B": B, "C": C,
+        "ring_depth": D, "n_batches": n_batches, "bpp": BPP,
+        "n_devices": n_dev, "rollup_panes": ROLLUP,
+        "keyspace": KEYSPACE, "exchange_lanes": EX_LANES,
+        "single_stage": {"events_per_s": round(s_eps),
+                         "p50_fire_ms": _pct(s_lat, 50),
+                         "p99_fire_ms": _pct(s_lat, 99)},
+        "chained_2stage": {"events_per_s": round(c_eps),
+                           "p50_fire_ms": _pct(c_lat, 50),
+                           "p99_fire_ms": _pct(c_lat, 99)},
+        "acceptance": {
+            "ratio": round(c_eps / max(s_eps, 1.0), 3),
+            "criterion": ">= 0.85 (<15% throughput cost for the "
+                         "second chained stage)",
+        },
+    }
+    print(json.dumps(
+        {"config": "chained_stages", "detail": detail}), flush=True)
+    return (s_eps, c_eps, _pct(s_lat, 99), _pct(c_lat, 99))
 
 
 def run_scaling_cell(total_events: int):

@@ -306,6 +306,21 @@ class CoreOptions:
         "skew before a batch falls back to the replicated route — "
         "larger tolerates hotter shards at the cost of HBM and padded "
         "drain work")
+    PIPELINE_STAGES_EXCHANGE_LANES = ConfigOption(
+        "pipeline.stages.exchange-lanes", 1024,
+        "chained stage graphs (runtime/stages.py, ISSUE 16): lanes of "
+        "the on-device inter-stage exchange — the packed fire rows one "
+        "drain slot may hand from stage N to stage N+1. Sized above "
+        "fires-per-step x the per-fire key population the upstream "
+        "stage can emit; overrun counts into the DOWNSTREAM stage's "
+        "dropped_capacity (strict capacity surfaces it)")
+    PIPELINE_STAGES_MAX_STAGES = ConfigOption(
+        "pipeline.stages.max-stages", 4,
+        "chained stage graphs: maximum keyed windowed stages one job "
+        "may chain through the resident drain. Each stage adds its own "
+        "table+ring state and per-slot update+fire work to the ONE "
+        "drain dispatch; the cap keeps a pathological deep chain a "
+        "loud setup error instead of an HBM surprise")
     STATE_PACKED_PLANES = ConfigOption(
         "state.packed-planes", "auto",
         "auto | on | off — store the touched (fire-eligibility) bits as "

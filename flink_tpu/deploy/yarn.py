@@ -70,7 +70,11 @@ class YarnRestClient:
     module docstring for the seam.
     """
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        # 30s: a dead RM fails fast anyway (connection refused), but an
+        # ALIVE one whose handler thread is starved by a co-located
+        # container compiling at full tilt can legitimately take >10s
+        # to answer on a single-core host.
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
 

@@ -56,10 +56,13 @@ from flink_tpu.metrics.tracing import (
 )
 from flink_tpu.runtime import elastic
 from flink_tpu.runtime import ingest as ingest_mod
+from flink_tpu.runtime import stages as stages_mod
 from flink_tpu.runtime.step import (
     WindowStageSpec,
     build_compact_step,
     build_kg_occupancy_step,
+    build_window_chained_drain,
+    build_window_chained_drain_sharded,
     build_window_fire_reduced_step,
     build_window_fire_step,
     build_window_megastep,
@@ -738,6 +741,10 @@ class _Pipeline:
     # ingestion edge, a recorded no-op single-host (see
     # PartitionTransformation)
     ingest_partition: Optional[str] = None
+    # downstream keyed windowed stages beyond (key_by, window_agg):
+    # ordered [key_by, window_agg] pairs collected by _translate, turned
+    # into a validated StageGraph (runtime/stages.py) at dispatch
+    stages: List[Any] = dataclasses.field(default_factory=list)
 
     @property
     def all_sinks(self):
@@ -876,9 +883,35 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
         elif isinstance(t, sg.TimestampsWatermarksTransformation):
             pipe.ts_transform = t
         elif isinstance(t, sg.KeyByTransformation):
-            pipe.key_by = t
+            if pipe.key_by is None:
+                pipe.key_by = t
+            else:
+                # a SECOND keyed boundary: collect it for the StageGraph
+                # (runtime/stages.py) instead of silently overwriting the
+                # first — the chain validates at dispatch, where every
+                # unsupported shape raises naming its edge
+                pipe.stages.append([t, None])
         elif isinstance(t, sg.WindowAggTransformation):
-            pipe.window_agg = t
+            if pipe.stages:
+                if pipe.stages[-1][1] is not None:
+                    from flink_tpu.runtime.stages import StageGraphError
+
+                    raise StageGraphError(
+                        f"two window aggregations with no keyBy between "
+                        f"them after stage[{len(pipe.stages)}] — every "
+                        f"chained stage is a keyBy→window pair"
+                    )
+                pipe.stages[-1][1] = t
+            elif pipe.window_agg is not None:
+                from flink_tpu.runtime.stages import StageGraphError
+
+                raise StageGraphError(
+                    "two window aggregations with no keyBy between them "
+                    "— a downstream window must re-key the upstream "
+                    "stage's results (.key_by(lambda r: r.key))"
+                )
+            else:
+                pipe.window_agg = t
         elif isinstance(t, sg.KeyedProcessTransformation):
             pipe.rolling = t
         elif isinstance(t, sg.ProcessTransformation):
@@ -897,6 +930,18 @@ def _translate(sink_transforms: List[sg.SinkTransformation]) -> _Pipeline:
         raise NotImplementedError(
             "keyed stream must currently end in a window agg, rolling "
             "reduce, or process function"
+        )
+    if pipe.stages and (
+        pipe.stages[-1][1] is None
+        or pipe.rolling is not None or pipe.process is not None
+    ):
+        from flink_tpu.runtime.stages import StageGraphError
+
+        raise StageGraphError(
+            f"stage[{len(pipe.stages)}] does not end in a window "
+            f"aggregation — a chained keyed stage must be a keyBy→window "
+            f"pair (rolling reduces and process functions cannot chain "
+            f"after a windowed stage)"
         )
     return pipe
 
@@ -1179,8 +1224,21 @@ class LocalExecutor:
             )
 
             if self.env.config.get_str("dcn.coordinator", ""):
+                if pipe.stages:
+                    raise stages_mod.StageGraphError(
+                        "multi-stage keyed chains are single-host for now "
+                        "— the DCN lockstep plane runs one keyed stage"
+                    )
                 handle = self._run_dcn(pipe, metrics, job_name,
                                        restore_from)
+            elif pipe.stages:
+                # chained keyed windowed stages: StageGraph.from_pipeline
+                # validates every edge up front (loud setup-time errors
+                # naming the unsupported edge) before any compile work
+                handle = self._run_windowed(
+                    pipe, metrics, job_name, restore_from,
+                    graph=stages_mod.StageGraph.from_pipeline(pipe),
+                )
             elif pipe.window_agg is not None and (
                 pipe.window_agg.trigger is not None
                 or pipe.window_agg.evictor is not None
@@ -1510,12 +1568,23 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
     def _run_windowed(self, pipe: _Pipeline, metrics: JobMetrics, job_name,
-                      restore_from=None):
+                      restore_from=None, graph=None):
         from flink_tpu.core.time import TimeCharacteristic
 
         env = self.env
         wagg = pipe.window_agg
         assigner = wagg.assigner
+        # -- chained stage graph (runtime/stages.py, round 16): when the
+        # pipeline carries downstream keyBy→window stages, `graph` is the
+        # validated StageGraph and the resident drain becomes the chained
+        # variant (step.build_window_chained_drain*): stage-N fires are
+        # re-keyed on device and applied to stage-N+1 inside the same
+        # count-gated scan, so a 2-stage pipeline still costs one host
+        # dispatch per ring drain. Sinks observe the FINAL stage's fires;
+        # emit_wagg carries that stage's result_fn/codec semantics.
+        emit_wagg = graph.stages[-1].wagg if graph is not None else wagg
+        chain_specs: List[Any] = []   # downstream WindowStageSpecs (setup)
+        chain_states: List[Any] = []  # downstream device states
         event_time = assigner.is_event_time and (
             env.time_characteristic == TimeCharacteristic.EventTime
         )
@@ -1752,6 +1821,10 @@ class LocalExecutor:
                 # lateness the job keeps strict-capacity semantics instead
                 # of being silently wrong for that corner
                 and wagg.allowed_lateness_ms == 0
+                # chained stage graphs keep strict capacity: a spill-tier
+                # eviction on stage 0 would have to replay through every
+                # downstream stage (host stores carry no edge lineage)
+                and graph is None
             )
             # -1/unset = auto: absorbs the full sampled-lagged detection
             # window of full-batch overflow (MON_EVERY*(OVF_LAG+1) steps
@@ -1816,6 +1889,28 @@ class LocalExecutor:
             )
             metrics.state_layout = layout[0]
             metrics.state_packed_planes = use_packed
+            if graph is not None:
+                # plan the downstream stages off stage 0's spec (identity
+                # re-key: every stage shares the codec/layout/capacity,
+                # fires stay shard-local) and reject runtime shapes the
+                # chained drain cannot serve — loudly, naming the knob,
+                # before any compile work
+                # drain_depth sizes the downstream pane rings: the
+                # chained drain advances stages 1..N-1 once per drain,
+                # so they must absorb a whole ring's worth of upstream
+                # fires between advances
+                chain_specs[:] = graph.plan_specs(
+                    spec, drain_depth=ring_depth
+                )
+                graph.check_runtime(
+                    use_resident=use_resident,
+                    overflow_lanes=ovf,
+                    drain_stats=drain_stats_on,
+                    reduced_fires=sink_device_reduce,
+                    max_stages=env.config.get(
+                        _CoreOpts.PIPELINE_STAGES_MAX_STAGES
+                    ),
+                )
             if not steps_by_route:
                 # exchange.mode — how records reach their owning shard on
                 # a multi-device mesh (the reference's keyed shuffle,
@@ -1838,7 +1933,17 @@ class LocalExecutor:
                         f"exchange.mode must be auto|all_to_all|mask, "
                         f"got {mode!r}"
                     )
-                want_ex = ctx.n_shards > 1 and mode in ("auto", "all_to_all")
+                if graph is not None and mode == "all_to_all":
+                    raise stages_mod.StageGraphError(
+                        "exchange.mode=all_to_all is not supported with "
+                        "chained stage graphs — the identity re-key keeps "
+                        "fires shard-local, so the chained drain runs the "
+                        "replicate-and-mask route; unset exchange.mode"
+                    )
+                want_ex = (
+                    ctx.n_shards > 1 and mode in ("auto", "all_to_all")
+                    and graph is None
+                )
                 B_step[0] = (
                     ((B + ctx.n_shards - 1) // ctx.n_shards) * ctx.n_shards
                     if want_ex else B
@@ -1849,7 +1954,15 @@ class LocalExecutor:
                 )
                 build_fast = spillable and win.overflow and \
                     layout[0] != "direct"
-                if not want_ex or mode == "auto":
+                if graph is not None:
+                    # chained jobs dispatch ONLY through the chained
+                    # resident drain — a plain per-batch step would
+                    # advance stage 0 without feeding stage 1, so no
+                    # single-step kernel exists; the placeholder keeps
+                    # the route table (and the ingest plan's route
+                    # tuple) shaped like the single-stage path
+                    steps_by_route["mask"] = {"insert": None, "fast": None}
+                elif not want_ex or mode == "auto":
                     steps_by_route["mask"] = {
                         "insert": build_window_update_step(
                             ctx, spec, kg_fill=kg_stats_on,
@@ -1873,7 +1986,7 @@ class LocalExecutor:
                         ) if build_fast else None,
                     }
                     exchange_cap[0] = ex_insert.bucket_cap
-                if k_fuse > 1:
+                if k_fuse > 1 and graph is None:
                     # K-fused megasteps mirror the [route][tier] variant
                     # table for exactly the routes built above; partial
                     # groups fall back to the single steps (bit-identical
@@ -1925,7 +2038,48 @@ class LocalExecutor:
                                 insert=False, kg_fill=kg_stats_on,
                             ) if build_fast else None,
                         }
-                if use_resident:
+                if use_resident and graph is not None:
+                    # chained resident drain (round 16): ONE count-gated
+                    # scan advances EVERY stage — stage-N fire lanes are
+                    # re-keyed on device (cumsum+searchsorted+gather)
+                    # and applied to stage-N+1 inside the same scan, so
+                    # the whole chain costs one host dispatch per ring
+                    # drain. Insert tier only: the fast tier's miss
+                    # contract needs the overflow ring, which chained
+                    # jobs run without (strict capacity).
+                    ex_lanes = env.config.get(
+                        _CoreOpts.PIPELINE_STAGES_EXCHANGE_LANES
+                    )
+                    all_specs = (spec,) + tuple(chain_specs)
+                    residents_by_route["mask"] = {
+                        "insert": build_window_chained_drain(
+                            ctx, all_specs, ring_depth,
+                            kg_fill=kg_stats_on,
+                            exchange_lanes=ex_lanes,
+                        ),
+                        "fast": None,
+                    }
+                    if use_dp:
+                        shard_cap[0] = bucket_capacity(
+                            B_step[0], ctx.n_shards, dp_capf
+                        )
+                        residents_by_route["sharded"] = {
+                            "insert": build_window_chained_drain_sharded(
+                                ctx, all_specs, ring_depth,
+                                kg_fill=kg_stats_on,
+                                exchange_lanes=ex_lanes,
+                            ),
+                            "fast": None,
+                        }
+                        if self._job_group is not None:
+                            # same idempotent per-shard refusal gauges
+                            # as the single-stage sharded ring below
+                            for _s in range(ctx.n_shards):
+                                self._job_group.gauge(
+                                    f"ring_publish_refusals_shard_{_s}",
+                                    partial(_ring_refusals, _s),
+                                )
+                elif use_resident:
                     # resident ring-drain kernels (pipeline.resident-
                     # loop): ONE count-gated scan per route x tier
                     # serves EVERY fill level 1..ring_depth — the host
@@ -2063,8 +2217,17 @@ class LocalExecutor:
                                     f"drain_consume_latency_p{_q}_ms",
                                     partial(_dt_lat, "consume", float(_q)),
                                 )
-                fire_step = build_window_fire_step(ctx, spec)
-                if sink_device_reduce:
+                if graph is not None:
+                    # NO standalone fire step for chained jobs: a bare
+                    # fire sweep would consume stage-0 fires without
+                    # feeding them to stage 1. Every fire — steady state
+                    # and end-of-stream flush — goes through the chained
+                    # drain (drain_fires' chained branch dispatches
+                    # empty drain rounds to sweep out residual panes).
+                    fire_step = None
+                else:
+                    fire_step = build_window_fire_step(ctx, spec)
+                if sink_device_reduce and graph is None:
                     # a second compiled fire variant with NO key/value
                     # packing; the drain picks per-iteration (the spill
                     # tier may appear mid-job, forcing the full variant)
@@ -2103,6 +2266,10 @@ class LocalExecutor:
             ))
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
+                if graph is not None:
+                    chain_states[:] = [
+                        init_sharded_state(ctx, cs) for cs in chain_specs
+                    ]
                 # trigger ALL compiles NOW (inside any benchmark warmup)
                 # so neither the first pane-boundary fire nor the first
                 # insert->fast tier switch nor the first adaptive route
@@ -2176,15 +2343,17 @@ class LocalExecutor:
                 # warmup fired-megastep payloads: sentinel watermarks
                 # fire nothing, and warmup must not leave handles behind
                 fire_watch.clear()
-                with CompileEvents.stage("window-fire"):
-                    cf = run_fire(None)
-                    jax.block_until_ready(cf.counts)
-                    if fire_reduced_step is not None:
-                        rf = run_fire(None, reduced=True)
-                        jax.block_until_ready(rf.counts)
+                if fire_step is not None:
+                    with CompileEvents.stage("window-fire"):
+                        cf = run_fire(None)
+                        jax.block_until_ready(cf.counts)
+                        if fire_reduced_step is not None:
+                            rf = run_fire(None, reduced=True)
+                            jax.block_until_ready(rf.counts)
                 if env.config.get_bool("observability.compile-cost",
                                        False) \
-                        and self._job_group is not None:
+                        and self._job_group is not None \
+                        and graph is None:
                     # AOT cost_analysis of the primary update step (FLOPs
                     # / bytes accessed where the backend reports them);
                     # costs a second trace+compile, hence config-gated
@@ -2547,6 +2716,17 @@ class LocalExecutor:
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
+            if graph is not None:
+                # downstream stage states ride the aux blob, NOT the
+                # entries npz: incremental replay merges entries by
+                # (key, pane) across the manifest chain, which would
+                # collide rows from different stages. The chained
+                # drain's watermark coupling means a drain-boundary cut
+                # carries no in-flight edge payload — these full
+                # per-stage snapshots alone ARE the exactly-once cut.
+                aux["chain_stages"] = graph.snapshot_chain(
+                    chain_states, chain_specs
+                )
             # the APPLIED-offset cut (runtime/ingest.py): the prefetch
             # thread may have polled the source several batches ahead,
             # so the snapshot names the offsets of the last batch the
@@ -2861,12 +3041,23 @@ class LocalExecutor:
                 store.close()
             ovf_stores.clear()
             offsets = ingest.applied_offsets()
+            # downstream stage states re-bucket over the new mesh the
+            # same way: logical snapshot before the re-plan, restore
+            # against the re-planned chain_specs after setup()
+            ch_payload = (
+                graph.snapshot_chain(chain_states, chain_specs)
+                if graph is not None else None
+            )
             _replan_mesh(targets)
             setup(td.origin_ms, fresh_state=False)
             leftover = [] if win.overflow else None
             state = ckpt.restore_window_state(
                 entries, scalars, ctx, spec, leftover=leftover
             )
+            if graph is not None:
+                chain_states[:] = graph.restore_chain(
+                    ch_payload, ctx, chain_specs
+                )
             _seed_spill_leftover(leftover)
             # live-state divergence since the last durable cut has no
             # dirty bits anymore (the re-bucketed state restores with
@@ -2983,9 +3174,14 @@ class LocalExecutor:
                 # entries exist on no device shard — only the full
                 # rebuild's leftover path resurrects them) but not the
                 # kernel-warm full restore
+                # chained jobs always take the full re-stage: the splice
+                # only re-stages stage 0's dirty shards, but the cut's
+                # chain_stages snapshots replace EVERY downstream state
+                # wholesale — a spliced stage 0 paired with wholesale
+                # downstream restores would tear the watermark coupling
                 mode = (
                     "warm-splice"
-                    if not had_spill
+                    if not had_spill and graph is None
                     and _try_warm_splice(entries, scalars, cid)
                     else "warm-full"
                 )
@@ -2997,6 +3193,22 @@ class LocalExecutor:
                 state = ckpt.restore_window_state(
                     entries, scalars, ctx, spec, leftover=leftover
                 )
+                if graph is not None:
+                    if "chain_stages" not in aux:
+                        raise ValueError(
+                            "checkpoint carries no chain_stages payload "
+                            "but the job is a chained stage graph — "
+                            "restore with the matching pipeline"
+                        )
+                    chain_states[:] = graph.restore_chain(
+                        aux["chain_stages"], ctx, chain_specs
+                    )
+                elif aux.get("chain_stages"):
+                    raise ValueError(
+                        "checkpoint carries chained stage state but the "
+                        "job is single-stage — restore with the matching "
+                        "pipeline"
+                    )
             rec_tracker.mark_phase("stage", t_stage0)
             rec_tracker.set_mode(mode, cid)
             _seed_spill_leftover(leftover)
@@ -3069,6 +3281,11 @@ class LocalExecutor:
                 "state_layout": layout[0],
                 "sink_states": [s.snapshot_state() for s in pipe.all_sinks],
             }
+            if graph is not None:
+                # same aux-not-entries placement as the periodic cut
+                aux["chain_stages"] = graph.snapshot_chain(
+                    chain_states, chain_specs
+                )
             cid = (sp.latest() or 0) + 1
             # applied-offset cut, like periodic checkpoints: prefetched-
             # ahead batches are NOT part of the savepoint and replay on
@@ -3466,6 +3683,15 @@ class LocalExecutor:
                 else "insert"
             )
             active = tiers[tier]
+            if active is None:
+                # chained stage graphs register route placeholders only
+                # (every dispatch goes through the chained resident
+                # drain); reaching here means a dispatch path missed its
+                # chained branch — fail loudly, never silently drop
+                raise RuntimeError(
+                    f"no single-step kernel for route {route!r}: chained "
+                    f"stage jobs must dispatch via the resident drain"
+                )
             # chaos seam: a dying chip surfaces as a runtime error out
             # of the dispatch — the device_loss fault class injects
             # exactly there (no-op module-global check in production)
@@ -3741,14 +3967,28 @@ class LocalExecutor:
                     if getattr(active, "sharded_drain", False)
                     else np.int32(count)
                 )
-                res = active(state, *flat, wmv, cnt)
-                # telemetry-ON drains return a 4th element: the
-                # [n_shards, D, len(DRAIN_STAT_FIELDS)] flight-recorder
-                # payload. Its handle is kept every drain-stats-every-th
-                # drain only (the device computes it every drain; the
-                # host fetch cadence is the knob) and rides the lagged
-                # fire_watch channel — never a fresh sync
-                state, (ovf_handle, act_handle, kgf_handle), fires = res[:3]
+                if getattr(active, "chained_drain", False):
+                    # chained drain: donated state is the TUPLE of every
+                    # stage's state; fires are the FINAL stage's
+                    res = active(
+                        (state,) + tuple(chain_states), *flat, wmv, cnt
+                    )
+                    sts = res[0]
+                    state = sts[0]
+                    chain_states[:] = sts[1:]
+                    (ovf_handle, act_handle, kgf_handle), fires = \
+                        res[1], res[2]
+                else:
+                    res = active(state, *flat, wmv, cnt)
+                    # telemetry-ON drains return a 4th element: the
+                    # [n_shards, D, len(DRAIN_STAT_FIELDS)] flight-
+                    # recorder payload. Its handle is kept every
+                    # drain-stats-every-th drain only (the device
+                    # computes it every drain; the host fetch cadence is
+                    # the knob) and rides the lagged fire_watch channel
+                    # — never a fresh sync
+                    state, (ovf_handle, act_handle, kgf_handle), fires = \
+                        res[:3]
                 ds_h = None
                 if drain_stats_on:
                     ds_skip[0] += 1
@@ -4272,8 +4512,11 @@ class LocalExecutor:
                 )
             if len(v) == 0:
                 return 0
-            if wagg.result_fn is not None:
-                v = np.asarray(wagg.result_fn(v))
+            if emit_wagg.result_fn is not None:
+                # chained graphs surface the FINAL stage's fires, so the
+                # final stage's projection applies (emit_wagg == wagg
+                # for single-stage jobs)
+                v = np.asarray(emit_wagg.result_fn(v))
             metrics.fires += len(v)
             if columnar_emit:
                 kid = (khi.astype(np.uint64) << np.uint64(32)) | klo.astype(
@@ -4386,6 +4629,47 @@ class LocalExecutor:
                 phase_acc["emit"] += time.perf_counter() - t_f0
             return total
 
+        def drain_chained(wm_ms, t_cross=None):
+            """Chained-graph analog of drain_fires. There is NO
+            standalone fire step for a stage chain (a bare fire sweep
+            would consume stage-0 fires without feeding stage 1), so
+            residual due panes are flushed by dispatching EMPTY chained
+            drain rounds at the target watermark: each round fires up
+            to F window ends per stage and forwards them one edge down
+            inside the scan. ceil((ring + panes_per_window) / F) rounds
+            per stage plus one hop per edge bound the flush; steady-
+            state polls never reach the loop (in-scan fires ride the
+            lagged consume path, same as the single-stage resident
+            drain)."""
+            t_e0 = time.perf_counter()
+            # pending resident-pipeline payloads predate this flush
+            total = consume_fires(force=True)
+            if td is None or wm_ms is None:
+                phase_acc["emit"] += time.perf_counter() - t_e0
+                return total
+            fires_before = metrics.fires
+            route = (
+                "sharded" if "sharded" in residents_by_route else "mask"
+            )
+            rounds = len(chain_specs) + 1
+            for sp in (spec,) + tuple(chain_specs):
+                w = sp.win
+                rounds += -(
+                    -(w.ring + w.size_ticks // w.slide_ticks)
+                    // w.fires_per_step
+                )
+            for _ in range(rounds):
+                args, _, _ = _empty_fused_item(route)
+                run_update_resident(route, [(args, wm_ms, None)])
+            total += consume_fires(force=True)
+            if t_cross is not None:
+                metrics.record_fire_latency(
+                    metrics.fires - fires_before,
+                    (time.perf_counter() - t_cross) * 1e3,
+                )
+            phase_acc["emit"] += time.perf_counter() - t_e0
+            return total
+
         def drain_fires(wm_ms, t_cross=None):
             """Fire every due window end at watermark wm_ms. One fire step
             evaluates up to F window ends (+ up to F late re-fires); loop
@@ -4395,6 +4679,8 @@ class LocalExecutor:
             watermark crossing; every window emitted by this drain records
             (now - t_cross) as its fire latency (the p99 half of the
             north-star metric; ref WindowOperator.onEventTime drain)."""
+            if graph is not None:
+                return drain_chained(wm_ms, t_cross)
             dbg = os.environ.get("FLINK_TPU_DRAIN_DEBUG")
             t_e0 = time.perf_counter()
             # pending resident-pipeline payloads predate this drain's
@@ -4651,6 +4937,14 @@ class LocalExecutor:
                 res_cfg == "auto" and use_fused_fire and use_staging
                 and jax.default_backend() != "cpu"
             )
+            if graph is not None and res_cfg == "auto":
+                # a chained stage graph CANNOT run outside the resident
+                # drain (stage edges live inside the drain scan), so
+                # auto lights it up whenever the staging substrate
+                # exists — on every backend, with or without dispatch
+                # fusion; setup()'s check_runtime is the loud backstop
+                # when staging is off or resident-loop was forced off
+                use_resident = use_staging
         if use_resident:
             # the drain group IS the ring: accumulator capacity tracks
             # ring depth, and groups always hold fires (the drain fires
@@ -5026,7 +5320,7 @@ class LocalExecutor:
                 Bs = B_step[0]
                 for off in range(0, m, B):
                     hi_off = min(off + B, m)
-                    run_update(
+                    chunk = (
                         _pad(g_hi[off:hi_off], Bs, np.uint32),
                         _pad(g_lo[off:hi_off], Bs, np.uint32),
                         _pad(g_ticks[off:hi_off], Bs, np.int32),
@@ -5036,8 +5330,19 @@ class LocalExecutor:
                         ingest_mod.prefix_mask(
                             valid_tmpl[0], hi_off - off
                         ),
-                        g_wm if hi_off == m else None,
                     )
+                    wm_chunk = g_wm if hi_off == m else None
+                    if graph is not None:
+                        # no single-step kernel exists for a stage
+                        # chain: catch-up chunks ride the chained drain
+                        # as 1-slot dispatches on the replicate-and-
+                        # mask route (unrouted host arrays)
+                        c_args, _ = _stage_planned(chunk, "mask")
+                        run_update_resident(
+                            "mask", [(c_args, wm_chunk, None)]
+                        )
+                    else:
+                        run_update(*chunk, wm_chunk)
                 # catch-up slices must fire between groups or newer
                 # panes would evict older unfired ones from the ring
                 if catch_up:
@@ -5233,9 +5538,17 @@ class LocalExecutor:
             ck_io.close()
 
         if state is not None:
-            metrics.dropped_late = int(np.asarray(state.dropped_late).sum())
-            metrics.dropped_capacity = int(
-                np.asarray(state.dropped_capacity).sum()
+            # chained jobs fold every stage's counters in: an undersized
+            # inter-stage exchange (pipeline.stages.exchange-lanes)
+            # lands its drops in the DOWNSTREAM stage's
+            # dropped_capacity, so strict capacity surfaces it loudly
+            all_states = [state] + list(chain_states)
+            metrics.dropped_late = sum(
+                int(np.asarray(s.dropped_late).sum()) for s in all_states
+            )
+            metrics.dropped_capacity = sum(
+                int(np.asarray(s.dropped_capacity).sum())
+                for s in all_states
             )
             if metrics.dropped_capacity and self.env.config.get_bool(
                 "state.backend.strict-capacity", True
@@ -5243,8 +5556,10 @@ class LocalExecutor:
                 raise RuntimeError(
                     f"state backend over capacity: {metrics.dropped_capacity} "
                     f"records lost (raise state.backend.device.slots-per-shard "
-                    f"or the pane ring, or set state.backend.strict-capacity "
-                    f"to false to tolerate drops)"
+                    f"or the pane ring — for chained stage graphs also "
+                    f"pipeline.stages.exchange-lanes — or set "
+                    f"state.backend.strict-capacity to false to tolerate "
+                    f"drops)"
                 )
         return JobHandle(job_name, metrics, state=state, ctx=ctx)
 

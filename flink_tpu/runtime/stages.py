@@ -1,0 +1,419 @@
+"""Stage-graph planner: multi-stage keyed windowed DAGs.
+
+The executor historically ran exactly ONE keyed windowed stage per job
+(`_translate` collapsed a second keyBy->window pair onto the first and
+the leftover shape died much later in a deep NotImplementedError). This
+module is the planning half of the round-16 chained-drain subsystem:
+
+  * ``StageGraph.from_pipeline`` collects the ordered
+    (KeyByTransformation, WindowAggTransformation) pairs off the
+    translated spine and validates the chain SHAPE at setup time — every
+    unsupported form raises :class:`StageGraphError` naming the exact
+    edge, before any state is allocated or kernel compiled.
+  * ``plan_reduces`` / ``plan_specs`` own the per-stage ``ReduceSpec``s
+    and downstream ``WindowStageSpec``s (ring sizing, shared key
+    layout). Interior stages inherit the upstream key codec unchanged:
+    the on-device edge re-keys fires by IDENTITY (the fired 64-bit key
+    ids flow straight into the next stage's table), so one host-side
+    codec decodes every stage's emissions and a stage-0 ``direct``
+    layout remains valid downstream.
+  * ``snapshot_chain`` / ``restore_chain`` are the checkpoint cut for
+    stages 1..N-1: full logical snapshots that ride the checkpoint's
+    aux payload. They are deliberately NOT merged into the incremental
+    entries channel — ``replay_chain`` merges entries across a chain by
+    (key, pane) and stage-2 rows would collide with stage-1 rows.
+
+The execution half lives in ``runtime/step.py``
+(``build_window_chained_drain[_sharded]``): stage-N fire lanes are
+packed on device (cumsum + searchsorted + gather — the
+``_pack_fire_lanes`` seam) and applied to stage N+1's update inside the
+same count-gated drain scan, so an N-stage pipeline still costs one
+host dispatch per ring drain. Because the re-key is the identity, fires
+stay on their owning shard and the sharded route needs no collective on
+the edge.
+
+Exactly-once across the edge needs no in-flight lane payload in the
+cut: the chained watermark coupling (``_chain_stage_watermark``) holds
+stage N+1's watermark below ``(fired_through_N + 2) * slide_N - 2``, so
+every future stage-N fire lands strictly before stage N+1's lateness
+horizon — replaying the upstream ring after restore regenerates exactly
+the edge traffic the crash lost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import numpy as np
+
+from flink_tpu.graph import stream_graph as sg
+
+
+class StageGraphError(ValueError):
+    """A multi-keyed-stage pipeline shape the chained drain cannot run.
+
+    Raised at SETUP time by StageGraph validation with the offending
+    edge named — replacing the deep, late NotImplementedError the
+    single-stage executor used to throw after silently collapsing the
+    extra stages."""
+
+
+class _Probe:
+    """Stand-in WindowResult for probing downstream selectors/extractors."""
+
+    __slots__ = ("key", "window_end_ms", "value")
+
+    def __init__(self, key, value):
+        self.key = key
+        self.window_end_ms = 0
+        self.value = value
+
+
+@dataclasses.dataclass
+class Stage:
+    """One keyed windowed stage of the chain (stage 0 = ingest stage)."""
+
+    index: int
+    key_by: Optional[sg.KeyByTransformation]
+    wagg: sg.WindowAggTransformation
+
+    @property
+    def name(self) -> str:
+        return f"stage[{self.index}]"
+
+    @property
+    def size_ms(self) -> int:
+        return self.wagg.assigner.size_ms
+
+    @property
+    def slide_ms(self) -> int:
+        return self.wagg.assigner.slide_ms
+
+
+class StageGraph:
+    """Validated, topologically ordered chain of keyed windowed stages.
+
+    The spine translation already linearizes the DAG (divergence is
+    only legal in trailing stateless chains), so topological order is
+    list order; ``edges()`` yields consecutive pairs."""
+
+    def __init__(self, stages: List[Stage]):
+        if len(stages) < 2:
+            raise StageGraphError(
+                "a StageGraph needs at least 2 keyed stages; single-stage "
+                "jobs take the direct windowed path"
+            )
+        self.stages = stages
+        self._reduces: Optional[List[Any]] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_pipeline(cls, pipe) -> "StageGraph":
+        """Build + shape-validate the graph off a translated pipeline.
+
+        ``pipe.window_agg``/``pipe.key_by`` is stage 0; ``pipe.stages``
+        carries the downstream (key_by, wagg) pairs in spine order."""
+        if pipe.window_agg is None:
+            raise StageGraphError(
+                "multi-stage chain has no stage[0] window aggregation "
+                "(a downstream keyBy→window pair needs an upstream "
+                "windowed stage to consume)"
+            )
+        stages = [Stage(0, pipe.key_by, pipe.window_agg)]
+        for i, (kb, wagg) in enumerate(pipe.stages, start=1):
+            if wagg is None:
+                raise StageGraphError(
+                    f"stage[{i}] has a keyBy with no window aggregation — "
+                    f"a downstream keyed stream must end in a window agg "
+                    f"(rolling reduces / process functions cannot chain "
+                    f"after a windowed stage yet)"
+                )
+            stages.append(Stage(i, kb, wagg))
+        g = cls(stages)
+        g.validate()
+        return g
+
+    # ------------------------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return len(self.stages)
+
+    def edges(self):
+        for up, down in zip(self.stages, self.stages[1:]):
+            yield up, down
+
+    def _edge(self, up: Stage, down: Stage) -> str:
+        return f"edge {up.name}->{down.name}"
+
+    # ------------------------------------------------------------------
+    def validate(self) -> None:
+        """Shape validation: every unsupported form names its edge."""
+        from flink_tpu.datastream.window.assigners import (
+            CountWindowAssigner, GlobalWindows,
+        )
+
+        for st in self.stages:
+            a = st.wagg.assigner
+            where = (st.name if st.index == 0
+                     else self._edge(self.stages[st.index - 1], st))
+            if isinstance(a, GlobalWindows):
+                raise StageGraphError(
+                    f"{where}: GlobalWindows cannot participate in a "
+                    f"chained stage graph (the generic host window "
+                    f"operator runs single-stage only)"
+                )
+            if isinstance(a, CountWindowAssigner):
+                raise StageGraphError(
+                    f"{where}: count windows cannot participate in a "
+                    f"chained stage graph (count stages run on the host "
+                    f"path, single-stage only)"
+                )
+            if getattr(a, "is_session", False):
+                raise StageGraphError(
+                    f"{where}: session windows cannot participate in a "
+                    f"chained stage graph (sessions run on the host "
+                    f"merge path, single-stage only)"
+                )
+            if not getattr(a, "is_event_time", False):
+                raise StageGraphError(
+                    f"{where}: chained stages require event-time "
+                    f"tumbling/sliding windows"
+                )
+            if (st.wagg.trigger is not None or st.wagg.evictor is not None
+                    or st.wagg.window_fn is not None):
+                raise StageGraphError(
+                    f"{where}: custom trigger/evictor/window function "
+                    f"routes to the generic host operator, which is "
+                    f"single-stage only"
+                )
+            if st.wagg.allowed_lateness_ms:
+                raise StageGraphError(
+                    f"{where}: allowed lateness is unsupported in a "
+                    f"chained stage graph — a late re-fire would re-emit "
+                    f"the corrected window into the downstream stage and "
+                    f"double-count it"
+                )
+
+        for up, down in self.edges():
+            e = self._edge(up, down)
+            if up.wagg.result_fn is not None:
+                raise StageGraphError(
+                    f"{e}: {up.name} has a result_fn — host-side result "
+                    f"extraction cannot run on an interior edge (fires "
+                    f"feed the next stage on device); only the final "
+                    f"stage may declare one"
+                )
+            if down.wagg.value_prep is not None:
+                raise StageGraphError(
+                    f"{e}: {down.name} has a value_prep — host-side "
+                    f"value prep cannot run on an interior edge (the "
+                    f"edge carries device fire values directly)"
+                )
+            self._probe_edge(up, down)
+
+        reduces = self.plan_reduces()
+        for up, down in self.edges():
+            e = self._edge(up, down)
+            r_up, r_down = reduces[up.index], reduces[down.index]
+            if r_up.kind == "sketch" or r_down.kind == "sketch":
+                raise StageGraphError(
+                    f"{e}: sketch reduces cannot sit on a chained edge — "
+                    f"register planes are not rollup-able values"
+                )
+            if tuple(r_down.value_shape) != tuple(r_up.out_shape):
+                raise StageGraphError(
+                    f"{e}: {down.name} consumes values of shape "
+                    f"{tuple(r_down.value_shape)} but {up.name} fires "
+                    f"shape {tuple(r_up.out_shape)}"
+                )
+            if np.dtype(r_down.dtype) != np.dtype(r_up.out_dtype):
+                raise StageGraphError(
+                    f"{e}: {down.name} consumes dtype "
+                    f"{np.dtype(r_down.dtype).name} but {up.name} fires "
+                    f"{np.dtype(r_up.out_dtype).name}"
+                )
+
+    def _probe_edge(self, up: Stage, down: Stage) -> None:
+        """The device edge re-keys by identity and forwards the fire
+        value verbatim — the downstream selector/extractor must agree
+        (``lambda r: r.key`` / ``lambda r: r.value`` shapes). Probed
+        with sentinel objects so a non-conforming lambda fails loudly
+        at setup instead of silently computing something else than the
+        host-chained semantics."""
+        e = self._edge(up, down)
+        k_mark, v_mark = object(), object()
+        probe = _Probe(k_mark, v_mark)
+        try:
+            sel = down.key_by.key_selector(probe)
+        except Exception as exc:
+            raise StageGraphError(
+                f"{e}: {down.name}'s key selector failed on a "
+                f"WindowResult probe ({exc!r}) — the chained edge "
+                f"re-keys by the upstream window key, so the selector "
+                f"must be key-preserving (r.key)"
+            ) from exc
+        if sel is not k_mark:
+            raise StageGraphError(
+                f"{e}: {down.name}'s key selector does not preserve the "
+                f"upstream key — the device edge re-keys fires by "
+                f"identity, so only `r.key` selectors are supported"
+            )
+        if down.wagg.extractor is not None:
+            try:
+                val = down.wagg.extractor(probe)
+            except Exception as exc:
+                raise StageGraphError(
+                    f"{e}: {down.name}'s value extractor failed on a "
+                    f"WindowResult probe ({exc!r}) — the edge carries "
+                    f"the fire value verbatim, so the extractor must be "
+                    f"`r.value`"
+                ) from exc
+            if val is not v_mark:
+                raise StageGraphError(
+                    f"{e}: {down.name}'s value extractor does not pass "
+                    f"the upstream fire value through — the device edge "
+                    f"forwards it verbatim, so only `r.value` "
+                    f"extractors are supported"
+                )
+
+    # ------------------------------------------------------------------
+    def check_runtime(self, *, use_resident: bool, overflow_lanes: int,
+                      drain_stats: bool, reduced_fires: bool,
+                      max_stages: int) -> None:
+        """Config-dependent validation, called from the executor's
+        setup once the pipeline knobs are resolved."""
+        if self.depth > max_stages:
+            raise StageGraphError(
+                f"stage chain depth {self.depth} exceeds "
+                f"pipeline.stages.max-stages={max_stages}"
+            )
+        if not use_resident:
+            raise StageGraphError(
+                "a chained stage graph requires the resident drain loop "
+                "(pipeline.resident-loop must not resolve to off, and "
+                "prefetch/device staging must be available) — the edge "
+                "exists only inside the drain scan"
+            )
+        if overflow_lanes:
+            raise StageGraphError(
+                "the overflow/spill ring is unsupported in a chained "
+                "stage graph (spill merges host-side at emission; "
+                "interior stages never emit host-side) — set "
+                "state.overflow-ring-lanes=0"
+            )
+        if drain_stats:
+            raise StageGraphError(
+                "the drain flight recorder does not instrument chained "
+                "drains yet — set observability.drain-stats=false for "
+                "multi-stage jobs"
+            )
+        if reduced_fires:
+            raise StageGraphError(
+                "device-reduced fire emission (device_reduce sinks) is "
+                "unsupported in a chained stage graph — the final "
+                "stage's fires emit on the standard compact path"
+            )
+
+    # ------------------------------------------------------------------
+    def plan_reduces(self) -> List[Any]:
+        """Per-stage ReduceSpecs, built once (factories may close over
+        mutable user state; calling them once mirrors single-stage
+        setup)."""
+        if self._reduces is None:
+            self._reduces = [s.wagg.reduce_spec_factory()
+                             for s in self.stages]
+        return self._reduces
+
+    def plan_specs(self, base_spec, drain_depth: int = 1) -> List[Any]:
+        """Downstream WindowStageSpecs (stages 1..N-1), derived from the
+        resolved stage-0 spec: same capacity/probe/layout (identity
+        re-key ⇒ same key population and the same direct-index
+        contract), precombine/packed off (edge batches are a few fire
+        lanes; the shared-sort and packed-plane seams buy nothing
+        there).
+
+        Ring sizing: a downstream stage advances ONCE per drain (the
+        chained drain's stage tail), so between advances it must hold
+        every pane between its purge horizon and the newest pane a
+        just-fired upstream window can land in. A whole drain's worth
+        of stage-0 slots fires at most ``drain_depth * F`` upstream
+        pane-ends spanning ``drain_depth * F * slide_up`` ticks beyond
+        the coupled watermark (the catch-up worst case), on top of the
+        usual 2*panes_per_window live span. Ring rows are [C]-sized
+        pane planes and the fire eval is O(F * panes_per_window * C) —
+        independent of ring length — so the wider ring costs memory,
+        not steady-state time."""
+        from flink_tpu import ops as _ops  # noqa: F401 (kernel import root)
+        from flink_tpu.ops import window_kernels as wk
+        from flink_tpu.runtime.step import WindowStageSpec
+
+        reduces = self.plan_reduces()
+        specs = []
+        for up, down in self.edges():
+            size_t, slide_t = down.size_ms, down.slide_ms
+            ppw = size_t // slide_t
+            f_up = base_spec.win.fires_per_step
+            depth = max(1, int(drain_depth))
+            slack = (depth * f_up * up.slide_ms) // slide_t + 2
+            ring = max(8, 2 * ppw + slack, ppw + 3)
+            win = wk.WindowSpec(
+                size_ticks=size_t, slide_ticks=slide_t, ring=ring,
+                fires_per_step=base_spec.win.fires_per_step,
+                lateness_ticks=0, overflow=0,
+            )
+            specs.append(WindowStageSpec(
+                win, reduces[down.index],
+                capacity_per_shard=base_spec.capacity_per_shard,
+                probe_len=base_spec.probe_len,
+                layout=base_spec.layout,
+                precombine=False, packed=False,
+            ))
+        return specs
+
+    # ------------------------------------------------------------------
+    # checkpoint cut for stages 1..N-1 (rides the aux payload)
+    def snapshot_chain(self, states, specs) -> List[dict]:
+        """Full logical snapshots of the downstream stage states, taken
+        at the drain boundary (the same cut point as stage 0's). The
+        payload is small — C keys x ring panes per stage — so the sync
+        fetch rides the checkpoint's SYNC phase like source offsets."""
+        from flink_tpu.runtime import checkpoint as ckpt
+
+        out = []
+        for st, sp in zip(states, specs):
+            entries, scalars = ckpt.snapshot_window_state(
+                st, sp.win, red=sp.red
+            )
+            out.append({
+                "entries": entries, "scalars": scalars,
+                "size_ticks": int(sp.win.size_ticks),
+                "slide_ticks": int(sp.win.slide_ticks),
+            })
+        return out
+
+    def restore_chain(self, payload, ctx, specs) -> List[Any]:
+        """aux['chain_stages'] -> device states for stages 1..N-1."""
+        from flink_tpu.runtime import checkpoint as ckpt
+
+        if payload is None or len(payload) != len(specs):
+            have = 0 if payload is None else len(payload)
+            raise ValueError(
+                f"checkpoint carries {have} chained stage snapshot(s) "
+                f"but the job declares {len(specs)} downstream stage(s) "
+                f"— the stage graph changed across restore"
+            )
+        states = []
+        for i, (ch, sp) in enumerate(zip(payload, specs), start=1):
+            if (int(ch["size_ticks"]) != int(sp.win.size_ticks)
+                    or int(ch["slide_ticks"]) != int(sp.win.slide_ticks)):
+                raise ValueError(
+                    f"stage[{i}] window changed across restore: "
+                    f"checkpoint has size/slide "
+                    f"{ch['size_ticks']}/{ch['slide_ticks']} ticks, job "
+                    f"declares {sp.win.size_ticks}/{sp.win.slide_ticks}"
+                )
+            states.append(ckpt.restore_window_state(
+                ch["entries"], ch["scalars"], ctx, sp
+            ))
+        return states

@@ -1192,6 +1192,427 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     return drain
 
 
+def _chain_fires_to_lanes(cf, n_lanes: int):
+    """Re-key CompactFires into the NEXT stage's input lanes (the
+    inter-stage edge of the chained drain, ISSUE 16): every fired
+    (key, window) pair becomes one record keyed by the SAME key with
+    event time ``window_end - 1`` — the newest instant the fired window
+    covers, so a multi-level rollup lands each upstream result in
+    exactly the downstream pane its window closed in (the reference's
+    re-keyed DataStream between two WindowOperators).
+
+    Accepts one slot's fires ([F, C] planes) or a whole drain's STACKED
+    fires ([D, F, C]): leading axes flatten into a single plane list,
+    so one pass packs an entire drain's upstream output — the shape the
+    per-drain stage tail (_chained_stage_tail) feeds it.
+
+    Compaction exploits that CompactFires lanes are already PREFIX-
+    packed per plane (live lanes are the first ``counts[f]`` of plane
+    ``f``), so the edge never touches the payload wholesale: a cumsum
+    over the per-plane counts gives plane offsets, a searchsorted over
+    those offsets maps each of the ``n_lanes`` output slots to its
+    (plane, lane) source, and three O(E) gathers pull the rows — no
+    sort, no scatter, nothing proportional to F*C, so the edge adds
+    nothing to the op-budget ledger's scatter/sort counts and stays
+    cheap at large capacities. Lanes beyond ``n_lanes`` (an over-full
+    edge) are counted in ``dropped`` so the executor's strict-capacity
+    accounting sees them; identity re-keying keeps every fired key in
+    its owning shard's key-group range, so the packed lanes feed the
+    local next-stage update with ZERO collectives."""
+    C = int(cf.key_hi.shape[-1])
+    Pn = 1
+    for d in cf.counts.shape:
+        Pn *= int(d)
+    counts = cf.counts.reshape(Pn)
+    lane_valid = cf.lane_valid.reshape(Pn)
+    ends = cf.window_end_ticks.reshape(Pn)
+    key_hi = cf.key_hi.reshape(Pn, C)
+    key_lo = cf.key_lo.reshape(Pn, C)
+    out_shape = tuple(cf.values.shape[cf.counts.ndim + 1:])
+    values = cf.values.reshape((Pn, C) + out_shape)
+    E = int(n_lanes)
+    live_counts = jnp.where(
+        lane_valid, jnp.minimum(counts, jnp.int32(C)), jnp.int32(0)
+    )
+    offs = jnp.cumsum(live_counts)
+    total = offs[-1]
+    starts = offs - live_counts
+    ar = jnp.arange(E, dtype=jnp.int32)
+    f_sel = jnp.clip(jnp.searchsorted(offs, ar + 1), 0, Pn - 1)
+    idx = jnp.clip(ar - starts[f_sel], 0, C - 1)
+    ok = ar < total
+    hi = jnp.where(ok, key_hi[f_sel, idx], jnp.uint32(0))
+    lo = jnp.where(ok, key_lo[f_sel, idx], jnp.uint32(0))
+    ts = jnp.where(ok, ends[f_sel] - jnp.int32(1), jnp.int32(0))
+    okv = ok.reshape((E,) + (1,) * len(out_shape))
+    vals = jnp.where(okv, values[f_sel, idx], jnp.zeros((), values.dtype))
+    dropped = jnp.maximum(total - jnp.int32(E), 0)
+    return hi, lo, ts, vals, ok, dropped
+
+
+def _chain_stage_watermark(up_wm, up_state, up_spec: WindowStageSpec):
+    """Downstream watermark for the stage fed by ``up_state``'s fires.
+
+    The upstream stage has fired panes through ``fired_through``; every
+    FUTURE fire comes from a pane > fired_through, whose re-keyed record
+    carries ts = (pane + 1) * slide - 1 >= (fired_through + 2) * slide
+    - 1. Capping the downstream watermark at that horizon minus one
+    guarantees no inter-stage record is ever late at the next stage —
+    the stage tail inserts the whole drain's edge records BEFORE its
+    single advance, and the cap is monotone in ``fired_through``, so
+    records arriving in a LATER drain also beat this drain's cap. The
+    outer min keeps the job watermark contract: a downstream window
+    never closes past what the source watermark allows."""
+    slide = int(up_spec.win.slide_ticks)
+    # fired_through jumps to the WATERMARK pane once the upstream
+    # backlog clears (end-of-stream flush: ~2^31/slide), so the
+    # horizon multiply must clamp first or it wraps int32 negative and
+    # pins the downstream watermark below the final windows forever
+    ft_cap = (2**31 - 4) // slide - 2
+    ft = jnp.clip(up_state.fired_through, jnp.int32(-1), jnp.int32(ft_cap))
+    horizon = (ft + 2) * jnp.int32(slide) - 2
+    return jnp.minimum(up_wm, horizon)
+
+
+def _chained_slot_body(stage0, spec0, kg_start, kg_end, maxp, s_hi, s_lo,
+                       s_ts, s_vals, s_valid, s_wm, insert, kg_fill):
+    """One live slot of the chained drain's stage-0 scan: consume the
+    staged batch exactly like the single-stage resident body and emit
+    this slot's CompactFires for the scan to stack. Downstream stages
+    deliberately do NOT run here — they run ONCE per drain over the
+    stacked fires (_chained_stage_tail), which is the chained drain's
+    whole cost model."""
+    st, pend = stage0
+    st, act, kgf = mask_update_shard(
+        st, spec0, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+        s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+        clear_rows=pend,
+    )
+    st, pend, cf = wk.advance_and_fire_resident(
+        st, spec0.win, spec0.red, s_wm
+    )
+    return (st, pend), (act, kgf, cf)
+
+
+def _chained_stage_tail(down_states, specs, st0, cf_stack, wm_last,
+                        kg_start, kg_end, maxp, exchange_lanes):
+    """Downstream stages of the chained drain, ONCE per drain — not
+    once per slot. The whole drain's stacked stage-0 fires pack into a
+    single ``exchange_lanes``-wide edge (_chain_fires_to_lanes over the
+    [D, F, C] stack), feed ONE update and ONE advance-and-fire at the
+    coupled watermark, and each further stage repeats the pattern on
+    its upstream's single fire set.
+
+    Correct because every insert precedes the stage's single advance
+    (no window can close before receiving all of this drain's records
+    for it), and the watermark coupling (_chain_stage_watermark) still
+    guarantees across drains that no future upstream fire is late
+    downstream. Fires only become host-visible after the dispatch
+    returns, so deferring the downstream advance to the drain boundary
+    changes no observable timing — but it changes the cost model
+    completely: a second stage adds one E-lane update + one advance
+    per D-slot drain instead of D of each (plus D per-slot state
+    copies through the fire gate). The <15%-overhead acceptance
+    criterion of ISSUE 16 lives here.
+
+    Returns ``(down_states', final_fires)`` with ``final_fires`` a
+    1-slot stacked CompactFires ([1, F, C] leaves) when the chain has
+    a downstream stage — the executor's consume path reads the slot
+    dimension from the payload shape, so the narrower stack needs no
+    host-side change."""
+    import dataclasses as _dc
+
+    out = []
+    up_state, up_fires, wm_up = st0, cf_stack, wm_last
+    for j in range(1, len(specs)):
+        wm_j = _chain_stage_watermark(wm_up, up_state, specs[j - 1])
+        c_hi, c_lo, c_ts, c_vals, c_ok, c_drop = _chain_fires_to_lanes(
+            up_fires, exchange_lanes
+        )
+        st_j = down_states[j - 1]
+        # downstream stages always insert: their key population arrives
+        # through the edge, never through the ingest-staged batch the
+        # fast (lookup-only) tier models
+        st_j, _act_j, _kgf_j = mask_update_shard(
+            st_j, specs[j], kg_start, kg_end, c_hi, c_lo, c_ts,
+            c_vals, c_ok, wm_j, maxp, insert=True, kg_fill=False,
+        )
+        # an over-full edge drops the overflow lanes; fold them into
+        # the receiving stage's capacity-drop counter so the executor's
+        # strict-capacity accounting (and the drop metrics) see them
+        st_j = _dc.replace(
+            st_j, dropped_capacity=st_j.dropped_capacity + c_drop
+        )
+        st_j, pend_j, cf_j = wk.advance_and_fire_resident(
+            st_j, specs[j].win, specs[j].red, wm_j
+        )
+        # one purge sweep per drain (instead of deferring into a next
+        # update's ring reset — there is no next update this dispatch)
+        st_j = wk.apply_pending_purge(
+            st_j, specs[j].win, specs[j].red, pend_j
+        )
+        out.append(st_j)
+        up_state, wm_up = st_j, wm_j
+        up_fires = jax.tree_util.tree_map(lambda x: x[None], cf_j)
+    return tuple(out), up_fires
+
+
+def build_window_chained_drain(ctx: MeshContext,
+                               specs: Sequence[WindowStageSpec],
+                               depth: int, insert: bool = True,
+                               kg_fill: bool = False,
+                               exchange_lanes: int = 1024):
+    """Multi-stage resident ring drain (stage-graph subsystem, ISSUE
+    16): ONE jitted dispatch consumes up to ``depth`` staged ring slots
+    through a CHAIN of keyed window stages — stage 0 applies the staged
+    batch exactly like build_window_resident_drain's body (the same
+    count-gated slot scan), stacking each slot's CompactFires; then
+    each downstream stage runs ONCE per drain (_chained_stage_tail):
+    the whole stack of upstream fires is re-keyed on device
+    (_chain_fires_to_lanes: a cumsum+searchsorted+gather pack over the
+    stacked fire planes) and applied in one update + one
+    advance-and-fire at the coupled watermark. A keyBy→window→keyBy→
+    window pipeline (sessionize→aggregate, multi-level rollup)
+    therefore still costs one host dispatch per ring drain — the
+    Hazelcast-Jet saturation criterion the ISSUE names: chaining must
+    not reintroduce per-stage host round trips — and the second stage
+    adds one edge pack + E-lane update + advance per DRAIN, not per
+    slot (fires only become host-visible when the dispatch returns, so
+    the deferral changes no observable timing).
+
+    Inter-stage edge: identity re-key. A fired key keeps its key bits,
+    so it hashes to the same key group and stays on its owning shard —
+    the per-shard exchange is a local pack, no all_to_all, and the
+    sharded variant keeps its zero-collective body. ``exchange_lanes``
+    bounds the PER-DRAIN edge width (pipeline.stages.exchange-lanes —
+    size it at distinct keys x panes closing per drain); overflow
+    lanes count into the downstream stage's dropped_capacity so a
+    too-narrow edge is loudly visible, never silent.
+
+    Watermark coupling: stage j+1 advances to ``min(upstream wm,
+    (fired_through_j + 2) * slide_j - 2)`` (_chain_stage_watermark) so
+    no future upstream fire can be late downstream — the exactly-once
+    cut at a drain boundary then needs no in-flight edge payload: every
+    fire the upstream state counts as fired has been folded into the
+    downstream state within the same dispatch.
+
+    Signature: ``drain(states, hi_0, lo_0, ticks_0, values_0, valid_0,
+    ..., wmv, count)`` — ``states`` a TUPLE of per-stage stacked window
+    states (donated as one buffer set), batch operands exactly as
+    build_window_resident_drain. Returns ``(states', (ovf_n, activity,
+    kg_fill), fires)`` with ``fires`` the FINAL stage's CompactFires
+    stacked [n_shards, 1] (one tail advance per drain) — the
+    executor's lagged consume_fires path reads the slot dimension from
+    the payload shape, so the chain's output needs no host change."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(depth)
+    specs = tuple(specs)
+
+    def shard_body(states, kg_start, kg_end, count, hi, lo, ts, values,
+                   valid, wm):
+        states = jax.tree_util.tree_map(lambda x: x[0], states)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        carry0 = (states[0], jnp.zeros(specs[0].win.ring, bool))
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(op):
+                return _chained_slot_body(
+                    op, specs[0], kg_start, kg_end, maxp, s_hi, s_lo,
+                    s_ts, s_vals, s_valid, s_wm, insert, kg_fill,
+                )
+
+            def skip(op):
+                kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+                return op, (jnp.zeros((), jnp.int32), kgf,
+                            _zero_slot_fires(specs[0], False))
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        wm_vec = wm[0]
+        carry, ys = jax.lax.scan(
+            sub, carry0,
+            (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
+             wm_vec),
+        )
+        acts, kgfs, cf_stack = ys
+        st0 = wk.apply_pending_purge(
+            carry[0], specs[0].win, specs[0].red, carry[1]
+        )
+        # effective drain watermark: MAX over LIVE slots — update-only
+        # slots (and the dispatch pad) carry the MIN-int "no watermark"
+        # sentinel, so the last slot is not necessarily the target
+        live_mask = jnp.arange(D, dtype=jnp.int32) < count
+        wm_last = jnp.max(jnp.where(
+            live_mask, wm_vec, jnp.int32(-(2**31) + 1)
+        ))
+        down, fires = _chained_stage_tail(
+            states[1:], specs, st0, cf_stack, wm_last, kg_start,
+            kg_end, maxp, exchange_lanes,
+        )
+        states = (st0,) + down
+        ovf_n = states[0].ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(states), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(),                       # count: replicated scalar cursor
+            P(), P(), P(), P(), P(),   # [D, B] batch stacks, replicated
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(states, *flat):
+        *batches, wmv, count = flat
+        stacks = _fused_batch_stack(D, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            states, starts, ends, jnp.asarray(count, jnp.int32),
+            *stacks, wmv,
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.chained_drain = True
+    drain.n_stages = len(specs)
+    drain.exchange_lanes = int(exchange_lanes)
+    drain.fused_fire = True
+    drain.fused_fire_reduced = False
+    drain.drain_stats = False
+    return drain
+
+
+def build_window_chained_drain_sharded(ctx: MeshContext,
+                                       specs: Sequence[WindowStageSpec],
+                                       depth: int, insert: bool = True,
+                                       kg_fill: bool = False,
+                                       exchange_lanes: int = 1024):
+    """Data-parallel chained drain: the multi-stage chain of
+    build_window_chained_drain lowered over build_window_sharded_drain's
+    shard-local geometry — per-shard pre-routed lane slices, per-shard
+    count VECTOR, and still ZERO cross-chip collectives in the body:
+    the identity re-key keeps every inter-stage record on the shard
+    that fired it (same key → same key group → same owner), so the
+    chained edge is a local pack and divergent per-shard counts stay
+    safe exactly as in the single-stage sharded drain."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(depth)
+    specs = tuple(specs)
+
+    def shard_body(states, kg_start, kg_end, counts, hi, lo, ts, values,
+                   valid, wm):
+        states = jax.tree_util.tree_map(lambda x: x[0], states)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        count = counts[0]          # this shard's OWN fill level
+        carry0 = (states[0], jnp.zeros(specs[0].win.ring, bool))
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(op):
+                return _chained_slot_body(
+                    op, specs[0], kg_start, kg_end, maxp, s_hi, s_lo,
+                    s_ts, s_vals, s_valid, s_wm, insert, kg_fill,
+                )
+
+            def skip(op):
+                kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+                return op, (jnp.zeros((), jnp.int32), kgf,
+                            _zero_slot_fires(specs[0], False))
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        wm_vec = wm[0]
+        carry, ys = jax.lax.scan(
+            sub, carry0,
+            # [D, 1, cap] per-shard batch stacks squeeze the split axis
+            (jnp.arange(D, dtype=jnp.int32), hi[:, 0], lo[:, 0],
+             ts[:, 0], values[:, 0], valid[:, 0], wm_vec),
+        )
+        acts, kgfs, cf_stack = ys
+        st0 = wk.apply_pending_purge(
+            carry[0], specs[0].win, specs[0].red, carry[1]
+        )
+        # per-shard effective drain watermark: MAX over this shard's
+        # LIVE slots (divergent counts are safe — each shard's tail
+        # advances under its own target, same as the per-slot scan)
+        live_mask = jnp.arange(D, dtype=jnp.int32) < count
+        wm_last = jnp.max(jnp.where(
+            live_mask, wm_vec, jnp.int32(-(2**31) + 1)
+        ))
+        down, fires = _chained_stage_tail(
+            states[1:], specs, st0, cf_stack, wm_last, kg_start,
+            kg_end, maxp, exchange_lanes,
+        )
+        states = (st0,) + down
+        ovf_n = states[0].ovf_n
+        act = jnp.sum(acts)
+        kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        return (
+            pack(states), ovf_n[None], act[None], kgf[None], pack(fires),
+        )
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS),             # counts: per-shard fill levels
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(states, *flat):
+        *batches, wmv, counts = flat
+        stacks = _fused_batch_stack(D, batches)
+        st, ovf_n, act, kgf, fires = sharded(
+            states, starts, ends, jnp.asarray(counts, jnp.int32),
+            *stacks, wmv,
+        )
+        return st, (ovf_n, act, kgf), fires
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.sharded_drain = True
+    drain.chained_drain = True
+    drain.n_stages = len(specs)
+    drain.exchange_lanes = int(exchange_lanes)
+    drain.fused_fire = True
+    drain.fused_fire_reduced = False
+    drain.drain_stats = False
+    return drain
+
+
 def build_window_fire_step(ctx: MeshContext, spec: WindowStageSpec):
     """Fire-only half: advance the watermark, evaluate due window ends for
     the whole key population, and return device-compacted fires
@@ -1543,6 +1964,8 @@ AUDIT_K_STEPS = 2
 # depth - 1, so BOTH branches appear in the traced program), small
 # enough to stay inside the lint tier's wall-time budget
 AUDIT_RING_DEPTH = 4
+# per-slot inter-stage edge width for the audited chained-drain chain
+AUDIT_EXCHANGE_LANES = 16
 
 
 @dataclass(frozen=True)
@@ -1673,6 +2096,22 @@ def kernel_family_grid():
         F("step.sharded_drain.hash.d4.dstats", build_window_sharded_drain,
           "sharded_drain", route="sharded", k_steps=AUDIT_RING_DEPTH,
           drain_stats=True),
+        # the multi-stage chained drain (ISSUE 16): stage-N fires
+        # re-keyed on device into stage-N+1's update inside the same
+        # count-gated scan. The edge is gather-only (_chain_fires_to
+        # _lanes) — a sort or scatter creeping into it is exactly the
+        # structural drift the op-budget ledger exists to catch, and
+        # the sharded variant stays collective-free (no-host-crossing)
+        F("step.chained_drain.mask.hash.d4.s2",
+          build_window_chained_drain,
+          "chained_drain", k_steps=AUDIT_RING_DEPTH, deep=True),
+        F("step.chained_drain.mask.direct.d4.s2",
+          build_window_chained_drain,
+          "chained_drain", layout="direct", k_steps=AUDIT_RING_DEPTH),
+        F("step.chained_drain.sharded.hash.d4.s2",
+          build_window_chained_drain_sharded,
+          "chained_drain_sharded", route="sharded",
+          k_steps=AUDIT_RING_DEPTH),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -1710,6 +2149,26 @@ def audit_stage_spec(fam: KernelFamily):
             red=red,
             capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
         )
+    if fam.kind in ("chained_drain", "chained_drain_sharded"):
+        # a 2-stage rollup chain: stage 0 at the canonical tiny dims,
+        # stage 1 a coarser tumbling window over the re-keyed fires.
+        # The identity re-key preserves the key bits, so the direct-
+        # index contract (hi == 0, lo < capacity) holds downstream
+        # whenever it holds at ingest — both stages share the layout
+        s0 = WindowStageSpec(
+            win=wk.WindowSpec(4, 2, ring=4, fires_per_step=2),
+            red=red,
+            capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+            layout=fam.layout, precombine=fam.precombine,
+            packed=fam.packed,
+        )
+        s1 = WindowStageSpec(
+            win=wk.WindowSpec(8, 4, ring=4, fires_per_step=2),
+            red=wk.ReduceSpec("sum", jnp.float32),
+            capacity_per_shard=AUDIT_CAPACITY, probe_len=AUDIT_PROBE_LEN,
+            layout=fam.layout,
+        )
+        return (s0, s1)
     win = wk.WindowSpec(4, 2, ring=4, fires_per_step=2, overflow=4)
     return WindowStageSpec(
         win=win, red=red,
@@ -1744,7 +2203,13 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
         wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
         count = jnp.asarray(fam.k_steps - 1, jnp.int32)
         return (state,) + per * fam.k_steps + (wmv, count)
-    if fam.kind == "sharded_drain":
+    if fam.kind == "chained_drain":
+        # same operand shape as the single-stage resident drain: the
+        # chained edge is internal to the kernel (state is the tuple)
+        wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
+        count = jnp.asarray(fam.k_steps - 1, jnp.int32)
+        return (state,) + per * fam.k_steps + (wmv, count)
+    if fam.kind in ("sharded_drain", "chained_drain_sharded"):
         # per-shard [n_shards, cap] lane slices (cap = the audit batch)
         # and a per-shard count VECTOR at depth - 1 — both cond
         # branches live, per-shard gating in the traced signature
@@ -1774,7 +2239,8 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     spec = audit_stage_spec(fam)
     kw = {}
     if fam.kind in ("update", "megastep", "megastep_fired",
-                    "resident_drain", "sharded_drain"):
+                    "resident_drain", "sharded_drain", "chained_drain",
+                    "chained_drain_sharded"):
         kw["insert"] = fam.insert
         kw["kg_fill"] = True
     if fam.route == "exchange":
@@ -1786,12 +2252,18 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     if fam.kind in ("resident_drain", "sharded_drain"):
         kw["depth"] = fam.k_steps
         kw["drain_stats"] = fam.drain_stats
+    if fam.kind in ("chained_drain", "chained_drain_sharded"):
+        kw["depth"] = fam.k_steps
+        kw["exchange_lanes"] = AUDIT_EXCHANGE_LANES
     fn = fam.builder(ctx, spec, **kw)
     init = {
         "session": init_session_state,
         "count": init_count_state,
         "rolling": init_rolling_state,
     }.get(fam.kind, init_sharded_state)
-    state = init(ctx, spec)
+    if fam.kind in ("chained_drain", "chained_drain_sharded"):
+        state = tuple(init_sharded_state(ctx, s) for s in spec)
+    else:
+        state = init(ctx, spec)
     args = _family_example_args(fam, ctx, state, batch)
     return fn, args, ((0,) if fam.donated else ())

@@ -158,8 +158,8 @@ def test_where_batch_equivalent_to_where():
     import numpy as np
 
     rng = np.random.default_rng(21)
-    names = rng.choice(["a", "b", "x"], 3000, p=[0.2, 0.2, 0.6])
-    events = [Event(int(i), str(names[i]), int(i % 7)) for i in range(3000)]
+    names = rng.choice(["a", "b", "x"], 2000, p=[0.2, 0.2, 0.6])
+    events = [Event(int(i), str(names[i]), int(i % 7)) for i in range(2000)]
 
     scalar = (
         Pattern.begin("a").where(lambda e: e.name == "a")

@@ -62,7 +62,7 @@ def test_columnar_generator_tumbling_sum():
         return cols, ts
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8).set_max_parallelism(128)
+    env.set_parallelism(4).set_max_parallelism(128)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     env.set_state_capacity(4096)
     env.batch_size = per_batch

@@ -240,7 +240,7 @@ def test_windowed_pipeline_from_rabbitmq(broker):
     from flink_tpu import StreamExecutionEnvironment
     from flink_tpu.runtime.sinks import CollectSink
 
-    total, n_keys = 4_000, 8
+    total, n_keys = 2_400, 8
     sink_side = RMQSink(
         "127.0.0.1", broker.port, "events",
         serializer=lambda e: f"{e[0]},{e[1]}".encode(),
@@ -260,7 +260,9 @@ def test_windowed_pipeline_from_rabbitmq(broker):
     assert broker.message_count("events") == total   # no consumer yet
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8)
+    # parallelism 4 keeps the exchange compile affordable on 1-core CI
+    # hosts; 8-shard routing is covered by tests/test_exchange*.py
+    env.set_parallelism(4)
     out = CollectSink()
     (
         env.add_source(RMQSource(

@@ -122,7 +122,9 @@ def test_windowed_aggregation_into_redis(server):
     from flink_tpu.core.time import TimeCharacteristic
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8)
+    # parallelism 4: same keyed routing paths, half the shard compile
+    # cost (8-shard coverage lives in tests/test_exchange*.py)
+    env.set_parallelism(4)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     sink = RedisSink(
         "127.0.0.1", server.port,

@@ -10,7 +10,7 @@ from flink_tpu.runtime.sinks import CollectSink
 
 def test_rolling_sum_matches_scalar_model(rng):
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8).set_max_parallelism(128)
+    env.set_parallelism(4).set_max_parallelism(128)
     env.set_state_capacity(512)
     env.batch_size = 64
 

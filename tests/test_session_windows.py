@@ -361,7 +361,7 @@ def test_sessions_survive_inter_poll_time_jump():
                 ts.astype(np.int64))
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8)
+    env.set_parallelism(4)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     env.set_state_capacity(4096)
     env.batch_size = 4096

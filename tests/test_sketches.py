@@ -51,7 +51,10 @@ def test_distinct_count_tumbling():
     items = rng.integers(0, 500, n)  # duplicates guaranteed
     ts = np.sort(rng.integers(0, 20_000, n))
 
-    env = _env()
+    # 8 distinct keys: a 64-slot table exercises the same hash/evict
+    # paths as the 1024 default at a fraction of the [ring, C, m]
+    # register-plane compile cost (m=4096 at p=12).
+    env = _env(capacity=64)
     sink = CollectSink()
 
     def gen(offset, nn):
@@ -89,7 +92,9 @@ def test_count_min_sliding_query():
     ts = np.sort(rng.integers(0, 12_000, n))
     query = [0, 1, 5, 199]
 
-    env = _env(parallelism=2)
+    # one stream key: 64 slots keep the [ring, C, depth*width] CMS
+    # planes small without touching the sketch dims under test.
+    env = _env(parallelism=2, capacity=64)
     sink = CollectSink()
 
     def gen(offset, nn):

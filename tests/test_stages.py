@@ -1,0 +1,289 @@
+"""Chained keyed stages (ISSUE 16, runtime/stages.py StageGraph +
+runtime/step.py chained resident drain):
+
+* 2-stage keyBy -> window -> keyBy -> window pipeline bit-exact against
+  a host-chained oracle (stage-1 fires re-windowed at
+  ``window_end_ms - 1``), single-shard and sharded,
+* exactly-once across a MID-DRAIN crash (the ``step.drain`` fault seam)
+  with prefetch + incremental checkpoints — both stages' window states
+  ride the cut and the un-retired group replays without loss or double
+  count,
+* checkpoint cut portability: a fresh process restores a chained cut
+  (aux ``chain_stages`` payload) and finishes the stream,
+* setup-time StageGraph validation: unsupported shapes fail LOUDLY at
+  plan time naming the stage or edge — never a silent wrong answer.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.runtime.stages import StageGraphError
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+N_KEYS = 64
+W1 = 10_000
+W2 = 20_000
+
+
+def gen(offset, n):
+    idx = np.arange(offset, offset + n)
+    cols = {
+        "key": (idx * 48271) % N_KEYS,
+        "value": np.ones(n, np.float32),
+    }
+    return cols, (idx // 50) * 1000
+
+
+def expected(total):
+    """Host-chained oracle: stage-1 tumbling sums, re-keyed into
+    stage-2 windows at ts = window_end - 1 (the device edge's
+    timestamp assignment)."""
+    idx = np.arange(total)
+    keys = (idx * 48271) % N_KEYS
+    ts = (idx // 50) * 1000
+    s1 = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // W1 + 1) * W1
+        s1[(k, we)] = s1.get((k, we), 0.0) + 1.0
+    s2 = {}
+    for (k, we1), v in s1.items():
+        t2 = we1 - 1
+        we2 = (t2 // W2 + 1) * W2
+        s2[(k, we2)] = s2.get((k, we2), 0.0) + v
+    return s2
+
+
+def build_env(parallelism, ckpt_dir=None, interval=0, restart=None, **cfg):
+    conf = Configuration(cfg)
+    if restart:
+        conf.set("restart-strategy", "fixed-delay")
+        conf.set("restart-strategy.fixed-delay.attempts", restart)
+    env = StreamExecutionEnvironment(conf)
+    env.set_parallelism(parallelism).set_max_parallelism(128)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    # 64 keys: 256 slots exercise the same hash/evict paths while both
+    # stages' [ring, C, ...] planes stay cheap to compile on 1-core CI
+    env.set_state_capacity(256)
+    env.batch_size = 256
+    if ckpt_dir:
+        env.enable_checkpointing(interval, str(ckpt_dir))
+    return env
+
+
+def run_job(env, total, restore_from=None):
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .key_by(lambda r: r.key)
+        .time_window(W2)
+        .sum(lambda r: r.value)
+        .add_sink(sink)
+    )
+    env.execute("chained-job", restore_from=restore_from)
+    return {(r.key, r.window_end_ms): r.value for r in sink.results}
+
+
+RESIDENT_CFG = {
+    "pipeline.prefetch": "on",
+    "pipeline.device-staging": "on",
+    "pipeline.resident-loop": "on",
+    "pipeline.ring-depth": 4,
+}
+
+
+# ----------------------------------------------------- steady state
+
+def test_two_stage_chain_bit_exact():
+    """THE round-16 criterion: a 2-stage keyed pipeline through the
+    chained resident drain equals the host-chained oracle bit-exactly,
+    and every step retired through drain dispatches."""
+    total = 4096
+    env = build_env(1, **RESIDENT_CFG)
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    assert m.resident_drains > 0
+
+
+def test_two_stage_chain_bit_exact_sharded():
+    """Same criterion over the sharded (data-parallel) chained drain:
+    2 shards, each owning a key-group slice of BOTH stages."""
+    total = 4096
+    env = build_env(2, **RESIDENT_CFG)
+    got = run_job(env, total)
+    assert got == expected(total)
+    assert env.last_job.metrics.resident_drains > 0
+
+
+def test_two_stage_chain_default_config():
+    """Chained jobs light up the resident drain under pure defaults —
+    no silent fallback path exists, so auto must resolve on."""
+    total = 2048
+    env = build_env(1)
+    got = run_job(env, total)
+    assert got == expected(total)
+    assert env.last_job.metrics.resident_drains > 0
+
+
+# ------------------------------------------ mid-drain crash, exactly-once
+
+def test_chained_mid_drain_crash_restore_exactly_once(tmp_path):
+    """Crash at a drain dispatch with BOTH stages holding window state,
+    under prefetch + incremental checkpoints; restore replays the
+    un-retired group from the cut — the chained payload
+    (aux ``chain_stages``) restores positionally, so neither stage
+    loses or double-counts."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{**RESIDENT_CFG,
+           "checkpoint.mode": "incremental", "checkpoint.async": True},
+    )
+    # chained jobs hit the drain seam several times per batch (the
+    # flush rounds), so index the crash mid-stream: after the first
+    # cut is durable, well before the source drains
+    inj = FaultInjector([
+        FaultRule("step.drain",
+                  exc=RuntimeError("injected mid-drain crash"), at=40),
+    ])
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert inj.fired_at("step.drain"), "drain seam never fired"
+    assert m.restarts == 1
+    assert m.resident_drains > 0
+    assert got == expected(total)
+
+
+def test_chained_checkpoint_cut_across_processes(tmp_path):
+    """Chained cut portability: phase 1 checkpoints and stops
+    mid-stream; a FRESH env restores the latest cut (both stages'
+    states from the aux payload) and finishes. Merged output equals
+    the single-run truth."""
+    total, half = 8192, 4096
+    env1 = build_env(1, tmp_path / "chk", interval=1, **RESIDENT_CFG)
+    got1 = run_job(env1, half)
+    env2 = build_env(1, **RESIDENT_CFG)
+    got2 = run_job(env2, total, restore_from=str(tmp_path / "chk"))
+    assert {**got1, **got2} == expected(total)
+
+
+def test_chained_checkpoint_rejected_by_single_stage_job(tmp_path):
+    """A chained checkpoint carries stage state a single-stage job
+    cannot hold — restoring it must fail loudly, not drop stage 2."""
+    env1 = build_env(1, tmp_path / "chk", interval=1, **RESIDENT_CFG)
+    run_job(env1, 4096)
+    env2 = build_env(1, **RESIDENT_CFG)
+    sink = CollectSink()
+    (
+        env2.add_source(GeneratorSource(gen, total=4096))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    with pytest.raises(ValueError, match="chained stage state"):
+        env2.execute("single", restore_from=str(tmp_path / "chk"))
+
+
+# --------------------------------------------- setup-time validation
+
+def _chain(env, sink, key_sel=None, extractor=None):
+    return (
+        env.add_source(GeneratorSource(gen, total=512))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .key_by(key_sel or (lambda r: r.key))
+        .time_window(W2)
+        .sum(extractor or (lambda r: r.value))
+        .add_sink(sink)
+    )
+
+
+def test_chain_key_selector_must_preserve_key():
+    """The device edge re-keys fires by identity: a selector that keys
+    stage 2 by anything else fails at plan time naming the edge."""
+    env = build_env(1, **RESIDENT_CFG)
+    _chain(env, CollectSink(), key_sel=lambda r: r.value)
+    with pytest.raises(StageGraphError,
+                       match="does not preserve the upstream key"):
+        env.execute("bad-key")
+
+
+def test_chain_value_extractor_must_forward():
+    """The edge carries the fire value verbatim: an extractor reading
+    any other slot fails at plan time naming the edge."""
+    env = build_env(1, **RESIDENT_CFG)
+    _chain(env, CollectSink(), extractor=lambda r: r.key)
+    with pytest.raises(StageGraphError,
+                       match="value extractor does not pass"):
+        env.execute("bad-extract")
+
+
+def test_chain_depth_capped_by_config():
+    """pipeline.stages.max-stages bounds the accepted chain depth —
+    deeper chains fail at plan time, before any compile."""
+    env = build_env(1, **{**RESIDENT_CFG,
+                          "pipeline.stages.max-stages": 2})
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=512))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .key_by(lambda r: r.key)
+        .time_window(W2)
+        .sum(lambda r: r.value)
+        .key_by(lambda r: r.key)
+        .time_window(2 * W2)
+        .sum(lambda r: r.value)
+        .add_sink(sink)
+    )
+    with pytest.raises(StageGraphError, match="max-stages"):
+        env.execute("too-deep")
+
+
+def test_chain_requires_staging_substrate():
+    """Without prefetch/staging there is no resident drain, and a
+    chained graph has no single-step fallback — loud config error."""
+    env = build_env(1, **{"pipeline.prefetch": "off"})
+    _chain(env, CollectSink())
+    with pytest.raises(StageGraphError, match="resident"):
+        env.execute("no-substrate")
+
+
+def test_chain_rejects_all_to_all_exchange():
+    """The chained drain routes ONLY through the mask exchange; the
+    all_to_all plan has no inter-stage seam."""
+    env = build_env(2, **{**RESIDENT_CFG, "exchange.mode": "all_to_all"})
+    _chain(env, CollectSink())
+    with pytest.raises(StageGraphError, match="all_to_all"):
+        env.execute("bad-exchange")
+
+
+def test_chain_rejects_trailing_keyed_stage_without_window():
+    """A keyBy after a windowed stage must itself end in a window
+    aggregation — rolling reduces cannot chain on the device edge."""
+    env = build_env(1, **RESIDENT_CFG)
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=512))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .key_by(lambda r: r.key)
+        .sum(lambda r: r.value)
+        .add_sink(sink)
+    )
+    with pytest.raises(StageGraphError, match="window aggregation"):
+        env.execute("rolling-tail")

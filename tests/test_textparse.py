@@ -117,7 +117,7 @@ def test_socket_words_source_end_to_end():
     threading.Thread(target=feed, daemon=True).start()
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    env.set_parallelism(8)
+    env.set_parallelism(4)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     env.set_state_capacity(4096)
     env.batch_size = 4096

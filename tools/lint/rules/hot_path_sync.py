@@ -72,6 +72,11 @@ HOT_PATHS = (
     # same standard as the kernels it observes. (The publish-time stamps
     # stay inside ingest.py's two allowlisted ingest-thread blocks.)
     "flink_tpu/metrics/drain_stats.py",
+    # stage-graph planner (ISSUE 16): setup-time only, but its plan
+    # products (specs, codecs, snapshot/restore payloads) feed the
+    # chained drain directly — hold it to hot-path discipline so no
+    # per-drain device sync sneaks in through a planner helper
+    "flink_tpu/runtime/stages.py",
 )
 
 # documented host-facing seams that live in hot-path modules but are
