@@ -540,8 +540,8 @@ def main():
             "import json, jax; "
             "jax.config.update('jax_platforms', 'cpu'); "
             "from bench_configs import run_elastic_recovery; "
-            f"frac, mttr = run_elastic_recovery({args.events}, True); "
-            "print('ELASTIC_RESULT ' + json.dumps([frac, mttr]))"
+            f"frac, mttr, p99 = run_elastic_recovery({args.events}, True); "
+            "print('ELASTIC_RESULT ' + json.dumps([frac, mttr, p99]))"
         )
         result, last_err = None, "no attempts ran"
         for attempt in range(2):
@@ -570,12 +570,13 @@ def main():
                   f"retrying: {last_err}", file=sys.stderr)
         if result is None:
             fail(f"elastic drill failed twice: {last_err}")
-        frac, mttr_ms = result
+        frac, mttr_ms, p99_ms = result
         print(json.dumps({
             "metric": "elastic recovery: degraded throughput fraction "
                       "after losing 1 of 8 shards",
             "value": round(frac, 3),
             "unit": "fraction of pre-fault throughput",
+            "p99_fire_ms": p99_ms,
             "vs_baseline": round(frac / (7 / 8), 3),
             "criterion": ">= 0.6 * (7/8) = 0.525",
             "rescale_detect_to_first_fire_ms": mttr_ms,
@@ -588,7 +589,7 @@ def main():
         # — so one child process per cell, same segfault workarounds as
         # the elastic drill (no compile cache under the forced mesh, one
         # retry per cell)
-        curve, errs = {}, []
+        curve, p99s, errs = {}, {}, []
         for n_chips in (1, 2, 4, 8):
             child_env = dict(os.environ)
             child_env["JAX_PLATFORMS"] = "cpu"
@@ -605,8 +606,8 @@ def main():
                 "import json, jax; "
                 "jax.config.update('jax_platforms', 'cpu'); "
                 "from bench_configs import run_scaling_cell; "
-                f"n, eps = run_scaling_cell({args.events}); "
-                "print('SCALING_RESULT ' + json.dumps([n, eps]))"
+                f"n, eps, p99 = run_scaling_cell({args.events}); "
+                "print('SCALING_RESULT ' + json.dumps([n, eps, p99]))"
             )
             cell = None
             for attempt in range(2):
@@ -631,24 +632,28 @@ def main():
                 )
             if cell is None:
                 continue
-            n_got, eps = cell
+            n_got, eps, cell_p99 = cell
             if n_got != n_chips:
                 errs.append(
                     f"{n_chips}-chip cell got {n_got} devices"
                 )
                 continue
             curve[str(n_chips)] = round(eps)
+            p99s[str(n_chips)] = cell_p99
         if "1" not in curve:
             fail(f"scaling curve has no 1-chip baseline: {errs}")
         one = curve["1"]
         best = max(curve.values())
+        best_chips = max(curve, key=curve.get)
         print(json.dumps({
             "metric": "multi-chip scaling: sharded resident drain, "
                       "total events/s at 1/2/4/8 virtual devices",
             "value": best,
             "unit": "events/s",
+            "p99_fire_ms": p99s.get(best_chips),
             "vs_baseline": round(best / one, 2),
             "events_per_s_by_chips": curve,
+            "p99_fire_ms_by_chips": p99s,
             "parallel_efficiency": {
                 c: round(v / (int(c) * one), 3)
                 for c, v in curve.items()

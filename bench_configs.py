@@ -599,6 +599,7 @@ def run_observability_overhead(total_events: int, cpu: bool):
 
     detail = {m: run(m) for m in ("off", "sampled", "every_step")}
     detail["resident_drain_stats"] = _resident_drain_stats_rows()
+    detail["chained_drain_stats"] = _chained_drain_stats_rows()
     print(json.dumps(
         {"config": "observability_overhead", "detail": detail}),
         flush=True)
@@ -713,6 +714,135 @@ def _resident_drain_stats_rows():
         "sampled": measure(True, 8),
         "every_drain": measure(True, 1),
         "B": B, "C": C, "ring_depth": D, "n_batches": n_batches,
+        "fetch_every_sampled": 8,
+    }
+    rows["sampled_over_off"] = round(
+        rows["sampled"] / max(rows["off"], 1), 4
+    )
+    rows["criterion"] = "sampled >= 0.98x off (<= 2% overhead)"
+    return rows
+
+
+def _chained_drain_stats_rows():
+    """Round-17 rows: the STAGE-AWARE flight recorder measured inside
+    the 2-stage chained drain at the round-16 matched dims (B=512 /
+    C=4096 / ring depth D=32, firing rollup stream). Three modes,
+    mirroring ``_resident_drain_stats_rows``:
+
+    * ``off`` — ``drain_stats=False``: the chained kernel compiles
+      WITHOUT the telemetry payload (op_budget_pre_stage_stats.json
+      pins this byte-identical to pre-PR);
+    * ``sampled`` — stage-0 per-slot payload + per-downstream-stage
+      records compiled in, host fetches every 8th drain;
+    * ``every_drain`` — both payload planes fetched with every drain.
+
+    The sampled-vs-off ratio is the acceptance criterion (<= 2%
+    events/s): the stage tail's record is six scalar reductions over
+    planes the edge pack already materialized, riding the same lagged
+    fetch as the stage-0 block."""
+    from collections import deque as _dq
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec,
+        build_window_chained_drain,
+        init_sharded_state,
+    )
+
+    n_dev = len(jax.devices())
+    ctx = MeshContext.create(n_dev, 128)
+    B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
+    BPP, D = 4, 32
+    ROLLUP, KEYSPACE, EX_LANES = 4, 256, 2048
+    n_groups = 6
+    n_batches = n_groups * D
+    spec1 = WindowStageSpec(
+        win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=C, layout="direct", precombine=False,
+    )
+    s2 = ROLLUP * SLIDE
+    slack = (D * spec1.win.fires_per_step * SLIDE) // s2 + 2
+    spec2 = WindowStageSpec(
+        win=wk.WindowSpec(s2, s2, ring=max(8, 2 + slack, 4),
+                          fires_per_step=4),
+        red=wk.ReduceSpec("sum", jnp.float32),
+        capacity_per_shard=C, layout="direct", precombine=False,
+    )
+
+    rng = np.random.default_rng(11)
+    batches, wms = [], []
+    for j in range(n_batches):
+        p = j // BPP
+        n_hot = B // 2
+        lo = np.concatenate([
+            rng.integers(0, KEYSPACE, B - n_hot),
+            rng.integers(0, 64, n_hot),
+        ]).astype(np.uint32)
+        rng.shuffle(lo)
+        ts = np.full(B, p * SLIDE + SLIDE // 2, np.int32)
+        batches.append(tuple(jax.device_put(a) for a in (
+            np.zeros(B, np.uint32), lo, ts,
+            np.ones(B, np.float32), np.ones(B, bool),
+        )))
+        wms.append(np.int32(p * SLIDE - 1))
+
+    def measure(drain_stats, fetch_every):
+        step = build_window_chained_drain(
+            ctx, (spec1, spec2), D, exchange_lanes=EX_LANES,
+            drain_stats=drain_stats,
+        )
+
+        def run_once():
+            state = (init_sharded_state(ctx, spec1),
+                     init_sharded_state(ctx, spec2))
+            t0 = time.perf_counter()
+            handles = _dq()
+            mon = None
+            for g in range(n_groups):
+                sel = range(g * D, (g + 1) * D)
+                flat = [a for i in sel for a in batches[i]]
+                wmv = np.tile(
+                    np.asarray([wms[i] for i in sel], np.int32),
+                    (n_dev, 1),
+                )
+                res = step(state, *flat, wmv, np.int32(D))
+                state, mon, fires = res[:3]
+                ds = (res[3] if drain_stats
+                      and (g + 1) % fetch_every == 0 else None)
+                handles.append((fires, ds))
+                if len(handles) > 1:
+                    cf, ds_h = handles.popleft()
+                    payload = (cf.counts, cf.lane_valid,
+                               cf.window_end_ticks, cf.value_sums)
+                    jax.device_get(
+                        payload + (ds_h,) if ds_h is not None
+                        else payload
+                    )
+            while handles:
+                cf, ds_h = handles.popleft()
+                payload = (cf.counts, cf.lane_valid,
+                           cf.window_end_ticks, cf.value_sums)
+                jax.device_get(
+                    payload + (ds_h,) if ds_h is not None else payload
+                )
+            jax.block_until_ready(mon[1])
+            return time.perf_counter() - t0
+
+        run_once()                               # compile + settle
+        dt = min(run_once() for _ in range(3))
+        return round(B * n_batches / dt)
+
+    rows = {
+        "off": measure(False, 0),
+        "sampled": measure(True, 8),
+        "every_drain": measure(True, 1),
+        "B": B, "C": C, "ring_depth": D, "n_batches": n_batches,
+        "n_stages": 2, "exchange_lanes": EX_LANES,
         "fetch_every_sampled": 8,
     }
     rows["sampled_over_off"] = round(
@@ -966,7 +1096,10 @@ def run_elastic_recovery(total_events: int, cpu: bool):
     0.525 of pre-fault), the rescaled-recovery detect-to-first-fire
     alongside PR 6's MTTR tiers, and the exactly-once oracle — the
     emission set across the whole cycle equals the unfaulted analytic
-    oracle. Returns (degraded_fraction, rescale_first_fire_ms)."""
+    oracle. Returns (degraded_fraction, rescale_first_fire_ms,
+    p99_fire_ms) — the p99 is the job's weighted fire-latency
+    percentile across the whole cycle (ISSUE 17: the latency half of
+    the north-star metric stamped in the headline)."""
     import tempfile
 
     import jax
@@ -1133,10 +1266,14 @@ def run_elastic_recovery(total_events: int, cpu: bool):
         and rescaled[-1]["first_fire_ms"] else 0.0
     )
     el = env._elasticity_report()
+    live_m = getattr(env, "_live_metrics", None)
+    p99 = live_m.fire_latency_pct(99) if live_m is not None else None
+    p99 = round(p99, 2) if p99 is not None else None
     detail = {
         "events": events,
         "devices": N_DEV,
         "killed_shard": KILL_SHARD,
+        "p99_fire_ms": p99,
         "pre_fault_eps": round(pre_eps) if pre_eps else None,
         "degraded_eps": round(degraded_eps) if degraded_eps else None,
         "degraded_fraction": round(frac, 3),
@@ -1159,7 +1296,7 @@ def run_elastic_recovery(total_events: int, cpu: bool):
         "exactly-once oracle FAILED across kill -> degraded -> "
         "scale-back"
     )
-    return frac, first_fire_ms
+    return frac, first_fire_ms, p99
 
 
 # ------------------------------------------------ device update ceiling
@@ -1960,7 +2097,9 @@ def run_scaling_cell(total_events: int):
     scaling, each chip drains its own full ring slice. The caller
     (``bench.py --scaling``) forces the device count per child process;
     this function just measures where it lands and returns
-    (n_devices, events/s)."""
+    (n_devices, events/s, p99_fire_ms) — the p99 is the weighted
+    dispatch-to-consume fire latency over emitted lanes (ISSUE 17:
+    both halves of the north-star metric stamped in the headline)."""
     from collections import deque as _dq
 
     import jax
@@ -2017,12 +2156,13 @@ def run_scaling_cell(total_events: int):
         wmvs.append(np.int32(p * SLIDE - 1))
 
     def consume(cf):
-        jax.device_get((cf.counts, cf.lane_valid,
-                        cf.window_end_ticks, cf.value_sums))
+        got = jax.device_get((cf.counts, cf.lane_valid,
+                              cf.window_end_ticks, cf.value_sums))
+        return int(np.asarray(got[1]).sum())
 
     counts = np.full(n, D, np.int32)    # full ring, every shard live
 
-    def run_once():
+    def run_once(lat=None):
         state = init_sharded_state(ctx, spec)
         t0 = time.perf_counter()
         handles = _dq()
@@ -2033,17 +2173,29 @@ def run_scaling_cell(total_events: int):
             wmv = np.tile(
                 np.asarray([wmvs[i] for i in sel], np.int32), (n, 1))
             state, mon, fires = drain(state, *flat, wmv, counts)
-            handles.append(fires)
+            handles.append((fires, time.perf_counter()))
             if len(handles) > 1:
-                consume(handles.popleft())
+                cf, t_pub = handles.popleft()
+                lanes = consume(cf)
+                if lat is not None and lanes:
+                    lat.append(
+                        (lanes, (time.perf_counter() - t_pub) * 1e3))
         while handles:
-            consume(handles.popleft())
+            cf, t_pub = handles.popleft()
+            lanes = consume(cf)
+            if lat is not None and lanes:
+                lat.append((lanes, (time.perf_counter() - t_pub) * 1e3))
         jax.block_until_ready(mon[1])
         return time.perf_counter() - t0
 
+    from flink_tpu.metrics.latency import weighted_percentile
+
     run_once()                           # compile + settle
-    dt = min(run_once() for _ in range(3))
-    return n, n * B * n_batches / dt
+    lat = []
+    dt = min(run_once(lat) for _ in range(3))
+    p99 = weighted_percentile(lat, 99)
+    return n, n * B * n_batches / dt, (
+        round(p99, 2) if p99 is not None else None)
 
 
 CONFIGS = {

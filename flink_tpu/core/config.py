@@ -461,6 +461,39 @@ class CoreOptions:
         "observability.compile-cost", False,
         "record XLA cost_analysis (FLOPs/bytes) of the update step at "
         "warmup — costs one extra trace+compile")
+    KG_HEAT_ALPHA = ConfigOption(
+        "observability.kg-heat-alpha", 0.05,
+        "EWMA smoothing factor for the per-key-group heat series the "
+        "flight recorder folds the sampled kg-fill counters into "
+        "(higher = faster reaction, noisier heat); needs "
+        "observability.kg-stats")
+    DOCTOR = ConfigOption(
+        "observability.doctor", True,
+        "enable the pipeline doctor (metrics/doctor.py): a pure "
+        "host-side rule engine joining the telemetry planes into "
+        "ranked findings with evidence + config remedies, served at "
+        "/jobs/<jid>/doctor and `python -m flink_tpu.doctor`")
+    DOCTOR_STARVED_THRESHOLD = ConfigOption(
+        "observability.doctor.starved-threshold", 0.5,
+        "ring-starved EWMA fraction above which the doctor reports a "
+        "ring-starved finding (publish side cannot keep the drain fed)")
+    DOCTOR_SATURATED_THRESHOLD = ConfigOption(
+        "observability.doctor.saturated-threshold", 0.9,
+        "drain duty-cycle EWMA above which the doctor reports a "
+        "device-saturated finding (every drain retires a full ring)")
+    DOCTOR_EDGE_UTILIZATION_THRESHOLD = ConfigOption(
+        "observability.doctor.edge-utilization-threshold", 0.8,
+        "peak inter-stage edge demand / pipeline.stages.exchange-lanes "
+        "ratio above which the doctor warns the edge is near overflow")
+    DOCTOR_KG_SKEW_THRESHOLD = ConfigOption(
+        "observability.doctor.kg-skew-threshold", 4.0,
+        "key-group heat max/mean ratio above which the doctor flags a "
+        "shard re-slice candidate")
+    DOCTOR_RECOMPILE_THRESHOLD = ConfigOption(
+        "observability.doctor.recompile-threshold", 8,
+        "steady-state XLA compiles beyond which the doctor reports a "
+        "recompile storm (steady state should dispatch pre-compiled "
+        "steps only)")
     # -- state backend / keying (docs/performance.md) -------------------
     # The keys below predate the config-hygiene lint (ISSUE 9): they
     # were read as bare literals across the executor; declaring them

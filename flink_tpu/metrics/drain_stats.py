@@ -63,6 +63,26 @@ COUNTER_FIELDS = ("events", "activity", "fire_lanes", "fired_keys",
                   "late_dropped", "nofit_dropped", "panes_advanced")
 LEVEL_FIELDS = ("ovf_fill", "kg_fill_max")
 
+# Per-downstream-stage record emitted ONCE per drain by the chained
+# stage tail (ISSUE 17) — one row per stage j >= 1, stacked to
+# ``[n_stages-1, n_shards, len(STAGE_STAT_FIELDS)]`` next to the
+# stage-0 per-slot payload. Single source of truth: runtime/step.py
+# packs by this order, this module unpacks by it.
+STAGE_STAT_FIELDS = (
+    "edge_demand",       # upstream fire lanes offered to the edge
+                         # (pre-clamp: demand > exchange-lanes budget
+                         # means the edge dropped)
+    "edge_events",       # lanes actually inserted (min(demand, E))
+    "fire_lanes",        # downstream fire lanes packed this drain
+    "dropped_capacity",  # edge lanes dropped for lane-budget overflow
+    "wm_lag_panes",      # coupled-watermark lag behind upstream, in
+                         # downstream pane widths (level)
+    "panes_advanced",    # downstream panes this drain's advance crossed
+)
+STAGE_COUNTER_FIELDS = ("edge_demand", "edge_events", "fire_lanes",
+                        "dropped_capacity", "panes_advanced")
+STAGE_LEVEL_FIELDS = ("wm_lag_panes",)
+
 
 class DrainTelemetry:
     """Aggregates the drain flight-recorder payload into per-shard
@@ -70,7 +90,9 @@ class DrainTelemetry:
 
     def __init__(self, n_shards: int, ring_depth: int,
                  alpha: float = 0.1, max_series: int = 512,
-                 tracer=None):
+                 tracer=None, n_stages: int = 1,
+                 exchange_lanes: int = 0, key_groups: int = 0,
+                 kg_alpha: float = 0.05):
         self.n_shards = max(1, int(n_shards))
         self.ring_depth = max(1, int(ring_depth))
         self.alpha = float(alpha)
@@ -80,6 +102,22 @@ class DrainTelemetry:
         nf = len(DRAIN_STAT_FIELDS)
         self._totals = np.zeros((n, nf), np.int64)
         self._last = np.zeros((n, nf), np.int64)
+        # stage-aware half (chained drains): per-downstream-stage
+        # counter totals / latest levels / per-drain peaks, summed
+        # (resp. maxed) over shards at absorb time
+        self.n_stages = max(1, int(n_stages))
+        self.exchange_lanes = max(0, int(exchange_lanes))
+        nsf = len(STAGE_STAT_FIELDS)
+        self._stage_totals = np.zeros((self.n_stages - 1, nsf), np.int64)
+        self._stage_last = np.zeros((self.n_stages - 1, nsf), np.int64)
+        self._stage_peak = np.zeros((self.n_stages - 1, nsf), np.int64)
+        # key-group heat: EWMA of sampled per-batch fill plus a
+        # last-touched recency counter, per key group
+        self.key_groups = max(0, int(key_groups))
+        self.kg_alpha = float(kg_alpha)
+        self._kg_heat = np.zeros(self.key_groups, np.float64)
+        self._kg_last = np.full(self.key_groups, -1, np.int64)
+        self._kg_seq = 0
         self._duty = [0.0] * n          # device-busy EWMA (count/depth)
         self._starved = [0.0] * n       # empty-ring drain EWMA
         self._fill = [0] * n            # last observed ring fill
@@ -200,6 +238,63 @@ class DrainTelemetry:
                     fire_lanes=int(per_shard[s][2]),
                 )
 
+    def absorb_stage_payload(self, ss: np.ndarray,
+                             t_wall: Optional[float] = None):
+        """Fold one fetched ``[n_stages-1, n_shards, len(STAGE_STAT_
+        FIELDS)]`` per-downstream-stage record (the chained tail emits
+        ONE row per stage per drain) into stage totals, latest levels
+        and per-drain peaks, and emit per-stage counter tracks."""
+        if t_wall is None:
+            t_wall = time.perf_counter()
+        ss = ss.astype(np.int64, copy=False)
+        if ss.ndim == 2:            # single-shard payload without axis
+            ss = ss[:, None, :]
+        n_down = min(ss.shape[0], self.n_stages - 1)
+        if n_down <= 0:
+            return
+        per_stage = ss[:n_down].sum(axis=1)          # counters: + shards
+        lvl = ss[:n_down].max(axis=1)                # levels: max shard
+        with self._lock:
+            self._stage_totals[:n_down] += per_stage
+            self._stage_last[:n_down] = lvl
+            self._stage_peak[:n_down] = np.maximum(
+                self._stage_peak[:n_down], lvl
+            )
+            tr = self.tracer
+        if tr is not None and tr.active:
+            fi = {f: i for i, f in enumerate(STAGE_STAT_FIELDS)}
+            for j in range(n_down):
+                tr.rec_counter(
+                    f"drain_stage{j + 1}", t_wall,
+                    edge_lanes=int(lvl[j][fi["edge_events"]]),
+                    fire_lanes=int(lvl[j][fi["fire_lanes"]]),
+                    wm_lag_panes=int(lvl[j][fi["wm_lag_panes"]]),
+                )
+
+    def absorb_kg_fill(self, counts: np.ndarray, n_batches: int = 1):
+        """Fold one sampled per-key-group fill vector (the lagged
+        monitoring fetch the executor already performs) into the heat
+        EWMA + last-touched recency — the demote/prefetch and
+        live-rebalance sensor. Pure host numpy on an already-fetched
+        array."""
+        counts = counts.astype(np.float64, copy=False).ravel()
+        if counts.size == 0:
+            return
+        obs = counts / max(1, int(n_batches))
+        a = self.kg_alpha
+        with self._lock:
+            if counts.size != self.key_groups:
+                self.key_groups = counts.size
+                heat = np.zeros(counts.size, np.float64)
+                last = np.full(counts.size, -1, np.int64)
+                n = min(self._kg_heat.size, counts.size)
+                heat[:n] = self._kg_heat[:n]
+                last[:n] = self._kg_last[:n]
+                self._kg_heat, self._kg_last = heat, last
+            self._kg_seq += 1
+            self._kg_heat += a * (obs - self._kg_heat)
+            self._kg_last[counts > 0] = self._kg_seq
+
     def note_fires(self, pairs: Sequence[Tuple[int, int]],
                    t_wall: Optional[float] = None):
         """Record event-time-to-fire latency for an emission:
@@ -234,6 +329,71 @@ class DrainTelemetry:
     def consume_latency_ms(self, q: float) -> Optional[float]:
         with self._lock:
             return self._consume_lat.percentile(q)
+
+    def stage_stat(self, stage: int, field: str) -> int:
+        """Latest-level (LEVEL fields) or running-total (COUNTER
+        fields) value for downstream stage ``stage`` (1-based)."""
+        j = int(stage) - 1
+        if not 0 <= j < self.n_stages - 1 or field not in STAGE_STAT_FIELDS:
+            return 0
+        i = STAGE_STAT_FIELDS.index(field)
+        with self._lock:
+            src = (self._stage_last if field in STAGE_LEVEL_FIELDS
+                   else self._stage_totals)
+            return int(src[j][i])
+
+    def kg_heat_block(self, k: int = 8) -> Dict[str, Any]:
+        """Top-k/cold-tail view of the key-group heat series."""
+        with self._lock:
+            heat = self._kg_heat.copy()
+            last = self._kg_last.copy()
+            seq = self._kg_seq
+            alpha = self.kg_alpha
+        if heat.size == 0 or seq == 0:
+            return {"available": False, "samples": seq,
+                    "hint": "needs observability.kg-stats and traffic"}
+        order = np.argsort(heat)[::-1][:max(1, int(k))]
+        touched = last >= 0
+        mean_heat = float(heat[touched].mean()) if touched.any() else 0.0
+        max_heat = float(heat.max())
+        # cold tail: groups never touched, or whose heat decayed below
+        # 10% of the mean over touched groups — the demote candidates
+        cold = (~touched) | (heat < 0.1 * mean_heat)
+        return {
+            "available": True,
+            "alpha": alpha,
+            "samples": seq,
+            "groups": int(heat.size),
+            "skew_ratio": round(max_heat / mean_heat, 4)
+            if mean_heat > 0 else 0.0,
+            "top": [
+                {
+                    "group": int(g),
+                    "heat": round(float(heat[g]), 4),
+                    "last_touched_ago": (
+                        int(seq - last[g]) if last[g] >= 0 else None
+                    ),
+                }
+                for g in order if heat[g] > 0
+            ],
+            "cold_tail": {
+                "count": int(cold.sum()),
+                "fraction": round(float(cold.mean()), 4),
+            },
+        }
+
+    def kg_heat_max(self) -> float:
+        with self._lock:
+            return float(self._kg_heat.max()) if self._kg_heat.size else 0.0
+
+    def kg_heat_skew(self) -> float:
+        with self._lock:
+            heat = self._kg_heat
+            touched = self._kg_last >= 0
+            if not touched.any():
+                return 0.0
+            mean = float(heat[touched].mean())
+            return float(heat.max()) / mean if mean > 0 else 0.0
 
     def regime(self) -> Tuple[float, float]:
         """(mean duty-cycle, mean ring-starved fraction) across shards —
@@ -281,7 +441,7 @@ class DrainTelemetry:
                     )
                 return out
 
-            return {
+            out: Dict[str, Any] = {
                 "available": True,
                 "n_shards": self.n_shards,
                 "ring_depth": self.ring_depth,
@@ -294,3 +454,33 @@ class DrainTelemetry:
                     "publish_to_consume": pct(self._consume_lat),
                 },
             }
+            if self.n_stages > 1:
+                fi = {f: i for i, f in enumerate(STAGE_STAT_FIELDS)}
+                budget = self.exchange_lanes
+                stages = []
+                for j in range(self.n_stages - 1):
+                    peak_demand = int(
+                        self._stage_peak[j][fi["edge_demand"]]
+                    )
+                    stages.append({
+                        "stage": j + 1,
+                        "totals": {
+                            f: int(self._stage_totals[j][fi[f]])
+                            for f in STAGE_COUNTER_FIELDS
+                        },
+                        "levels": {
+                            f: int(self._stage_last[j][fi[f]])
+                            for f in STAGE_LEVEL_FIELDS
+                        },
+                        "edge_lane_budget": budget,
+                        "edge_peak_demand": peak_demand,
+                        "edge_utilization": (
+                            round(peak_demand / budget, 4)
+                            if budget > 0 else None
+                        ),
+                    })
+                out["stages"] = stages
+                out["stage_fields"] = list(STAGE_STAT_FIELDS)
+        if self.key_groups > 0:
+            out["kg_heat"] = self.kg_heat_block()
+        return out

@@ -254,6 +254,12 @@ class CompileEvents:
     _sinks: List[Any] = []
     # trace-phase durations worth exporting alongside backend compiles
     _EVENT = "/jax/core/compile/backend_compile_duration"
+    # with the persistent compilation cache on, a cache HIT skips
+    # backend_compile entirely and emits this retrieval event instead —
+    # count it as a compile (an executable still materialized for a new
+    # signature; the jit cache absorbs true repeats, so storm semantics
+    # are unchanged) or compile-count gauges would read 0 on cached runs
+    _EVENT_CACHED = "/jax/compilation_cache/cache_retrieval_time_sec"
 
     @classmethod
     def install(cls):
@@ -273,7 +279,7 @@ class CompileEvents:
 
     @classmethod
     def _on_duration(cls, event: str, duration_s: float, **kw):
-        if event != cls._EVENT:
+        if event != cls._EVENT and event != cls._EVENT_CACHED:
             return
         with cls._lock:
             cls.total_count += 1

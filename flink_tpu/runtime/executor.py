@@ -1784,6 +1784,9 @@ class LocalExecutor:
             pipe.ts_transform.strategy if pipe.ts_transform is not None
             else WatermarkStrategy.for_monotonous_timestamps()
         )
+        # doctor recompile baseline: steady-bucket snapshot re-pinned at
+        # the end of every setup() so only post-build compiles count
+        _doctor_steady0 = [{"count": 0, "time_ms": 0.0}]
 
         def setup(origin_ms: int, fresh_state: bool = True):
             nonlocal td, win, spec, fire_step, fire_reduced_step, state
@@ -2056,6 +2059,7 @@ class LocalExecutor:
                             ctx, all_specs, ring_depth,
                             kg_fill=kg_stats_on,
                             exchange_lanes=ex_lanes,
+                            drain_stats=drain_stats_on,
                         ),
                         "fast": None,
                     }
@@ -2068,6 +2072,7 @@ class LocalExecutor:
                                 ctx, all_specs, ring_depth,
                                 kg_fill=kg_stats_on,
                                 exchange_lanes=ex_lanes,
+                                drain_stats=drain_stats_on,
                             ),
                             "fast": None,
                         }
@@ -2153,70 +2158,113 @@ class LocalExecutor:
                                     f"ring_publish_refusals_shard_{_s}",
                                     partial(_ring_refusals, _s),
                                 )
-                    if drain_stats_on:
-                        # drain flight recorder, host half: the
-                        # aggregator the lagged consume path feeds,
-                        # plugged into the attribution as its resident-
-                        # loop regime signal. Rebuilt per setup() so an
-                        # elastic re-plan resizes the per-shard series
-                        # with the mesh. Lane count follows the RING:
-                        # per-shard with the sharded ring (use_dp), one
-                        # global lane otherwise (absorb_payload folds
-                        # the payload's shard rows to match).
-                        n_lanes = ctx.n_shards if use_dp else 1
-                        drain_telem[0] = DrainTelemetry(
-                            n_lanes, ring_depth, tracer=tracer,
+                if use_resident and drain_stats_on:
+                    # drain flight recorder, host half: the
+                    # aggregator the lagged consume path feeds,
+                    # plugged into the attribution as its resident-
+                    # loop regime signal — single-stage AND chained
+                    # drains (stage-aware since ISSUE 17). Rebuilt per
+                    # setup() so an elastic re-plan resizes the
+                    # per-shard series with the mesh. Lane count
+                    # follows the RING: per-shard with the sharded
+                    # ring (use_dp), one global lane otherwise
+                    # (absorb_payload folds the payload's shard rows
+                    # to match).
+                    n_lanes = ctx.n_shards if use_dp else 1
+                    n_stages_t = (
+                        1 + len(chain_specs) if graph is not None else 1
+                    )
+                    ex_lanes_t = env.config.get(
+                        _CoreOpts.PIPELINE_STAGES_EXCHANGE_LANES
+                    ) if graph is not None else 0
+                    drain_telem[0] = DrainTelemetry(
+                        n_lanes, ring_depth, tracer=tracer,
+                        n_stages=n_stages_t,
+                        exchange_lanes=ex_lanes_t,
+                        key_groups=maxp_kg if kg_stats_on else 0,
+                        kg_alpha=env.config.get(
+                            _CoreOpts.KG_HEAT_ALPHA
+                        ),
+                    )
+                    ds_skip[0] = 0
+                    if self._attribution is not None:
+                        self._attribution.resident_fn = (
+                            drain_telem[0].regime
                         )
-                        ds_skip[0] = 0
-                        if self._attribution is not None:
-                            self._attribution.resident_fn = (
-                                drain_telem[0].regime
+                    if self._job_group is not None:
+                        grp_d = self._job_group
+
+                        def _dt_fill(s):
+                            dt = drain_telem[0]
+                            return dt.slot_fill(s) if dt else 0
+
+                        def _dt_duty(s):
+                            dt = drain_telem[0]
+                            return (
+                                round(dt.duty_cycle(s), 4)
+                                if dt else 0.0
                             )
-                        if self._job_group is not None:
-                            grp_d = self._job_group
 
-                            def _dt_fill(s):
-                                dt = drain_telem[0]
-                                return dt.slot_fill(s) if dt else 0
+                        def _dt_lat(which, q):
+                            dt = drain_telem[0]
+                            if dt is None:
+                                return 0.0
+                            v = (
+                                dt.fire_latency_ms(q)
+                                if which == "fire"
+                                else dt.consume_latency_ms(q)
+                            )
+                            return round(v, 3) if v is not None else 0.0
 
-                            def _dt_duty(s):
-                                dt = drain_telem[0]
-                                return (
-                                    round(dt.duty_cycle(s), 4)
-                                    if dt else 0.0
+                        # same idempotency story as the refusal
+                        # series above (registry.register overwrites)
+                        for _s in range(n_lanes):
+                            grp_d.gauge(
+                                f"drain_slot_fill_shard_{_s}",
+                                partial(_dt_fill, _s),
+                            )
+                            grp_d.gauge(
+                                f"drain_duty_cycle_shard_{_s}",
+                                partial(_dt_duty, _s),
+                            )
+                        for _q in (50, 95, 99):
+                            grp_d.gauge(
+                                f"drain_fire_latency_p{_q}_ms",
+                                partial(_dt_lat, "fire", float(_q)),
+                            )
+                            grp_d.gauge(
+                                f"drain_consume_latency_p{_q}_ms",
+                                partial(_dt_lat, "consume", float(_q)),
+                            )
+
+                        def _dt_stage(i, field):
+                            dt = drain_telem[0]
+                            return dt.stage_stat(i, field) if dt else 0
+
+                        # per-downstream-stage gauges (chained jobs):
+                        # edge pressure + coupled-watermark lag per
+                        # stage, scraped like any other job gauge
+                        for _i in range(1, n_stages_t):
+                            for _f in ("edge_events", "fire_lanes",
+                                       "dropped_capacity",
+                                       "wm_lag_panes"):
+                                grp_d.gauge(
+                                    f"drain_stage{_i}_{_f}",
+                                    partial(_dt_stage, _i, _f),
                                 )
-
-                            def _dt_lat(which, q):
+                        if kg_stats_on:
+                            def _kg_heat(which):
                                 dt = drain_telem[0]
                                 if dt is None:
                                     return 0.0
-                                v = (
-                                    dt.fire_latency_ms(q)
-                                    if which == "fire"
-                                    else dt.consume_latency_ms(q)
-                                )
-                                return round(v, 3) if v is not None else 0.0
+                                v = (dt.kg_heat_max() if which == "max"
+                                     else dt.kg_heat_skew())
+                                return round(v, 4)
 
-                            # same idempotency story as the refusal
-                            # series above (registry.register overwrites)
-                            for _s in range(n_lanes):
-                                grp_d.gauge(
-                                    f"drain_slot_fill_shard_{_s}",
-                                    partial(_dt_fill, _s),
-                                )
-                                grp_d.gauge(
-                                    f"drain_duty_cycle_shard_{_s}",
-                                    partial(_dt_duty, _s),
-                                )
-                            for _q in (50, 95, 99):
-                                grp_d.gauge(
-                                    f"drain_fire_latency_p{_q}_ms",
-                                    partial(_dt_lat, "fire", float(_q)),
-                                )
-                                grp_d.gauge(
-                                    f"drain_consume_latency_p{_q}_ms",
-                                    partial(_dt_lat, "consume", float(_q)),
-                                )
+                            grp_d.gauge("kg_heat_max",
+                                        partial(_kg_heat, "max"))
+                            grp_d.gauge("kg_heat_skew_ratio",
+                                        partial(_kg_heat, "skew"))
                 if graph is not None:
                     # NO standalone fire step for chained jobs: a bare
                     # fire sweep would consume stage-0 fires without
@@ -2387,6 +2435,16 @@ class LocalExecutor:
                         self._job_group.settable_gauge(
                             f"xla_update_step_{k}", v
                         )
+            # re-pin the doctor's recompile baseline at setup end: the
+            # labelled build bursts above and the unlabelled eager
+            # warm-up shapes (device_put, init zeros) that land in the
+            # process-global "steady" bucket during setup are NOT this
+            # job's steady-state growth — only compiles AFTER this
+            # point feed the recompile-storm rule (metrics/doctor.py)
+            _doctor_steady0[0] = (
+                CompileEvents.report()["by_stage"].get("steady")
+                or {"count": 0, "time_ms": 0.0}
+            )
 
         # -- checkpointing (barrier = step boundary, SURVEY §3.4) ----------
         storage = None
@@ -3541,6 +3599,77 @@ class LocalExecutor:
             return rep
 
         env._pipeline_report = pipeline_report
+
+        # CompileEvents is process-global: its "steady" bucket carries
+        # every unlabelled compile since process start (other jobs,
+        # eager warm-up shapes). The doctor's recompile-storm rule is
+        # about growth DURING THIS JOB, so pin a job-start baseline and
+        # serve the delta; setup() re-pins it after its build bursts.
+        _doctor_steady0[0] = (
+            CompileEvents.report()["by_stage"].get("steady")
+            or {"count": 0, "time_ms": 0.0}
+        )
+
+        def doctor_report() -> dict:
+            """/jobs/<jid>/doctor body: joins every telemetry plane into
+            one snapshot and runs the ranked-findings rule engine over it
+            (metrics/doctor.py). The snapshot and thresholds are embedded
+            in the payload so ``python -m flink_tpu.doctor`` can replay
+            the exact diagnosis offline."""
+            if not env.config.get(_CoreOpts.DOCTOR):
+                return {
+                    "available": False,
+                    "reason": "observability.doctor off",
+                }
+            from flink_tpu.metrics.doctor import diagnose
+
+            comp = CompileEvents.report()
+            steady = dict(comp["by_stage"].get("steady")
+                          or {"count": 0, "time_ms": 0.0})
+            steady["count"] = max(
+                0, steady["count"] - _doctor_steady0[0]["count"]
+            )
+            steady["time_ms"] = round(max(
+                0.0, steady["time_ms"] - _doctor_steady0[0]["time_ms"]
+            ), 2)
+            comp["by_stage"] = {**comp["by_stage"], "steady": steady}
+            snapshot = {
+                "pipeline": pipeline_report(),
+                "metrics": {
+                    f: getattr(metrics, f, 0)
+                    for f in JobMetrics.GAUGE_FIELDS
+                },
+                "checkpoints": list(metrics.checkpoint_stats or []),
+                "compile": comp,
+                "fire_latency_ms": {
+                    "p50": metrics.fire_latency_pct(50),
+                    "p99": metrics.fire_latency_pct(99),
+                },
+            }
+            rec_rep = getattr(env, "_recovery_report", None)
+            if rec_rep is not None:
+                try:
+                    snapshot["recovery"] = rec_rep()
+                except Exception:
+                    pass
+            thresholds = {
+                "starved": env.config.get(
+                    _CoreOpts.DOCTOR_STARVED_THRESHOLD),
+                "saturated": env.config.get(
+                    _CoreOpts.DOCTOR_SATURATED_THRESHOLD),
+                "edge_utilization": env.config.get(
+                    _CoreOpts.DOCTOR_EDGE_UTILIZATION_THRESHOLD),
+                "kg_skew": env.config.get(
+                    _CoreOpts.DOCTOR_KG_SKEW_THRESHOLD),
+                "recompile": env.config.get(
+                    _CoreOpts.DOCTOR_RECOMPILE_THRESHOLD),
+            }
+            payload = diagnose(snapshot, thresholds)
+            payload["snapshot"] = snapshot
+            payload["thresholds"] = thresholds
+            return payload
+
+        env._doctor_report = doctor_report
         if self._job_group is not None:
             grp = self._job_group
             # effective fused depth of the most recent dispatch (K for a
@@ -4234,8 +4363,17 @@ class LocalExecutor:
             # megastep), so fill-per-sampled-batch stays a per-batch rate
             kgf = np.asarray(kgf_h)
             if kgf.size:
-                kg_fill_total[:] += kgf.sum(axis=0)
+                kg_sum = kgf.sum(axis=0)
+                kg_fill_total[:] += kg_sum
                 kg_fill_sampled[0] += n_batches
+                # key-group heat (ISSUE 17): the same sampled fill
+                # vector folds into the flight recorder's EWMA heat +
+                # recency series — the demote/prefetch and
+                # live-rebalance sensor; host numpy on the fetched
+                # lagged handle, no extra sync
+                dt_kg = drain_telem[0]
+                if dt_kg is not None:
+                    dt_kg.absorb_kg_fill(kg_sum, n_batches)
             # -- adaptive step tiering: while new keys are being PLACED,
             # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
@@ -4603,7 +4741,13 @@ class LocalExecutor:
                 dt = drain_telem[0]
                 if dt is not None:
                     if ds_np is not None:
-                        dt.absorb_payload(ds_np)
+                        if isinstance(ds_np, tuple):
+                            # chained-drain payload pair (ISSUE 17):
+                            # stage-0 per-slot stack + per-stage records
+                            dt.absorb_payload(ds_np[0])
+                            dt.absorb_stage_payload(ds_np[1])
+                        else:
+                            dt.absorb_payload(ds_np)
                     live = lanes.astype(bool)
                     if live.any():
                         # event-time-to-fire: every live lane is one

@@ -302,12 +302,11 @@ class StageGraph:
                 "interior stages never emit host-side) — set "
                 "state.overflow-ring-lanes=0"
             )
-        if drain_stats:
-            raise StageGraphError(
-                "the drain flight recorder does not instrument chained "
-                "drains yet — set observability.drain-stats=false for "
-                "multi-stage jobs"
-            )
+        # drain_stats: accepted and supported since ISSUE 17 — the
+        # chained drains carry the stage-aware flight recorder, so no
+        # rejection; the param stays so the executor's call site reads
+        # as the full runtime-knob contract
+        del drain_stats
         if reduced_fires:
             raise StageGraphError(
                 "device-reduced fire emission (device_reduce sinks) is "

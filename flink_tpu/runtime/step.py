@@ -738,15 +738,25 @@ def _zero_slot_fires(spec: WindowStageSpec, reduced: bool):
 # "flight recorder" payload the executor unpacks LAGGED alongside fires.
 # The tuple lives with the host-side unpacker so packer and unpacker
 # cannot drift (flink_tpu/metrics/drain_stats.py documents each field).
-from flink_tpu.metrics.drain_stats import DRAIN_STAT_FIELDS  # noqa: E402
+from flink_tpu.metrics.drain_stats import (  # noqa: E402
+    DRAIN_STAT_FIELDS, STAGE_STAT_FIELDS,
+)
 
 
 def _slot_drain_stats(st, spec: WindowStageSpec, s_valid, act, kgf, cf,
-                      wm_before, late0, cap0):
+                      wm_before, late0, cap0, defer_fires=False):
     """One live slot's DRAIN_STAT_FIELDS vector — element ops and tiny
     reductions over fields the fused body already materialized, so the
     telemetry-ON kernels add zero sort/scatter/gather passes (the
-    op-budget ledger pins the OFF variants byte-identical)."""
+    op-budget ledger pins the OFF variants byte-identical).
+
+    ``defer_fires`` zeroes the two fire-plane reductions (fire_lanes,
+    fired_keys): in the CHAINED drain the per-slot CompactFires are
+    stacked for the stage tail rather than consumed in the slot body,
+    and reducing them inside the scan forces XLA to materialize the
+    fire pack twice per slot (~25% on the chained body). The builder
+    fills the columns after the scan with one vectorized pass over the
+    stacked fires (_deferred_fire_columns) — same numbers, one read."""
     slide = jnp.int32(spec.win.slide_ticks)
     # clamp the pre-advance watermark so a fresh job's MIN sentinel
     # cannot overflow the int32 pane subtraction, and report the very
@@ -761,17 +771,37 @@ def _slot_drain_stats(st, spec: WindowStageSpec, s_valid, act, kgf, cf,
     kg_max = (
         jnp.max(kgf) if kgf.shape[0] else jnp.zeros((), jnp.int32)
     )
+    zero = jnp.zeros((), jnp.int32)
     return jnp.stack([
         jnp.sum(s_valid, dtype=jnp.int32),
         act,
-        jnp.sum(cf.lane_valid, dtype=jnp.int32),
-        jnp.sum(cf.counts, dtype=jnp.int32),
+        zero if defer_fires else jnp.sum(cf.lane_valid, dtype=jnp.int32),
+        zero if defer_fires else jnp.sum(cf.counts, dtype=jnp.int32),
         st.dropped_late - late0,
         st.dropped_capacity - cap0,
         st.ovf_n,
         kg_max,
         panes,
     ])
+
+
+def _deferred_fire_columns(ds_stack, cf_stack):
+    """Fill the deferred fire_lanes / fired_keys columns of a [D, N]
+    per-slot stats stack from the scan's STACKED CompactFires — one
+    vectorized reduction per drain instead of one per slot inside the
+    scan (see _slot_drain_stats defer_fires). Skip slots stacked zero
+    fires, so their columns stay zero exactly as the inline path."""
+    lv, cnt = cf_stack.lane_valid, cf_stack.counts
+    fire_lanes = jnp.sum(
+        lv, dtype=jnp.int32, axis=tuple(range(1, lv.ndim))
+    )
+    fired_keys = jnp.sum(
+        cnt, dtype=jnp.int32, axis=tuple(range(1, cnt.ndim))
+    )
+    return jnp.concatenate([
+        ds_stack[:, :2], fire_lanes[:, None], fired_keys[:, None],
+        ds_stack[:, 4:],
+    ], axis=1)
 
 
 def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
@@ -1247,7 +1277,11 @@ def _chain_fires_to_lanes(cf, n_lanes: int):
     okv = ok.reshape((E,) + (1,) * len(out_shape))
     vals = jnp.where(okv, values[f_sel, idx], jnp.zeros((), values.dtype))
     dropped = jnp.maximum(total - jnp.int32(E), 0)
-    return hi, lo, ts, vals, ok, dropped
+    # ``total`` is the edge DEMAND (upstream fire lanes offered,
+    # pre-clamp) — the stage flight recorder reports it against the
+    # exchange-lanes budget so a near-overflow edge is visible before
+    # it drops (ISSUE 17)
+    return hi, lo, ts, vals, ok, dropped, total
 
 
 def _chain_stage_watermark(up_wm, up_state, up_spec: WindowStageSpec):
@@ -1275,14 +1309,19 @@ def _chain_stage_watermark(up_wm, up_state, up_spec: WindowStageSpec):
 
 
 def _chained_slot_body(stage0, spec0, kg_start, kg_end, maxp, s_hi, s_lo,
-                       s_ts, s_vals, s_valid, s_wm, insert, kg_fill):
+                       s_ts, s_vals, s_valid, s_wm, insert, kg_fill,
+                       drain_stats=False):
     """One live slot of the chained drain's stage-0 scan: consume the
     staged batch exactly like the single-stage resident body and emit
     this slot's CompactFires for the scan to stack. Downstream stages
     deliberately do NOT run here — they run ONCE per drain over the
     stacked fires (_chained_stage_tail), which is the chained drain's
-    whole cost model."""
+    whole cost model. With ``drain_stats`` the slot also emits its
+    DRAIN_STAT_FIELDS vector — the stage-0 half of the stage-aware
+    flight recorder (ISSUE 17), identical to the single-stage payload."""
     st, pend = stage0
+    wm_b = st.watermark
+    late0, cap0 = st.dropped_late, st.dropped_capacity
     st, act, kgf = mask_update_shard(
         st, spec0, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
         s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
@@ -1291,11 +1330,16 @@ def _chained_slot_body(stage0, spec0, kg_start, kg_end, maxp, s_hi, s_lo,
     st, pend, cf = wk.advance_and_fire_resident(
         st, spec0.win, spec0.red, s_wm
     )
+    if drain_stats:
+        ds = _slot_drain_stats(st, spec0, s_valid, act, kgf, cf,
+                               wm_b, late0, cap0, defer_fires=True)
+        return (st, pend), (act, kgf, cf, ds)
     return (st, pend), (act, kgf, cf)
 
 
 def _chained_stage_tail(down_states, specs, st0, cf_stack, wm_last,
-                        kg_start, kg_end, maxp, exchange_lanes):
+                        kg_start, kg_end, maxp, exchange_lanes,
+                        drain_stats=False):
     """Downstream stages of the chained drain, ONCE per drain — not
     once per slot. The whole drain's stacked stage-0 fires pack into a
     single ``exchange_lanes``-wide edge (_chain_fires_to_lanes over the
@@ -1319,17 +1363,23 @@ def _chained_stage_tail(down_states, specs, st0, cf_stack, wm_last,
     1-slot stacked CompactFires ([1, F, C] leaves) when the chain has
     a downstream stage — the executor's consume path reads the slot
     dimension from the payload shape, so the narrower stack needs no
-    host-side change."""
+    host-side change. With ``drain_stats`` a third element rides
+    along: a ``[n_stages-1, len(STAGE_STAT_FIELDS)]`` int32 stack, one
+    per-drain record per downstream stage (the tail runs once per
+    drain, so each row IS this drain's edge/watermark story) — element
+    ops and tiny reductions only, same ledger discipline as the
+    per-slot payload."""
     import dataclasses as _dc
 
     out = []
+    stage_recs = []
     up_state, up_fires, wm_up = st0, cf_stack, wm_last
     for j in range(1, len(specs)):
         wm_j = _chain_stage_watermark(wm_up, up_state, specs[j - 1])
-        c_hi, c_lo, c_ts, c_vals, c_ok, c_drop = _chain_fires_to_lanes(
-            up_fires, exchange_lanes
-        )
+        (c_hi, c_lo, c_ts, c_vals, c_ok, c_drop,
+         c_demand) = _chain_fires_to_lanes(up_fires, exchange_lanes)
         st_j = down_states[j - 1]
+        wm_b_j = st_j.watermark
         # downstream stages always insert: their key population arrives
         # through the edge, never through the ingest-staged batch the
         # fast (lookup-only) tier models
@@ -1351,9 +1401,38 @@ def _chained_stage_tail(down_states, specs, st0, cf_stack, wm_last,
         st_j = wk.apply_pending_purge(
             st_j, specs[j].win, specs[j].red, pend_j
         )
+        if drain_stats:
+            slide_j = jnp.int32(specs[j].win.slide_ticks)
+            # coupled-watermark lag behind upstream, in downstream pane
+            # widths; max-0 first so an end-of-stream flush (wm near
+            # int32 max) wrapping the subtraction reads 0, never junk
+            lag_panes = jnp.maximum(wm_up - wm_j, jnp.int32(0)) // slide_j
+            # downstream panes this advance crossed, sentinel-clamped
+            # exactly like the per-slot payload (_slot_drain_stats)
+            wb_j = jnp.maximum(
+                wm_b_j, st_j.watermark - jnp.int32(1 << 20)
+            )
+            panes_j = jnp.maximum(
+                jnp.int32(0),
+                st_j.watermark // slide_j - wb_j // slide_j,
+            )
+            panes_j = jnp.where(
+                wm_b_j < jnp.int32(-(2 ** 30)), jnp.int32(0), panes_j
+            )
+            stage_recs.append(jnp.stack([       # STAGE_STAT_FIELDS order
+                c_demand,
+                jnp.minimum(c_demand, jnp.int32(exchange_lanes)),
+                jnp.sum(cf_j.lane_valid, dtype=jnp.int32),
+                c_drop,
+                lag_panes,
+                panes_j,
+            ]))
         out.append(st_j)
         up_state, wm_up = st_j, wm_j
         up_fires = jax.tree_util.tree_map(lambda x: x[None], cf_j)
+    if drain_stats:
+        ss = jnp.stack(stage_recs)      # [n_stages-1, N_STAGE_FIELDS]
+        return tuple(out), up_fires, ss
     return tuple(out), up_fires
 
 
@@ -1361,7 +1440,8 @@ def build_window_chained_drain(ctx: MeshContext,
                                specs: Sequence[WindowStageSpec],
                                depth: int, insert: bool = True,
                                kg_fill: bool = False,
-                               exchange_lanes: int = 1024):
+                               exchange_lanes: int = 1024,
+                               drain_stats: bool = False):
     """Multi-stage resident ring drain (stage-graph subsystem, ISSUE
     16): ONE jitted dispatch consumes up to ``depth`` staged ring slots
     through a CHAIN of keyed window stages — stage 0 applies the staged
@@ -1403,7 +1483,15 @@ def build_window_chained_drain(ctx: MeshContext,
     kg_fill), fires)`` with ``fires`` the FINAL stage's CompactFires
     stacked [n_shards, 1] (one tail advance per drain) — the
     executor's lagged consume_fires path reads the slot dimension from
-    the payload shape, so the chain's output needs no host change."""
+    the payload shape, so the chain's output needs no host change.
+    With ``drain_stats`` (observability.drain-stats, ISSUE 17) a
+    fourth return element rides along: the PAIR ``(ds0, ss)`` — the
+    stage-0 per-slot [n_shards, depth, len(DRAIN_STAT_FIELDS)] flight-
+    recorder stack exactly as the single-stage drain emits it, plus a
+    per-downstream-stage [n_stages-1, n_shards,
+    len(STAGE_STAT_FIELDS)] record of this drain's edge/watermark
+    story; off, arity and op budgets are byte-identical to pre-
+    telemetry (op_budget_pre_stage_stats.json pins it)."""
     starts, ends = ctx.kg_bounds()
     starts = jnp.asarray(starts)
     ends = jnp.asarray(ends)
@@ -1425,12 +1513,16 @@ def build_window_chained_drain(ctx: MeshContext,
                 return _chained_slot_body(
                     op, specs[0], kg_start, kg_end, maxp, s_hi, s_lo,
                     s_ts, s_vals, s_valid, s_wm, insert, kg_fill,
+                    drain_stats=drain_stats,
                 )
 
             def skip(op):
                 kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
-                return op, (jnp.zeros((), jnp.int32), kgf,
-                            _zero_slot_fires(specs[0], False))
+                ys = (jnp.zeros((), jnp.int32), kgf,
+                      _zero_slot_fires(specs[0], False))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return op, ys
 
             return jax.lax.cond(i < count, live, skip, carry)
 
@@ -1440,7 +1532,7 @@ def build_window_chained_drain(ctx: MeshContext,
             (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
              wm_vec),
         )
-        acts, kgfs, cf_stack = ys
+        acts, kgfs, cf_stack = ys[:3]
         st0 = wk.apply_pending_purge(
             carry[0], specs[0].win, specs[0].red, carry[1]
         )
@@ -1451,18 +1543,25 @@ def build_window_chained_drain(ctx: MeshContext,
         wm_last = jnp.max(jnp.where(
             live_mask, wm_vec, jnp.int32(-(2**31) + 1)
         ))
-        down, fires = _chained_stage_tail(
+        tail = _chained_stage_tail(
             states[1:], specs, st0, cf_stack, wm_last, kg_start,
-            kg_end, maxp, exchange_lanes,
+            kg_end, maxp, exchange_lanes, drain_stats=drain_stats,
         )
+        down, fires = tail[0], tail[1]
         states = (st0,) + down
         ovf_n = states[0].ovf_n
         act = jnp.sum(acts)
         kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
         pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return (
+        out = (
             pack(states), ovf_n[None], act[None], kgf[None], pack(fires),
         )
+        if drain_stats:
+            # [1, D, N] per-slot stack (deferred fire columns filled
+            # from the stacked fires) + [1, S-1, K] per-stage records
+            ds0 = _deferred_fire_columns(ys[3], cf_stack)
+            out += (ds0[None], tail[2][None])
+        return out
 
     sharded = shard_map(
         shard_body,
@@ -1474,7 +1573,8 @@ def build_window_chained_drain(ctx: MeshContext,
             P(SHARD_AXIS),             # wmv [n_shards, D]
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                   P(SHARD_AXIS), P(SHARD_AXIS)),
+                   P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS), P(SHARD_AXIS)) if drain_stats else ()),
         check_vma=False,
     )
 
@@ -1482,10 +1582,17 @@ def build_window_chained_drain(ctx: MeshContext,
     def drain(states, *flat):
         *batches, wmv, count = flat
         stacks = _fused_batch_stack(D, batches)
-        st, ovf_n, act, kgf, fires = sharded(
+        res = sharded(
             states, starts, ends, jnp.asarray(count, jnp.int32),
             *stacks, wmv,
         )
+        st, ovf_n, act, kgf, fires = res[:5]
+        if drain_stats:
+            # stage records transpose to the documented
+            # [n_stages-1, n_shards, K] block (element op only)
+            return st, (ovf_n, act, kgf), fires, (
+                res[5], jnp.swapaxes(res[6], 0, 1)
+            )
         return st, (ovf_n, act, kgf), fires
 
     drain.k_steps = D
@@ -1496,7 +1603,7 @@ def build_window_chained_drain(ctx: MeshContext,
     drain.exchange_lanes = int(exchange_lanes)
     drain.fused_fire = True
     drain.fused_fire_reduced = False
-    drain.drain_stats = False
+    drain.drain_stats = drain_stats
     return drain
 
 
@@ -1504,7 +1611,8 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
                                        specs: Sequence[WindowStageSpec],
                                        depth: int, insert: bool = True,
                                        kg_fill: bool = False,
-                                       exchange_lanes: int = 1024):
+                                       exchange_lanes: int = 1024,
+                                       drain_stats: bool = False):
     """Data-parallel chained drain: the multi-stage chain of
     build_window_chained_drain lowered over build_window_sharded_drain's
     shard-local geometry — per-shard pre-routed lane slices, per-shard
@@ -1535,12 +1643,16 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
                 return _chained_slot_body(
                     op, specs[0], kg_start, kg_end, maxp, s_hi, s_lo,
                     s_ts, s_vals, s_valid, s_wm, insert, kg_fill,
+                    drain_stats=drain_stats,
                 )
 
             def skip(op):
                 kgf = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
-                return op, (jnp.zeros((), jnp.int32), kgf,
-                            _zero_slot_fires(specs[0], False))
+                ys = (jnp.zeros((), jnp.int32), kgf,
+                      _zero_slot_fires(specs[0], False))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return op, ys
 
             return jax.lax.cond(i < count, live, skip, carry)
 
@@ -1551,7 +1663,7 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
             (jnp.arange(D, dtype=jnp.int32), hi[:, 0], lo[:, 0],
              ts[:, 0], values[:, 0], valid[:, 0], wm_vec),
         )
-        acts, kgfs, cf_stack = ys
+        acts, kgfs, cf_stack = ys[:3]
         st0 = wk.apply_pending_purge(
             carry[0], specs[0].win, specs[0].red, carry[1]
         )
@@ -1562,18 +1674,23 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
         wm_last = jnp.max(jnp.where(
             live_mask, wm_vec, jnp.int32(-(2**31) + 1)
         ))
-        down, fires = _chained_stage_tail(
+        tail = _chained_stage_tail(
             states[1:], specs, st0, cf_stack, wm_last, kg_start,
-            kg_end, maxp, exchange_lanes,
+            kg_end, maxp, exchange_lanes, drain_stats=drain_stats,
         )
+        down, fires = tail[0], tail[1]
         states = (st0,) + down
         ovf_n = states[0].ovf_n
         act = jnp.sum(acts)
         kgf = kgfs.sum(axis=0) if kg_fill else jnp.zeros(0, jnp.int32)
         pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return (
+        out = (
             pack(states), ovf_n[None], act[None], kgf[None], pack(fires),
         )
+        if drain_stats:
+            ds0 = _deferred_fire_columns(ys[3], cf_stack)
+            out += (ds0[None], tail[2][None])
+        return out
 
     sharded = shard_map(
         shard_body,
@@ -1586,7 +1703,8 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
             P(SHARD_AXIS),             # wmv [n_shards, D]
         ),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                   P(SHARD_AXIS), P(SHARD_AXIS)),
+                   P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS), P(SHARD_AXIS)) if drain_stats else ()),
         check_vma=False,
     )
 
@@ -1594,10 +1712,15 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
     def drain(states, *flat):
         *batches, wmv, counts = flat
         stacks = _fused_batch_stack(D, batches)
-        st, ovf_n, act, kgf, fires = sharded(
+        res = sharded(
             states, starts, ends, jnp.asarray(counts, jnp.int32),
             *stacks, wmv,
         )
+        st, ovf_n, act, kgf, fires = res[:5]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, (
+                res[5], jnp.swapaxes(res[6], 0, 1)
+            )
         return st, (ovf_n, act, kgf), fires
 
     drain.k_steps = D
@@ -1609,7 +1732,7 @@ def build_window_chained_drain_sharded(ctx: MeshContext,
     drain.exchange_lanes = int(exchange_lanes)
     drain.fused_fire = True
     drain.fused_fire_reduced = False
-    drain.drain_stats = False
+    drain.drain_stats = drain_stats
     return drain
 
 
@@ -2112,6 +2235,18 @@ def kernel_family_grid():
           build_window_chained_drain_sharded,
           "chained_drain_sharded", route="sharded",
           k_steps=AUDIT_RING_DEPTH),
+        # stage-aware flight recorder (ISSUE 17): the chained drains'
+        # telemetry-ON twins — stage-0 per-slot payload + per-stage
+        # edge/watermark records, all element ops, so the OFF twins
+        # stay byte-identical (op_budget_pre_stage_stats.json) and the
+        # ON twins match their OFF twin per op group
+        F("step.chained_drain.mask.hash.d4.s2.dstats",
+          build_window_chained_drain,
+          "chained_drain", k_steps=AUDIT_RING_DEPTH, drain_stats=True),
+        F("step.chained_drain.sharded.hash.d4.s2.dstats",
+          build_window_chained_drain_sharded,
+          "chained_drain_sharded", route="sharded",
+          k_steps=AUDIT_RING_DEPTH, drain_stats=True),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -2255,6 +2390,7 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     if fam.kind in ("chained_drain", "chained_drain_sharded"):
         kw["depth"] = fam.k_steps
         kw["exchange_lanes"] = AUDIT_EXCHANGE_LANES
+        kw["drain_stats"] = fam.drain_stats
     fn = fam.builder(ctx, spec, **kw)
     init = {
         "session": init_session_state,

@@ -25,6 +25,10 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
                               occupancy/duty-cycle/refusal series +
                               fire/consume latency percentiles
                               (observability.drain-stats, ISSUE 14)
+    /jobs/<jid>/doctor        ranked pipeline-health findings with
+                              evidence + config remedies, snapshot
+                              embedded for offline replay
+                              (observability.doctor, ISSUE 17)
     /metrics                  Prometheus text exposition over every job's
                               registry (text/plain, not JSON — scrape me)
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
@@ -1043,6 +1047,25 @@ class WebMonitor:
                     "hint": "pipeline telemetry is recorded by resident-"
                             "loop windowed stages with observability."
                             "drain-stats on; this job has none (yet)",
+                }
+            return report_fn()
+        m = re.fullmatch(r"/jobs/([^/]+)/doctor", path)
+        if m:
+            # the pipeline doctor (ISSUE 17): every telemetry plane
+            # joined into one snapshot and run through the ranked-
+            # findings rule engine (metrics/doctor.py) — each finding
+            # carries evidence values and a concrete config remedy; the
+            # snapshot is embedded so `python -m flink_tpu.doctor` can
+            # replay the diagnosis offline
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            report_fn = getattr(rec.env, "_doctor_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "the doctor runs over windowed keyed "
+                            "stages' telemetry; this job has none (yet)",
                 }
             return report_fn()
         m = re.fullmatch(r"/jobs/([^/]+)/elasticity", path)

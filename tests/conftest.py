@@ -22,6 +22,13 @@ if "xla_backend_optimization_level" not in flags:
     flags = (flags + " --xla_backend_optimization_level=0").strip()
 os.environ["XLA_FLAGS"] = flags
 
+# NOTE: do NOT enable the jax persistent compilation cache here
+# (JAX_COMPILATION_CACHE_DIR) to dedupe the suite's repeated kernel
+# builds: on this CPU jaxlib, executables deserialized from the cache
+# mid-suite produce wrong results and segfault under donation
+# (reproduced in tests/test_checkpoint.py). Compile-time savings must
+# come from smaller test dims instead.
+
 import jax  # noqa: E402
 
 # The environment's axon sitecustomize force-sets jax_platforms="axon,cpu",

@@ -1,0 +1,401 @@
+"""Pipeline doctor + stage-aware flight recorder units (ISSUE 17).
+
+* every doctor rule reproduced by a synthetic-pathology snapshot —
+  starved ring, saturated drain, edge-lane near-overflow AND overflow,
+  kg heat skew, recompile storm, checkpoint budget burn, ring
+  refusals, watchdog trips — each finding carrying evidence values
+  and a concrete config remedy;
+* ranking (severity class, then score), graceful degradation on
+  missing planes, threshold overrides;
+* the ``python -m flink_tpu.doctor`` CLI: exit 0 clean / 1 findings /
+  2 error, the stable ``--json`` schema, and replaying a served
+  payload through its embedded snapshot;
+* DrainTelemetry's stage-aware half: per-downstream-stage counter
+  totals / levels / peaks, the report() stages block with edge
+  utilization, and the key-group heat series (EWMA fold, recency,
+  cold tail, skew, live resize).
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flink_tpu.metrics.doctor import (
+    DEFAULT_THRESHOLDS,
+    DOCTOR_SCHEMA_VERSION,
+    RULE_NAMES,
+    diagnose,
+    run_rules,
+)
+from flink_tpu.metrics.drain_stats import (
+    STAGE_STAT_FIELDS,
+    DrainTelemetry,
+)
+
+
+# ------------------------------------------------ synthetic pathologies
+
+def _shard(i, **kw):
+    row = {"shard": i, "duty_cycle": 0.2, "ring_starved": 0.0,
+           "totals": {}, "levels": {}}
+    row.update(kw)
+    return row
+
+
+def _one(snapshot, rule):
+    found = [f for f in run_rules(snapshot) if f["rule"] == rule]
+    assert len(found) == 1, (rule, [f["rule"] for f in
+                                    run_rules(snapshot)])
+    return found[0]
+
+
+def test_rule_ring_starved():
+    snap = {"pipeline": {"shards": [
+        _shard(0, ring_starved=0.85), _shard(1, ring_starved=0.1),
+    ]}}
+    f = _one(snap, "ring-starved")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["shards"] == [
+        {"shard": 0, "ring_starved": 0.85}
+    ]
+    assert f["remedy"]["key"] == "pipeline.prefetch-depth"
+    # below threshold: no finding
+    snap["pipeline"]["shards"][0]["ring_starved"] = 0.3
+    assert not [x for x in run_rules(snap) if x["rule"] == "ring-starved"]
+
+
+def test_rule_device_saturated():
+    snap = {"pipeline": {"shards": [
+        _shard(0, duty_cycle=0.97), _shard(1, duty_cycle=0.95),
+    ]}}
+    f = _one(snap, "device-saturated")
+    assert f["severity"] == "warning"
+    assert len(f["evidence"]["shards"]) == 2
+    assert f["score"] == 0.97
+    assert f["remedy"]["key"] == "pipeline.ring-depth"
+
+
+def test_rule_edge_lane_near_overflow_warns_before_dropping():
+    snap = {"pipeline": {"stages": [{
+        "stage": 1, "edge_lane_budget": 1024, "edge_peak_demand": 900,
+        "edge_utilization": 0.8789, "totals": {"dropped_capacity": 0},
+        "levels": {},
+    }]}}
+    f = _one(snap, "edge-lane-overflow")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["edge_peak_demand"] == 900
+    assert f["evidence"]["dropped_capacity"] == 0
+    assert f["remedy"]["key"] == "pipeline.stages.exchange-lanes"
+
+
+def test_rule_edge_lane_overflow_dropped_is_critical():
+    snap = {"pipeline": {"stages": [{
+        "stage": 2, "edge_lane_budget": 64, "edge_peak_demand": 91,
+        "edge_utilization": 1.4219, "totals": {"dropped_capacity": 27},
+        "levels": {},
+    }]}}
+    f = _one(snap, "edge-lane-overflow")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["dropped_capacity"] == 27
+    assert "OVERFLOWED" in f["summary"]
+
+
+def test_rule_kg_heat_skew():
+    snap = {"pipeline": {"kg_heat": {
+        "available": True, "skew_ratio": 9.3,
+        "top": [{"group": 7, "heat": 93.0, "last_touched_ago": 0}],
+        "cold_tail": {"count": 90, "fraction": 0.7},
+    }}}
+    f = _one(snap, "kg-heat-skew")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["skew_ratio"] == 9.3
+    assert f["evidence"]["hot_groups"][0]["group"] == 7
+    assert f["remedy"]["key"] == "pipeline.data-parallel"
+    # unavailable heat block never fires the rule
+    snap["pipeline"]["kg_heat"] = {"available": False, "samples": 0}
+    assert not run_rules(snap)
+
+
+def test_rule_recompile_storm():
+    snap = {"compile": {"compiles": 40, "by_stage": {
+        "steady": {"count": 31, "time_ms": 9000.0},
+    }}}
+    f = _one(snap, "recompile-storm")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["steady_compiles"] == 31
+    assert f["remedy"]["key"] == "pipeline.steps-per-dispatch"
+    # the warmup bucket never triggers it
+    assert not run_rules({"compile": {
+        "by_stage": {"warmup": {"count": 99, "time_ms": 1.0}},
+    }})
+
+
+def test_rule_checkpoint_budget_burn():
+    snap = {
+        "metrics": {"checkpoints_aborted": 2, "checkpoints_declined": 1},
+        "checkpoints": [
+            {"id": 3, "status": "completed"},
+            {"id": 4, "status": "aborted",
+             "failure_reason": "injected fault: publish"},
+        ],
+    }
+    f = _one(snap, "checkpoint-budget-burn")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["recent_aborts"] == [
+        {"id": 4, "failure_reason": "injected fault: publish"}
+    ]
+    assert f["remedy"]["key"] == "checkpoint.tolerable-failures"
+
+
+def test_rule_ring_refusals():
+    snap = {"pipeline": {"shards": [
+        _shard(0, publish_refusals=5), _shard(1, publish_refusals=0),
+    ]}}
+    f = _one(snap, "ring-refusals")
+    assert f["severity"] == "info"
+    assert f["evidence"]["total_refusals"] == 5
+    assert f["remedy"]["key"] == "pipeline.ring-depth"
+
+
+def test_rule_watchdog_trips():
+    snap = {"metrics": {"watchdog_trips": 1, "restarts": 1}}
+    f = _one(snap, "watchdog-trips")
+    assert f["severity"] == "warning"
+    assert f["evidence"] == {"watchdog_trips": 1, "restarts": 1}
+    assert f["remedy"]["key"] == "watchdog.drain-timeout"
+
+
+# ------------------------------------------------ engine behaviour
+
+def test_empty_snapshot_is_clean_and_every_plane_degrades():
+    payload = diagnose({})
+    assert payload["clean"] is True
+    assert payload["findings"] == []
+    assert payload["version"] == DOCTOR_SCHEMA_VERSION
+    assert set(payload["rules"]) == set(RULE_NAMES)
+    assert len(RULE_NAMES) == 8
+    # partial planes of the wrong-but-plausible shapes never crash
+    assert diagnose({"pipeline": {}, "metrics": {}, "compile": {},
+                     "checkpoints": []})["clean"] is True
+
+
+def test_findings_rank_critical_first_then_score():
+    snap = {
+        "pipeline": {"shards": [_shard(0, ring_starved=0.9,
+                                       publish_refusals=3)]},
+        "compile": {"by_stage": {"steady": {"count": 50}}},
+        "metrics": {"watchdog_trips": 7},
+    }
+    findings = run_rules(snap)
+    assert [f["rule"] for f in findings] == [
+        "recompile-storm",                      # critical
+        "watchdog-trips", "ring-starved",       # warnings, score desc
+        "ring-refusals",                        # info
+    ]
+    sev = [f["severity"] for f in findings]
+    assert sev == ["critical", "warning", "warning", "info"]
+
+
+def test_threshold_overrides_and_none_values_ignored():
+    snap = {"pipeline": {"shards": [_shard(0, duty_cycle=0.5)]}}
+    assert not run_rules(snap)
+    hot = run_rules(snap, {"saturated": 0.4, "kg_skew": None})
+    assert [f["rule"] for f in hot] == ["device-saturated"]
+    assert hot[0]["evidence"]["threshold"] == 0.4
+    # a None override keeps the default, not a crash / 0-threshold
+    assert DEFAULT_THRESHOLDS["kg_skew"] == 4.0
+
+
+# ------------------------------------------------ CLI exit codes
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "flink_tpu.doctor", *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_cli_clean_snapshot_exits_zero(tmp_path):
+    p = tmp_path / "snap.json"
+    p.write_text("{}")
+    r = _cli(str(p))
+    assert r.returncode == 0, r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_findings_exit_one_with_stable_json(tmp_path):
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps({
+        "metrics": {"watchdog_trips": 3},
+        "compile": {"by_stage": {"steady": {"count": 20}}},
+    }))
+    r = _cli(str(p), "--json")
+    assert r.returncode == 1, r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["version"] == DOCTOR_SCHEMA_VERSION
+    assert payload["clean"] is False
+    assert [f["rule"] for f in payload["findings"]] == [
+        "recompile-storm", "watchdog-trips",
+    ]
+    for f in payload["findings"]:
+        assert f["evidence"] and f["remedy"]["key"]
+    # human rendering carries the same remedies
+    rt = _cli(str(p))
+    assert rt.returncode == 1
+    assert "pipeline.steps-per-dispatch" in rt.stdout
+
+
+def test_cli_replays_a_served_payload_through_embedded_snapshot(
+        tmp_path):
+    """A saved /jobs/<jid>/doctor payload re-diagnoses identically:
+    the embedded snapshot + thresholds are the replay inputs."""
+    served = diagnose({"metrics": {"watchdog_trips": 2}})
+    served["snapshot"] = {"metrics": {"watchdog_trips": 2}}
+    served["thresholds"] = dict(DEFAULT_THRESHOLDS)
+    p = tmp_path / "served.json"
+    p.write_text(json.dumps(served))
+    r = _cli(str(p), "--json")
+    assert r.returncode == 1
+    replay = json.loads(r.stdout)
+    assert replay["findings"] == served["findings"]
+
+
+def test_cli_errors_exit_two(tmp_path):
+    assert _cli("/definitely/not/there.json").returncode == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert _cli(str(bad)).returncode == 2
+    # exactly one of <snapshot> / --url
+    assert _cli().returncode == 2
+    p = tmp_path / "s.json"
+    p.write_text("{}")
+    assert _cli(str(p), "--url", "http://x/").returncode == 2
+
+
+# --------------------------------------- stage-aware flight recorder
+
+def _stage_payload(**kw):
+    """One [1, n_shards, K] record with named fields on shard 0."""
+    fi = {f: i for i, f in enumerate(STAGE_STAT_FIELDS)}
+    n_shards = kw.pop("n_shards", 1)
+    ss = np.zeros((1, n_shards, len(STAGE_STAT_FIELDS)), np.int32)
+    for f, v in kw.items():
+        ss[0, 0, fi[f]] = v
+    return ss
+
+
+def test_stage_payload_counters_accumulate_and_levels_track_latest():
+    dt = DrainTelemetry(1, 4, n_stages=2, exchange_lanes=100)
+    dt.absorb_stage_payload(_stage_payload(
+        edge_demand=40, edge_events=40, fire_lanes=3, wm_lag_panes=5,
+        panes_advanced=2,
+    ))
+    dt.absorb_stage_payload(_stage_payload(
+        edge_demand=90, edge_events=90, fire_lanes=1, wm_lag_panes=1,
+        panes_advanced=1,
+    ))
+    assert dt.stage_stat(1, "edge_demand") == 130      # counter: sum
+    assert dt.stage_stat(1, "fire_lanes") == 4
+    assert dt.stage_stat(1, "panes_advanced") == 3
+    assert dt.stage_stat(1, "wm_lag_panes") == 1       # level: latest
+    # out-of-range stage / unknown field read as 0, never raise
+    assert dt.stage_stat(2, "edge_demand") == 0
+    assert dt.stage_stat(1, "nope") == 0
+
+    rep = dt.report()
+    (st,) = rep["stages"]
+    assert st["stage"] == 1
+    assert st["totals"]["edge_demand"] == 130
+    assert st["levels"]["wm_lag_panes"] == 1
+    assert st["edge_lane_budget"] == 100
+    assert st["edge_peak_demand"] == 90                # per-drain peak
+    assert st["edge_utilization"] == 0.9
+    assert rep["stage_fields"] == list(STAGE_STAT_FIELDS)
+
+
+def test_stage_payload_sums_shards_and_accepts_2d():
+    dt = DrainTelemetry(2, 4, n_stages=2, exchange_lanes=0)
+    ss = _stage_payload(n_shards=2, edge_demand=10)
+    ss[0, 1, 0] = 30                                   # shard 1 demand
+    dt.absorb_stage_payload(ss)
+    assert dt.stage_stat(1, "edge_demand") == 40       # summed shards
+    # a [n_stages-1, K] payload (no shard axis) is promoted
+    dt.absorb_stage_payload(
+        np.full((1, len(STAGE_STAT_FIELDS)), 2, np.int32))
+    assert dt.stage_stat(1, "edge_demand") == 42
+    # zero budget: utilization is None, not a ZeroDivisionError
+    assert dt.report()["stages"][0]["edge_utilization"] is None
+
+
+def test_single_stage_report_has_no_stages_block():
+    dt = DrainTelemetry(1, 4)
+    rep = dt.report()
+    assert "stages" not in rep and "kg_heat" not in rep
+
+
+class _FakeTracer:
+    active = True
+
+    def __init__(self):
+        self.counters = []
+
+    def rec_counter(self, track, t, **values):
+        self.counters.append((track, values))
+
+
+def test_stage_payload_emits_per_stage_counter_tracks():
+    tr = _FakeTracer()
+    dt = DrainTelemetry(1, 4, tracer=tr, n_stages=3, exchange_lanes=8)
+    ss = np.zeros((2, 1, len(STAGE_STAT_FIELDS)), np.int32)
+    ss[:, 0, 1] = (4, 7)                               # edge_events
+    dt.absorb_stage_payload(ss)
+    tracks = dict(tr.counters)
+    assert set(tracks) == {"drain_stage1", "drain_stage2"}
+    assert tracks["drain_stage2"]["edge_lanes"] == 7
+    assert set(tracks["drain_stage1"]) == {
+        "edge_lanes", "fire_lanes", "wm_lag_panes",
+    }
+
+
+# ------------------------------------------------ key-group heat
+
+def test_kg_heat_ewma_recency_and_cold_tail():
+    dt = DrainTelemetry(1, 4, key_groups=8, kg_alpha=0.5)
+    assert dt.kg_heat_block()["available"] is False
+    fill = np.zeros(8, np.int64)
+    fill[2] = 100
+    dt.absorb_kg_fill(fill)
+    dt.absorb_kg_fill(fill)
+    # EWMA with alpha .5 over obs 100: 50 then 75
+    assert dt.kg_heat_max() == pytest.approx(75.0)
+    blk = dt.kg_heat_block(k=3)
+    assert blk["available"] and blk["samples"] == 2
+    assert blk["top"][0] == {
+        "group": 2, "heat": 75.0, "last_touched_ago": 0,
+    }
+    # only group 2 was ever touched: mean-over-touched == max
+    assert blk["skew_ratio"] == 1.0
+    assert blk["cold_tail"]["count"] == 7              # untouched tail
+    # now a second group goes hot-then-cold: recency ages out
+    fill2 = np.zeros(8, np.int64)
+    fill2[5] = 10
+    dt.absorb_kg_fill(fill2)
+    dt.absorb_kg_fill(np.zeros(8, np.int64))
+    blk = dt.kg_heat_block(k=8)
+    ago = {r["group"]: r["last_touched_ago"] for r in blk["top"]}
+    assert ago[5] == 1 and ago[2] == 2
+    assert dt.kg_heat_skew() > 1.0                     # 2 dominates 5
+
+
+def test_kg_heat_normalizes_by_batches_and_resizes():
+    dt = DrainTelemetry(1, 4, key_groups=4, kg_alpha=1.0)
+    dt.absorb_kg_fill(np.asarray([8, 0, 0, 0], np.int64), n_batches=4)
+    assert dt.kg_heat_max() == pytest.approx(2.0)      # per-batch obs
+    # a wider fill vector (elastic re-plan) resizes in place,
+    # preserving the existing prefix
+    dt.absorb_kg_fill(np.zeros(6, np.int64))
+    assert dt.kg_heat_block(k=1)["groups"] == 6
+    assert dt.kg_heat_max() == pytest.approx(2.0 * 0.0)  # alpha=1 decay

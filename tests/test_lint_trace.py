@@ -175,12 +175,44 @@ def test_drain_stats_compiles_out_byte_identical_to_pre_pr_ledger():
         "step.resident_drain.mask.hash.d4.dstats",
         "step.resident_drain.exchange.hash.d4.dstats",
         "step.sharded_drain.hash.d4.dstats",
+        "step.chained_drain.mask.hash.d4.s2.dstats",
+        "step.chained_drain.sharded.hash.d4.s2.dstats",
     }, on
     # the recorder is element-ops-only: an ON variant may not add a
     # single sort/scatter/gather pass over its OFF twin
     for name in sorted(on):
         off = live[name[: -len(".dstats")]]
         assert live[name] == off, (name, live[name], off)
+
+
+def test_stage_stats_compile_out_byte_identical_to_pre_pr_ledger():
+    """ISSUE 17 acceptance, the chained half of the frozen-golden
+    discipline: with ``observability.drain-stats`` off the CHAINED
+    drain kernels are the SAME programs as before the stage-aware
+    flight recorder existed — their op budgets must stay byte-identical
+    to the golden frozen at the PR boundary — and each chained
+    telemetry-ON twin must cost zero extra passes per op group (the
+    per-stage record is jnp.stack/sum/where element ops over planes
+    the edge pack already materialized)."""
+    golden_rel = "tools/lint/ledgers/op_budget_pre_stage_stats.json"
+    with open(os.path.join(ROOT, golden_rel)) as f:
+        golden = json.load(f)["families"]
+    with open(os.path.join(ROOT, LEDGERS[0])) as f:
+        live = json.load(f)["families"]
+    assert len(golden) == 3
+    for name, budget in sorted(golden.items()):
+        assert "dstats" not in name, name
+        assert name.startswith("step.chained_drain."), name
+        assert live.get(name) == budget, (
+            f"{name}: telemetry-OFF chained family drifted from the "
+            f"pre-stage-stats golden ({live.get(name)} != {budget}) — "
+            f"the stage payload no longer compiles out"
+        )
+    for name in ("step.chained_drain.mask.hash.d4.s2",
+                 "step.chained_drain.sharded.hash.d4.s2"):
+        assert live[name + ".dstats"] == live[name], (
+            name, live[name + ".dstats"], live[name]
+        )
 
 
 def test_no_family_crosses_the_host_or_widens():

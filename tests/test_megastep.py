@@ -435,7 +435,7 @@ def run_job(env, total, source=None, restore_from=None):
 
 
 def test_fused_executor_exact_and_actually_fused():
-    total = 16384
+    total = 8192
     env = build_env(2, **{"pipeline.steps-per-dispatch": K})
     got = run_job(env, total)
     assert got == expected(total)
@@ -477,7 +477,7 @@ def test_fused_crash_restore_exactly_once(tmp_path):
     K>1, restore, exactly-once counts: the snapshot cut is the offsets
     of the LAST batch of the last flushed group, so batches pending in
     the fused slot at the crash replay without double-counting."""
-    total = 16384
+    total = 8192
     env = build_env(
         2, tmp_path / "chk", interval=2, restart=3,
         **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
@@ -496,7 +496,7 @@ def test_fused_checkpoint_cadence_exact(tmp_path):
     3 micro-batches vs K=4): every trigger flushes the fused slot first,
     checkpoints get written, results stay exact, and fusion still
     happens between triggers."""
-    total = 16384
+    total = 8192
     env = build_env(
         2, tmp_path / "chk", interval=3,
         **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
@@ -538,7 +538,7 @@ def test_fused_fire_executor_exact_with_in_group_crossings():
     groups, fires surface from megastep payloads (lagged), results stay
     exact, and the groups really stay fused across the crossings (the
     split path would have broken every one)."""
-    total = 16384
+    total = 8192
     env = build_env(2, **{"pipeline.steps-per-dispatch": K})
     got = run_job(env, total, source=GeneratorSource(gen_fast, total=total))
     assert got == expected_fast(total)
@@ -565,7 +565,7 @@ def test_fused_fire_crash_restore_exactly_once_with_in_group_fire(tmp_path):
     to the megastep-boundary cut, unread in-flight fire payloads are
     discarded and re-fired from the replayed state, and the window
     counts come out exactly once."""
-    total = 16384
+    total = 8192
     env = build_env(
         2, tmp_path / "chk", interval=2, restart=3,
         **{"pipeline.prefetch": "on", "checkpoint.mode": "incremental",
@@ -624,7 +624,7 @@ def test_fused_fire_device_reduce_sink_exact():
     from flink_tpu.runtime.sinks import CountingSink
     from flink_tpu.runtime.sources import GeneratorSource
 
-    total = 16384
+    total = 8192
     env = build_env(2, **{"pipeline.steps-per-dispatch": K})
     sink = CountingSink()
     (
@@ -650,7 +650,7 @@ def test_fused_fire_spill_tier_exact():
     handle rides the fire payload for exactly this), or fired values
     silently lose their spilled shares."""
     N = 1500                      # ~3x the 2x256-slot table capacity
-    total = 16384
+    total = 8192
 
     def gen_spill(offset, n):
         idx = np.arange(offset, offset + n)

@@ -82,7 +82,7 @@ def test_happy_path_two_processes(cluster, tmp_path):
 
 
 def test_kill_worker_recovers_from_checkpoint(cluster, tmp_path):
-    total = 64_000
+    total = 32_000
     out = str(tmp_path / "out")
     chk = str(tmp_path / "chk")
     wid = cluster.submit(
@@ -130,7 +130,7 @@ def test_leader_failover_resumes_jobs(tmp_path):
     TM-task-cancellation-on-JM-loss + new-leader job recovery semantics.
     """
     ha = tmp_path / "ha"
-    total = 64_000
+    total = 32_000
     out = str(tmp_path / "out")
     chk = str(tmp_path / "chk")
 
@@ -192,7 +192,7 @@ def test_leader_failover_resumes_jobs(tmp_path):
 def test_heartbeat_timeout_detects_frozen_worker(cluster, tmp_path):
     """SIGSTOP freezes the process WITHOUT exiting: only the heartbeat
     path can detect it (the DeathWatch-distinct liveness signal)."""
-    total = 64_000
+    total = 32_000
     out = str(tmp_path / "out")
     chk = str(tmp_path / "chk")
     wid = cluster.submit(

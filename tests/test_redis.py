@@ -109,7 +109,7 @@ def test_windowed_aggregation_into_redis(server):
     from flink_tpu import StreamExecutionEnvironment
     from flink_tpu.runtime.sources import GeneratorSource
 
-    total, n_keys = 100_000, 500
+    total, n_keys = 50_000, 500
 
     def gen(offset, n):
         idx = np.arange(offset, offset + n, dtype=np.int64)
@@ -122,9 +122,9 @@ def test_windowed_aggregation_into_redis(server):
     from flink_tpu.core.time import TimeCharacteristic
 
     env = StreamExecutionEnvironment.get_execution_environment()
-    # parallelism 4: same keyed routing paths, half the shard compile
-    # cost (8-shard coverage lives in tests/test_exchange*.py)
-    env.set_parallelism(4)
+    # parallelism 2: same keyed routing paths, a quarter of the shard
+    # compile cost (8-shard coverage lives in tests/test_exchange*.py)
+    env.set_parallelism(2)
     env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
     sink = RedisSink(
         "127.0.0.1", server.port,

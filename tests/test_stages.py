@@ -287,3 +287,126 @@ def test_chain_rejects_trailing_keyed_stage_without_window():
     )
     with pytest.raises(StageGraphError, match="window aggregation"):
         env.execute("rolling-tail")
+
+
+# --------------------------- stage-aware flight recorder (ISSUE 17)
+
+def test_chained_drain_stats_stage_telemetry_end_to_end():
+    """ISSUE 17 acceptance: a 2-stage chained job with drain-stats on
+    stays bit-exact vs the host-chained oracle AND surfaces per-stage
+    edge-lane utilization, coupled-watermark lag, and kg-heat top-k at
+    /jobs/<jid>/pipeline; /jobs/<jid>/doctor serves the ranked-
+    findings engine over the same planes; the per-stage Perfetto
+    counter tracks and ``drain_stage1_*`` / ``kg_heat_*`` Prometheus
+    gauges ride beside the round-14 families."""
+    import json as _json
+    import urllib.request
+
+    from flink_tpu.runtime.cluster import MiniCluster
+    from flink_tpu.runtime.web import WebMonitor
+
+    def get_json(port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return _json.loads(r.read())
+
+    total = 4096
+    env = build_env(2, **{
+        **RESIDENT_CFG,
+        "observability.tracing": True,
+        "observability.drain-stats-every": 1,
+    })
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(W1)
+        .sum(lambda c: c["value"])
+        .key_by(lambda r: r.key)
+        .time_window(W2)
+        .sum(lambda r: r.value)
+        .add_sink(sink)
+    )
+    cluster = MiniCluster()
+    web = WebMonitor(cluster)
+    port = web.start()
+    try:
+        jid = cluster.submit(env, "chained-obs-job")
+        assert cluster.wait(jid, 240) == "FINISHED"
+        got = {(r.key, r.window_end_ms): r.value for r in sink.results}
+        assert got == expected(total)
+
+        # -- /pipeline: the stage-aware block next to the round-14 view
+        rep = get_json(port, f"/jobs/{jid}/pipeline")
+        assert rep["available"] is True
+        assert rep["drains"] > 0 and rep["payload_fetches"] > 0
+        (st,) = rep["stages"]
+        assert st["stage"] == 1
+        assert st["totals"]["edge_demand"] > 0
+        assert st["totals"]["edge_events"] > 0
+        assert st["totals"]["dropped_capacity"] == 0
+        assert st["totals"]["fire_lanes"] > 0
+        assert st["totals"]["panes_advanced"] > 0
+        assert st["edge_lane_budget"] > 0
+        assert 0.0 < st["edge_utilization"] <= 1.0
+        assert st["levels"]["wm_lag_panes"] >= 0
+        assert rep["stage_fields"][0] == "edge_demand"
+        # kg heat rides the same report (kg-stats defaults to tracing)
+        kg = rep["kg_heat"]
+        assert kg["available"] and kg["samples"] > 0
+        assert kg["top"][0]["heat"] > 0
+        assert kg["skew_ratio"] >= 1.0
+        assert 0.0 <= kg["cold_tail"]["fraction"] <= 1.0
+
+        # -- /doctor: the rule engine joins the same planes; this
+        # healthy run must NOT fire the edge/skew/compile rules, and
+        # the payload embeds its snapshot for CLI replay
+        doc = get_json(port, f"/jobs/{jid}/doctor")
+        assert doc["available"] is True and doc["version"] == 1
+        assert set(doc["rules"]) >= {
+            "ring-starved", "edge-lane-overflow", "kg-heat-skew",
+            "recompile-storm",
+        }
+        fired = {f["rule"] for f in doc["findings"]}
+        assert "edge-lane-overflow" not in fired
+        assert "recompile-storm" not in fired
+        snap = doc["snapshot"]
+        assert snap["pipeline"]["stages"][0]["totals"]["edge_demand"] \
+            == st["totals"]["edge_demand"]
+        assert "thresholds" in doc and doc["thresholds"]["kg_skew"] > 0
+
+        # -- Prometheus: per-stage + kg-heat gauge families
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        for f in ("edge_events", "fire_lanes", "dropped_capacity",
+                  "wm_lag_panes"):
+            assert (f'flink_tpu_drain_stage1_{f}'
+                    f'{{job="chained-obs-job"}}') in text
+        assert 'flink_tpu_kg_heat_max{job="chained-obs-job"}' in text
+        assert ('flink_tpu_kg_heat_skew_ratio{job="chained-obs-job"}'
+                in text)
+
+        # -- Perfetto: the drain_stage counter track beside the spans
+        tr = get_json(port, f"/jobs/{jid}/traces")
+        counters = [ev for ev in tr["traceEvents"] if ev["ph"] == "C"]
+        st_ev = next(ev for ev in counters
+                     if ev["name"] == "drain_stage1")
+        assert set(st_ev["args"]) == {
+            "edge_lanes", "fire_lanes", "wm_lag_panes",
+        }
+    finally:
+        web.stop()
+
+
+def test_chained_drain_stats_off_report_unavailable():
+    """Default config (no tracing): the chained kernels compile without
+    the stage payload and /pipeline stays unavailable — the OFF arity
+    contract the frozen op-budget golden pins at the kernel level."""
+    env = build_env(1, **RESIDENT_CFG)
+    got = run_job(env, 2048)
+    assert got == expected(2048)
+    rep = env._pipeline_report()
+    assert rep["available"] is False and "reason" in rep

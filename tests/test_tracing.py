@@ -218,7 +218,7 @@ def test_drain_stats_gating():
         # fetch the payload on every drain: short jobs drain only a
         # handful of times, far fewer than the default sampling stride
         "observability.drain-stats-every": 1,
-    }, total=16384)
+    }, total=8192)
     env.execute("drain-only")
     assert env._span_tracer is None
     rep = env._pipeline_report()
@@ -229,7 +229,7 @@ def test_drain_stats_gating():
     assert rep["shards"][0]["occupancy"]
 
     # default (tracing off): the recorder never instantiates
-    env2, _ = _windowed_env(resident, total=16384)
+    env2, _ = _windowed_env(resident, total=4096)
     env2.execute("drain-default")
     rep2 = env2._pipeline_report()
     assert rep2["available"] is False and "reason" in rep2
@@ -315,7 +315,7 @@ def test_web_job_scoped_endpoints_404_unknown_job():
             "/jobs/nope/metrics", "/jobs/nope/checkpoints/config",
             "/jobs/nope/plan", "/jobs/nope/exceptions",
             "/jobs/nope/recovery", "/jobs/nope/elasticity",
-            "/jobs/nope/pipeline",
+            "/jobs/nope/pipeline", "/jobs/nope/doctor",
         ):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get_json(port, path)

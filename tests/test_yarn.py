@@ -175,7 +175,7 @@ def test_session_deploy_job_and_teardown(rm, tmp_path):
 def test_container_death_rerequests_and_job_recovers(rm, tmp_path):
     desc = YarnClusterDescriptor(rm.url)
     client = desc.deploy_session_cluster("recovery-session")
-    total = 64_000
+    total = 32_000
     out = str(tmp_path / "out")
     chk = str(tmp_path / "chk")
     wid = client.submit_job(
@@ -292,7 +292,7 @@ def test_am_restart_recovers_jobs_exactly_once(rm, tmp_path):
         rm.url, max_app_attempts=2, am_ha_dir=str(tmp_path / "ha"),
     )
     client = desc.deploy_session_cluster("ha-session")
-    total = 64_000
+    total = 32_000
     out = str(tmp_path / "out")
     chk = str(tmp_path / "chk")
     wid = client.submit_job(

@@ -72,6 +72,11 @@ HOT_PATHS = (
     # same standard as the kernels it observes. (The publish-time stamps
     # stay inside ingest.py's two allowlisted ingest-thread blocks.)
     "flink_tpu/metrics/drain_stats.py",
+    # pipeline doctor rule engine (ISSUE 17): pure dict arithmetic over
+    # already-assembled snapshots — a rule that synced the device would
+    # turn a diagnostics scrape into a pipeline stall, so the module is
+    # held to hot-path discipline alongside drain_stats.py
+    "flink_tpu/metrics/doctor.py",
     # stage-graph planner (ISSUE 16): setup-time only, but its plan
     # products (specs, codecs, snapshot/restore payloads) feed the
     # chained drain directly — hold it to hot-path discipline so no
