@@ -2198,6 +2198,124 @@ def run_scaling_cell(total_events: int):
         round(p99, 2) if p99 is not None else None)
 
 
+def run_tiered(total_events: int, cpu: bool):
+    """Tiered key-group state under a cold-tail working set (ISSUE 18):
+    the same Zipf-skewed keyed windowed sum run twice through the full
+    executor — once all-resident (the baseline every earlier PR ships)
+    and once with ``state.tiers.resident-key-groups`` capping the HBM
+    hot set at BUDGET of MAXP key-groups (~13x more groups than the
+    budget, inside the >= 10x acceptance floor). The stream is the shape
+    the
+    tier exists for: a handful of Zipf-hot keys carry ~90%% of the
+    traffic and hash into few enough groups to fit the budget, while
+    the cold tail sprays the whole group space — so the manager must
+    keep the hot set pinned, demote the tail to the host pane stores,
+    and promote ahead of each pane close off the watermark.
+
+    subject = tiered eps, baseline = all-resident eps; the acceptance
+    fraction (>= 0.6x all-resident) stamps in the detail JSON next to
+    p99 fire latency and the prefetch hit/miss counters pulled from the
+    job's tiers report."""
+    from flink_tpu import StreamExecutionEnvironment
+    from flink_tpu.core.config import Configuration
+    from flink_tpu.core.time import TimeCharacteristic
+    from flink_tpu.runtime.sinks import CountingSink
+    from flink_tpu.runtime.sources import GeneratorSource
+
+    MAXP, BUDGET = 64, 5
+    N_KEYS = 4096
+    WINDOW_MS = 1000
+    BATCH = 32768
+    ZIPF_A = 2.5
+    total = int(min(total_events, 2_000_000))
+
+    # Zipf(2.5) key pool, drawn once: top-4 keys ~95% of traffic; the
+    # rest spreads over N_KEYS keys -> all MAXP key-groups get touched
+    rng = np.random.default_rng(7)
+    pool = (np.minimum(rng.zipf(ZIPF_A, size=total), N_KEYS) - 1).astype(
+        np.int64)
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n)
+        cols = {
+            "key": pool[offset:offset + n],
+            "value": np.ones(n, np.float32),
+        }
+        # one pane per batch: steady watermark advance -> ~60 pane
+        # closes over the run, each a promote-ahead opportunity
+        return cols, (idx // (BATCH // 8)) * (WINDOW_MS // 8)
+
+    def run(budget):
+        # best-of-2 per config: the first rep pays the XLA compiles
+        # (the tiered build is a distinct kernel family, so compile
+        # cost would otherwise masquerade as tier overhead); the claim
+        # is SUSTAINED throughput, which is the second rep
+        opts = {}
+        if budget:
+            opts = {"state.tiers.resident-key-groups": budget}
+        best = None
+        for _ in range(2):
+            env = StreamExecutionEnvironment(Configuration(opts))
+            env.set_parallelism(1)
+            env.set_max_parallelism(MAXP)
+            env.set_stream_time_characteristic(
+                TimeCharacteristic.EventTime)
+            env.set_state_capacity(1 << 14)
+            env.batch_size = BATCH
+            sink = CountingSink()
+            t0 = time.perf_counter()
+            (
+                env.add_source(GeneratorSource(gen, total=total))
+                .key_by(lambda c: c["key"])
+                .time_window(WINDOW_MS)
+                .sum(lambda c: c["value"])
+                .add_sink(sink)
+            )
+            job = env.execute(f"tiered-bench-budget{budget}")
+            dt = time.perf_counter() - t0
+            assert sink.value_sum == total, (sink.value_sum, total)
+            if best is not None and total / dt <= best["events_per_s"]:
+                continue
+            p99 = job.metrics.fire_latency_pct(99)
+            rep = env._pipeline_report()
+            best = {
+                "events_per_s": total / dt,
+                "p99_fire_ms": (round(p99, 2) if p99 is not None
+                                else None),
+                "tiers": (rep.get("tiers") if isinstance(rep, dict)
+                          else None),
+            }
+        best["events_per_s"] = round(best["events_per_s"])
+        return best
+
+    base = run(0)
+    tiered = run(BUDGET)
+    ratio = tiered["events_per_s"] / max(base["events_per_s"], 1)
+    detail = {
+        "events": total, "batch": BATCH, "n_keys": N_KEYS,
+        "max_parallelism": MAXP, "resident_budget": BUDGET,
+        "group_to_budget_ratio": round(MAXP / BUDGET, 1),
+        "zipf_a": ZIPF_A,
+        "all_resident": base,
+        "tiered": tiered,
+        "acceptance": {
+            "ratio": round(ratio, 3),
+            "criterion": ">= 0.6 of all-resident throughput at >= 10x "
+                         "more key-groups than the resident budget",
+        },
+    }
+    print(json.dumps({"config": "tiered_state", "detail": detail}),
+          flush=True)
+    t = tiered["tiers"] or {}
+    return (tiered["events_per_s"], base["events_per_s"],
+            tiered["p99_fire_ms"],
+            {"prefetch_hits": int(t.get("prefetch_hits", 0)),
+             "prefetch_misses": int(t.get("prefetch_misses", 0)),
+             "demotes": int(t.get("demotes", 0)),
+             "promotes": int(t.get("promotes", 0)),
+             "tier_faults": int(t.get("faults", 0))})
+
+
 CONFIGS = {
     "socket_wc": (run_socket_wc, 2_000_000),
     "count_min": (run_count_min, 4_000_000),

@@ -331,6 +331,27 @@ class CoreOptions:
         "scatter passes dominate; CPU keeps split planes (the wider "
         "sweep costs more than the scatter it saves — measured in "
         "device_update_ceiling)")
+    # tiered key-group state (round 18): HBM-resident hot set over the
+    # host spill tier, watermark-driven prefetch (docs/state-tiers.md)
+    STATE_TIERS_RESIDENT_KEY_GROUPS = ConfigOption(
+        "state.tiers.resident-key-groups", 0,
+        "key-groups kept HBM-resident per shard (0 = tiering off, every "
+        "group resident). Cold groups demote to the host spill tier and "
+        "promote back ahead of their predicted next fire; a batch "
+        "routing into a non-resident group rides the overflow ring for "
+        "that batch only (never lossy, counted in tier_faults). "
+        "Requires a spill-tier-eligible stage (builtin float32 reduce, "
+        "allowed lateness 0, no chained stages) with an overflow ring")
+    STATE_TIERS_PREFETCH_AHEAD_PANES = ConfigOption(
+        "state.tiers.prefetch-ahead-panes", 2,
+        "promote a cold key-group once its earliest pending pane is "
+        "within this many panes of the watermark — the window fire it "
+        "predicts then comes off the device instead of a host merge")
+    STATE_TIERS_MIN_DWELL_CYCLES = ConfigOption(
+        "state.tiers.min-dwell-cycles", 4,
+        "poll cycles a key-group must stay in its tier before the "
+        "ranker may flip it again (hysteresis against promote/demote "
+        "thrash; an imminent-fire promote overrides it)")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)
@@ -489,6 +510,16 @@ class CoreOptions:
         "observability.doctor.kg-skew-threshold", 4.0,
         "key-group heat max/mean ratio above which the doctor flags a "
         "shard re-slice candidate")
+    DOCTOR_TIER_CHURN_THRESHOLD = ConfigOption(
+        "observability.doctor.tier-churn-threshold", 0.5,
+        "tier swaps (promotes+demotes) per resident drain above which "
+        "the doctor reports tier-thrash (the residency budget is "
+        "fighting the working set)")
+    DOCTOR_TIER_MISS_THRESHOLD = ConfigOption(
+        "observability.doctor.tier-miss-threshold", 0.5,
+        "prefetch-miss fraction (misses / (hits+misses)) above which "
+        "the doctor reports tier-thrash — promotions arrive after the "
+        "traffic they predicted")
     DOCTOR_RECOMPILE_THRESHOLD = ConfigOption(
         "observability.doctor.recompile-threshold", 8,
         "steady-state XLA compiles beyond which the doctor reports a "

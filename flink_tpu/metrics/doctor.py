@@ -59,6 +59,12 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # steady-bucket XLA compiles beyond which something recompiles
     # per batch (steady state dispatches pre-compiled steps only)
     "recompile": 8,
+    # tier swaps (demotes + promotes) per drain above which residency
+    # churns faster than the working set justifies
+    "tier_churn": 0.5,
+    # prefetch-miss fraction above which the prefetcher promotes
+    # groups that never get touched before re-demotion
+    "tier_miss": 0.5,
 }
 
 
@@ -322,6 +328,62 @@ def _rule_watchdog_trips(snap, th):
     )
 
 
+def _rule_tier_thrash(snap, th):
+    pipe = snap.get("pipeline") or {}
+    tiers = pipe.get("tiers")
+    if not tiers:
+        return None
+    swaps = int(tiers.get("demotes", 0)) + int(tiers.get("promotes", 0))
+    hits = int(tiers.get("prefetch_hits", 0))
+    misses = int(tiers.get("prefetch_misses", 0))
+    m = snap.get("metrics") or {}
+    drains = (int(m.get("resident_drains", 0))
+              + int(m.get("steps", 0))
+              + int(m.get("fused_dispatches", 0)))
+    churn = swaps / drains if drains > 0 else 0.0
+    miss_frac = misses / (hits + misses) if (hits + misses) > 0 else 0.0
+    churny = drains > 0 and churn >= th["tier_churn"]
+    missy = (hits + misses) >= 4 and miss_frac >= th["tier_miss"]
+    if not (churny or missy):
+        return None
+    if churny:
+        summary = (
+            f"tiered state is thrashing: {swaps} residency swap(s) over "
+            f"{drains} dispatch(es) ({churn:.2f}/dispatch >= "
+            f"{th['tier_churn']}) — demote/promote splices burn host-"
+            f"device copies faster than the working set justifies"
+        )
+        score = churn
+    else:
+        summary = (
+            f"tier prefetch is mispredicting: {misses}/{hits + misses} "
+            f"promoted group(s) were never touched before re-demotion "
+            f"({miss_frac:.0%} >= {th['tier_miss']:.0%})"
+        )
+        score = miss_frac
+    return _finding(
+        "tier-thrash", "warning", score, summary,
+        {
+            "churn_threshold": th["tier_churn"],
+            "miss_threshold": th["tier_miss"],
+            "demotes": int(tiers.get("demotes", 0)),
+            "promotes": int(tiers.get("promotes", 0)),
+            "dispatches": drains,
+            "prefetch_hits": hits,
+            "prefetch_misses": misses,
+            "tier_faults": int(tiers.get("faults", 0)),
+            "budget_per_shard": tiers.get("budget_per_shard"),
+            "resident_groups": tiers.get("resident_groups"),
+            "cold_groups_pending": tiers.get("cold_groups_pending"),
+        },
+        "state.tiers.resident-key-groups",
+        "raise state.tiers.resident-key-groups so the hot set fits, or "
+        "raise state.tiers.min-dwell-cycles to damp the churn; if the "
+        "misses dominate, lower state.tiers.prefetch-ahead-panes so "
+        "promotion waits for firmer watermark evidence",
+    )
+
+
 _RULES: List[Callable] = [
     _rule_ring_starved,
     _rule_device_saturated,
@@ -331,6 +393,7 @@ _RULES: List[Callable] = [
     _rule_checkpoint_budget_burn,
     _rule_ring_refusals,
     _rule_watchdog_trips,
+    _rule_tier_thrash,
 ]
 
 RULE_NAMES = tuple(

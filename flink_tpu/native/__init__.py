@@ -12,6 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import zlib
 from typing import Optional, Tuple
 
 import numpy as np
@@ -285,16 +286,70 @@ class SpillStore:
         )
         return keys[:got], vals[:got]
 
+    # checksummed dump file: magic + width/count header, CRC32 over the
+    # key and value payload bytes. A torn or bit-flipped spill dump must
+    # surface as a clean OSError at load — the caller falls back to
+    # re-seeding from the logical snapshot — never as silently wrong
+    # window state (the pre-CRC native format restored whatever bytes
+    # the file held).
+    _SAVE_MAGIC = b"SPL2"
+
     def save(self, path: str):
-        if not self._lib.spill_save(self._h, path.encode()):
-            raise OSError(f"spill save failed: {path}")
+        keys, vals = self.dump()
+        kb = np.ascontiguousarray(keys, np.uint64).tobytes()
+        vb = np.ascontiguousarray(vals, np.float32).tobytes()
+        crc = zlib.crc32(kb)
+        crc = zlib.crc32(vb, crc)
+        header = self._SAVE_MAGIC + np.asarray(
+            [self.width, len(keys), crc], np.uint64
+        ).tobytes()
+        try:
+            with open(path, "wb") as f:
+                f.write(header)
+                f.write(kb)
+                f.write(vb)
+        except OSError as e:
+            raise OSError(f"spill save failed: {path}: {e}") from e
 
     @classmethod
     def load(cls, path: str) -> "SpillStore":
-        h = get_lib().spill_load(path.encode())
-        if not h:
-            raise OSError(f"spill load failed: {path}")
-        return cls(_handle=h)
+        from flink_tpu.testing import faults
+
+        faults.inject("ckpt.spill.read", path=path)
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise OSError(f"spill load failed: {path}: {e}") from e
+        m = len(cls._SAVE_MAGIC)
+        if len(blob) < m + 24 or blob[:m] != cls._SAVE_MAGIC:
+            raise OSError(f"spill load failed: {path}: bad header")
+        width, count, crc = (
+            int(v) for v in np.frombuffer(blob[m:m + 24], np.uint64)
+        )
+        kb_end = m + 24 + count * 8
+        vb_end = kb_end + count * width * 4
+        if len(blob) != vb_end:
+            raise OSError(
+                f"spill load failed: {path}: truncated "
+                f"({len(blob)} bytes, expected {vb_end})"
+            )
+        got = zlib.crc32(blob[m + 24:kb_end])
+        got = zlib.crc32(blob[kb_end:vb_end], got)
+        if got != crc:
+            raise OSError(
+                f"spill load failed: {path}: checksum mismatch "
+                f"(stored {crc:#x}, computed {got:#x})"
+            )
+        store = cls(width=width, initial_capacity=max(16, count * 2))
+        if count:
+            store.put(
+                np.frombuffer(blob[m + 24:kb_end], np.uint64),
+                np.frombuffer(blob[kb_end:vb_end], np.float32).reshape(
+                    count, width
+                ),
+            )
+        return store
 
 
 def parse_ts_words(data, cap: Optional[int] = None

@@ -590,6 +590,7 @@ def update(
     precombine: bool = False,
     kg_fill: int = 0,
     clear_rows=None,
+    kg_res=None,
 ):
     """Apply one micro-batch of records to shard state (pure function).
 
@@ -641,7 +642,22 @@ def update(
     the accumulator's trailing column, so the value scatter and the
     ring-reset/purge sweeps maintain both planes in one pass and the
     separate touched scatter disappears.
+
+    ``kg_res`` (bool ``[max_parallelism]``, tiered key-group state —
+    ``state.tiers.*``) is this shard's HBM-residency mask: lanes whose
+    key group reads False never touch the table or accumulators — they
+    fall straight down the overflow ring to the host spill tier, which
+    owns cold-group state. The mask is a plain operand, so the compiled
+    step is shape-stable as residency changes; diversion is NEVER lossy
+    (only ring exhaustion drops, same as any overflow) and requires
+    ``win.overflow > 0`` for exactly that reason.
     """
+    if kg_res is not None and not win.overflow:
+        raise ValueError(
+            "kg_res (tiered residency) requires an overflow ring "
+            "(win.overflow > 0): non-resident lanes divert to the "
+            "host spill tier through it"
+        )
     C = state.table.capacity
     R = win.ring
     k = win.panes_per_window
@@ -741,14 +757,35 @@ def update(
             f"kg_fill group count {kg_fill} != changelog group count {KG}"
         )
     pre = precombine and red.kind in ("sum", "min", "max", "count")
-    if (KG or kg_fill) and kg is None:
-        kg = assign_to_key_group(route_hash(hi, lo, jnp), KG or kg_fill, jnp)
+    n_groups = KG or kg_fill or (
+        kg_res.shape[0] if kg_res is not None else 0
+    )
+    if kg_res is not None and (KG or kg_fill) and \
+            kg_res.shape[0] != (KG or kg_fill):
+        raise ValueError(
+            f"kg_res group count {kg_res.shape[0]} != "
+            f"changelog/kg_fill group count {KG or kg_fill}"
+        )
+    if n_groups and kg is None:
+        kg = assign_to_key_group(route_hash(hi, lo, jnp), n_groups, jnp)
     if KG and not pre:
         kg_dirty = state.kg_dirty.at[
             jnp.where(live, kg.astype(jnp.int32), jnp.int32(KG))
         ].set(True, mode="drop")
     else:
         kg_dirty = state.kg_dirty
+
+    # -- tiered residency (state.tiers.*): divert lanes whose key group
+    # is cold BEFORE the upsert — they must not claim table slots, and
+    # `activity` must stay a pure hot-tier signal (a cold-group burst
+    # may not flip the executor's insert/fast step tiering). The dirty
+    # marking above deliberately still covers them: their spill-side
+    # state changes under the same group.
+    if kg_res is not None:
+        tier_nonres = live & ~kg_res[kg.astype(jnp.int32)]
+        live = live & ~tier_nonres
+    else:
+        tier_nonres = None
 
     # -- key upsert / lookup ------------------------------------------------
     # activity = lanes the CURRENT mode failed to handle natively:
@@ -776,6 +813,11 @@ def update(
         ok = found & live
         nofit = live & ~ok
         activity = jnp.sum(nofit, dtype=jnp.int32)
+    if tier_nonres is not None:
+        # cold-group lanes ride the same overflow ring as capacity
+        # overcommit: appended (key, pane, value), host-merged into the
+        # spill tier, merged back into emissions at fire — lossless
+        nofit = nofit | tier_nonres
     live = live & ok
 
     # -- overflow ring: nofit records append (key, pane, value) for the

@@ -79,6 +79,7 @@ from flink_tpu.runtime.step import (
     init_sharded_state,
 )
 from flink_tpu.runtime import checkpoint as ckpt
+from flink_tpu.runtime import tiers as tiers_mod
 from flink_tpu.runtime.cluster import JobCancelledException
 from flink_tpu.runtime.union import to_elements
 from flink_tpu.runtime.watchdog import WatchdogError, watchdog_from_config
@@ -1736,6 +1737,18 @@ class LocalExecutor:
             pp_cfg == "auto" and jax.default_backend() != "cpu"
             and wk.packed_eligible(red)
         )
+        # -- tiered key-group state (state.tiers.resident-key-groups):
+        # a per-shard budget caps how many key-groups keep device slot
+        # rows; the rest live in the host pane stores and ride the
+        # overflow ring until promoted. The manager is created in
+        # setup() (key-group ranges come from the mesh) and SURVIVES
+        # re-plans via rescale() so fault/churn counters span the job.
+        tier_budget_cfg = int(
+            env.config.get(_CoreOpts.STATE_TIERS_RESIDENT_KEY_GROUPS)
+        )
+        use_tiers = [False]
+        tier_mgr = [None]
+        tier_mask_dev = [None]    # device replica of the residency mask
         exchange_cap = [0]        # per-(src,dst) bucket lanes of the exchange
         force_route = [None]      # warmup override
         fire_step = None
@@ -1859,6 +1872,20 @@ class LocalExecutor:
                 stride = -(-MON_EVERY // grp_k) * grp_k
                 auto = (stride * (OVF_LAG + 1) + 4 + grp_k) * B + 8192
                 ovf = ovf_cfg if ovf_cfg >= 0 else auto
+            # tiered state rides the spill tier: a non-resident lane
+            # diverts to the overflow ring and folds into the same host
+            # pane stores, so every spill-tier precondition is a tier
+            # precondition too (and the ring must actually exist)
+            if tier_budget_cfg > 0 and not (spillable and ovf):
+                raise ValueError(
+                    "state.tiers.resident-key-groups is set but this "
+                    "window stage cannot run tiered state (requires the "
+                    "spill tier: a builtin float32 sum/count/min/max "
+                    "reduce without finalize, allowed lateness 0, no "
+                    "chained stage graph, and a non-zero overflow "
+                    "ring); unset it to keep every key-group resident"
+                )
+            use_tiers[0] = tier_budget_cfg > 0
             win = wk.WindowSpec(
                 size_ticks=size_ms, slide_ticks=slide_ms,
                 ring=ring,
@@ -1892,6 +1919,45 @@ class LocalExecutor:
             )
             metrics.state_layout = layout[0]
             metrics.state_packed_planes = use_packed
+            if use_tiers[0]:
+                starts_t, ends_t = ctx.kg_bounds()
+                if tier_mgr[0] is None:
+                    tier_mgr[0] = tiers_mod.TierManager(
+                        ctx.max_parallelism, starts_t, ends_t,
+                        tier_budget_cfg,
+                        prefetch_ahead_panes=int(env.config.get(
+                            _CoreOpts.STATE_TIERS_PREFETCH_AHEAD_PANES
+                        )),
+                        min_dwell_cycles=int(env.config.get(
+                            _CoreOpts.STATE_TIERS_MIN_DWELL_CYCLES
+                        )),
+                    )
+                else:
+                    # elastic re-plan / restore: re-slice residency to
+                    # the new shard ranges, keep the job-lifetime
+                    # counters (faults/churn feed the doctor rule)
+                    tier_mgr[0].rescale(starts_t, ends_t)
+                tier_mask_dev[0] = jnp.asarray(tier_mgr[0].mask())
+                if self._job_group is not None:
+                    grp_t = self._job_group
+
+                    def _tier_ctr(field):
+                        tm = tier_mgr[0]
+                        return int(getattr(tm, field)) if tm else 0
+
+                    def _tier_res():
+                        tm = tier_mgr[0]
+                        return tm.resident_groups() if tm else 0
+
+                    # idempotent like the drain gauges (register
+                    # overwrites), re-run per setup for elastic re-plans
+                    grp_t.gauge("tier_resident_groups", _tier_res)
+                    grp_t.gauge("tier_faults",
+                                partial(_tier_ctr, "tier_faults"))
+                    grp_t.gauge("tier_prefetch_hits",
+                                partial(_tier_ctr, "prefetch_hits"))
+                    grp_t.gauge("tier_prefetch_misses",
+                                partial(_tier_ctr, "prefetch_misses"))
             if graph is not None:
                 # plan the downstream stages off stage 0's spec (identity
                 # re-key: every stage shares the codec/layout/capacity,
@@ -1969,9 +2035,11 @@ class LocalExecutor:
                     steps_by_route["mask"] = {
                         "insert": build_window_update_step(
                             ctx, spec, kg_fill=kg_stats_on,
+                            tiered=use_tiers[0],
                         ),
                         "fast": build_window_update_step(
                             ctx, spec, insert=False, kg_fill=kg_stats_on,
+                            tiered=use_tiers[0],
                         ) if build_fast else None,
                     }
                 if want_ex:
@@ -1980,12 +2048,13 @@ class LocalExecutor:
                                                 2.0)
                     ex_insert = build_window_update_step_exchange(
                         ctx, spec, bpd, capf, kg_fill=kg_stats_on,
+                        tiered=use_tiers[0],
                     )
                     steps_by_route["exchange"] = {
                         "insert": ex_insert,
                         "fast": build_window_update_step_exchange(
                             ctx, spec, bpd, capf, insert=False,
-                            kg_fill=kg_stats_on,
+                            kg_fill=kg_stats_on, tiered=use_tiers[0],
                         ) if build_fast else None,
                     }
                     exchange_cap[0] = ex_insert.bucket_cap
@@ -2024,21 +2093,23 @@ class LocalExecutor:
                         megasteps_by_route["mask"] = {
                             "insert": mk_mask(
                                 ctx, spec, k_fuse, kg_fill=kg_stats_on,
+                                tiered=use_tiers[0],
                             ),
                             "fast": mk_mask(
                                 ctx, spec, k_fuse, insert=False,
-                                kg_fill=kg_stats_on,
+                                kg_fill=kg_stats_on, tiered=use_tiers[0],
                             ) if build_fast else None,
                         }
                     if "exchange" in steps_by_route:
                         megasteps_by_route["exchange"] = {
                             "insert": mk_ex(
                                 ctx, spec, bpd, k_fuse, capf,
-                                kg_fill=kg_stats_on,
+                                kg_fill=kg_stats_on, tiered=use_tiers[0],
                             ),
                             "fast": mk_ex(
                                 ctx, spec, bpd, k_fuse, capf,
                                 insert=False, kg_fill=kg_stats_on,
+                                tiered=use_tiers[0],
                             ) if build_fast else None,
                         }
                 if use_resident and graph is not None:
@@ -2102,11 +2173,13 @@ class LocalExecutor:
                                 ctx, spec, ring_depth,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ),
                             "fast": build_window_resident_drain(
                                 ctx, spec, ring_depth, insert=False,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ) if build_fast else None,
                         }
                     if "exchange" in steps_by_route:
@@ -2115,12 +2188,14 @@ class LocalExecutor:
                                 ctx, spec, bpd, ring_depth, capf,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ),
                             "fast": build_window_resident_drain_exchange(
                                 ctx, spec, bpd, ring_depth, capf,
                                 insert=False, kg_fill=kg_stats_on,
                                 reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ) if build_fast else None,
                         }
                     if use_dp:
@@ -2140,11 +2215,13 @@ class LocalExecutor:
                                 ctx, spec, ring_depth,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ),
                             "fast": build_window_sharded_drain(
                                 ctx, spec, ring_depth, insert=False,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
+                                tiered=use_tiers[0],
                             ) if build_fast else None,
                         }
                         if self._job_group is not None:
@@ -3581,11 +3658,16 @@ class LocalExecutor:
             attribution verdict)."""
             dt = drain_telem[0]
             if dt is None:
-                return {
+                rep = {
                     "available": False,
                     "reason": "observability.drain-stats off or the "
                               "resident loop is not active",
                 }
+                # the tiers block does not need the recorder: tiered
+                # jobs stay observable with drain-stats off
+                if tier_mgr[0] is not None:
+                    rep["tiers"] = tier_mgr[0].report()
+                return rep
             try:
                 dr = ingest.device_ring
             except NameError:
@@ -3594,6 +3676,8 @@ class LocalExecutor:
                 refusals=dr.refusals() if dr is not None else None
             )
             rep["drain_stats_every"] = drain_stats_every
+            if tier_mgr[0] is not None:
+                rep["tiers"] = tier_mgr[0].report()
             if self._attribution is not None:
                 rep["classification"] = self._attribution.classify()
             return rep
@@ -3663,6 +3747,10 @@ class LocalExecutor:
                     _CoreOpts.DOCTOR_KG_SKEW_THRESHOLD),
                 "recompile": env.config.get(
                     _CoreOpts.DOCTOR_RECOMPILE_THRESHOLD),
+                "tier_churn": env.config.get(
+                    _CoreOpts.DOCTOR_TIER_CHURN_THRESHOLD),
+                "tier_miss": env.config.get(
+                    _CoreOpts.DOCTOR_TIER_MISS_THRESHOLD),
             }
             payload = diagnose(snapshot, thresholds)
             payload["snapshot"] = snapshot
@@ -3767,6 +3855,12 @@ class LocalExecutor:
                 ingest.plan, hi[:n_valid], lo[:n_valid]
             )
 
+        def _tier_args():
+            # trailing residency-mask operand of every tiered kernel —
+            # data, not structure: a demote/promote swaps the device
+            # array, never the compiled step
+            return (tier_mask_dev[0],) if use_tiers[0] else ()
+
         def run_update(hi, lo, ticks, values, valid, wm_ms, staged=None,
                        route=None):
             """Dispatch one update-only device step. No host sync: the
@@ -3834,13 +3928,13 @@ class LocalExecutor:
                     staged = s_args
             if staged is not None:
                 state, (ovf_handle, act_handle, kgf_handle) = active(
-                    state, *staged, wmv,
+                    state, *staged, wmv, *_tier_args(),
                 )
             else:
                 state, (ovf_handle, act_handle, kgf_handle) = active(
                     state, jnp.asarray(hi), jnp.asarray(lo),
                     jnp.asarray(ticks), jnp.asarray(values),
-                    jnp.asarray(valid), wmv,
+                    jnp.asarray(valid), wmv, *_tier_args(),
                 )
             # dispatch normally returns immediately; it BLOCKS when the
             # device pipeline is saturated -> the device-bound signal.
@@ -3971,7 +4065,7 @@ class LocalExecutor:
                 # monotone until a host drain, so the post-scan value
                 # can never under-report the fill at fire time).
                 state, (ovf_handle, act_handle, kgf_handle), fires = \
-                    active(state, *flat, wmv)
+                    active(state, *flat, wmv, *_tier_args())
                 # no drain-stats lane on megasteps (resident drains only)
                 fire_watch.append(
                     (fires, ovf_handle, time.perf_counter(), None)
@@ -3979,7 +4073,7 @@ class LocalExecutor:
                 metrics.fused_fire_dispatches += 1
             else:
                 state, (ovf_handle, act_handle, kgf_handle) = active(
-                    state, *flat, wmv,
+                    state, *flat, wmv, *_tier_args(),
                 )
             inflight.append(act_handle)
             if len(inflight) > max_inflight:
@@ -4108,7 +4202,7 @@ class LocalExecutor:
                     (ovf_handle, act_handle, kgf_handle), fires = \
                         res[1], res[2]
                 else:
-                    res = active(state, *flat, wmv, cnt)
+                    res = active(state, *flat, wmv, cnt, *_tier_args())
                     # telemetry-ON drains return a 4th element: the
                     # [n_shards, D, len(DRAIN_STAT_FIELDS)] flight-
                     # recorder payload. Its handle is kept every
@@ -4374,6 +4468,12 @@ class LocalExecutor:
                 dt_kg = drain_telem[0]
                 if dt_kg is not None:
                     dt_kg.absorb_kg_fill(kg_sum, n_batches)
+                if tier_mgr[0] is not None:
+                    # tier fault accounting rides the SAME sampled
+                    # vector: traffic into a non-resident group = a
+                    # batch that fell down the route ladder (documented
+                    # sampled, like every MON_EVERY-cadence counter)
+                    tier_mgr[0].note_sample(kg_sum)
             # -- adaptive step tiering: while new keys are being PLACED,
             # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
@@ -4454,6 +4554,16 @@ class LocalExecutor:
                 old, found = store.get(uk)
                 merged = np.where(found[:, None], host_combine(old, agg), agg)
                 store.put(uk, merged)
+            if tier_mgr[0] is not None:
+                # pending-pane index for the prefetcher: every ring lane
+                # that just folded cold is a (key-group, pane) the
+                # watermark will eventually fire
+                tier_mgr[0].note_cold(
+                    tiers_mod.entries_key_groups(
+                        {"key_hi": hi, "key_lo": lo}, ctx.max_parallelism
+                    ),
+                    panes,
+                )
             state = clear_overflow(state)
             return True
 
@@ -4518,6 +4628,194 @@ class LocalExecutor:
             cutoff = min(host_fired_pane, wm_pane_l)
             for q in [q for q in ovf_stores if q + k - 1 <= cutoff]:
                 ovf_stores.pop(q).close()
+            if tier_mgr[0] is not None:
+                # same horizon for the prefetcher's pending-pane index
+                tier_mgr[0].prune_cold(cutoff - k + 1)
+
+        def _apply_tier_plan(plan):
+            """Demote/promote swap at the exactly-once cut: move the
+            affected key-groups' logical entries between device slot
+            rows and host pane stores, then re-splice each touched
+            shard in place (the warm-restore splice machinery).
+            Correctness is residency-INVARIANT — a (key, pane)'s
+            pending state may legally split across both tiers (the
+            mid-pane-fill overflow path already does) and fire/
+            checkpoint/restore compose the halves — so the swap is
+            purely a placement action; a crash anywhere inside it
+            restores bit-exact from the last cut. The one ordering
+            obligation: pending fire payloads were computed against
+            the CURRENT placement, so they are consumed before any
+            entry moves (a window must never merge the same entry
+            from both tiers)."""
+            nonlocal state
+            tm = tier_mgr[0]
+            by_shard = {}
+            for g in plan.demote:
+                by_shard.setdefault(tm.shard_of(g), ([], []))[0].append(g)
+            for g in plan.promote:
+                by_shard.setdefault(tm.shard_of(g), ([], []))[1].append(g)
+            if by_shard:
+                flush_fused()
+                consume_fires(force=True)
+                _merge_ring_into_stores()
+                from flink_tpu.native import SpillStore
+
+                def mk_store():
+                    return SpillStore(width=ovf_w, initial_capacity=1024)
+
+                def fold_cold(ent, fault_point):
+                    tiers_mod.fold_entries(
+                        ent, ovf_stores, ovf_w, ufunc, ovf_neutral,
+                        mk_store, host_combine, fault_point=fault_point,
+                    )
+                    if len(ent["pane"]):
+                        tm.note_cold(
+                            tiers_mod.entries_key_groups(
+                                ent, ctx.max_parallelism
+                            ),
+                            ent["pane"],
+                        )
+
+                def splice_shard(s_row, built):
+                    nonlocal state
+                    idx = jnp.asarray(np.asarray([s_row], np.int32))
+
+                    def spl(live_arr, sub):
+                        return jax.device_put(
+                            live_arr.at[idx].set(jnp.asarray(sub)),
+                            ctx.state_sharding,
+                        )
+
+                    repl = dict(
+                        table=type(state.table)(
+                            spl(state.table.keys, built["keys"]),
+                            spec.probe_len,
+                        ),
+                        fresh=spl(state.fresh, built["fresh"]),
+                        pane_ids=spl(state.pane_ids, built["pane_ids"]),
+                        n_fresh=spl(state.n_fresh, built["n_fresh"]),
+                    )
+                    if use_packed:
+                        # splice rows are logical; re-pack onto the live
+                        # packed plane (touched rides inside)
+                        repl["acc"] = spl(state.acc, wk.make_packed(
+                            built["acc"], built["touched"], red
+                        ))
+                    else:
+                        repl["acc"] = spl(state.acc, built["acc"])
+                        repl["touched"] = spl(
+                            state.touched, built["touched"]
+                        )
+                    state = dataclasses.replace(state, **repl)
+
+                max_pane_h = np.asarray(jax.device_get(state.max_pane))
+                kg_dirty_h = np.asarray(
+                    jax.device_get(state.kg_dirty)
+                ).copy()
+                for s in sorted(by_shard):
+                    dem, pro = by_shard[s]
+                    staged = ckpt.stage_window_state(
+                        state, rows=[s], red=red
+                    )
+                    # label ring rows from THIS shard's own pane clock:
+                    # the staged scalars aggregate the GLOBAL max, which
+                    # would mislabel a lagging shard's rows
+                    staged["scalars"]["max_pane"] = int(max_pane_h[s])
+                    entries, scalars = ckpt.extract_entries(staged, win)
+                    kgs = tiers_mod.entries_key_groups(
+                        entries, ctx.max_parallelism
+                    )
+                    dem_m = (
+                        np.isin(kgs, np.asarray(dem, np.int64))
+                        if dem else np.zeros(len(kgs), bool)
+                    )
+                    merged, demoted = tiers_mod.split_entries(
+                        entries, ~dem_m
+                    )
+                    # unconditional: the demote seam fires once per
+                    # shard swap even when no entries move, so chaos
+                    # tests can land a crash on every swap
+                    fold_cold(demoted, "tier.demote.write")
+                    for g in pro:
+                        got = tiers_mod.fetch_group_entries(
+                            ovf_stores, g, ctx.max_parallelism, ovf_w,
+                            staged["value_tail"], staged["value_dtype"],
+                        )
+                        tm.forget_cold(g)
+                        on, off = tiers_mod.ring_window(
+                            got, int(scalars["max_pane"]), int(win.ring)
+                        )
+                        # panes outside the live ring have no device row
+                        # to hold them yet: straight back to the stores
+                        # (combine-aware, never dropped); they merge at
+                        # fire the normal spill way
+                        fold_cold(off, None)
+                        merged = tiers_mod.concat_entries(merged, on)
+                    merged = tiers_mod.precombine_entries(
+                        merged, ovf_w, ufunc, ovf_neutral
+                    )
+                    leftover = []
+                    built = ckpt.restore_window_rows(
+                        merged, scalars, ctx, spec, rows=[s],
+                        leftover=leftover,
+                    )
+                    splice_shard(s, built)
+                    for l_hi, l_lo, l_pane, l_val in leftover:
+                        # promoted rows the table cannot place (chain
+                        # exhaustion under the promote's extra keys) go
+                        # straight back cold — fold, not put: a raw put
+                        # would clobber a resident group's overflow
+                        # residue sharing the (key, pane) cell
+                        fold_cold({
+                            "key_hi": l_hi, "key_lo": l_lo,
+                            "pane": l_pane, "value": l_val,
+                            "fresh": np.ones(len(l_pane), bool),
+                        }, None)
+                    # the swap changed these groups' rows without the
+                    # kernels marking them: dirty bits keep the next
+                    # incremental checkpoint honest
+                    for g in dem + pro:
+                        kg_dirty_h[s, g] = True
+                state = dataclasses.replace(
+                    state,
+                    kg_dirty=jax.device_put(
+                        kg_dirty_h, ctx.state_sharding
+                    ),
+                )
+            tm.apply(plan)
+            tier_mask_dev[0] = jnp.asarray(tm.mask())
+
+        def _tier_maintenance():
+            """Poll-cycle tier pass (the elastic-latch seam): rank
+            groups on the flight recorder's kg-heat/recency series plus
+            the watermark-derived next-fire pane, and apply any swap at
+            this cycle's cut. Planning is pure host numpy; a cycle with
+            an empty plan costs no device traffic at all."""
+            tm = tier_mgr[0]
+            if tm is None or state is None or win is None:
+                return
+            dt = drain_telem[0]
+            maxp = ctx.max_parallelism
+            heat = getattr(dt, "_kg_heat", None) if dt is not None \
+                else None
+            if heat is not None and len(heat) == maxp:
+                heat = np.asarray(heat, np.float64)
+                last = np.asarray(dt._kg_last, np.int64)
+                seq = int(dt._kg_seq)
+            else:
+                # no recorder (drain-stats off): heat is flat and the
+                # watermark prefetch signal alone drives placement
+                heat = np.zeros(maxp, np.float64)
+                last = np.full(maxp, -1, np.int64)
+                seq = 0
+            plan = tm.plan(
+                heat, last, seq,
+                wm_pane=(
+                    host_fired_pane + 1
+                    if host_fired_pane > -(2 ** 61) else None
+                ),
+            )
+            _apply_tier_plan(plan)
 
         columnar_emit = (
             len(pipe.branches) == 1
@@ -5215,6 +5513,10 @@ class LocalExecutor:
                     list(elastic_ctl.full_devices), "scale_up",
                     "operator scale-up request",
                 )
+            # tiered state maintenance rides the same cycle-boundary
+            # seam: residency swaps happen between dispatches, at a cut
+            if tier_mgr[0] is not None and td is not None:
+                _tier_maintenance()
             if tracer is not None:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()

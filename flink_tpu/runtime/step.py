@@ -129,7 +129,7 @@ def build_window_step(ctx: MeshContext, spec: WindowStageSpec):
 def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
                       hi, lo, ts, values, valid, wm, maxp: int,
                       insert: bool = True, kg_fill: bool = False,
-                      clear_rows=None):
+                      clear_rows=None, kg_res=None):
     """Shared per-shard body for the mask (replicated-batch) route: hash
     to key groups, mask to owned groups, apply the window update, and
     advance the shard watermark. Used by the single step AND the K-fused
@@ -141,7 +141,9 @@ def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
     pre-combine sort with the other scatter consumers, statically
     compiled out to a zero-length array when off. ``clear_rows`` folds
     the fused-fire scan's deferred purge into the update's ring-reset
-    sweep (wk.update)."""
+    sweep (wk.update). ``kg_res`` (bool ``[maxp]``, tiered state) is the
+    replicated HBM-residency mask wk.update diverts cold-group lanes
+    around the table with."""
     import dataclasses as _dc
 
     if spec.pre is not None:
@@ -154,7 +156,7 @@ def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
         state, spec.win, spec.red, hi, lo, ts, values, mine,
         insert=insert, direct=spec.layout == "direct", kg=kg,
         precombine=spec.precombine, kg_fill=maxp if kg_fill else 0,
-        clear_rows=clear_rows,
+        clear_rows=clear_rows, kg_res=kg_res,
     )
     state = _dc.replace(state, watermark=jnp.maximum(state.watermark, wm))
     return state, activity, kgf
@@ -162,7 +164,8 @@ def mask_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
 
 def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
                              insert: bool = True,
-                             kg_fill: bool = False):
+                             kg_fill: bool = False,
+                             tiered: bool = False):
     """Update-only half of the window step: apply a micro-batch and advance
     the shard watermark, but do NOT evaluate fires. The reference evaluates
     timers on every watermark advance (HeapInternalTimerService), but a
@@ -176,18 +179,25 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
     ``insert=False`` builds the lookup-only FAST variant (wk.update's
     insert flag): same state layout, so the executor switches between the
     two compiled steps per micro-batch at zero cost, driven by the lagged
-    activity signal in the monitoring output."""
+    activity signal in the monitoring output.
+
+    ``tiered=True`` appends one trailing ``kg_res`` operand (replicated
+    bool ``[max_parallelism]`` HBM-residency mask, state.tiers.*): cold-
+    group lanes divert to the overflow ring inside wk.update. The mask
+    is data, not structure — residency changes never recompile."""
     starts, ends = ctx.kg_bounds()
     starts = jnp.asarray(starts)
     ends = jnp.asarray(ends)
     maxp = ctx.max_parallelism
     mesh = ctx.mesh
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         state, activity, kgf = mask_update_shard(
             state, spec, kg_start[0], kg_end[0], hi, lo, ts, values,
             valid, wm[0], maxp, insert=insert, kg_fill=kg_fill,
+            kg_res=rest[0] if tiered else None,
         )
         ovf_n = state.ovf_n
         return (
@@ -202,14 +212,14 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
             P(), P(), P(), P(), P(),
             P(SHARD_AXIS),
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
-    def update_step(state, hi, lo, ts, values, valid, wm):
+    def update_step(state, hi, lo, ts, values, valid, wm, *rest):
         """Returns (state', (ovf_n, activity, kg_fill)). The second
         element is a tiny NON-donated monitoring tuple: overflow-ring
         fill level, not-already-resident lane count, and per-key-group
@@ -221,15 +231,17 @@ def build_window_update_step(ctx: MeshContext, spec: WindowStageSpec,
         monitoring). `activity` drives the insert<->fast step tiering.
         """
         st, ovf_n, act, kgf = sharded(state, starts, ends, hi, lo, ts,
-                                      values, valid, wm)
+                                      values, valid, wm, *rest)
         return st, (ovf_n, act, kgf)
 
+    update_step.tiered = tiered
     return update_step
 
 
 def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
                           hi, lo, ts, values, valid, n: int, maxp: int,
-                          cap: int, insert: bool = True, clear_rows=None):
+                          cap: int, insert: bool = True, clear_rows=None,
+                          kg_res=None):
     """Shared per-shard body: route this device's lane slice to owning
     shards over the mesh all_to_all, mask to owned key groups, and apply
     the window update. Used by the single-host exchange step and the
@@ -253,7 +265,7 @@ def exchange_update_shard(state, spec: WindowStageSpec, kg_start, kg_end,
                                    insert=insert,
                                    direct=spec.layout == "direct",
                                    precombine=spec.precombine,
-                                   clear_rows=clear_rows)
+                                   clear_rows=clear_rows, kg_res=kg_res)
     state = _dc.replace(
         state, dropped_capacity=state.dropped_capacity + n_over
     )
@@ -264,7 +276,8 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
                                       batch_per_device: int,
                                       capacity_factor: float = 2.0,
                                       insert: bool = True,
-                                      kg_fill: bool = False):
+                                      kg_fill: bool = False,
+                                      tiered: bool = False):
     """Update step with a real ICI record exchange instead of
     replicate-and-mask: the host splits the batch over devices (each holds
     B/n lanes), each device buckets its lanes by owning shard and ONE
@@ -287,12 +300,14 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
     n = ctx.n_shards
     cap = bucket_capacity(batch_per_device, n, capacity_factor)
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
         state, activity = exchange_update_shard(
             state, spec, kg_start, kg_end, hi, lo, ts, values, valid,
             n, maxp, cap, insert=insert,
+            kg_res=rest[0] if tiered else None,
         )
         state = _dc.replace(
             state, watermark=jnp.maximum(state.watermark, wm[0])
@@ -323,23 +338,24 @@ def build_window_update_step_exchange(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
             P(SHARD_AXIS),
             P(SHARD_AXIS),  # per-shard watermark
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS)),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
-    def _jit_step(state, hi, lo, ts, values, valid, wm):
+    def _jit_step(state, hi, lo, ts, values, valid, wm, *rest):
         st, ovf_n, act, kgf = sharded(state, starts, ends, hi, lo, ts,
-                                      values, valid, wm)
+                                      values, valid, wm, *rest)
         return st, (ovf_n, act, kgf)
 
-    def update_step(state, hi, lo, ts, values, valid, wm):
-        return _jit_step(state, hi, lo, ts, values, valid, wm)
+    def update_step(state, hi, lo, ts, values, valid, wm, *rest):
+        return _jit_step(state, hi, lo, ts, values, valid, wm, *rest)
 
     update_step.recv_lanes = n * cap
     update_step.bucket_cap = cap
+    update_step.tiered = tiered
     # the jitted inner step, for AOT consumers (cost_analysis needs
     # .lower(), which the plain wrapper doesn't have)
     update_step.jit = _jit_step
@@ -360,7 +376,8 @@ def _fused_batch_stack(K: int, flat):
 
 def build_window_megastep(ctx: MeshContext, spec: WindowStageSpec,
                           k_steps: int, insert: bool = True,
-                          kg_fill: bool = False):
+                          kg_fill: bool = False,
+                          tiered: bool = False):
     """K-step dispatch fusion (pipeline.steps-per-dispatch): ONE jitted
     ``lax.scan`` applies a stack of K staged micro-batches against
     donated state in a single dispatch. Every fused group divides the
@@ -385,15 +402,18 @@ def build_window_megastep(ctx: MeshContext, spec: WindowStageSpec,
     mesh = ctx.mesh
     K = int(k_steps)
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None   # scan-invariant, closed over
 
         def sub(st, xs):
             s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
             st, act, kgf = mask_update_shard(
                 st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
                 s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+                kg_res=kg_res,
             )
             return st, (act, kgf)
 
@@ -415,7 +435,7 @@ def build_window_megastep(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
             P(), P(), P(), P(), P(),   # [K, B] batch stacks, replicated
             P(SHARD_AXIS),             # wmv [n_shards, K]
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS)),
         check_vma=False,
@@ -423,12 +443,18 @@ def build_window_megastep(ctx: MeshContext, spec: WindowStageSpec,
 
     @partial(jax.jit, donate_argnums=(0,))
     def megastep(state, *flat):
-        *batches, wmv = flat
+        if tiered:
+            *batches, wmv, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(K, batches)
-        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, wmv)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, *tail)
         return st, (ovf_n, act, kgf)
 
     megastep.k_steps = K
+    megastep.tiered = tiered
     return megastep
 
 
@@ -436,7 +462,8 @@ def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
                                    batch_per_device: int, k_steps: int,
                                    capacity_factor: float = 2.0,
                                    insert: bool = True,
-                                   kg_fill: bool = False):
+                                   kg_fill: bool = False,
+                                   tiered: bool = False):
     """Exchange-route megastep: the K-fused analog of
     build_window_update_step_exchange — each scan sub-step runs the
     shared ``exchange_update_shard`` body (bucket + all_to_all + masked
@@ -456,15 +483,17 @@ def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
     cap = bucket_capacity(batch_per_device, n, capacity_factor)
     K = int(k_steps)
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
 
         def sub(st, xs):
             s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
             st, act = exchange_update_shard(
                 st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
-                s_valid, n, maxp, cap, insert=insert,
+                s_valid, n, maxp, cap, insert=insert, kg_res=kg_res,
             )
             st = _dc.replace(st, watermark=jnp.maximum(st.watermark, s_wm))
             if kg_fill:
@@ -496,7 +525,7 @@ def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
             P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(SHARD_AXIS),
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS)),
         check_vma=False,
@@ -504,21 +533,28 @@ def build_window_megastep_exchange(ctx: MeshContext, spec: WindowStageSpec,
 
     @partial(jax.jit, donate_argnums=(0,))
     def megastep(state, *flat):
-        *batches, wmv = flat
+        if tiered:
+            *batches, wmv, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(K, batches)
-        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, wmv)
+        st, ovf_n, act, kgf = sharded(state, starts, ends, *stacks, *tail)
         return st, (ovf_n, act, kgf)
 
     megastep.k_steps = K
     megastep.recv_lanes = n * cap
     megastep.bucket_cap = cap
+    megastep.tiered = tiered
     return megastep
 
 
 def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
                                 k_steps: int, insert: bool = True,
                                 kg_fill: bool = False,
-                                reduced: bool = False):
+                                reduced: bool = False,
+                                tiered: bool = False):
     """Resident-pipeline megastep (pipeline.fused-fire, ISSUE 7): the
     K-fused ``lax.scan`` with the FIRE SWEEP folded into the scan body.
     Each sub-step applies its micro-batch (the shared mask_update_shard
@@ -553,9 +589,11 @@ def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
     mesh = ctx.mesh
     K = int(k_steps)
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
         pend0 = jnp.zeros(spec.win.ring, bool)
 
         def sub(carry, xs):
@@ -564,7 +602,7 @@ def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
             st, act, kgf = mask_update_shard(
                 st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
                 s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
-                clear_rows=pend,
+                clear_rows=pend, kg_res=kg_res,
             )
             st, pend, cf = wk.advance_and_fire_resident(
                 st, spec.win, spec.red, s_wm, reduced=reduced
@@ -590,7 +628,7 @@ def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
             P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
             P(), P(), P(), P(), P(),   # [K, B] batch stacks, replicated
             P(SHARD_AXIS),             # wmv [n_shards, K]
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
@@ -598,16 +636,22 @@ def build_window_megastep_fired(ctx: MeshContext, spec: WindowStageSpec,
 
     @partial(jax.jit, donate_argnums=(0,))
     def megastep(state, *flat):
-        *batches, wmv = flat
+        if tiered:
+            *batches, wmv, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(K, batches)
         st, ovf_n, act, kgf, fires = sharded(
-            state, starts, ends, *stacks, wmv
+            state, starts, ends, *stacks, *tail
         )
         return st, (ovf_n, act, kgf), fires
 
     megastep.k_steps = K
     megastep.fused_fire = True
     megastep.fused_fire_reduced = reduced
+    megastep.tiered = tiered
     return megastep
 
 
@@ -618,7 +662,8 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
                                          capacity_factor: float = 2.0,
                                          insert: bool = True,
                                          kg_fill: bool = False,
-                                         reduced: bool = False):
+                                         reduced: bool = False,
+                                         tiered: bool = False):
     """Exchange-route resident megastep: the fused-fire analog of
     build_window_megastep_exchange — each scan sub-step runs the shared
     ``exchange_update_shard`` body (bucket + all_to_all + masked update)
@@ -639,9 +684,11 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
     cap = bucket_capacity(batch_per_device, n, capacity_factor)
     K = int(k_steps)
 
-    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid, wm):
+    def shard_body(state, kg_start, kg_end, hi, lo, ts, values, valid,
+                   wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
         pend0 = jnp.zeros(spec.win.ring, bool)
 
         def sub(carry, xs):
@@ -650,6 +697,7 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
             st, act = exchange_update_shard(
                 st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
                 s_valid, n, maxp, cap, insert=insert, clear_rows=pend,
+                kg_res=kg_res,
             )
             st = _dc.replace(st, watermark=jnp.maximum(st.watermark, s_wm))
             if kg_fill:
@@ -685,7 +733,7 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
             P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(SHARD_AXIS),
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
@@ -693,10 +741,15 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
 
     @partial(jax.jit, donate_argnums=(0,))
     def megastep(state, *flat):
-        *batches, wmv = flat
+        if tiered:
+            *batches, wmv, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(K, batches)
         st, ovf_n, act, kgf, fires = sharded(
-            state, starts, ends, *stacks, wmv
+            state, starts, ends, *stacks, *tail
         )
         return st, (ovf_n, act, kgf), fires
 
@@ -705,6 +758,7 @@ def build_window_megastep_fired_exchange(ctx: MeshContext,
     megastep.fused_fire_reduced = reduced
     megastep.recv_lanes = n * cap
     megastep.bucket_cap = cap
+    megastep.tiered = tiered
     return megastep
 
 
@@ -808,7 +862,8 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
                                 depth: int, insert: bool = True,
                                 kg_fill: bool = False,
                                 reduced: bool = False,
-                                drain_stats: bool = False):
+                                drain_stats: bool = False,
+                                tiered: bool = False):
     """Device-resident ring-drain loop (pipeline.resident-loop, ISSUE
     12): ONE jitted dispatch consumes up to ``depth`` staged ring slots
     against donated state, running the PR 7 fused update+fire body per
@@ -855,9 +910,10 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
     D = int(depth)
 
     def shard_body(state, kg_start, kg_end, count, hi, lo, ts, values,
-                   valid, wm):
+                   valid, wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None   # scan-invariant residency
         pend0 = jnp.zeros(spec.win.ring, bool)
 
         def sub(carry, xs):
@@ -870,7 +926,7 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
                 st, act, kgf = mask_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, s_wm, maxp, insert=insert,
-                    kg_fill=kg_fill, clear_rows=pend,
+                    kg_fill=kg_fill, clear_rows=pend, kg_res=kg_res,
                 )
                 st, pend, cf = wk.advance_and_fire_resident(
                     st, spec.win, spec.red, s_wm, reduced=reduced
@@ -917,7 +973,7 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
             P(),                       # count: replicated scalar cursor
             P(), P(), P(), P(), P(),   # [D, B] batch stacks, replicated
             P(SHARD_AXIS),             # wmv [n_shards, D]
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(SHARD_AXIS))
         + ((P(SHARD_AXIS),) if drain_stats else ()),
@@ -926,11 +982,16 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
 
     @partial(jax.jit, donate_argnums=(0,))
     def drain(state, *flat):
-        *batches, wmv, count = flat
+        if tiered:
+            *batches, wmv, count, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv, count = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(D, batches)
         res = sharded(
             state, starts, ends, jnp.asarray(count, jnp.int32),
-            *stacks, wmv,
+            *stacks, *tail,
         )
         st, ovf_n, act, kgf, fires = res[:5]
         if drain_stats:
@@ -943,6 +1004,7 @@ def build_window_resident_drain(ctx: MeshContext, spec: WindowStageSpec,
     drain.fused_fire = True
     drain.fused_fire_reduced = reduced
     drain.drain_stats = drain_stats
+    drain.tiered = tiered
     return drain
 
 
@@ -954,7 +1016,8 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
                                          insert: bool = True,
                                          kg_fill: bool = False,
                                          reduced: bool = False,
-                                         drain_stats: bool = False):
+                                         drain_stats: bool = False,
+                                         tiered: bool = False):
     """Exchange-route resident drain: the ring-drain analog of
     build_window_megastep_fired_exchange — each live slot runs the
     shared ``exchange_update_shard`` body (bucket + all_to_all + masked
@@ -980,9 +1043,10 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
     D = int(depth)
 
     def shard_body(state, kg_start, kg_end, count, hi, lo, ts, values,
-                   valid, wm):
+                   valid, wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
         pend0 = jnp.zeros(spec.win.ring, bool)
 
         def sub(carry, xs):
@@ -995,7 +1059,7 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
                 st, act = exchange_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, n, maxp, cap, insert=insert,
-                    clear_rows=pend,
+                    clear_rows=pend, kg_res=kg_res,
                 )
                 st = _dc.replace(
                     st, watermark=jnp.maximum(st.watermark, s_wm)
@@ -1054,7 +1118,7 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
             P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(SHARD_AXIS),
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(SHARD_AXIS))
         + ((P(SHARD_AXIS),) if drain_stats else ()),
@@ -1063,11 +1127,16 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
 
     @partial(jax.jit, donate_argnums=(0,))
     def drain(state, *flat):
-        *batches, wmv, count = flat
+        if tiered:
+            *batches, wmv, count, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv, count = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(D, batches)
         res = sharded(
             state, starts, ends, jnp.asarray(count, jnp.int32),
-            *stacks, wmv,
+            *stacks, *tail,
         )
         st, ovf_n, act, kgf, fires = res[:5]
         if drain_stats:
@@ -1082,6 +1151,7 @@ def build_window_resident_drain_exchange(ctx: MeshContext,
     drain.recv_lanes = n * cap
     drain.bucket_cap = cap
     drain.drain_stats = drain_stats
+    drain.tiered = tiered
     return drain
 
 
@@ -1089,7 +1159,8 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
                                depth: int, insert: bool = True,
                                kg_fill: bool = False,
                                reduced: bool = False,
-                               drain_stats: bool = False):
+                               drain_stats: bool = False,
+                               tiered: bool = False):
     """Data-parallel resident drain (pipeline.data-parallel, ISSUE 13):
     the ring-drain scan lowered shard-LOCALLY — the ingest side already
     partitioned each batch by owning key-group slice and published the
@@ -1125,9 +1196,10 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     D = int(depth)
 
     def shard_body(state, kg_start, kg_end, counts, hi, lo, ts, values,
-                   valid, wm):
+                   valid, wm, *rest):
         state = jax.tree_util.tree_map(lambda x: x[0], state)
         kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
         count = counts[0]          # this shard's OWN fill level
         pend0 = jnp.zeros(spec.win.ring, bool)
 
@@ -1141,7 +1213,7 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
                 st, act, kgf = mask_update_shard(
                     st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
                     s_vals, s_valid, s_wm, maxp, insert=insert,
-                    kg_fill=kg_fill, clear_rows=pend,
+                    kg_fill=kg_fill, clear_rows=pend, kg_res=kg_res,
                 )
                 st, pend, cf = wk.advance_and_fire_resident(
                     st, spec.win, spec.red, s_wm, reduced=reduced
@@ -1192,7 +1264,7 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
             P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(None, SHARD_AXIS), P(None, SHARD_AXIS),
             P(SHARD_AXIS),             # wmv [n_shards, D]
-        ),
+        ) + ((P(),) if tiered else ()),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
                    P(SHARD_AXIS), P(SHARD_AXIS))
         + ((P(SHARD_AXIS),) if drain_stats else ()),
@@ -1201,11 +1273,16 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
 
     @partial(jax.jit, donate_argnums=(0,))
     def drain(state, *flat):
-        *batches, wmv, counts = flat
+        if tiered:
+            *batches, wmv, counts, kg_res = flat
+            tail = (wmv, kg_res)
+        else:
+            *batches, wmv, counts = flat
+            tail = (wmv,)
         stacks = _fused_batch_stack(D, batches)
         res = sharded(
             state, starts, ends, jnp.asarray(counts, jnp.int32),
-            *stacks, wmv,
+            *stacks, *tail,
         )
         st, ovf_n, act, kgf, fires = res[:5]
         if drain_stats:
@@ -1219,6 +1296,7 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     drain.fused_fire = True
     drain.fused_fire_reduced = reduced
     drain.drain_stats = drain_stats
+    drain.tiered = tiered
     return drain
 
 
@@ -2131,6 +2209,12 @@ class KernelFamily:
     # keep their pre-telemetry names AND ledger entries — the byte-
     # identity test proves the payload compiles out.
     drain_stats: bool = False
+    # tiered-residency variant (ISSUE 18): the kernel takes a trailing
+    # replicated kg_res bool[max_parallelism] mask and diverts lanes of
+    # non-resident key-groups down the overflow ring. OFF families keep
+    # their pre-tier ledger entries byte-identical — residency is data,
+    # not structure.
+    tiered: bool = False
 
 
 def kernel_family_grid():
@@ -2219,6 +2303,30 @@ def kernel_family_grid():
         F("step.sharded_drain.hash.d4.dstats", build_window_sharded_drain,
           "sharded_drain", route="sharded", k_steps=AUDIT_RING_DEPTH,
           drain_stats=True),
+        # tiered-residency variants (ISSUE 18): one per dispatchable
+        # route through the tiered executor path. Ledgered like any
+        # family — the residency mask must stay a pure element-wise
+        # divert (gather + and/or), so a sort/scatter creeping into the
+        # tier gate is structural drift the op-budget rule catches; OFF
+        # twins stay byte-identical to the frozen ledger
+        F("step.update.mask.hash.tiered", build_window_update_step,
+          "update", tiered=True),
+        F("step.update.exchange.hash.tiered",
+          build_window_update_step_exchange,
+          "update", route="exchange", tiered=True),
+        F("step.megastep_fired.mask.hash.k2.tiered",
+          build_window_megastep_fired,
+          "megastep_fired", k_steps=K, tiered=True),
+        F("step.resident_drain.mask.hash.d4.tiered",
+          build_window_resident_drain,
+          "resident_drain", k_steps=AUDIT_RING_DEPTH, tiered=True),
+        F("step.resident_drain.exchange.hash.d4.tiered",
+          build_window_resident_drain_exchange,
+          "resident_drain", route="exchange", k_steps=AUDIT_RING_DEPTH,
+          tiered=True),
+        F("step.sharded_drain.hash.d4.tiered", build_window_sharded_drain,
+          "sharded_drain", route="sharded", k_steps=AUDIT_RING_DEPTH,
+          tiered=True),
         # the multi-stage chained drain (ISSUE 16): stage-N fires
         # re-keyed on device into stage-N+1's update inside the same
         # count-gated scan. The edge is gather-only (_chain_fires_to
@@ -2327,17 +2435,21 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
         lo = jnp.arange(B, dtype=jnp.uint32)
     per = (hi, lo, jnp.zeros(B, jnp.int32), jnp.ones(B, jnp.float32),
            jnp.ones(B, bool))
+    # tiered families take a trailing replicated residency mask; the
+    # canonical call marks every key-group resident (the mask is data,
+    # so the all-resident trace covers the divert path structurally)
+    tier = ((jnp.ones(ctx.max_parallelism, bool),) if fam.tiered else ())
     if fam.kind in ("update", "combined"):
-        return (state,) + per + (watermark_vector(ctx, 0),)
+        return (state,) + per + (watermark_vector(ctx, 0),) + tier
     if fam.kind in ("megastep", "megastep_fired"):
         wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
-        return (state,) + per * fam.k_steps + (wmv,)
+        return (state,) + per * fam.k_steps + (wmv,) + tier
     if fam.kind == "resident_drain":
         # partially-filled ring (count = depth - 1): both cond branches
         # are live in the traced program, so the audit sees the gate
         wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
         count = jnp.asarray(fam.k_steps - 1, jnp.int32)
-        return (state,) + per * fam.k_steps + (wmv, count)
+        return (state,) + per * fam.k_steps + (wmv, count) + tier
     if fam.kind == "chained_drain":
         # same operand shape as the single-stage resident drain: the
         # chained edge is internal to the kernel (state is the tuple)
@@ -2352,7 +2464,7 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
         per2 = tuple(jnp.broadcast_to(a, (n,) + a.shape) for a in per)
         wmv = jnp.zeros((n, fam.k_steps), jnp.int32)
         counts = jnp.full((n,), fam.k_steps - 1, jnp.int32)
-        return (state,) + per2 * fam.k_steps + (wmv, counts)
+        return (state,) + per2 * fam.k_steps + (wmv, counts) + tier
     if fam.kind in ("fire", "fire_reduced"):
         return (state, watermark_vector(ctx, 0))
     if fam.kind == "session":
@@ -2387,6 +2499,9 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     if fam.kind in ("resident_drain", "sharded_drain"):
         kw["depth"] = fam.k_steps
         kw["drain_stats"] = fam.drain_stats
+    if fam.kind in ("update", "megastep", "megastep_fired",
+                    "resident_drain", "sharded_drain"):
+        kw["tiered"] = fam.tiered
     if fam.kind in ("chained_drain", "chained_drain_sharded"):
         kw["depth"] = fam.k_steps
         kw["exchange_lanes"] = AUDIT_EXCHANGE_LANES

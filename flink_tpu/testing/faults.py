@@ -60,6 +60,24 @@ Injection-point catalog (the sites wired in this repo):
                             ``device_loss`` fault class (below) rides:
                             a dying chip surfaces exactly here, as a
                             runtime error out of the dispatch
+    step.drain              runtime/executor resident ring drain, before
+                            the drain dispatch (warmup drains exempt) —
+                            the mid-drain crash seam of the exactly-once
+                            drain tests
+    tier.demote.write       runtime/tiers.fold_entries, before a demoted
+                            key-group's entries fold into the host pane
+                            stores — a crash between a demote and its
+                            checkpoint loses only process-local host
+                            memory the next restore re-seeds from the
+                            last cut (tests/test_tiers.py)
+    tier.promote.read       runtime/tiers.fetch_group_entries, before a
+                            promote pulls a key-group's pending entries
+                            out of the pane stores (the read half of the
+                            tier swap)
+    ckpt.spill.read         native SpillStore.load, before the
+                            checksummed file read — a corrupt or torn
+                            spill dump surfaces here and the caller
+                            falls back instead of restoring bad state
 
 Actions:
 

@@ -3,8 +3,8 @@
 * every doctor rule reproduced by a synthetic-pathology snapshot —
   starved ring, saturated drain, edge-lane near-overflow AND overflow,
   kg heat skew, recompile storm, checkpoint budget burn, ring
-  refusals, watchdog trips — each finding carrying evidence values
-  and a concrete config remedy;
+  refusals, watchdog trips, tier thrash (ISSUE 18) — each finding
+  carrying evidence values and a concrete config remedy;
 * ranking (severity class, then score), graceful degradation on
   missing planes, threshold overrides;
 * the ``python -m flink_tpu.doctor`` CLI: exit 0 clean / 1 findings /
@@ -168,6 +168,36 @@ def test_rule_watchdog_trips():
     assert f["remedy"]["key"] == "watchdog.drain-timeout"
 
 
+def test_rule_tier_thrash_churn_and_miss_arms():
+    # churn arm: swaps outpace dispatches
+    snap = {
+        "pipeline": {"tiers": {
+            "demotes": 30, "promotes": 30, "faults": 2,
+            "prefetch_hits": 9, "prefetch_misses": 1,
+            "budget_per_shard": 2, "resident_groups": 4,
+            "cold_groups_pending": 3,
+        }},
+        "metrics": {"resident_drains": 40},
+    }
+    f = _one(snap, "tier-thrash")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["dispatches"] == 40
+    assert f["evidence"]["demotes"] == 30
+    assert f["remedy"]["key"] == "state.tiers.resident-key-groups"
+    assert "thrashing" in f["summary"]
+    # miss arm: prefetches mostly never touched (needs >= 4 samples)
+    snap["pipeline"]["tiers"].update(
+        demotes=1, promotes=1, prefetch_hits=1, prefetch_misses=5)
+    f = _one(snap, "tier-thrash")
+    assert "mispredicting" in f["summary"]
+    assert f["evidence"]["prefetch_misses"] == 5
+    # healthy tiering: low churn, good hit rate — no finding
+    snap["pipeline"]["tiers"].update(prefetch_hits=50, prefetch_misses=1)
+    assert not run_rules(snap)
+    # a job without tiers never fires the rule
+    assert not run_rules({"pipeline": {}, "metrics": {"steps": 100}})
+
+
 # ------------------------------------------------ engine behaviour
 
 def test_empty_snapshot_is_clean_and_every_plane_degrades():
@@ -176,7 +206,7 @@ def test_empty_snapshot_is_clean_and_every_plane_degrades():
     assert payload["findings"] == []
     assert payload["version"] == DOCTOR_SCHEMA_VERSION
     assert set(payload["rules"]) == set(RULE_NAMES)
-    assert len(RULE_NAMES) == 8
+    assert len(RULE_NAMES) == 9
     # partial planes of the wrong-but-plausible shapes never crash
     assert diagnose({"pipeline": {}, "metrics": {}, "compile": {},
                      "checkpoints": []})["clean"] is True

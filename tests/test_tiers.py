@@ -1,0 +1,261 @@
+"""Tiered key-group state (ISSUE 18): exactly-once + placement units.
+
+* property test: the tiered job (HBM budget of 2 key-groups out of 8)
+  is bit-exact against the all-resident oracle job across
+  {hash, direct} layouts x packed planes x 1/2-shard meshes — the tier
+  swap is a placement action, never a semantic one;
+* exactly-once across the tier fault seams: a crash at
+  ``tier.demote.write`` (between a demote and its checkpoint), a crash
+  at ``tier.promote.read`` (the restore-adjacent read half), and a
+  chaos soak with both seams firing repeatedly — restore replays from
+  the last cut, nothing skipped, nothing double-counted;
+* TierManager planner units: budget validation, watermark-urgent
+  promotion beating dwell hysteresis, rescale re-slicing residency,
+  prefetch hit/miss accounting.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from flink_tpu import StreamExecutionEnvironment
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.time import TimeCharacteristic
+from flink_tpu.runtime import tiers as tiers_mod
+from flink_tpu.runtime.sinks import CollectSink
+from flink_tpu.runtime.sources import GeneratorSource
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+N_KEYS = 512
+WINDOW_MS = 1000
+EVENTS_PER_KEY = 6
+TOTAL = N_KEYS * EVENTS_PER_KEY
+
+
+def _gen(offset, n):
+    idx = np.arange(offset, offset + n, dtype=np.int64)
+    keys = idx % N_KEYS
+    # event time sweeps 4 windows over the stream: every key-group
+    # carries pending panes, so demotes always have entries to fold
+    ts = (idx * 4 * WINDOW_MS) // TOTAL
+    return {"key": keys, "value": np.ones(n, np.float32)}, ts
+
+
+def run_job(tiers=0, n_shards=1, packed=None, layout=None,
+            n_keys=N_KEYS, capacity=1024, ckpt_dir=None, restart=None,
+            total=TOTAL):
+    opts = {"keys.reverse-map": True}
+    if tiers:
+        opts["state.tiers.resident-key-groups"] = tiers
+        opts["state.tiers.min-dwell-cycles"] = 1
+    if packed is not None:
+        opts["state.packed-planes"] = packed
+    if layout is not None:
+        opts["state.backend.layout"] = layout
+    if restart:
+        opts.update({
+            "restart-strategy": "fixed-delay",
+            "restart-strategy.fixed-delay.attempts": restart,
+            "restart-strategy.fixed-delay.delay": 0,
+        })
+    env = StreamExecutionEnvironment(Configuration(opts))
+    env.set_parallelism(n_shards)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(capacity)
+    env.batch_size = 256
+    if ckpt_dir:
+        # every step: the tier seams fire early in the run, and recovery
+        # needs a completed cut to restart from
+        env.enable_checkpointing(1, str(ckpt_dir))
+
+    def gen(offset, n):
+        idx = np.arange(offset, offset + n, dtype=np.int64)
+        keys = idx % n_keys
+        ts = (idx * 4 * WINDOW_MS) // total
+        return {"key": keys, "value": np.ones(n, np.float32)}, ts
+
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(gen, total=total))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW_MS)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    env.execute("tiers-job")
+    got = {(int(r.key), int(r.window_end_ms)): float(r.value)
+           for r in sink.results}
+    return env, got
+
+
+def expected(n_keys=N_KEYS, total=TOTAL):
+    idx = np.arange(total)
+    keys = idx % n_keys
+    ts = (idx * 4 * WINDOW_MS) // total
+    out = {}
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        we = (t // WINDOW_MS + 1) * WINDOW_MS
+        out[(k, we)] = out.get((k, we), 0) + 1.0
+    return out
+
+
+# --------------------------------- property: bit-exact vs all-resident
+
+@pytest.mark.parametrize("kwargs", [
+    dict(n_shards=1),
+    dict(n_shards=1, layout="direct", n_keys=200, capacity=256),
+    dict(n_shards=2),
+    dict(n_shards=1, packed="on"),
+], ids=["hash", "direct", "two-shard", "packed"])
+def test_tiered_bit_exact_vs_all_resident(kwargs):
+    """Budget 2 of 8 key-groups, dwell 1 (maximum churn): every result
+    window matches the all-resident oracle job exactly, and the tier
+    manager really swapped (demotes > 0, cold traffic existed)."""
+    _, base = run_job(tiers=0, **kwargs)
+    env, tiered = run_job(tiers=2, **kwargs)
+    assert tiered == base
+    rep = env._pipeline_report()["tiers"]
+    assert rep["budget_per_shard"] == 2
+    assert rep["demotes"] > 0 and rep["promotes"] > 0
+
+
+def test_tiers_require_spillable_overflow():
+    """The tier gate is a config error, never a silent downgrade: with
+    the overflow ring forced off there is no cold route, so a budget
+    refuses to start instead of silently keeping everything resident."""
+    env = StreamExecutionEnvironment(Configuration({
+        "state.tiers.resident-key-groups": 2,
+        "state.backend.overflow-ring": 0,
+    }))
+    env.set_parallelism(1)
+    env.set_max_parallelism(8)
+    env.set_stream_time_characteristic(TimeCharacteristic.EventTime)
+    env.set_state_capacity(1024)
+    env.batch_size = 256
+    sink = CollectSink()
+    (
+        env.add_source(GeneratorSource(_gen, total=1024))
+        .key_by(lambda c: c["key"])
+        .time_window(WINDOW_MS)
+        .sum(lambda c: c["value"])
+        .add_sink(sink)
+    )
+    with pytest.raises(ValueError, match="state.tiers"):
+        env.execute("tiers-gate")
+
+
+# ------------------------------------ exactly-once across tier faults
+
+def test_demote_crash_before_checkpoint_restores_exactly_once(tmp_path):
+    """Crash at ``tier.demote.write`` — after the swap plan committed
+    to moving rows but before any later checkpoint covered it. The
+    demoted entries lived only in process-local host memory; restore
+    re-seeds both tiers from the last cut and replays — nothing
+    skipped, nothing double-counted."""
+    inj = FaultInjector([
+        FaultRule("tier.demote.write",
+                  exc=RuntimeError("injected demote crash"), at=1),
+    ])
+    with faults.active(inj):
+        env, got = run_job(tiers=2, ckpt_dir=tmp_path / "chk",
+                           restart=3)
+    assert inj.fired_at("tier.demote.write"), "demote seam never fired"
+    assert env.last_job.metrics.restarts == 1
+    assert got == expected()
+
+
+def test_promote_crash_restores_exactly_once(tmp_path):
+    """Crash at ``tier.promote.read`` — a promote died mid-read of the
+    pane stores. The stores are rebuilt from the checkpoint on restore
+    (promote-during-restore is just the next maintenance cycle), so the
+    replayed run converges to the oracle."""
+    inj = FaultInjector([
+        FaultRule("tier.promote.read",
+                  exc=OSError("injected promote read failure"), at=3),
+    ])
+    with faults.active(inj):
+        env, got = run_job(tiers=2, ckpt_dir=tmp_path / "chk",
+                           restart=3)
+    assert inj.fired_at("tier.promote.read"), "promote seam never fired"
+    assert env.last_job.metrics.restarts >= 1
+    assert got == expected()
+
+
+def test_tier_chaos_soak_exactly_once(tmp_path):
+    """Both tier seams fire repeatedly across the run (bounded by
+    ``times`` so the restart budget survives); every crash lands at a
+    different swap. The final window set is still the oracle's."""
+    inj = FaultInjector([
+        FaultRule("tier.demote.write",
+                  exc=RuntimeError("chaos demote"), at=4),
+        FaultRule("tier.promote.read",
+                  exc=OSError("chaos promote"), at=5),
+    ], seed=18)
+    with faults.active(inj):
+        env, got = run_job(tiers=2, n_shards=2,
+                           ckpt_dir=tmp_path / "chk", restart=6)
+    fired = {f["point"] for f in inj.fired}
+    assert fired == {"tier.demote.write", "tier.promote.read"}
+    assert env.last_job.metrics.restarts >= 2
+    assert got == expected()
+
+
+# ------------------------------------------- TierManager planner units
+
+def _mgr(**kw):
+    return tiers_mod.TierManager(
+        8, np.asarray([0]), np.asarray([7]), kw.pop("budget", 2), **kw)
+
+
+def test_manager_rejects_zero_budget():
+    with pytest.raises(ValueError):
+        _mgr(budget=0)
+
+
+def test_urgent_promote_beats_dwell_and_counts_hits():
+    """A cold group with a pane due inside the watermark horizon is
+    promoted even though the incumbents' dwell has not expired; a
+    promoted group that sees traffic before its next demotion counts a
+    prefetch hit, one that never does counts a miss."""
+    tm = _mgr(budget=2, min_dwell_cycles=100, prefetch_ahead_panes=2)
+    heat = np.asarray([9.0, 8.0, 0.1, 0.0, 0, 0, 0, 0])
+    last = np.asarray([0, 0, 0, -1, -1, -1, -1, -1])
+    # groups 0..1 resident (default: first-budget); group 2 is cold
+    # with a pane closing inside the prefetch horizon, and its dwell
+    # clock says "just flipped" — only the urgency exemption can
+    # promote it
+    tm.note_cold([2], [5])
+    tm._last_flip[2] = 0
+    plan = tm.plan(heat, last, seq=1, wm_pane=4)
+    assert 2 in set(plan.promote)
+    assert len(plan.demote) == len(plan.promote)
+    tm.apply(plan)
+    assert tm.mask()[2]
+    # traffic lands on the promoted group -> prefetch hit
+    kg_sum = np.zeros(8, np.int64)
+    kg_sum[2] = 10
+    tm.note_sample(kg_sum)
+    assert tm.report()["prefetch_hits"] == 1
+    # a cold (non-resident) group absorbing traffic is a tier fault
+    victim = plan.demote[0]
+    kg_sum2 = np.zeros(8, np.int64)
+    kg_sum2[victim] = 3
+    tm.note_sample(kg_sum2)
+    assert tm.report()["faults"] == 1
+
+
+def test_rescale_reslices_residency_and_keeps_counters():
+    tm = _mgr(budget=2)
+    before = tm.report()
+    assert before["resident_groups"] == 2
+    tm.note_cold([5], [1])
+    tm.rescale(np.asarray([0, 4]), np.asarray([3, 7]))
+    rep = tm.report()
+    # 2 shards x budget 2 = 4 resident groups after the re-slice
+    assert rep["resident_groups"] == 4
+    assert rep["cold_groups_pending"] == 1   # pending survives rescale
+    assert tm.shard_of(5) == 1
+
