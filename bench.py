@@ -364,6 +364,13 @@ def main():
                          "budget, events/s as a fraction of the all-"
                          "resident baseline + p99_fire_ms + prefetch "
                          "hit/miss counts")
+    ap.add_argument("--selftune", action="store_true",
+                    help="run ONLY the self-tuning drill (ISSUE 19): "
+                         "skew-shifting stream on a 4-device CPU mesh "
+                         "whose hot key-groups migrate mid-run; the "
+                         "controller's live rebalance must restore "
+                         ">= 0.8x balanced throughput without restart "
+                         "while the controller-off run stays degraded")
     ap.add_argument("--scaling", action="store_true",
                     help="run ONLY the chips-vs-events/s curve (ISSUE "
                          "13): the sharded resident drain at matched "
@@ -614,6 +621,73 @@ def main():
             "vs_baseline": round(frac / (7 / 8), 3),
             "criterion": ">= 0.6 * (7/8) = 0.525",
             "rescale_detect_to_first_fire_ms": mttr_ms,
+        }))
+        return
+
+    if args.selftune:
+        # self-tuning drill (ISSUE 19): defined on the 4-device virtual
+        # CPU mesh, forced BEFORE JAX initializes — so it runs in a
+        # CHILD process with one retry, same segfault workarounds as
+        # the elastic drill (no compile cache under the forced mesh)
+        child_env = dict(os.environ)
+        child_env["JAX_PLATFORMS"] = "cpu"
+        xla = " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "host_platform_device_count" not in f
+        )
+        child_env["XLA_FLAGS"] = (
+            f"{xla} --xla_force_host_platform_device_count=4".strip()
+        )
+        child_env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        code = (
+            "import json, jax; "
+            "jax.config.update('jax_platforms', 'cpu'); "
+            "from bench_configs import run_selftune; "
+            f"on, off, p99, ctl = run_selftune({args.events}, True); "
+            "print('SELFTUNE_RESULT ' + json.dumps([on, off, p99, ctl]))"
+        )
+        result, last_err = None, "no attempts ran"
+        for attempt in range(2):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", code], env=child_env,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    timeout=1200, capture_output=True, text=True,
+                )
+            except subprocess.TimeoutExpired:
+                last_err = "selftune child timed out (>1200s)"
+                continue
+            sys.stderr.write(r.stderr)
+            for line in r.stdout.splitlines():
+                if line.startswith("SELFTUNE_RESULT "):
+                    result = json.loads(line[len("SELFTUNE_RESULT "):])
+                else:
+                    print(line)     # the drill's detail JSON passes up
+            if result is not None:
+                break
+            last_err = (
+                f"selftune child rc={r.returncode}: "
+                f"{(r.stderr or r.stdout).strip()[-300:]}"
+            )
+            print(f"selftune drill attempt {attempt + 1} failed; "
+                  f"retrying: {last_err}", file=sys.stderr)
+        if result is None:
+            fail(f"selftune drill failed twice: {last_err}")
+        ratio_on, ratio_off, p99_ms, ctl = result
+        print(json.dumps({
+            "metric": "self-tuning controller: skew-shifting stream, "
+                      "hot key-groups migrate mid-run; live rebalance "
+                      "tail throughput vs balanced baseline",
+            "value": ratio_on,
+            "unit": "fraction of balanced tail throughput",
+            "p99_fire_ms": p99_ms,
+            "vs_baseline": (
+                round(ratio_on / ratio_off, 2) if ratio_off else 0
+            ),
+            "criterion": ">= 0.8 of balanced throughput without "
+                         "restart; controller-off stays degraded",
+            "controller_off_fraction": ratio_off,
+            **ctl,
         }))
         return
 

@@ -352,6 +352,12 @@ class CoreOptions:
         "poll cycles a key-group must stay in its tier before the "
         "ranker may flip it again (hysteresis against promote/demote "
         "thrash; an imminent-fire promote overrides it)")
+    STATE_TIERS_MAX_SWAPS_PER_CYCLE = ConfigOption(
+        "state.tiers.max-swaps-per-cycle", 0,
+        "cap on tier promote+demote moves one poll cycle may splice "
+        "(0 = unlimited); a working-set shift bigger than the cap "
+        "carries the remainder to the next cycle instead of stalling "
+        "the step loop behind one giant swap burst")
     RESTART_STRATEGY = ConfigOption("restart-strategy", "none")
     RESTART_ATTEMPTS = ConfigOption("restart-strategy.fixed-delay.attempts", 3)
     RESTART_DELAY_S = ConfigOption("restart-strategy.fixed-delay.delay", 0.0)
@@ -525,6 +531,52 @@ class CoreOptions:
         "steady-state XLA compiles beyond which the doctor reports a "
         "recompile storm (steady state should dispatch pre-compiled "
         "steps only)")
+    # -- self-tuning runtime controller (runtime/controller.py,
+    # docs/self-tuning.md): closed loop over the doctor's findings +
+    # the raw regime/heat planes, serviced at the poll-cycle seam ------
+    CONTROLLER_ENABLED = ConfigOption(
+        "controller.enabled", False,
+        "enable the self-tuning RuntimeController: bounded hill-climb "
+        "over the declared hot knobs keyed on the observed regime, "
+        "plus live heat-balanced key-group rebalancing through the "
+        "savepoint-cut rescale. Off (the default) constructs nothing "
+        "and adds zero work to any path")
+    CONTROLLER_INTERVAL_CYCLES = ConfigOption(
+        "controller.interval-cycles", 16,
+        "poll cycles between controller decisions; each decision "
+        "applies at most one knob move or one rebalance, so the "
+        "interval is also the minimum spacing between actuations")
+    CONTROLLER_REVERT_THRESHOLD = ConfigOption(
+        "controller.revert-threshold", 0.05,
+        "fractional worsening of the tracked metric (events/s) within "
+        "the probation window that auto-reverts a knob move; the "
+        "reverted (knob, direction) then sits out a cooldown")
+    CONTROLLER_PROBATION_CYCLES = ConfigOption(
+        "controller.probation-cycles", 16,
+        "poll cycles a knob move stays on probation: the controller "
+        "compares the tracked metric before vs after and reverts past "
+        "controller.revert-threshold; no new move starts meanwhile")
+    CONTROLLER_COOLDOWN_CYCLES = ConfigOption(
+        "controller.cooldown-cycles", 64,
+        "poll cycles a reverted (knob, direction) pair is barred from "
+        "being retried (keeps the hill-climb from oscillating on a "
+        "knob the workload has already voted down)")
+    CONTROLLER_REBALANCE_THRESHOLD = ConfigOption(
+        "controller.rebalance-threshold", 4.0,
+        "per-shard key-group heat skew (hottest shard / mean shard "
+        "heat) above which the controller considers a live "
+        "heat-balanced re-slice of the shard ranges")
+    CONTROLLER_MIN_REBALANCE_INTERVAL = ConfigOption(
+        "controller.min-rebalance-interval", 30.0,
+        "seconds between live rebalances: each one is a savepoint-cut "
+        "rescale (flush + snapshot + re-plan + restore), so the rate "
+        "limit bounds how much of the job's time rebalancing may eat")
+    CONTROLLER_MIN_GAIN = ConfigOption(
+        "controller.min-gain", 1.2,
+        "predicted imbalance improvement (current hottest-shard heat / "
+        "rebalanced hottest-shard heat) a re-slice must clear before "
+        "the controller pays for a live rescale; gains under it are "
+        "skipped and ledgered as such")
     # -- state backend / keying (docs/performance.md) -------------------
     # The keys below predate the config-hygiene lint (ISSUE 9): they
     # were read as bare literals across the executor; declaring them

@@ -163,6 +163,14 @@ class MetricGroup:
     def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
         return self._register(name, Gauge(fn))
 
+    def remove(self, name: str):
+        """Drop a metric registered under this group's scope — the
+        scale-DOWN half of idempotent re-registration: per-shard series
+        re-registered on an elastic re-plan overwrite in place, but the
+        shards that no longer exist must be unregistered or their stale
+        gauges keep reporting the dead mesh forever."""
+        self._registry.unregister(self.scope_string(name))
+
     def histogram(self, name: str, window: int = 1024) -> Histogram:
         return self._register(name, Histogram(window))
 

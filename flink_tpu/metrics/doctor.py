@@ -70,8 +70,9 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
 
 def _finding(rule: str, severity: str, score: float, summary: str,
              evidence: Dict[str, Any], remedy_key: str,
-             remedy_suggestion: str) -> Dict[str, Any]:
-    return {
+             remedy_suggestion: str,
+             action: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    out = {
         "rule": rule,
         "severity": severity,
         "score": round(float(score), 4),
@@ -79,6 +80,14 @@ def _finding(rule: str, severity: str, score: float, summary: str,
         "evidence": evidence,
         "remedy": {"key": remedy_key, "suggestion": remedy_suggestion},
     }
+    if action is not None:
+        # machine-actionable arm of the remedy: names a registered
+        # RuntimeController actuator (runtime/controller.py
+        # ACTUATOR_NAMES — the contract lint in tests/test_doctor.py
+        # pins the two lists together) so the self-tuning loop can
+        # apply the same advice the human-facing remedy describes
+        out["action"] = action
+    return out
 
 
 # ---------------------------------------------------------------- rules
@@ -108,6 +117,7 @@ def _rule_ring_starved(snap, th):
         "pipeline.prefetch-depth",
         "raise pipeline.prefetch-depth (and check the source poll "
         "rate) so the publish side keeps the ring fed between drains",
+        action={"actuator": "ring-fill-target", "direction": "down"},
     )
 
 
@@ -136,6 +146,7 @@ def _rule_device_saturated(snap, th):
         "raise pipeline.ring-depth (more slots retire per dispatch) "
         "and/or pipeline.steps-per-dispatch to amortize the fixed "
         "dispatch cost over more work",
+        action={"actuator": "ring-fill-target", "direction": "up"},
     )
 
 
@@ -215,6 +226,7 @@ def _rule_kg_heat_skew(snap, th):
         "re-slice the shard key-group ranges around the hot groups "
         "(the savepoint-cut rescale path), or raise parallelism so "
         "the hot groups spread over more shards",
+        action={"actuator": "rebalance-key-groups"},
     )
 
 
@@ -251,6 +263,7 @@ def _rule_recompile_storm(snap, th):
         "find the shape leak (env._compile_report() names the stages); "
         "pin batch shapes or lower pipeline.steps-per-dispatch so one "
         "signature serves every dispatch",
+        action={"actuator": "dispatch-group", "direction": "down"},
     )
 
 
@@ -354,6 +367,7 @@ def _rule_tier_thrash(snap, th):
             f"device copies faster than the working set justifies"
         )
         score = churn
+        action = None
     else:
         summary = (
             f"tier prefetch is mispredicting: {misses}/{hits + misses} "
@@ -361,6 +375,11 @@ def _rule_tier_thrash(snap, th):
             f"({miss_frac:.0%} >= {th['tier_miss']:.0%})"
         )
         score = miss_frac
+        # only the miss arm is machine-actionable: backing off the
+        # prefetch horizon is safe; the churn arm's remedy (grow the
+        # resident budget) changes memory shape, which stays a human
+        # decision
+        action = {"actuator": "tier-prefetch-ahead", "direction": "down"}
     return _finding(
         "tier-thrash", "warning", score, summary,
         {
@@ -381,6 +400,7 @@ def _rule_tier_thrash(snap, th):
         "raise state.tiers.min-dwell-cycles to damp the churn; if the "
         "misses dominate, lower state.tiers.prefetch-ahead-panes so "
         "promotion waits for firmer watermark evidence",
+        action=action,
     )
 
 

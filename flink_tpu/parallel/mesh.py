@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from flink_tpu.core.keygroups import (
+    KeyGroupRange,
     check_parallelism,
     key_group_range_for_operator,
 )
@@ -36,26 +37,65 @@ from flink_tpu.core.keygroups import (
 SHARD_AXIS = "shards"
 
 
+def validate_kg_slices(max_parallelism: int, n_shards: int, slices):
+    """Check a custom contiguous key-group slicing: ``slices`` is a
+    sequence of ``n_shards`` (start, end) pairs with INCLUSIVE ends,
+    non-empty, strictly increasing, covering [0, max_parallelism-1]
+    exactly. The searchsorted ownership mapping
+    (:meth:`MeshContext.shard_of_key_groups`) and the ingest route
+    planner both assume exactly this shape, so a malformed slicing is a
+    loud error here rather than silent misrouting there."""
+    if len(slices) != n_shards:
+        raise ValueError(
+            f"kg_slices has {len(slices)} ranges for {n_shards} shards")
+    lo = 0
+    for i, (s, e) in enumerate(slices):
+        s, e = int(s), int(e)
+        if s != lo or e < s:
+            raise ValueError(
+                f"kg_slices[{i}]=({s},{e}) must start at {lo} and be "
+                f"non-empty (inclusive ends, contiguous cover)")
+        lo = e + 1
+    if lo != max_parallelism:
+        raise ValueError(
+            f"kg_slices cover [0,{lo - 1}] but max_parallelism is "
+            f"{max_parallelism}")
+
+
 @dataclass
 class MeshContext:
-    """A job's device topology: n_shards over the `shards` mesh axis."""
+    """A job's device topology: n_shards over the `shards` mesh axis.
+
+    ``kg_slices`` optionally overrides the uniform key-group
+    partition with a custom contiguous slicing (the controller's
+    heat-balanced rebalance, ISSUE 19): a tuple of inclusive
+    (start, end) pairs, one per shard, validated to cover
+    [0, max_parallelism-1]. Every ownership consumer reads through
+    ``key_group_ranges``/``kg_bounds``/``shard_of_key_groups``, so the
+    override is a single cut."""
 
     mesh: Mesh
     max_parallelism: int
+    kg_slices: Optional[tuple] = None
 
     @staticmethod
     def create(
         n_shards: Optional[int] = None,
         max_parallelism: int = 128,
         devices=None,
+        kg_slices=None,
     ) -> "MeshContext":
         devices = devices if devices is not None else jax.devices()
         n = n_shards or len(devices)
         if n > len(devices):
             raise ValueError(f"need {n} devices, have {len(devices)}")
         check_parallelism(max_parallelism, n)
+        if kg_slices is not None:
+            kg_slices = tuple(
+                (int(s), int(e)) for s, e in kg_slices)
+            validate_kg_slices(max_parallelism, n, kg_slices)
         mesh = Mesh(np.asarray(devices[:n]), (SHARD_AXIS,))
-        return MeshContext(mesh, max_parallelism)
+        return MeshContext(mesh, max_parallelism, kg_slices)
 
     @property
     def n_shards(self) -> int:
@@ -63,6 +103,8 @@ class MeshContext:
 
     @cached_property
     def key_group_ranges(self):
+        if self.kg_slices is not None:
+            return [KeyGroupRange(s, e) for s, e in self.kg_slices]
         return [
             key_group_range_for_operator(self.max_parallelism, self.n_shards, i)
             for i in range(self.n_shards)

@@ -54,6 +54,7 @@ from flink_tpu.metrics.tracing import (
     cost_analysis_of,
     tracer_from_config,
 )
+from flink_tpu.runtime import controller as controller_mod
 from flink_tpu.runtime import elastic
 from flink_tpu.runtime import ingest as ingest_mod
 from flink_tpu.runtime import stages as stages_mod
@@ -1593,6 +1594,12 @@ class LocalExecutor:
         n_dev = len(jax.devices())
         n_shards = max(1, min(env.parallelism, n_dev))
         ctx = MeshContext.create(n_shards, env.max_parallelism)
+        # controller-chosen heat-balanced key-group slicing (ISSUE 19):
+        # holds the (start, end) pairs the NEXT _replan_mesh installs,
+        # persisting a rebalance across subsequent setups; None = the
+        # uniform slicing. A shard-COUNT change (elastic loss/scale-up)
+        # drops it — the heat evidence it encoded was per-shard.
+        kg_slices_hold = [None]
         # -- elastic survival (runtime/elastic.py; ISSUE 8): device loss
         # re-plans the job over the surviving shards instead of crash-
         # looping at a parallelism the mesh no longer has. The
@@ -1931,6 +1938,9 @@ class LocalExecutor:
                         min_dwell_cycles=int(env.config.get(
                             _CoreOpts.STATE_TIERS_MIN_DWELL_CYCLES
                         )),
+                        max_swaps_per_cycle=int(env.config.get(
+                            _CoreOpts.STATE_TIERS_MAX_SWAPS_PER_CYCLE
+                        )),
                     )
                 else:
                     # elastic re-plan / restore: re-slice residency to
@@ -2229,12 +2239,22 @@ class LocalExecutor:
                             # the main gauges block) so they track the
                             # mesh size across elastic re-plans;
                             # registry.register overwrites, so the
-                            # repeat registration is idempotent.
+                            # repeat registration is idempotent — and
+                            # a scale-DOWN re-plan removes the series
+                            # of shards that no longer exist (ISSUE 19
+                            # bugfix: stale gauges reported the dead
+                            # mesh forever)
                             for _s in range(ctx.n_shards):
                                 self._job_group.gauge(
                                     f"ring_publish_refusals_shard_{_s}",
                                     partial(_ring_refusals, _s),
                                 )
+                            for _s in range(ctx.n_shards,
+                                            refusal_gauge_n[0]):
+                                self._job_group.remove(
+                                    f"ring_publish_refusals_shard_{_s}"
+                                )
+                            refusal_gauge_n[0] = ctx.n_shards
                 if use_resident and drain_stats_on:
                     # drain flight recorder, host half: the
                     # aggregator the lagged consume path feeds,
@@ -2294,7 +2314,8 @@ class LocalExecutor:
                             return round(v, 3) if v is not None else 0.0
 
                         # same idempotency story as the refusal
-                        # series above (registry.register overwrites)
+                        # series above (registry.register overwrites;
+                        # shards dropped by a re-plan unregister)
                         for _s in range(n_lanes):
                             grp_d.gauge(
                                 f"drain_slot_fill_shard_{_s}",
@@ -2304,6 +2325,10 @@ class LocalExecutor:
                                 f"drain_duty_cycle_shard_{_s}",
                                 partial(_dt_duty, _s),
                             )
+                        for _s in range(n_lanes, drain_gauge_n[0]):
+                            grp_d.remove(f"drain_slot_fill_shard_{_s}")
+                            grp_d.remove(f"drain_duty_cycle_shard_{_s}")
+                        drain_gauge_n[0] = n_lanes
                         for _q in (50, 95, 99):
                             grp_d.gauge(
                                 f"drain_fire_latency_p{_q}_ms",
@@ -3133,8 +3158,15 @@ class LocalExecutor:
             the re-plan with a restore (rescaled cut) — state is NOT
             touched here."""
             nonlocal ctx, _kg_ends, compact_step_fn
+            if (kg_slices_hold[0] is not None
+                    and len(kg_slices_hold[0]) != len(devices)):
+                # a heat-balanced slicing is per-shard-count evidence:
+                # an elastic re-plan to a DIFFERENT count falls back to
+                # the uniform slices (the controller re-derives later)
+                kg_slices_hold[0] = None
             ctx = MeshContext.create(
-                len(devices), env.max_parallelism, devices=devices
+                len(devices), env.max_parallelism, devices=devices,
+                kg_slices=kg_slices_hold[0],
             )
             _kg_ends = np.asarray(ctx.kg_bounds()[1])
             steps_by_route.clear()
@@ -3599,11 +3631,21 @@ class LocalExecutor:
         drain_stats_on = env.config.get_bool(
             "observability.drain-stats", tracer is not None
         )
-        drain_stats_every = max(1, env.config.get_int(
+        # one-element holder (not a plain local) so the runtime
+        # controller's drain-stats-cadence actuator can retune the host
+        # fetch cadence live (ISSUE 19) — the device computes the
+        # payload every drain either way; this only paces the keeps
+        drain_stats_every = [max(1, env.config.get_int(
             "observability.drain-stats-every", 8
-        ))
+        ))]
         drain_telem = [None]   # DrainTelemetry; built in setup() when
         ds_skip = [0]          # the resident loop is live (payload cadence)
+        # per-shard gauge high-water marks: how many labelled series the
+        # last setup() registered, so a scale-down re-plan can remove
+        # the stale tail (setup() resolves these at call time, like
+        # `ingest` below)
+        refusal_gauge_n = [0]
+        drain_gauge_n = [0]
 
         def refresh_kg_occupancy(force: bool = False):
             """Run the per-key-group occupancy kernel and cache the host
@@ -3675,7 +3717,7 @@ class LocalExecutor:
             rep = dt.report(
                 refusals=dr.refusals() if dr is not None else None
             )
-            rep["drain_stats_every"] = drain_stats_every
+            rep["drain_stats_every"] = drain_stats_every[0]
             if tier_mgr[0] is not None:
                 rep["tiers"] = tier_mgr[0].report()
             if self._attribution is not None:
@@ -4215,7 +4257,7 @@ class LocalExecutor:
                 ds_h = None
                 if drain_stats_on:
                     ds_skip[0] += 1
-                    if ds_skip[0] >= drain_stats_every:
+                    if ds_skip[0] >= drain_stats_every[0]:
                         ds_skip[0] = 0
                         ds_h = res[3]
                 fire_watch.append(
@@ -4444,36 +4486,54 @@ class LocalExecutor:
         MON_EVERY = 8
         OVF_LAG = 1
 
+        def _absorb_kg(kgf_h, n_batches):
+            """Fold one sampled dispatch's per-key-group record counts
+            ([n_shards, maxp] — shards are disjoint, sum them;
+            [n_shards, 0] when the steps were built without kg_fill)
+            into the skew telemetry. n_batches = micro-batches the
+            handle covers (K for a fused megastep), so fill-per-sampled-
+            batch stays a per-batch rate."""
+            kgf = np.asarray(kgf_h)
+            if not kgf.size:
+                return
+            kg_sum = kgf.sum(axis=0)
+            kg_fill_total[:] += kg_sum
+            kg_fill_sampled[0] += n_batches
+            # key-group heat (ISSUE 17): the same sampled fill
+            # vector folds into the flight recorder's EWMA heat +
+            # recency series — the demote/prefetch and
+            # live-rebalance sensor; host numpy on the fetched
+            # lagged handle, no extra sync
+            dt_kg = drain_telem[0]
+            if dt_kg is not None:
+                dt_kg.absorb_kg_fill(kg_sum, n_batches)
+            if tier_mgr[0] is not None:
+                # tier fault accounting rides the SAME sampled
+                # vector: traffic into a non-resident group = a
+                # batch that fell down the route ladder (documented
+                # sampled, like every MON_EVERY-cadence counter)
+                tier_mgr[0].note_sample(kg_sum)
+
+        def salvage_kg_watch():
+            """Drain mon_watch keeping ONLY the kg_fill counts. The
+            queued ring-fill handles go stale across an overflow drain
+            (they reflect pre-drain occupancy), but the kg counts
+            measure the sampled dispatch's record traffic — still valid.
+            Dropping them too blinds the heat plane exactly while the
+            pipeline sits in sustained overflow, which is when the
+            skew sensor (tier placement, live rebalance) is the only
+            thing that can relieve the pressure."""
+            while mon_watch:
+                _, _, kgf_h, n_batches = mon_watch.popleft()
+                _absorb_kg(kgf_h, n_batches)
+
         def check_overflow_pressure():
             if len(mon_watch) <= OVF_LAG:
                 return
             ovf_h, act_h, kgf_h, n_batches = mon_watch.popleft()
             fill = int(np.asarray(ovf_h).max(initial=0))
             act = int(np.asarray(act_h).sum())
-            # skew telemetry: the sampled dispatch's per-key-group record
-            # counts ([n_shards, maxp] — shards are disjoint, sum them;
-            # [n_shards, 0] when the steps were built without kg_fill).
-            # n_batches = micro-batches the handle covers (K for a fused
-            # megastep), so fill-per-sampled-batch stays a per-batch rate
-            kgf = np.asarray(kgf_h)
-            if kgf.size:
-                kg_sum = kgf.sum(axis=0)
-                kg_fill_total[:] += kg_sum
-                kg_fill_sampled[0] += n_batches
-                # key-group heat (ISSUE 17): the same sampled fill
-                # vector folds into the flight recorder's EWMA heat +
-                # recency series — the demote/prefetch and
-                # live-rebalance sensor; host numpy on the fetched
-                # lagged handle, no extra sync
-                dt_kg = drain_telem[0]
-                if dt_kg is not None:
-                    dt_kg.absorb_kg_fill(kg_sum, n_batches)
-                if tier_mgr[0] is not None:
-                    # tier fault accounting rides the SAME sampled
-                    # vector: traffic into a non-resident group = a
-                    # batch that fell down the route ladder (documented
-                    # sampled, like every MON_EVERY-cadence counter)
-                    tier_mgr[0].note_sample(kg_sum)
+            _absorb_kg(kgf_h, n_batches)
             # -- adaptive step tiering: while new keys are being PLACED,
             # run the upsert step; once placement stops
             # (TIER_QUIET_CHECKS consecutive zero-activity checks), switch
@@ -4577,7 +4637,8 @@ class LocalExecutor:
                 return
             if not _merge_ring_into_stores():
                 return
-            mon_watch.clear()     # queued handles reflect pre-drain fill
+            salvage_kg_watch()    # fill handles reflect pre-drain fill;
+            #                       the kg traffic counts stay valid
             miss_tolerance[0] = 0  # compaction may change placeability
             if spec.layout == "direct":
                 # no dead slots to free (slot == key, table immutable) —
@@ -5422,6 +5483,167 @@ class LocalExecutor:
         # connection: serialize them with the producer's polls
         ck_io.source_lock = ingest.source_lock
 
+        # -- self-tuning runtime controller (runtime/controller.py;
+        # ISSUE 19, ROADMAP item 3): the closed loop over the doctor's
+        # findings + the raw regime/heat planes, serviced at the poll-
+        # cycle boundary below. Constructed ONLY when controller.enabled
+        # is on — the shipping default (off) builds nothing here, reads
+        # no sensor, registers no gauge: the off path stays byte-neutral
+        # (no new dispatches, drain kernels untouched).
+        runtime_ctl = [None]
+
+        def _controller_sensor():
+            """One host dict of the planes the controller decides on —
+            all already-fetched telemetry (regime/heat EWMAs maintained
+            by the lagged consume path), never a fresh device sync."""
+            dt = drain_telem[0]
+            duty = starved = None
+            heat = None
+            if dt is not None:
+                duty, starved = dt.regime()
+                h = getattr(dt, "_kg_heat", None)
+                if h is not None and len(h) == ctx.max_parallelism:
+                    heat = np.array(h, np.float64)
+            starts_c, ends_c = ctx.kg_bounds()
+            return {
+                "records": int(metrics.records_in),
+                "duty": duty, "starved": starved, "heat": heat,
+                "kg_starts": [int(x) for x in starts_c],
+                "kg_ends": [int(x) for x in ends_c],
+            }
+
+        def _controller_rebalance(starts, ends):
+            """Apply a heat-balanced re-slice LIVE through the same
+            savepoint-cut machinery as the elastic scale-up — exactly-
+            once preserved (tiers re-slice inside setup(), the
+            incremental chain re-bases). On ANY failure the pre-
+            rebalance slicing re-latches so recovery re-plans the mesh
+            the job actually ran on, not the half-applied target."""
+            if td is None or state is None:
+                raise RuntimeError(
+                    "controller rebalance before the job has state")
+            # chaos seam: a crash here lands mid-rebalance, BEFORE the
+            # cut — restart must recover exactly-once from the last
+            # completed checkpoint (tests/test_controller.py)
+            faults.inject(
+                "controller.apply",
+                ends=[int(e) for e in ends],
+                n_shards=ctx.n_shards,
+            )
+            prev = kg_slices_hold[0]
+            kg_slices_hold[0] = tuple(
+                (int(s), int(e)) for s, e in zip(starts, ends)
+            )
+            try:
+                _rescale_live(
+                    list(np.asarray(ctx.mesh.devices).flat),
+                    "rebalance", "controller heat rebalance",
+                )
+            except BaseException:
+                kg_slices_hold[0] = prev
+                raise
+
+        if env.config.get(_CoreOpts.CONTROLLER_ENABLED):
+            _acts = {}
+            if use_resident:
+                # effective drain fill target: the accumulator's
+                # capacity is a plain attribute the count-gated drain
+                # serves at ANY fill level 1..ring_depth — a live write,
+                # zero recompiles. Down = drain earlier (ring-starved
+                # regime), up = amortize dispatch cost (saturated).
+                def _rf_set(v):
+                    fused.k = int(v)
+
+                _acts["ring-fill-target"] = controller_mod.Actuator(
+                    "ring-fill-target", lambda: int(fused.k), _rf_set,
+                    lo=1, hi=ring_depth,
+                )
+            elif k_fuse > 1:
+                # without the resident ring the same attribute is the
+                # megastep grouping (pipeline.steps-per-dispatch):
+                # shrinking it bounds recompile exposure per dispatch
+                def _dg_set(v):
+                    fused.k = int(v)
+
+                _acts["dispatch-group"] = controller_mod.Actuator(
+                    "dispatch-group", lambda: int(fused.k), _dg_set,
+                    lo=1, hi=k_fuse,
+                )
+            if drain_stats_on:
+                def _ds_set(v):
+                    drain_stats_every[0] = max(1, int(v))
+
+                _acts["drain-stats-cadence"] = controller_mod.Actuator(
+                    "drain-stats-cadence",
+                    lambda: int(drain_stats_every[0]), _ds_set,
+                    lo=1, hi=64,
+                )
+            if tier_budget_cfg > 0:
+                def _tp_get():
+                    tm = tier_mgr[0]
+                    if tm is not None:
+                        return int(tm.prefetch_ahead_panes)
+                    return int(env.config.get(
+                        _CoreOpts.STATE_TIERS_PREFETCH_AHEAD_PANES))
+
+                def _tp_set(v):
+                    tm = tier_mgr[0]
+                    if tm is not None:
+                        tm.prefetch_ahead_panes = max(0, int(v))
+
+                _acts["tier-prefetch-ahead"] = controller_mod.Actuator(
+                    "tier-prefetch-ahead", _tp_get, _tp_set,
+                    lo=0, hi=16, step="additive",
+                )
+
+            runtime_ctl[0] = controller_mod.RuntimeController(
+                _acts, _controller_sensor,
+                findings_fn=lambda: (
+                    (doctor_report() or {}).get("findings") or []
+                ),
+                rebalancer=_controller_rebalance,
+                interval_cycles=int(env.config.get(
+                    _CoreOpts.CONTROLLER_INTERVAL_CYCLES)),
+                revert_threshold=float(env.config.get(
+                    _CoreOpts.CONTROLLER_REVERT_THRESHOLD)),
+                probation_cycles=int(env.config.get(
+                    _CoreOpts.CONTROLLER_PROBATION_CYCLES)),
+                cooldown_cycles=int(env.config.get(
+                    _CoreOpts.CONTROLLER_COOLDOWN_CYCLES)),
+                rebalance_threshold=float(env.config.get(
+                    _CoreOpts.CONTROLLER_REBALANCE_THRESHOLD)),
+                min_rebalance_interval=float(env.config.get(
+                    _CoreOpts.CONTROLLER_MIN_REBALANCE_INTERVAL)),
+                min_gain=float(env.config.get(
+                    _CoreOpts.CONTROLLER_MIN_GAIN)),
+            )
+            if self._job_group is not None:
+                grp_c = self._job_group
+
+                def _ctl_ctr(field):
+                    ctl = runtime_ctl[0]
+                    return int(getattr(ctl, field)) if ctl else 0
+
+                grp_c.gauge("controller_actions",
+                            partial(_ctl_ctr, "actions"))
+                grp_c.gauge("controller_reverts",
+                            partial(_ctl_ctr, "reverts"))
+                grp_c.gauge("controller_rebalances",
+                            partial(_ctl_ctr, "rebalances"))
+
+        def controller_report() -> dict:
+            """/jobs/<jid>/controller body: the decision ledger +
+            actuator/counter view (or the off stub)."""
+            ctl = runtime_ctl[0]
+            if ctl is None:
+                return {
+                    "available": False,
+                    "reason": "controller.enabled off",
+                }
+            return ctl.report()
+
+        env._controller_report = controller_report
+
         def _apply_planned(pb):
             """Apply one PLANNED single-group batch: the ingest side
             already chose the route and (with staging on) moved the
@@ -5509,14 +5731,28 @@ class LocalExecutor:
             # a loss even lands — stays pending until it applies.
             if td is not None and elastic_ctl.degraded and \
                     elastic_ctl.take_scale_up_request():
-                _rescale_live(
-                    list(elastic_ctl.full_devices), "scale_up",
-                    "operator scale-up request",
-                )
+                try:
+                    _rescale_live(
+                        list(elastic_ctl.full_devices), "scale_up",
+                        "operator scale-up request",
+                    )
+                except BaseException:
+                    # the latch was consumed but the rescale never
+                    # completed: re-latch so the request survives the
+                    # recovery restart instead of being silently lost
+                    # (ISSUE 19 bugfix)
+                    elastic_ctl.request_scale_up()
+                    raise
             # tiered state maintenance rides the same cycle-boundary
             # seam: residency swaps happen between dispatches, at a cut
             if tier_mgr[0] is not None and td is not None:
                 _tier_maintenance()
+            # self-tuning controller (ISSUE 19): same seam — at most one
+            # knob move or rebalance per interval, between dispatches,
+            # at a cut. None (the default) costs one list-index check.
+            if runtime_ctl[0] is not None and td is not None \
+                    and state is not None:
+                runtime_ctl[0].service()
             if tracer is not None:
                 tracer.begin_cycle()   # sampling decision for this cycle
             t_c0 = time.perf_counter()

@@ -77,7 +77,8 @@ class TierManager:
     def __init__(self, max_parallelism: int, starts: Sequence[int],
                  ends: Sequence[int], budget: int,
                  prefetch_ahead_panes: int = 2,
-                 min_dwell_cycles: int = 4):
+                 min_dwell_cycles: int = 4,
+                 max_swaps_per_cycle: int = 0):
         if budget <= 0:
             raise ValueError("tier budget must be positive "
                              "(0 disables tiering upstream)")
@@ -85,6 +86,12 @@ class TierManager:
         self.budget = int(budget)
         self.prefetch_ahead_panes = int(prefetch_ahead_panes)
         self.min_dwell_cycles = int(min_dwell_cycles)
+        # cap on promote+demote moves one plan may return
+        # (state.tiers.max-swaps-per-cycle; 0 = unlimited): swap work
+        # runs at the poll-cycle seam on the step loop, so a working-set
+        # shift bigger than the cap carries forward instead of stalling
+        # one cycle behind a giant splice burst
+        self.max_swaps_per_cycle = int(max_swaps_per_cycle)
         self.resident = np.zeros(self.maxp, bool)
         self._shard_of = np.zeros(self.maxp, np.int32)
         self._cycle = 0
@@ -238,6 +245,14 @@ class TierManager:
         dwell_ok = (
             self._cycle - self._last_flip >= self.min_dwell_cycles
         )
+        # swap budget across BOTH move kinds and all shards; a plan the
+        # cap truncates leaves the residue un-flipped (no _last_flip
+        # stamp), so the next cycle's ranking re-derives and carries it
+        # forward
+        swaps_left = (
+            self.max_swaps_per_cycle if self.max_swaps_per_cycle > 0
+            else 2 * self.maxp + 1
+        )
         for s in range(len(self.starts)):
             lo, hi = int(self.starts[s]), int(self.ends[s])
             if lo > hi:
@@ -252,21 +267,26 @@ class TierManager:
             want[order[: self.budget]] = True
             demoted_here = 0
             for i in np.nonzero(res & ~want)[0]:
+                if swaps_left <= 0:
+                    break
                 g = int(rng[i])
                 if dwell_ok[g]:
                     demote.append(g)
                     demoted_here += 1
+                    swaps_left -= 1
             # promotions fill exactly the slots the demotes freed (plus
             # any initial slack), so residency never exceeds the budget
+            # — a capped demote pass shrinks the room with it
             room = self.budget - (int(res.sum()) - demoted_here)
             for i in order:
-                if room <= 0:
+                if room <= 0 or swaps_left <= 0:
                     break
                 if want[i] and not res[i]:
                     g = int(rng[i])
                     if dwell_ok[g] or g in urgent:
                         promote.append(g)
                         room -= 1
+                        swaps_left -= 1
                         if g in urgent or self._cold_count.get(g, 0) == 0:
                             prefetch.add(g)
         return TierPlan(demote=demote, promote=promote, prefetch=prefetch)

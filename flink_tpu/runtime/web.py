@@ -29,6 +29,10 @@ Endpoints (reference REST shapes, docs/monitoring/rest_api.md):
                               evidence + config remedies, snapshot
                               embedded for offline replay
                               (observability.doctor, ISSUE 17)
+    /jobs/<jid>/controller    self-tuning controller decision ledger:
+                              knob moves/reverts/rebalances with
+                              evidence, live actuator values
+                              (controller.enabled, ISSUE 19)
     /metrics                  Prometheus text exposition over every job's
                               registry (text/plain, not JSON — scrape me)
     /jobs/<jid>/checkpoints   checkpoint history: id/duration/bytes/entries
@@ -1066,6 +1070,23 @@ class WebMonitor:
                     "available": False,
                     "hint": "the doctor runs over windowed keyed "
                             "stages' telemetry; this job has none (yet)",
+                }
+            return report_fn()
+        m = re.fullmatch(r"/jobs/([^/]+)/controller", path)
+        if m:
+            # the self-tuning runtime controller (ISSUE 19): decision
+            # ledger (tune/revert/rebalance entries with before/after
+            # evidence), live actuator values, probation/cooldown state
+            # (runtime/controller.py; controller.enabled gates it)
+            rec = self.cluster.jobs.get(m.group(1))
+            if rec is None:
+                return None       # JSON 404: unknown job id
+            report_fn = getattr(rec.env, "_controller_report", None)
+            if report_fn is None:
+                return {
+                    "available": False,
+                    "hint": "the controller services windowed keyed "
+                            "stages; this job has none (yet)",
                 }
             return report_fn()
         m = re.fullmatch(r"/jobs/([^/]+)/elasticity", path)

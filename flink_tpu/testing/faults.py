@@ -78,6 +78,13 @@ Injection-point catalog (the sites wired in this repo):
                             checksummed file read — a corrupt or torn
                             spill dump surfaces here and the caller
                             falls back instead of restoring bad state
+    controller.apply        runtime/executor controller rebalance, after
+                            the decision but BEFORE the savepoint-cut
+                            _rescale_live — a crash mid-rebalance lands
+                            ahead of the cut, so restart must recover
+                            exactly-once from the last completed
+                            checkpoint with the PRE-rebalance slicing
+                            re-latched (tests/test_controller.py)
 
 Actions:
 

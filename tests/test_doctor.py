@@ -13,9 +13,14 @@
 * DrainTelemetry's stage-aware half: per-downstream-stage counter
   totals / levels / peaks, the report() stages block with edge
   utilization, and the key-group heat series (EWMA fold, recency,
-  cold tail, skew, live resize).
+  cold tail, skew, live resize);
+* the doctor->controller contract lint (ISSUE 19): every remedy key
+  a finding emits names a declared ConfigOption, and every machine
+  ``action`` names a registered RuntimeController actuator.
 """
 
+import ast
+import inspect
 import json
 import subprocess
 import sys
@@ -429,3 +434,70 @@ def test_kg_heat_normalizes_by_batches_and_resizes():
     dt.absorb_kg_fill(np.zeros(6, np.int64))
     assert dt.kg_heat_block(k=1)["groups"] == 6
     assert dt.kg_heat_max() == pytest.approx(2.0 * 0.0)  # alpha=1 decay
+
+
+# ------------------------------------------------ controller contract
+
+def _finding_call_sites():
+    """AST of every ``_finding(...)`` call in the doctor module."""
+    from flink_tpu.metrics import doctor as doctor_mod
+    tree = ast.parse(inspect.getsource(doctor_mod))
+    return [
+        node for node in ast.walk(tree)
+        if isinstance(node, ast.Call)
+        and getattr(node.func, "id", "") == "_finding"
+    ]
+
+
+def test_doctor_remedy_keys_are_declared_config_options():
+    """Every remedy a finding emits must name a key the Configuration
+    layer declares — a typo'd remedy would read as actionable advice
+    the config system then silently ignores. Linted statically so the
+    contract holds for rules no synthetic snapshot happens to fire."""
+    from flink_tpu.core.config import ConfigOption, CoreOptions
+    declared = {
+        v.key for v in vars(CoreOptions).values()
+        if isinstance(v, ConfigOption)
+    }
+    calls = _finding_call_sites()
+    assert calls                                   # lint found the rules
+    keys = []
+    for call in calls:
+        assert len(call.args) > 5, ast.dump(call)  # remedy_key positional
+        rk = call.args[5]
+        assert isinstance(rk, ast.Constant) and isinstance(rk.value, str)
+        keys.append(rk.value)
+    assert keys and set(keys) <= declared, sorted(set(keys) - declared)
+
+
+def test_doctor_actions_name_registered_actuators():
+    """The machine-actionable ``action`` arm of a remedy must name a
+    RuntimeController actuator: the self-tuning loop looks actions up
+    by name, and an unknown one is refused at apply time — far from
+    the rule that emitted it. Every literal ``{"actuator": ...}`` dict
+    in the module is checked, including those bound to locals before
+    being passed to ``_finding``."""
+    from flink_tpu.metrics import doctor as doctor_mod
+    from flink_tpu.runtime.controller import ACTUATOR_NAMES
+    tree = ast.parse(inspect.getsource(doctor_mod))
+    actions = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        lit = {
+            k.value: v for k, v in zip(node.keys, node.values)
+            if isinstance(k, ast.Constant)
+        }
+        if "actuator" not in lit:
+            continue
+        act = lit["actuator"]
+        assert isinstance(act, ast.Constant), ast.dump(node)
+        actions.append((node.lineno, act.value, lit.get("direction")))
+    assert actions                                  # lint found actions
+    names = {a for _, a, _ in actions}
+    assert names <= set(ACTUATOR_NAMES), sorted(names)
+    for lineno, _, direction in actions:
+        if direction is not None:
+            assert isinstance(direction, ast.Constant), lineno
+            assert direction.value in ("up", "down"), (lineno,
+                                                       direction.value)

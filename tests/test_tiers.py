@@ -259,3 +259,42 @@ def test_rescale_reslices_residency_and_keeps_counters():
     assert rep["cold_groups_pending"] == 1   # pending survives rescale
     assert tm.shard_of(5) == 1
 
+
+
+def test_max_swaps_cap_carries_residue_forward():
+    """``state.tiers.max-swaps-per-cycle`` bounds one plan's moves; the
+    truncated residue is re-derived and finished next cycle (ISSUE 19:
+    the controller leans on this to keep swap bursts off the poll
+    seam). Budget 1, one swap/cycle: cycle 1 spends its swap demoting
+    the stale incumbent, cycle 2 promotes the hot group."""
+    tm = _mgr(budget=1, min_dwell_cycles=0, max_swaps_per_cycle=1)
+    heat = np.zeros(8)
+    heat[5] = 100.0
+    last = np.full(8, -1, np.int64)
+    last[5] = 0
+    p1 = tm.plan(heat, last, seq=1)
+    assert (p1.demote, p1.promote) == ([0], [])
+    tm.apply(p1)
+    p2 = tm.plan(heat, last, seq=2)
+    assert (p2.demote, p2.promote) == ([], [5])
+    tm.apply(p2)
+    assert tm.mask()[5] and not tm.mask()[0]
+    # unlimited (the default 0): the same shift lands in one plan
+    tm2 = _mgr(budget=1, min_dwell_cycles=0)
+    p = tm2.plan(heat, last, seq=1)
+    assert (p.demote, p.promote) == ([0], [5])
+
+
+def test_rescale_accepts_unequal_ranges():
+    """The live heat-balanced re-slice (ISSUE 19) hands TierManager
+    deliberately unequal shard ranges — residency must seed from each
+    range's own head and the pending prefetch predictions must reset
+    (they were ranked under the old ownership)."""
+    tm = tiers_mod.TierManager(
+        8, np.asarray([0, 4]), np.asarray([3, 7]), budget=2)
+    assert sorted(np.nonzero(tm.mask())[0]) == [0, 1, 4, 5]
+    tm._prefetched.add(3)
+    tm.rescale(np.asarray([0, 6]), np.asarray([5, 7]))
+    assert sorted(np.nonzero(tm.mask())[0]) == [0, 1, 6, 7]
+    assert not tm._prefetched
+    assert tm.shard_of(5) == 0 and tm.shard_of(6) == 1

@@ -316,6 +316,7 @@ def test_web_job_scoped_endpoints_404_unknown_job():
             "/jobs/nope/plan", "/jobs/nope/exceptions",
             "/jobs/nope/recovery", "/jobs/nope/elasticity",
             "/jobs/nope/pipeline", "/jobs/nope/doctor",
+            "/jobs/nope/controller",
         ):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _get_json(port, path)

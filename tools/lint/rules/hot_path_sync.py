@@ -82,6 +82,12 @@ HOT_PATHS = (
     # chained drain directly — hold it to hot-path discipline so no
     # per-drain device sync sneaks in through a planner helper
     "flink_tpu/runtime/stages.py",
+    # self-tuning runtime controller (ISSUE 19): serviced at the poll-
+    # cycle boundary on the step-loop thread — its whole contract is
+    # host-side arithmetic over ALREADY-FETCHED telemetry (regime/heat
+    # EWMAs, doctor findings), so a device sync in a decision would
+    # stall the very pipeline it tunes
+    "flink_tpu/runtime/controller.py",
 )
 
 # documented host-facing seams that live in hot-path modules but are
