@@ -495,6 +495,41 @@ def main():
             "fused_p99_fire_ms": fused_p99,
             "batch": DEVICE_CEILING_BATCH,
         }))
+        # round-20 rows: early-exit while drain vs the scan drain at
+        # matched dims, and the per-host DCN-resident mode vs lockstep
+        from bench_configs import run_dcn_resident, run_while_drain
+
+        w_eps, s_eps, w_p99, s_p99 = run_while_drain(
+            args.events, args.cpu
+        )
+        print(json.dumps({
+            "metric": "early-exit while drain (max_slots=64) vs "
+                      "count-gated scan drain (D=32), matched dims, "
+                      "firing stream",
+            "value": round(w_eps),
+            "unit": "events/s",
+            "p99_fire_ms": w_p99,
+            "vs_baseline": round(w_eps / s_eps, 2) if s_eps else 0,
+            "criterion": ">= 1.0 events/s AND >= 1.5x fewer "
+                         "dispatches/1k-events (structural 2x)",
+            "scan_events_per_s": round(s_eps),
+            "scan_p99_fire_ms": s_p99,
+            "batch": DEVICE_CEILING_BATCH,
+        }))
+        r_eps, l_eps, r_cyc, l_cyc = run_dcn_resident(
+            args.events, args.cpu
+        )
+        print(json.dumps({
+            "metric": "per-host DCN-resident drains vs single-step "
+                      "lockstep rounds",
+            "value": round(r_eps),
+            "unit": "events/s",
+            "vs_baseline": round(r_eps / l_eps, 2) if l_eps else 0,
+            "criterion": ">= 1.3x (two-process); see detail.mode for "
+                         "the measured topology",
+            "cycles": r_cyc,
+            "lockstep_cycles": l_cyc,
+        }))
         return
 
     if args.stages:
@@ -692,31 +727,75 @@ def main():
         return
 
     if args.scaling:
-        # scaling curve (ISSUE 13): each chip count needs its own forced
-        # virtual-device count, which must be set BEFORE JAX initializes
-        # — so one child process per cell, same segfault workarounds as
-        # the elastic drill (no compile cache under the forced mesh, one
-        # retry per cell)
+        # real-device probe (ISSUE 20 satellite): with a multi-chip
+        # non-CPU backend reachable, each cell slices the FIRST n chips
+        # of the REAL mesh — distinct physical cores, so the curve is a
+        # genuine chip-count speedup and stamps shared_cores: false.
+        # Without one (or under --cpu) the virtual-CPU path below runs
+        # unchanged: forced host device counts, shared_cores: true.
+        real_backend = None   # (backend, platform, n_devices) or None
+        if not args.cpu:
+            probe_code = (
+                "import json, jax; d = jax.devices(); "
+                "print('SCALING_PROBE ' + json.dumps("
+                "[jax.default_backend(), d[0].platform, len(d)]))"
+            )
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", probe_code],
+                    env=dict(os.environ),
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    timeout=600, capture_output=True, text=True,
+                )
+                for line in r.stdout.splitlines():
+                    if line.startswith("SCALING_PROBE "):
+                        bk, plat, ndev = json.loads(
+                            line[len("SCALING_PROBE "):])
+                        if bk != "cpu" and ndev >= 2:
+                            real_backend = (bk, plat, ndev)
+            except subprocess.TimeoutExpired:
+                pass
         curve, p99s, errs = {}, {}, []
         for n_chips in (1, 2, 4, 8):
             child_env = dict(os.environ)
-            child_env["JAX_PLATFORMS"] = "cpu"
-            xla = " ".join(
-                f for f in os.environ.get("XLA_FLAGS", "").split()
-                if "host_platform_device_count" not in f
-            )
-            child_env["XLA_FLAGS"] = (
-                f"{xla} --xla_force_host_platform_device_count"
-                f"={n_chips}".strip()
-            )
-            child_env.pop("JAX_COMPILATION_CACHE_DIR", None)
-            code = (
-                "import json, jax; "
-                "jax.config.update('jax_platforms', 'cpu'); "
-                "from bench_configs import run_scaling_cell; "
-                f"n, eps, p99 = run_scaling_cell({args.events}); "
-                "print('SCALING_RESULT ' + json.dumps([n, eps, p99]))"
-            )
+            if real_backend is not None:
+                if n_chips > real_backend[2]:
+                    continue
+                # the real mesh is already the process's device set;
+                # the cell slices its first n_chips devices
+                child_env.pop("JAX_COMPILATION_CACHE_DIR", None)
+                code = (
+                    "import json, jax; "
+                    "from bench_configs import run_scaling_cell; "
+                    f"n, eps, p99 = run_scaling_cell({args.events}, "
+                    f"n_devices={n_chips}); "
+                    "print('SCALING_RESULT ' + json.dumps("
+                    "[n, eps, p99]))"
+                )
+            else:
+                # scaling curve (ISSUE 13): each chip count needs its
+                # own forced virtual-device count, set BEFORE JAX
+                # initializes — one child process per cell, same
+                # segfault workarounds as the elastic drill (no compile
+                # cache under the forced mesh, one retry per cell)
+                child_env["JAX_PLATFORMS"] = "cpu"
+                xla = " ".join(
+                    f for f in os.environ.get("XLA_FLAGS", "").split()
+                    if "host_platform_device_count" not in f
+                )
+                child_env["XLA_FLAGS"] = (
+                    f"{xla} --xla_force_host_platform_device_count"
+                    f"={n_chips}".strip()
+                )
+                child_env.pop("JAX_COMPILATION_CACHE_DIR", None)
+                code = (
+                    "import json, jax; "
+                    "jax.config.update('jax_platforms', 'cpu'); "
+                    "from bench_configs import run_scaling_cell; "
+                    f"n, eps, p99 = run_scaling_cell({args.events}); "
+                    "print('SCALING_RESULT ' + json.dumps("
+                    "[n, eps, p99]))"
+                )
             cell = None
             for attempt in range(2):
                 try:
@@ -766,12 +845,21 @@ def main():
                 c: round(v / (int(c) * one), 3)
                 for c, v in curve.items()
             },
-            "shared_cores": True,
-            "note": "all virtual devices share this host's physical "
-                    "cores, so N-chip cells add shard_map partitioning "
-                    "overhead without adding compute — the curve "
-                    "validates the sharded dispatch discipline here; "
-                    "chip-count speedup needs real chips",
+            "shared_cores": real_backend is None,
+            "backend": (real_backend[0] if real_backend else "cpu"),
+            "platform": (real_backend[1] if real_backend else "cpu"),
+            "note": (
+                f"real {real_backend[0]} mesh: each cell runs the "
+                f"sharded drain over the first N of "
+                f"{real_backend[2]} physical devices — the curve is "
+                f"genuine chip-count speedup"
+                if real_backend else
+                "all virtual devices share this host's physical "
+                "cores, so N-chip cells add shard_map partitioning "
+                "overhead without adding compute — the curve "
+                "validates the sharded dispatch discipline here; "
+                "chip-count speedup needs real chips"
+            ),
             "errors": errs,
         }))
         return

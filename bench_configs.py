@@ -1909,6 +1909,308 @@ def run_resident_loop(total_events: int, cpu: bool):
     return (bests["resident"][1], bests["fused"][1], res_p99, fused_p99)
 
 
+def run_while_drain(total_events: int, cpu: bool):
+    """Early-exit while drain vs the count-gated scan drain (ISSUE 20):
+    matched dims (B=512 / C=4096 / scan ring depth D=32, the
+    ``run_resident_loop`` firing stream), two dispatch disciplines:
+
+    * ``scan_d32`` — ``build_window_resident_drain`` at D=32, one
+      count-gated dispatch per 32 staged slots (the round-12 steady
+      state), and
+    * ``while_ms64`` — ``build_window_while_drain`` at
+      max_slots=2xD=64 (the executor's default
+      pipeline.while-drain.max-slots resolution): the publish cursor
+      runs ahead of the drain base, so one dispatch retires the whole
+      64-slot burst the accumulator groups under sustained ingest.
+
+    The dispatch accounting is structural (full groups only):
+    1000/(B*D) vs 1000/(B*MS) host dispatches per 1k events, a 2x cut
+    against the >= 1.5x criterion. The throughput criterion is parity
+    or better (>= 1.0x) — the while lowering must not tax the per-slot
+    body — and fire-VISIBILITY p99 stamps beside events/s for both
+    disciplines (the while drain holds fires until the loop exits, so
+    its emit lag is the number the criterion guards)."""
+    from collections import deque as _dq
+
+    import jax
+    import jax.numpy as jnp
+
+    from flink_tpu.metrics.latency import weighted_percentile
+    from flink_tpu.ops import window_kernels as wk
+    from flink_tpu.parallel.mesh import MeshContext
+    from flink_tpu.runtime.step import (
+        WindowStageSpec,
+        build_window_resident_drain,
+        build_window_while_drain,
+        init_sharded_state,
+    )
+
+    n_dev = len(jax.devices())
+    ctx = MeshContext.create(n_dev, 128)
+    B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000
+    BPP = 4
+    D = 32                  # scan ring depth (matched with PR 12)
+    MS = 2 * D              # while-drain bound: the executor default
+    iters = max(128, min(8192, total_events // B))
+    n_groups = max(2, max(96, iters // 8) // MS)
+    n_batches = n_groups * MS
+
+    def _spec():
+        return WindowStageSpec(
+            win=wk.WindowSpec(SLIDE, SLIDE, ring=RING, fires_per_step=4),
+            red=wk.ReduceSpec("sum", jnp.float32),
+            capacity_per_shard=C, layout="direct", precombine=False,
+        )
+
+    def _keys(rng, dup=0.5):
+        n_hot = int(B * dup)
+        lo = np.concatenate([
+            rng.integers(0, C - 1, B - n_hot),
+            rng.integers(0, 64, n_hot),
+        ]).astype(np.uint32)
+        rng.shuffle(lo)
+        return np.zeros(B, np.uint32), lo
+
+    def make_stream(rng):
+        batches, wms = [], []
+        for j in range(n_batches):
+            p = j // BPP
+            hi, lo = _keys(rng)
+            ts = np.full(B, p * SLIDE + SLIDE // 2, np.int32)
+            batches.append(tuple(jax.device_put(a) for a in (
+                hi, lo, ts, np.ones(B, np.float32), np.ones(B, bool),
+            )))
+            wms.append(np.int32(p * SLIDE - 1))
+        return batches, wms
+
+    def consume(cf):
+        got = jax.device_get((cf.counts, cf.lane_valid,
+                              cf.window_end_ticks, cf.value_sums))
+        return max(int(np.asarray(got[1]).sum()), 1)
+
+    def measure(group, step, is_while):
+        batches, wms = make_stream(np.random.default_rng(11))
+        n_disp = n_batches // group
+        lat = []
+
+        def run_once():
+            state = init_sharded_state(ctx, spec)
+            t0 = time.perf_counter()
+            handles = _dq()
+            mon = None
+            for g in range(n_disp):
+                sel = range(g * group, (g + 1) * group)
+                flat = [a for i in sel for a in batches[i]]
+                wmv = np.tile(
+                    np.asarray([wms[i] for i in sel], np.int32),
+                    (n_dev, 1),
+                )
+                if is_while:
+                    # steady state: the publish cursor committed the
+                    # whole staged burst (absolute seqs; base = the
+                    # group's first ring seq)
+                    base = g * group
+                    state, mon, fires, _consumed = step(
+                        state, *flat, wmv,
+                        np.full(1, base + group, np.int32),
+                        np.int32(base), np.int32(group),
+                    )
+                else:
+                    state, mon, fires = step(
+                        state, *flat, wmv, np.int32(group)
+                    )
+                handles.append((time.perf_counter(), fires))
+                if len(handles) > 1:
+                    t_d, cf = handles.popleft()
+                    lat.append((consume(cf),
+                                (time.perf_counter() - t_d) * 1e3))
+            while handles:
+                t_d, cf = handles.popleft()
+                lat.append((consume(cf),
+                            (time.perf_counter() - t_d) * 1e3))
+            jax.block_until_ready(mon[1])
+            return time.perf_counter() - t0
+
+        run_once()                               # compile + settle
+        lat.clear()
+        dt = min(run_once() for _ in range(3))
+        return B * n_batches / dt, lat
+
+    def _p99(lat):
+        p = weighted_percentile(lat, 99)
+        return round(p, 2) if p is not None else None
+
+    spec = _spec()
+    scan_eps, scan_lat = measure(
+        D, build_window_resident_drain(ctx, spec, D, reduced=True),
+        False,
+    )
+    while_eps, while_lat = measure(
+        MS, build_window_while_drain(ctx, spec, MS, reduced=True),
+        True,
+    )
+    scan_p99, while_p99 = _p99(scan_lat), _p99(while_lat)
+    detail = {
+        "platform": jax.default_backend(), "B": B, "C": C,
+        "scan_ring_depth": D, "while_max_slots": MS,
+        "n_batches": n_batches, "bpp": BPP, "n_devices": n_dev,
+        "scan_d32": {"eps": round(scan_eps), "p99_fire_ms": scan_p99},
+        "while_ms64": {"eps": round(while_eps),
+                       "p99_fire_ms": while_p99},
+        # structural dispatch accounting (full groups only — exact)
+        "dispatch": {
+            "scan_per_1k_events": round(1000.0 / (B * D), 4),
+            "while_per_1k_events": round(1000.0 / (B * MS), 4),
+            "cut": round(MS / D, 2),
+            "criterion": ">= 1.5x fewer",
+        },
+        "throughput_ratio": round(while_eps / max(scan_eps, 1.0), 2),
+        "throughput_criterion": ">= 1.0",
+    }
+    print(json.dumps(
+        {"config": "while_drain", "detail": detail}), flush=True)
+    return while_eps, scan_eps, while_p99, scan_p99
+
+
+def run_dcn_resident(total_events: int, cpu: bool):
+    """Per-host DCN-resident mode vs the single-step lockstep fallback
+    (ISSUE 20b). The honest form is a two-process ensemble (each host
+    stacks up to ring-depth locally-polled chunks into one drain per
+    lockstep round; >= 1.3x wall-clock criterion vs lockstep); on
+    backends without cross-process collectives (this container's CPU
+    runtime) the row degrades to a SINGLE-process measurement of the
+    same two runners — the same drain kernel, real collectives across
+    the local shards — and stamps ``mode`` so the artifact says which
+    topology produced the numbers. Cycle counts are exact either way:
+    the resident runner's cycles are drain dispatches, the lockstep
+    runner's are single-chunk rounds, so the dispatch cut is auditable
+    from the artifact alone."""
+    import os
+
+    import jax
+
+    from flink_tpu.runtime.dcn import (
+        DCNJobSpec,
+        GeneratorPartitionSource,
+        runner_for_spec,
+    )
+
+    n_keys, ts_div, win_ms = 977, 16, 1000
+    total = max(8192, min(total_events, 40_000))
+
+    def source_factory(pid, nproc, _total=total):
+        per_host = n_keys // nproc
+
+        def gen(offset, n):
+            idx = np.arange(offset, offset + n, dtype=np.int64)
+            return (pid + nproc * (idx % per_host), idx // ts_div,
+                    np.ones(n, np.float32))
+
+        return GeneratorPartitionSource(gen, _total)
+
+    def _spec(resident):
+        return DCNJobSpec(
+            source_factory=source_factory,
+            size_ms=win_ms,
+            capacity_per_shard=2048,
+            max_parallelism=64,
+            batch_per_host=2048,
+            fires_per_step=4,
+            resident=resident,
+            resident_ring_depth=4,
+        )
+
+    def run_single(resident):
+        r = runner_for_spec(_spec(resident), 0, 1)
+        t0 = time.perf_counter()
+        out = r.run()
+        dt = time.perf_counter() - t0
+        return total / dt, int(out["cycles"])
+
+    def _two_proc_supported():
+        import sys as _sys
+
+        tests_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tests")
+        if tests_dir not in _sys.path:
+            _sys.path.insert(0, tests_dir)
+        try:
+            from dcn_probe import multiprocess_collectives_supported
+            return multiprocess_collectives_supported()
+        except Exception:  # noqa: BLE001 — probe absent: assume not
+            return False
+
+    def run_two_proc(builder):
+        """One 2-process ensemble (tests/dcn_jobs.py builders — the
+        same specs the gated ensemble tests run); wall-clock covers the
+        whole run, cycles come from the workers' stats line."""
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord = f"127.0.0.1:{s.getsockname()[1]}"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        outs = [os.path.join(tempfile.mkdtemp(), f"out-{p}.npz")
+                for p in range(2)]
+        t0 = time.perf_counter()
+        procs = [subprocess.Popen(
+            [_sys.executable, "-m", "flink_tpu.runtime.dcn",
+             "--coordinator", coord, "--num-processes", "2",
+             "--process-id", str(p), "--builder",
+             os.path.join(repo, "tests", "dcn_jobs.py") + ":" + builder,
+             "--out", outs[p]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ) for p in range(2)]
+        cycles = None
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            if p.returncode != 0:
+                raise RuntimeError(out.decode(errors="replace")[-2000:])
+            for line in out.decode(errors="replace").splitlines():
+                if line.startswith("{"):
+                    cycles = json.loads(line)["cycles"]
+        dt = time.perf_counter() - t0
+        # two hosts x TOTAL_PER_HOST records (tests/dcn_jobs.py)
+        return 80_000 / dt, int(cycles)
+
+    if _two_proc_supported():
+        import socket
+        import subprocess
+        import tempfile
+
+        res_eps, res_cycles = run_two_proc("two_host_window_resident")
+        lock_eps, lock_cycles = run_two_proc("two_host_window")
+        mode, note = "two_process", "real cross-process ensemble"
+    else:
+        # compile-and-settle once per discipline, then measure
+        run_single(True)
+        res_eps, res_cycles = run_single(True)
+        run_single(False)
+        lock_eps, lock_cycles = run_single(False)
+        mode = "single_process_fallback"
+        note = ("cross-process collectives unavailable on this "
+                "backend; same kernels, one host over the local mesh")
+    detail = {
+        "platform": jax.default_backend(),
+        "mode": mode,
+        "note": note,
+        "total_events": total,
+        "resident": {"eps": round(res_eps), "cycles": res_cycles},
+        "lockstep": {"eps": round(lock_eps), "cycles": lock_cycles},
+        "cycle_cut": round(lock_cycles / max(res_cycles, 1), 2),
+        "throughput_ratio": round(res_eps / max(lock_eps, 1.0), 2),
+        "criterion": ">= 1.3x vs lockstep (two-process); cycle cut "
+                     "~ring-depth structurally",
+    }
+    print(json.dumps(
+        {"config": "dcn_resident", "detail": detail}), flush=True)
+    return res_eps, lock_eps, res_cycles, lock_cycles
+
+
 def run_chained_stages(total_events: int, cpu: bool):
     """Chained 2-stage drain vs the single-stage resident drain at
     matched dims (ISSUE 16): B=512 / C=4096 / ring depth D=32, the same
@@ -2088,7 +2390,7 @@ def run_chained_stages(total_events: int, cpu: bool):
     return (s_eps, c_eps, _pct(s_lat, 99), _pct(c_lat, 99))
 
 
-def run_scaling_cell(total_events: int):
+def run_scaling_cell(total_events: int, n_devices=None):
     """ONE cell of the chips-vs-events/s curve (ISSUE 13): the sharded
     resident drain (``build_window_sharded_drain``) at THIS process's
     device count, matched dims with ``run_resident_loop`` (same B per
@@ -2115,7 +2417,12 @@ def run_scaling_cell(total_events: int):
         init_sharded_state,
     )
 
-    n = len(jax.devices())
+    # virtual-CPU path: the caller forces the process device count and
+    # n_devices stays None. Real-device path (ISSUE 20 satellite): the
+    # caller passes n_devices to slice the FIRST n chips of the real
+    # mesh — distinct physical cores, so the curve measures genuine
+    # chip-count speedup, not shard_map partitioning overhead
+    n = int(n_devices) if n_devices else len(jax.devices())
     MAXP = 128
     ctx = MeshContext.create(n, MAXP)
     B, C, RING, SLIDE = DEVICE_CEILING_BATCH, 4096, 9, 1000

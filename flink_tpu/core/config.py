@@ -270,22 +270,45 @@ class CoreOptions:
         "DCN lockstep plane)")
     PIPELINE_RESIDENT_LOOP = ConfigOption(
         "pipeline.resident-loop", "auto",
-        "auto | on | off — the device-resident steady-state loop (ISSUE "
-        "12): the prefetch thread publishes staged batches into an HBM "
-        "batch ring and the step loop dispatches ONE jitted drain over "
-        "every ready slot (fused update+fire per slot, count-gated), so "
-        "steady state costs one host round trip per ring drain instead "
-        "of one per megastep. Requires prefetch + device staging + "
-        "fused fire; exactly-once cuts move to ring-drain boundaries. "
-        "auto = on whenever the fused-fire resident pipeline is active "
-        "on a single-controller topology; DCN lockstep planes keep the "
-        "loud single-step fallback")
+        "auto | on | while | off — the device-resident steady-state "
+        "loop (ISSUE 12): the prefetch thread publishes staged batches "
+        "into an HBM batch ring and the step loop dispatches ONE jitted "
+        "drain over every ready slot (fused update+fire per slot, "
+        "count-gated), so steady state costs one host round trip per "
+        "ring drain instead of one per megastep. Requires prefetch + "
+        "device staging + fused fire; exactly-once cuts move to "
+        "ring-drain boundaries. auto = on whenever the fused-fire "
+        "resident pipeline is active on a single-controller topology. "
+        "while (ISSUE 20) swaps the count-gated scan for an early-exit "
+        "lax.while_loop whose condition re-reads the ring's HBM publish "
+        "cursor, so a batch published mid-drain retires in the SAME "
+        "dispatch (bounded by pipeline.while-drain.max-slots); CPU "
+        "backends keep the scan drain (no-aliasing platform gate — see "
+        "pipeline.while-drain.cpu-override). DCN coordinator jobs "
+        "compose per-host: on/while run the host-local resident drain "
+        "between lockstep exchange boundaries (ISSUE 20b)")
     PIPELINE_RING_DEPTH = ConfigOption(
         "pipeline.ring-depth", 16,
         "HBM slots in the device batch ring (pipeline.resident-loop): "
         "bounds device-resident batches AND the max slots one drain "
         "dispatch consumes — deeper rings amortize the host round trip "
         "further but coarsen fire/checkpoint latency and HBM residency")
+    PIPELINE_WHILE_DRAIN_MAX_SLOTS = ConfigOption(
+        "pipeline.while-drain.max-slots", 0,
+        "per-dispatch slot bound for pipeline.resident-loop=while: the "
+        "while-drain retires at most this many ring slots in one "
+        "dispatch regardless of how many publishes land mid-drain, so "
+        "the exactly-once cut, the watchdog deadline (armed at the "
+        "BOUND, not the observed fill), and the flight-recorder payload "
+        "[n_shards, max_slots, 9] stay well-defined. 0 (default) sizes "
+        "it to 2 x pipeline.ring-depth, never below ring-depth")
+    PIPELINE_WHILE_DRAIN_CPU_OVERRIDE = ConfigOption(
+        "pipeline.while-drain.cpu-override", "off",
+        "on | off — run the while-drain kernel on CPU backends despite "
+        "the platform gate (CPU buffer donation does not alias, so the "
+        "cursor freezes at its dispatch snapshot and the while drain "
+        "degrades to exactly the scan drain's count gating). Test/bench "
+        "escape hatch; production CPU runs keep the scan drain")
     PIPELINE_DATA_PARALLEL = ConfigOption(
         "pipeline.data-parallel", "auto",
         "auto | on | off — mesh-resident data parallelism (ISSUE 13): "

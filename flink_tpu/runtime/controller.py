@@ -41,6 +41,8 @@ argument.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -194,6 +196,7 @@ class RuntimeController:
                  rebalance_threshold: float = 4.0,
                  min_rebalance_interval: float = 30.0,
                  min_gain: float = 1.2,
+                 persist_dir: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic):
         unknown = [n for n in actuators if n not in ACTUATOR_NAMES]
         if unknown:
@@ -230,17 +233,74 @@ class RuntimeController:
         self.rebalances = 0
         self.rebalance_skips = 0
         self.rebalance_failures = 0
+        # durable decision ledger (ISSUE 20 satellite): every entry is
+        # also appended to <persist_dir>/controller-ledger.jsonl, and a
+        # restarted job reloads prior runs' tail so /jobs/<jid>/
+        # controller serves the MERGED history — "why is the knob at
+        # this value" survives the restart that applied it
+        self._run = 1
+        self._history: List[dict] = []
+        self._ledger_path = None
+        self.persist_errors = 0
+        if persist_dir:
+            self._ledger_path = os.path.join(
+                persist_dir, "controller-ledger.jsonl")
+            self._load_history()
 
     # -- ledger ----------------------------------------------------------
 
+    def _load_history(self):
+        try:
+            with open(self._ledger_path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue   # torn tail line from a crash mid-append
+            if isinstance(e, dict):
+                self._history.append(e)
+        del self._history[:-400]
+        if self._history:
+            # continue the sequence across restarts: merged entries stay
+            # totally ordered, and the run counter marks each restart
+            self._seq = max(int(e.get("seq", 0)) for e in self._history)
+            self._run = 1 + max(
+                int(e.get("run", 1)) for e in self._history)
+
+    @staticmethod
+    def _jsonable(o):
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        return str(o)
+
     def _log(self, kind: str, **fields) -> dict:
         self._seq += 1
-        entry = {"seq": self._seq, "cycle": self._cycle,
+        entry = {"seq": self._seq, "run": self._run,
+                 "cycle": self._cycle,
                  "t_wall": round(time.time(), 3), "kind": kind}
         entry.update(fields)
         with self._lock:
             self._ledger.append(entry)
             del self._ledger[:-100]
+        if self._ledger_path:
+            try:
+                with open(self._ledger_path, "a") as f:
+                    f.write(json.dumps(entry, default=self._jsonable)
+                            + "\n")
+            except OSError:
+                # observability must not kill the job; the counter (a
+                # Prometheus gauge via report()) keeps the loss visible
+                self.persist_errors += 1
         return entry
 
     # -- the loop --------------------------------------------------------
@@ -436,7 +496,10 @@ class RuntimeController:
 
     def report(self) -> dict:
         with self._lock:
-            ledger = list(self._ledger)
+            # merged history: prior runs' persisted tail + this run's
+            # live entries, one totally-ordered list (seq continues
+            # across restarts, each entry stamped with its run)
+            ledger = self._history + list(self._ledger)
         knobs = {
             n: {"value": int(a.get()), "lo": a.lo, "hi": a.hi,
                 "step": a.step}
@@ -446,6 +509,9 @@ class RuntimeController:
         return {
             "available": True,
             "cycle": self._cycle,
+            "run": self._run,
+            "restored_entries": len(self._history),
+            "persist_errors": self.persist_errors,
             "interval_cycles": self.interval_cycles,
             "actions": self.actions,
             "reverts": self.reverts,

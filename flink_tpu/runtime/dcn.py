@@ -172,6 +172,18 @@ class DCNJobSpec:
     # EXPLICIT single-step fallback: noted loudly at startup, never
     # silently absorbed.
     steps_per_dispatch: int = 1
+    # per-host resident mode (pipeline.resident-loop on/while under a
+    # dcn.coordinator, ISSUE 20b): between DCN boundaries each host
+    # polls up to resident_ring_depth local chunks and retires them in
+    # ONE multi-slot drain dispatch (runtime/step.py
+    # build_window_dcn_resident_drain — the trip count is pmax-agreed on
+    # device, so no host-side count exchange). The rebalance/shuffle/
+    # global side channels run ONCE per drain cycle, at the boundary,
+    # with their frame deadlines scaled by the slots the previous drain
+    # retired (deadline_scale — DCNPeerStalledError attribution keeps
+    # its base semantics at scale 1). Time-window jobs only.
+    resident: bool = False
+    resident_ring_depth: int = 4
 
 
 class GeneratorPartitionSource:
@@ -263,6 +275,13 @@ class _RebalanceRing:
         self.pid = pid
         self.nproc = nproc
         self.recv_timeout_s = float(recv_timeout_s)
+        # drain-boundary deadline scaling (per-host resident mode, ISSUE
+        # 20b): the runner sets this to the slot count the PREVIOUS
+        # drain retired, so a peer legitimately busy draining a deep
+        # ring gets proportionally more frame time before
+        # DCNPeerStalledError attributes it — same contract as
+        # Watchdog.arm(scale=), never below the configured base deadline
+        self.deadline_scale = 1.0
         self.reconnect_attempts = max(0, int(reconnect_attempts))
         self.reconnect_backoff_s = float(reconnect_backoff_s)
         # how long a resync waits for the lost peer to come back up
@@ -373,13 +392,20 @@ class _RebalanceRing:
                 self._resync()
         raise AssertionError("unreachable")
 
+    def _frame_deadline_s(self) -> float:
+        """The live frame deadline: base recv timeout scaled by the
+        slot count the previous resident drain retired (1.0 in lockstep
+        single-step mode, so behavior there is byte-identical)."""
+        return self.recv_timeout_s * max(1.0, float(self.deadline_scale))
+
     def _send_all(self, sock, data: bytes, peer: str = "peer") -> None:
         """sendall in socket-timeout slices under the SAME deadline the
         reads get: a peer that merely pauses (checkpoint sync, GC) while
         our frame overruns the kernel buffers is waited out up to
-        ``recv_timeout_s``, then attributed — never killed on one
-        2-second slice."""
-        deadline = time.monotonic() + self.recv_timeout_s
+        ``recv_timeout_s`` (drain-scaled), then attributed — never
+        killed on one 2-second slice."""
+        frame_s = self._frame_deadline_s()
+        deadline = time.monotonic() + frame_s
         view = memoryview(data)
         sent = 0
         while sent < len(view):
@@ -390,7 +416,7 @@ class _RebalanceRing:
                     raise DCNPeerStalledError(
                         f"process {self.pid}: peer {peer} stalled — "
                         f"send stuck at {sent}/{len(view)} frame bytes "
-                        f"after {self.recv_timeout_s:.1f}s"
+                        f"after {frame_s:.1f}s"
                     ) from None
                 continue
 
@@ -400,7 +426,8 @@ class _RebalanceRing:
         # many empty timeout slices the scheduler happens to produce
         faults.inject("dcn.recv", pid=self.pid, peer=peer, sock=sock)
         buf = b""
-        deadline = time.monotonic() + self.recv_timeout_s
+        frame_s = self._frame_deadline_s()
+        deadline = time.monotonic() + frame_s
         while len(buf) < n:
             try:
                 chunk = sock.recv(n - len(buf))
@@ -409,7 +436,7 @@ class _RebalanceRing:
                     raise DCNPeerStalledError(
                         f"process {self.pid}: peer {peer} stalled — "
                         f"{len(buf)}/{n} frame bytes after "
-                        f"{self.recv_timeout_s:.1f}s"
+                        f"{frame_s:.1f}s"
                     ) from None
                 continue
             if not chunk:
@@ -666,7 +693,17 @@ class _DCNRunnerBase:
                 file=sys.stderr,
             )
         self.ingested_local = 0   # records this host's lanes carried
+        # per-host resident mode (ISSUE 20b): subclasses that support it
+        # set self._drain + self._resident_depth in _build_step
+        self._drain = None
+        self._resident_depth = 0
         self._build_step()
+        if getattr(spec, "resident", False) and self._drain is None:
+            raise ValueError(
+                "DCNJobSpec.resident requires a time-window job "
+                "(window_kind='time'); session/rolling/cep runners keep "
+                "single-step lockstep dispatch"
+            )
         self._init_state()
 
     # -- mesh plumbing ----------------------------------------------------
@@ -675,6 +712,9 @@ class _DCNRunnerBase:
         from flink_tpu.parallel.mesh import SHARD_AXIS
 
         self._lane_sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        # slot-major stacks for the resident drain: [depth, B] with the
+        # slot axis replicated and the lane axis process-sharded
+        self._slot_sharding = NamedSharding(mesh, P(None, SHARD_AXIS))
 
     def _init_state(self):
         self.state = self._init_fn()
@@ -725,46 +765,61 @@ class _DCNRunnerBase:
         return rng.permutation(base)
 
     # -- host loop ---------------------------------------------------------
-    def run(self) -> dict:
+    def _poll_chunk(self, exhausted: bool, exchange: bool = True):
+        """One padded ingest chunk: poll the source, run the ring /
+        router side channels when ``exchange`` (the DCN boundary —
+        resident mode's follow-up chunks stay host-local), pad to the
+        lane budget and advance the local watermark. Returns ``(hi, lo,
+        ts, values, valid, m, done_now, exhausted)``."""
         from flink_tpu.ops.hashing import key_identity64
 
         spec = self.spec
         B = self.B_local
         poll_budget = self._poll_budget()
-        exhausted = False
-        while True:
-            if not exhausted:
-                keys, ts_ms, vals, exhausted = self.source.poll(poll_budget)
-            else:
-                keys = np.zeros(0, np.int64)
-                ts_ms = np.zeros(0, np.int64)
-                vals = np.zeros(0, np.float32)
-            done_now = exhausted
-            if self._router is not None:
-                # targeted routing (shuffle/global): stamp destinations,
-                # relay around the ring, ingest what lands here. The
-                # per-host watermark advances from the SOURCE's (pre-
-                # route) timestamps: the routed mix contains other
-                # hosts' later timestamps, and a watermark read off the
-                # merged batch would push the global pmin past records a
-                # slower source hasn't polled yet (late-dropping them).
-                # Source-side watermarks keep pmin = the true low mark.
-                if len(ts_ms):
-                    rel_max = int(np.asarray(ts_ms, np.int64).max()) \
-                        - spec.origin_ms
-                    self.local_wm_ticks = min(max(
-                        self.local_wm_ticks,
-                        rel_max - spec.out_of_orderness_ms - 1,
-                    ), MAX_TICKS)
+        if not exhausted:
+            keys, ts_ms, vals, exhausted = self.source.poll(poll_budget)
+        else:
+            keys = np.zeros(0, np.int64)
+            ts_ms = np.zeros(0, np.int64)
+            vals = np.zeros(0, np.float32)
+        done_now = exhausted
+        if self._router is not None:
+            # targeted routing (shuffle/global): stamp destinations,
+            # relay around the ring, ingest what lands here. The
+            # per-host watermark advances from the SOURCE's (pre-
+            # route) timestamps: the routed mix contains other
+            # hosts' later timestamps, and a watermark read off the
+            # merged batch would push the global pmin past records a
+            # slower source hasn't polled yet (late-dropping them).
+            # Source-side watermarks keep pmin = the true low mark.
+            if len(ts_ms):
+                rel_max = int(np.asarray(  # host-sync-ok: source-poll numpy, no device array
+                    ts_ms, np.int64).max()) \
+                    - spec.origin_ms
+                self.local_wm_ticks = min(max(
+                    self.local_wm_ticks,
+                    rel_max - spec.out_of_orderness_ms - 1,
+                ), MAX_TICKS)
+            if exchange:
                 keys, ts_ms, vals, all_done = self._router.route(
                     keys, ts_ms, vals,
                     self._targets(len(keys)), exhausted,
                 )
                 done_now = all_done and len(keys) == 0
-            if self._ring is not None:
+            else:
+                # resident follow-up chunk: the records stay on the
+                # polling host's lanes (the device all_to_all still
+                # delivers each to the owning shard, so results are
+                # unchanged — host-level placement waits for the next
+                # boundary), and peer done flags are only learned at
+                # boundaries
+                done_now = False
+        if self._ring is not None:
+            if exchange:
                 # physical rebalance: offer spare lanes to the ring
-                # neighbor's backlog, serve the other neighbor's request
-                # from MY backlog (every process, every cycle — lockstep)
+                # neighbor's backlog, serve the other neighbor's
+                # request from MY backlog (every process, every
+                # boundary — lockstep)
                 rk, rt, rv, donor_done = self._ring.exchange(
                     B - len(keys), self.source.poll
                 )
@@ -772,40 +827,52 @@ class _DCNRunnerBase:
                     keys = np.concatenate([keys, rk])
                     ts_ms = np.concatenate([ts_ms, rt])
                     vals = np.concatenate([vals, rv])
-                # keep cycling while the donor neighbor still has records
+                # keep cycling while the donor neighbor has records
                 done_now = exhausted and donor_done and not len(rk)
-            m = len(keys)
-            self.ingested_local += m
-            h = key_identity64(keys) if m else np.zeros(0, np.uint64)
-            hi = np.zeros(B, np.uint32)
-            lo = np.zeros(B, np.uint32)
-            hi[:m] = (h >> np.uint64(32)).astype(np.uint32)
-            lo[:m] = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            ts = np.zeros(B, np.int32)
-            if m:
-                rts = np.asarray(ts_ms, np.int64) - spec.origin_ms
-                if int(rts.max()) > MAX_TICKS or int(rts.min()) < 0:
-                    # refuse rather than silently clamp (clamped records
-                    # would all collapse into the MAX_TICKS window)
-                    bad = (int(rts.min()) if int(rts.min()) < 0
-                           else int(rts.max()))
-                    raise ValueError(
-                        f"timestamp {bad + spec.origin_ms} out of int32 "
-                        f"tick range relative to origin_ms="
-                        f"{spec.origin_ms}; set DCNJobSpec.origin_ms to "
-                        f"(at most) the stream's first timestamp"
-                    )
-                ts[:m] = rts.astype(np.int32)
-            values = np.zeros(B, np.float32)
-            values[:m] = vals
-            valid = np.zeros(B, bool)
-            valid[:m] = True
-            if m and self._router is None:
-                # routed modes advanced the watermark pre-route (above)
-                self.local_wm_ticks = min(max(
-                    self.local_wm_ticks,
-                    int(rts.max()) - spec.out_of_orderness_ms - 1,
-                ), MAX_TICKS)
+            else:
+                done_now = False
+        m = len(keys)
+        self.ingested_local += m
+        h = key_identity64(keys) if m else np.zeros(0, np.uint64)
+        hi = np.zeros(B, np.uint32)
+        lo = np.zeros(B, np.uint32)
+        hi[:m] = (h >> np.uint64(32)).astype(np.uint32)
+        lo[:m] = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        ts = np.zeros(B, np.int32)
+        if m:
+            rts = np.asarray(  # host-sync-ok: source-poll numpy, no device array
+                ts_ms, np.int64) - spec.origin_ms
+            if int(rts.max()) > MAX_TICKS or int(rts.min()) < 0:
+                # refuse rather than silently clamp (clamped records
+                # would all collapse into the MAX_TICKS window)
+                bad = (int(rts.min()) if int(rts.min()) < 0
+                       else int(rts.max()))
+                raise ValueError(
+                    f"timestamp {bad + spec.origin_ms} out of int32 "
+                    f"tick range relative to origin_ms="
+                    f"{spec.origin_ms}; set DCNJobSpec.origin_ms to "
+                    f"(at most) the stream's first timestamp"
+                )
+            ts[:m] = rts.astype(np.int32)
+        values = np.zeros(B, np.float32)
+        values[:m] = vals
+        valid = np.zeros(B, bool)
+        valid[:m] = True
+        if m and self._router is None:
+            # routed modes advanced the watermark pre-route (above)
+            self.local_wm_ticks = min(max(
+                self.local_wm_ticks,
+                int(rts.max()) - spec.out_of_orderness_ms - 1,
+            ), MAX_TICKS)
+        return hi, lo, ts, values, valid, m, done_now, exhausted
+
+    def run(self) -> dict:
+        if getattr(self.spec, "resident", False):
+            return self._run_resident()
+        exhausted = False
+        while True:
+            (hi, lo, ts, values, valid, _m, done_now,
+             exhausted) = self._poll_chunk(exhausted)
             wm_now = MAX_TICKS if done_now else self.local_wm_ticks
             wm = np.full(self.L, np.int32(wm_now))
             done = np.full(self.L, np.int32(1 if done_now else 0))
@@ -824,8 +891,80 @@ class _DCNRunnerBase:
             if self.ckpt_dir and self.ckpt_every and \
                     self.cycle % self.ckpt_every == 0:
                 self._write_checkpoint()
-            if int(np.asarray(stop)) == 1:
+            if int(np.asarray(stop)) == 1:  # host-sync-ok: lockstep stop decision, one fetch per dispatch
                 break
+        return self._finish()
+
+    def _run_resident(self) -> dict:
+        """Per-host resident mode (ISSUE 20b): each cycle polls up to
+        ``resident_ring_depth`` chunks — the FIRST runs the DCN
+        side-channel exchange (the drain boundary); follow-ups stay
+        host-local — and retires them all in ONE drain dispatch.
+        Stop / watermark / fill agreement ride the drain kernel's
+        collectives, and the side channels' frame deadlines scale with
+        the slots the previous drain retired (a host deep in a long
+        drain is making progress, not stalled)."""
+        drain = self._drain   # __init__ guarantees this for resident specs
+        B = self.B_local
+        D = self._resident_depth
+        exhausted = False
+        drained_prev = 1
+        while True:
+            for ch in (self._ring, self._router):
+                if ch is not None:
+                    ch.deadline_scale = max(1.0, float(drained_prev))
+            hi_s = np.zeros((D, B), np.uint32)
+            lo_s = np.zeros((D, B), np.uint32)
+            ts_s = np.zeros((D, B), np.int32)
+            val_s = np.zeros((D, B), np.float32)
+            ok_s = np.zeros((D, B), bool)
+            wm_s = np.empty((D, self.L), np.int32)
+            fill = 0
+            done_now = False
+            for ci in range(D):
+                (hi, lo, ts, values, valid, m, done_now,
+                 exhausted) = self._poll_chunk(exhausted, exchange=ci == 0)
+                hi_s[fill], lo_s[fill], ts_s[fill] = hi, lo, ts
+                val_s[fill], ok_s[fill] = values, valid
+                wm_s[fill] = np.int32(
+                    MAX_TICKS if done_now else self.local_wm_ticks)
+                fill += 1
+                if done_now or m == 0:
+                    # a dry local poll ends the cycle early: padding the
+                    # drain with empty slots buys nothing, and the next
+                    # boundary may land records from peers
+                    break
+            wm_s[fill:] = wm_s[fill - 1]  # pad slots hold the frontier
+            done = np.full(self.L, np.int32(1 if done_now else 0))
+            fills = np.full(self.L, np.int32(fill))
+            self.state, cfs, stop, drained = drain(
+                self.state,
+                self._gslots(hi_s), self._gslots(lo_s),
+                self._gslots(ts_s), self._gslots(val_s),
+                self._gslots(ok_s), self._gslots(wm_s),
+                self._global(done), self._global(fills),
+            )
+            drained_prev = int(np.asarray(drained))  # host-sync-ok: drain boundary — the agreed count scales the next frame deadline
+            self._emit_local_slots(cfs, drained_prev)
+            self.cycle += 1
+            if self.ckpt_dir and self.ckpt_every and \
+                    self.cycle % self.ckpt_every == 0:
+                self._write_checkpoint()
+            if int(np.asarray(stop)) == 1:  # host-sync-ok: lockstep stop decision, one fetch per dispatch
+                break
+        return self._finish()
+
+    def _gslots(self, local: np.ndarray):
+        """Assemble a [depth, B_local] host stack into the global
+        [depth, B] slot-major array (slot axis replicated, lane axis
+        sharded across processes)."""
+        import jax
+
+        return jax.make_array_from_process_local_data(
+            self._slot_sharding, local
+        )
+
+    def _finish(self) -> dict:
         if self._ring is not None:
             self._ring.close()
         if self._router is not None:
@@ -1091,6 +1230,56 @@ class DCNWindowRunner(_DCNRunnerBase):
             out_specs=P(SHARD_AXIS), check_vma=False,
         ))
         self._mk_lane_sharding(mesh)
+
+        if getattr(spec, "resident", False):
+            # per-host resident mode (ISSUE 20b): same stage spec and
+            # bucket capacity as the lockstep step — the drain IS the
+            # lockstep body run up to resident_ring_depth times per
+            # dispatch, with control collectives at the boundary
+            from flink_tpu.runtime.step import (
+                build_window_dcn_resident_drain,
+            )
+
+            self._resident_depth = max(1, int(spec.resident_ring_depth))
+            self._drain = build_window_dcn_resident_drain(
+                self.ctx, stage, bpd, self._resident_depth,
+                capacity_factor=2.0,
+            )
+
+    def _emit_local_slots(self, cfs, drained: int):
+        """Resident-drain fires: [n_shards, depth, ...] stacks — emit
+        the first ``drained`` slots of each addressable shard in slot
+        order (pad slots past the agreed count never fired)."""
+        for (counts_sh, lanes_sh, ends_sh, khi_sh, klo_sh,
+             vals_sh) in zip(
+                cfs.counts.addressable_shards,
+                cfs.lane_valid.addressable_shards,
+                cfs.window_end_ticks.addressable_shards,
+                cfs.key_hi.addressable_shards,
+                cfs.key_lo.addressable_shards,
+                cfs.values.addressable_shards):
+            counts = np.asarray(counts_sh.data)[0]  # host-sync-ok: fire-payload fetch AFTER the drain retired
+            lanes = np.asarray(lanes_sh.data)[0]  # host-sync-ok: fire-payload fetch
+            ends = np.asarray(ends_sh.data)[0]  # host-sync-ok: fire-payload fetch
+            khi = None
+            for i in range(min(int(drained), counts.shape[0])):
+                for f in np.nonzero(lanes[i])[0]:
+                    c = int(counts[i, f])
+                    if c == 0:
+                        continue
+                    if khi is None:
+                        khi = np.asarray(khi_sh.data)[0]  # host-sync-ok: lazy key fetch, only when a slot fired
+                        klo = np.asarray(klo_sh.data)[0]  # host-sync-ok: lazy key fetch
+                        vv = np.asarray(vals_sh.data)[0]  # host-sync-ok: lazy value fetch
+                    k64 = (khi[i, f, :c].astype(np.uint64)
+                           << np.uint64(32)) \
+                        | klo[i, f, :c].astype(np.uint64)
+                    end_ms = int(ends[i, f]) + self.spec.origin_ms
+                    self.rows_key.append(k64)
+                    self.rows_start.append(
+                        np.full(c, end_ms - self.spec.size_ms, np.int64))
+                    self.rows_end.append(np.full(c, end_ms, np.int64))
+                    self.rows_val.append(vv[i, f, :c].astype(np.float32))
 
     def _emit_local(self, cf):
         """Each process emits fires from ITS addressable shards only —

@@ -73,6 +73,8 @@ from flink_tpu.runtime.step import (
     build_window_resident_drain,
     build_window_resident_drain_exchange,
     build_window_sharded_drain,
+    build_window_while_drain,
+    build_window_while_drain_sharded,
     build_window_update_step,
     build_window_update_step_exchange,
     clear_dirty,
@@ -1318,19 +1320,15 @@ class LocalExecutor:
         nproc = env.config.get_int("dcn.num-processes", 1)
         pid = env.config.get_int("dcn.process-id", 0)
         res_dcn = env.config.get_str("pipeline.resident-loop", "auto")
-        if res_dcn == "on":
-            # a config ERROR, not a silent degrade (round 13, mirroring
-            # the steps-per-dispatch loud single-step fallback): the
-            # lockstep plane's global collectives require every process
-            # to dispatch the same step sequence, which a locally-
-            # count-gated ring drain cannot guarantee
-            raise ValueError(
-                "pipeline.resident-loop=on is incompatible with the DCN "
-                "lockstep plane (dcn.coordinator set): every process "
-                "must dispatch the same step sequence, which a locally "
-                "count-gated ring drain cannot guarantee; unset it or "
-                "use pipeline.resident-loop=auto (resolves to off here)"
-            )
+        # Round 20 (was a config ERROR through round 19): resident-loop
+        # on the DCN plane now COMPOSES — each host drains up to
+        # ring-depth locally-polled batches per lockstep round in one
+        # dispatch, the trip count pmax-agreed ON DEVICE so every
+        # process still enters the same all_to_all sequence
+        # (runtime/dcn.py _run_resident + step.py
+        # build_window_dcn_resident_drain). "on" and "while" both select
+        # it; "auto" keeps the single-step lockstep dispatch.
+        dcn_resident = res_dcn in ("on", "while")
         if res_dcn == "auto":
             print(
                 "flink-tpu: pipeline.resident-loop auto resolves to OFF "
@@ -1404,6 +1402,10 @@ class LocalExecutor:
             origin_ms=env.config.get_int("dcn.origin-ms", 0),
             steps_per_dispatch=env.config.get_int(
                 "pipeline.steps-per-dispatch", 1
+            ),
+            resident=dcn_resident,
+            resident_ring_depth=env.config.get_int(
+                "pipeline.ring-depth", 16
             ),
         )
         # physical ingest partitioner: the API annotation (.shuffle(),
@@ -1671,12 +1673,29 @@ class LocalExecutor:
         # executor entirely (_run_dcn) and keeps its loud single-step
         # fallback there.
         res_cfg = str(env.config.get(_CoreOpts.PIPELINE_RESIDENT_LOOP))
-        if res_cfg not in ("auto", "on", "off"):
+        if res_cfg not in ("auto", "on", "while", "off"):
             raise ValueError(
-                f"pipeline.resident-loop must be auto|on|off, "
+                f"pipeline.resident-loop must be auto|on|while|off, "
                 f"got {res_cfg!r}"
             )
         ring_depth = max(2, env.config.get_int("pipeline.ring-depth", 16))
+        # early-exit while-drain (pipeline.resident-loop=while, ISSUE
+        # 20): the drain's trip count re-reads the ring's HBM publish
+        # cursor inside the loop condition, bounded per dispatch by
+        # while-drain.max-slots — the bound (not the observed fill) is
+        # what the watchdog arms and the flight recorder sizes to, and
+        # the drain GROUP capacity grows to the bound so publishes
+        # landing while the previous drain was in flight join the
+        # current dispatch instead of forcing a new one. 0 sizes the
+        # bound to 2x ring depth (never below ring depth).
+        wd_max_slots = env.config.get_int(
+            "pipeline.while-drain.max-slots", 0)
+        if wd_max_slots <= 0:
+            wd_max_slots = 2 * ring_depth
+        wd_max_slots = max(ring_depth, wd_max_slots)
+        wd_cpu_override = env.config.get_str(
+            "pipeline.while-drain.cpu-override", "off") == "on"
+        use_while = False          # finalized with use_resident
         use_resident = False       # finalized at ingest construction
         residents_by_route = {}    # [route][tier] resident-drain kernels
         pending_batch = [None]     # greedy ring fill's non-drain leftover
@@ -2177,31 +2196,59 @@ class LocalExecutor:
                     rd_reduced = bool(
                         sink_device_reduce and not win.overflow
                     )
+                    # while mode (ISSUE 20): mask + sharded routes swap
+                    # the count-gated scan for the early-exit while
+                    # drain sized to the while-drain BOUND; the exchange
+                    # route keeps the scan kernel (the all_to_all in a
+                    # data-dependent while body is not worth the
+                    # collective-under-while hazard) but is sized to the
+                    # same bound so while-mode drain groups fit it
+                    drain_depth = wd_max_slots if use_while else ring_depth
                     if "mask" in steps_by_route:
-                        residents_by_route["mask"] = {
-                            "insert": build_window_resident_drain(
-                                ctx, spec, ring_depth,
-                                kg_fill=kg_stats_on, reduced=rd_reduced,
-                                drain_stats=drain_stats_on,
-                                tiered=use_tiers[0],
-                            ),
-                            "fast": build_window_resident_drain(
-                                ctx, spec, ring_depth, insert=False,
-                                kg_fill=kg_stats_on, reduced=rd_reduced,
-                                drain_stats=drain_stats_on,
-                                tiered=use_tiers[0],
-                            ) if build_fast else None,
-                        }
+                        if use_while:
+                            residents_by_route["mask"] = {
+                                "insert": build_window_while_drain(
+                                    ctx, spec, wd_max_slots,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ),
+                                "fast": build_window_while_drain(
+                                    ctx, spec, wd_max_slots, insert=False,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ) if build_fast else None,
+                            }
+                        else:
+                            residents_by_route["mask"] = {
+                                "insert": build_window_resident_drain(
+                                    ctx, spec, ring_depth,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ),
+                                "fast": build_window_resident_drain(
+                                    ctx, spec, ring_depth, insert=False,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ) if build_fast else None,
+                            }
                     if "exchange" in steps_by_route:
                         residents_by_route["exchange"] = {
                             "insert": build_window_resident_drain_exchange(
-                                ctx, spec, bpd, ring_depth, capf,
+                                ctx, spec, bpd, drain_depth, capf,
                                 kg_fill=kg_stats_on, reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
                                 tiered=use_tiers[0],
                             ),
                             "fast": build_window_resident_drain_exchange(
-                                ctx, spec, bpd, ring_depth, capf,
+                                ctx, spec, bpd, drain_depth, capf,
                                 insert=False, kg_fill=kg_stats_on,
                                 reduced=rd_reduced,
                                 drain_stats=drain_stats_on,
@@ -2220,20 +2267,40 @@ class LocalExecutor:
                         shard_cap[0] = bucket_capacity(
                             B_step[0], ctx.n_shards, dp_capf
                         )
-                        residents_by_route["sharded"] = {
-                            "insert": build_window_sharded_drain(
-                                ctx, spec, ring_depth,
-                                kg_fill=kg_stats_on, reduced=rd_reduced,
-                                drain_stats=drain_stats_on,
-                                tiered=use_tiers[0],
-                            ),
-                            "fast": build_window_sharded_drain(
-                                ctx, spec, ring_depth, insert=False,
-                                kg_fill=kg_stats_on, reduced=rd_reduced,
-                                drain_stats=drain_stats_on,
-                                tiered=use_tiers[0],
-                            ) if build_fast else None,
-                        }
+                        if use_while:
+                            residents_by_route["sharded"] = {
+                                "insert": build_window_while_drain_sharded(
+                                    ctx, spec, wd_max_slots,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ),
+                                "fast": build_window_while_drain_sharded(
+                                    ctx, spec, wd_max_slots, insert=False,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ) if build_fast else None,
+                            }
+                        else:
+                            residents_by_route["sharded"] = {
+                                "insert": build_window_sharded_drain(
+                                    ctx, spec, ring_depth,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ),
+                                "fast": build_window_sharded_drain(
+                                    ctx, spec, ring_depth, insert=False,
+                                    kg_fill=kg_stats_on,
+                                    reduced=rd_reduced,
+                                    drain_stats=drain_stats_on,
+                                    tiered=use_tiers[0],
+                                ) if build_fast else None,
+                            }
                         if self._job_group is not None:
                             # per-shard refusal gauges live here (not
                             # the main gauges block) so they track the
@@ -2414,6 +2481,14 @@ class LocalExecutor:
                 ring_depth=ring_depth if use_resident else 0,
                 shard_cap=shard_cap[0] if use_dp else 0,
             ))
+            if use_while and ingest.device_ring is not None:
+                # stand up the HBM publish cursor the while-drain's loop
+                # condition re-reads: replicated scalar slot for the
+                # global ring, one entry per owning chip for the sharded
+                # lanes (same shardings the batch operands use)
+                ingest.device_ring.enable_device_cursor(
+                    split_sh if ingest.device_ring.sharded else mask_sh
+                )
             if fresh_state:
                 state = init_sharded_state(ctx, spec)
                 if graph is not None:
@@ -4185,20 +4260,27 @@ class LocalExecutor:
                 # (step.dispatch) must be able to target resident jobs
                 faults.inject("step.dispatch", step=metrics.steps,
                               route=route, slots=count)
+            is_while = getattr(active, "while_drain", False)
+            # the kernel's slot depth: ring depth for the scan drains,
+            # the while-drain bound for while mode (the exchange scan is
+            # also built at the bound there, so groups up to the bound
+            # always fit whatever kernel serves the route)
+            depth_k = int(getattr(active, "ring_depth", ring_depth))
             flat = []
             # lint: allow(retrace): tiny [n_shards, D] watermark matrix, fresh per drain dispatch for the same reason as run_update's wmv (queued async dispatches must not share the buffer)
-            wmv = np.empty((ctx.n_shards, ring_depth), np.int32)
+            wmv = np.empty((ctx.n_shards, depth_k), np.int32)
             for i, (args, wm_ms, _pb) in enumerate(items):
                 flat.extend(args)
                 wmv[:, i] = np.int32(
                     min(int(td.to_ticks(wm_ms)), 2**31 - 4)
                     if wm_ms is not None else -(2**31) + 1
                 )
-            # pad the operand list to ring depth by repeating the last
-            # slot: the skip branch never applies them, and the MIN-
-            # sentinel watermark fires nothing even if it did — the pad
-            # exists only so the scan's stacked xs keep one static shape
-            for i in range(count, ring_depth):
+            # pad the operand list to the kernel depth by repeating the
+            # last slot: the skip branch never applies them, and the
+            # MIN-sentinel watermark fires nothing even if it did — the
+            # pad exists only so the scan's stacked xs keep one static
+            # shape (the while drain's staged clamp plays the same role)
+            for i in range(count, depth_k):
                 flat.extend(items[-1][0])
                 wmv[:, i] = np.int32(-(2**31) + 1)
             wd_prev = None
@@ -4211,10 +4293,16 @@ class LocalExecutor:
                 # legitimate wall time grows ~n_shards x and the arm
                 # must too (a deep 8-shard drain would otherwise trip a
                 # deadline tuned for one chip's slots)
-                wd_scale = count
+                # the while drain may legitimately retire MORE slots
+                # than the host packed (cursor stores landing mid-drain
+                # on an aliasing runtime), so its deadline arms at the
+                # per-dispatch BOUND, not the observed fill — the bound
+                # is what makes "one while dispatch" a well-defined unit
+                # of work for the watchdog to time
+                wd_scale = depth_k if is_while else count
                 if (getattr(active, "sharded_drain", False)
                         and jax.default_backend() == "cpu"):
-                    wd_scale = count * ctx.n_shards
+                    wd_scale = wd_scale * ctx.n_shards
                 wd_prev = wd.arm("device-drain",
                                  detail=f"slots={count}", scale=wd_scale)
             try:
@@ -4243,6 +4331,60 @@ class LocalExecutor:
                     chain_states[:] = sts[1:]
                     (ovf_handle, act_handle, kgf_handle), fires = \
                         res[1], res[2]
+                elif is_while:
+                    # while drain: the count operand becomes (cursor,
+                    # base, staged). The cursor is the ring's live HBM
+                    # slot (donated — the kernel reuses its buffer for
+                    # the consumed count, and on an aliasing runtime the
+                    # donation is what lets a mid-drain commit store be
+                    # observed); base anchors it so cursor - base equals
+                    # this group's fill at dispatch; staged clamps the
+                    # trip count to the payloads actually packed above
+                    dr = ingest.device_ring
+                    # the ring cursor only fits a kernel of the SAME
+                    # layout (scalar slot vs per-shard vector) — a dp
+                    # job's mask-route fallback drain synthesizes a
+                    # frozen cursor instead (== scan count gating)
+                    cur = (
+                        dr.device_cursor()
+                        if dr is not None and dr.sharded
+                        == bool(getattr(active, "sharded_drain", False))
+                        else None
+                    )
+                    if getattr(active, "sharded_drain", False):
+                        staged_op = np.full(ctx.n_shards, count, np.int32)
+                        if cur is None:
+                            cursor_op = np.full(
+                                ctx.n_shards, count, np.int32)
+                            base_op = np.zeros(ctx.n_shards, np.int32)
+                        else:
+                            cursor_op, snap = cur
+                            base_op = (
+                                np.asarray(snap, np.int32)
+                                - np.int32(count)
+                            )
+                    else:
+                        staged_op = np.int32(count)
+                        if cur is None:
+                            cursor_op = np.full(1, count, np.int32)
+                            base_op = np.int32(0)
+                        else:
+                            cursor_op, snap = cur
+                            base_op = np.int32(snap - count)
+                    res = active(state, *flat, wmv, cursor_op, base_op,
+                                 staged_op, *_tier_args())
+                    if dr is not None and cur is not None:
+                        # the dispatch consumed (donated) the grabbed
+                        # cursor array; stand up a fresh one so a quiet
+                        # stream's next drain never re-passes a deleted
+                        # buffer
+                        dr.refresh_device_cursor()
+                    # res[3] is the consumed count — the host already
+                    # knows the release boundary (the packed items'
+                    # ring seqs; staged clamps the kernel to exactly
+                    # them), so the handle is dropped, never synced
+                    state, (ovf_handle, act_handle, kgf_handle), fires = \
+                        res[:3]
                 else:
                     res = active(state, *flat, wmv, cnt, *_tier_args())
                     # telemetry-ON drains return a 4th element: the
@@ -4259,7 +4401,9 @@ class LocalExecutor:
                     ds_skip[0] += 1
                     if ds_skip[0] >= drain_stats_every[0]:
                         ds_skip[0] = 0
-                        ds_h = res[3]
+                        # while drains slot the consumed count at res[3],
+                        # so their recorder payload rides one later
+                        ds_h = res[4] if is_while else res[3]
                 fire_watch.append(
                     (fires, ovf_handle, time.perf_counter(), ds_h)
                 )
@@ -5421,15 +5565,23 @@ class LocalExecutor:
         # "on" without the prefetch+staging substrate is a config error,
         # and "auto" lights up exactly when the fused-fire resident
         # pipeline is active with staging available
-        if res_cfg == "on":
+        if res_cfg in ("on", "while"):
             if not use_staging:
                 raise ValueError(
-                    "pipeline.resident-loop=on requires pipeline."
+                    f"pipeline.resident-loop={res_cfg} requires pipeline."
                     "prefetch + pipeline.device-staging: the drain "
                     "consumes device-staged batches published into the "
                     "HBM ring by the ingest thread"
                 )
             use_resident = True
+            # while-drain platform gate: CPU buffer donation does not
+            # alias, so the in-kernel cursor re-read can never observe a
+            # mid-drain publish there — keep the scan drain unless the
+            # declared test/bench escape hatch is on (where the while
+            # kernel degrades, bit-exactly, to the scan's count gating)
+            use_while = res_cfg == "while" and (
+                jax.default_backend() != "cpu" or wd_cpu_override
+            )
         else:
             # auto is PLATFORM-gated like precombine/packed-planes: the
             # drain retires a ~100ms tunneled host round trip per
@@ -5451,9 +5603,14 @@ class LocalExecutor:
         if use_resident:
             # the drain group IS the ring: accumulator capacity tracks
             # ring depth, and groups always hold fires (the drain fires
-            # in-scan per slot)
+            # in-scan per slot). While mode accumulates up to the
+            # while-drain bound instead — batches published while the
+            # previous drain was in flight join the CURRENT dispatch
+            # (beyond ring depth they ride unringed fresh staging), so
+            # a publish landing mid-drain never forces its own dispatch
             fused = ingest_mod.FusedBatchAccumulator(
-                ring_depth, hold_fires=True
+                wd_max_slots if use_while else ring_depth,
+                hold_fires=True,
             )
         # -- finalize data parallelism (validated where dp_cfg was
         # read): the sharded drain is a shard_map'd variant of the
@@ -5616,6 +5773,10 @@ class LocalExecutor:
                     _CoreOpts.CONTROLLER_MIN_REBALANCE_INTERVAL)),
                 min_gain=float(env.config.get(
                     _CoreOpts.CONTROLLER_MIN_GAIN)),
+                # durable decisions (ISSUE 20 satellite): the ledger
+                # rides the checkpoint dir so a restarted job serves
+                # the merged tuning history at /jobs/<jid>/controller
+                persist_dir=env.checkpoint_dir or None,
             )
             if self._job_group is not None:
                 grp_c = self._job_group

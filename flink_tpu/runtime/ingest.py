@@ -430,7 +430,49 @@ class DeviceBatchRing:
         # executor flips stats_enabled so the default path appends nothing
         self.stats_enabled = False
         self._pub_samples: deque = deque(maxlen=4096)
+        # device publish cursor (pipeline.resident-loop=while, ISSUE 20):
+        # a tiny HBM int32 slot mirroring the host write cursor. The
+        # ingest thread refreshes it after every commit; the while-drain
+        # dispatch takes the freshest copy (donated, so an aliasing
+        # runtime reuses the same HBM slot) and its loop condition
+        # re-reads it — a batch published mid-drain retires in the same
+        # dispatch. Disabled (None) unless the executor opts in.
+        self._cursor_sharding = None
+        self._cursor = None
         self._lock = threading.Lock()
+
+    def enable_device_cursor(self, sharding) -> None:
+        """Opt in to the HBM publish cursor (while-drain mode). The
+        sharding is the replicated scalar-slot sharding the while-drain
+        kernel expects for its ``cursor`` operand."""
+        with self._lock:
+            self._cursor_sharding = sharding
+            self._cursor = jax.device_put(
+                np.full(1, self._write, np.int32), sharding)
+
+    def device_cursor(self):
+        """``(cursor, write_snapshot)`` — the freshest device-resident
+        publish cursor (int32[1]) plus the host write seq it encodes
+        (read under the same lock, so the pair is consistent) — or None
+        when the cursor slot is disabled. The caller passes the array
+        straight into the while-drain dispatch and derives the drain
+        base from the snapshot; the array is replaced (never mutated)
+        on every commit, so a grabbed reference is a stable snapshot
+        lower-bounding the live value."""
+        with self._lock:
+            if self._cursor is None:
+                return None
+            return self._cursor, self._write
+
+    def refresh_device_cursor(self) -> None:
+        """Re-stage the cursor slot (the consumer calls this right after
+        a while-drain dispatch donated the grabbed array, so a quiet
+        stream's NEXT drain never re-passes a deleted buffer)."""
+        with self._lock:
+            if self._cursor_sharding is not None:
+                self._cursor = jax.device_put(
+                    np.full(1, self._write, np.int32),
+                    self._cursor_sharding)
 
     # -- producer (prefetch thread) --------------------------------------
     def try_publish(self, plan: IngestPlan, hi, lo, ticks, values,
@@ -454,6 +496,14 @@ class DeviceBatchRing:
         with self._lock:
             self._slots[seq % self.depth] = (seq, epoch, staged)
             self._write = seq + 1
+            if self._cursor_sharding is not None:
+                # refresh the HBM cursor slot AFTER the commit so the
+                # device can never see a cursor covering a slot whose
+                # payload isn't resident yet (the while-drain's staged
+                # clamp guards the packed-operand side)
+                self._cursor = jax.device_put(
+                    np.full(1, self._write, np.int32),
+                    self._cursor_sharding)
             if self.stats_enabled:
                 self._pub_samples.append((
                     0, seq, self._write - self._read, max_tick,
@@ -572,6 +622,10 @@ class ShardedDeviceBatchRing:
         # drain flight recorder stamps — see DeviceBatchRing
         self.stats_enabled = False
         self._pub_samples: deque = deque(maxlen=4096)
+        # per-shard device publish cursor (while-drain mode) — int32
+        # [n_shards] under the shard axis; see DeviceBatchRing
+        self._cursor_sharding = None
+        self._cursor = None
         self._lock = threading.Lock()
         self._mask_tmpl = make_prefix_mask_template(self.cap)
         self._reuse = not _host_put_aliases_cached(
@@ -579,6 +633,34 @@ class ShardedDeviceBatchRing:
              for b in slot.values()],
             plan.mask_sharding,
         )
+
+    def enable_device_cursor(self, sharding) -> None:
+        """Opt in to the per-shard HBM publish cursor (while-drain
+        mode); ``sharding`` places int32[n_shards] one entry per owning
+        chip (shard axis)."""
+        with self._lock:
+            self._cursor_sharding = sharding
+            self._cursor = jax.device_put(
+                np.fromiter(self._write, np.int32, self.n_shards),
+                sharding)
+
+    def device_cursor(self):
+        """``(cursor, write_snapshots)`` — the freshest per-shard
+        publish cursor (int32[n_shards]) plus the per-shard host write
+        seqs it encodes — or None; see DeviceBatchRing.device_cursor."""
+        with self._lock:
+            if self._cursor is None:
+                return None
+            return self._cursor, tuple(self._write)
+
+    def refresh_device_cursor(self) -> None:
+        """Re-stage the per-shard cursor after a while-drain dispatch
+        donated the grabbed array; see DeviceBatchRing."""
+        with self._lock:
+            if self._cursor_sharding is not None:
+                self._cursor = jax.device_put(
+                    np.fromiter(self._write, np.int32, self.n_shards),
+                    self._cursor_sharding)
 
     @staticmethod
     def _fill(buf: np.ndarray, arr: np.ndarray, c: int) -> np.ndarray:
@@ -653,6 +735,11 @@ class ShardedDeviceBatchRing:
                         s, seqs[s], self._write[s] - self._read[s],
                         max_tick, t_pub,
                     ))
+            if self._cursor_sharding is not None:
+                # post-commit refresh; see DeviceBatchRing.try_publish
+                self._cursor = jax.device_put(
+                    np.fromiter(self._write, np.int32, self.n_shards),
+                    self._cursor_sharding)
         if tracer is not None and tracer.active:
             tracer.rec("stage", t0, t_pad, n=n)
             tracer.rec("transfer", t_pad, route="sharded")

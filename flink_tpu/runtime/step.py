@@ -1300,6 +1300,492 @@ def build_window_sharded_drain(ctx: MeshContext, spec: WindowStageSpec,
     return drain
 
 
+def _zero_fires_stack(spec: WindowStageSpec, reduced: bool, depth: int):
+    """[depth]-stacked zero fire payload — the while-drain's accumulation
+    buffer. Row ``i`` is written by dynamic_update_index_in_dim when slot
+    ``i`` retires; unconsumed rows stay bit-identical to the scan drain's
+    skip-branch zeros, so the executor's lagged consume_fires treats both
+    lowering forms identically."""
+    z = _zero_slot_fires(spec, reduced)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros((depth,) + x.shape, x.dtype), z
+    )
+
+
+def _while_drain_limit(cursor, base, staged, max_slots):
+    """The live trip bound of one while-drain dispatch: slots the publish
+    cursor has committed past this drain's base, clamped to what the host
+    actually staged into the operand stacks and the configured per-
+    dispatch bound. Re-evaluated in the loop CONDITION each iteration so
+    a cursor store landing mid-drain (the DeviceBatchRing's HBM cursor
+    slot, donated alongside the payloads on an aliasing runtime) extends
+    the trip count of the dispatch already in flight."""
+    return jnp.minimum(
+        jnp.minimum(
+            jnp.maximum(cursor - base, jnp.int32(0)), staged
+        ),
+        jnp.int32(max_slots),
+    )
+
+
+def build_window_while_drain(ctx: MeshContext, spec: WindowStageSpec,
+                             max_slots: int, insert: bool = True,
+                             kg_fill: bool = False,
+                             reduced: bool = False,
+                             drain_stats: bool = False,
+                             tiered: bool = False):
+    """Early-exit live ring drain (pipeline.resident-loop=while, ISSUE
+    20): the resident drain lowered as a ``lax.while_loop`` whose
+    condition re-reads a device-visible PUBLISH CURSOR instead of a
+    host-frozen count — a batch the ingest thread commits while the
+    drain is running is retired *inside the same dispatch*, so the
+    structural one-dispatch-per-publish-burst cost of the count-gated
+    scan disappears under sustained ingest.
+
+    Contract vs the scan drain (build_window_resident_drain):
+
+    * the ``count`` operand is replaced by ``(cursor, base, staged)`` —
+      cursor int32 [1] is the ring's device cursor slot (absolute
+      publish seq, stored by the ingest thread after each commit; the
+      executor donates it so an aliasing runtime lets the in-flight
+      loop observe the store), base is the drain group's first ring
+      seq, staged is how many slot payloads the host bound into THIS
+      dispatch's operand stacks. The trip bound is
+      ``clamp(cursor - base, 0, min(staged, max_slots))``: on a
+      runtime without host->HBM stores into dispatched buffers the
+      cursor term freezes at its dispatch-time value and the kernel
+      degrades exactly to the scan drain's count gating — never reads
+      a slot the host didn't stage.
+    * ``max_slots`` (pipeline.while-drain.max-slots) bounds ONE
+      dispatch, so the exactly-once cut, the watchdog deadline
+      (``Watchdog.arm`` scale = the bound) and the flight-recorder
+      payload ([n_shards, max_slots, N] with zeroed dead rows) stay
+      well-defined however long the publisher keeps the cursor ahead.
+    * a fourth return element, ``consumed`` int32 [1], reports the live
+      slot count this dispatch actually retired — the host's release /
+      telemetry boundary (it matches the cursor slot's shape+dtype, so
+      the donated cursor buffer is reused for it).
+
+    Fires cannot ride a scan stack here: each retired slot's payload is
+    written into a preallocated [max_slots, ...] buffer with one
+    dynamic_update_slice per field per iteration — a deliberately
+    different op profile from the scan drain, pinned by its own
+    ``step.while_drain.*`` op-budget/signature ledger entries."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(max_slots)
+    n_ds = len(DRAIN_STAT_FIELDS)
+
+    def shard_body(state, kg_start, kg_end, cursor, base, staged, hi,
+                   lo, ts, values, valid, wm, *rest):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
+        wm_l = wm[0]                       # [D] per-shard watermarks
+        pend0 = jnp.zeros(spec.win.ring, bool)
+        kgf0 = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+        fires0 = _zero_fires_stack(spec, reduced, D)
+        ds0 = jnp.zeros((D, n_ds), jnp.int32)
+
+        def cond(carry):
+            i, cur = carry[0], carry[1]
+            # the live re-read: cur is carried so the bound check sits
+            # INSIDE the loop, not hoisted as a dispatch-time constant
+            return i < _while_drain_limit(cur[0], base, staged, D)
+
+        def body(carry):
+            i, cur, st, pend, act, kgf, fires, ds = carry
+            pick = lambda a: jax.lax.dynamic_index_in_dim(
+                a, i, keepdims=False
+            )
+            s_hi, s_lo, s_ts = pick(hi), pick(lo), pick(ts)
+            s_vals, s_valid, s_wm = pick(values), pick(valid), pick(wm_l)
+            wm_b = st.watermark
+            late0, cap0 = st.dropped_late, st.dropped_capacity
+            st, a, kg = mask_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+                clear_rows=pend, kg_res=kg_res,
+            )
+            st, pend, cf = wk.advance_and_fire_resident(
+                st, spec.win, spec.red, s_wm, reduced=reduced
+            )
+            fires = jax.tree_util.tree_map(
+                lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+                    buf, v, i, 0
+                ),
+                fires, cf,
+            )
+            if drain_stats:
+                row = _slot_drain_stats(st, spec, s_valid, a, kg, cf,
+                                        wm_b, late0, cap0)
+                ds = jax.lax.dynamic_update_index_in_dim(ds, row, i, 0)
+            return (i + 1, cur, st, pend, act + a,
+                    kgf + kg if kg_fill else kgf, fires, ds)
+
+        i_fin, _cur, state, pend, act, kgf, fires, ds = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cursor, state, pend0,
+                 jnp.zeros((), jnp.int32), kgf0, fires0, ds0),
+            )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        out = (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+            i_fin[None],               # consumed: live retired-slot count
+        )
+        if drain_stats:
+            out += (ds[None],)         # [1, max_slots, N] recorder stack
+        return out
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(), P(), P(),             # cursor [1], base, staged: all
+            #                            replicated so every shard takes
+            #                            the same trip count
+            P(), P(), P(), P(), P(),   # [D, B] batch stacks, replicated
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ) + ((P(),) if tiered else ()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS), P())
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
+        check_vma=False,
+    )
+
+    # donate the state AND the cursor slot: consumed [1] int32 reuses the
+    # cursor's buffer, and on an aliasing runtime the donation is what
+    # lets the ingest thread's commit store land in the dispatched slot
+    @partial(jax.jit, donate_argnums=(0, 5 * D + 2))
+    def drain(state, *flat):
+        if tiered:
+            *batches, wmv, cursor, base, staged, kg_res = flat
+            tail = (kg_res,)
+        else:
+            *batches, wmv, cursor, base, staged = flat
+            tail = ()
+        stacks = _fused_batch_stack(D, batches)
+        res = sharded(
+            state, starts, ends, jnp.asarray(cursor, jnp.int32),
+            jnp.asarray(base, jnp.int32), jnp.asarray(staged, jnp.int32),
+            *stacks, wmv, *tail,
+        )
+        st, ovf_n, act, kgf, fires, consumed = res[:6]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, consumed, res[6]
+        return st, (ovf_n, act, kgf), fires, consumed
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.max_slots = D
+    drain.resident_drain = True
+    drain.while_drain = True
+    drain.fused_fire = True
+    drain.fused_fire_reduced = reduced
+    drain.drain_stats = drain_stats
+    drain.tiered = tiered
+    return drain
+
+
+def build_window_while_drain_sharded(ctx: MeshContext,
+                                     spec: WindowStageSpec,
+                                     max_slots: int, insert: bool = True,
+                                     kg_fill: bool = False,
+                                     reduced: bool = False,
+                                     drain_stats: bool = False,
+                                     tiered: bool = False):
+    """Data-parallel early-exit drain: build_window_while_drain lowered
+    shard-LOCALLY over pre-routed per-shard lane slices (the
+    build_window_sharded_drain layout). ``cursor``/``base``/``staged``
+    are int32 [n_shards] VECTORS under P(SHARD_AXIS): each shard's
+    while_loop trips on its OWN publish cursor, and — with zero
+    collectives in the keyed body — divergent trip counts are safe, so
+    one shard's quiet ring never under-drains a hot one mid-dispatch.
+    ``consumed`` returns [n_shards]: each shard's live retired count,
+    the per-shard release boundary (and the donated cursor vector's
+    buffer)."""
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    D = int(max_slots)
+    n_ds = len(DRAIN_STAT_FIELDS)
+
+    def shard_body(state, kg_start, kg_end, cursor, base, staged, hi,
+                   lo, ts, values, valid, wm, *rest):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        kg_res = rest[0] if tiered else None
+        s_base, s_staged = base[0], staged[0]
+        # [D, 1, cap] per-shard batch stacks squeeze the split axis
+        b_hi, b_lo, b_ts = hi[:, 0], lo[:, 0], ts[:, 0]
+        b_vals, b_valid = values[:, 0], valid[:, 0]
+        wm_l = wm[0]
+        pend0 = jnp.zeros(spec.win.ring, bool)
+        kgf0 = jnp.zeros(maxp if kg_fill else 0, jnp.int32)
+        fires0 = _zero_fires_stack(spec, reduced, D)
+        ds0 = jnp.zeros((D, n_ds), jnp.int32)
+
+        def cond(carry):
+            i, cur = carry[0], carry[1]
+            return i < _while_drain_limit(cur[0], s_base, s_staged, D)
+
+        def body(carry):
+            i, cur, st, pend, act, kgf, fires, ds = carry
+            pick = lambda a: jax.lax.dynamic_index_in_dim(
+                a, i, keepdims=False
+            )
+            s_hi, s_lo, s_ts = pick(b_hi), pick(b_lo), pick(b_ts)
+            s_vals, s_valid = pick(b_vals), pick(b_valid)
+            s_wm = pick(wm_l)
+            wm_b = st.watermark
+            late0, cap0 = st.dropped_late, st.dropped_capacity
+            st, a, kg = mask_update_shard(
+                st, spec, kg_start, kg_end, s_hi, s_lo, s_ts, s_vals,
+                s_valid, s_wm, maxp, insert=insert, kg_fill=kg_fill,
+                clear_rows=pend, kg_res=kg_res,
+            )
+            st, pend, cf = wk.advance_and_fire_resident(
+                st, spec.win, spec.red, s_wm, reduced=reduced
+            )
+            fires = jax.tree_util.tree_map(
+                lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+                    buf, v, i, 0
+                ),
+                fires, cf,
+            )
+            if drain_stats:
+                row = _slot_drain_stats(st, spec, s_valid, a, kg, cf,
+                                        wm_b, late0, cap0)
+                ds = jax.lax.dynamic_update_index_in_dim(ds, row, i, 0)
+            return (i + 1, cur, st, pend, act + a,
+                    kgf + kg if kg_fill else kgf, fires, ds)
+
+        i_fin, _cur, state, pend, act, kgf, fires, ds = \
+            jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cursor, state, pend0,
+                 jnp.zeros((), jnp.int32), kgf0, fires0, ds0),
+            )
+        state = wk.apply_pending_purge(state, spec.win, spec.red, pend)
+        ovf_n = state.ovf_n
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        out = (
+            pack(state), ovf_n[None], act[None], kgf[None], pack(fires),
+            i_fin[None],
+        )
+        if drain_stats:
+            out += (ds[None],)
+        return out
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            # per-shard cursor/base/staged vectors: each shard trips on
+            # its OWN publish frontier (no collectives in the body, so
+            # divergent trip counts cannot deadlock anything)
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            # [D, n_shards, cap] stacks SPLIT on the shard axis
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(SHARD_AXIS),             # wmv [n_shards, D]
+        ) + ((P(),) if tiered else ()),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                   P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS))
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 5 * D + 2))
+    def drain(state, *flat):
+        if tiered:
+            *batches, wmv, cursor, base, staged, kg_res = flat
+            tail = (kg_res,)
+        else:
+            *batches, wmv, cursor, base, staged = flat
+            tail = ()
+        stacks = _fused_batch_stack(D, batches)
+        res = sharded(
+            state, starts, ends, jnp.asarray(cursor, jnp.int32),
+            jnp.asarray(base, jnp.int32), jnp.asarray(staged, jnp.int32),
+            *stacks, wmv, *tail,
+        )
+        st, ovf_n, act, kgf, fires, consumed = res[:6]
+        if drain_stats:
+            return st, (ovf_n, act, kgf), fires, consumed, res[6]
+        return st, (ovf_n, act, kgf), fires, consumed
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.max_slots = D
+    drain.resident_drain = True
+    drain.sharded_drain = True
+    drain.while_drain = True
+    drain.fused_fire = True
+    drain.fused_fire_reduced = reduced
+    drain.drain_stats = drain_stats
+    drain.tiered = tiered
+    return drain
+
+
+def build_window_dcn_resident_drain(ctx: MeshContext,
+                                    spec: WindowStageSpec,
+                                    batch_per_device: int,
+                                    depth: int,
+                                    capacity_factor: float = 2.0,
+                                    insert: bool = True,
+                                    drain_stats: bool = False):
+    """Per-host DCN-resident drain (ISSUE 20 tentpole b): the lockstep
+    DCN step (runtime/dcn.py DCNWindowRunner._build_step) promoted to a
+    count-gated multi-slot drain — each lockstep ROUND retires up to
+    ``depth`` locally-polled batches in ONE dispatch, with the keyed
+    all_to_all still running per slot and the cross-host control plane
+    (global watermark / done / fire backlog pmin-pmax) evaluated at the
+    DRAIN BOUNDARY.
+
+    The trip count is agreed ON DEVICE: every host passes its own local
+    fill in ``fills`` and the kernel takes ``pmax`` over the shard axis
+    before the slot loop, so all hosts enter the same number of
+    all_to_all rounds (a host with a shallower ring pads empty-valid
+    slots) without any host-side count exchange — the collective fabric
+    that moves the records also synchronizes the drain shape.
+
+    Signature: ``drain(state, hi, lo, ts, values, valid, wm, done,
+    fills)`` with [depth, B] batch stacks SPLIT over the global mesh on
+    the lane axis, wm int32 [depth, n_shards] split on the shard axis,
+    done/fills int32 [n_shards]. Returns ``(state', fires, stop,
+    drained)``: fires stacked [n_shards, depth] for the runner's
+    per-slot ``_emit_local``, stop the lockstep termination conjunction
+    (gdone and no fire backlog in any live slot), drained the agreed
+    slot count — the host scales the NEXT boundary's peer-exchange
+    frame deadline by it. With ``drain_stats`` a fifth element rides
+    along: the [n_shards, depth, N] per-slot recorder stack."""
+    from flink_tpu.parallel.exchange import bucket_capacity
+
+    starts, ends = ctx.kg_bounds()
+    starts = jnp.asarray(starts)
+    ends = jnp.asarray(ends)
+    maxp = ctx.max_parallelism
+    mesh = ctx.mesh
+    n = ctx.n_shards
+    cap = bucket_capacity(batch_per_device, n, capacity_factor)
+    D = int(depth)
+    F = spec.win.fires_per_step
+
+    def shard_body(state, kg_start, kg_end, fills, done, hi, lo, ts,
+                   values, valid, wm):
+        state = jax.tree_util.tree_map(lambda x: x[0], state)
+        kg_start, kg_end = kg_start[0], kg_end[0]
+        # the drain shape is a GLOBAL agreement: deepest local ring
+        # wins, shallower hosts run empty-valid pad slots — replicated
+        # by construction, so every host's all_to_all count matches
+        count = jax.lax.pmax(fills[0], SHARD_AXIS)
+        gdone = jax.lax.pmin(done[0], SHARD_AXIS)
+        wm_l = wm[:, 0]                    # [D] this shard's wm column
+
+        def sub(carry, xs):
+            i, s_hi, s_lo, s_ts, s_vals, s_valid, s_wm = xs
+
+            def live(st):
+                # per-slot global low watermark: decisions ride the
+                # same fabric as the records (lockstep invariant)
+                gwm = jax.lax.pmin(s_wm, SHARD_AXIS)
+                wm_b = st.watermark
+                late0, cap0 = st.dropped_late, st.dropped_capacity
+                st, act = exchange_update_shard(
+                    st, spec, kg_start, kg_end, s_hi, s_lo, s_ts,
+                    s_vals, s_valid, n, maxp, cap, insert=insert,
+                )
+                st, fr = wk.advance_and_fire(st, spec.win, spec.red,
+                                             gwm)
+                cf = wk.compact_fires(st.table, fr)
+                # fire backlog: full on-time lanes mean more window
+                # ends may be due — the ensemble must keep cycling
+                pending = (
+                    jnp.sum(fr.lane_valid[:F], dtype=jnp.int32)
+                    >= jnp.int32(F)
+                ).astype(jnp.int32)
+                if drain_stats:
+                    kgf = jnp.zeros(0, jnp.int32)
+                    ds = _slot_drain_stats(st, spec, s_valid, act, kgf,
+                                           cf, wm_b, late0, cap0)
+                    return st, (cf, pending, ds)
+                return st, (cf, pending)
+
+            def skip(st):
+                ys = (_zero_slot_fires(spec, False),
+                      jnp.zeros((), jnp.int32))
+                if drain_stats:
+                    ys += (jnp.zeros(len(DRAIN_STAT_FIELDS), jnp.int32),)
+                return st, ys
+
+            return jax.lax.cond(i < count, live, skip, carry)
+
+        state, ys = jax.lax.scan(
+            sub, state,
+            (jnp.arange(D, dtype=jnp.int32), hi, lo, ts, values, valid,
+             wm_l),
+        )
+        cfs, pendings = ys[0], ys[1]
+        # any live slot with a full fire-lane set keeps the ensemble
+        # stepping (conservative: terminates once fires run dry)
+        gpending = jax.lax.pmax(jnp.max(pendings), SHARD_AXIS)
+        stop = gdone * (1 - gpending)
+        pack = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        out = (pack(state), pack(cfs), stop, count)
+        if drain_stats:
+            out += (ys[2][None],)
+        return out
+
+    sharded = shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(
+            P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+            P(SHARD_AXIS),             # fills: per-host ring occupancy
+            P(SHARD_AXIS),             # done flags
+            # [D, B] batch stacks SPLIT over the global mesh on the
+            # lane axis: each host's records sit on its local devices
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+            P(None, SHARD_AXIS),       # wm [D, n_shards]
+        ),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(), P())
+        + ((P(SHARD_AXIS),) if drain_stats else ()),
+        check_vma=False,
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def drain(state, hi, lo, ts, values, valid, wm, done, fills):
+        res = sharded(
+            state, starts, ends, jnp.asarray(fills, jnp.int32),
+            jnp.asarray(done, jnp.int32), hi, lo, ts, values, valid,
+            wm,
+        )
+        if drain_stats:
+            return res[0], res[1], res[2], res[3], res[4]
+        return res[:4]
+
+    drain.k_steps = D
+    drain.ring_depth = D
+    drain.resident_drain = True
+    drain.dcn_resident = True
+    drain.recv_lanes = n * cap
+    drain.bucket_cap = cap
+    drain.drain_stats = drain_stats
+    return drain
+
+
 def _chain_fires_to_lanes(cf, n_lanes: int):
     """Re-key CompactFires into the NEXT stage's input lanes (the
     inter-stage edge of the chained drain, ISSUE 16): every fired
@@ -2355,6 +2841,30 @@ def kernel_family_grid():
           build_window_chained_drain_sharded,
           "chained_drain_sharded", route="sharded",
           k_steps=AUDIT_RING_DEPTH, drain_stats=True),
+        # the early-exit live drains (ISSUE 20a): the count-gated scan
+        # lowered as a while_loop tripping on the device-visible publish
+        # cursor. The body is the SAME exchange/advance/fire sequence —
+        # the op-budget ledger pins that the lowering change costs no
+        # sorts/scatters — and the sharded variant keeps the keyed body
+        # collective-free (divergent per-shard trip counts stay safe)
+        F("step.while_drain.mask.hash.d4", build_window_while_drain,
+          "while_drain", k_steps=AUDIT_RING_DEPTH, deep=True),
+        F("step.while_drain.sharded.hash.d4",
+          build_window_while_drain_sharded,
+          "while_drain_sharded", route="sharded",
+          k_steps=AUDIT_RING_DEPTH),
+        F("step.while_drain.mask.hash.d4.dstats", build_window_while_drain,
+          "while_drain", k_steps=AUDIT_RING_DEPTH, drain_stats=True),
+        # the per-host DCN-resident drain (ISSUE 20b): the lockstep DCN
+        # body run depth times per dispatch with the trip count
+        # pmax-agreed on device — the all_to_all count per slot is the
+        # structural invariant the signature ledger pins
+        F("step.dcn_resident.hash.d4", build_window_dcn_resident_drain,
+          "dcn_resident", route="exchange", k_steps=AUDIT_RING_DEPTH),
+        F("step.dcn_resident.hash.d4.dstats",
+          build_window_dcn_resident_drain,
+          "dcn_resident", route="exchange", k_steps=AUDIT_RING_DEPTH,
+          drain_stats=True),
         F("step.fire.hash", build_window_fire_step, "fire", deep=True),
         F("step.fire_reduced.hash", build_window_fire_reduced_step,
           "fire_reduced"),
@@ -2465,6 +2975,37 @@ def _family_example_args(fam: KernelFamily, ctx: MeshContext, state,
         wmv = jnp.zeros((n, fam.k_steps), jnp.int32)
         counts = jnp.full((n,), fam.k_steps - 1, jnp.int32)
         return (state,) + per2 * fam.k_steps + (wmv, counts) + tier
+    if fam.kind == "while_drain":
+        # cursor = base + (depth - 1) staged slots: the while_loop's
+        # bound is live (not the static depth), so the traced program
+        # keeps the cursor re-read in its condition
+        wmv = jnp.zeros((ctx.n_shards, fam.k_steps), jnp.int32)
+        cursor = jnp.full((1,), fam.k_steps - 1, jnp.int32)
+        base = jnp.asarray(0, jnp.int32)
+        staged = jnp.asarray(fam.k_steps - 1, jnp.int32)
+        return ((state,) + per * fam.k_steps
+                + (wmv, cursor, base, staged) + tier)
+    if fam.kind == "while_drain_sharded":
+        # per-shard cursor/base/staged VECTORS — each shard trips its
+        # own while_loop on its own publish cursor
+        n = ctx.n_shards
+        per2 = tuple(jnp.broadcast_to(a, (n,) + a.shape) for a in per)
+        wmv = jnp.zeros((n, fam.k_steps), jnp.int32)
+        cursor = jnp.full((n,), fam.k_steps - 1, jnp.int32)
+        base = jnp.zeros((n,), jnp.int32)
+        staged = jnp.full((n,), fam.k_steps - 1, jnp.int32)
+        return ((state,) + per2 * fam.k_steps
+                + (wmv, cursor, base, staged) + tier)
+    if fam.kind == "dcn_resident":
+        # [depth, B] slot-major stacks + per-shard wm columns / done /
+        # fills (fills = depth - 1: both cond branches live)
+        D = fam.k_steps
+        n = ctx.n_shards
+        stack = tuple(jnp.broadcast_to(a, (D,) + a.shape) for a in per)
+        wm = jnp.zeros((D, n), jnp.int32)
+        done = jnp.zeros((n,), jnp.int32)
+        fills = jnp.full((n,), D - 1, jnp.int32)
+        return (state,) + stack + (wm, done, fills)
     if fam.kind in ("fire", "fire_reduced"):
         return (state, watermark_vector(ctx, 0))
     if fam.kind == "session":
@@ -2487,7 +3028,8 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     kw = {}
     if fam.kind in ("update", "megastep", "megastep_fired",
                     "resident_drain", "sharded_drain", "chained_drain",
-                    "chained_drain_sharded"):
+                    "chained_drain_sharded", "while_drain",
+                    "while_drain_sharded"):
         kw["insert"] = fam.insert
         kw["kg_fill"] = True
     if fam.route == "exchange":
@@ -2505,6 +3047,15 @@ def build_family(fam: KernelFamily, ctx: MeshContext,
     if fam.kind in ("chained_drain", "chained_drain_sharded"):
         kw["depth"] = fam.k_steps
         kw["exchange_lanes"] = AUDIT_EXCHANGE_LANES
+        kw["drain_stats"] = fam.drain_stats
+    if fam.kind in ("while_drain", "while_drain_sharded"):
+        kw["max_slots"] = fam.k_steps
+        kw["reduced"] = fam.reduced
+        kw["drain_stats"] = fam.drain_stats
+        kw["tiered"] = fam.tiered
+    if fam.kind == "dcn_resident":
+        kw["depth"] = fam.k_steps
+        kw["insert"] = fam.insert
         kw["drain_stats"] = fam.drain_stats
     fn = fam.builder(ctx, spec, **kw)
     init = {

@@ -50,6 +50,28 @@ def expected(nproc):
     return exp
 
 
+# -- round 20: per-host resident mode (ISSUE 20b) --------------------------
+
+RESIDENT_DEPTH = 4
+
+
+def two_host_window_resident():
+    spec = two_host_window()
+    spec.resident = True
+    spec.resident_ring_depth = RESIDENT_DEPTH
+    return spec
+
+
+def skewed_window_rebalanced_resident():
+    """Rebalance side channel + resident drains: the peer exchange runs
+    only at drain boundaries with the frame deadline scaled by the
+    previous drain's slot count."""
+    spec = skewed_window_rebalanced()
+    spec.resident = True
+    spec.resident_ring_depth = RESIDENT_DEPTH
+    return spec
+
+
 # -- round 5: generalized plane (sliding + sessions + env.execute) --------
 
 SLIDE_MS = 500

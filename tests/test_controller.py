@@ -200,6 +200,53 @@ def test_probation_pass_keeps_move():
         ["tune", "probation-pass"]
 
 
+def test_ledger_persists_and_merges_across_restart(tmp_path):
+    """ISSUE 20 satellite: decisions survive the restart that applied
+    them — the jsonl ledger rides the checkpoint dir, a fresh
+    controller reloads the tail, and report() serves ONE totally-
+    ordered merged history with per-run stamps."""
+    import json
+
+    rig = _Rig(persist_dir=str(tmp_path))
+    rig.tick()
+    rig.findings = [{"rule": "device-saturated",
+                     "action": {"actuator": "ring-fill-target",
+                                "direction": "up"}}]
+    rig.tick()                         # tune 8 -> 16, persisted
+    rig.findings = []
+    rig.tick()
+    rig.tick()                         # probation passes, persisted
+    path = tmp_path / "controller-ledger.jsonl"
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [e["kind"] for e in lines] == ["tune", "probation-pass"]
+    assert all(e["run"] == 1 for e in lines)
+
+    # restart: a fresh controller over the same dir serves the MERGED
+    # history and keeps appending with a bumped run / continued seq
+    rig2 = _Rig(persist_dir=str(tmp_path))
+    rep = rig2.ctl.report()
+    assert rep["run"] == 2 and rep["restored_entries"] == 2
+    assert [e["kind"] for e in rep["ledger"]] == \
+        ["tune", "probation-pass"]
+    rig2.tick()
+    rig2.findings = [{"rule": "device-saturated",
+                      "action": {"actuator": "ring-fill-target",
+                                 "direction": "up"}}]
+    rig2.tick()                        # run-2 tune
+    merged = rig2.ctl.report()["ledger"]
+    assert [(e["run"], e["kind"]) for e in merged] == \
+        [(1, "tune"), (1, "probation-pass"), (2, "tune")]
+    seqs = [e["seq"] for e in merged]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    # a torn tail line (crash mid-append) is skipped, never fatal
+    with open(path, "a") as f:
+        f.write('{"seq": 99, "k')
+    rig3 = _Rig(persist_dir=str(tmp_path))
+    assert rig3.ctl.report()["restored_entries"] == 3
+    assert rig3.ctl.report()["run"] == 3
+
+
 def test_regime_fallback_picks_ring_fill_target():
     rig = _Rig()
     rig.tick()

@@ -1,0 +1,242 @@
+"""Per-host DCN-resident mode (ISSUE 20 tentpole (b), runtime/dcn.py
+``_run_resident`` + runtime/step.py ``build_window_dcn_resident_drain``):
+
+* single-process (one host, real 8-device collectives): resident drains
+  are bit-exact vs the analytic oracle and retire the stream in strictly
+  fewer lockstep rounds than single-step dispatch — each round stacks up
+  to ring-depth locally-polled chunks into ONE drain dispatch,
+* two-process ensemble (capability-gated like test_dcn.py): merged
+  emissions bit-exact vs the single-host oracle, records still cross the
+  process boundary through the in-kernel all_to_all, and the cycle count
+  beats the lockstep ensemble,
+* resident + rebalance side channel: peer exchange runs only at drain
+  boundaries with the frame deadline scaled by the previous drain's slot
+  count — results stay exact,
+* drain-boundary peer-stall units: ``_frame_deadline_s`` scales the base
+  recv timeout by ``deadline_scale`` (never below the base, 1.0 in
+  lockstep mode ⇒ byte-identical), and a stalled peer still raises an
+  attributed :class:`DCNPeerStalledError` under the SCALED deadline —
+  the semantics ISSUE 20 requires the resident mode to preserve.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from dcn_jobs import (  # noqa: E402
+    RESIDENT_DEPTH,
+    expected,
+    expected_skewed,
+)
+from dcn_probe import (  # noqa: E402
+    SKIP_REASON,
+    multiprocess_collectives_supported,
+)
+
+from flink_tpu.runtime.dcn import (  # noqa: E402
+    DCNPeerStalledError,
+    _RebalanceRing,
+    runner_for_spec,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NPROC = 2
+
+ensemble = pytest.mark.skipif(
+    not multiprocess_collectives_supported(), reason=SKIP_REASON
+)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _rows(out):
+    got = {}
+    for k64, w, v in zip(out["key_id"], out["window_end_ms"],
+                         out["value"]):
+        key = (int(k64), int(w))
+        assert key not in got, f"duplicate emission {key}"
+        got[key] = float(v)
+    return got
+
+
+# ------------------------------------------- single process, real drains
+
+def test_resident_single_host_exact_and_fewer_cycles():
+    """One host over the full local mesh: the resident drain kernel's
+    collectives (pmax fill agreement, pmin done/watermark, all_to_all
+    routing) run for real across the local shards. Exact results, and
+    the cycle count (= drain dispatches) is strictly below the lockstep
+    runner's single-step rounds on the same stream."""
+    from dcn_jobs import two_host_window, two_host_window_resident
+
+    out = runner_for_spec(two_host_window_resident(), 0, 1).run()
+    assert _rows(out) == expected(1)
+    assert out["cycles"] > 0
+
+    base = runner_for_spec(two_host_window(), 0, 1).run()
+    assert _rows(base) == expected(1)
+    assert out["cycles"] < base["cycles"], (out["cycles"], base["cycles"])
+
+
+def test_resident_requires_time_window_job():
+    """``resident=True`` on a runner family without a resident drain
+    kernel (session/rolling/cep) is a config error, never a silent
+    fallback to lockstep."""
+    from dcn_jobs import two_host_session
+
+    spec = two_host_session()
+    spec.resident = True
+    spec.resident_ring_depth = RESIDENT_DEPTH
+    with pytest.raises(ValueError, match="resident"):
+        runner_for_spec(spec, 0, 1)
+
+
+# --------------------------------------------- two-process ensemble (gated)
+
+def _spawn(pid, coord, builder, out, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "flink_tpu.runtime.dcn",
+         "--coordinator", coord, "--num-processes", str(NPROC),
+         "--process-id", str(pid), "--builder",
+         os.path.join(REPO, "tests", "dcn_jobs.py") + ":" + builder,
+         "--out", out],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+
+
+def _run_ensemble(tmp_path, tag, builder, extra_env=None):
+    import json
+
+    coord = f"127.0.0.1:{_free_port()}"
+    outs = [str(tmp_path / f"{tag}-{p}.npz") for p in range(NPROC)]
+    procs = [_spawn(p, coord, builder, outs[p], extra_env)
+             for p in range(NPROC)]
+    deadline = time.time() + 420
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(out.decode(errors="replace"))
+    cycles = None
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-2000:]
+        for line in log.splitlines():
+            if line.startswith("{"):
+                cycles = json.loads(line)["cycles"]
+    got, by_host = {}, {}
+    for host, path in enumerate(outs):
+        data = np.load(path)
+        for k64, w, v in zip(data["key_id"], data["window_end_ms"],
+                             data["value"]):
+            key = (int(k64), int(w))
+            assert key not in got, f"duplicate emission {key}"
+            got[key] = float(v)
+            by_host[key] = host
+    return got, by_host, cycles
+
+
+@ensemble
+def test_resident_two_process_bit_exact_vs_oracle(tmp_path):
+    """The round-20 cross-host criterion: the two-process resident
+    ensemble's merged emissions equal the single-host oracle exactly,
+    records provably cross the DCN hop inside the resident drain, and
+    the drain-grouped rounds beat the lockstep ensemble's cycles."""
+    got, by_host, cycles = _run_ensemble(
+        tmp_path, "res", "two_host_window_resident")
+    assert got == expected(NPROC)
+    crossed = sum(
+        1 for (k, _w), host in by_host.items() if host != k % NPROC
+    )
+    assert crossed > len(got) // 4, (crossed, len(got))
+    assert len(set(by_host.values())) == NPROC
+
+    _got_l, _bh, cyc_lock = _run_ensemble(
+        tmp_path, "lock", "two_host_window")
+    assert cycles < cyc_lock, (cycles, cyc_lock)
+
+
+@ensemble
+def test_resident_with_rebalance_exchanges_at_drain_boundaries(tmp_path):
+    """Resident drains + the host-level rebalance ring: the peer
+    exchange happens only at drain boundaries (one frame per up-to-depth
+    chunks) under the drain-scaled frame deadline, and the 90/10 skewed
+    stream still sums exactly."""
+    addrs = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+    got, _by_host, cycles = _run_ensemble(
+        tmp_path, "resreb", "skewed_window_rebalanced_resident",
+        {"FLINK_TPU_TEST_REBALANCE_ADDRS": addrs})
+    assert got == expected_skewed()
+    assert cycles > 0
+
+
+# -------------------------------------- drain-boundary stall units (local)
+
+def _mk_ring_shell(recv_timeout_s, scale):
+    """A _RebalanceRing with just the fields the deadline/stall paths
+    touch — no sockets dialed, no peers needed."""
+    import struct as struct_mod
+
+    ch = _RebalanceRing.__new__(_RebalanceRing)
+    ch.struct = struct_mod
+    ch.socket = socket
+    ch.pid = 0
+    ch.recv_timeout_s = float(recv_timeout_s)
+    ch.deadline_scale = float(scale)
+    return ch
+
+
+def test_frame_deadline_scales_with_drained_slots():
+    """deadline = base x max(1, scale): lockstep (scale 1.0) is
+    byte-identical to the pre-resident contract, a deep drain multiplies
+    the budget, and a sub-1 scale NEVER shrinks below the base."""
+    ch = _mk_ring_shell(2.0, 1.0)
+    assert ch._frame_deadline_s() == 2.0
+    ch.deadline_scale = 4.0
+    assert ch._frame_deadline_s() == 8.0
+    ch.deadline_scale = 0.25          # drained 0 slots: clamp to base
+    assert ch._frame_deadline_s() == 2.0
+
+
+def test_stalled_peer_attributed_under_scaled_deadline():
+    """A peer that sends nothing still raises DCNPeerStalledError — the
+    resident mode scales the deadline, it never disables attribution.
+    The error names the peer, and the wait really honors the scaled
+    budget (scale 3 waits ~3x the base before attributing)."""
+    a, b = socket.socketpair()
+    try:
+        a.settimeout(0.05)
+        ch = _mk_ring_shell(0.2, 1.0)
+        t0 = time.monotonic()
+        with pytest.raises(DCNPeerStalledError, match="next stalled"):
+            ch._recv_exact(a, 8, peer="next")
+        base_wait = time.monotonic() - t0
+
+        ch.deadline_scale = 3.0
+        t0 = time.monotonic()
+        with pytest.raises(DCNPeerStalledError, match="next stalled"):
+            ch._recv_exact(a, 8, peer="next")
+        scaled_wait = time.monotonic() - t0
+        assert scaled_wait >= 0.55        # ~0.6s budget vs ~0.2s base
+        assert scaled_wait > base_wait
+    finally:
+        a.close()
+        b.close()

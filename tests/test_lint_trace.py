@@ -177,6 +177,9 @@ def test_drain_stats_compiles_out_byte_identical_to_pre_pr_ledger():
         "step.sharded_drain.hash.d4.dstats",
         "step.chained_drain.mask.hash.d4.s2.dstats",
         "step.chained_drain.sharded.hash.d4.s2.dstats",
+        # round 20: while / DCN-resident drains carry the recorder too
+        "step.while_drain.mask.hash.d4.dstats",
+        "step.dcn_resident.hash.d4.dstats",
     }, on
     # the recorder is element-ops-only: an ON variant may not add a
     # single sort/scatter/gather pass over its OFF twin

@@ -1,0 +1,278 @@
+"""Early-exit while-loop drains (ISSUE 20 tentpole (a), runtime/step.py
+``build_window_while_drain[_sharded]`` + runtime/executor.py ``while``
+resident mode + runtime/ingest.py device publish cursor):
+
+* steady-state correctness with ``pipeline.resident-loop=while`` — exact
+  windows with no more drain dispatches than the scan-mode baseline (the
+  while body retires every staged slot the HBM cursor exposes, including
+  batches published mid-drain),
+* the platform gate: ``while`` on CPU without
+  ``pipeline.while-drain.cpu-override`` falls back to the scan drain and
+  stays exact,
+* ``pipeline.while-drain.max-slots`` bounds a single dispatch without
+  changing results,
+* exactly-once across a MID-WHILE-DRAIN crash (``step.drain`` seam)
+  under prefetch + incremental + async checkpoints + packed planes,
+* a cursor-race property test over {scan, while} x {1, 4} shards: with
+  the device cursor enabled (while mode) the consumer retires slots
+  purely from ``device_cursor()`` snapshots — every published slot is
+  retired exactly once, snapshots are monotone, and a grabbed cursor
+  array is a stable (never-mutated) snapshot even after later commits.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from flink_tpu.parallel.mesh import MeshContext
+from flink_tpu.runtime import ingest as ingest_mod
+from flink_tpu.testing import faults
+from flink_tpu.testing.faults import FaultInjector, FaultRule
+
+from test_resident_loop import (  # noqa: F401 — shared job helpers
+    RESIDENT_CFG,
+    _batch,
+    _mk_plan,
+    build_env,
+    expected,
+    run_job,
+)
+
+WHILE_CFG = {
+    **RESIDENT_CFG,
+    "pipeline.resident-loop": "while",
+    # CPU has no async dispatch gap to close; tests opt in explicitly so
+    # the while kernel itself (not just the gate) is exercised
+    "pipeline.while-drain.cpu-override": "on",
+}
+
+
+# ----------------------------------------------------- steady state
+
+def test_while_drain_exact_with_no_more_dispatches_than_scan():
+    """While mode is exact and never dispatches MORE drains than the
+    scan baseline on the same stream: the loop condition re-reads the
+    publish cursor, so slots landing mid-drain retire in the same
+    dispatch instead of forcing another one."""
+    total = 4096
+    env = build_env(1, **WHILE_CFG)
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    assert m.resident_drains > 0
+
+    scan_env = build_env(1, **RESIDENT_CFG)
+    assert run_job(scan_env, total) == expected(total)
+    assert m.resident_drains <= scan_env.last_job.metrics.resident_drains
+
+
+def test_while_gated_on_cpu_falls_back_to_scan():
+    """Without the cpu-override the platform gate keeps the scan drain
+    (no while dispatch on a backend with no gap to close) — results are
+    identical, drains still happen."""
+    cfg = {k: v for k, v in WHILE_CFG.items()
+           if k != "pipeline.while-drain.cpu-override"}
+    env = build_env(1, **cfg)
+    assert run_job(env, 2048) == expected(2048)
+    assert env.last_job.metrics.resident_drains > 0
+
+
+def test_while_max_slots_bounds_dispatch_not_results():
+    """``pipeline.while-drain.max-slots`` caps one dispatch's trip count
+    (the watchdog deadline scale) — a tight cap of 2 changes dispatch
+    granularity only, never the windows."""
+    env = build_env(1, **{**WHILE_CFG,
+                          "pipeline.while-drain.max-slots": 2})
+    assert run_job(env, 4096) == expected(4096)
+    assert env.last_job.metrics.resident_drains > 0
+
+
+def test_while_requires_staging_substrate():
+    """``while`` without prefetch+staging is a config error, identical
+    to ``on`` — never a silent downgrade."""
+    env = build_env(1, **{"pipeline.prefetch": "off",
+                          "pipeline.resident-loop": "while"})
+    with pytest.raises(ValueError, match="resident-loop"):
+        run_job(env, 512)
+
+
+def test_while_sharded_exact_with_data_parallel():
+    """Sharded while drain under data-parallel: per-shard cursor vector,
+    per-shard early exit, exact global windows."""
+    total = 4096
+    env = build_env(4, **{**WHILE_CFG, "pipeline.data-parallel": "on"})
+    got = run_job(env, total)
+    assert got == expected(total)
+    m = env.last_job.metrics
+    assert m.resident_drains > 0
+    assert m.steps_sharded > 0
+
+
+# ------------------------------------------ mid-drain crash, exactly-once
+
+def test_while_mid_drain_crash_restore_exactly_once(tmp_path):
+    """The round-20 exactly-once criterion for while mode: crash at the
+    drain dispatch (``step.drain`` seam, staged slots accumulated + HBM
+    cursor ahead of the retired base) under prefetch + incremental +
+    async checkpoints + packed planes; restore replays the un-retired
+    group from the applied-offset cut — the device cursor is rebuilt
+    from the host write cursor on restart, so no slot is skipped or
+    double-drained."""
+    total = 4096
+    env = build_env(
+        2, tmp_path / "chk", interval=2, restart=3,
+        **{**WHILE_CFG,
+           "checkpoint.mode": "incremental", "checkpoint.async": True,
+           "state.packed-planes": "on"},
+    )
+    inj = FaultInjector([
+        FaultRule("step.drain",
+                  exc=RuntimeError("injected mid-while-drain crash"),
+                  at=1),
+    ])
+    with faults.active(inj):
+        got = run_job(env, total)
+    m = env.last_job.metrics
+    assert inj.fired_at("step.drain"), "drain seam never fired"
+    assert m.restarts == 1
+    assert m.resident_drains > 0
+    assert got == expected(total)
+
+
+# --------------------------------------- cursor race, {scan,while}x{1,4}
+
+def _sharded_plan(n=4, B=8, cap=8, depth=4):
+    ctx = MeshContext.create(n, 128, devices=jax.devices()[:n])
+    mask_sh, split_sh = ingest_mod.IngestPlan.shardings_for(ctx.mesh)
+    return ingest_mod.IngestPlan(
+        td=None, slide_ticks=1000, span_limit=8, B=B, B_step=B,
+        n_shards=n, max_parallelism=128,
+        kg_ends=np.asarray(ctx.kg_bounds()[1]), exchange_cap=0,
+        routes=("mask", "sharded"), staging=True,
+        mask_sharding=mask_sh, split_sharding=split_sh,
+        ring_depth=depth, shard_cap=cap,
+    )
+
+
+@pytest.mark.parametrize("mode", ["scan", "while"])
+@pytest.mark.parametrize("n_shards", [1, 4])
+def test_cursor_race_every_slot_retired_exactly_once(mode, n_shards):
+    """Threaded producer/consumer over the publish/retire seam, the way
+    the executor really drives it in each mode: in ``while`` mode the
+    consumer learns progress ONLY from ``device_cursor()`` snapshots
+    (host write seq paired with the HBM slot contents, read under one
+    lock) and re-stages the slot after every 'dispatch' with
+    ``refresh_device_cursor()``; in ``scan`` mode the cursor is disabled
+    and retirement follows the host-side published seqs. Either way
+    every published slot is retired exactly once, snapshots never move
+    backwards, and a grabbed cursor array holds its value even after
+    later commits (replace-not-mutate contract — a donated buffer can
+    never alias a live snapshot)."""
+    depth, B, M = 4, 8, 120
+    if n_shards == 1:
+        plan = _mk_plan(B=B, depth=depth)
+        ring = ingest_mod.DeviceBatchRing(plan, depth)
+        cursor_sh = plan.mask_sharding
+    else:
+        plan = _sharded_plan(n=n_shards, B=B, depth=depth)
+        ring = ingest_mod.ShardedDeviceBatchRing(plan, depth)
+        cursor_sh = plan.split_sharding
+    if mode == "while":
+        ring.enable_device_cursor(cursor_sh)
+    else:
+        assert ring.device_cursor() is None
+
+    published = []                 # per-publish seq records (host truth)
+    errs = []
+    done = threading.Event()
+
+    def producer():
+        try:
+            for j in range(M):
+                hi, lo, ticks, vals = _batch(j, B, B)
+                if n_shards == 1:
+                    while True:
+                        pub = ring.try_publish(plan, hi, lo, ticks,
+                                               vals, B, "mask", epoch=0)
+                        if pub is not None:
+                            break
+                        time.sleep(0.0002)   # full: consumer is behind
+                    published.append(pub[0])
+                else:
+                    # every batch carries all shards, so lanes fill in
+                    # lockstep; gating on occupancy (only THIS thread
+                    # publishes, so it can't grow concurrently) keeps
+                    # every slot ring-resident — no fresh-buffer bypass
+                    shard = np.arange(B, dtype=np.int64) % n_shards
+                    while ring.occupancy() >= depth:
+                        time.sleep(0.0002)
+                    seqs, _staged = ring.publish_batch(
+                        plan, hi, lo, ticks, vals, shard, B, 0)
+                    assert seqs == [j] * n_shards
+                    published.append(seqs)
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    freed = 0
+    prev = None                    # (cursor array, host snapshot) pair
+    last_snap = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if mode == "while":
+            cur, snap = ring.device_cursor()
+            # consistency: the HBM slot encodes exactly the host write
+            # seq it was paired with under the lock
+            got = np.asarray(cur)
+            if n_shards == 1:
+                assert int(got[0]) == snap
+                assert last_snap is None or snap >= last_snap
+                if snap > 0:
+                    freed += ring.release_through(snap - 1)
+            else:
+                assert tuple(int(v) for v in got) == snap
+                assert last_snap is None or all(
+                    a >= b for a, b in zip(snap, last_snap))
+                freed += ring.release_shards(
+                    [w - 1 if w > 0 else None for w in snap])
+            # stability: the PREVIOUS grabbed array still reads its own
+            # snapshot after newer commits replaced the live slot
+            if prev is not None:
+                old_cur, old_snap = prev
+                old = np.asarray(old_cur)
+                if n_shards == 1:
+                    assert int(old[0]) == old_snap
+                else:
+                    assert tuple(int(v) for v in old) == old_snap
+            prev = (cur, snap)
+            last_snap = snap
+            # the dispatch donated the grabbed array: re-stage
+            ring.refresh_device_cursor()
+        else:
+            k = len(published)
+            if k > 0:
+                if n_shards == 1:
+                    freed += ring.release_through(published[k - 1])
+                else:
+                    freed += ring.release_shards(published[k - 1])
+        if done.is_set() and freed == M * n_shards:
+            break
+        time.sleep(0.0005)
+    t.join(timeout=10)
+    assert not errs, errs
+    assert len(published) == M
+    # exactly once: every slot of every publish freed, none twice
+    assert freed == M * n_shards
+    assert ring.occupancy() == 0
+    if n_shards == 4:
+        assert ring.refusals() == [0] * n_shards
+    if mode == "while":
+        # final snapshot converged on the full stream
+        _cur, snap = ring.device_cursor()
+        assert snap == (M if n_shards == 1 else (M,) * n_shards)
